@@ -1,0 +1,19 @@
+"""Test configuration.
+
+JAX-dependent tests (parallel/, models/, ops/) run on a virtual 8-device CPU
+mesh so multi-chip sharding is exercised without TPU hardware, per the
+driver's dry-run model.  The env vars must be set before jax import, hence
+here at conftest import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

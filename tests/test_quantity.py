@@ -1,0 +1,60 @@
+import pytest
+
+from tpu_dra.utils.quantity import Quantity, QuantityParseError
+
+
+class TestParse:
+    @pytest.mark.parametrize(
+        "text,expected_int",
+        [
+            ("0", 0),
+            ("1", 1),
+            ("16Gi", 16 * 1024**3),
+            ("1Ki", 1024),
+            ("2Mi", 2 * 1024**2),
+            ("1Ti", 1024**4),
+            ("1k", 1000),
+            ("1M", 10**6),
+            ("1G", 10**9),
+            ("-5", -5),
+            ("1e3", 1000),
+            ("1E3", 1000),
+        ],
+    )
+    def test_integer_values(self, text, expected_int):
+        assert Quantity(text).to_int() == expected_int
+
+    def test_millis(self):
+        q = Quantity("1500m")
+        assert q.cmp(Quantity("1.5")) == 0
+
+    def test_round_up(self):
+        assert Quantity("100m").to_int() == 1
+
+    @pytest.mark.parametrize("bad", ["", "abc", "1Gx", "--1", "1.2.3", "Gi"])
+    def test_invalid(self, bad):
+        with pytest.raises(QuantityParseError):
+            Quantity(bad)
+
+
+class TestCompare:
+    def test_cross_suffix(self):
+        assert Quantity("1Gi") > Quantity("1G")
+        assert Quantity("1024Mi") == Quantity("1Gi")
+        assert Quantity("16Gi") < Quantity("32Gi")
+
+    def test_cmp_values(self):
+        assert Quantity("1").cmp("2") == -1
+        assert Quantity("2").cmp("2") == 0
+        assert Quantity("3").cmp("2") == 1
+
+
+class TestSerialize:
+    def test_roundtrip_preserves_text(self):
+        assert str(Quantity("16Gi")) == "16Gi"
+
+    def test_int_to_binary_suffix(self):
+        assert str(Quantity(16 * 1024**3)) == "16Gi"
+
+    def test_plain_int(self):
+        assert str(Quantity(7)) == "7"

@@ -1,0 +1,162 @@
+"""Selector algebra tests (reference semantics: selector.go:73-185)."""
+
+from tpu_dra.api import serde
+from tpu_dra.api.selector import (
+    CompareOp,
+    QuantityComparator,
+    Selector,
+    VersionComparator,
+    glob_matches,
+)
+from tpu_dra.api.tpu_v1alpha1 import (
+    TpuSelector,
+    TpuSelectorProperties,
+    make_property_selector,
+)
+from tpu_dra.utils.quantity import Quantity
+
+
+class TestGlob:
+    def test_case_insensitive(self):
+        assert glob_matches("TPU-V5E*", "tpu-v5e-4")
+
+    def test_unanchored_search(self):
+        # The reference's regexp.MatchString is a search, not a full match.
+        assert glob_matches("v5e", "tpu-v5e-4")
+
+    def test_star(self):
+        assert glob_matches("tpu*4", "tpu-v5e-4")
+        assert not glob_matches("tpu*8", "tpu-v5e-4")
+
+    def test_meta_chars_quoted(self):
+        assert not glob_matches("tpu.v5e", "tpuxv5e")
+        assert glob_matches("tpu.v5e", "tpu.v5e")
+
+
+class TestComparators:
+    def test_quantity_ops(self):
+        c = QuantityComparator(Quantity("16Gi"), CompareOp.GREATER_THAN_OR_EQUAL_TO)
+        assert c.matches("16Gi")
+        assert c.matches("32Gi")
+        assert not c.matches("8Gi")
+
+    def test_quantity_less_than(self):
+        c = QuantityComparator(Quantity("16Gi"), CompareOp.LESS_THAN)
+        assert c.matches("8Gi")
+        assert not c.matches("16Gi")
+
+    def test_version_ops(self):
+        c = VersionComparator("1.10.0", CompareOp.GREATER_THAN)
+        assert c.matches("1.11.0")
+        assert c.matches("v1.11")  # leading v optional, missing patch = 0... 1.11 > 1.10
+        assert not c.matches("1.10.0")
+
+    def test_version_prerelease_sorts_below_release(self):
+        c = VersionComparator("2.0.0", CompareOp.LESS_THAN)
+        assert c.matches("2.0.0-rc1")
+
+
+class TestEvaluation:
+    def compare(self, want_index):
+        return lambda p: p == want_index
+
+    def test_empty_selector_is_false(self):
+        assert Selector().matches(lambda p: True) is False
+
+    def test_properties(self):
+        s = Selector(properties=3)
+        assert s.matches(self.compare(3))
+        assert not s.matches(self.compare(4))
+
+    def test_and_all_must_match(self):
+        s = Selector(and_expression=[Selector(properties=3), Selector(properties=4)])
+        assert not s.matches(self.compare(3))
+        assert s.matches(lambda p: True)
+
+    def test_empty_and_is_true(self):
+        assert Selector(and_expression=[]).matches(lambda p: False) is True
+
+    def test_empty_or_is_false(self):
+        assert Selector(or_expression=[]).matches(lambda p: True) is False
+
+    def test_or_any_matches(self):
+        s = Selector(or_expression=[Selector(properties=3), Selector(properties=4)])
+        assert s.matches(self.compare(3))
+        assert s.matches(self.compare(4))
+        assert not s.matches(self.compare(5))
+
+    def test_nesting(self):
+        s = Selector(
+            or_expression=[
+                Selector(
+                    and_expression=[Selector(properties=1), Selector(properties=1)]
+                ),
+                Selector(properties=9),
+            ]
+        )
+        assert s.matches(self.compare(1))
+        assert s.matches(self.compare(9))
+        assert not s.matches(self.compare(2))
+
+
+class TestTpuSelectorJson:
+    def test_inline_property_shape(self):
+        s = make_property_selector(product="tpu-v5e*")
+        assert serde.to_dict(s) == {"product": "tpu-v5e*"}
+
+    def test_and_shape(self):
+        s = TpuSelector(
+            and_expression=[
+                make_property_selector(generation="v5e"),
+                make_property_selector(
+                    hbm=QuantityComparator(
+                        Quantity("16Gi"), CompareOp.GREATER_THAN_OR_EQUAL_TO
+                    )
+                ),
+            ]
+        )
+        obj = serde.to_dict(s)
+        assert obj == {
+            "andExpression": [
+                {"generation": "v5e"},
+                {"hbm": {"value": "16Gi", "operator": "GreaterThanOrEqualTo"}},
+            ]
+        }
+
+    def test_roundtrip(self):
+        obj = {
+            "orExpression": [
+                {"index": 0},
+                {
+                    "andExpression": [
+                        {"partitionable": True},
+                        {"libtpuVersion": {"value": "1.0.0", "operator": "GreaterThan"}},
+                    ]
+                },
+            ]
+        }
+        s = TpuSelector.__from_json__(obj)
+        assert serde.to_dict(s) == obj
+        assert s.or_expression[0].properties.index == 0
+        inner = s.or_expression[1].and_expression
+        assert inner[0].properties.partitionable is True
+        assert inner[1].properties.libtpu_version.operator == CompareOp.GREATER_THAN
+
+    def test_evaluation_against_properties(self):
+        s = TpuSelector.__from_json__(
+            {
+                "andExpression": [
+                    {"generation": "v5e"},
+                    {"hbm": {"value": "8Gi", "operator": "GreaterThan"}},
+                ]
+            }
+        )
+
+        def compare(p: TpuSelectorProperties) -> bool:
+            if p.generation is not None:
+                return glob_matches(p.generation, "v5e")
+            if p.hbm is not None:
+                return p.hbm.matches(Quantity("16Gi"))
+            return False
+
+        assert s.matches(compare)

@@ -1,0 +1,225 @@
+"""Serde + API-type round-trip tests."""
+
+from tpu_dra.api import serde
+from tpu_dra.api.meta import ObjectMeta, OwnerReference
+from tpu_dra.api.nas_v1alpha1 import (
+    AllocatableDevice,
+    AllocatableSubslice,
+    AllocatableTpu,
+    AllocatedDevices,
+    AllocatedTpu,
+    AllocatedTpus,
+    ClaimInfo,
+    NodeAllocationState,
+    NodeAllocationStateSpec,
+    PreparedDevices,
+    PreparedSubslice,
+    PreparedSubslices,
+)
+from tpu_dra.api.sharing import (
+    RuntimeProxyConfig,
+    SharingStrategy,
+    TimeSliceInterval,
+    TimeSlicingConfig,
+    TpuSharing,
+)
+from tpu_dra.api.topology import Placement
+from tpu_dra.api.tpu_v1alpha1 import (
+    DeviceClassParameters,
+    DeviceClassParametersSpec,
+    TpuClaimParameters,
+    TpuClaimParametersSpec,
+    default_device_class_parameters_spec,
+    default_tpu_claim_parameters_spec,
+    make_property_selector,
+)
+from tpu_dra.utils.quantity import Quantity
+
+
+class TestSerdeBasics:
+    def test_camel_case(self):
+        assert serde.snake_to_camel("hbm_bytes") == "hbmBytes"
+        assert serde.snake_to_camel("uuid") == "uuid"
+
+    def test_omitempty(self):
+        meta = ObjectMeta(name="n")
+        d = serde.to_dict(meta)
+        assert d == {"name": "n"}
+
+    def test_unknown_keys_ignored(self):
+        meta = serde.from_dict(ObjectMeta, {"name": "n", "bogus": 1})
+        assert meta.name == "n"
+
+    def test_owner_refs(self):
+        meta = ObjectMeta(
+            name="n",
+            owner_references=[
+                OwnerReference(api_version="v1", kind="Node", name="node1", uid="u1")
+            ],
+        )
+        d = serde.to_dict(meta)
+        assert d["ownerReferences"][0]["apiVersion"] == "v1"
+        back = serde.from_dict(ObjectMeta, d)
+        assert back.owner_references[0].kind == "Node"
+
+
+class TestSharingTypes:
+    def test_defaults(self):
+        s = TpuSharing()
+        assert s.is_time_slicing()
+        assert s.get_time_slicing_config().interval == TimeSliceInterval.DEFAULT
+
+    def test_wrong_strategy_raises(self):
+        import pytest
+
+        from tpu_dra.api.sharing import SharingValidationError, SubsliceSharing
+
+        s = TpuSharing(strategy=SharingStrategy.TIME_SLICING)
+        with pytest.raises(SharingValidationError):
+            s.get_runtime_proxy_config()
+        sub = SubsliceSharing(strategy=SharingStrategy.RUNTIME_PROXY)
+        with pytest.raises(SharingValidationError):
+            sub.get_runtime_proxy_config()
+
+    def test_normalize(self):
+        # Reference's one unit-tested routine: sharing_test.go:28-91.
+        cfg = RuntimeProxyConfig(
+            default_hbm_limit=Quantity("4Gi"),
+            per_chip_hbm_limit={"uuid2": Quantity("8Gi")},
+        )
+        out = cfg.normalize(["uuid1", "uuid2"])
+        assert out == {"uuid1": Quantity("4Gi"), "uuid2": Quantity("8Gi")}
+
+    def test_normalize_default_key(self):
+        cfg = RuntimeProxyConfig(per_chip_hbm_limit={"default": Quantity("2Gi")})
+        out = cfg.normalize(["a", "b"])
+        assert out == {"a": Quantity("2Gi"), "b": Quantity("2Gi")}
+
+    def test_normalize_empty(self):
+        assert RuntimeProxyConfig().normalize(["a"]) == {}
+
+    def test_roundtrip(self):
+        s = TpuSharing(
+            strategy=SharingStrategy.RUNTIME_PROXY,
+            runtime_proxy_config=RuntimeProxyConfig(
+                max_active_core_percentage=50,
+                default_hbm_limit=Quantity("4Gi"),
+            ),
+        )
+        d = serde.to_dict(s)
+        assert d["strategy"] == "RuntimeProxy"
+        back = serde.from_dict(TpuSharing, d)
+        assert back.runtime_proxy_config.max_active_core_percentage == 50
+        assert back.runtime_proxy_config.default_hbm_limit == Quantity("4Gi")
+
+
+class TestClaimParameterTypes:
+    def test_tpu_claim_roundtrip(self):
+        params = TpuClaimParameters(
+            metadata=ObjectMeta(name="my-claim", namespace="default"),
+            spec=TpuClaimParametersSpec(
+                topology="2x2x1",
+                selector=make_property_selector(generation="v5e"),
+                sharing=TpuSharing(time_slicing_config=TimeSlicingConfig()),
+            ),
+        )
+        d = serde.to_dict(params)
+        assert d["kind"] == "TpuClaimParameters"
+        assert d["spec"]["topology"] == "2x2x1"
+        assert d["spec"]["selector"] == {"generation": "v5e"}
+        back = serde.from_dict(TpuClaimParameters, d)
+        assert back.spec.selector.properties.generation == "v5e"
+        assert back.spec.topology == "2x2x1"
+
+    def test_device_class_sharable_json_key(self):
+        # json key "sharable" [sic] matches the reference (deviceclass.go:25).
+        d = serde.to_dict(
+            DeviceClassParameters(spec=DeviceClassParametersSpec(shareable=True))
+        )
+        assert d["spec"] == {"sharable": True}
+
+    def test_defaulting(self):
+        spec = default_tpu_claim_parameters_spec(None)
+        assert spec.count == 1
+        spec2 = default_tpu_claim_parameters_spec(TpuClaimParametersSpec(topology="2x2"))
+        assert spec2.count is None and spec2.topology == "2x2"
+        dc = default_device_class_parameters_spec(None)
+        assert dc.shareable is True
+
+
+class TestNasTypes:
+    def make_nas(self):
+        return NodeAllocationState(
+            metadata=ObjectMeta(name="node1", namespace="tpu-dra"),
+            spec=NodeAllocationStateSpec(
+                allocatable_devices=[
+                    AllocatableDevice(
+                        tpu=AllocatableTpu(
+                            index=0,
+                            uuid="tpu-0",
+                            coord=(0, 0, 0),
+                            ici_domain="host-0",
+                            cores=4,
+                            hbm_bytes=16 * 1024**3,
+                            product="tpu-v5e",
+                            generation="v5e",
+                            partitionable=True,
+                        )
+                    ),
+                    AllocatableDevice(
+                        subslice=AllocatableSubslice(
+                            profile="1c.4gb",
+                            parent_product="tpu-v5e",
+                            placements=[Placement(0, 1), Placement(1, 1)],
+                        )
+                    ),
+                ],
+                allocated_claims={
+                    "uid-1": AllocatedDevices(
+                        claim_info=ClaimInfo(namespace="default", name="c1", uid="uid-1"),
+                        tpu=AllocatedTpus(
+                            devices=[AllocatedTpu(uuid="tpu-0", coord=(0, 0, 0))],
+                            topology="1x1x1",
+                        ),
+                    )
+                },
+                prepared_claims={
+                    "uid-1": PreparedDevices(
+                        subslice=PreparedSubslices(
+                            devices=[
+                                PreparedSubslice(
+                                    uuid="ss-1",
+                                    profile="1c.4gb",
+                                    parent_uuid="tpu-0",
+                                    placement=Placement(0, 1),
+                                )
+                            ]
+                        )
+                    )
+                },
+            ),
+            status="Ready",
+        )
+
+    def test_device_type(self):
+        nas = self.make_nas()
+        assert nas.spec.allocatable_devices[0].type() == "tpu"
+        assert nas.spec.allocatable_devices[1].type() == "subslice"
+        assert AllocatableDevice().type() == "unknown"
+        assert nas.spec.allocated_claims["uid-1"].type() == "tpu"
+        assert nas.spec.prepared_claims["uid-1"].type() == "subslice"
+
+    def test_roundtrip(self):
+        nas = self.make_nas()
+        d = serde.to_dict(nas)
+        assert d["spec"]["allocatableDevices"][0]["tpu"]["coord"] == [0, 0, 0]
+        back = serde.from_dict(NodeAllocationState, d)
+        assert back.spec.allocatable_devices[0].tpu.coord == (0, 0, 0)
+        assert back.spec.allocated_claims["uid-1"].tpu.devices[0].uuid == "tpu-0"
+        assert back.spec.prepared_claims["uid-1"].subslice.devices[0].placement == Placement(0, 1)
+
+    def test_deepcopy_independent(self):
+        nas = self.make_nas()
+        copy = serde.deepcopy(nas)
+        copy.spec.allocated_claims["uid-1"].tpu.devices[0].uuid = "changed"
+        assert nas.spec.allocated_claims["uid-1"].tpu.devices[0].uuid == "tpu-0"

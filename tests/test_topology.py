@@ -1,0 +1,104 @@
+import pytest
+
+from tpu_dra.api.topology import (
+    Placement,
+    SubsliceProfile,
+    Topology,
+    coord_str,
+    parse_coord,
+)
+
+
+class TestCoord:
+    def test_parse_comma(self):
+        assert parse_coord("1,2,3") == (1, 2, 3)
+
+    def test_parse_2d_defaults_z(self):
+        assert parse_coord("1,2") == (1, 2, 0)
+
+    def test_parse_sequence(self):
+        assert parse_coord([0, 1]) == (0, 1, 0)
+
+    def test_roundtrip(self):
+        assert coord_str(parse_coord("3,2,1")) == "3,2,1"
+
+    @pytest.mark.parametrize("bad", ["", "1", "1,2,3,4", "-1,0,0", "a,b"])
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            parse_coord(bad)
+
+
+class TestTopology:
+    def test_parse_3d(self):
+        t = Topology.parse("2x2x1")
+        assert t.dims() == (2, 2, 1)
+        assert t.size == 4
+
+    def test_parse_2d(self):
+        assert Topology.parse("4x4").dims() == (4, 4, 1)
+
+    def test_str_roundtrip(self):
+        assert str(Topology.parse("2x4x2")) == "2x4x2"
+
+    @pytest.mark.parametrize("bad", ["", "2x", "0x1x1", "2x2x2x2", "axb"])
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            Topology.parse(bad)
+
+    def test_orientations_distinct(self):
+        t = Topology.parse("2x1x1")
+        dims = {o.dims() for o in t.orientations()}
+        assert dims == {(2, 1, 1), (1, 2, 1), (1, 1, 2)}
+
+    def test_orientations_cube(self):
+        assert len(Topology.parse("2x2x2").orientations()) == 1
+
+    def test_coords_from(self):
+        t = Topology.parse("2x2x1")
+        coords = list(t.coords_from((1, 1, 0)))
+        assert coords == [(1, 1, 0), (2, 1, 0), (1, 2, 0), (2, 2, 0)]
+
+    def test_fits_within(self):
+        assert Topology.parse("2x2x1").fits_within(Topology.parse("2x2x1"))
+        assert not Topology.parse("4x1x1").fits_within(Topology.parse("2x2x1"))
+
+
+class TestSubsliceProfile:
+    def test_parse(self):
+        p = SubsliceProfile.parse("1c.4gb")
+        assert (p.cores, p.hbm_gb) == (1, 4)
+        assert str(p) == "1c.4gb"
+
+    def test_parse_case_insensitive(self):
+        assert SubsliceProfile.parse("2C.8GB") == SubsliceProfile(2, 8)
+
+    @pytest.mark.parametrize("bad", ["", "1c", "4gb", "0c.4gb", "1c.0gb", "c.gb"])
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            SubsliceProfile.parse(bad)
+
+    def test_profiles_for_chip(self):
+        # 4-core chip with 16 GiB: 1c.4gb, 2c.8gb, 4c.16gb
+        profiles = SubsliceProfile.profiles_for_chip(4, 16 * 1024**3)
+        assert [str(p) for p in profiles] == ["1c.4gb", "2c.8gb", "4c.16gb"]
+
+    def test_placements_aligned(self):
+        p = SubsliceProfile(1, 4)
+        assert p.placements(4) == [
+            Placement(0, 1),
+            Placement(1, 1),
+            Placement(2, 1),
+            Placement(3, 1),
+        ]
+        p2 = SubsliceProfile(2, 8)
+        assert p2.placements(4) == [Placement(0, 2), Placement(2, 2)]
+
+    def test_placements_too_big(self):
+        assert SubsliceProfile(8, 32).placements(4) == []
+
+
+class TestPlacement:
+    def test_overlap(self):
+        assert Placement(0, 2).overlaps(Placement(1, 2))
+        assert not Placement(0, 2).overlaps(Placement(2, 2))
+        assert Placement(1, 1).overlaps(Placement(0, 4))

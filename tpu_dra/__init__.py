@@ -1,0 +1,29 @@
+"""tpu_dra — a TPU-native Kubernetes Dynamic Resource Allocation (DRA) driver.
+
+Built from scratch with the capabilities of NVIDIA's k8s-dra-driver (the
+reference surveyed in SURVEY.md): Kubernetes ResourceClaims allocate Cloud TPU
+chips.  The package layout mirrors the reference's layer map (SURVEY.md §1)
+re-designed TPU-first:
+
+- ``tpu_dra.api``        — CRD types: claim parameters, NodeAllocationState,
+                           sharing config, selector algebra, topology model
+                           (reference layer L1, ``api/``).
+- ``tpu_dra.client``     — typed clientset + in-memory fake apiserver for
+                           hardware/cluster-free testing (reference layer L2).
+- ``tpu_dra.controller`` — cluster-level allocation brain: reconcile loop,
+                           driver dispatch, ICI-topology-aware allocators
+                           (reference layers L3+L4a).
+- ``tpu_dra.plugin``     — per-node kubelet plugin: device discovery (tpulib),
+                           DeviceState, CDI spec generation, sharing actuation,
+                           gRPC servers (reference layers L3+L4b).
+- ``tpu_dra.parallel``   — JAX mesh/collectives validation of allocated ICI
+                           domains (psum bandwidth, gang all-reduce).
+- ``tpu_dra.models``     — flagship pjit-sharded validation workload run by
+                           claiming pods to prove the slice works end to end.
+- ``tpu_dra.ops``        — Pallas TPU kernels used by the validation workload.
+- ``tpu_dra.utils``      — Quantity, version compare, misc shared helpers.
+"""
+
+from tpu_dra.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,9 @@
+"""API layer — the contract (reference layer L1, ``api/``).
+
+CRD-shaped types for the two API groups:
+
+- ``tpu.resource.google.com/v1alpha1`` — user-facing claim parameters
+  (reference: api/nvidia.com/resource/gpu/v1alpha1).
+- ``nas.tpu.resource.google.com/v1alpha1`` — per-node NodeAllocationState
+  (reference: api/nvidia.com/resource/gpu/nas/v1alpha1).
+"""

@@ -1,0 +1,43 @@
+"""Object/Type metadata — the subset of k8s apimachinery metav1 the driver uses.
+
+Reference types embed metav1.TypeMeta/ObjectMeta (e.g.
+api/nvidia.com/resource/gpu/nas/v1alpha1/nas.go:169-175); this is the
+from-scratch Python equivalent with only the fields the driver reads/writes:
+name/namespace/uid for identity, resourceVersion for optimistic concurrency,
+ownerReferences for NAS->Node lifetime binding
+(pkg/flags/nodeallocationstate.go:62-80), labels for selection, finalizers for
+the claim lifecycle (vendored controller.go:405-506).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    generation: int = field(default=0, metadata={"omitzero": True})
+    creation_timestamp: str = ""
+    deletion_timestamp: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    owner_references: list[OwnerReference] = field(default_factory=list)
+    finalizers: list[str] = field(default_factory=list)
+
+
+@dataclass
+class TypeMeta:
+    api_version: str = ""
+    kind: str = ""

@@ -1,0 +1,185 @@
+"""NodeAllocationState CRD for group ``nas.tpu.resource.google.com/v1alpha1``.
+
+Reference: api/nvidia.com/resource/gpu/nas/v1alpha1/{api.go,nas.go}
+(component C8).  The NAS object is the system of record through which the
+controller and node plugin communicate — they never talk directly
+(SURVEY.md overview).  Spec carries three sections (nas.go:155-159):
+
+- ``allocatable_devices`` — what the node discovered (published by plugin),
+- ``allocated_claims``    — claimUID -> devices (written by controller),
+- ``prepared_claims``     — claimUID -> devices (written by plugin).
+
+Status is the Ready/NotReady readiness handshake (api.go:31-32).
+
+TPU-first deltas vs the reference: every allocatable chip carries its ICI
+mesh coordinate and domain id so the controller can pack contiguous
+sub-meshes (the reference publishes no interconnect info at all — SURVEY.md
+§2 flags that as the gap to fix); allocated whole-chip entries retain the
+coordinate so the node plugin can reconstruct the claimed mesh for env
+injection (TPU runtimes need host-bounds/visible-chips env, not just device
+nodes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.sharing import SubsliceSharing, TpuSharing
+from tpu_dra.api.topology import Coord, Placement
+
+GROUP_NAME = "nas.tpu.resource.google.com"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP_NAME}/{VERSION}"
+NODE_ALLOCATION_STATE_KIND = "NodeAllocationState"
+
+TPU_DEVICE_TYPE = "tpu"
+SUBSLICE_DEVICE_TYPE = "subslice"
+UNKNOWN_DEVICE_TYPE = "unknown"
+
+STATUS_READY = "Ready"
+STATUS_NOT_READY = "NotReady"
+
+
+@dataclass
+class ClaimInfo:
+    """Identifying info about a claim (nas.go:24-28)."""
+
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class AllocatableTpu:
+    """An allocatable whole chip (AllocatableGpu analog, nas.go:37-46) plus
+    ICI topology attributes."""
+
+    index: int = 0
+    uuid: str = ""
+    coord: Coord = (0, 0, 0)  # chip coordinate in the host's ICI mesh
+    ici_domain: str = ""  # slice/pod interconnect domain id
+    cores: int = 1
+    hbm_bytes: int = 0
+    product: str = ""  # e.g. "tpu-v5e"
+    generation: str = ""  # e.g. "v5e"
+    partitionable: bool = False  # supports core subslicing (migEnabled analog)
+    libtpu_version: str = ""
+    runtime_version: str = ""
+
+
+@dataclass
+class AllocatableSubslice:
+    """An allocatable subslice profile and its placements on a parent chip
+    product (AllocatableMigDevice analog, nas.go:49-53)."""
+
+    profile: str = ""
+    parent_product: str = ""
+    placements: list[Placement] = field(default_factory=list)
+
+
+@dataclass
+class AllocatableDevice:
+    tpu: AllocatableTpu | None = None
+    subslice: AllocatableSubslice | None = None
+
+    def type(self) -> str:
+        if self.tpu is not None:
+            return TPU_DEVICE_TYPE
+        if self.subslice is not None:
+            return SUBSLICE_DEVICE_TYPE
+        return UNKNOWN_DEVICE_TYPE
+
+
+@dataclass
+class AllocatedTpu:
+    uuid: str = ""
+    coord: Coord = (0, 0, 0)
+
+
+@dataclass
+class AllocatedSubslice:
+    profile: str = ""
+    parent_uuid: str = ""
+    placement: Placement = field(default_factory=lambda: Placement(0, 0))
+
+
+@dataclass
+class AllocatedTpus:
+    devices: list[AllocatedTpu] = field(default_factory=list)
+    # Topology actually granted, e.g. "2x2x1", when the claim requested one.
+    topology: str = ""
+    sharing: TpuSharing | None = None
+
+
+@dataclass
+class AllocatedSubslices:
+    devices: list[AllocatedSubslice] = field(default_factory=list)
+    sharing: SubsliceSharing | None = None
+
+
+@dataclass
+class AllocatedDevices:
+    claim_info: ClaimInfo | None = None
+    tpu: AllocatedTpus | None = None
+    subslice: AllocatedSubslices | None = None
+
+    def type(self) -> str:
+        if self.tpu is not None:
+            return TPU_DEVICE_TYPE
+        if self.subslice is not None:
+            return SUBSLICE_DEVICE_TYPE
+        return UNKNOWN_DEVICE_TYPE
+
+
+@dataclass
+class PreparedTpu:
+    uuid: str = ""
+    coord: Coord = (0, 0, 0)
+
+
+@dataclass
+class PreparedSubslice:
+    uuid: str = ""  # uuid of the created subslice device
+    profile: str = ""
+    parent_uuid: str = ""
+    placement: Placement = field(default_factory=lambda: Placement(0, 0))
+
+
+@dataclass
+class PreparedTpus:
+    devices: list[PreparedTpu] = field(default_factory=list)
+
+
+@dataclass
+class PreparedSubslices:
+    devices: list[PreparedSubslice] = field(default_factory=list)
+
+
+@dataclass
+class PreparedDevices:
+    tpu: PreparedTpus | None = None
+    subslice: PreparedSubslices | None = None
+
+    def type(self) -> str:
+        if self.tpu is not None:
+            return TPU_DEVICE_TYPE
+        if self.subslice is not None:
+            return SUBSLICE_DEVICE_TYPE
+        return UNKNOWN_DEVICE_TYPE
+
+
+@dataclass
+class NodeAllocationStateSpec:
+    allocatable_devices: list[AllocatableDevice] = field(default_factory=list)
+    allocated_claims: dict[str, AllocatedDevices] = field(default_factory=dict)
+    prepared_claims: dict[str, PreparedDevices] = field(default_factory=dict)
+
+
+@dataclass
+class NodeAllocationState:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeAllocationStateSpec = field(default_factory=NodeAllocationStateSpec)
+    status: str = ""
+    kind: str = NODE_ALLOCATION_STATE_KIND
+    api_version: str = API_VERSION

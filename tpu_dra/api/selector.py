@@ -1,0 +1,105 @@
+"""Generic selector algebra (reference: api/utils/selector/selector.go:31-185).
+
+A Selector over a properties type P is either a single P (one condition) or a
+list of sub-selectors combined with And/Or.  Evaluation semantics mirror the
+reference exactly:
+
+- properties set        -> compare(properties)
+- and_expression set    -> all sub-selectors match (empty list => True)
+- or_expression set     -> any sub-selector matches (empty list => False)
+- nothing set           -> False
+
+Comparators:
+
+- glob: case-insensitive, ``*`` wildcard, *unanchored* (the reference's
+  ``regexp.MatchString`` searches anywhere in the string,
+  selector.go:127-132,174-185) — so ``"v5e*"`` matches ``"tpu-v5e-4"``.
+- quantity: k8s resource.Quantity comparison (selector.go:135-138).
+- version: semver comparison with optional leading 'v' (selector.go:141-153).
+
+The reference needs three hand-unrolled nesting levels because CRD OpenAPI
+schemas cannot recurse (gpuselector.go:28-58); in Python the type recurses
+naturally and the CRD generator (tpu_dra/api/crdgen.py) unrolls to the same
+three levels when emitting YAML.
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Generic, TypeVar
+
+from tpu_dra.utils.quantity import Quantity
+from tpu_dra.utils.versioncmp import compare_versions
+
+P = TypeVar("P")
+
+
+class CompareOp(str, enum.Enum):
+    EQUALS = "Equals"
+    LESS_THAN = "LessThan"
+    LESS_THAN_OR_EQUAL_TO = "LessThanOrEqualTo"
+    GREATER_THAN = "GreaterThan"
+    GREATER_THAN_OR_EQUAL_TO = "GreaterThanOrEqualTo"
+
+
+def _check_compare(value: int, op: "CompareOp | str") -> bool:
+    op = CompareOp(op)
+    if op is CompareOp.EQUALS:
+        return value == 0
+    if op is CompareOp.LESS_THAN:
+        return value < 0
+    if op is CompareOp.LESS_THAN_OR_EQUAL_TO:
+        return value <= 0
+    if op is CompareOp.GREATER_THAN:
+        return value > 0
+    if op is CompareOp.GREATER_THAN_OR_EQUAL_TO:
+        return value >= 0
+    return False
+
+
+def glob_matches(pattern: str, value: str) -> bool:
+    """Case-insensitive unanchored glob match (``*`` -> ``.*``)."""
+    parts = pattern.lower().split("*")
+    regex = ".*".join(re.escape(p) for p in parts)
+    return re.search(regex, value.lower()) is not None
+
+
+@dataclass
+class QuantityComparator:
+    """Compares a resource quantity (e.g. HBM bytes) against a bound."""
+
+    value: Quantity = field(default_factory=lambda: Quantity(0))
+    operator: CompareOp = CompareOp.EQUALS
+
+    def matches(self, quantity: "Quantity | str | int") -> bool:
+        q = quantity if isinstance(quantity, Quantity) else Quantity(quantity)
+        return _check_compare(q.cmp(self.value), self.operator)
+
+
+@dataclass
+class VersionComparator:
+    """Compares a semver string (e.g. libtpu version) against a bound."""
+
+    value: str = ""
+    operator: CompareOp = CompareOp.EQUALS
+
+    def matches(self, version: str) -> bool:
+        return _check_compare(compare_versions(version, self.value), self.operator)
+
+
+@dataclass
+class Selector(Generic[P]):
+    properties: P | None = None
+    and_expression: "list[Selector[P]] | None" = None
+    or_expression: "list[Selector[P]] | None" = None
+
+    def matches(self, compare: Callable[[P], bool]) -> bool:
+        if self.properties is not None:
+            return compare(self.properties)
+        if self.and_expression is not None:
+            return all(s.matches(compare) for s in self.and_expression)
+        if self.or_expression is not None:
+            return any(s.matches(compare) for s in self.or_expression)
+        return False

@@ -1,0 +1,154 @@
+"""Dataclass <-> JSON-object serialization for CRD-shaped types.
+
+The reference gets this for free from Go's ``encoding/json`` struct tags and
+generated DeepCopy methods.  Here one small reflective layer provides the same
+three capabilities for every API type:
+
+- ``to_dict(obj)``    — camelCase JSON object, omitting None/empty
+                        ("omitempty" semantics, which k8s API types rely on).
+- ``from_dict(cls, data)`` — typed reconstruction, tolerant of unknown keys
+                        (k8s API compatibility rule: unknown fields ignored).
+- ``deepcopy(obj)``   — structural copy via round-trip (DeepCopy analog).
+
+Supported field types: primitives, Optional, list/dict, nested dataclasses,
+enums (by value), ``Quantity`` (canonical string form), and tuples of ints
+(serialized as JSON arrays — used for chip coordinates).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import types
+import typing
+from typing import Any, TypeVar, get_args, get_origin, get_type_hints
+
+from tpu_dra.utils.quantity import Quantity
+
+T = TypeVar("T")
+
+_HINTS_CACHE: dict[type, dict[str, Any]] = {}
+
+
+def json_name(field: dataclasses.Field) -> str:
+    """JSON key for a dataclass field: explicit override or camelCase."""
+    override = field.metadata.get("json")
+    if override:
+        return override
+    return snake_to_camel(field.name)
+
+
+def snake_to_camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _is_empty(value: Any, omitzero: bool = False) -> bool:
+    # "omitempty": None, empty string, empty collection.  Unlike Go, 0 and
+    # False are NOT omitted by default — the reference's zero-meaningful
+    # fields (Placement.start, AllocatableGpu.index, ...) carry no omitempty
+    # tag.  Fields tagged metadata={"omitzero": True} opt in to Go behavior.
+    if value is None:
+        return True
+    if isinstance(value, str) and value == "":
+        return True
+    if isinstance(value, (list, dict)) and not value:
+        return True
+    if omitzero and (value is False or (isinstance(value, int) and value == 0)):
+        return True
+    return False
+
+
+def to_dict(obj: Any) -> Any:
+    """Recursively serialize a value to JSON-compatible primitives."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if hasattr(type(obj), "__to_json__"):
+        return obj.__to_json__()
+    if isinstance(obj, Quantity):
+        return str(obj)
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items()}
+    if dataclasses.is_dataclass(obj):
+        out = {}
+        for f in dataclasses.fields(obj):
+            value = getattr(obj, f.name)
+            if f.metadata.get("omitempty", True) and _is_empty(
+                value, f.metadata.get("omitzero", False)
+            ):
+                continue
+            out[json_name(f)] = to_dict(value)
+        return out
+    raise TypeError(f"cannot serialize {type(obj).__name__}: {obj!r}")
+
+
+def _type_hints(cls: type) -> dict[str, Any]:
+    hints = _HINTS_CACHE.get(cls)
+    if hints is None:
+        hints = get_type_hints(cls)
+        _HINTS_CACHE[cls] = hints
+    return hints
+
+
+def _from_value(hint: Any, value: Any) -> Any:
+    if value is None:
+        return None
+    origin = get_origin(hint)
+    # Optional[X] / X | None
+    if origin is typing.Union or origin is types.UnionType:
+        args = [a for a in get_args(hint) if a is not type(None)]
+        if len(args) == 1:
+            return _from_value(args[0], value)
+        # Heterogeneous unions are not used by API types.
+        return value
+    if origin in (list, typing.List):
+        (item_t,) = get_args(hint) or (Any,)
+        return [_from_value(item_t, v) for v in value]
+    if origin in (tuple, typing.Tuple):
+        args = get_args(hint)
+        item_t = args[0] if args else Any
+        return tuple(_from_value(item_t, v) for v in value)
+    if origin in (dict, typing.Dict):
+        args = get_args(hint)
+        val_t = args[1] if len(args) == 2 else Any
+        return {k: _from_value(val_t, v) for k, v in value.items()}
+    if isinstance(hint, type):
+        if hasattr(hint, "__from_json__"):
+            return hint.__from_json__(value)
+        if dataclasses.is_dataclass(hint):
+            return from_dict(hint, value)
+        if issubclass(hint, enum.Enum):
+            return hint(value)
+        if issubclass(hint, Quantity):
+            return Quantity(value)
+        if hint is float and isinstance(value, int):
+            return float(value)
+    return value
+
+
+def from_dict(cls: type[T], data: dict | None) -> T:
+    """Reconstruct dataclass ``cls`` from a JSON object (unknown keys ignored)."""
+    if data is None:
+        data = {}
+    if not isinstance(data, dict):
+        raise TypeError(f"expected object for {cls.__name__}, got {data!r}")
+    if hasattr(cls, "__from_json__"):
+        return cls.__from_json__(data)  # type: ignore[attr-defined]
+    hints = _type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        key = json_name(f)
+        if key in data:
+            kwargs[f.name] = _from_value(hints[f.name], data[key])
+    return cls(**kwargs)
+
+
+def deepcopy(obj: T) -> T:
+    """Structural copy of an API object (DeepCopy analog)."""
+    if obj is None:
+        return None
+    return from_dict(type(obj), to_dict(obj))

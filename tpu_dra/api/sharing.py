@@ -1,0 +1,129 @@
+"""Sharing strategy config types (reference:
+api/nvidia.com/resource/gpu/nas/v1alpha1/sharing.go:27-221, component C11).
+
+The reference offers two temporal-sharing strategies for a claimed device:
+TimeSlicing (driver-level scheduler quanta) and MPS (a per-claim control
+daemon that multiplexes client processes onto one device).  The TPU-native
+equivalents:
+
+- ``TimeSlicing`` — program-level preemption quanta enforced by the TPU
+  runtime scheduler; the interval enum maps to a scheduler quantum exactly
+  like TimeSlicingConfig's Default/Short/Medium/Long -> int mapping
+  (sharing.go:174-186).
+- ``RuntimeProxy`` (MPS analog) — a per-claim proxy daemon owns the chip's
+  device nodes and serves IFRT/PJRT clients from the claim's consumer
+  containers over a unix socket; limits mirror MpsConfig's active-thread
+  percentage and per-device pinned-memory limits (sharing.go:191-221),
+  re-expressed as core percentage and per-chip HBM limits.
+
+Subslice claims only support TimeSlicing, mirroring MigDeviceSharing's
+rejection of MPS (sharing.go:79-98).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from tpu_dra.utils.quantity import Quantity
+
+
+class SharingStrategy(str, enum.Enum):
+    TIME_SLICING = "TimeSlicing"
+    RUNTIME_PROXY = "RuntimeProxy"
+
+
+class TimeSliceInterval(str, enum.Enum):
+    DEFAULT = "Default"
+    SHORT = "Short"
+    MEDIUM = "Medium"
+    LONG = "Long"
+
+    def int_value(self) -> int:
+        """Scheduler quantum in milliseconds (analog of sharing.go:174-186's
+        enum->int mapping passed to `nvidia-smi compute-policy`)."""
+        return {
+            TimeSliceInterval.DEFAULT: 0,  # 0 == runtime default
+            TimeSliceInterval.SHORT: 1,
+            TimeSliceInterval.MEDIUM: 2,
+            TimeSliceInterval.LONG: 4,
+        }[self]
+
+
+@dataclass
+class TimeSlicingConfig:
+    interval: TimeSliceInterval = TimeSliceInterval.DEFAULT
+
+
+@dataclass
+class RuntimeProxyConfig:
+    """Limits applied by the per-claim runtime proxy daemon (MpsConfig analog).
+
+    ``per_chip_hbm_limit`` maps chip UUID (or "default") to an HBM cap, the
+    analog of MpsConfig.PerDevicePinnedMemoryLimit (sharing.go:205-221).
+    """
+
+    max_active_core_percentage: int | None = None
+    default_hbm_limit: Quantity | None = None
+    per_chip_hbm_limit: dict[str, Quantity] = field(default_factory=dict)
+
+    def normalize(self, uuids: list[str]) -> dict[str, Quantity]:
+        """Expand default + per-chip overrides into an explicit per-UUID map
+        (reference: MpsPerDevicePinnedMemoryLimit.Normalize, sharing.go:191-221,
+        the one routine the reference unit-tests, sharing_test.go:28-91)."""
+        out: dict[str, Quantity] = {}
+        for uuid in uuids:
+            if self.default_hbm_limit is not None:
+                out[uuid] = self.default_hbm_limit
+        for key, limit in self.per_chip_hbm_limit.items():
+            if key == "default":
+                for uuid in uuids:
+                    out.setdefault(uuid, limit)
+                continue
+            if key in uuids:
+                out[key] = limit
+        return out
+
+
+class SharingValidationError(ValueError):
+    pass
+
+
+@dataclass
+class TpuSharing:
+    """Sharing settings for whole-chip claims (GpuSharing analog)."""
+
+    strategy: SharingStrategy = SharingStrategy.TIME_SLICING
+    time_slicing_config: TimeSlicingConfig | None = None
+    runtime_proxy_config: RuntimeProxyConfig | None = None
+
+    def is_time_slicing(self) -> bool:
+        return self.strategy == SharingStrategy.TIME_SLICING
+
+    def is_runtime_proxy(self) -> bool:
+        return self.strategy == SharingStrategy.RUNTIME_PROXY
+
+    def get_time_slicing_config(self) -> TimeSlicingConfig:
+        if not self.is_time_slicing():
+            raise SharingValidationError(
+                f"strategy is {self.strategy.value}, not TimeSlicing"
+            )
+        return self.time_slicing_config or TimeSlicingConfig()
+
+    def get_runtime_proxy_config(self) -> RuntimeProxyConfig:
+        if not self.is_runtime_proxy():
+            raise SharingValidationError(
+                f"strategy is {self.strategy.value}, not RuntimeProxy"
+            )
+        return self.runtime_proxy_config or RuntimeProxyConfig()
+
+
+@dataclass
+class SubsliceSharing(TpuSharing):
+    """Sharing settings for subslice claims: TimeSlicing only
+    (MigDeviceSharing analog — MPS on MIG is rejected, sharing.go:79-98)."""
+
+    def get_runtime_proxy_config(self) -> RuntimeProxyConfig:
+        raise SharingValidationError(
+            "RuntimeProxy sharing is not supported on subslice claims"
+        )

@@ -1,0 +1,182 @@
+"""TPU topology model: chip coordinates, topology boxes, subslice profiles.
+
+This module is the TPU-first replacement for two reference concepts:
+
+- The MIG profile grammar + placement math
+  (cmd/nvidia-dra-plugin/mig-profile.go:35-269, component C21): a canonical
+  profile string parsed/validated and mapped to interval placements inside a
+  parent device.  TPU analog: a *core subslice* profile ``"<N>c.<M>gb"``
+  (N TensorCores + M GB of the chip's HBM) placed at an aligned core interval
+  inside one chip — the "1-of-4 core subslice" of BASELINE.md.
+
+- The *absence* of interconnect topology in the reference allocator
+  (first-fit over map order, cmd/nvidia-dra-controller/gpu.go:150-159 — noted
+  as a gap in SURVEY.md §2).  TPUs make that gap fatal: collective bandwidth
+  depends on the allocated chips forming an ICI-contiguous sub-mesh.  So chip
+  identity here is a coordinate ``(x, y, z)`` in the host's ICI mesh, and a
+  multi-chip request is a ``Topology`` box (e.g. ``2x2x1``) that the allocator
+  must place as an axis-aligned contiguous block.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from itertools import permutations
+from typing import Iterable, Iterator
+
+Coord = tuple[int, int, int]
+
+
+def parse_coord(text: "str | Iterable[int]") -> Coord:
+    """Parse a chip coordinate: "x,y,z" or a 2/3-element sequence."""
+    if isinstance(text, str):
+        parts = [p for p in re.split(r"[,x]", text.strip()) if p != ""]
+        vals = [int(p) for p in parts]
+    else:
+        vals = [int(v) for v in text]
+    if len(vals) == 2:
+        vals.append(0)
+    if len(vals) != 3 or any(v < 0 for v in vals):
+        raise ValueError(f"invalid chip coordinate: {text!r}")
+    return (vals[0], vals[1], vals[2])
+
+
+def coord_str(coord: Coord) -> str:
+    return ",".join(str(c) for c in coord)
+
+
+@dataclass(frozen=True)
+class Topology:
+    """An axis-aligned box of chips, e.g. 2x2x1 (canonical form "XxYxZ")."""
+
+    x: int
+    y: int
+    z: int = 1
+
+    _TOPOLOGY_RE = re.compile(r"^(\d+)x(\d+)(?:x(\d+))?$")
+
+    @classmethod
+    def parse(cls, text: str) -> "Topology":
+        m = cls._TOPOLOGY_RE.match(text.strip())
+        if not m:
+            raise ValueError(f"invalid topology {text!r} (expected e.g. '2x2x1')")
+        x, y = int(m.group(1)), int(m.group(2))
+        z = int(m.group(3)) if m.group(3) else 1
+        if x < 1 or y < 1 or z < 1:
+            raise ValueError(f"invalid topology {text!r}: dims must be >= 1")
+        return cls(x, y, z)
+
+    @property
+    def size(self) -> int:
+        return self.x * self.y * self.z
+
+    def dims(self) -> tuple[int, int, int]:
+        return (self.x, self.y, self.z)
+
+    def orientations(self) -> "list[Topology]":
+        """Distinct axis permutations of this box.
+
+        A request for a 2x1x1 ring can be satisfied by chips laid out along
+        any mesh axis; the allocator tries each orientation.  Order is
+        deterministic (sorted) so allocation is reproducible.
+        """
+        seen = sorted(set(permutations((self.x, self.y, self.z))))
+        return [Topology(*d) for d in seen]
+
+    def coords_from(self, origin: Coord) -> Iterator[Coord]:
+        """All chip coordinates of this box placed with min-corner at origin.
+
+        Iteration order is x-minor (x fastest), matching the device order a
+        JAX mesh over the slice expects for contiguous ICI neighbors.
+        """
+        ox, oy, oz = origin
+        for dz in range(self.z):
+            for dy in range(self.y):
+                for dx in range(self.x):
+                    yield (ox + dx, oy + dy, oz + dz)
+
+    def fits_within(self, other: "Topology") -> bool:
+        return self.x <= other.x and self.y <= other.y and self.z <= other.z
+
+    def __str__(self) -> str:
+        return f"{self.x}x{self.y}x{self.z}"
+
+
+# --- Core subslice profiles (MIG-profile analog) ---------------------------
+
+_PROFILE_RE = re.compile(r"^(\d+)c\.(\d+)gb$")
+
+
+@dataclass(frozen=True)
+class SubsliceProfile:
+    """A partition of one chip: N TensorCores + M GB HBM, canonical "Nc.Mgb".
+
+    Reference parity: MigProfile's canonical ``[Nc.]Ng.MgbN[+me]`` string with
+    parse/validate/round-trip (mig-profile.go:35-269).  The memory attribute
+    uses the same rounding heuristic idea: profile GB = chip HBM divided by
+    the core partition count, rounded to whole GB.
+    """
+
+    cores: int
+    hbm_gb: int
+
+    @classmethod
+    def parse(cls, text: str) -> "SubsliceProfile":
+        m = _PROFILE_RE.match(text.strip().lower())
+        if not m:
+            raise ValueError(
+                f"invalid subslice profile {text!r} (expected e.g. '1c.4gb')"
+            )
+        cores, hbm = int(m.group(1)), int(m.group(2))
+        if cores < 1 or hbm < 1:
+            raise ValueError(f"invalid subslice profile {text!r}")
+        return cls(cores, hbm)
+
+    @classmethod
+    def profiles_for_chip(
+        cls, total_cores: int, hbm_bytes: int
+    ) -> "list[SubsliceProfile]":
+        """Valid profiles for a chip: power-of-two core counts up to total.
+
+        Mirrors how the reference enumerates per-GPU MIG profiles from NVML
+        (nvlib.go:92-233) — but computed from chip geometry, since TPUs have
+        no on-silicon partition catalog.
+        """
+        profiles = []
+        n = 1
+        while n <= total_cores:
+            hbm_gb = round(hbm_bytes * n / total_cores / (1024**3))
+            profiles.append(cls(n, max(1, hbm_gb)))
+            n *= 2
+        return profiles
+
+    def placements(self, total_cores: int) -> list["Placement"]:
+        """Aligned, non-overlapping-capable start intervals within a chip.
+
+        Like MIG placements (nas.go:31-34), a profile of size N may start
+        only at multiples of N — the allocator's backtracking search packs
+        these intervals without overlap.
+        """
+        if self.cores > total_cores:
+            return []
+        return [
+            Placement(start, self.cores)
+            for start in range(0, total_cores - self.cores + 1, self.cores)
+        ]
+
+    def __str__(self) -> str:
+        return f"{self.cores}c.{self.hbm_gb}gb"
+
+
+@dataclass(frozen=True)
+class Placement:
+    """A core interval [start, start+size) within a chip (MigDevicePlacement
+    analog, api/nvidia.com/resource/gpu/nas/v1alpha1/nas.go:31-34)."""
+
+    start: int
+    size: int
+
+    def overlaps(self, other: "Placement") -> bool:
+        """Interval-overlap math (reference: mig.go:290-312)."""
+        return self.start < other.start + other.size and other.start < self.start + self.size

@@ -1,0 +1,255 @@
+"""Claim-parameter CRDs for group ``tpu.resource.google.com/v1alpha1``.
+
+Reference: api/nvidia.com/resource/gpu/v1alpha1 (component C9).  The user-
+facing request vocabulary:
+
+- ``DeviceClassParameters``   (deviceclass.go:22-40): class-level defaults —
+  shareable.
+- ``TpuClaimParameters``      (gpuclaim.go:26-33 analog): whole-chip claims by
+  ``count`` *or* ICI ``topology`` ("2x2x1"), with selector + sharing.  The
+  topology field is the TPU-first addition: it requests an axis-aligned
+  contiguous sub-mesh rather than N arbitrary chips (SURVEY.md §2 disclosure).
+- ``SubsliceClaimParameters`` (migclaim.go:26-32 analog): a core-subslice of a
+  chip by profile ("1c.4gb"), optionally affine to a parent whole-chip claim
+  via ``tpu_claim_name`` (the gpuClaimName co-allocation affinity).
+- ``CoreClaimParameters``     (ciclaim.go:22-28 analog): registered but not
+  yet wired into the controller, mirroring the reference's not-yet-implemented
+  ComputeInstance claim path.
+
+Defaulting helpers mirror api.go:27-57.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpu_dra.api import serde
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.selector import (
+    CompareOp,
+    QuantityComparator,
+    Selector,
+    VersionComparator,
+    glob_matches,
+)
+from tpu_dra.api.sharing import SubsliceSharing, TpuSharing
+from tpu_dra.utils.quantity import Quantity
+
+GROUP_NAME = "tpu.resource.google.com"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP_NAME}/{VERSION}"
+
+DEVICE_CLASS_PARAMETERS_KIND = "DeviceClassParameters"
+TPU_CLAIM_PARAMETERS_KIND = "TpuClaimParameters"
+SUBSLICE_CLAIM_PARAMETERS_KIND = "SubsliceClaimParameters"
+CORE_CLAIM_PARAMETERS_KIND = "CoreClaimParameters"
+
+
+# --- Selector --------------------------------------------------------------
+
+
+@dataclass
+class TpuSelectorProperties:
+    """The chip properties a selector condition can test
+    (GpuSelectorProperties analog, gpuselector.go:62-73).
+
+    Exactly one field should be set per condition (the CRD schema enforces
+    MaxProperties=1, as the reference does).
+    """
+
+    index: int | None = None
+    uuid: str | None = None
+    partitionable: bool | None = None  # migEnabled analog: core-subslice capable
+    hbm: QuantityComparator | None = None  # memory analog
+    product: str | None = None  # glob, e.g. "tpu-v5e*" (productName analog)
+    generation: str | None = None  # glob, e.g. "v5e" (architecture analog)
+    ici_domain: str | None = None  # glob over the ICI/slice domain id
+    libtpu_version: VersionComparator | None = None  # driverVersion analog
+    runtime_version: VersionComparator | None = None  # cudaRuntimeVersion analog
+
+
+_PROPERTY_KEYS = {
+    "index": int,
+    "uuid": str,
+    "partitionable": bool,
+    "hbm": QuantityComparator,
+    "product": str,
+    "generation": str,
+    "iciDomain": str,
+    "libtpuVersion": VersionComparator,
+    "runtimeVersion": VersionComparator,
+}
+
+
+@dataclass
+class TpuSelector(Selector[TpuSelectorProperties]):
+    """Boolean selector tree over TpuSelectorProperties.
+
+    JSON shape mirrors the reference (gpuselector.go:32-36): a node is either
+    one inline property condition (``{"product": "tpu-v5e*"}``) or
+    ``{"andExpression": [...]}`` / ``{"orExpression": [...]}``.  The CRD
+    generator unrolls recursion to 3 levels (gpuselector.go:28-30).
+    """
+
+    and_expression: "list[TpuSelector] | None" = None
+    or_expression: "list[TpuSelector] | None" = None
+
+    def __to_json__(self) -> dict:
+        if self.and_expression is not None:
+            return {"andExpression": [s.__to_json__() for s in self.and_expression]}
+        if self.or_expression is not None:
+            return {"orExpression": [s.__to_json__() for s in self.or_expression]}
+        if self.properties is not None:
+            return serde.to_dict(self.properties)
+        return {}
+
+    @classmethod
+    def __from_json__(cls, data: dict) -> "TpuSelector":
+        if "andExpression" in data:
+            return cls(
+                and_expression=[cls.__from_json__(d) for d in data["andExpression"]]
+            )
+        if "orExpression" in data:
+            return cls(
+                or_expression=[cls.__from_json__(d) for d in data["orExpression"]]
+            )
+        props = serde.from_dict(TpuSelectorProperties, data)
+        return cls(properties=props)
+
+
+def make_property_selector(**kwargs) -> TpuSelector:
+    """Convenience constructor: one condition per keyword."""
+    conditions = [
+        TpuSelector(properties=TpuSelectorProperties(**{k: v}))
+        for k, v in kwargs.items()
+    ]
+    if len(conditions) == 1:
+        return conditions[0]
+    return TpuSelector(and_expression=conditions)
+
+
+# --- Claim parameter CRDs --------------------------------------------------
+
+
+@dataclass
+class DeviceClassParametersSpec:
+    shareable: bool | None = field(default=None, metadata={"json": "sharable"})
+    # ^ json key "sharable" [sic] kept for reference parity (deviceclass.go:25)
+
+
+@dataclass
+class DeviceClassParameters:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DeviceClassParametersSpec = field(default_factory=DeviceClassParametersSpec)
+    kind: str = DEVICE_CLASS_PARAMETERS_KIND
+    api_version: str = API_VERSION
+
+
+@dataclass
+class TpuClaimParametersSpec:
+    """Whole-chip claim: ``count`` N chips or ``topology`` "XxYxZ" (not both).
+
+    With ``topology`` set the allocator must place an ICI-contiguous
+    axis-aligned block of chips; with ``count`` it may pick any chips but
+    still prefers contiguity (see controller/tpu_allocator.py).
+    """
+
+    count: int | None = None
+    topology: str | None = None
+    selector: TpuSelector | None = None
+    sharing: TpuSharing | None = None
+
+
+@dataclass
+class TpuClaimParameters:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TpuClaimParametersSpec = field(default_factory=TpuClaimParametersSpec)
+    kind: str = TPU_CLAIM_PARAMETERS_KIND
+    api_version: str = API_VERSION
+
+
+@dataclass
+class SubsliceClaimParametersSpec:
+    profile: str = ""
+    sharing: SubsliceSharing | None = None
+    tpu_claim_name: str = field(default="", metadata={"json": "tpuClaimName"})
+
+
+@dataclass
+class SubsliceClaimParameters:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: SubsliceClaimParametersSpec = field(
+        default_factory=SubsliceClaimParametersSpec
+    )
+    kind: str = SUBSLICE_CLAIM_PARAMETERS_KIND
+    api_version: str = API_VERSION
+
+
+@dataclass
+class CoreClaimParametersSpec:
+    """Single-core claim within a shared subslice (ComputeInstance analog,
+    ciclaim.go:22-28 — registered, not yet wired into the controller)."""
+
+    profile: str = ""
+    subslice_claim_name: str = field(default="", metadata={"json": "subsliceClaimName"})
+
+
+@dataclass
+class CoreClaimParameters:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CoreClaimParametersSpec = field(default_factory=CoreClaimParametersSpec)
+    kind: str = CORE_CLAIM_PARAMETERS_KIND
+    api_version: str = API_VERSION
+
+
+# --- Defaulting (api.go:27-57 analogs) -------------------------------------
+
+
+def default_device_class_parameters_spec(
+    spec: DeviceClassParametersSpec | None,
+) -> DeviceClassParametersSpec:
+    new = serde.deepcopy(spec) if spec is not None else DeviceClassParametersSpec()
+    if new.shareable is None:
+        new.shareable = True
+    return new
+
+
+def default_tpu_claim_parameters_spec(
+    spec: TpuClaimParametersSpec | None,
+) -> TpuClaimParametersSpec:
+    new = serde.deepcopy(spec) if spec is not None else TpuClaimParametersSpec()
+    if new.count is None and new.topology is None:
+        new.count = 1
+    return new
+
+
+def default_subslice_claim_parameters_spec(
+    spec: SubsliceClaimParametersSpec | None,
+) -> SubsliceClaimParametersSpec:
+    return serde.deepcopy(spec) if spec is not None else SubsliceClaimParametersSpec()
+
+
+__all__ = [
+    "GROUP_NAME",
+    "VERSION",
+    "API_VERSION",
+    "CompareOp",
+    "QuantityComparator",
+    "VersionComparator",
+    "Quantity",
+    "glob_matches",
+    "TpuSelector",
+    "TpuSelectorProperties",
+    "make_property_selector",
+    "DeviceClassParameters",
+    "DeviceClassParametersSpec",
+    "TpuClaimParameters",
+    "TpuClaimParametersSpec",
+    "SubsliceClaimParameters",
+    "SubsliceClaimParametersSpec",
+    "CoreClaimParameters",
+    "CoreClaimParametersSpec",
+    "default_device_class_parameters_spec",
+    "default_tpu_claim_parameters_spec",
+    "default_subslice_claim_parameters_spec",
+]

@@ -1,0 +1,4 @@
+from tpu_dra.utils.quantity import Quantity
+from tpu_dra.utils.versioncmp import compare_versions
+
+__all__ = ["Quantity", "compare_versions"]

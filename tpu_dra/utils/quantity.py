@@ -1,0 +1,141 @@
+"""Kubernetes-style resource Quantity.
+
+The reference relies on ``k8s.io/apimachinery/pkg/api/resource.Quantity`` for
+memory-size selector comparisons (api/utils/selector/selector.go:135-138).
+This is a from-scratch implementation of the subset the driver needs: parse
+the canonical serialization (plain integers, decimal SI suffixes, binary
+suffixes, decimal exponents), compare, and re-serialize.
+
+TPU relevance: HBM sizes in AllocatableTpu attributes ("16Gi" for v5e) and
+selector conditions like ``hbm >= 16Gi``.
+"""
+
+from __future__ import annotations
+
+import re
+from fractions import Fraction
+from functools import total_ordering
+
+_BINARY_SUFFIXES = {
+    "Ki": 1024,
+    "Mi": 1024**2,
+    "Gi": 1024**3,
+    "Ti": 1024**4,
+    "Pi": 1024**5,
+    "Ei": 1024**6,
+}
+
+_DECIMAL_SUFFIXES = {
+    "n": Fraction(1, 10**9),
+    "u": Fraction(1, 10**6),
+    "m": Fraction(1, 10**3),
+    "": Fraction(1),
+    "k": Fraction(10**3),
+    "M": Fraction(10**6),
+    "G": Fraction(10**9),
+    "T": Fraction(10**12),
+    "P": Fraction(10**15),
+    "E": Fraction(10**18),
+}
+
+_QUANTITY_RE = re.compile(
+    r"^(?P<sign>[+-]?)(?P<digits>[0-9]+(?:\.[0-9]*)?|\.[0-9]+)"
+    r"(?P<suffix>Ki|Mi|Gi|Ti|Pi|Ei|[numkMGTPE]?|[eE][+-]?[0-9]+)$"
+)
+
+
+class QuantityParseError(ValueError):
+    pass
+
+
+@total_ordering
+class Quantity:
+    """An exact rational quantity with its original string form retained."""
+
+    __slots__ = ("_value", "_text")
+
+    def __init__(self, value: "str | int | float | Fraction | Quantity"):
+        if isinstance(value, Quantity):
+            self._value = value._value
+            self._text = value._text
+            return
+        if isinstance(value, str):
+            self._value = self._parse(value)
+            self._text = value
+            return
+        if isinstance(value, bool):
+            raise QuantityParseError(f"not a quantity: {value!r}")
+        if isinstance(value, (int, Fraction)):
+            self._value = Fraction(value)
+        elif isinstance(value, float):
+            self._value = Fraction(value).limit_denominator(10**9)
+        else:
+            raise QuantityParseError(f"not a quantity: {value!r}")
+        self._text = None
+
+    @staticmethod
+    def _parse(text: str) -> Fraction:
+        m = _QUANTITY_RE.match(text.strip())
+        if not m:
+            raise QuantityParseError(f"unable to parse quantity {text!r}")
+        sign = -1 if m.group("sign") == "-" else 1
+        digits = m.group("digits")
+        suffix = m.group("suffix")
+        base = Fraction(digits)
+        if suffix in _BINARY_SUFFIXES:
+            mult = Fraction(_BINARY_SUFFIXES[suffix])
+        elif suffix in _DECIMAL_SUFFIXES:
+            mult = _DECIMAL_SUFFIXES[suffix]
+        elif suffix and suffix[0] in "eE":
+            exp = int(suffix[1:])
+            mult = Fraction(10) ** exp
+        else:  # pragma: no cover - regex prevents this
+            raise QuantityParseError(f"unknown suffix in {text!r}")
+        return sign * base * mult
+
+    @property
+    def value(self) -> Fraction:
+        return self._value
+
+    def to_int(self) -> int:
+        """Value rounded up to an integer (k8s rounds up for int64 access)."""
+        v = self._value
+        return int(v) if v.denominator == 1 else int(v) + (1 if v > 0 else 0)
+
+    def cmp(self, other: "Quantity | str | int") -> int:
+        o = other if isinstance(other, Quantity) else Quantity(other)
+        if self._value < o._value:
+            return -1
+        if self._value > o._value:
+            return 1
+        return 0
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, (Quantity, str, int)):
+            return NotImplemented
+        return self.cmp(other) == 0
+
+    def __lt__(self, other) -> bool:
+        if not isinstance(other, (Quantity, str, int)):
+            return NotImplemented
+        return self.cmp(other) < 0
+
+    def __hash__(self) -> int:
+        return hash(self._value)
+
+    def __str__(self) -> str:
+        if self._text is not None:
+            return self._text
+        v = self._value
+        if v.denominator == 1:
+            # Prefer the largest binary suffix that divides evenly (memory
+            # quantities round-trip as "16Gi" rather than "17179869184").
+            for suffix in ("Ei", "Pi", "Ti", "Gi", "Mi", "Ki"):
+                mult = _BINARY_SUFFIXES[suffix]
+                if v.numerator % mult == 0:
+                    return f"{v.numerator // mult}{suffix}"
+            return str(v.numerator)
+        return f"{float(v):g}"
+
+    def __repr__(self) -> str:
+        return f"Quantity({str(self)!r})"

@@ -1,0 +1,75 @@
+"""Semantic-version comparison.
+
+The reference compares driver/CUDA versions with golang.org/x/mod/semver in
+selector conditions (api/utils/selector/selector.go:141-153).  The TPU analog
+compares libtpu / runtime versions.  Implements semver 2.0 precedence
+(numeric core, pre-release identifiers; build metadata ignored) without any
+third-party dependency.
+"""
+
+from __future__ import annotations
+
+import re
+
+_SEMVER_RE = re.compile(
+    r"^v?(?P<major>\d+)(?:\.(?P<minor>\d+))?(?:\.(?P<patch>\d+))?"
+    r"(?:-(?P<pre>[0-9A-Za-z.-]+))?(?:\+(?P<build>[0-9A-Za-z.-]+))?$"
+)
+
+
+def _parse(version: str):
+    m = _SEMVER_RE.match(version.strip())
+    if not m:
+        return None
+    core = (
+        int(m.group("major")),
+        int(m.group("minor") or 0),
+        int(m.group("patch") or 0),
+    )
+    pre = m.group("pre")
+    pre_ids: tuple | None = None
+    if pre is not None:
+        ids = []
+        for ident in pre.split("."):
+            # Numeric identifiers sort below alphanumeric ones.
+            if ident.isdigit():
+                ids.append((0, int(ident), ""))
+            else:
+                ids.append((1, 0, ident))
+        pre_ids = tuple(ids)
+    return core, pre_ids
+
+
+def compare_versions(a: str, b: str) -> int:
+    """Return -1/0/+1 comparing semver strings (leading 'v' optional).
+
+    Unparseable versions compare as lowest (mirrors semver.Compare treating
+    invalid versions as empty, golang.org/x/mod/semver semantics).
+    """
+    pa, pb = _parse(a), _parse(b)
+    if pa is None and pb is None:
+        return 0
+    if pa is None:
+        return -1
+    if pb is None:
+        return 1
+    if pa[0] != pb[0]:
+        return -1 if pa[0] < pb[0] else 1
+    # Same core: a pre-release sorts below the release proper.
+    prea, preb = pa[1], pb[1]
+    if prea is None and preb is None:
+        return 0
+    if prea is None:
+        return 1
+    if preb is None:
+        return -1
+    if prea == preb:
+        return 0
+    # Compare identifier by identifier; shorter list sorts first when equal
+    # prefix.
+    for ia, ib in zip(prea, preb):
+        if ia != ib:
+            return -1 if ia < ib else 1
+    if len(prea) == len(preb):
+        return 0
+    return -1 if len(prea) < len(preb) else 1

@@ -1,0 +1,39 @@
+"""Version info (reference: internal/info/version.go:22-43, component C14).
+
+The reference injects version/commit via Go ldflags at build time
+(Makefile:44).  Here the same information is resolved at import time from the
+environment (populated by the container build) with static fallbacks, and a
+``git describe`` is attempted only when running from a source checkout.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+__version__ = "0.1.0"
+
+
+def _git_commit() -> str:
+    env = os.environ.get("TPU_DRA_GIT_COMMIT")
+    if env:
+        return env
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    return "unknown"
+
+
+def version_string() -> str:
+    """Human-readable version string, analogous to info.GetVersionString()."""
+    version = os.environ.get("TPU_DRA_VERSION", __version__)
+    return f"{version} (commit: {_git_commit()})"
