@@ -1,0 +1,108 @@
+"""Shared test fixtures/builders for controller and plugin tests."""
+
+from __future__ import annotations
+
+import uuid as uuidlib
+
+from tpu_dra.api.k8s import Pod, PodSpec, ResourceClaim, ResourceClass
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.nas_v1alpha1 import (
+    AllocatableDevice,
+    AllocatableSubslice,
+    AllocatableTpu,
+    NodeAllocationState,
+    NodeAllocationStateSpec,
+)
+from tpu_dra.api.topology import SubsliceProfile
+from tpu_dra.controller.types import ClaimAllocation
+
+GIB = 1024**3
+
+
+def make_chip(
+    index: int,
+    coord,
+    *,
+    partitionable: bool = False,
+    cores: int = 4,
+    hbm_gb: int = 16,
+    product: str = "tpu-v5e",
+    generation: str = "v5e",
+) -> AllocatableTpu:
+    return AllocatableTpu(
+        index=index,
+        uuid=f"tpu-{index}",
+        coord=tuple(coord),
+        ici_domain="host-0",
+        cores=cores,
+        hbm_bytes=hbm_gb * GIB,
+        product=product,
+        generation=generation,
+        partitionable=partitionable,
+        libtpu_version="1.10.0",
+        runtime_version="2.0.0",
+    )
+
+
+def make_nas(
+    node: str = "node-1",
+    mesh=(2, 2),
+    *,
+    partitionable: bool = False,
+    namespace: str = "tpu-dra",
+) -> NodeAllocationState:
+    """A NAS publishing an x-by-y host mesh of chips, optionally partitionable
+    (with the matching subslice allocatable entries, as the plugin publishes)."""
+    chips = []
+    index = 0
+    for y in range(mesh[1]):
+        for x in range(mesh[0]):
+            chips.append(
+                AllocatableDevice(
+                    tpu=make_chip(index, (x, y, 0), partitionable=partitionable)
+                )
+            )
+            index += 1
+    devices = list(chips)
+    if partitionable:
+        sample = chips[0].tpu
+        for profile in SubsliceProfile.profiles_for_chip(
+            sample.cores, sample.hbm_bytes
+        ):
+            devices.append(
+                AllocatableDevice(
+                    subslice=AllocatableSubslice(
+                        profile=str(profile),
+                        parent_product=sample.product,
+                        placements=profile.placements(sample.cores),
+                    )
+                )
+            )
+    return NodeAllocationState(
+        metadata=ObjectMeta(name=node, namespace=namespace),
+        spec=NodeAllocationStateSpec(allocatable_devices=devices),
+        status="Ready",
+    )
+
+
+def make_claim(name: str = "claim-1", namespace: str = "default") -> ResourceClaim:
+    return ResourceClaim(
+        metadata=ObjectMeta(
+            name=name, namespace=namespace, uid=str(uuidlib.uuid4())
+        )
+    )
+
+
+def make_pod(name: str = "pod-1", namespace: str = "default") -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=namespace, uid=str(uuidlib.uuid4())),
+        spec=PodSpec(),
+    )
+
+
+def make_ca(claim_params, name: str = "claim-1") -> ClaimAllocation:
+    return ClaimAllocation(
+        claim=make_claim(name),
+        class_=ResourceClass(metadata=ObjectMeta(name="tpu.google.com")),
+        claim_parameters=claim_params,
+    )

@@ -1,0 +1,301 @@
+"""Fake apiserver semantics tests: RV conflicts, watches, finalizers, GC."""
+
+import threading
+
+import pytest
+
+from tpu_dra.api import serde
+from tpu_dra.api.k8s import Node, ResourceClaim
+from tpu_dra.api.meta import ObjectMeta, OwnerReference
+from tpu_dra.api.nas_v1alpha1 import NodeAllocationState, NodeAllocationStateSpec
+from tpu_dra.client import (
+    AlreadyExistsError,
+    ClientSet,
+    ConflictError,
+    FakeApiServer,
+    InvalidError,
+    NasClient,
+    NotFoundError,
+    retry_on_conflict,
+)
+
+
+@pytest.fixture
+def server():
+    return FakeApiServer()
+
+
+@pytest.fixture
+def cs(server):
+    return ClientSet(server)
+
+
+def make_claim(name="c1", namespace="default"):
+    return ResourceClaim(metadata=ObjectMeta(name=name, namespace=namespace))
+
+
+class TestCrud:
+    def test_create_assigns_identity(self, cs):
+        created = cs.resource_claims("default").create(make_claim())
+        assert created.metadata.uid
+        assert created.metadata.resource_version
+        assert created.metadata.creation_timestamp
+
+    def test_create_duplicate(self, cs):
+        cs.resource_claims("default").create(make_claim())
+        with pytest.raises(AlreadyExistsError):
+            cs.resource_claims("default").create(make_claim())
+
+    def test_get_not_found(self, cs):
+        with pytest.raises(NotFoundError):
+            cs.resource_claims("default").get("nope")
+
+    def test_create_requires_name(self, server):
+        with pytest.raises(InvalidError):
+            server.create({"kind": "ResourceClaim", "metadata": {}})
+
+    def test_namespaced_isolation(self, cs):
+        cs.resource_claims("ns1").create(make_claim("c", "ns1"))
+        with pytest.raises(NotFoundError):
+            cs.resource_claims("ns2").get("c")
+        assert len(cs.resource_claims("ns1").list()) == 1
+        assert len(cs.resource_claims("ns2").list()) == 0
+
+    def test_list_all_namespaces(self, cs):
+        cs.resource_claims("ns1").create(make_claim("c1", "ns1"))
+        cs.resource_claims("ns2").create(make_claim("c2", "ns2"))
+        assert len(cs.resource_claims("").list_all_namespaces()) == 2
+
+    def test_delete(self, cs):
+        cs.resource_claims("default").create(make_claim())
+        cs.resource_claims("default").delete("c1")
+        with pytest.raises(NotFoundError):
+            cs.resource_claims("default").get("c1")
+
+
+class TestOptimisticConcurrency:
+    def test_update_with_current_rv(self, cs):
+        client = cs.resource_claims("default")
+        obj = client.create(make_claim())
+        obj.spec.resource_class_name = "tpu.google.com"
+        updated = client.update(obj)
+        assert updated.spec.resource_class_name == "tpu.google.com"
+        assert updated.metadata.resource_version != obj.metadata.resource_version
+
+    def test_stale_rv_conflicts(self, cs):
+        client = cs.resource_claims("default")
+        obj = client.create(make_claim())
+        fresh = client.get("c1")
+        fresh.spec.resource_class_name = "a"
+        client.update(fresh)
+        obj.spec.resource_class_name = "b"  # still holds the old RV
+        with pytest.raises(ConflictError):
+            client.update(obj)
+
+    def test_uid_immutable_through_update(self, cs):
+        client = cs.resource_claims("default")
+        obj = client.create(make_claim())
+        original_uid = obj.metadata.uid
+        obj.metadata.uid = "forged"
+        updated = client.update(obj)
+        assert updated.metadata.uid == original_uid
+
+    def test_retry_on_conflict_converges(self, cs):
+        client = cs.resource_claims("default")
+        client.create(make_claim())
+
+        # Two threads both do read-modify-write with retry; both must land.
+        def bump(value):
+            def attempt():
+                fresh = client.get("c1")
+                fresh.metadata.labels[value] = "y"
+                client.update(fresh)
+
+            retry_on_conflict(attempt)
+
+        threads = [threading.Thread(target=bump, args=(f"k{i}",)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        final = client.get("c1")
+        assert len(final.metadata.labels) == 8
+
+    def test_retry_exhaustion_raises(self, cs):
+        client = cs.resource_claims("default")
+        client.create(make_claim())
+        stale = client.get("c1")
+        fresh = client.get("c1")
+        fresh.metadata.labels["x"] = "y"
+        client.update(fresh)
+
+        def always_stale():
+            client.update(stale)  # never refreshes
+
+        with pytest.raises(ConflictError):
+            retry_on_conflict(always_stale, steps=3)
+
+
+class TestStatusSubresource:
+    def test_update_status_keeps_spec(self, server):
+        obj = server.create(
+            {
+                "kind": "ResourceClaim",
+                "metadata": {"name": "c", "namespace": "d"},
+                "spec": {"resourceClassName": "x"},
+            }
+        )
+        obj["status"] = {"driverName": "tpu.google.com"}
+        obj["spec"] = {"resourceClassName": "TAMPERED"}
+        result = server.update_status(obj)
+        assert result["spec"]["resourceClassName"] == "x"
+        assert result["status"]["driverName"] == "tpu.google.com"
+
+
+class TestWatch:
+    def test_event_stream(self, cs, server):
+        watch = server.watch("ResourceClaim")
+        client = cs.resource_claims("default")
+        client.create(make_claim())
+        obj = client.get("c1")
+        obj.metadata.labels["a"] = "b"
+        client.update(obj)
+        client.delete("c1")
+
+        events = [watch.next(timeout=1) for _ in range(3)]
+        assert [e["type"] for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+        watch.stop()
+        assert watch.next(timeout=0.1) is None
+
+    def test_name_scoped_watch(self, cs, server):
+        watch = server.watch("ResourceClaim", "default", "c2")
+        client = cs.resource_claims("default")
+        client.create(make_claim("c1"))
+        client.create(make_claim("c2"))
+        event = watch.next(timeout=1)
+        assert event["object"]["metadata"]["name"] == "c2"
+        watch.stop()
+
+    def test_watch_events_are_copies(self, cs, server):
+        watch = server.watch("ResourceClaim")
+        client = cs.resource_claims("default")
+        client.create(make_claim())
+        event = watch.next(timeout=1)
+        event["object"]["metadata"]["name"] = "mutated"
+        assert client.get("c1").metadata.name == "c1"
+        watch.stop()
+
+
+class TestFinalizers:
+    def test_delete_with_finalizer_defers(self, cs):
+        client = cs.resource_claims("default")
+        obj = client.create(make_claim())
+        obj.metadata.finalizers = ["tpu.google.com/deletion-protection"]
+        obj = client.update(obj)
+
+        client.delete("c1")
+        still_there = client.get("c1")
+        assert still_there.metadata.deletion_timestamp
+
+        still_there.metadata.finalizers = []
+        client.update(still_there)
+        with pytest.raises(NotFoundError):
+            client.get("c1")
+
+    def test_deletion_timestamp_immutable(self, cs):
+        client = cs.resource_claims("default")
+        obj = client.create(make_claim())
+        obj.metadata.finalizers = ["f"]
+        obj = client.update(obj)
+        client.delete("c1")
+        obj = client.get("c1")
+        ts = obj.metadata.deletion_timestamp
+        obj.metadata.deletion_timestamp = ""
+        updated = client.update(obj)
+        assert updated.metadata.deletion_timestamp == ts
+
+
+class TestOwnerGC:
+    def test_cascade_delete(self, cs):
+        node = cs.nodes().create(Node(metadata=ObjectMeta(name="node1")))
+        nas = NodeAllocationState(
+            metadata=ObjectMeta(
+                name="node1",
+                namespace="tpu-dra",
+                owner_references=[
+                    OwnerReference(
+                        api_version="v1", kind="Node", name="node1", uid=node.metadata.uid
+                    )
+                ],
+            )
+        )
+        cs.node_allocation_states("tpu-dra").create(nas)
+        cs.nodes().delete("node1")
+        with pytest.raises(NotFoundError):
+            cs.node_allocation_states("tpu-dra").get("node1")
+
+
+class TestNasClient:
+    def test_get_or_create_then_update(self, cs):
+        nas = NodeAllocationState(
+            metadata=ObjectMeta(name="node1", namespace="tpu-dra")
+        )
+        client = NasClient(nas, cs)
+        client.get_or_create()
+        assert nas.metadata.uid
+
+        # Second GetOrCreate adopts the existing object.
+        nas2 = NodeAllocationState(
+            metadata=ObjectMeta(name="node1", namespace="tpu-dra")
+        )
+        client2 = NasClient(nas2, cs)
+        client2.get_or_create()
+        assert nas2.metadata.uid == nas.metadata.uid
+
+        client.update_status("Ready")
+        client2.get()
+        assert nas2.status == "Ready"
+
+        spec = NodeAllocationStateSpec()
+        client.update(spec)
+        assert nas.metadata.resource_version
+
+    def test_delete_idempotent(self, cs):
+        nas = NodeAllocationState(metadata=ObjectMeta(name="n", namespace="ns"))
+        client = NasClient(nas, cs)
+        client.get_or_create()
+        client.delete()
+        client.delete()  # NotFound swallowed (reference client.go:61-69)
+
+    def test_watch(self, cs):
+        nas = NodeAllocationState(metadata=ObjectMeta(name="n", namespace="ns"))
+        client = NasClient(nas, cs)
+        client.get_or_create()
+        watch = client.watch()
+        client.update_status("Ready")
+        event = watch.next(timeout=1)
+        assert event["type"] == "MODIFIED"
+        assert event["object"]["status"] == "Ready"
+        watch.stop()
+
+
+class TestTypedRoundtrip:
+    def test_serde_through_server(self, cs):
+        from tpu_dra.api.tpu_v1alpha1 import (
+            TpuClaimParameters,
+            TpuClaimParametersSpec,
+            make_property_selector,
+        )
+
+        client = cs.tpu_claim_parameters("default")
+        params = TpuClaimParameters(
+            metadata=ObjectMeta(name="p", namespace="default"),
+            spec=TpuClaimParametersSpec(
+                topology="2x2",
+                selector=make_property_selector(generation="v5e"),
+            ),
+        )
+        client.create(params)
+        back = client.get("p")
+        assert back.spec.topology == "2x2"
+        assert back.spec.selector.properties.generation == "v5e"

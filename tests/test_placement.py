@@ -1,0 +1,113 @@
+"""Placement engine tests: contiguity, orientation, fragmentation scoring."""
+
+from tpu_dra.api.topology import Topology
+from tpu_dra.controller.placement import (
+    _box_factorizations,
+    place_count,
+    place_topology,
+)
+
+
+def mesh(x, y, z=1):
+    return {(i, j, k) for i in range(x) for j in range(y) for k in range(z)}
+
+
+class TestPlaceTopology:
+    def test_exact_fit(self):
+        free = mesh(2, 2)
+        block, placed = place_topology(Topology.parse("2x2x1"), free)
+        assert sorted(block) == sorted(free)
+        assert placed.dims() == (2, 2, 1)
+
+    def test_no_fit(self):
+        assert place_topology(Topology.parse("4x1x1"), mesh(2, 2)) is None
+
+    def test_orientation_rotates(self):
+        # A 1x4 request on a 4x1 mesh must rotate to fit.
+        free = {(i, 0, 0) for i in range(4)}
+        placed = place_topology(Topology.parse("1x4x1"), free)
+        assert placed is not None
+        block, orientation = placed
+        assert sorted(block) == sorted(free)
+        # The *placed* orientation (4 along x) is reported, not the request.
+        assert orientation.dims() == (4, 1, 1)
+
+    def test_non_contiguous_rejected(self):
+        # 3 free chips in an L cannot host a 3x1 bar.
+        free = {(0, 0, 0), (1, 0, 0), (1, 1, 0)}
+        assert place_topology(Topology.parse("3x1x1"), free) is None
+
+    def test_fragmentation_corner_packing(self):
+        # On an empty 4x4 mesh a 2x2 block should pack into a corner (it
+        # touches 4 free neighbors) rather than the center (8 free neighbors).
+        free = mesh(4, 4)
+        block, _ = place_topology(Topology.parse("2x2x1"), free)
+        xs = [c[0] for c in block]
+        ys = [c[1] for c in block]
+        assert (min(xs), min(ys)) == (0, 0)
+
+    def test_deterministic(self):
+        free = mesh(4, 4)
+        a = place_topology(Topology.parse("2x2x1"), free)
+        b = place_topology(Topology.parse("2x2x1"), set(reversed(sorted(free))))
+        assert a == b
+
+    def test_occupied_blocks_respected(self):
+        free = mesh(2, 2) - {(0, 0, 0)}
+        assert place_topology(Topology.parse("2x2x1"), free) is None
+        bar = place_topology(Topology.parse("2x1x1"), free)
+        assert bar is not None
+        assert all(c in free for c in bar[0])
+
+
+class TestBoxFactorizations:
+    def test_cube_first(self):
+        boxes = _box_factorizations(8)
+        assert boxes[0].dims() == (2, 2, 2)
+
+    def test_all_volumes_match(self):
+        for n in (1, 4, 6, 12):
+            for box in _box_factorizations(n):
+                assert box.size == n
+
+    def test_four(self):
+        dims = [b.dims() for b in _box_factorizations(4)]
+        assert dims[0] == (2, 2, 1)  # more compact than 4x1x1
+        assert (4, 1, 1) in dims
+
+
+class TestPlaceCount:
+    def test_prefers_square_block(self):
+        chips, topo = place_count(4, mesh(4, 4))
+        assert topo is not None and topo.size == 4
+        assert topo.dims() == (2, 2, 1)
+        xs = {c[0] for c in chips}
+        ys = {c[1] for c in chips}
+        assert len(xs) == 2 and len(ys) == 2
+
+    def test_falls_back_to_bar(self):
+        # A 4x1 strip can't host 2x2 but can host 4x1.
+        chips, topo = place_count(4, {(i, 0, 0) for i in range(4)})
+        assert len(chips) == 4
+        assert topo is not None and sorted(topo.dims(), reverse=True) == [4, 1, 1]
+
+    def test_falls_back_to_connected_cluster(self):
+        # L-shaped free region: no 3-box fits... actually 3x1 fits nowhere,
+        # so BFS cluster should return the connected L.
+        free = {(0, 0, 0), (1, 0, 0), (1, 1, 0)}
+        chips, topo = place_count(3, free)
+        assert len(chips) == 3
+        assert topo is None
+
+    def test_disconnected_last_resort(self):
+        free = {(0, 0, 0), (5, 5, 0)}
+        chips, topo = place_count(2, free)
+        assert len(chips) == 2
+        assert topo is None
+
+    def test_insufficient(self):
+        chips, topo = place_count(5, mesh(2, 2))
+        assert chips == [] and topo is None
+
+    def test_zero(self):
+        assert place_count(0, mesh(2, 2)) == ([], None)

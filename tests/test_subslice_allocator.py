@@ -1,0 +1,230 @@
+"""Subslice allocator tests: candidates, affinity, backtracking."""
+
+import pytest
+
+from helpers import make_ca, make_nas, make_pod
+from tpu_dra.api.nas_v1alpha1 import (
+    AllocatedDevices,
+    AllocatedTpu,
+    AllocatedTpus,
+    ClaimInfo,
+)
+from tpu_dra.api.topology import Placement
+from tpu_dra.api.tpu_v1alpha1 import SubsliceClaimParametersSpec, TpuClaimParametersSpec
+from tpu_dra.controller.subslice_allocator import SubsliceDriver, SubslicePlacement
+from tpu_dra.controller.tpu_allocator import TpuDriver
+
+NODE = "node-1"
+
+
+def run_unsuitable(driver, nas, cas, pod=None, allcas=None):
+    pod = pod or make_pod()
+    driver.unsuitable_node(nas, pod, cas, allcas or cas, NODE)
+    return cas
+
+
+class TestValidate:
+    def test_profile_required(self):
+        with pytest.raises(ValueError):
+            SubsliceDriver().validate_claim_parameters(SubsliceClaimParametersSpec())
+
+    def test_malformed_profile(self):
+        with pytest.raises(ValueError):
+            SubsliceDriver().validate_claim_parameters(
+                SubsliceClaimParametersSpec(profile="bogus")
+            )
+
+
+class TestAllocation:
+    def test_basic_allocation(self):
+        driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        ca = make_ca(SubsliceClaimParametersSpec(profile="1c.4gb"))
+        run_unsuitable(driver, nas, [ca])
+        assert ca.unsuitable_nodes == []
+        allocated = nas.spec.allocated_claims[ca.claim.metadata.uid].subslice
+        assert allocated.devices[0].profile == "1c.4gb"
+        assert allocated.devices[0].placement.size == 1
+
+    def test_unknown_profile_unsuitable(self):
+        driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        ca = make_ca(SubsliceClaimParametersSpec(profile="3c.12gb"))
+        run_unsuitable(driver, nas, [ca])
+        assert NODE in ca.unsuitable_nodes
+
+    def test_non_partitionable_node_unsuitable(self):
+        driver = SubsliceDriver()
+        nas = make_nas(partitionable=False)
+        ca = make_ca(SubsliceClaimParametersSpec(profile="1c.4gb"))
+        run_unsuitable(driver, nas, [ca])
+        assert NODE in ca.unsuitable_nodes
+
+    def test_packing_many_small_slices(self):
+        # 4 chips x 4 cores = 16 one-core slices fit; the 17th doesn't.
+        driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        cas = [
+            make_ca(SubsliceClaimParametersSpec(profile="1c.4gb"), name=f"s{i}")
+            for i in range(16)
+        ]
+        run_unsuitable(driver, nas, cas)
+        assert all(ca.unsuitable_nodes == [] for ca in cas)
+        placements = {
+            (d.parent_uuid, d.placement.start)
+            for ca in cas
+            for d in nas.spec.allocated_claims[ca.claim.metadata.uid].subslice.devices
+        }
+        assert len(placements) == 16  # all distinct
+
+        extra = make_ca(SubsliceClaimParametersSpec(profile="1c.4gb"), name="extra")
+        run_unsuitable(driver, nas, [extra])
+        assert NODE in extra.unsuitable_nodes
+
+    def test_backtracking_mixed_profiles(self):
+        # One chip: 4 cores.  Claims: 2c + 1c + 1c must tile without overlap.
+        driver = SubsliceDriver()
+        nas = make_nas(mesh=(1, 1), partitionable=True)
+        cas = [
+            make_ca(SubsliceClaimParametersSpec(profile="2c.8gb"), name="big"),
+            make_ca(SubsliceClaimParametersSpec(profile="1c.4gb"), name="a"),
+            make_ca(SubsliceClaimParametersSpec(profile="1c.4gb"), name="b"),
+        ]
+        run_unsuitable(driver, nas, cas)
+        assert all(ca.unsuitable_nodes == [] for ca in cas)
+        intervals = []
+        for ca in cas:
+            d = nas.spec.allocated_claims[ca.claim.metadata.uid].subslice.devices[0]
+            intervals.append((d.placement.start, d.placement.size))
+        # No overlaps and total coverage == 4 cores.
+        covered = set()
+        for start, size in intervals:
+            span = set(range(start, start + size))
+            assert not (covered & span)
+            covered |= span
+        assert covered == {0, 1, 2, 3}
+
+    def test_overcommit_unsuitable(self):
+        driver = SubsliceDriver()
+        nas = make_nas(mesh=(1, 1), partitionable=True)
+        cas = [
+            make_ca(SubsliceClaimParametersSpec(profile="2c.8gb"), name="a"),
+            make_ca(SubsliceClaimParametersSpec(profile="2c.8gb"), name="b"),
+            make_ca(SubsliceClaimParametersSpec(profile="1c.4gb"), name="c"),
+        ]
+        run_unsuitable(driver, nas, cas)
+        assert all(NODE in ca.unsuitable_nodes for ca in cas)
+
+
+class TestParentAffinity:
+    def setup_parent(self, driver_tpu, nas, pod, claim_name):
+        """Allocate a whole partitionable chip to the pod via a TPU claim."""
+        from tpu_dra.api.tpu_v1alpha1 import make_property_selector
+
+        ca = make_ca(
+            TpuClaimParametersSpec(
+                count=1, selector=make_property_selector(partitionable=True)
+            ),
+            name=claim_name,
+        )
+        driver_tpu.unsuitable_node(nas, pod, [ca], [ca], NODE)
+        assert ca.unsuitable_nodes == []
+        return ca
+
+    def test_affinity_to_parent_claim(self):
+        tpu_driver = TpuDriver()
+        sub_driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        pod = make_pod("pod-x")
+        parent_ca = self.setup_parent(tpu_driver, nas, pod, "parent-claim")
+        parent_uuid = nas.spec.allocated_claims[
+            parent_ca.claim.metadata.uid
+        ].tpu.devices[0].uuid
+
+        sub_ca = make_ca(
+            SubsliceClaimParametersSpec(profile="1c.4gb", tpu_claim_name="parent-claim"),
+            name="sub",
+        )
+        run_unsuitable(sub_driver, nas, [sub_ca], pod=pod)
+        assert sub_ca.unsuitable_nodes == []
+        dev = nas.spec.allocated_claims[sub_ca.claim.metadata.uid].subslice.devices[0]
+        assert dev.parent_uuid == parent_uuid
+
+    def test_affinity_pod_prefixed_template_name(self):
+        tpu_driver = TpuDriver()
+        sub_driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        pod = make_pod("pod-x")
+        # Template-instantiated parent claim is named "<pod>-<template name>".
+        self.setup_parent(tpu_driver, nas, pod, "pod-x-parent")
+        sub_ca = make_ca(
+            SubsliceClaimParametersSpec(profile="1c.4gb", tpu_claim_name="parent"),
+            name="sub",
+        )
+        run_unsuitable(sub_driver, nas, [sub_ca], pod=pod)
+        assert sub_ca.unsuitable_nodes == []
+
+    def test_affinity_unsatisfied_when_no_parent(self):
+        sub_driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        sub_ca = make_ca(
+            SubsliceClaimParametersSpec(profile="1c.4gb", tpu_claim_name="ghost"),
+            name="sub",
+        )
+        run_unsuitable(sub_driver, nas, [sub_ca])
+        assert NODE in sub_ca.unsuitable_nodes
+
+    def test_foreign_parent_chip_not_poached(self):
+        # A chip whole-allocated to an unrelated claim must not host
+        # affinity-free subslices (stricter than the reference; see module doc).
+        sub_driver = SubsliceDriver()
+        nas = make_nas(mesh=(1, 1), partitionable=True)
+        nas.spec.allocated_claims["foreign-uid"] = AllocatedDevices(
+            claim_info=ClaimInfo(namespace="other", name="foreign", uid="foreign-uid"),
+            tpu=AllocatedTpus(devices=[AllocatedTpu(uuid="tpu-0", coord=(0, 0, 0))]),
+        )
+        sub_ca = make_ca(SubsliceClaimParametersSpec(profile="1c.4gb"), name="sub")
+        run_unsuitable(sub_driver, nas, [sub_ca])
+        assert NODE in sub_ca.unsuitable_nodes
+
+
+class TestTwoPhase:
+    def test_promote_pending(self):
+        driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        ca = make_ca(SubsliceClaimParametersSpec(profile="1c.4gb"))
+        run_unsuitable(driver, nas, [ca])
+        uid = ca.claim.metadata.uid
+
+        nas2 = make_nas(partitionable=True)
+        on_success = driver.allocate(nas2, ca.claim, ca.claim_parameters, None, NODE)
+        assert nas2.spec.allocated_claims[uid].subslice.devices[0].profile == "1c.4gb"
+        on_success()
+        assert not driver.pending_allocated_claims.exists(uid, NODE)
+
+    def test_allocate_without_pending_fails(self):
+        driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        ca = make_ca(SubsliceClaimParametersSpec(profile="1c.4gb"))
+        with pytest.raises(RuntimeError):
+            driver.allocate(nas, ca.claim, ca.claim_parameters, None, NODE)
+
+
+class TestSubslicePlacement:
+    def test_overlap_same_parent_only(self):
+        a = SubslicePlacement("p1", Placement(0, 2))
+        b = SubslicePlacement("p1", Placement(1, 2))
+        c = SubslicePlacement("p2", Placement(0, 2))
+        assert a.overlaps(b)
+        assert not a.overlaps(c)
+
+
+class TestEmptyClaimList:
+    def test_no_subslice_claims_is_noop(self):
+        # A pod with only whole-TPU claims must not be poisoned by the
+        # subslice driver (reference: len(nil) == len(empty migcas) passes).
+        driver = SubsliceDriver()
+        nas = make_nas(partitionable=False)
+        other = make_ca(TpuClaimParametersSpec(count=1), name="tpu-only")
+        driver.unsuitable_node(nas, make_pod(), [], [other], NODE)
+        assert other.unsuitable_nodes == []

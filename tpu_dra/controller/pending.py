@@ -1,0 +1,60 @@
+"""Pending-allocation cache (reference: cmd/nvidia-dra-controller/
+allocations.go:25-113, component C5).
+
+Bridges the two-phase scheduling dance: UnsuitableNodes computes and caches a
+tentative per-node allocation; Allocate later promotes the cached entry for
+the scheduler-selected node into the NAS object.  SURVEY.md §7 flags this
+hand-off as "racy by design, easy to corrupt" — hence a plain lock (not a
+RWLock) and deep copies on every get/set so cached entries can never alias
+NAS documents under concurrent workers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from tpu_dra.api import serde
+from tpu_dra.api.nas_v1alpha1 import AllocatedDevices
+
+
+class PerNodeAllocatedClaims:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # claimUID -> node -> AllocatedDevices
+        self._allocations: dict[str, dict[str, AllocatedDevices]] = {}
+
+    def exists(self, claim_uid: str, node: str) -> bool:
+        with self._lock:
+            return node in self._allocations.get(claim_uid, {})
+
+    def get(self, claim_uid: str, node: str) -> AllocatedDevices:
+        with self._lock:
+            entry = self._allocations.get(claim_uid, {}).get(node)
+            return serde.deepcopy(entry) if entry is not None else AllocatedDevices()
+
+    def set(self, claim_uid: str, node: str, devices: AllocatedDevices) -> None:
+        with self._lock:
+            self._allocations.setdefault(claim_uid, {})[node] = serde.deepcopy(
+                devices
+            )
+
+    def visit_node(
+        self, node: str, visitor: Callable[[str, AllocatedDevices], None]
+    ) -> None:
+        with self._lock:
+            snapshot = [
+                (uid, serde.deepcopy(nodes[node]))
+                for uid, nodes in self._allocations.items()
+                if node in nodes
+            ]
+        for uid, allocation in snapshot:
+            visitor(uid, allocation)
+
+    def remove_node(self, claim_uid: str, node: str) -> None:
+        with self._lock:
+            self._allocations.get(claim_uid, {}).pop(node, None)
+
+    def remove(self, claim_uid: str) -> None:
+        with self._lock:
+            self._allocations.pop(claim_uid, None)

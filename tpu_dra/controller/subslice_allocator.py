@@ -1,0 +1,257 @@
+"""Core-subslice allocator — reference: cmd/nvidia-dra-controller/
+mig.go:30-325 (component C4).
+
+Subslice claims request a profile ("1c.4gb") carved out of a partitionable
+chip, optionally affine to the pod's whole-chip claim via ``tpu_claim_name``
+(the gpuClaimName parent-affinity of mig.go:196-210).  The allocator:
+
+1. builds the candidate map profile -> [(parent chip UUID, placement)] from
+   the node's allocatable subslice entries crossed with its partitionable
+   chips (mig.go:122-153),
+2. removes candidates overlapping already-allocated subslices
+   (mig.go:155-166),
+3. filters by parent-claim affinity (mig.go:196-210) — stricter than the
+   reference: a candidate whose parent chip is whole-allocated to *any*
+   claim is usable only when the affinity names that claim (the reference
+   only checks claims of the current pod, which could double-book a parent
+   chip held by another pod),
+4. runs a backtracking search for a mutually non-overlapping placement
+   combination across all the pod's subslice claims (mig.go:231-262), with
+   per-step overlap pruning rather than leaf-only checks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api import serde
+from tpu_dra.api import tpu_v1alpha1 as tpucrd
+from tpu_dra.api.k8s import Pod, ResourceClaim
+from tpu_dra.api.topology import Placement
+from tpu_dra.controller.pending import PerNodeAllocatedClaims
+from tpu_dra.controller.types import ClaimAllocation
+
+OnSuccessCallback = Callable[[], None]
+
+
+@dataclass(frozen=True)
+class SubslicePlacement:
+    """A concrete candidate: profile placed at a core interval of a chip
+    (MigDevicePlacement analog, mig.go:44-47)."""
+
+    parent_uuid: str
+    placement: Placement
+
+    def overlaps(self, other: "SubslicePlacement") -> bool:
+        return (
+            self.parent_uuid == other.parent_uuid
+            and self.placement.overlaps(other.placement)
+        )
+
+
+class SubsliceDriver:
+    def __init__(self):
+        self.pending_allocated_claims = PerNodeAllocatedClaims()
+
+    def validate_claim_parameters(
+        self, params: tpucrd.SubsliceClaimParametersSpec
+    ) -> None:
+        from tpu_dra.api.topology import SubsliceProfile
+
+        if not params.profile:
+            raise ValueError("subslice claim requires a profile")
+        SubsliceProfile.parse(params.profile)  # raises on malformed
+
+    def allocate(
+        self,
+        crd: nascrd.NodeAllocationState,
+        claim: ResourceClaim,
+        claim_params: tpucrd.SubsliceClaimParametersSpec,
+        class_params: tpucrd.DeviceClassParametersSpec,
+        selected_node: str,
+    ) -> OnSuccessCallback:
+        claim_uid = claim.metadata.uid
+        if not self.pending_allocated_claims.exists(claim_uid, selected_node):
+            raise RuntimeError(
+                f"no allocations generated for claim '{claim_uid}' "
+                f"on node '{selected_node}' yet"
+            )
+        crd.spec.allocated_claims[claim_uid] = self.pending_allocated_claims.get(
+            claim_uid, selected_node
+        )
+        return lambda: self.pending_allocated_claims.remove(claim_uid)
+
+    def deallocate(self, crd: nascrd.NodeAllocationState, claim: ResourceClaim) -> None:
+        self.pending_allocated_claims.remove(claim.metadata.uid)
+
+    def unsuitable_node(
+        self,
+        crd: nascrd.NodeAllocationState,
+        pod: Pod,
+        subcas: list[ClaimAllocation],
+        allcas: list[ClaimAllocation],
+        potential_node: str,
+    ) -> None:
+        def sync(claim_uid: str, allocation: nascrd.AllocatedDevices) -> None:
+            if claim_uid in crd.spec.allocated_claims:
+                self.pending_allocated_claims.remove(claim_uid)
+            else:
+                crd.spec.allocated_claims[claim_uid] = allocation
+
+        self.pending_allocated_claims.visit_node(potential_node, sync)
+
+        # A pod with no subslice claims is trivially satisfiable here — the
+        # reference passes this case because len(nil) == len(empty migcas)
+        # (mig.go:85-91); without this guard an empty candidate map would
+        # poison the node for the pod's other claims.
+        if not subcas:
+            return
+
+        placements = self._allocate(crd, pod, subcas)
+        if placements is None or len(placements) != len(subcas):
+            for other in allcas:
+                other.unsuitable_nodes.append(potential_node)
+            return
+
+        for ca in subcas:
+            claim_uid = ca.claim.metadata.uid
+            params: tpucrd.SubsliceClaimParametersSpec = ca.claim_parameters
+            chosen = placements[claim_uid]
+            result = nascrd.AllocatedDevices(
+                claim_info=nascrd.ClaimInfo(
+                    namespace=ca.claim.metadata.namespace,
+                    name=ca.claim.metadata.name,
+                    uid=claim_uid,
+                ),
+                subslice=nascrd.AllocatedSubslices(
+                    devices=[
+                        nascrd.AllocatedSubslice(
+                            profile=params.profile,
+                            parent_uuid=chosen.parent_uuid,
+                            placement=chosen.placement,
+                        )
+                    ],
+                    sharing=serde.deepcopy(params.sharing),
+                ),
+            )
+            self.pending_allocated_claims.set(claim_uid, potential_node, result)
+            crd.spec.allocated_claims[claim_uid] = result
+
+    # -- internals ----------------------------------------------------------
+
+    def _available(
+        self, crd: nascrd.NodeAllocationState
+    ) -> dict[str, list[SubslicePlacement]]:
+        """profile -> candidate placements on every partitionable chip,
+        minus those overlapping already-allocated subslices (mig.go:122-169)."""
+        parents: dict[str, list[str]] = {}
+        for device in crd.spec.allocatable_devices:
+            if device.type() != nascrd.TPU_DEVICE_TYPE:
+                continue
+            if not device.tpu.partitionable:
+                continue
+            parents.setdefault(device.tpu.product, []).append(device.tpu.uuid)
+
+        candidates: dict[str, list[SubslicePlacement]] = {}
+        for device in crd.spec.allocatable_devices:
+            if device.type() != nascrd.SUBSLICE_DEVICE_TYPE:
+                continue
+            entry = []
+            for parent_uuid in parents.get(device.subslice.parent_product, []):
+                for p in device.subslice.placements:
+                    entry.append(SubslicePlacement(parent_uuid, p))
+            candidates[device.subslice.profile] = entry
+
+        for allocation in crd.spec.allocated_claims.values():
+            if allocation.type() != nascrd.SUBSLICE_DEVICE_TYPE:
+                continue
+            for dev in allocation.subslice.devices:
+                taken = SubslicePlacement(dev.parent_uuid, dev.placement)
+                for profile in candidates:
+                    candidates[profile] = [
+                        c for c in candidates[profile] if not c.overlaps(taken)
+                    ]
+        return candidates
+
+    def _parent_claim_info(
+        self, crd: nascrd.NodeAllocationState
+    ) -> dict[str, nascrd.ClaimInfo]:
+        """Chip UUID -> the whole-chip claim holding it (mig.go:265-287,
+        widened to all allocated claims, not just the pod's)."""
+        info: dict[str, nascrd.ClaimInfo] = {}
+        for claim_uid, allocation in crd.spec.allocated_claims.items():
+            if allocation.type() != nascrd.TPU_DEVICE_TYPE:
+                continue
+            claim_info = allocation.claim_info or nascrd.ClaimInfo(uid=claim_uid)
+            for dev in allocation.tpu.devices:
+                info[dev.uuid] = claim_info
+        return info
+
+    def _allocate(
+        self,
+        crd: nascrd.NodeAllocationState,
+        pod: Pod,
+        subcas: list[ClaimAllocation],
+    ) -> dict[str, SubslicePlacement] | None:
+        available = self._available(crd)
+        parent_info = self._parent_claim_info(crd)
+
+        possible: dict[str, list[SubslicePlacement]] = {}
+        for ca in subcas:
+            claim_uid = ca.claim.metadata.uid
+            existing = crd.spec.allocated_claims.get(claim_uid)
+            if existing is not None and existing.subslice is not None:
+                dev = existing.subslice.devices[0]
+                possible[claim_uid] = [
+                    SubslicePlacement(dev.parent_uuid, dev.placement)
+                ]
+                continue
+
+            params: tpucrd.SubsliceClaimParametersSpec = ca.claim_parameters
+            candidates = available.get(params.profile)
+            if not candidates:
+                return None
+
+            filtered = []
+            for cand in candidates:
+                holder = parent_info.get(cand.parent_uuid)
+                if holder is not None:
+                    # Parent chip is whole-allocated: usable only via affinity
+                    # to that claim — template-instantiated (pod-prefixed) or
+                    # exact name (mig.go:198-204).
+                    if params.tpu_claim_name and holder.name in (
+                        f"{pod.metadata.name}-{params.tpu_claim_name}",
+                        params.tpu_claim_name,
+                    ):
+                        filtered.append(cand)
+                    continue
+                if not params.tpu_claim_name:
+                    filtered.append(cand)
+            if not filtered:
+                return None
+            possible[claim_uid] = filtered
+
+        if not possible:
+            return None
+
+        # Backtracking search for a mutually non-overlapping combination
+        # (mig.go:231-262), pruning overlaps at each step.
+        order = [ca.claim.metadata.uid for ca in subcas]
+        chosen: dict[str, SubslicePlacement] = {}
+
+        def search(i: int) -> bool:
+            if i == len(order):
+                return True
+            uid = order[i]
+            for cand in possible[uid]:
+                if any(cand.overlaps(prev) for prev in chosen.values()):
+                    continue
+                chosen[uid] = cand
+                if search(i + 1):
+                    return True
+                del chosen[uid]
+            return False
+
+        return dict(chosen) if search(0) else None

@@ -1,0 +1,231 @@
+"""Whole-chip (TPU) allocator — reference: cmd/nvidia-dra-controller/
+gpu.go:31-204 (component C3), with the first-fit placement replaced by the
+ICI-topology-aware engine in placement.py.
+
+The two-phase protocol it implements (identical to the reference):
+
+- ``unsuitable_node`` (scheduling phase, gpu.go:68-112): re-sync the pending
+  cache against the node's NAS (promote entries the controller already wrote,
+  drop duplicates), tentatively allocate every TPU claim of the pod, and if
+  any claim can't be satisfied mark this node unsuitable for *all* the pod's
+  claims (gang semantics, gpu.go:85-90).  Successful tentative allocations
+  are recorded both in the pending cache and the in-memory NAS copy so later
+  claims in the same pass see them as taken.
+- ``allocate`` (commit phase, gpu.go:48-61): promote the pending entry for
+  the scheduler-selected node into the NAS document; the returned on-success
+  callback clears the cache entry once the NAS write lands.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api import serde
+from tpu_dra.api import tpu_v1alpha1 as tpucrd
+from tpu_dra.api.k8s import Pod, ResourceClaim
+from tpu_dra.api.selector import glob_matches
+from tpu_dra.api.topology import Topology
+from tpu_dra.controller.pending import PerNodeAllocatedClaims
+from tpu_dra.controller.placement import place_count, place_topology
+from tpu_dra.controller.types import ClaimAllocation
+from tpu_dra.utils.quantity import Quantity
+
+OnSuccessCallback = Callable[[], None]
+
+
+class TpuDriver:
+    def __init__(self):
+        self.pending_allocated_claims = PerNodeAllocatedClaims()
+
+    def validate_claim_parameters(
+        self, params: tpucrd.TpuClaimParametersSpec
+    ) -> None:
+        if params.count is not None and params.topology is not None:
+            raise ValueError("claim may set count or topology, not both")
+        if params.count is None and params.topology is None:
+            raise ValueError("claim must set count or topology")
+        if params.count is not None and params.count < 1:
+            raise ValueError(f"invalid number of TPUs requested: {params.count}")
+        if params.topology is not None:
+            Topology.parse(params.topology)  # raises on malformed
+
+    def allocate(
+        self,
+        crd: nascrd.NodeAllocationState,
+        claim: ResourceClaim,
+        claim_params: tpucrd.TpuClaimParametersSpec,
+        class_params: tpucrd.DeviceClassParametersSpec,
+        selected_node: str,
+    ) -> OnSuccessCallback:
+        claim_uid = claim.metadata.uid
+        if not self.pending_allocated_claims.exists(claim_uid, selected_node):
+            raise RuntimeError(
+                f"no allocations generated for claim '{claim_uid}' "
+                f"on node '{selected_node}' yet"
+            )
+        crd.spec.allocated_claims[claim_uid] = self.pending_allocated_claims.get(
+            claim_uid, selected_node
+        )
+        return lambda: self.pending_allocated_claims.remove(claim_uid)
+
+    def deallocate(self, crd: nascrd.NodeAllocationState, claim: ResourceClaim) -> None:
+        self.pending_allocated_claims.remove(claim.metadata.uid)
+
+    def unsuitable_node(
+        self,
+        crd: nascrd.NodeAllocationState,
+        pod: Pod,
+        tpucas: list[ClaimAllocation],
+        allcas: list[ClaimAllocation],
+        potential_node: str,
+    ) -> None:
+        # Re-sync pending cache with the NAS truth (gpu.go:69-76).
+        def sync(claim_uid: str, allocation: nascrd.AllocatedDevices) -> None:
+            if claim_uid in crd.spec.allocated_claims:
+                self.pending_allocated_claims.remove(claim_uid)
+            else:
+                crd.spec.allocated_claims[claim_uid] = allocation
+
+        self.pending_allocated_claims.visit_node(potential_node, sync)
+
+        allocated = self._allocate(crd, tpucas)
+        for ca in tpucas:
+            claim_uid = ca.claim.metadata.uid
+            params: tpucrd.TpuClaimParametersSpec = ca.claim_parameters
+            requested = (
+                Topology.parse(params.topology).size
+                if params.topology is not None
+                else params.count
+            )
+            devices, topo = allocated.get(claim_uid, ([], None))
+            if requested != len(devices):
+                # Gang semantics: one unsatisfiable claim poisons the node
+                # for every claim of the pod (gpu.go:85-90).
+                for other in allcas:
+                    other.unsuitable_nodes.append(potential_node)
+                return
+
+            result = nascrd.AllocatedDevices(
+                claim_info=nascrd.ClaimInfo(
+                    namespace=ca.claim.metadata.namespace,
+                    name=ca.claim.metadata.name,
+                    uid=claim_uid,
+                ),
+                tpu=nascrd.AllocatedTpus(
+                    devices=devices,
+                    topology=str(topo) if topo is not None else "",
+                    sharing=serde.deepcopy(params.sharing),
+                ),
+            )
+            self.pending_allocated_claims.set(claim_uid, potential_node, result)
+            crd.spec.allocated_claims[claim_uid] = result
+
+    def _allocate(
+        self,
+        crd: nascrd.NodeAllocationState,
+        tpucas: list[ClaimAllocation],
+    ) -> dict[str, tuple[list[nascrd.AllocatedTpu], Topology | None]]:
+        """Tentatively place every claim; availability = allocatable minus
+        already-allocated (whole chips and subslice parents), gpu.go:114-135."""
+        available: dict[str, nascrd.AllocatableTpu] = {}
+        for device in crd.spec.allocatable_devices:
+            if device.type() == nascrd.TPU_DEVICE_TYPE:
+                available[device.tpu.uuid] = device.tpu
+
+        for allocation in crd.spec.allocated_claims.values():
+            if allocation.type() == nascrd.TPU_DEVICE_TYPE:
+                for dev in allocation.tpu.devices:
+                    available.pop(dev.uuid, None)
+            elif allocation.type() == nascrd.SUBSLICE_DEVICE_TYPE:
+                for dev in allocation.subslice.devices:
+                    available.pop(dev.parent_uuid, None)
+
+        allocated: dict[str, tuple[list[nascrd.AllocatedTpu], Topology | None]] = {}
+        for ca in tpucas:
+            claim_uid = ca.claim.metadata.uid
+            existing = crd.spec.allocated_claims.get(claim_uid)
+            if existing is not None and existing.tpu is not None:
+                topo = (
+                    Topology.parse(existing.tpu.topology)
+                    if existing.tpu.topology
+                    else None
+                )
+                allocated[claim_uid] = (list(existing.tpu.devices), topo)
+                continue
+
+            params: tpucrd.TpuClaimParametersSpec = ca.claim_parameters
+            eligible = {
+                uuid: chip
+                for uuid, chip in available.items()
+                if selector_matches_tpu(params.selector, chip)
+            }
+            free_coords = {chip.coord: chip for chip in eligible.values()}
+
+            if params.topology is not None:
+                placed = place_topology(
+                    Topology.parse(params.topology), set(free_coords)
+                )
+                # The *placed* orientation is recorded (it may be a rotation
+                # of the request): device order + topology string together
+                # define the claimed mesh for the node plugin's env injection.
+                block, topo = placed if placed is not None else ([], None)
+                chips = [free_coords[c] for c in block]
+            else:
+                block, topo = place_count(params.count or 0, set(free_coords))
+                chips = [free_coords[c] for c in block]
+
+            devices = [
+                nascrd.AllocatedTpu(uuid=chip.uuid, coord=chip.coord)
+                for chip in chips
+            ]
+            for chip in chips:
+                available.pop(chip.uuid, None)
+            allocated[claim_uid] = (devices, topo)
+
+        return allocated
+
+
+def selector_matches_tpu(
+    selector: tpucrd.TpuSelector | None, tpu: nascrd.AllocatableTpu
+) -> bool:
+    """Evaluate a claim selector against one chip's attributes
+    (gpu.go:166-204 analog).
+
+    Parity detail: with no selector, only non-partitionable chips match; and
+    a matching selector that never examined ``partitionable`` also excludes
+    partitionable chips — they are reserved for subslice claims unless
+    requested explicitly (mirrors the migEnabled handling).
+    """
+    if selector is None:
+        return not tpu.partitionable
+
+    checked_partitionable = False
+
+    def compare(p: tpucrd.TpuSelectorProperties) -> bool:
+        nonlocal checked_partitionable
+        if p.index is not None:
+            return p.index == tpu.index
+        if p.uuid is not None:
+            return p.uuid == tpu.uuid
+        if p.partitionable is not None:
+            checked_partitionable = True
+            return p.partitionable == tpu.partitionable
+        if p.hbm is not None:
+            return p.hbm.matches(Quantity(tpu.hbm_bytes))
+        if p.product is not None:
+            return glob_matches(p.product, tpu.product)
+        if p.generation is not None:
+            return glob_matches(p.generation, tpu.generation)
+        if p.ici_domain is not None:
+            return glob_matches(p.ici_domain, tpu.ici_domain)
+        if p.libtpu_version is not None:
+            return p.libtpu_version.matches(tpu.libtpu_version)
+        if p.runtime_version is not None:
+            return p.runtime_version.matches(tpu.runtime_version)
+        return False
+
+    matches = selector.matches(compare)
+    if matches and not checked_partitionable:
+        return not tpu.partitionable
+    return matches

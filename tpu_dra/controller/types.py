@@ -1,0 +1,25 @@
+"""Shared controller types.
+
+``ClaimAllocation`` is the unit of work the reconciler hands to the driver
+for each claim of a pod being scheduled (analog of the vendored
+controller.ClaimAllocation, vendor/.../controller/controller.go:93-104).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from tpu_dra.api.k8s import AllocationResult, ResourceClaim, ResourceClass
+
+
+@dataclass
+class ClaimAllocation:
+    claim: ResourceClaim
+    class_: ResourceClass
+    claim_parameters: Any = None
+    class_parameters: Any = None
+    unsuitable_nodes: list[str] = field(default_factory=list)
+    # Filled by Allocate on success:
+    allocation: AllocationResult | None = None
+    error: Exception | None = None
