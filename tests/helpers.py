@@ -106,3 +106,73 @@ def make_ca(claim_params, name: str = "claim-1") -> ClaimAllocation:
         class_=ResourceClass(metadata=ObjectMeta(name="tpu.google.com")),
         claim_parameters=claim_params,
     )
+
+
+# --- plugin-stack helpers ---------------------------------------------------
+
+def make_plugin_stack(
+    tmp_path,
+    clientset,
+    *,
+    node: str = "node-1",
+    mesh: str = "2x2x1",
+    partitionable: bool = False,
+    namespace: str = "tpu-dra",
+    backoff_scale: float = 0.01,
+):
+    """Build a full node-plugin stack over the fake apiserver + mock tpulib."""
+    from tpu_dra.plugin.cdi import CDIHandler
+    from tpu_dra.plugin.device_state import DeviceState
+    from tpu_dra.plugin.sharing import RuntimeProxyManager, TimeSlicingManager
+    from tpu_dra.plugin.tpulib import MockTpuLib
+
+    tpulib = MockTpuLib(
+        mesh,
+        partitionable=partitionable,
+        state_dir=str(tmp_path / "tpulib"),
+    )
+    cdi = CDIHandler(str(tmp_path / "cdi"), tpulib)
+    ts = TimeSlicingManager(tpulib)
+    proxy = RuntimeProxyManager(
+        clientset,
+        tpulib,
+        node_name=node,
+        namespace=namespace,
+        proxy_root=str(tmp_path / "proxy"),
+        backoff_scale=backoff_scale,
+    )
+    state = DeviceState(tpulib, cdi, ts, proxy)
+    return tpulib, cdi, state
+
+
+class DeploymentReadinessStub:
+    """Marks every created Deployment ready — the fake cluster's
+    'deployment controller' so RuntimeProxy readiness polls succeed."""
+
+    def __init__(self, clientset, namespace: str = "tpu-dra"):
+        import threading
+
+        self._cs = clientset
+        self._ns = namespace
+        self._watch = clientset.server.watch("Deployment")
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        from tpu_dra.client.apiserver import ApiError
+
+        for event in self._watch:
+            if event["type"] != "ADDED":
+                continue
+            obj = event["object"]
+            client = self._cs.deployments(obj["metadata"].get("namespace", ""))
+            try:
+                deployment = client.get(obj["metadata"]["name"])
+                deployment.status.ready_replicas = 1
+                deployment.status.available_replicas = 1
+                client.update_status(deployment)
+            except ApiError:
+                pass
+
+    def stop(self):
+        self._watch.stop()

@@ -1,0 +1,120 @@
+"""CDI handler tests: spec files, env construction, lifecycle."""
+
+import json
+
+import pytest
+
+from tpu_dra.api.nas_v1alpha1 import (
+    AllocatedDevices,
+    AllocatedTpu,
+    AllocatedTpus,
+    PreparedDevices,
+    PreparedSubslice,
+    PreparedSubslices,
+    PreparedTpu,
+    PreparedTpus,
+)
+from tpu_dra.api.topology import Placement
+from tpu_dra.plugin.cdi import CDIHandler
+from tpu_dra.plugin.tpulib import MockTpuLib
+
+
+@pytest.fixture
+def lib(tmp_path):
+    return MockTpuLib("2x2x1", partitionable=True, state_dir=str(tmp_path / "state"))
+
+
+@pytest.fixture
+def handler(tmp_path, lib):
+    return CDIHandler(str(tmp_path / "cdi"), lib)
+
+
+def prepared_tpus(*uuids):
+    return PreparedDevices(
+        tpu=PreparedTpus(devices=[PreparedTpu(uuid=u) for u in uuids])
+    )
+
+
+class TestTpuClaimSpec:
+    def test_spec_contents(self, handler):
+        prepared = prepared_tpus("mock-tpu-0", "mock-tpu-1")
+        allocated = AllocatedDevices(
+            tpu=AllocatedTpus(
+                devices=[AllocatedTpu(uuid="mock-tpu-0"), AllocatedTpu(uuid="mock-tpu-1")],
+                topology="2x1x1",
+            )
+        )
+        path = handler.create_claim_spec_file("uid-1", prepared, allocated)
+        spec = json.load(open(path))
+        assert spec["kind"] == "tpu.resource.google.com/claim"
+        (device,) = spec["devices"]
+        assert device["name"] == "uid-1"
+        edits = device["containerEdits"]
+        assert {n["path"] for n in edits["deviceNodes"]} == {"/dev/accel0", "/dev/accel1"}
+        env = dict(e.split("=", 1) for e in edits["env"])
+        assert env["TPU_VISIBLE_DEVICES"] == "0,1"
+        assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,1,1"
+        assert env["TPU_ACCELERATOR_TYPE"] == "v5e"
+        assert env["TPU_DRA_CLAIM"] == "uid-1"
+        # libtpu common mount present
+        assert any("libtpu.so" in m["hostPath"] for m in edits["mounts"])
+
+    def test_no_topology_no_bounds(self, handler):
+        path = handler.create_claim_spec_file("uid-2", prepared_tpus("mock-tpu-3"))
+        edits = json.load(open(path))["devices"][0]["containerEdits"]
+        env = dict(e.split("=", 1) for e in edits["env"])
+        assert "TPU_CHIPS_PER_HOST_BOUNDS" not in env
+        assert env["TPU_VISIBLE_DEVICES"] == "3"
+
+    def test_extra_edits_merged(self, handler):
+        path = handler.create_claim_spec_file(
+            "uid-3",
+            prepared_tpus("mock-tpu-0"),
+            extra_edits={"env": ["TPU_RUNTIME_PROXY_ADDR=/run/proxy.sock"]},
+        )
+        edits = json.load(open(path))["devices"][0]["containerEdits"]
+        assert "TPU_RUNTIME_PROXY_ADDR=/run/proxy.sock" in edits["env"]
+
+
+class TestSubsliceClaimSpec:
+    def test_spec_contents(self, handler):
+        prepared = PreparedDevices(
+            subslice=PreparedSubslices(
+                devices=[
+                    PreparedSubslice(
+                        uuid="ss-abc",
+                        profile="2c.8gb",
+                        parent_uuid="mock-tpu-2",
+                        placement=Placement(2, 2),
+                    )
+                ]
+            )
+        )
+        path = handler.create_claim_spec_file("uid-4", prepared)
+        edits = json.load(open(path))["devices"][0]["containerEdits"]
+        env = dict(e.split("=", 1) for e in edits["env"])
+        assert env["TPU_VISIBLE_DEVICES"] == "2"
+        assert env["TPU_VISIBLE_CORES"] == "2-3"
+        assert env["TPU_SUBSLICE_UUID"] == "ss-abc"
+        assert {n["path"] for n in edits["deviceNodes"]} == {"/dev/accel2"}
+
+
+class TestLifecycle:
+    def test_exists_list_delete(self, handler):
+        handler.create_claim_spec_file("uid-a", prepared_tpus("mock-tpu-0"))
+        handler.create_claim_spec_file("uid-b", prepared_tpus("mock-tpu-1"))
+        assert handler.claim_spec_exists("uid-a")
+        assert handler.list_claim_spec_files() == ["uid-a", "uid-b"]
+        handler.delete_claim_spec_file("uid-a")
+        assert not handler.claim_spec_exists("uid-a")
+        handler.delete_claim_spec_file("uid-a")  # idempotent
+        assert handler.list_claim_spec_files() == ["uid-b"]
+
+    def test_qualified_device_name(self, handler):
+        assert handler.get_claim_devices("uid-9") == [
+            "tpu.resource.google.com/claim=uid-9"
+        ]
+
+    def test_unknown_type_raises(self, handler):
+        with pytest.raises(ValueError):
+            handler.create_claim_spec_file("uid-x", PreparedDevices())

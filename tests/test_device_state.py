@@ -1,0 +1,367 @@
+"""DeviceState tests: prepare/unprepare, spec sync, crash re-adoption."""
+
+import pytest
+
+from helpers import DeploymentReadinessStub, make_plugin_stack
+from tpu_dra.api.nas_v1alpha1 import (
+    AllocatedDevices,
+    AllocatedSubslice,
+    AllocatedSubslices,
+    AllocatedTpu,
+    AllocatedTpus,
+    ClaimInfo,
+    NodeAllocationStateSpec,
+)
+from tpu_dra.api.sharing import (
+    SharingStrategy,
+    SubsliceSharing,
+    TimeSliceInterval,
+    TimeSlicingConfig,
+    TpuSharing,
+)
+from tpu_dra.api.topology import Placement
+from tpu_dra.client import ClientSet, FakeApiServer
+
+
+@pytest.fixture
+def cs():
+    return ClientSet(FakeApiServer())
+
+
+@pytest.fixture
+def stack(tmp_path, cs):
+    return make_plugin_stack(tmp_path, cs, partitionable=True)
+
+
+def tpu_allocation(*uuids, topology="", sharing=None, uid="uid-1"):
+    return AllocatedDevices(
+        claim_info=ClaimInfo(namespace="default", name="c", uid=uid),
+        tpu=AllocatedTpus(
+            devices=[AllocatedTpu(uuid=u) for u in uuids],
+            topology=topology,
+            sharing=sharing,
+        ),
+    )
+
+
+def subslice_allocation(parent, profile="1c.4gb", start=0, sharing=None, uid="uid-2"):
+    from tpu_dra.api.topology import SubsliceProfile
+
+    size = SubsliceProfile.parse(profile).cores
+    return AllocatedDevices(
+        claim_info=ClaimInfo(namespace="default", name="c2", uid=uid),
+        subslice=AllocatedSubslices(
+            devices=[
+                AllocatedSubslice(
+                    profile=profile,
+                    parent_uuid=parent,
+                    placement=Placement(start, size),
+                )
+            ],
+            sharing=sharing,
+        ),
+    )
+
+
+class TestPrepare:
+    def test_prepare_tpu_claim(self, stack):
+        _, cdi, state = stack
+        devices = state.prepare("uid-1", tpu_allocation("mock-tpu-0", "mock-tpu-1"))
+        assert devices == ["tpu.resource.google.com/claim=uid-1"]
+        assert cdi.claim_spec_exists("uid-1")
+
+    def test_prepare_idempotent(self, stack):
+        _, _, state = stack
+        a = state.prepare("uid-1", tpu_allocation("mock-tpu-0"))
+        b = state.prepare("uid-1", tpu_allocation("mock-tpu-0"))
+        assert a == b
+
+    def test_prepare_unknown_chip(self, stack):
+        _, _, state = stack
+        with pytest.raises(ValueError, match="does not exist"):
+            state.prepare("uid-1", tpu_allocation("ghost-chip"))
+
+    def test_prepare_empty_allocation(self, stack):
+        _, _, state = stack
+        with pytest.raises(ValueError, match="no allocated devices"):
+            state.prepare("uid-1", AllocatedDevices())
+
+    def test_prepare_subslice_creates_device(self, stack):
+        tpulib, cdi, state = stack
+        state.prepare("uid-2", subslice_allocation("mock-tpu-0"))
+        live = tpulib.list_subslices()
+        assert len(live) == 1
+        assert live[0].parent_uuid == "mock-tpu-0"
+        assert cdi.claim_spec_exists("uid-2")
+
+    def test_prepare_subslice_rollback_on_failure(self, stack):
+        tpulib, _, state = stack
+        # Second device in the claim is invalid -> first must be rolled back.
+        bad = AllocatedDevices(
+            claim_info=ClaimInfo(uid="uid-3"),
+            subslice=AllocatedSubslices(
+                devices=[
+                    AllocatedSubslice(
+                        profile="1c.4gb",
+                        parent_uuid="mock-tpu-0",
+                        placement=Placement(0, 1),
+                    ),
+                    AllocatedSubslice(
+                        profile="1c.4gb",
+                        parent_uuid="ghost",
+                        placement=Placement(0, 1),
+                    ),
+                ]
+            ),
+        )
+        with pytest.raises(ValueError):
+            state.prepare("uid-3", bad)
+        assert tpulib.list_subslices() == []
+
+    def test_prepare_with_time_slicing(self, stack):
+        tpulib, _, state = stack
+        sharing = TpuSharing(
+            strategy=SharingStrategy.TIME_SLICING,
+            time_slicing_config=TimeSlicingConfig(TimeSliceInterval.LONG),
+        )
+        state.prepare("uid-4", tpu_allocation("mock-tpu-0", sharing=sharing))
+        assert tpulib.get_time_slice("mock-tpu-0") == 4
+
+    def test_prepare_with_runtime_proxy(self, stack, cs):
+        stub = DeploymentReadinessStub(cs)
+        try:
+            _, cdi, state = stack
+            sharing = TpuSharing(strategy=SharingStrategy.RUNTIME_PROXY)
+            state.prepare(
+                "uid-5", tpu_allocation("mock-tpu-0", sharing=sharing, uid="uid-5")
+            )
+            import json, glob, os
+
+            deployment = cs.deployments("tpu-dra").get("tpu-runtime-proxy-uid-5")
+            assert deployment.status.ready_replicas == 1
+            # Consumer edits flowed into the CDI spec.
+            spec_files = [
+                f for f in glob.glob(os.path.join(cdi._cdi_root, "*.json"))
+                if "uid-5" in f
+            ]
+            spec = json.load(open(spec_files[0]))
+            env = spec["devices"][0]["containerEdits"]["env"]
+            assert any(e.startswith("TPU_RUNTIME_PROXY_ADDR=") for e in env)
+        finally:
+            stub.stop()
+
+    def test_prepare_proxy_failure_rolls_back(self, tmp_path, cs):
+        # No readiness stub -> assert_ready times out -> deployment removed.
+        _, cdi, state = make_plugin_stack(
+            tmp_path, cs, partitionable=True, backoff_scale=0.001
+        )
+        sharing = TpuSharing(strategy=SharingStrategy.RUNTIME_PROXY)
+        with pytest.raises(TimeoutError):
+            state.prepare(
+                "uid-6", tpu_allocation("mock-tpu-0", sharing=sharing, uid="uid-6")
+            )
+        from tpu_dra.client.apiserver import NotFoundError
+
+        with pytest.raises(NotFoundError):
+            cs.deployments("tpu-dra").get("tpu-runtime-proxy-uid-6")
+        assert not cdi.claim_spec_exists("uid-6")
+        # Claim can be retried.
+        state.prepare("uid-6", tpu_allocation("mock-tpu-0", uid="uid-6"))
+
+
+class TestUnprepare:
+    def test_unprepare_tpu(self, stack):
+        tpulib, cdi, state = stack
+        sharing = TpuSharing(
+            strategy=SharingStrategy.TIME_SLICING,
+            time_slicing_config=TimeSlicingConfig(TimeSliceInterval.LONG),
+        )
+        state.prepare("uid-1", tpu_allocation("mock-tpu-0", sharing=sharing))
+        state.unprepare("uid-1")
+        assert not cdi.claim_spec_exists("uid-1")
+        assert tpulib.get_time_slice("mock-tpu-0") == 0  # reset
+
+    def test_unprepare_subslice(self, stack):
+        tpulib, cdi, state = stack
+        state.prepare("uid-2", subslice_allocation("mock-tpu-1"))
+        state.unprepare("uid-2")
+        assert tpulib.list_subslices() == []
+        assert not cdi.claim_spec_exists("uid-2")
+
+    def test_unprepare_unknown_noop(self, stack):
+        _, _, state = stack
+        state.unprepare("never-prepared")
+
+
+class TestSpecSync:
+    def test_get_updated_spec(self, stack):
+        _, _, state = stack
+        state.prepare("uid-1", tpu_allocation("mock-tpu-0"))
+        spec = state.get_updated_spec(NodeAllocationStateSpec())
+        assert len([d for d in spec.allocatable_devices if d.type() == "tpu"]) == 4
+        assert "uid-1" in spec.prepared_claims
+        assert spec.prepared_claims["uid-1"].tpu.devices[0].uuid == "mock-tpu-0"
+
+    def test_existing_spec_fields_preserved(self, stack):
+        _, _, state = stack
+        inspec = NodeAllocationStateSpec(
+            allocated_claims={"uid-9": tpu_allocation("mock-tpu-3", uid="uid-9")}
+        )
+        spec = state.get_updated_spec(inspec)
+        assert "uid-9" in spec.allocated_claims
+
+
+class TestCrashRecovery:
+    def test_readopt_subslices(self, tmp_path, cs):
+        tpulib1, _, state1 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        state1.prepare("uid-1", subslice_allocation("mock-tpu-0", uid="uid-1"))
+        old_uuid = tpulib1.list_subslices()[0].uuid
+        spec = state1.get_updated_spec(NodeAllocationStateSpec())
+        spec.allocated_claims["uid-1"] = subslice_allocation("mock-tpu-0", uid="uid-1")
+
+        # "Restart": fresh stack sharing the same tpulib state dir.
+        _, _, state2 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        state2.sync_prepared_from_crd_spec(spec)
+        out = state2.get_updated_spec(NodeAllocationStateSpec())
+        assert out.prepared_claims["uid-1"].subslice.devices[0].uuid == old_uuid
+
+    def test_recreate_missing_subslice(self, tmp_path, cs):
+        tpulib1, _, state1 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        state1.prepare("uid-1", subslice_allocation("mock-tpu-0", uid="uid-1"))
+        lost = tpulib1.list_subslices()[0].uuid
+        spec = state1.get_updated_spec(NodeAllocationStateSpec())
+        spec.allocated_claims["uid-1"] = subslice_allocation("mock-tpu-0", uid="uid-1")
+        # Simulate losing the subslice across the crash.
+        tpulib1.delete_subslice(lost)
+
+        _, _, state2 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        state2.sync_prepared_from_crd_spec(spec)
+        out = state2.get_updated_spec(NodeAllocationStateSpec())
+        devices = out.prepared_claims["uid-1"].subslice.devices
+        assert len(devices) == 1 and devices[0].uuid != lost
+        assert devices[0].placement == Placement(0, 1)
+
+    def test_orphan_subslice_errors(self, tmp_path, cs):
+        tpulib1, _, _ = make_plugin_stack(tmp_path, cs, partitionable=True)
+        tpulib1.create_subslice("mock-tpu-0", "1c.4gb", Placement(0, 1))
+
+        _, _, state2 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        with pytest.raises(RuntimeError, match="aren't prepared to any claim"):
+            state2.sync_prepared_from_crd_spec(NodeAllocationStateSpec())
+
+    def test_stale_prepared_claim_adopted_not_orphaned(self, tmp_path, cs):
+        # Claim prepared but no longer allocated: its subslices are adopted
+        # (GC will unprepare them) rather than flagged as orphans.
+        tpulib1, _, state1 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        state1.prepare("uid-1", subslice_allocation("mock-tpu-0", uid="uid-1"))
+        spec = state1.get_updated_spec(NodeAllocationStateSpec())
+        # NOTE: allocated_claims deliberately left empty.
+
+        _, _, state2 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        state2.sync_prepared_from_crd_spec(spec)
+        out = state2.get_updated_spec(NodeAllocationStateSpec())
+        assert "uid-1" in out.prepared_claims
+
+    def test_sharing_reapplied(self, tmp_path, cs):
+        tpulib1, _, state1 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        sharing = TpuSharing(
+            strategy=SharingStrategy.TIME_SLICING,
+            time_slicing_config=TimeSlicingConfig(TimeSliceInterval.MEDIUM),
+        )
+        alloc = tpu_allocation("mock-tpu-0", sharing=sharing, uid="uid-1")
+        state1.prepare("uid-1", alloc)
+        spec = state1.get_updated_spec(NodeAllocationStateSpec())
+        spec.allocated_claims["uid-1"] = alloc
+
+        tpulib2, _, state2 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        state2.sync_prepared_from_crd_spec(spec)
+        assert tpulib2.get_time_slice("mock-tpu-0") == 2
+
+    def test_cdi_file_recreated(self, tmp_path, cs):
+        _, cdi1, state1 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        alloc = tpu_allocation("mock-tpu-0", uid="uid-1")
+        state1.prepare("uid-1", alloc)
+        cdi1.delete_claim_spec_file("uid-1")  # lost across crash
+        spec = state1.get_updated_spec(NodeAllocationStateSpec())
+        spec.allocated_claims["uid-1"] = alloc
+
+        _, cdi2, state2 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        state2.sync_prepared_from_crd_spec(spec)
+        assert cdi2.claim_spec_exists("uid-1")
+
+
+class TestReviewRegressions:
+    def test_recovery_idempotent_after_recreation(self, tmp_path, cs):
+        # First recovery re-creates a lost subslice under a new UUID; a retry
+        # of the startup sequence (conflict path) must re-adopt it by
+        # parent+placement instead of colliding with its own creation.
+        tpulib1, _, state1 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        state1.prepare("uid-1", subslice_allocation("mock-tpu-0", uid="uid-1"))
+        lost = tpulib1.list_subslices()[0].uuid
+        spec = state1.get_updated_spec(NodeAllocationStateSpec())
+        spec.allocated_claims["uid-1"] = subslice_allocation("mock-tpu-0", uid="uid-1")
+        tpulib1.delete_subslice(lost)
+
+        tpulib2, _, state2 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        state2.sync_prepared_from_crd_spec(spec)  # re-creates as ss-NEW
+        state2.sync_prepared_from_crd_spec(spec)  # retry: must not collide
+        assert len(tpulib2.list_subslices()) == 1
+
+    def test_stale_adopted_proxy_claim_torn_down(self, tmp_path, cs):
+        from helpers import DeploymentReadinessStub
+
+        stub = DeploymentReadinessStub(cs)
+        try:
+            _, _, state1 = make_plugin_stack(tmp_path, cs, partitionable=True)
+            sharing = TpuSharing(strategy=SharingStrategy.RUNTIME_PROXY)
+            alloc = tpu_allocation("mock-tpu-0", sharing=sharing, uid="uid-proxy1")
+            state1.prepare("uid-proxy1", alloc)
+            assert cs.deployments("tpu-dra").get("tpu-runtime-proxy-uid-prox")
+
+            # Restart with the allocation gone: claim adopted without its
+            # daemon handle, then GC-unprepared — deployment must still die.
+            spec = state1.get_updated_spec(NodeAllocationStateSpec())
+            _, _, state2 = make_plugin_stack(tmp_path, cs, partitionable=True)
+            state2.sync_prepared_from_crd_spec(spec)
+            state2.unprepare("uid-proxy1")
+            from tpu_dra.client.apiserver import NotFoundError
+
+            with pytest.raises(NotFoundError):
+                cs.deployments("tpu-dra").get("tpu-runtime-proxy-uid-prox")
+        finally:
+            stub.stop()
+
+    def test_rollback_resets_time_slice(self, tmp_path, cs, monkeypatch):
+        tpulib, cdi, state = make_plugin_stack(tmp_path, cs, partitionable=True)
+        sharing = TpuSharing(
+            strategy=SharingStrategy.TIME_SLICING,
+            time_slicing_config=TimeSlicingConfig(TimeSliceInterval.LONG),
+        )
+
+        def boom(*a, **k):
+            raise OSError("cdi root unwritable")
+
+        monkeypatch.setattr(cdi, "create_claim_spec_file", boom)
+        with pytest.raises(OSError):
+            state.prepare("uid-ts", tpu_allocation("mock-tpu-0", sharing=sharing))
+        assert tpulib.get_time_slice("mock-tpu-0") == 0
+
+    def test_multi_device_subslice_claim_rejected(self, tmp_path, cs):
+        _, _, state = make_plugin_stack(tmp_path, cs, partitionable=True)
+        bad = AllocatedDevices(
+            claim_info=ClaimInfo(uid="uid-multi"),
+            subslice=AllocatedSubslices(
+                devices=[
+                    AllocatedSubslice(
+                        profile="1c.4gb", parent_uuid="mock-tpu-0",
+                        placement=Placement(0, 1),
+                    ),
+                    AllocatedSubslice(
+                        profile="1c.4gb", parent_uuid="mock-tpu-0",
+                        placement=Placement(1, 1),
+                    ),
+                ]
+            ),
+        )
+        with pytest.raises(ValueError, match="exactly one device"):
+            state.prepare("uid-multi", bad)

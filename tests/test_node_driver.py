@@ -1,0 +1,189 @@
+"""Node driver tests: startup handshake, prepare RPC, GC, shutdown."""
+
+import time
+
+import pytest
+
+from helpers import make_plugin_stack
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.nas_v1alpha1 import (
+    AllocatedDevices,
+    AllocatedTpu,
+    AllocatedTpus,
+    ClaimInfo,
+    NodeAllocationState,
+)
+from tpu_dra.client import ClientSet, FakeApiServer, NasClient
+from tpu_dra.plugin.driver import NodeDriver
+
+NODE = "node-1"
+NS = "tpu-dra"
+
+
+@pytest.fixture
+def cs():
+    return ClientSet(FakeApiServer())
+
+
+def make_driver(tmp_path, cs, *, start_gc=False, partitionable=False):
+    _, _, state = make_plugin_stack(tmp_path, cs, partitionable=partitionable)
+    nas = NodeAllocationState(metadata=ObjectMeta(name=NODE, namespace=NS))
+    nasclient = NasClient(nas, cs)
+    driver = NodeDriver(
+        nas, nasclient, state, error_backoff_s=0.05, start_gc=start_gc
+    )
+    return driver, nas, state
+
+
+def allocate_claim(cs, uid, *uuids):
+    """Simulate the controller writing an allocation into the NAS."""
+    client = cs.node_allocation_states(NS)
+    nas = client.get(NODE)
+    nas.spec.allocated_claims[uid] = AllocatedDevices(
+        claim_info=ClaimInfo(namespace="default", name=f"claim-{uid}", uid=uid),
+        tpu=AllocatedTpus(devices=[AllocatedTpu(uuid=u) for u in uuids]),
+    )
+    client.update(nas)
+
+
+def deallocate_claim(cs, uid):
+    client = cs.node_allocation_states(NS)
+    nas = client.get(NODE)
+    nas.spec.allocated_claims.pop(uid, None)
+    client.update(nas)
+
+
+class TestStartup:
+    def test_handshake_publishes_and_readies(self, tmp_path, cs):
+        make_driver(tmp_path, cs)
+        published = cs.node_allocation_states(NS).get(NODE)
+        assert published.status == "Ready"
+        assert len(published.spec.allocatable_devices) == 4
+
+    def test_adopts_existing_nas(self, tmp_path, cs):
+        nas0 = NodeAllocationState(metadata=ObjectMeta(name=NODE, namespace=NS))
+        created = cs.node_allocation_states(NS).create(nas0)
+        make_driver(tmp_path, cs)
+        after = cs.node_allocation_states(NS).get(NODE)
+        assert after.metadata.uid == created.metadata.uid
+        assert after.status == "Ready"
+
+
+class TestPrepare:
+    def test_prepare_flow(self, tmp_path, cs):
+        driver, _, _ = make_driver(tmp_path, cs)
+        allocate_claim(cs, "uid-1", "mock-tpu-0")
+        devices = driver.node_prepare_resource("uid-1")
+        assert devices == ["tpu.resource.google.com/claim=uid-1"]
+        published = cs.node_allocation_states(NS).get(NODE)
+        assert "uid-1" in published.spec.prepared_claims
+
+    def test_prepare_idempotent(self, tmp_path, cs):
+        driver, _, _ = make_driver(tmp_path, cs)
+        allocate_claim(cs, "uid-1", "mock-tpu-0")
+        a = driver.node_prepare_resource("uid-1")
+        b = driver.node_prepare_resource("uid-1")
+        assert a == b
+
+    def test_prepare_without_allocation_fails(self, tmp_path, cs):
+        driver, _, _ = make_driver(tmp_path, cs)
+        with pytest.raises(ValueError, match="no allocation"):
+            driver.node_prepare_resource("ghost-uid")
+
+    def test_unprepare_rpc_is_noop(self, tmp_path, cs):
+        driver, _, _ = make_driver(tmp_path, cs)
+        allocate_claim(cs, "uid-1", "mock-tpu-0")
+        driver.node_prepare_resource("uid-1")
+        driver.node_unprepare_resource("uid-1")
+        published = cs.node_allocation_states(NS).get(NODE)
+        assert "uid-1" in published.spec.prepared_claims  # still prepared
+
+
+class TestStaleStateGC:
+    def wait_for(self, predicate, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_deallocation_triggers_unprepare(self, tmp_path, cs):
+        driver, _, state = make_driver(tmp_path, cs, start_gc=True)
+        try:
+            allocate_claim(cs, "uid-1", "mock-tpu-0")
+            driver.node_prepare_resource("uid-1")
+            assert state.cdi.claim_spec_exists("uid-1")
+
+            deallocate_claim(cs, "uid-1")
+            assert self.wait_for(
+                lambda: "uid-1"
+                not in cs.node_allocation_states(NS).get(NODE).spec.prepared_claims
+            )
+            assert not state.cdi.claim_spec_exists("uid-1")
+        finally:
+            driver.shutdown()
+
+    def test_startup_gc_cleans_preexisting_stale(self, tmp_path, cs):
+        # Claim prepared by a previous incarnation but deallocated while the
+        # plugin was down: the first GC pass must clean it.
+        driver1, _, _ = make_driver(tmp_path, cs)
+        allocate_claim(cs, "uid-1", "mock-tpu-0")
+        driver1.node_prepare_resource("uid-1")
+        deallocate_claim(cs, "uid-1")
+        # "Crash" driver1 (no shutdown); restart with GC enabled.
+        _, _, state2 = make_plugin_stack(tmp_path, cs)
+        nas2 = NodeAllocationState(metadata=ObjectMeta(name=NODE, namespace=NS))
+        driver2 = NodeDriver(
+            nas2, NasClient(nas2, cs), state2, error_backoff_s=0.05, start_gc=True
+        )
+        try:
+            assert self.wait_for(
+                lambda: "uid-1"
+                not in cs.node_allocation_states(NS).get(NODE).spec.prepared_claims
+            )
+        finally:
+            driver2.shutdown()
+
+    def test_orphaned_cdi_files_swept(self, tmp_path, cs):
+        driver, _, state = make_driver(tmp_path, cs, start_gc=True)
+        try:
+            # A CDI file with no allocated or prepared claim behind it.
+            from tpu_dra.api.nas_v1alpha1 import PreparedDevices, PreparedTpu, PreparedTpus
+
+            state.cdi.create_claim_spec_file(
+                "orphan-uid",
+                PreparedDevices(
+                    tpu=PreparedTpus(devices=[PreparedTpu(uuid="mock-tpu-0")])
+                ),
+            )
+            # Trigger a NAS modification to wake the GC.
+            allocate_claim(cs, "uid-x", "mock-tpu-1")
+            assert self.wait_for(
+                lambda: not state.cdi.claim_spec_exists("orphan-uid")
+            )
+        finally:
+            driver.shutdown()
+
+
+class TestShutdown:
+    def test_flips_not_ready(self, tmp_path, cs):
+        driver, _, _ = make_driver(tmp_path, cs, start_gc=True)
+        driver.shutdown()
+        assert cs.node_allocation_states(NS).get(NODE).status == "NotReady"
+
+
+class TestCrashRecoveryIntegration:
+    def test_prepared_claims_survive_restart(self, tmp_path, cs):
+        driver1, _, _ = make_driver(tmp_path, cs, partitionable=True)
+        allocate_claim(cs, "uid-1", "mock-tpu-0")
+        driver1.node_prepare_resource("uid-1")
+        # Crash without shutdown; restart a fresh stack on the same state dir.
+        _, _, state2 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        nas2 = NodeAllocationState(metadata=ObjectMeta(name=NODE, namespace=NS))
+        NodeDriver(
+            nas2, NasClient(nas2, cs), state2, error_backoff_s=0.05, start_gc=False
+        )
+        published = cs.node_allocation_states(NS).get(NODE)
+        assert published.status == "Ready"
+        assert "uid-1" in published.spec.prepared_claims

@@ -1,0 +1,219 @@
+"""Sharing actuation tests: time-slicing and runtime-proxy daemons."""
+
+import os
+
+import pytest
+
+from helpers import DeploymentReadinessStub, make_plugin_stack
+from tpu_dra.api.nas_v1alpha1 import (
+    ClaimInfo,
+    PreparedDevices,
+    PreparedSubslice,
+    PreparedSubslices,
+    PreparedTpu,
+    PreparedTpus,
+)
+from tpu_dra.api.sharing import (
+    RuntimeProxyConfig,
+    SharingStrategy,
+    TimeSliceInterval,
+    TimeSlicingConfig,
+    TpuSharing,
+)
+from tpu_dra.api.topology import Placement
+from tpu_dra.client import ClientSet, FakeApiServer
+from tpu_dra.plugin.sharing import (
+    RuntimeProxyManager,
+    TimeSlicingManager,
+    setup_sharing,
+)
+from tpu_dra.utils.quantity import Quantity
+
+
+@pytest.fixture
+def cs():
+    return ClientSet(FakeApiServer())
+
+
+@pytest.fixture
+def stack(tmp_path, cs):
+    return make_plugin_stack(tmp_path, cs, partitionable=True)
+
+
+def prepared_tpus(*uuids):
+    return PreparedDevices(
+        tpu=PreparedTpus(devices=[PreparedTpu(uuid=u) for u in uuids])
+    )
+
+
+class TestTimeSlicing:
+    def test_set_on_chips(self, stack):
+        tpulib, _, _ = stack
+        mgr = TimeSlicingManager(tpulib)
+        mgr.set_time_slice(
+            prepared_tpus("mock-tpu-0", "mock-tpu-1"),
+            TimeSlicingConfig(interval=TimeSliceInterval.LONG),
+        )
+        assert tpulib.get_time_slice("mock-tpu-0") == 4
+        assert tpulib.get_time_slice("mock-tpu-1") == 4
+
+    def test_reset_with_none(self, stack):
+        tpulib, _, _ = stack
+        mgr = TimeSlicingManager(tpulib)
+        mgr.set_time_slice(
+            prepared_tpus("mock-tpu-0"),
+            TimeSlicingConfig(interval=TimeSliceInterval.SHORT),
+        )
+        mgr.set_time_slice(prepared_tpus("mock-tpu-0"), None)
+        assert tpulib.get_time_slice("mock-tpu-0") == 0
+
+    def test_subslices_set_on_parents(self, stack):
+        tpulib, _, _ = stack
+        mgr = TimeSlicingManager(tpulib)
+        prepared = PreparedDevices(
+            subslice=PreparedSubslices(
+                devices=[
+                    PreparedSubslice(
+                        uuid="ss-1", parent_uuid="mock-tpu-2", placement=Placement(0, 1)
+                    )
+                ]
+            )
+        )
+        mgr.set_time_slice(prepared, TimeSlicingConfig(TimeSliceInterval.MEDIUM))
+        assert tpulib.get_time_slice("mock-tpu-2") == 2
+
+
+class TestRuntimeProxy:
+    def make_manager(self, tmp_path, cs, stack):
+        tpulib, _, _ = stack
+        return RuntimeProxyManager(
+            cs,
+            tpulib,
+            node_name="node-1",
+            namespace="tpu-dra",
+            proxy_root=str(tmp_path / "proxy2"),
+            backoff_scale=0.01,
+        )
+
+    def test_start_creates_deployment(self, tmp_path, cs, stack):
+        mgr = self.make_manager(tmp_path, cs, stack)
+        claim = ClaimInfo(namespace="default", name="c1", uid="uid-123456789")
+        daemon = mgr.new_daemon(
+            claim,
+            prepared_tpus("mock-tpu-0", "mock-tpu-1"),
+            RuntimeProxyConfig(
+                max_active_core_percentage=50,
+                default_hbm_limit=Quantity("4Gi"),
+            ),
+        )
+        daemon.start()
+        deployment = cs.deployments("tpu-dra").get("tpu-runtime-proxy-uid-1234")
+        labels = deployment.metadata.labels
+        assert labels["tpu.resource.google.com/claim"] == claim.uid
+        env = {
+            e["name"]: e["value"]
+            for e in deployment.spec.template["spec"]["containers"][0]["env"]
+        }
+        assert env["TPU_VISIBLE_DEVICES"] == "0,1"
+        assert env["TPU_PROXY_ACTIVE_CORE_PERCENTAGE"] == "50"
+        assert env["TPU_PROXY_HBM_LIMIT_mock_tpu_0"] == "4Gi"
+        assert deployment.spec.template["spec"]["nodeName"] == "node-1"
+        assert os.path.isdir(os.path.dirname(daemon.socket_path))
+
+        daemon.start()  # idempotent
+
+    def test_assert_ready_times_out(self, tmp_path, cs, stack):
+        mgr = self.make_manager(tmp_path, cs, stack)
+        daemon = mgr.new_daemon(
+            ClaimInfo(uid="uid-xyz"), prepared_tpus("mock-tpu-0"), RuntimeProxyConfig()
+        )
+        daemon.start()
+        with pytest.raises(TimeoutError):
+            daemon.assert_ready()
+
+    def test_assert_ready_succeeds(self, tmp_path, cs, stack):
+        stub = DeploymentReadinessStub(cs)
+        try:
+            mgr = self.make_manager(tmp_path, cs, stack)
+            daemon = mgr.new_daemon(
+                ClaimInfo(uid="uid-ready"),
+                prepared_tpus("mock-tpu-0"),
+                RuntimeProxyConfig(),
+            )
+            daemon.start()
+            daemon.assert_ready()
+        finally:
+            stub.stop()
+
+    def test_cdi_edits(self, tmp_path, cs, stack):
+        mgr = self.make_manager(tmp_path, cs, stack)
+        daemon = mgr.new_daemon(
+            ClaimInfo(uid="uid-edits"), prepared_tpus("mock-tpu-0"), RuntimeProxyConfig()
+        )
+        edits = daemon.get_cdi_edits()
+        assert edits["env"] == [f"TPU_RUNTIME_PROXY_ADDR={daemon.socket_path}"]
+        assert edits["mounts"][0]["hostPath"] == os.path.dirname(daemon.socket_path)
+
+    def test_stop(self, tmp_path, cs, stack):
+        mgr = self.make_manager(tmp_path, cs, stack)
+        daemon = mgr.new_daemon(
+            ClaimInfo(uid="uid-stop"), prepared_tpus("mock-tpu-0"), RuntimeProxyConfig()
+        )
+        daemon.start()
+        daemon.stop()
+        from tpu_dra.client.apiserver import NotFoundError
+
+        with pytest.raises(NotFoundError):
+            cs.deployments("tpu-dra").get("tpu-runtime-proxy-uid-stop")
+        assert not os.path.exists(os.path.dirname(daemon.socket_path))
+        daemon.stop()  # idempotent
+
+    def test_subslice_claims_rejected(self, tmp_path, cs, stack):
+        mgr = self.make_manager(tmp_path, cs, stack)
+        prepared = PreparedDevices(subslice=PreparedSubslices())
+        with pytest.raises(ValueError, match="whole-chip"):
+            mgr.new_daemon(ClaimInfo(uid="u"), prepared, RuntimeProxyConfig())
+
+
+class TestSetupSharing:
+    def test_none_is_noop(self, stack):
+        tpulib, _, _ = stack
+        mgr = TimeSlicingManager(tpulib)
+        assert (
+            setup_sharing(mgr, None, None, None, prepared_tpus("mock-tpu-0")) is None
+        )
+
+    def test_time_slicing_dispatch(self, tmp_path, cs, stack):
+        tpulib, _, _ = stack
+        ts = TimeSlicingManager(tpulib)
+        proxy = RuntimeProxyManager(
+            cs, tpulib, node_name="n", namespace="tpu-dra",
+            proxy_root=str(tmp_path / "p"), backoff_scale=0.01,
+        )
+        sharing = TpuSharing(
+            strategy=SharingStrategy.TIME_SLICING,
+            time_slicing_config=TimeSlicingConfig(TimeSliceInterval.SHORT),
+        )
+        daemon = setup_sharing(
+            ts, proxy, sharing, ClaimInfo(uid="u"), prepared_tpus("mock-tpu-0")
+        )
+        assert daemon is None
+        assert tpulib.get_time_slice("mock-tpu-0") == 1
+
+    def test_runtime_proxy_dispatch(self, tmp_path, cs, stack):
+        stub = DeploymentReadinessStub(cs)
+        try:
+            tpulib, _, _ = stack
+            ts = TimeSlicingManager(tpulib)
+            proxy = RuntimeProxyManager(
+                cs, tpulib, node_name="n", namespace="tpu-dra",
+                proxy_root=str(tmp_path / "p2"), backoff_scale=0.01,
+            )
+            sharing = TpuSharing(strategy=SharingStrategy.RUNTIME_PROXY)
+            daemon = setup_sharing(
+                ts, proxy, sharing, ClaimInfo(uid="u2"), prepared_tpus("mock-tpu-0")
+            )
+            assert daemon is not None
+            assert cs.deployments("tpu-dra").get("tpu-runtime-proxy-u2")
+        finally:
+            stub.stop()

@@ -1,0 +1,142 @@
+"""Device layer tests: enumeration, subslice lifecycle, persistence."""
+
+import os
+
+import pytest
+
+from tpu_dra.api.topology import Placement
+from tpu_dra.plugin.tpulib import MockTpuLib, RealTpuLib, SubsliceRegistry, SubsliceInfo
+
+
+@pytest.fixture
+def lib(tmp_path):
+    return MockTpuLib("2x2x1", partitionable=True, state_dir=str(tmp_path))
+
+
+class TestEnumeration:
+    def test_chips(self, lib):
+        devices = lib.enumerate_all_possible_devices()
+        chips = [d for d in devices if d.type() == "tpu"]
+        assert len(chips) == 4
+        coords = [c.tpu.coord for c in chips]
+        assert coords == [(0, 0, 0), (1, 0, 0), (0, 1, 0), (1, 1, 0)]
+        assert all(c.tpu.partitionable for c in chips)
+
+    def test_subslice_profiles_published_once_per_product(self, lib):
+        devices = lib.enumerate_all_possible_devices()
+        subs = [d for d in devices if d.type() == "subslice"]
+        profiles = [s.subslice.profile for s in subs]
+        assert profiles == ["1c.4gb", "2c.8gb", "4c.16gb"]
+        assert subs[0].subslice.placements == [
+            Placement(0, 1),
+            Placement(1, 1),
+            Placement(2, 1),
+            Placement(3, 1),
+        ]
+
+    def test_non_partitionable_publishes_no_profiles(self, tmp_path):
+        lib = MockTpuLib("2x2x1", partitionable=False, state_dir=str(tmp_path))
+        devices = lib.enumerate_all_possible_devices()
+        assert all(d.type() == "tpu" for d in devices)
+
+    def test_chip_info_paths(self, lib):
+        info = lib.chip_info("mock-tpu-2")
+        assert info.device_paths == ["/dev/accel2"]
+        with pytest.raises(KeyError):
+            lib.chip_info("nope")
+
+
+class TestSubsliceLifecycle:
+    def test_create_delete(self, lib):
+        info = lib.create_subslice("mock-tpu-0", "1c.4gb", Placement(0, 1))
+        assert info.uuid.startswith("ss-")
+        assert [s.uuid for s in lib.list_subslices()] == [info.uuid]
+        lib.delete_subslice(info.uuid)
+        assert lib.list_subslices() == []
+
+    def test_overlap_rejected(self, lib):
+        lib.create_subslice("mock-tpu-0", "2c.8gb", Placement(0, 2))
+        with pytest.raises(ValueError, match="overlaps"):
+            lib.create_subslice("mock-tpu-0", "1c.4gb", Placement(1, 1))
+        # Other chip is fine.
+        lib.create_subslice("mock-tpu-1", "1c.4gb", Placement(1, 1))
+
+    def test_invalid_placement_rejected(self, lib):
+        with pytest.raises(ValueError, match="invalid placement"):
+            lib.create_subslice("mock-tpu-0", "2c.8gb", Placement(1, 2))
+
+    def test_non_partitionable_rejected(self, tmp_path):
+        lib = MockTpuLib("1x1", partitionable=False, state_dir=str(tmp_path))
+        with pytest.raises(ValueError, match="not partitionable"):
+            lib.create_subslice("mock-tpu-0", "1c.4gb", Placement(0, 1))
+
+    def test_persistence_across_restart(self, tmp_path):
+        # The crash re-adoption seam: a new lib instance sees old subslices.
+        lib1 = MockTpuLib("2x2", partitionable=True, state_dir=str(tmp_path))
+        info = lib1.create_subslice("mock-tpu-0", "1c.4gb", Placement(2, 1))
+        lib2 = MockTpuLib("2x2", partitionable=True, state_dir=str(tmp_path))
+        survivors = lib2.list_subslices()
+        assert [s.uuid for s in survivors] == [info.uuid]
+        assert survivors[0].placement == Placement(2, 1)
+
+
+class TestTimeSlice:
+    def test_set(self, lib):
+        lib.set_time_slice(["mock-tpu-0", "mock-tpu-1"], 2)
+        assert lib.get_time_slice("mock-tpu-0") == 2
+        assert lib.get_time_slice("mock-tpu-3") == 0
+
+    def test_unknown_chip(self, lib):
+        with pytest.raises(KeyError):
+            lib.set_time_slice(["bogus"], 1)
+
+
+class TestSubsliceRegistry:
+    def test_roundtrip(self, tmp_path):
+        reg = SubsliceRegistry(str(tmp_path / "s.json"))
+        reg.add(SubsliceInfo("u1", "1c.4gb", "p1", Placement(0, 1)))
+        reg.add(SubsliceInfo("u2", "2c.8gb", "p1", Placement(2, 2)))
+        assert [s.uuid for s in reg.list()] == ["u1", "u2"]
+        reg.remove("u1")
+        assert [s.uuid for s in reg.list()] == ["u2"]
+
+    def test_corrupt_file_treated_empty(self, tmp_path):
+        path = tmp_path / "s.json"
+        path.write_text("{corrupt")
+        reg = SubsliceRegistry(str(path))
+        assert reg.list() == []
+
+
+class TestRealTpuLib:
+    def test_devfs_discovery(self, tmp_path, monkeypatch):
+        devfs = tmp_path / "dev"
+        devfs.mkdir()
+        for i in range(4):
+            (devfs / f"accel{i}").touch()
+        monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+        monkeypatch.setenv("TPU_WORKER_ID", "3")
+        lib = RealTpuLib(state_dir=str(tmp_path / "state"), devfs_root=str(devfs))
+        devices = lib.enumerate_all_possible_devices()
+        chips = [d.tpu for d in devices if d.type() == "tpu"]
+        assert len(chips) == 4
+        assert chips[0].generation == "v5e"
+        assert chips[0].product == "tpu-v5e"
+        assert [c.coord for c in chips] == [(0, 0, 0), (1, 0, 0), (0, 1, 0), (1, 1, 0)]
+        assert chips[0].uuid == "tpu-3-0"
+        assert lib.chip_info("tpu-3-1").device_paths == [str(devfs / "accel1")]
+
+    def test_vfio_fallback(self, tmp_path, monkeypatch):
+        devfs = tmp_path / "dev"
+        (devfs / "vfio").mkdir(parents=True)
+        for i in range(2):
+            (devfs / "vfio" / str(i)).touch()
+        monkeypatch.delenv("TPU_CHIPS_PER_HOST_BOUNDS", raising=False)
+        monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+        lib = RealTpuLib(state_dir=str(tmp_path / "state"), devfs_root=str(devfs))
+        chips = [d for d in lib.enumerate_all_possible_devices() if d.type() == "tpu"]
+        assert len(chips) == 2
+
+    def test_empty_devfs(self, tmp_path):
+        lib = RealTpuLib(state_dir=str(tmp_path / "state"), devfs_root=str(tmp_path))
+        assert [d for d in lib.enumerate_all_possible_devices() if d.type() == "tpu"] == []

@@ -148,7 +148,13 @@ def from_dict(cls: type[T], data: dict | None) -> T:
 
 
 def deepcopy(obj: T) -> T:
-    """Structural copy of an API object (DeepCopy analog)."""
-    if obj is None:
-        return None
+    """Structural copy of an API object or container of them (DeepCopy analog)."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, list):
+        return [deepcopy(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(deepcopy(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: deepcopy(v) for k, v in obj.items()}
     return from_dict(type(obj), to_dict(obj))

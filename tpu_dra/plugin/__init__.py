@@ -1,0 +1,16 @@
+"""Node plugin — the per-node kubelet plugin (reference layers L3+L4b).
+
+- ``tpulib``        — device layer: chip enumeration + subslice actuation
+                      behind one interface with mock and real impls
+                      (nvlib.go/find.go analog, C18)
+- ``cdi``           — per-claim CDI spec generation: devnodes, libtpu mount,
+                      TPU runtime env (cdi.go analog, C19)
+- ``device_state``  — in-memory allocatable+prepared truth with NAS sync and
+                      crash re-adoption (device_state.go analog, C17)
+- ``driver``        — gRPC NodeServer + NAS lifecycle + watch-driven
+                      stale-state GC (driver.go analog, C16)
+- ``sharing``       — TimeSlicing / RuntimeProxy actuation
+                      (sharing.go analog, C20)
+- ``kubeletplugin`` — registration + DRA gRPC servers over unix sockets
+                      (vendored kubeletplugin analog, C23)
+"""

@@ -1,0 +1,178 @@
+"""CDI spec generation (component C19; reference: cmd/nvidia-dra-plugin/
+cdi.go:38-243).
+
+For every prepared claim the plugin writes one transient CDI spec file named
+``<vendor>-claim_<uid>.json`` in the CDI root, containing a single CDI device
+``tpu.resource.google.com/claim=<claimUID>`` whose container edits make the
+claimed chips — and only them — visible inside the consuming containers:
+
+- device nodes for each claimed chip (``/dev/accel*`` / ``/dev/vfio/*``),
+- a mount of ``libtpu.so`` from the host driver root (the common edits of
+  nvcdi's GetCommonEdits, lib-nvml.go:68-75 analog),
+- TPU runtime environment so JAX/libtpu inside the container sees exactly
+  the claimed sub-mesh (SURVEY.md §7 hard-part (e)):
+
+  - ``TPU_VISIBLE_DEVICES``         — claimed chip indices on this host
+  - ``TPU_CHIPS_PER_HOST_BOUNDS``   — the claimed topology "x,y,z" (only
+    when the allocation is a full box, so the runtime derives a mesh of
+    exactly the claimed shape)
+  - ``TPU_ACCELERATOR_TYPE``        — generation of the claimed chips
+  - ``TPU_VISIBLE_CORES``           — core interval "start-end" for
+    subslice claims (driver extension; enforced by the runtime proxy)
+  - ``TPU_DRA_CLAIM``               — claim UID for debugging
+
+Sharing managers append their own edits (RuntimeProxy socket env/mounts —
+the MPS edit analog of sharing.go:334-354) via ``extra_edits``.
+
+The qualified device name returned to the kubelet (cdi.go:238-243 analog) is
+``tpu.resource.google.com/claim=<claimUID>``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.plugin.tpulib import TpuLib
+
+CDI_VENDOR = "tpu.resource.google.com"
+CDI_CLASS = "claim"
+CDI_KIND = f"{CDI_VENDOR}/{CDI_CLASS}"
+CDI_VERSION = "0.5.0"
+
+
+class CDIHandler:
+    def __init__(self, cdi_root: str, tpulib: TpuLib, vendor: str = CDI_VENDOR):
+        self._cdi_root = cdi_root
+        self._tpulib = tpulib
+        self._vendor = vendor
+        os.makedirs(cdi_root, exist_ok=True)
+
+    # -- edits construction --------------------------------------------------
+
+    def _common_edits(self) -> dict:
+        """Driver-library mounts shared by every claim (GetCommonEdits
+        analog)."""
+        mounts = []
+        for lib in self._tpulib.library_paths():
+            mounts.append(
+                {
+                    "hostPath": lib,
+                    "containerPath": f"/usr/lib/{os.path.basename(lib)}",
+                    "options": ["ro", "nosuid", "nodev", "bind"],
+                }
+            )
+        return {"mounts": mounts} if mounts else {}
+
+    def _tpu_edits(
+        self, prepared: nascrd.PreparedTpus, allocated: nascrd.AllocatedDevices | None
+    ) -> dict:
+        device_nodes = []
+        indices = []
+        generations = set()
+        for dev in prepared.devices:
+            info = self._tpulib.chip_info(dev.uuid)
+            indices.append(info.tpu.index)
+            generations.add(info.tpu.generation)
+            for path in info.device_paths:
+                device_nodes.append({"path": path})
+        env = [
+            "TPU_VISIBLE_DEVICES=" + ",".join(str(i) for i in sorted(indices)),
+        ]
+        topology = ""
+        if allocated is not None and allocated.tpu is not None:
+            topology = allocated.tpu.topology
+        if topology:
+            bounds = topology.replace("x", ",")
+            env.append(f"TPU_CHIPS_PER_HOST_BOUNDS={bounds}")
+        if len(generations) == 1:
+            env.append(f"TPU_ACCELERATOR_TYPE={generations.pop()}")
+        return {"deviceNodes": device_nodes, "env": env}
+
+    def _subslice_edits(self, prepared: nascrd.PreparedSubslices) -> dict:
+        device_nodes = []
+        envs = []
+        for dev in prepared.devices:
+            info = self._tpulib.chip_info(dev.parent_uuid)
+            for path in info.device_paths:
+                device_nodes.append({"path": path})
+            envs.append(f"TPU_VISIBLE_DEVICES={info.tpu.index}")
+            start = dev.placement.start
+            end = start + dev.placement.size - 1
+            envs.append(f"TPU_VISIBLE_CORES={start}-{end}")
+            envs.append(f"TPU_SUBSLICE_UUID={dev.uuid}")
+        return {"deviceNodes": device_nodes, "env": envs}
+
+    @staticmethod
+    def _merge_edits(*edits: dict) -> dict:
+        merged: dict = {}
+        for edit in edits:
+            for key, value in edit.items():
+                if not value:
+                    continue
+                merged.setdefault(key, []).extend(value)
+        return merged
+
+    # -- spec file lifecycle (cdi.go:121-236 analog) -------------------------
+
+    def _spec_path(self, claim_uid: str) -> str:
+        return os.path.join(
+            self._cdi_root, f"{self._vendor.replace('/', '_')}-claim_{claim_uid}.json"
+        )
+
+    def create_claim_spec_file(
+        self,
+        claim_uid: str,
+        prepared: nascrd.PreparedDevices,
+        allocated: nascrd.AllocatedDevices | None = None,
+        extra_edits: dict | None = None,
+    ) -> str:
+        if prepared.type() == nascrd.TPU_DEVICE_TYPE:
+            device_edits = self._tpu_edits(prepared.tpu, allocated)
+        elif prepared.type() == nascrd.SUBSLICE_DEVICE_TYPE:
+            device_edits = self._subslice_edits(prepared.subslice)
+        else:
+            raise ValueError(f"unknown prepared device type for claim {claim_uid}")
+
+        edits = self._merge_edits(
+            device_edits,
+            self._common_edits(),
+            {"env": [f"TPU_DRA_CLAIM={claim_uid}"]},
+            extra_edits or {},
+        )
+        spec = {
+            "cdiVersion": CDI_VERSION,
+            "kind": f"{self._vendor}/{CDI_CLASS}",
+            "devices": [{"name": claim_uid, "containerEdits": edits}],
+        }
+        path = self._spec_path(claim_uid)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(spec, f, indent=2)
+        os.replace(tmp, path)
+        return path
+
+    def delete_claim_spec_file(self, claim_uid: str) -> None:
+        try:
+            os.remove(self._spec_path(claim_uid))
+        except FileNotFoundError:
+            pass
+
+    def claim_spec_exists(self, claim_uid: str) -> bool:
+        return os.path.exists(self._spec_path(claim_uid))
+
+    def list_claim_spec_files(self) -> list[str]:
+        prefix = f"{self._vendor.replace('/', '_')}-claim_"
+        out = []
+        try:
+            for entry in os.listdir(self._cdi_root):
+                if entry.startswith(prefix) and entry.endswith(".json"):
+                    out.append(entry[len(prefix) : -len(".json")])
+        except OSError:
+            pass
+        return sorted(out)
+
+    def get_claim_devices(self, claim_uid: str) -> list[str]:
+        """Qualified CDI device names handed back to the kubelet."""
+        return [f"{self._vendor}/{CDI_CLASS}={claim_uid}"]

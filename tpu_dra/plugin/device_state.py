@@ -1,0 +1,310 @@
+"""DeviceState — in-memory device truth + NAS CRD sync (component C17;
+reference: cmd/nvidia-dra-plugin/device_state.go:29-532).
+
+Owns the node's allocatable inventory and the per-claim prepared state:
+
+- ``prepare``   (device_state.go:175-215): idempotent; routes by device type
+  (whole chips vs subslice creation through tpulib), applies sharing, writes
+  the claim's CDI spec file, records the prepared entry.
+- ``unprepare`` (device_state.go:217-253): stops the proxy daemon, deletes
+  subslice devices, resets time-slicing, removes the CDI file.
+- ``get_updated_spec`` (device_state.go:255-263): projects the in-memory
+  truth (allocatable + prepared) onto a NAS spec copy for publishing.
+- ``sync_prepared_from_crd_spec`` (device_state.go:429-498): crash recovery —
+  on restart, reconcile the CRD's prepared claims against live subslices from
+  the registry, re-adopting survivors, re-creating the missing, re-applying
+  sharing, and erroring on orphans that belong to no claim.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api import serde
+from tpu_dra.api.topology import Placement
+from tpu_dra.plugin.cdi import CDIHandler
+from tpu_dra.plugin.sharing import (
+    RuntimeProxyDaemon,
+    RuntimeProxyManager,
+    TimeSlicingManager,
+    setup_sharing,
+)
+from tpu_dra.plugin.tpulib import TpuLib
+
+
+@dataclass
+class PreparedClaim:
+    """One claim's prepared devices + any sharing daemon attached to it."""
+
+    devices: nascrd.PreparedDevices
+    proxy_daemon: RuntimeProxyDaemon | None = None
+
+
+class DeviceState:
+    def __init__(
+        self,
+        tpulib: TpuLib,
+        cdi: CDIHandler,
+        ts_manager: TimeSlicingManager,
+        proxy_manager: RuntimeProxyManager,
+    ):
+        self._lock = threading.Lock()
+        self._tpulib = tpulib
+        self._cdi = cdi
+        self._ts_manager = ts_manager
+        self._proxy_manager = proxy_manager
+        self._allocatable = tpulib.enumerate_all_possible_devices()
+        self._chips = {
+            d.tpu.uuid: d.tpu for d in self._allocatable if d.type() == "tpu"
+        }
+        self._prepared: dict[str, PreparedClaim] = {}
+
+    @property
+    def cdi(self) -> CDIHandler:
+        return self._cdi
+
+    # -- prepare / unprepare -------------------------------------------------
+
+    def prepare(self, claim_uid: str, allocated: nascrd.AllocatedDevices) -> list[str]:
+        with self._lock:
+            if claim_uid in self._prepared:
+                return self._cdi.get_claim_devices(claim_uid)
+
+            if allocated.type() == nascrd.TPU_DEVICE_TYPE:
+                devices = self._prepare_tpus(allocated.tpu)
+                sharing = allocated.tpu.sharing
+            elif allocated.type() == nascrd.SUBSLICE_DEVICE_TYPE:
+                devices = self._prepare_subslices(allocated.subslice)
+                sharing = allocated.subslice.sharing
+            else:
+                raise ValueError(
+                    f"claim {claim_uid} has no allocated devices to prepare"
+                )
+
+            entry = PreparedClaim(devices=devices)
+            try:
+                entry.proxy_daemon = setup_sharing(
+                    self._ts_manager,
+                    self._proxy_manager,
+                    sharing,
+                    allocated.claim_info,
+                    devices,
+                )
+                extra = (
+                    entry.proxy_daemon.get_cdi_edits()
+                    if entry.proxy_daemon is not None
+                    else None
+                )
+                self._cdi.create_claim_spec_file(
+                    claim_uid, devices, allocated, extra_edits=extra
+                )
+            except Exception:
+                self._rollback_prepare(entry)
+                raise
+
+            self._prepared[claim_uid] = entry
+            return self._cdi.get_claim_devices(claim_uid)
+
+    def _rollback_prepare(self, entry: PreparedClaim) -> None:
+        """Undo partial prepare so a retry starts clean (the reference leaks
+        created MIG devices on mid-prepare failure — a known gap fixed here)."""
+        if entry.proxy_daemon is not None:
+            entry.proxy_daemon.stop()
+        if entry.devices.tpu is not None:
+            # setup_sharing may already have applied a quantum; reset it.
+            self._ts_manager.set_time_slice(entry.devices, None)
+        if entry.devices.subslice is not None:
+            for dev in entry.devices.subslice.devices:
+                self._tpulib.delete_subslice(dev.uuid)
+
+    def unprepare(self, claim_uid: str) -> None:
+        with self._lock:
+            entry = self._prepared.get(claim_uid)
+            if entry is None:
+                return
+            if entry.proxy_daemon is not None:
+                entry.proxy_daemon.stop()
+            elif entry.devices.type() == nascrd.TPU_DEVICE_TYPE:
+                # The in-memory daemon handle can be lost across a restart
+                # when the claim was adopted without its allocation (see
+                # sync_prepared_from_crd_spec); tear down by claim UID so a
+                # RuntimeProxy deployment never outlives its claim.
+                self._proxy_manager.stop_for_claim(claim_uid)
+            if entry.devices.type() == nascrd.TPU_DEVICE_TYPE:
+                # Reset scheduler quanta (device_state.go:315-321).
+                self._ts_manager.set_time_slice(entry.devices, None)
+            elif entry.devices.type() == nascrd.SUBSLICE_DEVICE_TYPE:
+                for dev in entry.devices.subslice.devices:
+                    self._tpulib.delete_subslice(dev.uuid)
+            self._cdi.delete_claim_spec_file(claim_uid)
+            del self._prepared[claim_uid]
+
+    def _prepare_tpus(self, allocated: nascrd.AllocatedTpus) -> nascrd.PreparedDevices:
+        prepared = nascrd.PreparedTpus()
+        for device in allocated.devices:
+            chip = self._chips.get(device.uuid)
+            if chip is None:
+                raise ValueError(f"allocated TPU does not exist: {device.uuid}")
+            prepared.devices.append(
+                nascrd.PreparedTpu(uuid=chip.uuid, coord=chip.coord)
+            )
+        return nascrd.PreparedDevices(tpu=prepared)
+
+    def _prepare_subslices(
+        self, allocated: nascrd.AllocatedSubslices
+    ) -> nascrd.PreparedDevices:
+        if len(allocated.devices) != 1:
+            # The allocator only ever emits one subslice per claim (as the
+            # reference's MIG path does, mig.go:100-106); the CDI env
+            # contract (TPU_VISIBLE_CORES) is single-interval.
+            raise ValueError(
+                f"subslice claims must allocate exactly one device, "
+                f"got {len(allocated.devices)}"
+            )
+        prepared = nascrd.PreparedSubslices()
+        created: list[str] = []
+        try:
+            for device in allocated.devices:
+                if device.parent_uuid not in self._chips:
+                    raise ValueError(
+                        f"allocated parent TPU does not exist: {device.parent_uuid}"
+                    )
+                info = self._tpulib.create_subslice(
+                    device.parent_uuid, device.profile, device.placement
+                )
+                created.append(info.uuid)
+                prepared.devices.append(
+                    nascrd.PreparedSubslice(
+                        uuid=info.uuid,
+                        profile=info.profile,
+                        parent_uuid=info.parent_uuid,
+                        placement=info.placement,
+                    )
+                )
+        except Exception:
+            for uuid in created:
+                self._tpulib.delete_subslice(uuid)
+            raise
+        return nascrd.PreparedDevices(subslice=prepared)
+
+    # -- CRD spec sync (device_state.go:365-532) -----------------------------
+
+    def get_updated_spec(
+        self, inspec: nascrd.NodeAllocationStateSpec
+    ) -> nascrd.NodeAllocationStateSpec:
+        with self._lock:
+            outspec = serde.deepcopy(inspec)
+            self._sync_allocatable_to_spec(outspec)
+            self._sync_prepared_to_spec(outspec)
+            return outspec
+
+    def _sync_allocatable_to_spec(self, spec: nascrd.NodeAllocationStateSpec) -> None:
+        spec.allocatable_devices = serde.deepcopy(self._allocatable)
+
+    def _sync_prepared_to_spec(self, spec: nascrd.NodeAllocationStateSpec) -> None:
+        spec.prepared_claims = {
+            uid: serde.deepcopy(entry.devices)
+            for uid, entry in self._prepared.items()
+        }
+
+    def sync_prepared_from_crd_spec(
+        self, spec: nascrd.NodeAllocationStateSpec
+    ) -> None:
+        """Crash recovery (device_state.go:429-498): rebuild prepared state
+        from the CRD, re-adopting live subslices / re-creating missing ones,
+        re-applying sharing, and failing on orphaned subslices."""
+        live = {s.uuid: s for s in self._tpulib.list_subslices()}
+
+        prepared: dict[str, PreparedClaim] = {}
+        for claim_uid, devices in spec.prepared_claims.items():
+            allocated = spec.allocated_claims.get(claim_uid)
+            if allocated is None:
+                # Claim no longer allocated; stale-state GC will unprepare it.
+                # Still adopt its subslices so they aren't treated as orphans.
+                if devices.subslice is not None:
+                    for d in devices.subslice.devices:
+                        live.pop(d.uuid, None)
+                prepared[claim_uid] = PreparedClaim(devices=serde.deepcopy(devices))
+                continue
+
+            if devices.type() == nascrd.TPU_DEVICE_TYPE:
+                entry = PreparedClaim(
+                    devices=self._prepare_tpus(allocated.tpu)
+                )
+                sharing = allocated.tpu.sharing if allocated.tpu else None
+            elif devices.type() == nascrd.SUBSLICE_DEVICE_TYPE:
+                rebuilt = nascrd.PreparedSubslices()
+                for d in devices.subslice.devices:
+                    survivor = live.pop(d.uuid, None)
+                    if survivor is None:
+                        # UUID miss: a previous recovery may have re-created
+                        # this subslice under a fresh UUID before the CRD was
+                        # republished.  Match by identity-on-silicon
+                        # (parent + placement) so recovery is idempotent.
+                        for uuid, cand in list(live.items()):
+                            if (
+                                cand.parent_uuid == d.parent_uuid
+                                and cand.placement.start == d.placement.start
+                                and cand.placement.size == d.placement.size
+                            ):
+                                survivor = live.pop(uuid)
+                                break
+                    if survivor is None:
+                        # Re-create what the crash lost (device_state.go:464-476).
+                        info = self._tpulib.create_subslice(
+                            d.parent_uuid, d.profile, d.placement
+                        )
+                        rebuilt.devices.append(
+                            nascrd.PreparedSubslice(
+                                uuid=info.uuid,
+                                profile=info.profile,
+                                parent_uuid=info.parent_uuid,
+                                placement=info.placement,
+                            )
+                        )
+                    else:
+                        rebuilt.devices.append(
+                            nascrd.PreparedSubslice(
+                                uuid=survivor.uuid,
+                                profile=survivor.profile,
+                                parent_uuid=survivor.parent_uuid,
+                                placement=Placement(
+                                    survivor.placement.start, survivor.placement.size
+                                ),
+                            )
+                        )
+                entry = PreparedClaim(
+                    devices=nascrd.PreparedDevices(subslice=rebuilt)
+                )
+                sharing = allocated.subslice.sharing if allocated.subslice else None
+            else:
+                continue
+
+            entry.proxy_daemon = setup_sharing(
+                self._ts_manager,
+                self._proxy_manager,
+                sharing,
+                allocated.claim_info,
+                entry.devices,
+            )
+            # Ensure the CDI spec file survived the crash too.
+            if not self._cdi.claim_spec_exists(claim_uid):
+                extra = (
+                    entry.proxy_daemon.get_cdi_edits()
+                    if entry.proxy_daemon is not None
+                    else None
+                )
+                self._cdi.create_claim_spec_file(
+                    claim_uid, entry.devices, allocated, extra_edits=extra
+                )
+            prepared[claim_uid] = entry
+
+        if live:
+            raise RuntimeError(
+                f"subslice devices found that aren't prepared to any claim: "
+                f"{sorted(live)}"
+            )
+        with self._lock:
+            self._prepared = prepared

@@ -1,0 +1,198 @@
+"""Node plugin driver — NAS lifecycle, prepare/unprepare RPC handlers, and
+watch-driven stale-state GC (component C16; reference:
+cmd/nvidia-dra-plugin/driver.go:39-357).
+
+Lifecycle (driver.go:47-91): on startup, under conflict retry —
+GetOrCreate NAS -> status NotReady -> build DeviceState (enumerate + crash
+recovery) -> publish allocatable+prepared spec -> status Ready — then start
+the background GC.
+
+Prepare semantics (driver.go:103-171): NodePrepareResource is idempotent
+(answers from NAS preparedClaims if present) and otherwise runs the
+conflict-retried read->prepare->publish loop.  NodeUnprepareResource is
+deliberately a **no-op** (driver.go:128-133): actual cleanup is deferred to
+the GC, which watches the NAS and unprepares any claim present in
+preparedClaims but gone from allocatedClaims (driver.go:198-271) — the
+controller removing the allocation is the deletion signal.
+
+Gap fixed vs reference: the reference leaves cleanupCDIFiles and
+cleanupMpsControlDaemonArtifacts as TODO stubs (driver.go:345-357); here
+orphaned CDI spec files are swept in the same GC pass.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.client.apiserver import ApiError
+from tpu_dra.client.nasclient import NasClient
+from tpu_dra.client.retry import retry_on_conflict
+from tpu_dra.plugin.device_state import DeviceState
+
+logger = logging.getLogger(__name__)
+
+CLEANUP_TIMEOUT_SECONDS_ON_ERROR = 5.0
+
+
+class NodeDriver:
+    def __init__(
+        self,
+        nas: nascrd.NodeAllocationState,
+        nasclient: NasClient,
+        state: DeviceState,
+        *,
+        error_backoff_s: float = CLEANUP_TIMEOUT_SECONDS_ON_ERROR,
+        start_gc: bool = True,
+    ):
+        self._lock = threading.Lock()
+        self._nas = nas
+        self._client = nasclient
+        self._state = state
+        self._error_backoff_s = error_backoff_s
+        self._stop = threading.Event()
+        self._gc_thread: threading.Thread | None = None
+
+        # Startup handshake (driver.go:50-83).
+        def startup():
+            self._client.get_or_create()
+            self._client.update_status(nascrd.STATUS_NOT_READY)
+            state.sync_prepared_from_crd_spec(self._nas.spec)
+            self._client.update(state.get_updated_spec(self._nas.spec))
+            self._client.update_status(nascrd.STATUS_READY)
+
+        retry_on_conflict(startup)
+
+        if start_gc:
+            self._gc_thread = threading.Thread(
+                target=self._cleanup_stale_state_continuously,
+                name=f"nas-gc-{nas.metadata.name}",
+                daemon=True,
+            )
+            self._gc_thread.start()
+
+    # -- gRPC-facing handlers ------------------------------------------------
+
+    def node_prepare_resource(self, claim_uid: str) -> list[str]:
+        """Idempotent prepare; returns qualified CDI device names
+        (driver.go:103-126)."""
+        with self._lock:
+            is_prepared, devices = self._is_prepared(claim_uid)
+            if is_prepared:
+                return devices
+            return self._prepare(claim_uid)
+
+    def node_unprepare_resource(self, claim_uid: str) -> None:
+        """Deliberate no-op — deferred to the NAS-watch GC
+        (driver.go:128-133)."""
+
+    def _is_prepared(self, claim_uid: str) -> tuple[bool, list[str]]:
+        self._client.get()
+        if claim_uid in self._nas.spec.prepared_claims:
+            return True, self._state.cdi.get_claim_devices(claim_uid)
+        return False, []
+
+    def _prepare(self, claim_uid: str) -> list[str]:
+        result: list[str] = []
+
+        def attempt():
+            nonlocal result
+            self._client.get()
+            allocated = self._nas.spec.allocated_claims.get(claim_uid)
+            if allocated is None:
+                raise ValueError(
+                    f"claim {claim_uid} has no allocation on node "
+                    f"{self._nas.metadata.name}"
+                )
+            result = self._state.prepare(claim_uid, allocated)
+            self._client.update(self._state.get_updated_spec(self._nas.spec))
+
+        retry_on_conflict(attempt)
+        return result
+
+    def unprepare(self, claim_uid: str) -> None:
+        """Conflict-retried unprepare + publish (driver.go:173-196).
+
+        Runs under the driver lock: the GC thread and the prepare RPC share
+        one NasClient, and an interleaved get/update pair could otherwise
+        publish a stale allocated_claims snapshot under a fresh
+        resourceVersion (lost update, no conflict fired)."""
+
+        def attempt():
+            with self._lock:
+                self._client.get()
+                self._state.unprepare(claim_uid)
+                self._client.update(self._state.get_updated_spec(self._nas.spec))
+
+        retry_on_conflict(attempt)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        """Flip NotReady and stop the GC (driver.go:93-101 + signal path)."""
+        self._stop.set()
+        if self._gc_thread is not None:
+            self._gc_thread.join(timeout=5)
+
+        def flip():
+            self._client.get()
+            self._client.update_status(nascrd.STATUS_NOT_READY)
+
+        retry_on_conflict(flip)
+
+    # -- stale-state GC (driver.go:198-343) ----------------------------------
+
+    def _cleanup_stale_state_continuously(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._client.get()
+                self._cleanup_stale_state(self._nas)
+            except Exception:
+                logger.exception("error cleaning up stale claim state")
+                self._stop.wait(self._error_backoff_s)
+                continue
+
+            watch = self._client.watch()
+            try:
+                while not self._stop.is_set():
+                    event = watch.next(timeout=0.2)
+                    if event is None:
+                        continue
+                    if event["type"] != "MODIFIED":
+                        continue
+                    from tpu_dra.api import serde
+
+                    nas = serde.from_dict(
+                        nascrd.NodeAllocationState, event["object"]
+                    )
+                    self._cleanup_stale_state(nas)
+            except Exception:
+                logger.exception("error cleaning up stale claim state")
+                self._stop.wait(self._error_backoff_s)
+            finally:
+                watch.stop()
+
+    def _cleanup_stale_state(self, nas: nascrd.NodeAllocationState) -> None:
+        errors = 0
+        for claim_uid in list(nas.spec.prepared_claims):
+            if claim_uid not in nas.spec.allocated_claims:
+                try:
+                    self.unprepare(claim_uid)
+                except Exception:
+                    logger.exception(
+                        "error unpreparing resources for claim %s", claim_uid
+                    )
+                    errors += 1
+        # Sweep orphaned CDI files (reference TODO at driver.go:345-350).
+        for claim_uid in self._state.cdi.list_claim_spec_files():
+            if (
+                claim_uid not in nas.spec.allocated_claims
+                and claim_uid not in nas.spec.prepared_claims
+            ):
+                try:
+                    self._state.cdi.delete_claim_spec_file(claim_uid)
+                except OSError:
+                    errors += 1
+        if errors:
+            raise ApiError(f"encountered {errors} errors")

@@ -1,0 +1,272 @@
+"""Sharing actuation (component C20; reference: cmd/nvidia-dra-plugin/
+sharing.go:47-391).
+
+Two mechanisms, mirroring the reference's managers:
+
+- ``TimeSlicingManager`` — applies a runtime scheduler quantum to the claimed
+  chips through the device library (the reference shells out to ``nvidia-smi
+  compute-policy --set-timeslice``, sharing.go:99-120 via nvlib.go:471-485;
+  the TPU path sets the program-preemption quantum via tpulib).  Unprepare
+  resets to the default quantum by passing no config.
+
+- ``RuntimeProxyManager`` (MpsManager analog, sharing.go:122-391) — for each
+  RuntimeProxy-shared claim, launches a **per-claim control-daemon
+  Deployment** on this node (sharing.go:172-275) that owns the claimed
+  chips' device nodes and serves PJRT/IFRT clients over a unix socket in a
+  per-claim directory; consumer containers get CDI edits pointing at that
+  socket (sharing.go:334-354).  Readiness is polled with the same backoff
+  shape (1s base, x2, 4 steps, cap 10s — sharing.go:277-284).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api.k8s import Deployment, DeploymentSpec
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.sharing import RuntimeProxyConfig, TimeSlicingConfig, TpuSharing
+from tpu_dra.client.apiserver import NotFoundError
+from tpu_dra.client.clientset import ClientSet
+from tpu_dra.plugin.tpulib import TpuLib
+
+# Readiness backoff (sharing.go:277-284 parity).
+READY_BACKOFF_BASE_S = 1.0
+READY_BACKOFF_FACTOR = 2.0
+READY_BACKOFF_STEPS = 4
+READY_BACKOFF_CAP_S = 10.0
+
+
+class TimeSlicingManager:
+    def __init__(self, tpulib: TpuLib):
+        self._tpulib = tpulib
+
+    def set_time_slice(
+        self,
+        prepared: "nascrd.PreparedDevices",
+        config: TimeSlicingConfig | None,
+    ) -> None:
+        """Apply (or, with config=None, reset) the scheduler quantum on the
+        chips backing the prepared devices."""
+        interval_ms = (config or TimeSlicingConfig()).interval.int_value()
+        uuids: list[str] = []
+        if prepared.tpu is not None:
+            uuids = [d.uuid for d in prepared.tpu.devices]
+        elif prepared.subslice is not None:
+            # Quanta apply at chip granularity; set on the parents.
+            uuids = sorted({d.parent_uuid for d in prepared.subslice.devices})
+        self._tpulib.set_time_slice(uuids, interval_ms)
+
+
+class RuntimeProxyDaemon:
+    """One per-claim proxy control daemon (MpsControlDaemon analog,
+    sharing.go:140-391)."""
+
+    def __init__(
+        self,
+        manager: "RuntimeProxyManager",
+        claim: nascrd.ClaimInfo,
+        prepared: "nascrd.PreparedDevices",
+        config: RuntimeProxyConfig,
+    ):
+        self._manager = manager
+        self._claim = claim
+        self._config = config
+        if prepared.tpu is not None:
+            self._uuids = [d.uuid for d in prepared.tpu.devices]
+        else:
+            raise ValueError(
+                "RuntimeProxy sharing is only supported on whole-chip claims"
+            )
+        self._name = f"tpu-runtime-proxy-{claim.uid[:8]}"
+        self._root = os.path.join(manager.proxy_root, claim.uid)
+
+    @property
+    def socket_path(self) -> str:
+        return os.path.join(self._root, "proxy.sock")
+
+    def start(self) -> None:
+        """Create the per-claim daemon Deployment + its socket/shm dir
+        (sharing.go:172-275).  Idempotent."""
+        os.makedirs(self._root, exist_ok=True)
+        indices = [
+            self._manager.tpulib.chip_info(u).tpu.index for u in self._uuids
+        ]
+        hbm_limits = self._config.normalize(self._uuids)
+        env = [
+            {"name": "TPU_VISIBLE_DEVICES", "value": ",".join(map(str, sorted(indices)))},
+            {"name": "TPU_PROXY_SOCKET", "value": self.socket_path},
+        ]
+        if self._config.max_active_core_percentage is not None:
+            env.append(
+                {
+                    "name": "TPU_PROXY_ACTIVE_CORE_PERCENTAGE",
+                    "value": str(self._config.max_active_core_percentage),
+                }
+            )
+        for uuid, limit in sorted(hbm_limits.items()):
+            env.append(
+                {"name": f"TPU_PROXY_HBM_LIMIT_{uuid.replace('-', '_')}", "value": str(limit)}
+            )
+        deployment = Deployment(
+            metadata=ObjectMeta(
+                name=self._name,
+                namespace=self._manager.namespace,
+                labels={
+                    "app.kubernetes.io/name": "tpu-runtime-proxy",
+                    "tpu.resource.google.com/claim": self._claim.uid,
+                },
+            ),
+            spec=DeploymentSpec(
+                replicas=1,
+                selector={
+                    "matchLabels": {"tpu.resource.google.com/claim": self._claim.uid}
+                },
+                template={
+                    "metadata": {
+                        "labels": {
+                            "tpu.resource.google.com/claim": self._claim.uid
+                        }
+                    },
+                    "spec": {
+                        "nodeName": self._manager.node_name,
+                        "containers": [
+                            {
+                                "name": "proxy",
+                                "image": self._manager.image,
+                                "command": ["tpu-runtime-proxy"],
+                                "env": env,
+                                "volumeMounts": [
+                                    {"name": "proxy-dir", "mountPath": self._root}
+                                ],
+                            }
+                        ],
+                        "volumes": [
+                            {
+                                "name": "proxy-dir",
+                                "hostPath": {"path": self._root},
+                            }
+                        ],
+                    },
+                },
+            ),
+        )
+        client = self._manager.clientset.deployments(self._manager.namespace)
+        try:
+            client.get(self._name)
+        except NotFoundError:
+            client.create(deployment)
+
+    def assert_ready(self) -> None:
+        """Poll deployment readiness with capped exponential backoff
+        (sharing.go:277-332)."""
+        client = self._manager.clientset.deployments(self._manager.namespace)
+        delay = READY_BACKOFF_BASE_S * self._manager.backoff_scale
+        for step in range(READY_BACKOFF_STEPS):
+            try:
+                deployment = client.get(self._name)
+                if deployment.status.ready_replicas >= 1:
+                    return
+            except NotFoundError:
+                pass
+            time.sleep(min(delay, READY_BACKOFF_CAP_S * self._manager.backoff_scale))
+            delay *= READY_BACKOFF_FACTOR
+        raise TimeoutError(
+            f"runtime proxy daemon {self._name} for claim {self._claim.uid} "
+            f"is not ready"
+        )
+
+    def get_cdi_edits(self) -> dict:
+        """Edits injected into every consumer container (sharing.go:334-354)."""
+        return {
+            "env": [f"TPU_RUNTIME_PROXY_ADDR={self.socket_path}"],
+            "mounts": [
+                {
+                    "hostPath": self._root,
+                    "containerPath": self._root,
+                    "options": ["rw", "nosuid", "nodev", "bind"],
+                }
+            ],
+        }
+
+    def stop(self) -> None:
+        """Tear down the deployment + socket dir (sharing.go:356-391)."""
+        client = self._manager.clientset.deployments(self._manager.namespace)
+        try:
+            client.delete(self._name)
+        except NotFoundError:
+            pass
+        shutil.rmtree(self._root, ignore_errors=True)
+
+
+class RuntimeProxyManager:
+    def __init__(
+        self,
+        clientset: ClientSet,
+        tpulib: TpuLib,
+        *,
+        node_name: str,
+        namespace: str,
+        proxy_root: str = "/var/run/tpu-dra/proxy",
+        image: str = "tpu-dra-driver:latest",
+        backoff_scale: float = 1.0,
+    ):
+        self.clientset = clientset
+        self.tpulib = tpulib
+        self.node_name = node_name
+        self.namespace = namespace
+        self.proxy_root = proxy_root
+        self.image = image
+        # Tests shrink the readiness backoff without changing its shape.
+        self.backoff_scale = backoff_scale
+
+    def new_daemon(
+        self,
+        claim: nascrd.ClaimInfo,
+        prepared: "nascrd.PreparedDevices",
+        config: RuntimeProxyConfig,
+    ) -> RuntimeProxyDaemon:
+        return RuntimeProxyDaemon(self, claim, prepared, config)
+
+    def stop_for_claim(self, claim_uid: str) -> None:
+        """Tear down any proxy daemon artifacts for a claim by UID alone —
+        used when the in-memory daemon handle was lost across a restart."""
+        client = self.clientset.deployments(self.namespace)
+        try:
+            client.delete(f"tpu-runtime-proxy-{claim_uid[:8]}")
+        except NotFoundError:
+            pass
+        shutil.rmtree(os.path.join(self.proxy_root, claim_uid), ignore_errors=True)
+
+
+def setup_sharing(
+    ts_manager: TimeSlicingManager,
+    proxy_manager: RuntimeProxyManager,
+    sharing: TpuSharing | None,
+    claim: nascrd.ClaimInfo | None,
+    prepared: "nascrd.PreparedDevices",
+) -> RuntimeProxyDaemon | None:
+    """Apply a claim's sharing config at prepare time (device_state.go:333-363
+    analog).  Returns the proxy daemon when one was started."""
+    if sharing is None:
+        return None
+    if sharing.is_time_slicing():
+        ts_manager.set_time_slice(prepared, sharing.get_time_slicing_config())
+        return None
+    if sharing.is_runtime_proxy():
+        daemon = proxy_manager.new_daemon(
+            claim or nascrd.ClaimInfo(),
+            prepared,
+            sharing.get_runtime_proxy_config(),
+        )
+        daemon.start()
+        try:
+            daemon.assert_ready()
+        except Exception:
+            # Don't leak a half-started daemon on readiness failure.
+            daemon.stop()
+            raise
+        return daemon
+    return None

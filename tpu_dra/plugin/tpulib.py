@@ -1,0 +1,392 @@
+"""Device layer: TPU chip enumeration and subslice actuation (component C18).
+
+The reference reaches silicon through cgo NVML bindings behind the
+``deviceLib`` seam (cmd/nvidia-dra-plugin/nvlib.go:32-500, find.go:24-89);
+SURVEY.md §7 directs that this boundary be an interface designed for mocking
+from day one.  The TPU equivalent needs no native bindings at all — chips
+appear as ``/dev/accel*`` (or ``/dev/vfio/*``) device nodes on a TPU VM and
+topology comes from TPU-VM environment/metadata — so both implementations
+are pure Python:
+
+- ``MockTpuLib``  — config-driven topology, runs anywhere (the seam
+  BASELINE.md config #1 requires: "mock/loopback enumerator — runs on CPU").
+- ``RealTpuLib``  — scans the host devfs and environment of a real TPU VM.
+
+**Subslice persistence.** MIG partitions live on the GPU and survive a node
+plugin restart, which is what makes the reference's crash re-adoption
+(device_state.go:429-498) meaningful.  TPUs have no on-silicon partition
+objects (SURVEY.md §7 hard-part (c)), so subslice existence is driver state:
+a file-backed ``SubsliceRegistry`` under the plugin's state dir plays the
+role of silicon — created subslices survive restarts and are re-adopted (or
+orphan-detected) exactly like MIG devices.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import re
+import uuid as uuidlib
+from dataclasses import dataclass, field
+from typing import Protocol
+
+from tpu_dra.api.nas_v1alpha1 import (
+    AllocatableDevice,
+    AllocatableSubslice,
+    AllocatableTpu,
+)
+from tpu_dra.api.topology import Coord, Placement, SubsliceProfile, Topology
+
+GIB = 1024**3
+
+
+@dataclass
+class TpuChipInfo:
+    """Everything the plugin knows about one physical chip."""
+
+    tpu: AllocatableTpu
+    device_paths: list[str] = field(default_factory=list)
+
+
+@dataclass
+class SubsliceInfo:
+    """A live (created) subslice device."""
+
+    uuid: str
+    profile: str
+    parent_uuid: str
+    placement: Placement
+
+
+class TpuLib(Protocol):
+    """The device boundary (deviceLib analog, nvlib.go:32-36)."""
+
+    def enumerate_all_possible_devices(self) -> list[AllocatableDevice]:
+        """Chips plus the subslice profiles each partitionable chip supports
+        (nvlib.go:92-233 analog)."""
+        ...
+
+    def chip_info(self, uuid: str) -> TpuChipInfo:
+        ...
+
+    def create_subslice(
+        self, parent_uuid: str, profile: str, placement: Placement
+    ) -> SubsliceInfo:
+        """Carve a core subslice out of a chip (createMigDevice analog,
+        nvlib.go:339-415)."""
+        ...
+
+    def delete_subslice(self, uuid: str) -> None:
+        ...
+
+    def list_subslices(self) -> list[SubsliceInfo]:
+        """Live subslices surviving from a previous plugin incarnation."""
+        ...
+
+    def set_time_slice(self, uuids: list[str], interval_ms: int) -> None:
+        """Runtime scheduler quantum (nvidia-smi compute-policy analog,
+        nvlib.go:471-485)."""
+        ...
+
+    def library_paths(self) -> list[str]:
+        """Host paths of libtpu.so and friends to mount into containers
+        (find.go:28-61 analog)."""
+        ...
+
+
+class SubsliceRegistry:
+    """File-backed subslice store — the 'silicon' that survives restarts."""
+
+    def __init__(self, state_file: str):
+        self._path = state_file
+        os.makedirs(os.path.dirname(state_file) or ".", exist_ok=True)
+
+    def _load(self) -> dict[str, dict]:
+        try:
+            with open(self._path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    def _store(self, data: dict[str, dict]) -> None:
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1)
+        os.replace(tmp, self._path)
+
+    def _locked(self):
+        class _Lock:
+            def __init__(self, path):
+                self._f = open(path + ".lock", "w")
+
+            def __enter__(self):
+                fcntl.flock(self._f, fcntl.LOCK_EX)
+                return self
+
+            def __exit__(self, *exc):
+                fcntl.flock(self._f, fcntl.LOCK_UN)
+                self._f.close()
+
+        return _Lock(self._path)
+
+    def add(self, info: SubsliceInfo) -> None:
+        with self._locked():
+            data = self._load()
+            data[info.uuid] = {
+                "profile": info.profile,
+                "parentUuid": info.parent_uuid,
+                "placement": {"start": info.placement.start, "size": info.placement.size},
+            }
+            self._store(data)
+
+    def remove(self, uuid: str) -> None:
+        with self._locked():
+            data = self._load()
+            data.pop(uuid, None)
+            self._store(data)
+
+    def list(self) -> list[SubsliceInfo]:
+        with self._locked():
+            data = self._load()
+        return [
+            SubsliceInfo(
+                uuid=u,
+                profile=d["profile"],
+                parent_uuid=d["parentUuid"],
+                placement=Placement(d["placement"]["start"], d["placement"]["size"]),
+            )
+            for u, d in sorted(data.items())
+        ]
+
+
+class _BaseTpuLib:
+    """Shared chip bookkeeping + subslice lifecycle for both impls."""
+
+    def __init__(self, chips: list[TpuChipInfo], registry: SubsliceRegistry):
+        self._chips: dict[str, TpuChipInfo] = {c.tpu.uuid: c for c in chips}
+        self._registry = registry
+        self._time_slice: dict[str, int] = {}
+
+    def enumerate_all_possible_devices(self) -> list[AllocatableDevice]:
+        devices: list[AllocatableDevice] = []
+        profiles_seen: dict[tuple[str, str], AllocatableSubslice] = {}
+        for chip in self._chips.values():
+            devices.append(AllocatableDevice(tpu=chip.tpu))
+            if not chip.tpu.partitionable:
+                continue
+            for profile in SubsliceProfile.profiles_for_chip(
+                chip.tpu.cores, chip.tpu.hbm_bytes
+            ):
+                key = (chip.tpu.product, str(profile))
+                if key not in profiles_seen:
+                    entry = AllocatableSubslice(
+                        profile=str(profile),
+                        parent_product=chip.tpu.product,
+                        placements=profile.placements(chip.tpu.cores),
+                    )
+                    profiles_seen[key] = entry
+                    devices.append(AllocatableDevice(subslice=entry))
+        return devices
+
+    def chip_info(self, uuid: str) -> TpuChipInfo:
+        if uuid not in self._chips:
+            raise KeyError(f"unknown TPU chip {uuid!r}")
+        return self._chips[uuid]
+
+    def create_subslice(
+        self, parent_uuid: str, profile: str, placement: Placement
+    ) -> SubsliceInfo:
+        parent = self.chip_info(parent_uuid)
+        if not parent.tpu.partitionable:
+            raise ValueError(f"chip {parent_uuid} is not partitionable")
+        parsed = SubsliceProfile.parse(profile)
+        if placement not in parsed.placements(parent.tpu.cores):
+            raise ValueError(
+                f"invalid placement {placement} for profile {profile} "
+                f"on {parent.tpu.cores}-core chip"
+            )
+        for live in self._registry.list():
+            if live.parent_uuid == parent_uuid and live.placement.overlaps(placement):
+                raise ValueError(
+                    f"placement {placement} overlaps live subslice {live.uuid}"
+                )
+        info = SubsliceInfo(
+            uuid=f"ss-{uuidlib.uuid4().hex[:12]}",
+            profile=profile,
+            parent_uuid=parent_uuid,
+            placement=placement,
+        )
+        self._registry.add(info)
+        return info
+
+    def delete_subslice(self, uuid: str) -> None:
+        self._registry.remove(uuid)
+
+    def list_subslices(self) -> list[SubsliceInfo]:
+        return self._registry.list()
+
+    def set_time_slice(self, uuids: list[str], interval_ms: int) -> None:
+        for uuid in uuids:
+            self.chip_info(uuid)  # validate
+            self._time_slice[uuid] = interval_ms
+
+    def get_time_slice(self, uuid: str) -> int:
+        return self._time_slice.get(uuid, 0)
+
+
+class MockTpuLib(_BaseTpuLib):
+    """Config-driven enumerator for hardware-free operation.
+
+    Publishes an ``x × y × z`` host mesh of chips with fake device nodes.
+    """
+
+    def __init__(
+        self,
+        mesh: "str | Topology" = "2x2x1",
+        *,
+        cores: int = 4,
+        hbm_gb: int = 16,
+        product: str = "tpu-v5e",
+        generation: str = "v5e",
+        partitionable: bool = False,
+        ici_domain: str = "mock-host",
+        state_dir: str = "/tmp/tpu-dra-mock",
+    ):
+        topo = mesh if isinstance(mesh, Topology) else Topology.parse(mesh)
+        chips = []
+        for index, coord in enumerate(topo.coords_from((0, 0, 0))):
+            chips.append(
+                TpuChipInfo(
+                    tpu=AllocatableTpu(
+                        index=index,
+                        uuid=f"mock-tpu-{index}",
+                        coord=coord,
+                        ici_domain=ici_domain,
+                        cores=cores,
+                        hbm_bytes=hbm_gb * GIB,
+                        product=product,
+                        generation=generation,
+                        partitionable=partitionable,
+                        libtpu_version="1.10.0",
+                        runtime_version="2.0.0",
+                    ),
+                    device_paths=[f"/dev/accel{index}"],
+                )
+            )
+        super().__init__(chips, SubsliceRegistry(os.path.join(state_dir, "subslices.json")))
+        self._state_dir = state_dir
+
+    def library_paths(self) -> list[str]:
+        return [os.path.join(self._state_dir, "lib", "libtpu.so")]
+
+
+# Known per-generation chip geometry for devfs-based discovery (the real
+# source of truth on a TPU VM is the instance metadata/env; these are the
+# public v4/v5 configurations).
+_GENERATION_SPECS = {
+    "v4": dict(cores=2, hbm_gb=32, product="tpu-v4"),
+    "v5e": dict(cores=1, hbm_gb=16, product="tpu-v5e"),
+    "v5p": dict(cores=2, hbm_gb=95, product="tpu-v5p"),
+    "v6e": dict(cores=1, hbm_gb=32, product="tpu-v6e"),
+}
+
+_LIBTPU_SEARCH_PATHS = [
+    "/usr/lib/libtpu.so",
+    "/usr/local/lib/libtpu.so",
+    "/lib/libtpu.so",
+]
+
+
+class RealTpuLib(_BaseTpuLib):
+    """Devfs + environment enumerator for a real TPU VM.
+
+    Discovery sources, in order (find.go:28-61 analog):
+
+    - chips: ``/dev/accel[0-9]+`` (TPU VM runtime) or ``/dev/vfio/[0-9]+``
+    - host topology: ``TPU_CHIPS_PER_HOST_BOUNDS`` env ("x,y,z"), falling
+      back to a square arrangement of the discovered chip count
+    - accelerator type: ``TPU_ACCELERATOR_TYPE`` env (e.g. "v5litepod-16")
+    - libtpu: well-known install paths or ``TPU_LIBRARY_PATH``
+    """
+
+    def __init__(self, state_dir: str = "/var/run/tpu-dra", devfs_root: str = "/dev"):
+        chips = self._discover(devfs_root)
+        super().__init__(
+            chips, SubsliceRegistry(os.path.join(state_dir, "subslices.json"))
+        )
+
+    @staticmethod
+    def _host_topology(count: int) -> Topology:
+        bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
+        if bounds:
+            try:
+                x, y, z = (int(v) for v in bounds.split(","))
+                return Topology(x, y, z)
+            except ValueError:
+                pass
+        # Fall back to the squarest 2D arrangement of `count` chips.
+        best = (1, count)
+        for x in range(1, count + 1):
+            if count % x == 0 and abs(x - count // x) < abs(best[0] - best[1]):
+                best = (x, count // x)
+        return Topology(best[0], best[1], 1)
+
+    @staticmethod
+    def _generation() -> str:
+        accel = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        m = re.match(r"(v\d+[a-z]*)", accel.replace("litepod", "e"))
+        if m:
+            return m.group(1)
+        return "v5e"
+
+    def _discover(self, devfs_root: str) -> list[TpuChipInfo]:
+        paths = []
+        try:
+            for entry in sorted(os.listdir(devfs_root)):
+                if re.fullmatch(r"accel\d+", entry):
+                    paths.append(os.path.join(devfs_root, entry))
+        except OSError:
+            pass
+        if not paths:
+            vfio = os.path.join(devfs_root, "vfio")
+            try:
+                for entry in sorted(os.listdir(vfio)):
+                    if entry.isdigit():
+                        paths.append(os.path.join(vfio, entry))
+            except OSError:
+                pass
+        generation = self._generation()
+        spec = _GENERATION_SPECS.get(generation, _GENERATION_SPECS["v5e"])
+        topo = self._host_topology(max(len(paths), 1))
+        coords: list[Coord] = list(topo.coords_from((0, 0, 0)))
+        worker_id = os.environ.get("TPU_WORKER_ID", "0")
+        ici_domain = os.environ.get("TPU_SLICE_NAME", f"host-{worker_id}")
+        chips = []
+        for index, path in enumerate(paths):
+            coord = coords[index] if index < len(coords) else (index, 0, 0)
+            chips.append(
+                TpuChipInfo(
+                    tpu=AllocatableTpu(
+                        index=index,
+                        uuid=f"tpu-{worker_id}-{index}",
+                        coord=coord,
+                        ici_domain=ici_domain,
+                        cores=spec["cores"],
+                        hbm_bytes=spec["hbm_gb"] * GIB,
+                        product=spec["product"],
+                        generation=generation,
+                        partitionable=spec["cores"] > 1,
+                        libtpu_version=os.environ.get("TPU_LIBRARY_VERSION", ""),
+                        runtime_version=os.environ.get("TPU_RUNTIME_VERSION", ""),
+                    ),
+                    device_paths=[path],
+                )
+            )
+        return chips
+
+    def library_paths(self) -> list[str]:
+        explicit = os.environ.get("TPU_LIBRARY_PATH")
+        if explicit and os.path.exists(explicit):
+            return [explicit]
+        return [p for p in _LIBTPU_SEARCH_PATHS if os.path.exists(p)]
