@@ -1,0 +1,482 @@
+"""Controller dispatch driver + reconciler unit tests."""
+
+import time
+
+import pytest
+
+from helpers import make_plugin_stack
+from tpu_dra.api.k8s import (
+    ALLOCATION_MODE_IMMEDIATE,
+    ResourceClaim,
+    ResourceClaimParametersReference,
+    ResourceClaimSpec,
+    ResourceClass,
+    ResourceClassParametersReference,
+    get_selected_node,
+)
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.nas_v1alpha1 import NodeAllocationState, NodeAllocationStateSpec
+from tpu_dra.api.tpu_v1alpha1 import (
+    GROUP_NAME,
+    DeviceClassParameters,
+    DeviceClassParametersSpec,
+    SubsliceClaimParameters,
+    SubsliceClaimParametersSpec,
+    TpuClaimParameters,
+    TpuClaimParametersSpec,
+)
+from tpu_dra.client import ClientSet, FakeApiServer, NasClient
+from tpu_dra.controller.driver import ControllerDriver
+from tpu_dra.controller.reconciler import FINALIZER, Controller
+from tpu_dra.plugin.driver import NodeDriver
+
+NS = "default"
+DRIVER_NS = "tpu-dra"
+
+
+@pytest.fixture
+def cs():
+    return ClientSet(FakeApiServer())
+
+
+@pytest.fixture
+def driver(cs):
+    return ControllerDriver(cs, DRIVER_NS)
+
+
+def publish_node(tmp_path, cs, node="node-1", **kwargs):
+    """Run a real node plugin once to publish a Ready NAS."""
+    _, _, state = make_plugin_stack(tmp_path, cs, node=node, **kwargs)
+    nas = NodeAllocationState(metadata=ObjectMeta(name=node, namespace=DRIVER_NS))
+    NodeDriver(nas, NasClient(nas, cs), state, start_gc=False)
+    return state
+
+
+def make_claim(cs, name="c1", kind=None, params_name=None, mode=None):
+    spec = ResourceClaimSpec(resource_class_name="tpu.google.com")
+    if kind:
+        spec.parameters_ref = ResourceClaimParametersReference(
+            api_group=GROUP_NAME, kind=kind, name=params_name
+        )
+    if mode:
+        spec.allocation_mode = mode
+    return cs.resource_claims(NS).create(
+        ResourceClaim(metadata=ObjectMeta(name=name, namespace=NS), spec=spec)
+    )
+
+
+class TestParameterResolution:
+    def test_class_defaults_without_ref(self, driver):
+        params = driver.get_class_parameters(ResourceClass())
+        assert params.shareable is True
+
+    def test_class_params_fetched(self, cs, driver):
+        cs.device_class_parameters().create(
+            DeviceClassParameters(
+                metadata=ObjectMeta(name="dc"),
+                spec=DeviceClassParametersSpec(shareable=False),
+            )
+        )
+        rc = ResourceClass(
+            parameters_ref=ResourceClassParametersReference(
+                api_group=GROUP_NAME, kind="DeviceClassParameters", name="dc"
+            )
+        )
+        assert driver.get_class_parameters(rc).shareable is False
+
+    def test_class_wrong_group(self, driver):
+        rc = ResourceClass(
+            parameters_ref=ResourceClassParametersReference(
+                api_group="nvidia.com", kind="DeviceClassParameters", name="x"
+            )
+        )
+        with pytest.raises(ValueError, match="incorrect API group"):
+            driver.get_class_parameters(rc)
+
+    def test_claim_defaults_without_ref(self, cs, driver):
+        claim = make_claim(cs)
+        params = driver.get_claim_parameters(claim, ResourceClass(), None)
+        assert params.count == 1
+
+    def test_claim_params_fetched_and_validated(self, cs, driver):
+        cs.tpu_claim_parameters(NS).create(
+            TpuClaimParameters(
+                metadata=ObjectMeta(name="p", namespace=NS),
+                spec=TpuClaimParametersSpec(topology="2x2"),
+            )
+        )
+        claim = make_claim(cs, kind="TpuClaimParameters", params_name="p")
+        params = driver.get_claim_parameters(claim, ResourceClass(), None)
+        assert params.topology == "2x2"
+
+    def test_invalid_claim_params_rejected(self, cs, driver):
+        cs.tpu_claim_parameters(NS).create(
+            TpuClaimParameters(
+                metadata=ObjectMeta(name="bad", namespace=NS),
+                spec=TpuClaimParametersSpec(count=0),
+            )
+        )
+        claim = make_claim(cs, kind="TpuClaimParameters", params_name="bad")
+        with pytest.raises(ValueError):
+            driver.get_claim_parameters(claim, ResourceClass(), None)
+
+    def test_subslice_kind_dispatch(self, cs, driver):
+        cs.subslice_claim_parameters(NS).create(
+            SubsliceClaimParameters(
+                metadata=ObjectMeta(name="s", namespace=NS),
+                spec=SubsliceClaimParametersSpec(profile="1c.4gb"),
+            )
+        )
+        claim = make_claim(cs, kind="SubsliceClaimParameters", params_name="s")
+        params = driver.get_claim_parameters(claim, ResourceClass(), None)
+        assert params.profile == "1c.4gb"
+
+    def test_unknown_kind(self, cs, driver):
+        claim = make_claim(cs, kind="CoreClaimParameters", params_name="x")
+        with pytest.raises(ValueError, match="unknown ResourceClaim"):
+            driver.get_claim_parameters(claim, ResourceClass(), None)
+
+
+class TestAllocateDeallocate:
+    def test_allocate_requires_ready_node(self, tmp_path, cs, driver):
+        publish_node(tmp_path, cs)
+        nas_client = cs.node_allocation_states(DRIVER_NS)
+        nas = nas_client.get("node-1")
+        nas.status = "NotReady"
+        nas_client.update(nas)
+
+        claim = make_claim(cs)
+        params = TpuClaimParametersSpec(count=1)
+        from tpu_dra.controller.types import ClaimAllocation
+        from tpu_dra.api.k8s import Pod
+
+        with pytest.raises(RuntimeError, match="NodeAllocationState status"):
+            driver.allocate(
+                claim, params, ResourceClass(), DeviceClassParametersSpec(True), "node-1"
+            )
+
+    def test_immediate_mode_unsupported(self, cs, driver):
+        claim = make_claim(cs)
+        with pytest.raises(NotImplementedError):
+            driver.allocate(
+                claim,
+                TpuClaimParametersSpec(count=1),
+                ResourceClass(),
+                DeviceClassParametersSpec(True),
+                "",
+            )
+
+    def test_full_two_phase_through_dispatch(self, tmp_path, cs, driver):
+        publish_node(tmp_path, cs)
+        claim = make_claim(cs)
+        params = TpuClaimParametersSpec(count=2)
+        from tpu_dra.api.k8s import Pod
+        from tpu_dra.controller.types import ClaimAllocation
+
+        ca = ClaimAllocation(
+            claim=claim, class_=ResourceClass(), claim_parameters=params
+        )
+        driver.unsuitable_nodes(Pod(), [ca], ["node-1"])
+        assert ca.unsuitable_nodes == []
+        result = driver.allocate(
+            claim, params, ResourceClass(), DeviceClassParametersSpec(True), "node-1"
+        )
+        assert get_selected_node_from(result) == "node-1"
+        nas = cs.node_allocation_states(DRIVER_NS).get("node-1")
+        assert claim.metadata.uid in nas.spec.allocated_claims
+        info = nas.spec.allocated_claims[claim.metadata.uid].claim_info
+        assert info.name == "c1" and info.namespace == NS
+
+        # Idempotent re-allocate.
+        again = driver.allocate(
+            claim, params, ResourceClass(), DeviceClassParametersSpec(True), "node-1"
+        )
+        assert get_selected_node_from(again) == "node-1"
+
+        # Deallocate removes the NAS entry.
+        claim.status.allocation = result
+        driver.deallocate(claim)
+        nas = cs.node_allocation_states(DRIVER_NS).get("node-1")
+        assert claim.metadata.uid not in nas.spec.allocated_claims
+
+    def test_unsuitable_when_node_missing(self, cs, driver):
+        from tpu_dra.api.k8s import Pod
+        from tpu_dra.controller.types import ClaimAllocation
+
+        claim = make_claim(cs)
+        ca = ClaimAllocation(
+            claim=claim,
+            class_=ResourceClass(),
+            claim_parameters=TpuClaimParametersSpec(count=1),
+        )
+        driver.unsuitable_nodes(Pod(), [ca], ["ghost-node"])
+        assert ca.unsuitable_nodes == ["ghost-node"]
+
+    def test_unsuitable_nodes_deduped(self, cs, driver):
+        from tpu_dra.api.k8s import Pod
+        from tpu_dra.controller.types import ClaimAllocation
+
+        claim = make_claim(cs)
+        ca = ClaimAllocation(
+            claim=claim,
+            class_=ResourceClass(),
+            claim_parameters=TpuClaimParametersSpec(count=1),
+        )
+        driver.unsuitable_nodes(Pod(), [ca], ["ghost", "ghost"])
+        assert ca.unsuitable_nodes == ["ghost"]
+
+
+def get_selected_node_from(result):
+    return result.available_on_nodes.node_selector_terms[0].match_fields[0].values[0]
+
+
+class TestReconcilerClaimLifecycle:
+    def wait_for(self, predicate, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.02)
+        return False
+
+    @pytest.fixture
+    def running(self, tmp_path, cs, driver):
+        publish_node(tmp_path, cs)
+        cs.resource_classes().create(
+            ResourceClass(
+                metadata=ObjectMeta(name="tpu.google.com"), driver_name=GROUP_NAME
+            )
+        )
+        controller = Controller(
+            driver, cs, workers=2, recheck_period_s=0.2, error_backoff_base_s=0.02
+        )
+        controller.start()
+        yield controller
+        controller.stop()
+
+    def test_claim_deletion_deallocates(self, tmp_path, cs, driver, running):
+        # Allocate through the driver (as scheduling would), then delete.
+        claim = make_claim(cs)
+        claim.metadata.finalizers.append(FINALIZER)
+        claim = cs.resource_claims(NS).update(claim)
+        params = TpuClaimParametersSpec(count=1)
+        from tpu_dra.api.k8s import Pod
+        from tpu_dra.controller.types import ClaimAllocation
+
+        ca = ClaimAllocation(
+            claim=claim, class_=ResourceClass(), claim_parameters=params
+        )
+        driver.unsuitable_nodes(Pod(), [ca], ["node-1"])
+        result = driver.allocate(
+            claim, params, ResourceClass(), DeviceClassParametersSpec(True), "node-1"
+        )
+        claim.status.allocation = result
+        claim.status.driver_name = GROUP_NAME
+        claim = cs.resource_claims(NS).update_status(claim)
+
+        cs.resource_claims(NS).delete("c1")
+        # Controller must deallocate + remove finalizer -> object vanishes.
+        from tpu_dra.client.apiserver import NotFoundError
+
+        def gone():
+            try:
+                cs.resource_claims(NS).get("c1")
+                return False
+            except NotFoundError:
+                return True
+
+        assert self.wait_for(gone)
+        nas = cs.node_allocation_states(DRIVER_NS).get("node-1")
+        assert claim.metadata.uid not in nas.spec.allocated_claims
+
+    def test_deallocation_requested(self, tmp_path, cs, driver, running):
+        claim = make_claim(cs, name="c2")
+        claim.metadata.finalizers.append(FINALIZER)
+        claim = cs.resource_claims(NS).update(claim)
+        params = TpuClaimParametersSpec(count=1)
+        from tpu_dra.api.k8s import Pod
+        from tpu_dra.controller.types import ClaimAllocation
+
+        ca = ClaimAllocation(
+            claim=claim, class_=ResourceClass(), claim_parameters=params
+        )
+        driver.unsuitable_nodes(Pod(), [ca], ["node-1"])
+        result = driver.allocate(
+            claim, params, ResourceClass(), DeviceClassParametersSpec(True), "node-1"
+        )
+        claim.status.allocation = result
+        claim.status.deallocation_requested = True
+        cs.resource_claims(NS).update_status(claim)
+
+        def deallocated():
+            fresh = cs.resource_claims(NS).get("c2")
+            return (
+                fresh.status.allocation is None
+                and not fresh.status.deallocation_requested
+                and FINALIZER not in fresh.metadata.finalizers
+            )
+
+        assert self.wait_for(deallocated)
+
+    def test_reserved_claims_left_alone(self, tmp_path, cs, driver, running):
+        from tpu_dra.api.k8s import ResourceClaimConsumerReference
+
+        claim = make_claim(cs, name="c3")
+        claim.status.reserved_for.append(
+            ResourceClaimConsumerReference(resource="pods", name="p", uid="u")
+        )
+        claim.status.deallocation_requested = True
+        cs.resource_claims(NS).update_status(claim)
+        time.sleep(0.3)
+        fresh = cs.resource_claims(NS).get("c3")
+        assert fresh.status.deallocation_requested  # untouched while in use
+
+
+class TestPhantomPendingDefenses:
+    """Regressions for the stale pending-capacity leak (SURVEY §7 hard-part
+    (b)): allocated claims are excluded from tentative placement, deleting
+    claims are never re-placed, dead pending entries are purged, and old
+    entries expire."""
+
+    def test_allocated_claim_skipped_in_pod_sync(self, tmp_path, cs, driver):
+        from tpu_dra.api.k8s import (
+            AllocationResult,
+            Pod,
+            PodResourceClaim,
+            PodResourceClaimSource,
+        )
+        from tpu_dra.api.k8s import PodSpec
+
+        controller = Controller(driver, cs, workers=0)
+        pod = Pod(
+            metadata=ObjectMeta(name="p", namespace=NS, uid="pod-uid"),
+            spec=PodSpec(),
+        )
+        claim = make_claim(cs, name="allocated-claim")
+        claim.status.allocation = AllocationResult()
+        cs.resource_claims(NS).update_status(claim)
+        pc = PodResourceClaim(
+            name="x",
+            source=PodResourceClaimSource(resource_claim_name="allocated-claim"),
+        )
+        assert controller._check_pod_claim(pod, pc) is None
+
+    def test_deleting_claim_skipped_in_pod_sync(self, tmp_path, cs, driver):
+        from tpu_dra.api.k8s import Pod, PodResourceClaim, PodResourceClaimSource, PodSpec
+
+        controller = Controller(driver, cs, workers=0)
+        claim = make_claim(cs, name="dying-claim")
+        claim.metadata.finalizers.append(FINALIZER)
+        cs.resource_claims(NS).update(claim)
+        cs.resource_claims(NS).delete("dying-claim")  # deferred by finalizer
+        pod = Pod(metadata=ObjectMeta(name="p", namespace=NS, uid="u"), spec=PodSpec())
+        pc = PodResourceClaim(
+            name="x",
+            source=PodResourceClaimSource(resource_claim_name="dying-claim"),
+        )
+        assert controller._check_pod_claim(pod, pc) is None
+
+    def test_dead_pending_purged_on_scheduling_pass(self, tmp_path, cs, driver):
+        from tpu_dra.api.k8s import Pod
+        from tpu_dra.controller.types import ClaimAllocation
+
+        publish_node(tmp_path, cs)
+        # A pending entry for a claim that no longer exists.
+        ghost = make_claim(cs, name="ghost")
+        ca = ClaimAllocation(
+            claim=ghost,
+            class_=ResourceClass(),
+            claim_parameters=TpuClaimParametersSpec(count=4),
+        )
+        driver.unsuitable_nodes(Pod(), [ca], ["node-1"])
+        uid = ghost.claim.metadata.uid if hasattr(ghost, "claim") else ghost.metadata.uid
+        assert driver.tpu.pending_allocated_claims.exists(uid, "node-1")
+        cs.resource_claims(NS).delete("ghost")
+
+        # Another pod's scheduling pass purges the dead entry and can use
+        # the full node.
+        live = make_claim(cs, name="live")
+        ca2 = ClaimAllocation(
+            claim=live,
+            class_=ResourceClass(),
+            claim_parameters=TpuClaimParametersSpec(count=4),
+        )
+        driver.unsuitable_nodes(Pod(), [ca2], ["node-1"])
+        assert ca2.unsuitable_nodes == []
+        assert not driver.tpu.pending_allocated_claims.exists(uid, "node-1")
+
+    def test_deallocate_clears_pending_without_nas_entry(self, cs, driver):
+        from tpu_dra.api.nas_v1alpha1 import AllocatedDevices
+
+        claim = make_claim(cs, name="never-committed")
+        uid = claim.metadata.uid
+        driver.tpu.pending_allocated_claims.set(uid, "node-x", AllocatedDevices())
+        driver.deallocate(claim)  # no selected node, no NAS entry
+        assert not driver.tpu.pending_allocated_claims.exists(uid, "node-x")
+
+    def test_pending_ttl_expiry(self):
+        from tpu_dra.api.nas_v1alpha1 import AllocatedDevices
+        from tpu_dra.controller.pending import PerNodeAllocatedClaims
+
+        cache = PerNodeAllocatedClaims(ttl_s=0.05)
+        cache.set("uid", "node", AllocatedDevices())
+        seen = []
+        cache.visit_node("node", lambda u, a: seen.append(u))
+        assert seen == ["uid"]
+        time.sleep(0.08)
+        seen.clear()
+        cache.visit_node("node", lambda u, a: seen.append(u))
+        assert seen == []
+        assert not cache.exists("uid", "node")
+
+
+class TestDelayQueue:
+    def test_earlier_deadline_wins(self):
+        from tpu_dra.controller.reconciler import _DelayQueue
+
+        q = _DelayQueue()
+        q.add(("k",), delay=30.0)  # slow recheck queued
+        q.add(("k",), delay=0.0)  # watch event must not be absorbed
+        assert q.get(timeout=0.5) == ("k",)
+        q.done(("k",))
+        q.close()
+
+    def test_later_add_deduped(self):
+        from tpu_dra.controller.reconciler import _DelayQueue
+
+        q = _DelayQueue()
+        q.add(("k",), delay=0.0)
+        q.add(("k",), delay=5.0)
+        assert q.get(timeout=0.5) == ("k",)
+        q.done(("k",))
+        assert q.get(timeout=0.05) is None  # only one delivery
+        q.close()
+
+    def test_single_flight(self):
+        from tpu_dra.controller.reconciler import _DelayQueue
+
+        q = _DelayQueue()
+        q.add(("k",))
+        key = q.get(timeout=0.5)
+        assert key == ("k",)
+        q.add(("k",))  # arrives while processing
+        assert q.get(timeout=0.05) is None  # not handed out concurrently
+        q.done(("k",))
+        assert q.get(timeout=0.5) == ("k",)  # deferred add released
+        q.done(("k",))
+        q.close()
+
+    def test_idempotent_allocate_preserves_shareability(self, tmp_path, cs, driver):
+        publish_node(tmp_path, cs)
+        claim = make_claim(cs)
+        params = TpuClaimParametersSpec(count=1)
+        from tpu_dra.api.k8s import Pod
+        from tpu_dra.controller.types import ClaimAllocation
+
+        ca = ClaimAllocation(claim=claim, class_=ResourceClass(), claim_parameters=params)
+        driver.unsuitable_nodes(Pod(), [ca], ["node-1"])
+        exclusive = DeviceClassParametersSpec(shareable=False)
+        first = driver.allocate(claim, params, ResourceClass(), exclusive, "node-1")
+        again = driver.allocate(claim, params, ResourceClass(), exclusive, "node-1")
+        assert first.shareable is False
+        assert again.shareable is False  # reference hardcodes True here

@@ -1,0 +1,395 @@
+"""End-to-end tests over the full in-process stack (SimCluster).
+
+These are the asserted analogs of the reference's demo walkthrough
+(demo/specs/quickstart/gpu-test{1..6}.yaml, SURVEY.md §4) plus the
+TPU-specific topology scenario from BASELINE.md:
+
+- test1: 2 pods, each 1 distinct chip via a ResourceClaimTemplate
+- test2: 1 pod, 2 containers sharing one claim
+- test3: 2 pods sharing one global shareable ResourceClaim
+- test4: parent-chip claim + subslice claims with tpuClaimName affinity
+- test5: 2 pods sharing one subslice claim (CI-analog, shared partition)
+- test6: nested and/or selector + TimeSlicing config
+- topology: 2x2 ICI-contiguous block claim
+- lifecycle: deletion frees chips for new claims
+"""
+
+import json
+import os
+
+import pytest
+
+from tpu_dra.api.k8s import (
+    Pod,
+    PodResourceClaim,
+    PodResourceClaimSource,
+    PodSpec,
+    ResourceClaim,
+    ResourceClaimParametersReference,
+    ResourceClaimSpec,
+    ResourceClaimTemplate,
+    ResourceClaimTemplateSpec,
+    ResourceClass,
+    ResourceClassParametersReference,
+)
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.sharing import (
+    SharingStrategy,
+    TimeSliceInterval,
+    TimeSlicingConfig,
+    TpuSharing,
+)
+from tpu_dra.api.tpu_v1alpha1 import (
+    GROUP_NAME,
+    SubsliceClaimParameters,
+    SubsliceClaimParametersSpec,
+    TpuClaimParameters,
+    TpuClaimParametersSpec,
+    TpuSelector,
+    make_property_selector,
+)
+from tpu_dra.sim import SimCluster
+
+NS = "default"
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """Plain chips (non-partitionable) — claims without selectors match."""
+    cluster = SimCluster(str(tmp_path), nodes=2, mesh="2x2x1")
+    cluster.start()
+    setup_resource_class(cluster)
+    yield cluster
+    cluster.stop()
+
+
+@pytest.fixture
+def pcluster(tmp_path):
+    """Partitionable chips — for subslice and explicit-selector scenarios."""
+    cluster = SimCluster(str(tmp_path), nodes=2, mesh="2x2x1", partitionable=True)
+    cluster.start()
+    setup_resource_class(cluster)
+    yield cluster
+    cluster.stop()
+
+
+def setup_resource_class(cluster):
+    cluster.clientset.resource_classes().create(
+        ResourceClass(
+            metadata=ObjectMeta(name="tpu.google.com"),
+            driver_name=GROUP_NAME,
+        )
+    )
+
+
+def create_tpu_params(cluster, name, **spec_kwargs):
+    cluster.clientset.tpu_claim_parameters(NS).create(
+        TpuClaimParameters(
+            metadata=ObjectMeta(name=name, namespace=NS),
+            spec=TpuClaimParametersSpec(**spec_kwargs),
+        )
+    )
+
+
+def create_subslice_params(cluster, name, **spec_kwargs):
+    cluster.clientset.subslice_claim_parameters(NS).create(
+        SubsliceClaimParameters(
+            metadata=ObjectMeta(name=name, namespace=NS),
+            spec=SubsliceClaimParametersSpec(**spec_kwargs),
+        )
+    )
+
+
+def claim_spec(params_name, kind="TpuClaimParameters"):
+    return ResourceClaimSpec(
+        resource_class_name="tpu.google.com",
+        parameters_ref=ResourceClaimParametersReference(
+            api_group=GROUP_NAME, kind=kind, name=params_name
+        ),
+    )
+
+
+def create_template(cluster, name, params_name, kind="TpuClaimParameters"):
+    cluster.clientset.resource_claim_templates(NS).create(
+        ResourceClaimTemplate(
+            metadata=ObjectMeta(name=name, namespace=NS),
+            spec=ResourceClaimTemplateSpec(spec=claim_spec(params_name, kind)),
+        )
+    )
+
+
+def create_claim(cluster, name, params_name, kind="TpuClaimParameters"):
+    cluster.clientset.resource_claims(NS).create(
+        ResourceClaim(
+            metadata=ObjectMeta(name=name, namespace=NS),
+            spec=claim_spec(params_name, kind),
+        )
+    )
+
+
+def make_pod(name, claim_entries):
+    """claim_entries: list of (entry_name, source_kwargs)."""
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=NS),
+        spec=PodSpec(
+            resource_claims=[
+                PodResourceClaim(
+                    name=entry, source=PodResourceClaimSource(**source)
+                )
+                for entry, source in claim_entries
+            ]
+        ),
+    )
+
+
+def chips_of(cluster, pod):
+    """The chip UUIDs allocated to a running pod's claims."""
+    uuids = []
+    for pod_claim in pod.spec.resource_claims:
+        from tpu_dra.controller.reconciler import resource_claim_name
+
+        claim = cluster.clientset.resource_claims(NS).get(
+            resource_claim_name(pod, pod_claim)
+        )
+        nas = cluster.clientset.node_allocation_states("tpu-dra").get(
+            pod.spec.node_name
+        )
+        allocated = nas.spec.allocated_claims[claim.metadata.uid]
+        if allocated.tpu is not None:
+            uuids.extend(d.uuid for d in allocated.tpu.devices)
+        else:
+            uuids.extend(
+                f"{d.parent_uuid}:{d.placement.start}+{d.placement.size}"
+                for d in allocated.subslice.devices
+            )
+    return uuids
+
+
+class TestTpuTest1DistinctChipsPerPod:
+    def test_two_pods_distinct_chips(self, cluster):
+        create_tpu_params(cluster, "single-tpu", count=1)
+        create_template(cluster, "single-tpu-template", "single-tpu")
+        pods_client = cluster.clientset.pods(NS)
+        for name in ("pod1", "pod2"):
+            pods_client.create(
+                make_pod(
+                    name,
+                    [("tpu", {"resource_claim_template_name": "single-tpu-template"})],
+                )
+            )
+        p1 = cluster.wait_for_pod_running(NS, "pod1")
+        p2 = cluster.wait_for_pod_running(NS, "pod2")
+        c1, c2 = chips_of(cluster, p1), chips_of(cluster, p2)
+        assert len(c1) == 1 and len(c2) == 1
+        assert set(c1).isdisjoint(c2)  # distinct devices — the point of test1
+
+
+class TestTpuTest2SharedClaimOnePod:
+    def test_two_containers_one_claim(self, cluster):
+        create_tpu_params(cluster, "shared-tpu", count=1)
+        create_claim(cluster, "shared-claim", "shared-tpu")
+        pod = make_pod("pod-2c", [("tpu", {"resource_claim_name": "shared-claim"})])
+        cluster.clientset.pods(NS).create(pod)
+        running = cluster.wait_for_pod_running(NS, "pod-2c")
+        # Both containers consume the same qualified CDI device.
+        devices = running.metadata.annotations["cdi.k8s.io/devices"]
+        claim = cluster.clientset.resource_claims(NS).get("shared-claim")
+        assert devices == f"tpu.resource.google.com/claim={claim.metadata.uid}"
+
+
+class TestTpuTest3SharedClaimTwoPods:
+    def test_two_pods_share_one_chip(self, cluster):
+        create_tpu_params(cluster, "shared-tpu", count=1)
+        create_claim(cluster, "global-claim", "shared-tpu")
+        for name in ("sharer1", "sharer2"):
+            cluster.clientset.pods(NS).create(
+                make_pod(name, [("tpu", {"resource_claim_name": "global-claim"})])
+            )
+        p1 = cluster.wait_for_pod_running(NS, "sharer1")
+        p2 = cluster.wait_for_pod_running(NS, "sharer2")
+        assert p1.spec.node_name == p2.spec.node_name
+        assert chips_of(cluster, p1) == chips_of(cluster, p2)
+        claim = cluster.clientset.resource_claims(NS).get("global-claim")
+        assert claim.status.allocation.shareable is True
+        assert len(claim.status.reserved_for) == 2
+
+
+class TestTpuTest4SubsliceAffinity:
+    def test_parent_and_subslices(self, pcluster):
+        cluster = pcluster
+        create_tpu_params(
+            cluster,
+            "parent-tpu",
+            count=1,
+            selector=make_property_selector(partitionable=True),
+        )
+        create_subslice_params(
+            cluster, "small-slice", profile="1c.4gb", tpu_claim_name="parent"
+        )
+        create_template(cluster, "parent-template", "parent-tpu")
+        create_template(
+            cluster, "slice-template", "small-slice", "SubsliceClaimParameters"
+        )
+        pod = make_pod(
+            "mig-style-pod",
+            [
+                ("parent", {"resource_claim_template_name": "parent-template"}),
+                ("s0", {"resource_claim_template_name": "slice-template"}),
+                ("s1", {"resource_claim_template_name": "slice-template"}),
+            ],
+        )
+        cluster.clientset.pods(NS).create(pod)
+        running = cluster.wait_for_pod_running(NS, "mig-style-pod", timeout=15)
+        allocated = chips_of(cluster, running)
+        parent_chip = allocated[0]
+        # Both subslices were carved out of the pod's own parent chip.
+        assert allocated[1].startswith(parent_chip + ":")
+        assert allocated[2].startswith(parent_chip + ":")
+        assert allocated[1] != allocated[2]  # distinct core intervals
+
+
+class TestTpuTest5SharedSubslice:
+    def test_two_pods_share_subslice(self, pcluster):
+        cluster = pcluster
+        create_subslice_params(cluster, "shared-slice", profile="2c.8gb")
+        create_claim(
+            cluster, "slice-claim", "shared-slice", "SubsliceClaimParameters"
+        )
+        for name in ("ci1", "ci2"):
+            cluster.clientset.pods(NS).create(
+                make_pod(name, [("slice", {"resource_claim_name": "slice-claim"})])
+            )
+        p1 = cluster.wait_for_pod_running(NS, "ci1")
+        p2 = cluster.wait_for_pod_running(NS, "ci2")
+        assert chips_of(cluster, p1) == chips_of(cluster, p2)
+
+
+class TestTpuTest6SelectorsAndTimeSlicing:
+    def test_nested_selector_with_sharing(self, pcluster):
+        cluster = pcluster
+        selector = TpuSelector(
+            or_expression=[
+                make_property_selector(generation="v4"),
+                TpuSelector(
+                    and_expression=[
+                        make_property_selector(product="tpu-v5e*"),
+                        make_property_selector(partitionable=True),
+                    ]
+                ),
+            ]
+        )
+        create_tpu_params(
+            cluster,
+            "selective-tpu",
+            count=1,
+            selector=selector,
+            sharing=TpuSharing(
+                strategy=SharingStrategy.TIME_SLICING,
+                time_slicing_config=TimeSlicingConfig(TimeSliceInterval.LONG),
+            ),
+        )
+        create_template(cluster, "selective-template", "selective-tpu")
+        cluster.clientset.pods(NS).create(
+            make_pod(
+                "selective-pod",
+                [("tpu", {"resource_claim_template_name": "selective-template"})],
+            )
+        )
+        running = cluster.wait_for_pod_running(NS, "selective-pod")
+        (chip_uuid,) = chips_of(cluster, running)
+        node = cluster.node(running.spec.node_name)
+        assert node.tpulib.get_time_slice(chip_uuid) == 4  # Long quantum applied
+
+
+class TestTopologyClaim:
+    def test_contiguous_2x2_block(self, pcluster):
+        cluster = pcluster
+        create_tpu_params(
+            cluster,
+            "slice-2x2",
+            topology="2x2",
+            selector=make_property_selector(partitionable=True),
+        )
+        create_template(cluster, "topo-template", "slice-2x2")
+        cluster.clientset.pods(NS).create(
+            make_pod(
+                "topo-pod",
+                [("slice", {"resource_claim_template_name": "topo-template"})],
+            )
+        )
+        running = cluster.wait_for_pod_running(NS, "topo-pod")
+        nas = cluster.clientset.node_allocation_states("tpu-dra").get(
+            running.spec.node_name
+        )
+        claim = cluster.clientset.resource_claims(NS).get("topo-pod-slice")
+        allocated = nas.spec.allocated_claims[claim.metadata.uid].tpu
+        assert allocated.topology == "2x2x1"
+        coords = sorted(d.coord for d in allocated.devices)
+        assert coords == [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)]
+        # The CDI file advertises the claimed mesh to the container runtime.
+        node = cluster.node(running.spec.node_name)
+        spec_path = node.cdi._spec_path(claim.metadata.uid)
+        env = json.load(open(spec_path))["devices"][0]["containerEdits"]["env"]
+        assert "TPU_CHIPS_PER_HOST_BOUNDS=2,2,1" in env
+
+
+class TestLifecycle:
+    def test_delete_frees_chips(self, pcluster):
+        cluster = pcluster
+        create_tpu_params(
+            cluster,
+            "whole-host",
+            count=4,
+            selector=make_property_selector(partitionable=True),
+        )
+        create_template(cluster, "whole-host-template", "whole-host")
+        # Two whole-host pods on a 2-node cluster: both fit.
+        for name in ("big1", "big2"):
+            cluster.clientset.pods(NS).create(
+                make_pod(
+                    name,
+                    [("tpu", {"resource_claim_template_name": "whole-host-template"})],
+                )
+            )
+        cluster.wait_for_pod_running(NS, "big1")
+        cluster.wait_for_pod_running(NS, "big2")
+
+        # Third doesn't fit anywhere...
+        cluster.clientset.pods(NS).create(
+            make_pod(
+                "big3",
+                [("tpu", {"resource_claim_template_name": "whole-host-template"})],
+            )
+        )
+        with pytest.raises(TimeoutError):
+            cluster.wait_for_pod_running(NS, "big3", timeout=1.0)
+
+        # ...until one of the first two is deleted.
+        cluster.delete_pod(NS, "big1")
+        cluster.wait_for_pod_running(NS, "big3", timeout=15)
+
+    def test_deletion_unprepares_on_node(self, cluster):
+        create_tpu_params(cluster, "one-tpu", count=1)
+        create_template(cluster, "one-tpu-template", "one-tpu")
+        cluster.clientset.pods(NS).create(
+            make_pod(
+                "transient",
+                [("tpu", {"resource_claim_template_name": "one-tpu-template"})],
+            )
+        )
+        running = cluster.wait_for_pod_running(NS, "transient")
+        node = cluster.node(running.spec.node_name)
+        claim = cluster.clientset.resource_claims(NS).get("transient-tpu")
+        uid = claim.metadata.uid
+        assert node.cdi.claim_spec_exists(uid)
+
+        cluster.delete_pod(NS, "transient")
+        import time
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and node.cdi.claim_spec_exists(uid):
+            time.sleep(0.05)
+        assert not node.cdi.claim_spec_exists(uid)
+        nas = cluster.clientset.node_allocation_states("tpu-dra").get(node.name)
+        assert uid not in nas.spec.allocated_claims
+        assert uid not in nas.spec.prepared_claims

@@ -1,0 +1,132 @@
+"""gRPC kubelet-plugin layer tests: wire codec + live unix-socket servers."""
+
+import pytest
+
+from helpers import make_plugin_stack
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.nas_v1alpha1 import (
+    AllocatedDevices,
+    AllocatedTpu,
+    AllocatedTpus,
+    ClaimInfo,
+    NodeAllocationState,
+)
+from tpu_dra.client import ClientSet, FakeApiServer, NasClient
+from tpu_dra.plugin import wire
+from tpu_dra.plugin.driver import NodeDriver
+from tpu_dra.plugin.kubeletplugin import (
+    DRAClient,
+    DRAPluginServer,
+    RegistrationClient,
+)
+
+NS = "tpu-dra"
+
+
+class TestWireCodec:
+    def test_prepare_request_roundtrip(self):
+        req = wire.NodePrepareResourceRequest(
+            namespace="default",
+            claim_uid="uid-123",
+            claim_name="my-claim",
+            resource_handle="h",
+        )
+        decoded = wire.NodePrepareResourceRequest.decode(req.encode())
+        assert decoded.namespace == "default"
+        assert decoded.claim_uid == "uid-123"
+        assert decoded.claim_name == "my-claim"
+        assert decoded.resource_handle == "h"
+
+    def test_repeated_strings(self):
+        resp = wire.NodePrepareResourceResponse(
+            cdi_devices=["vendor/class=a", "vendor/class=b"]
+        )
+        decoded = wire.NodePrepareResourceResponse.decode(resp.encode())
+        assert decoded.cdi_devices == ["vendor/class=a", "vendor/class=b"]
+
+    def test_bool_field(self):
+        status = wire.RegistrationStatus(plugin_registered=True, error="")
+        decoded = wire.RegistrationStatus.decode(status.encode())
+        assert decoded.plugin_registered is True
+        status2 = wire.RegistrationStatus(plugin_registered=False, error="boom")
+        decoded2 = wire.RegistrationStatus.decode(status2.encode())
+        assert decoded2.plugin_registered is False and decoded2.error == "boom"
+
+    def test_empty_message(self):
+        assert wire.InfoRequest().encode() == b""
+        wire.NodeUnprepareResourceResponse.decode(b"")
+
+    def test_unknown_fields_skipped(self):
+        # Field 9 (unknown, string) followed by field 2 (claim_uid).
+        payload = (
+            bytes([9 << 3 | 2, 3]) + b"xyz" + bytes([2 << 3 | 2, 2]) + b"ab"
+        )
+        decoded = wire.NodePrepareResourceRequest.decode(payload)
+        assert decoded.claim_uid == "ab"
+
+    def test_long_string_varint_length(self):
+        long = "x" * 300
+        req = wire.NodePrepareResourceRequest(namespace=long)
+        assert wire.NodePrepareResourceRequest.decode(req.encode()).namespace == long
+
+
+@pytest.fixture
+def served(tmp_path):
+    cs = ClientSet(FakeApiServer())
+    _, _, state = make_plugin_stack(tmp_path, cs)
+    nas = NodeAllocationState(metadata=ObjectMeta(name="node-1", namespace=NS))
+    driver = NodeDriver(nas, NasClient(nas, cs), state, start_gc=False)
+    server = DRAPluginServer(
+        driver,
+        "tpu.resource.google.com",
+        plugin_socket=str(tmp_path / "plugin.sock"),
+        registrar_socket=str(tmp_path / "reg.sock"),
+    )
+    server.start()
+    yield cs, server, tmp_path
+    server.stop()
+
+
+class TestLiveServers:
+    def test_registration_flow(self, served):
+        _, server, tmp_path = served
+        client = RegistrationClient(str(tmp_path / "reg.sock"))
+        info = client.get_info()
+        assert info.type == "DRAPlugin"
+        assert info.name == "tpu.resource.google.com"
+        assert info.supported_versions == ["1.0.0"]
+        assert info.endpoint.endswith("plugin.sock")
+        client.notify(True)
+        assert server.registration_error == ""
+        client.notify(False, "kubelet says no")
+        assert server.registration_error == "kubelet says no"
+        client.close()
+
+    def test_prepare_over_socket(self, served):
+        cs, _, tmp_path = served
+        nasc = cs.node_allocation_states(NS)
+        nas = nasc.get("node-1")
+        nas.spec.allocated_claims["uid-g"] = AllocatedDevices(
+            claim_info=ClaimInfo(namespace="default", name="c", uid="uid-g"),
+            tpu=AllocatedTpus(devices=[AllocatedTpu(uuid="mock-tpu-0")]),
+        )
+        nasc.update(nas)
+
+        client = DRAClient(str(tmp_path / "plugin.sock"))
+        devices = client.node_prepare_resource("default", "uid-g", "c")
+        assert devices == ["tpu.resource.google.com/claim=uid-g"]
+        # Unprepare RPC is a no-op by design.
+        client.node_unprepare_resource("default", "uid-g")
+        assert "uid-g" in nasc.get("node-1").spec.prepared_claims
+        client.close()
+
+    def test_prepare_error_propagates(self, served):
+        _, _, tmp_path = served
+        import grpc
+
+        client = DRAClient(str(tmp_path / "plugin.sock"))
+        with pytest.raises(grpc.RpcError) as exc_info:
+            client.node_prepare_resource("default", "ghost-uid")
+        assert exc_info.value.code() == grpc.StatusCode.INTERNAL
+        assert "no allocation" in exc_info.value.details()
+        client.close()

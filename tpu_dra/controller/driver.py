@@ -1,0 +1,267 @@
+"""Controller driver dispatch (component C2; reference:
+cmd/nvidia-dra-controller/driver.go:41-341).
+
+Implements the reconciler's Driver interface: parameter fetch + defaulting +
+validation, per-node-locked Allocate/Deallocate writing the NAS, and the
+UnsuitableNodes fan-out.  Dispatch is per claim-parameter kind — whole-chip
+claims route to TpuDriver, subslice claims to SubsliceDriver — and within a
+node the whole-chip kind is processed before subslices (driver.go:284-296)
+so parent-claim affinity can see the pod's freshly-placed chips.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_dra.api import k8s, nas_v1alpha1 as nascrd, serde, tpu_v1alpha1 as tpucrd
+from tpu_dra.api.k8s import (
+    AllocationResult,
+    Pod,
+    ResourceClaim,
+    ResourceClass,
+    build_allocation_result,
+    get_selected_node,
+)
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.client.clientset import ClientSet
+from tpu_dra.client.nasclient import NasClient
+from tpu_dra.controller.nodelock import PerNodeMutex
+from tpu_dra.controller.subslice_allocator import SubsliceDriver
+from tpu_dra.controller.tpu_allocator import TpuDriver
+from tpu_dra.controller.types import ClaimAllocation
+
+DRIVER_NAME = tpucrd.GROUP_NAME
+DRIVER_API_GROUP = tpucrd.GROUP_NAME
+
+
+class ControllerDriver:
+    def __init__(self, clientset: ClientSet, namespace: str = "tpu-dra"):
+        self.lock = PerNodeMutex()
+        self.namespace = namespace
+        self.clientset = clientset
+        self.tpu = TpuDriver()
+        self.subslice = SubsliceDriver()
+
+    # -- parameter resolution (driver.go:61-107) -----------------------------
+
+    def get_class_parameters(self, resource_class: ResourceClass) -> Any:
+        ref = resource_class.parameters_ref
+        if ref is None:
+            return tpucrd.default_device_class_parameters_spec(None)
+        if ref.api_group != DRIVER_API_GROUP:
+            raise ValueError(f"incorrect API group: {ref.api_group}")
+        dc = self.clientset.device_class_parameters().get(ref.name)
+        return tpucrd.default_device_class_parameters_spec(dc.spec)
+
+    def get_claim_parameters(
+        self, claim: ResourceClaim, resource_class: ResourceClass, class_params: Any
+    ) -> Any:
+        ref = claim.spec.parameters_ref
+        if ref is None:
+            return tpucrd.default_tpu_claim_parameters_spec(None)
+        if ref.api_group != DRIVER_API_GROUP:
+            raise ValueError(f"incorrect API group: {ref.api_group}")
+        namespace = claim.metadata.namespace
+        if ref.kind == tpucrd.TPU_CLAIM_PARAMETERS_KIND:
+            tc = self.clientset.tpu_claim_parameters(namespace).get(ref.name)
+            params = tpucrd.default_tpu_claim_parameters_spec(tc.spec)
+            self.tpu.validate_claim_parameters(params)
+            return params
+        if ref.kind == tpucrd.SUBSLICE_CLAIM_PARAMETERS_KIND:
+            sc = self.clientset.subslice_claim_parameters(namespace).get(ref.name)
+            params = tpucrd.default_subslice_claim_parameters_spec(sc.spec)
+            self.subslice.validate_claim_parameters(params)
+            return params
+        raise ValueError(f"unknown ResourceClaim.ParametersRef.Kind: {ref.kind}")
+
+    # -- allocate / deallocate (driver.go:109-226) ---------------------------
+
+    def _nas_client(self, node: str) -> tuple[nascrd.NodeAllocationState, NasClient]:
+        nas = nascrd.NodeAllocationState(
+            metadata=ObjectMeta(name=node, namespace=self.namespace)
+        )
+        return nas, NasClient(nas, self.clientset)
+
+    def allocate(
+        self,
+        claim: ResourceClaim,
+        claim_params: Any,
+        resource_class: ResourceClass,
+        class_params: tpucrd.DeviceClassParametersSpec,
+        selected_node: str,
+    ) -> AllocationResult:
+        if not selected_node:
+            raise NotImplementedError("immediate allocations not yet supported")
+
+        with self.lock.locked(selected_node):
+            nas, client = self._nas_client(selected_node)
+            client.get()
+
+            claim_uid = claim.metadata.uid
+            if claim_uid in nas.spec.allocated_claims:
+                # Idempotent retry (e.g. claim-status write lost a conflict
+                # after the NAS commit): report the class's real shareability
+                # — the reference hardcodes true here (driver.go:134), which
+                # would advertise an exclusive claim as shareable.
+                return build_allocation_result(
+                    selected_node, bool(class_params.shareable)
+                )
+
+            if nas.status != nascrd.STATUS_READY:
+                raise RuntimeError(f"NodeAllocationState status: {nas.status}")
+
+            if isinstance(claim_params, tpucrd.TpuClaimParametersSpec):
+                on_success = self.tpu.allocate(
+                    nas, claim, claim_params, class_params, selected_node
+                )
+            elif isinstance(claim_params, tpucrd.SubsliceClaimParametersSpec):
+                on_success = self.subslice.allocate(
+                    nas, claim, claim_params, class_params, selected_node
+                )
+            else:
+                raise ValueError(
+                    f"unknown claim parameters type: {type(claim_params).__name__}"
+                )
+
+            allocated = nas.spec.allocated_claims[claim_uid]
+            allocated.claim_info = nascrd.ClaimInfo(
+                namespace=claim.metadata.namespace,
+                name=claim.metadata.name,
+                uid=claim_uid,
+            )
+            client.update(nas.spec)
+            on_success()
+            return build_allocation_result(selected_node, bool(class_params.shareable))
+
+    def deallocate(self, claim: ResourceClaim) -> None:
+        # Drop any pending (uncommitted) allocation regardless of NAS state —
+        # the claim may never have reached the NAS, or may have been
+        # re-cached by a concurrent scheduling pass.
+        self.tpu.pending_allocated_claims.remove(claim.metadata.uid)
+        self.subslice.pending_allocated_claims.remove(claim.metadata.uid)
+        selected_node = get_selected_node(claim)
+        if not selected_node:
+            return
+        with self.lock.locked(selected_node):
+            nas, client = self._nas_client(selected_node)
+            client.get()
+            claim_uid = claim.metadata.uid
+            allocated = nas.spec.allocated_claims.get(claim_uid)
+            if allocated is None:
+                return
+            if allocated.type() == nascrd.TPU_DEVICE_TYPE:
+                self.tpu.deallocate(nas, claim)
+            elif allocated.type() == nascrd.SUBSLICE_DEVICE_TYPE:
+                self.subslice.deallocate(nas, claim)
+            else:
+                raise ValueError(f"unknown AllocatedDevices type: {allocated.type()}")
+            del nas.spec.allocated_claims[claim_uid]
+            client.update(nas.spec)
+
+    # -- scheduling fan-out (driver.go:228-298) ------------------------------
+
+    def unsuitable_nodes(
+        self, pod: Pod, cas: list[ClaimAllocation], potential_nodes: list[str]
+    ) -> None:
+        # Claim liveness is node-independent: resolve the dead pending set
+        # once per fan-out, outside the per-node locks, then drop the dead
+        # entries cheaply inside each node's pass.
+        dead = self._dead_pending_claims(potential_nodes)
+        for node in potential_nodes:
+            self._unsuitable_node(pod, cas, node, dead)
+        for ca in cas:
+            seen = set()
+            ca.unsuitable_nodes = [
+                n for n in ca.unsuitable_nodes if not (n in seen or seen.add(n))
+            ]
+
+    def _dead_pending_claims(self, nodes: list[str]) -> set[str]:
+        """Pending-cache claim UIDs whose claim no longer exists.
+
+        A claim deleted between UnsuitableNodes and Allocate can leave (or,
+        racing with Deallocate, re-create) a pending entry that is promoted
+        into every availability computation and permanently reserves phantom
+        capacity — the reference shares this leak (SURVEY.md §7 hard-part
+        (b)).  Each scheduling fan-out validates liveness via the claim_info
+        recorded in the entries (one GET per distinct claim, outside the node
+        locks), so any leak heals on the next pass.
+        """
+        from tpu_dra.client.apiserver import NotFoundError
+
+        infos: dict[str, nascrd.ClaimInfo] = {}
+        for subdriver in (self.tpu, self.subslice):
+            for node in nodes:
+                subdriver.pending_allocated_claims.visit_node(
+                    node,
+                    lambda uid, allocation: infos.setdefault(
+                        uid, allocation.claim_info
+                    ),
+                )
+        dead: set[str] = set()
+        for uid, info in infos.items():
+            if info is None or not info.namespace:
+                continue
+            try:
+                claim = self.clientset.resource_claims(info.namespace).get(info.name)
+            except NotFoundError:
+                dead.add(uid)
+                continue
+            if claim.metadata.uid != uid or claim.metadata.deletion_timestamp:
+                dead.add(uid)
+        return dead
+
+    def _unsuitable_node(
+        self,
+        pod: Pod,
+        allcas: list[ClaimAllocation],
+        potential_node: str,
+        dead_pending: set[str] | None = None,
+    ) -> None:
+        from tpu_dra.client.apiserver import ApiError
+
+        with self.lock.locked(potential_node):
+            nas, client = self._nas_client(potential_node)
+            try:
+                client.get()
+            except ApiError:
+                for ca in allcas:
+                    ca.unsuitable_nodes.append(potential_node)
+                return
+            if nas.status != nascrd.STATUS_READY:
+                for ca in allcas:
+                    ca.unsuitable_nodes.append(potential_node)
+                return
+
+            for uid in dead_pending or ():
+                self.tpu.pending_allocated_claims.remove_node(uid, potential_node)
+                self.subslice.pending_allocated_claims.remove_node(
+                    uid, potential_node
+                )
+
+            per_kind: dict[str, list[ClaimAllocation]] = {
+                tpucrd.TPU_CLAIM_PARAMETERS_KIND: [],
+                tpucrd.SUBSLICE_CLAIM_PARAMETERS_KIND: [],
+            }
+            for ca in allcas:
+                if isinstance(ca.claim_parameters, tpucrd.TpuClaimParametersSpec):
+                    per_kind[tpucrd.TPU_CLAIM_PARAMETERS_KIND].append(ca)
+                elif isinstance(
+                    ca.claim_parameters, tpucrd.SubsliceClaimParametersSpec
+                ):
+                    per_kind[tpucrd.SUBSLICE_CLAIM_PARAMETERS_KIND].append(ca)
+                else:
+                    raise ValueError(
+                        f"invalid claim parameters type: "
+                        f"{type(ca.claim_parameters).__name__}"
+                    )
+
+            # Whole-chip claims before subslices: affinity resolution
+            # depends on parents being placed first (driver.go:284-296).
+            self.tpu.unsuitable_node(
+                nas, pod, per_kind[tpucrd.TPU_CLAIM_PARAMETERS_KIND], allcas,
+                potential_node,
+            )
+            self.subslice.unsuitable_node(
+                nas, pod, per_kind[tpucrd.SUBSLICE_CLAIM_PARAMETERS_KIND], allcas,
+                potential_node,
+            )

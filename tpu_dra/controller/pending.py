@@ -12,17 +12,28 @@ NAS documents under concurrent workers.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable
 
 from tpu_dra.api import serde
 from tpu_dra.api.nas_v1alpha1 import AllocatedDevices
 
+# A pending entry normally promotes into the NAS within one scheduling
+# round-trip (seconds).  Entries that linger far longer belong to claims
+# that died mid-negotiation (e.g. pod deleted between UnsuitableNodes and
+# Allocate) and would otherwise reserve phantom capacity forever — the
+# reference has exactly this leak (SURVEY.md §7 hard-part (b)).
+DEFAULT_PENDING_TTL_S = 300.0
+
 
 class PerNodeAllocatedClaims:
-    def __init__(self):
+    def __init__(self, ttl_s: float = DEFAULT_PENDING_TTL_S):
         self._lock = threading.Lock()
+        self._ttl_s = ttl_s
         # claimUID -> node -> AllocatedDevices
         self._allocations: dict[str, dict[str, AllocatedDevices]] = {}
+        # claimUID -> monotonic time of last set()
+        self._stamped: dict[str, float] = {}
 
     def exists(self, claim_uid: str, node: str) -> bool:
         with self._lock:
@@ -38,11 +49,21 @@ class PerNodeAllocatedClaims:
             self._allocations.setdefault(claim_uid, {})[node] = serde.deepcopy(
                 devices
             )
+            self._stamped[claim_uid] = time.monotonic()
 
     def visit_node(
         self, node: str, visitor: Callable[[str, AllocatedDevices], None]
     ) -> None:
         with self._lock:
+            now = time.monotonic()
+            expired = [
+                uid
+                for uid, stamp in self._stamped.items()
+                if now - stamp > self._ttl_s
+            ]
+            for uid in expired:
+                self._allocations.pop(uid, None)
+                self._stamped.pop(uid, None)
             snapshot = [
                 (uid, serde.deepcopy(nodes[node]))
                 for uid, nodes in self._allocations.items()
@@ -54,7 +75,11 @@ class PerNodeAllocatedClaims:
     def remove_node(self, claim_uid: str, node: str) -> None:
         with self._lock:
             self._allocations.get(claim_uid, {}).pop(node, None)
+            if not self._allocations.get(claim_uid):
+                self._allocations.pop(claim_uid, None)
+                self._stamped.pop(claim_uid, None)
 
     def remove(self, claim_uid: str) -> None:
         with self._lock:
             self._allocations.pop(claim_uid, None)
+            self._stamped.pop(claim_uid, None)

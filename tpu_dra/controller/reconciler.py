@@ -1,0 +1,461 @@
+"""DRA controller reconcile loop (component C22; reference:
+vendor/k8s.io/dynamic-resource-allocation/controller/controller.go:55-813).
+
+Watch-driven workqueue over ResourceClaims and PodSchedulingContexts with the
+upstream loop's semantics:
+
+- ``sync_claim`` (controller.go:405-506): in-use claims are left alone;
+  deleting/deallocation-requested claims are deallocated and their finalizer
+  removed; Immediate-mode claims are allocated without a pod.
+- ``sync_pod_scheduling_context`` (controller.go:606-735): resolve the pod's
+  pending claims (template-instantiated names, WaitForFirstConsumer only,
+  this driver only), compute UnsuitableNodes *before* allocating, allocate
+  every claim when the scheduler picked a suitable node (finalizer first,
+  then driver.Allocate, then claim status + reservedFor), and publish
+  per-claim unsuitable nodes into the scheduling context status.
+- Periodic requeue of scheduling contexts every ``recheck_period_s``
+  (the upstream errPeriodic/30s recheck, controller.go:148) and exponential
+  backoff requeue on sync errors.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+import time
+from typing import Any
+
+from tpu_dra.api import tpu_v1alpha1 as tpucrd
+from tpu_dra.api.k8s import (
+    ALLOCATION_MODE_IMMEDIATE,
+    ALLOCATION_MODE_WAIT_FOR_FIRST_CONSUMER,
+    Pod,
+    PodResourceClaim,
+    PodSchedulingContext,
+    ResourceClaim,
+    ResourceClaimConsumerReference,
+    ResourceClaimSchedulingStatus,
+)
+from tpu_dra.client.apiserver import ApiError, ConflictError, NotFoundError
+from tpu_dra.client.clientset import ClientSet
+from tpu_dra.controller.driver import ControllerDriver
+from tpu_dra.controller.types import ClaimAllocation
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_WORKERS = 10  # reference default: cmd/nvidia-dra-controller/main.go:79
+DEFAULT_RECHECK_PERIOD_S = 30.0  # vendored controller.go:148
+ERROR_BACKOFF_BASE_S = 0.1
+ERROR_BACKOFF_CAP_S = 5.0
+
+FINALIZER = f"{tpucrd.GROUP_NAME}/deletion-protection"
+
+
+def resource_claim_name(pod: Pod, pod_claim: PodResourceClaim) -> str:
+    """Claim name for a pod's claim entry (k8s resourceclaim.Name analog):
+    an explicit claim name, or the template-instantiated "<pod>-<entry>"."""
+    if pod_claim.source.resource_claim_name:
+        return pod_claim.source.resource_claim_name
+    return f"{pod.metadata.name}-{pod_claim.name}"
+
+
+class _DelayQueue:
+    """A tiny delaying workqueue with upstream-workqueue semantics:
+
+    - per-key dedup where the *earliest* deadline wins (an immediate add
+      must not be absorbed into a pending slow recheck),
+    - single-flight per key: a key being processed is not handed out again
+      until ``done()``; adds arriving meanwhile are deferred and re-enqueued
+      at ``done()`` time.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._heap: list[tuple[float, tuple]] = []
+        self._deadline: dict[tuple, float] = {}
+        self._processing: set[tuple] = set()
+        self._deferred: dict[tuple, float] = {}
+        self._closed = False
+
+    def add(self, key: tuple, delay: float = 0.0) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            when = time.monotonic() + delay
+            if key in self._processing:
+                # Defer until the in-flight sync finishes (single-flight).
+                prev = self._deferred.get(key)
+                if prev is None or when < prev:
+                    self._deferred[key] = when
+                return
+            prev = self._deadline.get(key)
+            if prev is not None and prev <= when:
+                return  # already queued sooner (or equally soon)
+            # Earlier deadline wins; the stale heap entry is skipped lazily.
+            self._deadline[key] = when
+            heapq.heappush(self._heap, (when, key))
+            self._cond.notify()
+
+    def get(self, timeout: float = 0.2) -> tuple | None:
+        with self._cond:
+            deadline = time.monotonic() + timeout
+            while not self._closed:
+                now = time.monotonic()
+                while self._heap and self._heap[0][0] <= now:
+                    when, key = heapq.heappop(self._heap)
+                    if self._deadline.get(key) != when:
+                        continue  # stale entry superseded by an earlier add
+                    del self._deadline[key]
+                    self._processing.add(key)
+                    return key
+                wait = min(
+                    self._heap[0][0] - now if self._heap else timeout,
+                    deadline - now,
+                )
+                if wait <= 0:
+                    return None
+                self._cond.wait(wait)
+            return None
+
+    def done(self, key: tuple) -> None:
+        """Mark a key's sync finished, releasing deferred re-adds."""
+        with self._cond:
+            self._processing.discard(key)
+            when = self._deferred.pop(key, None)
+            if when is not None and not self._closed:
+                prev = self._deadline.get(key)
+                if prev is None or when < prev:
+                    self._deadline[key] = when
+                    heapq.heappush(self._heap, (when, key))
+                    self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+class Controller:
+    """The reconcile loop driving a ControllerDriver."""
+
+    def __init__(
+        self,
+        driver: ControllerDriver,
+        clientset: ClientSet,
+        *,
+        workers: int = DEFAULT_WORKERS,
+        recheck_period_s: float = DEFAULT_RECHECK_PERIOD_S,
+        error_backoff_base_s: float = ERROR_BACKOFF_BASE_S,
+    ):
+        self.driver = driver
+        self.clientset = clientset
+        self.workers = workers
+        self.recheck_period_s = recheck_period_s
+        self.error_backoff_base_s = error_backoff_base_s
+        self._queue = _DelayQueue()
+        self._retries: dict[tuple, int] = {}
+        self._threads: list[threading.Thread] = []
+        self._watches = []
+        self._stop = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        for kind in ("ResourceClaim", "PodSchedulingContext"):
+            watch = self.clientset.server.watch(kind)
+            self._watches.append(watch)
+            t = threading.Thread(
+                target=self._watch_loop, args=(kind, watch), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        # Prime the queue with existing objects (informer initial list).
+        for claim in self.clientset.resource_claims("").list_all_namespaces():
+            self._enqueue("ResourceClaim", claim.metadata)
+        for sc in self.clientset.pod_scheduling_contexts("").list_all_namespaces():
+            self._enqueue("PodSchedulingContext", sc.metadata)
+        for i in range(self.workers):
+            t = threading.Thread(
+                target=self._worker_loop, name=f"controller-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._queue.close()
+        for watch in self._watches:
+            watch.stop()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _watch_loop(self, kind: str, watch) -> None:
+        for event in watch:
+            meta = event["object"].get("metadata", {})
+            key = (kind, meta.get("namespace", ""), meta.get("name", ""))
+            self._queue.add(key)
+
+    def _enqueue(self, kind: str, metadata, delay: float = 0.0) -> None:
+        self._queue.add((kind, metadata.namespace, metadata.name), delay)
+
+    # -- workers -------------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            key = self._queue.get(timeout=0.2)
+            if key is None:
+                continue
+            try:
+                requeue_delay = self._sync_key(key)
+            except ConflictError:
+                # Optimistic-concurrency loser: retry promptly.
+                self._retry(key, immediate=True)
+            except ApiError as e:
+                logger.warning("sync %s failed: %s", key, e)
+                self._retry(key)
+            except Exception:
+                logger.exception("sync %s failed", key)
+                self._retry(key)
+            else:
+                self._retries.pop(key, None)
+                if requeue_delay is not None:
+                    self._queue.add(key, requeue_delay)
+            finally:
+                self._queue.done(key)
+
+    def _retry(self, key: tuple, immediate: bool = False) -> None:
+        attempts = self._retries.get(key, 0) + 1
+        self._retries[key] = attempts
+        delay = (
+            0.0
+            if immediate
+            else min(
+                self.error_backoff_base_s * (2 ** (attempts - 1)),
+                ERROR_BACKOFF_CAP_S,
+            )
+        )
+        self._queue.add(key, delay)
+
+    def _sync_key(self, key: tuple) -> float | None:
+        """Returns a requeue delay (errPeriodic analog) or None."""
+        kind, namespace, name = key
+        if kind == "ResourceClaim":
+            try:
+                claim = self.clientset.resource_claims(namespace).get(name)
+            except NotFoundError:
+                return None
+            return self._sync_claim(claim)
+        if kind == "PodSchedulingContext":
+            try:
+                sc = self.clientset.pod_scheduling_contexts(namespace).get(name)
+            except NotFoundError:
+                return None
+            return self._sync_pod_scheduling_context(sc)
+        return None
+
+    # -- claim lifecycle (controller.go:405-506) -----------------------------
+
+    def _sync_claim(self, claim: ResourceClaim) -> float | None:
+        if claim.status.reserved_for:
+            return None  # in use
+
+        if claim.metadata.deletion_timestamp or claim.status.deallocation_requested:
+            if FINALIZER in claim.metadata.finalizers:
+                if claim.status.allocation is not None:
+                    self.driver.deallocate(claim)
+                    claim.status.allocation = None
+                    claim.status.driver_name = ""
+                    claim.status.deallocation_requested = False
+                    claim = self.clientset.resource_claims(
+                        claim.metadata.namespace
+                    ).update_status(claim)
+                else:
+                    self.driver.deallocate(claim)
+                if claim.status.deallocation_requested:
+                    claim.status.deallocation_requested = False
+                    claim = self.clientset.resource_claims(
+                        claim.metadata.namespace
+                    ).update_status(claim)
+                claim.metadata.finalizers = [
+                    f for f in claim.metadata.finalizers if f != FINALIZER
+                ]
+                self.clientset.resource_claims(claim.metadata.namespace).update(claim)
+            return None
+
+        if claim.status.allocation is not None:
+            return None
+        if claim.spec.allocation_mode != ALLOCATION_MODE_IMMEDIATE:
+            return None  # waiting for first consumer
+
+        resource_class = self.clientset.resource_classes().get(
+            claim.spec.resource_class_name
+        )
+        if resource_class.driver_name != self.driver_name:
+            return self.recheck_period_s  # not ours at the moment; requeue
+        class_params = self.driver.get_class_parameters(resource_class)
+        claim_params = self.driver.get_claim_parameters(
+            claim, resource_class, class_params
+        )
+        self._allocate_claim(
+            claim, claim_params, resource_class, class_params, "", None
+        )
+        return None
+
+    @property
+    def driver_name(self) -> str:
+        from tpu_dra.controller.driver import DRIVER_NAME
+
+        return DRIVER_NAME
+
+    def _allocate_claim(
+        self,
+        claim: ResourceClaim,
+        claim_params: Any,
+        resource_class,
+        class_params: Any,
+        selected_node: str,
+        selected_user: ResourceClaimConsumerReference | None,
+    ) -> None:
+        """controller.go:520-566: finalizer first, then allocate, then
+        publish allocation + reservedFor in claim status."""
+        if claim.status.allocation is not None:
+            return
+        claims_client = self.clientset.resource_claims(claim.metadata.namespace)
+        if FINALIZER not in claim.metadata.finalizers:
+            claim.metadata.finalizers.append(FINALIZER)
+            claim = claims_client.update(claim)
+        allocation = self.driver.allocate(
+            claim, claim_params, resource_class, class_params, selected_node
+        )
+        claim.status.allocation = allocation
+        claim.status.driver_name = self.driver_name
+        if selected_user is not None:
+            claim.status.reserved_for.append(selected_user)
+        claims_client.update_status(claim)
+
+    # -- pod scheduling negotiation (controller.go:568-735) ------------------
+
+    def _check_pod_claim(
+        self, pod: Pod, pod_claim: PodResourceClaim
+    ) -> ClaimAllocation | None:
+        namespace = pod.metadata.namespace
+        claim_name = resource_claim_name(pod, pod_claim)
+        try:
+            claim = self.clientset.resource_claims(namespace).get(claim_name)
+        except NotFoundError:
+            return None
+        if claim.metadata.deletion_timestamp:
+            # A deleting claim must not be tentatively re-allocated: the
+            # allocation would land in the pending cache *after* Deallocate
+            # already cleared it, permanently leaking phantom capacity.
+            return None
+        if pod_claim.source.resource_claim_template_name:
+            # Template-instantiated claims must belong to this pod
+            # (resourceclaim.IsForPod analog).
+            owners = {o.uid for o in claim.metadata.owner_references}
+            if owners and pod.metadata.uid not in owners:
+                raise ValueError(
+                    f"claim {claim_name} was not created for pod "
+                    f"{pod.metadata.name}"
+                )
+        if claim.spec.allocation_mode != ALLOCATION_MODE_WAIT_FOR_FIRST_CONSUMER:
+            return None
+        if claim.status.allocation is not None:
+            # Already allocated: no tentative placement needed.  The upstream
+            # loop includes allocated claims in UnsuitableNodes fan-out, which
+            # makes every recheck re-place the running claim on *other* nodes
+            # and re-inject phantom pending-cache entries that reserve real
+            # capacity (reference: checkPodClaim lacks this check,
+            # controller.go:568-604 + gpu.go:68-112).
+            return None
+        try:
+            resource_class = self.clientset.resource_classes().get(
+                claim.spec.resource_class_name
+            )
+        except NotFoundError:
+            return None
+        if resource_class.driver_name != self.driver_name:
+            return None
+        class_params = self.driver.get_class_parameters(resource_class)
+        claim_params = self.driver.get_claim_parameters(
+            claim, resource_class, class_params
+        )
+        return ClaimAllocation(
+            claim=claim,
+            class_=resource_class,
+            claim_parameters=claim_params,
+            class_parameters=class_params,
+            pod_claim_name=pod_claim.name,
+        )
+
+    def _sync_pod_scheduling_context(
+        self, sc: PodSchedulingContext
+    ) -> float | None:
+        if sc.metadata.deletion_timestamp:
+            return None
+        if not sc.spec.selected_node and not sc.spec.potential_nodes:
+            return None  # waiting for the scheduler
+
+        try:
+            pod = self.clientset.pods(sc.metadata.namespace).get(sc.metadata.name)
+        except NotFoundError:
+            return None
+        if pod.metadata.deletion_timestamp:
+            return None
+        owners = {o.uid for o in sc.metadata.owner_references}
+        if owners and pod.metadata.uid not in owners:
+            return None  # obsolete object
+
+        claims: list[ClaimAllocation] = []
+        for pod_claim in pod.spec.resource_claims:
+            ca = self._check_pod_claim(pod, pod_claim)
+            if ca is not None:
+                claims.append(ca)
+        if not claims:
+            return self.recheck_period_s
+
+        if sc.spec.potential_nodes:
+            self.driver.unsuitable_nodes(pod, claims, sc.spec.potential_nodes)
+
+        selected_node = sc.spec.selected_node
+        if selected_node:
+            unsuitable = any(
+                selected_node in ca.unsuitable_nodes for ca in claims
+            )
+            if not unsuitable:
+                selected_user = ResourceClaimConsumerReference(
+                    resource="pods", name=pod.metadata.name, uid=pod.metadata.uid
+                )
+                for ca in claims:
+                    self._allocate_claim(
+                        ca.claim,
+                        ca.claim_parameters,
+                        ca.class_,
+                        ca.class_parameters,
+                        selected_node,
+                        selected_user,
+                    )
+
+        # Publish unsuitable nodes (controller.go:703-729).
+        modified = False
+        existing = {entry.name: entry for entry in sc.status.resource_claims}
+        for ca in claims:
+            name = ca.pod_claim_name or ca.claim.metadata.name
+            entry = existing.get(name)
+            if entry is None:
+                sc.status.resource_claims.append(
+                    ResourceClaimSchedulingStatus(
+                        name=name, unsuitable_nodes=list(ca.unsuitable_nodes)
+                    )
+                )
+                modified = True
+            elif entry.unsuitable_nodes != ca.unsuitable_nodes:
+                entry.unsuitable_nodes = list(ca.unsuitable_nodes)
+                modified = True
+        if modified:
+            self.clientset.pod_scheduling_contexts(
+                sc.metadata.namespace
+            ).update_status(sc)
+
+        return self.recheck_period_s

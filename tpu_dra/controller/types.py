@@ -19,6 +19,8 @@ class ClaimAllocation:
     class_: ResourceClass
     claim_parameters: Any = None
     class_parameters: Any = None
+    # The pod-local claim entry name (PodClaimName upstream).
+    pod_claim_name: str = ""
     unsuitable_nodes: list[str] = field(default_factory=list)
     # Filled by Allocate on success:
     allocation: AllocationResult | None = None

@@ -1,0 +1,244 @@
+"""Kubelet plugin gRPC servers (component C23; reference:
+vendor/k8s.io/dynamic-resource-allocation/kubeletplugin/{draplugin.go:150-236,
+registrationserver.go,nonblockinggrpcserver.go:57-151}).
+
+Two gRPC servers over unix sockets, exactly as the kubelet expects:
+
+- the **registration server** on
+  ``/var/lib/kubelet/plugins_registry/<driver>-reg.sock`` serving
+  ``pluginregistration.Registration`` (GetInfo/NotifyRegistrationStatus),
+- the **DRA node server** on
+  ``/var/lib/kubelet/plugins/<driver>/plugin.sock`` serving
+  ``v1alpha2.Node`` (NodePrepareResource/NodeUnprepareResource).
+
+Serialization uses the hand-rolled wire codec (wire.py) so no generated
+stubs are required; service/method names on the wire match the upstream
+protos byte for byte.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from concurrent import futures
+
+import grpc
+
+from tpu_dra.plugin import wire
+from tpu_dra.plugin.driver import NodeDriver
+
+logger = logging.getLogger(__name__)
+
+DRA_SERVICE = "v1alpha2.Node"
+REGISTRATION_SERVICE = "pluginregistration.Registration"
+DRA_VERSION = "1.0.0"
+
+
+def _unary(handler, request_cls, response_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        handler,
+        request_deserializer=request_cls.decode,
+        response_serializer=lambda msg: msg.encode(),
+    )
+
+
+class DRAPluginServer:
+    """Owns both gRPC servers and routes DRA RPCs to the NodeDriver."""
+
+    def __init__(
+        self,
+        driver: NodeDriver,
+        driver_name: str,
+        *,
+        plugin_socket: str,
+        registrar_socket: str,
+        kubelet_plugin_socket: str | None = None,
+        max_workers: int = 8,
+    ):
+        self._driver = driver
+        self._driver_name = driver_name
+        self._plugin_socket = plugin_socket
+        self._registrar_socket = registrar_socket
+        # The endpoint the kubelet should dial (inside its own mount ns);
+        # defaults to the plugin socket path.
+        self._kubelet_plugin_socket = kubelet_plugin_socket or plugin_socket
+        self._servers: list[grpc.Server] = []
+        self._max_workers = max_workers
+        self.registration_error: str = ""
+
+    # -- DRA NodeServer handlers --------------------------------------------
+
+    def _node_prepare_resource(
+        self, request: wire.NodePrepareResourceRequest, context
+    ) -> wire.NodePrepareResourceResponse:
+        logger.info("NodePrepareResource: %r", request)
+        try:
+            devices = self._driver.node_prepare_resource(request.claim_uid)
+        except Exception as e:
+            logger.exception("NodePrepareResource failed")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            raise AssertionError  # abort always raises
+        return wire.NodePrepareResourceResponse(cdi_devices=devices)
+
+    def _node_unprepare_resource(
+        self, request: wire.NodeUnprepareResourceRequest, context
+    ) -> wire.NodeUnprepareResourceResponse:
+        logger.info("NodeUnprepareResource: %r", request)
+        self._driver.node_unprepare_resource(request.claim_uid)
+        return wire.NodeUnprepareResourceResponse()
+
+    # -- registration handlers ----------------------------------------------
+
+    def _get_info(self, request: wire.InfoRequest, context) -> wire.PluginInfo:
+        return wire.PluginInfo(
+            type="DRAPlugin",
+            name=self._driver_name,
+            endpoint=self._kubelet_plugin_socket,
+            supported_versions=[DRA_VERSION],
+        )
+
+    def _notify_registration_status(
+        self, request: wire.RegistrationStatus, context
+    ) -> wire.RegistrationStatusResponse:
+        if not request.plugin_registered:
+            logger.error("kubelet registration failed: %s", request.error)
+            self.registration_error = request.error
+        else:
+            logger.info("registered with kubelet")
+        return wire.RegistrationStatusResponse()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _serve(self, socket_path: str, service: str, methods: dict) -> grpc.Server:
+        os.makedirs(os.path.dirname(socket_path), exist_ok=True)
+        try:
+            os.remove(socket_path)
+        except FileNotFoundError:
+            pass
+        server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self._max_workers)
+        )
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(service, methods),)
+        )
+        server.add_insecure_port(f"unix://{socket_path}")
+        server.start()
+        return server
+
+    def start(self) -> None:
+        self._servers.append(
+            self._serve(
+                self._plugin_socket,
+                DRA_SERVICE,
+                {
+                    "NodePrepareResource": _unary(
+                        self._node_prepare_resource,
+                        wire.NodePrepareResourceRequest,
+                        wire.NodePrepareResourceResponse,
+                    ),
+                    "NodeUnprepareResource": _unary(
+                        self._node_unprepare_resource,
+                        wire.NodeUnprepareResourceRequest,
+                        wire.NodeUnprepareResourceResponse,
+                    ),
+                },
+            )
+        )
+        self._servers.append(
+            self._serve(
+                self._registrar_socket,
+                REGISTRATION_SERVICE,
+                {
+                    "GetInfo": _unary(
+                        self._get_info, wire.InfoRequest, wire.PluginInfo
+                    ),
+                    "NotifyRegistrationStatus": _unary(
+                        self._notify_registration_status,
+                        wire.RegistrationStatus,
+                        wire.RegistrationStatusResponse,
+                    ),
+                },
+            )
+        )
+
+    def stop(self, grace: float = 2.0) -> None:
+        for server in self._servers:
+            server.stop(grace)
+        self._servers.clear()
+        for path in (self._plugin_socket, self._registrar_socket):
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+
+    def wait(self) -> None:
+        for server in self._servers:
+            server.wait_for_termination()
+
+
+class DRAClient:
+    """Client for the DRA node service — what the kubelet (and our tests /
+    simulator) uses to drive a plugin over its socket."""
+
+    def __init__(self, socket_path: str):
+        self._channel = grpc.insecure_channel(f"unix://{socket_path}")
+
+    def node_prepare_resource(
+        self, namespace: str, claim_uid: str, claim_name: str = "",
+        resource_handle: str = "",
+    ) -> list[str]:
+        call = self._channel.unary_unary(
+            f"/{DRA_SERVICE}/NodePrepareResource",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=wire.NodePrepareResourceResponse.decode,
+        )
+        response = call(
+            wire.NodePrepareResourceRequest(
+                namespace=namespace,
+                claim_uid=claim_uid,
+                claim_name=claim_name,
+                resource_handle=resource_handle,
+            )
+        )
+        return list(response.cdi_devices)
+
+    def node_unprepare_resource(self, namespace: str, claim_uid: str) -> None:
+        call = self._channel.unary_unary(
+            f"/{DRA_SERVICE}/NodeUnprepareResource",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=wire.NodeUnprepareResourceResponse.decode,
+        )
+        call(
+            wire.NodeUnprepareResourceRequest(
+                namespace=namespace, claim_uid=claim_uid
+            )
+        )
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class RegistrationClient:
+    """Client for the registration service (kubelet plugin-watcher side)."""
+
+    def __init__(self, socket_path: str):
+        self._channel = grpc.insecure_channel(f"unix://{socket_path}")
+
+    def get_info(self) -> wire.PluginInfo:
+        call = self._channel.unary_unary(
+            f"/{REGISTRATION_SERVICE}/GetInfo",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=wire.PluginInfo.decode,
+        )
+        return call(wire.InfoRequest())
+
+    def notify(self, registered: bool, error: str = "") -> None:
+        call = self._channel.unary_unary(
+            f"/{REGISTRATION_SERVICE}/NotifyRegistrationStatus",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=wire.RegistrationStatusResponse.decode,
+        )
+        call(wire.RegistrationStatus(plugin_registered=registered, error=error))
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,165 @@
+"""Minimal protobuf wire-format codec for the kubelet plugin protos.
+
+The DRA NodeServer and plugin-registration gRPC APIs use only proto3 string,
+bool, and repeated-string fields (vendor/k8s.io/kubelet/pkg/apis/dra/
+v1alpha2/api.proto; pluginregistration/v1/api.proto), so rather than depend
+on generated stubs this codec implements exactly the wire features those
+messages need: varint tags, length-delimited strings, varint bools.
+
+Message classes declare ``FIELDS = {field_number: (name, type)}`` with type
+one of ``str``, ``bool``, ``list`` (repeated string).  Unknown fields are
+skipped on decode (proto3 compatibility rule).
+"""
+
+from __future__ import annotations
+
+
+def _encode_varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        byte = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(byte | 0x80)
+        else:
+            out.append(byte)
+            return bytes(out)
+
+
+def _decode_varint(data: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        byte = data[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result, pos
+        shift += 7
+
+
+class WireMessage:
+    """Base for messages with FIELDS = {num: (attr, type)}."""
+
+    FIELDS: dict[int, tuple[str, type]] = {}
+
+    def __init__(self, **kwargs):
+        for num, (attr, typ) in self.FIELDS.items():
+            default = [] if typ is list else (False if typ is bool else "")
+            setattr(self, attr, kwargs.pop(attr, default))
+        if kwargs:
+            raise TypeError(f"unknown fields: {sorted(kwargs)}")
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        for num, (attr, typ) in sorted(self.FIELDS.items()):
+            value = getattr(self, attr)
+            if typ is str:
+                if value:
+                    data = value.encode()
+                    out += _encode_varint(num << 3 | 2)
+                    out += _encode_varint(len(data))
+                    out += data
+            elif typ is bool:
+                if value:
+                    out += _encode_varint(num << 3 | 0)
+                    out += _encode_varint(1)
+            elif typ is list:
+                for item in value:
+                    data = item.encode()
+                    out += _encode_varint(num << 3 | 2)
+                    out += _encode_varint(len(data))
+                    out += data
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, data: bytes):
+        msg = cls()
+        pos = 0
+        while pos < len(data):
+            tag, pos = _decode_varint(data, pos)
+            num, wire_type = tag >> 3, tag & 0x7
+            if wire_type == 2:
+                length, pos = _decode_varint(data, pos)
+                payload = data[pos : pos + length]
+                pos += length
+                field = cls.FIELDS.get(num)
+                if field is None:
+                    continue
+                attr, typ = field
+                if typ is list:
+                    getattr(msg, attr).append(payload.decode())
+                else:
+                    setattr(msg, attr, payload.decode())
+            elif wire_type == 0:
+                value, pos = _decode_varint(data, pos)
+                field = cls.FIELDS.get(num)
+                if field is not None:
+                    attr, typ = field
+                    setattr(msg, attr, bool(value) if typ is bool else value)
+            elif wire_type == 5:
+                pos += 4
+            elif wire_type == 1:
+                pos += 8
+            else:
+                raise ValueError(f"unsupported wire type {wire_type}")
+        return msg
+
+    def __repr__(self):
+        fields = ", ".join(
+            f"{attr}={getattr(self, attr)!r}" for _, (attr, _) in sorted(self.FIELDS.items())
+        )
+        return f"{type(self).__name__}({fields})"
+
+
+# --- dra/v1alpha2 (api.proto) ----------------------------------------------
+
+
+class NodePrepareResourceRequest(WireMessage):
+    FIELDS = {
+        1: ("namespace", str),
+        2: ("claim_uid", str),
+        3: ("claim_name", str),
+        4: ("resource_handle", str),
+    }
+
+
+class NodePrepareResourceResponse(WireMessage):
+    FIELDS = {1: ("cdi_devices", list)}
+
+
+class NodeUnprepareResourceRequest(WireMessage):
+    FIELDS = {
+        1: ("namespace", str),
+        2: ("claim_uid", str),
+        3: ("claim_name", str),
+        4: ("resource_handle", str),
+    }
+
+
+class NodeUnprepareResourceResponse(WireMessage):
+    FIELDS: dict = {}
+
+
+# --- pluginregistration/v1 (api.proto) --------------------------------------
+
+
+class InfoRequest(WireMessage):
+    FIELDS: dict = {}
+
+
+class PluginInfo(WireMessage):
+    FIELDS = {
+        1: ("type", str),
+        2: ("name", str),
+        3: ("endpoint", str),
+        4: ("supported_versions", list),
+    }
+
+
+class RegistrationStatus(WireMessage):
+    FIELDS = {1: ("plugin_registered", bool), 2: ("error", str)}
+
+
+class RegistrationStatusResponse(WireMessage):
+    FIELDS: dict = {}

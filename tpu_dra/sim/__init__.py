@@ -1,0 +1,14 @@
+"""In-process cluster simulation (the hardware-free analog of the reference's
+kind e2e harness, demo/clusters/kind — component C25).
+
+``SimCluster`` wires a fake apiserver, the DRA controller, N node plugins on
+mock tpulibs, and scheduler/kubelet simulators into one process so the full
+claim lifecycle — template instantiation, scheduling negotiation, allocation,
+prepare, CDI injection, GC — runs end to end with zero hardware and zero
+cluster, as SURVEY.md §4 prescribes ("fake clientset + mock device library
+are the intended seams").
+"""
+
+from tpu_dra.sim.cluster import SimCluster, SimNode
+
+__all__ = ["SimCluster", "SimNode"]
