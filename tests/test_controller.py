@@ -254,6 +254,23 @@ class TestReconcilerClaimLifecycle:
         yield controller
         controller.stop()
 
+    def test_immediate_claim_not_hot_retried(self, cs, running):
+        # Immediate-mode allocation is unsupported (driver.allocate raises
+        # NotImplementedError); the reconciler must treat that as terminal,
+        # not spin in its error-backoff loop forever.
+        claim = make_claim(cs, name="imm", mode="Immediate")
+        # The sync reaches driver.allocate (finalizer added first), raises,
+        # and must then clear its retry entry instead of backing off.
+        assert self.wait_for(
+            lambda: FINALIZER
+            in cs.resource_claims("default").get("imm").metadata.finalizers
+        )
+        time.sleep(0.5)  # many backoff periods at 0.02s base
+        assert all(attempts == 0 for attempts in running._retries.values()), (
+            running._retries
+        )
+        assert cs.resource_claims("default").get("imm").status.allocation is None
+
     def test_claim_deletion_deallocates(self, tmp_path, cs, driver, running):
         # Allocate through the driver (as scheduling would), then delete.
         claim = make_claim(cs)

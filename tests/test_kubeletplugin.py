@@ -44,6 +44,25 @@ class TestWireCodec:
         decoded = wire.NodePrepareResourceResponse.decode(resp.encode())
         assert decoded.cdi_devices == ["vendor/class=a", "vendor/class=b"]
 
+    def test_truncated_message_raises(self):
+        import pytest
+
+        encoded = wire.NodePrepareResourceRequest(claim_uid="uid-123").encode()
+        with pytest.raises(ValueError, match="truncated"):
+            wire.NodePrepareResourceRequest.decode(encoded[:-3])
+
+    def test_truncated_varint_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="truncated"):
+            wire.NodePrepareResourceRequest.decode(b"\x80")
+
+    def test_runaway_varint_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="varint"):
+            wire.NodePrepareResourceRequest.decode(b"\x80" * 12)
+
     def test_bool_field(self):
         status = wire.RegistrationStatus(plugin_registered=True, error="")
         decoded = wire.RegistrationStatus.decode(status.encode())

@@ -100,7 +100,7 @@ class TestPrepare:
 
 
 class TestStaleStateGC:
-    def wait_for(self, predicate, timeout=5.0):
+    def wait_for(self, predicate, timeout=15.0):
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if predicate():

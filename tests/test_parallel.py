@@ -1,0 +1,158 @@
+"""Tests for tpu_dra.parallel: mesh building, collectives, slice burn-in.
+
+Run on the virtual 8-device CPU mesh from conftest.py — the driver's model
+for validating multi-chip sharding without TPU hardware.
+"""
+
+import jax
+import pytest
+
+from tpu_dra.api.topology import Topology
+from tpu_dra.parallel import (
+    all_gather_check,
+    logical_mesh,
+    psum_bandwidth,
+    psum_check,
+    ring_check,
+    slice_mesh,
+    topology_from_env,
+    validate_slice,
+)
+from tpu_dra.parallel.gang import GangEnv
+
+
+@pytest.fixture(scope="module")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, "conftest must provide 8 virtual CPU devices"
+    return devs
+
+
+class TestMesh:
+    def test_slice_mesh_2x2x2(self, devices):
+        mesh = slice_mesh("2x2x2", devices)
+        assert mesh.shape == {"z": 2, "y": 2, "x": 2}
+
+    def test_slice_mesh_4x2(self, devices):
+        mesh = slice_mesh(Topology(4, 2), devices)
+        assert mesh.shape["x"] == 4 and mesh.shape["y"] == 2 and mesh.shape["z"] == 1
+
+    def test_slice_mesh_device_order_x_minor(self, devices):
+        mesh = slice_mesh("4x2x1", devices)
+        # x is the fastest-varying axis of claim device order.
+        assert mesh.devices[0, 0, 0] == devices[0]
+        assert mesh.devices[0, 0, 1] == devices[1]
+        assert mesh.devices[0, 1, 0] == devices[4]
+
+    def test_slice_mesh_size_mismatch(self, devices):
+        with pytest.raises(ValueError):
+            slice_mesh("2x2x1", devices)
+
+    def test_topology_from_env(self):
+        assert topology_from_env({}) is None
+        assert topology_from_env({"TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1"}) == Topology(
+            2, 2, 1
+        )
+
+    def test_slice_mesh_defaults_to_env(self, devices, monkeypatch):
+        monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,2")
+        mesh = slice_mesh(devices=devices)
+        assert mesh.shape == {"z": 2, "y": 2, "x": 2}
+
+    def test_logical_mesh_inferred_axis(self, devices):
+        mesh = logical_mesh(devices, data=-1, model=2)
+        assert mesh.shape == {"data": 4, "fsdp": 1, "model": 2}
+
+    def test_logical_mesh_bad_sizes(self, devices):
+        with pytest.raises(ValueError):
+            logical_mesh(devices, data=3, model=2)
+        with pytest.raises(ValueError):
+            logical_mesh(devices, data=-1, fsdp=-1)
+
+
+class TestCollectives:
+    def test_psum_check_each_axis(self, devices):
+        mesh = slice_mesh("2x2x2", devices)
+        for axis in ("x", "y", "z"):
+            r = psum_check(mesh, axis)
+            assert r.ok, r.error
+            assert r.n_devices == 2
+
+    def test_all_gather_check(self, devices):
+        mesh = slice_mesh("4x2x1", devices)
+        r = all_gather_check(mesh, "x")
+        assert r.ok, r.error
+
+    def test_ring_check(self, devices):
+        mesh = slice_mesh("4x2x1", devices)
+        r = ring_check(mesh, "x")
+        assert r.ok, r.error
+
+    def test_psum_bandwidth_reports(self, devices):
+        mesh = slice_mesh("8x1x1", devices)
+        r = psum_bandwidth(mesh, "x", mbytes=1, iters=3, warmup=1)
+        assert r.ok, r.error
+        assert r.busbw_gbps > 0
+        assert r.seconds_p50 > 0
+        assert r.bytes_per_device == 1 * 1024**2
+
+    def test_psum_bandwidth_trivial_axis(self, devices):
+        mesh = slice_mesh("1x1x1", devices[:1])
+        r = psum_bandwidth(mesh, "x", mbytes=1, iters=1, warmup=1)
+        assert r.ok
+        assert r.busbw_gbps == 0.0  # no links on a 1-chip "slice"
+
+
+class TestGangEnv:
+    def test_absent(self):
+        assert GangEnv.from_env({}) is None
+
+    def test_roundtrip(self):
+        gang = GangEnv(coordinator="10.0.0.1:8476", size=64, rank=3)
+        assert GangEnv.from_env(gang.as_env()) == gang
+
+
+class TestValidateSlice:
+    def test_malformed_gang_env_reports_not_raises(self):
+        report = validate_slice(
+            env={
+                "TPU_DRA_GANG_COORDINATOR": "10.0.0.1:8476",
+                "TPU_DRA_GANG_SIZE": "abc",
+            }
+        )
+        assert not report.ok
+        assert any("malformed gang env" in e for e in report.errors)
+
+    def test_gang_size_degraded_to_solo_fails(self):
+        # Coordinator injected but size env lost: must not pass a local-only
+        # burn-in as if the cross-host gang check succeeded.
+        report = validate_slice(env={"TPU_DRA_GANG_COORDINATOR": "10.0.0.1:8476"})
+        assert not report.ok
+        assert any("gang size is 1" in e for e in report.errors)
+
+    def test_full_burn_in_passes(self):
+        report = validate_slice(topology="4x2x1", env={})
+        assert report.ok, report.errors
+        assert report.n_devices == 8
+        assert report.busbw_gbps > 0
+        ops = {c["op"] for c in report.checks}
+        assert ops == {"psum", "all_gather", "ppermute_ring", "psum_bandwidth"}
+
+    def test_device_count_mismatch_fails(self):
+        report = validate_slice(
+            topology="4x2x1", env={"TPU_VISIBLE_DEVICES": "0,1,2,3"}
+        )
+        assert not report.ok
+        assert any("4 chips but jax sees 8" in e for e in report.errors)
+
+    def test_env_topology_used(self):
+        report = validate_slice(env={"TPU_CHIPS_PER_HOST_BOUNDS": "2,2,2"})
+        assert report.topology == "2x2x2"
+        assert report.ok, report.errors
+
+    def test_json_serializable(self):
+        import json
+
+        report = validate_slice(topology="8x1x1", env={})
+        parsed = json.loads(report.to_json())
+        assert parsed["ok"] is True

@@ -214,6 +214,12 @@ class Controller:
             except ApiError as e:
                 logger.warning("sync %s failed: %s", key, e)
                 self._retry(key)
+            except NotImplementedError as e:
+                # Unsupported request (e.g. Immediate-mode allocation,
+                # driver.py) — terminal until the object changes; retrying
+                # would hot-loop forever on the same answer.
+                logger.warning("sync %s unsupported, not retrying: %s", key, e)
+                self._retries.pop(key, None)
             except Exception:
                 logger.exception("sync %s failed", key)
                 self._retry(key)

@@ -1,0 +1,48 @@
+"""tpu_dra.parallel — JAX mesh/collectives validation of allocated ICI domains.
+
+The reference driver has no distributed-communication machinery of its own
+(SURVEY.md §2 disclosure): the deliverable for the TPU build is *proof* that
+the chips a ResourceClaim hands to a pod form a working ICI domain.  This
+package is that proof, and the library claiming pods use to assemble their
+slice:
+
+- ``tpu_dra.parallel.mesh``        — build ``jax.sharding.Mesh`` objects from
+  the claimed topology (CDI-injected env or explicit), both physical
+  ``(x, y, z)`` meshes and logical ``(data, model)`` training meshes.
+- ``tpu_dra.parallel.collectives`` — shard_map'd psum/all-gather/ppermute
+  correctness checks and the psum all-reduce bandwidth measurement from
+  BASELINE.md ("JAX psum all-reduce bandwidth on allocated slice").
+- ``tpu_dra.parallel.gang``        — multi-host gang assembly:
+  ``jax.distributed.initialize`` from DRA-injected coordination env, global
+  barrier and cross-host all-reduce (the v5e-256 64-pod gang config).
+- ``tpu_dra.parallel.validate``    — the slice burn-in a claiming pod runs:
+  assert visible devices match the claim, run the collective checks, emit a
+  JSON report.
+"""
+
+from tpu_dra.parallel.mesh import (
+    logical_mesh,
+    slice_mesh,
+    topology_from_env,
+)
+from tpu_dra.parallel.collectives import (
+    CollectiveReport,
+    all_gather_check,
+    psum_bandwidth,
+    psum_check,
+    ring_check,
+)
+from tpu_dra.parallel.validate import SliceReport, validate_slice
+
+__all__ = [
+    "CollectiveReport",
+    "SliceReport",
+    "all_gather_check",
+    "logical_mesh",
+    "psum_bandwidth",
+    "psum_check",
+    "ring_check",
+    "slice_mesh",
+    "topology_from_env",
+    "validate_slice",
+]

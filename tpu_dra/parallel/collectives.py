@@ -1,0 +1,196 @@
+"""Collective correctness checks + psum bandwidth over an allocated slice.
+
+These are the acceptance measurements from BASELINE.md: an allocation is only
+good if collectives across the claimed chips actually work and ride ICI at
+full bandwidth.  Everything is built on ``shard_map`` over a named mesh with
+XLA collectives (psum / all_gather / ppermute) — the TPU-native equivalent of
+the reference's (absent) NCCL layer, per SURVEY.md §2's disclosure.
+
+Bandwidth accounting uses *algorithm* bandwidth for ring all-reduce: each
+device sends and receives ``2 * (n-1)/n * bytes`` over the slowest link, so
+
+    busbw = 2 * (n-1)/n * bytes / time
+
+which is directly comparable across slice sizes (the number NCCL-tests and
+the scaling book report).
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class CollectiveReport:
+    """Result of one collective measurement on a mesh axis."""
+
+    op: str
+    axis: str
+    n_devices: int
+    ok: bool
+    bytes_per_device: int = 0
+    seconds_p50: float = 0.0
+    busbw_gbps: float = 0.0
+    error: str = ""
+    samples: "list[float]" = field(default_factory=list)
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    import jax
+
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pre-0.8 fallback
+        from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def psum_check(mesh, axis: str) -> CollectiveReport:
+    """All-reduce correctness: psum of per-device rank == sum of ranks."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = mesh.shape[axis]
+
+    def body(x):
+        return jax.lax.psum(x, axis)
+
+    try:
+        # One distinct value per axis position, `chunk` elements each.
+        chunk = 4
+        ranks = jnp.arange(n * chunk, dtype=jnp.float32)
+        spec = _axis_spec(mesh, axis)
+        f = jax.jit(_shard_map(body, mesh, in_specs=(spec,), out_specs=spec))
+        out = np.asarray(jax.device_get(f(ranks)))
+        # Input is sharded over `axis` (replicated elsewhere): shard i holds
+        # rows [i*chunk, (i+1)*chunk).  psum makes every shard the sum of all
+        # shards, so the global output is that sum tiled n times.
+        expected_shard = np.asarray(ranks).reshape(n, chunk).sum(axis=0)
+        expected = np.tile(expected_shard, n)
+        ok = bool(np.allclose(out, expected))
+        return CollectiveReport(op="psum", axis=axis, n_devices=n, ok=ok)
+    except Exception as e:  # surfaced in the report, not raised: burn-in must finish
+        return CollectiveReport(op="psum", axis=axis, n_devices=n, ok=False, error=str(e))
+
+
+def _axis_spec(mesh, axis: str):
+    """PartitionSpec sharding dim 0 over `axis` (others replicated)."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(axis)
+
+
+def all_gather_check(mesh, axis: str) -> CollectiveReport:
+    """all_gather correctness: every device ends with every shard."""
+    import jax
+    import jax.numpy as jnp
+
+    n = mesh.shape[axis]
+    try:
+        spec = _axis_spec(mesh, axis)
+
+        def body(x):
+            return jax.lax.all_gather(x, axis, tiled=True)
+
+        x = jnp.arange(n * 4, dtype=jnp.float32)
+        # Output stays sharded over `axis`: each shard is the full gathered
+        # array, so the global result is the original array tiled n times.
+        f = jax.jit(_shard_map(body, mesh, in_specs=(spec,), out_specs=spec))
+        out = jax.device_get(f(x))
+        ok = bool(jnp.allclose(out, jnp.tile(x, n)))
+        return CollectiveReport(op="all_gather", axis=axis, n_devices=n, ok=bool(ok))
+    except Exception as e:
+        return CollectiveReport(
+            op="all_gather", axis=axis, n_devices=n, ok=False, error=str(e)
+        )
+
+
+def ring_check(mesh, axis: str) -> CollectiveReport:
+    """ppermute ring: shift-by-one along the axis returns after n hops.
+
+    Exercises point-to-point ICI neighbor links individually — a broken link
+    that psum's tree/ring might route around still fails here.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = mesh.shape[axis]
+    try:
+        spec = _axis_spec(mesh, axis)
+
+        def body(x):
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            for _ in range(n):
+                x = jax.lax.ppermute(x, axis, perm)
+            return x
+
+        x = jnp.arange(max(n, 1), dtype=jnp.float32)
+        f = jax.jit(_shard_map(body, mesh, in_specs=(spec,), out_specs=spec))
+        out = jax.device_get(f(x))
+        ok = bool(jnp.allclose(out, x))  # n shifts of an n-ring = identity
+        return CollectiveReport(op="ppermute_ring", axis=axis, n_devices=n, ok=ok)
+    except Exception as e:
+        return CollectiveReport(
+            op="ppermute_ring", axis=axis, n_devices=n, ok=False, error=str(e)
+        )
+
+
+def psum_bandwidth(
+    mesh,
+    axis: str,
+    *,
+    mbytes: int = 64,
+    iters: int = 10,
+    warmup: int = 2,
+    dtype=None,
+) -> CollectiveReport:
+    """Measure psum all-reduce bus bandwidth along one mesh axis.
+
+    The BASELINE.md metric ("JAX psum all-reduce bandwidth on allocated
+    slice").  Times a jitted shard_map'd ``lax.psum`` of ``mbytes`` MiB per
+    device, p50 over ``iters`` timed runs after ``warmup`` compile+warm runs,
+    and reports ring-all-reduce bus bandwidth (see module docstring).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if dtype is None:
+        dtype = jnp.float32
+    n = mesh.shape[axis]
+    elems = max(1, mbytes * (1024**2) // jnp.dtype(dtype).itemsize)
+    nbytes = elems * jnp.dtype(dtype).itemsize
+
+    spec = _axis_spec(mesh, axis)
+
+    def body(x):
+        return jax.lax.psum(x, axis)
+
+    try:
+        # One shard of `elems` elements per device along the axis.
+        x = jnp.ones((elems * n,), dtype=dtype)
+        f = jax.jit(_shard_map(body, mesh, in_specs=(spec,), out_specs=spec))
+        for _ in range(max(1, warmup)):
+            jax.block_until_ready(f(x))
+        samples = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(x))
+            samples.append(time.perf_counter() - t0)
+        p50 = statistics.median(samples)
+        busbw = (2 * (n - 1) / n) * nbytes / p50 / 1e9 if n > 1 and p50 > 0 else 0.0
+        return CollectiveReport(
+            op="psum_bandwidth",
+            axis=axis,
+            n_devices=n,
+            ok=True,
+            bytes_per_device=nbytes,
+            seconds_p50=p50,
+            busbw_gbps=busbw,
+            samples=samples,
+        )
+    except Exception as e:
+        return CollectiveReport(
+            op="psum_bandwidth", axis=axis, n_devices=n, ok=False, error=str(e)
+        )

@@ -1,0 +1,115 @@
+"""Multi-host gang assembly for DRA-allocated TPU slices.
+
+The v5e-256 acceptance config (BASELINE.md: "64-pod ResourceClaimTemplate +
+pjit all-reduce") needs every pod of the gang to join one JAX distributed
+system: DCN for host coordination, ICI for the collectives.  The reference
+has no equivalent (its multi-device story stops at single-node gang claims,
+SURVEY.md §2) — this is new TPU-first surface.
+
+The driver's CDI layer injects the coordination contract into each gang
+member (tpu_dra/plugin/cdi.py gang edits):
+
+- ``TPU_DRA_GANG_COORDINATOR`` — host:port of process 0
+- ``TPU_DRA_GANG_SIZE``        — number of processes (pods) in the gang
+- ``TPU_DRA_GANG_RANK``        — this pod's process index
+
+:func:`initialize_gang` consumes those and calls
+``jax.distributed.initialize``; :func:`gang_allreduce` then proves the full
+gang forms one working collective domain.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+ENV_COORDINATOR = "TPU_DRA_GANG_COORDINATOR"
+ENV_SIZE = "TPU_DRA_GANG_SIZE"
+ENV_RANK = "TPU_DRA_GANG_RANK"
+
+
+@dataclass(frozen=True)
+class GangEnv:
+    """Gang coordination contract as injected by the driver."""
+
+    coordinator: str
+    size: int
+    rank: int
+
+    @classmethod
+    def from_env(cls, env: "dict[str, str] | None" = None) -> "GangEnv | None":
+        env = os.environ if env is None else env
+        coordinator = env.get(ENV_COORDINATOR)
+        if not coordinator:
+            return None
+        return cls(
+            coordinator=coordinator,
+            size=int(env.get(ENV_SIZE, "1")),
+            rank=int(env.get(ENV_RANK, "0")),
+        )
+
+    def as_env(self) -> "dict[str, str]":
+        return {
+            ENV_COORDINATOR: self.coordinator,
+            ENV_SIZE: str(self.size),
+            ENV_RANK: str(self.rank),
+        }
+
+
+def initialize_gang(gang: "GangEnv | None" = None) -> "GangEnv | None":
+    """Join the gang's JAX distributed system (idempotent, no-op if solo).
+
+    Call before any other jax API in a gang pod.  Returns the GangEnv used,
+    or None when running single-process (no gang env present).
+    """
+    if gang is None:
+        gang = GangEnv.from_env()
+    if gang is None or gang.size <= 1:
+        return None
+    import jax
+
+    if not jax.distributed.is_initialized():
+        jax.distributed.initialize(
+            coordinator_address=gang.coordinator,
+            num_processes=gang.size,
+            process_id=gang.rank,
+        )
+    return gang
+
+
+def gang_allreduce(mbytes: int = 16):
+    """Global psum across every chip of every gang member.
+
+    Returns a CollectiveReport over the full global device set — the pjit
+    all-reduce acceptance check.  ICI carries the intra-slice reduction,
+    DCN the cross-host hop; XLA picks the hierarchy from the mesh.
+    """
+    import jax
+
+    from tpu_dra.parallel.collectives import psum_bandwidth
+    from tpu_dra.parallel.mesh import logical_mesh
+
+    mesh = logical_mesh(jax.devices(), data=-1, fsdp=1, model=1)
+    return psum_bandwidth(mesh, "data", mbytes=mbytes)
+
+
+def barrier() -> None:
+    """Cross-process barrier: tiny global psum, blocks until all arrive."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dra.parallel.collectives import _shard_map
+    from tpu_dra.parallel.mesh import logical_mesh
+
+    mesh = logical_mesh(jax.devices(), data=-1, fsdp=1, model=1)
+    from jax.sharding import PartitionSpec as P
+
+    f = jax.jit(
+        _shard_map(
+            lambda x: jax.lax.psum(x, "data"),
+            mesh,
+            in_specs=(P("data"),),
+            out_specs=P("data"),
+        )
+    )
+    jax.block_until_ready(f(jnp.ones((mesh.shape["data"],), jnp.float32)))

@@ -1,0 +1,123 @@
+"""Mesh construction for allocated TPU slices.
+
+A claiming pod sees exactly the chips the driver allocated (CDI env:
+``TPU_VISIBLE_DEVICES``, ``TPU_CHIPS_PER_HOST_BOUNDS`` — tpu_dra/plugin/cdi.py).
+This module turns that into ``jax.sharding.Mesh`` objects:
+
+- :func:`slice_mesh` — the *physical* mesh: devices arranged by the claimed
+  topology box (e.g. 2x2x1) with axes named after ICI dimensions, so
+  collectives along an axis ride contiguous ICI links.  The allocator
+  guarantees contiguity (tpu_dra/controller/placement.py); this function is
+  where that guarantee pays off.
+- :func:`logical_mesh` — the *logical* training mesh: the same devices
+  reshaped into named parallelism axes (data/fsdp/model), the shape every
+  pjit training step shards over.
+
+Degenerate axes (size 1) are kept: a fixed axis vocabulary means sharding
+rules never need to special-case small slices — XLA elides collectives over
+size-1 axes for free.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+
+from tpu_dra.api.topology import Topology
+
+ICI_AXES = ("x", "y", "z")
+
+
+def topology_from_env(env: "dict[str, str] | None" = None) -> "Topology | None":
+    """Read the claimed topology from the CDI-injected environment.
+
+    ``TPU_CHIPS_PER_HOST_BOUNDS`` is set by the driver's CDI layer for
+    topology claims (plugin/cdi.py); absent means the claim was a plain
+    count (no box guarantee).
+    """
+    env = os.environ if env is None else env
+    bounds = env.get("TPU_CHIPS_PER_HOST_BOUNDS")
+    if not bounds:
+        return None
+    x, y, z = (int(p) for p in bounds.split(","))
+    return Topology(x, y, z)
+
+
+def _default_devices() -> list:
+    import jax
+
+    return list(jax.devices())
+
+
+def slice_mesh(
+    topology: "Topology | str | None" = None,
+    devices: "Sequence | None" = None,
+    axis_names: "tuple[str, ...]" = ICI_AXES,
+):
+    """Physical mesh over the allocated slice.
+
+    Device order within the claim is x-minor (Topology.coords_from), so a
+    plain reshape to (z, y, x) puts ICI neighbors adjacent along each mesh
+    axis.  ``axis_names`` maps (x, y, z) -> mesh axis names; note the mesh
+    array is indexed [z, y, x] but axes are named in (x, y, z) order for
+    callers, i.e. ``Mesh(devs.reshape(z, y, x), (names[2], names[1], names[0]))``.
+    """
+    from jax.sharding import Mesh
+
+    if isinstance(topology, str):
+        topology = Topology.parse(topology)
+    if devices is None:
+        devices = _default_devices()
+    if topology is None:
+        topology = topology_from_env() or Topology(len(devices), 1, 1)
+    if topology.size != len(devices):
+        raise ValueError(
+            f"topology {topology} needs {topology.size} devices, have {len(devices)}"
+        )
+    arr = np.array(devices, dtype=object).reshape(topology.z, topology.y, topology.x)
+    names = (axis_names[2], axis_names[1], axis_names[0])
+    return Mesh(arr, names)
+
+
+def logical_mesh(
+    devices: "Sequence | None" = None,
+    *,
+    data: int = -1,
+    fsdp: int = 1,
+    model: int = 1,
+):
+    """Logical training mesh with (data, fsdp, model) axes.
+
+    One axis may be -1 (inferred).  Device order is preserved from the
+    physical slice order, so the *innermost* (model) axis lands on the
+    fastest ICI neighbors — put the highest-traffic parallelism (tensor
+    parallel psums every layer) there, per the scaling-book recipe.
+    """
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = _default_devices()
+    n = len(devices)
+    sizes = {"data": data, "fsdp": fsdp, "model": model}
+    for name, v in sizes.items():
+        if v != -1 and v < 1:
+            raise ValueError(f"axis {name!r} size must be -1 (inferred) or >= 1, got {v}")
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = 1
+    for k, v in sizes.items():
+        if v != -1:
+            known *= v
+    if unknown:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[unknown[0]] = n // known
+    elif known != n:
+        raise ValueError(f"mesh {sizes} needs {known} devices, have {n}")
+    arr = np.array(devices, dtype=object).reshape(
+        sizes["data"], sizes["fsdp"], sizes["model"]
+    )
+    return Mesh(arr, ("data", "fsdp", "model"))

@@ -1,0 +1,179 @@
+"""Slice burn-in: prove a claimed TPU slice works, end to end.
+
+This is what a claiming pod runs (the payload of the tpu-test demo specs,
+demo/specs/ — the TPU analog of the reference pods' ``nvidia-smi -L``
+acceptance check, README.md:75-117).  It answers, in one JSON report:
+
+1. Does JAX see exactly the chips the claim allocated
+   (``TPU_VISIBLE_DEVICES`` / ``TPU_CHIPS_PER_HOST_BOUNDS`` from CDI)?
+2. Do collectives work along every axis of the claimed topology
+   (psum, all_gather, ppermute ring)?
+3. What psum bus bandwidth does the slice sustain (BASELINE.md metric)?
+
+Exit code 0 iff everything passed, so demo pods are assertable
+(SURVEY.md §4: "asserted not narrated").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import asdict, dataclass, field
+
+from tpu_dra.api.topology import Topology
+from tpu_dra.parallel.collectives import (
+    CollectiveReport,
+    all_gather_check,
+    psum_bandwidth,
+    psum_check,
+    ring_check,
+)
+from tpu_dra.parallel.gang import GangEnv, initialize_gang
+from tpu_dra.parallel.mesh import slice_mesh, topology_from_env
+
+
+@dataclass
+class SliceReport:
+    """Everything the burn-in learned about the claimed slice."""
+
+    ok: bool = False
+    n_devices: int = 0
+    expected_devices: "int | None" = None
+    platform: str = ""
+    topology: str = ""
+    gang: "dict | None" = None
+    checks: "list[dict]" = field(default_factory=list)
+    busbw_gbps: float = 0.0
+    errors: "list[str]" = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), sort_keys=True)
+
+
+def _expected_device_count(env) -> "int | None":
+    visible = env.get("TPU_VISIBLE_DEVICES")
+    if visible:
+        return len([v for v in visible.split(",") if v != ""])
+    return None
+
+
+def validate_slice(
+    *,
+    topology: "Topology | str | None" = None,
+    expected_devices: "int | None" = None,
+    bandwidth_mbytes: int = 16,
+    env: "dict[str, str] | None" = None,
+) -> SliceReport:
+    """Run the full burn-in against the devices visible to this process."""
+    environ = os.environ if env is None else env
+    report = SliceReport()
+
+    try:
+        gang = GangEnv.from_env(environ)
+    except (ValueError, TypeError) as e:
+        report.errors.append(f"malformed gang env: {e}")
+        return report
+    if gang is not None:
+        # Coordinator present but size <= 1 is a broken injection (a 64-pod
+        # gang member that lost its size env would otherwise "pass" a purely
+        # local burn-in) — fail loudly rather than degrade.
+        if gang.size <= 1:
+            report.errors.append(
+                f"gang coordinator set but gang size is {gang.size} "
+                f"(missing/invalid {'TPU_DRA_GANG_SIZE'}?)"
+            )
+            return report
+        try:
+            initialize_gang(gang)
+            report.gang = {"size": gang.size, "rank": gang.rank}
+        except Exception as e:
+            report.errors.append(f"gang init failed: {e}")
+            return report
+
+    try:
+        import jax
+
+        # The CDI env describes this host's chips, so in a gang every
+        # per-host expectation is checked against local devices; the global
+        # device set is exercised by the cross-host gang check below.
+        devices = jax.local_devices() if report.gang else jax.devices()
+    except Exception as e:
+        report.errors.append(f"jax initialization failed: {e}")
+        return report
+
+    report.n_devices = len(devices)
+    report.platform = devices[0].platform if devices else "none"
+
+    if expected_devices is None:
+        expected_devices = _expected_device_count(environ)
+    report.expected_devices = expected_devices
+    if expected_devices is not None and len(devices) != expected_devices:
+        report.errors.append(
+            f"claim allocated {expected_devices} chips but jax sees {len(devices)}"
+        )
+
+    if isinstance(topology, str):
+        topology = Topology.parse(topology)
+    if topology is None:
+        topology = topology_from_env(environ)
+    if topology is None:
+        topology = Topology(len(devices), 1, 1)
+    report.topology = str(topology)
+
+    try:
+        mesh = slice_mesh(topology, devices)
+    except ValueError as e:
+        report.errors.append(str(e))
+        return report
+
+    # Collective checks along every non-trivial ICI axis of the claim.
+    axes = [a for a in mesh.axis_names if mesh.shape[a] > 1]
+    best_bw = 0.0
+    for axis in axes:
+        for check in (psum_check, all_gather_check, ring_check):
+            r = check(mesh, axis)
+            report.checks.append(_compact(r))
+            if not r.ok:
+                report.errors.append(f"{r.op}[{axis}]: {r.error or 'mismatch'}")
+    # Bandwidth on the largest axis (the headline number).
+    if axes:
+        axis = max(axes, key=lambda a: mesh.shape[a])
+        r = psum_bandwidth(mesh, axis, mbytes=bandwidth_mbytes)
+        report.checks.append(_compact(r))
+        if r.ok:
+            best_bw = r.busbw_gbps
+        else:
+            report.errors.append(f"psum_bandwidth[{axis}]: {r.error}")
+    report.busbw_gbps = best_bw
+
+    # Cross-host: one all-reduce over every chip of every gang member.
+    if report.gang is not None:
+        from tpu_dra.parallel.gang import gang_allreduce
+
+        r = gang_allreduce(mbytes=bandwidth_mbytes)
+        report.checks.append(_compact(r))
+        if not r.ok:
+            report.errors.append(f"gang_allreduce: {r.error}")
+
+    report.ok = not report.errors
+    return report
+
+
+def _compact(r: CollectiveReport) -> dict:
+    d = asdict(r)
+    d.pop("samples", None)
+    return d
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    """CLI: ``python -m tpu_dra.parallel.validate [topology]``."""
+    argv = sys.argv[1:] if argv is None else argv
+    topology = argv[0] if argv else None
+    report = validate_slice(topology=topology)
+    print(report.to_json())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -34,7 +34,7 @@ REGISTRATION_SERVICE = "pluginregistration.Registration"
 DRA_VERSION = "1.0.0"
 
 
-def _unary(handler, request_cls, response_cls):
+def _unary(handler, request_cls):
     return grpc.unary_unary_rpc_method_handler(
         handler,
         request_deserializer=request_cls.decode,
@@ -84,7 +84,12 @@ class DRAPluginServer:
         self, request: wire.NodeUnprepareResourceRequest, context
     ) -> wire.NodeUnprepareResourceResponse:
         logger.info("NodeUnprepareResource: %r", request)
-        self._driver.node_unprepare_resource(request.claim_uid)
+        try:
+            self._driver.node_unprepare_resource(request.claim_uid)
+        except Exception as e:
+            logger.exception("NodeUnprepareResource failed")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            raise AssertionError  # abort always raises
         return wire.NodeUnprepareResourceResponse()
 
     # -- registration handlers ----------------------------------------------
@@ -134,12 +139,10 @@ class DRAPluginServer:
                     "NodePrepareResource": _unary(
                         self._node_prepare_resource,
                         wire.NodePrepareResourceRequest,
-                        wire.NodePrepareResourceResponse,
                     ),
                     "NodeUnprepareResource": _unary(
                         self._node_unprepare_resource,
                         wire.NodeUnprepareResourceRequest,
-                        wire.NodeUnprepareResourceResponse,
                     ),
                 },
             )
@@ -149,13 +152,10 @@ class DRAPluginServer:
                 self._registrar_socket,
                 REGISTRATION_SERVICE,
                 {
-                    "GetInfo": _unary(
-                        self._get_info, wire.InfoRequest, wire.PluginInfo
-                    ),
+                    "GetInfo": _unary(self._get_info, wire.InfoRequest),
                     "NotifyRegistrationStatus": _unary(
                         self._notify_registration_status,
                         wire.RegistrationStatus,
-                        wire.RegistrationStatusResponse,
                     ),
                 },
             )

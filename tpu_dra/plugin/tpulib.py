@@ -252,6 +252,7 @@ class MockTpuLib(_BaseTpuLib):
         partitionable: bool = False,
         ici_domain: str = "mock-host",
         state_dir: str = "/tmp/tpu-dra-mock",
+        uuid_prefix: str = "mock-tpu",
     ):
         topo = mesh if isinstance(mesh, Topology) else Topology.parse(mesh)
         chips = []
@@ -260,7 +261,7 @@ class MockTpuLib(_BaseTpuLib):
                 TpuChipInfo(
                     tpu=AllocatableTpu(
                         index=index,
-                        uuid=f"mock-tpu-{index}",
+                        uuid=f"{uuid_prefix}-{index}",
                         coord=coord,
                         ici_domain=ici_domain,
                         cores=cores,

@@ -30,6 +30,10 @@ def _decode_varint(data: bytes, pos: int) -> tuple[int, int]:
     result = 0
     shift = 0
     while True:
+        if pos >= len(data):
+            raise ValueError("truncated message: varint cut off at end of buffer")
+        if shift > 63:
+            raise ValueError("malformed varint: exceeds 64 bits")
         byte = data[pos]
         pos += 1
         result |= (byte & 0x7F) << shift
@@ -81,6 +85,11 @@ class WireMessage:
             num, wire_type = tag >> 3, tag & 0x7
             if wire_type == 2:
                 length, pos = _decode_varint(data, pos)
+                if pos + length > len(data):
+                    raise ValueError(
+                        f"truncated message: field {num} declares {length} bytes, "
+                        f"{len(data) - pos} remain"
+                    )
                 payload = data[pos : pos + length]
                 pos += length
                 field = cls.FIELDS.get(num)

@@ -68,11 +68,8 @@ class SimNode:
             partitionable=partitionable,
             state_dir=f"{state_root}/{name}/tpulib",
             ici_domain=name,
+            uuid_prefix=f"{name}-chip",  # distinct chip UUIDs per node
         )
-        # Distinct chip UUIDs per node.
-        for chip in self.tpulib._chips.values():
-            chip.tpu.uuid = f"{name}-chip-{chip.tpu.index}"
-        self.tpulib._chips = {c.tpu.uuid: c for c in self.tpulib._chips.values()}
         self.cdi = CDIHandler(f"{state_root}/{name}/cdi", self.tpulib)
         self.state = DeviceState(
             self.tpulib,
@@ -382,10 +379,13 @@ class SimCluster:
         )
 
     def delete_pod(self, namespace: str, name: str) -> None:
-        """Pod teardown: drop reservations, then delete the pod — owner-GC
-        cascades template-owned claims.  The pod goes first so lingering
-        scheduling-context syncs see it gone and cannot tentatively
-        re-allocate the dying claims."""
+        """Pod teardown: drop the pod's reservedFor entries first (the
+        kubelet's job on pod death), then delete the pod, whose owner-GC
+        cascades template-owned claims.  Unreserving first is safe because
+        the scheduler only negotiates for pods with pending claims — a
+        Running pod's claims are never tentatively re-allocated — and it
+        means that by the time the claim objects die their deallocation
+        path (controller syncClaim) sees no stale consumers."""
         pods = self.clientset.pods(namespace)
         pod = pods.get(name)
         claims_client = self.clientset.resource_claims(namespace)
