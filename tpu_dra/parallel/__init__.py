@@ -18,6 +18,9 @@ slice:
 - ``tpu_dra.parallel.validate``    — the slice burn-in a claiming pod runs:
   assert visible devices match the claim, run the collective checks, emit a
   JSON report.
+- ``tpu_dra.parallel.burnin``      — the flagship burn-in workload: a small
+  transformer LM trained over the claimed slice with dp/fsdp/tp/sp
+  shardings (the acceptance check that actually loads MXU + ICI).
 """
 
 from tpu_dra.parallel.mesh import (
@@ -33,10 +36,14 @@ from tpu_dra.parallel.collectives import (
     ring_check,
 )
 from tpu_dra.parallel.validate import SliceReport, validate_slice
+from tpu_dra.parallel.burnin import BurninConfig, TrainReport, train
 
 __all__ = [
+    "BurninConfig",
     "CollectiveReport",
     "SliceReport",
+    "TrainReport",
+    "train",
     "all_gather_check",
     "logical_mesh",
     "psum_bandwidth",

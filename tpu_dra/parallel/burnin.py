@@ -1,0 +1,369 @@
+"""Burn-in training workload: a sharded transformer LM over the claimed slice.
+
+The reference's acceptance check for an allocated device is ``nvidia-smi -L``
+inside the claiming pod (reference README.md:75-117).  That proves device
+*visibility*; for a TPU slice it proves nearly nothing — a slice is only good
+if the MXU sustains matmul throughput and the ICI links sustain the
+collectives a real training step issues.  So the TPU-native acceptance
+workload is an actual training step: a small causal-LM transformer, sharded
+over the allocated mesh with the full parallelism vocabulary, trained for a
+few steps with a loss-decrease assertion.
+
+This doubles as the framework's flagship model for compile checks
+(__graft_entry__.py) and as the heavy stage of slice burn-in
+(tpu_dra/parallel/validate.py).
+
+Parallelism (scaling-book recipe — annotate shardings, let XLA place the
+collectives):
+
+- **dp/fsdp**: batch sharded over ``("data", "fsdp")``; parameters and
+  optimizer state sharded over ``fsdp`` (ZeRO-3 style — XLA inserts the
+  all-gather on use and reduce-scatter on grads).
+- **tp**: attention heads and MLP hidden dim sharded over ``model``
+  (Megatron pairing: column-parallel in, row-parallel out → one psum per
+  block half).
+- **sp**: the residual stream between blocks is sequence-sharded over
+  ``model`` (Megatron sequence parallelism — the all-gather/reduce-scatter
+  pair replaces the psum, halving peak activation memory in norm regions).
+
+Compiler-friendliness: layers are stacked and iterated with ``lax.scan``
+(one trace regardless of depth), every shape is static, blocks are
+``jax.checkpoint``-ed so the backward pass rematerializes instead of saving
+activations (HBM is the bottleneck, FLOPs are cheap on the MXU), and all
+matmuls run in bfloat16 with fp32 accumulation (MXU-native).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from dataclasses import dataclass
+
+__all__ = [
+    "BurninConfig",
+    "init_params",
+    "param_specs",
+    "forward",
+    "make_train_step",
+    "train",
+    "TrainReport",
+]
+
+
+@dataclass(frozen=True)
+class BurninConfig:
+    """Model + data shape for the burn-in LM.  Defaults are tiny on purpose:
+    burn-in must finish in seconds; scale ``d_model``/``seq`` up for a
+    bandwidth-saturating soak."""
+
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    d_ff: int = 512
+    n_layers: int = 2
+    seq: int = 128
+    batch: int = 8
+    learning_rate: float = 1e-2
+
+    @property
+    def d_head(self) -> int:
+        if self.d_model % self.n_heads:
+            raise ValueError(f"d_model {self.d_model} not divisible by n_heads {self.n_heads}")
+        return self.d_model // self.n_heads
+
+    def scaled_to(self, mesh) -> "BurninConfig":
+        """Grow batch/heads/ff minimally so every sharded dim divides its
+        mesh axis — keeps tiny configs valid on any claimed slice."""
+        data = mesh.shape["data"] * mesh.shape["fsdp"]
+        model = mesh.shape["model"]
+        batch = _round_up(self.batch, data)
+        n_heads = _round_up(self.n_heads, model)
+        d_model = _round_up(self.d_model, n_heads * max(mesh.shape["fsdp"], 1))
+        d_ff = _round_up(self.d_ff, model * mesh.shape["fsdp"])
+        seq = _round_up(self.seq, model)  # sp shards seq over `model`
+        vocab = _round_up(self.vocab, mesh.shape["fsdp"] * model)
+        return dataclasses.replace(
+            self, batch=batch, n_heads=n_heads, d_model=d_model, d_ff=d_ff, seq=seq, vocab=vocab
+        )
+
+
+def _round_up(v: int, m: int) -> int:
+    return v if m <= 1 else ((v + m - 1) // m) * m
+
+
+# ---------------------------------------------------------------------------
+# Parameters.  A plain pytree (dict) — stacked per-layer leaves with a
+# leading n_layers dim so lax.scan iterates them without per-layer retracing.
+# ---------------------------------------------------------------------------
+
+
+def init_params(config: BurninConfig, key=None):
+    import jax
+    import jax.numpy as jnp
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    c = config
+    k = iter(jax.random.split(key, 8))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(jnp.float32)
+
+    L = c.n_layers
+    return {
+        "embed": dense(next(k), (c.vocab, c.d_model), c.d_model),
+        "pos": dense(next(k), (c.seq, c.d_model), c.d_model),
+        "layers": {
+            "wqkv": dense(next(k), (L, c.d_model, 3, c.n_heads, c.d_head), c.d_model),
+            "wo": dense(next(k), (L, c.n_heads, c.d_head, c.d_model), c.d_model),
+            "w1": dense(next(k), (L, c.d_model, c.d_ff), c.d_model),
+            "w2": dense(next(k), (L, c.d_ff, c.d_model), c.d_ff),
+            "ln1": jnp.ones((L, c.d_model), jnp.float32),
+            "ln2": jnp.ones((L, c.d_model), jnp.float32),
+        },
+        "ln_f": jnp.ones((c.d_model,), jnp.float32),
+    }
+
+
+def param_specs(config: BurninConfig):
+    """PartitionSpec pytree: fsdp shards the non-tp dim of every matrix,
+    model (tp) shards heads / ffn-hidden / vocab-out (Megatron layout)."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "embed": P("fsdp", "model"),
+        "pos": P(None, "model"),
+        "layers": {
+            "wqkv": P(None, "fsdp", None, "model", None),
+            "wo": P(None, "model", None, "fsdp"),
+            "w1": P(None, "fsdp", "model"),
+            "w2": P(None, "model", "fsdp"),
+            "ln1": P(None, None),
+            "ln2": P(None, None),
+        },
+        "ln_f": P(None),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward.
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm(x, scale):
+    import jax.numpy as jnp
+
+    x = x.astype(jnp.float32)
+    rms = jnp.sqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
+    return (x / rms) * scale
+
+
+def _block(layer, x, *, config: BurninConfig, constrain):
+    """One pre-norm transformer block.  ``constrain(kind, arr)`` applies the
+    sp/tp sharding constraints; identity when running unsharded."""
+    import jax.numpy as jnp
+
+    c = config
+    bf16 = jnp.bfloat16
+
+    # --- attention (tp over heads) ---
+    h = constrain("seq", x)  # sp region: (batch, seq/model, d)
+    h = _rms_norm(h, layer["ln1"])
+    h = constrain("hidden", h.astype(bf16))  # gather seq, enter tp region
+    qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(bf16))
+    q, k_, v = qkv[0], qkv[1], qkv[2]
+    scores = jnp.einsum("bshk,bthk->bhst", q, k_) / (c.d_head**0.5)
+    mask = jnp.tril(jnp.ones((c.seq, c.seq), bool))
+    scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
+    probs = jnp.exp(scores - scores.max(-1, keepdims=True))
+    probs = (probs / probs.sum(-1, keepdims=True)).astype(bf16)
+    att = jnp.einsum("bhst,bthk->bshk", probs, v)
+    att = jnp.einsum("bshk,hkd->bsd", att, layer["wo"].astype(bf16))
+    x = x + constrain("seq", att)  # row-parallel out: XLA reduce-scatters into sp
+
+    # --- mlp (tp over d_ff) ---
+    h = _rms_norm(constrain("seq", x), layer["ln2"])
+    h = constrain("hidden", h.astype(bf16))
+    h = jnp.einsum("bsd,df->bsf", h, layer["w1"].astype(bf16))
+    h = jnp.where(h > 0, h, 0.01 * h)  # leaky relu: cheap, fusion-friendly
+    h = jnp.einsum("bsf,fd->bsd", h, layer["w2"].astype(bf16))
+    x = x + constrain("seq", h)
+    return x
+
+
+def forward(params, tokens, config: BurninConfig, mesh=None):
+    """Logits for next-token prediction.  ``mesh=None`` → no constraints
+    (single-chip compile check); with a mesh, sp/tp constraints are applied."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    if mesh is None:
+        constrain = lambda kind, arr: arr  # noqa: E731
+    else:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        specs = {
+            # sp region: residual stream sequence-sharded over the tp axis
+            "seq": P(("data", "fsdp"), "model", None),
+            # tp region: full sequence, hidden ops sharded over heads/ffn
+            "hidden": P(("data", "fsdp"), None, None),
+        }
+
+        def constrain(kind, arr):
+            return jax.lax.with_sharding_constraint(arr, NamedSharding(mesh, specs[kind]))
+
+    x = params["embed"][tokens] + params["pos"][None, :, :]
+
+    block = jax.checkpoint(functools.partial(_block, config=c, constrain=constrain))
+
+    def scan_body(h, layer):
+        return block(layer, h), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = _rms_norm(constrain("seq", x), params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.bfloat16), params["embed"].astype(jnp.bfloat16))
+    return logits.astype(jnp.float32)
+
+
+def _loss(params, tokens, config: BurninConfig, mesh=None):
+    import jax.numpy as jnp
+
+    logits = forward(params, tokens, config, mesh)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    zmax = logits.max(-1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(logits - zmax), -1)) + zmax[..., 0]
+    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - picked)
+
+
+def make_train_step(config: BurninConfig, mesh=None):
+    """Build (train_step, init_state).
+
+    ``train_step(state, tokens) -> (state, loss)`` is a single jitted SGD+
+    momentum step.  With a mesh, params/momentum are fsdp/tp-sharded and the
+    batch is dp-sharded — the complete pjit training step the driver
+    dry-runs multi-chip.  Momentum (not adam) keeps optimizer state at 1x
+    params: burn-in measures the slice, not the optimizer.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    loss_fn = functools.partial(_loss, config=c, mesh=mesh)
+
+    def step(state, tokens):
+        params, mom = state
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        mom = jax.tree_util.tree_map(lambda m, g: 0.9 * m + g, mom, grads)
+        params = jax.tree_util.tree_map(lambda p, m: p - c.learning_rate * m, params, mom)
+        return (params, mom), loss
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=0), _init_state(c)
+
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    pspecs = param_specs(c)
+    state_sh = (
+        jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs),
+        jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs),
+    )
+    tok_sh = NamedSharding(mesh, P(("data", "fsdp"), None))
+    jitted = jax.jit(
+        step,
+        in_shardings=(state_sh, tok_sh),
+        out_shardings=(state_sh, NamedSharding(mesh, P())),
+        donate_argnums=0,
+    )
+    state = jax.device_put(_init_state(c), state_sh)
+    return jitted, state
+
+
+def _init_state(config: BurninConfig):
+    import jax
+
+    params = init_params(config)
+    mom = jax.tree_util.tree_map(lambda p: p * 0, params)
+    return (params, mom)
+
+
+def sample_tokens(config: BurninConfig, key=None):
+    """Deterministic synthetic data with learnable structure (token t+1 is a
+    fixed permutation of token t plus noise) so loss measurably decreases."""
+    import jax
+    import jax.numpy as jnp
+
+    if key is None:
+        key = jax.random.PRNGKey(42)
+    c = config
+    k1, k2 = jax.random.split(key)
+    start = jax.random.randint(k1, (c.batch, 1), 0, c.vocab)
+    steps = jnp.arange(c.seq)[None, :]
+    toks = (start + steps * 17) % c.vocab  # fixed affine walk: predictable
+    noise = jax.random.bernoulli(k2, 0.05, (c.batch, c.seq))
+    rand = jax.random.randint(k2, (c.batch, c.seq), 0, c.vocab)
+    return jnp.where(noise, rand, toks).astype(jnp.int32)
+
+
+@dataclass
+class TrainReport:
+    """Outcome of a burn-in training run on the claimed slice."""
+
+    ok: bool
+    steps: int
+    loss_first: float
+    loss_last: float
+    step_seconds_p50: float
+    tokens_per_second: float
+    error: str = ""
+
+
+def train(
+    config: "BurninConfig | None" = None,
+    mesh=None,
+    steps: int = 10,
+) -> TrainReport:
+    """Run the burn-in: jit the step over ``mesh`` (or single device), train
+    ``steps`` steps on synthetic data, assert the loss went down."""
+    import time
+
+    import jax
+
+    c = config or BurninConfig()
+    if mesh is not None:
+        c = c.scaled_to(mesh)
+    try:
+        step_fn, state = make_train_step(c, mesh)
+        tokens = sample_tokens(c)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            tokens = jax.device_put(tokens, NamedSharding(mesh, P(("data", "fsdp"), None)))
+        losses, times = [], []
+        for _ in range(max(2, steps)):
+            t0 = time.perf_counter()
+            state, loss = step_fn(state, tokens)
+            loss = float(jax.device_get(loss))
+            times.append(time.perf_counter() - t0)
+            losses.append(loss)
+        import statistics
+
+        p50 = statistics.median(times[1:])  # drop compile step
+        return TrainReport(
+            ok=losses[-1] < losses[0] and all(l == l for l in losses),  # NaN check
+            steps=len(losses),
+            loss_first=losses[0],
+            loss_last=losses[-1],
+            step_seconds_p50=p50,
+            tokens_per_second=c.batch * c.seq / p50 if p50 > 0 else 0.0,
+        )
+    except Exception as e:  # burn-in reports, never crashes the pod
+        return TrainReport(
+            ok=False, steps=0, loss_first=0.0, loss_last=0.0,
+            step_seconds_p50=0.0, tokens_per_second=0.0, error=f"{type(e).__name__}: {e}",
+        )

@@ -45,10 +45,22 @@ class SliceReport:
     gang: "dict | None" = None
     checks: "list[dict]" = field(default_factory=list)
     busbw_gbps: float = 0.0
+    train: "dict | None" = None
     errors: "list[str]" = field(default_factory=list)
 
     def to_json(self) -> str:
-        return json.dumps(asdict(self), sort_keys=True)
+        # allow_nan=False would raise; a diverged burn-in (NaN loss) must
+        # still produce a parseable report, so map non-finite floats to None.
+        def clean(v):
+            if isinstance(v, float) and (v != v or v in (float("inf"), float("-inf"))):
+                return None
+            if isinstance(v, dict):
+                return {k: clean(x) for k, x in v.items()}
+            if isinstance(v, list):
+                return [clean(x) for x in v]
+            return v
+
+        return json.dumps(clean(asdict(self)), sort_keys=True)
 
 
 def _expected_device_count(env) -> "int | None":
@@ -63,6 +75,7 @@ def validate_slice(
     topology: "Topology | str | None" = None,
     expected_devices: "int | None" = None,
     bandwidth_mbytes: int = 16,
+    train_steps: int = 0,
     env: "dict[str, str] | None" = None,
 ) -> SliceReport:
     """Run the full burn-in against the devices visible to this process."""
@@ -156,6 +169,20 @@ def validate_slice(
         if not r.ok:
             report.errors.append(f"gang_allreduce: {r.error}")
 
+    # Heavy stage: a real sharded training step on the slice (burnin.py) —
+    # MXU + ICI under training load, with a loss-decrease assertion.  Skipped
+    # once acceptance has already failed: training over a wedged ICI link can
+    # hang the pod, and the verdict is already decided.
+    if train_steps > 0 and not report.errors:
+        from tpu_dra.parallel.burnin import train as burnin_train
+        from tpu_dra.parallel.mesh import logical_mesh
+
+        tmesh = logical_mesh(devices, data=-1, fsdp=1, model=1)
+        tr = burnin_train(mesh=tmesh, steps=train_steps)
+        report.train = asdict(tr)
+        if not tr.ok:
+            report.errors.append(f"burnin train: {tr.error or 'loss did not decrease'}")
+
     report.ok = not report.errors
     return report
 
@@ -167,10 +194,26 @@ def _compact(r: CollectiveReport) -> dict:
 
 
 def main(argv: "list[str] | None" = None) -> int:
-    """CLI: ``python -m tpu_dra.parallel.validate [topology]``."""
+    """CLI: ``python -m tpu_dra.parallel.validate [topology] [--train N]``."""
     argv = sys.argv[1:] if argv is None else argv
+    train_steps = 0
+    if "--train" in argv:
+        i = argv.index("--train")
+        raw = argv[i + 1] if i + 1 < len(argv) else "5"
+        try:
+            train_steps = int(raw)
+        except ValueError:
+            # Must stay a JSON-report-emitting program even on bad args.
+            report = SliceReport(errors=[f"--train expects an integer, got {raw!r}"])
+            print(report.to_json())
+            return 1
+        if train_steps < 0:
+            report = SliceReport(errors=[f"--train must be >= 0, got {train_steps}"])
+            print(report.to_json())
+            return 1
+        argv = argv[:i] + argv[i + 2 :]
     topology = argv[0] if argv else None
-    report = validate_slice(topology=topology)
+    report = validate_slice(topology=topology, train_steps=train_steps)
     print(report.to_json())
     return 0 if report.ok else 1
 
