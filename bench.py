@@ -1,0 +1,177 @@
+"""Headline benchmark: claim→PodRunning latency through the full driver stack.
+
+BASELINE.md north star: "Claim→PodRunning p50, 4-chip topology claim:
+target < 5 s".  The reference publishes no numbers (BASELINE.json
+``published:{}``), so the 5 s target is the baseline we report against:
+``vs_baseline = target_s / measured_p50_s`` (> 1 means beating the target,
+bigger is better).
+
+What one sample measures — the entire allocation pipeline, in process:
+pod created with a ResourceClaimTemplate for a 2x2x1 topology claim →
+claim-template controller stamps the claim → scheduler publishes a
+PodSchedulingContext → controller driver runs UnsuitableNodes (ICI-contiguous
+placement search) → scheduler selects a node → controller allocates into the
+NAS CRD → kubelet calls the node plugin's NodePrepareResource over the real
+gRPC unix-socket pair → CDI spec written → pod Running.  Teardown (pod
+delete → deallocate → watch-driven node GC) runs between samples so every
+sample allocates from a fragmented-then-healed inventory, not a cold one.
+
+A secondary stanza runs the burn-in LM forward on whatever accelerator the
+bench host has (the real chip under the driver's runner) and reports
+tokens/s, so the compute path is exercised too.  Output: ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import tempfile
+import time
+
+TARGET_S = 5.0  # BASELINE.json north_star: claim→PodRunning p50 < 5 s
+SAMPLES = 24
+NS = "default"
+
+
+def bench_claim_to_running(samples: int = SAMPLES) -> "dict":
+    from tpu_dra.api.k8s import (
+        Pod,
+        PodResourceClaim,
+        PodResourceClaimSource,
+        PodSpec,
+        ResourceClaimParametersReference,
+        ResourceClaimSpec,
+        ResourceClaimTemplate,
+        ResourceClaimTemplateSpec,
+        ResourceClass,
+    )
+    from tpu_dra.api.meta import ObjectMeta
+    from tpu_dra.api.tpu_v1alpha1 import (
+        GROUP_NAME,
+        TpuClaimParameters,
+        TpuClaimParametersSpec,
+    )
+    from tpu_dra.sim import SimCluster
+
+    with tempfile.TemporaryDirectory() as root:
+        cluster = SimCluster(root, nodes=4, mesh="2x2x1")
+        cluster.start()
+        try:
+            cluster.clientset.resource_classes().create(
+                ResourceClass(
+                    metadata=ObjectMeta(name="tpu.google.com"), driver_name=GROUP_NAME
+                )
+            )
+            cluster.clientset.tpu_claim_parameters(NS).create(
+                TpuClaimParameters(
+                    metadata=ObjectMeta(name="topo-2x2", namespace=NS),
+                    spec=TpuClaimParametersSpec(topology="2x2x1"),
+                )
+            )
+            cluster.clientset.resource_claim_templates(NS).create(
+                ResourceClaimTemplate(
+                    metadata=ObjectMeta(name="topo-2x2", namespace=NS),
+                    spec=ResourceClaimTemplateSpec(
+                        spec=ResourceClaimSpec(
+                            resource_class_name="tpu.google.com",
+                            parameters_ref=ResourceClaimParametersReference(
+                                api_group=GROUP_NAME,
+                                kind="TpuClaimParameters",
+                                name="topo-2x2",
+                            ),
+                        )
+                    ),
+                )
+            )
+
+            def make_pod(name: str) -> Pod:
+                return Pod(
+                    metadata=ObjectMeta(name=name, namespace=NS),
+                    spec=PodSpec(
+                        resource_claims=[
+                            PodResourceClaim(
+                                name="tpu",
+                                source=PodResourceClaimSource(
+                                    resource_claim_template_name="topo-2x2"
+                                ),
+                            )
+                        ]
+                    ),
+                )
+
+            latencies = []
+            for i in range(samples):
+                name = f"bench-{i}"
+                t0 = time.perf_counter()
+                cluster.clientset.pods(NS).create(make_pod(name))
+                cluster.wait_for_pod_running(NS, name, timeout=30.0)
+                latencies.append(time.perf_counter() - t0)
+                cluster.delete_pod(NS, name)
+                _wait_chips_free(cluster, timeout=30.0)
+            return {
+                "p50_s": statistics.median(latencies),
+                "p95_s": sorted(latencies)[int(0.95 * (len(latencies) - 1))],
+                "mean_s": statistics.fmean(latencies),
+                "samples": len(latencies),
+            }
+        finally:
+            cluster.stop()
+
+
+def _wait_chips_free(cluster, timeout: float) -> None:
+    """Wait until every NAS shows zero allocated claims (teardown settled)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        nases = [
+            cluster.clientset.node_allocation_states(cluster.namespace).get(n.name)
+            for n in cluster.nodes
+        ]
+        if all(not nas.spec.allocated_claims for nas in nases) and all(
+            not nas.spec.prepared_claims for nas in nases
+        ):
+            return
+        time.sleep(cluster.poll_s)
+    raise TimeoutError("teardown did not settle")
+
+
+def bench_burnin_forward() -> "dict":
+    """Burn-in LM training throughput on this host's accelerator."""
+    try:
+        import jax
+
+        from tpu_dra.parallel.burnin import BurninConfig, train
+
+        report = train(BurninConfig(), mesh=None, steps=6)
+        return {
+            "platform": jax.devices()[0].platform,
+            "tokens_per_s": report.tokens_per_second,
+            "ok": bool(report.ok),
+        }
+    except Exception as e:  # bench must still emit its line without a chip
+        return {"platform": "none", "tokens_per_s": 0.0, "ok": False, "error": str(e)}
+
+
+def main() -> int:
+    alloc = bench_claim_to_running(SAMPLES)
+    compute = bench_burnin_forward()
+    p50 = alloc["p50_s"]
+    line = {
+        "metric": "claim_to_pod_running_p50",
+        "value": round(p50, 4),
+        "unit": "s",
+        "vs_baseline": round(TARGET_S / p50, 2) if p50 > 0 else 0.0,
+        "extras": {
+            "target_s": TARGET_S,
+            "p95_s": round(alloc["p95_s"], 4),
+            "mean_s": round(alloc["mean_s"], 4),
+            "samples": alloc["samples"],
+            "burnin": compute,
+        },
+    }
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

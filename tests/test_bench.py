@@ -1,0 +1,34 @@
+"""bench.py smoke: the driver contract is one parseable JSON line with the
+required keys, and the allocation pipeline actually completes."""
+
+import json
+import subprocess
+import sys
+
+
+def test_bench_claim_to_running_small():
+    import bench
+
+    out = bench.bench_claim_to_running(samples=3)
+    assert out["samples"] == 3
+    assert 0 < out["p50_s"] < 30
+
+
+def test_bench_emits_one_json_line(monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "SAMPLES", 2)
+    monkeypatch.setattr(
+        bench, "bench_burnin_forward", lambda: {"platform": "skipped", "tokens_per_s": 0.0, "ok": True}
+    )
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench.main()
+    assert rc == 0
+    lines = [l for l in buf.getvalue().splitlines() if l.strip()]
+    assert len(lines) == 1
+    parsed = json.loads(lines[0])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(parsed)

@@ -41,6 +41,7 @@ from dataclasses import dataclass
 
 __all__ = [
     "BurninConfig",
+    "burnin_mesh",
     "init_params",
     "param_specs",
     "forward",
@@ -48,6 +49,27 @@ __all__ = [
     "train",
     "TrainReport",
 ]
+
+
+def burnin_mesh(devices):
+    """(data, fsdp, model) mesh over the slice with every axis non-trivial
+    when the device count allows — so burn-in traffic includes the tp psums,
+    sp gather/scatter pairs, and ZeRO-3 param all-gathers, not just the dp
+    gradient all-reduce.  model gets the innermost axis (nearest ICI
+    neighbors carry the per-layer collectives)."""
+    from tpu_dra.parallel.mesh import logical_mesh
+
+    n = len(devices)
+    model = _pow2_divisor(n, cap=2)
+    fsdp = _pow2_divisor(n // model, cap=2)
+    return logical_mesh(devices, data=-1, fsdp=fsdp, model=model)
+
+
+def _pow2_divisor(n: int, cap: int) -> int:
+    p = 1
+    while p * 2 <= min(n, cap) and n % (p * 2) == 0:
+        p *= 2
+    return p
 
 
 @dataclass(frozen=True)

@@ -127,9 +127,17 @@ def validate_slice(
         )
 
     if isinstance(topology, str):
-        topology = Topology.parse(topology)
+        try:
+            topology = Topology.parse(topology)
+        except ValueError as e:
+            report.errors.append(str(e))
+            return report
     if topology is None:
-        topology = topology_from_env(environ)
+        try:
+            topology = topology_from_env(environ)
+        except ValueError as e:
+            report.errors.append(f"malformed TPU_CHIPS_PER_HOST_BOUNDS: {e}")
+            return report
     if topology is None:
         topology = Topology(len(devices), 1, 1)
     report.topology = str(topology)
@@ -174,11 +182,9 @@ def validate_slice(
     # once acceptance has already failed: training over a wedged ICI link can
     # hang the pod, and the verdict is already decided.
     if train_steps > 0 and not report.errors:
-        from tpu_dra.parallel.burnin import train as burnin_train
-        from tpu_dra.parallel.mesh import logical_mesh
+        from tpu_dra.parallel.burnin import burnin_mesh, train as burnin_train
 
-        tmesh = logical_mesh(devices, data=-1, fsdp=1, model=1)
-        tr = burnin_train(mesh=tmesh, steps=train_steps)
+        tr = burnin_train(mesh=burnin_mesh(devices), steps=train_steps)
         report.train = asdict(tr)
         if not tr.ok:
             report.errors.append(f"burnin train: {tr.error or 'loss did not decrease'}")
