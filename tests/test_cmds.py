@@ -1,0 +1,226 @@
+"""CLI binaries: flag parsing with env mirrors, and the real binaries wired
+over the HTTP apiserver shim (plugin handshake + gRPC prepare, controller
+allocation, set-nas-status flips)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api.k8s import Node
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.client.clientset import ClientSet
+from tpu_dra.client.restserver import ClusterConfig, RestApiServer
+from tpu_dra.cmds import controller as controller_cmd
+from tpu_dra.cmds import plugin as plugin_cmd
+from tpu_dra.cmds import set_nas_status as sns_cmd
+from tpu_dra.sim.httpapiserver import HttpApiServer
+
+NS = "tpu-dra"
+
+
+@pytest.fixture
+def shim():
+    server = HttpApiServer().start()
+    yield server
+    server.stop()
+
+
+def rest_clients(shim) -> ClientSet:
+    return ClientSet(RestApiServer(ClusterConfig(server=shim.url), qps=1000, burst=1000))
+
+
+def test_controller_flags_env_mirrors(monkeypatch):
+    monkeypatch.setenv("WORKERS", "3")
+    monkeypatch.setenv("POD_NAMESPACE", "other")
+    args = controller_cmd.parse_args([])
+    assert args.workers == 3
+    assert args.namespace == "other"
+    # explicit flag wins over env
+    args = controller_cmd.parse_args(["--workers", "7"])
+    assert args.workers == 7
+
+
+def test_plugin_flags_defaults():
+    args = plugin_cmd.parse_args(["--node-name", "n1"])
+    assert args.cdi_root == "/var/run/cdi"
+    assert args.plugin_root == "/var/lib/kubelet/plugins"
+    assert args.node_name == "n1"
+
+
+def test_set_nas_status_roundtrip(shim):
+    clients = rest_clients(shim)
+    clients.nodes().create(Node(metadata=ObjectMeta(name="n1")))
+    rc = sns_cmd.main(
+        [
+            "--status",
+            "NotReady",
+            "--node-name",
+            "n1",
+            "--namespace",
+            NS,
+            "--apiserver",
+            shim.url,
+        ]
+    )
+    assert rc == 0
+    nas = clients.node_allocation_states(NS).get("n1")
+    assert nas.status == "NotReady"
+    # Owner-ref to the Node was attached (nodeallocationstate.go:62-80 analog)
+    assert nas.metadata.owner_references[0].kind == "Node"
+    sns_cmd.main(
+        ["--status", "Ready", "--node-name", "n1", "--namespace", NS, "--apiserver", shim.url]
+    )
+    assert clients.node_allocation_states(NS).get("n1").status == "Ready"
+
+
+def test_plugin_app_handshake_and_grpc_prepare(shim, tmp_path):
+    """The real plugin binary wiring end to end: REST → NAS Ready with
+    discovered chips; claim allocated in NAS → kubelet gRPC prepare returns
+    CDI device names."""
+    clients = rest_clients(shim)
+    clients.nodes().create(Node(metadata=ObjectMeta(name="n1")))
+
+    args = plugin_cmd.parse_args(
+        [
+            "--node-name", "n1",
+            "--namespace", NS,
+            "--apiserver", shim.url,
+            "--mock-tpulib-mesh", "2x2x1",
+            "--cdi-root", str(tmp_path / "cdi"),
+            "--plugin-root", str(tmp_path / "plugins"),
+            "--registrar-root", str(tmp_path / "registry"),
+            "--state-dir", str(tmp_path / "state"),
+            "--http-endpoint", "127.0.0.1:0",
+        ]
+    )
+    app = plugin_cmd.PluginApp(args)
+    app.start()
+    try:
+        nas = clients.node_allocation_states(NS).get("n1")
+        assert nas.status == nascrd.STATUS_READY
+        assert len(nas.spec.allocatable_devices) == 4  # 2x2x1 mesh
+
+        # Simulate the controller writing an allocation for one chip.
+        chip = nas.spec.allocatable_devices[0]
+        claim_uid = "claim-uid-1"
+        nas.spec.allocated_claims[claim_uid] = nascrd.AllocatedDevices(
+            claim_info=nascrd.ClaimInfo(uid=claim_uid, name="c1", namespace=NS),
+            tpu=nascrd.AllocatedTpus(
+                devices=[nascrd.AllocatedTpu(uuid=chip.tpu.uuid, coord=chip.tpu.coord)]
+            ),
+        )
+        clients.node_allocation_states(NS).update(nas)
+
+        # kubelet's side of the contract: gRPC over the unix socket.
+        from tpu_dra.plugin.kubeletplugin import DRAClient
+
+        sock = os.path.join(str(tmp_path / "plugins"), app.driver_name, "plugin.sock")
+        dra = DRAClient(sock)
+        devices = dra.node_prepare_resource(NS, claim_uid, claim_name="c1")
+        assert devices and "claim" in devices[0]
+    finally:
+        app.stop()
+    # Shutdown flipped the NAS NotReady (preStop semantics).
+    assert clients.node_allocation_states(NS).get("n1").status == nascrd.STATUS_NOT_READY
+
+
+def test_controller_app_allocates_over_rest(shim):
+    """ControllerApp against the shim: a claim with a selected node gets
+    allocated into the NAS by the real reconcile loop."""
+    from tpu_dra.api.k8s import (
+        Pod,
+        PodResourceClaim,
+        PodResourceClaimSource,
+        PodSchedulingContext,
+        PodSchedulingContextSpec,
+        PodSpec,
+        ResourceClaim,
+        ResourceClaimParametersReference,
+        ResourceClaimSpec,
+        ResourceClass,
+    )
+    from tpu_dra.api.tpu_v1alpha1 import (
+        GROUP_NAME,
+        TpuClaimParameters,
+        TpuClaimParametersSpec,
+    )
+
+    clients = rest_clients(shim)
+
+    # Seed: node, ready NAS with 4 chips, resource class, params, claim.
+    clients.nodes().create(Node(metadata=ObjectMeta(name="n1")))
+    from tpu_dra.plugin.tpulib import MockTpuLib
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        lib = MockTpuLib("2x2x1", state_dir=td, ici_domain="n1")
+        nas = nascrd.NodeAllocationState(
+            metadata=ObjectMeta(name="n1", namespace=NS),
+            spec=nascrd.NodeAllocationStateSpec(
+                allocatable_devices=lib.enumerate_all_possible_devices()
+            ),
+            status=nascrd.STATUS_READY,
+        )
+    clients.node_allocation_states(NS).create(nas)
+    clients.resource_classes().create(
+        ResourceClass(metadata=ObjectMeta(name="tpu.google.com"), driver_name=GROUP_NAME)
+    )
+    clients.tpu_claim_parameters("default").create(
+        TpuClaimParameters(
+            metadata=ObjectMeta(name="two-chips", namespace="default"),
+            spec=TpuClaimParametersSpec(count=2),
+        )
+    )
+
+    args = controller_cmd.parse_args(["--apiserver", shim.url, "--namespace", NS, "--workers", "2"])
+    app = controller_cmd.ControllerApp(args)
+    app.start()
+    try:
+        claim = ResourceClaim(
+            metadata=ObjectMeta(name="c1", namespace="default"),
+            spec=ResourceClaimSpec(
+                resource_class_name="tpu.google.com",
+                parameters_ref=ResourceClaimParametersReference(
+                    api_group=GROUP_NAME, kind="TpuClaimParameters", name="two-chips"
+                ),
+            ),
+        )
+        clients.resource_claims("default").create(claim)
+        pod = Pod(
+            metadata=ObjectMeta(name="p1", namespace="default", uid="pod-uid-1"),
+            spec=PodSpec(
+                node_name="",
+                resource_claims=[
+                    PodResourceClaim(
+                        name="tpu",
+                        source=PodResourceClaimSource(resource_claim_name="c1"),
+                    )
+                ],
+            ),
+        )
+        clients.pods("default").create(pod)
+        clients.pod_scheduling_contexts("default").create(
+            PodSchedulingContext(
+                metadata=ObjectMeta(name="p1", namespace="default"),
+                spec=PodSchedulingContextSpec(
+                    selected_node="n1", potential_nodes=["n1"]
+                ),
+            )
+        )
+
+        deadline = time.monotonic() + 15
+        allocated = None
+        while time.monotonic() < deadline:
+            got = clients.resource_claims("default").get("c1")
+            if got.status and got.status.allocation:
+                allocated = got
+                break
+            time.sleep(0.1)
+        assert allocated is not None, "claim never allocated"
+        nas = clients.node_allocation_states(NS).get("n1")
+        assert len(nas.spec.allocated_claims) == 1
+    finally:
+        app.stop()

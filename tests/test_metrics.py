@@ -1,0 +1,79 @@
+"""Metrics registry, exposition format, and the HTTP endpoint."""
+
+import json
+import urllib.request
+
+from tpu_dra.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsServer,
+    Registry,
+)
+
+
+def test_counter_labels_and_exposition():
+    c = Counter("reqs_total", "requests")
+    c.inc(kind="a")
+    c.inc(2, kind="a")
+    c.inc(kind="b")
+    text = c.collect()
+    assert '# TYPE reqs_total counter' in text
+    assert 'reqs_total{kind="a"} 3.0' in text
+    assert 'reqs_total{kind="b"} 1.0' in text
+
+
+def test_gauge_function_sampled_at_scrape():
+    g = Gauge("depth", "queue depth")
+    vals = [5]
+    g.set_function(lambda: vals[0])
+    assert "depth 5.0" in g.collect()
+    vals[0] = 7
+    assert "depth 7.0" in g.collect()
+
+
+def test_histogram_buckets_cumulative():
+    h = Histogram("lat", "latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    text = h.collect()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1.0"} 2' in text
+    assert 'lat_bucket{le="+Inf"} 3' in text
+    assert "lat_count 3" in text
+    assert "lat_sum 5.55" in text
+
+
+def test_histogram_timer():
+    h = Histogram("t", "t")
+    with h.time(op="x"):
+        pass
+    assert 't_count{op="x"} 1' in h.collect()
+
+
+def test_http_endpoint_serves_metrics_health_debug():
+    reg = Registry()
+    c = reg.counter("hits_total", "hits")
+    c.inc()
+    ready = [False]
+    server = MetricsServer("127.0.0.1:0", registry=reg, ready_check=lambda: ready[0])
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "hits_total 1.0" in body
+        assert urllib.request.urlopen(f"{base}/healthz").status == 200
+        try:
+            urllib.request.urlopen(f"{base}/readyz")
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        ready[0] = True
+        assert urllib.request.urlopen(f"{base}/readyz").status == 200
+        threads = urllib.request.urlopen(f"{base}/debug/threads").read().decode()
+        assert "metrics-http" in threads
+    finally:
+        server.stop()
+
+
+import urllib.error  # noqa: E402

@@ -115,6 +115,11 @@ class FakeApiServer:
         self._rv += 1
         return str(self._rv)
 
+    def latest_rv(self) -> str:
+        """Current global resourceVersion (list/watch bookkeeping)."""
+        with self._lock:
+            return str(self._rv)
+
     def _meta(self, obj: dict) -> dict:
         return obj.setdefault("metadata", {})
 

@@ -1,0 +1,362 @@
+"""REST backend: the FakeApiServer protocol against a real Kubernetes apiserver.
+
+The reference reaches the apiserver through client-go + a generated clientset
+(pkg/flags/kubeclient.go:32-117, pkg/nvidia.com/resource/clientset/**); here
+the entire client stack above the wire is shared with the fake (clientset.py
+works against either backend), and this module is only the wire: stdlib
+HTTPS with bearer-token / client-cert auth, the standard REST path scheme,
+and streaming watches.
+
+Semantics matched to FakeApiServer (what driver logic depends on):
+
+- errors map to the same ApiError taxonomy — 404→NotFound, 409 with reason
+  AlreadyExists→AlreadyExists, other 409→Conflict (feeds retry_on_conflict),
+  400/422→Invalid;
+- ``watch()`` delivers events from the moment of subscription: a LIST
+  captures the collection resourceVersion and the stream starts there;
+- client-side rate limiting, token bucket QPS/burst, defaulting to the
+  reference's QPS 5 / burst 10 (pkg/flags/kubeclient.go:43-57).
+
+Scheme ``http://`` is accepted for plain test servers; real clusters use
+``https://`` with the in-cluster service-account files or a kubeconfig.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+
+from tpu_dra.client.apiserver import (
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+    Watch,
+)
+
+# kind -> (group, version, plural, namespaced)
+RESOURCES: "dict[str, tuple[str, str, str, bool]]" = {
+    "Pod": ("", "v1", "pods", True),
+    "Node": ("", "v1", "nodes", False),
+    "Deployment": ("apps", "v1", "deployments", True),
+    "ResourceClaim": ("resource.k8s.io", "v1alpha2", "resourceclaims", True),
+    "ResourceClaimTemplate": ("resource.k8s.io", "v1alpha2", "resourceclaimtemplates", True),
+    "ResourceClass": ("resource.k8s.io", "v1alpha2", "resourceclasses", False),
+    "PodSchedulingContext": ("resource.k8s.io", "v1alpha2", "podschedulingcontexts", True),
+    "DeviceClassParameters": ("tpu.resource.google.com", "v1alpha1", "deviceclassparameters", False),
+    "TpuClaimParameters": ("tpu.resource.google.com", "v1alpha1", "tpuclaimparameters", True),
+    "SubsliceClaimParameters": ("tpu.resource.google.com", "v1alpha1", "subsliceclaimparameters", True),
+    "NodeAllocationState": ("nas.tpu.resource.google.com", "v1alpha1", "nodeallocationstates", True),
+}
+
+# Kinds whose status lives behind a real /status subresource upstream.  The
+# NAS CRD deliberately has none (reference nas.go:161-167 +genclient:noStatus).
+STATUS_SUBRESOURCE = {"Pod", "Node", "Deployment", "ResourceClaim", "PodSchedulingContext"}
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+@dataclass
+class ClusterConfig:
+    """Where the apiserver is and how to authenticate."""
+
+    server: str
+    token: str = ""
+    ca_file: str = ""
+    client_cert_file: str = ""
+    client_key_file: str = ""
+    insecure_skip_verify: bool = False
+
+    @classmethod
+    def in_cluster(cls) -> "ClusterConfig":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise ApiError("not running in a cluster (KUBERNETES_SERVICE_HOST unset)")
+        with open(f"{SA_DIR}/token") as f:
+            token = f.read().strip()
+        return cls(server=f"https://{host}:{port}", token=token, ca_file=f"{SA_DIR}/ca.crt")
+
+    @classmethod
+    def from_kubeconfig(cls, path: "str | None" = None, context: "str | None" = None) -> "ClusterConfig":
+        import yaml
+
+        path = path or os.environ.get("KUBECONFIG") or os.path.expanduser("~/.kube/config")
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = context or cfg.get("current-context")
+        ctx = _named(cfg.get("contexts", []), ctx_name, "context")
+        cluster = _named(cfg.get("clusters", []), ctx["cluster"], "cluster")
+        user = _named(cfg.get("users", []), ctx["user"], "user")
+
+        out = cls(server=cluster["server"])
+        out.ca_file = _file_or_data(cluster, "certificate-authority", "kubeconfig-ca")
+        out.insecure_skip_verify = bool(cluster.get("insecure-skip-tls-verify"))
+        out.token = user.get("token", "")
+        out.client_cert_file = _file_or_data(user, "client-certificate", "kubeconfig-cert")
+        out.client_key_file = _file_or_data(user, "client-key", "kubeconfig-key")
+        return out
+
+    @classmethod
+    def autodetect(cls, kubeconfig: "str | None" = None) -> "ClusterConfig":
+        """In-cluster when the SA mount exists, kubeconfig otherwise —
+        client-go's rule and the flag default in pkg/flags/kubeclient.go."""
+        if kubeconfig:
+            return cls.from_kubeconfig(kubeconfig)
+        if os.path.exists(f"{SA_DIR}/token"):
+            return cls.in_cluster()
+        return cls.from_kubeconfig()
+
+
+def _named(items: list, name: str, what: str) -> dict:
+    """Kubeconfig lists are [{name: n, <what>: {...}}, ...]."""
+    for item in items or []:
+        if item.get("name") == name:
+            return item.get(what, {})
+    raise ApiError(f"kubeconfig has no {what} named {name!r}")
+
+
+def _file_or_data(section: dict, key: str, label: str) -> str:
+    """Return a file path for `key` or materialize `key`-data to a temp file."""
+    if section.get(key):
+        return section[key]
+    data = section.get(f"{key}-data")
+    if not data:
+        return ""
+    import base64
+
+    f = tempfile.NamedTemporaryFile(prefix=f"tpu-dra-{label}-", delete=False)
+    f.write(base64.b64decode(data))
+    f.close()
+    return f.name
+
+
+class _TokenBucket:
+    """Client-side rate limiter (reference default QPS 5 / burst 10,
+    pkg/flags/kubeclient.go:43-57)."""
+
+    def __init__(self, qps: float, burst: int):
+        self.qps = max(qps, 0.001)
+        self.burst = max(burst, 1)
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self) -> None:
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
+                self._last = now
+                if self._tokens >= 1:
+                    self._tokens -= 1
+                    return
+                wait = (1 - self._tokens) / self.qps
+            time.sleep(wait)
+
+
+@dataclass
+class RestApiServer:
+    """FakeApiServer-protocol client over a real apiserver."""
+
+    config: ClusterConfig
+    qps: float = 5.0
+    burst: int = 10
+    timeout_s: float = 30.0
+    _limiter: _TokenBucket = field(init=False, repr=False)
+    _ssl: "ssl.SSLContext | None" = field(init=False, repr=False, default=None)
+
+    def __post_init__(self):
+        self._limiter = _TokenBucket(self.qps, self.burst)
+        if self.config.server.startswith("https://"):
+            ctx = ssl.create_default_context(
+                cafile=self.config.ca_file or None
+            )
+            if self.config.insecure_skip_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            if self.config.client_cert_file:
+                ctx.load_cert_chain(self.config.client_cert_file, self.config.client_key_file or None)
+            self._ssl = ctx
+
+    # -- wire ---------------------------------------------------------------
+
+    def _path(self, kind: str, namespace: str, name: "str | None", subresource: "str | None" = None) -> str:
+        try:
+            group, version, plural, namespaced = RESOURCES[kind]
+        except KeyError:
+            raise InvalidError(f"unknown kind {kind!r}") from None
+        base = f"/api/{version}" if not group else f"/apis/{group}/{version}"
+        if namespaced and namespace:
+            base += f"/namespaces/{namespace}"
+        base += f"/{plural}"
+        if name:
+            base += f"/{name}"
+        if subresource:
+            base += f"/{subresource}"
+        return base
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: "dict | None" = None,
+        *,
+        stream: bool = False,
+        timeout: "float | None" = None,
+    ):
+        self._limiter.acquire()
+        url = self.config.server + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.config.token:
+            req.add_header("Authorization", f"Bearer {self.config.token}")
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=timeout if timeout is not None else self.timeout_s, context=self._ssl
+            )
+        except urllib.error.HTTPError as e:
+            raise _to_api_error(e) from None
+        except urllib.error.URLError as e:
+            raise ApiError(f"apiserver unreachable: {e.reason}") from None
+        if stream:
+            return resp
+        with resp:
+            return json.loads(resp.read() or b"{}")
+
+    # -- FakeApiServer protocol ---------------------------------------------
+
+    def create(self, obj: dict) -> dict:
+        obj = _stamp(obj)
+        meta = obj.get("metadata", {})
+        path = self._path(obj["kind"], meta.get("namespace", ""), None)
+        return self._request("POST", path, obj)
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        return self._request("GET", self._path(kind, namespace, name))
+
+    def list(self, kind: str, namespace: "str | None" = None) -> list[dict]:
+        body = self._request("GET", self._path(kind, namespace or "", None))
+        items = body.get("items", [])
+        for item in items:  # lists omit per-item kind; callers rely on it
+            item.setdefault("kind", kind)
+        return items
+
+    def update(self, obj: dict) -> dict:
+        obj = _stamp(obj)
+        meta = obj.get("metadata", {})
+        path = self._path(obj["kind"], meta.get("namespace", ""), meta.get("name"))
+        return self._request("PUT", path, obj)
+
+    def update_status(self, obj: dict) -> dict:
+        obj = _stamp(obj)
+        meta = obj.get("metadata", {})
+        sub = "status" if obj["kind"] in STATUS_SUBRESOURCE else None
+        path = self._path(obj["kind"], meta.get("namespace", ""), meta.get("name"), sub)
+        return self._request("PUT", path, obj)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._request("DELETE", self._path(kind, namespace, name))
+
+    def watch(self, kind: str, namespace: "str | None" = None, name: "str | None" = None) -> Watch:
+        """List to pin a resourceVersion, then stream events after it."""
+        listing = self._request("GET", self._path(kind, namespace or "", None))
+        rv = listing.get("metadata", {}).get("resourceVersion", "")
+
+        stop_flag = threading.Event()
+        watch = Watch(lambda w: stop_flag.set())
+
+        def pump():
+            backoff = 0.2
+            current_rv = rv
+            while not stop_flag.is_set():
+                qs = f"?watch=true&allowWatchBookmarks=true&resourceVersion={current_rv}"
+                if name:
+                    qs += f"&fieldSelector=metadata.name%3D{name}"
+                try:
+                    resp = self._request(
+                        "GET",
+                        self._path(kind, namespace or "", None) + qs,
+                        stream=True,
+                        timeout=300.0,
+                    )
+                    with resp:
+                        backoff = 0.2
+                        for line in resp:
+                            if stop_flag.is_set():
+                                return
+                            if not line.strip():
+                                continue
+                            event = json.loads(line)
+                            etype = event.get("type", "")
+                            obj = event.get("object", {})
+                            if etype == "BOOKMARK":
+                                current_rv = obj.get("metadata", {}).get("resourceVersion", current_rv)
+                                continue
+                            if etype == "ERROR":
+                                current_rv = ""  # relist on 410 Gone
+                                break
+                            obj.setdefault("kind", kind)
+                            current_rv = obj.get("metadata", {}).get("resourceVersion", current_rv)
+                            if name and obj.get("metadata", {}).get("name") != name:
+                                continue
+                            watch.deliver({"type": etype, "object": obj})
+                except ApiError as e:
+                    if getattr(e, "code", 0) == 410:
+                        current_rv = ""  # expired RV (etcd compaction): relist
+                except (OSError, TimeoutError, ValueError):
+                    # Idle-stream socket timeout / truncated chunk / torn JSON:
+                    # reconnect from the last seen RV, never kill the pump.
+                    pass
+                if stop_flag.is_set():
+                    return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+                if not current_rv:
+                    try:
+                        relist = self._request("GET", self._path(kind, namespace or "", None))
+                        current_rv = relist.get("metadata", {}).get("resourceVersion", "")
+                    except ApiError:
+                        pass
+
+        threading.Thread(target=pump, name=f"watch-{kind}", daemon=True).start()
+        return watch
+
+
+def _stamp(obj: dict) -> dict:
+    """Fill apiVersion/kind (serde strips neither; the wire needs both)."""
+    obj = dict(obj)
+    kind = obj.get("kind")
+    if kind and "apiVersion" not in obj:
+        group, version, _, _ = RESOURCES.get(kind, ("", "v1", "", True))
+        obj["apiVersion"] = f"{group}/{version}" if group else version
+    return obj
+
+
+def _to_api_error(e: "urllib.error.HTTPError") -> ApiError:
+    try:
+        status = json.loads(e.read() or b"{}")
+    except Exception:
+        status = {}
+    message = status.get("message", str(e))
+    reason = status.get("reason", "")
+    if e.code == 404:
+        return NotFoundError(message)
+    if e.code == 409:
+        return AlreadyExistsError(message) if reason == "AlreadyExists" else ConflictError(message)
+    if e.code in (400, 422):
+        return InvalidError(message)
+    err = ApiError(message)
+    err.code = e.code
+    return err

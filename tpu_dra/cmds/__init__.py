@@ -1,0 +1,12 @@
+"""tpu_dra.cmds — the three binaries of the driver (reference ships three
+from one image, Dockerfile.ubuntu:50-53):
+
+- ``python -m tpu_dra.cmds.controller``     cluster-level allocation brain
+  (reference cmd/nvidia-dra-controller/main.go:64)
+- ``python -m tpu_dra.cmds.plugin``         per-node kubelet plugin
+  (reference cmd/nvidia-dra-plugin/main.go:64)
+- ``python -m tpu_dra.cmds.set_nas_status`` init/preStop NAS status flipper
+  (reference cmd/set-nas-status/main.go:37)
+
+Shared flag groups live in flags.py (reference pkg/flags/*).
+"""

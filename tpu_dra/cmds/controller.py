@@ -1,0 +1,98 @@
+"""tpu-dra-controller: the cluster-level allocation brain (component C1;
+reference cmd/nvidia-dra-controller/main.go:45-223).
+
+Wires clientset → ControllerDriver → reconcile Controller, serves
+metrics/health/debug when --http-endpoint is set, runs until SIGTERM/SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from tpu_dra.cmds import flags
+from tpu_dra.version import version_string
+
+logger = logging.getLogger("tpu-dra-controller")
+
+
+def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="tpu-dra-controller",
+        description="DRA controller for google.com/tpu resources",
+    )
+    parser.add_argument("--version", action="version", version=version_string())
+    parser.add_argument(
+        "--workers",
+        type=int,
+        default=int(flags._env_default("WORKERS", "10")),
+        help="concurrent claim workers (reference default 10, main.go:79) [WORKERS]",
+    )
+    flags.add_kube_flags(parser)
+    flags.add_logging_flags(parser)
+    flags.add_http_flags(parser)
+    parser.add_argument(
+        "--namespace",
+        default=flags._env_default("POD_NAMESPACE", "tpu-dra"),
+        help="namespace holding NAS + parameter CRs [POD_NAMESPACE]",
+    )
+    return parser.parse_args(argv)
+
+
+class ControllerApp:
+    """The assembled controller process; start()/stop() for tests, run()
+    (signal-driven) for the real binary."""
+
+    def __init__(self, args: argparse.Namespace):
+        from tpu_dra.controller.driver import ControllerDriver
+        from tpu_dra.controller.reconciler import Controller
+
+        self.args = args
+        self.clientset = flags.build_clientset(args)
+        self.driver = ControllerDriver(self.clientset, args.namespace)
+        self.controller = Controller(self.driver, self.clientset, workers=args.workers)
+        self.metrics_server = None
+        if args.http_endpoint:
+            from tpu_dra.utils.metrics import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                args.http_endpoint,
+                metrics_path=args.metrics_path,
+                pprof_path=args.pprof_path,
+            )
+
+    def start(self) -> None:
+        if self.metrics_server:
+            self.metrics_server.start()
+            logger.info("http endpoint on %s", self.args.http_endpoint)
+        self.controller.start()
+        logger.info(
+            "controller %s running with %d workers", version_string(), self.args.workers
+        )
+
+    def stop(self) -> None:
+        self.controller.stop()
+        if self.metrics_server:
+            self.metrics_server.stop()
+
+    def run(self) -> int:
+        stop = threading.Event()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: stop.set())
+        self.start()
+        stop.wait()
+        logger.info("shutting down")
+        self.stop()
+        return 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    args = parse_args(argv)
+    flags.setup_logging(args)
+    return ControllerApp(args).run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

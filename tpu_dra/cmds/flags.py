@@ -1,0 +1,191 @@
+"""Reusable flag groups (component C13; reference pkg/flags/{kubeclient.go:
+32-117,logging.go:33-88,nodeallocationstate.go:32-80}).
+
+Every flag mirrors an environment variable, like the reference's urfave/cli
+``EnvVars`` — the Helm chart sets env, operators set flags.  Precedence:
+explicit flag > env var > default.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+
+def _env_default(var: str, default):
+    return os.environ.get(var, default)
+
+
+def add_kube_flags(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("kubernetes client")
+    g.add_argument(
+        "--kubeconfig",
+        default=_env_default("KUBECONFIG", ""),
+        help="kubeconfig path; empty = in-cluster when available [KUBECONFIG]",
+    )
+    g.add_argument(
+        "--apiserver",
+        default=_env_default("TPU_DRA_APISERVER", ""),
+        help="explicit apiserver URL (e.g. the local http shim, "
+        "python -m tpu_dra.sim.httpapiserver) — bypasses kubeconfig "
+        "[TPU_DRA_APISERVER]",
+    )
+    g.add_argument(
+        "--kube-apiserver-qps",
+        type=float,
+        default=float(_env_default("KUBE_APISERVER_QPS", "5")),
+        help="client-side request rate limit [KUBE_APISERVER_QPS]",
+    )
+    g.add_argument(
+        "--kube-apiserver-burst",
+        type=int,
+        default=int(_env_default("KUBE_APISERVER_BURST", "10")),
+        help="client-side request burst [KUBE_APISERVER_BURST]",
+    )
+    g.add_argument(
+        "--fake-apiserver",
+        action="store_true",
+        default=_env_default("TPU_DRA_FAKE_APISERVER", "") == "1",
+        help="TESTING: run against a process-local in-memory apiserver "
+        "(state dies with the process; use --apiserver + "
+        "python -m tpu_dra.sim.httpapiserver to share state across "
+        "binaries) [TPU_DRA_FAKE_APISERVER=1]",
+    )
+
+
+def add_logging_flags(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("logging")
+    g.add_argument(
+        "--log-level",
+        default=_env_default("LOG_LEVEL", "info"),
+        choices=["debug", "info", "warning", "error"],
+        help="log verbosity [LOG_LEVEL]",
+    )
+    g.add_argument(
+        "--log-json",
+        action="store_true",
+        default=_env_default("LOG_JSON", "") == "1",
+        help="one JSON object per log line (reference logging.go JSON "
+        "feature gate) [LOG_JSON=1]",
+    )
+
+
+def add_nas_flags(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("node allocation state")
+    g.add_argument(
+        "--namespace",
+        default=_env_default("POD_NAMESPACE", "tpu-dra"),
+        help="namespace of the NodeAllocationState CRs [POD_NAMESPACE]",
+    )
+    g.add_argument(
+        "--node-name",
+        default=_env_default("NODE_NAME", ""),
+        help="this node's name; the NAS CR shares it [NODE_NAME]",
+    )
+
+
+def add_http_flags(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("http endpoint")
+    g.add_argument(
+        "--http-endpoint",
+        default=_env_default("HTTP_ENDPOINT", ""),
+        help="host:port for metrics/health/debug; empty disables "
+        "[HTTP_ENDPOINT]",
+    )
+    g.add_argument(
+        "--metrics-path",
+        default=_env_default("METRICS_PATH", "/metrics"),
+        help="HTTP path for Prometheus metrics [METRICS_PATH]",
+    )
+    g.add_argument(
+        "--pprof-path",
+        default=_env_default("PPROF_PATH", "/debug"),
+        help="HTTP path prefix for thread dumps / profiles [PPROF_PATH]",
+    )
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        import json
+
+        out = {
+            "ts": self.formatTime(record),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def setup_logging(args: argparse.Namespace) -> None:
+    handler = logging.StreamHandler(sys.stderr)
+    if args.log_json:
+        handler.setFormatter(_JsonFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname).1s %(name)s: %(message)s")
+        )
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(args.log_level.upper())
+
+
+def build_clientset(args: argparse.Namespace):
+    """ClientSet against the real apiserver — or, for tests/demos, a
+    process-local fake (the reference's fake-clientset seam, SURVEY.md §4)."""
+    from tpu_dra.client.clientset import ClientSet
+
+    if args.fake_apiserver:
+        from tpu_dra.client.apiserver import FakeApiServer
+
+        return ClientSet(FakeApiServer())
+
+    from tpu_dra.client.restserver import ClusterConfig, RestApiServer
+
+    if args.apiserver:
+        config = ClusterConfig(server=args.apiserver)
+    else:
+        config = ClusterConfig.autodetect(args.kubeconfig or None)
+    server = RestApiServer(
+        config, qps=args.kube_apiserver_qps, burst=args.kube_apiserver_burst
+    )
+    return ClientSet(server)
+
+
+def build_nas(args: argparse.Namespace, clientset):
+    """NAS CR skeleton owned by this Node (reference
+    pkg/flags/nodeallocationstate.go:62-80) + its client wrapper."""
+    from tpu_dra.api import nas_v1alpha1 as nascrd
+    from tpu_dra.api.meta import ObjectMeta, OwnerReference
+    from tpu_dra.client.apiserver import NotFoundError
+    from tpu_dra.client.nasclient import NasClient
+
+    if not args.node_name:
+        raise SystemExit("--node-name (or NODE_NAME) is required")
+
+    owner_refs = []
+    try:
+        node = clientset.nodes().get(args.node_name)
+        owner_refs.append(
+            OwnerReference(
+                api_version="v1",
+                kind="Node",
+                name=node.metadata.name,
+                uid=node.metadata.uid,
+            )
+        )
+    except NotFoundError:
+        pass  # standalone/demo mode: no Node object to own the NAS
+
+    nas = nascrd.NodeAllocationState(
+        metadata=ObjectMeta(
+            name=args.node_name,
+            namespace=args.namespace,
+            owner_references=owner_refs,
+        )
+    )
+    return nas, NasClient(nas, clientset)

@@ -1,0 +1,219 @@
+"""tpu-dra-plugin: the per-node kubelet plugin (component C7; reference
+cmd/nvidia-dra-plugin/main.go:45-200).
+
+Startup: build the device layer (real devfs enumeration, or the mock for
+demos/tests) → CDI handler → DeviceState → NAS handshake
+(NotReady → discover → publish → Ready, NodeDriver) → kubelet gRPC pair.
+Shutdown (SIGTERM from the DaemonSet preStop): flip NAS NotReady and stop
+serving, exactly the reference's signal path (main.go:188-197).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import threading
+
+from tpu_dra.cmds import flags
+from tpu_dra.version import version_string
+
+logger = logging.getLogger("tpu-dra-plugin")
+
+DEFAULT_PLUGIN_ROOT = "/var/lib/kubelet/plugins"
+DEFAULT_REGISTRAR_ROOT = "/var/lib/kubelet/plugins_registry"
+DEFAULT_CDI_ROOT = "/var/run/cdi"
+DEFAULT_STATE_DIR = "/var/run/tpu-dra"
+
+
+def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="tpu-dra-plugin",
+        description="DRA kubelet plugin for google.com/tpu resources",
+    )
+    parser.add_argument("--version", action="version", version=version_string())
+    g = parser.add_argument_group("paths")
+    g.add_argument(
+        "--cdi-root",
+        default=flags._env_default("CDI_ROOT", DEFAULT_CDI_ROOT),
+        help="directory for transient per-claim CDI specs [CDI_ROOT]",
+    )
+    g.add_argument(
+        "--plugin-root",
+        default=flags._env_default("PLUGIN_ROOT", DEFAULT_PLUGIN_ROOT),
+        help="kubelet plugins dir (DRA socket lives under it) [PLUGIN_ROOT]",
+    )
+    g.add_argument(
+        "--registrar-root",
+        default=flags._env_default("REGISTRAR_ROOT", DEFAULT_REGISTRAR_ROOT),
+        help="kubelet plugin-registration dir [REGISTRAR_ROOT]",
+    )
+    g.add_argument(
+        "--state-dir",
+        default=flags._env_default("STATE_DIR", DEFAULT_STATE_DIR),
+        help="driver scratch state (subslice registry, proxy dirs) [STATE_DIR]",
+    )
+    d = parser.add_argument_group("device layer")
+    d.add_argument(
+        "--devfs-root",
+        default=flags._env_default("DEVFS_ROOT", "/dev"),
+        help="where TPU device nodes live [DEVFS_ROOT]",
+    )
+    d.add_argument(
+        "--mock-tpulib-mesh",
+        default=flags._env_default("MOCK_TPULIB_MESH", ""),
+        help="TESTING: use the mock chip enumerator with this mesh (e.g. "
+        "2x2x1) instead of scanning devfs [MOCK_TPULIB_MESH]",
+    )
+    d.add_argument(
+        "--mock-partitionable",
+        action="store_true",
+        default=flags._env_default("MOCK_PARTITIONABLE", "") == "1",
+        help="TESTING: mock chips advertise core subslicing "
+        "[MOCK_PARTITIONABLE=1]",
+    )
+    flags.add_kube_flags(parser)
+    flags.add_logging_flags(parser)
+    flags.add_nas_flags(parser)
+    flags.add_http_flags(parser)
+    return parser.parse_args(argv)
+
+
+def build_tpulib(args: argparse.Namespace):
+    if args.mock_tpulib_mesh:
+        from tpu_dra.plugin.tpulib import MockTpuLib
+
+        return MockTpuLib(
+            args.mock_tpulib_mesh,
+            partitionable=args.mock_partitionable,
+            state_dir=os.path.join(args.state_dir, "tpulib"),
+            ici_domain=args.node_name or "local",
+        )
+    from tpu_dra.plugin.tpulib import RealTpuLib
+
+    return RealTpuLib(state_dir=args.state_dir, devfs_root=args.devfs_root)
+
+
+class PluginApp:
+    """The assembled node-plugin process."""
+
+    def __init__(self, args: argparse.Namespace):
+        from tpu_dra.controller.driver import DRIVER_NAME
+        from tpu_dra.plugin.cdi import CDIHandler
+        from tpu_dra.plugin.device_state import DeviceState
+        from tpu_dra.plugin.driver import NodeDriver
+        from tpu_dra.plugin.kubeletplugin import DRAPluginServer
+        from tpu_dra.plugin.sharing import RuntimeProxyManager, TimeSlicingManager
+
+        self.args = args
+        self.driver_name = DRIVER_NAME
+        self.clientset = flags.build_clientset(args)
+        self.tpulib = build_tpulib(args)
+
+        for path in (
+            args.cdi_root,
+            os.path.join(args.plugin_root, self.driver_name),
+            args.registrar_root,
+            args.state_dir,
+        ):
+            os.makedirs(path, exist_ok=True)
+
+        self.state = DeviceState(
+            self.tpulib,
+            CDIHandler(args.cdi_root, self.tpulib),
+            TimeSlicingManager(self.tpulib),
+            RuntimeProxyManager(
+                self.clientset,
+                self.tpulib,
+                node_name=args.node_name or "local",
+                namespace=args.namespace,
+                proxy_root=os.path.join(args.state_dir, "proxy"),
+            ),
+        )
+        self.nas, self.nasclient = flags.build_nas(args, self.clientset)
+        self.node_driver = None
+        self.server = None
+        self.metrics_server = None
+        if args.http_endpoint:
+            from tpu_dra.utils.metrics import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                args.http_endpoint,
+                metrics_path=args.metrics_path,
+                pprof_path=args.pprof_path,
+                ready_check=self._ready,
+            )
+
+    def _ready(self) -> bool:
+        from tpu_dra.api import nas_v1alpha1 as nascrd
+
+        return self.nas.status == nascrd.STATUS_READY
+
+    def start(self) -> None:
+        from tpu_dra.plugin.driver import NodeDriver
+        from tpu_dra.plugin.kubeletplugin import DRAPluginServer
+
+        if self.metrics_server:
+            self.metrics_server.start()
+        # NodeDriver's constructor runs the NotReady→publish→Ready handshake.
+        self.node_driver = NodeDriver(self.nas, self.nasclient, self.state)
+        plugin_socket = os.path.join(
+            self.args.plugin_root, self.driver_name, "plugin.sock"
+        )
+        registrar_socket = os.path.join(
+            self.args.registrar_root, f"{self.driver_name}-reg.sock"
+        )
+        self.server = DRAPluginServer(
+            self.node_driver,
+            self.driver_name,
+            plugin_socket=plugin_socket,
+            registrar_socket=registrar_socket,
+        )
+        self.server.start()
+        logger.info(
+            "plugin %s serving on %s (node %s)",
+            version_string(),
+            plugin_socket,
+            self.args.node_name,
+        )
+
+    def stop(self) -> None:
+        from tpu_dra.api import nas_v1alpha1 as nascrd
+
+        if self.server:
+            self.server.stop()
+        if self.node_driver:
+            from tpu_dra.client.retry import retry_on_conflict
+
+            def flip():
+                self.nasclient.get()
+                self.nasclient.update_status(nascrd.STATUS_NOT_READY)
+
+            try:
+                retry_on_conflict(flip)
+            except Exception:
+                logger.exception("failed to flip NAS NotReady on shutdown")
+            self.node_driver.shutdown()
+        if self.metrics_server:
+            self.metrics_server.stop()
+
+    def run(self) -> int:
+        stop = threading.Event()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: stop.set())
+        self.start()
+        stop.wait()
+        logger.info("shutting down")
+        self.stop()
+        return 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    args = parse_args(argv)
+    flags.setup_logging(args)
+    return PluginApp(args).run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,57 @@
+"""tpu-set-nas-status: flip the node's NAS CR to Ready/NotReady (component
+C15; reference cmd/set-nas-status/main.go:37-124).
+
+Used by the plugin DaemonSet as an initContainer (NotReady before the plugin
+starts) and preStop hook (NotReady on teardown) — helm kubeletplugin.yaml:
+53-66,108-112.  GetOrCreate + update with conflict retry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from tpu_dra.cmds import flags
+from tpu_dra.version import version_string
+
+logger = logging.getLogger("tpu-set-nas-status")
+
+
+def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="tpu-set-nas-status",
+        description="set the NodeAllocationState status for this node",
+    )
+    parser.add_argument("--version", action="version", version=version_string())
+    parser.add_argument(
+        "--status",
+        required=True,
+        choices=["Ready", "NotReady"],
+        help="status to write",
+    )
+    flags.add_kube_flags(parser)
+    flags.add_logging_flags(parser)
+    flags.add_nas_flags(parser)
+    return parser.parse_args(argv)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    args = parse_args(argv)
+    flags.setup_logging(args)
+
+    from tpu_dra.client.retry import retry_on_conflict
+
+    clientset = flags.build_clientset(args)
+    _, nasclient = flags.build_nas(args, clientset)
+
+    def flip():
+        nasclient.get_or_create()
+        nasclient.update_status(args.status)
+
+    retry_on_conflict(flip)
+    logger.info("NAS %s/%s -> %s", args.namespace, args.node_name, args.status)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

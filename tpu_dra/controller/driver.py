@@ -29,6 +29,7 @@ from tpu_dra.controller.nodelock import PerNodeMutex
 from tpu_dra.controller.subslice_allocator import SubsliceDriver
 from tpu_dra.controller.tpu_allocator import TpuDriver
 from tpu_dra.controller.types import ClaimAllocation
+from tpu_dra.utils.metrics import ALLOCATE_SECONDS, UNSUITABLE_SECONDS
 
 DRIVER_NAME = tpucrd.GROUP_NAME
 DRIVER_API_GROUP = tpucrd.GROUP_NAME
@@ -93,7 +94,7 @@ class ControllerDriver:
         if not selected_node:
             raise NotImplementedError("immediate allocations not yet supported")
 
-        with self.lock.locked(selected_node):
+        with ALLOCATE_SECONDS.time(), self.lock.locked(selected_node):
             nas, client = self._nas_client(selected_node)
             client.get()
 
@@ -166,9 +167,10 @@ class ControllerDriver:
         # Claim liveness is node-independent: resolve the dead pending set
         # once per fan-out, outside the per-node locks, then drop the dead
         # entries cheaply inside each node's pass.
-        dead = self._dead_pending_claims(potential_nodes)
-        for node in potential_nodes:
-            self._unsuitable_node(pod, cas, node, dead)
+        with UNSUITABLE_SECONDS.time():
+            dead = self._dead_pending_claims(potential_nodes)
+            for node in potential_nodes:
+                self._unsuitable_node(pod, cas, node, dead)
         for ca in cas:
             seen = set()
             ca.unsuitable_nodes = [

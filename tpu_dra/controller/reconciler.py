@@ -41,6 +41,7 @@ from tpu_dra.client.apiserver import ApiError, ConflictError, NotFoundError
 from tpu_dra.client.clientset import ClientSet
 from tpu_dra.controller.driver import ControllerDriver
 from tpu_dra.controller.types import ClaimAllocation
+from tpu_dra.utils.metrics import SYNC_TOTAL, WORKQUEUE_DEPTH
 
 logger = logging.getLogger(__name__)
 
@@ -96,6 +97,10 @@ class _DelayQueue:
             self._deadline[key] = when
             heapq.heappush(self._heap, (when, key))
             self._cond.notify()
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._deadline)
 
     def get(self, timeout: float = 0.2) -> tuple | None:
         with self._cond:
@@ -162,6 +167,7 @@ class Controller:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
+        WORKQUEUE_DEPTH.set_function(self._queue.depth)
         for kind in ("ResourceClaim", "PodSchedulingContext"):
             watch = self.clientset.server.watch(kind)
             self._watches.append(watch)
@@ -206,21 +212,26 @@ class Controller:
             key = self._queue.get(timeout=0.2)
             if key is None:
                 continue
+            outcome = "ok"
             try:
                 requeue_delay = self._sync_key(key)
             except ConflictError:
+                outcome = "conflict"
                 # Optimistic-concurrency loser: retry promptly.
                 self._retry(key, immediate=True)
             except ApiError as e:
+                outcome = "error"
                 logger.warning("sync %s failed: %s", key, e)
                 self._retry(key)
             except NotImplementedError as e:
                 # Unsupported request (e.g. Immediate-mode allocation,
                 # driver.py) — terminal until the object changes; retrying
                 # would hot-loop forever on the same answer.
+                outcome = "unsupported"
                 logger.warning("sync %s unsupported, not retrying: %s", key, e)
                 self._retries.pop(key, None)
             except Exception:
+                outcome = "error"
                 logger.exception("sync %s failed", key)
                 self._retry(key)
             else:
@@ -228,6 +239,7 @@ class Controller:
                 if requeue_delay is not None:
                     self._queue.add(key, requeue_delay)
             finally:
+                SYNC_TOTAL.inc(kind=key[0], outcome=outcome)
                 self._queue.done(key)
 
     def _retry(self, key: tuple, immediate: bool = False) -> None:

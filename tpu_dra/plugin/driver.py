@@ -30,6 +30,7 @@ from tpu_dra.client.apiserver import ApiError
 from tpu_dra.client.nasclient import NasClient
 from tpu_dra.client.retry import retry_on_conflict
 from tpu_dra.plugin.device_state import DeviceState
+from tpu_dra.utils.metrics import ALLOCATED_CHIPS, PREPARE_SECONDS
 
 logger = logging.getLogger(__name__)
 
@@ -64,6 +65,15 @@ class NodeDriver:
 
         retry_on_conflict(startup)
 
+        def _allocated_count() -> int:
+            total = 0
+            for alloc in self._nas.spec.allocated_claims.values():
+                devs = alloc.tpu or alloc.subslice
+                total += len(devs.devices) if devs else 0
+            return total
+
+        ALLOCATED_CHIPS.set_function(_allocated_count, node=nas.metadata.name)
+
         if start_gc:
             self._gc_thread = threading.Thread(
                 target=self._cleanup_stale_state_continuously,
@@ -77,7 +87,7 @@ class NodeDriver:
     def node_prepare_resource(self, claim_uid: str) -> list[str]:
         """Idempotent prepare; returns qualified CDI device names
         (driver.go:103-126)."""
-        with self._lock:
+        with PREPARE_SECONDS.time(), self._lock:
             is_prepared, devices = self._is_prepared(claim_uid)
             if is_prepared:
                 return devices

@@ -1,0 +1,260 @@
+"""HTTP facade over FakeApiServer speaking the Kubernetes REST wire protocol.
+
+The reference's zero-hardware harness is a kind cluster (demo/clusters/kind);
+this is the in-between rung: the real binaries (tpu_dra.cmds.*) talking the
+real wire protocol (client/restserver.py) to an in-process store with real
+k8s semantics (client/apiserver.py) — no kubelet or etcd required.  Used by
+the CLI e2e tests and the local demo (`python -m tpu_dra.sim.httpapiserver`).
+
+Implements exactly the verbs RestApiServer emits:
+
+- ``GET    <collection>``                 list (collection resourceVersion)
+- ``GET    <collection>?watch=true``      streaming NDJSON watch events
+- ``GET    <resource>``                   get
+- ``POST   <collection>``                 create
+- ``PUT    <resource>[/status]``          update / update_status
+- ``DELETE <resource>``                   delete
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+from tpu_dra.client.apiserver import ApiError, FakeApiServer
+from tpu_dra.client.restserver import RESOURCES
+
+# plural -> (kind, namespaced); paths carry plurals, the store wants kinds.
+_BY_PLURAL = {plural: (kind, namespaced) for kind, (_, _, plural, namespaced) in RESOURCES.items()}
+
+
+def _parse_path(path: str):
+    """-> (kind, namespace, name, subresource) or None."""
+    parts = [p for p in path.split("/") if p]
+    # strip /api/v1 or /apis/<group>/<version>
+    if not parts or parts[0] not in ("api", "apis"):
+        return None
+    parts = parts[2:] if parts[0] == "api" else parts[3:]
+    namespace = ""
+    if len(parts) >= 2 and parts[0] == "namespaces":
+        namespace = unquote(parts[1])
+        parts = parts[2:]
+    if not parts:
+        return None
+    entry = _BY_PLURAL.get(parts[0])
+    if entry is None:
+        return None
+    kind, _ = entry
+    name = unquote(parts[1]) if len(parts) > 1 else ""
+    subresource = parts[2] if len(parts) > 2 else ""
+    return kind, namespace, name, subresource
+
+
+class HttpApiServer:
+    """Serve ``store`` (a FakeApiServer) on 127.0.0.1:<port>."""
+
+    def __init__(self, store: "FakeApiServer | None" = None, port: int = 0):
+        self.store = store or FakeApiServer()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            # -- helpers ----------------------------------------------------
+
+            def _send_json(self, code: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _send_error(self, e: ApiError):
+                reason = {
+                    404: "NotFound",
+                    409: "Conflict",
+                    400: "Invalid",
+                    422: "Invalid",
+                }.get(e.code, "InternalError")
+                if e.code == 409 and "already exists" in e.message:
+                    reason = "AlreadyExists"
+                self._send_json(
+                    e.code,
+                    {
+                        "kind": "Status",
+                        "status": "Failure",
+                        "message": e.message,
+                        "reason": reason,
+                        "code": e.code,
+                    },
+                )
+
+            def _read_body(self) -> dict:
+                length = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            # -- verbs ------------------------------------------------------
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                route = _parse_path(parsed.path)
+                if route is None:
+                    return self._send_json(404, {"message": "unknown path"})
+                kind, namespace, name, _ = route
+                query = parse_qs(parsed.query)
+                if query.get("watch", ["false"])[0] == "true":
+                    return self._watch(kind, namespace or None, query)
+                try:
+                    if name:
+                        self._send_json(200, outer.store.get(kind, namespace, name))
+                    else:
+                        items = outer.store.list(kind, namespace or None)
+                        self._send_json(
+                            200,
+                            {
+                                "kind": f"{kind}List",
+                                "metadata": {"resourceVersion": outer.store.latest_rv()},
+                                "items": items,
+                            },
+                        )
+                except ApiError as e:
+                    self._send_error(e)
+
+            def _watch(self, kind: str, namespace: "str | None", query: dict):
+                field_sel = query.get("fieldSelector", [""])[0]
+                name = ""
+                if field_sel.startswith("metadata.name="):
+                    name = field_sel.split("=", 1)[1]
+                watch = outer.store.watch(kind, namespace, name or None)
+                # Replay semantics: the client watches "from resourceVersion
+                # N", but the store only delivers events from subscription
+                # time.  Close the LIST→subscribe gap by emitting a synthetic
+                # MODIFIED for every object that changed after N — consumers
+                # are level-triggered, so a duplicate is harmless and a
+                # dropped event is not.
+                replay: list[dict] = []
+                try:
+                    since = int(query.get("resourceVersion", ["0"])[0] or 0)
+                except ValueError:
+                    since = 0
+                # rv=0 ("state unspecified") replays everything current.
+                for obj in outer.store.list(kind, namespace):
+                    meta = obj.get("metadata", {})
+                    if name and meta.get("name") != name:
+                        continue
+                    try:
+                        rv = int(meta.get("resourceVersion", "0"))
+                    except ValueError:
+                        rv = 0
+                    if rv > since:
+                        replay.append({"type": "MODIFIED", "object": obj})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for event in replay:
+                        line = json.dumps(event).encode() + b"\n"
+                        self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                    self.wfile.flush()
+                    while True:
+                        event = watch.next(timeout=0.5)
+                        if outer._closing.is_set():
+                            return
+                        if event is None:
+                            continue
+                        line = json.dumps(event).encode() + b"\n"
+                        self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    watch.stop()
+
+            def do_POST(self):
+                route = _parse_path(urlparse(self.path).path)
+                if route is None:
+                    return self._send_json(404, {"message": "unknown path"})
+                kind, namespace, _, _ = route
+                try:
+                    obj = self._read_body()
+                    obj.setdefault("kind", kind)
+                    if namespace:
+                        obj.setdefault("metadata", {}).setdefault("namespace", namespace)
+                    self._send_json(201, outer.store.create(obj))
+                except ApiError as e:
+                    self._send_error(e)
+
+            def do_PUT(self):
+                route = _parse_path(urlparse(self.path).path)
+                if route is None:
+                    return self._send_json(404, {"message": "unknown path"})
+                kind, namespace, name, subresource = route
+                try:
+                    obj = self._read_body()
+                    obj.setdefault("kind", kind)
+                    if subresource == "status":
+                        self._send_json(200, outer.store.update_status(obj))
+                    else:
+                        self._send_json(200, outer.store.update(obj))
+                except ApiError as e:
+                    self._send_error(e)
+
+            def do_DELETE(self):
+                route = _parse_path(urlparse(self.path).path)
+                if route is None:
+                    return self._send_json(404, {"message": "unknown path"})
+                kind, namespace, name, _ = route
+                try:
+                    outer.store.delete(kind, namespace, name)
+                    self._send_json(200, {"kind": "Status", "status": "Success"})
+                except ApiError as e:
+                    self._send_error(e)
+
+        self._closing = threading.Event()
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread: "threading.Thread | None" = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "HttpApiServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="http-apiserver", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._closing.set()
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def main() -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="local k8s-wire apiserver (demo)")
+    parser.add_argument("--port", type=int, default=8001)
+    args = parser.parse_args()
+    server = HttpApiServer(port=args.port).start()
+    print(f"serving on {server.url} (ctrl-c to stop)")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

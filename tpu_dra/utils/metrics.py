@@ -1,0 +1,301 @@
+"""Metrics + debug HTTP endpoint (reference: nvidia-dra-controller main.go
+167-214 — promhttp metrics and net/http/pprof, controller binary only; this
+framework gives both binaries the same endpoint).
+
+A small Prometheus-text-exposition registry (the reference registers no
+custom driver metrics, only runtime/workqueue defaults via blank imports
+main.go:37-39 — here the driver's own hot paths are instrumented), plus the
+Go-pprof analog for a Python process: thread stack dumps and an on-demand
+cProfile capture.
+
+Endpoints (paths configurable, matching the reference's --metrics-path /
+--pprof-path flags):
+
+- ``GET <metrics-path>``          Prometheus text format
+- ``GET /healthz`` / ``/readyz``  liveness/readiness
+- ``GET <pprof-path>/threads``    all-thread stack dump (goroutine analog)
+- ``GET <pprof-path>/profile?seconds=N``  all-thread sampling profile
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+def _fmt_labels(labels: "dict[str, str]") -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name, self.help = name, help_
+        self._values: "dict[tuple, float]" = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def collect(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items or [((), 0.0)]:
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return "\n".join(out)
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str):
+        self.name, self.help = name, help_
+        self._values: "dict[tuple, float]" = {}
+        self._fns: "dict[tuple, object]" = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = float(value)
+
+    def set_function(self, fn, **labels: str) -> None:
+        """Sample a callable at scrape time (e.g. workqueue depth)."""
+        with self._lock:
+            self._fns[tuple(sorted(labels.items()))] = fn
+
+    def collect(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            values = dict(sorted(self._values.items()))
+            fns = list(self._fns.items())
+        for key, fn in fns:
+            try:
+                values[key] = float(fn())
+            except Exception:
+                pass
+        for key, v in sorted(values.items()) or [((), 0.0)]:
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return "\n".join(out)
+
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets: "tuple[float, ...]" = DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.buckets = tuple(sorted(buckets))
+        self._counts: "dict[tuple, list[int]]" = {}
+        self._sums: "dict[tuple, float]" = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+                    return
+            counts[-1] += 1
+
+    def time(self, **labels: str):
+        """Context manager: observe the elapsed seconds of the block."""
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self.t0, **labels)
+                return False
+
+        return _Timer()
+
+    def collect(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+        for key, counts in items:
+            labels = dict(key)
+            cumulative = 0
+            for bound, c in zip(self.buckets, counts):
+                cumulative += c
+                le = {**labels, "le": repr(float(bound))}
+                out.append(f"{self.name}_bucket{_fmt_labels(le)} {cumulative}")
+            cumulative += counts[-1]
+            out.append(f'{self.name}_bucket{_fmt_labels({**labels, "le": "+Inf"})} {cumulative}')
+            out.append(f"{self.name}_sum{_fmt_labels(labels)} {sums.get(key, 0.0)}")
+            out.append(f"{self.name}_count{_fmt_labels(labels)} {cumulative}")
+        return "\n".join(out)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: "list[object]" = []
+        self._lock = threading.Lock()
+
+    def register(self, metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name: str, help_: str) -> Counter:
+        return self.register(Counter(name, help_))
+
+    def gauge(self, name: str, help_: str) -> Gauge:
+        return self.register(Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str, buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, help_, buckets))
+
+    def expose(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics)
+        return "\n".join(m.collect() for m in metrics) + "\n"
+
+
+# The default registry with the driver's own hot-path metrics, shared by the
+# controller and plugin processes (each process only moves its own series).
+REGISTRY = Registry()
+
+ALLOCATE_SECONDS = REGISTRY.histogram(
+    "tpu_dra_allocate_seconds", "Controller Allocate() latency per claim"
+)
+UNSUITABLE_SECONDS = REGISTRY.histogram(
+    "tpu_dra_unsuitable_nodes_seconds", "Controller UnsuitableNodes() latency per pod"
+)
+PREPARE_SECONDS = REGISTRY.histogram(
+    "tpu_dra_node_prepare_seconds", "Node plugin NodePrepareResource latency"
+)
+SYNC_TOTAL = REGISTRY.counter(
+    "tpu_dra_sync_total", "Reconcile syncs by kind and outcome"
+)
+ALLOCATED_CHIPS = REGISTRY.gauge(
+    "tpu_dra_allocated_chips", "Chips currently allocated on this node"
+)
+WORKQUEUE_DEPTH = REGISTRY.gauge(
+    "tpu_dra_workqueue_depth", "Items waiting in the controller workqueue"
+)
+
+
+def _dump_threads() -> str:
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
+
+
+def _profile(seconds: float, hz: float = 67.0) -> str:
+    """Sampling profiler over ALL threads (cProfile is per-thread and would
+    only see the sleeping HTTP handler).  Samples sys._current_frames() and
+    aggregates leaf-ward stacks — the Go-pprof model."""
+    seconds = min(seconds, 60.0)
+    interval = 1.0 / hz
+    own = threading.get_ident()
+    counts: "dict[tuple, int]" = {}
+    samples = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == own:
+                continue
+            stack = []
+            while frame is not None and len(stack) < 32:
+                code = frame.f_code
+                stack.append(f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{frame.f_lineno})")
+                frame = frame.f_back
+            counts[tuple(stack)] = counts.get(tuple(stack), 0) + 1
+        samples += 1
+        time.sleep(interval)
+    out = [f"# {samples} samples over {seconds}s across all threads\n"]
+    for stack, n in sorted(counts.items(), key=lambda kv: -kv[1])[:40]:
+        out.append(f"--- {n} samples ({100.0 * n / max(samples, 1):.1f}%) ---")
+        out.extend(f"  {line}" for line in stack[:12])
+        out.append("")
+    return "\n".join(out)
+
+
+class MetricsServer:
+    """Serve metrics + health + debug on one address, in a daemon thread."""
+
+    def __init__(
+        self,
+        address: str,
+        *,
+        registry: Registry = REGISTRY,
+        metrics_path: str = "/metrics",
+        pprof_path: str = "/debug",
+        ready_check=None,
+    ):
+        host, _, port = address.rpartition(":")
+        self.registry = registry
+        self.metrics_path = metrics_path
+        self.pprof_path = pprof_path.rstrip("/")
+        self.ready_check = ready_check or (lambda: True)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet: scrapes are not log events
+                pass
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                try:
+                    if parsed.path == outer.metrics_path:
+                        self._send(200, outer.registry.expose(), "text/plain; version=0.0.4")
+                    elif parsed.path == "/healthz":
+                        self._send(200, "ok\n")
+                    elif parsed.path == "/readyz":
+                        ready = outer.ready_check()
+                        self._send(200 if ready else 503, "ok\n" if ready else "not ready\n")
+                    elif parsed.path == f"{outer.pprof_path}/threads":
+                        self._send(200, _dump_threads())
+                    elif parsed.path == f"{outer.pprof_path}/profile":
+                        secs = float(parse_qs(parsed.query).get("seconds", ["5"])[0])
+                        self._send(200, _profile(secs))
+                    else:
+                        self._send(404, "not found\n")
+                except Exception as e:
+                    self._send(500, f"{e}\n")
+
+            def _send(self, code: int, body: str, ctype: str = "text/plain"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)
+        self._thread: "threading.Thread | None" = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="metrics-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
