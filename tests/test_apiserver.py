@@ -299,3 +299,43 @@ class TestTypedRoundtrip:
         back = client.get("p")
         assert back.spec.topology == "2x2"
         assert back.spec.selector.properties.generation == "v5e"
+
+
+class TestEventLog:
+    """events_since: rv-pinned replay incl. DELETED (the list->watch gap)."""
+
+    def test_replays_modifications_and_deletions(self, server, cs):
+        client = cs.resource_claims("default")
+        client.create(make_claim("a"))
+        since = int(server.latest_rv())
+        b = client.create(make_claim("b"))
+        b.metadata.labels = {"touched": "yes"}
+        client.update(b)
+        client.delete("a")
+
+        events = server.events_since(since, "ResourceClaim", "default")
+        assert [e["type"] for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+        assert events[-1]["object"]["metadata"]["name"] == "a"
+        # DELETED events carry a fresh rv so replay ordering is total.
+        rvs = [int(e["object"]["metadata"]["resourceVersion"]) for e in events]
+        assert rvs == sorted(rvs) and rvs[0] > since
+
+    def test_name_and_namespace_filters(self, server, cs):
+        client = cs.resource_claims("default")
+        client.create(make_claim("a"))
+        client.create(make_claim("b"))
+        only_a = server.events_since(0, "ResourceClaim", "default", "a")
+        assert [e["object"]["metadata"]["name"] for e in only_a] == ["a"]
+        other_ns = server.events_since(0, "ResourceClaim", "elsewhere")
+        assert other_ns == []
+
+    def test_trimmed_log_returns_none(self, server, cs):
+        client = cs.resource_claims("default")
+        client.create(make_claim("seed"))
+        server.EVENT_LOG_CAP = 4
+        for i in range(8):
+            client.create(make_claim(f"c{i}"))
+        assert server.events_since(1, "ResourceClaim", "default") is None
+        # A fresh-enough rv still replays.
+        recent = int(server.latest_rv()) - 1
+        assert server.events_since(recent, "ResourceClaim", "default") is not None

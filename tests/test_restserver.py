@@ -101,3 +101,51 @@ def test_rate_limiter_paces_requests():
         bucket.acquire()
     elapsed = time.monotonic() - t0
     assert elapsed >= 0.03  # 4 over burst at 100qps >= 40ms, margin for timing
+
+
+def test_watch_replays_gap_deletion(rig):
+    """A DELETED event landing between the client's rv-pin LIST and the
+    stream connecting must still be delivered (event-log replay).  Drives
+    the wire protocol directly with the stale rv a racing client holds."""
+    import json
+    import urllib.request
+
+    shim, rest = rig
+    clients = ClientSet(rest)
+    clients.nodes().create(Node(metadata=ObjectMeta(name="doomed")))
+    rv = shim.store.latest_rv()  # client pinned here...
+    shim.store.delete("Node", "", "doomed")  # ...then the gap deletion
+    url = f"{shim.url}/api/v1/nodes?watch=true&resourceVersion={rv}"
+    with urllib.request.urlopen(url, timeout=5.0) as resp:
+        event = json.loads(next(iter(resp)))
+    assert event["type"] == "DELETED"
+    assert event["object"]["metadata"]["name"] == "doomed"
+
+
+def test_watch_410_relist_recovery(rig):
+    """When the event log has been trimmed past the pinned rv, the shim
+    answers 410-style ERROR and the client pump relists and resumes."""
+    shim, rest = rig
+    clients = ClientSet(rest)
+    watch = clients.nodes().watch_all_namespaces()
+    time.sleep(0.3)
+    # Overflow the event log so any old rv is unreachable.
+    shim.store.EVENT_LOG_CAP = 4
+    for i in range(10):
+        shim.store.create({"kind": "Node", "metadata": {"name": f"n{i}"}})
+    # Drain whatever made it through, then prove the stream still lives.
+    deadline = time.monotonic() + 5.0
+    seen = set()
+    while time.monotonic() < deadline and len(seen) < 1:
+        event = watch.next(timeout=0.5)
+        if event:
+            seen.add(event["object"]["metadata"]["name"])
+    shim.store.create({"kind": "Node", "metadata": {"name": "after-gone"}})
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        event = watch.next(timeout=0.5)
+        if event and event["object"]["metadata"]["name"] == "after-gone":
+            break
+    else:
+        raise AssertionError("watch did not recover after 410")
+    watch.stop()

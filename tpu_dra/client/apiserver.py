@@ -102,12 +102,19 @@ class Watch:
 class FakeApiServer:
     """Thread-safe in-memory object store with k8s write/watch semantics."""
 
+    EVENT_LOG_CAP = 2048
+
     def __init__(self):
         self._lock = threading.RLock()
         self._objects: dict[tuple, dict] = {}
         self._rv = 0
         # (kind, namespace or None, name or None) -> set of Watch
         self._watches: dict[tuple, set[Watch]] = {}
+        # Bounded history of emitted events, ordered by resourceVersion, so
+        # watch clients can resume "from rv N" without losing DELETED events
+        # (a live watch only sees events from subscription onward).
+        self._event_log: list[tuple[int, dict]] = []
+        self._evicted_through = 0  # highest rv trimmed out of the log
 
     # -- internals ----------------------------------------------------------
 
@@ -128,6 +135,16 @@ class FakeApiServer:
         meta = obj.get("metadata", {})
         namespace, name = meta.get("namespace", ""), meta.get("name", "")
         event = {"type": event_type, "object": copy.deepcopy(obj)}
+        try:
+            rv = int(meta.get("resourceVersion", "0"))
+        except ValueError:
+            rv = 0
+        # `event` already wraps a private deepcopy; subscribers and
+        # events_since() each copy on their way out, so append it as-is.
+        self._event_log.append((rv, event))
+        if len(self._event_log) > self.EVENT_LOG_CAP:
+            evicted_rv, _ = self._event_log.pop(0)
+            self._evicted_through = max(self._evicted_through, evicted_rv)
         for selector in (
             (kind, None, None),
             (kind, namespace, None),
@@ -171,6 +188,13 @@ class FakeApiServer:
             return copy.deepcopy(obj)
 
     def list(self, kind: str, namespace: str | None = None) -> list[dict]:
+        return self.list_with_rv(kind, namespace)[0]
+
+    def list_with_rv(
+        self, kind: str, namespace: str | None = None
+    ) -> tuple[list[dict], str]:
+        """Atomic (items, collection resourceVersion) snapshot — the pair a
+        real LIST returns, needed to pin a gap-free watch start point."""
         with self._lock:
             out = []
             for (k, ns, _), obj in sorted(self._objects.items()):
@@ -179,7 +203,7 @@ class FakeApiServer:
                 if namespace is not None and ns != namespace:
                     continue
                 out.append(copy.deepcopy(obj))
-            return out
+            return out, str(self._rv)
 
     def _check_rv_and_store(self, obj: dict, subresource: str | None) -> dict:
         key = self._validate(obj)
@@ -249,6 +273,7 @@ class FakeApiServer:
                     self._emit("MODIFIED", obj)
                 return
             del self._objects[key]
+            meta["resourceVersion"] = self._next_rv()
             self._emit("DELETED", obj)
             self._cascade_delete(obj)
 
@@ -286,6 +311,36 @@ class FakeApiServer:
         with self._lock:
             self._watches.setdefault(selector, set()).add(watch)
         return watch
+
+    def events_since(
+        self,
+        since_rv: int,
+        kind: str,
+        namespace: str | None = None,
+        name: str | None = None,
+    ) -> list[dict] | None:
+        """Replay logged events with rv > since_rv matching the selector.
+
+        Returns None when the log has been trimmed past since_rv — the
+        "410 Gone" analog: the caller must relist instead of resuming.
+        """
+        with self._lock:
+            if since_rv < self._evicted_through:
+                return None
+            out = []
+            for rv, event in self._event_log:
+                if rv <= since_rv:
+                    continue
+                obj = event["object"]
+                meta = obj.get("metadata", {})
+                if obj.get("kind") != kind:
+                    continue
+                if namespace is not None and meta.get("namespace", "") != namespace:
+                    continue
+                if name is not None and meta.get("name") != name:
+                    continue
+                out.append(copy.deepcopy(event))
+            return out
 
 
 def _now() -> str:

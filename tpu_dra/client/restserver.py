@@ -314,7 +314,7 @@ class RestApiServer:
                             watch.deliver({"type": etype, "object": obj})
                 except ApiError as e:
                     if getattr(e, "code", 0) == 410:
-                        current_rv = ""  # expired RV (etcd compaction): relist
+                        current_rv = ""  # expired RV (etcd compaction)
                 except (OSError, TimeoutError, ValueError):
                     # Idle-stream socket timeout / truncated chunk / torn JSON:
                     # reconnect from the last seen RV, never kill the pump.
@@ -323,12 +323,11 @@ class RestApiServer:
                     return
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
-                if not current_rv:
-                    try:
-                        relist = self._request("GET", self._path(kind, namespace or "", None))
-                        current_rv = relist.get("metadata", {}).get("resourceVersion", "")
-                    except ApiError:
-                        pass
+                # After an expired RV, current_rv stays "" and the next
+                # connect asks for resourceVersion= (state unspecified): the
+                # server replays full current state as synthetic MODIFIEDs,
+                # so gap events are compensated rather than skipped (a relist
+                # purely to grab a fresh rv would silently drop them).
 
         threading.Thread(target=pump, name=f"watch-{kind}", daemon=True).start()
         return watch

@@ -102,8 +102,6 @@ class PluginApp:
         from tpu_dra.controller.driver import DRIVER_NAME
         from tpu_dra.plugin.cdi import CDIHandler
         from tpu_dra.plugin.device_state import DeviceState
-        from tpu_dra.plugin.driver import NodeDriver
-        from tpu_dra.plugin.kubeletplugin import DRAPluginServer
         from tpu_dra.plugin.sharing import RuntimeProxyManager, TimeSlicingManager
 
         self.args = args
@@ -179,22 +177,14 @@ class PluginApp:
         )
 
     def stop(self) -> None:
-        from tpu_dra.api import nas_v1alpha1 as nascrd
-
         if self.server:
             self.server.stop()
         if self.node_driver:
-            from tpu_dra.client.retry import retry_on_conflict
-
-            def flip():
-                self.nasclient.get()
-                self.nasclient.update_status(nascrd.STATUS_NOT_READY)
-
+            # shutdown() flips the NAS NotReady (the preStop semantic).
             try:
-                retry_on_conflict(flip)
+                self.node_driver.shutdown()
             except Exception:
-                logger.exception("failed to flip NAS NotReady on shutdown")
-            self.node_driver.shutdown()
+                logger.exception("error during node driver shutdown")
         if self.metrics_server:
             self.metrics_server.stop()
 

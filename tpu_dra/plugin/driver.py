@@ -144,6 +144,7 @@ class NodeDriver:
         self._stop.set()
         if self._gc_thread is not None:
             self._gc_thread.join(timeout=5)
+        ALLOCATED_CHIPS.remove_function(node=self._nas.metadata.name)
 
         def flip():
             self._client.get()
@@ -155,15 +156,21 @@ class NodeDriver:
 
     def _cleanup_stale_state_continuously(self) -> None:
         while not self._stop.is_set():
+            # Subscribe BEFORE the snapshot pass: a deallocation landing
+            # between get() and watch() would otherwise never be observed
+            # (the watch only delivers events from subscription onward).
+            watch = None
             try:
+                watch = self._client.watch()
                 self._client.get()
                 self._cleanup_stale_state(self._nas)
             except Exception:
                 logger.exception("error cleaning up stale claim state")
+                if watch is not None:
+                    watch.stop()
                 self._stop.wait(self._error_backoff_s)
                 continue
 
-            watch = self._client.watch()
             try:
                 while not self._stop.is_set():
                     event = watch.next(timeout=0.2)

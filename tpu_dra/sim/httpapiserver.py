@@ -23,7 +23,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, unquote, urlparse
 
-from tpu_dra.client.apiserver import ApiError, FakeApiServer
+from tpu_dra.client.apiserver import AlreadyExistsError, ApiError, FakeApiServer
 from tpu_dra.client.restserver import RESOURCES
 
 # plural -> (kind, namespaced); paths carry plurals, the store wants kinds.
@@ -82,7 +82,7 @@ class HttpApiServer:
                     400: "Invalid",
                     422: "Invalid",
                 }.get(e.code, "InternalError")
-                if e.code == 409 and "already exists" in e.message:
+                if isinstance(e, AlreadyExistsError):
                     reason = "AlreadyExists"
                 self._send_json(
                     e.code,
@@ -114,12 +114,15 @@ class HttpApiServer:
                     if name:
                         self._send_json(200, outer.store.get(kind, namespace, name))
                     else:
-                        items = outer.store.list(kind, namespace or None)
+                        # Atomic snapshot: a non-atomic list + latest_rv pair
+                        # could pin a watch rv newer than the items, silently
+                        # skipping the in-between events on replay.
+                        items, rv = outer.store.list_with_rv(kind, namespace or None)
                         self._send_json(
                             200,
                             {
                                 "kind": f"{kind}List",
-                                "metadata": {"resourceVersion": outer.store.latest_rv()},
+                                "metadata": {"resourceVersion": rv},
                                 "items": items,
                             },
                         )
@@ -131,29 +134,65 @@ class HttpApiServer:
                 name = ""
                 if field_sel.startswith("metadata.name="):
                     name = field_sel.split("=", 1)[1]
-                watch = outer.store.watch(kind, namespace, name or None)
                 # Replay semantics: the client watches "from resourceVersion
                 # N", but the store only delivers events from subscription
-                # time.  Close the LIST→subscribe gap by emitting a synthetic
-                # MODIFIED for every object that changed after N — consumers
-                # are level-triggered, so a duplicate is harmless and a
-                # dropped event is not.
-                replay: list[dict] = []
+                # time.  Subscribe FIRST, then replay the store's event log
+                # since N — real ADDED/MODIFIED/DELETED events, so deletions
+                # in the LIST→subscribe gap are not lost.  Live events that
+                # were also captured by the replay are deduped by rv.
+                watch = outer.store.watch(kind, namespace, name or None)
                 try:
                     since = int(query.get("resourceVersion", ["0"])[0] or 0)
                 except ValueError:
                     since = 0
-                # rv=0 ("state unspecified") replays everything current.
-                for obj in outer.store.list(kind, namespace):
-                    meta = obj.get("metadata", {})
-                    if name and meta.get("name") != name:
-                        continue
-                    try:
-                        rv = int(meta.get("resourceVersion", "0"))
-                    except ValueError:
-                        rv = 0
-                    if rv > since:
+                replay: "list[dict] | None"
+                if since:
+                    replay = outer.store.events_since(
+                        since, kind, namespace, name or None
+                    )
+                snapshot_rv = 0
+                if not since:
+                    # rv=0 ("state unspecified"): current state as synthetic
+                    # MODIFIED events, per k8s list-then-watch semantics.
+                    # The atomic snapshot rv (not max object rv) is the dedupe
+                    # horizon: a deletion <= snapshot_rv is already reflected
+                    # by the object's absence from the snapshot.
+                    items, rv_str = outer.store.list_with_rv(kind, namespace)
+                    snapshot_rv = int(rv_str or 0)
+                    replay = []
+                    for obj in items:
+                        if name and obj.get("metadata", {}).get("name") != name:
+                            continue
                         replay.append({"type": "MODIFIED", "object": obj})
+                if replay is None:
+                    # Log trimmed past the client's rv: 410 Gone analog —
+                    # one ERROR event, then close; the client relists.
+                    watch.stop()
+                    gone = {
+                        "type": "ERROR",
+                        "object": {"kind": "Status", "code": 410, "reason": "Expired"},
+                    }
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    try:
+                        line = json.dumps(gone).encode() + b"\n"
+                        self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                        self.wfile.write(b"0\r\n\r\n")
+                    except (BrokenPipeError, ConnectionResetError, OSError):
+                        pass
+                    return
+
+                def _rv(event: dict) -> int:
+                    try:
+                        return int(
+                            event["object"].get("metadata", {}).get("resourceVersion", "0")
+                        )
+                    except (KeyError, ValueError):
+                        return 0
+
+                seen_through = max([snapshot_rv, since] + [_rv(e) for e in replay])
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -169,6 +208,8 @@ class HttpApiServer:
                             return
                         if event is None:
                             continue
+                        if 0 < _rv(event) <= seen_through:
+                            continue  # duplicate of a replayed event
                         line = json.dumps(event).encode() + b"\n"
                         self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
                         self.wfile.flush()

@@ -70,6 +70,14 @@ class Gauge:
         with self._lock:
             self._fns[tuple(sorted(labels.items()))] = fn
 
+    def remove_function(self, **labels: str) -> None:
+        """Drop a sampled callable (and its series) — call on owner shutdown
+        so the process-global registry doesn't pin dead object graphs."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._fns.pop(key, None)
+            self._values.pop(key, None)
+
     def collect(self) -> str:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._lock:
