@@ -1,0 +1,24 @@
+{{/* Common naming + label helpers (reference chart _helpers.tpl). */}}
+
+{{- define "tpu-dra-driver.name" -}}
+{{ .Values.nameOverride | default .Chart.Name | trunc 63 | trimSuffix "-" }}
+{{- end }}
+
+{{- define "tpu-dra-driver.fullname" -}}
+{{ .Values.fullnameOverride | default .Release.Name | trunc 63 | trimSuffix "-" }}
+{{- end }}
+
+{{- define "tpu-dra-driver.namespace" -}}
+{{ .Values.namespace | default .Release.Namespace }}
+{{- end }}
+
+{{- define "tpu-dra-driver.serviceAccountName" -}}
+{{ .Values.serviceAccount.name | default (include "tpu-dra-driver.fullname" .) }}
+{{- end }}
+
+{{- define "tpu-dra-driver.labels" -}}
+app.kubernetes.io/name: {{ include "tpu-dra-driver.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end }}

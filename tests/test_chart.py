@@ -1,0 +1,156 @@
+"""Helm chart rendering (C24 analog) via the helmlite renderer: every
+manifest parses, cross-references match the Python constants, and the
+rendered CRDs are exactly the generated ones."""
+
+import os
+
+import pytest
+
+from tpu_dra.api import crdgen
+from tpu_dra.cmds.plugin import (
+    DEFAULT_CDI_ROOT,
+    DEFAULT_PLUGIN_ROOT,
+    DEFAULT_REGISTRAR_ROOT,
+    DEFAULT_STATE_DIR,
+)
+from tpu_dra.controller.driver import DRIVER_NAME
+from tpu_dra.deploy import render_chart
+from tpu_dra.deploy.helmlite import ChartError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART_DIR = os.path.join(REPO_ROOT, "deployments/helm/tpu-dra-driver")
+
+
+@pytest.fixture(scope="module")
+def manifests():
+    return render_chart(CHART_DIR)
+
+
+def _find(manifests, kind):
+    out = []
+    for docs in manifests.values():
+        out.extend(d for d in docs if d.get("kind") == kind)
+    return out
+
+
+class TestChartRenders:
+    def test_all_expected_kinds_present(self, manifests):
+        kinds = {d["kind"] for docs in manifests.values() for d in docs}
+        assert kinds >= {
+            "CustomResourceDefinition",
+            "Deployment",
+            "DaemonSet",
+            "ResourceClass",
+            "DeviceClassParameters",
+            "ClusterRole",
+            "ClusterRoleBinding",
+            "ServiceAccount",
+        }
+
+    def test_crds_are_the_generated_ones(self, manifests):
+        rendered = {
+            d["metadata"]["name"]
+            for d in _find(manifests, "CustomResourceDefinition")
+        }
+        generated = {
+            crd["metadata"]["name"] for crd in crdgen.generate_crds().values()
+        }
+        assert rendered == generated
+
+    def test_resourceclass_points_at_driver(self, manifests):
+        (rc,) = _find(manifests, "ResourceClass")
+        assert rc["driverName"] == DRIVER_NAME
+
+    def test_default_namespace_install_refused(self):
+        with pytest.raises(ChartError, match="default namespace"):
+            render_chart(CHART_DIR, values={"namespace": "default"})
+
+
+class TestKubeletPluginDaemonSet:
+    @pytest.fixture
+    def daemonset(self, manifests):
+        (ds,) = _find(manifests, "DaemonSet")
+        return ds
+
+    def test_host_mounts_match_plugin_defaults(self, daemonset):
+        spec = daemonset["spec"]["template"]["spec"]
+        host_paths = {v["hostPath"]["path"] for v in spec["volumes"]}
+        assert {
+            DEFAULT_PLUGIN_ROOT,
+            DEFAULT_REGISTRAR_ROOT,
+            DEFAULT_CDI_ROOT,
+            DEFAULT_STATE_DIR,
+            "/dev",
+        } <= host_paths
+
+    def test_plugin_env_matches_cli_env_mirrors(self, daemonset):
+        container = daemonset["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        # The CLI reads these exact env vars (cmds/plugin.py parse_args).
+        assert env["CDI_ROOT"] == DEFAULT_CDI_ROOT
+        assert env["PLUGIN_ROOT"] == DEFAULT_PLUGIN_ROOT
+        assert env["REGISTRAR_ROOT"] == DEFAULT_REGISTRAR_ROOT
+        assert env["STATE_DIR"] == DEFAULT_STATE_DIR
+        assert "NODE_NAME" in env and "POD_NAMESPACE" in env
+
+    def test_privileged_with_bidirectional_plugins_mount(self, daemonset):
+        container = daemonset["spec"]["template"]["spec"]["containers"][0]
+        assert container["securityContext"]["privileged"] is True
+        mounts = {m["name"]: m for m in container["volumeMounts"]}
+        assert mounts["plugins"]["mountPropagation"] == "Bidirectional"
+
+    def test_init_and_prestop_flip_nas_status(self, daemonset):
+        pod = daemonset["spec"]["template"]["spec"]
+        init = pod["initContainers"][0]
+        assert init["command"][0] == "tpu-set-nas-status"
+        assert "NotReady" in init["command"]
+        prestop = pod["containers"][0]["lifecycle"]["preStop"]["exec"]["command"]
+        assert prestop[0] == "tpu-set-nas-status" and "NotReady" in prestop
+
+
+class TestRbac:
+    def test_clusterrole_covers_owned_groups(self, manifests):
+        (role,) = _find(manifests, "ClusterRole")
+        groups = {g for rule in role["rules"] for g in rule["apiGroups"]}
+        assert {
+            "resource.k8s.io",
+            "tpu.resource.google.com",
+            "nas.tpu.resource.google.com",
+            "apps",
+            "",
+        } <= groups
+
+    def test_binding_targets_serviceaccount(self, manifests):
+        (binding,) = _find(manifests, "ClusterRoleBinding")
+        (sa,) = _find(manifests, "ServiceAccount")
+        (subject,) = binding["subjects"]
+        assert subject["kind"] == "ServiceAccount"
+        assert subject["name"] == sa["metadata"]["name"]
+        assert subject["namespace"] == sa["metadata"]["namespace"]
+
+
+class TestValuesOverrides:
+    def test_image_and_workers_flow_through(self):
+        out = render_chart(
+            CHART_DIR,
+            values={
+                "image": {"repository": "gcr.io/acme/tpu-dra", "tag": "v9"},
+                "controller": {"workers": 32},
+            },
+        )
+        (deploy,) = _find(out, "Deployment")
+        container = deploy["spec"]["template"]["spec"]["containers"][0]
+        assert container["image"] == "gcr.io/acme/tpu-dra:v9"
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["WORKERS"] == "32"
+
+    def test_mock_mesh_enables_env(self):
+        out = render_chart(
+            CHART_DIR, values={"kubeletPlugin": {"mockTpulibMesh": "2x2x1"}}
+        )
+        (ds,) = _find(out, "DaemonSet")
+        env = {
+            e["name"]: e.get("value")
+            for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert env["MOCK_TPULIB_MESH"] == "2x2x1"

@@ -110,15 +110,28 @@ class TestParameterResolution:
         assert params.topology == "2x2"
 
     def test_invalid_claim_params_rejected(self, cs, driver):
+        # count=0 etc. is now caught at admission by the CRD schema; the
+        # controller still validates combinations the schema cannot express.
         cs.tpu_claim_parameters(NS).create(
             TpuClaimParameters(
                 metadata=ObjectMeta(name="bad", namespace=NS),
-                spec=TpuClaimParametersSpec(count=0),
+                spec=TpuClaimParametersSpec(count=2, topology="2x2x1"),
             )
         )
         claim = make_claim(cs, kind="TpuClaimParameters", params_name="bad")
-        with pytest.raises(ValueError):
+        with pytest.raises(ValueError, match="not both"):
             driver.get_claim_parameters(claim, ResourceClass(), None)
+
+    def test_invalid_claim_params_rejected_at_admission(self, cs):
+        from tpu_dra.client.apiserver import InvalidError
+
+        with pytest.raises(InvalidError, match="invalid"):
+            cs.tpu_claim_parameters(NS).create(
+                TpuClaimParameters(
+                    metadata=ObjectMeta(name="bad", namespace=NS),
+                    spec=TpuClaimParametersSpec(count=0),
+                )
+            )
 
     def test_subslice_kind_dispatch(self, cs, driver):
         cs.subslice_claim_parameters(NS).create(

@@ -1,0 +1,326 @@
+"""CustomResourceDefinition YAML generation from the API dataclasses.
+
+The reference generates its CRD manifests with controller-gen from Go struct
+tags (`make generate-crds`, Makefile:78-95; output under
+deployments/helm/k8s-dra-driver/crds/).  Here the dataclasses in
+tpu_dra/api are the single source of truth and this module is the codegen
+pipeline: it reflects over the same types the driver serializes with
+tpu_dra/api/serde.py and emits structural OpenAPI v3 schemas, so the wire
+format and the CRD validation can never drift apart.
+
+Notable mappings (all mirroring controller-gen conventions):
+
+- ``Quantity``                -> int-or-string with x-kubernetes-int-or-string
+- enums                       -> string + enum values
+- ``Coord`` (tuple[int,...])  -> fixed-length integer array
+- recursive selectors         -> unrolled to 3 nesting levels, matching the
+  reference's hand-unrolled CRD-safe selector (gpuselector.go:28-58); the
+  deepest level accepts only a property condition.
+- ``ObjectMeta``              -> ``{type: object}`` (apiserver owns the schema)
+
+Regenerate with ``python -m tpu_dra.api.crdgen`` (or ``make generate-crds``);
+tests assert the checked-in YAML matches the types.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import types
+import typing
+from typing import Any, get_args, get_origin, get_type_hints
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api import tpu_v1alpha1 as tpucrd
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.serde import json_name
+from tpu_dra.utils.quantity import Quantity
+
+# How many levels of selector nesting the schema admits (gpuselector.go:28-30:
+# "we need one extra level ... CRDs do not support recursive types").
+SELECTOR_NESTING_LEVELS = 3
+
+_INT_OR_STRING = {
+    "anyOf": [{"type": "integer"}, {"type": "string"}],
+    "pattern": r"^(\+|-)?(([0-9]+(\.[0-9]*)?)|(\.[0-9]+))(([KMGTPE]i)|[numkMGTPE]|([eE](\+|-)?(([0-9]+(\.[0-9]*)?)|(\.[0-9]+))))?$",
+    "x-kubernetes-int-or-string": True,
+}
+
+
+def _strip_optional(hint: Any) -> Any:
+    origin = get_origin(hint)
+    if origin is typing.Union or origin is types.UnionType:
+        args = [a for a in get_args(hint) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return hint
+
+
+def _schema_for_type(hint: Any, *, recursion: dict[type, int]) -> dict:
+    hint = _strip_optional(hint)
+    origin = get_origin(hint)
+
+    if origin in (list, typing.List):
+        (item_t,) = get_args(hint) or (Any,)
+        return {"type": "array", "items": _schema_for_type(item_t, recursion=recursion)}
+    if origin in (tuple, typing.Tuple):
+        args = [a for a in get_args(hint) if a is not Ellipsis]
+        n = len(args)
+        item = _schema_for_type(args[0] if args else int, recursion=recursion)
+        return {"type": "array", "items": item, "minItems": n, "maxItems": n}
+    if origin in (dict, typing.Dict):
+        args = get_args(hint)
+        val_t = args[1] if len(args) == 2 else Any
+        return {
+            "type": "object",
+            "additionalProperties": _schema_for_type(val_t, recursion=recursion),
+        }
+
+    if hint is int:
+        return {"type": "integer"}
+    if hint is str:
+        return {"type": "string"}
+    if hint is bool:
+        return {"type": "boolean"}
+    if hint is float:
+        return {"type": "number"}
+
+    if isinstance(hint, type):
+        if hint is ObjectMeta:
+            return {"type": "object"}
+        if hint is tpucrd.TpuSelector:
+            return selector_schema()
+        if issubclass(hint, Quantity):
+            return dict(_INT_OR_STRING)
+        if issubclass(hint, enum.Enum):
+            return {"type": "string", "enum": [m.value for m in hint]}
+        if dataclasses.is_dataclass(hint):
+            return _schema_for_dataclass(hint, recursion=recursion)
+
+    return {}  # Any / unconstrained
+
+
+def _schema_for_dataclass(cls: type, *, recursion: dict[type, int]) -> dict:
+    """Object schema for a dataclass; self-referential types are unrolled to
+    SELECTOR_NESTING_LEVELS with the recursive fields dropped at the floor."""
+    depth = recursion.get(cls, 0)
+    recursion = {**recursion, cls: depth + 1}
+    hints = get_type_hints(cls)
+    properties: dict[str, dict] = {}
+    for f in dataclasses.fields(cls):
+        if f.name in ("kind", "api_version"):
+            continue  # carried by the envelope, not the spec schema
+        hint = _strip_optional(hints[f.name])
+        if _refers_to(hint, cls) and depth + 1 >= SELECTOR_NESTING_LEVELS:
+            continue  # recursion floor: deepest level is a bare condition
+        properties[json_name(f)] = _schema_for_type(hint, recursion=recursion)
+    schema: dict = {"type": "object"}
+    if properties:
+        schema["properties"] = properties
+    return schema
+
+
+def selector_schema(levels: int = SELECTOR_NESTING_LEVELS) -> dict:
+    """Selector node schema, hand-unrolled to ``levels`` like the reference
+    (gpuselector.go:28-58): each node is EITHER one inline property condition
+    (the TpuSelectorProperties fields appear at the node level, per
+    TpuSelector.__to_json__) OR one and/orExpression — maxProperties=1.  The
+    deepest level accepts only a bare condition."""
+    hints = get_type_hints(tpucrd.TpuSelectorProperties)
+    condition_props = {
+        json_name(f): _schema_for_type(hints[f.name], recursion={})
+        for f in dataclasses.fields(tpucrd.TpuSelectorProperties)
+    }
+
+    def level(n: int) -> dict:
+        props = dict(condition_props)
+        if n > 1:
+            sub = level(n - 1)
+            props["andExpression"] = {"type": "array", "items": sub}
+            props["orExpression"] = {"type": "array", "items": sub}
+        return {"type": "object", "properties": props, "maxProperties": 1}
+
+    return level(levels)
+
+
+def _refers_to(hint: Any, cls: type) -> bool:
+    if hint is cls:
+        return True
+    for arg in get_args(hint):
+        if arg is not Ellipsis and _refers_to(arg, cls):
+            return True
+    return False
+
+
+def _constrain(schema: dict, path: "tuple[str, ...]", **constraints) -> None:
+    """Attach validation keywords at a JSON path inside a generated schema."""
+    node = schema
+    for part in path:
+        if part == "[]":
+            node = node["items"]
+        else:
+            node = node["properties"][part]
+    node.update(constraints)
+
+
+def schema_for_object(cls: type) -> dict:
+    """Full top-level schema: apiVersion/kind/metadata + typed payload."""
+    base = _schema_for_dataclass(cls, recursion={})
+    props = base.setdefault("properties", {})
+    props["apiVersion"] = {"type": "string"}
+    props["kind"] = {"type": "string"}
+    props["metadata"] = {"type": "object"}
+    return base
+
+
+# --- per-kind schema builders (validation extras live here) -----------------
+
+
+def tpu_claim_parameters_schema() -> dict:
+    schema = schema_for_object(tpucrd.TpuClaimParameters)
+    _constrain(schema, ("spec", "count"), minimum=1)
+    _constrain(schema, ("spec", "topology"), pattern=r"^\d+x\d+(x\d+)?$")
+    return schema
+
+
+def device_class_parameters_schema() -> dict:
+    return schema_for_object(tpucrd.DeviceClassParameters)
+
+
+def subslice_claim_parameters_schema() -> dict:
+    schema = schema_for_object(tpucrd.SubsliceClaimParameters)
+    _constrain(schema, ("spec", "profile"), pattern=r"^\d+c\.\d+gb$")
+    return schema
+
+
+def core_claim_parameters_schema() -> dict:
+    schema = schema_for_object(tpucrd.CoreClaimParameters)
+    _constrain(schema, ("spec", "profile"), pattern=r"^\d+c\.\d+gb$")
+    return schema
+
+
+def node_allocation_state_schema() -> dict:
+    schema = schema_for_object(nascrd.NodeAllocationState)
+    _constrain(
+        schema,
+        ("status",),
+        enum=[nascrd.STATUS_READY, nascrd.STATUS_NOT_READY],
+    )
+    return schema
+
+
+# --- CRD assembly -----------------------------------------------------------
+
+
+def _crd(
+    kind: str,
+    group: str,
+    version: str,
+    plural: str,
+    namespaced: bool,
+    schema: dict,
+    *,
+    singular: "str | None" = None,
+) -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{group}"},
+        "spec": {
+            "group": group,
+            "names": {
+                "kind": kind,
+                "listKind": f"{kind}List",
+                "plural": plural,
+                "singular": singular or kind.lower(),
+            },
+            "scope": "Namespaced" if namespaced else "Cluster",
+            "versions": [
+                {
+                    "name": version,
+                    "served": True,
+                    "storage": True,
+                    "schema": {"openAPIV3Schema": schema},
+                }
+            ],
+        },
+    }
+
+
+def generate_crds() -> "dict[str, dict]":
+    """filename -> CustomResourceDefinition object, for every CRD we own."""
+    g, v = tpucrd.GROUP_NAME, tpucrd.VERSION
+    ng, nv = nascrd.GROUP_NAME, nascrd.VERSION
+    return {
+        f"tpu.resource.google.com_deviceclassparameters.yaml": _crd(
+            tpucrd.DEVICE_CLASS_PARAMETERS_KIND, g, v,
+            "deviceclassparameters", False, device_class_parameters_schema(),
+        ),
+        f"tpu.resource.google.com_tpuclaimparameters.yaml": _crd(
+            tpucrd.TPU_CLAIM_PARAMETERS_KIND, g, v,
+            "tpuclaimparameters", True, tpu_claim_parameters_schema(),
+        ),
+        f"tpu.resource.google.com_subsliceclaimparameters.yaml": _crd(
+            tpucrd.SUBSLICE_CLAIM_PARAMETERS_KIND, g, v,
+            "subsliceclaimparameters", True, subslice_claim_parameters_schema(),
+        ),
+        f"tpu.resource.google.com_coreclaimparameters.yaml": _crd(
+            tpucrd.CORE_CLAIM_PARAMETERS_KIND, g, v,
+            "coreclaimparameters", True, core_claim_parameters_schema(),
+        ),
+        f"nas.tpu.resource.google.com_nodeallocationstates.yaml": _crd(
+            nascrd.NODE_ALLOCATION_STATE_KIND, ng, nv,
+            "nodeallocationstates", True, node_allocation_state_schema(),
+        ),
+    }
+
+
+def render_crds() -> "dict[str, str]":
+    """filename -> YAML text (stable key order for clean regeneration)."""
+    import yaml
+
+    class _NoAliasDumper(yaml.SafeDumper):
+        def ignore_aliases(self, data):  # anchors confuse downstream tooling
+            return True
+
+    out = {}
+    for filename, crd in generate_crds().items():
+        out[filename] = (
+            "# Generated by tpu_dra/api/crdgen.py — DO NOT EDIT.\n"
+            "# Regenerate: python -m tpu_dra.api.crdgen\n"
+            + yaml.dump(
+                crd, Dumper=_NoAliasDumper, sort_keys=True, default_flow_style=False
+            )
+        )
+    return out
+
+
+def write_crds(output_dir: str) -> "list[str]":
+    import os
+
+    os.makedirs(output_dir, exist_ok=True)
+    written = []
+    for filename, text in render_crds().items():
+        path = os.path.join(output_dir, filename)
+        with open(path, "w") as f:
+            f.write(text)
+        written.append(path)
+    return sorted(written)
+
+
+DEFAULT_OUTPUT_DIR = "deployments/helm/tpu-dra-driver/crds"
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="generate CRD manifests")
+    parser.add_argument("--output-dir", default=DEFAULT_OUTPUT_DIR)
+    args = parser.parse_args(argv)
+    for path in write_crds(args.output_dir):
+        print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,134 @@
+"""Minimal structural-OpenAPI validator for the generated CRD schemas.
+
+The real apiserver validates every CRD write against the structural schema;
+the kind harness inherits that for free.  This validator gives the in-memory
+and HTTP sim apiservers the same behavior, and lets tests prove the schemas
+emitted by crdgen.py actually accept/reject the right objects (instead of
+only snapshotting YAML text).
+
+Supports exactly the keyword subset crdgen emits: type, properties,
+additionalProperties, items, enum, pattern, minimum, minItems/maxItems,
+maxProperties, anyOf, x-kubernetes-int-or-string.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+
+class ValidationError(ValueError):
+    def __init__(self, path: str, message: str):
+        self.path = path or "."
+        super().__init__(f"{self.path}: {message}")
+
+
+def prune(schema: dict, value: Any) -> Any:
+    """Drop fields not declared in a structural schema (in place for dicts).
+
+    The real apiextensions-apiserver prunes unknown fields BEFORE validating;
+    order matters: a node with one known + one unknown key passes
+    maxProperties=1 after pruning, and content past a recursion floor (e.g.
+    selector level 4) is silently dropped rather than stored.
+    """
+    if not schema:
+        return value
+    if "anyOf" in schema or schema.get("x-kubernetes-int-or-string"):
+        return value
+    t = schema.get("type")
+    if t == "object" and isinstance(value, dict):
+        props = schema.get("properties")
+        additional = schema.get("additionalProperties")
+        for key in list(value):
+            if props is not None and key in props:
+                prune(props[key], value[key])
+            elif additional is not None:
+                prune(additional, value[key])
+            elif props is not None:
+                del value[key]
+    elif t == "array" and isinstance(value, list):
+        item_schema = schema.get("items", {})
+        for item in value:
+            prune(item_schema, item)
+    return value
+
+
+def validate(schema: dict, value: Any, path: str = "") -> None:
+    """Raise ValidationError if value does not conform to schema."""
+    if not schema:
+        return
+
+    if schema.get("x-kubernetes-int-or-string"):
+        if not isinstance(value, (int, str)) or isinstance(value, bool):
+            raise ValidationError(path, f"expected int-or-string, got {type(value).__name__}")
+        if isinstance(value, str) and "pattern" in schema:
+            if not re.match(schema["pattern"], value):
+                raise ValidationError(path, f"{value!r} does not match quantity pattern")
+        return
+
+    if "anyOf" in schema:
+        errors = []
+        for sub in schema["anyOf"]:
+            try:
+                validate(sub, value, path)
+                break
+            except ValidationError as e:
+                errors.append(str(e))
+        else:
+            raise ValidationError(path, f"matches no anyOf branch: {errors}")
+        return
+
+    t = schema.get("type")
+    if t == "object":
+        if not isinstance(value, dict):
+            raise ValidationError(path, f"expected object, got {type(value).__name__}")
+        if "maxProperties" in schema and len(value) > schema["maxProperties"]:
+            raise ValidationError(
+                path, f"{len(value)} properties exceeds maxProperties={schema['maxProperties']}"
+            )
+        props = schema.get("properties", {})
+        additional = schema.get("additionalProperties")
+        for key, sub_value in value.items():
+            sub_path = f"{path}.{key}" if path else key
+            if key in props:
+                validate(props[key], sub_value, sub_path)
+            elif additional is not None:
+                validate(additional, sub_value, sub_path)
+            elif props:
+                # Structural schemas prune unknown fields rather than reject;
+                # mirror the apiserver by ignoring them.
+                continue
+        return
+    if t == "array":
+        if not isinstance(value, list):
+            raise ValidationError(path, f"expected array, got {type(value).__name__}")
+        if "minItems" in schema and len(value) < schema["minItems"]:
+            raise ValidationError(path, f"{len(value)} items < minItems={schema['minItems']}")
+        if "maxItems" in schema and len(value) > schema["maxItems"]:
+            raise ValidationError(path, f"{len(value)} items > maxItems={schema['maxItems']}")
+        item_schema = schema.get("items", {})
+        for i, item in enumerate(value):
+            validate(item_schema, item, f"{path}[{i}]")
+        return
+    if t == "string":
+        if not isinstance(value, str):
+            raise ValidationError(path, f"expected string, got {type(value).__name__}")
+        if "enum" in schema and value not in schema["enum"]:
+            raise ValidationError(path, f"{value!r} not in {schema['enum']}")
+        if "pattern" in schema and not re.search(schema["pattern"], value):
+            raise ValidationError(path, f"{value!r} does not match {schema['pattern']!r}")
+        return
+    if t == "integer":
+        if not isinstance(value, int) or isinstance(value, bool):
+            raise ValidationError(path, f"expected integer, got {type(value).__name__}")
+        if "minimum" in schema and value < schema["minimum"]:
+            raise ValidationError(path, f"{value} < minimum={schema['minimum']}")
+        return
+    if t == "number":
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            raise ValidationError(path, f"expected number, got {type(value).__name__}")
+        return
+    if t == "boolean":
+        if not isinstance(value, bool):
+            raise ValidationError(path, f"expected boolean, got {type(value).__name__}")
+        return

@@ -161,6 +161,18 @@ class FakeApiServer:
         name = meta.get("name")
         if not name:
             raise InvalidError(f"{kind} has no metadata.name")
+        schema = _crd_schemas().get(kind)
+        if schema is not None:
+            from tpu_dra.api.validate import ValidationError, prune, validate
+
+            # Prune BEFORE validating, matching apiextensions-apiserver
+            # ordering: unknown fields are dropped (and never stored), not
+            # rejected; the pruned object is what validation sees.
+            prune(schema, obj)
+            try:
+                validate(schema, obj)
+            except ValidationError as e:
+                raise InvalidError(f"{kind} {name} is invalid: {e}") from None
         return _key(kind, meta.get("namespace", ""), name)
 
     # -- CRUD ---------------------------------------------------------------
@@ -341,6 +353,26 @@ class FakeApiServer:
                     continue
                 out.append(copy.deepcopy(event))
             return out
+
+
+_CRD_SCHEMAS: "dict[str, dict] | None" = None
+
+
+def _crd_schemas() -> "dict[str, dict]":
+    """kind -> structural schema for the CRDs this driver owns, so writes to
+    them are validated exactly as a real apiserver would (the kind harness
+    gets this from the installed CRD manifests; the fake mirrors it)."""
+    global _CRD_SCHEMAS
+    if _CRD_SCHEMAS is None:
+        from tpu_dra.api import crdgen
+
+        _CRD_SCHEMAS = {
+            crd["spec"]["names"]["kind"]: crd["spec"]["versions"][0]["schema"][
+                "openAPIV3Schema"
+            ]
+            for crd in crdgen.generate_crds().values()
+        }
+    return _CRD_SCHEMAS
 
 
 def _now() -> str:

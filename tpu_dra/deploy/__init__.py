@@ -1,0 +1,1 @@
+from tpu_dra.deploy.helmlite import render_chart  # noqa: F401

@@ -1,0 +1,393 @@
+"""Minimal Helm-compatible chart renderer for the driver's own chart.
+
+The reference ships a Helm chart (deployments/helm/k8s-dra-driver/) rendered
+by the real Helm at install time; its CI/demo scripts shell out to `helm`.
+This environment has no helm binary, so this module implements the small
+template subset the tpu-dra-driver chart actually uses — enough for the demo
+and the e2e suite to install the chart into the sim cluster, and for tests to
+assert the rendered manifests instead of eyeballing YAML.
+
+Supported syntax (deliberately a subset; the chart is written against it):
+
+- actions: ``{{ expr }}`` with optional ``{{-`` / ``-}}`` whitespace chomping
+- data: ``.Values.a.b``, ``.Release.Name/Namespace/Service``,
+  ``.Chart.Name/Version/AppVersion``
+- pipelines: ``expr | fn arg ...`` with functions ``default``, ``quote``,
+  ``upper``, ``lower``, ``trunc N``, ``trimSuffix S``, ``nindent N``,
+  ``indent N``, ``toYaml``, ``required MSG``
+- string literals: ``"text"``, integers
+- ``include "name" .`` of ``{{- define "name" -}}...{{- end }}`` helpers
+  (helpers may themselves use the syntax above)
+- control flow: ``{{- if PIPELINE }} ... {{- else }} ... {{- end }}``
+  (truthiness: Go-template — false/nil/0/""/empty collection are falsey),
+  ``{{- range .Values.list }} ... {{- end }}`` with ``.`` bound per item
+- comments: ``{{/* ... */}}``
+
+Rendering yields one manifest list per template file (``---`` separated
+documents are split and YAML-parsed).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+
+class ChartError(ValueError):
+    pass
+
+
+# --- values ------------------------------------------------------------------
+
+
+def deep_merge(base: dict, override: dict) -> dict:
+    out = dict(base)
+    for key, value in override.items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = deep_merge(out[key], value)
+        else:
+            out[key] = value
+    return out
+
+
+# --- template tokenization ---------------------------------------------------
+
+_ACTION_RE = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}", re.DOTALL)
+
+
+@dataclass
+class _Node:
+    kind: str  # text | action | if | range | define
+    text: str = ""
+    expr: str = ""
+    body: "list[_Node]" = field(default_factory=list)
+    else_body: "list[_Node]" = field(default_factory=list)
+
+
+def _chomp(template: str) -> str:
+    """Apply {{- and -}} whitespace chomping before parsing."""
+    template = re.sub(r"[ \t]*\{\{-", "{{", template)
+    template = re.sub(r"-\}\}[ \t]*\n?", "}}", template)
+    return template
+
+
+def _tokenize(template: str) -> "list[tuple[str, str]]":
+    """-> [(kind, payload)] where kind is 'text' or 'action'."""
+    tokens = []
+    pos = 0
+    for m in _ACTION_RE.finditer(template):
+        if m.start() > pos:
+            tokens.append(("text", template[pos : m.start()]))
+        tokens.append(("action", m.group(1).strip()))
+        pos = m.end()
+    if pos < len(template):
+        tokens.append(("text", template[pos:]))
+    return tokens
+
+
+def _parse(tokens: "list[tuple[str, str]]", pos: int = 0, *, until: "set[str] | None" = None):
+    """Recursive-descent parse into a node tree; returns (nodes, next_pos,
+    terminator) where terminator is the control keyword that closed us."""
+    nodes: "list[_Node]" = []
+    while pos < len(tokens):
+        kind, payload = tokens[pos]
+        if kind == "text":
+            nodes.append(_Node("text", text=payload))
+            pos += 1
+            continue
+        if payload.startswith("/*"):
+            pos += 1
+            continue
+        word = payload.split(None, 1)[0] if payload else ""
+        if until and word in until:
+            return nodes, pos + 1, word
+        if word == "if":
+            body, pos, term = _parse(tokens, pos + 1, until={"else", "end"})
+            node = _Node("if", expr=payload[3:].strip(), body=body)
+            if term == "else":
+                node.else_body, pos, _ = _parse(tokens, pos, until={"end"})
+            nodes.append(node)
+            continue
+        if word == "range":
+            body, pos, _ = _parse(tokens, pos + 1, until={"end"})
+            nodes.append(_Node("range", expr=payload[6:].strip(), body=body))
+            continue
+        if word == "define":
+            name = payload.split(None, 1)[1].strip().strip('"')
+            body, pos, _ = _parse(tokens, pos + 1, until={"end"})
+            nodes.append(_Node("define", expr=name, body=body))
+            continue
+        nodes.append(_Node("action", expr=payload))
+        pos += 1
+    return nodes, pos, ""
+
+
+# --- expression evaluation ---------------------------------------------------
+
+
+def _truthy(value: Any) -> bool:
+    if value is None or value is False:
+        return False
+    if isinstance(value, (int, float)) and value == 0:
+        return False
+    if isinstance(value, (str, list, dict)) and len(value) == 0:
+        return False
+    return True
+
+
+def _split_pipeline(expr: str) -> "list[str]":
+    """Split on | outside quotes."""
+    parts, depth, cur = [], 0, []
+    in_str = False
+    for ch in expr:
+        if ch == '"':
+            in_str = not in_str
+        if ch == "|" and not in_str and depth == 0:
+            parts.append("".join(cur).strip())
+            cur = []
+            continue
+        if ch == "(" and not in_str:
+            depth += 1
+        if ch == ")" and not in_str:
+            depth -= 1
+        cur.append(ch)
+    parts.append("".join(cur).strip())
+    return [p for p in parts if p]
+
+
+def _split_args(text: str) -> "list[str]":
+    args, cur, in_str, depth = [], [], False, 0
+    for ch in text:
+        if ch == '"':
+            in_str = not in_str
+            cur.append(ch)
+            continue
+        if not in_str:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch.isspace() and depth == 0:
+                if cur:
+                    args.append("".join(cur))
+                    cur = []
+                continue
+        cur.append(ch)
+    if cur:
+        args.append("".join(cur))
+    return args
+
+
+class _Renderer:
+    def __init__(self, context: dict, helpers: "dict[str, list[_Node]]"):
+        self.context = context
+        self.helpers = helpers
+
+    # - atoms -
+    def _atom(self, token: str, dot: Any) -> Any:
+        if token.startswith("(") and token.endswith(")"):
+            return self._pipeline(token[1:-1].strip(), dot)
+        if token.startswith('"') and token.endswith('"'):
+            return token[1:-1]
+        if re.fullmatch(r"-?\d+", token):
+            return int(token)
+        if token == ".":
+            return dot
+        if token.startswith("."):
+            value: Any = dot if not isinstance(dot, _RootDot) else dot.root
+            # walk from the root context for .Values/.Release/.Chart
+            value = self.context if token.split(".")[1] in self.context else value
+            for part in token.strip(".").split("."):
+                if isinstance(value, dict):
+                    value = value.get(part)
+                else:
+                    value = getattr(value, part, None)
+                if value is None:
+                    return None
+            return value
+        if token == "true":
+            return True
+        if token == "false":
+            return False
+        raise ChartError(f"cannot evaluate {token!r}")
+
+    def _call(self, text: str, dot: Any, piped: "Any | None", has_piped: bool) -> Any:
+        args = _split_args(text)
+        fn, rest = args[0], args[1:]
+        if fn == "include":
+            name = self._atom(rest[0], dot)
+            body = self.helpers.get(name)
+            if body is None:
+                raise ChartError(f"include of undefined template {name!r}")
+            sub_dot = self._atom(rest[1], dot) if len(rest) > 1 else dot
+            return self._render_nodes(body, sub_dot).strip()
+        vals = [self._atom(a, dot) for a in rest]
+        if has_piped:
+            vals.append(piped)
+        if fn == "default":
+            fallback, value = vals[0], vals[1] if len(vals) > 1 else None
+            return value if _truthy(value) else fallback
+        if fn == "quote":
+            return '"%s"' % vals[-1]
+        if fn == "upper":
+            return str(vals[-1]).upper()
+        if fn == "lower":
+            return str(vals[-1]).lower()
+        if fn == "trunc":
+            n, value = vals[0], str(vals[-1])
+            return value[:n]
+        if fn == "trimSuffix":
+            suffix, value = str(vals[0]), str(vals[-1])
+            return value[: -len(suffix)] if suffix and value.endswith(suffix) else value
+        if fn in ("nindent", "indent"):
+            n, value = vals[0], "" if vals[-1] is None else vals[-1]
+            if not isinstance(value, str):
+                value = yaml.safe_dump(value, default_flow_style=False).rstrip("\n")
+            pad = " " * n
+            indented = "\n".join(pad + line if line else line for line in str(value).splitlines())
+            return ("\n" + indented) if fn == "nindent" else indented
+        if fn == "toYaml":
+            value = vals[-1]
+            if value is None:
+                return ""
+            return yaml.safe_dump(value, default_flow_style=False).rstrip("\n")
+        if fn == "required":
+            msg, value = vals[0], vals[-1]
+            if not _truthy(value):
+                raise ChartError(str(msg))
+            return value
+        if fn == "not":
+            return not _truthy(vals[-1])
+        if fn == "eq":
+            return vals[0] == vals[1]
+        if fn == "ne":
+            return vals[0] != vals[1]
+        raise ChartError(f"unsupported template function {fn!r}")
+
+    def _pipeline(self, expr: str, dot: Any) -> Any:
+        stages = _split_pipeline(expr)
+        value: Any = None
+        has_value = False
+        for i, stage in enumerate(stages):
+            stage = stage.strip()
+            if stage.startswith("(") and stage.endswith(")"):
+                stage = stage[1:-1].strip()
+            first = stage.split(None, 1)[0]
+            if i == 0 and (stage.startswith(".") or stage.startswith('"') or re.fullmatch(r"-?\d+|true|false", stage)) and " " not in stage:
+                value = self._atom(stage, dot)
+            else:
+                value = self._call(stage, dot, value if has_value else None, has_value or i > 0)
+            has_value = True
+        return value
+
+    # - nodes -
+    def _render_nodes(self, nodes: "list[_Node]", dot: Any) -> str:
+        out = []
+        for node in nodes:
+            if node.kind == "text":
+                out.append(node.text)
+            elif node.kind == "action":
+                value = self._pipeline(node.expr, dot)
+                if value is None:
+                    value = ""
+                if isinstance(value, bool):
+                    value = "true" if value else "false"
+                out.append(str(value))
+            elif node.kind == "if":
+                branch = node.body if _truthy(self._pipeline(node.expr, dot)) else node.else_body
+                out.append(self._render_nodes(branch, dot))
+            elif node.kind == "range":
+                items = self._pipeline(node.expr, dot) or []
+                if isinstance(items, dict):
+                    items = list(items.values())
+                for item in items:
+                    out.append(self._render_nodes(node.body, item))
+            elif node.kind == "define":
+                pass  # collected separately
+        return "".join(out)
+
+
+class _RootDot:
+    """`.` at top level: attribute access falls through to the root context."""
+
+    def __init__(self, root: dict):
+        self.root = root
+
+
+# --- public API --------------------------------------------------------------
+
+
+def render_chart(
+    chart_dir: str,
+    *,
+    values: "dict | None" = None,
+    release_name: str = "tpu-dra-driver",
+    namespace: str = "tpu-dra",
+    include_crds: bool = True,
+) -> "dict[str, list[dict]]":
+    """Render a chart directory -> {relative template path: [manifests]}.
+
+    Mirrors `helm template --include-crds`: CRDs from crds/ verbatim,
+    templates/ rendered with merged values, empty documents dropped.
+    """
+    chart_meta_path = os.path.join(chart_dir, "Chart.yaml")
+    with open(chart_meta_path) as f:
+        chart_meta = yaml.safe_load(f) or {}
+    values_path = os.path.join(chart_dir, "values.yaml")
+    base_values: dict = {}
+    if os.path.exists(values_path):
+        with open(values_path) as f:
+            base_values = yaml.safe_load(f) or {}
+    merged = deep_merge(base_values, values or {})
+
+    context = {
+        "Values": merged,
+        "Release": {"Name": release_name, "Namespace": namespace, "Service": "Helm"},
+        "Chart": {
+            "Name": chart_meta.get("name", ""),
+            "Version": str(chart_meta.get("version", "")),
+            "AppVersion": str(chart_meta.get("appVersion", "")),
+        },
+    }
+
+    template_dir = os.path.join(chart_dir, "templates")
+    helpers: "dict[str, list[_Node]]" = {}
+    files: "dict[str, list[_Node]]" = {}
+    for name in sorted(os.listdir(template_dir)):
+        path = os.path.join(template_dir, name)
+        if not os.path.isfile(path):
+            continue
+        with open(path) as f:
+            text = f.read()
+        nodes, _, _ = _parse(_tokenize(_chomp(text)))
+        for node in nodes:
+            if node.kind == "define":
+                helpers[node.expr] = node.body
+        if name.startswith("_") or name.endswith(".tpl"):
+            continue
+        files[name] = nodes
+
+    renderer = _Renderer(context, helpers)
+    dot = _RootDot(context)
+    out: "dict[str, list[dict]]" = {}
+
+    if include_crds:
+        crds_dir = os.path.join(chart_dir, "crds")
+        if os.path.isdir(crds_dir):
+            for name in sorted(os.listdir(crds_dir)):
+                if not name.endswith(".yaml"):
+                    continue
+                with open(os.path.join(crds_dir, name)) as f:
+                    docs = [d for d in yaml.safe_load_all(f) if d]
+                out[f"crds/{name}"] = docs
+
+    for name, nodes in files.items():
+        rendered = renderer._render_nodes(nodes, dot)
+        docs = [d for d in yaml.safe_load_all(rendered) if d]
+        if docs:
+            out[f"templates/{name}"] = docs
+    return out
