@@ -1,0 +1,11 @@
+#!/usr/bin/env sh
+# Tear down the demo cluster started by up.sh.
+STATE=${TPU_DRA_DEMO_STATE:-/tmp/tpu-dra-demo}
+for component in kubesim plugin controller apiserver; do
+  pidfile="$STATE/$component.pid"
+  if [ -f "$pidfile" ]; then
+    kill "$(cat "$pidfile")" 2>/dev/null || true
+    rm -f "$pidfile"
+  fi
+done
+echo "demo cluster down"

@@ -1,0 +1,42 @@
+#!/usr/bin/env sh
+# Bring up a local wire-protocol demo cluster (the kind-cluster analog,
+# reference: demo/clusters/kind/create-cluster.sh): an HTTP apiserver
+# speaking the k8s REST protocol, the real controller binary, and one real
+# node-plugin binary running the mock chip enumerator.
+#
+#   sh demo/clusters/sim/up.sh          # starts everything, writes PIDs
+#   python -m tpu_dra.sim.kubectl apply -f demo/specs/quickstart/tpu-test1.yaml
+#   sh demo/clusters/sim/down.sh
+set -e
+cd "$(dirname "$0")/../../.."
+
+STATE=${TPU_DRA_DEMO_STATE:-/tmp/tpu-dra-demo}
+APISERVER=${TPU_DRA_DEMO_APISERVER:-http://127.0.0.1:8001}
+PORT=${APISERVER##*:}
+mkdir -p "$STATE"
+
+python -m tpu_dra.sim.httpapiserver --port "$PORT" &
+echo $! > "$STATE/apiserver.pid"
+sleep 1
+
+# helm-install analog: ResourceClass + default DeviceClassParameters etc.
+python -m tpu_dra.deploy install --server "$APISERVER" --namespace tpu-dra
+
+TPU_DRA_APISERVER="$APISERVER" POD_NAMESPACE=tpu-dra \
+  python -m tpu_dra.cmds.controller --workers 4 &
+echo $! > "$STATE/controller.pid"
+
+TPU_DRA_APISERVER="$APISERVER" POD_NAMESPACE=tpu-dra NODE_NAME=demo-node \
+  MOCK_TPULIB_MESH=2x2x1 \
+  CDI_ROOT="$STATE/cdi" PLUGIN_ROOT="$STATE/plugins" \
+  REGISTRAR_ROOT="$STATE/plugins_registry" STATE_DIR="$STATE/state" \
+  python -m tpu_dra.cmds.plugin &
+echo $! > "$STATE/plugin.pid"
+
+python -m tpu_dra.sim.kubesim --apiserver "$APISERVER" --namespace tpu-dra   --node "demo-node=$STATE/plugins/tpu.resource.google.com/plugin.sock" &
+echo $! > "$STATE/kubesim.pid"
+
+echo "demo cluster up: apiserver=$APISERVER state=$STATE"
+echo "try: python -m tpu_dra.sim.kubectl apply -f demo/specs/quickstart/tpu-test1.yaml --server $APISERVER"
+echo "     (pods go Running via the kubesim scheduler/kubelet; watch with"
+echo "      python -m tpu_dra.sim.kubectl — or query the apiserver directly)"

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Scripted, asserted quickstart suite (C25 analog, done right).
+
+The reference's demo is a narrated walkthrough (`kubectl apply` + eyeball
+`nvidia-smi -L`, demo/specs/quickstart/README.md); SURVEY.md §4 calls out
+that gap.  This runner applies each spec in demo/specs/quickstart/ to a
+fresh SimCluster — chart-installed ResourceClass, mock chip enumerator,
+full controller/plugin/scheduler stack — and ASSERTS the outcome of every
+scenario.  Exit code 0 means the demo is true.
+
+Run: python demo/run_quickstart.py [--spec tpu-test1.yaml] [--keep-going]
+Also consumed by tests/test_quickstart.py so CI keeps the demo honest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+SPEC_DIR = os.path.join(REPO_ROOT, "demo", "specs", "quickstart")
+CHART_DIR = os.path.join(REPO_ROOT, "deployments", "helm", "tpu-dra-driver")
+DRIVER_NS = "tpu-dra"
+
+
+def new_cluster(state_root: str, *, partitionable: bool = False):
+    """SimCluster with the chart's cluster-scoped objects installed.
+
+    ``partitionable`` mirrors the reference demo's MIG-enabled vs plain GPU
+    fleets: selector-less claims only match non-partitionable chips
+    (tpu_allocator.selector_matches_tpu), so each scenario runs on the fleet
+    its claims are written for."""
+    from tpu_dra.deploy import render_chart
+    from tpu_dra.sim import SimCluster
+    from tpu_dra.sim.kubectl import apply
+
+    cluster = SimCluster(
+        state_root,
+        nodes=2,
+        mesh="2x2x1",
+        partitionable=partitionable,
+        namespace=DRIVER_NS,
+    )
+    cluster.start()
+    rendered = render_chart(CHART_DIR)
+    for path, docs in rendered.items():
+        for doc in docs:
+            # The sim stores CR kinds + ResourceClass; skip infra kinds that
+            # have no sim behavior (RBAC, CRDs, workloads of the driver).
+            if doc["kind"] in ("ResourceClass", "DeviceClassParameters"):
+                apply(cluster.server, [doc], default_namespace=DRIVER_NS)
+    return cluster
+
+
+def apply_spec(cluster, filename: str) -> "list[dict]":
+    from tpu_dra.sim.kubectl import apply, load_file
+
+    docs = load_file(os.path.join(SPEC_DIR, filename))
+    apply(cluster.server, docs)
+    return docs
+
+
+def claim_of(cluster, namespace: str, pod, entry_name: str):
+    from tpu_dra.controller.reconciler import resource_claim_name
+
+    pod_claim = next(c for c in pod.spec.resource_claims if c.name == entry_name)
+    return cluster.clientset.resource_claims(namespace).get(
+        resource_claim_name(pod, pod_claim)
+    )
+
+
+def chips_of(cluster, namespace: str, pod) -> "list[str]":
+    """Chip UUIDs (or parent:start+size for subslices) allocated to a pod."""
+    out = []
+    nas = cluster.clientset.node_allocation_states(DRIVER_NS).get(pod.spec.node_name)
+    for pod_claim in pod.spec.resource_claims:
+        claim = claim_of(cluster, namespace, pod, pod_claim.name)
+        allocated = nas.spec.allocated_claims[claim.metadata.uid]
+        if allocated.tpu is not None:
+            out.extend(d.uuid for d in allocated.tpu.devices)
+        else:
+            out.extend(
+                f"{d.parent_uuid}:{d.placement.start}+{d.placement.size}"
+                for d in allocated.subslice.devices
+            )
+    return out
+
+
+# --- scenario checks ---------------------------------------------------------
+
+
+def check_test1(cluster):
+    ns = "tpu-test1"
+    p1 = cluster.wait_for_pod_running(ns, "pod1", timeout=15)
+    p2 = cluster.wait_for_pod_running(ns, "pod2", timeout=15)
+    c1, c2 = chips_of(cluster, ns, p1), chips_of(cluster, ns, p2)
+    assert len(c1) == 1 and len(c2) == 1, (c1, c2)
+    assert set(c1).isdisjoint(c2), f"pods share a chip: {c1} vs {c2}"
+
+
+def check_test2(cluster):
+    ns = "tpu-test2"
+    pod = cluster.wait_for_pod_running(ns, "pod-2c", timeout=15)
+    claim = cluster.clientset.resource_claims(ns).get("shared-claim")
+    devices = pod.metadata.annotations["cdi.k8s.io/devices"]
+    assert devices == f"tpu.resource.google.com/claim={claim.metadata.uid}", devices
+
+
+def check_test3(cluster):
+    ns = "tpu-test3"
+    p1 = cluster.wait_for_pod_running(ns, "sharer1", timeout=15)
+    p2 = cluster.wait_for_pod_running(ns, "sharer2", timeout=15)
+    assert p1.spec.node_name == p2.spec.node_name
+    assert chips_of(cluster, ns, p1) == chips_of(cluster, ns, p2)
+    claim = cluster.clientset.resource_claims(ns).get("global-claim")
+    assert claim.status.allocation.shareable is True
+    assert len(claim.status.reserved_for) == 2
+
+
+def check_test4(cluster):
+    ns = "tpu-test4"
+    pod = cluster.wait_for_pod_running(ns, "subslice-pod", timeout=20)
+    allocated = chips_of(cluster, ns, pod)
+    parent = allocated[0]
+    assert allocated[1].startswith(parent + ":"), allocated
+    assert allocated[2].startswith(parent + ":"), allocated
+    assert allocated[1] != allocated[2], "subslices overlap"
+
+
+def check_test5(cluster):
+    ns = "tpu-test5"
+    p1 = cluster.wait_for_pod_running(ns, "ci1", timeout=15)
+    p2 = cluster.wait_for_pod_running(ns, "ci2", timeout=15)
+    assert chips_of(cluster, ns, p1) == chips_of(cluster, ns, p2)
+
+
+def check_test6(cluster):
+    ns = "tpu-test6"
+    pod = cluster.wait_for_pod_running(ns, "selective-pod", timeout=15)
+    (chip,) = chips_of(cluster, ns, pod)
+    node = cluster.node(pod.spec.node_name)
+    assert node.tpulib.get_time_slice(chip) == 4, "Long quantum not applied"
+
+
+def check_sharing(cluster):
+    ns = "tpu-test-sharing"
+    p1 = cluster.wait_for_pod_running(ns, "proxy-user1", timeout=20)
+    cluster.wait_for_pod_running(ns, "proxy-user2", timeout=20)
+    claim = cluster.clientset.resource_claims(ns).get("proxied-claim")
+    uid = claim.metadata.uid
+    # The per-claim proxy daemon Deployment exists and is "ready".
+    deployment = cluster.clientset.deployments(DRIVER_NS).get(
+        f"tpu-runtime-proxy-{uid[:8]}"
+    )
+    assert deployment.status.ready_replicas >= 1
+    # Consumer CDI spec carries the proxy socket env + mount edits.
+    node = cluster.node(p1.spec.node_name)
+    with open(node.cdi._spec_path(uid)) as f:
+        spec = json.load(f)
+    env = spec["devices"][0]["containerEdits"]["env"]
+    assert any(e.startswith("TPU_RUNTIME_PROXY_ADDR=") for e in env), env
+
+
+def check_topology(cluster):
+    ns = "tpu-test-topology"
+    pod = cluster.wait_for_pod_running(ns, "topo-pod", timeout=15)
+    nas = cluster.clientset.node_allocation_states(DRIVER_NS).get(pod.spec.node_name)
+    claim = claim_of(cluster, ns, pod, "slice")
+    allocated = nas.spec.allocated_claims[claim.metadata.uid].tpu
+    assert allocated.topology == "2x2x1"
+    coords = sorted(d.coord for d in allocated.devices)
+    assert coords == [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)], coords
+    node = cluster.node(pod.spec.node_name)
+    with open(node.cdi._spec_path(claim.metadata.uid)) as f:
+        env = json.load(f)["devices"][0]["containerEdits"]["env"]
+    assert "TPU_CHIPS_PER_HOST_BOUNDS=2,2,1" in env, env
+
+
+# filename -> (check, needs partitionable chips)
+SCENARIOS = {
+    "tpu-test1.yaml": (check_test1, False),
+    "tpu-test2.yaml": (check_test2, False),
+    "tpu-test3.yaml": (check_test3, False),
+    "tpu-test4.yaml": (check_test4, True),
+    "tpu-test5.yaml": (check_test5, True),
+    "tpu-test6.yaml": (check_test6, True),
+    "tpu-test-sharing.yaml": (check_sharing, False),
+    "tpu-test-topology.yaml": (check_topology, False),
+}
+
+
+def run_one(filename: str) -> None:
+    """Fresh cluster per spec, like each demo walkthrough step."""
+    check, partitionable = SCENARIOS[filename]
+    with tempfile.TemporaryDirectory(prefix="tpu-quickstart-") as state_root:
+        cluster = new_cluster(state_root, partitionable=partitionable)
+        try:
+            apply_spec(cluster, filename)
+            check(cluster)
+        finally:
+            cluster.stop()
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(description="asserted quickstart demo")
+    parser.add_argument("--spec", action="append", help="run only these spec files")
+    parser.add_argument("--keep-going", action="store_true")
+    args = parser.parse_args(argv)
+
+    specs = args.spec or sorted(SCENARIOS)
+    failures = 0
+    for filename in specs:
+        try:
+            run_one(filename)
+            print(f"PASS {filename}")
+        except Exception as e:  # noqa: BLE001 — report and continue
+            failures += 1
+            print(f"FAIL {filename}: {e}")
+            if not args.keep_going:
+                return 1
+    print(f"{len(specs) - failures}/{len(specs)} quickstart scenarios passed")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -146,33 +146,17 @@ def make_plugin_stack(
 
 
 class DeploymentReadinessStub:
-    """Marks every created Deployment ready — the fake cluster's
-    'deployment controller' so RuntimeProxy readiness polls succeed."""
+    """The deployment-controller half of KubeSim, for unit tests that need
+    RuntimeProxy readiness polls to succeed without a full cluster sim
+    (one readiness-flipping implementation in the tree, not two)."""
 
     def __init__(self, clientset, namespace: str = "tpu-dra"):
-        import threading
+        from tpu_dra.sim.kubesim import KubeSim
 
-        self._cs = clientset
-        self._ns = namespace
-        self._watch = clientset.server.watch("Deployment")
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
-
-    def _run(self):
-        from tpu_dra.client.apiserver import ApiError
-
-        for event in self._watch:
-            if event["type"] != "ADDED":
-                continue
-            obj = event["object"]
-            client = self._cs.deployments(obj["metadata"].get("namespace", ""))
-            try:
-                deployment = client.get(obj["metadata"]["name"])
-                deployment.status.ready_replicas = 1
-                deployment.status.available_replicas = 1
-                client.update_status(deployment)
-            except ApiError:
-                pass
+        self._sim = KubeSim(
+            clientset, prepare=lambda node, claim: [], namespace=namespace
+        )
+        self._sim.start()
 
     def stop(self):
-        self._watch.stop()
+        self._sim.stop()

@@ -339,3 +339,31 @@ class TestEventLog:
         # A fresh-enough rv still replays.
         recent = int(server.latest_rv()) - 1
         assert server.events_since(recent, "ResourceClaim", "default") is not None
+
+
+class TestStatusSubresourceSemantics:
+    def test_main_update_cannot_move_status(self, cs):
+        """`kubectl apply` of a spec-only manifest must not wipe status for
+        kinds with a real /status subresource."""
+        claims = cs.resource_claims("default")
+        created = claims.create(make_claim("c"))
+        created.status.deallocation_requested = True
+        claims.update_status(created)
+
+        fresh = claims.get("c")
+        fresh.status.deallocation_requested = False  # attempt via main update
+        fresh.metadata.labels["touched"] = "yes"
+        claims.update(fresh)
+
+        after = claims.get("c")
+        assert after.metadata.labels == {"touched": "yes"}  # spec/meta moved
+        assert after.status.deallocation_requested is True  # status did not
+
+    def test_nas_status_moves_via_main_update(self, cs):
+        """NAS has no status subresource (nas.go:161-167): main updates
+        carry status, as the driver's update_status wrapper relies on."""
+        nas = NodeAllocationState(metadata=ObjectMeta(name="n", namespace="ns"))
+        client = NasClient(nas, cs)
+        client.get_or_create()
+        client.update_status("Ready")
+        assert cs.node_allocation_states("ns").get("n").status == "Ready"

@@ -1,0 +1,25 @@
+"""The demo quickstart suite, kept honest in CI: every YAML spec under
+demo/specs/quickstart/ must run green on the sim cluster (SURVEY.md §4 —
+the reference's demo is a narrated walkthrough; ours is asserted)."""
+
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "demo"))
+
+import run_quickstart  # noqa: E402
+
+
+@pytest.mark.parametrize("spec", sorted(run_quickstart.SCENARIOS))
+def test_quickstart_spec(spec):
+    run_quickstart.run_one(spec)
+
+
+def test_every_spec_file_has_a_scenario():
+    spec_files = {
+        f for f in os.listdir(run_quickstart.SPEC_DIR) if f.endswith(".yaml")
+    }
+    assert spec_files == set(run_quickstart.SCENARIOS)

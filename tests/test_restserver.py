@@ -149,3 +149,16 @@ def test_watch_410_relist_recovery(rig):
     else:
         raise AssertionError("watch did not recover after 410")
     watch.stop()
+
+
+def test_namespace_resource_paths(rig):
+    """/api/v1/namespaces/<name> addresses the Namespace object itself —
+    the path grammar must not eat it as a scope prefix."""
+    shim, rest = rig
+    rest.create({"kind": "Namespace", "metadata": {"name": "demo-ns"}})
+    got = rest.get("Namespace", "", "demo-ns")
+    assert got["metadata"]["name"] == "demo-ns"
+    assert [n["metadata"]["name"] for n in rest.list("Namespace")] == ["demo-ns"]
+    rest.delete("Namespace", "", "demo-ns")
+    with pytest.raises(NotFoundError):
+        rest.get("Namespace", "", "demo-ns")

@@ -57,6 +57,17 @@ class InvalidError(ApiError):
     code = 422
 
 
+# Kinds whose status lives behind a real /status subresource upstream.  The
+# NAS CRD deliberately has none (reference nas.go:161-167 +genclient:noStatus).
+STATUS_SUBRESOURCE = {
+    "Pod",
+    "Node",
+    "Deployment",
+    "ResourceClaim",
+    "PodSchedulingContext",
+}
+
+
 def _key(kind: str, namespace: str, name: str) -> tuple:
     return (kind, namespace or "", name)
 
@@ -247,6 +258,15 @@ class FakeApiServer:
                     new["metadata"][immutable] = current_meta[immutable]
                 else:
                     new["metadata"].pop(immutable, None)
+            # For kinds with a real /status subresource, a main-resource
+            # update can NOT move status: carry the stored status over
+            # (mirrors the apiserver; e.g. `kubectl apply` of a spec-only
+            # manifest must not wipe claim allocations or pod phases).
+            if obj.get("kind") in STATUS_SUBRESOURCE:
+                if "status" in current:
+                    new["status"] = copy.deepcopy(current["status"])
+                else:
+                    new.pop("status", None)
         new["metadata"]["resourceVersion"] = self._next_rv()
         self._objects[key] = new
 

@@ -46,6 +46,7 @@ from tpu_dra.client.apiserver import (
 RESOURCES: "dict[str, tuple[str, str, str, bool]]" = {
     "Pod": ("", "v1", "pods", True),
     "Node": ("", "v1", "nodes", False),
+    "Namespace": ("", "v1", "namespaces", False),
     "Deployment": ("apps", "v1", "deployments", True),
     "ResourceClaim": ("resource.k8s.io", "v1alpha2", "resourceclaims", True),
     "ResourceClaimTemplate": ("resource.k8s.io", "v1alpha2", "resourceclaimtemplates", True),
@@ -57,9 +58,10 @@ RESOURCES: "dict[str, tuple[str, str, str, bool]]" = {
     "NodeAllocationState": ("nas.tpu.resource.google.com", "v1alpha1", "nodeallocationstates", True),
 }
 
-# Kinds whose status lives behind a real /status subresource upstream.  The
-# NAS CRD deliberately has none (reference nas.go:161-167 +genclient:noStatus).
-STATUS_SUBRESOURCE = {"Pod", "Node", "Deployment", "ResourceClaim", "PodSchedulingContext"}
+# Kinds whose status lives behind a real /status subresource upstream (the
+# store enforces the matching update semantics; NAS deliberately has none,
+# reference nas.go:161-167 +genclient:noStatus).
+from tpu_dra.client.apiserver import STATUS_SUBRESOURCE  # noqa: E402,F401
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 

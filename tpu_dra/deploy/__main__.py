@@ -1,0 +1,88 @@
+"""`helm template/install` analog for the driver chart.
+
+    python -m tpu_dra.deploy template [--chart DIR] [--set k=v ...]
+    python -m tpu_dra.deploy install --server URL [--chart DIR] [--set k=v ...]
+
+``install`` applies every rendered manifest whose kind the wire apiserver
+models (ResourceClass, DeviceClassParameters, Namespace, ...); kinds with no
+sim-side storage (RBAC, CRDs — a real cluster's business) are reported as
+skipped.  Used by demo/clusters/sim/up.sh the way the reference's demo
+scripts run `helm install` against kind.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import yaml
+
+from tpu_dra.deploy.helmlite import render_chart
+
+DEFAULT_CHART = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "deployments",
+    "helm",
+    "tpu-dra-driver",
+)
+
+
+def _parse_set(pairs: "list[str]") -> dict:
+    """--set a.b=c overrides, helm style (string values only)."""
+    values: dict = {}
+    for pair in pairs:
+        key, _, raw = pair.partition("=")
+        node = values
+        parts = key.split(".")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = yaml.safe_load(raw) if raw else ""
+    return values
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(prog="tpu-dra-deploy")
+    parser.add_argument("verb", choices=["template", "install"])
+    parser.add_argument("--chart", default=DEFAULT_CHART)
+    parser.add_argument("--server", help="apiserver URL (install)")
+    parser.add_argument("--namespace", default="tpu-dra")
+    parser.add_argument("--set", action="append", default=[], dest="sets")
+    args = parser.parse_args(argv)
+
+    rendered = render_chart(
+        args.chart, values=_parse_set(args.sets), namespace=args.namespace
+    )
+
+    if args.verb == "template":
+        for path, docs in rendered.items():
+            for doc in docs:
+                print("---")
+                print(f"# Source: {path}")
+                print(yaml.safe_dump(doc, sort_keys=False), end="")
+        return 0
+
+    if not args.server:
+        parser.error("install requires --server")
+    from tpu_dra.client.restserver import RESOURCES, ClusterConfig, RestApiServer
+    from tpu_dra.sim.kubectl import apply
+
+    server = RestApiServer(ClusterConfig(server=args.server))
+    skipped = []
+    for path, docs in rendered.items():
+        for doc in docs:
+            if doc.get("kind") not in RESOURCES:
+                skipped.append(f"{doc.get('kind')}/{doc['metadata']['name']}")
+                continue
+            for ref in apply(server, [doc], default_namespace=args.namespace):
+                print(f"{ref} applied")
+    if skipped:
+        print(
+            f"skipped (no sim-side storage): {', '.join(sorted(set(skipped)))}",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

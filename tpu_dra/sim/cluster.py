@@ -1,50 +1,28 @@
-"""SimCluster: fake apiserver + controller + node plugins + scheduler/kubelet.
+"""SimCluster: fake apiserver + controller + node plugins + KubeSim.
 
-The scheduler and kubelet simulators reproduce the parts of Kubernetes the
-driver negotiates with:
-
-- **claim-template controller** (kube-controller-manager's
-  resource-claim-controller): for each pod claim entry referencing a
-  ResourceClaimTemplate, create a ResourceClaim named "<pod>-<entry>" owned
-  by the pod.
-- **scheduler** (kube-scheduler DRA plugin): for pods with pending claims,
-  maintain a PodSchedulingContext — publish potentialNodes, read the
-  driver's unsuitableNodes verdicts, pick a node, set selectedNode — and
-  bind the pod once every claim is allocated.
-- **kubelet**: on bind, call the node plugin's NodePrepareResource for each
-  claim and mark the pod Running with its CDI devices attached; on pod
-  deletion, drop reservedFor and delete template-owned claims (which
-  triggers controller deallocation and, through the NAS watch, node GC).
-"""
+The Kubernetes machinery the driver negotiates with (scheduler, kubelet,
+claim-template + deployment controllers) lives in tpu_dra/sim/kubesim.py;
+this module assembles it in-process with the fake apiserver, the real
+controller, and full node-plugin stacks over the mock chip enumerator."""
 
 from __future__ import annotations
 
 import logging
-import threading
-import time
 
 from tpu_dra.api import nas_v1alpha1 as nascrd
-from tpu_dra.api import serde
-from tpu_dra.api.k8s import (
-    Node,
-    Pod,
-    PodSchedulingContext,
-    PodSchedulingContextSpec,
-    ResourceClaim,
-    get_selected_node,
-)
-from tpu_dra.api.meta import ObjectMeta, OwnerReference
-from tpu_dra.client.apiserver import AlreadyExistsError, ApiError, NotFoundError
-from tpu_dra.client.clientset import ClientSet
+from tpu_dra.api.k8s import Node
+from tpu_dra.api.meta import ObjectMeta
 from tpu_dra.client.apiserver import FakeApiServer
+from tpu_dra.client.clientset import ClientSet
 from tpu_dra.client.nasclient import NasClient
-from tpu_dra.controller.driver import DRIVER_NAME, ControllerDriver
-from tpu_dra.controller.reconciler import Controller, resource_claim_name
+from tpu_dra.controller.driver import ControllerDriver
+from tpu_dra.controller.reconciler import Controller
 from tpu_dra.plugin.cdi import CDIHandler
 from tpu_dra.plugin.device_state import DeviceState
 from tpu_dra.plugin.driver import NodeDriver
 from tpu_dra.plugin.sharing import RuntimeProxyManager, TimeSlicingManager
 from tpu_dra.plugin.tpulib import MockTpuLib
+from tpu_dra.sim.kubesim import KubeSim
 
 logger = logging.getLogger(__name__)
 
@@ -141,8 +119,12 @@ class SimCluster:
             recheck_period_s=0.2,
             error_backoff_base_s=0.02,
         )
-        self._stop = threading.Event()
-        self._threads: list[threading.Thread] = []
+        self.kubesim = KubeSim(
+            self.clientset,
+            prepare=self._prepare,
+            namespace=namespace,
+            poll_s=poll_s,
+        )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -150,15 +132,10 @@ class SimCluster:
         for node in self.nodes:
             node.start()
         self.controller.start()
-        for target in (self._scheduler_loop,):
-            t = threading.Thread(target=target, daemon=True)
-            t.start()
-            self._threads.append(t)
+        self.kubesim.start()
 
     def stop(self) -> None:
-        self._stop.set()
-        for t in self._threads:
-            t.join(timeout=5)
+        self.kubesim.stop()
         self.controller.stop()
         for node in self.nodes:
             node.stop()
@@ -166,237 +143,14 @@ class SimCluster:
     def node(self, name: str) -> SimNode:
         return next(n for n in self.nodes if n.name == name)
 
-    # -- scheduler / kubelet simulation --------------------------------------
+    # -- scheduler / kubelet / deployment-controller sim ----------------------
 
-    def _ready_nodes(self) -> list[str]:
-        out = []
-        for node in self.nodes:
-            try:
-                nas = self.clientset.node_allocation_states(self.namespace).get(
-                    node.name
-                )
-                if nas.status == nascrd.STATUS_READY:
-                    out.append(node.name)
-            except ApiError:
-                pass
-        return out
+    def _prepare(self, node_name: str, claim) -> "list[str]":
+        """In-process kubelet prepare: call the node's driver directly."""
+        return self.node(node_name).driver.node_prepare_resource(claim.metadata.uid)
 
-    def _scheduler_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                for pod in self.clientset.pods("").list_all_namespaces():
-                    if pod.metadata.deletion_timestamp:
-                        continue
-                    if pod.status.phase == "Running":
-                        continue
-                    self._schedule_pod(pod)
-            except Exception:
-                logger.exception("scheduler iteration failed")
-            self._stop.wait(self.poll_s)
-
-    def _ensure_claims(self, pod: Pod) -> list[ResourceClaim]:
-        """Claim-template controller: instantiate template claims."""
-        claims = []
-        claims_client = self.clientset.resource_claims(pod.metadata.namespace)
-        for pod_claim in pod.spec.resource_claims:
-            name = resource_claim_name(pod, pod_claim)
-            template_name = pod_claim.source.resource_claim_template_name
-            try:
-                claim = claims_client.get(name)
-            except NotFoundError:
-                if not template_name:
-                    return []  # referenced claim doesn't exist (yet)
-                template = self.clientset.resource_claim_templates(
-                    pod.metadata.namespace
-                ).get(template_name)
-                claim = ResourceClaim(
-                    metadata=ObjectMeta(
-                        name=name,
-                        namespace=pod.metadata.namespace,
-                        owner_references=[
-                            OwnerReference(
-                                api_version="v1",
-                                kind="Pod",
-                                name=pod.metadata.name,
-                                uid=pod.metadata.uid,
-                            )
-                        ],
-                    ),
-                    spec=serde.deepcopy(template.spec.spec),
-                )
-                try:
-                    claim = claims_client.create(claim)
-                except AlreadyExistsError:
-                    claim = claims_client.get(name)
-            claims.append(claim)
-        return claims
-
-    def _schedule_pod(self, pod: Pod) -> None:
-        claims = self._ensure_claims(pod)
-        if pod.spec.resource_claims and not claims:
-            return
-
-        pending = [c for c in claims if c.status.allocation is None]
-        if pending:
-            self._negotiate(pod, claims)
-            return
-
-        # All claims allocated (or none needed): bind + kubelet prepare.
-        node_name = pod.spec.node_name
-        if not node_name:
-            if claims:
-                node_name = get_selected_node(claims[0])
-            else:
-                ready = self._ready_nodes()
-                if not ready:
-                    return
-                node_name = ready[0]
-            pod.spec.node_name = node_name
-            try:
-                pod = self.clientset.pods(pod.metadata.namespace).update(pod)
-            except ApiError:
-                return
-
-        # Reserve each claim for this pod (the scheduler does this before
-        # binding; for shared claims this appends a second consumer).
-        claims_client = self.clientset.resource_claims(pod.metadata.namespace)
-        for claim in claims:
-            fresh = claims_client.get(claim.metadata.name)
-            if not any(
-                r.uid == pod.metadata.uid for r in fresh.status.reserved_for
-            ):
-                from tpu_dra.api.k8s import ResourceClaimConsumerReference
-
-                fresh.status.reserved_for.append(
-                    ResourceClaimConsumerReference(
-                        resource="pods",
-                        name=pod.metadata.name,
-                        uid=pod.metadata.uid,
-                    )
-                )
-                try:
-                    claims_client.update_status(fresh)
-                except ApiError:
-                    return
-
-        sim_node = self.node(node_name)
-        cdi_devices = []
-        for claim in claims:
-            cdi_devices.extend(
-                sim_node.driver.node_prepare_resource(claim.metadata.uid)
-            )
-        pod.status.phase = "Running"
-        pod.metadata.annotations["cdi.k8s.io/devices"] = ",".join(cdi_devices)
-        try:
-            self.clientset.pods(pod.metadata.namespace).update(pod)
-        except ApiError:
-            pass
-
-    def _negotiate(self, pod: Pod, claims: list[ResourceClaim]) -> None:
-        """Maintain the PodSchedulingContext for a pod with pending claims."""
-        sc_client = self.clientset.pod_scheduling_contexts(pod.metadata.namespace)
-        try:
-            sc = sc_client.get(pod.metadata.name)
-        except NotFoundError:
-            sc = PodSchedulingContext(
-                metadata=ObjectMeta(
-                    name=pod.metadata.name,
-                    namespace=pod.metadata.namespace,
-                    owner_references=[
-                        OwnerReference(
-                            api_version="v1",
-                            kind="Pod",
-                            name=pod.metadata.name,
-                            uid=pod.metadata.uid,
-                        )
-                    ],
-                ),
-                spec=PodSchedulingContextSpec(
-                    potential_nodes=self._ready_nodes()
-                ),
-            )
-            try:
-                sc_client.create(sc)
-            except AlreadyExistsError:
-                pass
-            return
-
-        if sc.spec.selected_node:
-            # Check the driver didn't veto our selection.
-            for entry in sc.status.resource_claims:
-                if sc.spec.selected_node in entry.unsuitable_nodes:
-                    sc.spec.selected_node = ""
-                    sc.spec.potential_nodes = self._ready_nodes()
-                    try:
-                        sc_client.update(sc)
-                    except ApiError:
-                        pass
-                    return
-            return  # wait for allocation to land
-
-        # Pick the first node not unsuitable for any claim, once the driver
-        # has reported on every claim.
-        if len(sc.status.resource_claims) < len(
-            [c for c in claims if c.status.allocation is None]
-        ):
-            return  # driver hasn't reported yet
-        unsuitable: set[str] = set()
-        for entry in sc.status.resource_claims:
-            unsuitable.update(entry.unsuitable_nodes)
-        candidates = [n for n in sc.spec.potential_nodes if n not in unsuitable]
-        if not candidates:
-            # Refresh potential nodes — but only write when the set actually
-            # changed: rewriting an identical spec every poll bumps the
-            # resourceVersion and livelocks the controller's status updates
-            # out of every conflict retry.
-            ready = self._ready_nodes()
-            if ready != sc.spec.potential_nodes:
-                sc.spec.potential_nodes = ready
-                try:
-                    sc_client.update(sc)
-                except ApiError:
-                    pass
-            return
-        sc.spec.selected_node = candidates[0]
-        try:
-            sc_client.update(sc)
-        except ApiError:
-            pass
-
-    # -- user-facing helpers --------------------------------------------------
-
-    def wait_for_pod_running(self, namespace: str, name: str, timeout: float = 10.0) -> Pod:
-        deadline = time.monotonic() + timeout
-        last = None
-        while time.monotonic() < deadline:
-            last = self.clientset.pods(namespace).get(name)
-            if last.status.phase == "Running":
-                return last
-            time.sleep(self.poll_s)
-        raise TimeoutError(
-            f"pod {namespace}/{name} not Running after {timeout}s "
-            f"(phase={last.status.phase if last else 'unknown'})"
-        )
+    def wait_for_pod_running(self, namespace: str, name: str, timeout: float = 10.0):
+        return self.kubesim.wait_for_pod_running(namespace, name, timeout)
 
     def delete_pod(self, namespace: str, name: str) -> None:
-        """Pod teardown: drop the pod's reservedFor entries first (the
-        kubelet's job on pod death), then delete the pod, whose owner-GC
-        cascades template-owned claims.  Unreserving first is safe because
-        the scheduler only negotiates for pods with pending claims — a
-        Running pod's claims are never tentatively re-allocated — and it
-        means that by the time the claim objects die their deallocation
-        path (controller syncClaim) sees no stale consumers."""
-        pods = self.clientset.pods(namespace)
-        pod = pods.get(name)
-        claims_client = self.clientset.resource_claims(namespace)
-        for pod_claim in pod.spec.resource_claims:
-            claim_name = resource_claim_name(pod, pod_claim)
-            try:
-                claim = claims_client.get(claim_name)
-            except NotFoundError:
-                continue
-            claim.status.reserved_for = [
-                r for r in claim.status.reserved_for if r.uid != pod.metadata.uid
-            ]
-            claims_client.update_status(claim)
-        pods.delete(name)
+        self.kubesim.delete_pod(namespace, name)

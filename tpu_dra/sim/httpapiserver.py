@@ -38,7 +38,12 @@ def _parse_path(path: str):
         return None
     parts = parts[2:] if parts[0] == "api" else parts[3:]
     namespace = ""
-    if len(parts) >= 2 and parts[0] == "namespaces":
+    if parts and parts[0] == "namespaces":
+        if len(parts) <= 2:
+            # /api/v1/namespaces[/<name>] addresses the Namespace resource
+            # itself — only a LONGER path uses "namespaces" as the scope
+            # prefix (the classic k8s path-grammar ambiguity).
+            return "Namespace", "", unquote(parts[1]) if len(parts) > 1 else "", ""
         namespace = unquote(parts[1])
         parts = parts[2:]
     if not parts:

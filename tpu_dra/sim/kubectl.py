@@ -1,0 +1,118 @@
+"""`kubectl apply/delete -f` analog for the sim and HTTP apiservers.
+
+The reference's demo is driven by `kubectl apply -f demo/specs/quickstart/...`
+against a kind cluster (demo/clusters/kind/*.sh, SURVEY.md §4).  This module
+is that verb for this repo's two cluster rungs: the in-process FakeApiServer
+(SimCluster) and the HTTP wire shim — so the same YAML workload specs run
+everywhere, and the e2e suite asserts them instead of narrating.
+
+Usage as a library: ``apply(server, load_yaml(text))``.
+Usage as a CLI:     ``python -m tpu_dra.sim.kubectl apply -f spec.yaml
+--server http://127.0.0.1:8001``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import yaml
+
+from tpu_dra.client.apiserver import AlreadyExistsError, ApiError, NotFoundError
+from tpu_dra.client.restserver import RESOURCES
+
+
+def load_yaml(text: str) -> "list[dict]":
+    """Parse a (possibly multi-document) YAML manifest stream."""
+    return [d for d in yaml.safe_load_all(text) if d]
+
+
+def load_file(path: str) -> "list[dict]":
+    with open(path) as f:
+        return load_yaml(f.read())
+
+
+def _is_namespaced(doc: dict) -> bool:
+    entry = RESOURCES.get(doc.get("kind", ""))
+    if entry is not None:
+        return entry[3]
+    return bool(doc.get("metadata", {}).get("namespace"))
+
+
+def apply(server, docs: "list[dict]", default_namespace: str = "default") -> "list[str]":
+    """Create-or-update every document; returns "kind/namespace/name" ids.
+
+    Mirrors `kubectl apply` semantics at the level the demo needs:
+    create, or on AlreadyExists re-read for the current resourceVersion and
+    update (full-object replace).
+    """
+    applied = []
+    for doc in docs:
+        kind = doc.get("kind")
+        if not kind:
+            raise ValueError("document has no kind")
+        meta = doc.setdefault("metadata", {})
+        if _is_namespaced(doc):
+            meta.setdefault("namespace", default_namespace)
+        namespace = meta.get("namespace", "")
+        name = meta.get("name", "")
+        try:
+            server.create(doc)
+        except AlreadyExistsError:
+            current = server.get(kind, namespace, name)
+            doc["metadata"]["resourceVersion"] = current["metadata"][
+                "resourceVersion"
+            ]
+            server.update(doc)
+        applied.append(f"{kind}/{namespace}/{name}" if namespace else f"{kind}/{name}")
+    return applied
+
+
+def delete(server, docs: "list[dict]", default_namespace: str = "default") -> "list[str]":
+    """Delete every document (reverse order, NotFound tolerated)."""
+    deleted = []
+    for doc in reversed(docs):
+        kind = doc.get("kind", "")
+        meta = doc.get("metadata", {})
+        namespace = meta.get("namespace") or (
+            default_namespace if _is_namespaced(doc) else ""
+        )
+        name = meta.get("name", "")
+        try:
+            server.delete(kind, namespace, name)
+            deleted.append(f"{kind}/{namespace}/{name}" if namespace else f"{kind}/{name}")
+        except NotFoundError:
+            pass
+    return deleted
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="tpu-kubectl", description="apply/delete manifests to an apiserver"
+    )
+    parser.add_argument("verb", choices=["apply", "delete"])
+    parser.add_argument("-f", "--filename", required=True, action="append")
+    parser.add_argument("--server", default="http://127.0.0.1:8001")
+    parser.add_argument("-n", "--namespace", default="default")
+    args = parser.parse_args(argv)
+
+    from tpu_dra.client.restserver import ClusterConfig, RestApiServer
+
+    server = RestApiServer(ClusterConfig(server=args.server))
+    docs = []
+    for path in args.filename:
+        docs.extend(load_file(path))
+    try:
+        fn = apply if args.verb == "apply" else delete
+        suffix = "applied" if args.verb == "apply" else "deleted"
+        for ref in fn(server, docs, default_namespace=args.namespace):
+            print(f"{ref} {suffix}")
+    except ApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,415 @@
+"""KubeSim: the Kubernetes machinery the driver negotiates with, simulated.
+
+The reference tests its driver inside a kind cluster, which supplies the
+real kube-scheduler, kube-controller-manager, and kubelet (SURVEY.md §4).
+This module simulates exactly the parts of those components the DRA driver
+talks to, over ANY clientset — the in-process FakeApiServer (SimCluster) or
+the HTTP wire (``python -m tpu_dra.sim.kubesim --apiserver ...`` next to the
+real controller/plugin binaries):
+
+- **claim-template controller** (kube-controller-manager's
+  resource-claim-controller): for each pod claim entry referencing a
+  ResourceClaimTemplate, create a ResourceClaim named "<pod>-<entry>" owned
+  by the pod.
+- **scheduler** (kube-scheduler DRA plugin): for pods with pending claims,
+  maintain a PodSchedulingContext — publish potentialNodes, read the
+  driver's unsuitableNodes verdicts, pick a node, set selectedNode — and
+  bind the pod once every claim is allocated.
+- **kubelet**: on bind, call the node plugin's NodePrepareResource for each
+  claim — via a pluggable ``prepare`` callable: in-process driver call
+  (SimCluster) or real gRPC over the plugin's unix socket (wire rung) — and
+  mark the pod Running with its CDI devices attached.
+- **deployment controller**: flip Deployments ready so RuntimeProxy daemon
+  readiness polls succeed.
+
+Ready nodes are discovered from NAS objects (status=Ready) in the driver
+namespace — the same source of truth the controller uses.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api import serde
+from tpu_dra.api.k8s import (
+    Pod,
+    PodSchedulingContext,
+    PodSchedulingContextSpec,
+    ResourceClaim,
+    ResourceClaimConsumerReference,
+    get_selected_node,
+)
+from tpu_dra.api.meta import ObjectMeta, OwnerReference
+from tpu_dra.client.apiserver import AlreadyExistsError, ApiError, NotFoundError
+from tpu_dra.client.clientset import ClientSet
+from tpu_dra.controller.reconciler import resource_claim_name
+
+logger = logging.getLogger(__name__)
+
+# prepare(node_name, claim) -> qualified CDI device names
+PrepareFn = Callable[[str, ResourceClaim], "list[str]"]
+
+
+class KubeSim:
+    def __init__(
+        self,
+        clientset: ClientSet,
+        *,
+        prepare: PrepareFn,
+        namespace: str = "tpu-dra",
+        poll_s: float = 0.01,
+    ):
+        self.clientset = clientset
+        self.namespace = namespace
+        self.poll_s = poll_s
+        self._prepare = prepare
+        self._stop = threading.Event()
+        self._threads: "list[threading.Thread]" = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        for target in (self._scheduler_loop, self._deployment_controller_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # -- node discovery -------------------------------------------------------
+
+    def ready_nodes(self) -> "list[str]":
+        out = []
+        try:
+            for nas in self.clientset.node_allocation_states(self.namespace).list():
+                if nas.status == nascrd.STATUS_READY:
+                    out.append(nas.metadata.name)
+        except ApiError:
+            pass
+        return sorted(out)
+
+    # -- control loops --------------------------------------------------------
+
+    def _scheduler_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                for pod in self.clientset.pods("").list_all_namespaces():
+                    if pod.metadata.deletion_timestamp:
+                        continue
+                    if pod.status.phase == "Running":
+                        continue
+                    self._schedule_pod(pod)
+            except Exception:
+                logger.exception("scheduler iteration failed")
+            self._stop.wait(self.poll_s)
+
+    def _deployment_controller_loop(self) -> None:
+        """Mark every Deployment ready, so the node plugin's RuntimeProxy
+        readiness poll (sharing.py assert_ready) succeeds the way it would
+        once kubelet ran the proxy pod."""
+        while not self._stop.is_set():
+            try:
+                client = self.clientset.deployments(self.namespace)
+                for deployment in client.list():
+                    want = deployment.spec.replicas or 1
+                    if deployment.status.ready_replicas != want:
+                        deployment.status.ready_replicas = want
+                        deployment.status.available_replicas = want
+                        try:
+                            client.update_status(deployment)
+                        except ApiError:
+                            pass
+            except Exception:
+                logger.exception("deployment controller iteration failed")
+            self._stop.wait(self.poll_s)
+
+    def _ensure_claims(self, pod: Pod) -> "list[ResourceClaim]":
+        """Claim-template controller: instantiate template claims."""
+        claims = []
+        claims_client = self.clientset.resource_claims(pod.metadata.namespace)
+        for pod_claim in pod.spec.resource_claims:
+            name = resource_claim_name(pod, pod_claim)
+            template_name = pod_claim.source.resource_claim_template_name
+            try:
+                claim = claims_client.get(name)
+            except NotFoundError:
+                if not template_name:
+                    return []  # referenced claim doesn't exist (yet)
+                template = self.clientset.resource_claim_templates(
+                    pod.metadata.namespace
+                ).get(template_name)
+                claim = ResourceClaim(
+                    metadata=ObjectMeta(
+                        name=name,
+                        namespace=pod.metadata.namespace,
+                        owner_references=[
+                            OwnerReference(
+                                api_version="v1",
+                                kind="Pod",
+                                name=pod.metadata.name,
+                                uid=pod.metadata.uid,
+                            )
+                        ],
+                    ),
+                    spec=serde.deepcopy(template.spec.spec),
+                )
+                try:
+                    claim = claims_client.create(claim)
+                except AlreadyExistsError:
+                    claim = claims_client.get(name)
+            claims.append(claim)
+        return claims
+
+    def _schedule_pod(self, pod: Pod) -> None:
+        claims = self._ensure_claims(pod)
+        if pod.spec.resource_claims and not claims:
+            return
+
+        pending = [c for c in claims if c.status.allocation is None]
+        if pending:
+            self._negotiate(pod, claims)
+            return
+
+        # All claims allocated (or none needed): bind + kubelet prepare.
+        node_name = pod.spec.node_name
+        if not node_name:
+            if claims:
+                node_name = get_selected_node(claims[0])
+            else:
+                ready = self.ready_nodes()
+                if not ready:
+                    return
+                node_name = ready[0]
+            pod.spec.node_name = node_name
+            try:
+                pod = self.clientset.pods(pod.metadata.namespace).update(pod)
+            except ApiError:
+                return
+
+        # Reserve each claim for this pod (the scheduler does this before
+        # binding; for shared claims this appends a second consumer).
+        claims_client = self.clientset.resource_claims(pod.metadata.namespace)
+        for claim in claims:
+            fresh = claims_client.get(claim.metadata.name)
+            if not any(
+                r.uid == pod.metadata.uid for r in fresh.status.reserved_for
+            ):
+                fresh.status.reserved_for.append(
+                    ResourceClaimConsumerReference(
+                        resource="pods",
+                        name=pod.metadata.name,
+                        uid=pod.metadata.uid,
+                    )
+                )
+                try:
+                    claims_client.update_status(fresh)
+                except ApiError:
+                    return
+
+        cdi_devices = []
+        for claim in claims:
+            cdi_devices.extend(self._prepare(node_name, claim))
+        pods_client = self.clientset.pods(pod.metadata.namespace)
+        pod.metadata.annotations["cdi.k8s.io/devices"] = ",".join(cdi_devices)
+        try:
+            # Main update carries the annotation; phase moves through the
+            # status subresource (the store won't let a main update touch it,
+            # matching the real kubelet's pods/status write).
+            pod = pods_client.update(pod)
+            pod.status.phase = "Running"
+            pods_client.update_status(pod)
+        except ApiError:
+            pass
+
+    def _negotiate(self, pod: Pod, claims: "list[ResourceClaim]") -> None:
+        """Maintain the PodSchedulingContext for a pod with pending claims."""
+        sc_client = self.clientset.pod_scheduling_contexts(pod.metadata.namespace)
+        try:
+            sc = sc_client.get(pod.metadata.name)
+        except NotFoundError:
+            sc = PodSchedulingContext(
+                metadata=ObjectMeta(
+                    name=pod.metadata.name,
+                    namespace=pod.metadata.namespace,
+                    owner_references=[
+                        OwnerReference(
+                            api_version="v1",
+                            kind="Pod",
+                            name=pod.metadata.name,
+                            uid=pod.metadata.uid,
+                        )
+                    ],
+                ),
+                spec=PodSchedulingContextSpec(potential_nodes=self.ready_nodes()),
+            )
+            try:
+                sc_client.create(sc)
+            except AlreadyExistsError:
+                pass
+            return
+
+        if sc.spec.selected_node:
+            # Check the driver didn't veto our selection.
+            for entry in sc.status.resource_claims:
+                if sc.spec.selected_node in entry.unsuitable_nodes:
+                    sc.spec.selected_node = ""
+                    sc.spec.potential_nodes = self.ready_nodes()
+                    try:
+                        sc_client.update(sc)
+                    except ApiError:
+                        pass
+                    return
+            return  # wait for allocation to land
+
+        # Pick the first node not unsuitable for any claim, once the driver
+        # has reported on every claim.
+        if len(sc.status.resource_claims) < len(
+            [c for c in claims if c.status.allocation is None]
+        ):
+            return  # driver hasn't reported yet
+        unsuitable: "set[str]" = set()
+        for entry in sc.status.resource_claims:
+            unsuitable.update(entry.unsuitable_nodes)
+        candidates = [n for n in sc.spec.potential_nodes if n not in unsuitable]
+        if not candidates:
+            # Refresh potential nodes — but only write when the set actually
+            # changed: rewriting an identical spec every poll bumps the
+            # resourceVersion and livelocks the controller's status updates
+            # out of every conflict retry.
+            ready = self.ready_nodes()
+            if ready != sc.spec.potential_nodes:
+                sc.spec.potential_nodes = ready
+                try:
+                    sc_client.update(sc)
+                except ApiError:
+                    pass
+            return
+        sc.spec.selected_node = candidates[0]
+        try:
+            sc_client.update(sc)
+        except ApiError:
+            pass
+
+    # -- user-facing helpers ---------------------------------------------------
+
+    def wait_for_pod_running(
+        self, namespace: str, name: str, timeout: float = 10.0
+    ) -> Pod:
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            last = self.clientset.pods(namespace).get(name)
+            if last.status.phase == "Running":
+                return last
+            time.sleep(self.poll_s)
+        raise TimeoutError(
+            f"pod {namespace}/{name} not Running after {timeout}s "
+            f"(phase={last.status.phase if last else 'unknown'})"
+        )
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        """Pod teardown: drop the pod's reservedFor entries first (the
+        kubelet's job on pod death), then delete the pod, whose owner-GC
+        cascades template-owned claims.  Unreserving first is safe because
+        the scheduler only negotiates for pods with pending claims — a
+        Running pod's claims are never tentatively re-allocated — and it
+        means that by the time the claim objects die their deallocation
+        path (controller syncClaim) sees no stale consumers."""
+        pods = self.clientset.pods(namespace)
+        pod = pods.get(name)
+        claims_client = self.clientset.resource_claims(namespace)
+        for pod_claim in pod.spec.resource_claims:
+            claim_name = resource_claim_name(pod, pod_claim)
+            try:
+                claim = claims_client.get(claim_name)
+            except NotFoundError:
+                continue
+            claim.status.reserved_for = [
+                r for r in claim.status.reserved_for if r.uid != pod.metadata.uid
+            ]
+            claims_client.update_status(claim)
+        pods.delete(name)
+
+
+class GrpcKubelet:
+    """Kubelet prepare path for the wire rung: dial each node's plugin
+    socket with the real DRA gRPC client."""
+
+    def __init__(self, sockets: "dict[str, str]"):
+        self._sockets = sockets  # node name -> plugin.sock path
+
+    def prepare(self, node_name: str, claim: ResourceClaim) -> "list[str]":
+        from tpu_dra.plugin.kubeletplugin import DRAClient
+
+        socket = self._sockets.get(node_name)
+        if socket is None:
+            raise RuntimeError(f"no plugin socket known for node {node_name}")
+        client = DRAClient(socket)
+        try:
+            return client.node_prepare_resource(
+                claim.metadata.namespace,
+                claim.metadata.uid,
+                claim.metadata.name,
+            )
+        finally:
+            client.close()
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(
+        prog="tpu-kubesim",
+        description="scheduler/kubelet/controller-manager sim for the wire demo",
+    )
+    parser.add_argument("--apiserver", required=True)
+    parser.add_argument("--namespace", default="tpu-dra")
+    parser.add_argument(
+        "--node",
+        action="append",
+        required=True,
+        metavar="NAME=PLUGIN_SOCKET",
+        help="node name and its DRA plugin socket path (repeatable)",
+    )
+    parser.add_argument("--poll-seconds", type=float, default=0.1)
+    args = parser.parse_args(argv)
+
+    sockets = {}
+    for entry in args.node:
+        name, _, socket = entry.partition("=")
+        if not socket:
+            parser.error(f"--node needs NAME=PLUGIN_SOCKET, got {entry!r}")
+        sockets[name] = socket
+
+    from tpu_dra.client.restserver import ClusterConfig, RestApiServer
+
+    clientset = ClientSet(
+        RestApiServer(ClusterConfig(server=args.apiserver), qps=100, burst=200)
+    )
+    sim = KubeSim(
+        clientset,
+        prepare=GrpcKubelet(sockets).prepare,
+        namespace=args.namespace,
+        poll_s=args.poll_seconds,
+    )
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    sim.start()
+    logging.basicConfig(level=logging.INFO)
+    logger.info("kubesim running against %s (nodes: %s)", args.apiserver, sockets)
+    stop.wait()
+    sim.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
