@@ -1,0 +1,67 @@
+// Minimal C-level smoke test for the discovery ABI (run via `make test`):
+// builds a fake devfs/sysfs tree, scans it, and checks the JSON shape.
+#include <assert.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <fstream>
+#include <string>
+
+extern "C" {
+const char* tpu_discovery_version(void);
+long tpu_discovery_scan(const char* devfs_root, const char* sysfs_root,
+                        char* out, unsigned long cap);
+}
+
+static void WriteFile(const std::string& path, const std::string& text) {
+  std::ofstream(path) << text << "\n";
+}
+
+int main() {
+  assert(strcmp(tpu_discovery_version(), "tpu-discovery/1") == 0);
+
+  char tmpl[] = "/tmp/tpudisc-XXXXXX";
+  std::string root = mkdtemp(tmpl);
+  std::string dev = root + "/dev", sys = root + "/sys";
+  mkdir(dev.c_str(), 0755);
+  mkdir(sys.c_str(), 0755);
+  mkdir((sys + "/class").c_str(), 0755);
+  mkdir((sys + "/class/accel").c_str(), 0755);
+  for (int i = 0; i < 2; i++) {
+    std::string name = "accel" + std::to_string(i);
+    WriteFile(dev + "/" + name, "");
+    std::string devdir = sys + "/class/accel/" + name;
+    mkdir(devdir.c_str(), 0755);
+    std::string pci = sys + "/pci-" + std::to_string(i);
+    mkdir(pci.c_str(), 0755);
+    WriteFile(pci + "/vendor", "0x1ae0");
+    WriteFile(pci + "/device", "0x0063");
+    WriteFile(pci + "/numa_node", std::to_string(i));
+    symlink(("../../../pci-" + std::to_string(i)).c_str(),
+            (devdir + "/device").c_str());
+  }
+
+  char out[8192];
+  long n = tpu_discovery_scan(dev.c_str(), sys.c_str(), out, sizeof(out));
+  assert(n > 0);
+  std::string json(out);
+  assert(json.find("\"chips\":[{") != std::string::npos);
+  assert(json.find("\"path\":\"" + dev + "/accel0\"") != std::string::npos);
+  assert(json.find("\"vendor\":\"0x1ae0\"") != std::string::npos);
+
+  // cap too small reports the needed size.
+  long need = tpu_discovery_scan(dev.c_str(), sys.c_str(), out, 4);
+  assert(need < 0 && static_cast<long>(-need) == n + 1);
+
+  // empty devfs is data, not an error.
+  std::string empty = root + "/emptydev";
+  mkdir(empty.c_str(), 0755);
+  n = tpu_discovery_scan(empty.c_str(), sys.c_str(), out, sizeof(out));
+  assert(n > 0 && std::string(out).find("\"chips\":[]") != std::string::npos);
+
+  printf("native smoke OK: %s\n", tpu_discovery_version());
+  return 0;
+}

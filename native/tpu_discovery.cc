@@ -1,0 +1,224 @@
+// TPU device-discovery shim — the native boundary of the node plugin.
+//
+// The reference driver's only native code is the NVML cgo binding behind its
+// deviceLib seam (reference: cmd/nvidia-dra-plugin/nvlib.go:32-66 loading
+// libnvidia-ml.so.1, find.go:28-44 locating it).  The TPU analog needs no
+// vendor library, but the low-level half of discovery — walking devfs,
+// correlating each accel node with its PCI function and NUMA node through
+// sysfs — is the same kind of host-poking work, done here in C++ behind a
+// minimal C ABI that tpu_dra/plugin/native.py loads with ctypes (no
+// pybind11 dependency).
+//
+// ABI (stable, JSON-out to keep marshalling trivial and versionable):
+//   const char* tpu_discovery_version(void);
+//   long tpu_discovery_scan(const char* devfs_root, const char* sysfs_root,
+//                           char* out, unsigned long cap);
+//     Writes a JSON document {"chips":[...],"bounds":[x,y,z]|null} and
+//     returns the byte length, or -(needed bytes) if cap was too small, or
+//     -1 on internal error.  Scanning an empty/missing devfs yields
+//     {"chips":[]} — absence of TPUs is data, not an error.
+
+#include <dirent.h>
+#include <limits.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cctype>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr const char kVersion[] = "tpu-discovery/1";
+
+bool IsAllDigits(const std::string& s) {
+  if (s.empty()) return false;
+  return std::all_of(s.begin(), s.end(),
+                     [](unsigned char c) { return std::isdigit(c); });
+}
+
+// "accel12" -> 12, anything else -> -1.
+int AccelIndex(const std::string& name) {
+  if (name.rfind("accel", 0) != 0) return -1;
+  std::string digits = name.substr(5);
+  if (!IsAllDigits(digits)) return -1;
+  return std::atoi(digits.c_str());
+}
+
+std::vector<std::string> ListDir(const std::string& path) {
+  std::vector<std::string> names;
+  DIR* dir = opendir(path.c_str());
+  if (dir == nullptr) return names;
+  while (dirent* entry = readdir(dir)) {
+    std::string name = entry->d_name;
+    if (name != "." && name != "..") names.push_back(name);
+  }
+  closedir(dir);
+  std::sort(names.begin(), names.end());
+  return names;
+}
+
+std::string ReadTrimmed(const std::string& path) {
+  std::ifstream in(path);
+  if (!in) return "";
+  std::string line;
+  std::getline(in, line);
+  while (!line.empty() &&
+         (line.back() == '\n' || line.back() == '\r' || line.back() == ' ')) {
+    line.pop_back();
+  }
+  return line;
+}
+
+// The PCI address is the basename of the resolved device symlink, e.g.
+// /sys/class/accel/accel0/device -> ../../../0000:00:05.0
+std::string PciAddress(const std::string& device_link) {
+  char resolved[PATH_MAX];
+  ssize_t n = readlink(device_link.c_str(), resolved, sizeof(resolved) - 1);
+  if (n <= 0) return "";
+  resolved[n] = '\0';
+  std::string target(resolved);
+  size_t slash = target.find_last_of('/');
+  return slash == std::string::npos ? target : target.substr(slash + 1);
+}
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+struct Chip {
+  int index = 0;
+  std::string path;        // /dev/accelN or /dev/vfio/N
+  std::string kind;        // "accel" | "vfio"
+  std::string pci_address; // 0000:00:05.0 ("" if sysfs has no record)
+  std::string vendor;      // 0x1ae0 ("" unknown)
+  std::string device;      // chip model id ("" unknown)
+  int numa_node = -1;
+};
+
+void AppendChipJson(std::ostringstream& out, const Chip& chip) {
+  out << "{\"index\":" << chip.index
+      << ",\"path\":\"" << JsonEscape(chip.path) << "\""
+      << ",\"kind\":\"" << chip.kind << "\""
+      << ",\"pciAddress\":\"" << JsonEscape(chip.pci_address) << "\""
+      << ",\"vendor\":\"" << JsonEscape(chip.vendor) << "\""
+      << ",\"device\":\"" << JsonEscape(chip.device) << "\""
+      << ",\"numaNode\":" << chip.numa_node << "}";
+}
+
+// TPU_CHIPS_PER_HOST_BOUNDS="2,2,1" -> {2,2,1}; unset/malformed -> empty.
+std::vector<int> HostBounds() {
+  const char* raw = std::getenv("TPU_CHIPS_PER_HOST_BOUNDS");
+  if (raw == nullptr) return {};
+  std::vector<int> bounds;
+  std::stringstream ss(raw);
+  std::string part;
+  while (std::getline(ss, part, ',')) {
+    if (!IsAllDigits(part)) return {};
+    bounds.push_back(std::atoi(part.c_str()));
+  }
+  if (bounds.size() == 2) bounds.push_back(1);
+  if (bounds.size() != 3) return {};
+  return bounds;
+}
+
+std::vector<Chip> Scan(const std::string& devfs_root,
+                       const std::string& sysfs_root) {
+  std::vector<Chip> chips;
+  // Primary: /dev/accelN (TPU VM runtime driver).
+  for (const std::string& name : ListDir(devfs_root)) {
+    int index = AccelIndex(name);
+    if (index < 0) continue;
+    Chip chip;
+    chip.index = index;
+    chip.path = devfs_root + "/" + name;
+    chip.kind = "accel";
+    std::string sys = sysfs_root + "/class/accel/" + name + "/device";
+    chip.pci_address = PciAddress(sys);
+    chip.vendor = ReadTrimmed(sys + "/vendor");
+    chip.device = ReadTrimmed(sys + "/device");
+    std::string numa = ReadTrimmed(sys + "/numa_node");
+    if (!numa.empty() && (IsAllDigits(numa) || numa[0] == '-')) {
+      chip.numa_node = std::atoi(numa.c_str());
+    }
+    chips.push_back(chip);
+  }
+  if (!chips.empty()) {
+    std::sort(chips.begin(), chips.end(),
+              [](const Chip& a, const Chip& b) { return a.index < b.index; });
+    return chips;
+  }
+  // Fallback: /dev/vfio/N (DPDK-style binding; no accel-class sysfs).
+  // Numeric ordering, matching the accel path: 7 before 12.
+  std::vector<int> groups;
+  for (const std::string& name : ListDir(devfs_root + "/vfio")) {
+    if (IsAllDigits(name)) groups.push_back(std::atoi(name.c_str()));
+  }
+  std::sort(groups.begin(), groups.end());
+  int index = 0;
+  for (int group : groups) {
+    Chip chip;
+    chip.index = index++;
+    chip.path = devfs_root + "/vfio/" + std::to_string(group);
+    chip.kind = "vfio";
+    chips.push_back(chip);
+  }
+  return chips;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* tpu_discovery_version(void) { return kVersion; }
+
+long tpu_discovery_scan(const char* devfs_root, const char* sysfs_root,
+                        char* out, unsigned long cap) {
+  if (devfs_root == nullptr || out == nullptr) return -1;
+  std::string sysfs = sysfs_root ? sysfs_root : "/sys";
+  std::ostringstream json;
+  json << "{\"version\":\"" << kVersion << "\",\"chips\":[";
+  bool first = true;
+  for (const Chip& chip : Scan(devfs_root, sysfs)) {
+    if (!first) json << ",";
+    first = false;
+    AppendChipJson(json, chip);
+  }
+  json << "],\"bounds\":";
+  std::vector<int> bounds = HostBounds();
+  if (bounds.empty()) {
+    json << "null";
+  } else {
+    json << "[" << bounds[0] << "," << bounds[1] << "," << bounds[2] << "]";
+  }
+  json << "}";
+  const std::string& text = json.str();
+  if (text.size() + 1 > cap) return -static_cast<long>(text.size() + 1);
+  std::memcpy(out, text.c_str(), text.size() + 1);
+  return static_cast<long>(text.size());
+}
+
+}  // extern "C"

@@ -81,6 +81,7 @@ class TestKubeletPluginDaemonSet:
             DEFAULT_CDI_ROOT,
             DEFAULT_STATE_DIR,
             "/dev",
+            "/sys",
         } <= host_paths
 
     def test_plugin_env_matches_cli_env_mirrors(self, daemonset):

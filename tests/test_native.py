@@ -1,0 +1,160 @@
+"""Native discovery shim: build, C ABI via ctypes, RealTpuLib integration,
+and graceful fallback when the library is absent."""
+
+import ctypes
+import os
+import subprocess
+
+import pytest
+
+from tpu_dra.plugin import native
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+LIB_PATH = os.path.join(NATIVE_DIR, "build", "libtpudiscovery.so")
+
+
+@pytest.fixture(scope="session")
+def native_lib():
+    """Build the shim (cheap, cached by make) or skip if no toolchain."""
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=NATIVE_DIR, check=True, capture_output=True
+        )
+    except (OSError, subprocess.CalledProcessError) as e:
+        pytest.skip(f"native toolchain unavailable: {e}")
+    assert os.path.exists(LIB_PATH)
+    return LIB_PATH
+
+
+@pytest.fixture
+def fake_host(tmp_path):
+    """A devfs/sysfs tree shaped like a 4-chip TPU VM."""
+    dev = tmp_path / "dev"
+    sys = tmp_path / "sys"
+    accel_class = sys / "class" / "accel"
+    accel_class.mkdir(parents=True)
+    for i in range(4):
+        (dev / f"accel{i}").parent.mkdir(exist_ok=True)
+        (dev / f"accel{i}").touch()
+        pci = sys / f"0000:00:0{i + 4}.0"
+        pci.mkdir()
+        (pci / "vendor").write_text("0x1ae0\n")
+        (pci / "device").write_text("0x0063\n")
+        (pci / "numa_node").write_text(f"{i % 2}\n")
+        chip_dir = accel_class / f"accel{i}"
+        chip_dir.mkdir()
+        (chip_dir / "device").symlink_to(f"../../../0000:00:0{i + 4}.0")
+    return str(dev), str(sys)
+
+
+class TestNativeScan:
+    def test_scan_reads_devfs_and_sysfs(self, native_lib, fake_host, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_NATIVE_LIB", native_lib)
+        native.reset_cache_for_tests()
+        shim = native.load()
+        assert shim is not None and shim.version() == "tpu-discovery/1"
+
+        dev, sys = fake_host
+        result = shim.scan(dev, sys)
+        chips = result["chips"]
+        assert [c["index"] for c in chips] == [0, 1, 2, 3]
+        assert chips[0]["kind"] == "accel"
+        assert chips[0]["vendor"] == "0x1ae0"
+        assert chips[0]["pciAddress"] == "0000:00:04.0"
+        assert [c["numaNode"] for c in chips] == [0, 1, 0, 1]
+
+    def test_bounds_from_env(self, native_lib, fake_host, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_NATIVE_LIB", native_lib)
+        monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2")
+        native.reset_cache_for_tests()
+        dev, sys = fake_host
+        assert native.load().scan(dev, sys)["bounds"] == [2, 2, 1]
+
+    def test_vfio_fallback(self, native_lib, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_NATIVE_LIB", native_lib)
+        native.reset_cache_for_tests()
+        vfio = tmp_path / "dev" / "vfio"
+        vfio.mkdir(parents=True)
+        for i in (7, 12):
+            (vfio / str(i)).touch()
+        chips = native.load().scan(str(tmp_path / "dev"), str(tmp_path / "sys"))["chips"]
+        assert [c["kind"] for c in chips] == ["vfio", "vfio"]
+        # Numeric ordering (7 before 12), matching the accel path.
+        assert chips[0]["path"].endswith("/vfio/7")
+        assert chips[1]["path"].endswith("/vfio/12")
+
+    def test_empty_devfs_is_not_an_error(self, native_lib, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_NATIVE_LIB", native_lib)
+        native.reset_cache_for_tests()
+        empty = tmp_path / "dev"
+        empty.mkdir()
+        assert native.load().scan(str(empty), str(tmp_path)) == {
+            "version": "tpu-discovery/1",
+            "chips": [],
+            "bounds": None,
+        }
+
+
+class TestLoader:
+    def test_absent_lib_returns_none(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TPU_DRA_NATIVE_LIB", str(tmp_path / "nope.so"))
+        monkeypatch.setattr(
+            native, "_candidate_paths", lambda: [str(tmp_path / "nope.so")]
+        )
+        native.reset_cache_for_tests()
+        assert native.load() is None
+
+    def test_wrong_abi_rejected(self, monkeypatch, tmp_path, native_lib):
+        # A lib exporting the wrong version string must be skipped.
+        src = tmp_path / "bad.c"
+        src.write_text(
+            'const char* tpu_discovery_version(void){return "tpu-discovery/99";}\n'
+            "long tpu_discovery_scan(const char*a,const char*b,char*c,"
+            "unsigned long d){(void)a;(void)b;(void)c;(void)d;return -1;}\n"
+        )
+        bad = tmp_path / "libbad.so"
+        subprocess.run(
+            ["gcc", "-shared", "-fPIC", "-o", str(bad), str(src)], check=True
+        )
+        monkeypatch.setattr(native, "_candidate_paths", lambda: [str(bad)])
+        native.reset_cache_for_tests()
+        assert native.load() is None
+
+
+class TestRealTpuLibWithNative:
+    def test_discovery_publishes_pci_and_numa(
+        self, native_lib, fake_host, monkeypatch, tmp_path
+    ):
+        from tpu_dra.plugin.tpulib import RealTpuLib
+
+        monkeypatch.setenv("TPU_DRA_NATIVE_LIB", native_lib)
+        monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-4")
+        native.reset_cache_for_tests()
+        dev, sys = fake_host
+        lib = RealTpuLib(
+            state_dir=str(tmp_path / "state"), devfs_root=dev, sysfs_root=sys
+        )
+        devices = lib.enumerate_all_possible_devices()
+        tpus = [d.tpu for d in devices if d.tpu is not None]
+        assert len(tpus) == 4
+        assert tpus[0].pci_address == "0000:00:04.0"
+        assert tpus[0].numa_node == 0 and tpus[1].numa_node == 1
+        assert tpus[0].generation == "v5e"
+        coords = sorted(t.coord for t in tpus)
+        assert coords == [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)]
+
+    def test_python_fallback_still_discovers(self, fake_host, monkeypatch, tmp_path):
+        from tpu_dra.plugin.tpulib import RealTpuLib
+
+        monkeypatch.setattr(native, "_candidate_paths", lambda: [])
+        native.reset_cache_for_tests()
+        dev, sys = fake_host
+        lib = RealTpuLib(
+            state_dir=str(tmp_path / "state"), devfs_root=dev, sysfs_root=sys
+        )
+        tpus = [d.tpu for d in lib.enumerate_all_possible_devices() if d.tpu]
+        assert len(tpus) == 4
+        assert tpus[0].pci_address == ""  # fallback has no sysfs correlation
+        native.reset_cache_for_tests()

@@ -66,6 +66,10 @@ class AllocatableTpu:
     partitionable: bool = False  # supports core subslicing (migEnabled analog)
     libtpu_version: str = ""
     runtime_version: str = ""
+    # Host-local placement facts from the native discovery shim (sysfs);
+    # None/empty when discovery ran without it.
+    pci_address: str = ""
+    numa_node: int | None = None
 
 
 @dataclass

@@ -61,6 +61,11 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
         help="where TPU device nodes live [DEVFS_ROOT]",
     )
     d.add_argument(
+        "--sysfs-root",
+        default=flags._env_default("SYSFS_ROOT", "/sys"),
+        help="host sysfs mount (PCI/NUMA correlation) [SYSFS_ROOT]",
+    )
+    d.add_argument(
         "--mock-tpulib-mesh",
         default=flags._env_default("MOCK_TPULIB_MESH", ""),
         help="TESTING: use the mock chip enumerator with this mesh (e.g. "
@@ -92,7 +97,11 @@ def build_tpulib(args: argparse.Namespace):
         )
     from tpu_dra.plugin.tpulib import RealTpuLib
 
-    return RealTpuLib(state_dir=args.state_dir, devfs_root=args.devfs_root)
+    return RealTpuLib(
+        state_dir=args.state_dir,
+        devfs_root=args.devfs_root,
+        sysfs_root=args.sysfs_root,
+    )
 
 
 class PluginApp:

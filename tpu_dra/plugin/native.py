@@ -1,0 +1,118 @@
+"""ctypes loader for the native discovery shim (native/tpu_discovery.cc).
+
+Mirrors the reference's runtime library-loading pattern — NVML is dlopened
+from a discovered path with graceful absence handling (reference:
+cmd/nvidia-dra-plugin/nvlib.go:38-66, find.go:28-44) — without cgo/pybind11:
+the shim exposes a two-function C ABI returning JSON, loaded here with
+ctypes.  When the library is absent (not built, non-Linux, stripped image)
+``load()`` returns None and the caller falls back to the pure-Python
+scanner, so the native layer is an acceleration/fidelity upgrade, never a
+hard dependency.
+
+Search order: $TPU_DRA_NATIVE_LIB, <repo>/native/build/, the package dir,
+then the system loader.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import json
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_LIB_NAME = "libtpudiscovery.so"
+_ABI_VERSION = "tpu-discovery/1"
+
+
+def _candidate_paths() -> "list[str]":
+    paths = []
+    explicit = os.environ.get("TPU_DRA_NATIVE_LIB")
+    if explicit:
+        paths.append(explicit)
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    paths.append(os.path.join(repo, "native", "build", _LIB_NAME))
+    paths.append(os.path.join(here, _LIB_NAME))
+    found = ctypes.util.find_library("tpudiscovery")
+    if found:
+        paths.append(found)
+    return paths
+
+
+class NativeDiscovery:
+    """Typed wrapper around the loaded shim."""
+
+    def __init__(self, lib: ctypes.CDLL, path: str):
+        self._lib = lib
+        self.path = path
+        self._lib.tpu_discovery_version.restype = ctypes.c_char_p
+        self._lib.tpu_discovery_scan.restype = ctypes.c_long
+        self._lib.tpu_discovery_scan.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_ulong,
+        ]
+
+    def version(self) -> str:
+        return self._lib.tpu_discovery_version().decode()
+
+    def scan(self, devfs_root: str, sysfs_root: str = "/sys") -> dict:
+        """-> {"chips": [{index,path,kind,pciAddress,vendor,device,numaNode}],
+        "bounds": [x,y,z] | None}."""
+        cap = 1 << 16
+        for _ in range(2):
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.tpu_discovery_scan(
+                devfs_root.encode(), sysfs_root.encode(), buf, cap
+            )
+            if n >= 0:
+                return json.loads(buf.value.decode())
+            if n == -1:
+                raise RuntimeError("tpu_discovery_scan failed")
+            cap = -n  # buffer too small: exact needed size reported
+        raise RuntimeError("tpu_discovery_scan: buffer negotiation failed")
+
+
+_CACHE: "tuple[NativeDiscovery | None] | None" = None
+
+
+def load() -> "NativeDiscovery | None":
+    """Load the shim once per process; None if unavailable/incompatible."""
+    global _CACHE
+    if _CACHE is not None:
+        return _CACHE[0]
+    for path in _candidate_paths():
+        # Explicit file paths are pre-checked; bare sonames from the system
+        # loader (find_library returns e.g. "libtpudiscovery.so", never a
+        # path) go straight to CDLL, which resolves them via ld.so.
+        if os.path.sep in path and not os.path.exists(path):
+            continue
+        try:
+            shim = NativeDiscovery(ctypes.CDLL(path), path)
+            version = shim.version()
+        except OSError as e:
+            logger.debug("native discovery candidate %s not loadable: %s", path, e)
+            continue
+        except AttributeError as e:
+            logger.warning("library at %s lacks the discovery ABI: %s", path, e)
+            continue
+        if version != _ABI_VERSION:
+            logger.warning(
+                "native discovery at %s has ABI %s, want %s — skipping",
+                path, version, _ABI_VERSION,
+            )
+            continue
+        logger.info("native discovery loaded from %s (%s)", path, version)
+        _CACHE = (shim,)
+        return shim
+    _CACHE = (None,)
+    return None
+
+
+def reset_cache_for_tests() -> None:
+    global _CACHE
+    _CACHE = None

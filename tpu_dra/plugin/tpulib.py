@@ -3,14 +3,15 @@
 The reference reaches silicon through cgo NVML bindings behind the
 ``deviceLib`` seam (cmd/nvidia-dra-plugin/nvlib.go:32-500, find.go:24-89);
 SURVEY.md §7 directs that this boundary be an interface designed for mocking
-from day one.  The TPU equivalent needs no native bindings at all — chips
-appear as ``/dev/accel*`` (or ``/dev/vfio/*``) device nodes on a TPU VM and
-topology comes from TPU-VM environment/metadata — so both implementations
-are pure Python:
+from day one.  Two implementations:
 
 - ``MockTpuLib``  — config-driven topology, runs anywhere (the seam
   BASELINE.md config #1 requires: "mock/loopback enumerator — runs on CPU").
-- ``RealTpuLib``  — scans the host devfs and environment of a real TPU VM.
+- ``RealTpuLib``  — enumerates a real TPU VM.  The low-level scan (devfs
+  walk + sysfs PCI/NUMA correlation) runs through the native C++ shim
+  (native/tpu_discovery.cc via tpu_dra/plugin/native.py — the NVML-boundary
+  analog) when built, with a pure-Python devfs fallback so the driver never
+  hard-depends on the native build.
 
 **Subslice persistence.** MIG partitions live on the GPU and survive a node
 plugin restart, which is what makes the reference's crash re-adoption
@@ -311,8 +312,13 @@ class RealTpuLib(_BaseTpuLib):
     - libtpu: well-known install paths or ``TPU_LIBRARY_PATH``
     """
 
-    def __init__(self, state_dir: str = "/var/run/tpu-dra", devfs_root: str = "/dev"):
-        chips = self._discover(devfs_root)
+    def __init__(
+        self,
+        state_dir: str = "/var/run/tpu-dra",
+        devfs_root: str = "/dev",
+        sysfs_root: str = "/sys",
+    ):
+        chips = self._discover(devfs_root, sysfs_root)
         super().__init__(
             chips, SubsliceRegistry(os.path.join(state_dir, "subslices.json"))
         )
@@ -322,8 +328,11 @@ class RealTpuLib(_BaseTpuLib):
         bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
         if bounds:
             try:
-                x, y, z = (int(v) for v in bounds.split(","))
-                return Topology(x, y, z)
+                parts = [int(v) for v in bounds.split(",")]
+                if len(parts) == 2:
+                    parts.append(1)  # "x,y" shorthand, same as the shim
+                if len(parts) == 3:
+                    return Topology(*parts)
             except ValueError:
                 pass
         # Fall back to the squarest 2D arrangement of `count` chips.
@@ -341,31 +350,61 @@ class RealTpuLib(_BaseTpuLib):
             return m.group(1)
         return "v5e"
 
-    def _discover(self, devfs_root: str) -> list[TpuChipInfo]:
-        paths = []
+    @staticmethod
+    def _scan_devfs(
+        devfs_root: str, sysfs_root: str
+    ) -> "tuple[list[dict], list[int] | None]":
+        """Low-level chip scan -> (chips, host bounds or None): the native
+        shim when built (devfs + sysfs PCI/NUMA correlation + bounds env,
+        native/tpu_discovery.cc), else a pure-Python devfs walk with the
+        same result shape and ordering (numeric by device index)."""
+        from tpu_dra.plugin import native
+
+        shim = native.load()
+        if shim is not None:
+            result = shim.scan(devfs_root, sysfs_root)
+            return result["chips"], result.get("bounds")
+
+        found = []
         try:
-            for entry in sorted(os.listdir(devfs_root)):
+            indexed = []
+            for entry in os.listdir(devfs_root):
                 if re.fullmatch(r"accel\d+", entry):
-                    paths.append(os.path.join(devfs_root, entry))
+                    indexed.append((int(entry[5:]), entry))
+            for _, entry in sorted(indexed):
+                found.append(
+                    {"path": os.path.join(devfs_root, entry), "kind": "accel"}
+                )
         except OSError:
             pass
-        if not paths:
+        if not found:
             vfio = os.path.join(devfs_root, "vfio")
             try:
-                for entry in sorted(os.listdir(vfio)):
-                    if entry.isdigit():
-                        paths.append(os.path.join(vfio, entry))
+                for group in sorted(
+                    int(e) for e in os.listdir(vfio) if e.isdigit()
+                ):
+                    found.append(
+                        {"path": os.path.join(vfio, str(group)), "kind": "vfio"}
+                    )
             except OSError:
                 pass
+        return found, None
+
+    def _discover(self, devfs_root: str, sysfs_root: str) -> list[TpuChipInfo]:
+        scanned, native_bounds = self._scan_devfs(devfs_root, sysfs_root)
         generation = self._generation()
         spec = _GENERATION_SPECS.get(generation, _GENERATION_SPECS["v5e"])
-        topo = self._host_topology(max(len(paths), 1))
+        if native_bounds:
+            topo = Topology(*native_bounds)
+        else:
+            topo = self._host_topology(max(len(scanned), 1))
         coords: list[Coord] = list(topo.coords_from((0, 0, 0)))
         worker_id = os.environ.get("TPU_WORKER_ID", "0")
         ici_domain = os.environ.get("TPU_SLICE_NAME", f"host-{worker_id}")
         chips = []
-        for index, path in enumerate(paths):
+        for index, entry in enumerate(scanned):
             coord = coords[index] if index < len(coords) else (index, 0, 0)
+            numa = entry.get("numaNode", -1)
             chips.append(
                 TpuChipInfo(
                     tpu=AllocatableTpu(
@@ -380,8 +419,10 @@ class RealTpuLib(_BaseTpuLib):
                         partitionable=spec["cores"] > 1,
                         libtpu_version=os.environ.get("TPU_LIBRARY_VERSION", ""),
                         runtime_version=os.environ.get("TPU_RUNTIME_VERSION", ""),
+                        pci_address=entry.get("pciAddress", ""),
+                        numa_node=numa if numa is not None and numa >= 0 else None,
                     ),
-                    device_paths=[path],
+                    device_paths=[entry["path"]],
                 )
             )
         return chips
