@@ -1,0 +1,52 @@
+# Build/test entry points (reference: Makefile:40-140 of k8s-dra-driver —
+# dockerized Go builds, codegen, lint, coverage; re-expressed for this
+# repo's Python + C++ layout).
+
+PYTHON  ?= python
+IMAGE   ?= tpu-dra-driver
+TAG     ?= latest
+
+.PHONY: all test lint generate-crds check-generate native native-test \
+        demo-quickstart bench image clean help
+
+all: lint test
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+lint:
+	$(PYTHON) tools/lint.py
+
+# CRD manifests from the API dataclasses (controller-gen analog).
+generate-crds:
+	$(PYTHON) -m tpu_dra.api.crdgen
+
+# CI gate: regenerating must be a no-op (git diff --exit-code analog is the
+# freshness test, which compares rendered text against the checked-in files).
+check-generate:
+	$(PYTHON) -m pytest tests/test_crdgen.py -q
+
+native:
+	$(MAKE) -C native
+
+native-test:
+	$(MAKE) -C native test
+
+# The asserted demo suite on the sim cluster (C25 analog, SURVEY.md §4).
+demo-quickstart:
+	$(PYTHON) demo/run_quickstart.py
+
+bench:
+	$(PYTHON) bench.py
+
+image:
+	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile .
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf build dist *.egg-info .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
+
+help:
+	@echo "targets: test lint generate-crds check-generate native native-test"
+	@echo "         demo-quickstart bench image clean"

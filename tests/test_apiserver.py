@@ -4,7 +4,6 @@ import threading
 
 import pytest
 
-from tpu_dra.api import serde
 from tpu_dra.api.k8s import Node, ResourceClaim
 from tpu_dra.api.meta import ObjectMeta, OwnerReference
 from tpu_dra.api.nas_v1alpha1 import NodeAllocationState, NodeAllocationStateSpec

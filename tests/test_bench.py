@@ -2,8 +2,6 @@
 required keys, and the allocation pipeline actually completes."""
 
 import json
-import subprocess
-import sys
 
 
 def test_bench_claim_to_running_small():

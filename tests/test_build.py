@@ -1,0 +1,96 @@
+"""Build layer (C26 analog): Makefile targets resolve, lint is clean, the
+linter itself catches what it claims to, CI/Dockerfile reference real paths."""
+
+import os
+import subprocess
+import sys
+
+import yaml
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+import lint  # noqa: E402
+
+
+class TestLinter:
+    def test_repo_is_lint_clean(self):
+        assert lint.main(["tpu_dra", "tests", "demo", "tools"]) == 0
+
+    def _findings(self, tmp_path, source):
+        path = tmp_path / "case.py"
+        path.write_text(source)
+        return [f.code for f in lint.check_file(str(path), "tpu_dra/case.py")]
+
+    def test_catches_unused_import(self, tmp_path):
+        assert "L002" in self._findings(tmp_path, "import os\nx = 1\n")
+
+    def test_catches_mutable_default(self, tmp_path):
+        assert "L003" in self._findings(tmp_path, "def f(x=[]):\n    return x\n")
+
+    def test_catches_bare_except(self, tmp_path):
+        src = "try:\n    pass\nexcept:\n    pass\n"
+        assert "L004" in self._findings(tmp_path, src)
+
+    def test_catches_library_print(self, tmp_path):
+        assert "L005" in self._findings(tmp_path, "print('hi')\n")
+
+    def test_noqa_suppresses(self, tmp_path):
+        assert self._findings(tmp_path, "import os  # noqa\nx = 1\n") == []
+
+    def test_string_annotations_count_as_usage(self, tmp_path):
+        src = (
+            "from typing import Optional\n"
+            'def f(x: "Optional[int]") -> None:\n    return None\n'
+        )
+        assert self._findings(tmp_path, src) == []
+
+
+class TestMakefile:
+    def test_lint_target(self):
+        result = subprocess.run(
+            ["make", "-s", "lint"], cwd=REPO_ROOT, capture_output=True, text=True
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+
+    def test_native_target(self):
+        result = subprocess.run(
+            ["make", "-s", "native"], cwd=REPO_ROOT, capture_output=True, text=True
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+
+
+class TestCiAndImageReferences:
+    def test_workflow_parses_and_paths_exist(self):
+        with open(os.path.join(REPO_ROOT, ".github", "workflows", "build.yaml")) as f:
+            workflow = yaml.safe_load(f)
+        assert "lint-and-test" in workflow["jobs"]
+        for job in workflow["jobs"].values():
+            for step in job["steps"]:
+                run = step.get("run", "")
+                for token in run.split():
+                    if token.startswith(("tools/", "tests/", "demo/", "deployments/")):
+                        assert os.path.exists(os.path.join(REPO_ROOT, token)), token
+
+    def test_dockerfile_copies_real_paths(self):
+        with open(
+            os.path.join(REPO_ROOT, "deployments", "container", "Dockerfile")
+        ) as f:
+            for line in f:
+                if line.startswith("COPY ") and "--from" not in line:
+                    sources = line.split()[1:-1]
+                    for source in sources:
+                        assert os.path.exists(
+                            os.path.join(REPO_ROOT, source)
+                        ), f"Dockerfile COPY source missing: {source}"
+
+    def test_console_scripts_resolve(self):
+        import importlib
+        import tomllib
+
+        with open(os.path.join(REPO_ROOT, "pyproject.toml"), "rb") as f:
+            project = tomllib.load(f)
+        for name, target in project["project"]["scripts"].items():
+            module_name, _, attr = target.partition(":")
+            module = importlib.import_module(module_name)
+            assert callable(getattr(module, attr)), f"{name} -> {target}"

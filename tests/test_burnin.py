@@ -4,7 +4,6 @@ points (the driver's single-chip + multi-chip compile contract)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from tpu_dra.parallel.burnin import (
     BurninConfig,

@@ -3,7 +3,6 @@ over the HTTP apiserver shim (plugin handshake + gRPC prepare, controller
 allocation, set-nas-status flips)."""
 
 import os
-import threading
 import time
 
 import pytest

@@ -6,16 +6,14 @@ import pytest
 
 from helpers import make_plugin_stack
 from tpu_dra.api.k8s import (
-    ALLOCATION_MODE_IMMEDIATE,
     ResourceClaim,
     ResourceClaimParametersReference,
     ResourceClaimSpec,
     ResourceClass,
     ResourceClassParametersReference,
-    get_selected_node,
 )
 from tpu_dra.api.meta import ObjectMeta
-from tpu_dra.api.nas_v1alpha1 import NodeAllocationState, NodeAllocationStateSpec
+from tpu_dra.api.nas_v1alpha1 import NodeAllocationState
 from tpu_dra.api.tpu_v1alpha1 import (
     GROUP_NAME,
     DeviceClassParameters,

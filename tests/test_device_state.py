@@ -14,7 +14,6 @@ from tpu_dra.api.nas_v1alpha1 import (
 )
 from tpu_dra.api.sharing import (
     SharingStrategy,
-    SubsliceSharing,
     TimeSliceInterval,
     TimeSlicingConfig,
     TpuSharing,

@@ -15,7 +15,6 @@ TPU-specific topology scenario from BASELINE.md:
 """
 
 import json
-import os
 
 import pytest
 
@@ -30,7 +29,6 @@ from tpu_dra.api.k8s import (
     ResourceClaimTemplate,
     ResourceClaimTemplateSpec,
     ResourceClass,
-    ResourceClassParametersReference,
 )
 from tpu_dra.api.meta import ObjectMeta
 from tpu_dra.api.sharing import (

@@ -1,6 +1,5 @@
 """Metrics registry, exposition format, and the HTTP endpoint."""
 
-import json
 import urllib.request
 
 from tpu_dra.utils.metrics import (

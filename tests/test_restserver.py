@@ -2,7 +2,6 @@
 FakeApiServer protocol over real HTTP, including error taxonomy and
 streaming watches."""
 
-import threading
 import time
 
 import pytest

@@ -4,7 +4,6 @@ import pytest
 
 from helpers import make_ca, make_chip, make_nas, make_pod
 from tpu_dra.api.nas_v1alpha1 import (
-    AllocatableDevice,
     AllocatedDevices,
     AllocatedSubslice,
     AllocatedSubslices,

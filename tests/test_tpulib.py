@@ -1,6 +1,5 @@
 """Device layer tests: enumeration, subslice lifecycle, persistence."""
 
-import os
 
 import pytest
 

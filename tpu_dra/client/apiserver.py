@@ -30,7 +30,7 @@ import queue
 import threading
 import time
 import uuid
-from typing import Any, Callable, Iterator
+from typing import Callable, Iterator
 
 
 class ApiError(Exception):

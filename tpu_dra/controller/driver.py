@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from typing import Any
 
-from tpu_dra.api import k8s, nas_v1alpha1 as nascrd, serde, tpu_v1alpha1 as tpucrd
+from tpu_dra.api import nas_v1alpha1 as nascrd, tpu_v1alpha1 as tpucrd
 from tpu_dra.api.k8s import (
     AllocationResult,
     Pod,

@@ -19,7 +19,7 @@ Owns the node's allocatable inventory and the per-claim prepared state:
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tpu_dra.api import nas_v1alpha1 as nascrd
 from tpu_dra.api import serde
