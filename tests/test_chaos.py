@@ -1,0 +1,248 @@
+"""Fault-injection + concurrency stress (closing SURVEY.md §4/§5's gap:
+"no distributed-system tests, no race-detector CI, no fault injection").
+
+Chaos: the full SimCluster running through a FlakyApiServer — injected
+retryable errors and optimistic-concurrency conflicts — must still take
+pods to Running and clean up after them.  Stress: many pods churning
+concurrently against limited capacity must never double-allocate a chip.
+"""
+
+import threading
+import time
+
+from tpu_dra.api.k8s import (
+    Pod,
+    ResourceClaim,
+    PodResourceClaim,
+    PodResourceClaimSource,
+    PodSpec,
+    ResourceClaimParametersReference,
+    ResourceClaimSpec,
+    ResourceClaimTemplate,
+    ResourceClaimTemplateSpec,
+    ResourceClass,
+)
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.tpu_v1alpha1 import (
+    GROUP_NAME,
+    TpuClaimParameters,
+    TpuClaimParametersSpec,
+)
+from tpu_dra.client.apiserver import FakeApiServer
+from tpu_dra.sim import SimCluster
+from tpu_dra.sim.faults import FlakyApiServer
+
+NS = "default"
+DRIVER_NS = "tpu-dra"
+
+
+def setup_workload(cluster, params_name="one-tpu", template="tpu-template"):
+    cluster.clientset.resource_classes().create(
+        ResourceClass(
+            metadata=ObjectMeta(name="tpu.google.com"), driver_name=GROUP_NAME
+        )
+    )
+    cluster.clientset.tpu_claim_parameters(NS).create(
+        TpuClaimParameters(
+            metadata=ObjectMeta(name=params_name, namespace=NS),
+            spec=TpuClaimParametersSpec(count=1),
+        )
+    )
+    cluster.clientset.resource_claim_templates(NS).create(
+        ResourceClaimTemplate(
+            metadata=ObjectMeta(name=template, namespace=NS),
+            spec=ResourceClaimTemplateSpec(
+                spec=ResourceClaimSpec(
+                    resource_class_name="tpu.google.com",
+                    parameters_ref=ResourceClaimParametersReference(
+                        api_group=GROUP_NAME,
+                        kind="TpuClaimParameters",
+                        name=params_name,
+                    ),
+                )
+            ),
+        )
+    )
+
+
+def make_pod(name, template="tpu-template"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=NS),
+        spec=PodSpec(
+            resource_claims=[
+                PodResourceClaim(
+                    name="tpu",
+                    source=PodResourceClaimSource(
+                        resource_claim_template_name=template
+                    ),
+                )
+            ]
+        ),
+    )
+
+
+def allocated_chip_owners(cluster) -> "dict[str, list[str]]":
+    """chip uuid -> claim uids holding it, across all NAS objects."""
+    owners: dict[str, list[str]] = {}
+    for nas in cluster.clientset.node_allocation_states(DRIVER_NS).list():
+        for claim_uid, alloc in nas.spec.allocated_claims.items():
+            devices = alloc.tpu.devices if alloc.tpu else []
+            for device in devices:
+                owners.setdefault(device.uuid, []).append(claim_uid)
+    return owners
+
+
+def wait_running(observer, namespace, name, timeout):
+    """Poll phase through an un-faulted observer clientset."""
+    deadline = time.monotonic() + timeout
+    phase = ""
+    while time.monotonic() < deadline:
+        try:
+            phase = observer.pods(namespace).get(name).status.phase
+        except Exception:
+            phase = ""
+        if phase == "Running":
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"pod {namespace}/{name} not Running ({phase=})")
+
+
+class TestChaosConvergence:
+    def test_pods_run_through_flaky_apiserver(self, tmp_path):
+        from tpu_dra.client.clientset import ClientSet
+
+        flaky = FlakyApiServer(FakeApiServer(), seed=7)
+        observer = ClientSet(flaky.inner)  # the test watches ground truth
+        cluster = SimCluster(str(tmp_path), nodes=2, mesh="2x2x1", server=flaky)
+        cluster.start()
+        try:
+            setup_workload(cluster)
+            # Faults on AFTER clean startup: 10% retryable errors + 15%
+            # write conflicts from here on — every component must converge
+            # through them.
+            flaky.error_rate = 0.10
+            flaky.conflict_rate = 0.15
+            for i in range(4):
+                observer.pods(NS).create(make_pod(f"chaos-{i}"))
+            for i in range(4):
+                wait_running(observer, NS, f"chaos-{i}", timeout=60)
+            assert flaky.faults_injected > 0, "chaos test injected nothing"
+            owners = {}
+            for nas in observer.node_allocation_states(DRIVER_NS).list():
+                for claim_uid, alloc in nas.spec.allocated_claims.items():
+                    for device in alloc.tpu.devices if alloc.tpu else []:
+                        owners.setdefault(device.uuid, []).append(claim_uid)
+            assert all(len(v) == 1 for v in owners.values()), owners
+        finally:
+            flaky.error_rate = flaky.conflict_rate = 0.0
+            cluster.stop()
+
+    def test_outage_window_recovers(self, tmp_path):
+        from tpu_dra.client.clientset import ClientSet
+
+        flaky = FlakyApiServer(FakeApiServer(), seed=3)
+        observer = ClientSet(flaky.inner)
+        cluster = SimCluster(str(tmp_path), nodes=1, mesh="2x2x1", server=flaky)
+        cluster.start()
+        try:
+            setup_workload(cluster)
+            observer.pods(NS).create(make_pod("before-outage"))
+            wait_running(observer, NS, "before-outage", timeout=30)
+
+            flaky.pause()  # total outage: every driver call fails
+            time.sleep(0.5)
+            flaky.resume()
+
+            observer.pods(NS).create(make_pod("during-outage"))
+            wait_running(observer, NS, "during-outage", timeout=60)
+        finally:
+            flaky.resume()
+            cluster.stop()
+
+
+class TestConcurrencyStress:
+    def test_churn_never_double_allocates(self, tmp_path):
+        """3 waves × 8 pods over 8 chips (2 nodes × 2x2x1): concurrent
+        create/delete churn; invariant: a chip never has two holders."""
+        cluster = SimCluster(str(tmp_path), nodes=2, mesh="2x2x1", workers=8)
+        cluster.start()
+        violations: list = []
+        stop_checker = threading.Event()
+
+        def invariant_checker():
+            while not stop_checker.is_set():
+                owners = allocated_chip_owners(cluster)
+                bad = {k: v for k, v in owners.items() if len(v) > 1}
+                if bad:
+                    violations.append(bad)
+                time.sleep(0.01)
+
+        checker = threading.Thread(target=invariant_checker, daemon=True)
+        checker.start()
+        try:
+            setup_workload(cluster)
+            for wave in range(3):
+                names = [f"stress-{wave}-{i}" for i in range(8)]
+                for name in names:
+                    cluster.clientset.pods(NS).create(make_pod(name))
+                for name in names:
+                    cluster.wait_for_pod_running(NS, name, timeout=60)
+                # Delete concurrently from several threads.
+                threads = [
+                    threading.Thread(
+                        target=cluster.delete_pod, args=(NS, name), daemon=True
+                    )
+                    for name in names
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=20)
+                # Wait for capacity to free before the next wave.
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    if not allocated_chip_owners(cluster):
+                        break
+                    time.sleep(0.05)
+                else:
+                    raise AssertionError("capacity never freed after deletes")
+            assert not violations, violations[:3]
+        finally:
+            stop_checker.set()
+            checker.join(timeout=5)
+            cluster.stop()
+
+
+class TestClaimEvents:
+    def test_allocation_records_event(self, tmp_path):
+        cluster = SimCluster(str(tmp_path), nodes=1, mesh="2x2x1")
+        cluster.start()
+        try:
+            setup_workload(cluster)
+            cluster.clientset.pods(NS).create(make_pod("evt-pod"))
+            cluster.wait_for_pod_running(NS, "evt-pod", timeout=30)
+            events = cluster.clientset.events(NS).list()
+            allocated = [e for e in events if e.reason == "Allocated"]
+            assert allocated, [e.reason for e in events]
+            event = allocated[0]
+            assert event.type == "Normal"
+            assert event.involved_object.kind == "ResourceClaim"
+            assert event.involved_object.name == "evt-pod-tpu"
+            assert event.count >= 1 and event.last_timestamp
+        finally:
+            cluster.stop()
+
+    def test_repeat_events_compress(self, tmp_path):
+        from tpu_dra.client.clientset import ClientSet
+        from tpu_dra.utils.events import TYPE_WARNING, EventRecorder
+
+        cs = ClientSet(FakeApiServer())
+        claim = cs.resource_claims(NS).create(
+            ResourceClaim(metadata=ObjectMeta(name="c", namespace=NS))
+        )
+        recorder = EventRecorder(cs)
+        for _ in range(5):
+            recorder.event(claim, TYPE_WARNING, "SyncFailed", "boom")
+        events = cs.events(NS).list()
+        assert len(events) == 1
+        assert events[0].count == 5

@@ -47,6 +47,41 @@ class Node:
 
 
 @dataclass
+class ObjectReference:
+    """core/v1 ObjectReference — the involvedObject of an Event."""
+
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+    api_version: str = ""
+
+
+@dataclass
+class EventSource:
+    component: str = ""
+    host: str = ""
+
+
+@dataclass
+class Event:
+    """core/v1 Event, as the vendored DRA controller records them on claims
+    (controller.go:162-178 event broadcaster + :348-350 recorder use)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"  # Normal | Warning
+    count: int = 0
+    first_timestamp: str = ""
+    last_timestamp: str = ""
+    source: EventSource = field(default_factory=EventSource)
+    kind: str = "Event"
+    api_version: str = "v1"
+
+
+@dataclass
 class PodResourceClaimSource:
     resource_claim_name: str = ""
     resource_claim_template_name: str = ""

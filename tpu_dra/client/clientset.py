@@ -134,3 +134,6 @@ class ClientSet:
 
     def deployments(self, namespace: str) -> TypedClient:
         return TypedClient(self.server, k8s.Deployment, "Deployment", namespace)
+
+    def events(self, namespace: str) -> TypedClient:
+        return TypedClient(self.server, k8s.Event, "Event", namespace)

@@ -47,6 +47,7 @@ RESOURCES: "dict[str, tuple[str, str, str, bool]]" = {
     "Pod": ("", "v1", "pods", True),
     "Node": ("", "v1", "nodes", False),
     "Namespace": ("", "v1", "namespaces", False),
+    "Event": ("", "v1", "events", True),
     "Deployment": ("apps", "v1", "deployments", True),
     "ResourceClaim": ("resource.k8s.io", "v1alpha2", "resourceclaims", True),
     "ResourceClaimTemplate": ("resource.k8s.io", "v1alpha2", "resourceclaimtemplates", True),

@@ -42,6 +42,7 @@ from tpu_dra.client.clientset import ClientSet
 from tpu_dra.controller.driver import ControllerDriver
 from tpu_dra.controller.types import ClaimAllocation
 from tpu_dra.utils.metrics import SYNC_TOTAL, WORKQUEUE_DEPTH
+from tpu_dra.utils.events import TYPE_NORMAL, TYPE_WARNING, EventRecorder
 
 logger = logging.getLogger(__name__)
 
@@ -158,6 +159,9 @@ class Controller:
         self.workers = workers
         self.recheck_period_s = recheck_period_s
         self.error_backoff_base_s = error_backoff_base_s
+        # Events on claims, as the vendored controller records them
+        # (controller.go:162-178, :348-350).
+        self.recorder = EventRecorder(clientset)
         self._queue = _DelayQueue()
         self._retries: dict[tuple, int] = {}
         self._threads: list[threading.Thread] = []
@@ -222,6 +226,7 @@ class Controller:
             except ApiError as e:
                 outcome = "error"
                 logger.warning("sync %s failed: %s", key, e)
+                self._record_sync_failure(key, e)
                 self._retry(key)
             except NotImplementedError as e:
                 # Unsupported request (e.g. Immediate-mode allocation,
@@ -230,9 +235,10 @@ class Controller:
                 outcome = "unsupported"
                 logger.warning("sync %s unsupported, not retrying: %s", key, e)
                 self._retries.pop(key, None)
-            except Exception:
+            except Exception as e:
                 outcome = "error"
                 logger.exception("sync %s failed", key)
+                self._record_sync_failure(key, e)
                 self._retry(key)
             else:
                 self._retries.pop(key, None)
@@ -241,6 +247,18 @@ class Controller:
             finally:
                 SYNC_TOTAL.inc(kind=key[0], outcome=outcome)
                 self._queue.done(key)
+
+    def _record_sync_failure(self, key: tuple, error: Exception) -> None:
+        """Warning event on the claim whose sync failed (the vendored
+        controller's recorder.Event on sync errors)."""
+        kind, namespace, name = key
+        if kind != "ResourceClaim":
+            return
+        try:
+            claim = self.clientset.resource_claims(namespace).get(name)
+        except ApiError:
+            return
+        self.recorder.event(claim, TYPE_WARNING, "SyncFailed", str(error))
 
     def _retry(self, key: tuple, immediate: bool = False) -> None:
         attempts = self._retries.get(key, 0) + 1
@@ -299,6 +317,9 @@ class Controller:
                     f for f in claim.metadata.finalizers if f != FINALIZER
                 ]
                 self.clientset.resource_claims(claim.metadata.namespace).update(claim)
+                self.recorder.event(
+                    claim, TYPE_NORMAL, "Deallocated", "devices released"
+                )
             return None
 
         if claim.status.allocation is not None:
@@ -351,6 +372,9 @@ class Controller:
         if selected_user is not None:
             claim.status.reserved_for.append(selected_user)
         claims_client.update_status(claim)
+        self.recorder.eventf(
+            claim, TYPE_NORMAL, "Allocated", "allocated on node %s", selected_node
+        )
 
     # -- pod scheduling negotiation (controller.go:568-735) ------------------
 

@@ -95,8 +95,10 @@ class SimCluster:
         namespace: str = "tpu-dra",
         workers: int = 4,
         poll_s: float = 0.01,
+        server=None,
     ):
-        self.server = FakeApiServer()
+        # ``server`` lets chaos tests wrap the store (sim/faults.py).
+        self.server = server if server is not None else FakeApiServer()
         self.clientset = ClientSet(self.server)
         self.namespace = namespace
         self.poll_s = poll_s

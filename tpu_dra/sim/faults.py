@@ -1,0 +1,139 @@
+"""Fault injection for the apiserver seam.
+
+The reference has no fault-injection testing at all (SURVEY.md §4/§5:
+"no fault injection anywhere") even though its entire correctness story
+rests on conflict-retried read-modify-write loops.  This wrapper makes that
+story testable: it decorates any apiserver-protocol object with
+deterministic, seeded failures so the chaos suite can prove the controller,
+node plugin, and kubesim converge through flaky infrastructure.
+
+Injected faults (all independently configurable):
+
+- ``error_rate``     — fraction of calls failing with a retryable ApiError
+                       ("apiserver unavailable", code 503)
+- ``conflict_rate``  — fraction of writes failing with ConflictError
+                       *after* applying nothing (optimistic-concurrency loser)
+- ``latency_s``      — uniform extra delay per call (0..latency_s)
+
+Reads and writes can be targeted separately; a seeded RNG makes every run
+reproducible.  ``pause()`` gives scripted outage windows.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from tpu_dra.client.apiserver import ApiError, ConflictError
+
+
+class UnavailableError(ApiError):
+    code = 503
+
+
+_WRITE_VERBS = {"create", "update", "update_status", "delete"}
+
+
+class FlakyApiServer:
+    """Wraps a FakeApiServer (or any protocol-compatible server)."""
+
+    def __init__(
+        self,
+        inner,
+        *,
+        seed: int = 0,
+        error_rate: float = 0.0,
+        conflict_rate: float = 0.0,
+        latency_s: float = 0.0,
+        reads_fail: bool = True,
+        writes_fail: bool = True,
+    ):
+        self.inner = inner
+        self.error_rate = error_rate
+        self.conflict_rate = conflict_rate
+        self.latency_s = latency_s
+        self.reads_fail = reads_fail
+        self.writes_fail = writes_fail
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._paused = threading.Event()
+        self.faults_injected = 0
+        self.calls = 0
+
+    # -- scripted outages -----------------------------------------------------
+
+    def pause(self) -> None:
+        """Hard outage: every subsequent call fails until resume()."""
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    # -- fault gate -----------------------------------------------------------
+
+    def _maybe_fail(self, verb: str) -> None:
+        with self._lock:
+            self.calls += 1
+            if self._paused.is_set():
+                self.faults_injected += 1
+                raise UnavailableError("apiserver paused (scripted outage)")
+            latency = self._rng.uniform(0, self.latency_s) if self.latency_s else 0
+            roll = self._rng.random()
+            conflict_roll = self._rng.random()
+        if latency:
+            time.sleep(latency)
+        is_write = verb in _WRITE_VERBS
+        allowed = self.writes_fail if is_write else self.reads_fail
+        if allowed and roll < self.error_rate:
+            with self._lock:
+                self.faults_injected += 1
+            raise UnavailableError(f"injected fault on {verb}")
+        if is_write and verb != "delete" and conflict_roll < self.conflict_rate:
+            with self._lock:
+                self.faults_injected += 1
+            raise ConflictError(f"injected conflict on {verb}")
+
+    # -- protocol -------------------------------------------------------------
+
+    def create(self, obj):
+        self._maybe_fail("create")
+        return self.inner.create(obj)
+
+    def get(self, kind, namespace, name):
+        self._maybe_fail("get")
+        return self.inner.get(kind, namespace, name)
+
+    def list(self, kind, namespace=None):
+        self._maybe_fail("list")
+        return self.inner.list(kind, namespace)
+
+    def list_with_rv(self, kind, namespace=None):
+        self._maybe_fail("list_with_rv")
+        return self.inner.list_with_rv(kind, namespace)
+
+    def update(self, obj):
+        self._maybe_fail("update")
+        return self.inner.update(obj)
+
+    def update_status(self, obj):
+        self._maybe_fail("update_status")
+        return self.inner.update_status(obj)
+
+    def delete(self, kind, namespace, name):
+        self._maybe_fail("delete")
+        return self.inner.delete(kind, namespace, name)
+
+    def latest_rv(self):
+        self._maybe_fail("latest_rv")
+        return self.inner.latest_rv()
+
+    def events_since(self, since_rv, kind, namespace=None, name=None):
+        self._maybe_fail("events_since")
+        return self.inner.events_since(since_rv, kind, namespace, name)
+
+    def watch(self, kind, namespace=None, name=None):
+        # Watches stay reliable: the failure mode that matters for them
+        # (missed events) is exercised by the event-log replay tests; here
+        # faults target the request/response path the retry loops guard.
+        return self.inner.watch(kind, namespace, name)

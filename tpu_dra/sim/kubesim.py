@@ -255,6 +255,21 @@ class KubeSim:
                 pass
             return
 
+        if not sc.spec.selected_node and not sc.spec.potential_nodes:
+            # The context was created while node discovery came up empty
+            # (plugin not Ready yet, or a flaky list) — without a refresh the
+            # controller waits for potentialNodes while we wait for its
+            # verdicts, a deadlock.  The real scheduler re-publishes
+            # potentialNodes each cycle; so do we.
+            ready = self.ready_nodes()
+            if ready:
+                sc.spec.potential_nodes = ready
+                try:
+                    sc_client.update(sc)
+                except ApiError:
+                    pass
+            return
+
         if sc.spec.selected_node:
             # Check the driver didn't veto our selection.
             for entry in sc.status.resource_claims:

@@ -1,0 +1,93 @@
+"""Event recorder — k8s-style Events on driver-touched objects.
+
+The reference gets this from the vendored DRA controller's event
+broadcaster/recorder (controller.go:162-178), which records Normal/Warning
+events on ResourceClaims as allocation proceeds or fails (:348-350).  This
+recorder implements the same behavior against our clientset, including the
+apiserver-side compression real recorders rely on: repeat events (same
+involved object + reason + message) bump ``count``/``lastTimestamp`` on one
+Event object instead of piling up new ones.
+
+Recording is best-effort by contract: an unreachable apiserver or a
+conflict storm must never break the reconcile path that tried to record.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+
+from tpu_dra.api.k8s import Event, EventSource, ObjectReference
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.client.apiserver import ApiError, NotFoundError
+from tpu_dra.client.clientset import ClientSet
+
+logger = logging.getLogger(__name__)
+
+TYPE_NORMAL = "Normal"
+TYPE_WARNING = "Warning"
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def object_reference(obj) -> ObjectReference:
+    """Build an involvedObject ref from any of our typed API objects."""
+    return ObjectReference(
+        kind=getattr(obj, "kind", "") or type(obj).__name__,
+        namespace=obj.metadata.namespace,
+        name=obj.metadata.name,
+        uid=obj.metadata.uid,
+        api_version=getattr(obj, "api_version", ""),
+    )
+
+
+class EventRecorder:
+    def __init__(self, clientset: ClientSet, component: str = "tpu-dra-controller"):
+        self._clientset = clientset
+        self._component = component
+
+    def event(self, obj, type_: str, reason: str, message: str) -> None:
+        """Record (or compress into) an Event for ``obj``; never raises."""
+        try:
+            self._record(object_reference(obj), type_, reason, message)
+        except ApiError as e:
+            logger.debug("event %s/%s not recorded: %s", reason, message, e)
+
+    def eventf(self, obj, type_: str, reason: str, fmt: str, *args) -> None:
+        self.event(obj, type_, reason, fmt % args if args else fmt)
+
+    def _record(
+        self, ref: ObjectReference, type_: str, reason: str, message: str
+    ) -> None:
+        namespace = ref.namespace or "default"
+        # Deterministic name => the apiserver is the dedupe point, matching
+        # how client-go names series "<involved>.<hash>".
+        digest = hashlib.sha1(
+            f"{ref.uid}/{reason}/{message}".encode()
+        ).hexdigest()[:16]
+        name = f"{ref.name}.{digest}"
+        events = self._clientset.events(namespace)
+        now = _now()
+        try:
+            existing = events.get(name)
+        except NotFoundError:
+            events.create(
+                Event(
+                    metadata=ObjectMeta(name=name, namespace=namespace),
+                    involved_object=ref,
+                    reason=reason,
+                    message=message,
+                    type=type_,
+                    count=1,
+                    first_timestamp=now,
+                    last_timestamp=now,
+                    source=EventSource(component=self._component),
+                )
+            )
+            return
+        existing.count += 1
+        existing.last_timestamp = now
+        events.update(existing)
