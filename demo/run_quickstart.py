@@ -166,6 +166,37 @@ def check_sharing(cluster):
     assert any(e.startswith("TPU_RUNTIME_PROXY_ADDR=") for e in env), env
 
 
+def check_gang(cluster):
+    ns = "tpu-test-gang"
+    pods = [
+        cluster.wait_for_pod_running(ns, f"gang-{i}", timeout=30) for i in range(8)
+    ]
+    assignments = []
+    for pod in pods:
+        claim = claim_of(cluster, ns, pod, "tpu")
+        nas = cluster.clientset.node_allocation_states(DRIVER_NS).get(
+            pod.spec.node_name
+        )
+        gang = nas.spec.allocated_claims[claim.metadata.uid].tpu.gang
+        assert gang is not None, f"{pod.metadata.name} has no gang assignment"
+        assignments.append(gang)
+        # The CDI spec hands the contract to the container.
+        node = cluster.node(pod.spec.node_name)
+        with open(node.cdi._spec_path(claim.metadata.uid)) as f:
+            env = json.load(f)["devices"][0]["containerEdits"]["env"]
+        assert f"TPU_DRA_GANG_RANK={gang.rank}" in env, env
+        assert f"TPU_DRA_GANG_SIZE=8" in env, env
+    ranks = sorted(a.rank for a in assignments)
+    assert ranks == list(range(8)), ranks
+    coordinators = {a.coordinator for a in assignments}
+    assert len(coordinators) == 1, coordinators
+    # Coordinator is rank 0's node.
+    rank0_pod = next(
+        p for p, a in zip(pods, assignments) if a.rank == 0
+    )
+    assert coordinators.pop() == f"{rank0_pod.spec.node_name}:8476"
+
+
 def check_topology(cluster):
     ns = "tpu-test-topology"
     pod = cluster.wait_for_pod_running(ns, "topo-pod", timeout=15)
@@ -191,6 +222,7 @@ SCENARIOS = {
     "tpu-test6.yaml": (check_test6, True),
     "tpu-test-sharing.yaml": (check_sharing, False),
     "tpu-test-topology.yaml": (check_topology, False),
+    "tpu-test-gang.yaml": (check_gang, False),
 }
 
 

@@ -1,0 +1,130 @@
+"""GangTracker: unique ranks across nodes, crash-safe rebuild from NAS,
+idempotency, rank reuse after release, gang-full, and concurrency."""
+
+import threading
+
+import pytest
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.tpu_v1alpha1 import GangConfig
+from tpu_dra.client import ClientSet, FakeApiServer
+from tpu_dra.controller.gang_tracker import GangFullError, GangTracker
+
+NS = "tpu-dra"
+
+
+@pytest.fixture
+def cs():
+    return ClientSet(FakeApiServer())
+
+
+def commit_to_nas(cs, node, claim_uid, assignment, namespace="default"):
+    """Persist an assignment the way the controller does (into a NAS)."""
+    client = cs.node_allocation_states(NS)
+    try:
+        nas = client.get(node)
+    except Exception:
+        nas = client.create(
+            nascrd.NodeAllocationState(
+                metadata=ObjectMeta(name=node, namespace=NS)
+            )
+        )
+    nas.spec.allocated_claims[claim_uid] = nascrd.AllocatedDevices(
+        claim_info=nascrd.ClaimInfo(namespace=namespace, name="c", uid=claim_uid),
+        tpu=nascrd.AllocatedTpus(
+            devices=[nascrd.AllocatedTpu(uuid=f"chip-{claim_uid}")],
+            gang=assignment,
+        ),
+    )
+    client.update(nas)
+
+
+class TestRankAssignment:
+    def test_sequential_unique_ranks_and_shared_coordinator(self, cs):
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=4)
+        seen = []
+        for i, node in enumerate(["n0", "n1", "n0", "n1"]):
+            a = tracker.assign(gang, "default", f"uid-{i}", node)
+            commit_to_nas(cs, node, f"uid-{i}", a)
+            tracker.commit(f"uid-{i}")
+            seen.append(a)
+        assert sorted(a.rank for a in seen) == [0, 1, 2, 3]
+        assert {a.coordinator for a in seen} == {"n0:8476"}  # rank0's node
+
+    def test_idempotent_per_claim(self, cs):
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=2)
+        first = tracker.assign(gang, "default", "uid-1", "n0")
+        again = tracker.assign(gang, "default", "uid-1", "n1")
+        assert first == again
+
+    def test_idempotent_after_commit(self, cs):
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=2)
+        a = tracker.assign(gang, "default", "uid-1", "n0")
+        commit_to_nas(cs, "n0", "uid-1", a)
+        tracker.commit("uid-1")
+        assert tracker.assign(gang, "default", "uid-1", "n0") == a
+
+    def test_gang_full(self, cs):
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=1)
+        tracker.assign(gang, "default", "uid-1", "n0")
+        with pytest.raises(GangFullError):
+            tracker.assign(gang, "default", "uid-2", "n0")
+
+    def test_release_frees_rank(self, cs):
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=2)
+        a0 = tracker.assign(gang, "default", "uid-1", "n0")
+        tracker.release("uid-1")  # failed allocate: rank returns to pool
+        a1 = tracker.assign(gang, "default", "uid-2", "n0")
+        assert a1.rank == a0.rank == 0
+
+    def test_namespaced_gangs_do_not_collide(self, cs):
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="same-name", size=1)
+        a = tracker.assign(gang, "ns-a", "uid-a", "n0")
+        b = tracker.assign(gang, "ns-b", "uid-b", "n1")
+        assert a.rank == b.rank == 0  # distinct gangs
+        assert a.coordinator != b.coordinator
+
+
+class TestCrashRecovery:
+    def test_rebuilds_from_nas(self, cs):
+        gang = GangConfig(name="g", size=4)
+        tracker1 = GangTracker(cs, NS)
+        for i, node in enumerate(["n0", "n1"]):
+            a = tracker1.assign(gang, "default", f"uid-{i}", node)
+            commit_to_nas(cs, node, f"uid-{i}", a)
+            tracker1.commit(f"uid-{i}")
+        # "Controller restart": a fresh tracker sees committed members.
+        tracker2 = GangTracker(cs, NS)
+        a = tracker2.assign(gang, "default", "uid-2", "n2")
+        assert a.rank == 2
+        assert a.coordinator == "n0:8476"
+
+
+class TestConcurrency:
+    def test_parallel_assignment_is_race_free(self, cs):
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=16)
+        results = {}
+        errors = []
+
+        def worker(i):
+            try:
+                results[i] = tracker.assign(gang, "default", f"uid-{i}", f"n{i % 4}")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert sorted(a.rank for a in results.values()) == list(range(16))
+        assert len({a.coordinator for a in results.values()}) == 1

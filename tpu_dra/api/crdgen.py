@@ -180,6 +180,7 @@ def tpu_claim_parameters_schema() -> dict:
     schema = schema_for_object(tpucrd.TpuClaimParameters)
     _constrain(schema, ("spec", "count"), minimum=1)
     _constrain(schema, ("spec", "topology"), pattern=r"^\d+x\d+(x\d+)?$")
+    _constrain(schema, ("spec", "gang", "size"), minimum=1)
     return schema
 
 

@@ -109,11 +109,23 @@ class AllocatedSubslice:
 
 
 @dataclass
+class GangAssignment:
+    """The controller's rank assignment for a gang-member claim: consumed by
+    the node plugin's CDI edits to inject the TPU_DRA_GANG_* contract."""
+
+    name: str = ""
+    size: int = 0
+    rank: int = 0
+    coordinator: str = ""  # "<rank0-node>:<port>"
+
+
+@dataclass
 class AllocatedTpus:
     devices: list[AllocatedTpu] = field(default_factory=list)
     # Topology actually granted, e.g. "2x2x1", when the claim requested one.
     topology: str = ""
     sharing: TpuSharing | None = None
+    gang: GangAssignment | None = None
 
 
 @dataclass

@@ -146,6 +146,20 @@ class DeviceClassParameters:
 
 
 @dataclass
+class GangConfig:
+    """Multi-pod gang membership (TPU-first surface, no reference analog —
+    SURVEY.md §2: the reference's multi-device story stops at single-node
+    claims).  Claims sharing a gang ``name`` are ranked members of one JAX
+    distributed system: the controller assigns ranks at allocation time and
+    records the rank-0 node as coordinator; the node plugin's CDI edits
+    inject the TPU_DRA_GANG_* contract (tpu_dra/parallel/gang.py)."""
+
+    name: str = ""
+    size: int = 0
+    port: int = 8476  # jax.distributed default coordinator port
+
+
+@dataclass
 class TpuClaimParametersSpec:
     """Whole-chip claim: ``count`` N chips or ``topology`` "XxYxZ" (not both).
 
@@ -158,6 +172,7 @@ class TpuClaimParametersSpec:
     topology: str | None = None
     selector: TpuSelector | None = None
     sharing: TpuSharing | None = None
+    gang: GangConfig | None = None
 
 
 @dataclass
@@ -243,6 +258,7 @@ __all__ = [
     "make_property_selector",
     "DeviceClassParameters",
     "DeviceClassParametersSpec",
+    "GangConfig",
     "TpuClaimParameters",
     "TpuClaimParametersSpec",
     "SubsliceClaimParameters",

@@ -42,6 +42,9 @@ class ControllerDriver:
         self.clientset = clientset
         self.tpu = TpuDriver()
         self.subslice = SubsliceDriver()
+        from tpu_dra.controller.gang_tracker import GangTracker
+
+        self.gangs = GangTracker(clientset, namespace)
 
     # -- parameter resolution (driver.go:61-107) -----------------------------
 
@@ -130,7 +133,19 @@ class ControllerDriver:
                 name=claim.metadata.name,
                 uid=claim_uid,
             )
+            if (
+                isinstance(claim_params, tpucrd.TpuClaimParametersSpec)
+                and claim_params.gang is not None
+                and allocated.tpu is not None
+            ):
+                allocated.tpu.gang = self.gangs.assign(
+                    claim_params.gang,
+                    claim.metadata.namespace,
+                    claim_uid,
+                    selected_node,
+                )
             client.update(nas.spec)
+            self.gangs.commit(claim_uid)
             on_success()
             return build_allocation_result(selected_node, bool(class_params.shareable))
 
@@ -140,6 +155,7 @@ class ControllerDriver:
         # re-cached by a concurrent scheduling pass.
         self.tpu.pending_allocated_claims.remove(claim.metadata.uid)
         self.subslice.pending_allocated_claims.remove(claim.metadata.uid)
+        self.gangs.release(claim.metadata.uid)
         selected_node = get_selected_node(claim)
         if not selected_node:
             return

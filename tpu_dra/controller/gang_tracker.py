@@ -1,0 +1,109 @@
+"""Gang rank assignment — the controller half of the TPU_DRA_GANG_* contract.
+
+Claims whose parameters carry a ``gang`` config (tpu_v1alpha1.GangConfig)
+are ranked members of one JAX distributed system.  Rank assignment must be
+unique across the whole gang even though allocations land on different
+nodes under different per-node locks, so the tracker is the cross-node
+serialization point:
+
+- committed truth is read from the NAS objects themselves (every allocated
+  member's GangAssignment is persisted in AllocatedTpus.gang), which makes
+  assignment crash-safe — a restarted controller rebuilds its view from the
+  apiserver exactly like the pending-claims cache (SURVEY.md §5
+  checkpoint/resume: "the NAS CRD *is* the checkpoint");
+- in-flight assignments (handed out but not yet written to a NAS) are held
+  in memory under one lock so two concurrent allocations of the same gang
+  cannot take the same rank.
+
+The first-ranked member's node becomes the coordinator ("<node>:<port>"),
+recorded on every member so late joiners agree without discovery.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api import tpu_v1alpha1 as tpucrd
+from tpu_dra.client.clientset import ClientSet
+
+
+class GangFullError(RuntimeError):
+    pass
+
+
+class GangTracker:
+    def __init__(self, clientset: ClientSet, namespace: str):
+        self._clientset = clientset
+        self._namespace = namespace
+        self._lock = threading.Lock()
+        # (claim_namespace, gang_name) -> {claim_uid: GangAssignment}
+        self._in_flight: "dict[tuple[str, str], dict[str, nascrd.GangAssignment]]" = {}
+
+    def _committed(self, key: "tuple[str, str]") -> "dict[str, nascrd.GangAssignment]":
+        """Assignments already persisted in any NAS (all nodes)."""
+        namespace, gang_name = key
+        out: "dict[str, nascrd.GangAssignment]" = {}
+        for nas in self._clientset.node_allocation_states(self._namespace).list():
+            for claim_uid, alloc in nas.spec.allocated_claims.items():
+                if alloc.tpu is None or alloc.tpu.gang is None:
+                    continue
+                info = alloc.claim_info
+                if alloc.tpu.gang.name == gang_name and (
+                    info is None or info.namespace == namespace
+                ):
+                    out[claim_uid] = alloc.tpu.gang
+        return out
+
+    def assign(
+        self,
+        gang: tpucrd.GangConfig,
+        claim_namespace: str,
+        claim_uid: str,
+        selected_node: str,
+    ) -> nascrd.GangAssignment:
+        """Rank for this member (idempotent per claim UID)."""
+        key = (claim_namespace, gang.name)
+        with self._lock:
+            committed = self._committed(key)
+            if claim_uid in committed:
+                return committed[claim_uid]
+            flight = self._in_flight.setdefault(key, {})
+            if claim_uid in flight:
+                return flight[claim_uid]
+
+            used = {a.rank for a in committed.values()}
+            used.update(
+                a.rank for uid, a in flight.items() if uid not in committed
+            )
+            rank = next(r for r in range(gang.size + 1) if r not in used)
+            if rank >= gang.size:
+                raise GangFullError(
+                    f"gang {gang.name!r} already has {gang.size} members"
+                )
+            coordinator = ""
+            for member in list(committed.values()) + list(flight.values()):
+                if member.coordinator:
+                    coordinator = member.coordinator
+                    break
+            if not coordinator:
+                coordinator = f"{selected_node}:{gang.port}"
+            assignment = nascrd.GangAssignment(
+                name=gang.name,
+                size=gang.size,
+                rank=rank,
+                coordinator=coordinator,
+            )
+            flight[claim_uid] = assignment
+            return assignment
+
+    def release(self, claim_uid: str) -> None:
+        """Drop any in-flight assignment (deallocation / failed allocate);
+        committed assignments die with their NAS entry."""
+        with self._lock:
+            for flight in self._in_flight.values():
+                flight.pop(claim_uid, None)
+
+    def commit(self, claim_uid: str) -> None:
+        """The assignment reached the NAS; the committed scan now covers it."""
+        self.release(claim_uid)

@@ -49,6 +49,11 @@ class TpuDriver:
             raise ValueError(f"invalid number of TPUs requested: {params.count}")
         if params.topology is not None:
             Topology.parse(params.topology)  # raises on malformed
+        if params.gang is not None:
+            if not params.gang.name:
+                raise ValueError("gang config requires a name")
+            if params.gang.size < 1:
+                raise ValueError(f"invalid gang size: {params.gang.size}")
 
     def allocate(
         self,

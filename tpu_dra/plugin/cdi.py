@@ -81,13 +81,21 @@ class CDIHandler:
             "TPU_VISIBLE_DEVICES=" + ",".join(str(i) for i in sorted(indices)),
         ]
         topology = ""
+        gang = None
         if allocated is not None and allocated.tpu is not None:
             topology = allocated.tpu.topology
+            gang = allocated.tpu.gang
         if topology:
             bounds = topology.replace("x", ",")
             env.append(f"TPU_CHIPS_PER_HOST_BOUNDS={bounds}")
         if len(generations) == 1:
             env.append(f"TPU_ACCELERATOR_TYPE={generations.pop()}")
+        if gang is not None and gang.coordinator:
+            # The multi-host coordination contract (tpu_dra/parallel/gang.py):
+            # every member container can jax.distributed.initialize from env.
+            env.append(f"TPU_DRA_GANG_COORDINATOR={gang.coordinator}")
+            env.append(f"TPU_DRA_GANG_SIZE={gang.size}")
+            env.append(f"TPU_DRA_GANG_RANK={gang.rank}")
         return {"deviceNodes": device_nodes, "env": env}
 
     def _subslice_edits(self, prepared: nascrd.PreparedSubslices) -> dict:
