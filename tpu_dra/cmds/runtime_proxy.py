@@ -1,0 +1,55 @@
+"""tpu-runtime-proxy — the per-claim runtime-proxy control daemon binary.
+
+The per-claim Deployment created by the node plugin
+(tpu_dra/plugin/sharing.py RuntimeProxyDaemon.start) runs this command,
+the way the reference's templated Deployment runs NVIDIA's vendor
+``mps-control-daemon`` (templates/mps-control-daemon.tmpl.yaml:30-40).
+Config comes from ``--root`` / ``TPU_PROXY_ROOT`` (a per-claim directory
+holding config.json) or, standalone, from the TPU_PROXY_* env contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from tpu_dra.proxy import daemon as proxy_daemon
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpu-runtime-proxy",
+        description="per-claim TPU runtime-proxy control daemon",
+    )
+    parser.add_argument(
+        "--root",
+        default=os.environ.get("TPU_PROXY_ROOT", ""),
+        help="per-claim directory containing config.json "
+        "(default: $TPU_PROXY_ROOT)",
+    )
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        stream=sys.stderr,
+    )
+
+    if args.root and os.path.exists(
+        os.path.join(args.root, proxy_daemon.CONFIG_FILE)
+    ):
+        config = proxy_daemon.ProxyDaemonConfig.load(args.root)
+    else:
+        config = proxy_daemon.ProxyDaemonConfig.from_env()
+    if not config.socket_path:
+        parser.error(
+            "no socket path: provide --root with a config.json, or set "
+            "TPU_PROXY_SOCKET / TPU_PROXY_ROOT"
+        )
+    return proxy_daemon.run(config)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

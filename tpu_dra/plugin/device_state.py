@@ -19,7 +19,7 @@ Owns the node's allocatable inventory and the per-claim prepared state:
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from tpu_dra.api import nas_v1alpha1 as nascrd
 from tpu_dra.api import serde
@@ -36,10 +36,16 @@ from tpu_dra.plugin.tpulib import TpuLib
 
 @dataclass
 class PreparedClaim:
-    """One claim's prepared devices + any sharing daemon attached to it."""
+    """One claim's prepared devices + any sharing daemon attached to it.
+
+    ``ready``/``error`` gate concurrent preparers of the SAME claim on the
+    owner's readiness wait without holding the DeviceState lock, so a slow
+    proxy daemon never stalls unrelated claims' prepares on this node."""
 
     devices: nascrd.PreparedDevices
     proxy_daemon: RuntimeProxyDaemon | None = None
+    ready: threading.Event = field(default_factory=threading.Event)
+    error: Exception | None = None
 
 
 class DeviceState:
@@ -68,44 +74,82 @@ class DeviceState:
     # -- prepare / unprepare -------------------------------------------------
 
     def prepare(self, claim_uid: str, allocated: nascrd.AllocatedDevices) -> list[str]:
+        owner = False
         with self._lock:
-            if claim_uid in self._prepared:
-                return self._cdi.get_claim_devices(claim_uid)
+            entry = self._prepared.get(claim_uid)
+            if entry is None:
+                owner = True
+                if allocated.type() == nascrd.TPU_DEVICE_TYPE:
+                    devices = self._prepare_tpus(allocated.tpu)
+                    sharing = allocated.tpu.sharing
+                elif allocated.type() == nascrd.SUBSLICE_DEVICE_TYPE:
+                    devices = self._prepare_subslices(allocated.subslice)
+                    sharing = allocated.subslice.sharing
+                else:
+                    raise ValueError(
+                        f"claim {claim_uid} has no allocated devices to prepare"
+                    )
 
-            if allocated.type() == nascrd.TPU_DEVICE_TYPE:
-                devices = self._prepare_tpus(allocated.tpu)
-                sharing = allocated.tpu.sharing
-            elif allocated.type() == nascrd.SUBSLICE_DEVICE_TYPE:
-                devices = self._prepare_subslices(allocated.subslice)
-                sharing = allocated.subslice.sharing
-            else:
-                raise ValueError(
-                    f"claim {claim_uid} has no allocated devices to prepare"
-                )
+                entry = PreparedClaim(devices=devices)
+                try:
+                    # wait=False: daemon creation is quick API calls; the
+                    # readiness poll happens below, outside the lock.
+                    entry.proxy_daemon = setup_sharing(
+                        self._ts_manager,
+                        self._proxy_manager,
+                        sharing,
+                        allocated.claim_info,
+                        devices,
+                        wait=False,
+                    )
+                    extra = (
+                        entry.proxy_daemon.get_cdi_edits()
+                        if entry.proxy_daemon is not None
+                        else None
+                    )
+                    self._cdi.create_claim_spec_file(
+                        claim_uid, devices, allocated, extra_edits=extra
+                    )
+                except Exception:
+                    self._rollback_prepare(entry)
+                    raise
 
-            entry = PreparedClaim(devices=devices)
+                self._prepared[claim_uid] = entry
+
+        if owner:
             try:
-                entry.proxy_daemon = setup_sharing(
-                    self._ts_manager,
-                    self._proxy_manager,
-                    sharing,
-                    allocated.claim_info,
-                    devices,
-                )
-                extra = (
-                    entry.proxy_daemon.get_cdi_edits()
-                    if entry.proxy_daemon is not None
-                    else None
-                )
-                self._cdi.create_claim_spec_file(
-                    claim_uid, devices, allocated, extra_edits=extra
-                )
-            except Exception:
-                self._rollback_prepare(entry)
+                if entry.proxy_daemon is not None:
+                    entry.proxy_daemon.assert_ready()
+            except Exception as e:
+                entry.error = e
+                try:
+                    with self._lock:
+                        # Only clean up if this entry is still the live one:
+                        # an unprepare during the poll already tore it down,
+                        # and a subsequent successful prepare of the same
+                        # claim owns the per-claim dir/CDI file now — rolling
+                        # back here would destroy that newer state.
+                        if self._prepared.get(claim_uid) is entry:
+                            del self._prepared[claim_uid]
+                            self._rollback_prepare(entry)
+                            self._cdi.delete_claim_spec_file(claim_uid)
+                finally:
+                    # Always release waiters, even if cleanup itself raised —
+                    # otherwise concurrent preparers of this claim hang on
+                    # ready.wait() forever.
+                    entry.ready.set()
                 raise
-
-            self._prepared[claim_uid] = entry
-            return self._cdi.get_claim_devices(claim_uid)
+            entry.ready.set()
+        else:
+            # Another preparer of this same claim owns readiness; wait on it
+            # without holding the state lock, so prepares of OTHER claims
+            # proceed concurrently.
+            entry.ready.wait()
+            if entry.error is not None:
+                raise RuntimeError(
+                    f"concurrent prepare of claim {claim_uid} failed"
+                ) from entry.error
+        return self._cdi.get_claim_devices(claim_uid)
 
     def _rollback_prepare(self, entry: PreparedClaim) -> None:
         """Undo partial prepare so a retry starts clean (the reference leaks
@@ -307,4 +351,6 @@ class DeviceState:
                 f"{sorted(live)}"
             )
         with self._lock:
+            for entry in prepared.values():
+                entry.ready.set()  # recovered entries are ready by definition
             self._prepared = prepared

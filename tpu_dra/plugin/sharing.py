@@ -91,13 +91,16 @@ class RuntimeProxyDaemon:
         """Create the per-claim daemon Deployment + its socket/shm dir
         (sharing.go:172-275).  Idempotent."""
         os.makedirs(self._root, exist_ok=True)
-        indices = [
-            self._manager.tpulib.chip_info(u).tpu.index for u in self._uuids
-        ]
         hbm_limits = self._config.normalize(self._uuids)
+        daemon_config = self._build_daemon_config(hbm_limits)
+        daemon_config.save(self._root)
         env = [
-            {"name": "TPU_VISIBLE_DEVICES", "value": ",".join(map(str, sorted(indices)))},
+            {
+                "name": "TPU_VISIBLE_DEVICES",
+                "value": ",".join(map(str, daemon_config.visible_devices)),
+            },
             {"name": "TPU_PROXY_SOCKET", "value": self.socket_path},
+            {"name": "TPU_PROXY_ROOT", "value": self._root},
         ]
         if self._config.max_active_core_percentage is not None:
             env.append(
@@ -158,6 +161,33 @@ class RuntimeProxyDaemon:
             client.get(self._name)
         except NotFoundError:
             client.create(deployment)
+
+    def _build_daemon_config(self, hbm_limits: dict):
+        """The full contract the ``tpu-runtime-proxy`` binary
+        (tpu_dra/proxy/daemon.py) runs from: devnodes to own, core counts,
+        and the claim's limits — single source of truth for both config.json
+        and the Deployment env."""
+        from tpu_dra.proxy.daemon import ProxyDaemonConfig
+
+        device_paths: dict[str, list[str]] = {}
+        chip_cores: dict[str, int] = {}
+        indices: list[int] = []
+        for uuid in self._uuids:
+            info = self._manager.tpulib.chip_info(uuid)
+            device_paths[uuid] = list(info.device_paths)
+            chip_cores[uuid] = info.tpu.cores
+            indices.append(info.tpu.index)
+        return ProxyDaemonConfig(
+            claim_uid=self._claim.uid,
+            socket_path=self.socket_path,
+            visible_devices=sorted(indices),
+            device_paths=device_paths,
+            chip_cores=chip_cores,
+            max_active_core_percentage=self._config.max_active_core_percentage,
+            hbm_limits={
+                uuid: limit.to_int() for uuid, limit in hbm_limits.items()
+            },
+        )
 
     def assert_ready(self) -> None:
         """Poll deployment readiness with capped exponential backoff
@@ -247,9 +277,15 @@ def setup_sharing(
     sharing: TpuSharing | None,
     claim: nascrd.ClaimInfo | None,
     prepared: "nascrd.PreparedDevices",
+    wait: bool = True,
 ) -> RuntimeProxyDaemon | None:
     """Apply a claim's sharing config at prepare time (device_state.go:333-363
-    analog).  Returns the proxy daemon when one was started."""
+    analog).  Returns the proxy daemon when one was started.
+
+    With ``wait=False`` the daemon is started but readiness is NOT polled —
+    the caller must run ``daemon.assert_ready()`` itself (DeviceState does
+    this outside its state lock so one slow daemon can't stall every other
+    claim's prepare on the node)."""
     if sharing is None:
         return None
     if sharing.is_time_slicing():
@@ -262,11 +298,12 @@ def setup_sharing(
             sharing.get_runtime_proxy_config(),
         )
         daemon.start()
-        try:
-            daemon.assert_ready()
-        except Exception:
-            # Don't leak a half-started daemon on readiness failure.
-            daemon.stop()
-            raise
+        if wait:
+            try:
+                daemon.assert_ready()
+            except Exception:
+                # Don't leak a half-started daemon on readiness failure.
+                daemon.stop()
+                raise
         return daemon
     return None

@@ -254,10 +254,22 @@ class MockTpuLib(_BaseTpuLib):
         ici_domain: str = "mock-host",
         state_dir: str = "/tmp/tpu-dra-mock",
         uuid_prefix: str = "mock-tpu",
+        devfs_dir: "str | None" = None,
     ):
+        # With devfs_dir set, the fake devnodes are real (empty) files there,
+        # so processes that take ownership of them (the runtime-proxy daemon's
+        # flock) exercise the real code path hardware-free.
+        if devfs_dir:
+            os.makedirs(devfs_dir, exist_ok=True)
         topo = mesh if isinstance(mesh, Topology) else Topology.parse(mesh)
         chips = []
         for index, coord in enumerate(topo.coords_from((0, 0, 0))):
+            if devfs_dir:
+                devnode = os.path.join(devfs_dir, f"accel{index}")
+                with open(devnode, "a"):
+                    pass
+            else:
+                devnode = f"/dev/accel{index}"
             chips.append(
                 TpuChipInfo(
                     tpu=AllocatableTpu(
@@ -273,7 +285,7 @@ class MockTpuLib(_BaseTpuLib):
                         libtpu_version="1.10.0",
                         runtime_version="2.0.0",
                     ),
-                    device_paths=[f"/dev/accel{index}"],
+                    device_paths=[devnode],
                 )
             )
         super().__init__(chips, SubsliceRegistry(os.path.join(state_dir, "subslices.json")))

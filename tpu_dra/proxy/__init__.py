@@ -1,0 +1,27 @@
+"""The tpu-runtime-proxy control daemon and its client library.
+
+The reference's MPS sharing path works because NVIDIA ships a vendor binary
+(`mps-control-daemon`) that the driver merely templates into a per-claim
+Deployment (reference: cmd/nvidia-dra-plugin/sharing.go:122-391,
+templates/mps-control-daemon.tmpl.yaml:1-74).  There is no vendor equivalent
+for TPUs, so this package is that daemon, first-party:
+
+- ``daemon``   — the control-daemon process: owns the claimed chips' device
+  nodes, serves clients over a unix socket in the per-claim directory, and
+  enforces ``maxActiveCorePercentage`` / per-chip HBM limits on them.
+- ``client``   — what consumer containers use: connect to
+  ``TPU_RUNTIME_PROXY_ADDR``, attach with a resource ask, run work under the
+  granted lease, detach (or just die — leases are connection-scoped, exactly
+  like MPS client death handling).
+- ``protocol`` — the newline-delimited JSON framing both sides speak.
+"""
+
+from tpu_dra.proxy.client import ProxyClient, ProxyError
+from tpu_dra.proxy.daemon import ProxyDaemon, ProxyDaemonConfig
+
+__all__ = [
+    "ProxyClient",
+    "ProxyError",
+    "ProxyDaemon",
+    "ProxyDaemonConfig",
+]

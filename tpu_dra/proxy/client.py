@@ -1,0 +1,98 @@
+"""Consumer-side client for the tpu-runtime-proxy daemon.
+
+A consumer container finds the daemon through the CDI-injected
+``TPU_RUNTIME_PROXY_ADDR`` env (sharing.go:334-354 analog) and speaks the
+protocol in ``tpu_dra.proxy.protocol``.  The lease is connection-scoped: a
+client crash releases its resources the moment the socket drops.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+
+from tpu_dra.proxy import protocol
+
+ADDR_ENV = "TPU_RUNTIME_PROXY_ADDR"
+
+
+class ProxyError(Exception):
+    """The daemon refused a request (limits exceeded, no lease, ...)."""
+
+
+class ProxyClient:
+    def __init__(self, socket_path: "str | None" = None, timeout: float = 10.0):
+        path = socket_path or os.environ.get(ADDR_ENV)
+        if not path:
+            raise ValueError(
+                f"no proxy socket path given and {ADDR_ENV} is not set"
+            )
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        usable, fd = protocol.short_socket_path(path)
+        try:
+            self._sock.connect(usable)
+        finally:
+            if fd is not None:
+                os.close(fd)
+        self._rfile = self._sock.makefile("rb")
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _call(self, msg: dict) -> dict:
+        protocol.send_msg(self._sock, msg)
+        reply = protocol.recv_msg(self._rfile)
+        if reply is None:
+            raise ProxyError("daemon closed the connection")
+        if not reply.get("ok"):
+            raise ProxyError(reply.get("error", "request failed"))
+        return reply
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ProxyClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- operations ----------------------------------------------------------
+
+    def ping(self) -> dict:
+        return self._call({"op": "ping"})
+
+    def status(self) -> dict:
+        return self._call({"op": "status"})
+
+    def attach(
+        self,
+        client: str,
+        *,
+        core_percentage: int = 0,
+        hbm: "dict[str, int | str] | None" = None,
+        cores: "tuple[str, int, int] | None" = None,
+    ) -> dict:
+        """Acquire a lease; raises ProxyError when the ask exceeds the
+        claim's limits.  Returns the granted resources."""
+        msg: dict = {
+            "op": "attach",
+            "client": client,
+            "core_percentage": core_percentage,
+        }
+        if hbm:
+            msg["hbm"] = hbm
+        if cores:
+            msg["cores"] = list(cores)
+        return self._call(msg)["granted"]
+
+    def submit(self, payload) -> dict:
+        """Run work under the lease (requires a prior attach)."""
+        return self._call({"op": "submit", "payload": payload})["result"]
+
+    def detach(self) -> None:
+        self._call({"op": "detach"})

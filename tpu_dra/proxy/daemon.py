@@ -1,0 +1,450 @@
+"""The tpu-runtime-proxy control daemon (the first-party mps-control-daemon
+analog; reference lifecycle: cmd/nvidia-dra-plugin/sharing.go:122-391).
+
+One daemon per RuntimeProxy-shared claim.  On startup it:
+
+1. takes exclusive ownership of the claimed chips' device nodes (flock on
+   each devnode — the "owns the devices" property MPS gets by being the sole
+   CUDA context holder),
+2. binds a unix socket in the per-claim directory and serves the protocol in
+   ``tpu_dra.proxy.protocol``,
+3. writes a ``ready`` sentinel file the deployment controller (kubelet
+   readiness-probe analog) checks.
+
+Clients attach with a resource ask; the daemon admits them only while the
+aggregate stays within the claim's limits:
+
+- sum of active ``core_percentage`` asks ≤ ``maxActiveCorePercentage``
+  (MpsConfig active-thread-percentage analog, sharing.go:191-204),
+- per-chip sum of ``hbm`` asks ≤ that chip's HBM limit
+  (per-device pinned-memory-limit analog, sharing.go:205-221),
+- a client asking for an explicit core interval must stay inside the cores
+  this daemon owns, and intervals are exclusive across clients — this is
+  what makes ``TPU_VISIBLE_CORES`` an enforced contract rather than an
+  advisory env var.
+
+Leases are connection-scoped: a client that dies without detaching loses its
+lease when the socket drops, exactly like MPS client-death handling.
+SIGTERM stops the server, unlinks the socket, releases the devnode locks,
+and removes the ready file — teardown leaves nothing behind.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import logging
+import os
+import signal
+import socket
+import socketserver
+import threading
+from dataclasses import dataclass, field
+
+from tpu_dra.proxy import protocol
+from tpu_dra.utils.quantity import Quantity
+
+logger = logging.getLogger(__name__)
+
+CONFIG_FILE = "config.json"
+READY_FILE = "ready"
+
+
+@dataclass
+class ProxyDaemonConfig:
+    """Everything the daemon needs, written as ``config.json`` into the
+    per-claim directory by the node plugin (RuntimeProxyDaemon.start)."""
+
+    claim_uid: str = ""
+    socket_path: str = ""
+    visible_devices: list[int] = field(default_factory=list)
+    # chip uuid -> devnode paths; ownership is taken per path.
+    device_paths: dict[str, list[str]] = field(default_factory=dict)
+    # chip uuid -> total cores on that chip (for interval validation).
+    chip_cores: dict[str, int] = field(default_factory=dict)
+    max_active_core_percentage: int | None = None
+    # chip uuid -> HBM byte cap for the sum of client asks.
+    hbm_limits: dict[str, int] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "claimUid": self.claim_uid,
+            "socketPath": self.socket_path,
+            "visibleDevices": self.visible_devices,
+            "devicePaths": self.device_paths,
+            "chipCores": self.chip_cores,
+            "maxActiveCorePercentage": self.max_active_core_percentage,
+            "hbmLimits": self.hbm_limits,
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "ProxyDaemonConfig":
+        return cls(
+            claim_uid=data.get("claimUid", ""),
+            socket_path=data.get("socketPath", ""),
+            visible_devices=list(data.get("visibleDevices", [])),
+            device_paths={
+                k: list(v) for k, v in data.get("devicePaths", {}).items()
+            },
+            chip_cores=dict(data.get("chipCores", {})),
+            max_active_core_percentage=data.get("maxActiveCorePercentage"),
+            hbm_limits=dict(data.get("hbmLimits", {})),
+        )
+
+    @classmethod
+    def load(cls, root: str) -> "ProxyDaemonConfig":
+        with open(os.path.join(root, CONFIG_FILE)) as f:
+            cfg = cls.from_json(json.load(f))
+        if not cfg.socket_path:
+            cfg.socket_path = os.path.join(root, "proxy.sock")
+        return cfg
+
+    def save(self, root: str) -> None:
+        os.makedirs(root, exist_ok=True)
+        tmp = os.path.join(root, CONFIG_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(self.to_json(), f, indent=2)
+        os.replace(tmp, os.path.join(root, CONFIG_FILE))
+
+    @classmethod
+    def from_env(cls, env: "dict[str, str] | None" = None) -> "ProxyDaemonConfig":
+        """The env contract the per-claim Deployment carries (the template
+        analog of mps-control-daemon.tmpl.yaml's args).  ``TPU_PROXY_ROOT``
+        with a config.json takes precedence; plain env works standalone."""
+        env = dict(os.environ if env is None else env)
+        root = env.get("TPU_PROXY_ROOT", "")
+        if root and os.path.exists(os.path.join(root, CONFIG_FILE)):
+            return cls.load(root)
+        cfg = cls()
+        cfg.socket_path = env.get("TPU_PROXY_SOCKET", "")
+        if not cfg.socket_path and root:
+            cfg.socket_path = os.path.join(root, "proxy.sock")
+        devices = env.get("TPU_VISIBLE_DEVICES", "")
+        if devices:
+            cfg.visible_devices = [int(d) for d in devices.split(",") if d]
+        pct = env.get("TPU_PROXY_ACTIVE_CORE_PERCENTAGE")
+        if pct:
+            cfg.max_active_core_percentage = int(pct)
+        for key, value in env.items():
+            if key.startswith("TPU_PROXY_HBM_LIMIT_"):
+                uuid = key[len("TPU_PROXY_HBM_LIMIT_") :].replace("_", "-")
+                cfg.hbm_limits[uuid] = Quantity(value).to_int()
+        return cfg
+
+
+@dataclass
+class Lease:
+    client: str
+    core_percentage: int = 0
+    hbm: dict[str, int] = field(default_factory=dict)
+    cores: "tuple[str, int, int] | None" = None  # (uuid, start, end) inclusive
+
+
+class _LimitError(Exception):
+    pass
+
+
+class ProxyDaemon:
+    def __init__(self, config: ProxyDaemonConfig):
+        if not config.socket_path:
+            raise ValueError("proxy daemon needs a socket path")
+        self._config = config
+        self._root = os.path.dirname(config.socket_path)
+        self._lock = threading.Lock()
+        self._leases: dict[int, Lease] = {}  # keyed by connection id
+        self._devnode_fds: list[int] = []
+        self._missing_devnodes: list[str] = []
+        self._server: socketserver.ThreadingUnixStreamServer | None = None
+        self._stopped = threading.Event()
+
+    # -- devnode ownership ---------------------------------------------------
+
+    def _acquire_devnodes(self) -> None:
+        for uuid, paths in sorted(self._config.device_paths.items()):
+            for path in paths:
+                try:
+                    fd = os.open(path, os.O_RDWR)
+                except FileNotFoundError:
+                    # Mock/sim devnodes need not exist on this host; record
+                    # the gap so `status` surfaces it instead of hiding it.
+                    self._missing_devnodes.append(path)
+                    continue
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                except OSError:
+                    os.close(fd)
+                    for held in self._devnode_fds:
+                        os.close(held)
+                    self._devnode_fds.clear()
+                    raise RuntimeError(
+                        f"device node {path} (chip {uuid}) is owned by "
+                        f"another process"
+                    ) from None
+                self._devnode_fds.append(fd)
+
+    def _release_devnodes(self) -> None:
+        for fd in self._devnode_fds:
+            try:
+                os.close(fd)  # closing drops the flock
+            except OSError:
+                pass
+        self._devnode_fds.clear()
+
+    # -- admission control ---------------------------------------------------
+
+    def _admit(self, conn_id: int, lease: Lease) -> None:
+        if lease.core_percentage < 0:
+            raise _LimitError("core_percentage must be non-negative")
+        if any(ask < 0 for ask in lease.hbm.values()):
+            raise _LimitError("hbm asks must be non-negative")
+        with self._lock:
+            if conn_id in self._leases:
+                raise _LimitError("client already holds a lease")
+            limit = self._config.max_active_core_percentage
+            if limit is not None:
+                active = sum(l.core_percentage for l in self._leases.values())
+                if active + lease.core_percentage > limit:
+                    raise _LimitError(
+                        f"core percentage limit exceeded: active {active} + "
+                        f"requested {lease.core_percentage} > {limit}"
+                    )
+            for uuid, ask in lease.hbm.items():
+                if uuid not in self._config.device_paths and (
+                    self._config.device_paths
+                ):
+                    raise _LimitError(f"unknown chip {uuid}")
+                cap = self._config.hbm_limits.get(uuid)
+                if cap is not None:
+                    used = sum(
+                        l.hbm.get(uuid, 0) for l in self._leases.values()
+                    )
+                    if used + ask > cap:
+                        raise _LimitError(
+                            f"HBM limit exceeded on {uuid}: used {used} + "
+                            f"requested {ask} > {cap}"
+                        )
+            if lease.cores is not None:
+                uuid, start, end = lease.cores
+                total = self._config.chip_cores.get(uuid)
+                if total is None:
+                    raise _LimitError(f"unknown chip {uuid} for core interval")
+                if not (0 <= start <= end < total):
+                    raise _LimitError(
+                        f"core interval {start}-{end} outside chip {uuid}'s "
+                        f"0-{total - 1}"
+                    )
+                for other in self._leases.values():
+                    if other.cores is None or other.cores[0] != uuid:
+                        continue
+                    _, os_, oe = other.cores
+                    if start <= oe and os_ <= end:
+                        raise _LimitError(
+                            f"core interval {start}-{end} overlaps "
+                            f"{other.client}'s {os_}-{oe} on {uuid}"
+                        )
+            self._leases[conn_id] = lease
+
+    def _release(self, conn_id: int) -> bool:
+        with self._lock:
+            return self._leases.pop(conn_id, None) is not None
+
+    # -- request handling ----------------------------------------------------
+
+    def _status(self) -> dict:
+        with self._lock:
+            leases = [
+                {
+                    "client": l.client,
+                    "corePercentage": l.core_percentage,
+                    "hbm": l.hbm,
+                    "cores": list(l.cores) if l.cores else None,
+                }
+                for l in self._leases.values()
+            ]
+            active_pct = sum(l.core_percentage for l in self._leases.values())
+        return {
+            "claimUid": self._config.claim_uid,
+            "visibleDevices": self._config.visible_devices,
+            "limits": {
+                "maxActiveCorePercentage": self._config.max_active_core_percentage,
+                "hbm": self._config.hbm_limits,
+            },
+            "activeCorePercentage": active_pct,
+            "clients": leases,
+            "ownedDevnodes": len(self._devnode_fds),
+            "missingDevnodes": self._missing_devnodes,
+        }
+
+    def _handle(self, conn_id: int, msg: dict) -> "dict | None":
+        op = msg.get("op")
+        if op == "ping":
+            return {"ok": True, "claimUid": self._config.claim_uid}
+        if op == "status":
+            return {"ok": True, **self._status()}
+        if op == "attach":
+            hbm = {}
+            for uuid, ask in (msg.get("hbm") or {}).items():
+                hbm[uuid] = (
+                    Quantity(ask).to_int() if isinstance(ask, str) else int(ask)
+                )
+            cores = msg.get("cores")
+            lease = Lease(
+                client=str(msg.get("client", f"conn-{conn_id}")),
+                core_percentage=int(msg.get("core_percentage", 0)),
+                hbm=hbm,
+                cores=(
+                    (str(cores[0]), int(cores[1]), int(cores[2]))
+                    if cores
+                    else None
+                ),
+            )
+            try:
+                self._admit(conn_id, lease)
+            except _LimitError as e:
+                return {"ok": False, "error": str(e)}
+            return {
+                "ok": True,
+                "granted": {
+                    "visibleDevices": self._config.visible_devices,
+                    "corePercentage": lease.core_percentage,
+                    "hbm": lease.hbm,
+                    "cores": list(lease.cores) if lease.cores else None,
+                },
+            }
+        if op == "submit":
+            with self._lock:
+                lease = self._leases.get(conn_id)
+            if lease is None:
+                return {"ok": False, "error": "no lease; attach first"}
+            return {
+                "ok": True,
+                "result": {
+                    "payload": msg.get("payload"),
+                    "ranOn": self._config.visible_devices,
+                    "client": lease.client,
+                },
+            }
+        if op == "detach":
+            if not self._release(conn_id):
+                return {"ok": False, "error": "no lease held"}
+            return {"ok": True}
+        # Deliberately no remote "shutdown" op: every consumer container can
+        # reach this socket, and one tenant must not be able to kill the
+        # daemon for its co-tenants.  Lifecycle is SIGTERM-only (the
+        # Deployment's, i.e. kubelet's, job).
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    # -- server lifecycle ----------------------------------------------------
+
+    def start(self) -> None:
+        """Acquire devices, bind the socket, mark ready.  Serving happens on
+        the server's own threads; callers then ``wait()`` or ``stop()``."""
+        self._acquire_devnodes()
+        os.makedirs(self._root, exist_ok=True)
+        try:
+            os.unlink(self._config.socket_path)
+        except FileNotFoundError:
+            pass
+
+        daemon = self
+        next_id = iter(range(1 << 62))
+        id_lock = threading.Lock()
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                with id_lock:
+                    conn_id = next(next_id)
+                try:
+                    while True:
+                        try:
+                            msg = protocol.recv_msg(self.rfile)
+                        except protocol.ProtocolError as e:
+                            protocol.send_msg(
+                                self.connection, {"ok": False, "error": str(e)}
+                            )
+                            return
+                        if msg is None:
+                            return
+                        reply = daemon._handle(conn_id, msg)
+                        if reply is None:
+                            return
+                        protocol.send_msg(self.connection, reply)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    daemon._release(conn_id)
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        bind_path, dirfd = protocol.short_socket_path(self._config.socket_path)
+        try:
+            self._server = Server(bind_path, Handler)
+        finally:
+            if dirfd is not None:
+                os.close(dirfd)
+        thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        thread.start()
+        # Self-check: if the per-claim dir (or the socket file) is removed
+        # out from under us — the node plugin rolled back or unprepared the
+        # claim — exit so the supervisor doesn't keep a stale daemon whose
+        # socket path no longer resolves.
+        threading.Thread(target=self._watch_socket, daemon=True).start()
+        with open(os.path.join(self._root, READY_FILE), "w") as f:
+            f.write(self._config.claim_uid or "ready")
+        logger.info(
+            "tpu-runtime-proxy serving claim %s on %s (%d devnodes owned)",
+            self._config.claim_uid,
+            self._config.socket_path,
+            len(self._devnode_fds),
+        )
+
+    def _watch_socket(self) -> None:
+        while not self._stopped.wait(0.5):
+            if not os.path.exists(self._config.socket_path):
+                logger.warning(
+                    "socket %s disappeared; shutting down",
+                    self._config.socket_path,
+                )
+                self.stop()
+                return
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        if self._server is not None:
+            # shutdown() joins serve_forever; from a handler thread that
+            # would deadlock, so do it from a helper.
+            threading.Thread(target=self._server.shutdown, daemon=True).start()
+            self._server.server_close()
+        for name in (READY_FILE,):
+            try:
+                os.unlink(os.path.join(self._root, name))
+            except OSError:
+                pass
+        try:
+            os.unlink(self._config.socket_path)
+        except OSError:
+            pass
+        self._release_devnodes()
+
+    def wait(self) -> None:
+        self._stopped.wait()
+
+
+def run(config: ProxyDaemonConfig) -> int:
+    """Blocking entry point: serve until SIGTERM/SIGINT."""
+    daemon = ProxyDaemon(config)
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: daemon.stop())
+    daemon.start()
+    daemon.wait()
+    # stop() may have raced with signal delivery; make teardown certain.
+    daemon.stop()
+    return 0

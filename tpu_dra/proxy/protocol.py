@@ -1,0 +1,68 @@
+"""Wire framing for the runtime-proxy socket: newline-delimited JSON.
+
+One request object per line, one response object per line.  Responses always
+carry ``ok`` (bool); failures add ``error``.  The op vocabulary:
+
+- ``ping``    — liveness/readiness probe; returns daemon identity.
+- ``status``  — limits, owned devices, active clients and their usage.
+- ``attach``  — acquire a lease: ``core_percentage`` (share of the chips'
+  compute), ``hbm`` (per-chip byte asks), optional ``cores`` interval.
+  Rejected when it would exceed the claim's configured limits.
+- ``submit``  — run work under the lease (payload echoed back with the
+  granted devices); rejected without a lease.
+- ``detach``  — release the lease early (connection close also releases).
+
+There is deliberately no remote shutdown op: consumers share this socket,
+and daemon lifecycle belongs to the kubelet (SIGTERM), not to tenants.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+MAX_LINE = 1 << 20  # 1 MiB per message is far beyond any legitimate request.
+
+# sockaddr_un.sun_path is 108 bytes on Linux; stay comfortably below it.
+_SUN_PATH_MAX = 100
+
+
+class ProtocolError(Exception):
+    pass
+
+
+def short_socket_path(path: str) -> "tuple[str, int | None]":
+    """Work around the AF_UNIX sun_path length limit.
+
+    Returns ``(usable_path, fd)``: for short paths, the path itself and no
+    fd; for long ones, a ``/proc/self/fd/<dirfd>/<name>`` alias (the socket
+    file still lands at the real location).  The caller closes ``fd`` after
+    bind/connect."""
+    if len(path.encode()) <= _SUN_PATH_MAX:
+        return path, None
+    import os
+
+    fd = os.open(os.path.dirname(path) or ".", os.O_PATH)
+    return f"/proc/self/fd/{fd}/{os.path.basename(path)}", fd
+
+
+def send_msg(sock: socket.socket, msg: dict) -> None:
+    data = json.dumps(msg, separators=(",", ":")).encode() + b"\n"
+    sock.sendall(data)
+
+
+def recv_msg(rfile) -> dict | None:
+    """Read one message from a file-like wrapping the socket.  Returns None
+    on a clean EOF (peer closed)."""
+    line = rfile.readline(MAX_LINE)
+    if not line:
+        return None
+    if not line.endswith(b"\n"):
+        raise ProtocolError("message exceeds maximum frame size")
+    try:
+        msg = json.loads(line)
+    except json.JSONDecodeError as e:
+        raise ProtocolError(f"malformed message: {e}") from e
+    if not isinstance(msg, dict):
+        raise ProtocolError("message must be a JSON object")
+    return msg

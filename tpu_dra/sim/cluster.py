@@ -39,6 +39,8 @@ class SimNode:
         mesh: str = "2x2x1",
         partitionable: bool = False,
         namespace: str = "tpu-dra",
+        devfs: bool = False,
+        backoff_scale: float = 0.01,
     ):
         self.name = name
         self.tpulib = MockTpuLib(
@@ -47,6 +49,7 @@ class SimNode:
             state_dir=f"{state_root}/{name}/tpulib",
             ici_domain=name,
             uuid_prefix=f"{name}-chip",  # distinct chip UUIDs per node
+            devfs_dir=f"{state_root}/{name}/devfs" if devfs else None,
         )
         self.cdi = CDIHandler(f"{state_root}/{name}/cdi", self.tpulib)
         self.state = DeviceState(
@@ -59,7 +62,7 @@ class SimNode:
                 node_name=name,
                 namespace=namespace,
                 proxy_root=f"{state_root}/{name}/proxy",
-                backoff_scale=0.01,
+                backoff_scale=backoff_scale,
             ),
         )
         self.clientset = clientset
@@ -96,8 +99,12 @@ class SimCluster:
         workers: int = 4,
         poll_s: float = 0.01,
         server=None,
+        exec_proxies: bool = False,
     ):
         # ``server`` lets chaos tests wrap the store (sim/faults.py).
+        # ``exec_proxies`` makes KubeSim actually run tpu-runtime-proxy
+        # Deployments as local daemon processes (with real devnode files to
+        # own), instead of just flipping their readiness.
         self.server = server if server is not None else FakeApiServer()
         self.clientset = ClientSet(self.server)
         self.namespace = namespace
@@ -110,6 +117,11 @@ class SimCluster:
                 mesh=mesh,
                 partitionable=partitionable,
                 namespace=namespace,
+                devfs=exec_proxies,
+                # Real daemon processes need interpreter-startup time (~2s in
+                # this image: sitecustomize pulls in jax) before the readiness
+                # ping lands; sim-only runs shrink the poll instead.
+                backoff_scale=0.6 if exec_proxies else 0.01,
             )
             for i in range(nodes)
         ]
@@ -126,6 +138,7 @@ class SimCluster:
             prepare=self._prepare,
             namespace=namespace,
             poll_s=poll_s,
+            exec_proxies=exec_proxies,
         )
 
     # -- lifecycle -----------------------------------------------------------

@@ -19,8 +19,11 @@ real controller/plugin binaries):
   claim — via a pluggable ``prepare`` callable: in-process driver call
   (SimCluster) or real gRPC over the plugin's unix socket (wire rung) — and
   mark the pod Running with its CDI devices attached.
-- **deployment controller**: flip Deployments ready so RuntimeProxy daemon
-  readiness polls succeed.
+- **deployment controller**: with ``exec_proxies=True``, actually RUNS
+  ``tpu-runtime-proxy`` Deployments as local daemon processes (the kubelet
+  running the proxy pod), reporting readiness only once the daemon's socket
+  answers a ping, and SIGTERMing the process when the Deployment is deleted.
+  Otherwise Deployments are flipped ready without a backing process.
 
 Ready nodes are discovered from NAS objects (status=Ready) in the driver
 namespace — the same source of truth the controller uses.
@@ -29,6 +32,7 @@ namespace — the same source of truth the controller uses.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Callable
@@ -62,13 +66,16 @@ class KubeSim:
         prepare: PrepareFn,
         namespace: str = "tpu-dra",
         poll_s: float = 0.01,
+        exec_proxies: bool = False,
     ):
         self.clientset = clientset
         self.namespace = namespace
         self.poll_s = poll_s
+        self.exec_proxies = exec_proxies
         self._prepare = prepare
         self._stop = threading.Event()
         self._threads: "list[threading.Thread]" = []
+        self._proxy_procs: "dict[str, object]" = {}  # name -> subprocess.Popen
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -111,24 +118,109 @@ class KubeSim:
             self._stop.wait(self.poll_s)
 
     def _deployment_controller_loop(self) -> None:
-        """Mark every Deployment ready, so the node plugin's RuntimeProxy
-        readiness poll (sharing.py assert_ready) succeeds the way it would
-        once kubelet ran the proxy pod."""
+        """Reconcile Deployments: either actually run proxy daemons as local
+        processes (exec_proxies) or flip readiness, so the node plugin's
+        RuntimeProxy readiness poll (sharing.py assert_ready) behaves the way
+        it would once kubelet ran the proxy pod."""
         while not self._stop.is_set():
             try:
                 client = self.clientset.deployments(self.namespace)
+                seen: "set[str]" = set()
                 for deployment in client.list():
+                    seen.add(deployment.metadata.name)
                     want = deployment.spec.replicas or 1
-                    if deployment.status.ready_replicas != want:
-                        deployment.status.ready_replicas = want
-                        deployment.status.available_replicas = want
+                    if self.exec_proxies and self._proxy_command(deployment):
+                        ready = self._reconcile_proxy_process(deployment)
+                    else:
+                        ready = want
+                    if deployment.status.ready_replicas != ready:
+                        deployment.status.ready_replicas = ready
+                        deployment.status.available_replicas = ready
                         try:
                             client.update_status(deployment)
                         except ApiError:
                             pass
+                for name in [n for n in self._proxy_procs if n not in seen]:
+                    self._kill_proxy_process(name)
             except Exception:
                 logger.exception("deployment controller iteration failed")
             self._stop.wait(self.poll_s)
+        for name in list(self._proxy_procs):
+            self._kill_proxy_process(name)
+
+    # -- proxy-daemon process management (exec_proxies mode) -------------------
+
+    @staticmethod
+    def _proxy_command(deployment) -> "list[str] | None":
+        try:
+            container = deployment.spec.template["spec"]["containers"][0]
+            command = container.get("command") or []
+        except (KeyError, IndexError, TypeError):
+            return None
+        if command and os.path.basename(command[0]) == "tpu-runtime-proxy":
+            return command
+        return None
+
+    @staticmethod
+    def _proxy_env(deployment) -> "dict[str, str]":
+        container = deployment.spec.template["spec"]["containers"][0]
+        return {e["name"]: e["value"] for e in container.get("env", [])}
+
+    def _reconcile_proxy_process(self, deployment) -> int:
+        """Ensure the daemon process backing this Deployment runs; return the
+        ready replica count (1 only once its socket answers a ping)."""
+        import subprocess
+        import sys
+
+        name = deployment.metadata.name
+        proc = self._proxy_procs.get(name)
+        if proc is None or proc.poll() is not None:
+            env = dict(os.environ)
+            env.update(self._proxy_env(deployment))
+            root = env.get("TPU_PROXY_ROOT", "")
+            # Daemon stderr lands next to its socket — the pod-log analog.
+            log = (
+                open(os.path.join(root, "daemon.log"), "ab")
+                if root and os.path.isdir(root)
+                else subprocess.DEVNULL
+            )
+            try:
+                self._proxy_procs[name] = subprocess.Popen(
+                    [sys.executable, "-m", "tpu_dra.cmds.runtime_proxy"],
+                    env=env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=log,
+                )
+            finally:
+                if log is not subprocess.DEVNULL:
+                    log.close()
+            return 0
+        env = self._proxy_env(deployment)
+        socket_path = env.get("TPU_PROXY_SOCKET") or os.path.join(
+            env.get("TPU_PROXY_ROOT", ""), "proxy.sock"
+        )
+        try:
+            from tpu_dra.proxy.client import ProxyClient
+
+            with ProxyClient(socket_path, timeout=1.0) as probe:
+                probe.ping()
+            return 1
+        except Exception:
+            return 0
+
+    def _kill_proxy_process(self, name: str) -> None:
+        proc = self._proxy_procs.pop(name, None)
+        if proc is None:
+            return
+        try:
+            proc.terminate()
+            proc.wait(timeout=5)
+        except Exception:
+            try:
+                proc.kill()
+                proc.wait(timeout=5)
+            except Exception:
+                logger.warning("proxy process for %s did not exit", name)
 
     def _ensure_claims(self, pod: Pod) -> "list[ResourceClaim]":
         """Claim-template controller: instantiate template claims."""
