@@ -9,7 +9,11 @@ from tpu_dra.api import nas_v1alpha1 as nascrd
 from tpu_dra.api.meta import ObjectMeta
 from tpu_dra.api.tpu_v1alpha1 import GangConfig
 from tpu_dra.client import ClientSet, FakeApiServer
-from tpu_dra.controller.gang_tracker import GangFullError, GangTracker
+from tpu_dra.controller.gang_tracker import (
+    GangConfigError,
+    GangFullError,
+    GangTracker,
+)
 
 NS = "tpu-dra"
 
@@ -90,6 +94,105 @@ class TestRankAssignment:
         b = tracker.assign(gang, "ns-b", "uid-b", "n1")
         assert a.rank == b.rank == 0  # distinct gangs
         assert a.coordinator != b.coordinator
+
+
+class TestAdvisorRegressions:
+    """Round-1 advisor findings on the tracker (ADVICE.md items 1-2)."""
+
+    def test_size_shrink_is_clean_error_not_stopiteration(self, cs):
+        # Older committed members occupy ranks beyond a shrunken gang.size;
+        # the scan must raise GangConfigError, never StopIteration.
+        tracker = GangTracker(cs, NS)
+        big = GangConfig(name="g", size=4)
+        for i in range(3):
+            a = tracker.assign(big, "default", f"uid-{i}", "n0")
+            commit_to_nas(cs, "n0", f"uid-{i}", a)
+            tracker.commit(f"uid-{i}")
+        small = GangConfig(name="g", size=2)
+        with pytest.raises(GangConfigError, match="disagrees"):
+            tracker.assign(small, "default", "uid-new", "n1")
+
+    def test_size_zero_rejected(self, cs):
+        tracker = GangTracker(cs, NS)
+        with pytest.raises(GangConfigError, match="size must be"):
+            tracker.assign(GangConfig(name="g", size=0), "default", "u", "n0")
+
+    def test_coordinator_from_committed_rank0_not_first_seen(self, cs):
+        # First-seen member (rank 0, in-flight) fails its NAS write and is
+        # released; a member that committed against its tentative
+        # coordinator is repaired once the real rank 0 commits elsewhere.
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=2)
+        a0 = tracker.assign(gang, "default", "uid-a", "n0")
+        assert a0.rank == 0 and a0.coordinator == "n0:8476"
+        a1 = tracker.assign(gang, "default", "uid-b", "n1")
+        commit_to_nas(cs, "n1", "uid-b", a1)
+        tracker.commit("uid-b")
+        # uid-a's allocate failed: never committed.
+        tracker.release("uid-a")
+        # rank 0 reassigned on a different node.
+        a0b = tracker.assign(gang, "default", "uid-c", "n2")
+        assert a0b.rank == 0 and a0b.coordinator == "n2:8476"
+        commit_to_nas(cs, "n2", "uid-c", a0b)
+        tracker.commit("uid-c")
+        repaired = tracker.repair_coordinators("default", "g")
+        assert repaired == 1
+        nas = cs.node_allocation_states(NS).get("n1")
+        assert (
+            nas.spec.allocated_claims["uid-b"].tpu.gang.coordinator
+            == "n2:8476"
+        )
+
+    def test_repair_uses_published_node_address(self, cs):
+        # The coordinator must be a resolvable address when the plugin
+        # publishes one, not a bare node name (VERDICT weak #4).
+        client = cs.node_allocation_states(NS)
+        nas = client.create(
+            nascrd.NodeAllocationState(metadata=ObjectMeta(name="n0", namespace=NS))
+        )
+        nas.spec.node_address = "10.1.2.3"
+        client.update(nas)
+        tracker = GangTracker(cs, NS)
+        a = tracker.assign(GangConfig(name="g", size=2), "default", "u0", "n0")
+        assert a.coordinator == "10.1.2.3:8476"
+
+    def test_repair_noop_without_committed_rank0(self, cs):
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=2)
+        a1 = tracker.assign(gang, "default", "uid-b", "n1")
+        commit_to_nas(cs, "n1", "uid-b", a1)
+        tracker.commit("uid-b")
+        tracker.release("uid-a-never-committed")
+        assert tracker.repair_coordinators("default", "g") == 0
+
+
+class TestAudit:
+    def test_healthy_gang_no_warnings(self, cs):
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=2)
+        for i, node in enumerate(["n0", "n1"]):
+            a = tracker.assign(gang, "default", f"uid-{i}", node)
+            commit_to_nas(cs, node, f"uid-{i}", a)
+            tracker.commit(f"uid-{i}")
+        assert tracker.audit("default", "g") == []
+
+    def test_cross_domain_gang_warns(self, cs):
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=2)
+        client = cs.node_allocation_states(NS)
+        for i, (node, domain) in enumerate([("n0", "slice-a"), ("n1", "slice-b")]):
+            a = tracker.assign(gang, "default", f"uid-{i}", node)
+            commit_to_nas(cs, node, f"uid-{i}", a)
+            tracker.commit(f"uid-{i}")
+            nas = client.get(node)
+            nas.spec.allocatable_devices = [
+                nascrd.AllocatableDevice(
+                    tpu=nascrd.AllocatableTpu(uuid=f"c{i}", ici_domain=domain)
+                )
+            ]
+            client.update(nas)
+        warnings = tracker.audit("default", "g")
+        assert any("ICI domains" in w for w in warnings)
 
 
 class TestCrashRecovery:

@@ -99,6 +99,119 @@ class TestPrepare:
         assert "uid-1" in published.spec.prepared_claims  # still prepared
 
 
+class TestPrepareConcurrencyThroughDriver:
+    """The RPC entry point itself must not serialize prepares behind one
+    slow proxy daemon — the DeviceState-level fix is moot if the driver
+    lock still wraps the whole prepare (round-2 review finding)."""
+
+    def test_slow_proxy_does_not_block_other_claims_rpc(self, tmp_path, cs):
+        import threading
+        import time as _time
+
+        from helpers import make_plugin_stack as mps
+        from tpu_dra.api.nas_v1alpha1 import NodeAllocationState
+        from tpu_dra.api.sharing import SharingStrategy, TpuSharing
+
+        _, _, state = mps(tmp_path, cs, backoff_scale=0.2)
+        nas = NodeAllocationState(metadata=ObjectMeta(name=NODE, namespace=NS))
+        driver = NodeDriver(
+            nas, NasClient(nas, cs), state, error_backoff_s=0.05, start_gc=False
+        )
+        client = cs.node_allocation_states(NS)
+        fresh = client.get(NODE)
+        sharing = TpuSharing(strategy=SharingStrategy.RUNTIME_PROXY)
+        fresh.spec.allocated_claims["uid-slow"] = AllocatedDevices(
+            claim_info=ClaimInfo(namespace="default", name="slow", uid="uid-slow"),
+            tpu=AllocatedTpus(
+                devices=[AllocatedTpu(uuid="mock-tpu-0")], sharing=sharing
+            ),
+        )
+        fresh.spec.allocated_claims["uid-fast"] = AllocatedDevices(
+            claim_info=ClaimInfo(namespace="default", name="fast", uid="uid-fast"),
+            tpu=AllocatedTpus(devices=[AllocatedTpu(uuid="mock-tpu-1")]),
+        )
+        client.update(fresh)
+
+        errors = []
+
+        def slow():
+            try:
+                driver.node_prepare_resource("uid-slow")
+            except TimeoutError as e:
+                errors.append(e)
+
+        t = threading.Thread(target=slow)
+        t.start()
+        _time.sleep(0.3)  # slow claim is inside its readiness poll
+        start = _time.monotonic()
+        devices = driver.node_prepare_resource("uid-fast")
+        elapsed = _time.monotonic() - start
+        t.join(timeout=30)
+        assert devices == ["tpu.resource.google.com/claim=uid-fast"]
+        assert elapsed < 0.5, (
+            f"unrelated prepare RPC took {elapsed:.2f}s behind a slow proxy "
+            f"daemon — the driver lock is serializing prepares"
+        )
+        assert len(errors) == 1
+
+
+class TestGangEnvRefresh:
+    """Controller-side coordinator repairs must reach the claim's CDI spec
+    (round-2 review finding: NAS repair alone leaves containers with the
+    stale TPU_DRA_GANG_COORDINATOR)."""
+
+    def test_gc_pass_rewrites_cdi_after_coordinator_repair(self, tmp_path, cs):
+        import json as jsonlib
+        import os
+
+        from tpu_dra.api.nas_v1alpha1 import GangAssignment
+
+        driver, nas, state = make_driver(tmp_path, cs, start_gc=False)
+        client = cs.node_allocation_states(NS)
+        fresh = client.get(NODE)
+        fresh.spec.allocated_claims["uid-g"] = AllocatedDevices(
+            claim_info=ClaimInfo(namespace="default", name="g", uid="uid-g"),
+            tpu=AllocatedTpus(
+                devices=[AllocatedTpu(uuid="mock-tpu-0")],
+                gang=GangAssignment(
+                    name="ring", size=2, rank=1, coordinator="old-node:8476"
+                ),
+            ),
+        )
+        client.update(fresh)
+        driver.node_prepare_resource("uid-g")
+
+        def read_env():
+            path = os.path.join(
+                str(tmp_path),
+                "cdi",
+                "tpu.resource.google.com-claim_uid-g.json",
+            )
+            with open(path) as f:
+                spec = jsonlib.load(f)
+            return spec["devices"][0]["containerEdits"]["env"]
+
+        assert "TPU_DRA_GANG_COORDINATOR=old-node:8476" in read_env()
+
+        # Controller repairs the coordinator in the NAS...
+        fresh = client.get(NODE)
+        fresh.spec.allocated_claims["uid-g"].tpu.gang.coordinator = (
+            "10.0.0.9:8476"
+        )
+        client.update(fresh)
+        # ...and the plugin's GC pass re-materializes the CDI spec.
+        driver._client.get()
+        driver._cleanup_stale_state(nas)
+        env = read_env()
+        assert "TPU_DRA_GANG_COORDINATOR=10.0.0.9:8476" in env
+        assert "TPU_DRA_GANG_COORDINATOR=old-node:8476" not in env
+
+        # Unchanged contract: second pass is a no-op.
+        assert not state.refresh_claim_env(
+            "uid-g", fresh.spec.allocated_claims["uid-g"]
+        )
+
+
 class TestStaleStateGC:
     def wait_for(self, predicate, timeout=15.0):
         deadline = time.monotonic() + timeout

@@ -173,6 +173,13 @@ class TestAdmissionControl:
         with connect(config) as client:
             client.ping()
 
+    def test_malformed_request_gets_error_reply_not_disconnect(self, daemon):
+        _, config = daemon
+        with connect(config) as client:
+            with pytest.raises(ProxyError, match="bad request"):
+                client._call({"op": "attach", "core_percentage": "lots"})
+            client.ping()  # connection survives the bad request
+
     def test_double_attach_rejected(self, daemon):
         _, config = daemon
         with connect(config) as client:
@@ -234,13 +241,15 @@ class TestConfigContract:
                 "TPU_PROXY_SOCKET": "/run/p/proxy.sock",
                 "TPU_VISIBLE_DEVICES": "0,2",
                 "TPU_PROXY_ACTIVE_CORE_PERCENTAGE": "55",
-                "TPU_PROXY_HBM_LIMIT_mock_tpu_0": "4Gi",
+                # JSON limits env: chip UUIDs round-trip losslessly, even
+                # ones containing underscores.
+                "TPU_PROXY_HBM_LIMITS": '{"mock_tpu_0":"4Gi","b-1":1024}',
             }
         )
         assert cfg.socket_path == "/run/p/proxy.sock"
         assert cfg.visible_devices == [0, 2]
         assert cfg.max_active_core_percentage == 55
-        assert cfg.hbm_limits == {"mock-tpu-0": 4 * GIB}
+        assert cfg.hbm_limits == {"mock_tpu_0": 4 * GIB, "b-1": 1024}
 
     def test_env_root_prefers_config_file(self, tmp_path):
         config = make_config(tmp_path, name="claim-env")

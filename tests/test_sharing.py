@@ -116,7 +116,10 @@ class TestRuntimeProxy:
         }
         assert env["TPU_VISIBLE_DEVICES"] == "0,1"
         assert env["TPU_PROXY_ACTIVE_CORE_PERCENTAGE"] == "50"
-        assert env["TPU_PROXY_HBM_LIMIT_mock_tpu_0"] == "4Gi"
+        import json as jsonlib
+
+        limits = jsonlib.loads(env["TPU_PROXY_HBM_LIMITS"])
+        assert limits == {"mock-tpu-0": "4Gi", "mock-tpu-1": "4Gi"}
         assert deployment.spec.template["spec"]["nodeName"] == "node-1"
         assert os.path.isdir(os.path.dirname(daemon.socket_path))
 

@@ -70,6 +70,11 @@ class AllocatableTpu:
     # None/empty when discovery ran without it.
     pci_address: str = ""
     numa_node: int | None = None
+    # Absolute coordinate of this chip in the GLOBAL slice torus (host
+    # origin from TPU_WORKER_ID × host bounds plus the local coord).  None
+    # when the slice geometry is unknown — degraded mode publishes nothing
+    # rather than a guess.
+    slice_coord: Coord | None = None
 
 
 @dataclass
@@ -190,6 +195,13 @@ class NodeAllocationStateSpec:
     allocatable_devices: list[AllocatableDevice] = field(default_factory=list)
     allocated_claims: dict[str, AllocatedDevices] = field(default_factory=dict)
     prepared_claims: dict[str, PreparedDevices] = field(default_factory=dict)
+    # Cross-host slice facts published by the node plugin (SURVEY.md §2
+    # TPU-native equivalents: "publish the chip coordinates ... allocate
+    # ICI-contiguous blocks" must work across hosts, not just within one):
+    node_address: str = ""  # resolvable IP/DNS for this node ("" = unknown)
+    worker_id: int = 0  # this host's index within its slice
+    worker_count: int = 1  # hosts in the slice
+    slice_topology: str = ""  # global slice bounds "XxYxZ" ("" = unknown)
 
 
 @dataclass

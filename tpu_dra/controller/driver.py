@@ -133,6 +133,7 @@ class ControllerDriver:
                 name=claim.metadata.name,
                 uid=claim_uid,
             )
+            gang_name = None
             if (
                 isinstance(claim_params, tpucrd.TpuClaimParametersSpec)
                 and claim_params.gang is not None
@@ -144,10 +145,33 @@ class ControllerDriver:
                     claim_uid,
                     selected_node,
                 )
+                gang_name = claim_params.gang.name
             client.update(nas.spec)
             self.gangs.commit(claim_uid)
             on_success()
-            return build_allocation_result(selected_node, bool(class_params.shareable))
+        if gang_name is not None and self.gangs.take_repair_hint(
+            claim.metadata.namespace, gang_name
+        ):
+            # Outside the node lock (repair writes other nodes' NAS under
+            # their own locks): reconcile members committed against a
+            # tentative or since-moved rank-0 coordinator.  Best-effort:
+            # the allocation itself already committed, so a repair failure
+            # must not surface as an allocation failure — the hint fires
+            # again on the next assign, and the plugin-side refresh is
+            # level-triggered.
+            try:
+                self.gangs.repair_coordinators(
+                    claim.metadata.namespace, gang_name, node_lock=self.lock
+                )
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "gang %s coordinator repair failed (will retry on next "
+                    "member allocation)",
+                    gang_name,
+                )
+        return build_allocation_result(selected_node, bool(class_params.shareable))
 
     def deallocate(self, claim: ResourceClaim) -> None:
         # Drop any pending (uncommitted) allocation regardless of NAS state —
@@ -159,6 +183,7 @@ class ControllerDriver:
         selected_node = get_selected_node(claim)
         if not selected_node:
             return
+        gang = None
         with self.lock.locked(selected_node):
             nas, client = self._nas_client(selected_node)
             client.get()
@@ -166,6 +191,14 @@ class ControllerDriver:
             allocated = nas.spec.allocated_claims.get(claim_uid)
             if allocated is None:
                 return
+            if allocated.tpu is not None and allocated.tpu.gang is not None:
+                gang = (
+                    allocated.claim_info.namespace
+                    if allocated.claim_info
+                    else claim.metadata.namespace,
+                    allocated.tpu.gang.name,
+                    allocated.tpu.gang.rank,
+                )
             if allocated.type() == nascrd.TPU_DEVICE_TYPE:
                 self.tpu.deallocate(nas, claim)
             elif allocated.type() == nascrd.SUBSLICE_DEVICE_TYPE:
@@ -174,6 +207,24 @@ class ControllerDriver:
                 raise ValueError(f"unknown AllocatedDevices type: {allocated.type()}")
             del nas.spec.allocated_claims[claim_uid]
             client.update(nas.spec)
+        if gang is not None and gang[2] == 0:
+            # Rank 0 left: once a new rank-0 commits, members must converge
+            # on its coordinator; repair is a no-op until then (and again
+            # after the next gang allocate), but run it now to cover the
+            # rank-0-moved-while-members-remain window promptly.  Best-effort
+            # — deallocation already committed.
+            try:
+                self.gangs.repair_coordinators(
+                    gang[0], gang[1], node_lock=self.lock
+                )
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "gang %s coordinator repair after rank-0 deallocate "
+                    "failed",
+                    gang[1],
+                )
 
     # -- scheduling fan-out (driver.go:228-298) ------------------------------
 

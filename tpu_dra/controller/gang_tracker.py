@@ -15,21 +15,48 @@ serialization point:
   in memory under one lock so two concurrent allocations of the same gang
   cannot take the same rank.
 
-The first-ranked member's node becomes the coordinator ("<node>:<port>"),
-recorded on every member so late joiners agree without discovery.
+**Coordinator contract.** The coordinator is derived from the rank-0
+member: ``<address>:<port>`` where the address is the rank-0 node's
+published ``NAS.spec.node_address`` (a resolvable IP/DNS name, from the
+chart's downward-API NODE_IP env) falling back to the node name.  Ranks are
+assigned lowest-free-first, so a gang with no rank 0 hands rank 0 to the
+next joiner — an in-flight rank 0's coordinator is tentative until its NAS
+write commits, and :meth:`repair_coordinators` reconciles every committed
+member against the committed rank-0's address after rank-0 churn
+(reallocation onto a different node).
 """
 
 from __future__ import annotations
 
 import threading
+from dataclasses import dataclass, field
 
 from tpu_dra.api import nas_v1alpha1 as nascrd
 from tpu_dra.api import tpu_v1alpha1 as tpucrd
 from tpu_dra.client.clientset import ClientSet
+from tpu_dra.client.retry import retry_on_conflict
 
 
 class GangFullError(RuntimeError):
     pass
+
+
+class GangConfigError(ValueError):
+    """A member's gang config disagrees with the existing members'."""
+
+
+@dataclass
+class GangView:
+    """One scan of the gang's state across every NAS in the namespace."""
+
+    # claim uid -> persisted assignment
+    committed: dict[str, nascrd.GangAssignment] = field(default_factory=dict)
+    # claim uid -> node the assignment lives on
+    member_nodes: dict[str, str] = field(default_factory=dict)
+    # node -> published resolvable address ("" when the plugin didn't know)
+    addresses: dict[str, str] = field(default_factory=dict)
+    # node -> (worker_id, worker_count, slice_topology, ici domains)
+    host_facts: dict[str, tuple] = field(default_factory=dict)
 
 
 class GangTracker:
@@ -39,12 +66,29 @@ class GangTracker:
         self._lock = threading.Lock()
         # (claim_namespace, gang_name) -> {claim_uid: GangAssignment}
         self._in_flight: "dict[tuple[str, str], dict[str, nascrd.GangAssignment]]" = {}
+        # Gangs whose committed members may hold a stale coordinator —
+        # flagged during assign so callers repair only when needed rather
+        # than rescanning after every member allocation.
+        self._repair_needed: "set[tuple[str, str]]" = set()
 
-    def _committed(self, key: "tuple[str, str]") -> "dict[str, nascrd.GangAssignment]":
-        """Assignments already persisted in any NAS (all nodes)."""
+    def _scan(self, key: "tuple[str, str]") -> GangView:
+        """Gang state persisted in the NAS objects (all nodes)."""
         namespace, gang_name = key
-        out: "dict[str, nascrd.GangAssignment]" = {}
+        view = GangView()
         for nas in self._clientset.node_allocation_states(self._namespace).list():
+            node = nas.metadata.name
+            view.addresses[node] = nas.spec.node_address
+            domains = {
+                d.tpu.ici_domain
+                for d in nas.spec.allocatable_devices
+                if d.tpu is not None
+            }
+            view.host_facts[node] = (
+                nas.spec.worker_id,
+                nas.spec.worker_count,
+                nas.spec.slice_topology,
+                domains,
+            )
             for claim_uid, alloc in nas.spec.allocated_claims.items():
                 if alloc.tpu is None or alloc.tpu.gang is None:
                     continue
@@ -52,8 +96,14 @@ class GangTracker:
                 if alloc.tpu.gang.name == gang_name and (
                     info is None or info.namespace == namespace
                 ):
-                    out[claim_uid] = alloc.tpu.gang
-        return out
+                    view.committed[claim_uid] = alloc.tpu.gang
+                    view.member_nodes[claim_uid] = node
+        return view
+
+    @staticmethod
+    def _coordinator_for(view: GangView, node: str, port: int) -> str:
+        address = view.addresses.get(node) or node
+        return f"{address}:{port}"
 
     def assign(
         self,
@@ -63,31 +113,62 @@ class GangTracker:
         selected_node: str,
     ) -> nascrd.GangAssignment:
         """Rank for this member (idempotent per claim UID)."""
+        if gang.size < 1:
+            raise GangConfigError(f"gang {gang.name!r} size must be >= 1")
         key = (claim_namespace, gang.name)
         with self._lock:
-            committed = self._committed(key)
+            view = self._scan(key)
+            committed = view.committed
             if claim_uid in committed:
                 return committed[claim_uid]
             flight = self._in_flight.setdefault(key, {})
             if claim_uid in flight:
                 return flight[claim_uid]
 
+            # Every member must agree on the gang's geometry (ADVICE: a
+            # size change mid-gang would silently corrupt rank math).
+            existing = list(committed.values()) + list(flight.values())
+            for member in existing:
+                if member.size != gang.size:
+                    raise GangConfigError(
+                        f"gang {gang.name!r}: requested size {gang.size} "
+                        f"disagrees with existing members' size {member.size}"
+                    )
+
             used = {a.rank for a in committed.values()}
             used.update(
                 a.rank for uid, a in flight.items() if uid not in committed
             )
-            rank = next(r for r in range(gang.size + 1) if r not in used)
-            if rank >= gang.size:
+            # Bounded scan: ranks live in [0, size); a full gang is a clean
+            # error, never a StopIteration.
+            rank = next(
+                (r for r in range(gang.size) if r not in used), None
+            )
+            if rank is None:
                 raise GangFullError(
                     f"gang {gang.name!r} already has {gang.size} members"
                 )
-            coordinator = ""
-            for member in list(committed.values()) + list(flight.values()):
-                if member.coordinator:
-                    coordinator = member.coordinator
-                    break
-            if not coordinator:
-                coordinator = f"{selected_node}:{gang.port}"
+
+            if rank == 0:
+                # This member IS the coordinator.
+                coordinator = self._coordinator_for(
+                    view, selected_node, gang.port
+                )
+                if committed:
+                    # A late/reassigned rank 0 means earlier members
+                    # committed against a tentative coordinator.
+                    self._repair_needed.add(key)
+            else:
+                # Ranks are assigned lowest-free-first, so a rank-0 member
+                # exists — committed is authoritative, in-flight tentative
+                # (repair_coordinators reconciles if it never commits).
+                rank0 = next(
+                    (a for a in committed.values() if a.rank == 0), None
+                ) or next((a for a in flight.values() if a.rank == 0), None)
+                coordinator = rank0.coordinator if rank0 else ""
+
+            if len({a.coordinator for a in committed.values()}) > 1:
+                self._repair_needed.add(key)
             assignment = nascrd.GangAssignment(
                 name=gang.name,
                 size=gang.size,
@@ -96,6 +177,16 @@ class GangTracker:
             )
             flight[claim_uid] = assignment
             return assignment
+
+    def take_repair_hint(self, claim_namespace: str, gang_name: str) -> bool:
+        """True once per flagged gang: committed members may need their
+        coordinator reconciled (run repair_coordinators)."""
+        key = (claim_namespace, gang_name)
+        with self._lock:
+            if key in self._repair_needed:
+                self._repair_needed.discard(key)
+                return True
+            return False
 
     def release(self, claim_uid: str) -> None:
         """Drop any in-flight assignment (deallocation / failed allocate);
@@ -107,3 +198,110 @@ class GangTracker:
     def commit(self, claim_uid: str) -> None:
         """The assignment reached the NAS; the committed scan now covers it."""
         self.release(claim_uid)
+
+    # -- post-commit reconciliation ------------------------------------------
+
+    def repair_coordinators(
+        self, claim_namespace: str, gang_name: str, node_lock=None
+    ) -> int:
+        """Rewrite committed members whose coordinator disagrees with the
+        committed rank-0's address (rank-0 reallocation onto another node,
+        or members committed against a tentative rank-0 that never landed).
+        Returns the number of members repaired.
+
+        ``node_lock``: optional ``PerNodeMutex`` — when given, each node's
+        NAS rewrite happens under that node's lock (the controller's NAS
+        serialization convention)."""
+        from tpu_dra.client.nasclient import NasClient
+        from tpu_dra.api.meta import ObjectMeta
+
+        key = (claim_namespace, gang_name)
+        view = self._scan(key)
+        rank0_uid = next(
+            (uid for uid, a in view.committed.items() if a.rank == 0), None
+        )
+        if rank0_uid is None:
+            return 0  # rank 0 not committed yet; nothing authoritative
+        rank0 = view.committed[rank0_uid]
+        authoritative = self._coordinator_for(
+            view, view.member_nodes[rank0_uid], _port_of(rank0.coordinator)
+        )
+
+        stale_nodes = {
+            view.member_nodes[uid]
+            for uid, a in view.committed.items()
+            if a.coordinator != authoritative
+        }
+        repaired = 0
+        for node in sorted(stale_nodes):
+            def fix(node=node):
+                nonlocal repaired
+                nas = nascrd.NodeAllocationState(
+                    metadata=ObjectMeta(name=node, namespace=self._namespace)
+                )
+                client = NasClient(nas, self._clientset)
+                client.get()
+                changed = 0
+                for alloc in nas.spec.allocated_claims.values():
+                    if (
+                        alloc.tpu is not None
+                        and alloc.tpu.gang is not None
+                        and alloc.tpu.gang.name == gang_name
+                        and (
+                            alloc.claim_info is None
+                            or alloc.claim_info.namespace == claim_namespace
+                        )
+                        and alloc.tpu.gang.coordinator != authoritative
+                    ):
+                        alloc.tpu.gang.coordinator = authoritative
+                        changed += 1
+                if changed:
+                    client.update(nas.spec)
+                repaired += changed
+
+            if node_lock is not None:
+                with node_lock.locked(node):
+                    retry_on_conflict(fix)
+            else:
+                retry_on_conflict(fix)
+        return repaired
+
+    def audit(self, claim_namespace: str, gang_name: str) -> "list[str]":
+        """Cross-host ICI health of the committed gang.  Returns warnings:
+        a gang whose members span multiple ICI domains (different slices)
+        cannot ride ICI for its collectives; duplicate ranks indicate
+        corruption."""
+        view = self._scan((claim_namespace, gang_name))
+        warnings: "list[str]" = []
+        ranks: "dict[int, str]" = {}
+        for uid, a in view.committed.items():
+            if a.rank in ranks:
+                warnings.append(
+                    f"rank {a.rank} assigned to both {ranks[a.rank]} and {uid}"
+                )
+            ranks[a.rank] = uid
+        domains: "set[str]" = set()
+        for uid in view.committed:
+            node = view.member_nodes[uid]
+            facts = view.host_facts.get(node)
+            if facts:
+                domains.update(facts[3])
+        if len(domains) > 1:
+            warnings.append(
+                f"gang {gang_name!r} spans {len(domains)} ICI domains "
+                f"({sorted(domains)}): collectives will cross DCN, not ICI"
+            )
+        coords = {a.coordinator for a in view.committed.values()}
+        if len(coords) > 1:
+            warnings.append(
+                f"members disagree on coordinator: {sorted(coords)}"
+            )
+        return warnings
+
+
+def _port_of(coordinator: str, default: int = 8476) -> int:
+    _, _, port = coordinator.rpartition(":")
+    try:
+        return int(port)
+    except ValueError:
+        return default

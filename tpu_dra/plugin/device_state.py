@@ -46,6 +46,9 @@ class PreparedClaim:
     proxy_daemon: RuntimeProxyDaemon | None = None
     ready: threading.Event = field(default_factory=threading.Event)
     error: Exception | None = None
+    # Gang contract baked into the claim's CDI spec at write time; compared
+    # against the live allocation so coordinator repairs re-materialize.
+    gang: nascrd.GangAssignment | None = None
 
 
 class DeviceState:
@@ -91,6 +94,8 @@ class DeviceState:
                     )
 
                 entry = PreparedClaim(devices=devices)
+                if allocated.tpu is not None and allocated.tpu.gang is not None:
+                    entry.gang = serde.deepcopy(allocated.tpu.gang)
                 try:
                     # wait=False: daemon creation is quick API calls; the
                     # readiness poll happens below, outside the lock.
@@ -233,6 +238,36 @@ class DeviceState:
             raise
         return nascrd.PreparedDevices(subslice=prepared)
 
+    def refresh_claim_env(
+        self, claim_uid: str, allocated: nascrd.AllocatedDevices
+    ) -> bool:
+        """Re-materialize the claim's CDI spec when the allocation's gang
+        contract changed under it (the controller's coordinator repair,
+        gang_tracker.repair_coordinators, rewrites the NAS — containers not
+        yet started must pick up the new TPU_DRA_GANG_COORDINATOR).
+        Returns True when the spec file was rewritten."""
+
+        def key(g: "nascrd.GangAssignment | None"):
+            return (g.name, g.size, g.rank, g.coordinator) if g else None
+
+        with self._lock:
+            entry = self._prepared.get(claim_uid)
+            if entry is None or allocated.tpu is None:
+                return False
+            new_gang = allocated.tpu.gang
+            if key(new_gang) == key(entry.gang):
+                return False
+            extra = (
+                entry.proxy_daemon.get_cdi_edits()
+                if entry.proxy_daemon is not None
+                else None
+            )
+            self._cdi.create_claim_spec_file(
+                claim_uid, entry.devices, allocated, extra_edits=extra
+            )
+            entry.gang = serde.deepcopy(new_gang)
+            return True
+
     # -- CRD spec sync (device_state.go:365-532) -----------------------------
 
     def get_updated_spec(
@@ -246,6 +281,11 @@ class DeviceState:
 
     def _sync_allocatable_to_spec(self, spec: nascrd.NodeAllocationStateSpec) -> None:
         spec.allocatable_devices = serde.deepcopy(self._allocatable)
+        facts = self._tpulib.host_facts()
+        spec.node_address = facts.node_address
+        spec.worker_id = facts.worker_id
+        spec.worker_count = facts.worker_count
+        spec.slice_topology = facts.slice_topology
 
     def _sync_prepared_to_spec(self, spec: nascrd.NodeAllocationStateSpec) -> None:
         spec.prepared_claims = {
@@ -277,6 +317,8 @@ class DeviceState:
                 entry = PreparedClaim(
                     devices=self._prepare_tpus(allocated.tpu)
                 )
+                if allocated.tpu.gang is not None:
+                    entry.gang = serde.deepcopy(allocated.tpu.gang)
                 sharing = allocated.tpu.sharing if allocated.tpu else None
             elif devices.type() == nascrd.SUBSLICE_DEVICE_TYPE:
                 rebuilt = nascrd.PreparedSubslices()

@@ -87,10 +87,11 @@ class NodeDriver:
     def node_prepare_resource(self, claim_uid: str) -> list[str]:
         """Idempotent prepare; returns qualified CDI device names
         (driver.go:103-126)."""
-        with PREPARE_SECONDS.time(), self._lock:
-            is_prepared, devices = self._is_prepared(claim_uid)
-            if is_prepared:
-                return devices
+        with PREPARE_SECONDS.time():
+            with self._lock:
+                is_prepared, devices = self._is_prepared(claim_uid)
+                if is_prepared:
+                    return devices
             return self._prepare(claim_uid)
 
     def node_unprepare_resource(self, claim_uid: str) -> None:
@@ -104,10 +105,10 @@ class NodeDriver:
         return False, []
 
     def _prepare(self, claim_uid: str) -> list[str]:
-        result: list[str] = []
+        from tpu_dra.api import serde
 
-        def attempt():
-            nonlocal result
+        # Phase 1 (locked): read the allocation through the shared client.
+        with self._lock:
             self._client.get()
             allocated = self._nas.spec.allocated_claims.get(claim_uid)
             if allocated is None:
@@ -115,10 +116,23 @@ class NodeDriver:
                     f"claim {claim_uid} has no allocation on node "
                     f"{self._nas.metadata.name}"
                 )
-            result = self._state.prepare(claim_uid, allocated)
-            self._client.update(self._state.get_updated_spec(self._nas.spec))
+            allocated = serde.deepcopy(allocated)
 
-        retry_on_conflict(attempt)
+        # Phase 2 (UNLOCKED): actuation, including any proxy-daemon
+        # readiness wait — one slow daemon must not serialize unrelated
+        # claims' prepares behind the driver lock.  DeviceState has its own
+        # per-claim concurrency story.  If the claim is deallocated while we
+        # prepare, the NAS-watch GC unprepares it (deferred-unprepare
+        # semantics, driver.go:128-133).
+        result = self._state.prepare(claim_uid, allocated)
+
+        # Phase 3 (locked, conflict-retried): publish the prepared state.
+        def publish():
+            with self._lock:
+                self._client.get()
+                self._client.update(self._state.get_updated_spec(self._nas.spec))
+
+        retry_on_conflict(publish)
         return result
 
     def unprepare(self, claim_uid: str) -> None:
@@ -199,6 +213,23 @@ class NodeDriver:
                 except Exception:
                     logger.exception(
                         "error unpreparing resources for claim %s", claim_uid
+                    )
+                    errors += 1
+            else:
+                # Still allocated: pick up controller-side contract repairs
+                # (gang coordinator rewrites) into the claim's CDI spec.
+                try:
+                    if self._state.refresh_claim_env(
+                        claim_uid, nas.spec.allocated_claims[claim_uid]
+                    ):
+                        logger.info(
+                            "refreshed CDI spec for claim %s (gang contract "
+                            "changed)",
+                            claim_uid,
+                        )
+                except Exception:
+                    logger.exception(
+                        "error refreshing CDI spec for claim %s", claim_uid
                     )
                     errors += 1
         # Sweep orphaned CDI files (reference TODO at driver.go:345-350).

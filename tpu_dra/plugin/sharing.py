@@ -20,6 +20,7 @@ Two mechanisms, mirroring the reference's managers:
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import time
@@ -109,9 +110,17 @@ class RuntimeProxyDaemon:
                     "value": str(self._config.max_active_core_percentage),
                 }
             )
-        for uuid, limit in sorted(hbm_limits.items()):
+        if hbm_limits:
+            # One JSON env for per-chip limits — env names can't encode
+            # arbitrary chip UUIDs losslessly.
             env.append(
-                {"name": f"TPU_PROXY_HBM_LIMIT_{uuid.replace('-', '_')}", "value": str(limit)}
+                {
+                    "name": "TPU_PROXY_HBM_LIMITS",
+                    "value": json.dumps(
+                        {u: str(q) for u, q in sorted(hbm_limits.items())},
+                        separators=(",", ":"),
+                    ),
+                }
             )
         deployment = Deployment(
             metadata=ObjectMeta(

@@ -60,6 +60,43 @@ class SubsliceInfo:
     placement: Placement
 
 
+@dataclass
+class HostFacts:
+    """This host's position in the global slice — published to the NAS so
+    the controller can reason about cross-host ICI contiguity and record a
+    resolvable gang-coordinator address."""
+
+    node_address: str = ""  # resolvable IP/DNS ("" = unknown)
+    worker_id: int = 0
+    worker_count: int = 1
+    slice_topology: str = ""  # global bounds "XxYxZ" ("" = unknown)
+
+
+def slice_origin(
+    host_topo: Topology, slice_topo: Topology, worker_id: int
+) -> "Coord | None":
+    """The global coordinate of this host's (0,0,0) chip.
+
+    Hosts tile the slice torus in worker-id order, x-fastest (matching the
+    TPU VM runtime's TPU_WORKER_ID layout).  Returns None when the slice
+    bounds don't tile evenly by the host bounds — degraded mode publishes
+    no global coords rather than inventing them."""
+    if any(
+        s % h != 0
+        for s, h in zip(slice_topo.dims(), host_topo.dims())
+    ):
+        return None
+    gx = slice_topo.x // host_topo.x
+    gy = slice_topo.y // host_topo.y
+    gz = slice_topo.z // host_topo.z
+    if worker_id < 0 or worker_id >= gx * gy * gz:
+        return None
+    wx = worker_id % gx
+    wy = (worker_id // gx) % gy
+    wz = worker_id // (gx * gy)
+    return (wx * host_topo.x, wy * host_topo.y, wz * host_topo.z)
+
+
 class TpuLib(Protocol):
     """The device boundary (deviceLib analog, nvlib.go:32-36)."""
 
@@ -93,6 +130,10 @@ class TpuLib(Protocol):
     def library_paths(self) -> list[str]:
         """Host paths of libtpu.so and friends to mount into containers
         (find.go:28-61 analog)."""
+        ...
+
+    def host_facts(self) -> HostFacts:
+        """This host's slice-membership facts for NAS publishing."""
         ...
 
 
@@ -255,6 +296,10 @@ class MockTpuLib(_BaseTpuLib):
         state_dir: str = "/tmp/tpu-dra-mock",
         uuid_prefix: str = "mock-tpu",
         devfs_dir: "str | None" = None,
+        node_address: str = "",
+        worker_id: int = 0,
+        worker_count: int = 1,
+        slice_topology: "str | Topology | None" = None,
     ):
         # With devfs_dir set, the fake devnodes are real (empty) files there,
         # so processes that take ownership of them (the runtime-proxy daemon's
@@ -262,6 +307,28 @@ class MockTpuLib(_BaseTpuLib):
         if devfs_dir:
             os.makedirs(devfs_dir, exist_ok=True)
         topo = mesh if isinstance(mesh, Topology) else Topology.parse(mesh)
+        # Multi-host sim: the slice topology defaults to the host mesh
+        # (single-host slice); with worker facts set, chips carry global
+        # slice coords exactly like a real multi-host v5e pod.
+        if slice_topology is None:
+            slice_topo = topo if worker_count == 1 else None
+        elif isinstance(slice_topology, Topology):
+            slice_topo = slice_topology
+        else:
+            slice_topo = Topology.parse(slice_topology)
+        self._facts = HostFacts(
+            node_address=node_address,
+            worker_id=worker_id,
+            worker_count=worker_count,
+            slice_topology=(
+                f"{slice_topo.x}x{slice_topo.y}x{slice_topo.z}"
+                if slice_topo
+                else ""
+            ),
+        )
+        origin = (
+            slice_origin(topo, slice_topo, worker_id) if slice_topo else None
+        )
         chips = []
         for index, coord in enumerate(topo.coords_from((0, 0, 0))):
             if devfs_dir:
@@ -284,6 +351,15 @@ class MockTpuLib(_BaseTpuLib):
                         partitionable=partitionable,
                         libtpu_version="1.10.0",
                         runtime_version="2.0.0",
+                        slice_coord=(
+                            (
+                                origin[0] + coord[0],
+                                origin[1] + coord[1],
+                                origin[2] + coord[2],
+                            )
+                            if origin is not None
+                            else None
+                        ),
                     ),
                     device_paths=[devnode],
                 )
@@ -293,6 +369,9 @@ class MockTpuLib(_BaseTpuLib):
 
     def library_paths(self) -> list[str]:
         return [os.path.join(self._state_dir, "lib", "libtpu.so")]
+
+    def host_facts(self) -> HostFacts:
+        return self._facts
 
 
 # Known per-generation chip geometry for devfs-based discovery (the real
@@ -330,10 +409,67 @@ class RealTpuLib(_BaseTpuLib):
         devfs_root: str = "/dev",
         sysfs_root: str = "/sys",
     ):
+        self._facts = self._discover_host_facts()
         chips = self._discover(devfs_root, sysfs_root)
         super().__init__(
             chips, SubsliceRegistry(os.path.join(state_dir, "subslices.json"))
         )
+
+    # Known slice bounds per accelerator type (public v5e/v6e pod shapes);
+    # env TPU_SLICE_BOUNDS overrides.
+    _SLICE_BOUNDS = {
+        "v5litepod-4": (2, 2, 1),
+        "v5litepod-8": (4, 2, 1),
+        "v5litepod-16": (4, 4, 1),
+        "v5litepod-32": (8, 4, 1),
+        "v5litepod-64": (8, 8, 1),
+        "v5litepod-128": (16, 8, 1),
+        "v5litepod-256": (16, 16, 1),
+        "v6e-4": (2, 2, 1),
+        "v6e-8": (4, 2, 1),
+        "v6e-16": (4, 4, 1),
+        "v6e-32": (8, 4, 1),
+        "v6e-64": (8, 8, 1),
+        "v6e-128": (16, 8, 1),
+        "v6e-256": (16, 16, 1),
+    }
+
+    @classmethod
+    def _slice_topology(cls) -> "Topology | None":
+        bounds = os.environ.get("TPU_SLICE_BOUNDS", "")
+        if bounds:
+            try:
+                return Topology.parse(bounds.replace(",", "x"))
+            except ValueError:
+                return None
+        accel = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        dims = cls._SLICE_BOUNDS.get(accel)
+        return Topology(*dims) if dims else None
+
+    @classmethod
+    def _discover_host_facts(cls) -> HostFacts:
+        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        worker_count = len([h for h in hostnames.split(",") if h]) or 1
+        try:
+            worker_id = int(os.environ.get("TPU_WORKER_ID", "0"))
+        except ValueError:
+            worker_id = 0
+        slice_topo = cls._slice_topology()
+        return HostFacts(
+            node_address=os.environ.get(
+                "TPU_DRA_NODE_IP", os.environ.get("NODE_IP", "")
+            ),
+            worker_id=worker_id,
+            worker_count=worker_count,
+            slice_topology=(
+                f"{slice_topo.x}x{slice_topo.y}x{slice_topo.z}"
+                if slice_topo
+                else ""
+            ),
+        )
+
+    def host_facts(self) -> HostFacts:
+        return self._facts
 
     @staticmethod
     def _host_topology(count: int) -> Topology:
@@ -413,6 +549,12 @@ class RealTpuLib(_BaseTpuLib):
         coords: list[Coord] = list(topo.coords_from((0, 0, 0)))
         worker_id = os.environ.get("TPU_WORKER_ID", "0")
         ici_domain = os.environ.get("TPU_SLICE_NAME", f"host-{worker_id}")
+        slice_topo = self._slice_topology()
+        origin = (
+            slice_origin(topo, slice_topo, self._facts.worker_id)
+            if slice_topo
+            else None
+        )
         chips = []
         for index, entry in enumerate(scanned):
             coord = coords[index] if index < len(coords) else (index, 0, 0)
@@ -433,6 +575,15 @@ class RealTpuLib(_BaseTpuLib):
                         runtime_version=os.environ.get("TPU_RUNTIME_VERSION", ""),
                         pci_address=entry.get("pciAddress", ""),
                         numa_node=numa if numa is not None and numa >= 0 else None,
+                        slice_coord=(
+                            (
+                                origin[0] + coord[0],
+                                origin[1] + coord[1],
+                                origin[2] + coord[2],
+                            )
+                            if origin is not None
+                            else None
+                        ),
                     ),
                     device_paths=[entry["path"]],
                 )

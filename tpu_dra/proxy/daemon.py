@@ -125,10 +125,17 @@ class ProxyDaemonConfig:
         pct = env.get("TPU_PROXY_ACTIVE_CORE_PERCENTAGE")
         if pct:
             cfg.max_active_core_percentage = int(pct)
-        for key, value in env.items():
-            if key.startswith("TPU_PROXY_HBM_LIMIT_"):
-                uuid = key[len("TPU_PROXY_HBM_LIMIT_") :].replace("_", "-")
-                cfg.hbm_limits[uuid] = Quantity(value).to_int()
+        # One JSON env carries the per-chip limits: env NAMES can't encode
+        # arbitrary chip UUIDs (underscore-mangling wouldn't round-trip a
+        # UUID that itself contains '_').
+        limits = env.get("TPU_PROXY_HBM_LIMITS", "")
+        if limits:
+            for uuid, value in json.loads(limits).items():
+                cfg.hbm_limits[uuid] = (
+                    Quantity(value).to_int()
+                    if isinstance(value, str)
+                    else int(value)
+                )
         return cfg
 
 
@@ -365,7 +372,16 @@ class ProxyDaemon:
                             return
                         if msg is None:
                             return
-                        reply = daemon._handle(conn_id, msg)
+                        try:
+                            reply = daemon._handle(conn_id, msg)
+                        except Exception as e:
+                            # Malformed field values (bad quantity, wrong
+                            # arity) get the protocol's error reply, not a
+                            # dropped connection + stack trace.
+                            reply = {
+                                "ok": False,
+                                "error": f"bad request: {type(e).__name__}: {e}",
+                            }
                         if reply is None:
                             return
                         protocol.send_msg(self.connection, reply)

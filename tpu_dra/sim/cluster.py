@@ -41,16 +41,18 @@ class SimNode:
         namespace: str = "tpu-dra",
         devfs: bool = False,
         backoff_scale: float = 0.01,
+        tpulib_kwargs: "dict | None" = None,
     ):
         self.name = name
-        self.tpulib = MockTpuLib(
-            mesh,
+        kwargs = dict(
             partitionable=partitionable,
             state_dir=f"{state_root}/{name}/tpulib",
             ici_domain=name,
             uuid_prefix=f"{name}-chip",  # distinct chip UUIDs per node
             devfs_dir=f"{state_root}/{name}/devfs" if devfs else None,
         )
+        kwargs.update(tpulib_kwargs or {})
+        self.tpulib = MockTpuLib(mesh, **kwargs)
         self.cdi = CDIHandler(f"{state_root}/{name}/cdi", self.tpulib)
         self.state = DeviceState(
             self.tpulib,
@@ -100,15 +102,34 @@ class SimCluster:
         poll_s: float = 0.01,
         server=None,
         exec_proxies: bool = False,
+        multihost_slice: bool = False,
     ):
         # ``server`` lets chaos tests wrap the store (sim/faults.py).
         # ``exec_proxies`` makes KubeSim actually run tpu-runtime-proxy
         # Deployments as local daemon processes (with real devnode files to
         # own), instead of just flipping their readiness.
+        # ``multihost_slice`` makes all nodes workers of ONE slice: shared
+        # ICI domain, per-worker global coords (hosts tiled along x), and a
+        # loopback node_address so gang coordinators resolve in-process.
         self.server = server if server is not None else FakeApiServer()
         self.clientset = ClientSet(self.server)
         self.namespace = namespace
         self.poll_s = poll_s
+
+        def tpulib_kwargs(i: int) -> "dict":
+            if not multihost_slice:
+                return {}
+            from tpu_dra.api.topology import Topology
+
+            host = Topology.parse(mesh)
+            return {
+                "ici_domain": "slice-0",
+                "node_address": "127.0.0.1",
+                "worker_id": i,
+                "worker_count": nodes,
+                "slice_topology": Topology(host.x * nodes, host.y, host.z),
+            }
+
         self.nodes = [
             SimNode(
                 f"node-{i}",
@@ -122,6 +143,7 @@ class SimCluster:
                 # this image: sitecustomize pulls in jax) before the readiness
                 # ping lands; sim-only runs shrink the poll instead.
                 backoff_scale=0.6 if exec_proxies else 0.01,
+                tpulib_kwargs=tpulib_kwargs(i),
             )
             for i in range(nodes)
         ]
