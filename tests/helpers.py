@@ -80,7 +80,10 @@ def make_nas(
             )
     return NodeAllocationState(
         metadata=ObjectMeta(name=node, namespace=namespace),
-        spec=NodeAllocationStateSpec(allocatable_devices=devices),
+        spec=NodeAllocationStateSpec(
+            allocatable_devices=devices,
+            host_topology=f"{mesh[0]}x{mesh[1]}x1",
+        ),
         status="Ready",
     )
 

@@ -331,6 +331,96 @@ class TestTopologyClaim:
         assert "TPU_CHIPS_PER_HOST_BOUNDS=2,2,1" in env
 
 
+class TestImmediateMode:
+    """Immediate-mode allocation: the claim allocates on a suitable Ready
+    node at claim sync, before any pod exists.  The reference leaves this a
+    TODO (driver.go:111)."""
+
+    def wait_allocated(self, cluster, name, timeout=10.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            claim = cluster.clientset.resource_claims(NS).get(name)
+            if claim.status.allocation is not None:
+                return claim
+            time.sleep(0.05)
+        raise TimeoutError(f"claim {name} never allocated")
+
+    def create_immediate_claim(self, cluster, name, params_name):
+        from tpu_dra.api.k8s import ALLOCATION_MODE_IMMEDIATE
+
+        spec = claim_spec(params_name)
+        spec.allocation_mode = ALLOCATION_MODE_IMMEDIATE
+        cluster.clientset.resource_claims(NS).create(
+            ResourceClaim(
+                metadata=ObjectMeta(name=name, namespace=NS), spec=spec
+            )
+        )
+
+    def test_allocates_without_pod(self, cluster):
+        create_tpu_params(cluster, "imm-tpu", count=2)
+        self.create_immediate_claim(cluster, "imm-claim", "imm-tpu")
+        claim = self.wait_allocated(cluster, "imm-claim")
+        # Allocation landed in some node's NAS with devices reserved.
+        allocated_nodes = [
+            nas.metadata.name
+            for nas in cluster.clientset.node_allocation_states("tpu-dra").list()
+            if claim.metadata.uid in nas.spec.allocated_claims
+        ]
+        assert len(allocated_nodes) == 1
+        nas = cluster.clientset.node_allocation_states("tpu-dra").get(
+            allocated_nodes[0]
+        )
+        assert len(
+            nas.spec.allocated_claims[claim.metadata.uid].tpu.devices
+        ) == 2
+
+    def test_pod_consumes_immediate_claim(self, cluster):
+        create_tpu_params(cluster, "imm-tpu2", count=1)
+        self.create_immediate_claim(cluster, "imm-claim2", "imm-tpu2")
+        claim = self.wait_allocated(cluster, "imm-claim2")
+        cluster.clientset.pods(NS).create(
+            make_pod("imm-pod", [("tpu", {"resource_claim_name": "imm-claim2"})])
+        )
+        pod = cluster.wait_for_pod_running(NS, "imm-pod")
+        # The pod must land on the node the claim was pre-allocated to.
+        nas = cluster.clientset.node_allocation_states("tpu-dra").get(
+            pod.spec.node_name
+        )
+        assert claim.metadata.uid in nas.spec.allocated_claims
+
+    def test_deallocates_on_delete(self, cluster):
+        import time
+
+        create_tpu_params(cluster, "imm-tpu3", count=4)
+        self.create_immediate_claim(cluster, "imm-claim3", "imm-tpu3")
+        claim = self.wait_allocated(cluster, "imm-claim3")
+        cluster.clientset.resource_claims(NS).delete("imm-claim3")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            held = [
+                nas.metadata.name
+                for nas in cluster.clientset.node_allocation_states(
+                    "tpu-dra"
+                ).list()
+                if claim.metadata.uid in nas.spec.allocated_claims
+            ]
+            if not held:
+                break
+            time.sleep(0.05)
+        assert not held
+
+    def test_unsatisfiable_immediate_claim_stays_pending(self, cluster):
+        import time
+
+        create_tpu_params(cluster, "imm-huge", count=64)  # nodes have 4
+        self.create_immediate_claim(cluster, "imm-huge-claim", "imm-huge")
+        time.sleep(0.5)
+        claim = cluster.clientset.resource_claims(NS).get("imm-huge-claim")
+        assert claim.status.allocation is None
+
+
 class TestLifecycle:
     def test_delete_frees_chips(self, pcluster):
         cluster = pcluster

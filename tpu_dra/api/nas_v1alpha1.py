@@ -202,6 +202,9 @@ class NodeAllocationStateSpec:
     worker_id: int = 0  # this host's index within its slice
     worker_count: int = 1  # hosts in the slice
     slice_topology: str = ""  # global slice bounds "XxYxZ" ("" = unknown)
+    # This host's ICI bounds; "" = unknown (degraded): chip coords are
+    # arbitrary and the controller must not grant topology claims here.
+    host_topology: str = ""
 
 
 @dataclass

@@ -95,8 +95,71 @@ class ControllerDriver:
         selected_node: str,
     ) -> AllocationResult:
         if not selected_node:
-            raise NotImplementedError("immediate allocations not yet supported")
+            # Immediate mode: allocate on any suitable Ready node, no pod.
+            # The reference leaves this a TODO (driver.go:111); here the
+            # scheduling-phase suitability probe seeds the pending cache and
+            # the normal commit path promotes it.
+            return self._allocate_immediate(
+                claim, claim_params, resource_class, class_params
+            )
+        return self._allocate_on_node(
+            claim, claim_params, resource_class, class_params, selected_node
+        )
 
+    def _ready_nodes(self) -> list[str]:
+        nodes = []
+        for nas in self.clientset.node_allocation_states(self.namespace).list():
+            if nas.status == nascrd.STATUS_READY:
+                nodes.append(nas.metadata.name)
+        return sorted(nodes)
+
+    def _allocate_immediate(
+        self,
+        claim: ResourceClaim,
+        claim_params: Any,
+        resource_class: ResourceClass,
+        class_params: tpucrd.DeviceClassParametersSpec,
+    ) -> AllocationResult:
+        candidates = self._ready_nodes()
+        errors: list[str] = []
+        for node in candidates:
+            # Run the same placement pass the scheduler flow uses; a
+            # suitable node leaves a promotable pending-cache entry.
+            ca = ClaimAllocation(
+                claim=claim,
+                class_=resource_class,
+                claim_parameters=claim_params,
+            )
+            self._unsuitable_node(Pod(), [ca], node)
+            if node in ca.unsuitable_nodes:
+                errors.append(f"{node}: unsuitable")
+                continue
+            try:
+                return self._allocate_on_node(
+                    claim, claim_params, resource_class, class_params, node
+                )
+            except Exception as e:  # try the next candidate
+                self.tpu.pending_allocated_claims.remove_node(
+                    claim.metadata.uid, node
+                )
+                self.subslice.pending_allocated_claims.remove_node(
+                    claim.metadata.uid, node
+                )
+                errors.append(f"{node}: {e}")
+        raise RuntimeError(
+            f"immediate allocation of claim {claim.metadata.name!r} failed: "
+            f"no suitable node among {candidates or '[] (no Ready nodes)'}"
+            + (f" ({'; '.join(errors)})" if errors else "")
+        )
+
+    def _allocate_on_node(
+        self,
+        claim: ResourceClaim,
+        claim_params: Any,
+        resource_class: ResourceClass,
+        class_params: tpucrd.DeviceClassParametersSpec,
+        selected_node: str,
+    ) -> AllocationResult:
         with ALLOCATE_SECONDS.time(), self.lock.locked(selected_node):
             nas, client = self._nas_client(selected_node)
             client.get()

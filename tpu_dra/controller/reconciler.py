@@ -229,9 +229,8 @@ class Controller:
                 self._record_sync_failure(key, e)
                 self._retry(key)
             except NotImplementedError as e:
-                # Unsupported request (e.g. Immediate-mode allocation,
-                # driver.py) — terminal until the object changes; retrying
-                # would hot-loop forever on the same answer.
+                # Unsupported request — terminal until the object changes;
+                # retrying would hot-loop forever on the same answer.
                 outcome = "unsupported"
                 logger.warning("sync %s unsupported, not retrying: %s", key, e)
                 self._retries.pop(key, None)

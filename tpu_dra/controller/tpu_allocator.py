@@ -168,6 +168,13 @@ class TpuDriver:
             free_coords = {chip.coord: chip for chip in eligible.values()}
 
             if params.topology is not None:
+                if not crd.spec.host_topology:
+                    # Degraded node (tpulib published no ICI bounds): its
+                    # chip coords are arbitrary, so an ICI-contiguous block
+                    # granted here would be fiction.  Count claims remain
+                    # fine; topology claims are unsuitable.
+                    allocated[claim_uid] = ([], None)
+                    continue
                 placed = place_topology(
                     Topology.parse(params.topology), set(free_coords)
                 )

@@ -286,6 +286,7 @@ class DeviceState:
         spec.worker_id = facts.worker_id
         spec.worker_count = facts.worker_count
         spec.slice_topology = facts.slice_topology
+        spec.host_topology = facts.host_topology
 
     def _sync_prepared_to_spec(self, spec: nascrd.NodeAllocationStateSpec) -> None:
         spec.prepared_claims = {

@@ -1,0 +1,83 @@
+"""GCE metadata-server reader — silicon truth for RealTpuLib.
+
+The reference reads device attributes from NVML (nvlib.go:92-233); a TPU
+VM's equivalent source of truth is the GCE metadata server's TPU instance
+attributes.  Everything here degrades gracefully: a missing server, a
+missing attribute, or the ``TPU_DRA_DISABLE_METADATA`` kill-switch all
+yield None, and callers fall back to env vars or degraded mode.
+
+Attributes used (TPU-VM standard):
+
+- ``instance/attributes/accelerator-type``       — e.g. "v5litepod-16"
+- ``instance/attributes/agent-worker-number``    — this host's worker id
+- ``instance/attributes/worker-network-endpoints`` — one entry per worker,
+  ``<worker-id>:<uid>:<ip>`` comma-separated; yields worker count and this
+  host's resolvable address.
+
+The server address is env-overridable (``GCE_METADATA_HOST``) so tests run
+against a local fake endpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.error
+import urllib.request
+
+DEFAULT_HOST = "metadata.google.internal"
+ATTR_BASE = "instance/attributes"
+
+
+class GceMetadata:
+    def __init__(self, host: "str | None" = None, timeout: float = 1.0):
+        self._host = host or os.environ.get("GCE_METADATA_HOST", DEFAULT_HOST)
+        self._timeout = timeout
+        self._cache: "dict[str, str | None]" = {}
+        self._disabled = os.environ.get("TPU_DRA_DISABLE_METADATA", "") not in (
+            "",
+            "0",
+        )
+
+    def get(self, path: str) -> "str | None":
+        """One metadata value, or None when unreachable/absent (cached)."""
+        if self._disabled:
+            return None
+        if path in self._cache:
+            return self._cache[path]
+        url = f"http://{self._host}/computeMetadata/v1/{path}"
+        value: "str | None" = None
+        try:
+            req = urllib.request.Request(
+                url, headers={"Metadata-Flavor": "Google"}
+            )
+            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+                value = resp.read().decode().strip()
+        except (urllib.error.URLError, OSError, ValueError):
+            value = None
+        self._cache[path] = value
+        return value
+
+    # -- TPU attributes ------------------------------------------------------
+
+    def accelerator_type(self) -> "str | None":
+        return self.get(f"{ATTR_BASE}/accelerator-type")
+
+    def worker_id(self) -> "int | None":
+        value = self.get(f"{ATTR_BASE}/agent-worker-number")
+        try:
+            return int(value) if value is not None else None
+        except ValueError:
+            return None
+
+    def worker_endpoints(self) -> "list[str]":
+        """Per-worker resolvable addresses, indexed by worker id.  Entries
+        come as ``<worker-id>:<uid>:<ip>`` (the ip is the last field)."""
+        value = self.get(f"{ATTR_BASE}/worker-network-endpoints")
+        if not value:
+            return []
+        out = []
+        for entry in value.split(","):
+            entry = entry.strip()
+            if entry:
+                out.append(entry.rsplit(":", 1)[-1])
+        return out

@@ -70,6 +70,9 @@ class HostFacts:
     worker_id: int = 0
     worker_count: int = 1
     slice_topology: str = ""  # global bounds "XxYxZ" ("" = unknown)
+    # This host's ICI bounds "XxYxZ"; "" = unknown (degraded mode: the
+    # allocator must not grant topology claims on such a node).
+    host_topology: str = ""
 
 
 def slice_origin(
@@ -325,6 +328,7 @@ class MockTpuLib(_BaseTpuLib):
                 if slice_topo
                 else ""
             ),
+            host_topology=f"{topo.x}x{topo.y}x{topo.z}",
         )
         origin = (
             slice_origin(topo, slice_topo, worker_id) if slice_topo else None
@@ -408,7 +412,11 @@ class RealTpuLib(_BaseTpuLib):
         state_dir: str = "/var/run/tpu-dra",
         devfs_root: str = "/dev",
         sysfs_root: str = "/sys",
+        metadata=None,
     ):
+        from tpu_dra.plugin.metadata import GceMetadata
+
+        self._metadata = metadata if metadata is not None else GceMetadata()
         self._facts = self._discover_host_facts()
         chips = self._discover(devfs_root, sysfs_root)
         super().__init__(
@@ -434,31 +442,50 @@ class RealTpuLib(_BaseTpuLib):
         "v6e-256": (16, 16, 1),
     }
 
-    @classmethod
-    def _slice_topology(cls) -> "Topology | None":
+    def _accelerator_type(self) -> str:
+        """env override first, then the metadata server (silicon truth)."""
+        return (
+            os.environ.get("TPU_ACCELERATOR_TYPE", "")
+            or self._metadata.accelerator_type()
+            or ""
+        )
+
+    def _slice_topology(self) -> "Topology | None":
         bounds = os.environ.get("TPU_SLICE_BOUNDS", "")
         if bounds:
             try:
                 return Topology.parse(bounds.replace(",", "x"))
             except ValueError:
                 return None
-        accel = os.environ.get("TPU_ACCELERATOR_TYPE", "")
-        dims = cls._SLICE_BOUNDS.get(accel)
+        dims = self._SLICE_BOUNDS.get(self._accelerator_type())
         return Topology(*dims) if dims else None
 
-    @classmethod
-    def _discover_host_facts(cls) -> HostFacts:
+    # Known per-host chip arrangements in multi-host pods (v5e/v6e hosts
+    # carry 1/2/4 chips; a 4-chip host is a 2x2 ICI square).
+    _CHIPS_PER_HOST_BOUNDS = {1: (1, 1, 1), 2: (2, 1, 1), 4: (2, 2, 1)}
+
+    def _discover_host_facts(self) -> HostFacts:
         hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
-        worker_count = len([h for h in hostnames.split(",") if h]) or 1
+        worker_count = len([h for h in hostnames.split(",") if h])
+        if not worker_count:
+            worker_count = len(self._metadata.worker_endpoints()) or 1
+        worker_id_env = os.environ.get("TPU_WORKER_ID", "")
         try:
-            worker_id = int(os.environ.get("TPU_WORKER_ID", "0"))
+            worker_id = int(worker_id_env) if worker_id_env else None
         except ValueError:
-            worker_id = 0
-        slice_topo = cls._slice_topology()
+            worker_id = None
+        if worker_id is None:
+            worker_id = self._metadata.worker_id() or 0
+        node_address = os.environ.get(
+            "TPU_DRA_NODE_IP", os.environ.get("NODE_IP", "")
+        )
+        if not node_address:
+            endpoints = self._metadata.worker_endpoints()
+            if 0 <= worker_id < len(endpoints):
+                node_address = endpoints[worker_id]
+        slice_topo = self._slice_topology()
         return HostFacts(
-            node_address=os.environ.get(
-                "TPU_DRA_NODE_IP", os.environ.get("NODE_IP", "")
-            ),
+            node_address=node_address,
             worker_id=worker_id,
             worker_count=worker_count,
             slice_topology=(
@@ -466,13 +493,18 @@ class RealTpuLib(_BaseTpuLib):
                 if slice_topo
                 else ""
             ),
+            # host_topology is resolved during _discover (needs chip count);
+            # "" until then, and stays "" in degraded mode.
         )
 
     def host_facts(self) -> HostFacts:
         return self._facts
 
-    @staticmethod
-    def _host_topology(count: int) -> Topology:
+    def _host_topology(self, count: int) -> "Topology | None":
+        """This host's ICI bounds, from explicit or metadata-derived truth
+        ONLY — returns None (degraded mode) rather than guessing.  A wrong
+        guess poisons placement and the CDI bounds env; an unknown topology
+        just makes the node ineligible for topology claims."""
         bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
         if bounds:
             try:
@@ -483,16 +515,23 @@ class RealTpuLib(_BaseTpuLib):
                     return Topology(*parts)
             except ValueError:
                 pass
-        # Fall back to the squarest 2D arrangement of `count` chips.
-        best = (1, count)
-        for x in range(1, count + 1):
-            if count % x == 0 and abs(x - count // x) < abs(best[0] - best[1]):
-                best = (x, count // x)
-        return Topology(best[0], best[1], 1)
+        # Derive from the slice geometry: chips-per-host = slice size /
+        # worker count, with the known per-host arrangements.
+        slice_topo = self._slice_topology()
+        if slice_topo is not None:
+            workers = self._facts.worker_count
+            if workers == 1:
+                return slice_topo  # single host IS the slice
+            if slice_topo.size % workers == 0:
+                dims = self._CHIPS_PER_HOST_BOUNDS.get(
+                    slice_topo.size // workers
+                )
+                if dims:
+                    return Topology(*dims)
+        return None
 
-    @staticmethod
-    def _generation() -> str:
-        accel = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    def _generation(self) -> str:
+        accel = self._accelerator_type()
         m = re.match(r"(v\d+[a-z]*)", accel.replace("litepod", "e"))
         if m:
             return m.group(1)
@@ -545,25 +584,42 @@ class RealTpuLib(_BaseTpuLib):
         if native_bounds:
             topo = Topology(*native_bounds)
         else:
-            topo = self._host_topology(max(len(scanned), 1))
-        coords: list[Coord] = list(topo.coords_from((0, 0, 0)))
-        worker_id = os.environ.get("TPU_WORKER_ID", "0")
+            topo = self._host_topology(len(scanned))
+        if topo is not None and topo.size != len(scanned):
+            # The claimed bounds disagree with silicon: distrust them.
+            topo = None
+        if topo is not None:
+            coords: list[Coord] = list(topo.coords_from((0, 0, 0)))
+            self._facts.host_topology = f"{topo.x}x{topo.y}x{topo.z}"
+        else:
+            # Degraded mode: coordinates are an arbitrary (but unique)
+            # chain and NO topology is published — the controller must not
+            # grant topology claims against invented geometry.
+            coords = [(i, 0, 0) for i in range(len(scanned))]
+        worker_id = str(self._facts.worker_id)
         ici_domain = os.environ.get("TPU_SLICE_NAME", f"host-{worker_id}")
         slice_topo = self._slice_topology()
         origin = (
             slice_origin(topo, slice_topo, self._facts.worker_id)
-            if slice_topo
+            if (topo is not None and slice_topo is not None)
             else None
         )
         chips = []
         for index, entry in enumerate(scanned):
             coord = coords[index] if index < len(coords) else (index, 0, 0)
             numa = entry.get("numaNode", -1)
+            # Stable identity: the PCI address survives renumbering across
+            # reboots (the NVML-UUID analog); positional ids only when the
+            # scan ran without sysfs correlation.
+            pci = entry.get("pciAddress", "")
+            uuid = (
+                f"tpu-{pci}" if pci else f"tpu-{worker_id}-{index}"
+            )
             chips.append(
                 TpuChipInfo(
                     tpu=AllocatableTpu(
                         index=index,
-                        uuid=f"tpu-{worker_id}-{index}",
+                        uuid=uuid,
                         coord=coord,
                         ici_domain=ici_domain,
                         cores=spec["cores"],
