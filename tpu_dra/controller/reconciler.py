@@ -36,6 +36,7 @@ from tpu_dra.api.k8s import (
     ResourceClaim,
     ResourceClaimConsumerReference,
     ResourceClaimSchedulingStatus,
+    get_selected_node,
 )
 from tpu_dra.client.apiserver import ApiError, ConflictError, NotFoundError
 from tpu_dra.client.clientset import ClientSet
@@ -228,12 +229,6 @@ class Controller:
                 logger.warning("sync %s failed: %s", key, e)
                 self._record_sync_failure(key, e)
                 self._retry(key)
-            except NotImplementedError as e:
-                # Unsupported request — terminal until the object changes;
-                # retrying would hot-loop forever on the same answer.
-                outcome = "unsupported"
-                logger.warning("sync %s unsupported, not retrying: %s", key, e)
-                self._retries.pop(key, None)
             except Exception as e:
                 outcome = "error"
                 logger.exception("sync %s failed", key)
@@ -371,8 +366,11 @@ class Controller:
         if selected_user is not None:
             claim.status.reserved_for.append(selected_user)
         claims_client.update_status(claim)
+        # Immediate mode arrives with selected_node="" — report the node the
+        # driver actually chose (recorded in the allocation's node selector).
         self.recorder.eventf(
-            claim, TYPE_NORMAL, "Allocated", "allocated on node %s", selected_node
+            claim, TYPE_NORMAL, "Allocated", "allocated on node %s",
+            selected_node or get_selected_node(claim),
         )
 
     # -- pod scheduling negotiation (controller.go:568-735) ------------------
