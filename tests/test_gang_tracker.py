@@ -166,6 +166,65 @@ class TestAdvisorRegressions:
         assert tracker.repair_coordinators("default", "g") == 0
 
 
+class TestCommitTimeConsistency:
+    """Round-3 ADVICE leftover (VERDICT r3 weak #4): the interleaving that
+    assign-time checks can't see.  A member takes its coordinator from a
+    tentative (in-flight) rank 0; that rank 0 dies and is released; a
+    replacement rank 0 is assigned while the member's NAS write is in
+    flight.  Whichever commits last must flag the gang so the driver's
+    take_repair_hint -> repair_coordinators pass converges immediately —
+    previously the split-brain persisted until the next assign/deallocate."""
+
+    def _tentative_rank0_dies(self, cs):
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=2)
+        a0 = tracker.assign(gang, "default", "uid-r0", "n0")
+        assert a0.rank == 0
+        # Member takes the tentative coordinator while rank 0 is in flight.
+        a1 = tracker.assign(gang, "default", "uid-m", "n1")
+        assert a1.coordinator == "n0:8476"
+        # Tentative rank 0's allocate fails; replacement assigned elsewhere.
+        tracker.release("uid-r0")
+        a0b = tracker.assign(gang, "default", "uid-r0b", "n2")
+        assert a0b.rank == 0 and a0b.coordinator == "n2:8476"
+        return tracker, gang, a1, a0b
+
+    def test_member_commits_last(self, cs):
+        tracker, gang, a1, a0b = self._tentative_rank0_dies(cs)
+        commit_to_nas(cs, "n2", "uid-r0b", a0b)
+        tracker.commit("uid-r0b", "default", "g")
+        # No divergence visible yet (only rank 0 committed): no hint.
+        assert not tracker.take_repair_hint("default", "g")
+        commit_to_nas(cs, "n1", "uid-m", a1)
+        tracker.commit("uid-m", "default", "g")
+        assert tracker.take_repair_hint("default", "g")
+        assert tracker.repair_coordinators("default", "g") == 1
+        nas = cs.node_allocation_states(NS).get("n1")
+        assert (
+            nas.spec.allocated_claims["uid-m"].tpu.gang.coordinator
+            == "n2:8476"
+        )
+
+    def test_replacement_rank0_commits_last(self, cs):
+        tracker, gang, a1, a0b = self._tentative_rank0_dies(cs)
+        commit_to_nas(cs, "n1", "uid-m", a1)
+        tracker.commit("uid-m", "default", "g")
+        commit_to_nas(cs, "n2", "uid-r0b", a0b)
+        tracker.commit("uid-r0b", "default", "g")
+        assert tracker.take_repair_hint("default", "g")
+        assert tracker.repair_coordinators("default", "g") == 1
+        assert tracker.audit("default", "g") == []
+
+    def test_consistent_gang_raises_no_hint(self, cs):
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=2)
+        for i, node in enumerate(["n0", "n1"]):
+            a = tracker.assign(gang, "default", f"uid-{i}", node)
+            commit_to_nas(cs, node, f"uid-{i}", a)
+            tracker.commit(f"uid-{i}", "default", "g")
+            assert not tracker.take_repair_hint("default", "g")
+
+
 class TestAudit:
     def test_healthy_gang_no_warnings(self, cs):
         tracker = GangTracker(cs, NS)

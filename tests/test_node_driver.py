@@ -69,6 +69,28 @@ class TestStartup:
         assert after.status == "Ready"
 
 
+class TestLegacyUuidMigration:
+    def test_startup_migrates_preexisting_allocations(self, tmp_path, cs):
+        # A NAS written by an old driver holds positional chip UUIDs; the
+        # upgraded driver's startup sync must rewrite them so prepare works
+        # and the controller's availability math keys on live identities.
+        nas0 = NodeAllocationState(metadata=ObjectMeta(name=NODE, namespace=NS))
+        nas0.spec.allocated_claims["uid-old"] = AllocatedDevices(
+            claim_info=ClaimInfo(namespace="default", name="old", uid="uid-old"),
+            tpu=AllocatedTpus(devices=[AllocatedTpu(uuid="tpu-0-0")]),
+        )
+        cs.node_allocation_states(NS).create(nas0)
+        driver, _, _ = make_driver(tmp_path, cs)
+        published = cs.node_allocation_states(NS).get(NODE)
+        assert [
+            d.uuid
+            for d in published.spec.allocated_claims["uid-old"].tpu.devices
+        ] == ["mock-tpu-0"]
+        # And prepare of the migrated claim succeeds end to end.
+        devices = driver.node_prepare_resource("uid-old")
+        assert devices == ["tpu.resource.google.com/claim=uid-old"]
+
+
 class TestPrepare:
     def test_prepare_flow(self, tmp_path, cs):
         driver, _, _ = make_driver(tmp_path, cs)

@@ -83,6 +83,31 @@ class TestDaemonBasics:
         assert not os.path.exists(os.path.join(root, READY_FILE))
         d.stop()  # idempotent
 
+    def test_stop_joins_serve_loop_before_closing(self, tmp_path):
+        # Round-2 ADVICE regression: stop() used to spawn the shutdown()
+        # helper and call server_close() immediately — closing the listening
+        # fd under a live serve_forever select raises EBADF in the serve
+        # thread.  After stop() returns, the serve loop must have exited.
+        config = make_config(tmp_path, name="claim-join")
+        d = ProxyDaemon(config)
+        d.start()
+        assert d._serve_thread is not None and d._serve_thread.is_alive()
+        d.stop()
+        assert not d._serve_thread.is_alive()
+
+    def test_stop_from_watcher_thread_completes(self, tmp_path):
+        # stop() fired from the socket watcher (not the main thread) must
+        # still fully tear down without deadlocking on the serve loop.
+        config = make_config(tmp_path, name="claim-watch")
+        d = ProxyDaemon(config)
+        d.start()
+        os.unlink(config.socket_path)  # watcher notices and calls stop()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and d._serve_thread.is_alive():
+            time.sleep(0.05)
+        assert d._stopped.is_set()
+        assert not d._serve_thread.is_alive()
+
     def test_missing_devnodes_are_reported_not_fatal(self, tmp_path):
         config = make_config(tmp_path, name="claim-miss")
         config.device_paths["chip-0"] = [str(tmp_path / "claim-miss" / "nope")]

@@ -210,7 +210,9 @@ class ControllerDriver:
                 )
                 gang_name = claim_params.gang.name
             client.update(nas.spec)
-            self.gangs.commit(claim_uid)
+            self.gangs.commit(
+                claim_uid, claim.metadata.namespace, gang_name
+            )
             on_success()
         if gang_name is not None and self.gangs.take_repair_hint(
             claim.metadata.namespace, gang_name

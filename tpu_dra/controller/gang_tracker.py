@@ -195,9 +195,51 @@ class GangTracker:
             for flight in self._in_flight.values():
                 flight.pop(claim_uid, None)
 
-    def commit(self, claim_uid: str) -> None:
-        """The assignment reached the NAS; the committed scan now covers it."""
+    def commit(
+        self,
+        claim_uid: str,
+        claim_namespace: "str | None" = None,
+        gang_name: "str | None" = None,
+    ) -> None:
+        """The assignment reached the NAS; the committed scan now covers it.
+
+        With the gang key supplied, also verify the *committed* members'
+        coordinator consistency and flag the gang for repair on mismatch.
+        This closes the interleaving assign-time checks can't see: a member
+        takes its coordinator from a tentative (in-flight) rank 0, that
+        rank 0 dies and is released, a replacement rank 0 is assigned while
+        the member's NAS write is still in flight — at the replacement's
+        assign time nothing is committed yet, so only a post-commit scan
+        observes the divergence.  Every member's NAS write funnels through
+        here, so whichever of the two commits last raises the flag and the
+        caller's take_repair_hint → repair_coordinators pass converges the
+        gang immediately rather than waiting for the next assign or
+        deallocate."""
         self.release(claim_uid)
+        if claim_namespace is None or gang_name is None:
+            return
+        key = (claim_namespace, gang_name)
+        with self._lock:
+            view = self._scan(key)
+            rank0_uid = next(
+                (uid for uid, a in view.committed.items() if a.rank == 0), None
+            )
+            if rank0_uid is not None:
+                authoritative = self._coordinator_for(
+                    view,
+                    view.member_nodes[rank0_uid],
+                    _port_of(view.committed[rank0_uid].coordinator),
+                )
+                if any(
+                    a.coordinator != authoritative
+                    for a in view.committed.values()
+                ):
+                    self._repair_needed.add(key)
+            elif len({a.coordinator for a in view.committed.values()}) > 1:
+                # No committed rank 0 yet: repair has nothing authoritative
+                # to converge on, but remember the divergence so the hint
+                # fires once rank 0 lands.
+                self._repair_needed.add(key)
 
     # -- post-commit reconciliation ------------------------------------------
 

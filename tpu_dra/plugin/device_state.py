@@ -68,7 +68,26 @@ class DeviceState:
         self._chips = {
             d.tpu.uuid: d.tpu for d in self._allocatable if d.type() == "tpu"
         }
+        # Legacy-UUID aliases: drivers before the PCI-stable identity scheme
+        # published positional ``tpu-{worker}-{index}`` UUIDs
+        # (tpulib.py RealTpuLib._discover fallback).  Allocations written by
+        # such a driver survive an upgrade in the NAS; resolving the legacy
+        # name onto today's chip keeps their prepare/adopt paths working, and
+        # migrate_legacy_uuids rewrites them at startup sync so the
+        # controller's availability math never sees a stale identity.
+        worker_id = tpulib.host_facts().worker_id
+        self._chip_aliases: dict[str, str] = {}
+        for chip in self._chips.values():
+            legacy = f"tpu-{worker_id}-{chip.index}"
+            if legacy not in self._chips:
+                self._chip_aliases[legacy] = chip.uuid
         self._prepared: dict[str, PreparedClaim] = {}
+
+    def _resolve_chip_uuid(self, uuid: str) -> str:
+        """Canonical UUID for a possibly-legacy chip name."""
+        if uuid in self._chips:
+            return uuid
+        return self._chip_aliases.get(uuid, uuid)
 
     @property
     def cdi(self) -> CDIHandler:
@@ -193,7 +212,7 @@ class DeviceState:
     def _prepare_tpus(self, allocated: nascrd.AllocatedTpus) -> nascrd.PreparedDevices:
         prepared = nascrd.PreparedTpus()
         for device in allocated.devices:
-            chip = self._chips.get(device.uuid)
+            chip = self._chips.get(self._resolve_chip_uuid(device.uuid))
             if chip is None:
                 raise ValueError(f"allocated TPU does not exist: {device.uuid}")
             prepared.devices.append(
@@ -216,12 +235,13 @@ class DeviceState:
         created: list[str] = []
         try:
             for device in allocated.devices:
-                if device.parent_uuid not in self._chips:
+                parent_uuid = self._resolve_chip_uuid(device.parent_uuid)
+                if parent_uuid not in self._chips:
                     raise ValueError(
                         f"allocated parent TPU does not exist: {device.parent_uuid}"
                     )
                 info = self._tpulib.create_subslice(
-                    device.parent_uuid, device.profile, device.placement
+                    parent_uuid, device.profile, device.placement
                 )
                 created.append(info.uuid)
                 prepared.devices.append(
@@ -269,6 +289,40 @@ class DeviceState:
             return True
 
     # -- CRD spec sync (device_state.go:365-532) -----------------------------
+
+    def migrate_legacy_uuids(self, spec: nascrd.NodeAllocationStateSpec) -> bool:
+        """Rewrite legacy positional chip UUIDs (``tpu-{worker}-{index}``)
+        in the NAS's allocated + prepared claims to today's canonical
+        (PCI-stable) identities.  Runs at startup sync so a driver upgrade
+        that changes the identity scheme never strands pre-existing
+        allocations: without this, prepare fails with "allocated TPU does
+        not exist" and the controller's availability math (allocatable −
+        allocated, keyed by UUID) double-counts the legacy-named chips.
+        Returns True when anything was rewritten (callers republish)."""
+        changed = False
+
+        def fix(uuid: str) -> str:
+            nonlocal changed
+            canonical = self._resolve_chip_uuid(uuid)
+            if canonical != uuid:
+                changed = True
+            return canonical
+
+        for alloc in spec.allocated_claims.values():
+            if alloc.tpu is not None:
+                for dev in alloc.tpu.devices:
+                    dev.uuid = fix(dev.uuid)
+            if alloc.subslice is not None:
+                for dev in alloc.subslice.devices:
+                    dev.parent_uuid = fix(dev.parent_uuid)
+        for devices in spec.prepared_claims.values():
+            if devices.tpu is not None:
+                for dev in devices.tpu.devices:
+                    dev.uuid = fix(dev.uuid)
+            if devices.subslice is not None:
+                for dev in devices.subslice.devices:
+                    dev.parent_uuid = fix(dev.parent_uuid)
+        return changed
 
     def get_updated_spec(
         self, inspec: nascrd.NodeAllocationStateSpec

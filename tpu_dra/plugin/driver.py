@@ -59,6 +59,14 @@ class NodeDriver:
         def startup():
             self._client.get_or_create()
             self._client.update_status(nascrd.STATUS_NOT_READY)
+            # Upgrade path: rewrite legacy positional chip UUIDs to today's
+            # identities BEFORE recovery reads the spec, so adoption matches
+            # and the republish below persists canonical names.
+            if state.migrate_legacy_uuids(self._nas.spec):
+                logger.info(
+                    "migrated legacy chip UUIDs in NAS %s",
+                    self._nas.metadata.name,
+                )
             state.sync_prepared_from_crd_spec(self._nas.spec)
             self._client.update(state.get_updated_spec(self._nas.spec))
             self._client.update_status(nascrd.STATUS_READY)

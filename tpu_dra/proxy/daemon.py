@@ -162,6 +162,7 @@ class ProxyDaemon:
         self._devnode_fds: list[int] = []
         self._missing_devnodes: list[str] = []
         self._server: socketserver.ThreadingUnixStreamServer | None = None
+        self._serve_thread: threading.Thread | None = None
         self._stopped = threading.Event()
 
     # -- devnode ownership ---------------------------------------------------
@@ -400,12 +401,12 @@ class ProxyDaemon:
         finally:
             if dirfd is not None:
                 os.close(dirfd)
-        thread = threading.Thread(
+        self._serve_thread = threading.Thread(
             target=self._server.serve_forever,
             kwargs={"poll_interval": 0.05},
             daemon=True,
         )
-        thread.start()
+        self._serve_thread.start()
         # Self-check: if the per-claim dir (or the socket file) is removed
         # out from under us — the node plugin rolled back or unprepared the
         # claim — exit so the supervisor doesn't keep a stale daemon whose
@@ -435,9 +436,14 @@ class ProxyDaemon:
             return
         self._stopped.set()
         if self._server is not None:
-            # shutdown() joins serve_forever; from a handler thread that
-            # would deadlock, so do it from a helper.
-            threading.Thread(target=self._server.shutdown, daemon=True).start()
+            # shutdown() blocks until serve_forever's loop exits.  Run it
+            # from a helper thread (stop() can be invoked from a handler or
+            # watcher thread) but JOIN the helper before server_close():
+            # closing the listening fd while serve_forever is still inside
+            # its select raises EBADF in the serve thread.
+            helper = threading.Thread(target=self._server.shutdown, daemon=True)
+            helper.start()
+            helper.join(timeout=5.0)
             self._server.server_close()
         for name in (READY_FILE,):
             try:
