@@ -17,7 +17,7 @@ def test_bench_emits_one_json_line(monkeypatch):
 
     monkeypatch.setattr(bench, "SAMPLES", 2)
     monkeypatch.setattr(
-        bench, "bench_burnin_forward", lambda: {"platform": "skipped", "tokens_per_s": 0.0, "ok": True}
+        bench, "bench_compute", lambda: {"platform": "skipped", "mfu": 0.0, "ok": True}
     )
     import io
     from contextlib import redirect_stdout

@@ -1,0 +1,132 @@
+"""MFU bench: generation table, analytic FLOPs, chip-sized configs, and the
+CPU-rung measurement path (the real-chip numbers land in BENCH_r04.json)."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from tpu_dra.parallel.burnin import BurninConfig, init_params
+from tpu_dra.parallel.mfu import (
+    CHIP_PERF,
+    chip_perf_for,
+    chip_sized_config,
+    measure_hbm_bandwidth,
+    measure_mfu,
+    param_count,
+    train_flops_per_step,
+)
+
+
+def fake_device(platform="tpu", kind="TPU v5 lite"):
+    return SimpleNamespace(platform=platform, device_kind=kind)
+
+
+class TestChipPerf:
+    @pytest.mark.parametrize(
+        "kind,gen",
+        [
+            ("TPU v5 lite", "v5e"),
+            ("TPU v5p", "v5p"),
+            ("TPU v5", "v5p"),
+            ("TPU v4", "v4"),
+            ("TPU v6 lite", "v6e"),
+            ("TPU v3", "v3"),
+        ],
+    )
+    def test_device_kind_mapping(self, kind, gen):
+        perf = chip_perf_for(fake_device(kind=kind))
+        assert perf is not None and perf.generation == gen
+
+    def test_cpu_has_no_peak(self):
+        assert chip_perf_for(fake_device(platform="cpu", kind="cpu")) is None
+
+    def test_unknown_tpu_kind(self):
+        assert chip_perf_for(fake_device(kind="TPU v99")) is None
+
+    def test_peaks_are_published_specs(self):
+        assert CHIP_PERF["v5e"].bf16_tflops == 197.0
+        assert CHIP_PERF["v5e"].hbm_gib == 16
+        assert CHIP_PERF["v5e"].hbm_gbps == 819
+
+
+class TestAnalyticAccounting:
+    def test_param_count_matches_init_params(self):
+        import jax
+
+        c = BurninConfig()
+        leaves = jax.tree_util.tree_leaves(init_params(c))
+        assert param_count(c) == sum(leaf.size for leaf in leaves)
+
+    def test_param_count_matches_chip_sized(self):
+        import jax
+
+        c = chip_sized_config(16)
+        # Count without materializing half a billion floats.
+        shapes = jax.eval_shape(lambda: init_params(c))
+        total = sum(
+            leaf.size for leaf in jax.tree_util.tree_leaves(shapes)
+        )
+        assert param_count(c) == total
+
+    def test_flops_tracks_6n_tokens_rule(self):
+        # For a chip-sized config, matmul params dominate and the analytic
+        # count must land near 6*N*tokens (within the attention + embedding
+        # correction — embed params do 2 matmuls' worth at tied logits but
+        # none at lookup).
+        c = chip_sized_config(16)
+        tokens = c.batch * c.seq
+        approx = 6.0 * param_count(c) * tokens
+        exact = train_flops_per_step(c)
+        assert 0.5 * approx < exact < 1.5 * approx
+
+    def test_flops_scale_linearly_in_layers(self):
+        base = BurninConfig(n_layers=2)
+        double = BurninConfig(n_layers=4)
+        per_layer = (
+            train_flops_per_step(double) - train_flops_per_step(base)
+        ) / 2
+        assert per_layer > 0
+        # Adding two more layers adds exactly 2x the per-layer cost.
+        triple = BurninConfig(n_layers=6)
+        assert train_flops_per_step(triple) == pytest.approx(
+            train_flops_per_step(base) + 4 * per_layer
+        )
+
+
+class TestChipSizedConfig:
+    def test_ladder_monotone_in_hbm(self):
+        sizes = [
+            param_count(chip_sized_config(h)) for h in (8, 16, 32, 95)
+        ]
+        assert sizes == sorted(sizes)
+        assert sizes[0] < sizes[1]  # tiny < v5e
+
+    def test_v5e_config_is_chip_scale(self):
+        c = chip_sized_config(16)
+        assert c.d_model >= 2048 and c.seq >= 1024
+        n = param_count(c)
+        # fp32 params + momentum must fit 16 GiB with room for activations.
+        assert 8 * n < 8 * (1 << 30)
+        assert n > 100e6  # a real model, not a toy
+
+    def test_configs_shape_valid(self):
+        for h in (8, 16, 32, 95):
+            c = chip_sized_config(h)
+            assert c.d_model % c.n_heads == 0
+
+
+class TestMeasurement:
+    def test_measure_mfu_cpu_rung(self):
+        r = measure_mfu(BurninConfig(), warmup_steps=1, timed_steps=2)
+        assert r.ok, r.error
+        assert r.platform == "cpu"
+        assert r.generation == "" and r.peak_tflops == 0 and r.mfu == 0
+        assert r.achieved_tflops > 0
+        assert r.flops_per_step == train_flops_per_step(BurninConfig())
+        assert r.loss_last < r.loss_first
+
+    def test_measure_hbm_cpu_rung(self):
+        r = measure_hbm_bandwidth(array_bytes=8 << 20, iters=2)
+        assert r.ok, r.error
+        assert r.gbps > 0
+        assert r.peak_gbps == 0 and r.fraction_of_peak == 0

@@ -70,6 +70,11 @@ class GangTracker:
         # flagged during assign so callers repair only when needed rather
         # than rescanning after every member allocation.
         self._repair_needed: "set[tuple[str, str]]" = set()
+        # Gangs where a coordinator was handed out that wasn't backed by a
+        # COMMITTED rank 0 (a tentative rank 0's own address, or a member
+        # coordinator taken from an in-flight rank 0).  Only these need the
+        # post-commit consistency scan; healthy steady-state commits skip it.
+        self._tentative_coord: "set[tuple[str, str]]" = set()
 
     def _scan(self, key: "tuple[str, str]") -> GangView:
         """Gang state persisted in the NAS objects (all nodes)."""
@@ -150,10 +155,12 @@ class GangTracker:
                 )
 
             if rank == 0:
-                # This member IS the coordinator.
+                # This member IS the coordinator — tentative until its own
+                # NAS write commits.
                 coordinator = self._coordinator_for(
                     view, selected_node, gang.port
                 )
+                self._tentative_coord.add(key)
                 if committed:
                     # A late/reassigned rank 0 means earlier members
                     # committed against a tentative coordinator.
@@ -164,7 +171,12 @@ class GangTracker:
                 # (repair_coordinators reconciles if it never commits).
                 rank0 = next(
                     (a for a in committed.values() if a.rank == 0), None
-                ) or next((a for a in flight.values() if a.rank == 0), None)
+                )
+                if rank0 is None:
+                    rank0 = next(
+                        (a for a in flight.values() if a.rank == 0), None
+                    )
+                    self._tentative_coord.add(key)
                 coordinator = rank0.coordinator if rank0 else ""
 
             if len({a.coordinator for a in committed.values()}) > 1:
@@ -220,6 +232,11 @@ class GangTracker:
             return
         key = (claim_namespace, gang_name)
         with self._lock:
+            # Scan only gangs that ever handed out a coordinator not backed
+            # by a committed rank 0 — the healthy steady-state commit (rank 0
+            # long since committed) skips the extra apiserver LIST entirely.
+            if key not in self._tentative_coord:
+                return
             view = self._scan(key)
             rank0_uid = next(
                 (uid for uid, a in view.committed.items() if a.rank == 0), None
@@ -235,6 +252,12 @@ class GangTracker:
                     for a in view.committed.values()
                 ):
                     self._repair_needed.add(key)
+                if not self._in_flight.get(key):
+                    # Rank 0 committed and nothing is in flight: any member
+                    # that matters is visible to this scan, so the gang no
+                    # longer needs commit-time checks (divergence found above
+                    # is already flagged for repair).
+                    self._tentative_coord.discard(key)
             elif len({a.coordinator for a in view.committed.values()}) > 1:
                 # No committed rank 0 yet: repair has nothing authoritative
                 # to converge on, but remember the divergence so the hint

@@ -1,0 +1,293 @@
+"""Chip-sized MFU measurement: how much of the silicon the burn-in LM uses.
+
+The reference's perf story ends at device visibility (``nvidia-smi -L``,
+reference README.md:75-117); earlier rounds here ended at "the chip executes"
+— a tiny default burn-in whose tokens/s measured dispatch overhead, not the
+chip.  This module makes the compute claim real:
+
+- ``chip_sized_config``  — size the burn-in LM to the chip's HBM (params +
+  momentum + remat activations), read off the generation spec table.
+- ``train_flops_per_step`` — analytic model-FLOPs per training step
+  (matmul-exact forward count x3 for backward, the standard MFU convention;
+  rematerialization's recompute is deliberately NOT counted — MFU measures
+  useful work, so remat shows up as lost utilization, giving a conservative
+  number).
+- ``measure_mfu``        — steady-state step timing (warmup discarded, one
+  device sync around the timed window) -> achieved TFLOP/s and MFU vs the
+  generation's published bf16 peak.
+- ``measure_hbm_bandwidth`` — a saxpy-shaped probe (2 reads + 1 write per
+  element) timed over a large array: the single-chip HBM figure that bounds
+  every memory-bound op the driver's claims feed.
+
+Peak numbers are the published per-chip specs (bf16 dense, no sparsity):
+v4 275 TFLOP/s / 32 GiB / 1228 GB/s; v5e 197 / 16 / 819;
+v5p 459 / 95 / 2765; v6e 918 / 32 / 1640.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpu_dra.parallel.burnin import BurninConfig
+
+GIB = 1024**3
+
+
+@dataclass(frozen=True)
+class ChipPerf:
+    """Published single-chip peaks for one TPU generation."""
+
+    generation: str
+    bf16_tflops: float  # dense bf16 peak, TFLOP/s
+    hbm_gib: float
+    hbm_gbps: float  # HBM bandwidth peak, GB/s
+
+
+CHIP_PERF = {
+    "v2": ChipPerf("v2", 22.5, 8, 300),
+    "v3": ChipPerf("v3", 61.5, 16, 450),
+    "v4": ChipPerf("v4", 275.0, 32, 1228),
+    "v5e": ChipPerf("v5e", 197.0, 16, 819),
+    "v5p": ChipPerf("v5p", 459.0, 95, 2765),
+    "v6e": ChipPerf("v6e", 918.0, 32, 1640),
+}
+
+# jax device_kind substrings -> generation key (checked in order: the more
+# specific pattern first, so "v5 lite" wins over "v5").
+_KIND_PATTERNS = [
+    ("v6 lite", "v6e"),
+    ("v6e", "v6e"),
+    ("v5 lite", "v5e"),
+    ("v5e", "v5e"),
+    ("v5p", "v5p"),
+    ("v5", "v5p"),
+    ("v4", "v4"),
+    ("v3", "v3"),
+    ("v2", "v2"),
+]
+
+
+def chip_perf_for(device) -> "ChipPerf | None":
+    """Generation spec for a jax device; None off-TPU (no meaningful peak)."""
+    if getattr(device, "platform", "") != "tpu":
+        return None
+    kind = getattr(device, "device_kind", "").lower()
+    for pattern, gen in _KIND_PATTERNS:
+        if pattern in kind:
+            return CHIP_PERF[gen]
+    return None
+
+
+def chip_sized_config(hbm_gib: float) -> BurninConfig:
+    """A burn-in LM sized so fp32 params + momentum + remat activations +
+    the logits buffer fill a healthy fraction of the chip's HBM while step
+    time stays sub-second at reasonable MFU.  The ladder is by HBM class,
+    not exact bytes — static shapes keep XLA's tiling happy."""
+    if hbm_gib >= 90:  # v5p
+        return BurninConfig(
+            vocab=32768, d_model=4096, n_heads=32, d_ff=16384,
+            n_layers=16, seq=2048, batch=16,
+        )
+    if hbm_gib >= 30:  # v4 / v6e
+        return BurninConfig(
+            vocab=32768, d_model=4096, n_heads=32, d_ff=16384,
+            n_layers=8, seq=1024, batch=16,
+        )
+    if hbm_gib >= 14:  # v5e / v3
+        return BurninConfig(
+            vocab=32768, d_model=2048, n_heads=16, d_ff=8192,
+            n_layers=8, seq=1024, batch=8,
+        )
+    return BurninConfig(
+        vocab=8192, d_model=1024, n_heads=8, d_ff=4096,
+        n_layers=4, seq=512, batch=4,
+    )
+
+
+def param_count(c: BurninConfig) -> int:
+    """Exact parameter count of the burn-in LM (init_params layout)."""
+    per_layer = (
+        c.d_model * 3 * c.d_model  # wqkv
+        + c.d_model * c.d_model    # wo
+        + c.d_model * c.d_ff       # w1
+        + c.d_ff * c.d_model       # w2
+        + 2 * c.d_model            # ln1, ln2
+    )
+    return (
+        c.vocab * c.d_model        # embed (tied with the logits projection)
+        + c.seq * c.d_model        # pos
+        + c.n_layers * per_layer
+        + c.d_model                # ln_f
+    )
+
+
+def train_flops_per_step(c: BurninConfig) -> float:
+    """Analytic model-FLOPs per training step: exact matmul count for the
+    forward pass (2 FLOPs per MAC), x3 for forward+backward.  Matches the
+    6*N*tokens rule plus the attention term 12*L*s*d per token."""
+    b, s, d, f, L, v = c.batch, c.seq, c.d_model, c.d_ff, c.n_layers, c.vocab
+    per_layer_fwd = (
+        2 * b * s * d * (3 * d)  # qkv projection
+        + 2 * b * s * s * d      # q @ k^T (all heads: s*s*d_head per head)
+        + 2 * b * s * s * d      # probs @ v
+        + 2 * b * s * d * d      # output projection
+        + 2 * b * s * d * f      # mlp in
+        + 2 * b * s * f * d      # mlp out
+    )
+    fwd = L * per_layer_fwd + 2 * b * s * d * v  # + tied logits projection
+    return 3.0 * fwd
+
+
+@dataclass
+class MfuReport:
+    """Steady-state compute utilization of one training step on this host's
+    accelerator."""
+
+    ok: bool
+    platform: str = ""
+    device_kind: str = ""
+    generation: str = ""
+    params: int = 0
+    tokens_per_step: int = 0
+    flops_per_step: float = 0.0
+    step_seconds: float = 0.0
+    achieved_tflops: float = 0.0
+    peak_tflops: float = 0.0
+    mfu: float = 0.0  # 0 when no published peak (e.g. CPU)
+    tokens_per_second: float = 0.0
+    loss_first: float = 0.0
+    loss_last: float = 0.0
+    error: str = ""
+
+
+def measure_mfu(
+    config: "BurninConfig | None" = None,
+    *,
+    warmup_steps: int = 2,
+    timed_steps: int = 8,
+) -> MfuReport:
+    """Time the jitted training step in steady state and report MFU.
+
+    Unlike burnin.train (which fetches the loss synchronously every step to
+    assert learning), the timed window here keeps the device pipeline full:
+    steps are enqueued back-to-back and only the final step's loss is
+    fetched, so the measurement sees compute, not dispatch."""
+    import time
+
+    import jax
+
+    from tpu_dra.parallel.burnin import make_train_step, sample_tokens
+
+    try:
+        dev = jax.devices()[0]
+        perf = chip_perf_for(dev)
+        if config is None:
+            config = (
+                chip_sized_config(perf.hbm_gib)
+                if perf is not None
+                else BurninConfig()
+            )
+        c = config
+        step_fn, state = make_train_step(c, mesh=None)
+        tokens = sample_tokens(c)
+
+        # Warmup, then sync by FETCHING a value: device_get of a scalar
+        # cannot return before the step produced it, which block_until_ready
+        # has been observed to do on tunneled PJRT backends (axon).
+        for _ in range(max(1, warmup_steps)):
+            state, loss = step_fn(state, tokens)
+        loss_first = float(jax.device_get(loss))
+
+        t0 = time.perf_counter()
+        for _ in range(timed_steps):
+            state, loss = step_fn(state, tokens)
+        # The steps form a dependency chain through `state`, so fetching the
+        # last loss bounds all timed steps (bar the final elementwise param
+        # update — noise at these step times).
+        loss_last = float(jax.device_get(loss))
+        elapsed = time.perf_counter() - t0
+
+        step_s = elapsed / timed_steps
+        flops = train_flops_per_step(c)
+        achieved = flops / step_s / 1e12
+        peak = perf.bf16_tflops if perf is not None else 0.0
+        return MfuReport(
+            ok=loss_last < loss_first
+            and loss_first == loss_first
+            and loss_last == loss_last,  # NaN check
+            platform=dev.platform,
+            device_kind=getattr(dev, "device_kind", ""),
+            generation=perf.generation if perf is not None else "",
+            params=param_count(c),
+            tokens_per_step=c.batch * c.seq,
+            flops_per_step=flops,
+            step_seconds=step_s,
+            achieved_tflops=achieved,
+            peak_tflops=peak,
+            mfu=achieved / peak if peak > 0 else 0.0,
+            tokens_per_second=c.batch * c.seq / step_s,
+            loss_first=loss_first,
+            loss_last=loss_last,
+        )
+    except Exception as e:  # bench must emit its line without a chip
+        return MfuReport(ok=False, error=f"{type(e).__name__}: {e}")
+
+
+@dataclass
+class HbmReport:
+    """Single-chip HBM bandwidth probe result."""
+
+    ok: bool
+    gbps: float = 0.0
+    peak_gbps: float = 0.0
+    fraction_of_peak: float = 0.0
+    array_mib: float = 0.0
+    error: str = ""
+
+
+def measure_hbm_bandwidth(
+    *, array_bytes: "int | None" = None, iters: int = 10
+) -> HbmReport:
+    """saxpy probe: y = a*x + y over a large fp32 array.  3 HBM transfers
+    per element (read x, read y, write y) — purely bandwidth-bound at this
+    size, so achieved GB/s ~ the streaming HBM rate."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        dev = jax.devices()[0]
+        perf = chip_perf_for(dev)
+        if array_bytes is None:
+            # A quarter of HBM leaves room for the double buffer; tiny on CPU.
+            array_bytes = (
+                int(perf.hbm_gib * GIB // 8) if perf is not None else 64 << 20
+            )
+        n = array_bytes // 4  # fp32
+        x = jnp.ones((n,), jnp.float32)
+        y = jnp.zeros((n,), jnp.float32)
+
+        @jax.jit
+        def saxpy(x, y):
+            return 1.000001 * x + y
+
+        y = saxpy(x, y)  # compile + warm
+        float(jax.device_get(y[0]))  # value fetch: a sync that really waits
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y = saxpy(x, y)
+        float(jax.device_get(y[0]))
+        elapsed = time.perf_counter() - t0
+        bytes_moved = 3 * n * 4 * iters
+        gbps = bytes_moved / elapsed / 1e9
+        peak = perf.hbm_gbps if perf is not None else 0.0
+        return HbmReport(
+            ok=True,
+            gbps=gbps,
+            peak_gbps=peak,
+            fraction_of_peak=gbps / peak if peak > 0 else 0.0,
+            array_mib=n * 4 / (1 << 20),
+        )
+    except Exception as e:
+        return HbmReport(ok=False, error=f"{type(e).__name__}: {e}")

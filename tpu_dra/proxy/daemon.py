@@ -444,7 +444,15 @@ class ProxyDaemon:
             helper = threading.Thread(target=self._server.shutdown, daemon=True)
             helper.start()
             helper.join(timeout=5.0)
-            self._server.server_close()
+            if helper.is_alive():
+                # serve_forever didn't exit in time: leak the listening fd
+                # rather than close it under a live select (EBADF in the
+                # serve thread — the race this join exists to prevent).
+                logger.warning(
+                    "serve loop did not exit within 5s; leaving listener open"
+                )
+            else:
+                self._server.server_close()
         for name in (READY_FILE,):
             try:
                 os.unlink(os.path.join(self._root, name))
