@@ -28,7 +28,9 @@ CHART_DIR = os.path.join(REPO_ROOT, "deployments", "helm", "tpu-dra-driver")
 DRIVER_NS = "tpu-dra"
 
 
-def new_cluster(state_root: str, *, partitionable: bool = False):
+def new_cluster(
+    state_root: str, *, partitionable: bool = False, exec_proxies: bool = False
+):
     """SimCluster with the chart's cluster-scoped objects installed.
 
     ``partitionable`` mirrors the reference demo's MIG-enabled vs plain GPU
@@ -45,6 +47,7 @@ def new_cluster(state_root: str, *, partitionable: bool = False):
         mesh="2x2x1",
         partitionable=partitionable,
         namespace=DRIVER_NS,
+        exec_proxies=exec_proxies,
     )
     cluster.start()
     rendered = render_chart(CHART_DIR)
@@ -134,9 +137,54 @@ def check_test4(cluster):
 
 def check_test5(cluster):
     ns = "tpu-test5"
-    p1 = cluster.wait_for_pod_running(ns, "ci1", timeout=15)
-    p2 = cluster.wait_for_pod_running(ns, "ci2", timeout=15)
+    p1 = cluster.wait_for_pod_running(ns, "ci1", timeout=30)
+    p2 = cluster.wait_for_pod_running(ns, "ci2", timeout=30)
     assert chips_of(cluster, ns, p1) == chips_of(cluster, ns, p2)
+
+    # The share is mediated by a real enforcing daemon, not advisory env.
+    claim = cluster.clientset.resource_claims(ns).get("slice-claim")
+    uid = claim.metadata.uid
+    deployment = cluster.clientset.deployments(DRIVER_NS).get(
+        f"tpu-runtime-proxy-{uid[:8]}"
+    )
+    assert deployment.status.ready_replicas >= 1
+
+    node = cluster.node(p1.spec.node_name)
+    with open(node.cdi._spec_path(uid)) as f:
+        env = json.load(f)["devices"][0]["containerEdits"]["env"]
+    start, end = map(int, env_value(env, "TPU_VISIBLE_CORES").split("-"))
+
+    # Attach through the daemon's socket: in-interval admitted,
+    # out-of-interval rejected (the enforcement MIG gets from hardware).
+    from tpu_dra.proxy.client import ProxyClient, ProxyError
+
+    nas = cluster.clientset.node_allocation_states(DRIVER_NS).get(
+        p1.spec.node_name
+    )
+    sub = nas.spec.prepared_claims[uid].subslice.devices[0]
+    assert (sub.placement.start, sub.placement.start + sub.placement.size - 1) == (
+        start,
+        end,
+    )
+    socket_path = env_value(env, "TPU_RUNTIME_PROXY_ADDR")
+    with ProxyClient(socket_path, timeout=10.0) as inside:
+        inside.attach("ci-inside", cores=(sub.parent_uuid, start, end))
+        with ProxyClient(socket_path, timeout=10.0) as outside:
+            try:
+                outside.attach(
+                    "ci-outside", cores=(sub.parent_uuid, end + 1, end + 1)
+                )
+            except ProxyError as e:
+                assert "outside this claim's cores" in str(e), e
+            else:
+                raise AssertionError(
+                    "attach outside the subslice placement was admitted"
+                )
+
+
+def env_value(env: "list[str]", name: str) -> str:
+    (entry,) = [e for e in env if e.startswith(name + "=")]
+    return entry.split("=", 1)[1]
 
 
 def check_test6(cluster):
@@ -212,25 +260,27 @@ def check_topology(cluster):
     assert "TPU_CHIPS_PER_HOST_BOUNDS=2,2,1" in env, env
 
 
-# filename -> (check, needs partitionable chips)
+# filename -> (check, needs partitionable chips, run real proxy daemons)
 SCENARIOS = {
-    "tpu-test1.yaml": (check_test1, False),
-    "tpu-test2.yaml": (check_test2, False),
-    "tpu-test3.yaml": (check_test3, False),
-    "tpu-test4.yaml": (check_test4, True),
-    "tpu-test5.yaml": (check_test5, True),
-    "tpu-test6.yaml": (check_test6, True),
-    "tpu-test-sharing.yaml": (check_sharing, False),
-    "tpu-test-topology.yaml": (check_topology, False),
-    "tpu-test-gang.yaml": (check_gang, False),
+    "tpu-test1.yaml": (check_test1, False, False),
+    "tpu-test2.yaml": (check_test2, False, False),
+    "tpu-test3.yaml": (check_test3, False, False),
+    "tpu-test4.yaml": (check_test4, True, False),
+    "tpu-test5.yaml": (check_test5, True, True),
+    "tpu-test6.yaml": (check_test6, True, False),
+    "tpu-test-sharing.yaml": (check_sharing, False, False),
+    "tpu-test-topology.yaml": (check_topology, False, False),
+    "tpu-test-gang.yaml": (check_gang, False, False),
 }
 
 
 def run_one(filename: str) -> None:
     """Fresh cluster per spec, like each demo walkthrough step."""
-    check, partitionable = SCENARIOS[filename]
+    check, partitionable, exec_proxies = SCENARIOS[filename]
     with tempfile.TemporaryDirectory(prefix="tpu-quickstart-") as state_root:
-        cluster = new_cluster(state_root, partitionable=partitionable)
+        cluster = new_cluster(
+            state_root, partitionable=partitionable, exec_proxies=exec_proxies
+        )
         try:
             apply_spec(cluster, filename)
             check(cluster)

@@ -141,6 +141,96 @@ class TestDevnodeOwnership:
         d2.stop()
 
 
+class TestSubsliceOwnership:
+    """MPS-on-MIG analog: a daemon whose config carries core_ranges owns
+    only that interval of the parent chip and shares the devnode."""
+
+    def make_subslice_config(self, tmp_path, name, start, size, devnodes=None):
+        config = make_config(tmp_path, name=name, uuids=["parent-0"])
+        if devnodes is not None:
+            config.device_paths = devnodes
+        config.core_ranges = {"parent-0": (start, size)}
+        config.chip_cores = {"parent-0": 8}
+        return config
+
+    def test_attach_inside_owned_range(self, tmp_path):
+        config = self.make_subslice_config(tmp_path, "claim-ss", 2, 2)
+        d = ProxyDaemon(config)
+        d.start()
+        try:
+            with connect(config) as client:
+                granted = client.attach("ci-a", cores=("parent-0", 2, 3))
+                assert granted["cores"] == ["parent-0", 2, 3]
+        finally:
+            d.stop()
+
+    def test_attach_outside_owned_range_rejected(self, tmp_path):
+        config = self.make_subslice_config(tmp_path, "claim-ss2", 2, 2)
+        d = ProxyDaemon(config)
+        d.start()
+        try:
+            with connect(config) as client:
+                # In chip bounds (0-7) but outside the claim's 2-3.
+                with pytest.raises(ProxyError, match="outside this claim's"):
+                    client.attach("ci-b", cores=("parent-0", 4, 5))
+                with pytest.raises(ProxyError, match="outside this claim's"):
+                    client.attach("ci-b", cores=("parent-0", 1, 2))
+        finally:
+            d.stop()
+
+    def test_sibling_subslice_daemons_share_parent_devnode(self, tmp_path):
+        first = self.make_subslice_config(tmp_path, "claim-sib1", 0, 2)
+        d1 = ProxyDaemon(first)
+        d1.start()
+        try:
+            # Second daemon on a different interval of the SAME devnode:
+            # shared locks coexist.
+            second = self.make_subslice_config(
+                tmp_path, "claim-sib2", 2, 2, devnodes=first.device_paths
+            )
+            d2 = ProxyDaemon(second)
+            d2.start()
+            d2.stop()
+        finally:
+            d1.stop()
+
+    def test_whole_chip_daemon_conflicts_with_subslice(self, tmp_path):
+        sub = self.make_subslice_config(tmp_path, "claim-sub", 0, 2)
+        d1 = ProxyDaemon(sub)
+        d1.start()
+        try:
+            whole = make_config(tmp_path, name="claim-whole", uuids=["parent-0"])
+            whole.device_paths = sub.device_paths
+            with pytest.raises(RuntimeError, match="owned by another process"):
+                ProxyDaemon(whole).start()
+        finally:
+            d1.stop()
+
+    def test_second_daemon_for_same_claim_rejected(self, tmp_path):
+        # The devnode lock is SHARED for subslice daemons, so per-claim
+        # exclusivity comes from the claim-dir lock: a lingering old daemon
+        # and its replacement must never both admit clients.
+        config = self.make_subslice_config(tmp_path, "claim-dup", 0, 2)
+        d1 = ProxyDaemon(config)
+        d1.start()
+        try:
+            with pytest.raises(RuntimeError, match="already serves claim"):
+                ProxyDaemon(config).start()
+        finally:
+            d1.stop()
+        # After a clean stop the claim can be served again.
+        d3 = ProxyDaemon(config)
+        d3.start()
+        d3.stop()
+
+    def test_core_ranges_roundtrip_config_file(self, tmp_path):
+        config = self.make_subslice_config(tmp_path, "claim-rt", 2, 2)
+        root = str(tmp_path / "claim-rt")
+        config.save(root)
+        loaded = ProxyDaemonConfig.load(root)
+        assert loaded.core_ranges == {"parent-0": (2, 2)}
+
+
 class TestAdmissionControl:
     def test_attach_within_limits(self, daemon):
         _, config = daemon
@@ -177,7 +267,7 @@ class TestAdmissionControl:
     def test_core_interval_bounds_checked(self, daemon):
         _, config = daemon
         with connect(config) as client:
-            with pytest.raises(ProxyError, match="outside chip"):
+            with pytest.raises(ProxyError, match="outside this claim's cores"):
                 client.attach("job-x", cores=("chip-0", 6, 9))
 
     def test_negative_asks_rejected(self, daemon):

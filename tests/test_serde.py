@@ -77,9 +77,12 @@ class TestSharingTypes:
         s = TpuSharing(strategy=SharingStrategy.TIME_SLICING)
         with pytest.raises(SharingValidationError):
             s.get_runtime_proxy_config()
+        # Subslice claims support RuntimeProxy (MigDeviceSharing carries an
+        # MpsConfig, sharing.go:74-81) — no rejection.
         sub = SubsliceSharing(strategy=SharingStrategy.RUNTIME_PROXY)
+        assert sub.get_runtime_proxy_config() is not None
         with pytest.raises(SharingValidationError):
-            sub.get_runtime_proxy_config()
+            sub.get_time_slicing_config()
 
     def test_normalize(self):
         # Reference's one unit-tested routine: sharing_test.go:28-91.

@@ -16,8 +16,11 @@ equivalents:
   percentage and per-device pinned-memory limits (sharing.go:191-221),
   re-expressed as core percentage and per-chip HBM limits.
 
-Subslice claims only support TimeSlicing, mirroring MigDeviceSharing's
-rejection of MPS (sharing.go:79-98).
+Subslice claims support both strategies, mirroring MigDeviceSharing carrying
+an MpsConfig (sharing.go:74-81) and the MPS daemon consuming prepared MIG
+devices (cmd/nvidia-dra-plugin/sharing.go:172-275): a RuntimeProxy-shared
+subslice gets a daemon that owns the parent chip's devnode and admits
+clients only within the subslice's core interval.
 """
 
 from __future__ import annotations
@@ -120,10 +123,6 @@ class TpuSharing:
 
 @dataclass
 class SubsliceSharing(TpuSharing):
-    """Sharing settings for subslice claims: TimeSlicing only
-    (MigDeviceSharing analog — MPS on MIG is rejected, sharing.go:79-98)."""
-
-    def get_runtime_proxy_config(self) -> RuntimeProxyConfig:
-        raise SharingValidationError(
-            "RuntimeProxy sharing is not supported on subslice claims"
-        )
+    """Sharing settings for subslice claims (MigDeviceSharing analog,
+    sharing.go:74-81 — carries an MpsConfig, so the RuntimeProxy strategy is
+    supported here too; the daemon enforces the subslice's core interval)."""

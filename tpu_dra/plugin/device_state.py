@@ -194,11 +194,12 @@ class DeviceState:
                 return
             if entry.proxy_daemon is not None:
                 entry.proxy_daemon.stop()
-            elif entry.devices.type() == nascrd.TPU_DEVICE_TYPE:
+            else:
                 # The in-memory daemon handle can be lost across a restart
                 # when the claim was adopted without its allocation (see
                 # sync_prepared_from_crd_spec); tear down by claim UID so a
-                # RuntimeProxy deployment never outlives its claim.
+                # RuntimeProxy deployment never outlives its claim — for
+                # whole-chip AND subslice proxy claims.
                 self._proxy_manager.stop_for_claim(claim_uid)
             if entry.devices.type() == nascrd.TPU_DEVICE_TYPE:
                 # Reset scheduler quanta (device_state.go:315-321).

@@ -75,11 +75,36 @@ class RuntimeProxyDaemon:
         self._manager = manager
         self._claim = claim
         self._config = config
+        # chip uuid -> (start, size) interval the daemon owns on that chip;
+        # empty for whole-chip claims (it owns everything).
+        self._core_ranges: dict[str, tuple[int, int]] = {}
         if prepared.tpu is not None:
             self._uuids = [d.uuid for d in prepared.tpu.devices]
+        elif prepared.subslice is not None:
+            # MPS-on-MIG analog (reference sharing.go:172-275 consumes
+            # prepared MIG devices): the daemon attaches to the PARENT
+            # chip's devnode but only admits clients inside the subslice's
+            # core placement.
+            self._uuids = sorted(
+                {d.parent_uuid for d in prepared.subslice.devices}
+            )
+            for d in prepared.subslice.devices:
+                if d.parent_uuid in self._core_ranges:
+                    # One interval per parent: a dict would silently keep
+                    # only the last placement and reject the others' cores.
+                    # DeviceState._prepare_subslices enforces one device per
+                    # claim today; keep that invariant explicit here.
+                    raise ValueError(
+                        f"multiple subslices on parent {d.parent_uuid} in "
+                        f"one RuntimeProxy claim are not supported"
+                    )
+                self._core_ranges[d.parent_uuid] = (
+                    d.placement.start,
+                    d.placement.size,
+                )
         else:
             raise ValueError(
-                "RuntimeProxy sharing is only supported on whole-chip claims"
+                "RuntimeProxy sharing needs prepared TPU or subslice devices"
             )
         self._name = f"tpu-runtime-proxy-{claim.uid[:8]}"
         self._root = os.path.join(manager.proxy_root, claim.uid)
@@ -192,6 +217,7 @@ class RuntimeProxyDaemon:
             visible_devices=sorted(indices),
             device_paths=device_paths,
             chip_cores=chip_cores,
+            core_ranges=dict(self._core_ranges),
             max_active_core_percentage=self._config.max_active_core_percentage,
             hbm_limits={
                 uuid: limit.to_int() for uuid, limit in hbm_limits.items()

@@ -62,6 +62,11 @@ class ProxyDaemonConfig:
     device_paths: dict[str, list[str]] = field(default_factory=dict)
     # chip uuid -> total cores on that chip (for interval validation).
     chip_cores: dict[str, int] = field(default_factory=dict)
+    # chip uuid -> (start, size): the core interval this daemon owns on that
+    # chip.  Absent = the whole chip.  Set for subslice claims, where the
+    # daemon shares the PARENT chip's devnode but must admit clients only
+    # inside the subslice's placement (the MPS-on-MIG analog).
+    core_ranges: dict[str, tuple[int, int]] = field(default_factory=dict)
     max_active_core_percentage: int | None = None
     # chip uuid -> HBM byte cap for the sum of client asks.
     hbm_limits: dict[str, int] = field(default_factory=dict)
@@ -73,6 +78,7 @@ class ProxyDaemonConfig:
             "visibleDevices": self.visible_devices,
             "devicePaths": self.device_paths,
             "chipCores": self.chip_cores,
+            "coreRanges": {u: list(r) for u, r in self.core_ranges.items()},
             "maxActiveCorePercentage": self.max_active_core_percentage,
             "hbmLimits": self.hbm_limits,
         }
@@ -87,6 +93,10 @@ class ProxyDaemonConfig:
                 k: list(v) for k, v in data.get("devicePaths", {}).items()
             },
             chip_cores=dict(data.get("chipCores", {})),
+            core_ranges={
+                u: (int(r[0]), int(r[1]))
+                for u, r in data.get("coreRanges", {}).items()
+            },
             max_active_core_percentage=data.get("maxActiveCorePercentage"),
             hbm_limits=dict(data.get("hbmLimits", {})),
         )
@@ -163,12 +173,44 @@ class ProxyDaemon:
         self._missing_devnodes: list[str] = []
         self._server: socketserver.ThreadingUnixStreamServer | None = None
         self._serve_thread: threading.Thread | None = None
+        self._claim_lock_fd: int | None = None
         self._stopped = threading.Event()
 
     # -- devnode ownership ---------------------------------------------------
 
+    def _acquire_claim_lock(self) -> None:
+        """Exclusive per-claim lock in the claim's own directory: at most one
+        daemon incarnation serves a claim at a time.  Whole-chip claims get
+        this from the devnode's LOCK_EX, but subslice daemons hold the
+        parent devnode SHARED (siblings coexist) — without this, a lingering
+        old daemon and its replacement could both admit clients, with
+        independent lease tables granting overlapping core intervals."""
+        fd = os.open(
+            os.path.join(self._root, "daemon.lock"),
+            os.O_RDWR | os.O_CREAT,
+            0o644,
+        )
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            raise RuntimeError(
+                f"another daemon already serves claim "
+                f"{self._config.claim_uid or self._root}"
+            ) from None
+        self._claim_lock_fd = fd
+
     def _acquire_devnodes(self) -> None:
         for uuid, paths in sorted(self._config.device_paths.items()):
+            # A whole-chip daemon owns the devnode exclusively; a subslice
+            # daemon (core_ranges entry) takes a SHARED lock — sibling
+            # subslice daemons on other core intervals of the same parent
+            # coexist, while a whole-chip exclusive owner still conflicts.
+            lock = (
+                fcntl.LOCK_SH
+                if uuid in self._config.core_ranges
+                else fcntl.LOCK_EX
+            )
             for path in paths:
                 try:
                     fd = os.open(path, os.O_RDWR)
@@ -178,7 +220,7 @@ class ProxyDaemon:
                     self._missing_devnodes.append(path)
                     continue
                 try:
-                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    fcntl.flock(fd, lock | fcntl.LOCK_NB)
                 except OSError:
                     os.close(fd)
                     for held in self._devnode_fds:
@@ -236,10 +278,16 @@ class ProxyDaemon:
                 total = self._config.chip_cores.get(uuid)
                 if total is None:
                     raise _LimitError(f"unknown chip {uuid} for core interval")
-                if not (0 <= start <= end < total):
+                lo, hi = 0, total - 1
+                owned = self._config.core_ranges.get(uuid)
+                if owned is not None:
+                    # Subslice daemon: clients may only use the cores this
+                    # claim's placement carved out of the parent chip.
+                    lo, hi = owned[0], owned[0] + owned[1] - 1
+                if not (lo <= start <= end <= hi):
                     raise _LimitError(
-                        f"core interval {start}-{end} outside chip {uuid}'s "
-                        f"0-{total - 1}"
+                        f"core interval {start}-{end} outside this claim's "
+                        f"cores {lo}-{hi} on {uuid}"
                     )
                 for other in self._leases.values():
                     if other.cores is None or other.cores[0] != uuid:
@@ -276,6 +324,9 @@ class ProxyDaemon:
             "limits": {
                 "maxActiveCorePercentage": self._config.max_active_core_percentage,
                 "hbm": self._config.hbm_limits,
+                "coreRanges": {
+                    u: list(r) for u, r in self._config.core_ranges.items()
+                },
             },
             "activeCorePercentage": active_pct,
             "clients": leases,
@@ -347,8 +398,9 @@ class ProxyDaemon:
     def start(self) -> None:
         """Acquire devices, bind the socket, mark ready.  Serving happens on
         the server's own threads; callers then ``wait()`` or ``stop()``."""
-        self._acquire_devnodes()
         os.makedirs(self._root, exist_ok=True)
+        self._acquire_claim_lock()
+        self._acquire_devnodes()
         try:
             os.unlink(self._config.socket_path)
         except FileNotFoundError:
@@ -463,6 +515,12 @@ class ProxyDaemon:
         except OSError:
             pass
         self._release_devnodes()
+        if self._claim_lock_fd is not None:
+            try:
+                os.close(self._claim_lock_fd)  # drops the per-claim flock
+            except OSError:
+                pass
+            self._claim_lock_fd = None
 
     def wait(self) -> None:
         self._stopped.wait()
