@@ -135,6 +135,147 @@ def _wait_chips_free(cluster, timeout: float) -> None:
     raise TimeoutError("teardown did not settle")
 
 
+def bench_fleet_scale(
+    nodes: int = 64, waves: int = 3, pods_per_wave: int = 16
+) -> "dict":
+    """v5e-256 fleet scale (VERDICT r3 weak #7): 64 nodes x 4 chips, pods
+    with 2x2x1 topology claims churning against fragmentation.
+
+    Each wave creates ``pods_per_wave`` pods concurrently, waits for all to
+    run, then deletes half (keeping the fleet fragmented) before the next
+    wave.  Reports p50/p95 claim->Running across waves plus the
+    UnsuitableNodes fan-out wall time (one scheduler pass probing every
+    node under its per-node lock — the cost that grows with fleet size,
+    controller/driver.py unsuitable_nodes)."""
+    from tpu_dra.api.k8s import (
+        Pod,
+        PodResourceClaim,
+        PodResourceClaimSource,
+        PodSpec,
+        ResourceClaimParametersReference,
+        ResourceClaimSpec,
+        ResourceClaimTemplate,
+        ResourceClaimTemplateSpec,
+        ResourceClass,
+    )
+    from tpu_dra.api.meta import ObjectMeta
+    from tpu_dra.api.tpu_v1alpha1 import (
+        GROUP_NAME,
+        TpuClaimParameters,
+        TpuClaimParametersSpec,
+    )
+    from tpu_dra.sim import SimCluster
+
+    with tempfile.TemporaryDirectory() as root:
+        cluster = SimCluster(root, nodes=nodes, mesh="2x2x1", workers=8)
+
+        # Record every UnsuitableNodes fan-out's wall time (the full
+        # all-nodes probe), without touching driver internals.
+        fanout_times: "list[float]" = []
+        orig_fanout = cluster.controller_driver.unsuitable_nodes
+
+        def timed_fanout(pod, cas, potential_nodes):
+            t0 = time.perf_counter()
+            orig_fanout(pod, cas, potential_nodes)
+            fanout_times.append(time.perf_counter() - t0)
+
+        cluster.controller_driver.unsuitable_nodes = timed_fanout
+        cluster.start()
+        try:
+            cluster.clientset.resource_classes().create(
+                ResourceClass(
+                    metadata=ObjectMeta(name="tpu.google.com"), driver_name=GROUP_NAME
+                )
+            )
+            cluster.clientset.tpu_claim_parameters(NS).create(
+                TpuClaimParameters(
+                    metadata=ObjectMeta(name="fleet-topo", namespace=NS),
+                    spec=TpuClaimParametersSpec(topology="2x2x1"),
+                )
+            )
+            cluster.clientset.resource_claim_templates(NS).create(
+                ResourceClaimTemplate(
+                    metadata=ObjectMeta(name="fleet-topo", namespace=NS),
+                    spec=ResourceClaimTemplateSpec(
+                        spec=ResourceClaimSpec(
+                            resource_class_name="tpu.google.com",
+                            parameters_ref=ResourceClaimParametersReference(
+                                api_group=GROUP_NAME,
+                                kind="TpuClaimParameters",
+                                name="fleet-topo",
+                            ),
+                        )
+                    ),
+                )
+            )
+
+            def make_pod(name: str) -> Pod:
+                return Pod(
+                    metadata=ObjectMeta(name=name, namespace=NS),
+                    spec=PodSpec(
+                        resource_claims=[
+                            PodResourceClaim(
+                                name="tpu",
+                                source=PodResourceClaimSource(
+                                    resource_claim_template_name="fleet-topo"
+                                ),
+                            )
+                        ]
+                    ),
+                )
+
+            latencies: "list[float]" = []
+            live: "list[str]" = []
+            serial = 0
+            for wave in range(waves):
+                started = {}
+                for i in range(pods_per_wave):
+                    name = f"fleet-{serial}"
+                    serial += 1
+                    started[name] = time.perf_counter()
+                    cluster.clientset.pods(NS).create(make_pod(name))
+                for name, t0 in started.items():
+                    cluster.wait_for_pod_running(NS, name, timeout=120.0)
+                    latencies.append(time.perf_counter() - t0)
+                    live.append(name)
+                # Fragment: tear down every other pod before the next wave.
+                victims, live = live[::2], live[1::2]
+                for name in victims:
+                    cluster.delete_pod(NS, name)
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    claims = cluster.clientset.resource_claims(NS).list()
+                    owned = {
+                        c.metadata.name
+                        for c in claims
+                        if c.status.allocation is not None
+                    }
+                    if len(owned) <= len(live):
+                        break
+                    time.sleep(0.05)
+
+            lat = sorted(latencies)
+            fans = sorted(fanout_times)
+
+            def pct(values, q):
+                return values[int(q * (len(values) - 1))] if values else 0.0
+
+            return {
+                "nodes": nodes,
+                "chips": nodes * 4,
+                "pods": len(latencies),
+                "p50_s": pct(lat, 0.50),
+                "p95_s": pct(lat, 0.95),
+                "max_s": lat[-1] if lat else 0.0,
+                "fanout_p50_s": pct(fans, 0.50),
+                "fanout_p95_s": pct(fans, 0.95),
+                "fanout_samples": len(fans),
+                "target_met": bool(lat and pct(lat, 0.95) < TARGET_S),
+            }
+        finally:
+            cluster.stop()
+
+
 def bench_compute() -> "dict":
     """Chip-sized MFU + single-chip HBM bandwidth on this host's accelerator.
 
@@ -180,6 +321,7 @@ def bench_compute() -> "dict":
 
 def main() -> int:
     alloc = bench_claim_to_running(SAMPLES)
+    fleet = bench_fleet_scale()
     compute = bench_compute()
     p50 = alloc["p50_s"]
     line = {
@@ -192,6 +334,8 @@ def main() -> int:
             "p95_s": round(alloc["p95_s"], 4),
             "mean_s": round(alloc["mean_s"], 4),
             "samples": alloc["samples"],
+            "fleet": {k: round(v, 4) if isinstance(v, float) else v
+                      for k, v in fleet.items()},
             "compute": compute,
         },
     }

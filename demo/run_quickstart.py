@@ -86,10 +86,15 @@ def chips_of(cluster, namespace: str, pod) -> "list[str]":
         allocated = nas.spec.allocated_claims[claim.metadata.uid]
         if allocated.tpu is not None:
             out.extend(d.uuid for d in allocated.tpu.devices)
-        else:
+        elif allocated.subslice is not None:
             out.extend(
                 f"{d.parent_uuid}:{d.placement.start}+{d.placement.size}"
                 for d in allocated.subslice.devices
+            )
+        else:
+            out.extend(
+                f"{d.parent_uuid}:{d.placement.start}+{d.placement.size}"
+                for d in allocated.core.devices
             )
     return out
 
@@ -136,43 +141,67 @@ def check_test4(cluster):
 
 
 def check_test5(cluster):
+    """gpu-test5 semantics, implemented for real: per-pod core claims carved
+    out of one shared RuntimeProxy subslice claim, enforced by the daemon."""
     ns = "tpu-test5"
     p1 = cluster.wait_for_pod_running(ns, "ci1", timeout=30)
     p2 = cluster.wait_for_pod_running(ns, "ci2", timeout=30)
-    assert chips_of(cluster, ns, p1) == chips_of(cluster, ns, p2)
+    assert p1.spec.node_name == p2.spec.node_name  # both ride the shared claim
 
-    # The share is mediated by a real enforcing daemon, not advisory env.
-    claim = cluster.clientset.resource_claims(ns).get("slice-claim")
-    uid = claim.metadata.uid
-    deployment = cluster.clientset.deployments(DRIVER_NS).get(
-        f"tpu-runtime-proxy-{uid[:8]}"
-    )
-    assert deployment.status.ready_replicas >= 1
-
-    node = cluster.node(p1.spec.node_name)
-    with open(node.cdi._spec_path(uid)) as f:
-        env = json.load(f)["devices"][0]["containerEdits"]["env"]
-    start, end = map(int, env_value(env, "TPU_VISIBLE_CORES").split("-"))
-
-    # Attach through the daemon's socket: in-interval admitted,
-    # out-of-interval rejected (the enforcement MIG gets from hardware).
-    from tpu_dra.proxy.client import ProxyClient, ProxyError
-
+    shared = cluster.clientset.resource_claims(ns).get("slice-claim")
     nas = cluster.clientset.node_allocation_states(DRIVER_NS).get(
         p1.spec.node_name
     )
-    sub = nas.spec.prepared_claims[uid].subslice.devices[0]
-    assert (sub.placement.start, sub.placement.start + sub.placement.size - 1) == (
-        start,
-        end,
+    sub = nas.spec.allocated_claims[shared.metadata.uid].subslice.devices[0]
+    lo = sub.placement.start
+    hi = lo + sub.placement.size - 1
+
+    # The share is mediated by a real enforcing daemon, not advisory env.
+    deployment = cluster.clientset.deployments(DRIVER_NS).get(
+        f"tpu-runtime-proxy-{shared.metadata.uid[:8]}"
     )
-    socket_path = env_value(env, "TPU_RUNTIME_PROXY_ADDR")
+    assert deployment.status.ready_replicas >= 1
+
+    # Each pod's core claim: a disjoint interval INSIDE the shared placement,
+    # with consumer CDI carrying the interval + the parent daemon's socket.
+    node = cluster.node(p1.spec.node_name)
+    cores = []
+    socket_path = ""
+    for pod in (p1, p2):
+        cclaim = claim_of(cluster, ns, pod, "core")
+        core = nas.spec.allocated_claims[cclaim.metadata.uid].core.devices[0]
+        assert core.subslice_claim_uid == shared.metadata.uid
+        assert core.parent_uuid == sub.parent_uuid
+        core_end = core.placement.start + core.placement.size - 1
+        assert lo <= core.placement.start and core_end <= hi
+        with open(node.cdi._spec_path(cclaim.metadata.uid)) as f:
+            env = json.load(f)["devices"][0]["containerEdits"]["env"]
+        start, end = map(int, env_value(env, "TPU_VISIBLE_CORES").split("-"))
+        assert (start, end) == (core.placement.start, core_end)
+        assert env_value(env, "TPU_CORE_PARENT_CLAIM") == shared.metadata.uid
+        socket_path = env_value(env, "TPU_RUNTIME_PROXY_ADDR")
+        cores.append(core)
+    assert not cores[0].placement.overlaps(cores[1].placement)
+
+    # Attach through the shared daemon with a core claim's interval —
+    # admitted; outside the subslice placement — rejected (the enforcement
+    # MIG gets from hardware).
+    from tpu_dra.proxy.client import ProxyClient, ProxyError
+
+    c1 = cores[0]
     with ProxyClient(socket_path, timeout=10.0) as inside:
-        inside.attach("ci-inside", cores=(sub.parent_uuid, start, end))
+        inside.attach(
+            "ci1-core",
+            cores=(
+                c1.parent_uuid,
+                c1.placement.start,
+                c1.placement.start + c1.placement.size - 1,
+            ),
+        )
         with ProxyClient(socket_path, timeout=10.0) as outside:
             try:
                 outside.attach(
-                    "ci-outside", cores=(sub.parent_uuid, end + 1, end + 1)
+                    "ci-outside", cores=(c1.parent_uuid, hi + 1, hi + 1)
                 )
             except ProxyError as e:
                 assert "outside this claim's cores" in str(e), e

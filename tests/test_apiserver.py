@@ -300,6 +300,49 @@ class TestTypedRoundtrip:
         assert back.spec.selector.properties.generation == "v5e"
 
 
+class TestParseCache:
+    """RV-keyed deserialization cache: hits must be private copies, and a
+    write (new resourceVersion) must invalidate."""
+
+    def make_nas(self, cs, name="n1"):
+        from tpu_dra.api.nas_v1alpha1 import NodeAllocationState
+
+        return cs.node_allocation_states("tpu-dra").create(
+            NodeAllocationState(
+                metadata=ObjectMeta(name=name, namespace="tpu-dra")
+            )
+        )
+
+    def test_hit_returns_private_copy(self, cs):
+        self.make_nas(cs)
+        client = cs.node_allocation_states("tpu-dra")
+        a = client.get("n1")
+        a.spec.allocated_claims["uid-x"] = object.__class__  # mutate freely
+        b = client.get("n1")
+        assert "uid-x" not in b.spec.allocated_claims
+        assert a is not b and a.spec is not b.spec
+
+    def test_write_invalidates(self, cs):
+        self.make_nas(cs)
+        client = cs.node_allocation_states("tpu-dra")
+        first = client.get("n1")
+        first.spec.node_address = "10.0.0.9"
+        client.update(first)
+        again = client.get("n1")
+        assert again.spec.node_address == "10.0.0.9"
+
+    def test_list_uses_cache_per_object(self, cs):
+        self.make_nas(cs, "n1")
+        self.make_nas(cs, "n2")
+        client = cs.node_allocation_states("tpu-dra")
+        client.get("n1")
+        out = client.list()
+        assert {n.metadata.name for n in out} == {"n1", "n2"}
+        # Mutating a listed object must not leak into later reads.
+        out[0].spec.worker_id = 99
+        assert client.get(out[0].metadata.name).spec.worker_id != 99
+
+
 class TestEventLog:
     """events_since: rv-pinned replay incl. DELETED (the list->watch gap)."""
 

@@ -142,8 +142,27 @@ class TestParameterResolution:
         params = driver.get_claim_parameters(claim, ResourceClass(), None)
         assert params.profile == "1c.4gb"
 
+    def test_core_kind_dispatch(self, cs, driver):
+        from tpu_dra.api.tpu_v1alpha1 import (
+            CoreClaimParameters,
+            CoreClaimParametersSpec,
+        )
+
+        cs.core_claim_parameters(NS).create(
+            CoreClaimParameters(
+                metadata=ObjectMeta(name="c", namespace=NS),
+                spec=CoreClaimParametersSpec(
+                    profile="1c", subslice_claim_name="shared"
+                ),
+            )
+        )
+        claim = make_claim(cs, kind="CoreClaimParameters", params_name="c")
+        params = driver.get_claim_parameters(claim, ResourceClass(), None)
+        assert params.profile == "1c"
+        assert params.subslice_claim_name == "shared"
+
     def test_unknown_kind(self, cs, driver):
-        claim = make_claim(cs, kind="CoreClaimParameters", params_name="x")
+        claim = make_claim(cs, kind="NoSuchParameters", params_name="x")
         with pytest.raises(ValueError, match="unknown ResourceClaim"):
             driver.get_claim_parameters(claim, ResourceClass(), None)
 

@@ -1,0 +1,326 @@
+"""Core allocator tests: profile grammar, parent-claim affinity, free-gap
+carving, backtracking, and pending promotion (gpu-test5 semantics — the
+reference registers CI claims but never implements them)."""
+
+import pytest
+
+from helpers import make_ca, make_nas, make_pod
+from tpu_dra.api.nas_v1alpha1 import (
+    AllocatedDevices,
+    AllocatedSubslice,
+    AllocatedSubslices,
+    ClaimInfo,
+)
+from tpu_dra.api.topology import Placement
+from tpu_dra.api.tpu_v1alpha1 import CoreClaimParametersSpec
+from tpu_dra.controller.core_allocator import CoreDriver, core_count_of
+
+NODE = "node-1"
+
+
+def run_unsuitable(driver, nas, cas, pod=None, allcas=None):
+    pod = pod or make_pod()
+    driver.unsuitable_node(nas, pod, cas, allcas or cas, NODE)
+    return cas
+
+
+def add_shared_subslice(
+    nas,
+    *,
+    uid="sub-uid",
+    name="slice-claim",
+    parent="tpu-0",
+    start=0,
+    size=2,
+    sharing=None,
+):
+    nas.spec.allocated_claims[uid] = AllocatedDevices(
+        claim_info=ClaimInfo(namespace="default", name=name, uid=uid),
+        subslice=AllocatedSubslices(
+            devices=[
+                AllocatedSubslice(
+                    profile=f"{size}c.8gb",
+                    parent_uuid=parent,
+                    placement=Placement(start, size),
+                )
+            ],
+            sharing=sharing,
+        ),
+    )
+    return uid
+
+
+class TestProfileGrammar:
+    def test_cores_only(self):
+        assert core_count_of("1c") == 1
+        assert core_count_of("2c") == 2
+
+    def test_full_subslice_profile(self):
+        assert core_count_of("2c.8gb") == 2
+
+    @pytest.mark.parametrize("bad", ["", "c", "0c", "x2c", "2c.bogus"])
+    def test_malformed(self, bad):
+        with pytest.raises(ValueError):
+            core_count_of(bad)
+
+
+class TestValidate:
+    def test_profile_required(self):
+        with pytest.raises(ValueError, match="profile"):
+            CoreDriver().validate_claim_parameters(CoreClaimParametersSpec())
+
+    def test_parent_name_required(self):
+        with pytest.raises(ValueError, match="subsliceClaimName"):
+            CoreDriver().validate_claim_parameters(
+                CoreClaimParametersSpec(profile="1c")
+            )
+
+
+class TestAllocation:
+    def params(self, profile="1c", name="slice-claim"):
+        return CoreClaimParametersSpec(profile=profile, subslice_claim_name=name)
+
+    def test_carve_inside_parent_placement(self):
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        add_shared_subslice(nas, start=2, size=2)
+        ca = make_ca(self.params())
+        run_unsuitable(driver, nas, [ca])
+        assert ca.unsuitable_nodes == []
+        core = nas.spec.allocated_claims[ca.claim.metadata.uid].core.devices[0]
+        assert core.parent_uuid == "tpu-0"
+        assert core.subslice_claim_uid == "sub-uid"
+        assert 2 <= core.placement.start <= 3 and core.placement.size == 1
+
+    def test_no_parent_claim_unsuitable(self):
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        ca = make_ca(self.params())
+        run_unsuitable(driver, nas, [ca])
+        assert NODE in ca.unsuitable_nodes
+
+    def test_wrong_parent_name_unsuitable(self):
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        add_shared_subslice(nas, name="other-claim")
+        ca = make_ca(self.params(name="slice-claim"))
+        run_unsuitable(driver, nas, [ca])
+        assert NODE in ca.unsuitable_nodes
+
+    def test_pod_prefixed_template_affinity(self):
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        pod = make_pod("mypod")
+        add_shared_subslice(nas, name="mypod-slice")
+        ca = make_ca(self.params(name="slice"))
+        run_unsuitable(driver, nas, [ca], pod=pod)
+        assert ca.unsuitable_nodes == []
+
+    def test_two_pods_get_disjoint_cores(self):
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        add_shared_subslice(nas, start=0, size=2)
+        ca1 = make_ca(self.params(), name="core-1")
+        run_unsuitable(driver, nas, [ca1])
+        c1 = nas.spec.allocated_claims[ca1.claim.metadata.uid].core.devices[0]
+        ca2 = make_ca(self.params(), name="core-2")
+        run_unsuitable(driver, nas, [ca2])
+        c2 = nas.spec.allocated_claims[ca2.claim.metadata.uid].core.devices[0]
+        assert not c1.placement.overlaps(c2.placement)
+
+    def test_parent_exhausted_unsuitable(self):
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        add_shared_subslice(nas, start=0, size=2)
+        for i in range(2):
+            ca = make_ca(self.params(), name=f"core-{i}")
+            run_unsuitable(driver, nas, [ca])
+            assert ca.unsuitable_nodes == []
+        ca3 = make_ca(self.params(), name="core-3")
+        run_unsuitable(driver, nas, [ca3])
+        assert NODE in ca3.unsuitable_nodes
+
+    def test_multi_core_profile_needs_contiguous_run(self):
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        add_shared_subslice(nas, start=0, size=4)
+        # 1c then 2c: free cores {1,2,3} leave a contiguous pair.
+        ca1 = make_ca(self.params(), name="single")
+        run_unsuitable(driver, nas, [ca1])
+        one = nas.spec.allocated_claims[ca1.claim.metadata.uid].core.devices[0]
+        assert (one.placement.start, one.placement.size) == (0, 1)
+        ca2 = make_ca(self.params(profile="2c"), name="pair")
+        run_unsuitable(driver, nas, [ca2])
+        assert ca2.unsuitable_nodes == []
+        pair = nas.spec.allocated_claims[ca2.claim.metadata.uid].core.devices[0]
+        assert pair.placement.size == 2
+        assert not pair.placement.overlaps(one.placement)
+        # A second 2c ask: only core 3 remains free — no contiguous run.
+        ca3 = make_ca(self.params(profile="2c"), name="pair2")
+        run_unsuitable(driver, nas, [ca3])
+        assert NODE in ca3.unsuitable_nodes
+
+    def test_backtracking_two_claims_one_pod(self):
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        add_shared_subslice(nas, start=0, size=2)
+        cas = [
+            make_ca(self.params(), name="core-a"),
+            make_ca(self.params(), name="core-b"),
+        ]
+        run_unsuitable(driver, nas, cas)
+        assert all(ca.unsuitable_nodes == [] for ca in cas)
+        placements = [
+            nas.spec.allocated_claims[ca.claim.metadata.uid].core.devices[0].placement
+            for ca in cas
+        ]
+        assert not placements[0].overlaps(placements[1])
+
+    def test_parent_sharing_copied_down(self):
+        from tpu_dra.api.sharing import SharingStrategy, SubsliceSharing
+
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        add_shared_subslice(
+            nas,
+            sharing=SubsliceSharing(strategy=SharingStrategy.RUNTIME_PROXY),
+        )
+        ca = make_ca(self.params())
+        run_unsuitable(driver, nas, [ca])
+        allocated = nas.spec.allocated_claims[ca.claim.metadata.uid].core
+        assert allocated.parent_sharing is not None
+        assert allocated.parent_sharing.is_runtime_proxy()
+
+    def test_promote_pending(self):
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        add_shared_subslice(nas)
+        ca = make_ca(self.params())
+        run_unsuitable(driver, nas, [ca])
+        from tpu_dra.api.k8s import ResourceClass
+        from tpu_dra.api.meta import ObjectMeta
+        from tpu_dra.api.tpu_v1alpha1 import DeviceClassParametersSpec
+
+        fresh = make_nas(partitionable=True)
+        add_shared_subslice(fresh)
+        on_success = driver.allocate(
+            fresh, ca.claim, ca.claim_parameters, DeviceClassParametersSpec(), NODE
+        )
+        assert ca.claim.metadata.uid in fresh.spec.allocated_claims
+        on_success()
+        assert not driver.pending_allocated_claims.exists(
+            ca.claim.metadata.uid, NODE
+        )
+
+    def test_allocate_without_pending_fails(self):
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        from tpu_dra.api.tpu_v1alpha1 import DeviceClassParametersSpec
+
+        ca = make_ca(self.params())
+        with pytest.raises(RuntimeError, match="no allocations generated"):
+            driver.allocate(
+                nas, ca.claim, ca.claim_parameters, DeviceClassParametersSpec(), NODE
+            )
+
+    def test_parent_deallocate_blocked_while_cores_live(self):
+        # Review finding: a pod can hold ONLY the core claim, so the shared
+        # parent's reservedFor can't protect it — the controller must refuse
+        # to deallocate a subslice claim with live carved cores.
+        from tpu_dra.api import serde
+        from tpu_dra.api.k8s import (
+            AllocationResult,
+            ResourceClaim,
+            ResourceClaimStatus,
+        )
+        from tpu_dra.api.meta import ObjectMeta
+        from tpu_dra.client import ClientSet, FakeApiServer
+        from tpu_dra.controller.driver import ControllerDriver
+
+        cs = ClientSet(FakeApiServer())
+        driver = ControllerDriver(cs, NS := "tpu-dra")
+        nas = make_nas(partitionable=True, namespace=NS)
+        add_shared_subslice(nas, uid="parent-uid", name="slice-claim")
+        nas.spec.allocated_claims["core-uid"] = serde.from_dict(
+            AllocatedDevices,
+            {
+                "claimInfo": {
+                    "namespace": "default",
+                    "name": "core",
+                    "uid": "core-uid",
+                },
+                "core": {
+                    "devices": [
+                        {
+                            "profile": "1c",
+                            "parentUuid": "tpu-0",
+                            "placement": {"start": 0, "size": 1},
+                            "subsliceClaimUid": "parent-uid",
+                        }
+                    ]
+                },
+            },
+        )
+        cs.node_allocation_states(NS).create(nas)
+
+        from tpu_dra.api.k8s import build_allocation_result
+
+        parent_claim = ResourceClaim(
+            metadata=ObjectMeta(
+                name="slice-claim", namespace="default", uid="parent-uid"
+            ),
+            status=ResourceClaimStatus(
+                allocation=build_allocation_result("node-1", True)
+            ),
+        )
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="core claim"):
+            driver.deallocate(parent_claim)
+        # Core claim gone -> parent deallocates cleanly.
+        fresh = cs.node_allocation_states(NS).get("node-1")
+        del fresh.spec.allocated_claims["core-uid"]
+        cs.node_allocation_states(NS).update(fresh)
+        driver.deallocate(parent_claim)
+        after = cs.node_allocation_states(NS).get("node-1")
+        assert "parent-uid" not in after.spec.allocated_claims
+
+    def test_dangling_core_blocks_subslice_recarve(self):
+        # Even if a core claim dangles (parent somehow gone), its interval
+        # must not be re-carved into a fresh subslice.
+        from tpu_dra.api import serde
+        from tpu_dra.api.tpu_v1alpha1 import SubsliceClaimParametersSpec
+        from tpu_dra.controller.subslice_allocator import SubsliceDriver
+
+        nas = make_nas(partitionable=True)
+        nas.spec.allocated_claims["core-uid"] = serde.from_dict(
+            AllocatedDevices,
+            {
+                "core": {
+                    "devices": [
+                        {
+                            "profile": "1c",
+                            "parentUuid": "tpu-0",
+                            "placement": {"start": 0, "size": 1},
+                            "subsliceClaimUid": "gone-uid",
+                        }
+                    ]
+                }
+            },
+        )
+        driver = SubsliceDriver()
+        candidates = driver._available(nas)
+        for profile, entries in candidates.items():
+            for cand in entries:
+                if cand.parent_uuid == "tpu-0":
+                    assert not (
+                        cand.placement.start <= 0
+                        < cand.placement.start + cand.placement.size
+                    ), (profile, cand)
+
+    def test_no_core_claims_is_noop(self):
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        other = make_ca(CoreClaimParametersSpec(profile="1c"))
+        run_unsuitable(driver, nas, [], allcas=[other])
+        assert other.unsuitable_nodes == []

@@ -223,6 +223,97 @@ class TestPrepare:
         state.prepare("uid-6", tpu_allocation("mock-tpu-0", uid="uid-6"))
 
 
+def core_allocation(
+    parent, start=0, size=1, parent_uid="sub-uid", parent_sharing=None, uid="uid-c"
+):
+    from tpu_dra.api.nas_v1alpha1 import AllocatedCore, AllocatedCores
+
+    return AllocatedDevices(
+        claim_info=ClaimInfo(namespace="default", name="core", uid=uid),
+        core=AllocatedCores(
+            devices=[
+                AllocatedCore(
+                    profile=f"{size}c",
+                    parent_uuid=parent,
+                    placement=Placement(start, size),
+                    subslice_claim_uid=parent_uid,
+                )
+            ],
+            parent_sharing=parent_sharing,
+        ),
+    )
+
+
+class TestPrepareCores:
+    """Core claims (CI-of-shared-subslice, wired where the reference isn't)."""
+
+    def test_prepare_core_claim_env(self, stack):
+        _, cdi, state = stack
+        devices = state.prepare(
+            "uid-c1", core_allocation("mock-tpu-1", start=2, uid="uid-c1")
+        )
+        assert devices == ["tpu.resource.google.com/claim=uid-c1"]
+        import glob, json, os
+
+        (spec_file,) = [
+            f
+            for f in glob.glob(os.path.join(cdi._cdi_root, "*.json"))
+            if "uid-c1" in f
+        ]
+        env = json.load(open(spec_file))["devices"][0]["containerEdits"]["env"]
+        assert "TPU_VISIBLE_CORES=2-2" in env
+        assert "TPU_VISIBLE_DEVICES=1" in env
+        assert "TPU_CORE_PARENT_CLAIM=sub-uid" in env
+
+    def test_core_claim_with_proxy_parent_gets_socket(self, stack):
+        from tpu_dra.api.sharing import SharingStrategy, SubsliceSharing
+
+        _, cdi, state = stack
+        sharing = SubsliceSharing(strategy=SharingStrategy.RUNTIME_PROXY)
+        state.prepare(
+            "uid-c2",
+            core_allocation(
+                "mock-tpu-1",
+                parent_uid="parent-claim-uid",
+                parent_sharing=sharing,
+                uid="uid-c2",
+            ),
+        )
+        import glob, json, os
+
+        (spec_file,) = [
+            f
+            for f in glob.glob(os.path.join(cdi._cdi_root, "*.json"))
+            if "uid-c2" in f
+        ]
+        env = json.load(open(spec_file))["devices"][0]["containerEdits"]["env"]
+        (addr,) = [e for e in env if e.startswith("TPU_RUNTIME_PROXY_ADDR=")]
+        assert addr.endswith(os.path.join("parent-claim-uid", "proxy.sock"))
+
+    def test_unknown_parent_rejected(self, stack):
+        _, _, state = stack
+        with pytest.raises(ValueError, match="does not exist"):
+            state.prepare("uid-c3", core_allocation("no-such-chip", uid="uid-c3"))
+
+    def test_crash_recovery_rebuilds_core_claims(self, tmp_path, cs):
+        _, cdi, state = make_plugin_stack(tmp_path, cs, partitionable=True)
+        alloc = core_allocation("mock-tpu-0", start=1, uid="uid-c4")
+        state.prepare("uid-c4", alloc)
+        spec = state.get_updated_spec(NodeAllocationStateSpec())
+        spec.allocated_claims["uid-c4"] = alloc
+        # "Restart": fresh DeviceState re-adopts from the CRD spec.
+        _, cdi2, state2 = make_plugin_stack(tmp_path, cs, partitionable=True)
+        state2.sync_prepared_from_crd_spec(spec)
+        out = state2.get_updated_spec(NodeAllocationStateSpec())
+        dev = out.prepared_claims["uid-c4"].core.devices[0]
+        assert dev.parent_uuid == "mock-tpu-0"
+        assert (dev.placement.start, dev.placement.size) == (1, 1)
+        state2.unprepare("uid-c4")
+        assert "uid-c4" not in state2.get_updated_spec(
+            NodeAllocationStateSpec()
+        ).prepared_claims
+
+
 class TestLegacyUuidAliases:
     """Round-2 ADVICE regression: the identity scheme changed from
     positional ``tpu-{worker}-{index}`` to PCI-stable UUIDs; allocations

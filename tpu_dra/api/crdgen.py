@@ -196,7 +196,8 @@ def subslice_claim_parameters_schema() -> dict:
 
 def core_claim_parameters_schema() -> dict:
     schema = schema_for_object(tpucrd.CoreClaimParameters)
-    _constrain(schema, ("spec", "profile"), pattern=r"^\d+c\.\d+gb$")
+    # "Nc" (cores only) or a full subslice profile "Nc.Mgb" (cores used).
+    _constrain(schema, ("spec", "profile"), pattern=r"^\d+c(\.\d+gb)?$")
     return schema
 
 

@@ -35,6 +35,7 @@ NODE_ALLOCATION_STATE_KIND = "NodeAllocationState"
 
 TPU_DEVICE_TYPE = "tpu"
 SUBSLICE_DEVICE_TYPE = "subslice"
+CORE_DEVICE_TYPE = "core"
 UNKNOWN_DEVICE_TYPE = "unknown"
 
 STATUS_READY = "Ready"
@@ -140,16 +141,43 @@ class AllocatedSubslices:
 
 
 @dataclass
+class AllocatedCore:
+    """A core interval carved out of a SHARED subslice claim's placement
+    (ComputeInstance analog — the reference registers the CI claim type but
+    never wires it, ciclaim.go:22-28; here it is allocated for real).
+
+    ``placement`` is absolute on the parent chip (a sub-interval of the
+    parent subslice claim's placement)."""
+
+    profile: str = ""
+    parent_uuid: str = ""  # the chip
+    placement: Placement = field(default_factory=lambda: Placement(0, 0))
+    subslice_claim_uid: str = ""  # the shared subslice claim carved from
+
+
+@dataclass
+class AllocatedCores:
+    devices: list[AllocatedCore] = field(default_factory=list)
+    # Copied from the parent subslice claim at allocation time so the node
+    # plugin can route consumers through the parent's proxy daemon without
+    # re-reading the parent's allocation.
+    parent_sharing: SubsliceSharing | None = None
+
+
+@dataclass
 class AllocatedDevices:
     claim_info: ClaimInfo | None = None
     tpu: AllocatedTpus | None = None
     subslice: AllocatedSubslices | None = None
+    core: AllocatedCores | None = None
 
     def type(self) -> str:
         if self.tpu is not None:
             return TPU_DEVICE_TYPE
         if self.subslice is not None:
             return SUBSLICE_DEVICE_TYPE
+        if self.core is not None:
+            return CORE_DEVICE_TYPE
         return UNKNOWN_DEVICE_TYPE
 
 
@@ -178,15 +206,33 @@ class PreparedSubslices:
 
 
 @dataclass
+class PreparedCore:
+    """A prepared core interval: no silicon object is created (cores are a
+    view onto the parent chip), so prepared == the validated allocation."""
+
+    parent_uuid: str = ""
+    placement: Placement = field(default_factory=lambda: Placement(0, 0))
+    subslice_claim_uid: str = ""
+
+
+@dataclass
+class PreparedCores:
+    devices: list[PreparedCore] = field(default_factory=list)
+
+
+@dataclass
 class PreparedDevices:
     tpu: PreparedTpus | None = None
     subslice: PreparedSubslices | None = None
+    core: PreparedCores | None = None
 
     def type(self) -> str:
         if self.tpu is not None:
             return TPU_DEVICE_TYPE
         if self.subslice is not None:
             return SUBSLICE_DEVICE_TYPE
+        if self.core is not None:
+            return CORE_DEVICE_TYPE
         return UNKNOWN_DEVICE_TYPE
 
 

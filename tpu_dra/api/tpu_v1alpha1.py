@@ -12,9 +12,11 @@ facing request vocabulary:
 - ``SubsliceClaimParameters`` (migclaim.go:26-32 analog): a core-subslice of a
   chip by profile ("1c.4gb"), optionally affine to a parent whole-chip claim
   via ``tpu_claim_name`` (the gpuClaimName co-allocation affinity).
-- ``CoreClaimParameters``     (ciclaim.go:22-28 analog): registered but not
-  yet wired into the controller, mirroring the reference's not-yet-implemented
-  ComputeInstance claim path.
+- ``CoreClaimParameters``     (ciclaim.go:22-28 analog): N cores carved out
+  of a SHARED subslice claim named by ``subslice_claim_name`` (the
+  migDeviceClaimName affinity) — wired end to end through the controller
+  (controller/core_allocator.py), where the reference leaves the
+  ComputeInstance claim path registered but unimplemented.
 
 Defaulting helpers mirror api.go:27-57.
 """
@@ -202,8 +204,10 @@ class SubsliceClaimParameters:
 
 @dataclass
 class CoreClaimParametersSpec:
-    """Single-core claim within a shared subslice (ComputeInstance analog,
-    ciclaim.go:22-28 — registered, not yet wired into the controller)."""
+    """Core claim within a shared subslice (ComputeInstance analog,
+    ciclaim.go:22-28 — wired for real here).  ``profile`` is "Nc" (or a full
+    subslice profile whose core count is used); ``subslice_claim_name`` names
+    the shared subslice claim the cores are carved from."""
 
     profile: str = ""
     subslice_claim_name: str = field(default="", metadata={"json": "subsliceClaimName"})
@@ -244,6 +248,12 @@ def default_subslice_claim_parameters_spec(
     return serde.deepcopy(spec) if spec is not None else SubsliceClaimParametersSpec()
 
 
+def default_core_claim_parameters_spec(
+    spec: CoreClaimParametersSpec | None,
+) -> CoreClaimParametersSpec:
+    return serde.deepcopy(spec) if spec is not None else CoreClaimParametersSpec()
+
+
 __all__ = [
     "GROUP_NAME",
     "VERSION",
@@ -268,4 +278,5 @@ __all__ = [
     "default_device_class_parameters_spec",
     "default_tpu_claim_parameters_spec",
     "default_subslice_claim_parameters_spec",
+    "default_core_claim_parameters_spec",
 ]

@@ -138,6 +138,15 @@ class FakeApiServer:
         with self._lock:
             return str(self._rv)
 
+    def trim_event_log(self) -> None:
+        """Evict the whole event log (the etcd-compaction analog): any
+        subsequent replay from an old resourceVersion returns None, which
+        the wire shim surfaces as 410 Gone — chaos tests use this to force
+        real clients through their relist paths."""
+        with self._lock:
+            self._evicted_through = self._rv
+            self._event_log.clear()
+
     def _meta(self, obj: dict) -> dict:
         return obj.setdefault("metadata", {})
 

@@ -56,6 +56,7 @@ RESOURCES: "dict[str, tuple[str, str, str, bool]]" = {
     "DeviceClassParameters": ("tpu.resource.google.com", "v1alpha1", "deviceclassparameters", False),
     "TpuClaimParameters": ("tpu.resource.google.com", "v1alpha1", "tpuclaimparameters", True),
     "SubsliceClaimParameters": ("tpu.resource.google.com", "v1alpha1", "subsliceclaimparameters", True),
+    "CoreClaimParameters": ("tpu.resource.google.com", "v1alpha1", "coreclaimparameters", True),
     "NodeAllocationState": ("nas.tpu.resource.google.com", "v1alpha1", "nodeallocationstates", True),
 }
 

@@ -4,9 +4,12 @@ cmd/nvidia-dra-controller/driver.go:41-341).
 Implements the reconciler's Driver interface: parameter fetch + defaulting +
 validation, per-node-locked Allocate/Deallocate writing the NAS, and the
 UnsuitableNodes fan-out.  Dispatch is per claim-parameter kind — whole-chip
-claims route to TpuDriver, subslice claims to SubsliceDriver — and within a
-node the whole-chip kind is processed before subslices (driver.go:284-296)
-so parent-claim affinity can see the pod's freshly-placed chips.
+claims route to TpuDriver, subslice claims to SubsliceDriver, core claims to
+CoreDriver — and within a node kinds are processed parent-first (chips →
+subslices → cores, extending driver.go:284-296) so each affinity level can
+see its freshly-placed parents.  The core kind is wired for real, where the
+reference leaves ComputeInstance claims registered-but-unimplemented
+(ciclaim.go:22-28).
 """
 
 from __future__ import annotations
@@ -25,6 +28,7 @@ from tpu_dra.api.k8s import (
 from tpu_dra.api.meta import ObjectMeta
 from tpu_dra.client.clientset import ClientSet
 from tpu_dra.client.nasclient import NasClient
+from tpu_dra.controller.core_allocator import CoreDriver
 from tpu_dra.controller.nodelock import PerNodeMutex
 from tpu_dra.controller.subslice_allocator import SubsliceDriver
 from tpu_dra.controller.tpu_allocator import TpuDriver
@@ -42,6 +46,7 @@ class ControllerDriver:
         self.clientset = clientset
         self.tpu = TpuDriver()
         self.subslice = SubsliceDriver()
+        self.core = CoreDriver()
         from tpu_dra.controller.gang_tracker import GangTracker
 
         self.gangs = GangTracker(clientset, namespace)
@@ -75,6 +80,11 @@ class ControllerDriver:
             sc = self.clientset.subslice_claim_parameters(namespace).get(ref.name)
             params = tpucrd.default_subslice_claim_parameters_spec(sc.spec)
             self.subslice.validate_claim_parameters(params)
+            return params
+        if ref.kind == tpucrd.CORE_CLAIM_PARAMETERS_KIND:
+            cc = self.clientset.core_claim_parameters(namespace).get(ref.name)
+            params = tpucrd.default_core_claim_parameters_spec(cc.spec)
+            self.core.validate_claim_parameters(params)
             return params
         raise ValueError(f"unknown ResourceClaim.ParametersRef.Kind: {ref.kind}")
 
@@ -139,12 +149,10 @@ class ControllerDriver:
                     claim, claim_params, resource_class, class_params, node
                 )
             except Exception as e:  # try the next candidate
-                self.tpu.pending_allocated_claims.remove_node(
-                    claim.metadata.uid, node
-                )
-                self.subslice.pending_allocated_claims.remove_node(
-                    claim.metadata.uid, node
-                )
+                for subdriver in (self.tpu, self.subslice, self.core):
+                    subdriver.pending_allocated_claims.remove_node(
+                        claim.metadata.uid, node
+                    )
                 errors.append(f"{node}: {e}")
         raise RuntimeError(
             f"immediate allocation of claim {claim.metadata.name!r} failed: "
@@ -183,6 +191,10 @@ class ControllerDriver:
                 )
             elif isinstance(claim_params, tpucrd.SubsliceClaimParametersSpec):
                 on_success = self.subslice.allocate(
+                    nas, claim, claim_params, class_params, selected_node
+                )
+            elif isinstance(claim_params, tpucrd.CoreClaimParametersSpec):
+                on_success = self.core.allocate(
                     nas, claim, claim_params, class_params, selected_node
                 )
             else:
@@ -244,6 +256,7 @@ class ControllerDriver:
         # re-cached by a concurrent scheduling pass.
         self.tpu.pending_allocated_claims.remove(claim.metadata.uid)
         self.subslice.pending_allocated_claims.remove(claim.metadata.uid)
+        self.core.pending_allocated_claims.remove(claim.metadata.uid)
         self.gangs.release(claim.metadata.uid)
         selected_node = get_selected_node(claim)
         if not selected_node:
@@ -267,7 +280,32 @@ class ControllerDriver:
             if allocated.type() == nascrd.TPU_DEVICE_TYPE:
                 self.tpu.deallocate(nas, claim)
             elif allocated.type() == nascrd.SUBSLICE_DEVICE_TYPE:
+                # A shared subslice with live core claims carved from it must
+                # not deallocate: pods holding only the core claim don't
+                # appear in the parent's reservedFor, so the reconciler's
+                # in-use check can't protect them — without this guard the
+                # silicon subslice (and its enforcing daemon) would die under
+                # running consumers and the freed interval could be
+                # re-carved.  The raise surfaces as a deallocate failure the
+                # reconciler retries until the core claims are gone.
+                carved = [
+                    uid
+                    for uid, other in nas.spec.allocated_claims.items()
+                    if other.core is not None
+                    and any(
+                        d.subslice_claim_uid == claim_uid
+                        for d in other.core.devices
+                    )
+                ]
+                if carved:
+                    raise RuntimeError(
+                        f"subslice claim {claim_uid} still has "
+                        f"{len(carved)} core claim(s) carved from it: "
+                        f"{sorted(carved)}"
+                    )
                 self.subslice.deallocate(nas, claim)
+            elif allocated.type() == nascrd.CORE_DEVICE_TYPE:
+                self.core.deallocate(nas, claim)
             else:
                 raise ValueError(f"unknown AllocatedDevices type: {allocated.type()}")
             del nas.spec.allocated_claims[claim_uid]
@@ -293,6 +331,13 @@ class ControllerDriver:
 
     # -- scheduling fan-out (driver.go:228-298) ------------------------------
 
+    # Per-node suitability probes within one fan-out are independent (each
+    # takes its own node lock and reads its own NAS), so they run on a pool.
+    # At v5e-256 scale (64 nodes) a serial pass costs ~0.6s and convoys when
+    # many pods schedule at once — the fleet bench showed p95 blowing the 5s
+    # target on exactly this path (bench.py bench_fleet_scale).
+    FANOUT_PARALLELISM = 16
+
     def unsuitable_nodes(
         self, pod: Pod, cas: list[ClaimAllocation], potential_nodes: list[str]
     ) -> None:
@@ -301,13 +346,30 @@ class ControllerDriver:
         # entries cheaply inside each node's pass.
         with UNSUITABLE_SECONDS.time():
             dead = self._dead_pending_claims(potential_nodes)
-            for node in potential_nodes:
-                self._unsuitable_node(pod, cas, node, dead)
+            if len(potential_nodes) > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                workers = min(self.FANOUT_PARALLELISM, len(potential_nodes))
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    # list() propagates the first worker exception, matching
+                    # the serial loop's behavior.
+                    list(
+                        pool.map(
+                            lambda node: self._unsuitable_node(
+                                pod, cas, node, dead
+                            ),
+                            potential_nodes,
+                        )
+                    )
+            else:
+                for node in potential_nodes:
+                    self._unsuitable_node(pod, cas, node, dead)
+        # Canonical order (sorted, deduped): the pool appends in completion
+        # order, and an order-flapping list would make the reconciler's
+        # status comparison see a "change" every pass and rewrite the
+        # PodSchedulingContext for free.
         for ca in cas:
-            seen = set()
-            ca.unsuitable_nodes = [
-                n for n in ca.unsuitable_nodes if not (n in seen or seen.add(n))
-            ]
+            ca.unsuitable_nodes = sorted(set(ca.unsuitable_nodes))
 
     def _dead_pending_claims(self, nodes: list[str]) -> set[str]:
         """Pending-cache claim UIDs whose claim no longer exists.
@@ -323,7 +385,7 @@ class ControllerDriver:
         from tpu_dra.client.apiserver import NotFoundError
 
         infos: dict[str, nascrd.ClaimInfo] = {}
-        for subdriver in (self.tpu, self.subslice):
+        for subdriver in (self.tpu, self.subslice, self.core):
             for node in nodes:
                 subdriver.pending_allocated_claims.visit_node(
                     node,
@@ -367,14 +429,15 @@ class ControllerDriver:
                 return
 
             for uid in dead_pending or ():
-                self.tpu.pending_allocated_claims.remove_node(uid, potential_node)
-                self.subslice.pending_allocated_claims.remove_node(
-                    uid, potential_node
-                )
+                for subdriver in (self.tpu, self.subslice, self.core):
+                    subdriver.pending_allocated_claims.remove_node(
+                        uid, potential_node
+                    )
 
             per_kind: dict[str, list[ClaimAllocation]] = {
                 tpucrd.TPU_CLAIM_PARAMETERS_KIND: [],
                 tpucrd.SUBSLICE_CLAIM_PARAMETERS_KIND: [],
+                tpucrd.CORE_CLAIM_PARAMETERS_KIND: [],
             }
             for ca in allcas:
                 if isinstance(ca.claim_parameters, tpucrd.TpuClaimParametersSpec):
@@ -383,19 +446,28 @@ class ControllerDriver:
                     ca.claim_parameters, tpucrd.SubsliceClaimParametersSpec
                 ):
                     per_kind[tpucrd.SUBSLICE_CLAIM_PARAMETERS_KIND].append(ca)
+                elif isinstance(
+                    ca.claim_parameters, tpucrd.CoreClaimParametersSpec
+                ):
+                    per_kind[tpucrd.CORE_CLAIM_PARAMETERS_KIND].append(ca)
                 else:
                     raise ValueError(
                         f"invalid claim parameters type: "
                         f"{type(ca.claim_parameters).__name__}"
                     )
 
-            # Whole-chip claims before subslices: affinity resolution
-            # depends on parents being placed first (driver.go:284-296).
+            # Parent-first ordering: chips before subslices before cores —
+            # each affinity level resolves against freshly-placed parents
+            # (driver.go:284-296, extended one level down).
             self.tpu.unsuitable_node(
                 nas, pod, per_kind[tpucrd.TPU_CLAIM_PARAMETERS_KIND], allcas,
                 potential_node,
             )
             self.subslice.unsuitable_node(
                 nas, pod, per_kind[tpucrd.SUBSLICE_CLAIM_PARAMETERS_KIND], allcas,
+                potential_node,
+            )
+            self.core.unsuitable_node(
+                nas, pod, per_kind[tpucrd.CORE_CLAIM_PARAMETERS_KIND], allcas,
                 potential_node,
             )

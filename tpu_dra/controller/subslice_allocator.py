@@ -165,10 +165,22 @@ class SubsliceDriver:
             candidates[device.subslice.profile] = entry
 
         for allocation in crd.spec.allocated_claims.values():
-            if allocation.type() != nascrd.SUBSLICE_DEVICE_TYPE:
+            if allocation.type() == nascrd.SUBSLICE_DEVICE_TYPE:
+                taken_devices = [
+                    SubslicePlacement(d.parent_uuid, d.placement)
+                    for d in allocation.subslice.devices
+                ]
+            elif allocation.type() == nascrd.CORE_DEVICE_TYPE:
+                # Core claims occupy real cores on the parent chip too —
+                # without this, a dangling core claim's interval could be
+                # re-carved into a fresh overlapping subslice.
+                taken_devices = [
+                    SubslicePlacement(d.parent_uuid, d.placement)
+                    for d in allocation.core.devices
+                ]
+            else:
                 continue
-            for dev in allocation.subslice.devices:
-                taken = SubslicePlacement(dev.parent_uuid, dev.placement)
+            for taken in taken_devices:
                 for profile in candidates:
                     candidates[profile] = [
                         c for c in candidates[profile] if not c.overlaps(taken)

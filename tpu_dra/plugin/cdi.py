@@ -112,6 +112,23 @@ class CDIHandler:
             envs.append(f"TPU_SUBSLICE_UUID={dev.uuid}")
         return {"deviceNodes": device_nodes, "env": envs}
 
+    def _core_edits(self, prepared: nascrd.PreparedCores) -> dict:
+        """Core claims (CI-of-shared-subslice): same parent-chip visibility
+        as subslices, scoped to the carved interval, plus the parent claim
+        UID so a consumer can identify which shared subslice it lives in."""
+        device_nodes = []
+        envs = []
+        for dev in prepared.devices:
+            info = self._tpulib.chip_info(dev.parent_uuid)
+            for path in info.device_paths:
+                device_nodes.append({"path": path})
+            envs.append(f"TPU_VISIBLE_DEVICES={info.tpu.index}")
+            start = dev.placement.start
+            end = start + dev.placement.size - 1
+            envs.append(f"TPU_VISIBLE_CORES={start}-{end}")
+            envs.append(f"TPU_CORE_PARENT_CLAIM={dev.subslice_claim_uid}")
+        return {"deviceNodes": device_nodes, "env": envs}
+
     @staticmethod
     def _merge_edits(*edits: dict) -> dict:
         merged: dict = {}
@@ -140,6 +157,8 @@ class CDIHandler:
             device_edits = self._tpu_edits(prepared.tpu, allocated)
         elif prepared.type() == nascrd.SUBSLICE_DEVICE_TYPE:
             device_edits = self._subslice_edits(prepared.subslice)
+        elif prepared.type() == nascrd.CORE_DEVICE_TYPE:
+            device_edits = self._core_edits(prepared.core)
         else:
             raise ValueError(f"unknown prepared device type for claim {claim_uid}")
 

@@ -107,6 +107,9 @@ class DeviceState:
                 elif allocated.type() == nascrd.SUBSLICE_DEVICE_TYPE:
                     devices = self._prepare_subslices(allocated.subslice)
                     sharing = allocated.subslice.sharing
+                elif allocated.type() == nascrd.CORE_DEVICE_TYPE:
+                    devices = self._prepare_cores(allocated.core)
+                    sharing = None  # cores ride the parent claim's sharing
                 else:
                     raise ValueError(
                         f"claim {claim_uid} has no allocated devices to prepare"
@@ -129,7 +132,7 @@ class DeviceState:
                     extra = (
                         entry.proxy_daemon.get_cdi_edits()
                         if entry.proxy_daemon is not None
-                        else None
+                        else self._core_proxy_edits(allocated)
                     )
                     self._cdi.create_claim_spec_file(
                         claim_uid, devices, allocated, extra_edits=extra
@@ -259,6 +262,60 @@ class DeviceState:
             raise
         return nascrd.PreparedDevices(subslice=prepared)
 
+    def _prepare_cores(self, allocated: nascrd.AllocatedCores) -> nascrd.PreparedDevices:
+        """Core claims are a view onto the parent chip — nothing is created
+        on silicon; prepare validates the parent and records the interval."""
+        prepared = nascrd.PreparedCores()
+        for device in allocated.devices:
+            parent_uuid = self._resolve_chip_uuid(device.parent_uuid)
+            if parent_uuid not in self._chips:
+                raise ValueError(
+                    f"allocated parent TPU does not exist: {device.parent_uuid}"
+                )
+            prepared.devices.append(
+                nascrd.PreparedCore(
+                    parent_uuid=parent_uuid,
+                    placement=device.placement,
+                    subslice_claim_uid=device.subslice_claim_uid,
+                )
+            )
+        return nascrd.PreparedDevices(core=prepared)
+
+    def _core_proxy_edits(
+        self, allocated: nascrd.AllocatedDevices
+    ) -> "dict | None":
+        """Consumer routing for a core claim whose PARENT subslice claim is
+        RuntimeProxy-shared: inject the parent daemon's socket (its path is
+        deterministic — proxy_root/<parent claim uid>) so the container
+        attaches through the enforcing daemon, like any sibling consumer."""
+        import os
+
+        if allocated.core is None:
+            return None
+        sharing = allocated.core.parent_sharing
+        if sharing is None or not sharing.is_runtime_proxy():
+            return None
+        edits: dict = {"env": [], "mounts": []}
+        seen = set()
+        for dev in allocated.core.devices:
+            root = os.path.join(
+                self._proxy_manager.proxy_root, dev.subslice_claim_uid
+            )
+            if root in seen:
+                continue
+            seen.add(root)
+            edits["env"].append(
+                f"TPU_RUNTIME_PROXY_ADDR={os.path.join(root, 'proxy.sock')}"
+            )
+            edits["mounts"].append(
+                {
+                    "hostPath": root,
+                    "containerPath": root,
+                    "options": ["rw", "nosuid", "nodev", "bind"],
+                }
+            )
+        return edits
+
     def refresh_claim_env(
         self, claim_uid: str, allocated: nascrd.AllocatedDevices
     ) -> bool:
@@ -316,12 +373,18 @@ class DeviceState:
             if alloc.subslice is not None:
                 for dev in alloc.subslice.devices:
                     dev.parent_uuid = fix(dev.parent_uuid)
+            if alloc.core is not None:
+                for dev in alloc.core.devices:
+                    dev.parent_uuid = fix(dev.parent_uuid)
         for devices in spec.prepared_claims.values():
             if devices.tpu is not None:
                 for dev in devices.tpu.devices:
                     dev.uuid = fix(dev.uuid)
             if devices.subslice is not None:
                 for dev in devices.subslice.devices:
+                    dev.parent_uuid = fix(dev.parent_uuid)
+            if devices.core is not None:
+                for dev in devices.core.devices:
                     dev.parent_uuid = fix(dev.parent_uuid)
         return changed
 
@@ -421,6 +484,11 @@ class DeviceState:
                     devices=nascrd.PreparedDevices(subslice=rebuilt)
                 )
                 sharing = allocated.subslice.sharing if allocated.subslice else None
+            elif devices.type() == nascrd.CORE_DEVICE_TYPE:
+                # Nothing lives on silicon for cores; re-validate the parent
+                # and rebuild the view.
+                entry = PreparedClaim(devices=self._prepare_cores(allocated.core))
+                sharing = None
             else:
                 continue
 
@@ -436,7 +504,7 @@ class DeviceState:
                 extra = (
                     entry.proxy_daemon.get_cdi_edits()
                     if entry.proxy_daemon is not None
-                    else None
+                    else self._core_proxy_edits(allocated)
                 )
                 self._cdi.create_claim_spec_file(
                     claim_uid, entry.devices, allocated, extra_edits=extra

@@ -58,6 +58,7 @@ class FlakyApiServer:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._paused = threading.Event()
+        self._live_watches: "set[_BreakableWatch]" = set()
         self.faults_injected = 0
         self.calls = 0
 
@@ -133,7 +134,48 @@ class FlakyApiServer:
         return self.inner.events_since(since_rv, kind, namespace, name)
 
     def watch(self, kind, namespace=None, name=None):
-        # Watches stay reliable: the failure mode that matters for them
-        # (missed events) is exercised by the event-log replay tests; here
-        # faults target the request/response path the retry loops guard.
-        return self.inner.watch(kind, namespace, name)
+        # Subscription itself stays reliable (missed-event semantics are
+        # exercised by the event-log replay tests), but live streams are
+        # breakable: break_watches() poisons every open stream so wire-rung
+        # chaos can force real clients through their reconnect/relist paths.
+        wrapper = _BreakableWatch(self.inner.watch(kind, namespace, name), self)
+        with self._lock:
+            self._live_watches.add(wrapper)
+        return wrapper
+
+    def break_watches(self) -> None:
+        """Tear every live watch stream (the load-balancer-reset analog):
+        the next ``next()`` on each raises, ending the serving stream, and
+        wire clients must reconnect from their last seen resourceVersion."""
+        with self._lock:
+            watches = list(self._live_watches)
+        for w in watches:
+            w.poison()
+
+    def _drop_watch(self, wrapper: "_BreakableWatch") -> None:
+        with self._lock:
+            self._live_watches.discard(wrapper)
+
+
+class _BreakableWatch:
+    """Watch facade whose stream can be torn on demand."""
+
+    def __init__(self, inner, owner: FlakyApiServer):
+        self._inner = inner
+        self._owner = owner
+        self._poisoned = threading.Event()
+
+    def poison(self) -> None:
+        self._poisoned.set()
+
+    def next(self, timeout: "float | None" = None):
+        if self._poisoned.is_set():
+            raise UnavailableError("watch stream torn (scripted)")
+        return self._inner.next(timeout)
+
+    def deliver(self, event) -> None:  # protocol completeness
+        self._inner.deliver(event)
+
+    def stop(self) -> None:
+        self._owner._drop_watch(self)
+        self._inner.stop()

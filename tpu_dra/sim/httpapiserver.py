@@ -218,6 +218,12 @@ class HttpApiServer:
                         line = json.dumps(event).encode() + b"\n"
                         self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
                         self.wfile.flush()
+                except ApiError:
+                    # Store-side stream fault (e.g. FlakyApiServer's torn
+                    # watch): close the connection mid-chunk so the wire
+                    # client sees a truncated stream and reconnects from its
+                    # last seen resourceVersion.
+                    self.close_connection = True
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
                 finally:
