@@ -212,6 +212,30 @@ class TestAllocation:
             ca.claim.metadata.uid, NODE
         )
 
+    def test_allocate_revalidates_parent_still_allocated(self):
+        # Review finding: the parent subslice claim can deallocate between
+        # the UnsuitableNodes probe (which cached the pending core) and
+        # Allocate — committing then would dangle.  Allocate must re-check
+        # the fresh NAS and fail cleanly.
+        from tpu_dra.api.tpu_v1alpha1 import DeviceClassParametersSpec
+
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        add_shared_subslice(nas)
+        ca = make_ca(self.params())
+        run_unsuitable(driver, nas, [ca])
+        assert ca.unsuitable_nodes == []
+        # Parent gone by Allocate time.
+        fresh = make_nas(partitionable=True)
+        with pytest.raises(RuntimeError, match="no longer allocated"):
+            driver.allocate(
+                fresh, ca.claim, ca.claim_parameters, DeviceClassParametersSpec(), NODE
+            )
+        # The stale pending entry was dropped so it can't be re-promoted.
+        assert not driver.pending_allocated_claims.exists(
+            ca.claim.metadata.uid, NODE
+        )
+
     def test_allocate_without_pending_fails(self):
         driver = CoreDriver()
         nas = make_nas(partitionable=True)

@@ -1,0 +1,165 @@
+"""Chaos over the wire rung (VERDICT r3 next #7): the REAL binaries talking
+the REAL k8s HTTP wire (client/restserver.py) to a FlakyApiServer-wrapped
+store behind the HTTP shim — so the restserver's retry, reconnect-backoff,
+and 410-Gone relist paths (restserver.py watch pump) are exercised by
+injected faults, not just the in-process fake."""
+
+import os
+import time
+
+import pytest
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api.k8s import Node
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.client.apiserver import FakeApiServer
+from tpu_dra.client.clientset import ClientSet
+from tpu_dra.client.restserver import ClusterConfig, RestApiServer
+from tpu_dra.cmds import plugin as plugin_cmd
+from tpu_dra.sim.faults import FlakyApiServer
+from tpu_dra.sim.httpapiserver import HttpApiServer
+
+NS = "tpu-dra"
+NODE = "n1"
+
+
+@pytest.fixture
+def rig(tmp_path):
+    """Real plugin binary over the real wire to a flaky store.
+
+    Faults start OFF so startup is deterministic; tests turn the dials."""
+    inner = FakeApiServer()
+    flaky = FlakyApiServer(inner, seed=11)
+    shim = HttpApiServer(store=flaky).start()
+    clients = ClientSet(
+        RestApiServer(ClusterConfig(server=shim.url), qps=1000, burst=1000)
+    )
+    clients.nodes().create(Node(metadata=ObjectMeta(name=NODE)))
+    args = plugin_cmd.parse_args(
+        [
+            "--node-name", NODE,
+            "--namespace", NS,
+            "--apiserver", shim.url,
+            "--mock-tpulib-mesh", "2x2x1",
+            "--cdi-root", str(tmp_path / "cdi"),
+            "--plugin-root", str(tmp_path / "plugins"),
+            "--registrar-root", str(tmp_path / "registry"),
+            "--state-dir", str(tmp_path / "state"),
+            "--http-endpoint", "127.0.0.1:0",
+        ]
+    )
+    app = plugin_cmd.PluginApp(args)
+    app.start()
+    try:
+        yield inner, flaky, clients, app, tmp_path
+    finally:
+        flaky.error_rate = flaky.conflict_rate = 0.0
+        flaky.resume()
+        app.stop()
+        shim.stop()
+
+
+def allocate_chip(clients, claim_uid: str) -> None:
+    nas = clients.node_allocation_states(NS).get(NODE)
+    chip = next(d for d in nas.spec.allocatable_devices if d.tpu is not None)
+    nas.spec.allocated_claims[claim_uid] = nascrd.AllocatedDevices(
+        claim_info=nascrd.ClaimInfo(uid=claim_uid, name="c1", namespace=NS),
+        tpu=nascrd.AllocatedTpus(
+            devices=[nascrd.AllocatedTpu(uuid=chip.tpu.uuid, coord=chip.tpu.coord)]
+        ),
+    )
+    clients.node_allocation_states(NS).update(nas)
+
+
+def deallocate_chip(clients, claim_uid: str) -> None:
+    nas = clients.node_allocation_states(NS).get(NODE)
+    nas.spec.allocated_claims.pop(claim_uid, None)
+    clients.node_allocation_states(NS).update(nas)
+
+
+def grpc_prepare(app, tmp_path, claim_uid: str) -> "list[str]":
+    from tpu_dra.plugin.kubeletplugin import DRAClient
+
+    sock = os.path.join(str(tmp_path / "plugins"), app.driver_name, "plugin.sock")
+    return DRAClient(sock).node_prepare_resource(NS, claim_uid, claim_name="c1")
+
+
+def wait_unprepared(clients, claim_uid: str, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            nas = clients.node_allocation_states(NS).get(NODE)
+            if claim_uid not in nas.spec.prepared_claims:
+                return
+        except Exception:
+            pass  # flaky read; keep polling
+        time.sleep(0.1)
+    raise TimeoutError(f"claim {claim_uid} still prepared after {timeout}s")
+
+
+class TestWireChaos:
+    def test_prepare_and_gc_through_flaky_wire(self, rig):
+        """Errors + conflicts on the wire: the plugin's conflict-retried
+        prepare publish and watch-driven GC still converge."""
+        inner, flaky, clients, app, tmp_path = rig
+        allocate_chip(clients, "uid-flaky")
+        flaky.error_rate = 0.15
+        flaky.conflict_rate = 0.15
+        try:
+            devices = None
+            for _ in range(20):  # kubelet retries RPCs too
+                try:
+                    devices = grpc_prepare(app, tmp_path, "uid-flaky")
+                    break
+                except Exception:
+                    time.sleep(0.1)
+            assert devices == [f"tpu.resource.google.com/claim=uid-flaky"]
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                try:
+                    deallocate_chip(clients, "uid-flaky")
+                    break
+                except Exception:
+                    time.sleep(0.1)
+            wait_unprepared(clients, "uid-flaky")
+        finally:
+            flaky.error_rate = flaky.conflict_rate = 0.0
+        assert flaky.faults_injected > 0  # chaos actually happened
+
+    def test_outage_window_recovers_over_wire(self, rig):
+        """Scripted hard outage: every wire call 503s for a while; the GC
+        watch reconnect backoff rides it out and cleanup still happens."""
+        inner, flaky, clients, app, tmp_path = rig
+        allocate_chip(clients, "uid-outage")
+        assert grpc_prepare(app, tmp_path, "uid-outage")
+        flaky.pause()
+        time.sleep(1.0)  # let streams die and retries start failing
+        flaky.resume()
+        deallocate_chip(clients, "uid-outage")
+        wait_unprepared(clients, "uid-outage")
+
+    def test_torn_watch_410_relist_over_wire(self, rig):
+        """The exact etcd-compaction interleaving: the GC's watch stream is
+        torn and every reconnect fails (outage) while the deallocation lands
+        and the event log is compacted past the stream's resourceVersion.
+        On resume the reconnect gets 410 Gone and must RELIST — the gap
+        deallocation is only visible through the relist's synthetic state
+        replay (restserver.py pump rv='' path)."""
+        inner, flaky, clients, app, tmp_path = rig
+        allocate_chip(clients, "uid-410")
+        assert grpc_prepare(app, tmp_path, "uid-410")
+
+        # Tear the stream AND hold reconnects down so the gap is real.
+        flaky.break_watches()
+        flaky.pause()
+        time.sleep(1.0)  # the torn stream dies; reconnect attempts fail
+
+        # The gap write goes directly to the store (the apiserver is only
+        # unreachable to OUR client), then compaction eats the replay.
+        raw = inner.get("NodeAllocationState", NS, NODE)
+        raw["spec"]["allocatedClaims"].pop("uid-410")
+        inner.update(raw)
+        inner.trim_event_log()
+
+        flaky.resume()
+        wait_unprepared(clients, "uid-410")

@@ -100,9 +100,22 @@ class CoreDriver:
                 f"no allocations generated for claim '{claim_uid}' "
                 f"on node '{selected_node}' yet"
             )
-        crd.spec.allocated_claims[claim_uid] = self.pending_allocated_claims.get(
-            claim_uid, selected_node
-        )
+        pending = self.pending_allocated_claims.get(claim_uid, selected_node)
+        # Re-validate against the FRESH NAS: the parent subslice claim may
+        # have deallocated between the UnsuitableNodes probe and now (the
+        # controller's carved-cores guard only sees committed core claims,
+        # so a pending one can't block it) — committing would produce a core
+        # claim whose parent, daemon, and silicon are gone.
+        for dev in pending.core.devices if pending.core else []:
+            parent = crd.spec.allocated_claims.get(dev.subslice_claim_uid)
+            if parent is None or parent.subslice is None:
+                self.pending_allocated_claims.remove_node(claim_uid, selected_node)
+                raise RuntimeError(
+                    f"parent subslice claim {dev.subslice_claim_uid} of core "
+                    f"claim '{claim_uid}' is no longer allocated on "
+                    f"'{selected_node}'"
+                )
+        crd.spec.allocated_claims[claim_uid] = pending
         return lambda: self.pending_allocated_claims.remove(claim_uid)
 
     def deallocate(self, crd: nascrd.NodeAllocationState, claim: ResourceClaim) -> None:

@@ -14,6 +14,7 @@ reference leaves ComputeInstance claims registered-but-unimplemented
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 from tpu_dra.api import nas_v1alpha1 as nascrd, tpu_v1alpha1 as tpucrd
@@ -47,6 +48,8 @@ class ControllerDriver:
         self.tpu = TpuDriver()
         self.subslice = SubsliceDriver()
         self.core = CoreDriver()
+        self._fanout_pool = None
+        self._fanout_pool_lock = threading.Lock()
         from tpu_dra.controller.gang_tracker import GangTracker
 
         self.gangs = GangTracker(clientset, namespace)
@@ -338,6 +341,21 @@ class ControllerDriver:
     # target on exactly this path (bench.py bench_fleet_scale).
     FANOUT_PARALLELISM = 16
 
+    def _fanout_executor(self):
+        """One long-lived pool per driver (thread churn per fan-out would
+        land on the very path this parallelism speeds up); interpreter
+        shutdown joins it via concurrent.futures' atexit hook."""
+        if self._fanout_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._fanout_pool_lock:
+                if self._fanout_pool is None:
+                    self._fanout_pool = ThreadPoolExecutor(
+                        max_workers=self.FANOUT_PARALLELISM,
+                        thread_name_prefix="fanout",
+                    )
+        return self._fanout_pool
+
     def unsuitable_nodes(
         self, pod: Pod, cas: list[ClaimAllocation], potential_nodes: list[str]
     ) -> None:
@@ -347,20 +365,16 @@ class ControllerDriver:
         with UNSUITABLE_SECONDS.time():
             dead = self._dead_pending_claims(potential_nodes)
             if len(potential_nodes) > 1:
-                from concurrent.futures import ThreadPoolExecutor
-
-                workers = min(self.FANOUT_PARALLELISM, len(potential_nodes))
-                with ThreadPoolExecutor(max_workers=workers) as pool:
-                    # list() propagates the first worker exception, matching
-                    # the serial loop's behavior.
-                    list(
-                        pool.map(
-                            lambda node: self._unsuitable_node(
-                                pod, cas, node, dead
-                            ),
-                            potential_nodes,
-                        )
+                # list() propagates the first worker exception, matching
+                # the serial loop's behavior.
+                list(
+                    self._fanout_executor().map(
+                        lambda node: self._unsuitable_node(
+                            pod, cas, node, dead
+                        ),
+                        potential_nodes,
                     )
+                )
             else:
                 for node in potential_nodes:
                     self._unsuitable_node(pod, cas, node, dead)

@@ -304,6 +304,12 @@ class DeviceState:
             if root in seen:
                 continue
             seen.add(root)
+            # The daemon itself is started by the PARENT claim's prepare; a
+            # pod holding only the core claim can land before any parent
+            # consumer does.  Materialize the dir so the bind mount source
+            # exists and the container starts — its attach then blocks until
+            # the daemon binds the socket.
+            os.makedirs(root, exist_ok=True)
             edits["env"].append(
                 f"TPU_RUNTIME_PROXY_ADDR={os.path.join(root, 'proxy.sock')}"
             )

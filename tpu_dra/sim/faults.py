@@ -24,6 +24,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+import weakref
 
 from tpu_dra.client.apiserver import ApiError, ConflictError
 
@@ -58,7 +59,10 @@ class FlakyApiServer:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._paused = threading.Event()
-        self._live_watches: "set[_BreakableWatch]" = set()
+        # WeakSet: wrappers whose consumers vanish without stop() (e.g. an
+        # aborted serving thread) must not accumulate across a long chaos
+        # run; explicit stop() still drops eagerly.
+        self._live_watches = weakref.WeakSet()
         self.faults_injected = 0
         self.calls = 0
 
@@ -172,6 +176,13 @@ class _BreakableWatch:
         if self._poisoned.is_set():
             raise UnavailableError("watch stream torn (scripted)")
         return self._inner.next(timeout)
+
+    def __iter__(self):
+        while True:
+            event = self.next()
+            if event is None:
+                return
+            yield event
 
     def deliver(self, event) -> None:  # protocol completeness
         self._inner.deliver(event)
