@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Build the one driver image (controller + plugin + set-nas-status +
+# runtime-proxy; reference: demo/clusters/kind/build-dra-driver.sh).
+set -euo pipefail
+source "$(dirname -- "${BASH_SOURCE[0]}")/common.sh"
+
+docker build \
+  -t "${DRIVER_IMAGE}" \
+  -f "${REPO_DIR}/deployments/container/Dockerfile" \
+  "${REPO_DIR}"

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Shared configuration for the kind rung (reference:
+# demo/clusters/kind/scripts/common.sh).  Every script sources this.
+
+CURRENT_DIR="$(cd -- "$(dirname -- "${BASH_SOURCE[0]}")" &>/dev/null && pwd)"
+REPO_DIR="$(cd -- "${CURRENT_DIR}/../../.." &>/dev/null && pwd)"
+
+: "${KIND_CLUSTER_NAME:=tpu-dra-driver-cluster}"
+# Needs a k8s version serving resource.k8s.io/v1alpha2 (1.27–1.29).
+: "${KIND_NODE_IMAGE:=kindest/node:v1.27.3}"
+: "${KIND_CLUSTER_CONFIG:=${CURRENT_DIR}/kind-cluster-config.yaml}"
+
+: "${DRIVER_IMAGE:=tpu-dra-driver:latest}"
+: "${DRIVER_NAMESPACE:=tpu-dra}"
+: "${HELM_RELEASE:=tpu-dra-driver}"
+: "${CHART_DIR:=${REPO_DIR}/deployments/helm/tpu-dra-driver}"
+: "${KIND_VALUES:=${CURRENT_DIR}/kind-values.yaml}"

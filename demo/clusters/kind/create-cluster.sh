@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Create the kind cluster with DRA + CDI enabled (reference:
+# demo/clusters/kind/create-cluster.sh).
+set -euo pipefail
+source "$(dirname -- "${BASH_SOURCE[0]}")/common.sh"
+
+kind create cluster \
+  --retain \
+  --name "${KIND_CLUSTER_NAME}" \
+  --image "${KIND_NODE_IMAGE}" \
+  --config "${KIND_CLUSTER_CONFIG}"
+
+kubectl cluster-info --context "kind-${KIND_CLUSTER_NAME}"

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Tear the kind cluster down (reference: demo/clusters/kind/delete-cluster.sh).
+set -euo pipefail
+source "$(dirname -- "${BASH_SOURCE[0]}")/common.sh"
+
+kind delete cluster --name "${KIND_CLUSTER_NAME}"

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Install the driver chart with REAL helm (reference:
+# demo/clusters/kind/install-dra-driver.sh).  CI separately golden-diffs
+# `helm template` against the in-repo helmlite renderer, so what installs
+# here is what the sim rungs validated.
+set -euo pipefail
+source "$(dirname -- "${BASH_SOURCE[0]}")/common.sh"
+
+helm upgrade --install "${HELM_RELEASE}" "${CHART_DIR}" \
+  --namespace "${DRIVER_NAMESPACE}" \
+  --create-namespace \
+  --values "${KIND_VALUES}" \
+  --kube-context "kind-${KIND_CLUSTER_NAME}" \
+  --wait
+
+kubectl --context "kind-${KIND_CLUSTER_NAME}" -n "${DRIVER_NAMESPACE}" \
+  get pods

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Side-load the locally built image into the kind nodes (reference:
+# demo/clusters/kind/scripts/load-driver-image-into-kind.sh).
+set -euo pipefail
+source "$(dirname -- "${BASH_SOURCE[0]}")/common.sh"
+
+kind load docker-image --name "${KIND_CLUSTER_NAME}" "${DRIVER_IMAGE}"

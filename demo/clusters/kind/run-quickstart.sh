@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Asserted acceptance on the real cluster: apply tpu-test1-kind, wait for
+# both pods Running, and verify each container saw a distinct claimed chip
+# through its injected TPU_VISIBLE_DEVICES (the `nvidia-smi -L` analog).
+set -euo pipefail
+source "$(dirname -- "${BASH_SOURCE[0]}")/common.sh"
+
+CTX="kind-${KIND_CLUSTER_NAME}"
+K="kubectl --context ${CTX}"
+
+${K} apply -f "${CURRENT_DIR}/specs/tpu-test1-kind.yaml"
+
+for pod in pod1 pod2; do
+  ${K} -n tpu-test1 wait --for=condition=Ready "pod/${pod}" --timeout=180s
+done
+
+dev1=$(${K} -n tpu-test1 logs pod1 | grep CLAIMED:)
+dev2=$(${K} -n tpu-test1 logs pod2 | grep CLAIMED:)
+echo "pod1 ${dev1}"
+echo "pod2 ${dev2}"
+if [ "${dev1}" = "${dev2}" ]; then
+  echo "FAIL: both pods claimed the same chip" >&2
+  exit 1
+fi
+echo "PASS: tpu-test1 on kind (2 pods, distinct claimed chips)"

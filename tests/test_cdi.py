@@ -99,6 +99,32 @@ class TestSubsliceClaimSpec:
         assert {n["path"] for n in edits["deviceNodes"]} == {"/dev/accel2"}
 
 
+class TestDevicePathClassification:
+    """Kind-rung contract: real device nodes become CDI deviceNodes; the
+    mock enumerator's regular-file devnodes become bind mounts (containerd
+    can't mknod a regular file into the container); absent paths are
+    assumed devices for back-compat."""
+
+    def test_regular_files_become_mounts(self, tmp_path):
+        lib = MockTpuLib(
+            "2x1x1",
+            state_dir=str(tmp_path / "state"),
+            devfs_dir=str(tmp_path / "devfs"),  # real (empty) files
+        )
+        handler = CDIHandler(str(tmp_path / "cdi"), lib)
+        path = handler.create_claim_spec_file("uid-f", prepared_tpus("mock-tpu-0"))
+        edits = json.load(open(path))["devices"][0]["containerEdits"]
+        assert "deviceNodes" not in edits
+        devnode = str(tmp_path / "devfs" / "accel0")
+        assert any(m["hostPath"] == devnode for m in edits["mounts"])
+
+    def test_absent_paths_stay_device_nodes(self, handler):
+        # Default mock paths are /dev/accelN, which don't exist here.
+        path = handler.create_claim_spec_file("uid-d", prepared_tpus("mock-tpu-0"))
+        edits = json.load(open(path))["devices"][0]["containerEdits"]
+        assert {n["path"] for n in edits["deviceNodes"]} == {"/dev/accel0"}
+
+
 class TestLifecycle:
     def test_exists_list_delete(self, handler):
         handler.create_claim_spec_file("uid-a", prepared_tpus("mock-tpu-0"))

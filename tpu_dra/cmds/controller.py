@@ -74,6 +74,7 @@ class ControllerApp:
 
     def stop(self) -> None:
         self.controller.stop()
+        self.driver.close()
         if self.metrics_server:
             self.metrics_server.stop()
 

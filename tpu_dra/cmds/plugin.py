@@ -93,6 +93,10 @@ def build_tpulib(args: argparse.Namespace):
             args.mock_tpulib_mesh,
             partitionable=args.mock_partitionable,
             state_dir=os.path.join(args.state_dir, "tpulib"),
+            # Fake devnodes as real files under the (hostPath-backed) state
+            # dir: on a real cluster (kind rung) the CDI handler bind-mounts
+            # them into consumers, so mock pods schedule end to end.
+            devfs_dir=os.path.join(args.state_dir, "devfs"),
             ici_domain=args.node_name or "local",
         )
     from tpu_dra.plugin.tpulib import RealTpuLib

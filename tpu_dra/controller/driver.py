@@ -356,6 +356,16 @@ class ControllerDriver:
                     )
         return self._fanout_pool
 
+    def close(self) -> None:
+        """Release the fan-out pool's threads.  Wired into ControllerApp
+        and SimCluster stop paths so driver start/stop cycles (tests, chaos
+        runs) don't each pin FANOUT_PARALLELISM idle threads for the rest
+        of the process."""
+        with self._fanout_pool_lock:
+            pool, self._fanout_pool = self._fanout_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+
     def unsuitable_nodes(
         self, pod: Pod, cas: list[ClaimAllocation], potential_nodes: list[str]
     ) -> None:
@@ -365,16 +375,21 @@ class ControllerDriver:
         with UNSUITABLE_SECONDS.time():
             dead = self._dead_pending_claims(potential_nodes)
             if len(potential_nodes) > 1:
-                # list() propagates the first worker exception, matching
-                # the serial loop's behavior.
-                list(
-                    self._fanout_executor().map(
-                        lambda node: self._unsuitable_node(
-                            pod, cas, node, dead
-                        ),
-                        potential_nodes,
+                from concurrent.futures import wait
+
+                futures = [
+                    self._fanout_executor().submit(
+                        self._unsuitable_node, pod, cas, node, dead
                     )
-                )
+                    for node in potential_nodes
+                ]
+                # Join ALL probes before raising (as the old per-call
+                # context manager did): a straggler left running would race
+                # a retry's pass over the same ClaimAllocation lists and
+                # squat on the shared pool's threads.
+                wait(futures)
+                for future in futures:
+                    future.result()
             else:
                 for node in potential_nodes:
                     self._unsuitable_node(pod, cas, node, dead)

@@ -65,18 +65,45 @@ class CDIHandler:
             )
         return {"mounts": mounts} if mounts else {}
 
+    @staticmethod
+    def _device_entries(paths: "list[str]") -> dict:
+        """Split chip device paths into CDI ``deviceNodes`` vs bind
+        ``mounts``: real device nodes (and paths absent on this host —
+        assume devices) go to deviceNodes; REGULAR files (the mock
+        enumerator's fake devnodes on a kind worker) must be bind-mounted,
+        since the runtime can't mknod a regular file into the container."""
+        import stat
+
+        out: dict = {"deviceNodes": [], "mounts": []}
+        for path in paths:
+            try:
+                mode = os.stat(path).st_mode
+            except OSError:
+                out["deviceNodes"].append({"path": path})
+                continue
+            if stat.S_ISCHR(mode) or stat.S_ISBLK(mode):
+                out["deviceNodes"].append({"path": path})
+            else:
+                out["mounts"].append(
+                    {
+                        "hostPath": path,
+                        "containerPath": path,
+                        "options": ["rw", "nosuid", "nodev", "bind"],
+                    }
+                )
+        return out
+
     def _tpu_edits(
         self, prepared: nascrd.PreparedTpus, allocated: nascrd.AllocatedDevices | None
     ) -> dict:
-        device_nodes = []
+        paths = []
         indices = []
         generations = set()
         for dev in prepared.devices:
             info = self._tpulib.chip_info(dev.uuid)
             indices.append(info.tpu.index)
             generations.add(info.tpu.generation)
-            for path in info.device_paths:
-                device_nodes.append({"path": path})
+            paths.extend(info.device_paths)
         env = [
             "TPU_VISIBLE_DEVICES=" + ",".join(str(i) for i in sorted(indices)),
         ]
@@ -96,38 +123,36 @@ class CDIHandler:
             env.append(f"TPU_DRA_GANG_COORDINATOR={gang.coordinator}")
             env.append(f"TPU_DRA_GANG_SIZE={gang.size}")
             env.append(f"TPU_DRA_GANG_RANK={gang.rank}")
-        return {"deviceNodes": device_nodes, "env": env}
+        return {**self._device_entries(paths), "env": env}
 
     def _subslice_edits(self, prepared: nascrd.PreparedSubslices) -> dict:
-        device_nodes = []
+        paths = []
         envs = []
         for dev in prepared.devices:
             info = self._tpulib.chip_info(dev.parent_uuid)
-            for path in info.device_paths:
-                device_nodes.append({"path": path})
+            paths.extend(info.device_paths)
             envs.append(f"TPU_VISIBLE_DEVICES={info.tpu.index}")
             start = dev.placement.start
             end = start + dev.placement.size - 1
             envs.append(f"TPU_VISIBLE_CORES={start}-{end}")
             envs.append(f"TPU_SUBSLICE_UUID={dev.uuid}")
-        return {"deviceNodes": device_nodes, "env": envs}
+        return {**self._device_entries(paths), "env": envs}
 
     def _core_edits(self, prepared: nascrd.PreparedCores) -> dict:
         """Core claims (CI-of-shared-subslice): same parent-chip visibility
         as subslices, scoped to the carved interval, plus the parent claim
         UID so a consumer can identify which shared subslice it lives in."""
-        device_nodes = []
+        paths = []
         envs = []
         for dev in prepared.devices:
             info = self._tpulib.chip_info(dev.parent_uuid)
-            for path in info.device_paths:
-                device_nodes.append({"path": path})
+            paths.extend(info.device_paths)
             envs.append(f"TPU_VISIBLE_DEVICES={info.tpu.index}")
             start = dev.placement.start
             end = start + dev.placement.size - 1
             envs.append(f"TPU_VISIBLE_CORES={start}-{end}")
             envs.append(f"TPU_CORE_PARENT_CLAIM={dev.subslice_claim_uid}")
-        return {"deviceNodes": device_nodes, "env": envs}
+        return {**self._device_entries(paths), "env": envs}
 
     @staticmethod
     def _merge_edits(*edits: dict) -> dict:

@@ -174,6 +174,7 @@ class SimCluster:
     def stop(self) -> None:
         self.kubesim.stop()
         self.controller.stop()
+        self.controller_driver.close()
         for node in self.nodes:
             node.stop()
 
