@@ -14,12 +14,21 @@ for pod in pod1 pod2; do
   ${K} -n tpu-test1 wait --for=condition=Ready "pod/${pod}" --timeout=180s
 done
 
-dev1=$(${K} -n tpu-test1 logs pod1 | grep CLAIMED:)
-dev2=$(${K} -n tpu-test1 logs pod2 | grep CLAIMED:)
-echo "pod1 ${dev1}"
-echo "pod2 ${dev2}"
-if [ "${dev1}" = "${dev2}" ]; then
-  echo "FAIL: both pods claimed the same chip" >&2
+${K} -n tpu-test1 logs pod1 | grep "CLAIMED:"
+${K} -n tpu-test1 logs pod2 | grep "CLAIMED:"
+
+# Distinctness must be judged on the CHIP alone (TPU_VISIBLE_DEVICES), not
+# the full env — TPU_DRA_CLAIM is per-claim-unique and would always differ.
+# Two pods on the same node must hold different chip indices; on different
+# nodes any index is fine (indices are node-local).
+dev1=$(${K} -n tpu-test1 logs pod1 | grep "CLAIMED_DEVICES:" | awk '{print $2}')
+dev2=$(${K} -n tpu-test1 logs pod2 | grep "CLAIMED_DEVICES:" | awk '{print $2}')
+node1=$(${K} -n tpu-test1 get pod pod1 -o jsonpath='{.spec.nodeName}')
+node2=$(${K} -n tpu-test1 get pod pod2 -o jsonpath='{.spec.nodeName}')
+echo "pod1 on ${node1}: chips ${dev1}"
+echo "pod2 on ${node2}: chips ${dev2}"
+if [ "${node1}" = "${node2}" ] && [ "${dev1}" = "${dev2}" ]; then
+  echo "FAIL: both pods claimed chip(s) ${dev1} on ${node1}" >&2
   exit 1
 fi
 echo "PASS: tpu-test1 on kind (2 pods, distinct claimed chips)"

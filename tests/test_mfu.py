@@ -115,6 +115,61 @@ class TestChipSizedConfig:
             assert c.d_model % c.n_heads == 0
 
 
+class TestFallbackLadder:
+    def test_shrinks_until_it_fits(self, monkeypatch):
+        """OOM headroom varies across runtime versions: the auto-config
+        path must shrink and return a measured number, not an error."""
+        import tpu_dra.parallel.burnin as burnin
+        from tpu_dra.parallel import mfu
+
+        orig = burnin.make_train_step
+
+        def failing(c, mesh=None):
+            if c.batch > 2:
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            return orig(c, mesh)
+
+        monkeypatch.setattr(burnin, "make_train_step", failing)
+        monkeypatch.setattr(
+            mfu, "chip_perf_for", lambda dev: mfu.CHIP_PERF["v5e"]
+        )
+        monkeypatch.setattr(
+            mfu,
+            "chip_sized_config",
+            lambda h: BurninConfig(batch=8),
+        )
+        r = mfu.measure_mfu(warmup_steps=1, timed_steps=2)
+        assert r.ok, r.error
+        assert r.tokens_per_step == 2 * BurninConfig().seq
+
+    def test_bottom_of_ladder_reports_error(self, monkeypatch):
+        import tpu_dra.parallel.burnin as burnin
+        from tpu_dra.parallel import mfu
+
+        def always_fail(c, mesh=None):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+        monkeypatch.setattr(burnin, "make_train_step", always_fail)
+        monkeypatch.setattr(
+            mfu, "chip_perf_for", lambda dev: mfu.CHIP_PERF["v5e"]
+        )
+        r = mfu.measure_mfu(warmup_steps=1, timed_steps=1)
+        assert not r.ok and "RESOURCE_EXHAUSTED" in r.error
+
+    def test_shrink_order(self):
+        from tpu_dra.parallel.mfu import _shrink, chip_sized_config
+
+        c = chip_sized_config(16)
+        seen = []
+        while c is not None:
+            seen.append((c.batch, c.n_layers, c.d_model))
+            c = _shrink(c)
+        # batch first, then depth, then width; terminates.
+        assert seen[0] == (8, 8, 2048)
+        assert seen[-1][2] == 512 or seen[-1][0] == 2
+        assert len(seen) < 12
+
+
 class TestMeasurement:
     def test_measure_mfu_cpu_rung(self):
         r = measure_mfu(BurninConfig(), warmup_steps=1, timed_steps=2)

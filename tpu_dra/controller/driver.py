@@ -50,6 +50,7 @@ class ControllerDriver:
         self.core = CoreDriver()
         self._fanout_pool = None
         self._fanout_pool_lock = threading.Lock()
+        self._fanout_closed = False
         from tpu_dra.controller.gang_tracker import GangTracker
 
         self.gangs = GangTracker(clientset, namespace)
@@ -343,18 +344,22 @@ class ControllerDriver:
 
     def _fanout_executor(self):
         """One long-lived pool per driver (thread churn per fan-out would
-        land on the very path this parallelism speeds up); interpreter
-        shutdown joins it via concurrent.futures' atexit hook."""
-        if self._fanout_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
+        land on the very path this parallelism speeds up).  Created and
+        returned under the lock so close() can't null it mid-call, and
+        never re-created after close() — a straggling reconciler worker
+        that outlived its 5s join must not resurrect a pool nothing will
+        shut down (it gets a clean RuntimeError instead)."""
+        with self._fanout_pool_lock:
+            if self._fanout_closed:
+                raise RuntimeError("controller driver is closed")
+            if self._fanout_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
 
-            with self._fanout_pool_lock:
-                if self._fanout_pool is None:
-                    self._fanout_pool = ThreadPoolExecutor(
-                        max_workers=self.FANOUT_PARALLELISM,
-                        thread_name_prefix="fanout",
-                    )
-        return self._fanout_pool
+                self._fanout_pool = ThreadPoolExecutor(
+                    max_workers=self.FANOUT_PARALLELISM,
+                    thread_name_prefix="fanout",
+                )
+            return self._fanout_pool
 
     def close(self) -> None:
         """Release the fan-out pool's threads.  Wired into ControllerApp
@@ -363,6 +368,7 @@ class ControllerDriver:
         of the process."""
         with self._fanout_pool_lock:
             pool, self._fanout_pool = self._fanout_pool, None
+            self._fanout_closed = True
         if pool is not None:
             pool.shutdown(wait=False, cancel_futures=True)
 

@@ -160,6 +160,25 @@ class MfuReport:
     error: str = ""
 
 
+def _shrink(c: BurninConfig) -> "BurninConfig | None":
+    """Next rung down the fallback ladder: halve the dominant memory axis.
+    Returns None at the bottom."""
+    import dataclasses
+
+    if c.batch > 2:
+        return dataclasses.replace(c, batch=c.batch // 2)
+    if c.n_layers > 2:
+        return dataclasses.replace(c, n_layers=c.n_layers // 2)
+    if c.d_model > 512:
+        return dataclasses.replace(
+            c,
+            d_model=c.d_model // 2,
+            d_ff=c.d_ff // 2,
+            n_heads=max(c.n_heads // 2, 1),
+        )
+    return None
+
+
 def measure_mfu(
     config: "BurninConfig | None" = None,
     *,
@@ -171,7 +190,30 @@ def measure_mfu(
     Unlike burnin.train (which fetches the loss synchronously every step to
     assert learning), the timed window here keeps the device pipeline full:
     steps are enqueued back-to-back and only the final step's loss is
-    fetched, so the measurement sees compute, not dispatch."""
+    fetched, so the measurement sees compute, not dispatch.
+
+    When no config is given, the chip-sized one is tried first and shrunk
+    on failure (OOM headroom varies across runtime versions): a smaller
+    measured number beats an errored-out benchmark."""
+    if config is None:
+        try:
+            import jax
+
+            perf = chip_perf_for(jax.devices()[0])
+        except Exception as e:  # backend init failure: report, don't raise
+            return MfuReport(ok=False, error=f"{type(e).__name__}: {e}")
+        attempt: "BurninConfig | None" = (
+            chip_sized_config(perf.hbm_gib) if perf is not None else BurninConfig()
+        )
+        report = MfuReport(ok=False, error="no config attempted")
+        while attempt is not None:
+            report = measure_mfu(
+                attempt, warmup_steps=warmup_steps, timed_steps=timed_steps
+            )
+            if report.ok or not report.error:
+                return report
+            attempt = _shrink(attempt)
+        return report
     import time
 
     import jax
@@ -181,12 +223,6 @@ def measure_mfu(
     try:
         dev = jax.devices()[0]
         perf = chip_perf_for(dev)
-        if config is None:
-            config = (
-                chip_sized_config(perf.hbm_gib)
-                if perf is not None
-                else BurninConfig()
-            )
         c = config
         step_fn, state = make_train_step(c, mesh=None)
         tokens = sample_tokens(c)
