@@ -1,0 +1,132 @@
+"""Ring attention: exactness vs the single-device oracle on the virtual
+8-device mesh, causality across shard boundaries, jit/scan compatibility,
+and gradient flow (the training path uses it under jax.checkpoint)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from tpu_dra.parallel.ring import (
+    reference_attention,
+    ring_attention_sharded,
+)
+
+B, S, H, D = 2, 32, 4, 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    return Mesh(devices, ("data", "ctx"))
+
+
+def make_qkv(key=0, s=S):
+    ks = jax.random.split(jax.random.PRNGKey(key), 3)
+    shape = (B, s, H, D)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+class TestExactness:
+    def test_matches_reference_causal(self, mesh):
+        q, k, v = make_qkv()
+        want = reference_attention(q, k, v, causal=True)
+        got = ring_attention_sharded(q, k, v, mesh, "ctx", causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_matches_reference_non_causal(self, mesh):
+        q, k, v = make_qkv(key=1)
+        want = reference_attention(q, k, v, causal=False)
+        got = ring_attention_sharded(q, k, v, mesh, "ctx", causal=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_matches_under_jit_with_sharded_inputs(self, mesh):
+        q, k, v = make_qkv(key=2)
+        sharding = NamedSharding(mesh, P("data", "ctx", None, None))
+        qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+
+        @jax.jit
+        def run(q, k, v):
+            return ring_attention_sharded(q, k, v, mesh, "ctx")
+
+        got = run(qs, ks, vs)
+        want = reference_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_bf16_inputs(self, mesh):
+        q, k, v = (x.astype(jnp.bfloat16) for x in make_qkv(key=3))
+        got = ring_attention_sharded(q, k, v, mesh, "ctx")
+        want = reference_attention(q, k, v)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
+        )
+
+
+class TestCausality:
+    def test_first_position_sees_only_itself(self, mesh):
+        # Output at position 0 must equal v[0] exactly — any cross-shard
+        # leak from later K/V blocks would change it.
+        q, k, v = make_qkv(key=4)
+        got = ring_attention_sharded(q, k, v, mesh, "ctx")
+        np.testing.assert_allclose(
+            np.asarray(got[:, 0]), np.asarray(v[:, 0]), atol=1e-5
+        )
+
+    def test_future_kv_cannot_influence_past(self, mesh):
+        # Perturb K/V in the LAST context shard; outputs for all earlier
+        # positions must be bit-for-bit unchanged.
+        q, k, v = make_qkv(key=5)
+        base = np.asarray(ring_attention_sharded(q, k, v, mesh, "ctx"))
+        cut = S - S // 4  # the final ctx shard's block
+        k2 = k.at[:, cut:].add(7.0)
+        v2 = v.at[:, cut:].add(-3.0)
+        pert = np.asarray(ring_attention_sharded(q, k2, v2, mesh, "ctx"))
+        np.testing.assert_array_equal(pert[:, :cut], base[:, :cut])
+        assert not np.allclose(pert[:, cut:], base[:, cut:])
+
+
+class TestTraining:
+    def test_gradients_flow_through_the_ring(self, mesh):
+        q, k, v = make_qkv(key=6)
+        sharding = NamedSharding(mesh, P("data", "ctx", None, None))
+        qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+
+        @jax.jit
+        def loss(q, k, v):
+            out = ring_attention_sharded(q, k, v, mesh, "ctx")
+            return (out.astype(jnp.float32) ** 2).mean()
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(qs, ks, vs)
+
+        def ref_loss(q, k, v):
+            return (reference_attention(q, k, v).astype(jnp.float32) ** 2).mean()
+
+        want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(grads, want):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), atol=1e-5
+            )
+
+    def test_memory_scales_with_block_not_sequence(self, mesh):
+        # Structural property: per-device score blocks are (s/P)^2, so a
+        # 4x longer sequence on the same mesh only grows compiled peak
+        # memory ~16x/P, not 16x.  We can't read device memory on CPU;
+        # assert the lowering instead — no op in the jaxpr materializes an
+        # (S, S) score matrix.
+        q, k, v = make_qkv(key=7, s=64)
+        sharding = NamedSharding(mesh, P("data", "ctx", None, None))
+        qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+
+        def run(q, k, v):
+            return ring_attention_sharded(q, k, v, mesh, "ctx")
+
+        jaxpr = jax.make_jaxpr(run)(qs, ks, vs)
+        text = str(jaxpr).replace(" ", "")
+        s_local = 64 // 4
+        # Score blocks are (s_local, s_local); a full (S, S) score tensor
+        # would show up as a "...,64,64]" aval.
+        assert f"{s_local},{s_local}]" in text
+        assert "64,64]" not in text

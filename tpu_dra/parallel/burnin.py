@@ -25,6 +25,12 @@ collectives):
 - **sp**: the residual stream between blocks is sequence-sharded over
   ``model`` (Megatron sequence parallelism — the all-gather/reduce-scatter
   pair replaces the psum, halving peak activation memory in norm regions).
+- **cp** (``ring_attention=True``): attention itself runs context-parallel —
+  the sequence STAYS sharded through attention and K/V blocks rotate around
+  the ``model`` axis ring (tpu_dra/parallel/ring.py), so no chip ever holds
+  the full sequence or an s x s score matrix.  Heads are replicated in this
+  mode (cp replaces tp inside attention; the MLP keeps tp).  This is the
+  long-context configuration: per-chip attention memory is O((s/P)^2).
 
 Compiler-friendliness: layers are stacked and iterated with ``lax.scan``
 (one trace regardless of depth), every shape is static, blocks are
@@ -86,6 +92,9 @@ class BurninConfig:
     seq: int = 128
     batch: int = 8
     learning_rate: float = 1e-2
+    # Context parallelism: ring attention over the mesh's ``model`` axis
+    # (sequence stays sharded through attention; heads replicated there).
+    ring_attention: bool = False
 
     @property
     def d_head(self) -> int:
@@ -149,15 +158,26 @@ def init_params(config: BurninConfig, key=None):
 
 def param_specs(config: BurninConfig):
     """PartitionSpec pytree: fsdp shards the non-tp dim of every matrix,
-    model (tp) shards heads / ffn-hidden / vocab-out (Megatron layout)."""
+    model (tp) shards heads / ffn-hidden / vocab-out (Megatron layout).
+    With ring attention, heads are replicated (context parallelism replaces
+    tp inside attention) and only fsdp shards the attention matrices."""
     from jax.sharding import PartitionSpec as P
 
+    if config.ring_attention:
+        attn = {
+            "wqkv": P(None, "fsdp", None, None, None),
+            "wo": P(None, None, None, "fsdp"),
+        }
+    else:
+        attn = {
+            "wqkv": P(None, "fsdp", None, "model", None),
+            "wo": P(None, "model", None, "fsdp"),
+        }
     return {
         "embed": P("fsdp", "model"),
         "pos": P(None, "model"),
         "layers": {
-            "wqkv": P(None, "fsdp", None, "model", None),
-            "wo": P(None, "model", None, "fsdp"),
+            **attn,
             "w1": P(None, "fsdp", "model"),
             "w2": P(None, "model", "fsdp"),
             "ln1": P(None, None),
@@ -180,28 +200,43 @@ def _rms_norm(x, scale):
     return (x / rms) * scale
 
 
-def _block(layer, x, *, config: BurninConfig, constrain):
+def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
     """One pre-norm transformer block.  ``constrain(kind, arr)`` applies the
-    sp/tp sharding constraints; identity when running unsharded."""
+    sp/tp sharding constraints; identity when running unsharded.  With
+    ``ring_mesh`` set (and config.ring_attention), attention runs
+    context-parallel: the sequence stays sharded and K/V ride the ring."""
     import jax.numpy as jnp
 
     c = config
     bf16 = jnp.bfloat16
 
-    # --- attention (tp over heads) ---
-    h = constrain("seq", x)  # sp region: (batch, seq/model, d)
-    h = _rms_norm(h, layer["ln1"])
-    h = constrain("hidden", h.astype(bf16))  # gather seq, enter tp region
-    qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(bf16))
-    q, k_, v = qkv[0], qkv[1], qkv[2]
-    scores = jnp.einsum("bshk,bthk->bhst", q, k_) / (c.d_head**0.5)
-    mask = jnp.tril(jnp.ones((c.seq, c.seq), bool))
-    scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
-    probs = jnp.exp(scores - scores.max(-1, keepdims=True))
-    probs = (probs / probs.sum(-1, keepdims=True)).astype(bf16)
-    att = jnp.einsum("bhst,bthk->bshk", probs, v)
-    att = jnp.einsum("bshk,hkd->bsd", att, layer["wo"].astype(bf16))
-    x = x + constrain("seq", att)  # row-parallel out: XLA reduce-scatters into sp
+    if c.ring_attention and ring_mesh is not None:
+        # --- attention (cp: ring over the model axis, heads replicated) ---
+        from tpu_dra.parallel.ring import ring_attention_sharded
+
+        h = constrain("seq", x)  # stays (batch, seq/model, d) throughout
+        h = _rms_norm(h, layer["ln1"]).astype(bf16)
+        qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(bf16))
+        att = ring_attention_sharded(
+            qkv[0], qkv[1], qkv[2], ring_mesh, "model", causal=True
+        )
+        att = jnp.einsum("bshk,hkd->bsd", att, layer["wo"].astype(bf16))
+        x = x + constrain("seq", att)
+    else:
+        # --- attention (tp over heads) ---
+        h = constrain("seq", x)  # sp region: (batch, seq/model, d)
+        h = _rms_norm(h, layer["ln1"])
+        h = constrain("hidden", h.astype(bf16))  # gather seq, enter tp region
+        qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(bf16))
+        q, k_, v = qkv[0], qkv[1], qkv[2]
+        scores = jnp.einsum("bshk,bthk->bhst", q, k_) / (c.d_head**0.5)
+        mask = jnp.tril(jnp.ones((c.seq, c.seq), bool))
+        scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
+        probs = jnp.exp(scores - scores.max(-1, keepdims=True))
+        probs = (probs / probs.sum(-1, keepdims=True)).astype(bf16)
+        att = jnp.einsum("bhst,bthk->bshk", probs, v)
+        att = jnp.einsum("bshk,hkd->bsd", att, layer["wo"].astype(bf16))
+        x = x + constrain("seq", att)  # row-parallel out: XLA reduce-scatters into sp
 
     # --- mlp (tp over d_ff) ---
     h = _rms_norm(constrain("seq", x), layer["ln2"])
@@ -221,6 +256,11 @@ def forward(params, tokens, config: BurninConfig, mesh=None):
 
     c = config
     if mesh is None:
+        if c.ring_attention:
+            # A silent dense fallback would let a single-chip check report
+            # the long-context configuration as validated without running
+            # one line of the ring path.
+            raise ValueError("ring_attention requires a device mesh")
         constrain = lambda kind, arr: arr  # noqa: E731
     else:
         from jax.sharding import NamedSharding
@@ -238,7 +278,11 @@ def forward(params, tokens, config: BurninConfig, mesh=None):
 
     x = params["embed"][tokens] + params["pos"][None, :, :]
 
-    block = jax.checkpoint(functools.partial(_block, config=c, constrain=constrain))
+    block = jax.checkpoint(
+        functools.partial(
+            _block, config=c, constrain=constrain, ring_mesh=mesh
+        )
+    )
 
     def scan_body(h, layer):
         return block(layer, h), None
