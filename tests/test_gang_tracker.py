@@ -254,6 +254,71 @@ class TestAudit:
         assert any("ICI domains" in w for w in warnings)
 
 
+class TestAuditSweep:
+    """The level-triggered backstop: ControllerDriver.audit_gangs finds
+    split-brained gangs from the NAS state alone and repairs them — no
+    assign/commit/deallocate event needed."""
+
+    def make_driver(self, cs):
+        from tpu_dra.controller.driver import ControllerDriver
+
+        return ControllerDriver(cs, NS)
+
+    def test_sweep_repairs_coordinator_disagreement(self, cs):
+        driver = self.make_driver(cs)
+        tracker = driver.gangs
+        gang = GangConfig(name="g", size=2)
+        a0 = tracker.assign(gang, "default", "uid-0", "n0")
+        commit_to_nas(cs, "n0", "uid-0", a0)
+        tracker.commit("uid-0", "default", "g")
+        a1 = tracker.assign(gang, "default", "uid-1", "n1")
+        commit_to_nas(cs, "n1", "uid-1", a1)
+        tracker.commit("uid-1", "default", "g")
+        # Corrupt a member's coordinator directly in the NAS (simulating a
+        # window no event-triggered check saw).
+        nas = cs.node_allocation_states(NS).get("n1")
+        nas.spec.allocated_claims["uid-1"].tpu.gang.coordinator = "stale:1"
+        cs.node_allocation_states(NS).update(nas)
+
+        results = driver.audit_gangs()
+        assert ("default", "g") in results
+        assert any("coordinator" in w for w in results[("default", "g")])
+        # Repair ran: members converged on the committed rank-0's address.
+        nas = cs.node_allocation_states(NS).get("n1")
+        assert (
+            nas.spec.allocated_claims["uid-1"].tpu.gang.coordinator
+            == "n0:8476"
+        )
+        assert driver.audit_gangs() == {}  # healthy now
+        driver.close()
+
+    def test_sweep_ignores_healthy_gangs(self, cs):
+        driver = self.make_driver(cs)
+        tracker = driver.gangs
+        gang = GangConfig(name="h", size=2)
+        for i, node in enumerate(["n0", "n1"]):
+            a = tracker.assign(gang, "default", f"uid-{i}", node)
+            commit_to_nas(cs, node, f"uid-{i}", a)
+            tracker.commit(f"uid-{i}", "default", "h")
+        assert driver.audit_gangs() == {}
+        driver.close()
+
+    def test_auditor_thread_lifecycle(self, cs):
+        import threading
+        import time
+
+        driver = self.make_driver(cs)
+        driver.start_gang_auditor(interval_s=0.05)
+        time.sleep(0.2)  # a few sweeps over the empty cluster
+        assert any(
+            t.name == "gang-auditor" for t in threading.enumerate()
+        )
+        driver.close()
+        assert not any(
+            t.name == "gang-auditor" for t in threading.enumerate()
+        )
+
+
 class TestCrashRecovery:
     def test_rebuilds_from_nas(self, cs):
         gang = GangConfig(name="g", size=4)

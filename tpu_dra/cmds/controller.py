@@ -68,6 +68,8 @@ class ControllerApp:
             self.metrics_server.start()
             logger.info("http endpoint on %s", self.args.http_endpoint)
         self.controller.start()
+        # Level-triggered gang health: periodic audit + coordinator repair.
+        self.driver.start_gang_auditor()
         logger.info(
             "controller %s running with %d workers", version_string(), self.args.workers
         )

@@ -51,9 +51,74 @@ class ControllerDriver:
         self._fanout_pool = None
         self._fanout_pool_lock = threading.Lock()
         self._fanout_closed = False
+        self._auditor_stop = threading.Event()
+        self._auditor_thread: "threading.Thread | None" = None
         from tpu_dra.controller.gang_tracker import GangTracker
 
         self.gangs = GangTracker(clientset, namespace)
+
+    # -- gang audit loop ------------------------------------------------------
+
+    def audit_gangs(self) -> "dict[tuple, list[str]]":
+        """One audit sweep over every committed gang: returns the warning
+        lists (keyed by (namespace, gang name)) and, when members disagree
+        on a coordinator, runs the repair — the level-triggered backstop
+        behind the event-triggered checks (assign/commit/deallocate), so no
+        interleaving can leave a gang split-brained past one sweep."""
+        import logging
+
+        logger = logging.getLogger(__name__)
+        # ONE namespace listing feeds gang discovery and every per-gang
+        # scan; only the actual repair writes re-read fresh state (under
+        # the node locks).
+        nases = self.clientset.node_allocation_states(self.namespace).list()
+        seen: "set[tuple[str, str]]" = set()
+        for nas in nases:
+            for alloc in nas.spec.allocated_claims.values():
+                if alloc.tpu is not None and alloc.tpu.gang is not None:
+                    ns = alloc.claim_info.namespace if alloc.claim_info else ""
+                    seen.add((ns, alloc.tpu.gang.name))
+        results: "dict[tuple, list[str]]" = {}
+        for ns, name in sorted(seen):
+            warnings = self.gangs.audit(ns, name, nases=nases)
+            if not warnings:
+                continue
+            results[(ns, name)] = warnings
+            for w in warnings:
+                logger.warning("gang %s/%s: %s", ns, name, w)
+            if any("coordinator" in w for w in warnings):
+                try:
+                    repaired = self.gangs.repair_coordinators(
+                        ns, name, node_lock=self.lock, nases=nases
+                    )
+                    logger.info(
+                        "gang %s/%s: repaired %d member(s)", ns, name, repaired
+                    )
+                except Exception:
+                    logger.exception(
+                        "gang %s/%s coordinator repair failed (next sweep "
+                        "retries)", ns, name
+                    )
+        return results
+
+    def start_gang_auditor(self, interval_s: float = 60.0) -> None:
+        """Background periodic audit_gangs loop; stopped by close()."""
+        if self._auditor_thread is not None:
+            return
+
+        def loop():
+            while not self._auditor_stop.wait(interval_s):
+                try:
+                    self.audit_gangs()
+                except Exception:
+                    import logging
+
+                    logging.getLogger(__name__).exception("gang audit failed")
+
+        self._auditor_thread = threading.Thread(
+            target=loop, name="gang-auditor", daemon=True
+        )
+        self._auditor_thread.start()
 
     # -- parameter resolution (driver.go:61-107) -----------------------------
 
@@ -371,6 +436,10 @@ class ControllerDriver:
             self._fanout_closed = True
         if pool is not None:
             pool.shutdown(wait=False, cancel_futures=True)
+        self._auditor_stop.set()
+        if self._auditor_thread is not None:
+            self._auditor_thread.join(timeout=5)
+            self._auditor_thread = None
 
     def unsuitable_nodes(
         self, pod: Pod, cas: list[ClaimAllocation], potential_nodes: list[str]

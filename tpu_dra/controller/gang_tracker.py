@@ -76,11 +76,17 @@ class GangTracker:
         # post-commit consistency scan; healthy steady-state commits skip it.
         self._tentative_coord: "set[tuple[str, str]]" = set()
 
-    def _scan(self, key: "tuple[str, str]") -> GangView:
-        """Gang state persisted in the NAS objects (all nodes)."""
+    def _scan(self, key: "tuple[str, str]", nases=None) -> GangView:
+        """Gang state persisted in the NAS objects (all nodes).
+
+        ``nases``: optional pre-listed NAS objects — the audit sweep passes
+        one listing into every per-gang scan instead of re-listing the
+        whole namespace O(gangs) times."""
         namespace, gang_name = key
         view = GangView()
-        for nas in self._clientset.node_allocation_states(self._namespace).list():
+        if nases is None:
+            nases = self._clientset.node_allocation_states(self._namespace).list()
+        for nas in nases:
             node = nas.metadata.name
             view.addresses[node] = nas.spec.node_address
             domains = {
@@ -267,7 +273,7 @@ class GangTracker:
     # -- post-commit reconciliation ------------------------------------------
 
     def repair_coordinators(
-        self, claim_namespace: str, gang_name: str, node_lock=None
+        self, claim_namespace: str, gang_name: str, node_lock=None, nases=None
     ) -> int:
         """Rewrite committed members whose coordinator disagrees with the
         committed rank-0's address (rank-0 reallocation onto another node,
@@ -281,7 +287,9 @@ class GangTracker:
         from tpu_dra.api.meta import ObjectMeta
 
         key = (claim_namespace, gang_name)
-        view = self._scan(key)
+        # A pre-listed view only picks the repair TARGETS; each node's
+        # rewrite still re-reads fresh state under that node's lock.
+        view = self._scan(key, nases)
         rank0_uid = next(
             (uid for uid, a in view.committed.items() if a.rank == 0), None
         )
@@ -331,12 +339,14 @@ class GangTracker:
                 retry_on_conflict(fix)
         return repaired
 
-    def audit(self, claim_namespace: str, gang_name: str) -> "list[str]":
+    def audit(
+        self, claim_namespace: str, gang_name: str, nases=None
+    ) -> "list[str]":
         """Cross-host ICI health of the committed gang.  Returns warnings:
         a gang whose members span multiple ICI domains (different slices)
         cannot ride ICI for its collectives; duplicate ranks indicate
         corruption."""
-        view = self._scan((claim_namespace, gang_name))
+        view = self._scan((claim_namespace, gang_name), nases)
         warnings: "list[str]" = []
         ranks: "dict[int, str]" = {}
         for uid, a in view.committed.items():

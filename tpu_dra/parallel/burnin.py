@@ -25,12 +25,14 @@ collectives):
 - **sp**: the residual stream between blocks is sequence-sharded over
   ``model`` (Megatron sequence parallelism — the all-gather/reduce-scatter
   pair replaces the psum, halving peak activation memory in norm regions).
-- **cp** (``ring_attention=True``): attention itself runs context-parallel —
-  the sequence STAYS sharded through attention and K/V blocks rotate around
-  the ``model`` axis ring (tpu_dra/parallel/ring.py), so no chip ever holds
-  the full sequence or an s x s score matrix.  Heads are replicated in this
-  mode (cp replaces tp inside attention; the MLP keeps tp).  This is the
-  long-context configuration: per-chip attention memory is O((s/P)^2).
+- **cp** (``ring_attention=True``): the whole transformer stack runs
+  context-parallel — the sequence stays sharded through attention (K/V
+  blocks rotate around the ``model`` axis ring, tpu_dra/parallel/ring.py)
+  AND through the position-wise MLP, so no chip materializes the full
+  sequence or an s x s score matrix anywhere between embedding and logits.
+  Weights are replicated over the model axis in this mode (fsdp still
+  shards them).  This is the long-context configuration: per-chip
+  attention memory is O((s/P)^2) and activations are O(s/P).
 
 Compiler-friendliness: layers are stacked and iterated with ``lax.scan``
 (one trace regardless of depth), every shape is static, blocks are
@@ -164,22 +166,26 @@ def param_specs(config: BurninConfig):
     from jax.sharding import PartitionSpec as P
 
     if config.ring_attention:
-        attn = {
+        # cp: the model axis carries the sequence, so no weight is sharded
+        # over it — fsdp alone shards parameters.
+        matrices = {
             "wqkv": P(None, "fsdp", None, None, None),
             "wo": P(None, None, None, "fsdp"),
+            "w1": P(None, "fsdp", None),
+            "w2": P(None, None, "fsdp"),
         }
     else:
-        attn = {
+        matrices = {
             "wqkv": P(None, "fsdp", None, "model", None),
             "wo": P(None, "model", None, "fsdp"),
+            "w1": P(None, "fsdp", "model"),
+            "w2": P(None, "model", "fsdp"),
         }
     return {
         "embed": P("fsdp", "model"),
         "pos": P(None, "model"),
         "layers": {
-            **attn,
-            "w1": P(None, "fsdp", "model"),
-            "w2": P(None, "model", "fsdp"),
+            **matrices,
             "ln1": P(None, None),
             "ln2": P(None, None),
         },
@@ -238,13 +244,24 @@ def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
         att = jnp.einsum("bshk,hkd->bsd", att, layer["wo"].astype(bf16))
         x = x + constrain("seq", att)  # row-parallel out: XLA reduce-scatters into sp
 
-    # --- mlp (tp over d_ff) ---
-    h = _rms_norm(constrain("seq", x), layer["ln2"])
-    h = constrain("hidden", h.astype(bf16))
-    h = jnp.einsum("bsd,df->bsf", h, layer["w1"].astype(bf16))
-    h = jnp.where(h > 0, h, 0.01 * h)  # leaky relu: cheap, fusion-friendly
-    h = jnp.einsum("bsf,fd->bsd", h, layer["w2"].astype(bf16))
-    x = x + constrain("seq", h)
+    if c.ring_attention and ring_mesh is not None:
+        # --- mlp (cp: position-wise, sequence stays sharded) ---
+        # No hidden gather: in the long-context configuration nothing may
+        # materialize the full sequence on one chip; d_ff is replicated
+        # over the model axis here (fsdp still shards the weights).
+        h = _rms_norm(constrain("seq", x), layer["ln2"]).astype(bf16)
+        h = jnp.einsum("bsd,df->bsf", h, layer["w1"].astype(bf16))
+        h = jnp.where(h > 0, h, 0.01 * h)
+        h = jnp.einsum("bsf,fd->bsd", h, layer["w2"].astype(bf16))
+        x = x + constrain("seq", h)
+    else:
+        # --- mlp (tp over d_ff) ---
+        h = _rms_norm(constrain("seq", x), layer["ln2"])
+        h = constrain("hidden", h.astype(bf16))
+        h = jnp.einsum("bsd,df->bsf", h, layer["w1"].astype(bf16))
+        h = jnp.where(h > 0, h, 0.01 * h)  # leaky relu: cheap, fusion-friendly
+        h = jnp.einsum("bsf,fd->bsd", h, layer["w2"].astype(bf16))
+        x = x + constrain("seq", h)
     return x
 
 

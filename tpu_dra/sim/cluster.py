@@ -169,6 +169,7 @@ class SimCluster:
         for node in self.nodes:
             node.start()
         self.controller.start()
+        self.controller_driver.start_gang_auditor(interval_s=1.0)
         self.kubesim.start()
 
     def stop(self) -> None:
