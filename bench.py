@@ -330,6 +330,13 @@ def main() -> int:
         "unit": "s",
         "vs_baseline": round(TARGET_S / p50, 2) if p50 > 0 else 0.0,
         "extras": {
+            # Honest framing: the allocation pipeline (controller, NAS
+            # writes, kubelet gRPC prepare, CDI) is real; scheduler and
+            # apiserver are the in-process sim, and vs_baseline compares
+            # against the 5s TARGET, not a measured reference system (the
+            # reference publishes no numbers).  The compute stanza runs on
+            # whatever real accelerator this host has.
+            "rung": "sim (real driver + gRPC prepare; in-process scheduler/apiserver)",
             "target_s": TARGET_S,
             "p95_s": round(alloc["p95_s"], 4),
             "mean_s": round(alloc["mean_s"], 4),

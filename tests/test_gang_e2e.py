@@ -137,8 +137,8 @@ class TestMultiHostGang:
             assert all(e["TPU_DRA_GANG_SIZE"] == "2" for e in envs)
 
             # The controller's audit sees one healthy ICI domain.
-            warnings = cluster.controller_driver.gangs.audit(NS, "ring")
-            assert warnings == [], warnings
+            audit = cluster.controller_driver.gangs.audit(NS, "ring")
+            assert audit.warnings == [], audit.warnings
 
             # Spawn one REAL process per pod with ONLY the driver env.
             procs = []

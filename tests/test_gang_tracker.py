@@ -213,7 +213,7 @@ class TestCommitTimeConsistency:
         tracker.commit("uid-r0b", "default", "g")
         assert tracker.take_repair_hint("default", "g")
         assert tracker.repair_coordinators("default", "g") == 1
-        assert tracker.audit("default", "g") == []
+        assert tracker.audit("default", "g").warnings == []
 
     def test_consistent_gang_raises_no_hint(self, cs):
         tracker = GangTracker(cs, NS)
@@ -233,7 +233,8 @@ class TestAudit:
             a = tracker.assign(gang, "default", f"uid-{i}", node)
             commit_to_nas(cs, node, f"uid-{i}", a)
             tracker.commit(f"uid-{i}")
-        assert tracker.audit("default", "g") == []
+        audit = tracker.audit("default", "g")
+        assert audit.warnings == [] and not audit.coordinator_disagreement
 
     def test_cross_domain_gang_warns(self, cs):
         tracker = GangTracker(cs, NS)
@@ -250,8 +251,9 @@ class TestAudit:
                 )
             ]
             client.update(nas)
-        warnings = tracker.audit("default", "g")
-        assert any("ICI domains" in w for w in warnings)
+        audit = tracker.audit("default", "g")
+        assert audit.cross_domain
+        assert any("ICI domains" in w for w in audit.warnings)
 
 
 class TestAuditSweep:

@@ -138,6 +138,15 @@ class TestValidateSlice:
         ops = {c["op"] for c in report.checks}
         assert ops == {"psum", "all_gather", "ppermute_ring", "psum_bandwidth"}
 
+    def test_train_stage_includes_ring_configuration(self):
+        # With a multi-device model axis, acceptance must also run the
+        # long-context (ring attention) step.
+        report = validate_slice(topology="4x2x1", env={}, train_steps=2)
+        assert report.ok, report.errors
+        assert report.train is not None and report.train["ok"]
+        assert report.train_ring is not None, "ring stage did not run"
+        assert report.train_ring["ok"], report.train_ring
+
     def test_device_count_mismatch_fails(self):
         report = validate_slice(
             topology="4x2x1", env={"TPU_VISIBLE_DEVICES": "0,1,2,3"}

@@ -80,16 +80,20 @@ class ControllerDriver:
                     seen.add((ns, alloc.tpu.gang.name))
         results: "dict[tuple, list[str]]" = {}
         for ns, name in sorted(seen):
-            warnings = self.gangs.audit(ns, name, nases=nases)
-            if not warnings:
+            audit = self.gangs.audit(ns, name, nases=nases)
+            if not audit.warnings:
                 continue
-            results[(ns, name)] = warnings
-            for w in warnings:
+            results[(ns, name)] = audit.warnings
+            for w in audit.warnings:
                 logger.warning("gang %s/%s: %s", ns, name, w)
-            if any("coordinator" in w for w in warnings):
+            if audit.coordinator_disagreement:
+                # Repair scans FRESH state (no nases pass-through): the
+                # sweep's listing may be a full interval old, and deriving
+                # the authoritative rank-0 address from it could overwrite
+                # a since-converged gang with a dead coordinator.
                 try:
                     repaired = self.gangs.repair_coordinators(
-                        ns, name, node_lock=self.lock, nases=nases
+                        ns, name, node_lock=self.lock
                     )
                     logger.info(
                         "gang %s/%s: repaired %d member(s)", ns, name, repaired

@@ -46,6 +46,21 @@ class GangConfigError(ValueError):
 
 
 @dataclass
+class AuditResult:
+    """Structured gang-health verdict: the repair decision keys off typed
+    flags, never off warning-string contents (a rewording must not be able
+    to silently disable the auditor's repair path)."""
+
+    warnings: "list[str]" = field(default_factory=list)
+    coordinator_disagreement: bool = False
+    duplicate_ranks: bool = False
+    cross_domain: bool = False
+
+    def __bool__(self) -> bool:
+        return bool(self.warnings)
+
+
+@dataclass
 class GangView:
     """One scan of the gang's state across every NAS in the namespace."""
 
@@ -273,7 +288,7 @@ class GangTracker:
     # -- post-commit reconciliation ------------------------------------------
 
     def repair_coordinators(
-        self, claim_namespace: str, gang_name: str, node_lock=None, nases=None
+        self, claim_namespace: str, gang_name: str, node_lock=None
     ) -> int:
         """Rewrite committed members whose coordinator disagrees with the
         committed rank-0's address (rank-0 reallocation onto another node,
@@ -287,9 +302,10 @@ class GangTracker:
         from tpu_dra.api.meta import ObjectMeta
 
         key = (claim_namespace, gang_name)
-        # A pre-listed view only picks the repair TARGETS; each node's
-        # rewrite still re-reads fresh state under that node's lock.
-        view = self._scan(key, nases)
+        # Always a FRESH scan: the authoritative coordinator is derived
+        # from this view, and deriving it from a stale listing could
+        # overwrite a since-converged gang with a dead rank-0 address.
+        view = self._scan(key)
         rank0_uid = next(
             (uid for uid, a in view.committed.items() if a.rank == 0), None
         )
@@ -341,17 +357,18 @@ class GangTracker:
 
     def audit(
         self, claim_namespace: str, gang_name: str, nases=None
-    ) -> "list[str]":
-        """Cross-host ICI health of the committed gang.  Returns warnings:
-        a gang whose members span multiple ICI domains (different slices)
-        cannot ride ICI for its collectives; duplicate ranks indicate
-        corruption."""
+    ) -> AuditResult:
+        """Cross-host ICI health of the committed gang: duplicate ranks
+        indicate corruption; a gang spanning multiple ICI domains cannot
+        ride ICI for its collectives; coordinator disagreement means
+        split-brain.  Returns typed flags plus human-readable warnings."""
         view = self._scan((claim_namespace, gang_name), nases)
-        warnings: "list[str]" = []
+        result = AuditResult()
         ranks: "dict[int, str]" = {}
         for uid, a in view.committed.items():
             if a.rank in ranks:
-                warnings.append(
+                result.duplicate_ranks = True
+                result.warnings.append(
                     f"rank {a.rank} assigned to both {ranks[a.rank]} and {uid}"
                 )
             ranks[a.rank] = uid
@@ -362,16 +379,18 @@ class GangTracker:
             if facts:
                 domains.update(facts[3])
         if len(domains) > 1:
-            warnings.append(
+            result.cross_domain = True
+            result.warnings.append(
                 f"gang {gang_name!r} spans {len(domains)} ICI domains "
                 f"({sorted(domains)}): collectives will cross DCN, not ICI"
             )
         coords = {a.coordinator for a in view.committed.values()}
         if len(coords) > 1:
-            warnings.append(
+            result.coordinator_disagreement = True
+            result.warnings.append(
                 f"members disagree on coordinator: {sorted(coords)}"
             )
-        return warnings
+        return result
 
 
 def _port_of(coordinator: str, default: int = 8476) -> int:

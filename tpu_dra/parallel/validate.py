@@ -46,6 +46,9 @@ class SliceReport:
     checks: "list[dict]" = field(default_factory=list)
     busbw_gbps: float = 0.0
     train: "dict | None" = None
+    # Long-context configuration (ring attention over the model axis) —
+    # run when the claimed mesh has one; None when it doesn't.
+    train_ring: "dict | None" = None
     errors: "list[str]" = field(default_factory=list)
 
     def to_json(self) -> str:
@@ -182,12 +185,31 @@ def validate_slice(
     # once acceptance has already failed: training over a wedged ICI link can
     # hang the pod, and the verdict is already decided.
     if train_steps > 0 and not report.errors:
-        from tpu_dra.parallel.burnin import burnin_mesh, train as burnin_train
+        from tpu_dra.parallel.burnin import (
+            BurninConfig,
+            burnin_mesh,
+            train as burnin_train,
+        )
 
-        tr = burnin_train(mesh=burnin_mesh(devices), steps=train_steps)
+        bmesh = burnin_mesh(devices)
+        tr = burnin_train(mesh=bmesh, steps=train_steps)
         report.train = asdict(tr)
         if not tr.ok:
             report.errors.append(f"burnin train: {tr.error or 'loss did not decrease'}")
+        if tr.ok and bmesh.shape.get("model", 1) > 1:
+            # Long-context acceptance: the same step with the sequence
+            # sharded through attention and the K/V ring on ICI
+            # (tpu_dra/parallel/ring.py) — the configuration long-sequence
+            # jobs will actually run on this slice.
+            ring_tr = burnin_train(
+                BurninConfig(ring_attention=True), mesh=bmesh, steps=train_steps
+            )
+            report.train_ring = asdict(ring_tr)
+            if not ring_tr.ok:
+                report.errors.append(
+                    f"burnin train[ring]: "
+                    f"{ring_tr.error or 'loss did not decrease'}"
+                )
 
     report.ok = not report.errors
     return report
