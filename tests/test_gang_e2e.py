@@ -166,6 +166,66 @@ class TestMultiHostGang:
         finally:
             cluster.stop()
 
+    def test_v5e_256_shaped_gang(self, tmp_path):
+        """The BASELINE north-star config at full member count: one
+        64-member gang across a multi-host slice, every pod a ranked
+        worker.  Asserts the whole contract — unique ranks 0..63, one
+        coordinator (the committed rank-0's resolvable address), healthy
+        audit (single ICI domain, no split-brain), and the CDI-injected
+        TPU_DRA_GANG_* env for every member."""
+        size = 64
+        nodes = 16  # 4 chips each; 4 members per node
+        port = free_port()
+        cluster = SimCluster(
+            str(tmp_path),
+            nodes=nodes,
+            mesh="2x2x1",
+            multihost_slice=True,
+            workers=8,
+        )
+        cluster.start()
+        try:
+            setup_resource_class(cluster)
+            cluster.clientset.tpu_claim_parameters(NS).create(
+                TpuClaimParameters(
+                    metadata=ObjectMeta(name="gang-member", namespace=NS),
+                    spec=TpuClaimParametersSpec(
+                        count=1,
+                        gang=GangConfig(name="pod-64", size=size, port=port),
+                    ),
+                )
+            )
+            create_template(cluster, "gang-template", "gang-member")
+            for i in range(size):
+                cluster.clientset.pods(NS).create(
+                    make_pod(
+                        f"worker-{i}",
+                        [("tpu", {"resource_claim_template_name": "gang-template"})],
+                    )
+                )
+            for i in range(size):
+                cluster.wait_for_pod_running(NS, f"worker-{i}", timeout=180)
+
+            envs = []
+            for i in range(size):
+                claim = cluster.clientset.resource_claims(NS).get(
+                    f"worker-{i}-tpu"
+                )
+                envs.append(
+                    self.read_gang_env(tmp_path, cluster, claim.metadata.uid)
+                )
+            ranks = sorted(int(e["TPU_DRA_GANG_RANK"]) for e in envs)
+            assert ranks == list(range(size))
+            coordinators = {e["TPU_DRA_GANG_COORDINATOR"] for e in envs}
+            assert coordinators == {f"127.0.0.1:{port}"}
+            assert {e["TPU_DRA_GANG_SIZE"] for e in envs} == {str(size)}
+
+            audit = cluster.controller_driver.gangs.audit(NS, "pod-64")
+            assert audit.warnings == [], audit.warnings
+            assert not audit.cross_domain  # one slice, ICI all the way
+        finally:
+            cluster.stop()
+
     def test_global_slice_coords_published(self, tmp_path):
         cluster = SimCluster(
             str(tmp_path), nodes=2, mesh="2x1x1", multihost_slice=True
