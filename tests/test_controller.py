@@ -212,6 +212,45 @@ class TestAllocateDeallocate:
                 "",
             )
 
+    def test_failed_immediate_clears_pending_seeds(self, tmp_path, cs, driver):
+        # The parallel probe phase seeds pending entries on every suitable
+        # node; a run that then fails to commit anywhere must clear them,
+        # or an abandoned claim reserves phantom capacity fleet-wide.
+        publish_node(tmp_path, cs)
+        claim = make_claim(cs, mode="Immediate")
+
+        # Make every allocate attempt fail after probing succeeded.
+        original = driver._allocate_on_node
+
+        def boom(*a, **k):
+            raise RuntimeError("injected commit failure")
+
+        driver._allocate_on_node = boom
+        try:
+            with pytest.raises(RuntimeError, match="no suitable node"):
+                driver.allocate(
+                    claim,
+                    TpuClaimParametersSpec(count=1),
+                    ResourceClass(),
+                    DeviceClassParametersSpec(True),
+                    "",
+                )
+        finally:
+            driver._allocate_on_node = original
+        for subdriver in (driver.tpu, driver.subslice, driver.core):
+            assert not subdriver.pending_allocated_claims.exists(
+                claim.metadata.uid, "node-1"
+            )
+        # And the claim can still be allocated afterwards.
+        result = driver.allocate(
+            claim,
+            TpuClaimParametersSpec(count=1),
+            ResourceClass(),
+            DeviceClassParametersSpec(True),
+            "",
+        )
+        assert get_selected_node_from(result) == "node-1"
+
     def test_full_two_phase_through_dispatch(self, tmp_path, cs, driver):
         publish_node(tmp_path, cs)
         claim = make_claim(cs)

@@ -89,6 +89,36 @@ class TestWireCodec:
         assert wire.NodePrepareResourceRequest.decode(req.encode()).namespace == long
 
 
+class TestLongSocketPaths:
+    def test_serve_and_call_past_sun_path_limit(self, tmp_path):
+        """AF_UNIX sun_path caps at ~107 bytes; deep plugin roots (pytest
+        sandboxes after many runs, nested state dirs) used to fail the
+        grpc bind with an opaque 'Failed to bind' — both server and client
+        now alias long paths through /proc/self/fd."""
+        deep = tmp_path
+        while len(str(deep).encode()) < 140:
+            deep = deep / "deeply-nested-plugin-root"
+        deep.mkdir(parents=True, exist_ok=True)
+        cs = ClientSet(FakeApiServer())
+        _, _, state = make_plugin_stack(tmp_path, cs)
+        nas = NodeAllocationState(metadata=ObjectMeta(name="node-1", namespace=NS))
+        driver = NodeDriver(nas, NasClient(nas, cs), state, start_gc=False)
+        server = DRAPluginServer(
+            driver,
+            "tpu.resource.google.com",
+            plugin_socket=str(deep / "plugin.sock"),
+            registrar_socket=str(deep / "reg.sock"),
+        )
+        server.start()
+        try:
+            reg = RegistrationClient(str(deep / "reg.sock"))
+            info = reg.get_info()
+            assert info.name == "tpu.resource.google.com"
+            reg.close()
+        finally:
+            server.stop()
+
+
 @pytest.fixture
 def served(tmp_path):
     cs = ClientSet(FakeApiServer())

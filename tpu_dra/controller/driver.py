@@ -205,9 +205,13 @@ class ControllerDriver:
     ) -> AllocationResult:
         candidates = self._ready_nodes()
         errors: list[str] = []
+        # First-fit, probe-and-commit per node: on a healthy fleet the
+        # first probe succeeds and the claim commits after ONE locked NAS
+        # read — an up-front all-nodes fan-out would seed pending entries
+        # fleet-wide, transiently occupying every suitable node and making
+        # CONCURRENT allocations spuriously fail, while costing O(nodes)
+        # probes in the common case.
         for node in candidates:
-            # Run the same placement pass the scheduler flow uses; a
-            # suitable node leaves a promotable pending-cache entry.
             ca = ClaimAllocation(
                 claim=claim,
                 class_=resource_class,
@@ -227,6 +231,10 @@ class ControllerDriver:
                         claim.metadata.uid, node
                     )
                 errors.append(f"{node}: {e}")
+        # Nothing committed: clear any pending seed a probe may have left
+        # so a never-retried claim doesn't reserve phantom capacity.
+        for subdriver in (self.tpu, self.subslice, self.core):
+            subdriver.pending_allocated_claims.remove(claim.metadata.uid)
         raise RuntimeError(
             f"immediate allocation of claim {claim.metadata.name!r} failed: "
             f"no suitable node among {candidates or '[] (no Ready nodes)'}"

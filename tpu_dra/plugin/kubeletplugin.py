@@ -126,8 +126,20 @@ class DRAPluginServer:
         server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(service, methods),)
         )
-        server.add_insecure_port(f"unix://{socket_path}")
-        server.start()
+        # AF_UNIX sun_path caps at ~107 bytes; a deep plugin root (test
+        # sandboxes, nested state dirs) silently fails the bind otherwise.
+        from tpu_dra.proxy.protocol import short_socket_path
+
+        bind_path, dirfd = short_socket_path(socket_path)
+        try:
+            if server.add_insecure_port(f"unix://{bind_path}") == 0:
+                raise RuntimeError(
+                    f"failed to bind {service} socket {socket_path}"
+                )
+            server.start()
+        finally:
+            if dirfd is not None:
+                os.close(dirfd)
         return server
 
     def start(self) -> None:
@@ -176,12 +188,22 @@ class DRAPluginServer:
             server.wait_for_termination()
 
 
+def _unix_channel(socket_path: str) -> "tuple[grpc.Channel, int | None]":
+    """Channel to a unix socket, sun_path-limit safe.  The returned dirfd
+    (if any) must outlive the channel — grpc reconnects re-resolve the
+    aliased path — and be closed with it."""
+    from tpu_dra.proxy.protocol import short_socket_path
+
+    path, dirfd = short_socket_path(socket_path)
+    return grpc.insecure_channel(f"unix://{path}"), dirfd
+
+
 class DRAClient:
     """Client for the DRA node service — what the kubelet (and our tests /
     simulator) uses to drive a plugin over its socket."""
 
     def __init__(self, socket_path: str):
-        self._channel = grpc.insecure_channel(f"unix://{socket_path}")
+        self._channel, self._dirfd = _unix_channel(socket_path)
 
     def node_prepare_resource(
         self, namespace: str, claim_uid: str, claim_name: str = "",
@@ -216,13 +238,16 @@ class DRAClient:
 
     def close(self) -> None:
         self._channel.close()
+        if self._dirfd is not None:
+            os.close(self._dirfd)
+            self._dirfd = None
 
 
 class RegistrationClient:
     """Client for the registration service (kubelet plugin-watcher side)."""
 
     def __init__(self, socket_path: str):
-        self._channel = grpc.insecure_channel(f"unix://{socket_path}")
+        self._channel, self._dirfd = _unix_channel(socket_path)
 
     def get_info(self) -> wire.PluginInfo:
         call = self._channel.unary_unary(
@@ -242,3 +267,6 @@ class RegistrationClient:
 
     def close(self) -> None:
         self._channel.close()
+        if self._dirfd is not None:
+            os.close(self._dirfd)
+            self._dirfd = None
