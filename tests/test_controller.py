@@ -213,9 +213,9 @@ class TestAllocateDeallocate:
             )
 
     def test_failed_immediate_clears_pending_seeds(self, tmp_path, cs, driver):
-        # The parallel probe phase seeds pending entries on every suitable
-        # node; a run that then fails to commit anywhere must clear them,
-        # or an abandoned claim reserves phantom capacity fleet-wide.
+        # A suitability probe seeds a pending entry on the node it judged
+        # suitable; a run that then fails to commit anywhere must clear it,
+        # or an abandoned claim reserves phantom capacity.
         publish_node(tmp_path, cs)
         claim = make_claim(cs, mode="Immediate")
 

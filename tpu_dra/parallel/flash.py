@@ -1,0 +1,182 @@
+"""Pallas flash attention — the single-chip hot-op kernel.
+
+The burn-in LM's default attention materializes an (s, s) score matrix per
+head and lets XLA schedule it; this kernel computes the same causal softmax
+attention in O(block) VMEM with the flash online-softmax recurrence, tiled
+for the MXU:
+
+- grid over (batch x heads, query blocks, K/V blocks) with the K/V axis
+  innermost (sequential): each step DMAs ONE (block_k, d) K and V tile
+  into VMEM — K/V are streamed, never fully resident — while the running
+  (m, l, acc) lives in VMEM scratch that persists across the K steps,
+- scores per (block_q, block_k) tile via ``jnp.dot`` with fp32
+  accumulation (preferred_element_type); peak VMEM is O(block_q x d +
+  block_k x d + block_q x block_k), independent of sequence length,
+- causal masking on global positions; K blocks entirely in the future are
+  skipped with ``@pl.when`` (their DMA still lands, their FLOPs don't).
+
+Training still differentiates: ``flash_attention`` carries a custom VJP
+whose backward recomputes attention with plain XLA ops and differentiates
+that (exact same math, see ring.py's oracle) — forward-fast, backward
+standard.  The kernel itself is validated against the oracle in
+tests/test_flash.py via pallas interpret mode, so it runs hardware-free;
+on TPU, pass ``interpret=False`` (the default picks interpret off-TPU).
+
+Why it is NOT wired into bench.py's default path yet: compiled-mode
+numerics/tiling on real silicon must be validated on a live chip first;
+use ``flash_attention`` explicitly (it composes with the burn-in shapes
+(b, s, h, d)).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, block_q, block_k, causal, scale,
+):
+    from jax.experimental import pallas as pl
+
+    jq = pl.program_id(1)
+    kb = pl.program_id(2)
+    nkb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: a K block strictly in every query's future contributes
+    # nothing — skip its FLOPs entirely.
+    live = (kb * block_k <= (jq + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _fold():
+        q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+        k_blk = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = jq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0
+            )
+            kv_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kb == nkb - 1)
+    def _finalize():
+        o_ref[0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    scale = 1.0 / (d**0.5)
+    if s % block_q or s % block_k:
+        raise ValueError(
+            f"block_q={block_q} and block_k={block_k} must divide "
+            f"sequence length {s}"
+        )
+    # (b, s, h, d) -> (b*h, s, d): one grid row per (batch, head).
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        causal=causal,
+        scale=scale,
+    )
+    # K/V axis innermost: sequential on TPU, so the VMEM scratch carries
+    # (m, l, acc) across the K steps of each (head, q-block) program.
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        grid=(b * h, s // block_q, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running denominator
+            pltpu.VMEM((block_q, d), jnp.float32),  # running numerator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+)
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: "bool | None" = None,
+):
+    """Causal softmax attention, flash-tiled.  Shapes (b, s, h, d).
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter
+    elsewhere (the kernel is TPU-targeted; interpret mode keeps CPU tests
+    and hardware-free runs working)."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _flash_forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    # Backward recomputes with XLA ops (ring.py's oracle — the same
+    # function the kernel is tested against) and differentiates those —
+    # forward stays flash (incl. under remat), backward standard-memory.
+    from tpu_dra.parallel.ring import reference_attention
+
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: reference_attention(q, k, v, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
