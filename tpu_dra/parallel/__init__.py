@@ -18,6 +18,15 @@ slice:
 - ``tpu_dra.parallel.validate``    — the slice burn-in a claiming pod runs:
   assert visible devices match the claim, run the collective checks, emit a
   JSON report.
+- ``tpu_dra.parallel.burnin``      — the flagship sharded transformer LM
+  (dp/fsdp/tp/sp, plus the ring_attention long-context configuration) used
+  by acceptance, the compile checks, and the MFU benchmark.
+- ``tpu_dra.parallel.ring``        — ring attention: context parallelism
+  with K/V blocks rotating over an ICI ring (ppermute + online softmax).
+- ``tpu_dra.parallel.flash``       — pallas flash-attention kernel for the
+  single-chip hot path (streamed K/V tiles, VMEM online-softmax carry).
+- ``tpu_dra.parallel.mfu``         — chip-sized MFU + HBM-bandwidth
+  measurement with analytic FLOPs accounting vs published bf16 peaks.
 - ``tpu_dra.parallel.burnin``      — the flagship burn-in workload: a small
   transformer LM trained over the claimed slice with dp/fsdp/tp/sp
   shardings (the acceptance check that actually loads MXU + ICI).
