@@ -142,6 +142,60 @@ class TestRingAttentionIntegration:
         assert f"{s},{s}]" not in text
 
 
+class TestFlashAttentionIntegration:
+    def test_flash_train_loss_decreases_single_chip(self):
+        import dataclasses
+
+        c = dataclasses.replace(TINY, flash_attention=True)
+        report = train(c, mesh=None, steps=4)
+        assert report.error == ""
+        assert report.ok, f"loss {report.loss_first} -> {report.loss_last}"
+
+    def test_flash_forward_matches_dense(self):
+        import dataclasses
+
+        params = init_params(TINY)
+        tokens = sample_tokens(TINY)
+        dense = forward(params, tokens, TINY)
+        flash = forward(
+            params, tokens, dataclasses.replace(TINY, flash_attention=True)
+        )
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(flash), atol=0.15, rtol=0.05
+        )
+
+    def test_flash_handles_non_power_of_two_seq(self):
+        import dataclasses
+
+        c = dataclasses.replace(TINY, seq=24, flash_attention=True)
+        params = init_params(c)
+        tokens = sample_tokens(c)
+        out = forward(params, tokens, c)  # gcd block: 8 divides 24
+        assert out.shape == (c.batch, 24, c.vocab)
+        assert bool(jnp.isfinite(out).all())
+
+    def test_flash_with_mesh_rejected(self):
+        import dataclasses
+
+        import pytest
+
+        mesh = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+        c = dataclasses.replace(TINY, flash_attention=True)
+        with pytest.raises(ValueError, match="single-chip"):
+            forward(init_params(c), sample_tokens(c), c, mesh)
+
+    def test_flash_plus_ring_rejected(self):
+        import dataclasses
+
+        import pytest
+
+        c = dataclasses.replace(
+            TINY, flash_attention=True, ring_attention=True
+        )
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            forward(init_params(TINY), sample_tokens(TINY), c)
+
+
 def test_graft_entry_single_chip():
     import __graft_entry__ as ge
 

@@ -27,9 +27,6 @@ slice:
   single-chip hot path (streamed K/V tiles, VMEM online-softmax carry).
 - ``tpu_dra.parallel.mfu``         — chip-sized MFU + HBM-bandwidth
   measurement with analytic FLOPs accounting vs published bf16 peaks.
-- ``tpu_dra.parallel.burnin``      — the flagship burn-in workload: a small
-  transformer LM trained over the claimed slice with dp/fsdp/tp/sp
-  shardings (the acceptance check that actually loads MXU + ICI).
 """
 
 from tpu_dra.parallel.mesh import (

@@ -97,6 +97,10 @@ class BurninConfig:
     # Context parallelism: ring attention over the mesh's ``model`` axis
     # (sequence stays sharded through attention; heads replicated there).
     ring_attention: bool = False
+    # Single-chip hot path: the pallas flash kernel (parallel/flash.py)
+    # instead of XLA's materialized-scores attention.  Mutually exclusive
+    # with ring_attention (the ring shards the sequence; flash tiles it).
+    flash_attention: bool = False
 
     @property
     def d_head(self) -> int:
@@ -235,12 +239,26 @@ def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
         h = constrain("hidden", h.astype(bf16))  # gather seq, enter tp region
         qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(bf16))
         q, k_, v = qkv[0], qkv[1], qkv[2]
-        scores = jnp.einsum("bshk,bthk->bhst", q, k_) / (c.d_head**0.5)
-        mask = jnp.tril(jnp.ones((c.seq, c.seq), bool))
-        scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
-        probs = jnp.exp(scores - scores.max(-1, keepdims=True))
-        probs = (probs / probs.sum(-1, keepdims=True)).astype(bf16)
-        att = jnp.einsum("bhst,bthk->bshk", probs, v)
+        if c.flash_attention and ring_mesh is None:
+            # Pallas kernel: O(block) scores, never an (s, s) tensor.
+            # Single-chip only (forward() rejects flash+mesh): pallas_call
+            # under a sharded mesh needs a shard_map wrapper it doesn't
+            # have yet.
+            import math
+
+            from tpu_dra.parallel.flash import flash_attention
+
+            # Largest block <= 128 that divides the sequence (any seq works;
+            # min(128, seq) would crash on e.g. seq=192).
+            block = math.gcd(128, c.seq)
+            att = flash_attention(q, k_, v, True, block, block)
+        else:
+            scores = jnp.einsum("bshk,bthk->bhst", q, k_) / (c.d_head**0.5)
+            mask = jnp.tril(jnp.ones((c.seq, c.seq), bool))
+            scores = jnp.where(mask[None, None], scores.astype(jnp.float32), -1e30)
+            probs = jnp.exp(scores - scores.max(-1, keepdims=True))
+            probs = (probs / probs.sum(-1, keepdims=True)).astype(bf16)
+            att = jnp.einsum("bhst,bthk->bshk", probs, v)
         att = jnp.einsum("bshk,hkd->bsd", att, layer["wo"].astype(bf16))
         x = x + constrain("seq", att)  # row-parallel out: XLA reduce-scatters into sp
 
@@ -272,6 +290,11 @@ def forward(params, tokens, config: BurninConfig, mesh=None):
     import jax.numpy as jnp
 
     c = config
+    if c.ring_attention and c.flash_attention:
+        raise ValueError(
+            "ring_attention and flash_attention are mutually exclusive "
+            "(the ring shards the sequence; flash tiles it on one chip)"
+        )
     if mesh is None:
         if c.ring_attention:
             # A silent dense fallback would let a single-chip check report
@@ -280,6 +303,12 @@ def forward(params, tokens, config: BurninConfig, mesh=None):
             raise ValueError("ring_attention requires a device mesh")
         constrain = lambda kind, arr: arr  # noqa: E731
     else:
+        if c.flash_attention:
+            # Same no-silent-fallback rule as ring: a sharded run would
+            # quietly take the dense path in _block.
+            raise ValueError(
+                "flash_attention is single-chip (mesh=None) for now"
+            )
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
