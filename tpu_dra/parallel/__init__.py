@@ -19,8 +19,9 @@ slice:
   assert visible devices match the claim, run the collective checks, emit a
   JSON report.
 - ``tpu_dra.parallel.burnin``      — the flagship sharded transformer LM
-  (dp/fsdp/tp/sp, plus the ring_attention long-context configuration) used
-  by acceptance, the compile checks, and the MFU benchmark.
+  (dp/fsdp/tp/sp, plus the ring_attention long-context and
+  flash_attention kernel configurations) used by acceptance, the compile
+  checks, and the MFU benchmark.
 - ``tpu_dra.parallel.ring``        — ring attention: context parallelism
   with K/V blocks rotating over an ICI ring (ppermute + online softmax).
 - ``tpu_dra.parallel.flash``       — pallas flash-attention kernel for the

@@ -97,9 +97,10 @@ class BurninConfig:
     # Context parallelism: ring attention over the mesh's ``model`` axis
     # (sequence stays sharded through attention; heads replicated there).
     ring_attention: bool = False
-    # Single-chip hot path: the pallas flash kernel (parallel/flash.py)
-    # instead of XLA's materialized-scores attention.  Mutually exclusive
-    # with ring_attention (the ring shards the sequence; flash tiles it).
+    # The pallas flash kernel (parallel/flash.py) instead of XLA's
+    # materialized-scores attention; on a mesh each tp shard runs it on
+    # its local heads.  Mutually exclusive with ring_attention (the ring
+    # shards the sequence; flash tiles it per shard).
     flash_attention: bool = False
 
     @property
@@ -239,19 +240,33 @@ def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
         h = constrain("hidden", h.astype(bf16))  # gather seq, enter tp region
         qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(bf16))
         q, k_, v = qkv[0], qkv[1], qkv[2]
-        if c.flash_attention and ring_mesh is None:
-            # Pallas kernel: O(block) scores, never an (s, s) tensor.
-            # Single-chip only (forward() rejects flash+mesh): pallas_call
-            # under a sharded mesh needs a shard_map wrapper it doesn't
-            # have yet.
+        if c.flash_attention:
+            # Pallas kernel: O(block) scores, never an (s, s) tensor.  On a
+            # mesh, heads are tp-sharded over "model" and attention is
+            # per-head independent, so each shard runs the kernel locally
+            # (flash_attention_sharded — zero collectives).
             import math
 
-            from tpu_dra.parallel.flash import flash_attention
+            from tpu_dra.parallel.flash import (
+                flash_attention,
+                flash_attention_sharded,
+            )
 
-            # Largest block <= 128 that divides the sequence (any seq works;
-            # min(128, seq) would crash on e.g. seq=192).
+            # Largest power-of-two block <= 128 dividing the sequence.
+            # An odd seq would gcd to 1 — a 1x1 tile violates TPU tiling
+            # minima and explodes the grid, so reject it instead.
             block = math.gcd(128, c.seq)
-            att = flash_attention(q, k_, v, True, block, block)
+            if block < 8:
+                raise ValueError(
+                    f"flash_attention needs seq % 8 == 0, got seq={c.seq}"
+                )
+            if ring_mesh is None:
+                att = flash_attention(q, k_, v, True, block, block)
+            else:
+                att = flash_attention_sharded(
+                    q, k_, v, ring_mesh, "model",
+                    block_q=block, block_k=block,
+                )
         else:
             scores = jnp.einsum("bshk,bthk->bhst", q, k_) / (c.d_head**0.5)
             mask = jnp.tril(jnp.ones((c.seq, c.seq), bool))
@@ -293,7 +308,8 @@ def forward(params, tokens, config: BurninConfig, mesh=None):
     if c.ring_attention and c.flash_attention:
         raise ValueError(
             "ring_attention and flash_attention are mutually exclusive "
-            "(the ring shards the sequence; flash tiles it on one chip)"
+            "(the ring shards the sequence over the model axis; flash "
+            "tiles the full sequence per tp shard)"
         )
     if mesh is None:
         if c.ring_attention:
@@ -303,12 +319,6 @@ def forward(params, tokens, config: BurninConfig, mesh=None):
             raise ValueError("ring_attention requires a device mesh")
         constrain = lambda kind, arr: arr  # noqa: E731
     else:
-        if c.flash_attention:
-            # Same no-silent-fallback rule as ring: a sharded run would
-            # quietly take the dense path in _block.
-            raise ValueError(
-                "flash_attention is single-chip (mesh=None) for now"
-            )
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 

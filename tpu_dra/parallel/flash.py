@@ -35,7 +35,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_sharded"]
 
 _NEG_INF = -1e30
 
@@ -180,3 +180,45 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_sharded(
+    q,
+    k,
+    v,
+    mesh,
+    axis_name: str = "model",
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: "bool | None" = None,
+):
+    """Flash attention with HEADS sharded over ``axis_name`` (the tensor-
+    parallel layout): attention is independent per head, so each shard
+    runs the kernel on its local heads — no collectives at all.  The
+    batch dim rides every OTHER mesh axis (declaring it replicated would
+    force a full-batch all-gather and redundant per-device compute).
+    This is how the burn-in's tp region uses the kernel on a mesh; the
+    custom VJP composes through shard_map, keeping the backward
+    standard-memory."""
+    try:
+        from jax import shard_map  # jax >= 0.8 API
+        kwargs = {"check_vma": False}
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+        kwargs = {"check_rep": False}
+    from jax.sharding import PartitionSpec as P
+
+    other = tuple(n for n in mesh.axis_names if n != axis_name)
+    spec = P(other if other else None, None, axis_name, None)
+    fn = shard_map(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal, block_q, block_k, interpret
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        **kwargs,
+    )
+    return fn(q, k, v)
