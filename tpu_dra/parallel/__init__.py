@@ -19,13 +19,17 @@ slice:
   assert visible devices match the claim, run the collective checks, emit a
   JSON report.
 - ``tpu_dra.parallel.burnin``      — the flagship sharded transformer LM
-  (dp/fsdp/tp/sp, plus the ring_attention long-context and
-  flash_attention kernel configurations) used by acceptance, the compile
-  checks, and the MFU benchmark.
+  (dp/fsdp/tp/sp, plus the ring_attention long-context, flash_attention
+  kernel, moe_experts ep, and pipeline_stages pp configurations) used by
+  acceptance, the compile checks, and the MFU benchmark.
 - ``tpu_dra.parallel.ring``        — ring attention: context parallelism
   with K/V blocks rotating over an ICI ring (ppermute + online softmax).
 - ``tpu_dra.parallel.flash``       — pallas flash-attention kernel for the
   single-chip hot path (streamed K/V tiles, VMEM online-softmax carry).
+- ``tpu_dra.parallel.moe``         — expert parallelism: switch-routed MoE
+  MLP, experts sharded over ``model`` with XLA-inserted all-to-all.
+- ``tpu_dra.parallel.pipeline``    — pipeline parallelism: GPipe schedule
+  over a ``pipe`` mesh axis (shard_map + scan + ppermute hops).
 - ``tpu_dra.parallel.mfu``         — chip-sized MFU + HBM-bandwidth
   measurement with analytic FLOPs accounting vs published bf16 peaks.
 """

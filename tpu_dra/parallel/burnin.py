@@ -102,6 +102,17 @@ class BurninConfig:
     # its local heads.  Mutually exclusive with ring_attention (the ring
     # shards the sequence; flash tiles it per shard).
     flash_attention: bool = False
+    # Expert parallelism: > 0 replaces the dense MLP with a switch-routed
+    # MoE of this many experts, sharded over the ``model`` axis with
+    # XLA-inserted all-to-all dispatch (tpu_dra/parallel/moe.py).
+    moe_experts: int = 0
+    moe_capacity: float = 1.25
+    moe_aux_weight: float = 1e-2
+    # Pipeline parallelism: > 0 splits the layer stack into this many
+    # stages over a ``pipe`` mesh axis and streams microbatches through a
+    # GPipe schedule (tpu_dra/parallel/pipeline.py).
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 4
 
     @property
     def d_head(self) -> int:
@@ -111,17 +122,38 @@ class BurninConfig:
 
     def scaled_to(self, mesh) -> "BurninConfig":
         """Grow batch/heads/ff minimally so every sharded dim divides its
-        mesh axis — keeps tiny configs valid on any claimed slice."""
-        data = mesh.shape["data"] * mesh.shape["fsdp"]
-        model = mesh.shape["model"]
+        mesh axis — keeps tiny configs valid on any claimed slice.  Works
+        for both the (data, fsdp, model) mesh and the pipeline's
+        (data, pipe) mesh: absent axes count as size 1."""
+        shape = dict(mesh.shape)
+        if self.pipeline_stages > 0 and "pipe" not in shape:
+            raise ValueError(
+                "pipeline_stages requires a (data, pipe) mesh "
+                "(tpu_dra.parallel.pipeline.pipeline_mesh), got axes "
+                f"{tuple(shape)}"
+            )
+        fsdp = shape.get("fsdp", 1)
+        model = shape.get("model", 1)
+        pipe = shape.get("pipe", 1)
+        data = shape.get("data", 1) * fsdp
         batch = _round_up(self.batch, data)
+        if self.pipeline_stages > 0:
+            # Every data shard must split evenly into microbatches.
+            batch = _round_up(batch, data * self.pipeline_microbatches)
         n_heads = _round_up(self.n_heads, model)
-        d_model = _round_up(self.d_model, n_heads * max(mesh.shape["fsdp"], 1))
-        d_ff = _round_up(self.d_ff, model * mesh.shape["fsdp"])
+        d_model = _round_up(self.d_model, n_heads * max(fsdp, 1))
+        d_ff = _round_up(self.d_ff, model * fsdp)
         seq = _round_up(self.seq, model)  # sp shards seq over `model`
-        vocab = _round_up(self.vocab, mesh.shape["fsdp"] * model)
+        vocab = _round_up(self.vocab, fsdp * model)
+        experts = _round_up(self.moe_experts, model) if self.moe_experts else 0
+        layers = (
+            _round_up(self.n_layers, pipe) if self.pipeline_stages else self.n_layers
+        )
+        stages = pipe if self.pipeline_stages else 0
         return dataclasses.replace(
-            self, batch=batch, n_heads=n_heads, d_model=d_model, d_ff=d_ff, seq=seq, vocab=vocab
+            self, batch=batch, n_heads=n_heads, d_model=d_model, d_ff=d_ff,
+            seq=seq, vocab=vocab, moe_experts=experts, n_layers=layers,
+            pipeline_stages=stages,
         )
 
 
@@ -148,14 +180,26 @@ def init_params(config: BurninConfig, key=None):
         return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(jnp.float32)
 
     L = c.n_layers
-    return {
-        "embed": dense(next(k), (c.vocab, c.d_model), c.d_model),
-        "pos": dense(next(k), (c.seq, c.d_model), c.d_model),
-        "layers": {
-            "wqkv": dense(next(k), (L, c.d_model, 3, c.n_heads, c.d_head), c.d_model),
-            "wo": dense(next(k), (L, c.n_heads, c.d_head, c.d_model), c.d_model),
+    embed = dense(next(k), (c.vocab, c.d_model), c.d_model)
+    pos = dense(next(k), (c.seq, c.d_model), c.d_model)
+    wqkv = dense(next(k), (L, c.d_model, 3, c.n_heads, c.d_head), c.d_model)
+    wo = dense(next(k), (L, c.n_heads, c.d_head, c.d_model), c.d_model)
+    if c.moe_experts > 0:
+        from tpu_dra.parallel.moe import init_moe_layer_params
+
+        mlp = init_moe_layer_params(c, next(k))
+    else:
+        mlp = {
             "w1": dense(next(k), (L, c.d_model, c.d_ff), c.d_model),
             "w2": dense(next(k), (L, c.d_ff, c.d_model), c.d_ff),
+        }
+    return {
+        "embed": embed,
+        "pos": pos,
+        "layers": {
+            "wqkv": wqkv,
+            "wo": wo,
+            **mlp,
             "ln1": jnp.ones((L, c.d_model), jnp.float32),
             "ln2": jnp.ones((L, c.d_model), jnp.float32),
         },
@@ -167,9 +211,23 @@ def param_specs(config: BurninConfig):
     """PartitionSpec pytree: fsdp shards the non-tp dim of every matrix,
     model (tp) shards heads / ffn-hidden / vocab-out (Megatron layout).
     With ring attention, heads are replicated (context parallelism replaces
-    tp inside attention) and only fsdp shards the attention matrices."""
+    tp inside attention) and only fsdp shards the attention matrices.
+    With pipeline stages, the stacked layer dim is sharded over ``pipe``
+    (each stage holds its own layers) and everything else is replicated."""
     from jax.sharding import PartitionSpec as P
 
+    if config.pipeline_stages > 0:
+        layer_keys = (
+            ("wqkv", "wo", "router", "w1e", "w2e", "ln1", "ln2")
+            if config.moe_experts > 0
+            else ("wqkv", "wo", "w1", "w2", "ln1", "ln2")
+        )
+        return {
+            "embed": P(None, None),
+            "pos": P(None, None),
+            "layers": {k: P("pipe") for k in layer_keys},
+            "ln_f": P(None),
+        }
     if config.ring_attention:
         # cp: the model axis carries the sequence, so no weight is sharded
         # over it — fsdp alone shards parameters.
@@ -186,6 +244,12 @@ def param_specs(config: BurninConfig):
             "w1": P(None, "fsdp", "model"),
             "w2": P(None, "model", "fsdp"),
         }
+    if config.moe_experts > 0:
+        from tpu_dra.parallel.moe import moe_param_specs
+
+        for name in ("w1", "w2"):
+            matrices.pop(name, None)
+        matrices.update(moe_param_specs())
     return {
         "embed": P("fsdp", "model"),
         "pos": P(None, "model"),
@@ -215,11 +279,15 @@ def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
     """One pre-norm transformer block.  ``constrain(kind, arr)`` applies the
     sp/tp sharding constraints; identity when running unsharded.  With
     ``ring_mesh`` set (and config.ring_attention), attention runs
-    context-parallel: the sequence stays sharded and K/V ride the ring."""
+    context-parallel: the sequence stays sharded and K/V ride the ring.
+
+    Returns ``(x, aux)`` — aux is the MoE load-balance loss for this block
+    (0.0 when the MLP is dense)."""
     import jax.numpy as jnp
 
     c = config
     bf16 = jnp.bfloat16
+    aux = jnp.zeros((), jnp.float32)
 
     if c.ring_attention and ring_mesh is not None:
         # --- attention (cp: ring over the model axis, heads replicated) ---
@@ -287,6 +355,14 @@ def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
         h = jnp.where(h > 0, h, 0.01 * h)
         h = jnp.einsum("bsf,fd->bsd", h, layer["w2"].astype(bf16))
         x = x + constrain("seq", h)
+    elif c.moe_experts > 0:
+        # --- mlp (ep: switch-routed experts over the model axis) ---
+        from tpu_dra.parallel.moe import moe_mlp
+
+        h = _rms_norm(constrain("seq", x), layer["ln2"])
+        h = constrain("hidden", h.astype(bf16))
+        h, aux = moe_mlp(layer, h, c, constrain)
+        x = x + constrain("seq", h)
     else:
         # --- mlp (tp over d_ff) ---
         h = _rms_norm(constrain("seq", x), layer["ln2"])
@@ -295,12 +371,13 @@ def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
         h = jnp.where(h > 0, h, 0.01 * h)  # leaky relu: cheap, fusion-friendly
         h = jnp.einsum("bsf,fd->bsd", h, layer["w2"].astype(bf16))
         x = x + constrain("seq", h)
-    return x
+    return x, aux
 
 
-def forward(params, tokens, config: BurninConfig, mesh=None):
+def forward(params, tokens, config: BurninConfig, mesh=None, *, return_aux=False):
     """Logits for next-token prediction.  ``mesh=None`` → no constraints
-    (single-chip compile check); with a mesh, sp/tp constraints are applied."""
+    (single-chip compile check); with a mesh, sp/tp constraints are applied.
+    With ``return_aux`` the MoE load-balance loss is returned alongside."""
     import jax
     import jax.numpy as jnp
 
@@ -311,6 +388,27 @@ def forward(params, tokens, config: BurninConfig, mesh=None):
             "(the ring shards the sequence over the model axis; flash "
             "tiles the full sequence per tp shard)"
         )
+    if c.ring_attention and c.moe_experts > 0:
+        raise ValueError(
+            "ring_attention and moe_experts are mutually exclusive (the "
+            "ring shards the sequence over the model axis; MoE shards "
+            "experts over it)"
+        )
+    if c.pipeline_stages > 0:
+        if c.ring_attention or c.flash_attention:
+            raise ValueError(
+                "pipeline_stages is not combined with ring/flash attention "
+                "(the pipeline mesh has no model axis for them to use)"
+            )
+        if mesh is None or "pipe" not in mesh.shape:
+            raise ValueError(
+                "pipeline_stages requires a (data, pipe) mesh "
+                "(tpu_dra.parallel.pipeline.pipeline_mesh)"
+            )
+        from tpu_dra.parallel.pipeline import forward_pipelined
+
+        logits, aux = forward_pipelined(params, tokens, c, mesh)
+        return (logits, aux) if return_aux else logits
     if mesh is None:
         if c.ring_attention:
             # A silent dense fallback would let a single-chip check report
@@ -327,6 +425,10 @@ def forward(params, tokens, config: BurninConfig, mesh=None):
             "seq": P(("data", "fsdp"), "model", None),
             # tp region: full sequence, hidden ops sharded over heads/ffn
             "hidden": P(("data", "fsdp"), None, None),
+            # ep region: (E, B, C, D) expert tensors — experts over model;
+            # the boundary with the batch-sharded "hidden" layout is where
+            # XLA inserts the dispatch/return all-to-all pair.
+            "expert": P("model", ("data", "fsdp"), None, None),
         }
 
         def constrain(kind, arr):
@@ -340,25 +442,33 @@ def forward(params, tokens, config: BurninConfig, mesh=None):
         )
     )
 
-    def scan_body(h, layer):
-        return block(layer, h), None
+    def scan_body(carry, layer):
+        h, aux = carry
+        h, aux_l = block(layer, h)
+        return (h, aux + aux_l), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    (x, aux), _ = jax.lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    )
     x = _rms_norm(constrain("seq", x), params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.bfloat16), params["embed"].astype(jnp.bfloat16))
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    return (logits, aux) if return_aux else logits
 
 
 def _loss(params, tokens, config: BurninConfig, mesh=None):
     import jax.numpy as jnp
 
-    logits = forward(params, tokens, config, mesh)
+    logits, aux = forward(params, tokens, config, mesh, return_aux=True)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     zmax = logits.max(-1, keepdims=True)
     lse = jnp.log(jnp.sum(jnp.exp(logits - zmax), -1)) + zmax[..., 0]
     picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - picked)
+    ce = jnp.mean(lse - picked)
+    if config.moe_experts > 0:
+        ce = ce + config.moe_aux_weight * aux
+    return ce
 
 
 def make_train_step(config: BurninConfig, mesh=None):
@@ -387,14 +497,15 @@ def make_train_step(config: BurninConfig, mesh=None):
         return jax.jit(step, donate_argnums=0), _init_state(c)
 
     from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as P
 
     pspecs = param_specs(c)
     state_sh = (
         jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs),
         jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs),
     )
-    tok_sh = NamedSharding(mesh, P(("data", "fsdp"), None))
+    tok_sh = NamedSharding(mesh, token_spec(c))
+    from jax.sharding import PartitionSpec as P
+
     jitted = jax.jit(
         step,
         in_shardings=(state_sh, tok_sh),
@@ -403,6 +514,15 @@ def make_train_step(config: BurninConfig, mesh=None):
     )
     state = jax.device_put(_init_state(c), state_sh)
     return jitted, state
+
+
+def token_spec(config: BurninConfig):
+    """PartitionSpec for the token batch on this config's mesh flavor."""
+    from jax.sharding import PartitionSpec as P
+
+    if config.pipeline_stages > 0:
+        return P("data", None)  # the pipe mesh has no fsdp axis
+    return P(("data", "fsdp"), None)
 
 
 def _init_state(config: BurninConfig):
@@ -456,16 +576,15 @@ def train(
     import jax
 
     c = config or BurninConfig()
-    if mesh is not None:
-        c = c.scaled_to(mesh)
     try:
+        if mesh is not None:
+            c = c.scaled_to(mesh)
         step_fn, state = make_train_step(c, mesh)
         tokens = sample_tokens(c)
         if mesh is not None:
             from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
 
-            tokens = jax.device_put(tokens, NamedSharding(mesh, P(("data", "fsdp"), None)))
+            tokens = jax.device_put(tokens, NamedSharding(mesh, token_spec(c)))
         losses, times = [], []
         for _ in range(max(2, steps)):
             t0 = time.perf_counter()

@@ -1,0 +1,124 @@
+"""Expert parallelism: a switch-routed MoE MLP for the burn-in LM.
+
+The reference driver has no parallelism vocabulary of its own (SURVEY.md §2
+disclosure) — the TPU framework's job is to prove the allocated slice works
+under *every* sharding a real training job uses.  dp/fsdp/tp/sp and cp (ring)
+are covered by tpu_dra/parallel/burnin.py and ring.py; this module adds the
+last member, **ep**: experts sharded over the mesh's ``model`` axis, tokens
+routed to them through all-to-all collectives.
+
+Design: GShard-style *dense* dispatch (one-hot dispatch/combine einsums)
+rather than ragged gather/scatter —
+
+- every shape is static (XLA requirement; capacity bounds the per-expert
+  token count),
+- dispatch/combine are einsums, so they land on the MXU and fuse,
+- the all-to-alls are *inserted by XLA* from sharding constraints: token
+  tensors are batch-sharded, expert tensors are expert-sharded over
+  ``model``; the (b,s,e,c)->(e,b,c,d) einsum forces the resharding and the
+  compiler emits the a2a pair (dispatch + return) on ICI.  No hand-written
+  collective — the scaling-book recipe (annotate, let XLA place).
+
+Routing is top-1 ("switch") with a per-group capacity factor: tokens beyond
+an expert's capacity are dropped (their residual branch contributes zero —
+the residual stream carries them through), matching Switch Transformer
+semantics.  A load-balance auxiliary loss (E * sum_e f_e * p_e) keeps routing
+from collapsing; burn-in folds it into the training loss so the optimizer
+path is exercised too.
+"""
+
+from __future__ import annotations
+
+__all__ = ["init_moe_layer_params", "moe_param_specs", "moe_mlp"]
+
+
+def init_moe_layer_params(config, key):
+    """Stacked per-layer MoE weights (leading n_layers dim for lax.scan):
+    router (L, D, E), expert MLPs w1e (L, E, D, F), w2e (L, E, F, D)."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    L, D, F, E = c.n_layers, c.d_model, c.d_ff, c.moe_experts
+    k1, k2, k3 = jax.random.split(key, 3)
+
+    def dense(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)
+        ).astype(jnp.float32)
+
+    return {
+        "router": dense(k1, (L, D, E), D),
+        "w1e": dense(k2, (L, E, D, F), D),
+        "w2e": dense(k3, (L, E, F, D), F),
+    }
+
+
+def moe_param_specs():
+    """PartitionSpecs for the MoE leaves: experts sharded over ``model``
+    (that *is* expert parallelism), fsdp sharding the within-expert dim the
+    same way the dense MLP shards its matrices."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "router": P(None, "fsdp", None),
+        "w1e": P(None, "model", "fsdp", None),
+        "w2e": P(None, "model", None, "fsdp"),
+    }
+
+
+def expert_capacity(config) -> int:
+    """Static per-(batch-row, expert) token capacity."""
+    c = config
+    import math
+
+    return max(1, math.ceil(c.seq / c.moe_experts * c.moe_capacity))
+
+
+def moe_mlp(layer, h, config, constrain):
+    """The MoE MLP half of a transformer block.
+
+    ``h``: post-norm hidden states (batch, seq, d_model), bf16.
+    ``constrain(kind, arr)`` applies sharding constraints ("hidden" for
+    token-sharded tensors, "expert" for expert-sharded ones); identity when
+    unsharded.  Returns ``(out, aux)`` — the combined expert outputs (same
+    shape as h) and the scalar load-balance loss.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    bf16 = jnp.bfloat16
+    E = c.moe_experts
+    C = expert_capacity(c)
+
+    # --- routing (fp32: softmax and cumsum want the precision) ---
+    logits = jnp.einsum("bsd,de->bse", h.astype(jnp.float32), layer["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = probs.max(axis=-1)  # (B, S)
+    choice = probs.argmax(axis=-1)  # (B, S)
+
+    onehot = jax.nn.one_hot(choice, E, dtype=jnp.float32)  # (B, S, E)
+    # Position of each token in its expert's queue, in sequence order.
+    pos = jnp.cumsum(onehot, axis=1) - 1.0  # (B, S, E), valid where onehot=1
+    # one_hot maps out-of-range positions (>= C) to the zero row, so
+    # over-capacity tokens drop out of the dispatch tensor automatically.
+    posc = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
+    dispatch = onehot[..., None] * posc  # (B, S, E, C) in {0, 1}
+    combine = dispatch * gate[..., None, None]  # weighted return path
+
+    # --- load balance: E * sum_e (fraction routed to e) * (mean prob of e)
+    frac = onehot.mean(axis=(0, 1))  # (E,)
+    meanp = probs.mean(axis=(0, 1))  # (E,)
+    aux = E * jnp.sum(frac * meanp)
+
+    # --- dispatch -> expert compute -> combine (XLA inserts the a2a pair
+    # at the batch-sharded <-> expert-sharded boundary) ---
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(bf16), h)
+    expert_in = constrain("expert", expert_in)  # (E, B, C, D) E over model
+    h1 = jnp.einsum("ebcd,edf->ebcf", expert_in, layer["w1e"].astype(bf16))
+    h1 = jnp.where(h1 > 0, h1, 0.01 * h1)  # leaky relu, as the dense MLP
+    out_e = jnp.einsum("ebcf,efd->ebcd", h1, layer["w2e"].astype(bf16))
+    out_e = constrain("expert", out_e)
+    out = jnp.einsum("bsec,ebcd->bsd", combine.astype(bf16), out_e)
+    return out, aux

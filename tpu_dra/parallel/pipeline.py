@@ -1,0 +1,201 @@
+"""Pipeline parallelism: a GPipe schedule over a ``pipe`` mesh axis.
+
+Completes the burn-in LM's parallelism vocabulary (dp/fsdp/tp/sp in
+burnin.py, cp in ring.py, ep in moe.py): layers are split into P contiguous
+stages, each stage owned by one rank along the ``pipe`` axis, and
+microbatches stream through the stages with activations hopping stage→stage
+over ICI ``ppermute``.
+
+TPU-first shape of the implementation:
+
+- **SPMD, not MPMD.** One program runs on every chip (``shard_map`` over the
+  whole mesh); a stage's identity is ``lax.axis_index("pipe")``.  XLA sees a
+  single static program — no per-stage executables, no host-side scheduler,
+  unlike the reference ecosystem's NCCL send/recv pipelines.
+- **The schedule is a ``lax.scan``** over M + P - 1 ticks (the GPipe
+  steady-state plus fill/drain bubble).  Each tick: stage 0 ingests the next
+  microbatch, every stage applies its layer block, activations ``ppermute``
+  one hop down the ring.  Static trip count, static shapes — the whole
+  pipeline is one fused XLA while loop.
+- **Backward is just AD.** ``ppermute``'s transpose is the reverse
+  permutation, scan's transpose runs the ticks backward — differentiating
+  the forward yields the reverse pipeline schedule for free, with
+  ``jax.checkpoint`` on the stage block bounding activation memory to one
+  microbatch per stage.
+- Per-microbatch outputs are collected on the last stage and broadcast with
+  a masked ``psum`` (zeros elsewhere), keeping the output replicated over
+  ``pipe`` so loss/optimizer code stays axis-agnostic.
+
+Bubble fraction is the GPipe classic (P-1)/(M+P-1); burn-in reports wall
+time, so an undersized M shows up as lost throughput rather than an error.
+"""
+
+from __future__ import annotations
+
+import functools
+
+__all__ = ["pipeline_mesh", "forward_pipelined"]
+
+
+def pipeline_mesh(devices, *, stages: int, data: int = -1):
+    """A (data, pipe) logical mesh: ``pipe`` innermost so the every-tick
+    activation hop rides nearest-neighbor ICI links."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    n = len(devices)
+    if n % stages:
+        raise ValueError(f"{n} devices not divisible into {stages} stages")
+    if data == -1:
+        data = n // stages
+    if data * stages != n:
+        raise ValueError(f"mesh data={data} x pipe={stages} != {n} devices")
+    arr = np.array(devices, dtype=object).reshape(data, stages)
+    return Mesh(arr, ("data", "pipe"))
+
+
+def forward_pipelined(params, tokens, config, mesh):
+    """Pipelined logits: embedding and the logits projection are computed
+    replicated over ``pipe`` (tiny next to the blocks), the block stack runs
+    the GPipe schedule.  Returns ``(logits, aux)`` — aux is the MoE
+    load-balance loss averaged over microbatches (0.0 for dense MLPs), so
+    ep composes with pp."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    c = config
+    stages = int(mesh.shape["pipe"])
+    M = c.pipeline_microbatches
+    if c.n_layers % stages:
+        raise ValueError(
+            f"n_layers {c.n_layers} not divisible by {stages} pipeline stages"
+        )
+    if c.batch % (int(mesh.shape["data"]) * M):
+        raise ValueError(
+            f"batch {c.batch} not divisible by data {mesh.shape['data']} "
+            f"x microbatches {M}"
+        )
+
+    def constrain_data(arr):
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, P("data", *([None] * (arr.ndim - 1))))
+        )
+
+    x = params["embed"][tokens] + params["pos"][None, :, :]
+    x = constrain_data(x)
+    x, aux = _pipelined_blocks(params["layers"], x, config=c, mesh=mesh)
+    x = constrain_data(x)
+
+    from tpu_dra.parallel.burnin import _rms_norm
+
+    x = _rms_norm(x, params["ln_f"])
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x.astype(jnp.bfloat16), params["embed"].astype(jnp.bfloat16)
+    )
+    return logits.astype(jnp.float32), aux
+
+
+def _pipelined_blocks(layers, x, *, config, mesh):
+    """Run the stacked transformer blocks as a P-stage GPipe pipeline."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pre-0.8 jax
+        from jax.experimental.shard_map import shard_map
+    # Replication checking must be off (per-stage state diverges until the
+    # final psum); the flag was renamed check_rep -> check_vma in jax 0.8.
+    import inspect
+
+    _params = inspect.signature(shard_map).parameters
+    _nocheck = (
+        {"check_vma": False} if "check_vma" in _params else {"check_rep": False}
+    )
+
+    from tpu_dra.parallel.burnin import _block
+
+    c = config
+    stages = int(mesh.shape["pipe"])
+    M = c.pipeline_microbatches
+
+    # Stage compute: this rank's n_layers/P blocks, scanned (identical math
+    # to burnin.forward's scan; tp/sp constraints are identity inside a
+    # stage — the pipe axis carries layers, not tensor dims).
+    block = jax.checkpoint(
+        functools.partial(
+            _block, config=c, constrain=lambda kind, a: a, ring_mesh=None
+        )
+    )
+
+    def apply_stage(stage_layers, h):
+        def body(carry, layer):
+            h, aux = carry
+            h, aux_l = block(layer, h)
+            return (h, aux + aux_l), None
+
+        (h, aux), _ = lax.scan(
+            body, (h, jnp.zeros((), jnp.float32)), stage_layers
+        )
+        return h, aux
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("pipe"), P("data", None, None)),
+        out_specs=(P("data", None, None), P()),
+        **_nocheck,
+    )
+    def run(stage_layers, xb):
+        # stage_layers: this rank's (L/P, ...) slice of every layer leaf.
+        # xb: this data-shard's (b_local, S, D) activations (replicated
+        # over pipe — every stage holds them; only stage 0 feeds them in).
+        rank = lax.axis_index("pipe")
+        b_local = xb.shape[0]
+        mb = xb.reshape(M, b_local // M, *xb.shape[1:])
+        state = jnp.zeros_like(mb[0])
+        outs = jnp.zeros_like(mb)
+        aux0 = jnp.zeros((), jnp.float32)
+
+        def tick(carry, t):
+            state, outs, aux = carry
+            feed = lax.dynamic_index_in_dim(
+                mb, jnp.minimum(t, M - 1), 0, keepdims=False
+            )
+            cur = jnp.where(rank == 0, feed, state)
+            y, aux_t = apply_stage(stage_layers, cur)
+            # Stage r processes real microbatches only during its active
+            # window t in [r, r+M); fill/drain ticks chew on garbage and
+            # must not contribute to the aux loss.
+            active = (t >= rank) & (t < rank + M)
+            aux = aux + jnp.where(active, aux_t, 0.0)
+            # The last stage completes microbatch t-(P-1) at tick t; write
+            # it into the output buffer (other stages' writes are masked
+            # out by the final psum, and pre-fill ticks keep the old row).
+            out_idx = jnp.clip(t - (stages - 1), 0, M - 1)
+            prev = lax.dynamic_index_in_dim(outs, out_idx, 0, keepdims=False)
+            row = jnp.where(t >= stages - 1, y, prev)
+            outs = lax.dynamic_update_index_in_dim(outs, row, out_idx, 0)
+            state = lax.ppermute(
+                y, "pipe", [(i, (i + 1) % stages) for i in range(stages)]
+            )
+            return (state, outs, aux), None
+
+        (_, outs, aux), _ = lax.scan(
+            tick, (state, outs, aux0), jnp.arange(M + stages - 1)
+        )
+        # Only the last stage's buffer is real; broadcast it to all stages
+        # so the output is replicated over pipe.
+        outs = lax.psum(
+            jnp.where(rank == stages - 1, outs, jnp.zeros_like(outs)), "pipe"
+        )
+        # Per-stage aux sums cover disjoint layer ranges; the psum totals
+        # them, /M converts sum-over-microbatches to the microbatch mean,
+        # and the data-axis pmean makes the scalar truly replicated.
+        aux = lax.pmean(lax.psum(aux, "pipe") / M, "data")
+        return outs.reshape(xb.shape), aux
+
+    return run(layers, x)
