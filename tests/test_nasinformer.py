@@ -1,0 +1,166 @@
+"""NasInformer (tpu_dra/controller/nasinformer.py): the LIST+WATCH cache
+serving the scheduling fan-out's reads."""
+
+from __future__ import annotations
+
+import time
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.client.apiserver import FakeApiServer
+from tpu_dra.client.clientset import ClientSet
+from tpu_dra.controller.nasinformer import NasInformer
+
+NS = "tpu-dra"
+
+
+def _nas(name: str, status: str = nascrd.STATUS_READY) -> nascrd.NodeAllocationState:
+    return nascrd.NodeAllocationState(
+        metadata=ObjectMeta(name=name, namespace=NS), status=status
+    )
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_informer_syncs_and_tracks_events():
+    cs = ClientSet(FakeApiServer())
+    client = cs.node_allocation_states(NS)
+    client.create(_nas("node-a"))
+
+    informer = NasInformer(cs, NS)
+    informer.start()
+    try:
+        assert informer.wait_synced(5.0)
+        assert informer.get("node-a") is not None
+        assert informer.get("node-zzz") is None
+
+        # ADDED flows in via the watch.
+        client.create(_nas("node-b"))
+        assert _wait(lambda: informer.get("node-b") is not None)
+
+        # MODIFIED replaces the cached copy.
+        fresh = client.get("node-b")
+        fresh.status = nascrd.STATUS_NOT_READY
+        client.update(fresh)
+        assert _wait(
+            lambda: informer.get("node-b").status == nascrd.STATUS_NOT_READY
+        )
+
+        # DELETED evicts.
+        client.delete("node-a")
+        assert _wait(lambda: informer.get("node-a") is None)
+    finally:
+        informer.stop()
+
+
+def test_informer_returns_private_copies():
+    cs = ClientSet(FakeApiServer())
+    cs.node_allocation_states(NS).create(_nas("node-a"))
+    informer = NasInformer(cs, NS)
+    informer.start()
+    try:
+        assert informer.wait_synced(5.0)
+        first = informer.get("node-a")
+        # A fan-out pass mutates its copy (pending merge); the cache and
+        # other readers must not see it.
+        first.spec.allocated_claims["uid-1"] = nascrd.AllocatedDevices()
+        second = informer.get("node-a")
+        assert "uid-1" not in second.spec.allocated_claims
+    finally:
+        informer.stop()
+
+
+def test_informer_generation_bumps_on_events():
+    cs = ClientSet(FakeApiServer())
+    informer = NasInformer(cs, NS)
+    informer.start()
+    try:
+        assert informer.wait_synced(5.0)
+        g0 = informer.generation()
+        cs.node_allocation_states(NS).create(_nas("node-a"))
+        assert _wait(lambda: informer.generation() > g0)
+    finally:
+        informer.stop()
+
+
+def test_informer_stale_event_does_not_regress():
+    informer = NasInformer(ClientSet(FakeApiServer()), NS)
+    # Drive _apply directly: a newer object is held, an older buffered
+    # event (subscribe-before-list overlap) must be discarded.
+    new = _nas("node-a")
+    new.metadata.resource_version = "10"
+    informer._apply({"type": "ADDED", "object": new})
+    old = _nas("node-a", status=nascrd.STATUS_NOT_READY)
+    old.metadata.resource_version = "5"
+    informer._apply({"type": "MODIFIED", "object": old})
+    assert informer.get("node-a").status == nascrd.STATUS_READY
+    assert informer.get("node-a").metadata.resource_version == "10"
+
+
+def test_driver_write_fence_rejects_stale_informer_copy():
+    """Regression: a cached NAS older than the driver's own last committed
+    write must NOT feed the fan-out (it would drop just-allocated devices
+    from the availability math -> double allocation under churn)."""
+    from tpu_dra.controller.driver import ControllerDriver
+
+    cs = ClientSet(FakeApiServer())
+    client = cs.node_allocation_states(NS)
+    client.create(_nas("node-a"))
+    driver = ControllerDriver(cs, NS)
+    try:
+        driver.start_nas_informer()
+        assert driver.nas_informer.wait_synced(5.0)
+        assert _wait(lambda: driver.nas_informer.get("node-a") is not None)
+        # Fresh cache, no writes yet: served from the informer.
+        assert driver._informer_nas("node-a") is not None
+
+        # The driver commits a write (rv bumps beyond the cached copy)...
+        fresh = client.get("node-a")
+        fresh = client.update(fresh)
+        driver._note_node_write("node-a", fresh)
+
+        # ...and freeze the informer at the stale copy by stuffing the
+        # store directly (simulating watch lag at the worst moment).
+        import pickle
+
+        stale = _nas("node-a")
+        stale.metadata.resource_version = "1"
+        with driver.nas_informer._lock:
+            driver.nas_informer._store["node-a"] = (
+                1, pickle.dumps(stale, protocol=pickle.HIGHEST_PROTOCOL)
+            )
+        assert driver._informer_nas("node-a") is None  # forces a fresh GET
+
+        # A later write flows in via the watch and catches the cache up
+        # past the fence: the informer serves again.
+        fresh = client.get("node-a")
+        client.update(fresh)
+        assert _wait(lambda: driver._informer_nas("node-a") is not None)
+    finally:
+        driver.close()
+
+
+def test_driver_falls_back_until_synced():
+    from tpu_dra.controller.driver import ControllerDriver
+
+    cs = ClientSet(FakeApiServer())
+    driver = ControllerDriver(cs, NS)
+    try:
+        assert driver.nas_informer is None  # GET path by default
+        driver.start_nas_informer()
+        assert driver.nas_informer is not None
+        assert driver.nas_informer.synced()
+        # Idempotent start.
+        informer = driver.nas_informer
+        driver.start_nas_informer()
+        assert driver.nas_informer is informer
+    finally:
+        driver.close()
+    assert driver.nas_informer is None

@@ -70,6 +70,9 @@ class ControllerApp:
         self.controller.start()
         # Level-triggered gang health: periodic audit + coordinator repair.
         self.driver.start_gang_auditor()
+        # Fan-out reads served from the LIST+WATCH cache (informer model);
+        # falls back to per-node GETs until synced.
+        self.driver.start_nas_informer()
         logger.info(
             "controller %s running with %d workers", version_string(), self.args.workers
         )

@@ -53,9 +53,34 @@ class ControllerDriver:
         self._fanout_closed = False
         self._auditor_stop = threading.Event()
         self._auditor_thread: "threading.Thread | None" = None
+        # Optional watch-driven NAS cache for the fan-out read path
+        # (start_nas_informer); None -> per-node GETs like the reference.
+        self.nas_informer = None
+        # Read-your-writes fence for the informer path: highest NAS
+        # resourceVersion this driver committed per node.  The fan-out's
+        # correctness argument is "every picker sees fresh allocated state
+        # + all pending picks under the node lock"; an informer copy that
+        # trails our own allocate/deallocate writes would break the first
+        # half (observed as double allocation under churn), so such reads
+        # fall back to a fresh GET.
+        self._node_write_rv: "dict[str, int]" = {}
+        self._write_rv_lock = threading.Lock()
         from tpu_dra.controller.gang_tracker import GangTracker
 
         self.gangs = GangTracker(clientset, namespace)
+
+    def start_nas_informer(self, wait_synced_s: "float | None" = 5.0) -> None:
+        """Serve UnsuitableNodes reads from a LIST+WATCH cache instead of a
+        NAS GET per node per pass (controller/nasinformer.py).  Safe to skip
+        — the GET path remains the fallback until the cache syncs."""
+        if self.nas_informer is not None:
+            return
+        from tpu_dra.controller.nasinformer import NasInformer
+
+        self.nas_informer = NasInformer(self.clientset, self.namespace)
+        self.nas_informer.start()
+        if wait_synced_s:
+            self.nas_informer.wait_synced(wait_synced_s)
 
     # -- gang audit loop ------------------------------------------------------
 
@@ -168,6 +193,33 @@ class ControllerDriver:
             metadata=ObjectMeta(name=node, namespace=self.namespace)
         )
         return nas, NasClient(nas, self.clientset)
+
+    def _note_node_write(self, node: str, nas: nascrd.NodeAllocationState) -> None:
+        """Record our committed write's resourceVersion (informer fence)."""
+        try:
+            rv = int(nas.metadata.resource_version or "0")
+        except (TypeError, ValueError):
+            return
+        with self._write_rv_lock:
+            if rv > self._node_write_rv.get(node, 0):
+                self._node_write_rv[node] = rv
+
+    def _informer_nas(self, node: str) -> "nascrd.NodeAllocationState | None":
+        """The cached NAS if it is at least as fresh as our own last write
+        to this node; None -> caller must GET (or has no informer)."""
+        informer = self.nas_informer
+        if informer is None or not informer.synced():
+            return None
+        nas = informer.get(node)
+        if nas is None:
+            return None
+        try:
+            rv = int(nas.metadata.resource_version or "0")
+        except (TypeError, ValueError):
+            return None
+        with self._write_rv_lock:
+            fence = self._node_write_rv.get(node, 0)
+        return nas if rv >= fence else None
 
     def allocate(
         self,
@@ -303,6 +355,7 @@ class ControllerDriver:
                 )
                 gang_name = claim_params.gang.name
             client.update(nas.spec)
+            self._note_node_write(selected_node, nas)
             self.gangs.commit(
                 claim_uid, claim.metadata.namespace, gang_name
             )
@@ -391,6 +444,7 @@ class ControllerDriver:
                 raise ValueError(f"unknown AllocatedDevices type: {allocated.type()}")
             del nas.spec.allocated_claims[claim_uid]
             client.update(nas.spec)
+            self._note_node_write(selected_node, nas)
         if gang is not None and gang[2] == 0:
             # Rank 0 left: once a new rank-0 commits, members must converge
             # on its coordinator; repair is a no-op until then (and again
@@ -452,6 +506,9 @@ class ControllerDriver:
         if self._auditor_thread is not None:
             self._auditor_thread.join(timeout=5)
             self._auditor_thread = None
+        informer, self.nas_informer = self.nas_informer, None
+        if informer is not None:
+            informer.stop()
 
     def unsuitable_nodes(
         self, pod: Pod, cas: list[ClaimAllocation], potential_nodes: list[str]
@@ -532,13 +589,20 @@ class ControllerDriver:
         from tpu_dra.client.apiserver import ApiError
 
         with self.lock.locked(potential_node):
-            nas, client = self._nas_client(potential_node)
-            try:
-                client.get()
-            except ApiError:
-                for ca in allcas:
-                    ca.unsuitable_nodes.append(potential_node)
-                return
+            # Informer path: the cached copy is private (pickle round-trip)
+            # and rv-fenced against our own writes (_informer_nas) — the
+            # pending-pick disjointness argument needs every picker to see
+            # at least this driver's committed allocations.  Plugin-side
+            # staleness (status, prepared) is advisory only.
+            nas = self._informer_nas(potential_node)
+            if nas is None:
+                nas, client = self._nas_client(potential_node)
+                try:
+                    client.get()
+                except ApiError:
+                    for ca in allcas:
+                        ca.unsuitable_nodes.append(potential_node)
+                    return
             if nas.status != nascrd.STATUS_READY:
                 for ca in allcas:
                     ca.unsuitable_nodes.append(potential_node)

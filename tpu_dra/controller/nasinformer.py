@@ -1,0 +1,161 @@
+"""Watch-driven NodeAllocationState cache for the scheduling fan-out.
+
+The reference's UnsuitableNodes pass GETs the node's NAS under a per-node
+lock for every potential node of every pending pod
+(cmd/nvidia-dra-controller/driver.go:253-260) — at fleet scale that is
+nodes x pods x rechecks apiserver round-trips per scheduling wave.  Real
+Kubernetes controllers do not read hot state that way: they maintain a
+LIST+WATCH informer cache and serve reads locally (client-go's informer
+machinery, which the reference vendors but does not use for NAS reads).
+
+This is that informer, sized to the driver's needs:
+
+- One LIST seeds the store, then a WATCH keeps it current; any error or
+  dropped watch re-lists (the fake apiserver and the real wire client both
+  surface k8s relist semantics — restserver relists on 410 Gone).
+- ``get()`` returns a **private typed copy** (pickle round-trip, same trick
+  as the clientset's ParseCache): the unsuitable pass mutates the object it
+  reads (it merges pending allocations into ``spec.allocated_claims``), so
+  shared references would race.
+- Staleness is bounded by watch latency and is *safe by design*: the
+  unsuitable pass is advisory — Allocate re-GETs fresh state under the
+  node lock and every NAS write is resourceVersion-checked, so the worst a
+  stale read causes is one scheduling retry, not a double allocation.
+- ``generation()`` bumps on every applied event; callers can use it to
+  skip recomputation when nothing changed between passes.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api import serde
+
+logger = logging.getLogger(__name__)
+
+RELIST_BACKOFF_S = 1.0
+
+
+def _rv_int(obj) -> int:
+    """resourceVersion as an orderable int (k8s rvs are opaque strings but
+    both backing stores here emit increasing integers); unparseable -> 0 so
+    the event applies (last-writer-wins)."""
+    try:
+        return int(obj.metadata.resource_version or "0")
+    except (TypeError, ValueError):
+        return 0
+
+
+class NasInformer:
+    """LIST+WATCH cache of one namespace's NodeAllocationState objects."""
+
+    def __init__(self, clientset, namespace: str):
+        self._client = clientset.node_allocation_states(namespace)
+        self._lock = threading.Lock()
+        # name -> (resourceVersion as int, pickled typed object)
+        self._store: "dict[str, tuple[int, bytes]]" = {}
+        self._generation = 0
+        self._stop = threading.Event()
+        self._synced = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._watch = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="nas-informer", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        watch = self._watch
+        if watch is not None:
+            watch.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def wait_synced(self, timeout: "float | None" = 5.0) -> bool:
+        """True once the initial LIST has populated the store."""
+        return self._synced.wait(timeout)
+
+    # -- reads ---------------------------------------------------------------
+
+    def get(self, name: str) -> "nascrd.NodeAllocationState | None":
+        """A private copy of the cached NAS, or None when unknown/unsynced."""
+        with self._lock:
+            entry = self._store.get(name)
+        return pickle.loads(entry[1]) if entry is not None else None
+
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def synced(self) -> bool:
+        return self._synced.is_set()
+
+    # -- internals -----------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                # Subscribe BEFORE the snapshot (the node plugin's GC uses
+                # the same order, plugin/driver.py): a write landing between
+                # LIST and WATCH would otherwise be lost until a relist that
+                # may never come.  The rv guard in _apply makes the overlap
+                # harmless — a buffered event older than the listed object
+                # is discarded.
+                self._watch = self._client.watch()
+                objs = self._client.list()
+                fresh = {
+                    o.metadata.name: (
+                        _rv_int(o),
+                        pickle.dumps(o, protocol=pickle.HIGHEST_PROTOCOL),
+                    )
+                    for o in objs
+                }
+                with self._lock:
+                    self._store = fresh
+                    self._generation += 1
+                self._synced.set()
+                for event in self._watch:
+                    self._apply(event)
+                    if self._stop.is_set():
+                        break
+            except Exception:
+                if self._stop.is_set():
+                    return
+                logger.exception("nas informer list/watch failed; relisting")
+            finally:
+                watch, self._watch = self._watch, None
+                if watch is not None:
+                    watch.stop()
+            self._stop.wait(RELIST_BACKOFF_S)
+
+    def _apply(self, event: dict) -> None:
+        obj = event.get("object")
+        if isinstance(obj, dict):
+            obj = serde.from_dict(nascrd.NodeAllocationState, obj)
+        if obj is None or obj.metadata is None or not obj.metadata.name:
+            return
+        name = obj.metadata.name
+        rv = _rv_int(obj)
+        with self._lock:
+            held = self._store.get(name)
+            if held is not None and rv < held[0]:
+                return  # stale buffered event from the subscribe overlap
+            if event.get("type") == "DELETED":
+                self._store.pop(name, None)
+            else:
+                self._store[name] = (
+                    rv,
+                    pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+                )
+            self._generation += 1

@@ -170,6 +170,7 @@ class SimCluster:
             node.start()
         self.controller.start()
         self.controller_driver.start_gang_auditor(interval_s=1.0)
+        self.controller_driver.start_nas_informer()
         self.kubesim.start()
 
     def stop(self) -> None:

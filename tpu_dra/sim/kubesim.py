@@ -76,6 +76,13 @@ class KubeSim:
         self._stop = threading.Event()
         self._threads: "list[threading.Thread]" = []
         self._proxy_procs: "dict[str, object]" = {}  # name -> subprocess.Popen
+        # ready_nodes memo: (monotonic deadline, names).  A real scheduler
+        # reads node state from an informer cache, not a LIST per pod; one
+        # poll interval of staleness matches that model and takes the
+        # NAS-list cost out of the per-pod scheduling path (at 64 nodes the
+        # repeated LISTs dominated the fleet bench's scheduler loop).
+        self._ready_lock = threading.Lock()
+        self._ready_memo: "tuple[float, list[str]]" = (0.0, [])
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -93,14 +100,25 @@ class KubeSim:
     # -- node discovery -------------------------------------------------------
 
     def ready_nodes(self) -> "list[str]":
+        with self._ready_lock:
+            deadline, names = self._ready_memo
+            if time.monotonic() < deadline:
+                return list(names)
         out = []
         try:
             for nas in self.clientset.node_allocation_states(self.namespace).list():
                 if nas.status == nascrd.STATUS_READY:
                     out.append(nas.metadata.name)
         except ApiError:
-            pass
-        return sorted(out)
+            # Serve last-known-good without refreshing the memo (informer
+            # semantics): one transient LIST failure must not blank the
+            # fleet for a whole poll interval.
+            with self._ready_lock:
+                return list(self._ready_memo[1])
+        out = sorted(out)
+        with self._ready_lock:
+            self._ready_memo = (time.monotonic() + self.poll_s, out)
+        return list(out)
 
     # -- control loops --------------------------------------------------------
 
