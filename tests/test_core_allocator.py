@@ -348,3 +348,43 @@ class TestAllocation:
         other = make_ca(CoreClaimParametersSpec(profile="1c"))
         run_unsuitable(driver, nas, [], allcas=[other])
         assert other.unsuitable_nodes == []
+
+
+class TestPromoteGuard:
+    def params(self, profile="1c", name="slice-claim"):
+        return CoreClaimParametersSpec(profile=profile, subslice_claim_name=name)
+
+    def test_overlap_with_committed_sibling_core_raises_and_drops_pending(self):
+        from tpu_dra.api.nas_v1alpha1 import AllocatedCore, AllocatedCores
+
+        driver = CoreDriver()
+        nas = make_nas(partitionable=True)
+        add_shared_subslice(nas, start=0, size=2)
+        ca = make_ca(self.params(), name="core-b")
+        run_unsuitable(driver, nas, [ca])
+        picked = driver.pending_allocated_claims.get(
+            ca.claim.metadata.uid, NODE
+        ).core.devices[0]
+
+        # A sibling core claim committed the same interval meanwhile.
+        fresh = make_nas(partitionable=True)
+        add_shared_subslice(fresh, start=0, size=2)
+        fresh.spec.allocated_claims["sibling-uid"] = AllocatedDevices(
+            core=AllocatedCores(
+                devices=[
+                    AllocatedCore(
+                        profile="1c",
+                        parent_uuid=picked.parent_uuid,
+                        placement=Placement(
+                            picked.placement.start, picked.placement.size
+                        ),
+                        subslice_claim_uid=picked.subslice_claim_uid,
+                    )
+                ]
+            )
+        )
+        with pytest.raises(RuntimeError, match="overlaps committed"):
+            driver.allocate(fresh, ca.claim, ca.claim_parameters, None, NODE)
+        assert not driver.pending_allocated_claims.exists(
+            ca.claim.metadata.uid, NODE
+        )

@@ -138,14 +138,17 @@ class TestValidateSlice:
         ops = {c["op"] for c in report.checks}
         assert ops == {"psum", "all_gather", "ppermute_ring", "psum_bandwidth"}
 
-    def test_train_stage_includes_ring_configuration(self):
+    def test_train_stage_includes_ring_and_moe_configurations(self):
         # With a multi-device model axis, acceptance must also run the
-        # long-context (ring attention) step.
+        # long-context (ring attention) and expert-parallel (MoE a2a)
+        # steps — the collective patterns those job families will use.
         report = validate_slice(topology="4x2x1", env={}, train_steps=2)
         assert report.ok, report.errors
         assert report.train is not None and report.train["ok"]
         assert report.train_ring is not None, "ring stage did not run"
         assert report.train_ring["ok"], report.train_ring
+        assert report.train_moe is not None, "moe stage did not run"
+        assert report.train_moe["ok"], report.train_moe
 
     def test_device_count_mismatch_fails(self):
         report = validate_slice(

@@ -228,3 +228,115 @@ class TestEmptyClaimList:
         other = make_ca(TpuClaimParametersSpec(count=1), name="tpu-only")
         driver.unsuitable_node(nas, make_pod(), [], [other], NODE)
         assert other.unsuitable_nodes == []
+
+
+class TestPromoteGuard:
+    def test_overlap_with_committed_subslice_raises_and_drops_pending(self):
+        from tpu_dra.api.nas_v1alpha1 import AllocatedSubslice, AllocatedSubslices
+
+        driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        ca = make_ca(SubsliceClaimParametersSpec(profile="1c.4gb"), name="claim-b")
+        run_unsuitable(driver, nas, [ca])
+        picked = driver.pending_allocated_claims.get(
+            ca.claim.metadata.uid, NODE
+        ).subslice.devices[0]
+
+        fresh = make_nas(partitionable=True)
+        fresh.spec.allocated_claims["other-uid"] = AllocatedDevices(
+            subslice=AllocatedSubslices(
+                devices=[
+                    AllocatedSubslice(
+                        profile="1c.4gb",
+                        parent_uuid=picked.parent_uuid,
+                        placement=Placement(
+                            picked.placement.start, picked.placement.size
+                        ),
+                    )
+                ]
+            )
+        )
+        with pytest.raises(RuntimeError, match="overlaps committed"):
+            driver.allocate(fresh, ca.claim, ca.claim_parameters, None, NODE)
+        assert not driver.pending_allocated_claims.exists(
+            ca.claim.metadata.uid, NODE
+        )
+
+    def test_whole_chip_parent_claim_ok_with_affinity(self):
+        # Parent-claim affinity (tpu-test4) allocates the chip whole AND
+        # carves subslices from it; the promote guard must allow that.
+        driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        parent_ca = make_ca(TpuClaimParametersSpec(count=1), name="parent")
+        nas.spec.allocated_claims[parent_ca.claim.metadata.uid] = AllocatedDevices(
+            claim_info=ClaimInfo(
+                namespace="default",
+                name="parent",
+                uid=parent_ca.claim.metadata.uid,
+            ),
+            tpu=AllocatedTpus(devices=[AllocatedTpu(uuid="tpu-0")]),
+        )
+        ca = make_ca(
+            SubsliceClaimParametersSpec(
+                profile="1c.4gb", tpu_claim_name="parent"
+            ),
+            name="claim-b",
+        )
+        run_unsuitable(driver, nas, [ca])
+        assert ca.unsuitable_nodes == []
+        picked = driver.pending_allocated_claims.get(
+            ca.claim.metadata.uid, NODE
+        ).subslice.devices[0]
+        assert picked.parent_uuid == "tpu-0"
+
+        # Promote against fresh state still holding the whole-chip parent.
+        del nas.spec.allocated_claims[ca.claim.metadata.uid]
+        driver.allocate(nas, ca.claim, ca.claim_parameters, None, NODE)
+        assert ca.claim.metadata.uid in nas.spec.allocated_claims
+
+    def test_whole_chip_claim_conflicts_without_affinity(self):
+        # No tpu_claim_name: an unrelated whole-chip claim committed on the
+        # parent after the probe must fail the promote.
+        driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        ca = make_ca(SubsliceClaimParametersSpec(profile="1c.4gb"), name="claim-b")
+        run_unsuitable(driver, nas, [ca])
+        picked = driver.pending_allocated_claims.get(
+            ca.claim.metadata.uid, NODE
+        ).subslice.devices[0]
+
+        fresh = make_nas(partitionable=True)
+        fresh.spec.allocated_claims["other-uid"] = AllocatedDevices(
+            tpu=AllocatedTpus(devices=[AllocatedTpu(uuid=picked.parent_uuid)])
+        )
+        with pytest.raises(RuntimeError, match="whole-chip"):
+            driver.allocate(fresh, ca.claim, ca.claim_parameters, None, NODE)
+
+    def test_committed_core_interval_conflicts(self):
+        # Defense-in-depth vs dangling cores: a committed core interval on
+        # the same chip blocks an overlapping subslice promote.
+        from tpu_dra.api.nas_v1alpha1 import AllocatedCore, AllocatedCores
+
+        driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        ca = make_ca(SubsliceClaimParametersSpec(profile="4c.16gb"), name="claim-b")
+        run_unsuitable(driver, nas, [ca])
+        picked = driver.pending_allocated_claims.get(
+            ca.claim.metadata.uid, NODE
+        ).subslice.devices[0]
+
+        fresh = make_nas(partitionable=True)
+        fresh.spec.allocated_claims["core-uid"] = AllocatedDevices(
+            core=AllocatedCores(
+                devices=[
+                    AllocatedCore(
+                        profile="1c",
+                        parent_uuid=picked.parent_uuid,
+                        placement=Placement(picked.placement.start, 1),
+                        subslice_claim_uid="gone-uid",
+                    )
+                ]
+            )
+        )
+        with pytest.raises(RuntimeError, match="overlaps committed"):
+            driver.allocate(fresh, ca.claim, ca.claim_parameters, None, NODE)

@@ -284,3 +284,70 @@ class TestReviewRegressions:
         assert allocated.topology == "1x4x1"
         coords = [d.coord for d in allocated.devices]
         assert coords == [(0, 0, 0), (0, 1, 0), (0, 2, 0), (0, 3, 0)]
+
+
+class TestPromoteGuard:
+    """Promote-time overlap validation: a pending pick that collides with
+    state committed after the probe must be dropped, not written."""
+
+    def test_overlap_with_committed_tpu_claim_raises_and_drops_pending(self):
+        driver = TpuDriver()
+        nas = make_nas()
+        ca = make_ca(TpuClaimParametersSpec(count=1), name="claim-b")
+        run_unsuitable(driver, nas, [ca])
+        picked = driver.pending_allocated_claims.get(
+            ca.claim.metadata.uid, NODE
+        ).tpu.devices[0].uuid
+
+        # Another claim committed the same chip meanwhile (as a stale read
+        # would allow): fresh NAS now shows it allocated.
+        fresh = make_nas()
+        fresh.spec.allocated_claims["other-uid"] = AllocatedDevices(
+            tpu=AllocatedTpus(devices=[AllocatedTpu(uuid=picked)])
+        )
+        with pytest.raises(RuntimeError, match="overlaps committed"):
+            driver.allocate(fresh, ca.claim, ca.claim_parameters, None, NODE)
+        assert not driver.pending_allocated_claims.exists(
+            ca.claim.metadata.uid, NODE
+        ), "stale pending pick must be dropped so the retry re-places"
+
+    def test_subslice_on_parent_is_not_a_conflict(self):
+        # The MIG-model shape (tpu-test4): a whole-chip parent claim whose
+        # chip hosts affinity subslices is legitimate — the guard must only
+        # reject same-kind double-booking.
+        driver = TpuDriver()
+        nas = make_nas()
+        ca = make_ca(TpuClaimParametersSpec(count=4), name="claim-b")
+        run_unsuitable(driver, nas, [ca])
+        picked = driver.pending_allocated_claims.get(
+            ca.claim.metadata.uid, NODE
+        ).tpu.devices[0].uuid
+
+        fresh = make_nas()
+        fresh.spec.allocated_claims["other-uid"] = AllocatedDevices(
+            subslice=AllocatedSubslices(
+                devices=[
+                    AllocatedSubslice(
+                        profile="2c.8gb",
+                        parent_uuid=picked,
+                        placement=Placement(0, 2),
+                    )
+                ]
+            )
+        )
+        driver.allocate(fresh, ca.claim, ca.claim_parameters, None, NODE)
+        assert ca.claim.metadata.uid in fresh.spec.allocated_claims
+
+    def test_clean_promote_still_succeeds(self):
+        driver = TpuDriver()
+        nas = make_nas()
+        ca = make_ca(TpuClaimParametersSpec(count=2), name="claim-b")
+        run_unsuitable(driver, nas, [ca])
+        on_success = driver.allocate(
+            nas, ca.claim, ca.claim_parameters, None, NODE
+        )
+        assert ca.claim.metadata.uid in nas.spec.allocated_claims
+        on_success()
+        assert not driver.pending_allocated_claims.exists(
+            ca.claim.metadata.uid, NODE
+        )

@@ -115,6 +115,28 @@ class CoreDriver:
                     f"claim '{claim_uid}' is no longer allocated on "
                     f"'{selected_node}'"
                 )
+            # Promote-time overlap guard (see tpu_allocator.allocate): a
+            # committed sibling core claim carved from the same shared
+            # subslice must not hold an overlapping interval.
+            for uid, alloc in crd.spec.allocated_claims.items():
+                if uid == claim_uid or alloc.core is None:
+                    continue
+                for other in alloc.core.devices:
+                    if (
+                        other.subslice_claim_uid == dev.subslice_claim_uid
+                        and other.placement.overlaps(dev.placement)
+                    ):
+                        self.pending_allocated_claims.remove_node(
+                            claim_uid, selected_node
+                        )
+                        raise RuntimeError(
+                            f"pending core allocation for claim "
+                            f"'{claim_uid}' overlaps committed core claim "
+                            f"'{uid}' at {dev.parent_uuid}"
+                            f"[{dev.placement.start}:"
+                            f"{dev.placement.start + dev.placement.size}] "
+                            f"on '{selected_node}'; dropped for re-placement"
+                        )
         crd.spec.allocated_claims[claim_uid] = pending
         return lambda: self.pending_allocated_claims.remove(claim_uid)
 

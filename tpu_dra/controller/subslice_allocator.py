@@ -78,9 +78,60 @@ class SubsliceDriver:
                 f"no allocations generated for claim '{claim_uid}' "
                 f"on node '{selected_node}' yet"
             )
-        crd.spec.allocated_claims[claim_uid] = self.pending_allocated_claims.get(
-            claim_uid, selected_node
+        pending = self.pending_allocated_claims.get(claim_uid, selected_node)
+        # Promote-time overlap guard (see tpu_allocator.allocate): re-check
+        # the pending placements against the fresh NAS under the node lock.
+        # Conflicts: any committed subslice or core claim overlapping the
+        # same interval on the same chip; and — only when this claim has no
+        # tpu_claim_name affinity — a whole-chip claim holding the parent
+        # (with affinity, whole-parent + carved subslices is the intended
+        # shape: MIG model, demo tpu-test4).
+        whole = (
+            set()
+            if claim_params.tpu_claim_name
+            else {
+                d.uuid
+                for uid, alloc in crd.spec.allocated_claims.items()
+                if uid != claim_uid and alloc.tpu is not None
+                for d in alloc.tpu.devices
+            }
         )
+        committed = [
+            d
+            for uid, alloc in crd.spec.allocated_claims.items()
+            if uid != claim_uid and alloc.subslice is not None
+            for d in alloc.subslice.devices
+        ]
+        committed += [
+            d
+            for uid, alloc in crd.spec.allocated_claims.items()
+            if uid != claim_uid and alloc.core is not None
+            for d in alloc.core.devices
+        ]
+        conflicts = []
+        for dev in pending.subslice.devices if pending.subslice else []:
+            if dev.parent_uuid in whole:
+                conflicts.append(f"{dev.parent_uuid} (whole-chip claim)")
+            for other in committed:
+                if (
+                    other.parent_uuid == dev.parent_uuid
+                    and other.placement.overlaps(dev.placement)
+                ):
+                    conflicts.append(
+                        f"{dev.parent_uuid}[{dev.placement.start}:"
+                        f"{dev.placement.start + dev.placement.size}]"
+                    )
+        if conflicts:
+            # Only this node's pick is invalidated; picks probed against
+            # other nodes' state remain valid (and are re-synced by the
+            # retry's fan-out regardless).
+            self.pending_allocated_claims.remove_node(claim_uid, selected_node)
+            raise RuntimeError(
+                f"pending subslice allocation for claim '{claim_uid}' "
+                f"overlaps committed placement(s) {sorted(set(conflicts))} "
+                f"on node '{selected_node}'; dropped for re-placement"
+            )
+        crd.spec.allocated_claims[claim_uid] = pending
         return lambda: self.pending_allocated_claims.remove(claim_uid)
 
     def deallocate(self, crd: nascrd.NodeAllocationState, claim: ResourceClaim) -> None:

@@ -69,9 +69,39 @@ class TpuDriver:
                 f"no allocations generated for claim '{claim_uid}' "
                 f"on node '{selected_node}' yet"
             )
-        crd.spec.allocated_claims[claim_uid] = self.pending_allocated_claims.get(
-            claim_uid, selected_node
+        pending = self.pending_allocated_claims.get(claim_uid, selected_node)
+        # Promote-time overlap guard (the reference promotes blindly,
+        # gpu.go:48-61): the disjointness of pending picks rests on every
+        # UnsuitableNodes pass having seen fresh committed state; this
+        # re-checks that invariant against the NAS read under the node lock
+        # so no staleness bug can ever commit the same chip twice.  On
+        # conflict the pending entry is dropped — the scheduling retry then
+        # re-places against current truth instead of re-promoting the same
+        # stale pick forever.
+        # Only same-kind uuids conflict: a whole chip held by a parent
+        # claim legitimately hosts subslices carved via tpu_claim_name
+        # affinity (the MIG model, demo tpu-test4), so subslice parents are
+        # NOT counted against a whole-chip pick here.
+        taken = {
+            d.uuid
+            for uid, alloc in crd.spec.allocated_claims.items()
+            if uid != claim_uid and alloc.tpu is not None
+            for d in alloc.tpu.devices
+        }
+        overlap = (
+            {d.uuid for d in pending.tpu.devices} & taken
+            if pending.tpu is not None
+            else set()
         )
+        if overlap:
+            # Only this node's pick is invalid; other nodes' picks stand.
+            self.pending_allocated_claims.remove_node(claim_uid, selected_node)
+            raise RuntimeError(
+                f"pending allocation for claim '{claim_uid}' overlaps "
+                f"committed device(s) {sorted(overlap)} on node "
+                f"'{selected_node}'; dropped for re-placement"
+            )
+        crd.spec.allocated_claims[claim_uid] = pending
         return lambda: self.pending_allocated_claims.remove(claim_uid)
 
     def deallocate(self, crd: nascrd.NodeAllocationState, claim: ResourceClaim) -> None:

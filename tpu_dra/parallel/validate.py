@@ -49,6 +49,9 @@ class SliceReport:
     # Long-context configuration (ring attention over the model axis) —
     # run when the claimed mesh has one; None when it doesn't.
     train_ring: "dict | None" = None
+    # Expert-parallel configuration (MoE a2a over the model axis) — run
+    # when the claimed mesh has one; None when it doesn't.
+    train_moe: "dict | None" = None
     errors: "list[str]" = field(default_factory=list)
 
     def to_json(self) -> str:
@@ -209,6 +212,19 @@ def validate_slice(
                 report.errors.append(
                     f"burnin train[ring]: "
                     f"{ring_tr.error or 'loss did not decrease'}"
+                )
+            # Expert-parallel acceptance: the switch-routed MoE step puts
+            # the dispatch/return all-to-all pair on the same ICI links
+            # (tpu_dra/parallel/moe.py) — the collective pattern MoE jobs
+            # will actually run, which psum/all_gather checks don't cover.
+            moe_tr = burnin_train(
+                BurninConfig(moe_experts=4), mesh=bmesh, steps=train_steps
+            )
+            report.train_moe = asdict(moe_tr)
+            if not moe_tr.ok:
+                report.errors.append(
+                    f"burnin train[moe]: "
+                    f"{moe_tr.error or 'loss did not decrease'}"
                 )
 
     report.ok = not report.errors
