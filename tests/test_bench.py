@@ -30,3 +30,12 @@ def test_bench_emits_one_json_line(monkeypatch):
     assert len(lines) == 1
     parsed = json.loads(lines[0])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(parsed)
+
+
+def test_bench_wire_small():
+    import bench
+
+    out = bench.bench_wire(samples=2)
+    assert out["samples"] == 2
+    assert 0 < out["p50_s"] < 30
+    assert out["target_met"]

@@ -312,6 +312,45 @@ class TestPromoteGuard:
         with pytest.raises(RuntimeError, match="whole-chip"):
             driver.allocate(fresh, ca.claim, ca.claim_parameters, None, NODE)
 
+    def test_affinity_parent_gone_at_promote_conflicts(self):
+        # The pick resolved to a whole-chip parent claim; if that claim no
+        # longer holds the chip at promote time (deallocated, or a stranger
+        # took it), the pick is stale and must be rejected.
+        driver = SubsliceDriver()
+        nas = make_nas(partitionable=True)
+        parent_ca = make_ca(TpuClaimParametersSpec(count=1), name="parent")
+        nas.spec.allocated_claims[parent_ca.claim.metadata.uid] = AllocatedDevices(
+            claim_info=ClaimInfo(
+                namespace="default",
+                name="parent",
+                uid=parent_ca.claim.metadata.uid,
+            ),
+            tpu=AllocatedTpus(devices=[AllocatedTpu(uuid="tpu-0")]),
+        )
+        ca = make_ca(
+            SubsliceClaimParametersSpec(
+                profile="1c.4gb", tpu_claim_name="parent"
+            ),
+            name="claim-b",
+        )
+        run_unsuitable(driver, nas, [ca])
+        pending = driver.pending_allocated_claims.get(ca.claim.metadata.uid, NODE)
+        assert pending.subslice.parent_claim_uid == parent_ca.claim.metadata.uid
+
+        # Fresh state: the parent claim is gone.
+        fresh = make_nas(partitionable=True)
+        with pytest.raises(RuntimeError, match="no longer holds"):
+            driver.allocate(fresh, ca.claim, ca.claim_parameters, None, NODE)
+
+        # And: a different claim holding the chip is equally a conflict.
+        run_unsuitable(driver, nas, [ca])
+        fresh2 = make_nas(partitionable=True)
+        fresh2.spec.allocated_claims["stranger-uid"] = AllocatedDevices(
+            tpu=AllocatedTpus(devices=[AllocatedTpu(uuid="tpu-0")])
+        )
+        with pytest.raises(RuntimeError, match="no longer holds"):
+            driver.allocate(fresh2, ca.claim, ca.claim_parameters, None, NODE)
+
     def test_committed_core_interval_conflicts(self):
         # Defense-in-depth vs dangling cores: a committed core interval on
         # the same chip blocks an overlapping subslice promote.

@@ -311,10 +311,36 @@ class TestPromoteGuard:
             ca.claim.metadata.uid, NODE
         ), "stale pending pick must be dropped so the retry re-places"
 
-    def test_subslice_on_parent_is_not_a_conflict(self):
-        # The MIG-model shape (tpu-test4): a whole-chip parent claim whose
-        # chip hosts affinity subslices is legitimate — the guard must only
-        # reject same-kind double-booking.
+    def test_own_affinity_subslice_on_parent_is_not_a_conflict(self):
+        # The MIG-model shape (tpu-test4): subslices recording THIS claim
+        # as their affinity parent are legitimate on its chips.
+        driver = TpuDriver()
+        nas = make_nas()
+        ca = make_ca(TpuClaimParametersSpec(count=4), name="claim-b")
+        run_unsuitable(driver, nas, [ca])
+        picked = driver.pending_allocated_claims.get(
+            ca.claim.metadata.uid, NODE
+        ).tpu.devices[0].uuid
+
+        fresh = make_nas()
+        fresh.spec.allocated_claims["carve-uid"] = AllocatedDevices(
+            subslice=AllocatedSubslices(
+                devices=[
+                    AllocatedSubslice(
+                        profile="2c.8gb",
+                        parent_uuid=picked,
+                        placement=Placement(0, 2),
+                    )
+                ],
+                parent_claim_uid=ca.claim.metadata.uid,
+            )
+        )
+        driver.allocate(fresh, ca.claim, ca.claim_parameters, None, NODE)
+        assert ca.claim.metadata.uid in fresh.spec.allocated_claims
+
+    def test_stranger_subslice_on_picked_chip_conflicts(self):
+        # A standalone (or other-parent) subslice committed on the picked
+        # chip after the probe means the pick is stale: reject it.
         driver = TpuDriver()
         nas = make_nas()
         ca = make_ca(TpuClaimParametersSpec(count=4), name="claim-b")
@@ -335,8 +361,8 @@ class TestPromoteGuard:
                 ]
             )
         )
-        driver.allocate(fresh, ca.claim, ca.claim_parameters, None, NODE)
-        assert ca.claim.metadata.uid in fresh.spec.allocated_claims
+        with pytest.raises(RuntimeError, match="overlaps committed"):
+            driver.allocate(fresh, ca.claim, ca.claim_parameters, None, NODE)
 
     def test_clean_promote_still_succeeds(self):
         driver = TpuDriver()

@@ -138,6 +138,12 @@ class AllocatedTpus:
 class AllocatedSubslices:
     devices: list[AllocatedSubslice] = field(default_factory=list)
     sharing: SubsliceSharing | None = None
+    # With tpu_claim_name affinity: the uid of the whole-chip claim whose
+    # chips these subslices carve (empty for standalone subslices on
+    # unheld chips).  Lets the promote-time overlap guards distinguish the
+    # legitimate whole-parent+carve shape (MIG model, tpu-test4) from a
+    # stale pick double-booking a stranger's chip.
+    parent_claim_uid: str = ""
 
 
 @dataclass

@@ -82,20 +82,17 @@ class SubsliceDriver:
         # Promote-time overlap guard (see tpu_allocator.allocate): re-check
         # the pending placements against the fresh NAS under the node lock.
         # Conflicts: any committed subslice or core claim overlapping the
-        # same interval on the same chip; and — only when this claim has no
-        # tpu_claim_name affinity — a whole-chip claim holding the parent
-        # (with affinity, whole-parent + carved subslices is the intended
-        # shape: MIG model, demo tpu-test4).
-        whole = (
-            set()
-            if claim_params.tpu_claim_name
-            else {
-                d.uuid
-                for uid, alloc in crd.spec.allocated_claims.items()
-                if uid != claim_uid and alloc.tpu is not None
-                for d in alloc.tpu.devices
-            }
-        )
+        # same interval on the same chip; a whole-chip claim holding the
+        # parent — unless it is exactly the claim this pick's affinity
+        # resolved to (pending.subslice.parent_claim_uid: the intended
+        # whole-parent + carve shape, MIG model / demo tpu-test4); and an
+        # affinity pick whose recorded parent no longer holds the chip.
+        whole_by_chip = {
+            d.uuid: uid
+            for uid, alloc in crd.spec.allocated_claims.items()
+            if uid != claim_uid and alloc.tpu is not None
+            for d in alloc.tpu.devices
+        }
         committed = [
             d
             for uid, alloc in crd.spec.allocated_claims.items()
@@ -108,9 +105,19 @@ class SubsliceDriver:
             if uid != claim_uid and alloc.core is not None
             for d in alloc.core.devices
         ]
+        pend_parent = pending.subslice.parent_claim_uid if pending.subslice else ""
         conflicts = []
         for dev in pending.subslice.devices if pending.subslice else []:
-            if dev.parent_uuid in whole:
+            holder_uid = whole_by_chip.get(dev.parent_uuid)
+            if pend_parent:
+                if holder_uid != pend_parent:
+                    # Parent deallocated, or a stranger took the chip.
+                    conflicts.append(
+                        f"{dev.parent_uuid} (affinity parent "
+                        f"'{pend_parent}' no longer holds it; holder="
+                        f"{holder_uid or 'none'})"
+                    )
+            elif holder_uid is not None:
                 conflicts.append(f"{dev.parent_uuid} (whole-chip claim)")
             for other in committed:
                 if (
@@ -166,10 +173,12 @@ class SubsliceDriver:
                 other.unsuitable_nodes.append(potential_node)
             return
 
+        parent_info = self._parent_claim_info(crd)
         for ca in subcas:
             claim_uid = ca.claim.metadata.uid
             params: tpucrd.SubsliceClaimParametersSpec = ca.claim_parameters
             chosen = placements[claim_uid]
+            holder = parent_info.get(chosen.parent_uuid)
             result = nascrd.AllocatedDevices(
                 claim_info=nascrd.ClaimInfo(
                     namespace=ca.claim.metadata.namespace,
@@ -185,6 +194,11 @@ class SubsliceDriver:
                         )
                     ],
                     sharing=serde.deepcopy(params.sharing),
+                    # Affinity picks land on a held chip: record whose, so
+                    # the promote guard can verify that exact claim still
+                    # holds it.  Standalone picks are only made on unheld
+                    # chips (empty).
+                    parent_claim_uid=holder.uid if holder is not None else "",
                 ),
             )
             self.pending_allocated_claims.set(claim_uid, potential_node, result)

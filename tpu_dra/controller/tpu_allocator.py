@@ -78,16 +78,25 @@ class TpuDriver:
         # conflict the pending entry is dropped — the scheduling retry then
         # re-places against current truth instead of re-promoting the same
         # stale pick forever.
-        # Only same-kind uuids conflict: a whole chip held by a parent
-        # claim legitimately hosts subslices carved via tpu_claim_name
-        # affinity (the MIG model, demo tpu-test4), so subslice parents are
-        # NOT counted against a whole-chip pick here.
+        # Conflicts: chips held by other whole-chip claims, and chips
+        # hosting committed subslices — except subslices that carve THIS
+        # claim's chips (parent_claim_uid affinity: the MIG-model
+        # whole-parent + carve shape, demo tpu-test4).  The probe never
+        # picks either kind, so a hit here is a staleness artifact.
         taken = {
             d.uuid
             for uid, alloc in crd.spec.allocated_claims.items()
             if uid != claim_uid and alloc.tpu is not None
             for d in alloc.tpu.devices
         }
+        taken.update(
+            d.parent_uuid
+            for uid, alloc in crd.spec.allocated_claims.items()
+            if uid != claim_uid
+            and alloc.subslice is not None
+            and alloc.subslice.parent_claim_uid != claim_uid
+            for d in alloc.subslice.devices
+        )
         overlap = (
             {d.uuid for d in pending.tpu.devices} & taken
             if pending.tpu is not None
