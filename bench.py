@@ -514,6 +514,26 @@ out = {
 }
 if mfu.error:
     out["error"] = mfu.error
+
+# Opportunistic second measurement with the pallas flash-attention kernel,
+# on the SAME config the dense run actually measured (post shrink-ladder):
+# report it alongside when it works (never replaces the dense number on
+# failure — the kernel path is newer than the XLA one).
+if mfu.ok and mfu.platform == "tpu" and mfu.config is not None:
+    import dataclasses
+
+    flash = measure_mfu(
+        dataclasses.replace(mfu.config, flash_attention=True)
+    )
+    if flash.ok:
+        out["flash"] = {
+            "mfu": round(flash.mfu, 4),
+            "achieved_tflops": round(flash.achieved_tflops, 2),
+            "step_seconds": round(flash.step_seconds, 4),
+        }
+        out["mfu_best"] = round(max(mfu.mfu, flash.mfu), 4)
+    elif flash.error:
+        out["flash"] = {"ok": False, "error": flash.error[:200]}
 hbm = measure_hbm_bandwidth()
 out["hbm"] = {
     "gbps": round(hbm.gbps, 1),

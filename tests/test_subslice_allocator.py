@@ -312,6 +312,47 @@ class TestPromoteGuard:
         with pytest.raises(RuntimeError, match="whole-chip"):
             driver.allocate(fresh, ca.claim, ca.claim_parameters, None, NODE)
 
+    def test_affinity_parent_still_pending_promotes_first(self):
+        """Claims of one pod promote in pod-spec order: a subslice listed
+        BEFORE its whole-chip parent must promote while the parent is still
+        only in the tpu driver's pending cache (regression: the guard used
+        to read that as 'parent gone' and wedge the pod forever)."""
+        tpu_driver = TpuDriver()
+        driver = SubsliceDriver(
+            parent_pending=tpu_driver.pending_allocated_claims
+        )
+        nas = make_nas(partitionable=True)
+        pod = make_pod()
+        from tpu_dra.api.tpu_v1alpha1 import make_property_selector
+
+        parent_ca = make_ca(
+            TpuClaimParametersSpec(
+                count=1, selector=make_property_selector(partitionable=True)
+            ),
+            name="parent",
+        )
+        sub_ca = make_ca(
+            SubsliceClaimParametersSpec(
+                profile="1c.4gb", tpu_claim_name="parent"
+            ),
+            name="claim-b",
+        )
+        # One fan-out pass, parent-first like ControllerDriver does:
+        tpu_driver.unsuitable_node(nas, pod, [parent_ca], [parent_ca, sub_ca], NODE)
+        driver.unsuitable_node(nas, pod, [sub_ca], [parent_ca, sub_ca], NODE)
+        assert sub_ca.unsuitable_nodes == []
+
+        # Promote the SUBSLICE first against fresh state (parent not yet
+        # committed — it is still pending).
+        fresh = make_nas(partitionable=True)
+        driver.allocate(fresh, sub_ca.claim, sub_ca.claim_parameters, None, NODE)
+        assert sub_ca.claim.metadata.uid in fresh.spec.allocated_claims
+        # The parent promotes after, unaffected.
+        tpu_driver.allocate(
+            fresh, parent_ca.claim, parent_ca.claim_parameters, None, NODE
+        )
+        assert parent_ca.claim.metadata.uid in fresh.spec.allocated_claims
+
     def test_affinity_parent_gone_at_promote_conflicts(self):
         # The pick resolved to a whole-chip parent claim; if that claim no
         # longer holds the chip at promote time (deallocated, or a stranger

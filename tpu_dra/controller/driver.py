@@ -46,7 +46,9 @@ class ControllerDriver:
         self.namespace = namespace
         self.clientset = clientset
         self.tpu = TpuDriver()
-        self.subslice = SubsliceDriver()
+        self.subslice = SubsliceDriver(
+            parent_pending=self.tpu.pending_allocated_claims
+        )
         self.core = CoreDriver()
         self._fanout_pool = None
         self._fanout_pool_lock = threading.Lock()

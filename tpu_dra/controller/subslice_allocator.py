@@ -52,8 +52,14 @@ class SubslicePlacement:
 
 
 class SubsliceDriver:
-    def __init__(self):
+    def __init__(self, parent_pending: "PerNodeAllocatedClaims | None" = None):
         self.pending_allocated_claims = PerNodeAllocatedClaims()
+        # The whole-chip driver's pending cache: the promote guard consults
+        # it to tell "affinity parent not committed YET" (claims of one pod
+        # promote sequentially in pod-spec order, so a subslice listed
+        # before its parent legitimately promotes first) from "parent
+        # deallocated / chip stolen" (stale pick — reject).
+        self._parent_pending = parent_pending
 
     def validate_claim_parameters(
         self, params: tpucrd.SubsliceClaimParametersSpec
@@ -110,8 +116,16 @@ class SubsliceDriver:
         for dev in pending.subslice.devices if pending.subslice else []:
             holder_uid = whole_by_chip.get(dev.parent_uuid)
             if pend_parent:
-                if holder_uid != pend_parent:
-                    # Parent deallocated, or a stranger took the chip.
+                parent_still_pending = (
+                    holder_uid is None
+                    and self._parent_pending is not None
+                    and self._parent_pending.exists(pend_parent, selected_node)
+                )
+                if holder_uid != pend_parent and not parent_still_pending:
+                    # Parent deallocated, or a stranger took the chip.  (A
+                    # parent that simply hasn't promoted yet — later in the
+                    # pod's claim list — is still in the whole-chip pending
+                    # cache and is fine.)
                     conflicts.append(
                         f"{dev.parent_uuid} (affinity parent "
                         f"'{pend_parent}' no longer holds it; holder="

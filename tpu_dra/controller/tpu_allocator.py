@@ -97,6 +97,14 @@ class TpuDriver:
             and alloc.subslice.parent_claim_uid != claim_uid
             for d in alloc.subslice.devices
         )
+        # Defense-in-depth vs dangling core claims (parent subslice gone):
+        # their chips still hold live cores.
+        taken.update(
+            d.parent_uuid
+            for uid, alloc in crd.spec.allocated_claims.items()
+            if uid != claim_uid and alloc.core is not None
+            for d in alloc.core.devices
+        )
         overlap = (
             {d.uuid for d in pending.tpu.devices} & taken
             if pending.tpu is not None
@@ -183,6 +191,11 @@ class TpuDriver:
                     available.pop(dev.uuid, None)
             elif allocation.type() == nascrd.SUBSLICE_DEVICE_TYPE:
                 for dev in allocation.subslice.devices:
+                    available.pop(dev.parent_uuid, None)
+            elif allocation.type() == nascrd.CORE_DEVICE_TYPE:
+                # Defense-in-depth: a dangling core claim (parent subslice
+                # deallocated out from under it) still pins its chip.
+                for dev in allocation.core.devices:
                     available.pop(dev.parent_uuid, None)
 
         allocated: dict[str, tuple[list[nascrd.AllocatedTpu], Topology | None]] = {}

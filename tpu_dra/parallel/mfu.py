@@ -158,6 +158,10 @@ class MfuReport:
     loss_first: float = 0.0
     loss_last: float = 0.0
     error: str = ""
+    # The config actually measured (after any fallback-ladder shrinking) —
+    # callers re-measuring variants (e.g. flash attention) must start from
+    # this, not from chip_sized_config, or they compare different models.
+    config: "BurninConfig | None" = None
 
 
 def _shrink(c: BurninConfig) -> "BurninConfig | None":
@@ -264,9 +268,10 @@ def measure_mfu(
             tokens_per_second=c.batch * c.seq / step_s,
             loss_first=loss_first,
             loss_last=loss_last,
+            config=c,
         )
     except Exception as e:  # bench must emit its line without a chip
-        return MfuReport(ok=False, error=f"{type(e).__name__}: {e}")
+        return MfuReport(ok=False, error=f"{type(e).__name__}: {e}", config=config)
 
 
 @dataclass
