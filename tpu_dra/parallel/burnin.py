@@ -128,7 +128,7 @@ class BurninConfig:
         shape = dict(mesh.shape)
         if self.pipeline_stages > 0 and "pipe" not in shape:
             raise ValueError(
-                "pipeline_stages requires a (data, pipe) mesh "
+                "pipeline_stages requires a (data, pipe, model) mesh "
                 "(tpu_dra.parallel.pipeline.pipeline_mesh), got axes "
                 f"{tuple(shape)}"
             )
@@ -217,15 +217,28 @@ def param_specs(config: BurninConfig):
     from jax.sharding import PartitionSpec as P
 
     if config.pipeline_stages > 0:
-        layer_keys = (
-            ("wqkv", "wo", "router", "w1e", "w2e", "ln1", "ln2")
-            if config.moe_experts > 0
-            else ("wqkv", "wo", "w1", "w2", "ln1", "ln2")
-        )
+        # Stacked layer dim over pipe (each stage holds its own layers);
+        # within a stage the tp dims shard over model exactly as in the
+        # unpipelined Megatron layout (experts over model in MoE mode).
+        if config.moe_experts > 0:
+            mats = {
+                "wqkv": P("pipe", None, None, "model", None),
+                "wo": P("pipe", "model", None, None),
+                "router": P("pipe", None, None),
+                "w1e": P("pipe", "model", None, None),
+                "w2e": P("pipe", "model", None, None),
+            }
+        else:
+            mats = {
+                "wqkv": P("pipe", None, None, "model", None),
+                "wo": P("pipe", "model", None, None),
+                "w1": P("pipe", None, "model"),
+                "w2": P("pipe", "model", None),
+            }
         return {
             "embed": P(None, None),
             "pos": P(None, None),
-            "layers": {k: P("pipe") for k in layer_keys},
+            "layers": {**mats, "ln1": P("pipe"), "ln2": P("pipe")},
             "ln_f": P(None),
         }
     if config.ring_attention:
@@ -397,12 +410,15 @@ def forward(params, tokens, config: BurninConfig, mesh=None, *, return_aux=False
     if c.pipeline_stages > 0:
         if c.ring_attention or c.flash_attention:
             raise ValueError(
-                "pipeline_stages is not combined with ring/flash attention "
-                "(the pipeline mesh has no model axis for them to use)"
+                "pipeline_stages is not combined with ring/flash attention: "
+                "the ring rotates K/V over the model axis, which inside the "
+                "pipeline's partial-manual shard_map is an auto axis (no "
+                "ppermute), and the pallas flash kernel is not validated "
+                "under a shard_map body with auto axes"
             )
         if mesh is None or "pipe" not in mesh.shape:
             raise ValueError(
-                "pipeline_stages requires a (data, pipe) mesh "
+                "pipeline_stages requires a (data, pipe, model) mesh "
                 "(tpu_dra.parallel.pipeline.pipeline_mesh)"
             )
         from tpu_dra.parallel.pipeline import forward_pipelined
@@ -417,22 +433,7 @@ def forward(params, tokens, config: BurninConfig, mesh=None, *, return_aux=False
             raise ValueError("ring_attention requires a device mesh")
         constrain = lambda kind, arr: arr  # noqa: E731
     else:
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
-
-        specs = {
-            # sp region: residual stream sequence-sharded over the tp axis
-            "seq": P(("data", "fsdp"), "model", None),
-            # tp region: full sequence, hidden ops sharded over heads/ffn
-            "hidden": P(("data", "fsdp"), None, None),
-            # ep region: (E, B, C, D) expert tensors — experts over model;
-            # the boundary with the batch-sharded "hidden" layout is where
-            # XLA inserts the dispatch/return all-to-all pair.
-            "expert": P("model", ("data", "fsdp"), None, None),
-        }
-
-        def constrain(kind, arr):
-            return jax.lax.with_sharding_constraint(arr, NamedSharding(mesh, specs[kind]))
+        constrain = make_constrain(mesh, ("data", "fsdp"))
 
     x = params["embed"][tokens] + params["pos"][None, :, :]
 
@@ -514,6 +515,37 @@ def make_train_step(config: BurninConfig, mesh=None):
     )
     state = jax.device_put(_init_state(c), state_sh)
     return jitted, state
+
+
+def make_constrain(mesh, batch_axes):
+    """The sp/tp/ep sharding contract as a ``constrain(kind, arr)`` closure.
+
+    ``batch_axes``: the mesh axes carrying the batch — ``("data", "fsdp")``
+    on the training mesh, ``"data"`` inside the pipeline's shard_map body
+    (where fsdp doesn't exist and pipe is manual).  One definition so the
+    pipelined and unpipelined paths cannot diverge.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    specs = {
+        # sp region: residual stream sequence-sharded over the tp axis
+        "seq": P(batch_axes, "model", None),
+        # tp region: full sequence, hidden ops sharded over heads/ffn
+        "hidden": P(batch_axes, None, None),
+        # ep region: (E, B, C, D) expert tensors — experts over model; the
+        # boundary with the batch-sharded "hidden" layout is where XLA
+        # inserts the dispatch/return all-to-all pair.
+        "expert": P("model", batch_axes, None, None),
+    }
+
+    def constrain(kind, arr):
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, specs[kind])
+        )
+
+    return constrain
 
 
 def token_spec(config: BurninConfig):

@@ -8,12 +8,19 @@ over ICI ``ppermute``.
 
 TPU-first shape of the implementation:
 
-- **SPMD, not MPMD.** One program runs on every chip (``shard_map`` over the
-  whole mesh); a stage's identity is ``lax.axis_index("pipe")``.  XLA sees a
-  single static program — no per-stage executables, no host-side scheduler,
-  unlike the reference ecosystem's NCCL send/recv pipelines.
-- **The schedule is a ``lax.scan``** over M + P - 1 ticks (the GPipe
-  steady-state plus fill/drain bubble).  Each tick: stage 0 ingests the next
+- **SPMD, not MPMD.** One program runs on every chip; a stage's identity is
+  ``lax.axis_index("pipe")``.  XLA sees a single static program — no
+  per-stage executables, no host-side scheduler, unlike the reference
+  ecosystem's NCCL send/recv pipelines.
+- **Partial-manual ``shard_map``**: only the ``pipe`` axis is manual
+  (``axis_names={"pipe"}``); ``data`` and ``model`` stay *auto*, so inside a
+  stage the usual sharding constraints drive XLA's propagation — dp batch
+  sharding, Megatron tp/sp, and the MoE expert all-to-all all compose WITH
+  the pipeline in one jit.  This is the modern jax composition (0.8+); the
+  hand-scheduled part is exactly the part XLA cannot infer (the microbatch
+  schedule), nothing more.
+- **The schedule is a ``lax.scan``** over M + P - 1 ticks (GPipe steady
+  state plus fill/drain bubble).  Each tick: stage 0 ingests the next
   microbatch, every stage applies its layer block, activations ``ppermute``
   one hop down the ring.  Static trip count, static shapes — the whole
   pipeline is one fused XLA while loop.
@@ -37,29 +44,35 @@ import functools
 __all__ = ["pipeline_mesh", "forward_pipelined"]
 
 
-def pipeline_mesh(devices, *, stages: int, data: int = -1):
-    """A (data, pipe) logical mesh: ``pipe`` innermost so the every-tick
-    activation hop rides nearest-neighbor ICI links."""
+def pipeline_mesh(devices, *, stages: int, data: int = -1, model: int = 1):
+    """A (data, pipe, model) logical mesh.  ``model`` is the tp/sp/ep axis
+    inside each stage (innermost: per-layer collectives ride nearest ICI
+    neighbors); ``pipe`` next (one activation hop per tick); ``data``
+    outermost."""
     import numpy as np
     from jax.sharding import Mesh
 
     n = len(devices)
-    if n % stages:
-        raise ValueError(f"{n} devices not divisible into {stages} stages")
+    if n % (stages * model):
+        raise ValueError(
+            f"{n} devices not divisible into {stages} stages x {model} model"
+        )
     if data == -1:
-        data = n // stages
-    if data * stages != n:
-        raise ValueError(f"mesh data={data} x pipe={stages} != {n} devices")
-    arr = np.array(devices, dtype=object).reshape(data, stages)
-    return Mesh(arr, ("data", "pipe"))
+        data = n // (stages * model)
+    if data * stages * model != n:
+        raise ValueError(
+            f"mesh data={data} x pipe={stages} x model={model} != {n} devices"
+        )
+    arr = np.array(devices, dtype=object).reshape(data, stages, model)
+    return Mesh(arr, ("data", "pipe", "model"))
 
 
 def forward_pipelined(params, tokens, config, mesh):
     """Pipelined logits: embedding and the logits projection are computed
     replicated over ``pipe`` (tiny next to the blocks), the block stack runs
-    the GPipe schedule.  Returns ``(logits, aux)`` — aux is the MoE
-    load-balance loss averaged over microbatches (0.0 for dense MLPs), so
-    ep composes with pp."""
+    the GPipe schedule with tp/sp/ep constraints live inside each stage.
+    Returns ``(logits, aux)`` — aux is the MoE load-balance loss averaged
+    over microbatches (0.0 for dense MLPs), so ep composes with pp."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding
@@ -104,31 +117,20 @@ def _pipelined_blocks(layers, x, *, config, mesh):
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is None:  # pre-0.8 jax
-        from jax.experimental.shard_map import shard_map
-    # Replication checking must be off (per-stage state diverges until the
-    # final psum); the flag was renamed check_rep -> check_vma in jax 0.8.
-    import inspect
-
-    _params = inspect.signature(shard_map).parameters
-    _nocheck = (
-        {"check_vma": False} if "check_vma" in _params else {"check_rep": False}
-    )
-
-    from tpu_dra.parallel.burnin import _block
+    from tpu_dra.parallel.burnin import _block, make_constrain
 
     c = config
     stages = int(mesh.shape["pipe"])
     M = c.pipeline_microbatches
 
-    # Stage compute: this rank's n_layers/P blocks, scanned (identical math
-    # to burnin.forward's scan; tp/sp constraints are identity inside a
-    # stage — the pipe axis carries layers, not tensor dims).
+    # Inside the shard_map body, data and model are AUTO axes: the shared
+    # sp/tp/ep constraint contract keeps driving XLA exactly as in the
+    # unpipelined step (batch axis is plain "data" here — no fsdp on the
+    # pipeline mesh, and pipe is the manual axis).
+    constrain = make_constrain(mesh, "data")
+
     block = jax.checkpoint(
-        functools.partial(
-            _block, config=c, constrain=lambda kind, a: a, ring_mesh=None
-        )
+        functools.partial(_block, config=c, constrain=constrain, ring_mesh=None)
     )
 
     def apply_stage(stage_layers, h):
@@ -143,19 +145,22 @@ def _pipelined_blocks(layers, x, *, config, mesh):
         return h, aux
 
     @functools.partial(
-        shard_map,
+        jax.shard_map,
         mesh=mesh,
-        in_specs=(P("pipe"), P("data", None, None)),
-        out_specs=(P("data", None, None), P()),
-        **_nocheck,
+        # Only the layer stack is pipe-mapped; activations are replicated
+        # over pipe and stay GLOBAL over the auto axes (data/model).
+        in_specs=(P("pipe"), P()),
+        out_specs=(P(), P()),
+        axis_names={"pipe"},
+        check_vma=False,  # per-stage state diverges until the final psum
     )
     def run(stage_layers, xb):
         # stage_layers: this rank's (L/P, ...) slice of every layer leaf.
-        # xb: this data-shard's (b_local, S, D) activations (replicated
-        # over pipe — every stage holds them; only stage 0 feeds them in).
+        # xb: the (global-batch, S, D) activations — every stage holds
+        # them; only stage 0 feeds them in.
         rank = lax.axis_index("pipe")
-        b_local = xb.shape[0]
-        mb = xb.reshape(M, b_local // M, *xb.shape[1:])
+        b = xb.shape[0]
+        mb = xb.reshape(M, b // M, *xb.shape[1:])
         state = jnp.zeros_like(mb[0])
         outs = jnp.zeros_like(mb)
         aux0 = jnp.zeros((), jnp.float32)
@@ -193,9 +198,9 @@ def _pipelined_blocks(layers, x, *, config, mesh):
             jnp.where(rank == stages - 1, outs, jnp.zeros_like(outs)), "pipe"
         )
         # Per-stage aux sums cover disjoint layer ranges; the psum totals
-        # them, /M converts sum-over-microbatches to the microbatch mean,
-        # and the data-axis pmean makes the scalar truly replicated.
-        aux = lax.pmean(lax.psum(aux, "pipe") / M, "data")
+        # them and /M converts sum-over-microbatches to the microbatch
+        # mean.  (data/model are auto axes: aux is already global there.)
+        aux = lax.psum(aux, "pipe") / M
         return outs.reshape(xb.shape), aux
 
     return run(layers, x)
