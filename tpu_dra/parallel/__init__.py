@@ -29,7 +29,9 @@ slice:
 - ``tpu_dra.parallel.moe``         — expert parallelism: switch-routed MoE
   MLP, experts sharded over ``model`` with XLA-inserted all-to-all.
 - ``tpu_dra.parallel.pipeline``    — pipeline parallelism: GPipe schedule
-  over a ``pipe`` mesh axis (shard_map + scan + ppermute hops).
+  over a ``pipe`` mesh axis (partial-manual shard_map + scan + ppermute
+  hops); composes with tp/sp/ep inside each stage — one jitted step runs
+  dp x pp x tp x ep on a (data, pipe, model) mesh.
 - ``tpu_dra.parallel.mfu``         — chip-sized MFU + HBM-bandwidth
   measurement with analytic FLOPs accounting vs published bf16 peaks.
 """
