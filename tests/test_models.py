@@ -1,0 +1,40 @@
+"""Model-family facade (tpu_dra/models): every named family trains on the
+virtual 8-device mesh."""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_dra.models import FAMILIES, family_config, train_family
+
+
+@pytest.mark.parametrize("name", sorted(FAMILIES))
+def test_family_trains(name):
+    # flash runs in pallas interpret mode off-TPU: keep its step count low.
+    steps = 2 if name == "flash" else 4
+    r = train_family(name, steps=steps, n_layers=2)
+    assert r.ok, (name, r)
+    assert r.loss_last < r.loss_first
+
+
+def test_unknown_family_rejected():
+    with pytest.raises(ValueError, match="unknown model family"):
+        family_config("bogus")
+
+
+def test_overrides_apply():
+    c = family_config("moe", seq=64)
+    assert c.moe_experts == 4 and c.seq == 64
+
+
+def test_pipelined_stage_override_honored():
+    r = train_family("pipelined", steps=2, n_layers=4, pipeline_stages=4)
+    assert r.ok, r
+
+
+def test_pipelined_on_one_chip_reports_not_raises():
+    import jax
+
+    r = train_family("pipelined", devices=jax.devices()[:1], steps=2)
+    assert not r.ok
+    assert r.error

@@ -163,3 +163,56 @@ class TestWireChaos:
 
         flaky.resume()
         wait_unprepared(clients, "uid-410")
+
+
+class TestInformerOverWire:
+    """The controller's NAS informer (controller/nasinformer.py) against
+    the real wire: its cache must track writes through the restserver
+    watch, and survive a torn stream + log compaction (410 -> relist)."""
+
+    def test_informer_tracks_and_relists_over_wire(self, rig):
+        from tpu_dra.controller.nasinformer import NasInformer
+
+        inner, flaky, clients, app, tmp_path = rig
+        informer = NasInformer(clients, NS)
+        informer.start()
+        try:
+            assert informer.wait_synced(10.0)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if informer.get(NODE) is not None:
+                    break
+                time.sleep(0.05)
+            assert informer.get(NODE) is not None
+
+            # A write flows through the wire watch into the cache.
+            allocate_chip(clients, "uid-inf")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                nas = informer.get(NODE)
+                if nas is not None and "uid-inf" in nas.spec.allocated_claims:
+                    break
+                time.sleep(0.05)
+            assert "uid-inf" in informer.get(NODE).spec.allocated_claims
+
+            # Torn stream + outage + gap write + compaction: on resume the
+            # wire client's 410 path relists, and the informer converges on
+            # the gap state it never saw as an event.
+            flaky.break_watches()
+            flaky.pause()
+            time.sleep(1.0)
+            raw = inner.get("NodeAllocationState", NS, NODE)
+            raw["spec"]["allocatedClaims"].pop("uid-inf")
+            inner.update(raw)
+            inner.trim_event_log()
+            flaky.resume()
+
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                nas = informer.get(NODE)
+                if nas is not None and "uid-inf" not in nas.spec.allocated_claims:
+                    break
+                time.sleep(0.05)
+            assert "uid-inf" not in informer.get(NODE).spec.allocated_claims
+        finally:
+            informer.stop()

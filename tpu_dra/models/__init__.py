@@ -1,0 +1,110 @@
+"""Canonical workload families the driver validates slices against.
+
+The reference's acceptance workload is ``nvidia-smi -L`` (README.md:75-117)
+— device visibility only.  This driver's acceptance runs real training
+steps (tpu_dra/parallel/burnin.py), and this package names the canonical
+configurations — the "model families" a claimed slice must sustain — so
+operators and tests speak in families, not raw config fields:
+
+- ``dense``        — the baseline transformer LM: dp/fsdp batch+param
+  sharding, Megatron tp/sp inside blocks.
+- ``long_context`` — the same LM with ring attention (cp): the sequence
+  stays sharded through attention, K/V blocks rotate over the ICI ring.
+- ``moe``          — switch-routed mixture-of-experts MLPs (ep): experts
+  sharded over the model axis, XLA-inserted all-to-all dispatch.
+- ``flash``        — the pallas flash-attention kernel on the hot path
+  (single chip or tp-sharded heads).
+- ``pipelined``    — GPipe pipeline over a (data, pipe, model) mesh,
+  composing pp with tp/sp/ep inside each stage.
+
+Each family is a ``BurninConfig`` preset plus the mesh builder that suits
+it; ``train_family`` runs the family's training step on a claimed slice and
+returns the burn-in report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from tpu_dra.parallel.burnin import BurninConfig, TrainReport, burnin_mesh, train
+
+__all__ = ["FAMILIES", "family_config", "family_mesh", "train_family"]
+
+
+def _dense(**overrides) -> BurninConfig:
+    return dataclasses.replace(BurninConfig(), **overrides)
+
+
+def _preset(defaults: dict) -> "Callable[..., BurninConfig]":
+    def factory(**overrides) -> BurninConfig:
+        return _dense(**{**defaults, **overrides})  # overrides win
+
+    return factory
+
+
+FAMILIES: "dict[str, Callable[..., BurninConfig]]" = {
+    "dense": _preset({}),
+    "long_context": _preset({"ring_attention": True}),
+    "moe": _preset({"moe_experts": 4}),
+    "flash": _preset({"flash_attention": True}),
+    "pipelined": _preset({"pipeline_stages": 2, "moe_experts": 2}),
+}
+
+
+def family_config(name: str, **overrides) -> BurninConfig:
+    """The named family's canonical config (overrides applied on top)."""
+    try:
+        factory = FAMILIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model family {name!r}; choose from {sorted(FAMILIES)}"
+        ) from None
+    return factory(**overrides)
+
+
+def family_mesh(name: str, devices, *, stages: "int | None" = None):
+    """The mesh flavor the family shards over: (data, pipe, model) for the
+    pipelined family, (data, fsdp, model) for everything else.
+
+    ``stages``: explicit pipeline depth; defaults to 2.  An impossible
+    factorization raises ValueError (pipeline_mesh validates)."""
+    if name == "pipelined":
+        from tpu_dra.parallel.pipeline import pipeline_mesh
+
+        n = len(devices)
+        stages = stages or 2
+        model = 2 if n % (stages * 2) == 0 and n >= stages * 2 else 1
+        return pipeline_mesh(devices, stages=stages, model=model)
+    return burnin_mesh(devices)
+
+
+def train_family(
+    name: str,
+    devices=None,
+    *,
+    steps: int = 5,
+    **overrides,
+) -> TrainReport:
+    """Run the named family's training step over the claimed slice.
+
+    Honors the burn-in contract: reports, never raises — an impossible
+    mesh (e.g. the pipelined family on one chip) comes back as
+    ``TrainReport(ok=False, error=...)``."""
+    import jax
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    config = family_config(name, **overrides)
+    try:
+        mesh = family_mesh(
+            name, devices, stages=config.pipeline_stages or None
+        )
+    except Exception as e:
+        return TrainReport(
+            ok=False, steps=0, loss_first=0.0, loss_last=0.0,
+            step_seconds_p50=0.0, tokens_per_second=0.0,
+            error=f"{type(e).__name__}: {e}",
+        )
+    # train() -> scaled_to snaps the config to the mesh (incl. the pipe
+    # axis size, which family_mesh built from the requested stages).
+    return train(config, mesh, steps=steps)
