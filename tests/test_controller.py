@@ -602,3 +602,111 @@ class TestDelayQueue:
         again = driver.allocate(claim, params, ResourceClass(), exclusive, "node-1")
         assert first.shareable is False
         assert again.shareable is False  # reference hardcodes True here
+
+
+class TestProbeMemo:
+    """The scheduling probe memo (driver._probe_memo): identical state
+    replays the verdict; any input change forces a fresh pass."""
+
+    def _ca(self, cs, name="c1"):
+        from tpu_dra.controller.types import ClaimAllocation
+
+        return ClaimAllocation(
+            claim=make_claim(cs, name=name),
+            class_=ResourceClass(),
+            claim_parameters=TpuClaimParametersSpec(count=1),
+        )
+
+    def test_memo_hit_replays_verdict(self, tmp_path, cs, driver):
+        from tpu_dra.api.k8s import Pod
+
+        publish_node(tmp_path, cs)
+        driver.start_nas_informer()
+        ca = self._ca(cs)
+        driver.unsuitable_nodes(Pod(), [ca], ["node-1"])
+        assert ca.unsuitable_nodes == []
+        assert len(driver._probe_memo) == 1
+
+        # Same state -> memo hit; the probe result is identical and the
+        # seeded pending pick is untouched (version unchanged).
+        from tpu_dra.controller.types import ClaimAllocation
+
+        ver = driver.tpu.pending_allocated_claims.version("node-1")
+        ca2 = ClaimAllocation(
+            claim=ca.claim,  # same claim, same params
+            class_=ResourceClass(),
+            claim_parameters=TpuClaimParametersSpec(count=1),
+        )
+        driver.unsuitable_nodes(Pod(), [ca2], ["node-1"])
+        assert ca2.unsuitable_nodes == []
+        assert driver.tpu.pending_allocated_claims.version("node-1") == ver
+        assert len(driver._probe_memo) == 1
+
+    def test_memo_misses_after_pending_change(self, tmp_path, cs, driver):
+        from tpu_dra.api.k8s import Pod
+
+        publish_node(tmp_path, cs)
+        driver.start_nas_informer()
+        ca = self._ca(cs, name="c1")
+        driver.unsuitable_nodes(Pod(), [ca], ["node-1"])
+        memo_size = len(driver._probe_memo)
+
+        # A DIFFERENT claim probing the same node changes the pending
+        # state -> its pass is fresh (new memo entry, not a replay).
+        other = self._ca(cs, name="c2")
+        driver.unsuitable_nodes(Pod(), [other], ["node-1"])
+        assert len(driver._probe_memo) > memo_size
+
+    def test_memo_unsuitable_verdict_replayed(self, tmp_path, cs, driver):
+        from tpu_dra.api.k8s import Pod
+        from tpu_dra.controller.types import ClaimAllocation
+
+        publish_node(tmp_path, cs)  # 4 chips
+        driver.start_nas_informer()
+        ca = ClaimAllocation(
+            claim=make_claim(cs, name="big"),
+            class_=ResourceClass(),
+            claim_parameters=TpuClaimParametersSpec(count=64),  # can't fit
+        )
+        driver.unsuitable_nodes(Pod(), [ca], ["node-1"])
+        assert ca.unsuitable_nodes == ["node-1"]
+
+        ca.unsuitable_nodes = []
+        driver.unsuitable_nodes(Pod(), [ca], ["node-1"])
+        assert ca.unsuitable_nodes == ["node-1"]
+        assert len(driver._probe_memo) == 1
+
+    def test_memo_keyed_by_pod_identity(self, tmp_path, cs, driver):
+        # Subslice affinity verdicts depend on the pod name (template-
+        # instantiated parent claim names), so another pod must get a
+        # fresh pass even with identical node state.
+        from tpu_dra.api.k8s import Pod
+        from tpu_dra.api.meta import ObjectMeta
+
+        publish_node(tmp_path, cs)
+        driver.start_nas_informer()
+        ca = self._ca(cs)
+        driver.unsuitable_nodes(
+            Pod(metadata=ObjectMeta(name="pod-a", uid="ua")), [ca], ["node-1"]
+        )
+        n = len(driver._probe_memo)
+        ca2 = self._ca(cs, name="c2")
+        driver.unsuitable_nodes(
+            Pod(metadata=ObjectMeta(name="pod-b", uid="ub")), [ca2], ["node-1"]
+        )
+        assert len(driver._probe_memo) > n
+
+    def test_memo_entry_expires(self, tmp_path, cs, driver):
+        from tpu_dra.api.k8s import Pod
+
+        publish_node(tmp_path, cs)
+        driver.start_nas_informer()
+        driver.PROBE_MEMO_TTL_S = 0.0  # every entry instantly stale
+        ca = self._ca(cs)
+        driver.unsuitable_nodes(Pod(), [ca], ["node-1"])
+        ver = driver.tpu.pending_allocated_claims.version("node-1")
+        ca.unsuitable_nodes = []
+        driver.unsuitable_nodes(Pod(), [ca], ["node-1"])
+        # Expired entry -> a fresh pass ran (it re-seeded pending and
+        # bumped the version), not a replay.
+        assert driver.tpu.pending_allocated_claims.version("node-1") > ver

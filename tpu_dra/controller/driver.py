@@ -40,6 +40,17 @@ DRIVER_NAME = tpucrd.GROUP_NAME
 DRIVER_API_GROUP = tpucrd.GROUP_NAME
 
 
+def _params_key(ca: ClaimAllocation) -> str:
+    """Canonical fingerprint of a claim's resolved parameters (probe memo
+    key component — two passes with identical params + identical node state
+    derive identical verdicts)."""
+    import json
+
+    from tpu_dra.api import serde
+
+    return json.dumps(serde.to_dict(ca.claim_parameters), sort_keys=True)
+
+
 class ControllerDriver:
     def __init__(self, clientset: ClientSet, namespace: str = "tpu-dra"):
         self.lock = PerNodeMutex()
@@ -67,6 +78,23 @@ class ControllerDriver:
         # fall back to a fresh GET.
         self._node_write_rv: "dict[str, int]" = {}
         self._write_rv_lock = threading.Lock()
+        # Probe memo: (node, pod, nas rv, pending versions, claim-set key)
+        # -> which of those claims found the node unsuitable.  The
+        # reconciler re-syncs a PodSchedulingContext on every watch tick
+        # (its own status writes included), so probe passes repeat in
+        # bursts deriving identical verdicts from identical state; the memo
+        # replays them instead of re-running the placement search.  Keys
+        # embed every mutable input (pod identity — subslice affinity
+        # verdicts depend on the pod name; NAS resourceVersion; per-node
+        # pending mutation counters bumped AFTER a pass seeds its picks),
+        # and entries expire after PROBE_MEMO_TTL_S: lock-free pending
+        # removals can race the post-pass version read, and memo hits skip
+        # the set() calls that refresh pending TTL stamps — a short entry
+        # lifetime bounds both to one memo window.
+        self._probe_memo: "dict[tuple, tuple[float, dict[str, bool]]]" = {}
+        self._probe_memo_lock = threading.Lock()
+        self.PROBE_MEMO_CAP = 8192
+        self.PROBE_MEMO_TTL_S = 2.0
         from tpu_dra.controller.gang_tracker import GangTracker
 
         self.gangs = GangTracker(clientset, namespace)
@@ -520,12 +548,17 @@ class ControllerDriver:
         # entries cheaply inside each node's pass.
         with UNSUITABLE_SECONDS.time():
             dead = self._dead_pending_claims(potential_nodes)
+            claims_fp = tuple(
+                sorted(
+                    (ca.claim.metadata.uid, _params_key(ca)) for ca in cas
+                )
+            )
             if len(potential_nodes) > 1:
                 from concurrent.futures import wait
 
                 futures = [
                     self._fanout_executor().submit(
-                        self._unsuitable_node, pod, cas, node, dead
+                        self._unsuitable_node, pod, cas, node, dead, claims_fp
                     )
                     for node in potential_nodes
                 ]
@@ -538,7 +571,7 @@ class ControllerDriver:
                     future.result()
             else:
                 for node in potential_nodes:
-                    self._unsuitable_node(pod, cas, node, dead)
+                    self._unsuitable_node(pod, cas, node, dead, claims_fp)
         # Canonical order (sorted, deduped): the pool appends in completion
         # order, and an order-flapping list would make the reconciler's
         # status comparison see a "change" every pass and rewrite the
@@ -587,6 +620,7 @@ class ControllerDriver:
         allcas: list[ClaimAllocation],
         potential_node: str,
         dead_pending: set[str] | None = None,
+        claims_fp: "tuple | None" = None,
     ) -> None:
         from tpu_dra.client.apiserver import ApiError
 
@@ -597,6 +631,7 @@ class ControllerDriver:
             # at least this driver's committed allocations.  Plugin-side
             # staleness (status, prepared) is advisory only.
             nas = self._informer_nas(potential_node)
+            from_informer = nas is not None
             if nas is None:
                 nas, client = self._nas_client(potential_node)
                 try:
@@ -615,6 +650,35 @@ class ControllerDriver:
                     subdriver.pending_allocated_claims.remove_node(
                         uid, potential_node
                     )
+
+            # Memo path: only when the probe's inputs are fully
+            # fingerprintable (informer-served NAS — its rv IS the state;
+            # a GET fallback may race a write mid-pass) and no dead-pending
+            # cleanup just mutated state unaccounted for.
+            memo_key = None
+            if from_informer and not dead_pending and claims_fp is not None:
+                import time as _time
+
+                memo_key = (
+                    potential_node,
+                    pod.metadata.uid or pod.metadata.name,
+                    nas.metadata.resource_version,
+                    self.tpu.pending_allocated_claims.version(potential_node),
+                    self.subslice.pending_allocated_claims.version(potential_node),
+                    self.core.pending_allocated_claims.version(potential_node),
+                    claims_fp,
+                )
+                now = _time.monotonic()
+                with self._probe_memo_lock:
+                    entry = self._probe_memo.get(memo_key)
+                if entry is not None and now - entry[0] <= self.PROBE_MEMO_TTL_S:
+                    for ca in allcas:
+                        if entry[1].get(ca.claim.metadata.uid, False):
+                            ca.unsuitable_nodes.append(potential_node)
+                    return
+            lengths = {
+                ca.claim.metadata.uid: len(ca.unsuitable_nodes) for ca in allcas
+            }
 
             per_kind: dict[str, list[ClaimAllocation]] = {
                 tpucrd.TPU_CLAIM_PARAMETERS_KIND: [],
@@ -653,3 +717,28 @@ class ControllerDriver:
                 nas, pod, per_kind[tpucrd.CORE_CLAIM_PARAMETERS_KIND], allcas,
                 potential_node,
             )
+
+            if memo_key is not None:
+                import time as _time
+
+                # Re-key on the POST-pass pending versions: a memo hit then
+                # certifies the pass's seeded picks are still in place (the
+                # TTL bounds the residual race with lock-free removals).
+                stored_key = (
+                    memo_key[0],
+                    memo_key[1],
+                    memo_key[2],
+                    self.tpu.pending_allocated_claims.version(potential_node),
+                    self.subslice.pending_allocated_claims.version(potential_node),
+                    self.core.pending_allocated_claims.version(potential_node),
+                    memo_key[6],
+                )
+                verdict = {
+                    ca.claim.metadata.uid: potential_node
+                    in ca.unsuitable_nodes[lengths[ca.claim.metadata.uid]:]
+                    for ca in allcas
+                }
+                with self._probe_memo_lock:
+                    if len(self._probe_memo) >= self.PROBE_MEMO_CAP:
+                        self._probe_memo.clear()
+                    self._probe_memo[stored_key] = (_time.monotonic(), verdict)

@@ -34,6 +34,17 @@ class PerNodeAllocatedClaims:
         self._allocations: dict[str, dict[str, AllocatedDevices]] = {}
         # claimUID -> monotonic time of last set()
         self._stamped: dict[str, float] = {}
+        # node -> mutation counter: bumps on every set/remove touching the
+        # node, so callers can fingerprint "has this node's pending state
+        # changed" (the scheduling probe memo keys on it).
+        self._versions: dict[str, int] = {}
+
+    def version(self, node: str) -> int:
+        with self._lock:
+            return self._versions.get(node, 0)
+
+    def _bump(self, node: str) -> None:
+        self._versions[node] = self._versions.get(node, 0) + 1
 
     def exists(self, claim_uid: str, node: str) -> bool:
         with self._lock:
@@ -50,6 +61,7 @@ class PerNodeAllocatedClaims:
                 devices
             )
             self._stamped[claim_uid] = time.monotonic()
+            self._bump(node)
 
     def visit_node(
         self, node: str, visitor: Callable[[str, AllocatedDevices], None]
@@ -62,7 +74,8 @@ class PerNodeAllocatedClaims:
                 if now - stamp > self._ttl_s
             ]
             for uid in expired:
-                self._allocations.pop(uid, None)
+                for touched in self._allocations.pop(uid, {}):
+                    self._bump(touched)
                 self._stamped.pop(uid, None)
             snapshot = [
                 (uid, serde.deepcopy(nodes[node]))
@@ -74,12 +87,15 @@ class PerNodeAllocatedClaims:
 
     def remove_node(self, claim_uid: str, node: str) -> None:
         with self._lock:
-            self._allocations.get(claim_uid, {}).pop(node, None)
+            removed = self._allocations.get(claim_uid, {}).pop(node, None)
+            if removed is not None:
+                self._bump(node)
             if not self._allocations.get(claim_uid):
                 self._allocations.pop(claim_uid, None)
                 self._stamped.pop(claim_uid, None)
 
     def remove(self, claim_uid: str) -> None:
         with self._lock:
-            self._allocations.pop(claim_uid, None)
+            for touched in self._allocations.pop(claim_uid, {}):
+                self._bump(touched)
             self._stamped.pop(claim_uid, None)
