@@ -1,0 +1,62 @@
+"""Checkpoint/resume of the sharded burn-in state (tpu_dra/parallel/ckpt.py).
+
+The decisive property: a run preempted at step k and resumed from its
+checkpoint produces the SAME losses as an uninterrupted run — on the
+sharded mesh, with arrays restored directly into the mesh shardings.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from tpu_dra.parallel.burnin import BurninConfig, burnin_mesh
+from tpu_dra.parallel.ckpt import (
+    latest_step,
+    restore_state,
+    save_state,
+    train_with_resume,
+)
+
+CFG = BurninConfig(n_layers=2, seq=64, d_model=64, d_ff=128)
+
+
+def test_resume_matches_uninterrupted_run(tmp_path):
+    mesh = burnin_mesh(jax.devices())
+
+    # Uninterrupted: 6 steps.
+    _, full = train_with_resume(
+        CFG, mesh, tmp_path / "full", steps=6, save_every=100
+    )
+
+    # Preempted: 3 steps, checkpoint, fresh process-equivalent resume.
+    _, first = train_with_resume(
+        CFG, mesh, tmp_path / "resume", steps=3, save_every=1
+    )
+    assert latest_step(tmp_path / "resume") == 3
+    final, second = train_with_resume(
+        CFG, mesh, tmp_path / "resume", steps=3, save_every=1
+    )
+    assert final == 6
+    np.testing.assert_allclose(first + second, full, rtol=1e-5, atol=1e-6)
+
+
+def test_restore_lands_in_mesh_shardings(tmp_path):
+    mesh = burnin_mesh(jax.devices())
+    c = CFG.scaled_to(mesh)
+    from tpu_dra.parallel.burnin import make_train_step
+
+    _, state = make_train_step(c, mesh)
+    save_state(tmp_path / "ck", state, step=1)
+    restored = restore_state(tmp_path / "ck", c, mesh, step=1)
+    # Spot-check one fsdp-sharded leaf: the restored array carries the
+    # mesh sharding (not single-device or fully-replicated).
+    leaf = restored[0]["layers"]["w1"]
+    assert leaf.sharding.mesh.shape == mesh.shape
+    np.testing.assert_array_equal(
+        np.asarray(leaf), np.asarray(state[0]["layers"]["w1"])
+    )
+
+
+def test_latest_step_empty(tmp_path):
+    assert latest_step(tmp_path / "nope") is None

@@ -690,7 +690,15 @@ class TestProbeMemo:
             Pod(metadata=ObjectMeta(name="pod-a", uid="ua")), [ca], ["node-1"]
         )
         n = len(driver._probe_memo)
-        ca2 = self._ca(cs, name="c2")
+        # The SAME claim set probed by a different pod: only the pod
+        # component of the key differs, and it must force a fresh pass.
+        from tpu_dra.controller.types import ClaimAllocation
+
+        ca2 = ClaimAllocation(
+            claim=ca.claim,
+            class_=ResourceClass(),
+            claim_parameters=TpuClaimParametersSpec(count=1),
+        )
         driver.unsuitable_nodes(
             Pod(metadata=ObjectMeta(name="pod-b", uid="ub")), [ca2], ["node-1"]
         )

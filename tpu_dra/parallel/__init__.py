@@ -34,6 +34,9 @@ slice:
   dp x pp x tp x ep on a (data, pipe, model) mesh.
 - ``tpu_dra.parallel.mfu``         — chip-sized MFU + HBM-bandwidth
   measurement with analytic FLOPs accounting vs published bf16 peaks.
+- ``tpu_dra.parallel.ckpt``        — sharding-aware checkpoint/resume of
+  the training state (orbax; restore lands directly in the restoring
+  mesh's shardings, per-host shard writes).
 """
 
 from tpu_dra.parallel.mesh import (

@@ -472,7 +472,7 @@ def _loss(params, tokens, config: BurninConfig, mesh=None):
     return ce
 
 
-def make_train_step(config: BurninConfig, mesh=None):
+def make_train_step(config: BurninConfig, mesh=None, *, with_state: bool = True):
     """Build (train_step, init_state).
 
     ``train_step(state, tokens) -> (state, loss)`` is a single jitted SGD+
@@ -480,6 +480,10 @@ def make_train_step(config: BurninConfig, mesh=None):
     batch is dp-sharded — the complete pjit training step the driver
     dry-runs multi-chip.  Momentum (not adam) keeps optimizer state at 1x
     params: burn-in measures the slice, not the optimizer.
+
+    ``with_state=False`` skips materializing the fresh init (returns
+    ``(train_step, None)``) — the resume path restores a checkpoint into
+    HBM instead, and holding both copies would double peak state memory.
     """
     import jax
     import jax.numpy as jnp
@@ -495,7 +499,9 @@ def make_train_step(config: BurninConfig, mesh=None):
         return (params, mom), loss
 
     if mesh is None:
-        return jax.jit(step, donate_argnums=0), _init_state(c)
+        return jax.jit(step, donate_argnums=0), (
+            _init_state(c) if with_state else None
+        )
 
     from jax.sharding import NamedSharding
 
@@ -513,7 +519,7 @@ def make_train_step(config: BurninConfig, mesh=None):
         out_shardings=(state_sh, NamedSharding(mesh, P())),
         donate_argnums=0,
     )
-    state = jax.device_put(_init_state(c), state_sh)
+    state = jax.device_put(_init_state(c), state_sh) if with_state else None
     return jitted, state
 
 

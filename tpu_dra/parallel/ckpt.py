@@ -1,0 +1,136 @@
+"""Sharding-aware checkpoint/resume for the burn-in training state.
+
+The control plane's checkpoint story is "the NAS CRD is the checkpoint"
+(allocation state lives in the apiserver and is re-adopted on restart —
+SURVEY.md §5).  This module is the compute-side counterpart: persist a
+sharded training state (params + momentum) with orbax and restore it
+*into the restored process's own mesh sharding*, so a preempted slice
+job resumes exactly where it stopped.
+
+TPU-first specifics:
+
+- Saves go through ``orbax.checkpoint`` with the array's shardings
+  attached: on a multi-chip mesh each host writes its own shards (OCDBT),
+  no gather-to-host-0 — the pattern that scales to multi-host slices.
+- Restore takes the TARGET shardings (from the restoring process's mesh,
+  which may be a different slice of equal logical shape) and materializes
+  arrays directly into them — no host round-trip, no resharding step.
+- The train-state layout is the burn-in's plain pytree; abstract target
+  construction uses ``jax.eval_shape`` over ``_init_state`` so the
+  checkpoint schema is derived from the model code, never duplicated.
+"""
+
+from __future__ import annotations
+
+__all__ = ["save_state", "restore_state", "latest_step", "train_with_resume"]
+
+
+def _state_shardings(config, mesh):
+    """NamedSharding pytree for (params, momentum) on ``mesh`` (None ->
+    single-device: no shardings attached)."""
+    if mesh is None:
+        return None
+    import jax
+    from jax.sharding import NamedSharding
+
+    from tpu_dra.parallel.burnin import param_specs
+
+    pspecs = param_specs(config)
+    one = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs)
+    return (one, one)
+
+
+def save_state(path, state, *, step: int) -> None:
+    """Persist (params, momentum) at ``path``/<step> (atomic per orbax)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        ckptr.save(_step_dir(path, step), state)
+
+
+def restore_state(path, config, mesh=None, *, step: int):
+    """Restore (params, momentum) into this process's mesh shardings."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from tpu_dra.parallel.burnin import _init_state
+
+    abstract = jax.eval_shape(lambda: _init_state(config))
+    shardings = _state_shardings(config, mesh)
+    if shardings is not None:
+        abstract = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract,
+            shardings,
+        )
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        return ckptr.restore(_step_dir(path, step), abstract)
+
+
+def latest_step(path) -> "int | None":
+    """Highest step saved under ``path``, or None when empty/absent."""
+    import os
+
+    try:
+        steps = [int(d) for d in os.listdir(path) if d.isdigit()]
+    except FileNotFoundError:
+        return None
+    return max(steps) if steps else None
+
+
+def _step_dir(path, step: int) -> str:
+    import os
+
+    return os.path.join(os.fspath(path), str(step))
+
+
+def train_with_resume(
+    config,
+    mesh,
+    path,
+    *,
+    steps: int,
+    save_every: "int | None" = None,
+):
+    """Run burn-in training with checkpointing; resumes from the latest
+    step under ``path`` when one exists.  Returns (final_step, losses) —
+    ``losses`` covers only the steps run in THIS invocation, so a resumed
+    run's continuity is checkable against the pre-preemption run.
+
+    ``save_every=None`` saves once at the end (each save here is a
+    synchronous orbax write that stalls the step loop — frequent saves are
+    for preemption-sensitive runs, not the default)."""
+    import jax
+
+    from tpu_dra.parallel.burnin import make_train_step, sample_tokens
+
+    c = config if mesh is None else config.scaled_to(mesh)
+    start = latest_step(path)
+    if start is not None:
+        # Resume: build the step WITHOUT materializing a fresh init (the
+        # restore is about to fill HBM; two copies would double peak state
+        # memory at exactly the restore moment).
+        step_fn, _ = make_train_step(c, mesh, with_state=False)
+        state = restore_state(path, c, mesh, step=start)
+    else:
+        step_fn, state = make_train_step(c, mesh)
+        start = 0
+    tokens = sample_tokens(c)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        from tpu_dra.parallel.burnin import token_spec
+
+        tokens = jax.device_put(tokens, NamedSharding(mesh, token_spec(c)))
+
+    losses = []
+    current = start
+    for _ in range(steps):
+        state, loss = step_fn(state, tokens)
+        losses.append(float(jax.device_get(loss)))
+        current += 1
+        if save_every and current % save_every == 0:
+            save_state(path, state, step=current)
+    if steps and (not save_every or current % save_every):
+        save_state(path, state, step=current)
+    return current, losses
