@@ -566,18 +566,19 @@ def bench_compute(timeout_s: float = 480.0) -> "dict":
     # The child inherits cwd, not the parent's script-dir sys.path entry;
     # seed PYTHONPATH so tpu_dra imports regardless of where bench runs.
     repo_dir = os.path.dirname(os.path.abspath(__file__))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = (
-        repo_dir + os.pathsep + env["PYTHONPATH"]
-        if env.get("PYTHONPATH")
+    base_env = dict(os.environ)
+    base_env["PYTHONPATH"] = (
+        repo_dir + os.pathsep + base_env["PYTHONPATH"]
+        if base_env.get("PYTHONPATH")
         else repo_dir
     )
-    try:
+
+    def run_child(env, limit):
         proc = subprocess.run(
             [sys.executable, "-c", _COMPUTE_CHILD],
             capture_output=True,
             text=True,
-            timeout=timeout_s,
+            timeout=limit,
             env=env,
         )
         for line in proc.stdout.splitlines():
@@ -592,16 +593,44 @@ def bench_compute(timeout_s: float = 480.0) -> "dict":
                 f"stderr tail: {proc.stderr[-300:]!r})"
             ),
         }
+
+    # Budget split keeps the documented contract (total wall <= timeout_s):
+    # the accelerator attempt gets the bulk; the CPU fallback's reserve
+    # covers a cold-process compile of the tiny default config.
+    cpu_reserve = min(180.0, timeout_s / 2)
+    try:
+        return run_child(base_env, timeout_s - cpu_reserve)
     except subprocess.TimeoutExpired:
-        return {
-            "platform": "none",
-            "mfu": 0.0,
-            "ok": False,
-            "error": (
-                f"compute stanza exceeded {timeout_s:.0f}s wall "
-                "(accelerator backend unreachable or compile wedged)"
-            ),
-        }
+        # An unreachable accelerator tunnel wedges PJRT init in C++ (only
+        # SIGKILL clears it).  Measure the CPU instead of reporting
+        # nothing: the result is labeled a fallback only when it actually
+        # produced numbers, and platform says "cpu" — never passed off as
+        # chip performance.
+        try:
+            cpu_env = dict(base_env)
+            cpu_env["JAX_PLATFORMS"] = "cpu"
+            out = run_child(cpu_env, cpu_reserve)
+            if out.get("ok"):
+                out["fallback"] = (
+                    "accelerator backend unreachable after "
+                    f"{timeout_s - cpu_reserve:.0f}s; cpu-measured numbers"
+                )
+            else:
+                out.setdefault(
+                    "error",
+                    f"accelerator unreachable and cpu fallback not ok",
+                )
+            return out
+        except Exception as e:
+            return {
+                "platform": "none",
+                "mfu": 0.0,
+                "ok": False,
+                "error": (
+                    f"compute stanza exceeded its wall budget and the "
+                    f"cpu fallback failed: {type(e).__name__}: {e}"
+                ),
+            }
     except Exception as e:  # bench must still emit its line without a chip
         return {"platform": "none", "mfu": 0.0, "ok": False, "error": str(e)}
 

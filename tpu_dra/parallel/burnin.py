@@ -504,15 +504,10 @@ def make_train_step(config: BurninConfig, mesh=None, *, with_state: bool = True)
         )
 
     from jax.sharding import NamedSharding
-
-    pspecs = param_specs(c)
-    state_sh = (
-        jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs),
-        jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs),
-    )
-    tok_sh = NamedSharding(mesh, token_spec(c))
     from jax.sharding import PartitionSpec as P
 
+    state_sh = state_shardings(c, mesh)
+    tok_sh = NamedSharding(mesh, token_spec(c))
     jitted = jax.jit(
         step,
         in_shardings=(state_sh, tok_sh),
@@ -521,6 +516,34 @@ def make_train_step(config: BurninConfig, mesh=None, *, with_state: bool = True)
     )
     state = jax.device_put(_init_state(c), state_sh) if with_state else None
     return jitted, state
+
+
+def state_shardings(config: BurninConfig, mesh):
+    """NamedSharding pytree for the training state (params, momentum) —
+    the single source both the jitted step's in/out shardings and the
+    checkpoint restore targets (parallel/ckpt.py) are built from, so a
+    restored state always lands in exactly the step's donated layout."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    pspecs = param_specs(config)
+    one = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs)
+    return (one, one)
+
+
+def prepare_tokens(config: BurninConfig, mesh=None):
+    """Sample the synthetic batch and place it per the config's token spec
+    (shared by train() and the checkpointed loop in parallel/ckpt.py)."""
+    import jax
+
+    tokens = sample_tokens(config)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        tokens = jax.device_put(
+            tokens, NamedSharding(mesh, token_spec(config))
+        )
+    return tokens
 
 
 def make_constrain(mesh, batch_axes):
@@ -618,11 +641,7 @@ def train(
         if mesh is not None:
             c = c.scaled_to(mesh)
         step_fn, state = make_train_step(c, mesh)
-        tokens = sample_tokens(c)
-        if mesh is not None:
-            from jax.sharding import NamedSharding
-
-            tokens = jax.device_put(tokens, NamedSharding(mesh, token_spec(c)))
+        tokens = prepare_tokens(c, mesh)
         losses, times = [], []
         for _ in range(max(2, steps)):
             t0 = time.perf_counter()

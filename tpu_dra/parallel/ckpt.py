@@ -27,17 +27,14 @@ __all__ = ["save_state", "restore_state", "latest_step", "train_with_resume"]
 
 def _state_shardings(config, mesh):
     """NamedSharding pytree for (params, momentum) on ``mesh`` (None ->
-    single-device: no shardings attached)."""
+    single-device: no shardings attached).  Delegates to the burn-in's own
+    sharding builder so restore targets always match the jitted step's
+    donated in_shardings."""
     if mesh is None:
         return None
-    import jax
-    from jax.sharding import NamedSharding
+    from tpu_dra.parallel.burnin import state_shardings
 
-    from tpu_dra.parallel.burnin import param_specs
-
-    pspecs = param_specs(config)
-    one = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs)
-    return (one, one)
+    return state_shardings(config, mesh)
 
 
 def save_state(path, state, *, step: int) -> None:
@@ -68,7 +65,13 @@ def restore_state(path, config, mesh=None, *, step: int):
 
 
 def latest_step(path) -> "int | None":
-    """Highest step saved under ``path``, or None when empty/absent."""
+    """Highest step saved under ``path``, or None when empty/absent.
+
+    Deliberately a flat <path>/<step> layout managed here rather than
+    ocp.CheckpointManager: the burn-in needs save/restore/latest only, and
+    a handler-level Checkpointer keeps the dependency surface to orbax's
+    stable core (saves are still atomic per orbax's commit protocol;
+    non-digit entries like in-progress tmp dirs are skipped)."""
     import os
 
     try:
@@ -102,7 +105,7 @@ def train_with_resume(
     for preemption-sensitive runs, not the default)."""
     import jax
 
-    from tpu_dra.parallel.burnin import make_train_step, sample_tokens
+    from tpu_dra.parallel.burnin import make_train_step, prepare_tokens
 
     c = config if mesh is None else config.scaled_to(mesh)
     start = latest_step(path)
@@ -115,13 +118,7 @@ def train_with_resume(
     else:
         step_fn, state = make_train_step(c, mesh)
         start = 0
-    tokens = sample_tokens(c)
-    if mesh is not None:
-        from jax.sharding import NamedSharding
-
-        from tpu_dra.parallel.burnin import token_spec
-
-        tokens = jax.device_put(tokens, NamedSharding(mesh, token_spec(c)))
+    tokens = prepare_tokens(c, mesh)
 
     losses = []
     current = start
