@@ -119,7 +119,7 @@ def test_driver_write_fence_rejects_stale_informer_copy():
         assert driver.nas_informer.wait_synced(5.0)
         assert _wait(lambda: driver.nas_informer.get("node-a") is not None)
         # Fresh cache, no writes yet: served from the informer.
-        assert driver._informer_nas("node-a") is not None
+        assert driver._informer_nas("node-a")[0] is not None
 
         # The driver commits a write (rv bumps beyond the cached copy)...
         fresh = client.get("node-a")
@@ -136,13 +136,13 @@ def test_driver_write_fence_rejects_stale_informer_copy():
             driver.nas_informer._store["node-a"] = (
                 1, pickle.dumps(stale, protocol=pickle.HIGHEST_PROTOCOL)
             )
-        assert driver._informer_nas("node-a") is None  # forces a fresh GET
+        assert driver._informer_nas("node-a")[0] is None  # forces a fresh GET
 
         # A later write flows in via the watch and catches the cache up
         # past the fence: the informer serves again.
         fresh = client.get("node-a")
         client.update(fresh)
-        assert _wait(lambda: driver._informer_nas("node-a") is not None)
+        assert _wait(lambda: driver._informer_nas("node-a")[0] is not None)
     finally:
         driver.close()
 

@@ -34,7 +34,14 @@ from tpu_dra.controller.nodelock import PerNodeMutex
 from tpu_dra.controller.subslice_allocator import SubsliceDriver
 from tpu_dra.controller.tpu_allocator import TpuDriver
 from tpu_dra.controller.types import ClaimAllocation
-from tpu_dra.utils.metrics import ALLOCATE_SECONDS, UNSUITABLE_SECONDS
+from tpu_dra.utils.metrics import (
+    ALLOCATE_SECONDS,
+    INFORMER_FALLBACKS,
+    INFORMER_READS,
+    PROBE_MEMO_HITS,
+    PROBE_MEMO_MISSES,
+    UNSUITABLE_SECONDS,
+)
 
 DRIVER_NAME = tpucrd.GROUP_NAME
 DRIVER_API_GROUP = tpucrd.GROUP_NAME
@@ -234,22 +241,29 @@ class ControllerDriver:
             if rv > self._node_write_rv.get(node, 0):
                 self._node_write_rv[node] = rv
 
-    def _informer_nas(self, node: str) -> "nascrd.NodeAllocationState | None":
-        """The cached NAS if it is at least as fresh as our own last write
-        to this node; None -> caller must GET (or has no informer)."""
+    def _informer_nas(
+        self, node: str
+    ) -> "tuple[nascrd.NodeAllocationState | None, bool]":
+        """(cached NAS or None, informer_consulted).  The NAS is served
+        only when at least as fresh as our own last write to this node;
+        None -> caller must GET.  The second element reports whether a
+        live informer was consulted (from the same snapshot the decision
+        used — metrics must not re-read self.nas_informer racily)."""
         informer = self.nas_informer
-        if informer is None or not informer.synced():
-            return None
+        if informer is None:
+            return None, False
+        if not informer.synced():
+            return None, True
         nas = informer.get(node)
         if nas is None:
-            return None
+            return None, True
         try:
             rv = int(nas.metadata.resource_version or "0")
         except (TypeError, ValueError):
-            return None
+            return None, True
         with self._write_rv_lock:
             fence = self._node_write_rv.get(node, 0)
-        return nas if rv >= fence else None
+        return (nas if rv >= fence else None), True
 
     def allocate(
         self,
@@ -630,9 +644,13 @@ class ControllerDriver:
             # pending-pick disjointness argument needs every picker to see
             # at least this driver's committed allocations.  Plugin-side
             # staleness (status, prepared) is advisory only.
-            nas = self._informer_nas(potential_node)
+            nas, informer_consulted = self._informer_nas(potential_node)
             from_informer = nas is not None
-            if nas is None:
+            if from_informer:
+                INFORMER_READS.inc()
+            else:
+                if informer_consulted:
+                    INFORMER_FALLBACKS.inc()
                 nas, client = self._nas_client(potential_node)
                 try:
                     client.get()
@@ -672,10 +690,12 @@ class ControllerDriver:
                 with self._probe_memo_lock:
                     entry = self._probe_memo.get(memo_key)
                 if entry is not None and now - entry[0] <= self.PROBE_MEMO_TTL_S:
+                    PROBE_MEMO_HITS.inc()
                     for ca in allcas:
                         if entry[1].get(ca.claim.metadata.uid, False):
                             ca.unsuitable_nodes.append(potential_node)
                     return
+            PROBE_MEMO_MISSES.inc()
             lengths = {
                 ca.claim.metadata.uid: len(ca.unsuitable_nodes) for ca in allcas
             }

@@ -196,6 +196,23 @@ ALLOCATED_CHIPS = REGISTRY.gauge(
 WORKQUEUE_DEPTH = REGISTRY.gauge(
     "tpu_dra_workqueue_depth", "Items waiting in the controller workqueue"
 )
+PROBE_MEMO_HITS = REGISTRY.counter(
+    "tpu_dra_probe_memo_hits_total",
+    "Scheduling probes served from the verdict memo (placement search skipped)",
+)
+PROBE_MEMO_MISSES = REGISTRY.counter(
+    "tpu_dra_probe_memo_misses_total",
+    "Scheduling probes that ran the full placement search",
+)
+INFORMER_READS = REGISTRY.counter(
+    "tpu_dra_nas_informer_reads_total",
+    "Fan-out NAS reads served from the informer cache (no apiserver GET)",
+)
+INFORMER_FALLBACKS = REGISTRY.counter(
+    "tpu_dra_nas_informer_fallbacks_total",
+    "Fan-out NAS reads that fell back to a GET (unsynced cache or "
+    "rv fence rejected a stale copy)",
+)
 
 
 def _dump_threads() -> str:
