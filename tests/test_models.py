@@ -38,3 +38,23 @@ def test_pipelined_on_one_chip_reports_not_raises():
     r = train_family("pipelined", devices=jax.devices()[:1], steps=2)
     assert not r.ok
     assert r.error
+
+
+def test_validate_cli_family_flag(capsys):
+    import json
+
+    from tpu_dra.parallel.validate import main
+
+    rc = main(["--family", "dense", "--train", "2"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and out["family"] == "dense" and out["ok"]
+
+    rc = main(["--family", "nope"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1 and not out["ok"]
+
+    # A positional topology is refused in family mode (it would be
+    # silently ignored otherwise).
+    rc = main(["4x4", "--family", "dense"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1 and "not supported" in out["error"]

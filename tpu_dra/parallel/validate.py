@@ -55,18 +55,20 @@ class SliceReport:
     errors: "list[str]" = field(default_factory=list)
 
     def to_json(self) -> str:
-        # allow_nan=False would raise; a diverged burn-in (NaN loss) must
-        # still produce a parseable report, so map non-finite floats to None.
-        def clean(v):
-            if isinstance(v, float) and (v != v or v in (float("inf"), float("-inf"))):
-                return None
-            if isinstance(v, dict):
-                return {k: clean(x) for k, x in v.items()}
-            if isinstance(v, list):
-                return [clean(x) for x in v]
-            return v
+        return json.dumps(_clean_nonfinite(asdict(self)), sort_keys=True)
 
-        return json.dumps(clean(asdict(self)), sort_keys=True)
+
+def _clean_nonfinite(v):
+    """Map NaN/inf floats to None so every report stays parseable JSON —
+    allow_nan=False would raise, and a diverged burn-in (NaN loss) must
+    still produce a report (shared by the suite and --family outputs)."""
+    if isinstance(v, float) and (v != v or v in (float("inf"), float("-inf"))):
+        return None
+    if isinstance(v, dict):
+        return {k: _clean_nonfinite(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_clean_nonfinite(x) for x in v]
+    return v
 
 
 def _expected_device_count(env) -> "int | None":
@@ -238,9 +240,21 @@ def _compact(r: CollectiveReport) -> dict:
 
 
 def main(argv: "list[str] | None" = None) -> int:
-    """CLI: ``python -m tpu_dra.parallel.validate [topology] [--train N]``."""
+    """CLI: ``python -m tpu_dra.parallel.validate [topology] [--train N]
+    [--family NAME]``.
+
+    ``--family`` runs one named workload family (tpu_dra/models: dense /
+    long_context / moe / flash / pipelined) instead of the full acceptance
+    suite — the operator's "will MY job shape run on this slice" probe.
+    """
     argv = sys.argv[1:] if argv is None else argv
     train_steps = 0
+    train_given = False
+    family = None
+    if "--family" in argv:
+        i = argv.index("--family")
+        family = argv[i + 1] if i + 1 < len(argv) else ""
+        argv = argv[:i] + argv[i + 2 :]
     if "--train" in argv:
         i = argv.index("--train")
         raw = argv[i + 1] if i + 1 < len(argv) else "5"
@@ -255,7 +269,45 @@ def main(argv: "list[str] | None" = None) -> int:
             report = SliceReport(errors=[f"--train must be >= 0, got {train_steps}"])
             print(report.to_json())
             return 1
+        train_given = True
         argv = argv[:i] + argv[i + 2 :]
+    if family is not None:
+        from tpu_dra.models import FAMILIES, train_family
+
+        def family_report(extra: dict) -> str:
+            return json.dumps(
+                _clean_nonfinite({"family": family, **extra}), sort_keys=True
+            )
+
+        if argv:
+            # The family probe runs over the whole visible slice; a
+            # positional topology would be silently ignored — refuse
+            # rather than return an 'ok' that says nothing about it.
+            print(
+                family_report(
+                    {
+                        "ok": False,
+                        "error": (
+                            "--family probes the visible slice; a topology "
+                            f"argument ({argv[0]!r}) is not supported with it"
+                        ),
+                    }
+                )
+            )
+            return 1
+        if family not in FAMILIES:
+            print(
+                family_report(
+                    {
+                        "ok": False,
+                        "error": f"unknown family; choose from {sorted(FAMILIES)}",
+                    }
+                )
+            )
+            return 1
+        r = train_family(family, steps=train_steps if train_given else 5)
+        print(family_report(asdict(r)))
+        return 0 if r.ok else 1
     topology = argv[0] if argv else None
     report = validate_slice(topology=topology, train_steps=train_steps)
     print(report.to_json())
