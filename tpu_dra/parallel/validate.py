@@ -255,6 +255,21 @@ def main(argv: "list[str] | None" = None) -> int:
         i = argv.index("--family")
         family = argv[i + 1] if i + 1 < len(argv) else ""
         argv = argv[:i] + argv[i + 2 :]
+
+    def arg_error(message: str) -> int:
+        # Error shape follows the active mode so consumers can parse every
+        # outcome by one schema.
+        if family is not None:
+            print(
+                json.dumps(
+                    {"family": family, "ok": False, "error": message},
+                    sort_keys=True,
+                )
+            )
+        else:
+            print(SliceReport(errors=[message]).to_json())
+        return 1
+
     if "--train" in argv:
         i = argv.index("--train")
         raw = argv[i + 1] if i + 1 < len(argv) else "5"
@@ -262,13 +277,9 @@ def main(argv: "list[str] | None" = None) -> int:
             train_steps = int(raw)
         except ValueError:
             # Must stay a JSON-report-emitting program even on bad args.
-            report = SliceReport(errors=[f"--train expects an integer, got {raw!r}"])
-            print(report.to_json())
-            return 1
+            return arg_error(f"--train expects an integer, got {raw!r}")
         if train_steps < 0:
-            report = SliceReport(errors=[f"--train must be >= 0, got {train_steps}"])
-            print(report.to_json())
-            return 1
+            return arg_error(f"--train must be >= 0, got {train_steps}")
         train_given = True
         argv = argv[:i] + argv[i + 2 :]
     if family is not None:
@@ -283,30 +294,35 @@ def main(argv: "list[str] | None" = None) -> int:
             # The family probe runs over the whole visible slice; a
             # positional topology would be silently ignored — refuse
             # rather than return an 'ok' that says nothing about it.
-            print(
-                family_report(
-                    {
-                        "ok": False,
-                        "error": (
-                            "--family probes the visible slice; a topology "
-                            f"argument ({argv[0]!r}) is not supported with it"
-                        ),
-                    }
-                )
+            return arg_error(
+                "--family probes the visible slice; a topology argument "
+                f"({argv[0]!r}) is not supported with it"
             )
-            return 1
         if family not in FAMILIES:
-            print(
-                family_report(
-                    {
-                        "ok": False,
-                        "error": f"unknown family; choose from {sorted(FAMILIES)}",
-                    }
-                )
+            return arg_error(
+                f"unknown family; choose from {sorted(FAMILIES)}"
             )
-            return 1
-        r = train_family(family, steps=train_steps if train_given else 5)
-        print(family_report(asdict(r)))
+        if train_given and train_steps == 0:
+            # Suite mode's 0 means "skip training"; a family probe IS
+            # training, so honor the letter of the request by refusing it
+            # rather than silently running burnin.train's 2-step minimum.
+            return arg_error("--family requires --train >= 1 (it only trains)")
+        # Multi-host gang pods: join the distributed system from the
+        # driver-injected env BEFORE touching jax.devices(), exactly as
+        # the suite path does — otherwise the probe would silently cover
+        # only this host's chips.
+        from tpu_dra.parallel.gang import initialize_gang
+
+        try:
+            gang = initialize_gang()
+        except Exception as e:
+            return arg_error(f"gang initialization failed: {type(e).__name__}: {e}")
+        kwargs = {"steps": train_steps} if train_given else {}
+        r = train_family(family, **kwargs)
+        extra = asdict(r)
+        if gang is not None:
+            extra["gang"] = {"rank": gang.rank, "size": gang.size}
+        print(family_report(extra))
         return 0 if r.ok else 1
     topology = argv[0] if argv else None
     report = validate_slice(topology=topology, train_steps=train_steps)
