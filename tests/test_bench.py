@@ -28,6 +28,14 @@ def test_bench_emits_one_json_line(monkeypatch):
     assert rc == 0
     lines = [l for l in buf.getvalue().splitlines() if l.strip()]
     assert len(lines) == 1
+    # The driver parses this line: pin the headline keys and every stanza.
+    parsed = json.loads(lines[0])
+    assert parsed["metric"] == "claim_to_pod_running_p50"
+    assert {"value", "unit", "vs_baseline", "extras"} <= parsed.keys()
+    extras = parsed["extras"]
+    assert {"rung", "target_s", "fleet", "wire", "compute"} <= extras.keys()
+    assert extras["fleet"]["target_met"]
+    assert extras["wire"]["target_met"]
     parsed = json.loads(lines[0])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(parsed)
 
