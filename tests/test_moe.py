@@ -9,6 +9,7 @@ batch-sharded <-> expert-sharded boundary.
 from __future__ import annotations
 
 import jax
+import pytest
 import jax.numpy as jnp
 
 from tpu_dra.parallel.burnin import (
@@ -94,3 +95,44 @@ def test_moe_scaled_to_rounds_experts():
     mesh = burnin_mesh(jax.devices())  # model axis = 2
     c = BurninConfig(moe_experts=3).scaled_to(mesh)
     assert c.moe_experts % mesh.shape["model"] == 0
+
+
+class TestExpertAxis:
+    """moe_mesh: experts on their own axis, tp inside each expert."""
+
+    def _mesh(self):
+        from tpu_dra.parallel.moe import moe_mesh
+
+        return moe_mesh(jax.devices(), data=2, fsdp=1, model=2, expert=2)
+
+    def test_ep_x_tp_trains(self):
+        r = train(BurninConfig(moe_experts=4, n_layers=2), self._mesh(), steps=5)
+        assert r.ok, r
+
+    def test_ep_x_tp_compiles_a2a(self):
+        mesh = self._mesh()
+        c = BurninConfig(moe_experts=4, n_layers=2).scaled_to(mesh)
+        step, state = make_train_step(c, mesh)
+        hlo = step.lower(state, sample_tokens(c)).compile().as_text()
+        assert "all-to-all" in hlo
+
+    def test_expert_leaves_shard_over_expert_axis(self):
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_dra.parallel.burnin import param_specs
+
+        mesh = self._mesh()
+        specs = param_specs(BurninConfig(moe_experts=4, n_layers=2), mesh)
+        assert specs["layers"]["w1e"] == P(None, "expert", "fsdp", "model")
+        assert specs["layers"]["w2e"] == P(None, "expert", "model", "fsdp")
+
+    def test_scaled_to_rounds_experts_by_expert_axis(self):
+        mesh = self._mesh()  # expert axis = 2
+        c = BurninConfig(moe_experts=3).scaled_to(mesh)
+        assert c.moe_experts % 2 == 0
+
+    def test_mesh_factorization_validated(self):
+        from tpu_dra.parallel.moe import moe_mesh
+
+        with pytest.raises(ValueError):
+            moe_mesh(jax.devices(), data=3, fsdp=1, model=2, expert=2)

@@ -27,7 +27,9 @@ slice:
 - ``tpu_dra.parallel.flash``       — pallas flash-attention kernel for the
   single-chip hot path (streamed K/V tiles, VMEM online-softmax carry).
 - ``tpu_dra.parallel.moe``         — expert parallelism: switch-routed MoE
-  MLP, experts sharded over ``model`` with XLA-inserted all-to-all.
+  MLP with XLA-inserted all-to-all; experts ride the ``model`` axis on the
+  training mesh, or their own ``expert`` axis on ``moe_mesh`` with each
+  expert's FFN additionally Megatron-sharded (ep x tp).
 - ``tpu_dra.parallel.pipeline``    — pipeline parallelism: GPipe schedule
   over a ``pipe`` mesh axis (partial-manual shard_map + scan + ppermute
   hops); composes with tp/sp/ep inside each stage — one jitted step runs

@@ -103,8 +103,9 @@ class BurninConfig:
     # shards the sequence; flash tiles it per shard).
     flash_attention: bool = False
     # Expert parallelism: > 0 replaces the dense MLP with a switch-routed
-    # MoE of this many experts, sharded over the ``model`` axis with
-    # XLA-inserted all-to-all dispatch (tpu_dra/parallel/moe.py).
+    # MoE of this many experts with XLA-inserted all-to-all dispatch
+    # (tpu_dra/parallel/moe.py).  Experts shard over the mesh's dedicated
+    # ``expert`` axis when it has one (moe_mesh: ep x tp), else ``model``.
     moe_experts: int = 0
     moe_capacity: float = 1.25
     moe_aux_weight: float = 1e-2
@@ -145,7 +146,13 @@ class BurninConfig:
         d_ff = _round_up(self.d_ff, model * fsdp)
         seq = _round_up(self.seq, model)  # sp shards seq over `model`
         vocab = _round_up(self.vocab, fsdp * model)
-        experts = _round_up(self.moe_experts, model) if self.moe_experts else 0
+        # Experts divide their own axis when the mesh has one (moe_mesh),
+        # else the model axis they ride on.
+        experts = (
+            _round_up(self.moe_experts, shape.get("expert", model))
+            if self.moe_experts
+            else 0
+        )
         layers = (
             _round_up(self.n_layers, pipe) if self.pipeline_stages else self.n_layers
         )
@@ -207,9 +214,12 @@ def init_params(config: BurninConfig, key=None):
     }
 
 
-def param_specs(config: BurninConfig):
+def param_specs(config: BurninConfig, mesh=None):
     """PartitionSpec pytree: fsdp shards the non-tp dim of every matrix,
     model (tp) shards heads / ffn-hidden / vocab-out (Megatron layout).
+    ``mesh`` (optional) selects the MoE expert axis: experts ride a
+    dedicated ``expert`` axis when the mesh has one (moe_mesh: ep x tp),
+    else the ``model`` axis.
     With ring attention, heads are replicated (context parallelism replaces
     tp inside attention) and only fsdp shards the attention matrices.
     With pipeline stages, the stacked layer dim is sharded over ``pipe``
@@ -262,7 +272,10 @@ def param_specs(config: BurninConfig):
 
         for name in ("w1", "w2"):
             matrices.pop(name, None)
-        matrices.update(moe_param_specs())
+        expert_axis = (
+            "expert" if mesh is not None and "expert" in mesh.shape else "model"
+        )
+        matrices.update(moe_param_specs(expert_axis))
     return {
         "embed": P("fsdp", "model"),
         "pos": P(None, "model"),
@@ -435,7 +448,16 @@ def forward(params, tokens, config: BurninConfig, mesh=None, *, return_aux=False
     else:
         constrain = make_constrain(mesh, ("data", "fsdp"))
 
-    x = params["embed"][tokens] + params["pos"][None, :, :]
+    # Pin the post-embedding activation layout immediately: without it the
+    # partitioner has been seen to pick a gather sharding it can only
+    # reconcile with the first block's input by full rematerialization
+    # (observed on the 4-axis moe_mesh).  Ring mode pins to the
+    # sequence-sharded layout — cp's invariant is that no chip holds the
+    # full sequence anywhere between embedding and logits.
+    x = constrain(
+        "seq" if c.ring_attention else "hidden",
+        params["embed"][tokens] + params["pos"][None, :, :],
+    )
 
     block = jax.checkpoint(
         functools.partial(
@@ -526,7 +548,7 @@ def state_shardings(config: BurninConfig, mesh):
     import jax
     from jax.sharding import NamedSharding
 
-    pspecs = param_specs(config)
+    pspecs = param_specs(config, mesh)
     one = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs)
     return (one, one)
 
@@ -553,26 +575,41 @@ def make_constrain(mesh, batch_axes):
     on the training mesh, ``"data"`` inside the pipeline's shard_map body
     (where fsdp doesn't exist and pipe is manual).  One definition so the
     pipelined and unpipelined paths cannot diverge.
+
+    Expert tensors ride the mesh's ``expert`` axis when it has one
+    (moe_mesh: ep x tp — each expert's FFN stays Megatron-sharded over
+    ``model``), else the ``model`` axis (ep replaces tp inside the MLP).
     """
     import jax
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
+    has_expert_axis = "expert" in mesh.shape
+    e_ax = "expert" if has_expert_axis else "model"
     specs = {
         # sp region: residual stream sequence-sharded over the tp axis
         "seq": P(batch_axes, "model", None),
         # tp region: full sequence, hidden ops sharded over heads/ffn
         "hidden": P(batch_axes, None, None),
-        # ep region: (E, B, C, D) expert tensors — experts over model; the
-        # boundary with the batch-sharded "hidden" layout is where XLA
-        # inserts the dispatch/return all-to-all pair.
-        "expert": P("model", batch_axes, None, None),
+        # ep region: (E, B, C, D) expert tensors; the boundary with the
+        # batch-sharded "hidden" layout is where XLA inserts the
+        # dispatch/return all-to-all pair.
+        "expert": P(e_ax, batch_axes, None, None),
+        # within-expert FFN hidden (E, B, C, F): tp over model — only
+        # meaningful on a mesh with a dedicated expert axis (elsewhere the
+        # einsum's propagation already decides, and a redundant constraint
+        # is not free: inside the pipeline's partial-manual body it trips
+        # the context-mesh axis-type check).
+        "expert_ff": (
+            P(e_ax, batch_axes, None, "model") if has_expert_axis else None
+        ),
     }
 
     def constrain(kind, arr):
-        return jax.lax.with_sharding_constraint(
-            arr, NamedSharding(mesh, specs[kind])
-        )
+        spec = specs[kind]
+        if spec is None:
+            return arr
+        return jax.lax.with_sharding_constraint(arr, NamedSharding(mesh, spec))
 
     return constrain
 

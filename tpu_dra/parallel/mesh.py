@@ -87,13 +87,16 @@ def logical_mesh(
     data: int = -1,
     fsdp: int = 1,
     model: int = 1,
+    expert: "int | None" = None,
 ):
-    """Logical training mesh with (data, fsdp, model) axes.
+    """Logical training mesh with (data, fsdp, model[, expert]) axes.
 
     One axis may be -1 (inferred).  Device order is preserved from the
-    physical slice order, so the *innermost* (model) axis lands on the
-    fastest ICI neighbors — put the highest-traffic parallelism (tensor
-    parallel psums every layer) there, per the scaling-book recipe.
+    physical slice order, so the *innermost* axis lands on the fastest ICI
+    neighbors — put the highest-traffic parallelism there, per the
+    scaling-book recipe.  ``expert`` (when given) appends a dedicated MoE
+    axis innermost: the every-layer a2a dispatch pair outranks even the tp
+    psums in traffic.
     """
     from jax.sharding import Mesh
 
@@ -101,6 +104,8 @@ def logical_mesh(
         devices = _default_devices()
     n = len(devices)
     sizes = {"data": data, "fsdp": fsdp, "model": model}
+    if expert is not None:
+        sizes["expert"] = expert
     for name, v in sizes.items():
         if v != -1 and v < 1:
             raise ValueError(f"axis {name!r} size must be -1 (inferred) or >= 1, got {v}")
@@ -117,7 +122,5 @@ def logical_mesh(
         sizes[unknown[0]] = n // known
     elif known != n:
         raise ValueError(f"mesh {sizes} needs {known} devices, have {n}")
-    arr = np.array(devices, dtype=object).reshape(
-        sizes["data"], sizes["fsdp"], sizes["model"]
-    )
-    return Mesh(arr, ("data", "fsdp", "model"))
+    arr = np.array(devices, dtype=object).reshape(*sizes.values())
+    return Mesh(arr, tuple(sizes))

@@ -4,8 +4,11 @@ The reference driver has no parallelism vocabulary of its own (SURVEY.md §2
 disclosure) — the TPU framework's job is to prove the allocated slice works
 under *every* sharding a real training job uses.  dp/fsdp/tp/sp and cp (ring)
 are covered by tpu_dra/parallel/burnin.py and ring.py; this module adds the
-last member, **ep**: experts sharded over the mesh's ``model`` axis, tokens
-routed to them through all-to-all collectives.
+last member, **ep**: tokens routed to sharded experts through all-to-all
+collectives.  Two layouts: on the 3-axis training mesh experts ride the
+``model`` axis (ep replaces tp inside the MLP); on :func:`moe_mesh` experts
+get their own ``expert`` axis and each expert's FFN is additionally
+Megatron-sharded over ``model`` (ep x tp).
 
 Design: GShard-style *dense* dispatch (one-hot dispatch/combine einsums)
 rather than ragged gather/scatter —
@@ -29,7 +32,21 @@ path is exercised too.
 
 from __future__ import annotations
 
-__all__ = ["init_moe_layer_params", "moe_param_specs", "moe_mlp"]
+__all__ = ["init_moe_layer_params", "moe_param_specs", "moe_mlp", "moe_mesh"]
+
+
+def moe_mesh(devices, *, data: int = -1, fsdp: int = 1, model: int = 1, expert: int = 1):
+    """A (data, fsdp, model, expert) mesh: experts on their OWN axis so ep
+    composes with tp — each expert's FFN is Megatron-sharded over ``model``
+    while tokens all-to-all over ``expert`` (the scaling-book MoE layout).
+    ``expert`` innermost: the densest collective (the a2a pair every MoE
+    layer) rides nearest ICI neighbors; the per-expert tp psums ride the
+    next ring out.  Size inference/validation is logical_mesh's."""
+    from tpu_dra.parallel.mesh import logical_mesh
+
+    return logical_mesh(
+        devices, data=data, fsdp=fsdp, model=model, expert=expert
+    )
 
 
 def init_moe_layer_params(config, key):
@@ -54,12 +71,21 @@ def init_moe_layer_params(config, key):
     }
 
 
-def moe_param_specs():
-    """PartitionSpecs for the MoE leaves: experts sharded over ``model``
-    (that *is* expert parallelism), fsdp sharding the within-expert dim the
-    same way the dense MLP shards its matrices."""
+def moe_param_specs(expert_axis: str = "model"):
+    """PartitionSpecs for the MoE leaves.
+
+    ``expert_axis="model"`` (3-axis training mesh): experts ride the tp
+    axis — ep replaces tp inside the MLP.  ``expert_axis="expert"``
+    (moe_mesh): experts get their own axis and each expert's FFN is
+    additionally Megatron-sharded over ``model`` — ep x tp."""
     from jax.sharding import PartitionSpec as P
 
+    if expert_axis == "expert":
+        return {
+            "router": P(None, "fsdp", None),
+            "w1e": P(None, "expert", "fsdp", "model"),
+            "w2e": P(None, "expert", "model", "fsdp"),
+        }
     return {
         "router": P(None, "fsdp", None),
         "w1e": P(None, "model", "fsdp", None),
@@ -115,9 +141,12 @@ def moe_mlp(layer, h, config, constrain):
     # --- dispatch -> expert compute -> combine (XLA inserts the a2a pair
     # at the batch-sharded <-> expert-sharded boundary) ---
     expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(bf16), h)
-    expert_in = constrain("expert", expert_in)  # (E, B, C, D) E over model
+    expert_in = constrain("expert", expert_in)  # (E, B, C, D) ep-sharded
     h1 = jnp.einsum("ebcd,edf->ebcf", expert_in, layer["w1e"].astype(bf16))
     h1 = jnp.where(h1 > 0, h1, 0.01 * h1)  # leaky relu, as the dense MLP
+    # On a moe_mesh this pins F over model: the w2e contraction then runs
+    # column-parallel per expert and XLA psums the partials (ep x tp).
+    h1 = constrain("expert_ff", h1)
     out_e = jnp.einsum("ebcf,efd->ebcd", h1, layer["w2e"].astype(bf16))
     out_e = constrain("expert", out_e)
     out = jnp.einsum("bsec,ebcd->bsd", combine.astype(bf16), out_e)
