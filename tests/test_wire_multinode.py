@@ -1,0 +1,240 @@
+"""Multi-node wire rung: the real controller binary schedules across TWO
+real plugin binaries, all over the HTTP apiserver shim + REST client.
+
+The single-node wire tests (test_cmds.py, test_wire_chaos.py) prove each
+binary's wire behavior; this proves the cross-node story on the wire — the
+controller's UnsuitableNodes fan-out (informer-served) sees both NAS
+objects, claims land on both nodes, each node's kubelet socket prepares its
+own claims, and watch-driven GC unprepares per node.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from tpu_dra.api.k8s import (
+    Node,
+    Pod,
+    PodResourceClaim,
+    PodResourceClaimSource,
+    PodSchedulingContext,
+    PodSchedulingContextSpec,
+    PodSpec,
+    ResourceClaim,
+    ResourceClaimParametersReference,
+    ResourceClaimSpec,
+    ResourceClass,
+)
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.tpu_v1alpha1 import (
+    GROUP_NAME,
+    TpuClaimParameters,
+    TpuClaimParametersSpec,
+)
+from tpu_dra.client.clientset import ClientSet
+from tpu_dra.client.restserver import ClusterConfig, RestApiServer
+from tpu_dra.cmds import controller as controller_cmd
+from tpu_dra.cmds import plugin as plugin_cmd
+from tpu_dra.plugin.kubeletplugin import DRAClient
+from tpu_dra.sim.httpapiserver import HttpApiServer
+
+NS = "tpu-dra"
+WORK_NS = "default"
+NODES = ("wn-0", "wn-1")
+
+
+def _wait(pred, timeout=20.0, poll=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return False
+
+
+@pytest.fixture
+def rig(tmp_path):
+    shim = HttpApiServer().start()
+    clients = ClientSet(
+        RestApiServer(ClusterConfig(server=shim.url), qps=1000, burst=1000)
+    )
+    papps = []
+    capp = None
+    try:
+        clients.resource_classes().create(
+            ResourceClass(
+                metadata=ObjectMeta(name="tpu.google.com"), driver_name=GROUP_NAME
+            )
+        )
+        clients.tpu_claim_parameters(WORK_NS).create(
+            TpuClaimParameters(
+                metadata=ObjectMeta(name="two-chips", namespace=WORK_NS),
+                spec=TpuClaimParametersSpec(count=2),
+            )
+        )
+        socks = {}
+        for node in NODES:
+            clients.nodes().create(Node(metadata=ObjectMeta(name=node)))
+            root = tmp_path / node
+            app = plugin_cmd.PluginApp(
+                plugin_cmd.parse_args(
+                    [
+                        "--node-name", node,
+                        "--namespace", NS,
+                        "--apiserver", shim.url,
+                        "--mock-tpulib-mesh", "2x1x1",  # 2 chips per node
+                        "--cdi-root", str(root / "cdi"),
+                        "--plugin-root", str(root / "plugins"),
+                        "--registrar-root", str(root / "registry"),
+                        "--state-dir", str(root / "state"),
+                        "--http-endpoint", "127.0.0.1:0",
+                    ]
+                )
+            )
+            app.start()
+            papps.append(app)
+            socks[node] = os.path.join(
+                str(root / "plugins"), app.driver_name, "plugin.sock"
+            )
+        capp = controller_cmd.ControllerApp(
+            controller_cmd.parse_args(
+                [
+                    "--apiserver", shim.url,
+                    "--namespace", NS,
+                    "--workers", "2",
+                    "--kube-apiserver-qps", "1000",
+                    "--kube-apiserver-burst", "1000",
+                ]
+            )
+        )
+        capp.start()
+        yield clients, socks
+    finally:
+        try:
+            if capp is not None:
+                capp.stop()
+        finally:
+            for app in papps:
+                try:
+                    app.stop()
+                except Exception:
+                    pass
+            shim.stop()
+
+
+def test_claims_spread_across_both_wire_nodes(rig):
+    """Two 2-chip claims: each node holds 2 chips, so the claims MUST land
+    on different nodes — the fan-out's unsuitable reporting over the wire
+    is what steers the second claim away from the full node."""
+    clients, socks = rig
+    uids = {}
+    for i, node in enumerate(NODES):
+        name = f"mw-{i}"
+        created = clients.resource_claims(WORK_NS).create(
+            ResourceClaim(
+                metadata=ObjectMeta(name=name, namespace=WORK_NS),
+                spec=ResourceClaimSpec(
+                    resource_class_name="tpu.google.com",
+                    parameters_ref=ResourceClaimParametersReference(
+                        api_group=GROUP_NAME,
+                        kind="TpuClaimParameters",
+                        name="two-chips",
+                    ),
+                ),
+            )
+        )
+        uids[name] = created.metadata.uid
+        clients.pods(WORK_NS).create(
+            Pod(
+                metadata=ObjectMeta(name=name, namespace=WORK_NS),
+                spec=PodSpec(
+                    resource_claims=[
+                        PodResourceClaim(
+                            name="tpu",
+                            source=PodResourceClaimSource(resource_claim_name=name),
+                        )
+                    ]
+                ),
+            )
+        )
+        # The bench/scheduler role: offer BOTH nodes; the controller's
+        # fan-out must mark the full one unsuitable before selection.
+        clients.pod_scheduling_contexts(WORK_NS).create(
+            PodSchedulingContext(
+                metadata=ObjectMeta(name=name, namespace=WORK_NS),
+                spec=PodSchedulingContextSpec(potential_nodes=list(NODES)),
+            )
+        )
+
+        # The scheduler role, as kube-scheduler plays it: select a node
+        # outside the published unsuitable set; when the controller later
+        # reports the selected node unsuitable (the negotiation's whole
+        # point), DESELECT and pick again.
+        def negotiate(n=name, timeout=30.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if (
+                    clients.resource_claims(WORK_NS).get(n).status.allocation
+                    is not None
+                ):
+                    return True
+                sc = clients.pod_scheduling_contexts(WORK_NS).get(n)
+                unsuitable = set()
+                for rc in sc.status.resource_claims if sc.status else []:
+                    unsuitable.update(rc.unsuitable_nodes)
+                candidates = [x for x in NODES if x not in unsuitable]
+                from tpu_dra.client.apiserver import ConflictError
+
+                try:
+                    if sc.spec.selected_node in unsuitable:
+                        sc.spec.selected_node = ""
+                        clients.pod_scheduling_contexts(WORK_NS).update(sc)
+                    elif not sc.spec.selected_node and candidates:
+                        sc.spec.selected_node = candidates[0]
+                        clients.pod_scheduling_contexts(WORK_NS).update(sc)
+                except ConflictError:
+                    pass  # RV conflict with the controller: re-read and retry
+                time.sleep(0.05)
+            return False
+
+        assert negotiate(), f"claim {name} not allocated"
+
+    # The two claims landed on different nodes (each node only fits one).
+    nases = {
+        node: clients.node_allocation_states(NS).get(node) for node in NODES
+    }
+    held = {
+        node: set(nas.spec.allocated_claims) for node, nas in nases.items()
+    }
+    assert all(len(h) == 1 for h in held.values()), held
+    assert held[NODES[0]] != held[NODES[1]]
+
+    # Each node's kubelet socket prepares ITS claim.
+    for node in NODES:
+        claim_uid = next(iter(held[node]))
+        name = next(n for n, u in uids.items() if u == claim_uid)
+        devices = DRAClient(socks[node]).node_prepare_resource(
+            WORK_NS, claim_uid, claim_name=name
+        )
+        assert devices and "claim" in devices[0]
+
+    # Teardown: delete everything; both plugins' watch-GC unprepare.
+    for i, name in enumerate(uids):
+        clients.pods(WORK_NS).delete(name)
+        clients.pod_scheduling_contexts(WORK_NS).delete(name)
+        fresh = clients.resource_claims(WORK_NS).get(name)
+        if fresh.status.reserved_for:
+            fresh.status.reserved_for = []
+            clients.resource_claims(WORK_NS).update_status(fresh)
+        clients.resource_claims(WORK_NS).delete(name)
+    for node in NODES:
+        assert _wait(
+            lambda n=node: not clients.node_allocation_states(NS)
+            .get(n)
+            .spec.allocated_claims
+            and not clients.node_allocation_states(NS).get(n).spec.prepared_claims,
+            timeout=25.0,
+        ), f"teardown did not settle on {node}"
