@@ -58,3 +58,15 @@ def test_validate_cli_family_flag(capsys):
     rc = main(["4x4", "--family", "dense"])
     out = json.loads(capsys.readouterr().out.strip())
     assert rc == 1 and "not supported" in out["error"]
+
+
+def test_moe_family_uses_expert_axis_when_possible():
+    import jax
+
+    from tpu_dra.models import family_mesh
+
+    mesh = family_mesh("moe", jax.devices())  # 8 devices: ep x tp
+    assert "expert" in mesh.shape and mesh.shape["expert"] == 2
+    # Indivisible count falls back to the 3-axis training mesh.
+    mesh3 = family_mesh("moe", jax.devices()[:2])
+    assert "expert" not in mesh3.shape

@@ -65,7 +65,9 @@ def family_config(name: str, **overrides) -> BurninConfig:
 
 def family_mesh(name: str, devices, *, stages: "int | None" = None):
     """The mesh flavor the family shards over: (data, pipe, model) for the
-    pipelined family, (data, fsdp, model) for everything else.
+    pipelined family, (data, fsdp, model, expert) for moe when the device
+    count factors (ep x tp — experts on their own axis, Megatron tp inside
+    each expert), (data, fsdp, model) otherwise.
 
     ``stages``: explicit pipeline depth; defaults to 2.  An impossible
     factorization raises ValueError (pipeline_mesh validates)."""
@@ -76,6 +78,10 @@ def family_mesh(name: str, devices, *, stages: "int | None" = None):
         stages = stages or 2
         model = 2 if n % (stages * 2) == 0 and n >= stages * 2 else 1
         return pipeline_mesh(devices, stages=stages, model=model)
+    if name == "moe" and len(devices) % 4 == 0:
+        from tpu_dra.parallel.moe import moe_mesh
+
+        return moe_mesh(devices, model=2, expert=2)
     return burnin_mesh(devices)
 
 
