@@ -17,6 +17,13 @@ def test_family_trains(name):
     assert r.loss_last < r.loss_first
 
 
+def test_long_context_moe_reports_on_indivisible_slice():
+    import jax
+
+    r = train_family("long_context_moe", devices=jax.devices()[:2], steps=2)
+    assert not r.ok and r.error  # moe_mesh factorization refused, reported
+
+
 def test_unknown_family_rejected():
     with pytest.raises(ValueError, match="unknown model family"):
         family_config("bogus")

@@ -47,6 +47,9 @@ FAMILIES: "dict[str, Callable[..., BurninConfig]]" = {
     "dense": _preset({}),
     "long_context": _preset({"ring_attention": True}),
     "moe": _preset({"moe_experts": 4}),
+    # cp x ep (x tp): ring attention + routed experts — needs the 4-axis
+    # moe_mesh (family_mesh refuses indivisible device counts).
+    "long_context_moe": _preset({"ring_attention": True, "moe_experts": 4}),
     "flash": _preset({"flash_attention": True}),
     "pipelined": _preset({"pipeline_stages": 2, "moe_experts": 2}),
 }
@@ -78,7 +81,10 @@ def family_mesh(name: str, devices, *, stages: "int | None" = None):
         stages = stages or 2
         model = 2 if n % (stages * 2) == 0 and n >= stages * 2 else 1
         return pipeline_mesh(devices, stages=stages, model=model)
-    if name == "moe" and len(devices) % 4 == 0:
+    # moe prefers the 4-axis layout when the count factors; for
+    # long_context_moe it is mandatory (the ring owns the model axis, so
+    # experts need their own — moe_mesh raises on indivisible counts).
+    if name == "long_context_moe" or (name == "moe" and len(devices) % 4 == 0):
         from tpu_dra.parallel.moe import moe_mesh
 
         return moe_mesh(devices, model=2, expert=2)

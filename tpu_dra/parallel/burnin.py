@@ -276,9 +276,15 @@ def param_specs(config: BurninConfig, mesh=None):
             "expert" if mesh is not None and "expert" in mesh.shape else "model"
         )
         matrices.update(moe_param_specs(expert_axis))
+    # In cp mode the model axis carries the SEQUENCE: sharding d_model over
+    # it in the embedding would make every lookup produce a layout the
+    # partitioner can only reconcile with the sequence-sharded stream by
+    # full rematerialization (observed); fsdp alone shards the table there.
+    embed = P("fsdp", None) if config.ring_attention else P("fsdp", "model")
+    pos = P(None, None) if config.ring_attention else P(None, "model")
     return {
-        "embed": P("fsdp", "model"),
-        "pos": P(None, "model"),
+        "embed": embed,
+        "pos": pos,
         "layers": {
             **matrices,
             "ln1": P(None, None),
@@ -377,10 +383,25 @@ def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
         # materialize the full sequence on one chip; d_ff is replicated
         # over the model axis here (fsdp still shards the weights).
         h = _rms_norm(constrain("seq", x), layer["ln2"]).astype(bf16)
-        h = jnp.einsum("bsd,df->bsf", h, layer["w1"].astype(bf16))
-        h = jnp.where(h > 0, h, 0.01 * h)
-        h = jnp.einsum("bsf,fd->bsd", h, layer["w2"].astype(bf16))
-        x = x + constrain("seq", h)
+        if c.moe_experts > 0:
+            # Long-context MoE (cp x ep — needs the dedicated expert axis,
+            # enforced in forward()).  Scope: attention stays O((s/P)^2)
+            # per chip (the long-context bottleneck), but the switch
+            # routing is GLOBAL — its capacity cumsum crosses shards, so
+            # the partitioner materializes O(B*s*d_model) activations per
+            # chip at the dispatch (verified in the compiled HLO).  Fine
+            # for long-but-not-extreme sequences; per-shard local routing
+            # (shard_map over model with local capacity) is the known
+            # upgrade path beyond that.
+            from tpu_dra.parallel.moe import moe_mlp
+
+            h, aux = moe_mlp(layer, h, c, constrain)
+            x = x + constrain("seq", h)
+        else:
+            h = jnp.einsum("bsd,df->bsf", h, layer["w1"].astype(bf16))
+            h = jnp.where(h > 0, h, 0.01 * h)
+            h = jnp.einsum("bsf,fd->bsd", h, layer["w2"].astype(bf16))
+            x = x + constrain("seq", h)
     elif c.moe_experts > 0:
         # --- mlp (ep: switch-routed experts over the model axis) ---
         from tpu_dra.parallel.moe import moe_mlp
@@ -414,11 +435,15 @@ def forward(params, tokens, config: BurninConfig, mesh=None, *, return_aux=False
             "(the ring shards the sequence over the model axis; flash "
             "tiles the full sequence per tp shard)"
         )
-    if c.ring_attention and c.moe_experts > 0:
+    if (
+        c.ring_attention
+        and c.moe_experts > 0
+        and (mesh is None or "expert" not in mesh.shape)
+    ):
         raise ValueError(
-            "ring_attention and moe_experts are mutually exclusive (the "
-            "ring shards the sequence over the model axis; MoE shards "
-            "experts over it)"
+            "ring_attention + moe_experts needs a mesh with a dedicated "
+            "expert axis (tpu_dra.parallel.moe.moe_mesh): the ring shards "
+            "the sequence over the model axis, so experts cannot ride it"
         )
     if c.pipeline_stages > 0:
         if c.ring_attention or c.flash_attention:
