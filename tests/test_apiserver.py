@@ -343,6 +343,33 @@ class TestParseCache:
         assert client.get(out[0].metadata.name).spec.worker_id != 99
 
 
+class TestTryDumpsGuard:
+    """_try_dumps must refuse (return None → deepcopy fallback) any object
+    json.dumps would silently corrupt instead of raising on: int/float/bool
+    dict keys coerce to strings, tuples to lists (ADVICE r4 #3)."""
+
+    def test_non_str_keys_fall_back(self):
+        from tpu_dra.client.apiserver import _try_dumps
+
+        assert _try_dumps({"spec": {1: "a"}}) is None
+        assert _try_dumps({"spec": {True: "a"}}) is None
+        assert _try_dumps({"spec": [{"deep": {2.5: "x"}}]}) is None
+
+    def test_tuples_fall_back(self):
+        from tpu_dra.client.apiserver import _try_dumps
+
+        assert _try_dumps({"spec": {"coords": (1, 2, 3)}}) is None
+
+    def test_json_shaped_round_trips(self):
+        import json
+
+        from tpu_dra.client.apiserver import _try_dumps
+
+        obj = {"spec": {"a": [1, 2, {"b": None, "c": True}]}, "n": 1.5}
+        dumped = _try_dumps(obj)
+        assert dumped is not None and json.loads(dumped) == obj
+
+
 class TestEventLog:
     """events_since: rv-pinned replay incl. DELETED (the list->watch gap)."""
 

@@ -143,6 +143,37 @@ class TestAdvisorRegressions:
             == "n2:8476"
         )
 
+    def test_repair_fires_on_write_fence_callback(self, cs):
+        """Repair's NAS commits must advance the controller's informer
+        read-your-writes fence like every other controller-side NAS write
+        (ADVICE r4 #1): on_write fires once per repaired node with the
+        post-commit NAS (fresh resourceVersion)."""
+        tracker = GangTracker(cs, NS)
+        gang = GangConfig(name="g", size=2)
+        a0 = tracker.assign(gang, "default", "uid-a", "n0")
+        a1 = tracker.assign(gang, "default", "uid-b", "n1")
+        commit_to_nas(cs, "n1", "uid-b", a1)
+        tracker.commit("uid-b")
+        tracker.release("uid-a")
+        a0b = tracker.assign(gang, "default", "uid-c", "n2")
+        commit_to_nas(cs, "n2", "uid-c", a0b)
+        tracker.commit("uid-c")
+        writes = []
+        assert (
+            tracker.repair_coordinators(
+                "default", "g",
+                on_write=lambda node, nas: writes.append(
+                    (node, nas.metadata.resource_version)
+                ),
+            )
+            == 1
+        )
+        assert [w[0] for w in writes] == ["n1"]
+        # The callback sees the committed write's RV (the fence input).
+        assert writes[0][1] == cs.node_allocation_states(NS).get(
+            "n1"
+        ).metadata.resource_version
+
     def test_repair_uses_published_node_address(self, cs):
         # The coordinator must be a resolvable address when the plugin
         # publishes one, not a bare node name (VERDICT weak #4).

@@ -353,6 +353,51 @@ class TestPromoteGuard:
         )
         assert parent_ca.claim.metadata.uid in fresh.spec.allocated_claims
 
+    def test_affinity_parent_pick_expired_rejects_promote(self):
+        """An EXPIRED whole-chip parent pick must not vouch for the carve:
+        without TTL-aware exists() the promote guard would commit a
+        subslice whose affinity parent can never promote (ADVICE r4 #2).
+        The parent's own promote fails symmetrically (retryable
+        "no allocations generated yet"), so the pair re-negotiates instead
+        of half-committing."""
+        tpu_driver = TpuDriver()
+        # TTL=0: every parent pick is expired the instant it is stamped.
+        tpu_driver.pending_allocated_claims._ttl_s = 0.0
+        driver = SubsliceDriver(
+            parent_pending=tpu_driver.pending_allocated_claims
+        )
+        nas = make_nas(partitionable=True)
+        pod = make_pod()
+        from tpu_dra.api.tpu_v1alpha1 import make_property_selector
+
+        parent_ca = make_ca(
+            TpuClaimParametersSpec(
+                count=1, selector=make_property_selector(partitionable=True)
+            ),
+            name="parent",
+        )
+        sub_ca = make_ca(
+            SubsliceClaimParametersSpec(
+                profile="1c.4gb", tpu_claim_name="parent"
+            ),
+            name="claim-b",
+        )
+        tpu_driver.unsuitable_node(nas, pod, [parent_ca], [parent_ca, sub_ca], NODE)
+        driver.unsuitable_node(nas, pod, [sub_ca], [parent_ca, sub_ca], NODE)
+        assert sub_ca.unsuitable_nodes == []
+
+        # The parent pick has expired (ttl 0) and was never visited; the
+        # subslice promote must refuse rather than dangle.
+        fresh = make_nas(partitionable=True)
+        with pytest.raises(RuntimeError, match="no longer holds"):
+            driver.allocate(fresh, sub_ca.claim, sub_ca.claim_parameters, None, NODE)
+        # And the expired parent cannot half-commit either: its own gate
+        # reads the expired pick as absent (retryable, re-negotiates).
+        with pytest.raises(RuntimeError, match="no allocations generated"):
+            tpu_driver.allocate(
+                fresh, parent_ca.claim, parent_ca.claim_parameters, None, NODE
+            )
+
     def test_affinity_parent_gone_at_promote_conflicts(self):
         # The pick resolved to a whole-chip parent claim; if that claim no
         # longer holds the chip at promote time (deallocated, or a stranger

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# TPU tunnel-recovery probe (VERDICT r4 next-step #1).
+#
+# The axon PJRT tunnel to the single real chip goes down for hours at a
+# time, and a wedged backend init blocks in C++ and ignores SIGTERM; only
+# a killable child under `timeout -k` keeps a probe loop alive.  This
+# script probes until the chip answers once, records the result, and
+# exits so the chip is free for the real measurement (two TPU-touching
+# processes serialize on backend init — never overlap them).
+#
+# Status-file grammar (first word): UP | DOWN | BROKEN.  BROKEN means the
+# probe itself cannot run (python/jax missing — fast non-timeout failure),
+# not that the tunnel is down; the loop aborts rather than spinning with
+# a misleading DOWN.
+#
+# Usage: tools/tpu_probe.sh [status-file] [probe-timeout-s] [sleep-s]
+set -u
+STATUS="${1:-/root/repo/.tpu_probe_status}"
+PROBE_TIMEOUT="${2:-120}"
+SLEEP_S="${3:-45}"
+attempt=0
+echo "DOWN attempts=0 $(date -u +%FT%TZ)" > "$STATUS"
+while true; do
+  attempt=$((attempt + 1))
+  start=$SECONDS
+  out=$(timeout -k 10 "$PROBE_TIMEOUT" python -u -c \
+    'import jax; d=jax.devices(); print("DEVS:", [str(x) for x in d])' 2>&1)
+  rc=$?
+  elapsed=$((SECONDS - start))
+  if [ $rc -eq 0 ] && printf '%s' "$out" | grep -qi 'DEVS:.*\(tpu\|Tpu\|TPU\)'; then
+    echo "UP attempts=$attempt $(date -u +%FT%TZ) $out" > "$STATUS"
+    echo "TPU UP after $attempt attempts: $out"
+    exit 0
+  fi
+  # rc=0 but no TPU devices (e.g. CPU-only jax): tunnel down, keep trying.
+  # rc=124/137: probe child timed out / was SIGKILLed — the wedge signature.
+  # Anything else that failed FAST is the probe's own environment broken
+  # (python missing → 127, jax ImportError → 1 within seconds): abort loudly
+  # instead of reporting DOWN forever.
+  if [ $rc -ne 0 ] && [ $rc -ne 124 ] && [ $rc -ne 137 ] && [ "$elapsed" -lt 15 ]; then
+    echo "BROKEN attempts=$attempt rc=$rc $(date -u +%FT%TZ) $out" > "$STATUS"
+    echo "probe itself failed (rc=$rc in ${elapsed}s): $out"
+    exit 2
+  fi
+  echo "DOWN attempts=$attempt rc=$rc elapsed=${elapsed}s $(date -u +%FT%TZ) ${out:0:200}" > "$STATUS"
+  sleep "$SLEEP_S"
+done

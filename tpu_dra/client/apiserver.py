@@ -91,12 +91,31 @@ def _try_dumps(obj: dict) -> "str | None":
     Contract: stored objects are JSON-shaped (string keys, list/dict/
     primitive values) — both supported write paths guarantee it (the typed
     clientset serializes through serde.to_dict; the wire shim decodes HTTP
-    JSON).  Values json can encode but not round-trip (int dict keys,
-    tuples) would come back coerced; no driver object contains them."""
+    JSON).  json.dumps does NOT enforce all of that: it silently coerces
+    int/float/bool dict keys to strings (and tuples to lists) instead of
+    raising, which would corrupt such objects on every cached read rather
+    than falling back.  Guard the known gap explicitly so a future
+    non-string-keyed field degrades to deepcopy instead."""
     try:
-        return json.dumps(obj, separators=(",", ":"))
-    except (TypeError, ValueError):
+        if not _str_keyed(obj):
+            return None
+        return json.dumps(obj, separators=(",", ":"), allow_nan=False)
+    except (TypeError, ValueError, RecursionError):
+        # RecursionError: a circular object; deepcopy's memo handles
+        # cycles, json.dumps (and the key scan) cannot.
         return None
+
+
+def _str_keyed(value) -> bool:
+    """True when every dict key reachable from ``value`` is a str and no
+    tuple appears (both round-trip lossily through json.dumps)."""
+    if isinstance(value, dict):
+        return all(
+            isinstance(k, str) and _str_keyed(v) for k, v in value.items()
+        )
+    if isinstance(value, (list,)):
+        return all(_str_keyed(v) for v in value)
+    return not isinstance(value, tuple)
 
 
 class Watch:

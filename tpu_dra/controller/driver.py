@@ -155,7 +155,8 @@ class ControllerDriver:
                 # a since-converged gang with a dead coordinator.
                 try:
                     repaired = self.gangs.repair_coordinators(
-                        ns, name, node_lock=self.lock
+                        ns, name, node_lock=self.lock,
+                        on_write=self._note_node_write,
                     )
                     logger.info(
                         "gang %s/%s: repaired %d member(s)", ns, name, repaired
@@ -416,7 +417,8 @@ class ControllerDriver:
             # level-triggered.
             try:
                 self.gangs.repair_coordinators(
-                    claim.metadata.namespace, gang_name, node_lock=self.lock
+                    claim.metadata.namespace, gang_name, node_lock=self.lock,
+                    on_write=self._note_node_write,
                 )
             except Exception:
                 import logging
@@ -497,7 +499,8 @@ class ControllerDriver:
             # — deallocation already committed.
             try:
                 self.gangs.repair_coordinators(
-                    gang[0], gang[1], node_lock=self.lock
+                    gang[0], gang[1], node_lock=self.lock,
+                    on_write=self._note_node_write,
                 )
             except Exception:
                 import logging

@@ -288,7 +288,8 @@ class GangTracker:
     # -- post-commit reconciliation ------------------------------------------
 
     def repair_coordinators(
-        self, claim_namespace: str, gang_name: str, node_lock=None
+        self, claim_namespace: str, gang_name: str, node_lock=None,
+        on_write=None,
     ) -> int:
         """Rewrite committed members whose coordinator disagrees with the
         committed rank-0's address (rank-0 reallocation onto another node,
@@ -297,7 +298,14 @@ class GangTracker:
 
         ``node_lock``: optional ``PerNodeMutex`` — when given, each node's
         NAS rewrite happens under that node's lock (the controller's NAS
-        serialization convention)."""
+        serialization convention).
+
+        ``on_write``: optional ``callback(node, nas)`` invoked after each
+        committed NAS update.  The controller passes its
+        ``_note_node_write`` so repair writes advance the informer
+        read-your-writes fence like every other controller-side NAS
+        mutation — without it, an informer-served read could trail this
+        controller's own repair commit."""
         from tpu_dra.client.nasclient import NasClient
         from tpu_dra.api.meta import ObjectMeta
 
@@ -346,6 +354,8 @@ class GangTracker:
                         changed += 1
                 if changed:
                     client.update(nas.spec)
+                    if on_write is not None:
+                        on_write(node, nas)
                 repaired += changed
 
             if node_lock is not None:

@@ -46,8 +46,29 @@ class PerNodeAllocatedClaims:
     def _bump(self, node: str) -> None:
         self._versions[node] = self._versions.get(node, 0) + 1
 
+    def _collect_expired_locked(self) -> None:
+        """Drop every entry past its TTL (caller holds the lock)."""
+        now = time.monotonic()
+        expired = [
+            uid
+            for uid, stamp in self._stamped.items()
+            if now - stamp > self._ttl_s
+        ]
+        for uid in expired:
+            for touched in self._allocations.pop(uid, {}):
+                self._bump(touched)
+            self._stamped.pop(uid, None)
+
     def exists(self, claim_uid: str, node: str) -> bool:
+        """TTL-aware: an expired pick is collected here and reads as
+        absent.  Every consumer needs this uniformly — the allocators'
+        own promote gates so an expired pick fails with the retryable
+        "no allocations generated yet" (a fresh scheduling pass re-picks),
+        and the subslice parent-affinity vouch so a carve is never
+        committed on the word of a parent pick that will itself never
+        promote (ADVICE r4 #2)."""
         with self._lock:
+            self._collect_expired_locked()
             return node in self._allocations.get(claim_uid, {})
 
     def get(self, claim_uid: str, node: str) -> AllocatedDevices:
@@ -67,16 +88,7 @@ class PerNodeAllocatedClaims:
         self, node: str, visitor: Callable[[str, AllocatedDevices], None]
     ) -> None:
         with self._lock:
-            now = time.monotonic()
-            expired = [
-                uid
-                for uid, stamp in self._stamped.items()
-                if now - stamp > self._ttl_s
-            ]
-            for uid in expired:
-                for touched in self._allocations.pop(uid, {}):
-                    self._bump(touched)
-                self._stamped.pop(uid, None)
+            self._collect_expired_locked()
             snapshot = [
                 (uid, serde.deepcopy(nodes[node]))
                 for uid, nodes in self._allocations.items()

@@ -112,15 +112,20 @@ class SubsliceDriver:
             for d in alloc.core.devices
         ]
         pend_parent = pending.subslice.parent_claim_uid if pending.subslice else ""
+        # exists() is TTL-aware: an expired parent pick reads as absent, so
+        # it cannot vouch for a promotion it can itself never make (its own
+        # promote gate fails the same way).  Loop-invariant — evaluated
+        # once, not per device (each call locks + sweeps the cache).
+        parent_pick_live = bool(
+            pend_parent
+            and self._parent_pending is not None
+            and self._parent_pending.exists(pend_parent, selected_node)
+        )
         conflicts = []
         for dev in pending.subslice.devices if pending.subslice else []:
             holder_uid = whole_by_chip.get(dev.parent_uuid)
             if pend_parent:
-                parent_still_pending = (
-                    holder_uid is None
-                    and self._parent_pending is not None
-                    and self._parent_pending.exists(pend_parent, selected_node)
-                )
+                parent_still_pending = holder_uid is None and parent_pick_live
                 if holder_uid != pend_parent and not parent_still_pending:
                     # Parent deallocated, or a stranger took the chip.  (A
                     # parent that simply hasn't promoted yet — later in the
