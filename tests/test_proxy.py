@@ -468,7 +468,9 @@ class TestRuntimeProxyE2E:
                 [("tpu", {"resource_claim_name": "shared-claim"})],
             )
             cluster.clientset.pods(NS).create(pod)
-            cluster.wait_for_pod_running(NS, "consumer-1", timeout=30.0)
+            cluster.wait_for_pod_running(
+                NS, "consumer-1", timeout=cluster.proxy_ready_timeout()
+            )
 
             claim = cluster.clientset.resource_claims(NS).get("shared-claim")
             node = cluster.nodes[0]

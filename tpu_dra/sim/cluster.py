@@ -192,5 +192,14 @@ class SimCluster:
     def wait_for_pod_running(self, namespace: str, name: str, timeout: float = 10.0):
         return self.kubesim.wait_for_pod_running(namespace, name, timeout)
 
+    def proxy_ready_timeout(self, margin_s: float = 60.0) -> float:
+        """Pod-wait budget for RuntimeProxy-shared claims: a margin ABOVE
+        the plugins' own adaptive readiness deadline, so the caller's wait
+        is never the first timer to expire on a loaded box."""
+        return (
+            max(n.state._proxy_manager.ready_deadline_s() for n in self.nodes)
+            + margin_s
+        )
+
     def delete_pod(self, namespace: str, name: str) -> None:
         self.kubesim.delete_pod(namespace, name)
