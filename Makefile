@@ -40,7 +40,7 @@ bench:
 	$(PYTHON) bench.py
 
 image:
-	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile .
+	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
 clean:
 	$(MAKE) -C native clean

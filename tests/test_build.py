@@ -73,16 +73,21 @@ class TestCiAndImageReferences:
                         assert os.path.exists(os.path.join(REPO_ROOT, token)), token
 
     def test_dockerfile_copies_real_paths(self):
-        with open(
-            os.path.join(REPO_ROOT, "deployments", "container", "Dockerfile")
-        ) as f:
-            for line in f:
-                if line.startswith("COPY ") and "--from" not in line:
-                    sources = line.split()[1:-1]
-                    for source in sources:
-                        assert os.path.exists(
-                            os.path.join(REPO_ROOT, source)
-                        ), f"Dockerfile COPY source missing: {source}"
+        # Every distro variant (reference ships ubuntu + ubi images).
+        container_dir = os.path.join(REPO_ROOT, "deployments", "container")
+        dockerfiles = [
+            n for n in os.listdir(container_dir) if n.startswith("Dockerfile")
+        ]
+        assert {"Dockerfile.ubuntu", "Dockerfile.ubi9"} <= set(dockerfiles)
+        for name in dockerfiles:
+            with open(os.path.join(container_dir, name)) as f:
+                for line in f:
+                    if line.startswith("COPY ") and "--from" not in line:
+                        sources = line.split()[1:-1]
+                        for source in sources:
+                            assert os.path.exists(
+                                os.path.join(REPO_ROOT, source)
+                            ), f"{name} COPY source missing: {source}"
 
     def test_console_scripts_resolve(self):
         import importlib

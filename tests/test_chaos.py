@@ -250,6 +250,13 @@ class TestClaimEvents:
         assert events[0].count == 5
 
 
+def _burn_cpu(ev):
+    # Module-level so the Process target pickles under spawn/forkserver
+    # start methods (macOS default; Linux default from 3.14).
+    while not ev.is_set():
+        sum(i * i for i in range(10_000))
+
+
 class TestProxyReadinessUnderLoad:
     """VERDICT r4 weak #3: the fixed ~15s readiness ladder failed
     reproducibly whenever the box was busy (and would flake the same way
@@ -262,13 +269,8 @@ class TestProxyReadinessUnderLoad:
         import multiprocessing
 
         stop = multiprocessing.Event()
-
-        def burn(ev):
-            while not ev.is_set():
-                sum(i * i for i in range(10_000))
-
         hogs = [
-            multiprocessing.Process(target=burn, args=(stop,), daemon=True)
+            multiprocessing.Process(target=_burn_cpu, args=(stop,), daemon=True)
             for _ in range(n)
         ]
         for h in hogs:

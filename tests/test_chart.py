@@ -65,6 +65,52 @@ class TestChartRenders:
         with pytest.raises(ChartError, match="default namespace"):
             render_chart(CHART_DIR, values={"namespace": "default"})
 
+    def test_runtime_proxy_template_shipped_and_wired(self, manifests):
+        """The per-claim proxy daemon's pod template is chart-delivered
+        (values-overridable) and mounted into the plugin, which consumes
+        it at runtime — reference: templates/mps-control-daemon.tmpl.yaml."""
+        import yaml
+
+        cm = next(
+            c
+            for c in _find(manifests, "ConfigMap")
+            if c["metadata"]["name"].endswith("runtime-proxy-template")
+        )
+        skeleton = yaml.safe_load(cm["data"]["runtime-proxy-daemon.yaml"])
+        # The default skeleton carries the operator-facing knobs.
+        assert any(
+            t["key"] == "google.com/tpu"
+            for t in skeleton["spec"]["tolerations"]
+        )
+        (ds,) = _find(manifests, "DaemonSet")
+        plugin = ds["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in plugin["env"]}
+        assert (
+            env["RUNTIME_PROXY_TEMPLATE"]
+            == "/etc/tpu-dra/runtime-proxy-daemon.yaml"
+        )
+        # Default proxy image falls back to the driver image.
+        assert env["RUNTIME_PROXY_IMAGE"] == "tpu-dra-driver:latest"
+        mounts = {m["name"]: m["mountPath"] for m in plugin["volumeMounts"]}
+        assert mounts["runtime-proxy-template"] == "/etc/tpu-dra"
+        volumes = {
+            v["name"]: v for v in ds["spec"]["template"]["spec"]["volumes"]
+        }
+        assert volumes["runtime-proxy-template"]["configMap"]["name"] == cm[
+            "metadata"
+        ]["name"]
+
+    def test_runtime_proxy_image_override(self):
+        manifests = render_chart(
+            CHART_DIR, values={"runtimeProxy": {"image": "proxy:v2"}}
+        )
+        (ds,) = _find(manifests, "DaemonSet")
+        env = {
+            e["name"]: e.get("value")
+            for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert env["RUNTIME_PROXY_IMAGE"] == "proxy:v2"
+
 
 class TestKubeletPluginDaemonSet:
     @pytest.fixture
@@ -74,7 +120,9 @@ class TestKubeletPluginDaemonSet:
 
     def test_host_mounts_match_plugin_defaults(self, daemonset):
         spec = daemonset["spec"]["template"]["spec"]
-        host_paths = {v["hostPath"]["path"] for v in spec["volumes"]}
+        host_paths = {
+            v["hostPath"]["path"] for v in spec["volumes"] if "hostPath" in v
+        }
         assert {
             DEFAULT_PLUGIN_ROOT,
             DEFAULT_REGISTRAR_ROOT,

@@ -125,6 +125,126 @@ class TestRuntimeProxy:
 
         daemon.start()  # idempotent
 
+    def test_operator_pod_template_consumed(self, tmp_path, cs, stack):
+        """The chart-shipped skeleton customizes scheduling/resources and
+        may add env; the plugin forces the correctness-critical fields
+        (nodeName, claim label, command, driver env, per-claim hostPath).
+        Reference analog: templates/mps-control-daemon.tmpl.yaml consumed
+        at runtime (sharing.go:210)."""
+        tpulib, _, _ = stack
+        template_file = tmp_path / "runtime-proxy-daemon.yaml"
+        template_file.write_text(
+            """
+spec:
+  priorityClassName: system-node-critical
+  tolerations:
+    - key: google.com/tpu
+      operator: Exists
+      effect: NoSchedule
+  containers:
+    - name: proxy
+      image: registry.example/proxy:v9
+      resources:
+        limits:
+          memory: 128Mi
+      env:
+        - name: OPERATOR_EXTRA
+          value: "1"
+        - name: TPU_VISIBLE_DEVICES
+          value: "operator-must-not-win"
+"""
+        )
+        mgr = RuntimeProxyManager(
+            cs,
+            tpulib,
+            node_name="node-1",
+            namespace="tpu-dra",
+            proxy_root=str(tmp_path / "proxy3"),
+            template_path=str(template_file),
+            backoff_scale=0.01,
+        )
+        daemon = mgr.new_daemon(
+            ClaimInfo(namespace="default", name="c1", uid="uid-tmpl-1234"),
+            prepared_tpus("mock-tpu-0"),
+            RuntimeProxyConfig(),
+        )
+        daemon.start()
+        deployment = cs.deployments("tpu-dra").get("tpu-runtime-proxy-uid-tmpl")
+        pod_spec = deployment.spec.template["spec"]
+        # Operator-controlled fields survive.
+        assert pod_spec["priorityClassName"] == "system-node-critical"
+        assert pod_spec["tolerations"][0]["key"] == "google.com/tpu"
+        container = pod_spec["containers"][0]
+        assert container["image"] == "registry.example/proxy:v9"
+        assert container["resources"]["limits"]["memory"] == "128Mi"
+        env = {e["name"]: e["value"] for e in container["env"]}
+        assert env["OPERATOR_EXTRA"] == "1"
+        # Driver-owned fields forced.
+        assert pod_spec["nodeName"] == "node-1"
+        assert container["command"] == ["tpu-runtime-proxy"]
+        assert env["TPU_VISIBLE_DEVICES"] == "0"  # driver wins the collision
+        assert (
+            deployment.spec.template["metadata"]["labels"][
+                "tpu.resource.google.com/claim"
+            ]
+            == "uid-tmpl-1234"
+        )
+        assert any(
+            v.get("hostPath", {}).get("path") == daemon._root
+            for v in pod_spec["volumes"]
+        )
+
+    def test_null_keys_pod_template_degrades(self, tmp_path, cs, stack):
+        """A template whose keys are present but null ('spec:' above a
+        commented-out body parses as {'spec': None}) must behave like an
+        absent key, not crash claim preparation."""
+        tpulib, _, _ = stack
+        nulls = tmp_path / "nulls.yaml"
+        nulls.write_text("metadata:\nspec:\n")
+        mgr = RuntimeProxyManager(
+            cs,
+            tpulib,
+            node_name="node-1",
+            namespace="tpu-dra",
+            proxy_root=str(tmp_path / "proxy5"),
+            template_path=str(nulls),
+            backoff_scale=0.01,
+        )
+        daemon = mgr.new_daemon(
+            ClaimInfo(uid="uid-null-keys"),
+            prepared_tpus("mock-tpu-0"),
+            RuntimeProxyConfig(),
+        )
+        daemon.start()
+        deployment = cs.deployments("tpu-dra").get("tpu-runtime-proxy-uid-null")
+        spec = deployment.spec.template["spec"]
+        assert spec["nodeName"] == "node-1"
+        assert spec["containers"][0]["command"] == ["tpu-runtime-proxy"]
+
+    def test_broken_pod_template_falls_back(self, tmp_path, cs, stack):
+        tpulib, _, _ = stack
+        bad = tmp_path / "bad.yaml"
+        bad.write_text("just a string, not a mapping")
+        mgr = RuntimeProxyManager(
+            cs,
+            tpulib,
+            node_name="node-1",
+            namespace="tpu-dra",
+            proxy_root=str(tmp_path / "proxy4"),
+            template_path=str(bad),
+            backoff_scale=0.01,
+        )
+        daemon = mgr.new_daemon(
+            ClaimInfo(uid="uid-bad-tmpl"),
+            prepared_tpus("mock-tpu-0"),
+            RuntimeProxyConfig(),
+        )
+        daemon.start()  # built-in spec; sharing must not go down
+        deployment = cs.deployments("tpu-dra").get("tpu-runtime-proxy-uid-bad-")
+        container = deployment.spec.template["spec"]["containers"][0]
+        assert container["command"] == ["tpu-runtime-proxy"]
+        assert container["image"] == "tpu-dra-driver:latest"
+
     def test_assert_ready_times_out(self, tmp_path, cs, stack):
         mgr = self.make_manager(tmp_path, cs, stack)
         daemon = mgr.new_daemon(

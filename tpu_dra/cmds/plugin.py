@@ -71,6 +71,21 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
         help="TESTING: use the mock chip enumerator with this mesh (e.g. "
         "2x2x1) instead of scanning devfs [MOCK_TPULIB_MESH]",
     )
+    s = parser.add_argument_group("sharing")
+    s.add_argument(
+        "--runtime-proxy-template",
+        default=flags._env_default("RUNTIME_PROXY_TEMPLATE", ""),
+        help="operator-customizable pod-template skeleton (YAML) for the "
+        "per-claim runtime-proxy daemon; chart ships it as a ConfigMap "
+        "(reference: templates/mps-control-daemon.tmpl.yaml) "
+        "[RUNTIME_PROXY_TEMPLATE]",
+    )
+    s.add_argument(
+        "--runtime-proxy-image",
+        default=flags._env_default("RUNTIME_PROXY_IMAGE", "tpu-dra-driver:latest"),
+        help="image for the per-claim runtime-proxy daemon pod "
+        "[RUNTIME_PROXY_IMAGE]",
+    )
     d.add_argument(
         "--mock-partitionable",
         action="store_true",
@@ -140,6 +155,8 @@ class PluginApp:
                 node_name=args.node_name or "local",
                 namespace=args.namespace,
                 proxy_root=os.path.join(args.state_dir, "proxy"),
+                image=args.runtime_proxy_image,
+                template_path=args.runtime_proxy_template,
             ),
         )
         self.nas, self.nasclient = flags.build_nas(args, self.clientset)

@@ -260,6 +260,10 @@ class _Renderer:
             if not _truthy(value):
                 raise ChartError(str(msg))
             return value
+        if fn == "printf":
+            fmt, fmt_args = str(vals[0]), vals[1:]
+            # Go's %v has no Python equivalent; everything prints like %s.
+            return fmt.replace("%v", "%s") % tuple(fmt_args)
         if fn == "not":
             return not _truthy(vals[-1])
         if fn == "eq":

@@ -173,33 +173,7 @@ class RuntimeProxyDaemon:
                 selector={
                     "matchLabels": {"tpu.resource.google.com/claim": self._claim.uid}
                 },
-                template={
-                    "metadata": {
-                        "labels": {
-                            "tpu.resource.google.com/claim": self._claim.uid
-                        }
-                    },
-                    "spec": {
-                        "nodeName": self._manager.node_name,
-                        "containers": [
-                            {
-                                "name": "proxy",
-                                "image": self._manager.image,
-                                "command": ["tpu-runtime-proxy"],
-                                "env": env,
-                                "volumeMounts": [
-                                    {"name": "proxy-dir", "mountPath": self._root}
-                                ],
-                            }
-                        ],
-                        "volumes": [
-                            {
-                                "name": "proxy-dir",
-                                "hostPath": {"path": self._root},
-                            }
-                        ],
-                    },
-                },
+                template=self._render_pod_template(env),
             ),
         )
         client = self._manager.clientset.deployments(self._manager.namespace)
@@ -207,6 +181,67 @@ class RuntimeProxyDaemon:
             client.get(self._name)
         except NotFoundError:
             client.create(deployment)
+
+    def _render_pod_template(self, env: "list[dict]") -> dict:
+        """Per-claim pod template: the operator-customizable skeleton from
+        the chart (tolerations, nodeSelector, resources, priorityClass,
+        image...) with the driver-owned fields forced on top.
+
+        The reference ships its control-daemon pod spec as a
+        chart-delivered template the plugin fills at runtime
+        (templates/mps-control-daemon.tmpl.yaml:1-74, parsed at
+        sharing.go:210); the TPU analog splits responsibilities instead of
+        string-substituting: the skeleton is plain YAML the operator fully
+        controls, and the plugin overrides only what correctness needs —
+        nodeName (daemon must run beside the chips), the claim selector
+        label, the proxy container's command/env, and the per-claim
+        hostPath dir."""
+        # `or {}` (not setdefault) throughout: an operator template with a
+        # present-but-null key ('spec:' above a commented-out body) parses
+        # as {'spec': None}, and a null must degrade like an absent key —
+        # never crash claim preparation.
+        template = self._manager.load_pod_template() or {}
+        meta = template.get("metadata") or {}
+        template["metadata"] = meta
+        labels = meta.get("labels") or {}
+        meta["labels"] = labels
+        labels["tpu.resource.google.com/claim"] = self._claim.uid
+        spec = template.get("spec") or {}
+        template["spec"] = spec
+        spec["nodeName"] = self._manager.node_name
+        containers = spec.get("containers") or []
+        spec["containers"] = containers
+        proxy = next(
+            (c for c in containers if c.get("name") == "proxy"), None
+        )
+        if proxy is None:
+            proxy = {"name": "proxy"}
+            containers.insert(0, proxy)
+        if not proxy.get("image"):
+            proxy["image"] = self._manager.image
+        proxy["command"] = ["tpu-runtime-proxy"]
+        # Driver env wins on name collisions; operator-added env survives.
+        ours = {e["name"] for e in env}
+        proxy["env"] = [
+            e for e in (proxy.get("env") or []) if e.get("name") not in ours
+        ] + env
+        mounts = [
+            m
+            for m in (proxy.get("volumeMounts") or [])
+            if m.get("name") != "proxy-dir"
+        ]
+        mounts.append({"name": "proxy-dir", "mountPath": self._root})
+        proxy["volumeMounts"] = mounts
+        volumes = [
+            v
+            for v in (spec.get("volumes") or [])
+            if v.get("name") != "proxy-dir"
+        ]
+        volumes.append(
+            {"name": "proxy-dir", "hostPath": {"path": self._root}}
+        )
+        spec["volumes"] = volumes
+        return template
 
     def _build_daemon_config(self, hbm_limits: dict):
         """The full contract the ``tpu-runtime-proxy`` binary
@@ -328,6 +363,7 @@ class RuntimeProxyManager:
         namespace: str,
         proxy_root: str = "/var/run/tpu-dra/proxy",
         image: str = "tpu-dra-driver:latest",
+        template_path: str = "",
         backoff_scale: float = 1.0,
     ):
         self.clientset = clientset
@@ -336,6 +372,7 @@ class RuntimeProxyManager:
         self.namespace = namespace
         self.proxy_root = proxy_root
         self.image = image
+        self.template_path = template_path
         # Tests shrink the readiness budget without changing its shape.
         self.backoff_scale = backoff_scale
         import threading
@@ -365,6 +402,30 @@ class RuntimeProxyManager:
         floor = READY_DEADLINE_DEFAULT_S * self.backoff_scale
         cap = READY_DEADLINE_MAX_S * self.backoff_scale
         return min(max(floor, slowest * READY_STARTUP_MARGIN), cap)
+
+    def load_pod_template(self) -> "dict | None":
+        """The chart-shipped, values-overridable daemon pod-template
+        skeleton (ConfigMap mounted into the plugin; reference analog:
+        templates/mps-control-daemon.tmpl.yaml).  Re-read on every daemon
+        start so a ConfigMap update takes effect without a plugin restart.
+        Absent/empty/broken template falls back to the built-in spec —
+        a bad operator override must not take sharing down."""
+        if not self.template_path or not os.path.exists(self.template_path):
+            return None
+        try:
+            import yaml
+
+            with open(self.template_path) as f:
+                loaded = yaml.safe_load(f)
+            return loaded if isinstance(loaded, dict) else None
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "runtime-proxy pod template %s unreadable; using built-in",
+                self.template_path,
+            )
+            return None
 
     def new_daemon(
         self,
