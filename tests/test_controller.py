@@ -527,6 +527,63 @@ class TestPhantomPendingDefenses:
         assert ca2.unsuitable_nodes == []
         assert not driver.tpu.pending_allocated_claims.exists(uid, "node-1")
 
+    def test_dead_sweep_memo_shares_only_same_membership(self, tmp_path, cs, driver):
+        """Fan-outs over the SAME pending membership within the TTL share
+        one liveness sweep (the O(W²)-GETs fleet hot spot); a membership
+        change always recomputes, so a fresh ghost is purged on the very
+        next pass (the quickly-healing contract of
+        test_dead_pending_purged_on_scheduling_pass stays exact)."""
+        from tpu_dra.api.k8s import Pod
+        from tpu_dra.controller.types import ClaimAllocation
+
+        publish_node(tmp_path, cs)
+        ghost = make_claim(cs, name="ghost")
+        ca = ClaimAllocation(
+            claim=ghost,
+            class_=ResourceClass(),
+            claim_parameters=TpuClaimParametersSpec(count=4),
+        )
+        driver.unsuitable_nodes(Pod(), [ca], ["node-1"])
+        uid = ghost.metadata.uid
+        assert driver.tpu.pending_allocated_claims.exists(uid, "node-1")
+        cs.resource_claims(NS).delete("ghost")
+
+        # Same membership ({ghost}) swept LIVE moments ago?  No: the sweep
+        # that ran during ghost's own fan-out saw membership {} (the pick
+        # seeds after the sweep), so the next pass — membership {ghost} —
+        # recomputes and purges.  Pin the memo to the live verdict first to
+        # exercise the sharing path deliberately:
+        # Stamp pinned into the future so the stale-shared assertion can't
+        # flake if >TTL of wall time passes before the sweep runs.
+        driver._dead_memo = (
+            __import__("time").monotonic() + 60.0,
+            frozenset({uid}),
+            frozenset(),
+        )
+        live = make_claim(cs, name="live")
+        ca2 = ClaimAllocation(
+            claim=live,
+            class_=ResourceClass(),
+            claim_parameters=TpuClaimParametersSpec(count=4),
+        )
+        driver.unsuitable_nodes(Pod(), [ca2], ["node-1"])
+        # Shared (stale-live) sweep: ghost still squats, node unsuitable.
+        # (An unsuitable verdict seeds no pick, so membership is unchanged
+        # — the staleness bound here is the TTL, not a membership bump.)
+        assert ca2.unsuitable_nodes == ["node-1"]
+
+        # TTL expired: recompute purges the ghost and the node opens up.
+        _, membership, dead = driver._dead_memo
+        driver._dead_memo = (
+            __import__("time").monotonic() - driver.DEAD_SWEEP_TTL_S - 0.1,
+            membership,
+            dead,
+        )
+        ca2.unsuitable_nodes = []
+        driver.unsuitable_nodes(Pod(), [ca2], ["node-1"])
+        assert ca2.unsuitable_nodes == []
+        assert not driver.tpu.pending_allocated_claims.exists(uid, "node-1")
+
     def test_deallocate_clears_pending_without_nas_entry(self, cs, driver):
         from tpu_dra.api.nas_v1alpha1 import AllocatedDevices
 

@@ -102,6 +102,16 @@ class ControllerDriver:
         self._probe_memo_lock = threading.Lock()
         self.PROBE_MEMO_CAP = 8192
         self.PROBE_MEMO_TTL_S = 2.0
+        # The dead-pending sweep costs one claim GET per distinct pending
+        # entry per fan-out; with W pods scheduling concurrently that is
+        # O(W²) GETs per wave for a result that rarely changes.  It is
+        # level-triggered healing (a leaked entry just needs to die on
+        # SOME pass soon), so fan-outs within a short window share one
+        # sweep.  The fleet bench's wave latency sits on this path.
+        # (stamp, swept-membership, dead-set); see _dead_pending_claims.
+        self._dead_memo: "tuple[float, frozenset, frozenset] | None" = None
+        self._dead_memo_lock = threading.Lock()
+        self.DEAD_SWEEP_TTL_S = 1.0
         from tpu_dra.controller.gang_tracker import GangTracker
 
         self.gangs = GangTracker(clientset, namespace)
@@ -596,7 +606,7 @@ class ControllerDriver:
         for ca in cas:
             ca.unsuitable_nodes = sorted(set(ca.unsuitable_nodes))
 
-    def _dead_pending_claims(self, nodes: list[str]) -> set[str]:
+    def _dead_pending_claims(self, nodes: list[str]) -> "frozenset[str]":
         """Pending-cache claim UIDs whose claim no longer exists.
 
         A claim deleted between UnsuitableNodes and Allocate can leave (or,
@@ -606,7 +616,18 @@ class ControllerDriver:
         (b)).  Each scheduling fan-out validates liveness via the claim_info
         recorded in the entries (one GET per distinct claim, outside the node
         locks), so any leak heals on the next pass.
+
+        Sweeps over the SAME pending membership within DEAD_SWEEP_TTL_S
+        share one result — that is the quadratic case (every pod in a
+        scheduling wave re-verifying the same W in-flight claims).  A
+        membership change (new pending entry, entry removed) always
+        recomputes, so a fresh ghost is still caught on the very next
+        pass; only a claim swept live and deleted within the TTL window
+        is re-verified one TTL late — level-triggered healing absorbs
+        that.
         """
+        import time as _time
+
         from tpu_dra.client.apiserver import NotFoundError
 
         infos: dict[str, nascrd.ClaimInfo] = {}
@@ -618,6 +639,17 @@ class ControllerDriver:
                         uid, allocation.claim_info
                     ),
                 )
+        membership = frozenset(infos)
+        now = _time.monotonic()
+        with self._dead_memo_lock:
+            memo = self._dead_memo
+        if (
+            memo is not None
+            and memo[1] == membership
+            and now - memo[0] <= self.DEAD_SWEEP_TTL_S
+        ):
+            return memo[2]
+
         dead: set[str] = set()
         for uid, info in infos.items():
             if info is None or not info.namespace:
@@ -629,7 +661,10 @@ class ControllerDriver:
                 continue
             if claim.metadata.uid != uid or claim.metadata.deletion_timestamp:
                 dead.add(uid)
-        return dead
+        result = frozenset(dead)
+        with self._dead_memo_lock:
+            self._dead_memo = (now, membership, result)
+        return result
 
     def _unsuitable_node(
         self,
