@@ -275,7 +275,11 @@ def param_specs(config: BurninConfig, mesh=None):
         expert_axis = (
             "expert" if mesh is not None and "expert" in mesh.shape else "model"
         )
-        matrices.update(moe_param_specs(expert_axis))
+        # cp x ep: the model axis carries the sequence, so the expert FFN
+        # dims must not ride it (moe_param_specs ring flavor).
+        matrices.update(
+            moe_param_specs(expert_axis, ring=config.ring_attention)
+        )
     # In cp mode the model axis carries the SEQUENCE: sharding d_model over
     # it in the embedding would make every lookup produce a layout the
     # partitioner can only reconcile with the sequence-sharded stream by
@@ -385,17 +389,17 @@ def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
         h = _rms_norm(constrain("seq", x), layer["ln2"]).astype(bf16)
         if c.moe_experts > 0:
             # Long-context MoE (cp x ep — needs the dedicated expert axis,
-            # enforced in forward()).  Scope: attention stays O((s/P)^2)
-            # per chip (the long-context bottleneck), but the switch
-            # routing is GLOBAL — its capacity cumsum crosses shards, so
-            # the partitioner materializes O(B*s*d_model) activations per
-            # chip at the dispatch (verified in the compiled HLO).  Fine
-            # for long-but-not-extreme sequences; per-shard local routing
-            # (shard_map over model with local capacity) is the known
-            # upgrade path beyond that.
-            from tpu_dra.parallel.moe import moe_mlp
+            # enforced in forward()).  Routing is GROUP-LOCAL, one group
+            # per sequence shard (moe_mlp_local): the capacity cumsum
+            # never crosses shards, the dispatch tensor stays sharded
+            # over model AND expert, and per-chip activations stay
+            # O(B * s/P * d_model) — so the composition scales in s like
+            # the ring attention it sits beside.
+            from tpu_dra.parallel.moe import moe_mlp_local
 
-            h, aux = moe_mlp(layer, h, c, constrain)
+            h, aux = moe_mlp_local(
+                layer, h, c, constrain, ring_mesh.shape["model"]
+            )
             x = x + constrain("seq", h)
         else:
             h = jnp.einsum("bsd,df->bsf", h, layer["w1"].astype(bf16))
@@ -627,6 +631,21 @@ def make_constrain(mesh, batch_axes):
         # the context-mesh axis-type check).
         "expert_ff": (
             P(e_ax, batch_axes, None, "model") if has_expert_axis else None
+        ),
+        # cp x ep group-local routing (moe_mlp_local): the sequence split
+        # into per-shard groups (B, G, S/G, D) with G on the model axis...
+        "seq_grouped": P(batch_axes, "model", None, None),
+        # ...and expert tensors (E, B, G, C, D) sharded over BOTH expert
+        # and model, so the dispatch a2a moves tokens only over the
+        # expert axis while every group stays on its sequence shard.
+        # Needs the dedicated expert axis (e_ax falling back to "model"
+        # would name the same mesh axis twice); forward() enforces the
+        # axis for the cp x ep path, so None here only covers direct
+        # moe_mlp_local callers, mirroring "expert_ff".
+        "expert_local": (
+            P(e_ax, batch_axes, "model", None, None)
+            if has_expert_axis
+            else None
         ),
     }
 
