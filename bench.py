@@ -701,6 +701,74 @@ def bench_compute(timeout_s: float = 600.0) -> "dict":
         }
 
 
+def bench_northstar_mesh(timeout_s: float = 420.0) -> "dict":
+    """Compile + execute the full dp x fsdp x tp x ep composition on a
+    64-virtual-device CPU mesh (the BASELINE v5e-256 north-star shape at
+    chip count 64) — proof the sharded program SCALES to the gang size
+    the driver allocates, not just the 8-device dryrun.  Runs in a child
+    so the 64-device XLA flag can't leak into this process's jax."""
+    import os
+    import subprocess
+
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        repo_dir + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH")
+        else repo_dir
+    )
+    import re
+
+    env["JAX_PLATFORMS"] = "cpu"
+    # Strip ANY inherited device-count flag (the value is
+    # environment-controlled, not always 8) so the child never carries
+    # two conflicting counts.
+    env["XLA_FLAGS"] = (
+        re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            env.get("XLA_FLAGS", ""),
+        ).strip()
+        + " --xla_force_host_platform_device_count=64"
+    ).strip()
+    # Same composition the dryrun's env-gated stanza runs — one source
+    # (northstar_train), so the two proofs cannot drift.
+    child = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from __graft_entry__ import northstar_train\n"
+        "nmesh, ns = northstar_train(steps=2)\n"
+        "import json\n"
+        "print('BENCHJSON:' + json.dumps({'mesh': dict(nmesh.shape),"
+        " 'devices': 64, 'loss_first': round(ns.loss_first, 4),"
+        " 'loss_last': round(ns.loss_last, 4),"
+        " 'step_p50_s': round(ns.step_seconds_p50, 4), 'ok': bool(ns.ok),"
+        " **({'error': ns.error} if ns.error else {})}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", child],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+        for line in proc.stdout.splitlines():
+            if line.startswith("BENCHJSON:"):
+                return json.loads(line[len("BENCHJSON:"):])
+        return {
+            "ok": False,
+            "error": (
+                f"no result (rc={proc.returncode}, "
+                f"stderr tail: {proc.stderr[-300:]!r})"
+            ),
+        }
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"exceeded {timeout_s:.0f}s"}
+    except Exception as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
 def main() -> int:
     alloc = bench_claim_to_running(SAMPLES)
     fleet = bench_fleet_scale()
@@ -708,6 +776,7 @@ def main() -> int:
         wire = bench_wire()
     except Exception as e:  # the wire rung must not sink the whole bench
         wire = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    northstar = bench_northstar_mesh()
     compute = bench_compute()
     p50 = alloc["p50_s"]
     line = {
@@ -733,6 +802,9 @@ def main() -> int:
             # played by the bench): claim -> allocated -> gRPC-prepared.
             "wire": {k: round(v, 4) if isinstance(v, float) else v
                      for k, v in wire.items()},
+            # 64-virtual-device compile+execute of the full dp x fsdp x
+            # tp x ep composition — the north-star gang shape.
+            "northstar_mesh": northstar,
             "compute": compute,
         },
     }

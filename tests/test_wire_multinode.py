@@ -10,6 +10,7 @@ own claims, and watch-driven GC unprepares per node.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 
@@ -55,11 +56,14 @@ def _wait(pred, timeout=20.0, poll=0.02):
     return False
 
 
-@pytest.fixture
-def rig(tmp_path):
+@contextlib.contextmanager
+def wire_rig(tmp_path, *, nodes=NODES, mesh="2x1x1", qps=1000, workers=2):
+    """Real controller + one real plugin per node over the HTTP shim.
+    Yields ``(clients, socks, roots)``; single teardown ordering for every
+    wire test (controller first, then plugins, then the shim)."""
     shim = HttpApiServer().start()
     clients = ClientSet(
-        RestApiServer(ClusterConfig(server=shim.url), qps=1000, burst=1000)
+        RestApiServer(ClusterConfig(server=shim.url), qps=qps, burst=qps)
     )
     papps = []
     capp = None
@@ -69,23 +73,18 @@ def rig(tmp_path):
                 metadata=ObjectMeta(name="tpu.google.com"), driver_name=GROUP_NAME
             )
         )
-        clients.tpu_claim_parameters(WORK_NS).create(
-            TpuClaimParameters(
-                metadata=ObjectMeta(name="two-chips", namespace=WORK_NS),
-                spec=TpuClaimParametersSpec(count=2),
-            )
-        )
-        socks = {}
-        for node in NODES:
+        socks, roots = {}, {}
+        for node in nodes:
             clients.nodes().create(Node(metadata=ObjectMeta(name=node)))
             root = tmp_path / node
+            roots[node] = root
             app = plugin_cmd.PluginApp(
                 plugin_cmd.parse_args(
                     [
                         "--node-name", node,
                         "--namespace", NS,
                         "--apiserver", shim.url,
-                        "--mock-tpulib-mesh", "2x1x1",  # 2 chips per node
+                        "--mock-tpulib-mesh", mesh,
                         "--cdi-root", str(root / "cdi"),
                         "--plugin-root", str(root / "plugins"),
                         "--registrar-root", str(root / "registry"),
@@ -104,14 +103,14 @@ def rig(tmp_path):
                 [
                     "--apiserver", shim.url,
                     "--namespace", NS,
-                    "--workers", "2",
-                    "--kube-apiserver-qps", "1000",
-                    "--kube-apiserver-burst", "1000",
+                    "--workers", str(workers),
+                    "--kube-apiserver-qps", str(qps),
+                    "--kube-apiserver-burst", str(qps),
                 ]
             )
         )
         capp.start()
-        yield clients, socks
+        yield clients, socks, roots
     finally:
         try:
             if capp is not None:
@@ -123,6 +122,58 @@ def rig(tmp_path):
                 except Exception:
                     pass
             shim.stop()
+
+
+def negotiate_claims(clients, names, nodes, timeout=30.0, poll=0.05):
+    """Play kube-scheduler's PodSchedulingContext role for ``names``:
+    deselect whenever the controller reports the selected node unsuitable,
+    reselect among remaining candidates.  Returns True when every claim is
+    allocated.  (A scheduler that never renegotiates deadlocks at exact
+    capacity — two claims can each hold the other's last chip via pending
+    picks.)"""
+    from tpu_dra.client.apiserver import ConflictError
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        claims = clients.resource_claims(WORK_NS).list()
+        by_name = {c.metadata.name: c for c in claims}
+        unallocated = [
+            n
+            for n in names
+            if by_name.get(n) is None
+            or by_name[n].status.allocation is None
+        ]
+        if not unallocated:
+            return True
+        for name in unallocated:
+            sc = clients.pod_scheduling_contexts(WORK_NS).get(name)
+            unsuitable = set()
+            for rc in sc.status.resource_claims if sc.status else []:
+                unsuitable.update(rc.unsuitable_nodes)
+            candidates = [n for n in nodes if n not in unsuitable]
+            try:
+                if sc.spec.selected_node in unsuitable:
+                    sc.spec.selected_node = ""
+                    clients.pod_scheduling_contexts(WORK_NS).update(sc)
+                elif not sc.spec.selected_node and candidates:
+                    sc.spec.selected_node = candidates[0]
+                    clients.pod_scheduling_contexts(WORK_NS).update(sc)
+            except ConflictError:
+                pass  # RV race with the controller: re-read next round
+        time.sleep(poll)
+    return False
+
+
+@pytest.fixture
+def rig(tmp_path):
+    with wire_rig(tmp_path) as (clients, socks, _roots):
+        clients.tpu_claim_parameters(WORK_NS).create(
+            TpuClaimParameters(
+                metadata=ObjectMeta(name="two-chips", namespace=WORK_NS),
+                spec=TpuClaimParametersSpec(count=2),
+            )
+        )
+        yield clients, socks
 
 
 def test_claims_spread_across_both_wire_nodes(rig):
@@ -173,34 +224,9 @@ def test_claims_spread_across_both_wire_nodes(rig):
         # outside the published unsuitable set; when the controller later
         # reports the selected node unsuitable (the negotiation's whole
         # point), DESELECT and pick again.
-        def negotiate(n=name, timeout=30.0):
-            deadline = time.monotonic() + timeout
-            while time.monotonic() < deadline:
-                if (
-                    clients.resource_claims(WORK_NS).get(n).status.allocation
-                    is not None
-                ):
-                    return True
-                sc = clients.pod_scheduling_contexts(WORK_NS).get(n)
-                unsuitable = set()
-                for rc in sc.status.resource_claims if sc.status else []:
-                    unsuitable.update(rc.unsuitable_nodes)
-                candidates = [x for x in NODES if x not in unsuitable]
-                from tpu_dra.client.apiserver import ConflictError
-
-                try:
-                    if sc.spec.selected_node in unsuitable:
-                        sc.spec.selected_node = ""
-                        clients.pod_scheduling_contexts(WORK_NS).update(sc)
-                    elif not sc.spec.selected_node and candidates:
-                        sc.spec.selected_node = candidates[0]
-                        clients.pod_scheduling_contexts(WORK_NS).update(sc)
-                except ConflictError:
-                    pass  # RV conflict with the controller: re-read and retry
-                time.sleep(0.05)
-            return False
-
-        assert negotiate(), f"claim {name} not allocated"
+        assert negotiate_claims(
+            clients, [name], NODES
+        ), f"claim {name} not allocated"
 
     # The two claims landed on different nodes (each node only fits one).
     nases = {
@@ -238,3 +264,126 @@ def test_claims_spread_across_both_wire_nodes(rig):
             and not clients.node_allocation_states(NS).get(n).spec.prepared_claims,
             timeout=25.0,
         ), f"teardown did not settle on {node}"
+
+
+class TestWireGangSmoke:
+    """Reduced north-star wire-gang smoke (VERDICT r4 next-step #6): a
+    64-member gang negotiated over the REAL wire — real controller binary,
+    four real plugin binaries each publishing a 16-chip mock mesh, HTTP
+    apiserver shim — with ranks 0..63 committed into the NAS objects and a
+    sampled gRPC prepare showing the CDI gang env.  (The full 64-pod
+    in-proc gang contract is tests/test_gang_e2e.py::test_v5e_256_shaped_gang;
+    this proves the same negotiation holds across process/wire boundaries.)"""
+
+    def test_64_member_gang_over_the_wire(self, tmp_path):
+        import json
+
+        from tpu_dra.api.tpu_v1alpha1 import GangConfig
+
+        size = 64
+        gang_nodes = tuple(f"gw-{i}" for i in range(4))  # 16 chips each
+        with wire_rig(
+            tmp_path, nodes=gang_nodes, mesh="4x2x2", qps=2000, workers=4
+        ) as (clients, socks, roots):
+            clients.tpu_claim_parameters(WORK_NS).create(
+                TpuClaimParameters(
+                    metadata=ObjectMeta(name="gang-member", namespace=WORK_NS),
+                    spec=TpuClaimParametersSpec(
+                        count=1,
+                        gang=GangConfig(name="wire-64", size=size, port=8476),
+                    ),
+                )
+            )
+
+            # 64 member claims; the test plays the scheduler, spreading
+            # members round-robin (16 per node fills every chip).  The
+            # pre-set node is an initial preference only: at exact
+            # capacity a scheduler that never renegotiates deadlocks (two
+            # members can each hold the other's last chip via pending
+            # picks) — negotiate_claims plays kube-scheduler properly.
+            names = [f"member-{i}" for i in range(size)]
+            for i, name in enumerate(names):
+                clients.resource_claims(WORK_NS).create(
+                    ResourceClaim(
+                        metadata=ObjectMeta(name=name, namespace=WORK_NS),
+                        spec=ResourceClaimSpec(
+                            resource_class_name="tpu.google.com",
+                            parameters_ref=ResourceClaimParametersReference(
+                                api_group=GROUP_NAME,
+                                kind="TpuClaimParameters",
+                                name="gang-member",
+                            ),
+                        ),
+                    )
+                )
+                clients.pods(WORK_NS).create(
+                    Pod(
+                        metadata=ObjectMeta(name=name, namespace=WORK_NS),
+                        spec=PodSpec(
+                            resource_claims=[
+                                PodResourceClaim(
+                                    name="tpu",
+                                    source=PodResourceClaimSource(
+                                        resource_claim_name=name
+                                    ),
+                                )
+                            ]
+                        ),
+                    )
+                )
+                clients.pod_scheduling_contexts(WORK_NS).create(
+                    PodSchedulingContext(
+                        metadata=ObjectMeta(name=name, namespace=WORK_NS),
+                        spec=PodSchedulingContextSpec(
+                            selected_node=gang_nodes[i % len(gang_nodes)],
+                            potential_nodes=list(gang_nodes),
+                        ),
+                    )
+                )
+
+            assert negotiate_claims(
+                clients, names, gang_nodes, timeout=240.0, poll=0.25
+            ), "gang members not all allocated over the wire"
+
+            # Rank contract, read from the four NAS objects over the wire.
+            ranks, coordinators = [], set()
+            for node in gang_nodes:
+                nas = clients.node_allocation_states(NS).get(node)
+                for alloc in nas.spec.allocated_claims.values():
+                    gang = alloc.tpu.gang
+                    assert gang is not None and gang.name == "wire-64"
+                    ranks.append(gang.rank)
+                    coordinators.add(gang.coordinator)
+            assert sorted(ranks) == list(range(size))
+            assert len(coordinators) == 1, coordinators
+
+            # Sampled wire prepare: one claim per sampled node flows
+            # through the kubelet gRPC socket and the CDI spec carries the
+            # gang env.  (Claim set is immutable here: one uid->name map.)
+            uid_to_name = {
+                c.metadata.uid: c.metadata.name
+                for c in clients.resource_claims(WORK_NS).list()
+            }
+            for node in gang_nodes[:2]:
+                nas = clients.node_allocation_states(NS).get(node)
+                uid = next(iter(nas.spec.allocated_claims))
+                devices = DRAClient(socks[node]).node_prepare_resource(
+                    WORK_NS, uid, claim_name=uid_to_name[uid]
+                )
+                assert devices and "claim" in devices[0]
+                spec_path = (
+                    roots[node]
+                    / "cdi"
+                    / f"tpu.resource.google.com-claim_{uid}.json"
+                )
+                with open(spec_path) as f:
+                    spec = json.load(f)
+                env = spec["devices"][0]["containerEdits"]["env"]
+                gang_env = {
+                    e.split("=", 1)[0]: e.split("=", 1)[1]
+                    for e in env
+                    if e.startswith("TPU_DRA_GANG")
+                }
+                assert gang_env["TPU_DRA_GANG_SIZE"] == str(size)
+                assert int(gang_env["TPU_DRA_GANG_RANK"]) in range(size)
+                assert gang_env["TPU_DRA_GANG_COORDINATOR"]
