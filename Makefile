@@ -14,6 +14,9 @@ all: lint test
 test: native
 	$(PYTHON) -m pytest tests/ -q
 
+test-all: native
+	$(PYTHON) -m pytest tests/ -q --runslow
+
 lint:
 	$(PYTHON) tools/lint.py
 

@@ -24,6 +24,7 @@ tokens/s, so the compute path is exercised too.  Output: ONE JSON line.
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import tempfile
@@ -533,6 +534,47 @@ def bench_wire(samples: int = 8) -> "dict":
                 tmp.cleanup()
 
 
+def _seed_pythonpath(env: dict) -> dict:
+    """Children inherit cwd, not this script-dir sys.path entry; seed
+    PYTHONPATH so tpu_dra imports regardless of where bench runs."""
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = (
+        repo_dir + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH")
+        else repo_dir
+    )
+    return env
+
+
+def _run_bench_child(child_src: str, env: dict, limit: float, *,
+                     empty_result: dict) -> dict:
+    """Run a jax-touching measurement in a killable child and parse its one
+    ``BENCHJSON:`` stdout line — the shared protocol of the compute and
+    northstar stanzas (a wedged PJRT init blocks in C++ and shrugs off
+    SIGTERM, so only a subprocess under a wall timeout stays killable).
+    ``empty_result`` seeds the no-result report's stanza-specific keys."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-c", child_src],
+        capture_output=True,
+        text=True,
+        timeout=limit,
+        env=env,
+    )
+    for line in proc.stdout.splitlines():
+        if line.startswith("BENCHJSON:"):
+            return json.loads(line[len("BENCHJSON:"):])
+    return {
+        **empty_result,
+        "ok": False,
+        "error": (
+            f"child emitted no result (rc={proc.returncode}, "
+            f"stderr tail: {proc.stderr[-300:]!r})"
+        ),
+    }
+
+
 _COMPUTE_CHILD = r"""
 import json
 import os
@@ -615,39 +657,15 @@ def bench_compute(timeout_s: float = 600.0) -> "dict":
     (TPU tunnel down) blocks in C++ and shrugs off SIGTERM, so only a
     killable child keeps the bench's one-JSON-line contract honest.  The
     allocation stanzas never touch jax and always report."""
-    import os
     import subprocess
 
-    # The child inherits cwd, not the parent's script-dir sys.path entry;
-    # seed PYTHONPATH so tpu_dra imports regardless of where bench runs.
-    repo_dir = os.path.dirname(os.path.abspath(__file__))
-    base_env = dict(os.environ)
-    base_env["PYTHONPATH"] = (
-        repo_dir + os.pathsep + base_env["PYTHONPATH"]
-        if base_env.get("PYTHONPATH")
-        else repo_dir
-    )
+    base_env = _seed_pythonpath(dict(os.environ))
 
     def run_child(env, limit):
-        proc = subprocess.run(
-            [sys.executable, "-c", _COMPUTE_CHILD],
-            capture_output=True,
-            text=True,
-            timeout=limit,
-            env=env,
+        return _run_bench_child(
+            _COMPUTE_CHILD, env, limit,
+            empty_result={"platform": "none", "mfu": 0.0},
         )
-        for line in proc.stdout.splitlines():
-            if line.startswith("BENCHJSON:"):
-                return json.loads(line[len("BENCHJSON:"):])
-        return {
-            "platform": "none",
-            "mfu": 0.0,
-            "ok": False,
-            "error": (
-                f"compute child emitted no result (rc={proc.returncode}, "
-                f"stderr tail: {proc.stderr[-300:]!r})"
-            ),
-        }
 
     # Budget split keeps the documented contract (total wall <= timeout_s):
     # the accelerator attempt gets the bulk; the CPU fallback's reserve
@@ -707,18 +725,10 @@ def bench_northstar_mesh(timeout_s: float = 420.0) -> "dict":
     chip count 64) — proof the sharded program SCALES to the gang size
     the driver allocates, not just the 8-device dryrun.  Runs in a child
     so the 64-device XLA flag can't leak into this process's jax."""
-    import os
+    import re
     import subprocess
 
-    repo_dir = os.path.dirname(os.path.abspath(__file__))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = (
-        repo_dir + os.pathsep + env["PYTHONPATH"]
-        if env.get("PYTHONPATH")
-        else repo_dir
-    )
-    import re
-
+    env = _seed_pythonpath(dict(os.environ))
     env["JAX_PLATFORMS"] = "cpu"
     # Strip ANY inherited device-count flag (the value is
     # environment-controlled, not always 8) so the child never carries
@@ -731,7 +741,7 @@ def bench_northstar_mesh(timeout_s: float = 420.0) -> "dict":
         ).strip()
         + " --xla_force_host_platform_device_count=64"
     ).strip()
-    # Same composition the dryrun's env-gated stanza runs — one source
+    # Same composition dryrun_multichip(64) runs — one source
     # (northstar_train), so the two proofs cannot drift.
     child = (
         "import jax\n"
@@ -746,23 +756,7 @@ def bench_northstar_mesh(timeout_s: float = 420.0) -> "dict":
         " **({'error': ns.error} if ns.error else {})}))\n"
     )
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", child],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            env=env,
-        )
-        for line in proc.stdout.splitlines():
-            if line.startswith("BENCHJSON:"):
-                return json.loads(line[len("BENCHJSON:"):])
-        return {
-            "ok": False,
-            "error": (
-                f"no result (rc={proc.returncode}, "
-                f"stderr tail: {proc.stderr[-300:]!r})"
-            ),
-        }
+        return _run_bench_child(child, env, timeout_s, empty_result={})
     except subprocess.TimeoutExpired:
         return {"ok": False, "error": f"exceeded {timeout_s:.0f}s"}
     except Exception as e:

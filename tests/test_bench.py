@@ -1,6 +1,7 @@
 """bench.py smoke: the driver contract is one parseable JSON line with the
 required keys, and the allocation pipeline actually completes."""
 
+import pytest
 import json
 
 
@@ -12,12 +13,21 @@ def test_bench_claim_to_running_small():
     assert 0 < out["p50_s"] < 30
 
 
+@pytest.mark.slow
 def test_bench_emits_one_json_line(monkeypatch):
     import bench
 
     monkeypatch.setattr(bench, "SAMPLES", 2)
     monkeypatch.setattr(
         bench, "bench_compute", lambda: {"platform": "skipped", "mfu": 0.0, "ok": True}
+    )
+    # Stubbed like bench_compute: the 64-device compile child has its own
+    # coverage (test_bench_northstar_mesh_stanza); running it here would
+    # burn minutes of a single-core runner inside an unrelated assertion.
+    monkeypatch.setattr(
+        bench,
+        "bench_northstar_mesh",
+        lambda: {"devices": 64, "ok": True, "stubbed": True},
     )
     import io
     from contextlib import redirect_stdout
@@ -33,11 +43,24 @@ def test_bench_emits_one_json_line(monkeypatch):
     assert parsed["metric"] == "claim_to_pod_running_p50"
     assert {"value", "unit", "vs_baseline", "extras"} <= parsed.keys()
     extras = parsed["extras"]
-    assert {"rung", "target_s", "fleet", "wire", "compute"} <= extras.keys()
+    assert {
+        "rung", "target_s", "fleet", "wire", "northstar_mesh", "compute"
+    } <= extras.keys()
     assert extras["fleet"]["target_met"]
     assert extras["wire"]["target_met"]
     parsed = json.loads(lines[0])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(parsed)
+
+
+@pytest.mark.slow
+def test_bench_northstar_mesh_stanza():
+    """The 64-virtual-device compile child must produce a real report."""
+    import bench
+
+    out = bench.bench_northstar_mesh()
+    assert out.get("ok"), out
+    assert out["devices"] == 64
+    assert out["mesh"] == {"data": 2, "fsdp": 4, "model": 4, "expert": 2}
 
 
 def test_bench_wire_small():

@@ -3,6 +3,7 @@ points (the driver's single-chip + multi-chip compile contract)."""
 
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from tpu_dra.parallel.burnin import (
@@ -51,6 +52,7 @@ def test_sharded_train_step_8dev():
     assert report.ok, f"loss {report.loss_first} -> {report.loss_last}"
 
 
+@pytest.mark.slow
 def test_sharded_matches_unsharded_loss():
     """Same init + data → first-step loss identical sharded vs not (numerics
     aside): proves the sharding annotations don't change the math."""
@@ -228,6 +230,7 @@ def test_graft_entry_single_chip():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 def test_graft_entry_multichip():
     import __graft_entry__ as ge
 

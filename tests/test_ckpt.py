@@ -7,6 +7,7 @@ sharded mesh, with arrays restored directly into the mesh shardings.
 
 from __future__ import annotations
 
+import pytest
 import jax
 import numpy as np
 
@@ -21,6 +22,7 @@ from tpu_dra.parallel.ckpt import (
 CFG = BurninConfig(n_layers=2, seq=64, d_model=64, d_ff=128)
 
 
+@pytest.mark.slow
 def test_resume_matches_uninterrupted_run(tmp_path):
     mesh = burnin_mesh(jax.devices())
 

@@ -11,6 +11,8 @@ global psum across both processes' devices.
 
 import json
 import os
+
+import pytest
 import socket
 import subprocess
 import sys
@@ -290,6 +292,7 @@ print("TRAIN_OK " + json.dumps({"rank": gang.rank, "step": step, "losses": losse
 
 
 class TestGangElasticRecovery:
+    @pytest.mark.slow
     def test_preempted_gang_resumes_from_checkpoint(self, tmp_path):
         """Elastic recovery end to end: a 2-member gang trains with
         checkpointing, both members die (preemption), a NEW pair of

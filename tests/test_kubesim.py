@@ -70,6 +70,7 @@ def wire_cluster(tmp_path):
         shim.stop()
 
 
+@pytest.mark.slow
 def test_quickstart_spec_over_the_wire(wire_cluster):
     rest, clients, kubesim = wire_cluster
     apply(rest, load_file(os.path.join(SPEC_DIR, "tpu-test1.yaml")))

@@ -116,6 +116,7 @@ class TestChipSizedConfig:
 
 
 class TestFallbackLadder:
+    @pytest.mark.slow
     def test_shrinks_until_it_fits(self, monkeypatch):
         """OOM headroom varies across runtime versions: the auto-config
         path must shrink and return a measured number, not an error."""

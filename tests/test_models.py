@@ -9,6 +9,7 @@ from tpu_dra.models import FAMILIES, family_config, train_family
 
 
 @pytest.mark.parametrize("name", sorted(FAMILIES))
+@pytest.mark.slow
 def test_family_trains(name):
     # flash runs in pallas interpret mode off-TPU: keep its step count low.
     steps = 2 if name == "flash" else 4
@@ -34,6 +35,7 @@ def test_overrides_apply():
     assert c.moe_experts == 4 and c.seq == 64
 
 
+@pytest.mark.slow
 def test_pipelined_stage_override_honored():
     r = train_family("pipelined", steps=2, n_layers=4, pipeline_stages=4)
     assert r.ok, r

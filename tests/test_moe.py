@@ -29,6 +29,7 @@ def test_moe_single_chip_trains():
     assert r.loss_last < r.loss_first
 
 
+@pytest.mark.slow
 def test_moe_sharded_trains():
     mesh = burnin_mesh(jax.devices())
     r = train(BurninConfig(moe_experts=4, n_layers=2), mesh, steps=6)
@@ -107,6 +108,7 @@ class TestExpertAxis:
 
         return moe_mesh(jax.devices(), data=2, fsdp=1, model=2, expert=2)
 
+    @pytest.mark.slow
     def test_ep_x_tp_trains(self):
         r = train(BurninConfig(moe_experts=4, n_layers=2), self._mesh(), steps=5)
         assert r.ok, r
@@ -149,6 +151,7 @@ class TestLongContextMoe:
 
         return moe_mesh(jax.devices(), data=2, fsdp=1, model=2, expert=2)
 
+    @pytest.mark.slow
     def test_ring_plus_moe_trains_on_expert_axis(self):
         r = train(
             BurninConfig(ring_attention=True, moe_experts=4, n_layers=2),
@@ -171,6 +174,7 @@ class TestLongContextMoe:
         hlo = step.lower(state, sample_tokens(c)).compile().as_text()
         assert "collective-permute" in hlo  # the K/V ring
 
+    @pytest.mark.slow
     def test_local_routing_bounds_per_chip_memory(self):
         """The round-4 scope limit, closed: group-local routing must beat
         global-cumsum routing on per-chip compiled memory for the same

@@ -126,6 +126,7 @@ class TestPrepareConcurrencyThroughDriver:
     slow proxy daemon — the DeviceState-level fix is moot if the driver
     lock still wraps the whole prepare (round-2 review finding)."""
 
+    @pytest.mark.slow
     def test_slow_proxy_does_not_block_other_claims_rpc(self, tmp_path, cs):
         import threading
         import time as _time

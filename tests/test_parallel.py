@@ -138,6 +138,7 @@ class TestValidateSlice:
         ops = {c["op"] for c in report.checks}
         assert ops == {"psum", "all_gather", "ppermute_ring", "psum_bandwidth"}
 
+    @pytest.mark.slow
     def test_train_stage_includes_ring_and_moe_configurations(self):
         # With a multi-device model axis, acceptance must also run the
         # long-context (ring attention) and expert-parallel (MoE a2a)

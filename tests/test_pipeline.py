@@ -54,6 +54,7 @@ def test_pipelined_forward_matches_unpipelined():
     assert float(aux) == 0.0  # dense MLP: no MoE aux
 
 
+@pytest.mark.slow
 def test_pipeline_trains():
     mesh = _mesh(4)
     r = train(BurninConfig(pipeline_stages=4, n_layers=4), mesh, steps=6)
@@ -61,6 +62,7 @@ def test_pipeline_trains():
     assert r.loss_last < r.loss_first
 
 
+@pytest.mark.slow
 def test_pipeline_with_moe_trains():
     # pp + ep compose: experts replicated per stage, aux threaded through
     # the schedule.

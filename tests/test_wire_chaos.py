@@ -98,6 +98,7 @@ def wait_unprepared(clients, claim_uid: str, timeout: float = 30.0) -> None:
 
 
 class TestWireChaos:
+    @pytest.mark.slow
     def test_prepare_and_gc_through_flaky_wire(self, rig):
         """Errors + conflicts on the wire: the plugin's conflict-retried
         prepare publish and watch-driven GC still converge."""

@@ -275,6 +275,7 @@ class TestWireGangSmoke:
     in-proc gang contract is tests/test_gang_e2e.py::test_v5e_256_shaped_gang;
     this proves the same negotiation holds across process/wire boundaries.)"""
 
+    @pytest.mark.slow
     def test_64_member_gang_over_the_wire(self, tmp_path):
         import json
 

@@ -137,6 +137,12 @@ class BurninConfig:
         model = shape.get("model", 1)
         pipe = shape.get("pipe", 1)
         data = shape.get("data", 1) * fsdp
+        if self.ring_attention:
+            # ring_attention_sharded shards batch over every non-model
+            # axis (ring.py:136), so on a moe_mesh the expert axis joins
+            # the batch product (caught by dryrun_multichip(64): 16 data
+            # x 2 expert needs batch % 32 == 0).
+            data *= shape.get("expert", 1)
         batch = _round_up(self.batch, data)
         if self.pipeline_stages > 0:
             # Every data shard must split evenly into microbatches.

@@ -259,7 +259,7 @@ def routing_temp_comparison(
     c = BurninConfig(
         n_layers=1, seq=seq, d_model=d_model, d_ff=d_ff,
         ring_attention=True, moe_experts=experts,
-    )
+    ).scaled_to(mesh)  # batch/dims must divide the mesh (any device count)
     layer = {
         k: v[0]
         for k, v in init_moe_layer_params(c, jax.random.PRNGKey(0)).items()
