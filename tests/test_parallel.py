@@ -102,6 +102,71 @@ class TestCollectives:
         assert r.ok
         assert r.busbw_gbps == 0.0  # no links on a 1-chip "slice"
 
+    def test_hierarchical_psum_matches_flat_and_reduce_scatters(self, devices):
+        """The two-level multi-host all-reduce (reduce-scatter over ICI →
+        psum over DCN on 1/n_ici bytes → all-gather over ICI) must equal
+        the flat psum and structurally carry the reduce-scatter."""
+        from jax.sharding import Mesh
+
+        from tpu_dra.parallel.collectives import hierarchical_psum_check
+
+        # 2 "hosts" (dcn) × 4 local chips (ici) over the virtual devices.
+        import numpy as np
+
+        mesh = Mesh(
+            np.array(devices[:8]).reshape(2, 4), ("dcn", "ici")
+        )
+        r = hierarchical_psum_check(mesh, "ici", "dcn")
+        assert r.ok, r.error
+        assert r.n_devices == 8
+
+    def test_hierarchical_psum_inside_gang_style_mesh(self, devices):
+        """Direct numeric check of hierarchical_psum (the public export)
+        under shard_map on a (dcn, ici) mesh: every device ends with the
+        global sum."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_dra.parallel import hierarchical_psum
+
+        mesh = Mesh(np.array(devices[:8]).reshape(2, 4), ("dcn", "ici"))
+        spec = P(("dcn", "ici"))
+        x = jnp.arange(64, dtype=jnp.float32)  # 8 = n_ici*2 elems/device
+
+        def body(v):
+            return hierarchical_psum(v, "ici", "dcn")
+
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        f = jax.jit(
+            shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
+        )
+        out = np.asarray(jax.device_get(f(x)))
+        shard_sum = np.asarray(x).reshape(8, 8).sum(axis=0)
+        assert np.allclose(out, np.tile(shard_sum, 8))
+
+    def test_hierarchical_psum_check_any_ici_size(self, devices):
+        """Regression: n_ici=8 (a real TPU host's local chip count, not a
+        divisor of the old fixed 4-element shard) must pass, and a bogus
+        axis name must come back as a report, not a raise."""
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from tpu_dra.parallel import hierarchical_psum_check
+
+        mesh = Mesh(np.array(devices[:8]).reshape(1, 8), ("dcn", "ici"))
+        r = hierarchical_psum_check(mesh, "ici", "dcn")
+        assert r.ok, r.error
+
+        bad = hierarchical_psum_check(mesh, "bogus", "dcn")
+        assert not bad.ok
+        assert "bogus" in bad.error
+
 
 class TestGangEnv:
     def test_absent(self):
@@ -136,7 +201,13 @@ class TestValidateSlice:
         assert report.n_devices == 8
         assert report.busbw_gbps > 0
         ops = {c["op"] for c in report.checks}
-        assert ops == {"psum", "all_gather", "ppermute_ring", "psum_bandwidth"}
+        assert ops == {
+            "psum",
+            "all_gather",
+            "ppermute_ring",
+            "psum_bandwidth",
+            "hierarchical_psum",  # 4x2 slice: two axes to hierarchize over
+        }
 
     @pytest.mark.slow
     def test_train_stage_includes_ring_and_moe_configurations(self):

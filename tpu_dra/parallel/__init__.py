@@ -49,6 +49,8 @@ from tpu_dra.parallel.mesh import (
 from tpu_dra.parallel.collectives import (
     CollectiveReport,
     all_gather_check,
+    hierarchical_psum,
+    hierarchical_psum_check,
     psum_bandwidth,
     psum_check,
     ring_check,
@@ -63,6 +65,8 @@ __all__ = [
     "TrainReport",
     "train",
     "all_gather_check",
+    "hierarchical_psum",
+    "hierarchical_psum_check",
     "logical_mesh",
     "psum_bandwidth",
     "psum_check",

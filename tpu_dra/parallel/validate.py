@@ -176,6 +176,21 @@ def validate_slice(
             report.errors.append(f"psum_bandwidth[{axis}]: {r.error}")
     report.busbw_gbps = best_bw
 
+    # Two-level all-reduce across the two largest non-trivial axes — the
+    # multi-host pattern (reduce-scatter over the fast inner axis, psum
+    # the 1/n chunk over the outer, all-gather back).  A slice that can't
+    # run it won't scale past one host, so it is part of acceptance
+    # whenever the claim has two axes to hierarchize over.
+    if len(axes) >= 2:
+        from tpu_dra.parallel.collectives import hierarchical_psum_check
+
+        by_size = sorted(axes, key=lambda a: mesh.shape[a], reverse=True)
+        inner, outer = by_size[0], by_size[1]  # inner = fast/ICI role
+        r = hierarchical_psum_check(mesh, inner, outer)
+        report.checks.append(_compact(r))
+        if not r.ok:
+            report.errors.append(f"hierarchical_psum[{r.axis}]: {r.error}")
+
     # Cross-host: one all-reduce over every chip of every gang member.
     if report.gang is not None:
         from tpu_dra.parallel.gang import gang_allreduce
