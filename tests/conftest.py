@@ -11,9 +11,10 @@ on a single-core box):
 - ``pytest tests -q``            — fast suite: compile-heavy tests skipped.
 - ``pytest tests -q --runslow``  — everything (CI runs this).
 
-A persistent JAX compilation cache under ``.jax_cache/`` makes repeat runs
-of the compile-heavy tests much cheaper across processes (first run pays,
-later dev iterations reuse).
+An OPT-IN persistent JAX compilation cache (``TPU_DRA_JAX_CACHE=1``,
+``.jax_cache/``) makes repeat runs of the compile-heavy tests ~2.4x
+cheaper across processes — see the hazard note at the cache block below
+before enabling it.
 """
 
 import os
@@ -42,19 +43,23 @@ jax.config.update("jax_platforms", "cpu")
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
-# Persistent compilation cache: cuts repeat-run compile cost ~2.4x on this
-# box (cache is per-machine; entries embed host features).  Set through
-# the ENV, not only jax.config, so the compile-heavy subprocess tests
-# (gang workers, wire rigs, bench children — they inherit os.environ but
-# not this process's jax.config) share the same cache.
-_cache_dir = os.path.join(_REPO_ROOT, ".jax_cache")
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
-try:
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-except Exception:
-    pass  # older jax without the persistent cache: run uncached
+# Persistent compilation cache: cuts repeat-run compile cost ~2.4x —
+# OPT-IN via TPU_DRA_JAX_CACHE=1, not default.  XLA:CPU restores cached
+# AOT executables whose embedded machine-feature list can mismatch the
+# host's (the prefer-no-scatter/gather pseudo-features), and a stale
+# entry reproducibly ABORTED the interpreter mid-suite on this box
+# (SIGABRT inside jax.device_get) — exactly the hazard the loader's
+# ERROR log warns about.  Env-propagated when enabled so subprocess
+# tests share the cache; wipe .jax_cache/ if a crash appears.
+if os.environ.get("TPU_DRA_JAX_CACHE") == "1":
+    _cache_dir = os.path.join(_REPO_ROOT, ".jax_cache")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # older jax without the persistent cache: run uncached
 
 
 def pytest_addoption(parser):

@@ -195,15 +195,22 @@ def hierarchical_psum_check(mesh, ici_axis: str, dcn_axis: str) -> CollectiveRep
         )
         got = np.asarray(jax.device_get(compiled(x)))
         want = np.asarray(jax.device_get(f_flat(x)))
-        ok = bool(np.allclose(got, want))
-        if "reduce-scatter" not in compiled.as_text():
-            ok = False
+        # Two independent failure modes, reported separately: wrong
+        # numbers mean broken hardware; a missing reduce-scatter means
+        # the compiler dropped the hierarchy (the DCN-traffic guarantee).
+        numeric_ok = bool(np.allclose(got, want))
+        structural_ok = "reduce-scatter" in compiled.as_text()
+        failures = []
+        if not numeric_ok:
+            failures.append("mismatch vs flat psum")
+        if not structural_ok:
+            failures.append("no reduce-scatter in compiled HLO")
         return CollectiveReport(
             op="hierarchical_psum",
             axis=f"{ici_axis}x{dcn_axis}",
             n_devices=n,
-            ok=ok,
-            error="" if ok else "mismatch vs flat psum or no reduce-scatter in HLO",
+            ok=not failures,
+            error="; ".join(failures),
         )
     except Exception as e:
         return CollectiveReport(
@@ -213,6 +220,44 @@ def hierarchical_psum_check(mesh, ici_axis: str, dcn_axis: str) -> CollectiveRep
             ok=False,
             error=str(e),
         )
+
+
+def timed_allreduce_report(
+    op: str,
+    axis_label: str,
+    n: int,
+    fn,
+    x,
+    nbytes: int,
+    *,
+    iters: int = 10,
+    warmup: int = 2,
+) -> CollectiveReport:
+    """Shared timing scaffold for all-reduce-shaped measurements: warm
+    runs, p50 over timed runs, and ring-all-reduce bus-bandwidth
+    accounting (module docstring) — one implementation so every caller's
+    number is computed identically and stays comparable."""
+    import jax
+
+    for _ in range(max(1, warmup)):
+        jax.block_until_ready(fn(x))
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        samples.append(time.perf_counter() - t0)
+    p50 = statistics.median(samples)
+    busbw = (2 * (n - 1) / n) * nbytes / p50 / 1e9 if n > 1 and p50 > 0 else 0.0
+    return CollectiveReport(
+        op=op,
+        axis=axis_label,
+        n_devices=n,
+        ok=True,
+        bytes_per_device=nbytes,
+        seconds_p50=p50,
+        busbw_gbps=busbw,
+        samples=samples,
+    )
 
 
 def psum_bandwidth(
@@ -249,24 +294,9 @@ def psum_bandwidth(
         # One shard of `elems` elements per device along the axis.
         x = jnp.ones((elems * n,), dtype=dtype)
         f = jax.jit(_shard_map(body, mesh, in_specs=(spec,), out_specs=spec))
-        for _ in range(max(1, warmup)):
-            jax.block_until_ready(f(x))
-        samples = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            jax.block_until_ready(f(x))
-            samples.append(time.perf_counter() - t0)
-        p50 = statistics.median(samples)
-        busbw = (2 * (n - 1) / n) * nbytes / p50 / 1e9 if n > 1 and p50 > 0 else 0.0
-        return CollectiveReport(
-            op="psum_bandwidth",
-            axis=axis,
-            n_devices=n,
-            ok=True,
-            bytes_per_device=nbytes,
-            seconds_p50=p50,
-            busbw_gbps=busbw,
-            samples=samples,
+        return timed_allreduce_report(
+            "psum_bandwidth", axis, n, f, x, nbytes,
+            iters=iters, warmup=warmup,
         )
     except Exception as e:
         return CollectiveReport(

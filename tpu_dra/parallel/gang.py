@@ -78,19 +78,108 @@ def initialize_gang(gang: "GangEnv | None" = None) -> "GangEnv | None":
 
 
 def gang_allreduce(mbytes: int = 16):
-    """Global psum across every chip of every gang member.
+    """Global all-reduce across every chip of every gang member — the pjit
+    acceptance check.  Returns a CollectiveReport over the full global
+    device set.
 
-    Returns a CollectiveReport over the full global device set — the pjit
-    all-reduce acceptance check.  ICI carries the intra-slice reduction,
-    DCN the cross-host hop; XLA picks the hierarchy from the mesh.
+    Multi-process gangs reduce over an explicit (dcn=hosts, ici=local
+    chips) mesh with the two-level hierarchical_psum, so the cross-host
+    hop carries 1/n_local of the bytes BY CONSTRUCTION (collectives.py;
+    the structure is asserted there, not left to the partitioner's mood).
+    Single-process slices reduce flat over one axis.
     """
     import jax
 
     from tpu_dra.parallel.collectives import psum_bandwidth
     from tpu_dra.parallel.mesh import logical_mesh
 
+    if jax.process_count() > 1:
+        return hierarchical_allreduce_bandwidth(mbytes=mbytes)
     mesh = logical_mesh(jax.devices(), data=-1, fsdp=1, model=1)
     return psum_bandwidth(mesh, "data", mbytes=mbytes)
+
+
+def hierarchical_allreduce_bandwidth(
+    mbytes: int = 16, iters: int = 10, warmup: int = 2
+):
+    """Timed two-level all-reduce over the gang's (dcn, ici) mesh.
+
+    The mesh rows are grouped by PROCESS (sorted by (process_index, id))
+    — jax.devices() order alone does not guarantee host-major grouping,
+    and an ungrouped reshape would put cross-host links on the "ici"
+    axis, silently measuring the wrong thing.  Unequal per-host device
+    counts (a degraded member) are reported as a failure, not reshaped
+    around.  Timing/busbw accounting shares ``timed_allreduce_report``
+    with ``psum_bandwidth``, so the numbers are computed identically and
+    stay directly comparable — the hierarchy changes which LINK the
+    bytes cross, not the algorithmic volume."""
+    import collections
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dra.parallel.collectives import (
+        CollectiveReport,
+        _shard_map,
+        hierarchical_psum,
+        timed_allreduce_report,
+    )
+
+    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    n = len(devices)
+    try:
+        counts = collections.Counter(d.process_index for d in devices)
+        n_procs = len(counts)
+        if len(set(counts.values())) != 1:
+            return CollectiveReport(
+                op="hierarchical_allreduce",
+                axis="icixdcn",
+                n_devices=n,
+                ok=False,
+                error=(
+                    "unequal local device counts per host: "
+                    f"{dict(sorted(counts.items()))}"
+                ),
+            )
+        n_local = n // n_procs
+        mesh = Mesh(
+            np.array(devices).reshape(n_procs, n_local), ("dcn", "ici")
+        )
+        spec = P(("dcn", "ici"))
+        elems_per_dev = max(
+            n_local, mbytes * (1024**2) // 4 // n_local * n_local
+        )
+        x = jnp.ones((elems_per_dev * n,), jnp.float32)
+        f = jax.jit(
+            _shard_map(
+                lambda v: hierarchical_psum(v, "ici", "dcn"),
+                mesh,
+                in_specs=(spec,),
+                out_specs=spec,
+            )
+        )
+        return timed_allreduce_report(
+            "hierarchical_allreduce",
+            f"ici[{n_local}]xdcn[{n_procs}]",
+            n,
+            f,
+            x,
+            elems_per_dev * 4,
+            iters=iters,
+            warmup=warmup,
+        )
+    except Exception as e:
+        return CollectiveReport(
+            op="hierarchical_allreduce",
+            axis="icixdcn",
+            n_devices=n,
+            ok=False,
+            error=str(e),
+        )
 
 
 def barrier() -> None:
