@@ -411,6 +411,12 @@ def bench_wire(samples: int = 8) -> "dict":
                     "--registrar-root", os.path.join(tmp.name, "registry"),
                     "--state-dir", os.path.join(tmp.name, "state"),
                     "--http-endpoint", "127.0.0.1:0",
+                    # Like the controller below: the reference's QPS 5 /
+                    # burst 10 defaults throttle the bench to the token
+                    # bucket (a flat 0.2s per NAS op once the burst is
+                    # spent — measured); measure the driver instead.
+                    "--kube-apiserver-qps", "1000",
+                    "--kube-apiserver-burst", "1000",
                 ]
             )
         )

@@ -23,8 +23,10 @@ Scheme ``http://`` is accepted for plain test servers; real clusters use
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
+import socket
 import ssl
 import tempfile
 import threading
@@ -167,6 +169,30 @@ class _TokenBucket:
             time.sleep(wait)
 
 
+class _NoDelayHTTPConnection(http.client.HTTPConnection):
+    def connect(self):
+        super().connect()
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+
+class _NoDelayHTTPSConnection(http.client.HTTPSConnection):
+    def connect(self):
+        super().connect()
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+
+class _NoDelayHTTPHandler(urllib.request.HTTPHandler):
+    def http_open(self, req):
+        return self.do_open(_NoDelayHTTPConnection, req)
+
+
+class _NoDelayHTTPSHandler(urllib.request.HTTPSHandler):
+    def https_open(self, req):
+        return self.do_open(
+            _NoDelayHTTPSConnection, req, context=self._context
+        )
+
+
 @dataclass
 class RestApiServer:
     """FakeApiServer-protocol client over a real apiserver."""
@@ -177,6 +203,9 @@ class RestApiServer:
     timeout_s: float = 30.0
     _limiter: _TokenBucket = field(init=False, repr=False)
     _ssl: "ssl.SSLContext | None" = field(init=False, repr=False, default=None)
+    _opener: "urllib.request.OpenerDirector" = field(
+        init=False, repr=False, default=None
+    )
 
     def __post_init__(self):
         self._limiter = _TokenBucket(self.qps, self.burst)
@@ -190,6 +219,16 @@ class RestApiServer:
             if self.config.client_cert_file:
                 ctx.load_cert_chain(self.config.client_cert_file, self.config.client_key_file or None)
             self._ssl = ctx
+        # TCP_NODELAY opener: http.client sends request headers and body
+        # in separate send()s, a write-write-read pattern that Nagle x
+        # delayed-ACK can stall for tens of ms on multi-segment payloads
+        # (kernel-dependent).  Cheap insurance on the latency-sensitive
+        # wire path; the client-side QPS limiter remains the intentional
+        # throttle (reference kubeclient.go:43-57 defaults).
+        self._opener = urllib.request.build_opener(
+            _NoDelayHTTPHandler(),
+            _NoDelayHTTPSHandler(context=self._ssl),
+        )
 
     # -- wire ---------------------------------------------------------------
 
@@ -227,8 +266,8 @@ class RestApiServer:
         if self.config.token:
             req.add_header("Authorization", f"Bearer {self.config.token}")
         try:
-            resp = urllib.request.urlopen(
-                req, timeout=timeout if timeout is not None else self.timeout_s, context=self._ssl
+            resp = self._opener.open(
+                req, timeout=timeout if timeout is not None else self.timeout_s
             )
         except urllib.error.HTTPError as e:
             raise _to_api_error(e) from None

@@ -66,6 +66,11 @@ class HttpApiServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Headers and body go out in separate send()s; Nagle can hold
+            # the body segment for the peer's delayed ACK on multi-segment
+            # responses (kernel-dependent, tens of ms).  Cheap insurance
+            # on the wire rung's serving side.
+            disable_nagle_algorithm = True
 
             def log_message(self, *a):
                 pass
