@@ -10,6 +10,8 @@ concurrently against limited capacity must never double-allocate a chip.
 import threading
 import time
 
+import pytest
+
 from tpu_dra.api.k8s import (
     Pod,
     ResourceClaim,
@@ -277,6 +279,7 @@ class TestProxyReadinessUnderLoad:
             h.start()
         return stop, hogs
 
+    @pytest.mark.slow
     def test_shared_claim_ready_under_cpu_hog(self, tmp_path):
         import os
 

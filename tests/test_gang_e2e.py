@@ -93,6 +93,7 @@ def read_gang_env(tmp_path, cluster, claim_uid) -> dict:
 
 class TestMultiHostGang:
 
+    @pytest.mark.slow
     def test_two_pods_form_one_jax_distributed_system(self, tmp_path):
         port = free_port()
         cluster = SimCluster(

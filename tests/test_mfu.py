@@ -172,6 +172,7 @@ class TestFallbackLadder:
 
 
 class TestMeasurement:
+    @pytest.mark.slow
     def test_measure_mfu_cpu_rung(self):
         r = measure_mfu(BurninConfig(), warmup_steps=1, timed_steps=2)
         assert r.ok, r.error
