@@ -191,6 +191,38 @@ class TestLongContextMoe:
         # margin so compiler-version noise can't flip the verdict.
         assert local_temp * 1.4 < global_temp, (local_temp, global_temp)
 
+    def test_local_routing_matches_global_when_capacity_ample(self):
+        """With capacity that never binds, drop order is irrelevant and
+        group-local routing must equal global routing for ANY group count
+        — only capacity pressure may make them diverge (per-group vs
+        global queues)."""
+        import jax.numpy as jnp
+
+        from tpu_dra.parallel.moe import (
+            init_moe_layer_params,
+            moe_mlp,
+            moe_mlp_local,
+        )
+
+        c = BurninConfig(
+            n_layers=1, seq=32, d_model=16, d_ff=32, moe_experts=4,
+            moe_capacity=4.0,  # >= worst case: every token to one expert
+        )
+        params = init_moe_layer_params(c, jax.random.PRNGKey(3))
+        layer = {k: v[0] for k, v in params.items()}
+        h = jax.random.normal(
+            jax.random.PRNGKey(4), (c.batch, c.seq, c.d_model), jnp.bfloat16
+        )
+        ident = lambda kind, arr: arr  # noqa: E731
+        out_g, aux_g = moe_mlp(layer, h, c, ident)
+        for groups in (1, 2, 4):
+            out_l, aux_l = moe_mlp_local(layer, h, c, ident, groups)
+            assert jnp.allclose(out_g, out_l, atol=1e-2), (
+                groups,
+                float(jnp.abs(out_g - out_l).max()),
+            )
+            assert jnp.allclose(aux_g, aux_l, rtol=1e-5), groups
+
     def test_local_routing_single_group_matches_global_math(self):
         """With one group the local path IS the global path (same cumsum
         domain, same capacity) — outputs must agree bitwise-close."""
