@@ -1,0 +1,146 @@
+"""Ulysses (a2a) context parallelism: exactness vs the oracle, the
+collective story, training integration, and the composition matrix
+(tpu_dra/parallel/ulysses.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_dra.parallel.burnin import BurninConfig, burnin_mesh, train
+from tpu_dra.parallel.mesh import logical_mesh
+from tpu_dra.parallel.ring import reference_attention
+from tpu_dra.parallel.ulysses import ulysses_attention_sharded
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return logical_mesh(jax.devices(), data=2, fsdp=1, model=4)
+
+
+def qkv(B=4, S=64, H=8, D=16, dtype=jnp.float32, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(kq, (B, S, H, D), dtype),
+        jax.random.normal(kk, (B, S, H, D), dtype),
+        jax.random.normal(kv, (B, S, H, D), dtype),
+    )
+
+
+class TestExactness:
+    """Unlike the ring's online softmax, each head's attention here IS the
+    single-device computation — the a2a only moves data, so agreement with
+    the oracle is exact in fp32."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, mesh, causal):
+        q, k, v = qkv()
+        got = ulysses_attention_sharded(q, k, v, mesh, "model", causal=causal)
+        want = reference_attention(q, k, v, causal=causal)
+        assert float(jnp.abs(got - want).max()) == 0.0
+
+    def test_bf16_inputs(self, mesh):
+        q, k, v = (x.astype(jnp.bfloat16) for x in qkv())
+        got = ulysses_attention_sharded(q, k, v, mesh, "model")
+        want = reference_attention(q, k, v, causal=True)
+        err = float(
+            jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32)).max()
+        )
+        assert err < 5e-2
+
+    def test_flash_body_matches(self, mesh):
+        """The pallas kernel on the head-sharded view (interpret mode on
+        CPU) — the composition the ring cannot offer."""
+        q, k, v = (x.astype(jnp.bfloat16) for x in qkv())
+        got = ulysses_attention_sharded(
+            q, k, v, mesh, "model", flash=True, flash_block=32
+        )
+        want = reference_attention(q, k, v, causal=True)
+        err = float(
+            jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32)).max()
+        )
+        assert err < 5e-2
+
+
+class TestCollectiveStory:
+    def test_compiled_carries_all_to_all(self, mesh):
+        q, k, v = qkv()
+        f = jax.jit(
+            lambda q, k, v: ulysses_attention_sharded(q, k, v, mesh, "model")
+        )
+        hlo = f.lower(q, k, v).compile().as_text()
+        assert "all-to-all" in hlo
+
+    def test_heads_divisibility_enforced(self, mesh):
+        q, k, v = qkv(H=6)  # 6 % 4 != 0
+        with pytest.raises(ValueError, match="heads"):
+            ulysses_attention_sharded(q, k, v, mesh, "model")
+
+    def test_seq_divisibility_enforced(self, mesh):
+        q, k, v = qkv(S=30)  # 30 % 4 != 0
+        with pytest.raises(ValueError, match="seq"):
+            ulysses_attention_sharded(q, k, v, mesh, "model")
+
+
+class TestTraining:
+    def test_loss_decreases_on_mesh(self):
+        r = train(
+            BurninConfig(ulysses_attention=True, n_layers=2),
+            burnin_mesh(jax.devices()),
+            steps=6,
+        )
+        assert r.ok, r
+        assert r.loss_last < r.loss_first
+
+    @pytest.mark.slow
+    def test_composes_with_flash_and_moe(self):
+        from tpu_dra.parallel.moe import moe_mesh
+
+        rf = train(
+            BurninConfig(
+                ulysses_attention=True, flash_attention=True, n_layers=2
+            ),
+            burnin_mesh(jax.devices()),
+            steps=4,
+        )
+        assert rf.ok, rf
+        rm = train(
+            BurninConfig(ulysses_attention=True, moe_experts=4, n_layers=2),
+            moe_mesh(jax.devices(), model=2, expert=2),
+            steps=4,
+        )
+        assert rm.ok, rm
+
+    def test_ring_and_ulysses_mutually_exclusive(self):
+        r = train(
+            BurninConfig(ring_attention=True, ulysses_attention=True),
+            burnin_mesh(jax.devices()),
+            steps=2,
+        )
+        assert not r.ok
+        assert "flavors" in r.error
+
+    def test_flash_degenerate_block_rejected(self):
+        # Same TPU tiling minimum the tp flash path enforces: gcd(128,
+        # seq) < 8 must fail the burn-in, not silently "validate".
+        r = train(
+            BurninConfig(
+                ulysses_attention=True, flash_attention=True, seq=100
+            ),
+            burnin_mesh(jax.devices()),
+            steps=2,
+        )
+        assert not r.ok
+        assert "seq % 8" in r.error
+
+    def test_requires_mesh(self):
+        r = train(BurninConfig(ulysses_attention=True), mesh=None, steps=2)
+        assert not r.ok
+        assert "device mesh" in r.error
+
+    def test_family_preset_registered(self):
+        from tpu_dra.models import family_config
+
+        c = family_config("long_context_a2a")
+        assert c.ulysses_attention and c.flash_attention

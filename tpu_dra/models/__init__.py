@@ -46,6 +46,13 @@ def _preset(defaults: dict) -> "Callable[..., BurninConfig]":
 FAMILIES: "dict[str, Callable[..., BurninConfig]]" = {
     "dense": _preset({}),
     "long_context": _preset({"ring_attention": True}),
+    # The a2a (Ulysses) cp flavor: seq-sharding swapped for head-sharding
+    # around ordinary full-sequence attention, WITH the pallas flash
+    # kernel on the head-sharded view (the composition the ring cannot
+    # offer) — tpu_dra/parallel/ulysses.py.
+    "long_context_a2a": _preset(
+        {"ulysses_attention": True, "flash_attention": True}
+    ),
     "moe": _preset({"moe_experts": 4}),
     # cp x ep (x tp): ring attention + routed experts — needs the 4-axis
     # moe_mesh (family_mesh refuses indivisible device counts).

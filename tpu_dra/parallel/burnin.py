@@ -25,14 +25,18 @@ collectives):
 - **sp**: the residual stream between blocks is sequence-sharded over
   ``model`` (Megatron sequence parallelism — the all-gather/reduce-scatter
   pair replaces the psum, halving peak activation memory in norm regions).
-- **cp** (``ring_attention=True``): the whole transformer stack runs
-  context-parallel — the sequence stays sharded through attention (K/V
-  blocks rotate around the ``model`` axis ring, tpu_dra/parallel/ring.py)
-  AND through the position-wise MLP, so no chip materializes the full
-  sequence or an s x s score matrix anywhere between embedding and logits.
+- **cp** (``ring_attention=True`` or ``ulysses_attention=True``): the
+  whole transformer stack runs context-parallel — the residual stream
+  stays sequence-sharded through attention AND the position-wise MLP.
   Weights are replicated over the model axis in this mode (fsdp still
-  shards them).  This is the long-context configuration: per-chip
-  attention memory is O((s/P)^2) and activations are O(s/P).
+  shards them).  The two flavors differ INSIDE attention:
+  ring (tpu_dra/parallel/ring.py) rotates K/V around the axis, so no
+  chip ever materializes the full sequence or an s x s score matrix —
+  per-chip attention memory O((s/P)^2); Ulysses
+  (tpu_dra/parallel/ulysses.py) a2a-swaps to head-sharding, so each chip
+  DOES hold the full sequence for its H/P heads (activations still
+  O(B*s*d/P)) and score memory is O(s^2) per local head unless
+  flash_attention=True tiles it — size long-context runs accordingly.
 
 Compiler-friendliness: layers are stacked and iterated with ``lax.scan``
 (one trace regardless of depth), every shape is static, blocks are
@@ -97,6 +101,13 @@ class BurninConfig:
     # Context parallelism: ring attention over the mesh's ``model`` axis
     # (sequence stays sharded through attention; heads replicated there).
     ring_attention: bool = False
+    # Context parallelism, Ulysses flavor (parallel/ulysses.py): a2a swaps
+    # seq-sharding for head-sharding around ordinary full-sequence
+    # attention.  Same external contract as the ring (sequence sharded
+    # through the block); pick per workload — see the module docstring
+    # for the communication/memory trade.  Composes with flash_attention
+    # (the kernel runs on the head-sharded view).
+    ulysses_attention: bool = False
     # The pallas flash kernel (parallel/flash.py) instead of XLA's
     # materialized-scores attention; on a mesh each tp shard runs it on
     # its local heads.  Mutually exclusive with ring_attention (the ring
@@ -121,6 +132,12 @@ class BurninConfig:
             raise ValueError(f"d_model {self.d_model} not divisible by n_heads {self.n_heads}")
         return self.d_model // self.n_heads
 
+    @property
+    def context_parallel(self) -> bool:
+        """Either cp flavor: the sequence stays sharded through the whole
+        block (attention via ring or Ulysses, MLP position-wise)."""
+        return self.ring_attention or self.ulysses_attention
+
     def scaled_to(self, mesh) -> "BurninConfig":
         """Grow batch/heads/ff minimally so every sharded dim divides its
         mesh axis — keeps tiny configs valid on any claimed slice.  Works
@@ -137,11 +154,11 @@ class BurninConfig:
         model = shape.get("model", 1)
         pipe = shape.get("pipe", 1)
         data = shape.get("data", 1) * fsdp
-        if self.ring_attention:
-            # ring_attention_sharded shards batch over every non-model
-            # axis (ring.py:136), so on a moe_mesh the expert axis joins
-            # the batch product (caught by dryrun_multichip(64): 16 data
-            # x 2 expert needs batch % 32 == 0).
+        if self.context_parallel:
+            # Both cp flavors shard batch over every non-model axis
+            # (ring.py:136, ulysses.py spec), so on a moe_mesh the expert
+            # axis joins the batch product (caught by dryrun_multichip(64):
+            # 16 data x 2 expert needs batch % 32 == 0).
             data *= shape.get("expert", 1)
         batch = _round_up(self.batch, data)
         if self.pipeline_stages > 0:
@@ -257,7 +274,7 @@ def param_specs(config: BurninConfig, mesh=None):
             "layers": {**mats, "ln1": P("pipe"), "ln2": P("pipe")},
             "ln_f": P(None),
         }
-    if config.ring_attention:
+    if config.context_parallel:
         # cp: the model axis carries the sequence, so no weight is sharded
         # over it — fsdp alone shards parameters.
         matrices = {
@@ -284,14 +301,14 @@ def param_specs(config: BurninConfig, mesh=None):
         # cp x ep: the model axis carries the sequence, so the expert FFN
         # dims must not ride it (moe_param_specs ring flavor).
         matrices.update(
-            moe_param_specs(expert_axis, ring=config.ring_attention)
+            moe_param_specs(expert_axis, ring=config.context_parallel)
         )
     # In cp mode the model axis carries the SEQUENCE: sharding d_model over
     # it in the embedding would make every lookup produce a layout the
     # partitioner can only reconcile with the sequence-sharded stream by
     # full rematerialization (observed); fsdp alone shards the table there.
-    embed = P("fsdp", None) if config.ring_attention else P("fsdp", "model")
-    pos = P(None, None) if config.ring_attention else P(None, "model")
+    embed = P("fsdp", None) if config.context_parallel else P("fsdp", "model")
+    pos = P(None, None) if config.context_parallel else P(None, "model")
     return {
         "embed": embed,
         "pos": pos,
@@ -320,8 +337,9 @@ def _rms_norm(x, scale):
 def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
     """One pre-norm transformer block.  ``constrain(kind, arr)`` applies the
     sp/tp sharding constraints; identity when running unsharded.  With
-    ``ring_mesh`` set (and config.ring_attention), attention runs
-    context-parallel: the sequence stays sharded and K/V ride the ring.
+    ``ring_mesh`` set (and a cp flavor enabled), attention runs
+    context-parallel: the sequence stays sharded outside attention; the
+    ring rotates K/V, Ulysses a2a-swaps to head-sharding inside.
 
     Returns ``(x, aux)`` — aux is the MoE load-balance loss for this block
     (0.0 when the MLP is dense)."""
@@ -331,16 +349,35 @@ def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
     bf16 = jnp.bfloat16
     aux = jnp.zeros((), jnp.float32)
 
-    if c.ring_attention and ring_mesh is not None:
-        # --- attention (cp: ring over the model axis, heads replicated) ---
-        from tpu_dra.parallel.ring import ring_attention_sharded
-
+    if c.context_parallel and ring_mesh is not None:
+        # --- attention (cp: seq stays sharded; ring rotates K/V, Ulysses
+        # a2a-swaps to head-sharding for ordinary full-seq attention) ---
         h = constrain("seq", x)  # stays (batch, seq/model, d) throughout
         h = _rms_norm(h, layer["ln1"]).astype(bf16)
         qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(bf16))
-        att = ring_attention_sharded(
-            qkv[0], qkv[1], qkv[2], ring_mesh, "model", causal=True
-        )
+        if c.ulysses_attention:
+            import math
+
+            from tpu_dra.parallel.ulysses import ulysses_attention_sharded
+
+            block = math.gcd(128, c.seq)
+            if c.flash_attention and block < 8:
+                # Same TPU tiling minimum the tp flash path enforces: a
+                # degenerate tile must fail the burn-in, not "validate".
+                raise ValueError(
+                    f"flash_attention needs seq % 8 == 0, got seq={c.seq}"
+                )
+            att = ulysses_attention_sharded(
+                qkv[0], qkv[1], qkv[2], ring_mesh, "model", causal=True,
+                flash=c.flash_attention,
+                flash_block=block,
+            )
+        else:
+            from tpu_dra.parallel.ring import ring_attention_sharded
+
+            att = ring_attention_sharded(
+                qkv[0], qkv[1], qkv[2], ring_mesh, "model", causal=True
+            )
         att = jnp.einsum("bshk,hkd->bsd", att, layer["wo"].astype(bf16))
         x = x + constrain("seq", att)
     else:
@@ -387,7 +424,7 @@ def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
         att = jnp.einsum("bshk,hkd->bsd", att, layer["wo"].astype(bf16))
         x = x + constrain("seq", att)  # row-parallel out: XLA reduce-scatters into sp
 
-    if c.ring_attention and ring_mesh is not None:
+    if c.context_parallel and ring_mesh is not None:
         # --- mlp (cp: position-wise, sequence stays sharded) ---
         # No hidden gather: in the long-context configuration nothing may
         # materialize the full sequence on one chip; d_ff is replicated
@@ -439,24 +476,32 @@ def forward(params, tokens, config: BurninConfig, mesh=None, *, return_aux=False
     import jax.numpy as jnp
 
     c = config
+    if c.ring_attention and c.ulysses_attention:
+        raise ValueError(
+            "ring_attention and ulysses_attention are two flavors of the "
+            "same context parallelism; pick one"
+        )
     if c.ring_attention and c.flash_attention:
         raise ValueError(
             "ring_attention and flash_attention are mutually exclusive "
             "(the ring shards the sequence over the model axis; flash "
-            "tiles the full sequence per tp shard)"
+            "tiles the full sequence per tp shard).  ulysses_attention "
+            "DOES compose with flash (the kernel runs on the head-sharded "
+            "full-sequence view)"
         )
     if (
-        c.ring_attention
+        c.context_parallel
         and c.moe_experts > 0
         and (mesh is None or "expert" not in mesh.shape)
     ):
         raise ValueError(
-            "ring_attention + moe_experts needs a mesh with a dedicated "
-            "expert axis (tpu_dra.parallel.moe.moe_mesh): the ring shards "
-            "the sequence over the model axis, so experts cannot ride it"
+            "context parallelism + moe_experts needs a mesh with a "
+            "dedicated expert axis (tpu_dra.parallel.moe.moe_mesh): cp "
+            "shards the sequence over the model axis, so experts cannot "
+            "ride it"
         )
     if c.pipeline_stages > 0:
-        if c.ring_attention or c.flash_attention:
+        if c.context_parallel or c.flash_attention:
             raise ValueError(
                 "pipeline_stages is not combined with ring/flash attention: "
                 "the ring rotates K/V over the model axis, which inside the "
@@ -474,11 +519,11 @@ def forward(params, tokens, config: BurninConfig, mesh=None, *, return_aux=False
         logits, aux = forward_pipelined(params, tokens, c, mesh)
         return (logits, aux) if return_aux else logits
     if mesh is None:
-        if c.ring_attention:
+        if c.context_parallel:
             # A silent dense fallback would let a single-chip check report
             # the long-context configuration as validated without running
-            # one line of the ring path.
-            raise ValueError("ring_attention requires a device mesh")
+            # one line of the cp path.
+            raise ValueError("context-parallel attention requires a device mesh")
         constrain = lambda kind, arr: arr  # noqa: E731
     else:
         constrain = make_constrain(mesh, ("data", "fsdp"))
@@ -486,11 +531,12 @@ def forward(params, tokens, config: BurninConfig, mesh=None, *, return_aux=False
     # Pin the post-embedding activation layout immediately: without it the
     # partitioner has been seen to pick a gather sharding it can only
     # reconcile with the first block's input by full rematerialization
-    # (observed on the 4-axis moe_mesh).  Ring mode pins to the
-    # sequence-sharded layout — cp's invariant is that no chip holds the
-    # full sequence anywhere between embedding and logits.
+    # (observed on the 4-axis moe_mesh).  cp modes pin to the
+    # sequence-sharded layout: the residual stream is never whole on one
+    # chip (inside attention, Ulysses temporarily holds the full sequence
+    # for H/P heads — the ring never does).
     x = constrain(
-        "seq" if c.ring_attention else "hidden",
+        "seq" if c.context_parallel else "hidden",
         params["embed"][tokens] + params["pos"][None, :, :],
     )
 
