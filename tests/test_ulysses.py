@@ -49,6 +49,7 @@ class TestExactness:
         )
         assert err < 5e-2
 
+    @pytest.mark.slow
     def test_flash_body_matches(self, mesh):
         """The pallas kernel on the head-sharded view (interpret mode on
         CPU) — the composition the ring cannot offer."""
@@ -84,6 +85,7 @@ class TestCollectiveStory:
 
 
 class TestTraining:
+    @pytest.mark.slow
     def test_loss_decreases_on_mesh(self):
         r = train(
             BurninConfig(ulysses_attention=True, n_layers=2),
