@@ -878,7 +878,35 @@ def bench_northstar_mesh(timeout_s: float = 420.0) -> "dict":
         return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
 
+def _merge_tpu_catch(compute: dict) -> dict:
+    """Attach the freshest tools/tpu_catch.py silicon measurement.
+
+    The axon tunnel flickers: it can be alive for a minute mid-round and
+    dead at bench time.  The catcher loop (tools/tpu_catch.py) measures the
+    instant a probe answers and saves the result; if this bench's own
+    attempt fell back to CPU, that earlier same-build TPU measurement is
+    attached under ``tpu_catch`` (with its ``caught_at`` stamp) rather than
+    lost.  It never *replaces* the live attempt — platform labels stay
+    honest either way."""
+    if compute.get("platform") == "tpu":
+        return compute
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".tpu_catch_result.json")
+    try:
+        with open(path) as f:
+            catch = json.load(f)
+    except (OSError, ValueError):
+        return compute
+    if catch.get("platform") == "tpu":
+        compute["tpu_catch"] = catch
+    return compute
+
+
 def main() -> int:
+    # Compute first: if the flickering TPU tunnel happens to be alive when
+    # the bench starts, measure it NOW — the CPU-only stanzas don't care
+    # when they run, the chip window does.
+    compute = _merge_tpu_catch(bench_compute())
     alloc = bench_claim_to_running(SAMPLES)
     fleet = bench_fleet_scale()
     try:
@@ -886,7 +914,6 @@ def main() -> int:
     except Exception as e:  # the wire rung must not sink the whole bench
         wire = {"ok": False, "error": f"{type(e).__name__}: {e}"}
     northstar = bench_northstar_mesh()
-    compute = bench_compute()
     p50 = alloc["p50_s"]
     line = {
         "metric": "claim_to_pod_running_p50",
