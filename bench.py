@@ -756,6 +756,58 @@ try:
 except Exception as e:
     out["psum_busbw"] = {"ok": False, "error": f"{type(e).__name__}: {e}"}
 print("BENCHJSON:" + json.dumps(out), flush=True)
+
+# Serving throughput: KV-cache greedy decode (parallel/decode.py) on the
+# same chip-sized config the MFU stanza measured.  Decode is the
+# memory-bound complement to training's MXU-bound step — tokens/s here is
+# dominated by streaming the weights per generated token, so it pairs
+# with the HBM stanza the way mfu pairs with the matmul peak.  Runs LAST,
+# after the psum emission: its chip-sized scan compile is the longest
+# single compile in this child, and the salvage protocol must not let it
+# cost any other stanza.
+try:
+    import time as _time
+
+    from tpu_dra.parallel.decode import make_generate
+
+    dc = mfu.config
+    if dc is None:
+        out["decode"] = {"ok": False, "error": "no mfu config to size from"}
+    elif not mfu.ok:
+        out["decode"] = {"ok": False, "error": "mfu stanza not ok; skipped"}
+    elif dc.context_parallel or dc.pipeline_stages:
+        out["decode"] = {
+            "ok": False, "error": "cp/pipeline config: no decode path",
+        }
+    else:
+        import dataclasses
+
+        dc = dataclasses.replace(dc, flash_attention=False)
+        from tpu_dra.parallel.burnin import init_params
+
+        steps = 64
+        plen = max(1, min(64, dc.seq - steps - 1))
+        gen = make_generate(dc, prompt_len=plen, steps=steps, with_health=True)
+        params = init_params(dc)
+        prompt = jnp.ones((dc.batch, plen), jnp.int32)
+        jax.block_until_ready(gen(params, prompt))  # compile + warmup
+        t0 = _time.perf_counter()
+        res, healthy = jax.block_until_ready(gen(params, prompt))
+        dt = _time.perf_counter() - t0
+        out["decode"] = {
+            "batch": dc.batch,
+            "prompt_len": plen,
+            "steps": steps,
+            "tokens_per_s": round(dc.batch * steps / dt, 1),
+            "step_ms": round(dt / steps * 1e3, 3),
+            # Generated tokens are non-negative by construction (argmax
+            # picks index 0 even from all-NaN logits), so health is the
+            # in-program all-logits-finite reduction.
+            "ok": bool(healthy) and res.shape[1] == plen + steps,
+        }
+except Exception as e:
+    out["decode"] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+print("BENCHJSON:" + json.dumps(out), flush=True)
 """
 
 
@@ -878,6 +930,35 @@ def bench_northstar_mesh(timeout_s: float = 420.0) -> "dict":
         return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
 
+def _measurement_fingerprint() -> str:
+    """sha256 (truncated) over the sources that define what the compute
+    child measures.  A tools/tpu_catch.py artifact is stamped with this at
+    catch time; `_merge_tpu_catch` compares it so a caught number from an
+    older build is attached with ``measurement_code_current: false`` rather
+    than passed off as a measurement of the code under test."""
+    import hashlib
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for rel in (
+        "tpu_dra/parallel/mfu.py",
+        "tpu_dra/parallel/burnin.py",
+        "tpu_dra/parallel/decode.py",
+        "tpu_dra/parallel/flash.py",
+        "tpu_dra/parallel/moe.py",
+        "tpu_dra/parallel/collectives.py",
+        "tpu_dra/parallel/ring.py",
+        "tpu_dra/parallel/ulysses.py",
+    ):
+        try:
+            with open(os.path.join(repo, rel), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(rel.encode())
+    h.update(_COMPUTE_CHILD.encode())
+    return h.hexdigest()[:16]
+
+
 def _merge_tpu_catch(compute: dict) -> dict:
     """Attach the freshest tools/tpu_catch.py silicon measurement.
 
@@ -898,6 +979,9 @@ def _merge_tpu_catch(compute: dict) -> dict:
     except (OSError, ValueError):
         return compute
     if catch.get("platform") == "tpu":
+        catch["measurement_code_current"] = (
+            catch.get("fingerprint") == _measurement_fingerprint()
+        )
         compute["tpu_catch"] = catch
     return compute
 

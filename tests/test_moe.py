@@ -9,6 +9,7 @@ batch-sharded <-> expert-sharded boundary.
 from __future__ import annotations
 
 import jax
+import numpy as np
 import pytest
 import jax.numpy as jnp
 
@@ -81,6 +82,46 @@ def test_moe_capacity_drops_overflow_tokens():
     assert float(jnp.abs(dropped).max()) == 0.0
     kept = out[0, :cap, :]
     assert float(jnp.abs(kept).sum()) > 0.0
+
+
+def test_moe_capacity_override_is_prefix_stable():
+    """Serving prefill (decode.py) calls moe_mlp on a sequence PREFIX with
+    the TRAINING capacity clamped to the prefix length.  The queue cumsum
+    only looks backward, so that call must reproduce the full-sequence
+    call's leading positions exactly — while a capacity RECOMPUTED from the
+    prefix length (the regression this pins) is smaller and drops prompt
+    tokens the training router kept."""
+    import dataclasses
+
+    c = BurninConfig(moe_experts=4, n_layers=1, batch=2, seq=16)  # C_train=5
+    params = init_params(c)
+    layer = jax.tree_util.tree_map(lambda l: l[0], params["layers"])
+    # Concentrate routing on expert 0 (positive h, router col 0 = 1): a
+    # 6-token prefix queues 6 > the recomputed capacity ceil(6/4*1.25)=2,
+    # making the old-code divergence deterministic, not seed-dependent.
+    layer = dict(layer)
+    layer["router"] = jnp.zeros_like(layer["router"]).at[:, 0].set(1.0)
+    h16 = (
+        jnp.abs(jax.random.normal(jax.random.PRNGKey(5), (2, 16, c.d_model)))
+        + 0.1
+    ).astype(jnp.bfloat16)
+    S = 6
+    ident = lambda kind, a: a  # noqa: E731
+
+    full = moe_mlp(layer, h16, c, ident)[0][:, :S]
+    clamped = moe_mlp(
+        layer, h16[:, :S], c, ident, capacity=min(S, expert_capacity(c))
+    )[0]
+    np.testing.assert_array_equal(np.asarray(clamped), np.asarray(full))
+
+    recomputed = moe_mlp(
+        layer, h16[:, :S], c, ident,
+        capacity=expert_capacity(dataclasses.replace(c, seq=S)),
+    )[0]
+    assert not np.array_equal(np.asarray(recomputed), np.asarray(full)), (
+        "recomputed prefix capacity should have dropped tokens the "
+        "training capacity kept — the override exists because it does"
+    )
 
 
 def test_moe_ring_needs_expert_axis():

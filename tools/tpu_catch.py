@@ -101,6 +101,10 @@ def main() -> int:
         out = run_compute(args.budget)
         out["caught_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         out["catch_attempt"] = attempt
+        # Stamp what code produced this number: bench._merge_tpu_catch
+        # compares the fingerprint so a catch from an older build is
+        # labeled stale instead of impersonating the code under test.
+        out["fingerprint"] = bench._measurement_fingerprint()
 
         # Keep the best result so far: a TPU-platform report (even not-ok)
         # beats none; an ok TPU report ends the hunt.

@@ -34,6 +34,10 @@ slice:
   over a ``pipe`` mesh axis (partial-manual shard_map + scan + ppermute
   hops); composes with tp/sp/ep inside each stage — one jitted step runs
   dp x pp x tp x ep on a (data, pipe, model) mesh.
+- ``tpu_dra.parallel.decode``      — the serving path: static-shape KV-cache
+  autoregressive generation (`lax.scan` token loop compiled once, masked
+  full-buffer attention, per-step dropless MoE routing), sharded with the
+  training layout minus the sequence axis.
 - ``tpu_dra.parallel.mfu``         — chip-sized MFU + HBM-bandwidth
   measurement with analytic FLOPs accounting vs published bf16 peaks.
 - ``tpu_dra.parallel.ckpt``        — sharding-aware checkpoint/resume of
@@ -57,6 +61,7 @@ from tpu_dra.parallel.collectives import (
 )
 from tpu_dra.parallel.validate import SliceReport, validate_slice
 from tpu_dra.parallel.burnin import BurninConfig, TrainReport, train
+from tpu_dra.parallel.decode import generate, make_generate
 
 __all__ = [
     "BurninConfig",
@@ -64,6 +69,8 @@ __all__ = [
     "SliceReport",
     "TrainReport",
     "train",
+    "generate",
+    "make_generate",
     "all_gather_check",
     "hierarchical_psum",
     "hierarchical_psum_check",

@@ -1,0 +1,322 @@
+"""Autoregressive decode (serving) path for the burn-in LM.
+
+Training (`burnin.forward`) processes a full ``(batch, seq)`` block per
+step; serving generates one token at a time.  A naive serve loop re-runs
+the full forward per token — O(s²·L·d) work for s tokens.  This module is
+the TPU-native incremental path:
+
+- **KV cache with static shapes**: per-layer K/V buffers of the model's
+  full context length, updated in place with ``lax.dynamic_update_slice``
+  — no growing arrays, so the decode step compiles ONCE and every
+  generated token reuses the same executable (XLA retraces on shape
+  change; a cache that grew per token would recompile s times).
+- **Masked full-buffer attention**: the single-position query attends over
+  the whole cache buffer under a position mask (``j <= pos``).  Unwritten
+  tail entries are masked to -1e30 exactly like the training path's causal
+  mask, so the math matches `forward` — the oracle tests assert it.
+- **`lax.scan` generation loop**: the per-token loop lives inside the
+  compiled program (carry = (cache, token, position)); Python never
+  round-trips per token, which on a tunneled/remote device matters more
+  than the FLOPs.
+- **Same sharding vocabulary**: heads (and the KV cache's head dim) shard
+  over the mesh's ``model`` axis, batch over ``data``×``fsdp`` — decode on
+  a mesh is the training layout minus the sequence dimension.  Weight
+  layouts come from `burnin.param_specs` unchanged.
+
+MoE configs are served with **per-step routing**: each generated token
+goes to its argmax expert with per-call capacity (``expert_capacity`` of
+the actual slice length), which for single-token steps can never drop a
+token.  That is the standard dropless serving semantics for a
+capacity-trained switch router; it coincides with the training router's
+dispatch whenever training capacity wasn't exceeded (the equivalence test
+pins exactly that regime).
+
+Out of scope, by validation error rather than silent fallback: context
+parallelism (both flavors shard the *sequence* — meaningless for a
+single-position query) and pipeline stages.  ``flash_attention`` configs
+are served with the masked dense path: the flash kernel tiles long
+training sequences; a decode step is a (1, T) matvec with nothing to tile
+(documented, not hidden — the config flag changes training only).
+
+Reference parity note: the reference driver (nvidia k8s-dra-driver) has no
+compute path at all — this module is part of the compute-validation layer
+that exceeds it (SURVEY.md §5 long-context/distributed subsystems).
+"""
+
+from __future__ import annotations
+
+import functools
+
+from tpu_dra.parallel.burnin import (
+    BurninConfig,
+    _rms_norm,
+    make_constrain,
+    param_specs,
+)
+
+__all__ = [
+    "init_cache",
+    "decode_forward",
+    "make_generate",
+    "generate",
+]
+
+
+def _validate(config: BurninConfig) -> None:
+    if config.context_parallel:
+        raise ValueError(
+            "decode does not run under context parallelism: ring/Ulysses "
+            "shard the sequence, and a decode step has a single query "
+            "position (serve the cp-trained weights on a tp mesh instead)"
+        )
+    if config.pipeline_stages > 0:
+        raise ValueError(
+            "decode does not run under pipeline parallelism: a one-token "
+            "step has no microbatch stream to fill a GPipe schedule with"
+        )
+
+
+def init_cache(config: BurninConfig, batch: int):
+    """Zeroed KV cache: ``{"k","v"}`` of (L, B, T, H, d_head) bf16, where
+    T is the model's full context (``config.seq`` — the positional table's
+    reach).  bf16 matches the training compute dtype, halves the HBM
+    footprint of the dominant serving tensor, and keeps the cache-read
+    matmuls on the MXU's native input type."""
+    import jax.numpy as jnp
+
+    c = config
+    shape = (c.n_layers, batch, c.seq, c.n_heads, c.d_head)
+    return {
+        "k": jnp.zeros(shape, jnp.bfloat16),
+        "v": jnp.zeros(shape, jnp.bfloat16),
+    }
+
+
+def cache_spec(config: BurninConfig):
+    """PartitionSpec for the cache: batch over data x fsdp, heads over the
+    tp axis — the attention block's training layout without the sequence
+    sharding (the cache's T dim must stay whole: every step reads all of
+    it)."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(None, ("data", "fsdp"), None, "model", None)
+
+
+def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain):
+    """One block over ``x`` (B, S, d) whose positions are [p0, p0+S).
+
+    Writes K/V into the cache slices ``ck``/``cv`` (B, T, H, K) at p0 and
+    attends the queries over the full buffer under the causal position
+    mask.  Identical math (same casts, same einsum contractions, same
+    -1e30 masking) to the training `_block`'s tp branch, minus gradients
+    and checkpointing."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    bf16 = jnp.bfloat16
+    S = x.shape[1]
+    T = ck.shape[1]
+
+    h = _rms_norm(x, layer["ln1"])
+    h = constrain("hidden", h.astype(bf16))
+    qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(bf16))
+    q, k_new, v_new = qkv[0], qkv[1], qkv[2]
+
+    ck = jax.lax.dynamic_update_slice_in_dim(ck, k_new.astype(bf16), p0, axis=1)
+    cv = jax.lax.dynamic_update_slice_in_dim(cv, v_new.astype(bf16), p0, axis=1)
+
+    # Query at slice offset i sits at absolute position p0 + i: it may see
+    # cache entries j <= p0 + i.  Everything later — including the zeroed
+    # unwritten tail — is masked to -1e30 exactly like training's tril.
+    scores = jnp.einsum("bshk,bthk->bhst", q, ck) / (c.d_head**0.5)
+    valid = jnp.arange(T)[None, :] <= p0 + jnp.arange(S)[:, None]  # (S, T)
+    scores = jnp.where(valid[None, None], scores.astype(jnp.float32), -1e30)
+    probs = jnp.exp(scores - scores.max(-1, keepdims=True))
+    probs = (probs / probs.sum(-1, keepdims=True)).astype(bf16)
+    att = jnp.einsum("bhst,bthk->bshk", probs, cv)
+    att = jnp.einsum("bshk,hkd->bsd", att, layer["wo"].astype(bf16))
+    x = x + att
+
+    h = _rms_norm(x, layer["ln2"])
+    h = constrain("hidden", h.astype(bf16))
+    if c.moe_experts > 0:
+        from tpu_dra.parallel.moe import expert_capacity, moe_mlp
+
+        # Per-call capacity: the TRAINING capacity clamped to the tokens
+        # actually present (an expert can receive at most S of S tokens).
+        # Clamping — not recomputing from S — keeps prefill routing
+        # identical to training whenever training capacity never dropped
+        # (recomputed ceil(S/E*factor) can be smaller and drop prompt
+        # tokens training kept).  For S=1 this is 1: dropless serving.
+        h, _aux = moe_mlp(
+            layer, h, c, constrain, capacity=min(S, expert_capacity(c))
+        )
+        x = x + h
+    else:
+        h = jnp.einsum("bsd,df->bsf", h, layer["w1"].astype(bf16))
+        h = jnp.where(h > 0, h, 0.01 * h)
+        h = jnp.einsum("bsf,fd->bsd", h, layer["w2"].astype(bf16))
+        x = x + h
+    return x, ck, cv
+
+
+def decode_forward(params, tokens, cache, p0, config: BurninConfig, mesh=None):
+    """Forward ``tokens`` (B, S) occupying positions [p0, p0+S) against the
+    cache.  Returns ``(logits (B, S, vocab) f32, new_cache)``.
+
+    One function serves both phases: prefill is ``S = prompt_len, p0 = 0``;
+    a decode step is ``S = 1`` at the current position — two traces total,
+    each reused for every subsequent call of its shape."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    _validate(c)
+    constrain = (
+        (lambda kind, arr: arr)
+        if mesh is None
+        else make_constrain(mesh, ("data", "fsdp"))
+    )
+    S = tokens.shape[1]
+
+    pos_emb = jax.lax.dynamic_slice_in_dim(params["pos"], p0, S, axis=0)
+    x = constrain("hidden", params["embed"][tokens] + pos_emb[None, :, :])
+
+    block = functools.partial(_decode_block, config=c, constrain=constrain)
+
+    def body(h, xs):
+        layer, ck, cv = xs
+        h, ck, cv = block(layer, h, ck, cv, p0)
+        return h, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _rms_norm(x, params["ln_f"])
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x.astype(jnp.bfloat16), params["embed"].astype(jnp.bfloat16)
+    )
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def make_generate(
+    config: BurninConfig,
+    mesh=None,
+    *,
+    prompt_len: int,
+    steps: int,
+    temperature: float = 0.0,
+    with_health: bool = False,
+):
+    """Build the jitted generation function:
+    ``fn(params, prompt (B, prompt_len) int32[, key]) -> (B, prompt_len + steps)``.
+
+    Greedy when ``temperature == 0`` (no key argument); otherwise
+    temperature-scaled categorical sampling (key required).  The whole
+    prefill → scan(decode step) program is one compiled executable; batch
+    size is the only remaining trace dimension.
+
+    ``with_health=True`` returns ``(tokens, healthy)`` where ``healthy``
+    is an all-sampled-logits-finite flag reduced INSIDE the compiled
+    program — benchmarks get a meaningful ok bit without compiling a
+    second probe executable (argmax output alone can't show NaN: it
+    silently picks index 0).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    _validate(c)
+    if not 0 < prompt_len < c.seq:
+        raise ValueError(
+            f"prompt_len must be in (0, {c.seq}), got {prompt_len}"
+        )
+    if steps < 1 or prompt_len + steps > c.seq:
+        raise ValueError(
+            f"prompt_len + steps must fit the context {c.seq}, got "
+            f"{prompt_len} + {steps}"
+        )
+    sampled = temperature > 0.0
+
+    def pick(logits, key):
+        if not sampled:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    def run(params, prompt, key=None):
+        if sampled and key is None:
+            raise ValueError(
+                "temperature > 0 requires a PRNG key: fn(params, prompt, key)"
+            )
+        B = prompt.shape[0]
+        cache = init_cache(c, B)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            spec = NamedSharding(mesh, cache_spec(c))
+            cache = jax.tree_util.tree_map(
+                lambda a: jax.lax.with_sharding_constraint(a, spec), cache
+            )
+        logits, cache = decode_forward(params, prompt, cache, 0, c, mesh)
+        keys = (
+            jax.random.split(key, steps)
+            if sampled
+            else jnp.zeros((steps, 2), jnp.uint32)
+        )
+        tok = pick(logits[:, -1], keys[0])
+        fin = jnp.isfinite(logits[:, -1]).all()
+
+        def step(carry, xs):
+            cache, tok, pos, fin = carry
+            k = xs
+            logits, cache = decode_forward(
+                params, tok[:, None], cache, pos, c, mesh
+            )
+            nxt = pick(logits[:, -1], k)
+            fin = jnp.logical_and(fin, jnp.isfinite(logits[:, -1]).all())
+            return (cache, nxt, pos + 1, fin), tok
+
+        # steps - 1 cached decode steps: the prefill already sampled token
+        # 1 of `steps`, and the final sampled token is never fed back.
+        (_, last, _, fin), toks = jax.lax.scan(
+            step, (cache, tok, jnp.int32(prompt_len), fin), keys[1:]
+        )
+        # toks: (steps - 1, B) of the tokens FED at each step; `last` is
+        # the final sampled token — together the generated continuation.
+        out = jnp.concatenate(
+            [toks.transpose(1, 0), last[:, None]], axis=1
+        )
+        tokens_out = jnp.concatenate([prompt, out], axis=1)
+        return (tokens_out, fin) if with_health else tokens_out
+
+    if mesh is None:
+        return jax.jit(run)
+
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    pspecs = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_specs(c, mesh)
+    )
+    tok_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
+    if sampled:
+        key_sharding = NamedSharding(mesh, P())
+        return jax.jit(
+            run, in_shardings=(pspecs, tok_sharding, key_sharding)
+        )
+    return jax.jit(run, in_shardings=(pspecs, tok_sharding))
+
+
+def generate(params, prompt, steps, config: BurninConfig, mesh=None,
+             temperature: float = 0.0, key=None):
+    """One-shot convenience over `make_generate` (compiles per distinct
+    (prompt_len, steps) pair — hold on to `make_generate`'s fn for serving
+    loops)."""
+    fn = make_generate(
+        config, mesh, prompt_len=prompt.shape[1], steps=steps,
+        temperature=temperature,
+    )
+    return fn(params, prompt, key) if temperature > 0 else fn(params, prompt)

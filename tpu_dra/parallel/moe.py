@@ -120,7 +120,7 @@ def expert_capacity(config, groups: int = 1) -> int:
     return max(1, math.ceil(c.seq / groups / c.moe_experts * c.moe_capacity))
 
 
-def moe_mlp(layer, h, config, constrain):
+def moe_mlp(layer, h, config, constrain, capacity: "int | None" = None):
     """The MoE MLP half of a transformer block.
 
     ``h``: post-norm hidden states (batch, seq, d_model), bf16.
@@ -128,6 +128,11 @@ def moe_mlp(layer, h, config, constrain):
     token-sharded tensors, "expert" for expert-sharded ones); identity when
     unsharded.  Returns ``(out, aux)`` — the combined expert outputs (same
     shape as h) and the scalar load-balance loss.
+
+    ``capacity`` overrides the per-(batch-row, expert) queue length —
+    the decode path passes the TRAINING capacity clamped to the slice
+    length so serving drops exactly when training would have (capacity
+    recomputed from a short slice would drop tokens training keeps).
     """
     import jax
     import jax.numpy as jnp
@@ -135,7 +140,7 @@ def moe_mlp(layer, h, config, constrain):
     c = config
     bf16 = jnp.bfloat16
     E = c.moe_experts
-    C = expert_capacity(c)
+    C = expert_capacity(c) if capacity is None else capacity
 
     # --- routing (fp32: softmax and cumsum want the precision) ---
     logits = jnp.einsum("bsd,de->bse", h.astype(jnp.float32), layer["router"])
