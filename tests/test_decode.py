@@ -154,6 +154,123 @@ class TestGenerate:
         with pytest.raises(ValueError, match="requires a PRNG key"):
             generate(params, seeded_prompt(TINY, 2, 4), 3, TINY, temperature=0.5)
 
+    @pytest.mark.slow
+    def test_sampling_without_key_rejected_on_mesh(self):
+        """The mesh wrapper binds per-arg in_shardings — without the guard
+        a missing key dies on an opaque pjit arity error."""
+        mesh = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+        fn = make_generate(TINY, mesh, prompt_len=4, steps=3, temperature=0.5)
+        params = init_params(TINY)
+        with pytest.raises(ValueError, match="requires a PRNG key"):
+            fn(params, seeded_prompt(TINY, TINY.batch, 4))
+
+
+class TestPaddedBatch:
+    def test_padded_rows_match_unpadded_singletons(self):
+        """The headline padded-batch property: each row of a mixed-length
+        batch generates exactly what it would alone, unpadded.  Pads trail,
+        so prefill reuses the uniform causal path and the math is bitwise
+        identical at every real position."""
+        from tpu_dra.parallel.decode import make_generate_padded
+
+        params = init_params(TINY)
+        lens = [3, 5, 8, 6]
+        P, steps = 8, 6
+        prompt = np.full((4, P), 63, np.int32)  # pad value: deliberately a real token id
+        rows = []
+        for b, ln in enumerate(lens):
+            row = np.asarray(seeded_prompt(TINY, 1, ln, seed=20 + b))
+            prompt[b, :ln] = row[0]
+            rows.append(row)
+
+        fn = make_generate_padded(TINY, prompt_slots=P, steps=steps)
+        got = np.asarray(
+            fn(params, jnp.asarray(prompt), jnp.asarray(lens, jnp.int32))
+        )
+        assert got.shape == (4, P + steps)
+
+        for b, ln in enumerate(lens):
+            want = np.asarray(
+                generate(params, jnp.asarray(rows[b]), steps, TINY)
+            )[0]
+            np.testing.assert_array_equal(
+                got[b, P:], want[ln:],
+                err_msg=f"row {b} (len {ln}) diverged from its solo run",
+            )
+            np.testing.assert_array_equal(got[b, :ln], want[:ln])
+
+    def test_pad_value_is_irrelevant(self):
+        """Two different pad fillers must produce identical generations —
+        pads write cache garbage, but the mask keeps it invisible."""
+        from tpu_dra.parallel.decode import make_generate_padded
+
+        params = init_params(TINY)
+        lens = jnp.array([4, 7], jnp.int32)
+        base = np.zeros((2, 8), np.int32)
+        base[0, :4] = np.asarray(seeded_prompt(TINY, 1, 4, seed=31))[0]
+        base[1, :7] = np.asarray(seeded_prompt(TINY, 1, 7, seed=32))[0]
+        alt = base.copy()
+        alt[0, 4:] = 13
+        alt[1, 7:] = 55
+
+        fn = make_generate_padded(TINY, prompt_slots=8, steps=5)
+        got_a = np.asarray(fn(params, jnp.asarray(base), lens))
+        got_b = np.asarray(fn(params, jnp.asarray(alt), lens))
+        np.testing.assert_array_equal(got_a[:, 8:], got_b[:, 8:])
+
+    @pytest.mark.slow
+    def test_padded_moe_rows_match_unpadded(self):
+        """Trailing pads must not perturb per-row MoE routing: the capacity
+        queue cumsum is per batch row and pads sort after every real token
+        (the docstring's claim, pinned here at tight capacity)."""
+        from tpu_dra.parallel.decode import make_generate_padded
+
+        cfg = BurninConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=24,
+            batch=2, moe_experts=4, moe_capacity=1.25,
+        )
+        params = init_params(cfg)
+        lens = [4, 8]
+        P, steps = 8, 5
+        prompt = np.full((2, P), 11, np.int32)
+        rows = []
+        for b, ln in enumerate(lens):
+            row = np.asarray(seeded_prompt(cfg, 1, ln, seed=40 + b))
+            prompt[b, :ln] = row[0]
+            rows.append(row)
+        fn = make_generate_padded(cfg, prompt_slots=P, steps=steps)
+        got = np.asarray(
+            fn(params, jnp.asarray(prompt), jnp.asarray(lens, jnp.int32))
+        )
+        for b, ln in enumerate(lens):
+            want = np.asarray(
+                generate(params, jnp.asarray(rows[b]), steps, cfg)
+            )[0]
+            np.testing.assert_array_equal(got[b, P:], want[ln:])
+
+    def test_padded_bounds_rejected(self):
+        from tpu_dra.parallel.decode import make_generate_padded
+
+        with pytest.raises(ValueError, match="fit the context"):
+            make_generate_padded(TINY, prompt_slots=10, steps=8)
+
+    def test_out_of_contract_lens_flip_health(self):
+        """lens is runtime data — violations can't raise inside the
+        compiled program, so they clamp AND flip the health flag."""
+        from tpu_dra.parallel.decode import make_generate_padded
+
+        params = init_params(TINY)
+        fn = make_generate_padded(
+            TINY, prompt_slots=8, steps=4, with_health=True
+        )
+        prompt = seeded_prompt(TINY, 2, 8)
+        _, ok = fn(params, prompt, jnp.array([4, 8], jnp.int32))
+        assert bool(ok)
+        _, bad0 = fn(params, prompt, jnp.array([0, 8], jnp.int32))
+        assert not bool(bad0), "lens=0 must flip health"
+        _, bad9 = fn(params, prompt, jnp.array([4, 9], jnp.int32))
+        assert not bool(bad9), "lens > prompt_slots must flip health"
+
 
 class TestShardedDecode:
     @pytest.mark.slow

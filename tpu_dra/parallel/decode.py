@@ -57,9 +57,36 @@ from tpu_dra.parallel.burnin import (
 __all__ = [
     "init_cache",
     "decode_forward",
+    "decode_step_padded",
     "make_generate",
+    "make_generate_padded",
     "generate",
 ]
+
+
+def _require_key(jitted, nargs: int):
+    """Guard a sampled, mesh-sharded generation fn: its jit wrapper binds
+    per-argument in_shardings, so calling without the PRNG key dies on a
+    pjit arity mismatch before the trace-time ValueError can fire.  This
+    wrapper raises the clear error instead.  ``nargs``: positional args
+    before the key."""
+    import functools as _ft
+
+    @_ft.wraps(jitted)
+    def wrapper(*args, key=None):
+        if len(args) > nargs + 1:
+            raise TypeError(f"expected at most {nargs + 1} positional args")
+        if len(args) == nargs + 1:
+            key = args[nargs]
+            args = args[:nargs]
+        if key is None:
+            raise ValueError(
+                "temperature > 0 requires a PRNG key: fn(..., key)"
+            )
+        return jitted(*args, key)
+
+    wrapper._cache_size = jitted._cache_size
+    return wrapper
 
 
 def _validate(config: BurninConfig) -> None:
@@ -102,21 +129,21 @@ def cache_spec(config: BurninConfig):
     return P(None, ("data", "fsdp"), None, "model", None)
 
 
-def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain):
-    """One block over ``x`` (B, S, d) whose positions are [p0, p0+S).
+def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain,
+                  mask):
+    """One block over ``x`` (B, S, d) written to cache slots [p0, p0+S).
 
     Writes K/V into the cache slices ``ck``/``cv`` (B, T, H, K) at p0 and
-    attends the queries over the full buffer under the causal position
-    mask.  Identical math (same casts, same einsum contractions, same
-    -1e30 masking) to the training `_block`'s tp branch, minus gradients
-    and checkpointing."""
+    attends the queries over the full buffer under ``mask`` (broadcastable
+    to (B, 1, S, T); invalid slots score -1e30 exactly like training's
+    tril).  Identical math (same casts, same einsum contractions) to the
+    training `_block`'s tp branch, minus gradients and checkpointing."""
     import jax
     import jax.numpy as jnp
 
     c = config
     bf16 = jnp.bfloat16
     S = x.shape[1]
-    T = ck.shape[1]
 
     h = _rms_norm(x, layer["ln1"])
     h = constrain("hidden", h.astype(bf16))
@@ -126,12 +153,8 @@ def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain):
     ck = jax.lax.dynamic_update_slice_in_dim(ck, k_new.astype(bf16), p0, axis=1)
     cv = jax.lax.dynamic_update_slice_in_dim(cv, v_new.astype(bf16), p0, axis=1)
 
-    # Query at slice offset i sits at absolute position p0 + i: it may see
-    # cache entries j <= p0 + i.  Everything later — including the zeroed
-    # unwritten tail — is masked to -1e30 exactly like training's tril.
     scores = jnp.einsum("bshk,bthk->bhst", q, ck) / (c.d_head**0.5)
-    valid = jnp.arange(T)[None, :] <= p0 + jnp.arange(S)[:, None]  # (S, T)
-    scores = jnp.where(valid[None, None], scores.astype(jnp.float32), -1e30)
+    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
     probs = jnp.exp(scores - scores.max(-1, keepdims=True))
     probs = (probs / probs.sum(-1, keepdims=True)).astype(bf16)
     att = jnp.einsum("bhst,bthk->bshk", probs, cv)
@@ -161,29 +184,16 @@ def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain):
     return x, ck, cv
 
 
-def decode_forward(params, tokens, cache, p0, config: BurninConfig, mesh=None):
-    """Forward ``tokens`` (B, S) occupying positions [p0, p0+S) against the
-    cache.  Returns ``(logits (B, S, vocab) f32, new_cache)``.
-
-    One function serves both phases: prefill is ``S = prompt_len, p0 = 0``;
-    a decode step is ``S = 1`` at the current position — two traces total,
-    each reused for every subsequent call of its shape."""
+def _run_blocks(params, x, cache, p0, mask, config: BurninConfig, constrain):
+    """Layer scan + final norm + logits, shared by the uniform and padded
+    paths.  ``x``: embedded inputs (B, S, d); ``mask`` broadcastable to
+    (B, 1, S, T)."""
     import jax
     import jax.numpy as jnp
 
-    c = config
-    _validate(c)
-    constrain = (
-        (lambda kind, arr: arr)
-        if mesh is None
-        else make_constrain(mesh, ("data", "fsdp"))
+    block = functools.partial(
+        _decode_block, config=config, constrain=constrain, mask=mask
     )
-    S = tokens.shape[1]
-
-    pos_emb = jax.lax.dynamic_slice_in_dim(params["pos"], p0, S, axis=0)
-    x = constrain("hidden", params["embed"][tokens] + pos_emb[None, :, :])
-
-    block = functools.partial(_decode_block, config=c, constrain=constrain)
 
     def body(h, xs):
         layer, ck, cv = xs
@@ -198,6 +208,154 @@ def decode_forward(params, tokens, cache, p0, config: BurninConfig, mesh=None):
         "bsd,vd->bsv", x.astype(jnp.bfloat16), params["embed"].astype(jnp.bfloat16)
     )
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def _make_constrain(mesh):
+    return (
+        (lambda kind, arr: arr)
+        if mesh is None
+        else make_constrain(mesh, ("data", "fsdp"))
+    )
+
+
+def decode_forward(params, tokens, cache, p0, config: BurninConfig, mesh=None):
+    """Forward ``tokens`` (B, S) occupying positions [p0, p0+S) against the
+    cache.  Returns ``(logits (B, S, vocab) f32, new_cache)``.
+
+    One function serves both phases: prefill is ``S = prompt_len, p0 = 0``;
+    a decode step is ``S = 1`` at the current position — two traces total,
+    each reused for every subsequent call of its shape."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    _validate(c)
+    constrain = _make_constrain(mesh)
+    S = tokens.shape[1]
+    T = cache["k"].shape[2]
+
+    pos_emb = jax.lax.dynamic_slice_in_dim(params["pos"], p0, S, axis=0)
+    x = constrain("hidden", params["embed"][tokens] + pos_emb[None, :, :])
+
+    # Query at slice offset i sits at absolute position p0 + i: it may see
+    # cache entries j <= p0 + i.  Everything later — including the zeroed
+    # unwritten tail — is masked out exactly like training's tril.
+    valid = jnp.arange(T)[None, :] <= p0 + jnp.arange(S)[:, None]  # (S, T)
+    return _run_blocks(params, x, cache, p0, valid[None, None], c, constrain)
+
+
+def decode_step_padded(params, tok, cache, lens, prompt_slots, t,
+                       config: BurninConfig, mesh=None):
+    """One decode step for a PADDED batch: row ``b``'s prompt filled cache
+    slots [0, lens[b]) (pads trail in [lens[b], prompt_slots)), and
+    generated tokens occupy uniform slots prompt_slots + 0..t.
+
+    ``tok``: (B,) current tokens, written to slot ``prompt_slots + t``;
+    each row's token carries its LOGICAL position ``lens[b] + t`` (the
+    positional table doesn't see pad slots).  The attention mask shows row
+    ``b`` its real prompt slots and the decode slots so far — never the
+    trailing pads.  Returns ``(logits (B, vocab), new_cache)``."""
+    import jax.numpy as jnp
+
+    c = config
+    _validate(c)
+    constrain = _make_constrain(mesh)
+    T = cache["k"].shape[2]
+
+    pos_emb = params["pos"][lens + t]  # (B, d): logical, per-row
+    x = constrain("hidden", params["embed"][tok][:, None, :] + pos_emb[:, None, :])
+
+    slots = jnp.arange(T)[None, :]  # (1, T)
+    visible = (slots < lens[:, None]) | (
+        (slots >= prompt_slots) & (slots <= prompt_slots + t)
+    )  # (B, T)
+    mask = visible[:, None, None, :]  # (B, 1, 1, T)
+    logits, cache = _run_blocks(
+        params, x, cache, prompt_slots + t, mask, c, constrain
+    )
+    return logits[:, 0], cache
+
+
+def _check_window(c: BurninConfig, first: int, steps: int, name: str) -> None:
+    if not 0 < first < c.seq:
+        raise ValueError(f"{name} must be in (0, {c.seq}), got {first}")
+    if steps < 1 or first + steps > c.seq:
+        raise ValueError(
+            f"{name} + steps must fit the context {c.seq}, got "
+            f"{first} + {steps}"
+        )
+
+
+def _make_pick(sampled: bool, temperature: float):
+    import jax
+    import jax.numpy as jnp
+
+    def pick(logits, key):
+        if not sampled:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    return pick
+
+
+def _make_keys(sampled: bool, key, steps: int):
+    import jax
+    import jax.numpy as jnp
+
+    return (
+        jax.random.split(key, steps)
+        if sampled
+        else jnp.zeros((steps, 2), jnp.uint32)
+    )
+
+
+def _fresh_cache(c: BurninConfig, batch: int, mesh):
+    import jax
+
+    cache = init_cache(c, batch)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        spec = NamedSharding(mesh, cache_spec(c))
+        cache = jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(a, spec), cache
+        )
+    return cache
+
+
+def _assemble(prompt, toks, last, fin, with_health):
+    """Prompt + (fed tokens, final sample) -> the full output rows."""
+    import jax.numpy as jnp
+
+    out = jnp.concatenate([toks.transpose(1, 0), last[:, None]], axis=1)
+    tokens_out = jnp.concatenate([prompt, out], axis=1)
+    return (tokens_out, fin) if with_health else tokens_out
+
+
+def _jit_sharded(run, mesh, c, sampled, extra_shardings):
+    """jit tail shared by both factories: params + batch-sharded args (+
+    replicated key when sampling, guarded by _require_key)."""
+    import jax
+
+    if mesh is None:
+        return jax.jit(run)
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    pspecs = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_specs(c, mesh)
+    )
+    shardings = (pspecs, *(NamedSharding(mesh, s) for s in extra_shardings))
+    if sampled:
+        return _require_key(
+            jax.jit(
+                run, in_shardings=(*shardings, NamedSharding(mesh, P()))
+            ),
+            nargs=len(extra_shardings) + 1,
+        )
+    return jax.jit(run, in_shardings=shardings)
 
 
 def make_generate(
@@ -228,44 +386,18 @@ def make_generate(
 
     c = config
     _validate(c)
-    if not 0 < prompt_len < c.seq:
-        raise ValueError(
-            f"prompt_len must be in (0, {c.seq}), got {prompt_len}"
-        )
-    if steps < 1 or prompt_len + steps > c.seq:
-        raise ValueError(
-            f"prompt_len + steps must fit the context {c.seq}, got "
-            f"{prompt_len} + {steps}"
-        )
+    _check_window(c, prompt_len, steps, "prompt_len")
     sampled = temperature > 0.0
-
-    def pick(logits, key):
-        if not sampled:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
-            jnp.int32
-        )
+    pick = _make_pick(sampled, temperature)
 
     def run(params, prompt, key=None):
         if sampled and key is None:
             raise ValueError(
                 "temperature > 0 requires a PRNG key: fn(params, prompt, key)"
             )
-        B = prompt.shape[0]
-        cache = init_cache(c, B)
-        if mesh is not None:
-            from jax.sharding import NamedSharding
-
-            spec = NamedSharding(mesh, cache_spec(c))
-            cache = jax.tree_util.tree_map(
-                lambda a: jax.lax.with_sharding_constraint(a, spec), cache
-            )
+        cache = _fresh_cache(c, prompt.shape[0], mesh)
         logits, cache = decode_forward(params, prompt, cache, 0, c, mesh)
-        keys = (
-            jax.random.split(key, steps)
-            if sampled
-            else jnp.zeros((steps, 2), jnp.uint32)
-        )
+        keys = _make_keys(sampled, key, steps)
         tok = pick(logits[:, -1], keys[0])
         fin = jnp.isfinite(logits[:, -1]).all()
 
@@ -281,33 +413,105 @@ def make_generate(
 
         # steps - 1 cached decode steps: the prefill already sampled token
         # 1 of `steps`, and the final sampled token is never fed back.
+        # toks collects the token FED at each step; `last` is the final
+        # sample — together the generated continuation.
         (_, last, _, fin), toks = jax.lax.scan(
             step, (cache, tok, jnp.int32(prompt_len), fin), keys[1:]
         )
-        # toks: (steps - 1, B) of the tokens FED at each step; `last` is
-        # the final sampled token — together the generated continuation.
-        out = jnp.concatenate(
-            [toks.transpose(1, 0), last[:, None]], axis=1
-        )
-        tokens_out = jnp.concatenate([prompt, out], axis=1)
-        return (tokens_out, fin) if with_health else tokens_out
+        return _assemble(prompt, toks, last, fin, with_health)
 
-    if mesh is None:
-        return jax.jit(run)
-
-    from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    pspecs = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), param_specs(c, mesh)
-    )
-    tok_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
-    if sampled:
-        key_sharding = NamedSharding(mesh, P())
-        return jax.jit(
-            run, in_shardings=(pspecs, tok_sharding, key_sharding)
+    return _jit_sharded(run, mesh, c, sampled, [P(("data", "fsdp"), None)])
+
+
+def make_generate_padded(
+    config: BurninConfig,
+    mesh=None,
+    *,
+    prompt_slots: int,
+    steps: int,
+    temperature: float = 0.0,
+    with_health: bool = False,
+):
+    """Variable-length serving: build the jitted
+    ``fn(params, prompt (B, prompt_slots), lens (B,)[, key]) ->
+    (B, prompt_slots + steps)`` where row ``b``'s real prompt is
+    ``prompt[b, :lens[b]]`` and the rest of the row is padding (any
+    token value).
+
+    Slot-based cache layout: prompts (pads included) fill slots
+    [0, prompt_slots); generated tokens occupy uniform slots after.  Pads
+    TRAIL each row, which is what makes the batch-uniform prefill exact:
+
+    - attention: a real prompt query at slot i only looks at j <= i <
+      lens[b], so pad K/V (written, garbage) are invisible during prefill;
+      decode steps mask the pad slot range out explicitly.
+    - positions: slot == logical position for every real prompt token;
+      only decode steps need the per-row logical position lens[b] + t.
+    - MoE routing: the capacity queue cumsum is per batch row and pads
+      sort AFTER every real token, so pads can never displace a real
+      token from an expert queue — per-row routing matches the unpadded
+      batch exactly (pinned by the equivalence test).
+
+    Each row's continuation is written to the SAME slots; rows that hit
+    their context limit (lens[b] + steps > config.seq) are the caller's
+    contract violation — enforced for the worst case at build time.
+
+    The per-row contract is ``1 <= lens[b] <= prompt_slots``.  lens is a
+    runtime array, so violations can't raise inside the compiled program:
+    out-of-range values are CLAMPED into the contract (an empty row would
+    otherwise silently sample from a pad prefix — XLA gathers clamp, so
+    lens=0 reads position 0's garbage logits) and, with ``with_health``,
+    any clamping flips the health flag so the caller can reject the
+    batch."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    _validate(c)
+    _check_window(c, prompt_slots, steps, "prompt_slots")
+    sampled = temperature > 0.0
+    pick = _make_pick(sampled, temperature)
+
+    def run(params, prompt, lens, key=None):
+        if sampled and key is None:
+            raise ValueError(
+                "temperature > 0 requires a PRNG key: fn(params, prompt, lens, key)"
+            )
+        in_contract = (lens >= 1) & (lens <= prompt_slots)
+        lens_c = jnp.clip(lens, 1, prompt_slots)
+        cache = _fresh_cache(c, prompt.shape[0], mesh)
+        logits, cache = decode_forward(params, prompt, cache, 0, c, mesh)
+        # Row b's next token comes from its LAST REAL position, lens[b]-1.
+        last = jnp.take_along_axis(
+            logits, (lens_c - 1)[:, None, None], axis=1
+        )[:, 0]
+        keys = _make_keys(sampled, key, steps)
+        tok = pick(last, keys[0])
+        fin = jnp.isfinite(last).all() & in_contract.all()
+
+        def step(carry, xs):
+            cache, tok, t, fin = carry
+            k = xs
+            logits, cache = decode_step_padded(
+                params, tok, cache, lens_c, prompt_slots, t, c, mesh
+            )
+            nxt = pick(logits, k)
+            fin = jnp.logical_and(fin, jnp.isfinite(logits).all())
+            return (cache, nxt, t + 1, fin), tok
+
+        (_, last_tok, _, fin), toks = jax.lax.scan(
+            step, (cache, tok, jnp.int32(0), fin), keys[1:]
         )
-    return jax.jit(run, in_shardings=(pspecs, tok_sharding))
+        return _assemble(prompt, toks, last_tok, fin, with_health)
+
+    from jax.sharding import PartitionSpec as P
+
+    return _jit_sharded(
+        run, mesh, c, sampled,
+        [P(("data", "fsdp"), None), P(("data", "fsdp"))],
+    )
 
 
 def generate(params, prompt, steps, config: BurninConfig, mesh=None,
