@@ -61,7 +61,11 @@ from tpu_dra.parallel.collectives import (
 )
 from tpu_dra.parallel.validate import SliceReport, validate_slice
 from tpu_dra.parallel.burnin import BurninConfig, TrainReport, train
-from tpu_dra.parallel.decode import generate, make_generate
+from tpu_dra.parallel.decode import (
+    generate,
+    make_generate,
+    make_generate_padded,
+)
 
 __all__ = [
     "BurninConfig",
@@ -71,6 +75,7 @@ __all__ = [
     "train",
     "generate",
     "make_generate",
+    "make_generate_padded",
     "all_gather_check",
     "hierarchical_psum",
     "hierarchical_psum_check",
