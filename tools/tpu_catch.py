@@ -7,10 +7,13 @@ seconds later, wedges in C++ past a 420 s budget.  A probe loop that merely
 *records* UP (tools/tpu_probe.sh) therefore loses the window: by the time a
 human or the bench reacts, the tunnel is gone again.
 
-This runner closes the gap to zero: the same killable-child probe, and the
-moment it answers, the bench's own compute child (bench._COMPUTE_CHILD —
-chip-sized MFU, HBM bandwidth, psum busbw, compiled flash-vs-oracle gate)
-launches in the SAME iteration with a generous budget.  Results land in
+This runner closes the gap to zero: the probe process IS the measuring
+process.  One child runs bench._COMPUTE_CHILD; its own ``DEVS:`` line is
+the probe answer, and the same live backend flows straight into the
+stanzas in wedge-risk order — init report, warm matmul, HBM, then the
+chip-sized MFU/flash compiles, then psum (an ICI collective can wedge in
+C++) and decode last — each followed by a BENCHJSON emission so a
+mid-run wedge only costs the stanzas after the last line.  Results land in
 ``.tpu_catch_result.json`` with a wall-clock stamp; ``bench.py`` merges the
 freshest TPU-platform catch into its artifact when its own attempt meets a
 dead tunnel, so the silicon numbers survive into BENCH_r{N}.json no matter
@@ -44,34 +47,136 @@ def _status(line: str) -> None:
         f.write(f"{line} {stamp}\n")
 
 
-def probe(timeout_s: float) -> bool:
-    """True iff a fresh backend init sees a TPU device within timeout_s.
+def probe_and_measure(probe_timeout_s: float, budget_s: float) -> "tuple[str, dict | None]":
+    """One attempt, ONE process: launch the compute child, treat its own
+    ``DEVS:`` line as the probe answer, and keep the SAME backend alive for
+    the measurement.
 
-    SIGKILL via ``timeout -k`` semantics: a wedged PJRT init ignores
-    SIGTERM, so the child is hard-killed by subprocess timeout + kill."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-u", "-c",
-             "import jax; d=jax.devices(); print('DEVS:', [str(x) for x in d])"],
-            capture_output=True, text=True, timeout=timeout_s,
-            env=bench._seed_pythonpath(dict(os.environ)),
-        )
-    except subprocess.TimeoutExpired:
-        return False
-    return proc.returncode == 0 and "tpu" in proc.stdout.lower()
+    Round-5 lesson that forced this shape: the tunnel answered a separate
+    probe child, and the compute child's SECOND backend init — seconds
+    later — wedged for its whole 900 s budget with zero output.  The
+    window can be shorter than one extra init, so the probe process must
+    BE the measuring process.  The child emits a BENCHJSON line after
+    every stanza (cheapest first), so killing it mid-wedge still salvages
+    everything the window covered.
 
+    Returns (state, detail): state "down" (no DEVS within probe_timeout,
+    or the child died before any BENCHJSON — detail carries rc + stderr
+    tail for diagnosis), "cpu" (backend initialized but without a TPU:
+    the tunnel is down and jax fell back — killed immediately, NOT worth
+    a multi-minute CPU measurement), or "measured" with the last
+    BENCHJSON report.
+    """
+    import threading
 
-def run_compute(budget_s: float) -> dict:
     env = bench._seed_pythonpath(dict(os.environ))
-    try:
-        out = bench._run_bench_child(
-            bench._COMPUTE_CHILD, env, budget_s,
-            empty_result={"platform": "none", "mfu": 0.0},
-        )
-    except subprocess.TimeoutExpired:
-        return {"platform": "none", "mfu": 0.0, "ok": False,
-                "error": f"compute child exceeded {budget_s:.0f}s with no output"}
-    return out
+    spawn_t0 = time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", bench._COMPUTE_CHILD],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
+    lines: "list[str]" = []
+    err_lines: "list[str]" = []
+
+    def drain(stream, sink):
+        for line in stream:
+            sink.append(line.rstrip("\n"))
+
+    t_out = threading.Thread(target=drain, args=(proc.stdout, lines), daemon=True)
+    t_err = threading.Thread(
+        target=drain, args=(proc.stderr, err_lines), daemon=True
+    )
+    t_out.start()
+    t_err.start()
+
+    def kill():
+        # A wedged PJRT init ignores SIGTERM; only SIGKILL clears it.
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        proc.wait()
+
+    def devs_line() -> "str | None":
+        for ln in lines:
+            if ln.startswith("DEVS:"):
+                return ln
+        return None
+
+    def diag() -> dict:
+        return {
+            "rc": proc.poll(),
+            "stderr_tail": "\n".join(err_lines[-6:])[-500:],
+        }
+
+    deadline = time.monotonic() + probe_timeout_s
+    while time.monotonic() < deadline:
+        if devs_line() is not None:
+            break
+        if proc.poll() is not None:
+            # Child exited: join the drain first — output it wrote in this
+            # same poll window may not be appended yet, and racing it
+            # would misclassify an instant-exit report as "down".
+            t_out.join(timeout=5.0)
+            break
+        time.sleep(0.5)
+    seen = devs_line()
+    if seen is None:
+        rc_before_kill = proc.poll()  # None = wedged (we kill), else real exit
+        kill()
+        t_out.join(timeout=5.0)
+        t_err.join(timeout=5.0)
+        d = diag()
+        d["rc"] = rc_before_kill
+        return "down", d
+    if "tpu" not in seen.lower():
+        # Backend came up WITHOUT the chip (jax fell back to CPU): the
+        # tunnel is down — do not burn minutes measuring the fallback.
+        kill()
+        return "cpu", None
+
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline and proc.poll() is None:
+        time.sleep(1.0)
+    rc = proc.poll()  # one snapshot: None = timed out, else the real exit
+    timed_out = rc is None
+    kill()
+    t_out.join(timeout=5.0)
+    t_err.join(timeout=5.0)
+
+    out = bench._last_benchjson("\n".join(lines))
+    if out is None:
+        return "down", diag()
+    if timed_out:
+        # Wall time since SPAWN, not the post-DEVS budget: the note must
+        # state how long the child actually lived.
+        out["partial"] = bench._partial_kill_note(time.monotonic() - spawn_t0)
+    elif rc != 0:
+        # Crashed (not killed by us): the report is whatever the child got
+        # out before dying — annotate so a missing later stanza is a
+        # recorded crash, not a silent absence.
+        out["crashed"] = bench._crash_note(rc, "\n".join(err_lines[-6:]))
+    return "measured", out
+
+
+def _report_score(
+    r: "dict | None", current_fp: str
+) -> "tuple[int, int, int, int]":
+    """Orders saved catches: TPU platform first, then whether the catch was
+    measured by the CURRENT build (bench._merge_tpu_catch refuses to
+    promote a stale-fingerprint catch, so a same-build report must always
+    beat a higher-scoring stale one), then overall ok, then how many
+    sub-stanzas landed.  A fresh catch replaces an equal one (newer
+    timestamp wins ties)."""
+    if not r or r.get("platform") != "tpu":
+        return (0, 0, 0, 0)
+    subok = bench._substanza_ok_count(r)
+    return (
+        1,
+        1 if r.get("fingerprint") == current_fp else 0,
+        1 if r.get("ok") else 0,
+        subok + (1 if r.get("mfu", 0) > 0 else 0),
+    )
 
 
 def main() -> int:
@@ -89,25 +194,32 @@ def main() -> int:
     while time.monotonic() < deadline:
         attempt += 1
         t0 = time.monotonic()
-        up = probe(args.probe_timeout)
-        if not up:
-            _status(f"DOWN attempt={attempt} probe_s={time.monotonic() - t0:.0f}")
+        _status(f"PROBING attempt={attempt}")
+        state, out = probe_and_measure(args.probe_timeout, args.budget)
+        if state != "measured" or out is None:
+            extra = ""
+            if state == "down" and isinstance(out, dict):
+                extra = (
+                    f" rc={out.get('rc')} "
+                    f"stderr={out.get('stderr_tail', '')[-160:]!r}"
+                )
+            _status(
+                f"{state.upper()} attempt={attempt} "
+                f"probe_s={time.monotonic() - t0:.0f}{extra}"
+            )
             time.sleep(args.sleep)
             continue
 
-        # Window open: measure NOW.  No sleep, no handoff — the same loop
-        # iteration owns the chip while it answers.
-        _status(f"UP attempt={attempt} measuring")
-        out = run_compute(args.budget)
         out["caught_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         out["catch_attempt"] = attempt
         # Stamp what code produced this number: bench._merge_tpu_catch
         # compares the fingerprint so a catch from an older build is
         # labeled stale instead of impersonating the code under test.
-        out["fingerprint"] = bench._measurement_fingerprint()
+        fp = bench._measurement_fingerprint()
+        out["fingerprint"] = fp
 
-        # Keep the best result so far: a TPU-platform report (even not-ok)
-        # beats none; an ok TPU report ends the hunt.
+        # Keep the best result so far (ties go to the fresher catch): a
+        # partial TPU report beats none; an ok TPU report ends the hunt.
         prev = None
         if os.path.exists(RESULT_PATH):
             try:
@@ -115,19 +227,20 @@ def main() -> int:
                     prev = json.load(f)
             except (OSError, ValueError):
                 prev = None
-        is_tpu = out.get("platform") == "tpu"
-        prev_tpu = bool(prev) and prev.get("platform") == "tpu"
-        if is_tpu and (not prev_tpu or out.get("ok") or not prev.get("ok")):
+        if out.get("platform") == "tpu" and _report_score(
+            out, fp
+        ) >= _report_score(prev, fp):
             tmp = RESULT_PATH + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(out, f, indent=1)
             os.replace(tmp, RESULT_PATH)
-        if is_tpu and out.get("ok"):
+        if out.get("platform") == "tpu" and out.get("ok"):
             _status(f"CAUGHT attempt={attempt} mfu={out.get('mfu')}")
             print(json.dumps(out))
             return 0
         _status(
             f"MISSED attempt={attempt} platform={out.get('platform')} "
+            f"score={_report_score(out, fp)} "
             f"err={str(out.get('error', ''))[:120]!r}"
         )
         time.sleep(args.sleep)
