@@ -591,6 +591,7 @@ def _crash_note(rc: "int | None", stderr_tail: str) -> str:
 # what counts as a landed stanza.
 _COMPUTE_SUBSTANZAS = (
     "warm_matmul", "hbm", "psum_busbw", "flash_oracle", "flash", "decode",
+    "decode_int8",
 )
 
 
@@ -933,8 +934,37 @@ try:
             # in-program all-logits-finite reduction.
             "ok": bool(healthy) and res.shape[1] == plen + steps,
         }
+        print("BENCHJSON:" + json.dumps(out), flush=True)
+
+        if not out["decode"]["ok"]:
+            raise RuntimeError(
+                "bf16 decode stanza not ok: skipping the int8 rerun "
+                "(its uplift would compare against a broken baseline)"
+            )
+        # Weight-only int8 serving (parallel/quant.py): decode is
+        # memory-bound — tokens/s ~ hbm_bw / weight_bytes — so int8
+        # weights should approach the storage ratio in throughput.  Same
+        # generate fn (the trace adapts to the quantized tree), same
+        # prompt, uplift reported against the bf16 number above.
+        from tpu_dra.parallel.quant import quantize_params, tree_bytes
+
+        qparams = quantize_params(params)
+        jax.block_until_ready(gen(qparams, prompt))  # compile + warmup
+        t0 = _time.perf_counter()
+        qres, qhealthy = jax.block_until_ready(gen(qparams, prompt))
+        qdt = _time.perf_counter() - t0
+        out["decode_int8"] = {
+            "tokens_per_s": round(dc.batch * steps / qdt, 1),
+            "step_ms": round(qdt / steps * 1e3, 3),
+            "bytes_ratio_vs_f32": round(
+                tree_bytes(qparams) / max(1, tree_bytes(params)), 3
+            ),
+            "uplift_vs_bf16_decode": round(dt / qdt, 3),
+            "ok": bool(qhealthy) and qres.shape[1] == plen + steps,
+        }
 except Exception as e:
-    out["decode"] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+    key = "decode" if "decode" not in out else "decode_int8"
+    out[key] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
 print("BENCHJSON:" + json.dumps(out), flush=True)
 """
 
@@ -1091,6 +1121,7 @@ def _measurement_fingerprint() -> str:
         "tpu_dra/parallel/mfu.py",
         "tpu_dra/parallel/burnin.py",
         "tpu_dra/parallel/decode.py",
+        "tpu_dra/parallel/quant.py",
         "tpu_dra/parallel/flash.py",
         "tpu_dra/parallel/moe.py",
         "tpu_dra/parallel/collectives.py",

@@ -1,0 +1,211 @@
+"""Weight-only int8 serving quantization (tpu_dra/parallel/quant.py):
+roundtrip error bounds, memory reduction, quantized decode vs the
+full-precision path, mesh-sharded quantized generation, and the
+MoE/padded compositions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.parallel.burnin import BurninConfig, init_params
+from tpu_dra.parallel.decode import (
+    decode_forward,
+    init_cache,
+    make_generate,
+    make_generate_padded,
+)
+from tpu_dra.parallel.mesh import logical_mesh
+from tpu_dra.parallel.quant import (
+    dequantize,
+    is_quantized,
+    is_quantized_leaf,
+    quant_param_specs,
+    quantize_params,
+    quantize_tensor,
+    tree_bytes,
+)
+
+TINY = BurninConfig(
+    vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=16, batch=4
+)
+
+
+def seeded_prompt(config, batch, plen, seed=7):
+    k = jax.random.PRNGKey(seed)
+    return jax.random.randint(k, (batch, plen), 0, config.vocab, jnp.int32)
+
+
+class TestQuantizeTensor:
+    def test_roundtrip_error_bounded_per_channel(self):
+        """|W - dq(q(W))| <= amax_channel / 127 / 2 + eps elementwise: the
+        symmetric scheme's worst case is half a quantization step."""
+        w = jax.random.normal(jax.random.PRNGKey(0), (8, 3, 5, 4), jnp.float32)
+        leaf = quantize_tensor(w, (1, 2))
+        back = dequantize(leaf)
+        step = jnp.max(jnp.abs(w), axis=(1, 2), keepdims=True) / 127.0
+        assert float(jnp.max(jnp.abs(back - w) - step / 2)) <= 1e-6
+
+    def test_scale_shape_keepdims_and_int8(self):
+        w = jnp.ones((4, 6, 2), jnp.float32)
+        leaf = quantize_tensor(w, (1,))
+        assert leaf["q"].dtype == jnp.int8
+        assert leaf["s"].shape == (4, 1, 2)
+        assert is_quantized_leaf(leaf)
+
+    def test_zero_channel_does_not_divide_by_zero(self):
+        w = jnp.zeros((3, 5), jnp.float32)
+        leaf = quantize_tensor(w, (1,))
+        assert np.all(np.asarray(leaf["q"]) == 0)
+        assert np.all(np.isfinite(np.asarray(leaf["s"])))
+
+
+class TestQuantizeParams:
+    def test_memory_reduced_below_a_third(self):
+        """f32 storage -> int8 + small f32 scales: the tree must shrink
+        past 3x (the big matmul leaves dominate)."""
+        p = init_params(TINY)
+        qp = quantize_params(p)
+        assert is_quantized(qp) and not is_quantized(p)
+        assert tree_bytes(qp) < tree_bytes(p) / 3
+
+    def test_small_leaves_kept_verbatim(self):
+        p = init_params(TINY)
+        qp = quantize_params(p)
+        for name in ("pos", "ln_f"):
+            assert qp[name] is p[name]
+        for name in ("ln1", "ln2"):
+            assert qp["layers"][name] is p["layers"][name]
+
+    def test_moe_experts_quantized_router_kept(self):
+        c = BurninConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=16,
+            batch=4, moe_experts=4,
+        )
+        p = init_params(c)
+        qp = quantize_params(p)
+        assert is_quantized_leaf(qp["layers"]["w1e"])
+        assert is_quantized_leaf(qp["layers"]["w2e"])
+        assert qp["layers"]["router"] is p["layers"]["router"]
+
+
+class TestQuantizedDecode:
+    def test_prefill_logits_close_to_fp(self):
+        """int8 decode logits track the fp32 path within a few percent of
+        the logit scale (per-channel rounding is the only error source)."""
+        p = init_params(TINY)
+        qp = quantize_params(p)
+        prompt = seeded_prompt(TINY, TINY.batch, 8)
+        cache = init_cache(TINY, TINY.batch)
+        lg_fp, _ = decode_forward(p, prompt, cache, 0, TINY)
+        lg_q, _ = decode_forward(qp, prompt, cache, 0, TINY)
+        scale = float(jnp.abs(lg_fp).max())
+        assert float(jnp.abs(lg_fp - lg_q).max()) < 0.05 * max(scale, 1.0)
+
+    def test_generate_runs_healthy_same_shape(self):
+        p = init_params(TINY)
+        qp = quantize_params(p)
+        prompt = seeded_prompt(TINY, TINY.batch, 4)
+        fn = make_generate(TINY, prompt_len=4, steps=6, with_health=True)
+        toks_fp, h_fp = fn(p, prompt)
+        toks_q, h_q = fn(qp, prompt)
+        assert bool(h_fp) and bool(h_q)
+        assert toks_q.shape == toks_fp.shape == (TINY.batch, 10)
+        # The prompt echo is exact regardless of quantization.
+        np.testing.assert_array_equal(
+            np.asarray(toks_q[:, :4]), np.asarray(prompt)
+        )
+
+    def test_mesh_quantized_logits_match_single_device(self):
+        """Sharded int8 prefill logits match the single-device int8 path
+        to bf16 tolerance.  (Token trajectories are NOT compared — the
+        repo-wide sharded-decode contract: reassociated reductions can
+        flip a near-tie argmax; see test_decode's TestShardedDecode.)"""
+        mesh = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+        qp = quantize_params(init_params(TINY))
+        prompt = seeded_prompt(TINY, TINY.batch, 6)
+
+        cache = init_cache(TINY, TINY.batch)
+        want, _ = decode_forward(qp, prompt, cache, 0, TINY)
+        got, _ = decode_forward(
+            qp, prompt, init_cache(TINY, TINY.batch), 0, TINY, mesh=mesh
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=4e-2, rtol=0
+        )
+
+        out = make_generate(
+            TINY, mesh, prompt_len=4, steps=5, quantized=True
+        )(qp, prompt[:, :4])
+        toks = np.asarray(out)
+        assert toks.shape == (TINY.batch, 9)
+        assert ((0 <= toks) & (toks < TINY.vocab)).all()
+        np.testing.assert_array_equal(toks[:, :4], np.asarray(prompt[:, :4]))
+
+    def test_padded_quantized_healthy_and_prompt_exact(self):
+        mesh = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+        p = init_params(TINY)
+        qp = quantize_params(p)
+        prompt = seeded_prompt(TINY, TINY.batch, 6)
+        lens = jnp.array([2, 6, 1, 4], jnp.int32)
+        fn = make_generate_padded(
+            TINY, mesh, prompt_slots=6, steps=4, with_health=True,
+            quantized=True,
+        )
+        toks, healthy = fn(qp, prompt, lens)
+        assert bool(healthy)
+        assert toks.shape == (TINY.batch, 10)
+
+    def test_one_shot_generate_detects_quantized_on_mesh(self):
+        """generate() must pair with quantize_params without a flag: it
+        detects the int8 tree and builds the matching mesh shardings."""
+        from tpu_dra.parallel.decode import generate
+
+        mesh = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+        qp = quantize_params(init_params(TINY))
+        prompt = seeded_prompt(TINY, TINY.batch, 4)
+        out = generate(qp, prompt, 3, TINY, mesh=mesh)
+        toks = np.asarray(out)
+        assert toks.shape == (TINY.batch, 7)
+        np.testing.assert_array_equal(toks[:, :4], np.asarray(prompt))
+
+    def test_moe_quantized_decode_healthy(self):
+        c = BurninConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=16,
+            batch=4, moe_experts=4,
+        )
+        qp = quantize_params(init_params(c))
+        prompt = seeded_prompt(c, c.batch, 4)
+        fn = make_generate(c, prompt_len=4, steps=4, with_health=True)
+        toks, healthy = fn(qp, prompt)
+        assert bool(healthy) and toks.shape == (c.batch, 8)
+
+
+class TestQuantSpecs:
+    def test_specs_mirror_tree_structure(self):
+        """quant_param_specs and quantize_params must produce congruent
+        pytrees, or the sharded jit dies on a structure mismatch."""
+        p = quantize_params(init_params(TINY))
+        specs = quant_param_specs(TINY)
+        t1 = jax.tree_util.tree_structure(p)
+        t2 = jax.tree_util.tree_structure(specs)
+        assert t1 == t2
+
+    def test_scale_spec_nulls_contraction_dims(self):
+        specs = quant_param_specs(TINY)
+        wqkv = specs["layers"]["wqkv"]
+        # q keeps the megatron layout; s nulls the contracted d_model dim
+        # (size-1 in the keepdims scale) and keeps the head sharding.
+        assert wqkv["q"][3] == "model" and wqkv["s"][3] == "model"
+        assert wqkv["s"][1] is None
+
+    def test_moe_specs_congruent(self):
+        c = BurninConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=16,
+            batch=4, moe_experts=4,
+        )
+        p = quantize_params(init_params(c))
+        specs = quant_param_specs(c)
+        assert jax.tree_util.tree_structure(p) == jax.tree_util.tree_structure(
+            specs
+        )

@@ -38,6 +38,10 @@ slice:
   autoregressive generation (`lax.scan` token loop compiled once, masked
   full-buffer attention, per-step dropless MoE routing), sharded with the
   training layout minus the sequence axis.
+- ``tpu_dra.parallel.quant``       — weight-only int8 serving quantization:
+  symmetric per-output-channel scales, dequant fused into the consuming
+  matmul (HBM reads stay int8 — decode is memory-bound, so bytes are
+  tokens/s), transparent through every decode path incl. mesh sharding.
 - ``tpu_dra.parallel.mfu``         — chip-sized MFU + HBM-bandwidth
   measurement with analytic FLOPs accounting vs published bf16 peaks.
 - ``tpu_dra.parallel.ckpt``        — sharding-aware checkpoint/resume of
@@ -66,6 +70,7 @@ from tpu_dra.parallel.decode import (
     make_generate,
     make_generate_padded,
 )
+from tpu_dra.parallel.quant import quantize_params
 
 __all__ = [
     "BurninConfig",
@@ -82,6 +87,7 @@ __all__ = [
     "logical_mesh",
     "psum_bandwidth",
     "psum_check",
+    "quantize_params",
     "ring_check",
     "slice_mesh",
     "topology_from_env",

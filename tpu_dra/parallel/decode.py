@@ -187,9 +187,16 @@ def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain,
 def _run_blocks(params, x, cache, p0, mask, config: BurninConfig, constrain):
     """Layer scan + final norm + logits, shared by the uniform and padded
     paths.  ``x``: embedded inputs (B, S, d); ``mask`` broadcastable to
-    (B, 1, S, T)."""
+    (B, 1, S, T).
+
+    Accepts int8-quantized params (quant.quantize_params) transparently:
+    each scanned layer's ``{"q","s"}`` leaves are dequantized inside the
+    scan body, where XLA fuses the convert+scale into the consuming
+    matmul — per-layer weight reads stay int8 in HBM."""
     import jax
     import jax.numpy as jnp
+
+    from tpu_dra.parallel.quant import dequantize
 
     block = functools.partial(
         _decode_block, config=config, constrain=constrain, mask=mask
@@ -197,6 +204,7 @@ def _run_blocks(params, x, cache, p0, mask, config: BurninConfig, constrain):
 
     def body(h, xs):
         layer, ck, cv = xs
+        layer = {k: dequantize(v) for k, v in layer.items()}
         h, ck, cv = block(layer, h, ck, cv, p0)
         return h, (ck, cv)
 
@@ -205,9 +213,24 @@ def _run_blocks(params, x, cache, p0, mask, config: BurninConfig, constrain):
     )
     x = _rms_norm(x, params["ln_f"])
     logits = jnp.einsum(
-        "bsd,vd->bsv", x.astype(jnp.bfloat16), params["embed"].astype(jnp.bfloat16)
+        "bsd,vd->bsv",
+        x.astype(jnp.bfloat16),
+        dequantize(params["embed"]).astype(jnp.bfloat16),
     )
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def _embed_lookup(emb, idx):
+    """Token embedding gather for a plain (V, D) table or a quantized
+    ``{"q","s"}`` one (gather int8 rows + their per-row scales: the
+    dequantized table is never materialized)."""
+    from tpu_dra.parallel.quant import is_quantized_leaf
+
+    if not is_quantized_leaf(emb):
+        return emb[idx]
+    import jax.numpy as jnp
+
+    return emb["q"][idx].astype(jnp.float32) * emb["s"][idx]
 
 
 def _make_constrain(mesh):
@@ -235,7 +258,7 @@ def decode_forward(params, tokens, cache, p0, config: BurninConfig, mesh=None):
     T = cache["k"].shape[2]
 
     pos_emb = jax.lax.dynamic_slice_in_dim(params["pos"], p0, S, axis=0)
-    x = constrain("hidden", params["embed"][tokens] + pos_emb[None, :, :])
+    x = constrain("hidden", _embed_lookup(params["embed"], tokens) + pos_emb[None, :, :])
 
     # Query at slice offset i sits at absolute position p0 + i: it may see
     # cache entries j <= p0 + i.  Everything later — including the zeroed
@@ -263,7 +286,9 @@ def decode_step_padded(params, tok, cache, lens, prompt_slots, t,
     T = cache["k"].shape[2]
 
     pos_emb = params["pos"][lens + t]  # (B, d): logical, per-row
-    x = constrain("hidden", params["embed"][tok][:, None, :] + pos_emb[:, None, :])
+    x = constrain(
+        "hidden", _embed_lookup(params["embed"], tok)[:, None, :] + pos_emb[:, None, :]
+    )
 
     slots = jnp.arange(T)[None, :]  # (1, T)
     visible = (slots < lens[:, None]) | (
@@ -334,9 +359,10 @@ def _assemble(prompt, toks, last, fin, with_health):
     return (tokens_out, fin) if with_health else tokens_out
 
 
-def _jit_sharded(run, mesh, c, sampled, extra_shardings):
+def _jit_sharded(run, mesh, c, sampled, extra_shardings, quantized=False):
     """jit tail shared by both factories: params + batch-sharded args (+
-    replicated key when sampling, guarded by _require_key)."""
+    replicated key when sampling, guarded by _require_key).  ``quantized``
+    swaps in the int8 tree's specs (same layout, scale dims nulled)."""
     import jax
 
     if mesh is None:
@@ -344,9 +370,13 @@ def _jit_sharded(run, mesh, c, sampled, extra_shardings):
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    pspecs = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), param_specs(c, mesh)
-    )
+    if quantized:
+        from tpu_dra.parallel.quant import quant_param_specs
+
+        specs = quant_param_specs(c, mesh)
+    else:
+        specs = param_specs(c, mesh)
+    pspecs = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs)
     shardings = (pspecs, *(NamedSharding(mesh, s) for s in extra_shardings))
     if sampled:
         return _require_key(
@@ -366,9 +396,14 @@ def make_generate(
     steps: int,
     temperature: float = 0.0,
     with_health: bool = False,
+    quantized: bool = False,
 ):
     """Build the jitted generation function:
     ``fn(params, prompt (B, prompt_len) int32[, key]) -> (B, prompt_len + steps)``.
+
+    ``quantized=True`` declares that ``params`` will be an int8 tree from
+    `quant.quantize_params` (only the mesh shardings depend on it — the
+    trace itself adapts to whichever tree it sees).
 
     Greedy when ``temperature == 0`` (no key argument); otherwise
     temperature-scaled categorical sampling (key required).  The whole
@@ -422,7 +457,9 @@ def make_generate(
 
     from jax.sharding import PartitionSpec as P
 
-    return _jit_sharded(run, mesh, c, sampled, [P(("data", "fsdp"), None)])
+    return _jit_sharded(
+        run, mesh, c, sampled, [P(("data", "fsdp"), None)], quantized=quantized
+    )
 
 
 def make_generate_padded(
@@ -433,6 +470,7 @@ def make_generate_padded(
     steps: int,
     temperature: float = 0.0,
     with_health: bool = False,
+    quantized: bool = False,
 ):
     """Variable-length serving: build the jitted
     ``fn(params, prompt (B, prompt_slots), lens (B,)[, key]) ->
@@ -511,6 +549,7 @@ def make_generate_padded(
     return _jit_sharded(
         run, mesh, c, sampled,
         [P(("data", "fsdp"), None), P(("data", "fsdp"))],
+        quantized=quantized,
     )
 
 
@@ -518,9 +557,12 @@ def generate(params, prompt, steps, config: BurninConfig, mesh=None,
              temperature: float = 0.0, key=None):
     """One-shot convenience over `make_generate` (compiles per distinct
     (prompt_len, steps) pair — hold on to `make_generate`'s fn for serving
-    loops)."""
+    loops).  Detects an int8 tree (quant.quantize_params) by structure, so
+    quantized params need no extra flag here."""
+    from tpu_dra.parallel.quant import is_quantized
+
     fn = make_generate(
         config, mesh, prompt_len=prompt.shape[1], steps=steps,
-        temperature=temperature,
+        temperature=temperature, quantized=is_quantized(params),
     )
     return fn(params, prompt, key) if temperature > 0 else fn(params, prompt)

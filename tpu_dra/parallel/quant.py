@@ -1,0 +1,160 @@
+"""Weight-only int8 quantization for the serving path.
+
+Decode is memory-bound: each generated token streams every weight matrix
+out of HBM once, so tokens/s is bounded by ``param_bytes / hbm_bandwidth``
+long before the MXU matters (the per-token matmuls are matvec-thin).
+Halving — here quartering, f32 storage → int8 — the bytes per weight is
+the single highest-leverage serving optimization on TPU, and it composes
+with everything else in `decode` (KV cache, scan loop, mesh sharding).
+
+Scheme: symmetric per-output-channel int8.  For each weight ``W`` with
+contraction axes ``C`` (the dims a matmul sums over), the scale is the
+per-channel absmax over ``C``::
+
+    s = amax(|W|, axis=C, keepdims=True) / 127
+    q = round(W / s)  in  int8,   W  ≈  q * s
+
+Dequantization ``q.astype(f32) * s`` happens INSIDE the consuming jit:
+XLA fuses the convert+multiply into the matmul's operand read, so HBM
+traffic stays int8 and the bf16 weight exists only as a fusion temporary.
+int8 → bf16/f32 conversion is exact (|q| ≤ 127 < 2^8), so the only error
+is the rounding step — per-channel scaling keeps it ≤ amax/127 per
+element (the roundtrip test pins this bound).
+
+What is quantized: the large matmul operands — ``wqkv``, ``wo``,
+``w1``/``w2`` (dense) or ``w1e``/``w2e`` (MoE experts), and ``embed``
+(used by both the input gather and the logits projection; one per-row
+scale serves both).  What is not: ``pos``, the RMS-norm gains, and the
+MoE ``router`` — tiny tensors whose bytes don't matter and whose
+precision does (router logits decide expert assignment; a rounding flip
+there changes routing, not just numerics).
+
+A quantized leaf is a ``{"q": int8, "s": f32}`` dict (``s`` broadcast
+-shaped, contraction dims kept as size-1), so the params tree keeps its
+exact structure otherwise and ``lax.scan`` over stacked layers slices
+``q`` and ``s`` together for free.
+
+Reference parity note: the reference driver (nvidia k8s-dra-driver) has
+no compute path at all — this module extends the compute-validation
+layer that exceeds it (SURVEY.md §5), the way TensorRT-LLM-style serving
+stacks pair with the reference's CUDA ecosystem.
+"""
+
+from __future__ import annotations
+
+from tpu_dra.parallel.burnin import BurninConfig, param_specs
+
+__all__ = [
+    "quantize_tensor",
+    "quantize_params",
+    "dequantize",
+    "is_quantized_leaf",
+    "is_quantized",
+    "quant_param_specs",
+    "tree_bytes",
+]
+
+# Quantized leaf name -> contraction axes of its consuming matmul
+# (leading stacked-layer dim included in the index).  Scales keep these
+# dims as size 1; specs null them (a size-1 dim cannot be sharded).
+_CONTRACT_AXES = {
+    "embed": (1,),        # (V, D): logits contract D; gather scales per row
+    "wqkv": (1,),         # (L, D, 3, H, K): contract D
+    "wo": (1, 2),         # (L, H, K, D): contract H, K
+    "w1": (1,),           # (L, D, F): contract D
+    "w2": (1,),           # (L, F, D): contract F
+    "w1e": (2,),          # (L, E, D, F): contract D (per expert)
+    "w2e": (2,),          # (L, E, F, D): contract F (per expert)
+}
+
+
+def quantize_tensor(w, contract_axes: "tuple[int, ...]") -> dict:
+    """Symmetric per-channel int8: ``{"q": int8, "s": f32 keepdims}``."""
+    import jax.numpy as jnp
+
+    w = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=contract_axes, keepdims=True)
+    s = jnp.where(amax > 0, amax, 1.0) / 127.0
+    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def is_quantized_leaf(leaf) -> bool:
+    return isinstance(leaf, dict) and set(leaf.keys()) == {"q", "s"}
+
+
+def is_quantized(params: dict) -> bool:
+    """True iff the params tree came from `quantize_params`."""
+    return is_quantized_leaf(params.get("embed"))
+
+
+def dequantize(leaf):
+    """``{"q","s"}`` -> f32 array (fused into the consumer under jit);
+    passes plain arrays through, so layer dicts can be mapped blindly."""
+    if not is_quantized_leaf(leaf):
+        return leaf
+    import jax.numpy as jnp
+
+    return leaf["q"].astype(jnp.float32) * leaf["s"]
+
+
+def quantize_params(params: dict, config: "BurninConfig | None" = None) -> dict:
+    """Quantize a `burnin.init_params` tree for serving.
+
+    Returns the same tree with each large-matmul leaf replaced by its
+    ``{"q","s"}`` pair; everything else (pos, norms, router) is kept
+    verbatim.  ``config`` is unused (the leaf names identify themselves)
+    but accepted for call-site symmetry with the other factories."""
+    del config
+    layers = dict(params["layers"])
+    for name, axes in _CONTRACT_AXES.items():
+        if name != "embed" and name in layers:
+            layers[name] = quantize_tensor(layers[name], axes)
+    return {
+        **params,
+        "embed": quantize_tensor(params["embed"], _CONTRACT_AXES["embed"]),
+        "layers": layers,
+    }
+
+
+def quant_param_specs(config: BurninConfig, mesh=None):
+    """PartitionSpec tree mirroring `quantize_params`' structure.
+
+    ``q`` inherits the full-precision leaf's spec unchanged (same shape).
+    ``s`` keeps the spec's non-contraction entries and nulls the
+    contraction dims — they are size 1 in the keepdims scale, and a
+    size-1 dim must not carry a mesh axis."""
+    from jax.sharding import PartitionSpec as P
+
+    specs = param_specs(config, mesh)
+
+    def scale_spec(spec, contract_axes):
+        entries = list(spec) + [None] * (max(contract_axes) + 1 - len(spec))
+        for ax in contract_axes:
+            entries[ax] = None
+        return P(*entries)
+
+    layers = dict(specs["layers"])
+    for name, axes in _CONTRACT_AXES.items():
+        if name != "embed" and name in layers:
+            layers[name] = {
+                "q": layers[name],
+                "s": scale_spec(layers[name], axes),
+            }
+    return {
+        **specs,
+        "embed": {
+            "q": specs["embed"],
+            "s": scale_spec(specs["embed"], _CONTRACT_AXES["embed"]),
+        },
+        "layers": layers,
+    }
+
+
+def tree_bytes(tree) -> int:
+    """Total on-device bytes of a params tree (quantized or not)."""
+    import jax
+
+    return sum(
+        leaf.size * leaf.dtype.itemsize for leaf in jax.tree_util.tree_leaves(tree)
+    )
