@@ -941,23 +941,41 @@ try:
                 "bf16 decode stanza not ok: skipping the int8 rerun "
                 "(its uplift would compare against a broken baseline)"
             )
-        # Weight-only int8 serving (parallel/quant.py): decode is
-        # memory-bound — tokens/s ~ hbm_bw / weight_bytes — so int8
-        # weights should approach the storage ratio in throughput.  Same
-        # generate fn (the trace adapts to the quantized tree), same
-        # prompt, uplift reported against the bf16 number above.
+        # Full int8 serving stack (parallel/quant.py + kv_int8): decode
+        # is memory-bound — tokens/s ~ hbm_bw / streamed_bytes — and the
+        # two dominant streams are the weights (int8 via quantize_params)
+        # and the KV cache (int8 rows + per-token-per-head scales), so
+        # this rerun measures both together.  Uplift reported against the
+        # bf16 number above.
+        from tpu_dra.parallel.decode import init_cache
         from tpu_dra.parallel.quant import quantize_params, tree_bytes
 
         qparams = quantize_params(params)
-        jax.block_until_ready(gen(qparams, prompt))  # compile + warmup
+        qgen = make_generate(
+            dc, prompt_len=plen, steps=steps, with_health=True, kv_int8=True
+        )
+        jax.block_until_ready(qgen(qparams, prompt))  # compile + warmup
         t0 = _time.perf_counter()
-        qres, qhealthy = jax.block_until_ready(gen(qparams, prompt))
+        qres, qhealthy = jax.block_until_ready(qgen(qparams, prompt))
         qdt = _time.perf_counter() - t0
         out["decode_int8"] = {
             "tokens_per_s": round(dc.batch * steps / qdt, 1),
             "step_ms": round(qdt / steps * 1e3, 3),
-            "bytes_ratio_vs_f32": round(
+            "weight_bytes_ratio_vs_f32": round(
                 tree_bytes(qparams) / max(1, tree_bytes(params)), 3
+            ),
+            # eval_shape: count bytes from ShapeDtypeStructs — allocating
+            # two extra chip-sized caches just for a ratio could OOM the
+            # stanza on a memory-tight config.
+            "cache_bytes_ratio_vs_bf16": round(
+                tree_bytes(
+                    jax.eval_shape(lambda: init_cache(dc, dc.batch, kv_int8=True))
+                )
+                / max(
+                    1,
+                    tree_bytes(jax.eval_shape(lambda: init_cache(dc, dc.batch))),
+                ),
+                3,
             ),
             "uplift_vs_bf16_decode": round(dt / qdt, 3),
             "ok": bool(qhealthy) and qres.shape[1] == plen + steps,

@@ -181,6 +181,64 @@ class TestQuantizedDecode:
         assert bool(healthy) and toks.shape == (c.batch, 8)
 
 
+class TestKvInt8:
+    def test_prefill_logits_close_to_bf16_cache(self):
+        """int8 KV (per-token-per-head scales) tracks the bf16 cache path
+        closely: prefill logits within a few percent of the logit scale."""
+        p = init_params(TINY)
+        prompt = seeded_prompt(TINY, TINY.batch, 8)
+        want, _ = decode_forward(p, prompt, init_cache(TINY, TINY.batch), 0, TINY)
+        got, _ = decode_forward(
+            p, prompt, init_cache(TINY, TINY.batch, kv_int8=True), 0, TINY
+        )
+        scale = float(jnp.abs(want).max())
+        assert float(jnp.abs(want - got).max()) < 0.05 * max(scale, 1.0)
+
+    def test_cache_bytes_reduced(self):
+        """1 + 4/d_head bytes per element vs bf16's 2."""
+        cb = init_cache(TINY, TINY.batch)
+        cq = init_cache(TINY, TINY.batch, kv_int8=True)
+        expect = (1 + 4 / TINY.d_head) / 2
+        assert abs(tree_bytes(cq) / tree_bytes(cb) - expect) < 1e-6
+
+    def test_generate_healthy_all_int8_combos(self):
+        """kv-int8 composes with weight-int8: every combination generates
+        healthy, same shape, exact prompt echo."""
+        p = init_params(TINY)
+        qp = quantize_params(p)
+        prompt = seeded_prompt(TINY, TINY.batch, 4)
+        fn = make_generate(TINY, prompt_len=4, steps=5, with_health=True,
+                           kv_int8=True)
+        for params in (p, qp):
+            toks, healthy = fn(params, prompt)
+            assert bool(healthy) and toks.shape == (TINY.batch, 9)
+            np.testing.assert_array_equal(
+                np.asarray(toks[:, :4]), np.asarray(prompt)
+            )
+
+    def test_padded_kv_int8_on_mesh(self):
+        mesh = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+        qp = quantize_params(init_params(TINY))
+        prompt = seeded_prompt(TINY, TINY.batch, 6)
+        lens = jnp.array([2, 6, 1, 4], jnp.int32)
+        fn = make_generate_padded(
+            TINY, mesh, prompt_slots=6, steps=4, with_health=True,
+            quantized=True, kv_int8=True,
+        )
+        toks, healthy = fn(qp, prompt, lens)
+        assert bool(healthy) and toks.shape == (TINY.batch, 10)
+
+    def test_moe_kv_int8_healthy(self):
+        c = BurninConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=16,
+            batch=4, moe_experts=4,
+        )
+        fn = make_generate(c, prompt_len=4, steps=4, with_health=True,
+                           kv_int8=True)
+        toks, healthy = fn(init_params(c), seeded_prompt(c, c.batch, 4))
+        assert bool(healthy) and toks.shape == (c.batch, 8)
+
+
 class TestQuantSpecs:
     def test_specs_mirror_tree_structure(self):
         """quant_param_specs and quantize_params must produce congruent

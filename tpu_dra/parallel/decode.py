@@ -22,6 +22,12 @@ the TPU-native incremental path:
   over the mesh's ``model`` axis, batch over ``data``×``fsdp`` — decode on
   a mesh is the training layout minus the sequence dimension.  Weight
   layouts come from `burnin.param_specs` unchanged.
+- **int8 serving storage, both streams**: weights via `quant
+  .quantize_params` (dequant fused into each matmul), and the KV cache
+  via ``kv_int8=True`` (rows quantized once at insert with per-token
+  -per-head scales, dequantized fused into every attention read) — the
+  two dominant HBM streams of the memory-bound decode step, ~3.5× and
+  ~2× smaller respectively.
 
 MoE configs are served with **per-step routing**: each generated token
 goes to its argmax expert with per-call capacity (``expert_capacity`` of
@@ -103,30 +109,91 @@ def _validate(config: BurninConfig) -> None:
         )
 
 
-def init_cache(config: BurninConfig, batch: int):
+def init_cache(config: BurninConfig, batch: int, kv_int8: bool = False):
     """Zeroed KV cache: ``{"k","v"}`` of (L, B, T, H, d_head) bf16, where
     T is the model's full context (``config.seq`` — the positional table's
     reach).  bf16 matches the training compute dtype, halves the HBM
     footprint of the dominant serving tensor, and keeps the cache-read
-    matmuls on the MXU's native input type."""
+    matmuls on the MXU's native input type.
+
+    ``kv_int8=True`` stores each K/V entry as int8 with a per-token
+    -per-head scale (``{"q": int8 (L,B,T,H,K), "s": f32 (L,B,T,H,1)}`` —
+    the same ``{"q","s"}`` leaf convention as quant.py's weights): rows
+    are quantized once at insert and dequantized fused into every
+    attention read, so the dominant long-context tensor streams at
+    ~half its bf16 bytes (1 + 4/d_head per element vs 2)."""
     import jax.numpy as jnp
 
     c = config
     shape = (c.n_layers, batch, c.seq, c.n_heads, c.d_head)
+    if not kv_int8:
+        return {
+            "k": jnp.zeros(shape, jnp.bfloat16),
+            "v": jnp.zeros(shape, jnp.bfloat16),
+        }
+    sshape = shape[:-1] + (1,)
     return {
-        "k": jnp.zeros(shape, jnp.bfloat16),
-        "v": jnp.zeros(shape, jnp.bfloat16),
+        "k": {"q": jnp.zeros(shape, jnp.int8),
+              "s": jnp.zeros(sshape, jnp.float32)},
+        "v": {"q": jnp.zeros(shape, jnp.int8),
+              "s": jnp.zeros(sshape, jnp.float32)},
     }
 
 
-def cache_spec(config: BurninConfig):
+def cache_spec(config: BurninConfig, kv_int8: bool = False):
     """PartitionSpec for the cache: batch over data x fsdp, heads over the
     tp axis — the attention block's training layout without the sequence
     sharding (the cache's T dim must stay whole: every step reads all of
-    it)."""
+    it).  With ``kv_int8`` the spec is the matching ``{"q","s"}`` pair
+    (the scale's size-1 trailing dim stays unsharded)."""
     from jax.sharding import PartitionSpec as P
 
-    return P(None, ("data", "fsdp"), None, "model", None)
+    spec = P(None, ("data", "fsdp"), None, "model", None)
+    if not kv_int8:
+        return spec
+    return {"q": spec, "s": spec}
+
+
+def _cache_update(cbuf, new, p0):
+    """Write ``new`` (B, S, H, K) into cache slots [p0, p0+S) of ``cbuf``
+    — a bf16 buffer (B, T, H, K), or an int8 ``{"q","s"}`` pair, in which
+    case each row is quantized ONCE here (per-token-per-head symmetric
+    scale over d_head) and never re-quantized."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dra.parallel.quant import is_quantized_leaf
+
+    if not is_quantized_leaf(cbuf):
+        return jax.lax.dynamic_update_slice_in_dim(
+            cbuf, new.astype(jnp.bfloat16), p0, axis=1
+        )
+    from tpu_dra.parallel.quant import quantize_tensor
+
+    row = quantize_tensor(new, (3,))  # scale over d_head: one policy, quant.py's
+    return {
+        "q": jax.lax.dynamic_update_slice_in_dim(cbuf["q"], row["q"], p0, axis=1),
+        "s": jax.lax.dynamic_update_slice_in_dim(cbuf["s"], row["s"], p0, axis=1),
+    }
+
+
+def _cache_len(cache) -> int:
+    """Context length T of a cache in either storage format."""
+    k = cache["k"]
+    return (k["q"] if isinstance(k, dict) else k).shape[2]
+
+
+def _cache_read(cbuf):
+    """The attention-ready bf16 view of a cache buffer; for the int8 form
+    the convert+scale fuses into the consuming einsum's operand read, so
+    HBM traffic stays int8 + one scale per token-head.  One dequant
+    policy: quant.dequantize (passes the plain bf16 buffer through, where
+    the astype is a no-op)."""
+    import jax.numpy as jnp
+
+    from tpu_dra.parallel.quant import dequantize
+
+    return dequantize(cbuf).astype(jnp.bfloat16)
 
 
 def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain,
@@ -150,14 +217,14 @@ def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain,
     qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(bf16))
     q, k_new, v_new = qkv[0], qkv[1], qkv[2]
 
-    ck = jax.lax.dynamic_update_slice_in_dim(ck, k_new.astype(bf16), p0, axis=1)
-    cv = jax.lax.dynamic_update_slice_in_dim(cv, v_new.astype(bf16), p0, axis=1)
+    ck = _cache_update(ck, k_new, p0)
+    cv = _cache_update(cv, v_new, p0)
 
-    scores = jnp.einsum("bshk,bthk->bhst", q, ck) / (c.d_head**0.5)
+    scores = jnp.einsum("bshk,bthk->bhst", q, _cache_read(ck)) / (c.d_head**0.5)
     scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
     probs = jnp.exp(scores - scores.max(-1, keepdims=True))
     probs = (probs / probs.sum(-1, keepdims=True)).astype(bf16)
-    att = jnp.einsum("bhst,bthk->bshk", probs, cv)
+    att = jnp.einsum("bhst,bthk->bshk", probs, _cache_read(cv))
     att = jnp.einsum("bshk,hkd->bsd", att, layer["wo"].astype(bf16))
     x = x + att
 
@@ -255,7 +322,7 @@ def decode_forward(params, tokens, cache, p0, config: BurninConfig, mesh=None):
     _validate(c)
     constrain = _make_constrain(mesh)
     S = tokens.shape[1]
-    T = cache["k"].shape[2]
+    T = _cache_len(cache)
 
     pos_emb = jax.lax.dynamic_slice_in_dim(params["pos"], p0, S, axis=0)
     x = constrain("hidden", _embed_lookup(params["embed"], tokens) + pos_emb[None, :, :])
@@ -283,7 +350,7 @@ def decode_step_padded(params, tok, cache, lens, prompt_slots, t,
     c = config
     _validate(c)
     constrain = _make_constrain(mesh)
-    T = cache["k"].shape[2]
+    T = _cache_len(cache)
 
     pos_emb = params["pos"][lens + t]  # (B, d): logical, per-row
     x = constrain(
@@ -336,16 +403,21 @@ def _make_keys(sampled: bool, key, steps: int):
     )
 
 
-def _fresh_cache(c: BurninConfig, batch: int, mesh):
+def _fresh_cache(c: BurninConfig, batch: int, mesh, kv_int8: bool = False):
     import jax
 
-    cache = init_cache(c, batch)
+    cache = init_cache(c, batch, kv_int8)
     if mesh is not None:
         from jax.sharding import NamedSharding
 
-        spec = NamedSharding(mesh, cache_spec(c))
+        leaf_spec = cache_spec(c, kv_int8)
+        specs = {"k": leaf_spec, "v": leaf_spec}
         cache = jax.tree_util.tree_map(
-            lambda a: jax.lax.with_sharding_constraint(a, spec), cache
+            lambda a, s: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, s)
+            ),
+            cache,
+            specs,
         )
     return cache
 
@@ -397,6 +469,7 @@ def make_generate(
     temperature: float = 0.0,
     with_health: bool = False,
     quantized: bool = False,
+    kv_int8: bool = False,
 ):
     """Build the jitted generation function:
     ``fn(params, prompt (B, prompt_len) int32[, key]) -> (B, prompt_len + steps)``.
@@ -430,7 +503,7 @@ def make_generate(
             raise ValueError(
                 "temperature > 0 requires a PRNG key: fn(params, prompt, key)"
             )
-        cache = _fresh_cache(c, prompt.shape[0], mesh)
+        cache = _fresh_cache(c, prompt.shape[0], mesh, kv_int8)
         logits, cache = decode_forward(params, prompt, cache, 0, c, mesh)
         keys = _make_keys(sampled, key, steps)
         tok = pick(logits[:, -1], keys[0])
@@ -471,6 +544,7 @@ def make_generate_padded(
     temperature: float = 0.0,
     with_health: bool = False,
     quantized: bool = False,
+    kv_int8: bool = False,
 ):
     """Variable-length serving: build the jitted
     ``fn(params, prompt (B, prompt_slots), lens (B,)[, key]) ->
@@ -519,7 +593,7 @@ def make_generate_padded(
             )
         in_contract = (lens >= 1) & (lens <= prompt_slots)
         lens_c = jnp.clip(lens, 1, prompt_slots)
-        cache = _fresh_cache(c, prompt.shape[0], mesh)
+        cache = _fresh_cache(c, prompt.shape[0], mesh, kv_int8)
         logits, cache = decode_forward(params, prompt, cache, 0, c, mesh)
         # Row b's next token comes from its LAST REAL position, lens[b]-1.
         last = jnp.take_along_axis(
