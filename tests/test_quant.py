@@ -239,6 +239,73 @@ class TestKvInt8:
         assert bool(healthy) and toks.shape == (c.batch, 8)
 
 
+class TestChunkedPrefill:
+    def test_every_chunk_size_token_exact(self):
+        """Chunked prefill is the same cache math at different offsets —
+        tokens must match the one-shot prefill exactly (not just close:
+        each window is the identical masked-buffer computation row-wise)."""
+        p = init_params(TINY)
+        prompt = seeded_prompt(TINY, TINY.batch, 8)
+        one = make_generate(TINY, prompt_len=8, steps=4)(p, prompt)
+        for chunk in (1, 2, 4, 8):
+            got = make_generate(
+                TINY, prompt_len=8, steps=4, prefill_chunk=chunk
+            )(p, prompt)
+            np.testing.assert_array_equal(np.asarray(one), np.asarray(got))
+
+    def test_non_dividing_chunk_rejected(self):
+        with pytest.raises(ValueError, match="must divide prompt_len"):
+            make_generate(TINY, prompt_len=8, steps=2, prefill_chunk=3)
+
+    def test_moe_chunking_rejected(self):
+        """Per-window capacity queues would change MoE routing vs the
+        one-shot prefill — rejected, not silently divergent."""
+        c = BurninConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=16,
+            batch=4, moe_experts=4,
+        )
+        with pytest.raises(ValueError, match="not supported with moe"):
+            make_generate(c, prompt_len=8, steps=2, prefill_chunk=4)
+        # chunk == prompt_len is the one-shot path: allowed even for MoE.
+        make_generate(c, prompt_len=8, steps=2, prefill_chunk=8)
+
+    def test_composes_with_int8_stack_on_mesh(self):
+        mesh = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+        qp = quantize_params(init_params(TINY))
+        prompt = seeded_prompt(TINY, TINY.batch, 8)
+        fn = make_generate(
+            TINY, mesh, prompt_len=8, steps=3, with_health=True,
+            quantized=True, kv_int8=True, prefill_chunk=4,
+        )
+        toks, healthy = fn(qp, prompt)
+        assert bool(healthy) and toks.shape == (TINY.batch, 11)
+        np.testing.assert_array_equal(
+            np.asarray(toks[:, :8]), np.asarray(prompt)
+        )
+
+    def test_mesh_chunked_prefill_logits_ulp_close(self):
+        """On a mesh, chunked vs one-shot prefill differ only by sharded
+        reduction tiling: logits match to the repo-wide bf16 tolerance
+        (tokens may near-tie-flip — the sharded-decode contract)."""
+        mesh = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+        p = init_params(TINY)
+        prompt = seeded_prompt(TINY, TINY.batch, 8)
+        one, _ = decode_forward(
+            p, prompt, init_cache(TINY, TINY.batch), 0, TINY, mesh=mesh
+        )
+        cache = init_cache(TINY, TINY.batch)
+        outs = []
+        for i in range(2):
+            lg, cache = decode_forward(
+                p, prompt[:, i * 4:(i + 1) * 4], cache, i * 4, TINY, mesh=mesh
+            )
+            outs.append(lg)
+        chunked = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(one), np.asarray(chunked), atol=4e-2, rtol=0
+        )
+
+
 class TestQuantSpecs:
     def test_specs_mirror_tree_structure(self):
         """quant_param_specs and quantize_params must produce congruent

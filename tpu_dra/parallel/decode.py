@@ -470,6 +470,7 @@ def make_generate(
     with_health: bool = False,
     quantized: bool = False,
     kv_int8: bool = False,
+    prefill_chunk: "int | None" = None,
 ):
     """Build the jitted generation function:
     ``fn(params, prompt (B, prompt_len) int32[, key]) -> (B, prompt_len + steps)``.
@@ -477,6 +478,21 @@ def make_generate(
     ``quantized=True`` declares that ``params`` will be an int8 tree from
     `quant.quantize_params` (only the mesh shardings depend on it — the
     trace itself adapts to whichever tree it sees).
+
+    ``prefill_chunk=C`` (must divide ``prompt_len``) runs the prefill as
+    a `lax.scan` over C-token windows instead of one prompt-wide pass:
+    the (S, T) attention-score buffer — prefill's dominant activation —
+    shrinks from (prompt_len, T) to (C, T), bounding prefill memory for
+    long prompts at prompt_len/C times less, while the cache math is
+    identical (each window is `decode_forward` at its own offset, the
+    same masked-buffer path a decode step uses).  One chunk program is
+    compiled and reused across windows.  Dense configs only (MoE is
+    rejected: per-window capacity queues would change routing vs the
+    one-shot prefill).  Single-device the result is token-EXACT vs the
+    one-shot prefill; on a mesh it is bf16-ulp-close (different einsum
+    shapes tile the sharded reductions differently — the same
+    sharded-decode contract as everywhere else: logits match to
+    tolerance, a near-tie argmax may flip).
 
     Greedy when ``temperature == 0`` (no key argument); otherwise
     temperature-scaled categorical sampling (key required).  The whole
@@ -495,8 +511,54 @@ def make_generate(
     c = config
     _validate(c)
     _check_window(c, prompt_len, steps, "prompt_len")
+    if prefill_chunk is not None and (
+        prefill_chunk < 1 or prompt_len % prefill_chunk != 0
+    ):
+        raise ValueError(
+            f"prefill_chunk must divide prompt_len, got "
+            f"{prefill_chunk} vs {prompt_len}"
+        )
+    if prefill_chunk is not None and prefill_chunk != prompt_len and c.moe_experts > 0:
+        raise ValueError(
+            "prefill_chunk is not supported with moe_experts > 0: each "
+            "window would restart the per-expert capacity queue, so "
+            "chunked routing (and drops) would diverge from the one-shot "
+            "prefill's — breaking the drops-exactly-when-training-would "
+            "serving invariant (chunk the attention, not the router)"
+        )
     sampled = temperature > 0.0
     pick = _make_pick(sampled, temperature)
+
+    def prefill(params, prompt, cache):
+        """Returns (last-position logits (B, vocab), cache)."""
+        if prefill_chunk is None or prefill_chunk == prompt_len:
+            logits, cache = decode_forward(params, prompt, cache, 0, c, mesh)
+            return logits[:, -1], cache
+        nchunks = prompt_len // prefill_chunk
+        # (B, P) -> (nchunks, B, C): scan iterates windows in order.
+        windows = prompt.reshape(
+            prompt.shape[0], nchunks, prefill_chunk
+        ).transpose(1, 0, 2)
+
+        def one_window(carry, xs):
+            cache, _ = carry
+            window, i = xs
+            logits, cache = decode_forward(
+                params, window, cache, i * prefill_chunk, c, mesh
+            )
+            # Last-position logits ride the CARRY (only the final
+            # window's survive) — stacking them as scan ys would
+            # materialize an (nchunks, B, vocab) buffer, defeating the
+            # bounded-activation point of chunking.
+            return (cache, logits[:, -1]), None
+
+        seed = jnp.zeros((prompt.shape[0], c.vocab), jnp.float32)
+        (cache, last), _ = jax.lax.scan(
+            one_window,
+            (cache, seed),
+            (windows, jnp.arange(nchunks, dtype=jnp.int32)),
+        )
+        return last, cache
 
     def run(params, prompt, key=None):
         if sampled and key is None:
@@ -504,10 +566,10 @@ def make_generate(
                 "temperature > 0 requires a PRNG key: fn(params, prompt, key)"
             )
         cache = _fresh_cache(c, prompt.shape[0], mesh, kv_int8)
-        logits, cache = decode_forward(params, prompt, cache, 0, c, mesh)
+        last_logits, cache = prefill(params, prompt, cache)
         keys = _make_keys(sampled, key, steps)
-        tok = pick(logits[:, -1], keys[0])
-        fin = jnp.isfinite(logits[:, -1]).all()
+        tok = pick(last_logits, keys[0])
+        fin = jnp.isfinite(last_logits).all()
 
         def step(carry, xs):
             cache, tok, pos, fin = carry
