@@ -342,3 +342,114 @@ class TestMoeDecode:
         got = generate(params, prompt, 6, cfg)
         want = naive_generate(params, prompt, 6, cfg)
         np.testing.assert_array_equal(np.asarray(got), want)
+
+
+class TestTopKTopP:
+    """top-k / top-p (nucleus) sampling: static-shape filters composed
+    into the compiled generation scan (decode.filter_logits)."""
+
+    def _cfg(self):
+        return BurninConfig(
+            vocab=128, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=32,
+            batch=4,
+        )
+
+    def test_top_k_support_is_exactly_k(self):
+        from tpu_dra.parallel.decode import filter_logits
+
+        logits = jax.random.normal(jax.random.PRNGKey(5), (4, 128))
+        f = filter_logits(logits, top_k=5)
+        assert (np.isfinite(np.asarray(f)).sum(-1) == 5).all()
+        # the top-k values themselves are untouched
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(f), -1)[:, -5:],
+            np.sort(np.asarray(logits), -1)[:, -5:],
+        )
+
+    def test_top_p_keeps_argmax_and_shrinks_support(self):
+        from tpu_dra.parallel.decode import filter_logits
+
+        logits = jax.random.normal(jax.random.PRNGKey(6), (4, 128))
+        f = filter_logits(logits, top_p=0.5)
+        fin = np.isfinite(np.asarray(f))
+        assert (fin.sum(-1) >= 1).all() and (fin.sum(-1) < 128).all()
+        np.testing.assert_array_equal(
+            np.argmax(np.asarray(f), -1), np.argmax(np.asarray(logits), -1)
+        )
+
+    def test_top_k_1_is_greedy_any_key(self):
+        c = self._cfg()
+        params = init_params(c)
+        prompt = seeded_prompt(c, c.batch, 8)
+        greedy = make_generate(c, prompt_len=8, steps=5)(params, prompt)
+        for seed in (0, 1, 2):
+            got = make_generate(
+                c, prompt_len=8, steps=5, temperature=0.7, top_k=1
+            )(params, prompt, jax.random.PRNGKey(seed))
+            np.testing.assert_array_equal(np.asarray(greedy), np.asarray(got))
+
+    def test_top_p_1_matches_plain_sampling_same_key(self):
+        c = self._cfg()
+        params = init_params(c)
+        prompt = seeded_prompt(c, c.batch, 8)
+        key = jax.random.PRNGKey(11)
+        plain = make_generate(c, prompt_len=8, steps=5, temperature=0.8)(
+            params, prompt, key
+        )
+        nucleus = make_generate(
+            c, prompt_len=8, steps=5, temperature=0.8, top_p=1.0
+        )(params, prompt, key)
+        np.testing.assert_array_equal(np.asarray(plain), np.asarray(nucleus))
+
+    def test_bad_bounds_rejected(self):
+        from tpu_dra.parallel.decode import filter_logits
+
+        logits = jnp.zeros((2, 8))
+        with pytest.raises(ValueError, match="top_k"):
+            filter_logits(logits, top_k=0)
+        with pytest.raises(ValueError, match="top_k"):
+            filter_logits(logits, top_k=9)  # > vocab
+        with pytest.raises(ValueError, match="top_p"):
+            filter_logits(logits, top_p=0.0)
+
+    def test_ties_keep_exactly_k_matching_argmax(self):
+        """The stable sort breaks ties by index: tied maxima never widen
+        the support, and top_k=1 keeps exactly the greedy token."""
+        from tpu_dra.parallel.decode import filter_logits
+
+        logits = jnp.array([[3.0, 3.0, 1.0, 3.0]])
+        f1 = np.asarray(filter_logits(logits, top_k=1))
+        assert np.isfinite(f1).sum() == 1
+        assert np.argmax(f1) == 0  # argmax also picks the first max
+        f2 = np.asarray(filter_logits(logits, top_k=2))
+        assert np.isfinite(f2[0]).tolist() == [True, True, False, False]
+
+    def test_build_time_validation(self):
+        """Filter misuse fails at factory time with a clear message, not
+        deep inside the first pjit trace — and a filter that greedy mode
+        would silently ignore is rejected."""
+        c = self._cfg()
+        with pytest.raises(ValueError, match="require temperature"):
+            make_generate(c, prompt_len=8, steps=2, top_k=5)
+        with pytest.raises(ValueError, match="top_k must be in"):
+            make_generate(
+                c, prompt_len=8, steps=2, temperature=0.5, top_k=c.vocab + 1
+            )
+        with pytest.raises(ValueError, match="top_p must be in"):
+            make_generate(
+                c, prompt_len=8, steps=2, temperature=0.5, top_p=1.5
+            )
+
+    def test_padded_path_accepts_filters(self):
+        from tpu_dra.parallel.decode import make_generate_padded
+
+        c = self._cfg()
+        params = init_params(c)
+        prompt = seeded_prompt(c, c.batch, 8)
+        lens = jnp.array([3, 8, 1, 5], jnp.int32)
+        fn = make_generate_padded(
+            c, prompt_slots=8, steps=4, temperature=0.9, top_k=10, top_p=0.9,
+            with_health=True,
+        )
+        toks, healthy = fn(params, prompt, lens, jax.random.PRNGKey(2))
+        assert bool(healthy) and toks.shape == (c.batch, 12)

@@ -61,6 +61,7 @@ from tpu_dra.parallel.burnin import (
 )
 
 __all__ = [
+    "filter_logits",
     "init_cache",
     "decode_forward",
     "decode_step_padded",
@@ -378,16 +379,84 @@ def _check_window(c: BurninConfig, first: int, steps: int, name: str) -> None:
         )
 
 
-def _make_pick(sampled: bool, temperature: float):
+def _validate_filters(vocab: int, sampled: bool, top_k: "int | None",
+                      top_p: "float | None") -> None:
+    """Build-time filter validation shared by both generate factories:
+    errors must surface at factory time with a clear message, not as an
+    opaque failure deep inside the first pjit trace — and a filter that
+    would be silently ignored (greedy mode) is a caller bug."""
+    if top_k is None and top_p is None:
+        return
+    if not sampled:
+        raise ValueError(
+            "top_k/top_p require temperature > 0 (greedy argmax ignores "
+            "the sampling support)"
+        )
+    if top_k is not None and not 1 <= top_k <= vocab:
+        raise ValueError(f"top_k must be in [1, {vocab}], got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+def filter_logits(logits, *, top_k: "int | None" = None,
+                  top_p: "float | None" = None):
+    """Restrict a (B, vocab) logit row to its sampling support: tokens
+    outside the top-k set and/or the top-p nucleus get -inf.
+
+    Static-shape TPU formulation — ONE descending argsort feeds both
+    filters (rank mask + nucleus mask scattered back through the sort
+    permutation; no dynamic gather sizes), so the filter jits into the
+    per-token generation scan at a single O(V log V) sort:
+
+    - top-k: keep ranks < k.  The stable sort breaks ties by index, so
+      the support is EXACTLY k tokens and top_k=1 keeps precisely the
+      token greedy argmax would pick (argmax also takes the first max).
+    - top-p: softmax over the sorted row, exclusive cumulative sum; a
+      token stays while the probability mass STRICTLY BEFORE it is < p
+      (the argmax always stays, any p).
+
+    Both filters compose (intersection of supports)."""
+    import jax.numpy as jnp
+
+    V = logits.shape[-1]
+    if top_k is not None and not 1 <= top_k <= V:
+        raise ValueError(f"top_k must be in [1, {V}], got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+    neg = jnp.asarray(-jnp.inf, logits.dtype)
+    # jnp.argsort is stable: equal logits keep index order, so rank 0 is
+    # always the token argmax returns.
+    order = jnp.argsort(-logits, axis=-1)
+    keep_sorted = jnp.ones(logits.shape, bool)
+    if top_k is not None:
+        keep_sorted &= jnp.arange(V) < top_k
+    if top_p is not None:
+        from jax.nn import softmax
+
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        probs = softmax(sorted_logits, axis=-1)
+        before = jnp.cumsum(probs, axis=-1) - probs  # exclusive
+        keep_sorted &= before < top_p
+    keep = jnp.put_along_axis(
+        jnp.zeros(logits.shape, bool), order, keep_sorted, axis=-1,
+        inplace=False,
+    )
+    return jnp.where(keep, logits, neg)
+
+
+def _make_pick(sampled: bool, temperature: float,
+               top_k: "int | None" = None, top_p: "float | None" = None):
     import jax
     import jax.numpy as jnp
 
     def pick(logits, key):
         if not sampled:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
-            jnp.int32
-        )
+        scaled = logits / temperature
+        if top_k is not None or top_p is not None:
+            scaled = filter_logits(scaled, top_k=top_k, top_p=top_p)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
     return pick
 
@@ -467,6 +536,8 @@ def make_generate(
     prompt_len: int,
     steps: int,
     temperature: float = 0.0,
+    top_k: "int | None" = None,
+    top_p: "float | None" = None,
     with_health: bool = False,
     quantized: bool = False,
     kv_int8: bool = False,
@@ -527,7 +598,8 @@ def make_generate(
             "serving invariant (chunk the attention, not the router)"
         )
     sampled = temperature > 0.0
-    pick = _make_pick(sampled, temperature)
+    _validate_filters(c.vocab, sampled, top_k, top_p)
+    pick = _make_pick(sampled, temperature, top_k, top_p)
 
     def prefill(params, prompt, cache):
         """Returns (last-position logits (B, vocab), cache)."""
@@ -604,6 +676,8 @@ def make_generate_padded(
     prompt_slots: int,
     steps: int,
     temperature: float = 0.0,
+    top_k: "int | None" = None,
+    top_p: "float | None" = None,
     with_health: bool = False,
     quantized: bool = False,
     kv_int8: bool = False,
@@ -646,7 +720,8 @@ def make_generate_padded(
     _validate(c)
     _check_window(c, prompt_slots, steps, "prompt_slots")
     sampled = temperature > 0.0
-    pick = _make_pick(sampled, temperature)
+    _validate_filters(c.vocab, sampled, top_k, top_p)
+    pick = _make_pick(sampled, temperature, top_k, top_p)
 
     def run(params, prompt, lens, key=None):
         if sampled and key is None:
