@@ -39,11 +39,18 @@ import bench  # noqa: E402
 
 RESULT_PATH = os.path.join(REPO, ".tpu_catch_result.json")
 STATUS_PATH = os.path.join(REPO, ".tpu_catch_status")
+HISTORY_PATH = os.path.join(REPO, ".tpu_catch_history")
 
 
 def _status(line: str) -> None:
+    """Current state (overwritten) + append-only history: the history is
+    the evidence trail that the hunt ran all round — a tunnel that never
+    opened shows as an unbroken DOWN column with timestamps, not as an
+    absence of data."""
     stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     with open(STATUS_PATH, "w") as f:
+        f.write(f"{line} {stamp}\n")
+    with open(HISTORY_PATH, "a") as f:
         f.write(f"{line} {stamp}\n")
 
 

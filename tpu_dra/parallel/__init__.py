@@ -66,9 +66,13 @@ from tpu_dra.parallel.collectives import (
 from tpu_dra.parallel.validate import SliceReport, validate_slice
 from tpu_dra.parallel.burnin import BurninConfig, TrainReport, train
 from tpu_dra.parallel.decode import (
+    expand_cache,
+    filter_logits,
     generate,
     make_generate,
+    make_generate_from_cache,
     make_generate_padded,
+    make_prefill,
 )
 from tpu_dra.parallel.quant import quantize_params
 
@@ -78,9 +82,13 @@ __all__ = [
     "SliceReport",
     "TrainReport",
     "train",
+    "expand_cache",
+    "filter_logits",
     "generate",
     "make_generate",
+    "make_generate_from_cache",
     "make_generate_padded",
+    "make_prefill",
     "all_gather_check",
     "hierarchical_psum",
     "hierarchical_psum_check",

@@ -61,12 +61,15 @@ from tpu_dra.parallel.burnin import (
 )
 
 __all__ = [
+    "expand_cache",
     "filter_logits",
     "init_cache",
     "decode_forward",
     "decode_step_padded",
     "make_generate",
+    "make_generate_from_cache",
     "make_generate_padded",
+    "make_prefill",
     "generate",
 ]
 
@@ -500,10 +503,17 @@ def _assemble(prompt, toks, last, fin, with_health):
     return (tokens_out, fin) if with_health else tokens_out
 
 
-def _jit_sharded(run, mesh, c, sampled, extra_shardings, quantized=False):
-    """jit tail shared by both factories: params + batch-sharded args (+
-    replicated key when sampling, guarded by _require_key).  ``quantized``
-    swaps in the int8 tree's specs (same layout, scale dims nulled)."""
+def _jit_sharded(run, mesh, c, sampled, extra_shardings, quantized=False,
+                 out_shardings=None):
+    """jit tail shared by the generate/prefill factories: params +
+    batch-sharded args (+ replicated key when sampling, guarded by
+    _require_key).  Each extra sharding may be a single PartitionSpec or
+    a spec TREE (e.g. the KV-cache dict for from-cache generation).
+    ``quantized`` swaps in the int8 tree's specs (same layout, scale
+    dims nulled).  ``out_shardings`` (spec tree) pins the OUTPUT layout —
+    `make_prefill` needs it so the state it returns matches exactly the
+    in_shardings `make_generate_from_cache` declares (XLA's chosen
+    output sharding need not, and in practice does not)."""
     import jax
 
     if mesh is None:
@@ -517,16 +527,116 @@ def _jit_sharded(run, mesh, c, sampled, extra_shardings, quantized=False):
         specs = quant_param_specs(c, mesh)
     else:
         specs = param_specs(c, mesh)
-    pspecs = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs)
-    shardings = (pspecs, *(NamedSharding(mesh, s) for s in extra_shardings))
+
+    def named(tree):
+        return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), tree)
+
+    kw = {}
+    if out_shardings is not None:
+        kw["out_shardings"] = named(out_shardings)
+    pspecs = named(specs)
+    shardings = (pspecs, *(named(s) for s in extra_shardings))
     if sampled:
         return _require_key(
             jax.jit(
-                run, in_shardings=(*shardings, NamedSharding(mesh, P()))
+                run, in_shardings=(*shardings, NamedSharding(mesh, P())), **kw
             ),
             nargs=len(extra_shardings) + 1,
         )
-    return jax.jit(run, in_shardings=shardings)
+    return jax.jit(run, in_shardings=shardings, **kw)
+
+
+def _build_prefill(c: BurninConfig, mesh, prompt_len: int,
+                   prefill_chunk: "int | None"):
+    """Returns ``prefill(params, prompt, cache) -> (last_logits, cache)``
+    — one-shot or scanned-window (chunked) prefill, shared by
+    `make_generate` and `make_prefill`."""
+    import jax
+    import jax.numpy as jnp
+
+    def prefill(params, prompt, cache):
+        if prefill_chunk is None or prefill_chunk == prompt_len:
+            logits, cache = decode_forward(params, prompt, cache, 0, c, mesh)
+            return logits[:, -1], cache
+        nchunks = prompt_len // prefill_chunk
+        # (B, P) -> (nchunks, B, C): scan iterates windows in order.
+        windows = prompt.reshape(
+            prompt.shape[0], nchunks, prefill_chunk
+        ).transpose(1, 0, 2)
+
+        def one_window(carry, xs):
+            cache, _ = carry
+            window, i = xs
+            logits, cache = decode_forward(
+                params, window, cache, i * prefill_chunk, c, mesh
+            )
+            # Last-position logits ride the CARRY (only the final
+            # window's survive) — stacking them as scan ys would
+            # materialize an (nchunks, B, vocab) buffer, defeating the
+            # bounded-activation point of chunking.
+            return (cache, logits[:, -1]), None
+
+        seed = jnp.zeros((prompt.shape[0], c.vocab), jnp.float32)
+        (cache, last), _ = jax.lax.scan(
+            one_window,
+            (cache, seed),
+            (windows, jnp.arange(nchunks, dtype=jnp.int32)),
+        )
+        return last, cache
+
+    return prefill
+
+
+def _token_loop(params, cache, last_logits, pos0, keys, pick, c, mesh):
+    """The compiled generation loop from a prefilled state: sample the
+    first token from ``last_logits`` (the logits at position pos0-1),
+    then scan ``len(keys) - 1`` cached decode steps starting at pos0.
+    Returns ``(toks (steps-1, B) fed tokens, last (B,) final sample,
+    fin all-logits-finite flag)`` — shared by `make_generate` and
+    `make_generate_from_cache`."""
+    import jax
+    import jax.numpy as jnp
+
+    tok = pick(last_logits, keys[0])
+    fin = jnp.isfinite(last_logits).all()
+
+    def step(carry, xs):
+        cache, tok, pos, fin = carry
+        k = xs
+        logits, cache = decode_forward(
+            params, tok[:, None], cache, pos, c, mesh
+        )
+        nxt = pick(logits[:, -1], k)
+        fin = jnp.logical_and(fin, jnp.isfinite(logits[:, -1]).all())
+        return (cache, nxt, pos + 1, fin), tok
+
+    # steps - 1 cached decode steps: the prefill already sampled token
+    # 1 of `steps`, and the final sampled token is never fed back.
+    # toks collects the token FED at each step; `last` is the final
+    # sample — together the generated continuation.
+    (_, last, _, fin), toks = jax.lax.scan(
+        step, (cache, tok, jnp.int32(pos0), fin), keys[1:]
+    )
+    return toks, last, fin
+
+
+def _check_chunk(c: BurninConfig, prompt_len: int,
+                 prefill_chunk: "int | None") -> None:
+    if prefill_chunk is not None and (
+        prefill_chunk < 1 or prompt_len % prefill_chunk != 0
+    ):
+        raise ValueError(
+            f"prefill_chunk must divide prompt_len, got "
+            f"{prefill_chunk} vs {prompt_len}"
+        )
+    if prefill_chunk is not None and prefill_chunk != prompt_len and c.moe_experts > 0:
+        raise ValueError(
+            "prefill_chunk is not supported with moe_experts > 0: each "
+            "window would restart the per-expert capacity queue, so "
+            "chunked routing (and drops) would diverge from the one-shot "
+            "prefill's — breaking the drops-exactly-when-training-would "
+            "serving invariant (chunk the attention, not the router)"
+        )
 
 
 def make_generate(
@@ -582,55 +692,11 @@ def make_generate(
     c = config
     _validate(c)
     _check_window(c, prompt_len, steps, "prompt_len")
-    if prefill_chunk is not None and (
-        prefill_chunk < 1 or prompt_len % prefill_chunk != 0
-    ):
-        raise ValueError(
-            f"prefill_chunk must divide prompt_len, got "
-            f"{prefill_chunk} vs {prompt_len}"
-        )
-    if prefill_chunk is not None and prefill_chunk != prompt_len and c.moe_experts > 0:
-        raise ValueError(
-            "prefill_chunk is not supported with moe_experts > 0: each "
-            "window would restart the per-expert capacity queue, so "
-            "chunked routing (and drops) would diverge from the one-shot "
-            "prefill's — breaking the drops-exactly-when-training-would "
-            "serving invariant (chunk the attention, not the router)"
-        )
+    _check_chunk(c, prompt_len, prefill_chunk)
     sampled = temperature > 0.0
     _validate_filters(c.vocab, sampled, top_k, top_p)
     pick = _make_pick(sampled, temperature, top_k, top_p)
-
-    def prefill(params, prompt, cache):
-        """Returns (last-position logits (B, vocab), cache)."""
-        if prefill_chunk is None or prefill_chunk == prompt_len:
-            logits, cache = decode_forward(params, prompt, cache, 0, c, mesh)
-            return logits[:, -1], cache
-        nchunks = prompt_len // prefill_chunk
-        # (B, P) -> (nchunks, B, C): scan iterates windows in order.
-        windows = prompt.reshape(
-            prompt.shape[0], nchunks, prefill_chunk
-        ).transpose(1, 0, 2)
-
-        def one_window(carry, xs):
-            cache, _ = carry
-            window, i = xs
-            logits, cache = decode_forward(
-                params, window, cache, i * prefill_chunk, c, mesh
-            )
-            # Last-position logits ride the CARRY (only the final
-            # window's survive) — stacking them as scan ys would
-            # materialize an (nchunks, B, vocab) buffer, defeating the
-            # bounded-activation point of chunking.
-            return (cache, logits[:, -1]), None
-
-        seed = jnp.zeros((prompt.shape[0], c.vocab), jnp.float32)
-        (cache, last), _ = jax.lax.scan(
-            one_window,
-            (cache, seed),
-            (windows, jnp.arange(nchunks, dtype=jnp.int32)),
-        )
-        return last, cache
+    prefill = _build_prefill(c, mesh, prompt_len, prefill_chunk)
 
     def run(params, prompt, key=None):
         if sampled and key is None:
@@ -640,25 +706,8 @@ def make_generate(
         cache = _fresh_cache(c, prompt.shape[0], mesh, kv_int8)
         last_logits, cache = prefill(params, prompt, cache)
         keys = _make_keys(sampled, key, steps)
-        tok = pick(last_logits, keys[0])
-        fin = jnp.isfinite(last_logits).all()
-
-        def step(carry, xs):
-            cache, tok, pos, fin = carry
-            k = xs
-            logits, cache = decode_forward(
-                params, tok[:, None], cache, pos, c, mesh
-            )
-            nxt = pick(logits[:, -1], k)
-            fin = jnp.logical_and(fin, jnp.isfinite(logits[:, -1]).all())
-            return (cache, nxt, pos + 1, fin), tok
-
-        # steps - 1 cached decode steps: the prefill already sampled token
-        # 1 of `steps`, and the final sampled token is never fed back.
-        # toks collects the token FED at each step; `last` is the final
-        # sample — together the generated continuation.
-        (_, last, _, fin), toks = jax.lax.scan(
-            step, (cache, tok, jnp.int32(prompt_len), fin), keys[1:]
+        toks, last, fin = _token_loop(
+            params, cache, last_logits, prompt_len, keys, pick, c, mesh
         )
         return _assemble(prompt, toks, last, fin, with_health)
 
@@ -666,6 +715,115 @@ def make_generate(
 
     return _jit_sharded(
         run, mesh, c, sampled, [P(("data", "fsdp"), None)], quantized=quantized
+    )
+
+
+def make_prefill(
+    config: BurninConfig,
+    mesh=None,
+    *,
+    prompt_len: int,
+    quantized: bool = False,
+    kv_int8: bool = False,
+    prefill_chunk: "int | None" = None,
+):
+    """Prefix caching, step 1: build the jitted
+    ``fn(params, prompt (B, prompt_len)) -> (cache, last_logits)``.
+
+    The returned state is the input to `make_generate_from_cache` — and
+    because generation is functional (each continuation scans its own
+    cache copy), ONE prefill serves any number of continuations: the
+    shared-system-prompt serving pattern.  `expand_cache` tiles a
+    prefilled prefix across the batch for per-user fan-out."""
+    c = config
+    _validate(c)
+    _check_window(c, prompt_len, 1, "prompt_len")
+    _check_chunk(c, prompt_len, prefill_chunk)
+    prefill = _build_prefill(c, mesh, prompt_len, prefill_chunk)
+
+    def run(params, prompt):
+        cache = _fresh_cache(c, prompt.shape[0], mesh, kv_int8)
+        last, cache = prefill(params, prompt, cache)
+        return cache, last
+
+    from jax.sharding import PartitionSpec as P
+
+    leaf = cache_spec(c, kv_int8)
+    return _jit_sharded(
+        run, mesh, c, False, [P(("data", "fsdp"), None)],
+        quantized=quantized,
+        # Pin the returned state's layout to exactly what
+        # make_generate_from_cache declares as its in_shardings.
+        out_shardings=({"k": leaf, "v": leaf}, P(("data", "fsdp"), None)),
+    )
+
+
+def make_generate_from_cache(
+    config: BurninConfig,
+    mesh=None,
+    *,
+    start_pos: int,
+    steps: int,
+    temperature: float = 0.0,
+    top_k: "int | None" = None,
+    top_p: "float | None" = None,
+    with_health: bool = False,
+    quantized: bool = False,
+    kv_int8: bool = False,
+):
+    """Prefix caching, step 2: build the jitted
+    ``fn(params, cache, last_logits[, key]) -> (B, steps)`` continuation.
+
+    ``start_pos`` is the prompt length the cache was prefilled to (the
+    first generated token lands in slot start_pos).  The input cache is
+    never mutated — jax is functional, the scan carries its own copy —
+    so the same prefilled state fans out to any number of continuations
+    with different keys/filters, paying the prefix cost once.  With
+    ``prompt_len == start_pos``, prefill + from-cache reproduces
+    `make_generate`'s continuation exactly (pinned by test)."""
+    import jax.numpy as jnp
+
+    c = config
+    _validate(c)
+    _check_window(c, start_pos, steps, "start_pos")
+    sampled = temperature > 0.0
+    _validate_filters(c.vocab, sampled, top_k, top_p)
+    pick = _make_pick(sampled, temperature, top_k, top_p)
+
+    def run(params, cache, last_logits, key=None):
+        if sampled and key is None:
+            raise ValueError(
+                "temperature > 0 requires a PRNG key: "
+                "fn(params, cache, last_logits, key)"
+            )
+        keys = _make_keys(sampled, key, steps)
+        toks, last, fin = _token_loop(
+            params, cache, last_logits, start_pos, keys, pick, c, mesh
+        )
+        out = jnp.concatenate([toks.transpose(1, 0), last[:, None]], axis=1)
+        return (out, fin) if with_health else out
+
+    from jax.sharding import PartitionSpec as P
+
+    leaf = cache_spec(c, kv_int8)
+    return _jit_sharded(
+        run, mesh, c, sampled,
+        [{"k": leaf, "v": leaf}, P(("data", "fsdp"), None)],
+        quantized=quantized,
+    )
+
+
+def expand_cache(cache, last_logits, n: int):
+    """Tile a prefilled prefix across the batch: each of the B prompt
+    rows becomes ``n`` identical rows (batch axis 1 in every cache leaf,
+    axis 0 in the logits) — prefill a shared system prompt once at B=1,
+    expand to the user batch, and generate divergent continuations."""
+    import jax
+    import jax.numpy as jnp
+
+    return (
+        jax.tree_util.tree_map(lambda a: jnp.repeat(a, n, axis=1), cache),
+        jnp.repeat(last_logits, n, axis=0),
     )
 
 
