@@ -1,0 +1,184 @@
+"""Speculative decoding (tpu_dra/parallel/speculative.py): exactness vs
+the plain greedy pipeline for any draft, acceptance mechanics, batch
+consensus, validation, and composition with the int8 stack / mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.parallel.burnin import BurninConfig, init_params
+from tpu_dra.parallel.decode import make_generate
+from tpu_dra.parallel.mesh import logical_mesh
+from tpu_dra.parallel.quant import quantize_params
+from tpu_dra.parallel.speculative import (
+    draft_params,
+    make_generate_speculative,
+)
+
+CFG = BurninConfig(
+    vocab=128, d_model=32, n_heads=4, d_ff=64, n_layers=4, seq=64, batch=2
+)
+
+
+def seeded_prompt(config, batch, plen, seed=7):
+    k = jax.random.PRNGKey(seed)
+    return jax.random.randint(k, (batch, plen), 0, config.vocab, jnp.int32)
+
+
+class TestExactness:
+    def test_any_draft_depth_token_identical(self):
+        """The speculative contract: greedy output equals the plain
+        pipeline's for ANY draft quality — a 1-layer draft that never
+        agrees and the full-depth draft that always does."""
+        params = init_params(CFG)
+        prompt = seeded_prompt(CFG, CFG.batch, 8)
+        want = make_generate(CFG, prompt_len=8, steps=16)(params, prompt)
+        for dl in (1, 2, 4):
+            got = make_generate_speculative(
+                CFG, prompt_len=8, steps=16, draft_layers=dl, draft_len=4
+            )(params, prompt)
+            np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+    def test_draft_len_one_and_overshoot_steps(self):
+        """k=1 degenerates to verify-only; steps not divisible by the
+        per-round commit still truncates to exactly `steps` tokens."""
+        params = init_params(CFG)
+        prompt = seeded_prompt(CFG, CFG.batch, 8)
+        for steps, k in ((7, 3), (5, 1), (13, 8)):
+            want = make_generate(CFG, prompt_len=8, steps=steps)(
+                params, prompt
+            )
+            got = make_generate_speculative(
+                CFG, prompt_len=8, steps=steps, draft_layers=4, draft_len=k
+            )(params, prompt)
+            np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+    def test_int8_stack_exact_vs_int8_plain(self):
+        """Speculative over quantized weights + int8 KV equals the plain
+        pipeline run with the same quantized state."""
+        qp = quantize_params(init_params(CFG))
+        prompt = seeded_prompt(CFG, CFG.batch, 8)
+        want = make_generate(CFG, prompt_len=8, steps=10, kv_int8=True)(
+            qp, prompt
+        )
+        got = make_generate_speculative(
+            CFG, prompt_len=8, steps=10, draft_layers=2, draft_len=4,
+            kv_int8=True,
+        )(qp, prompt)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+class TestAcceptance:
+    def test_perfect_draft_commits_draft_len_plus_one_per_round(self):
+        """draft_layers == n_layers: the draft IS the target, every
+        proposal agrees, so each full-model pass commits draft_len + 1
+        tokens (the verify pass's own next-token is the free bonus) and
+        the round count collapses to ceil(steps / (k+1)) — the speedup
+        mechanism, pinned.  k=7 makes the +1 observable: 16 tokens need
+        2 rounds of 8, where k-only committing would need 3."""
+        params = init_params(CFG)
+        prompt = seeded_prompt(CFG, CFG.batch, 8)
+        fn = make_generate_speculative(
+            CFG, prompt_len=8, steps=16, draft_layers=4, draft_len=7,
+            with_stats=True,
+        )
+        toks, rounds, fin = fn(params, prompt)
+        assert bool(fin)
+        assert int(rounds) == 2  # ceil(16 / (7+1))
+        want = make_generate(CFG, prompt_len=8, steps=16)(params, prompt)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(toks))
+
+    def test_worst_case_bounded_by_steps_rounds(self):
+        params = init_params(CFG)
+        prompt = seeded_prompt(CFG, CFG.batch, 8)
+        fn = make_generate_speculative(
+            CFG, prompt_len=8, steps=12, draft_layers=1, draft_len=4,
+            with_stats=True,
+        )
+        _, rounds, _ = fn(params, prompt)
+        assert 1 <= int(rounds) <= 12
+
+    def test_batch_consensus_exact_per_row(self):
+        """Rows with different acceptance patterns all stay exact under
+        the shared-frontier consensus commit."""
+        c = BurninConfig(
+            vocab=128, d_model=32, n_heads=4, d_ff=64, n_layers=4, seq=64,
+            batch=4,
+        )
+        params = init_params(c)
+        prompt = seeded_prompt(c, 4, 8, seed=3)
+        want = make_generate(c, prompt_len=8, steps=12)(params, prompt)
+        got = make_generate_speculative(
+            c, prompt_len=8, steps=12, draft_layers=2, draft_len=4
+        )(params, prompt)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+class TestDraftParams:
+    def test_slices_layers_keeps_rest(self):
+        params = init_params(CFG)
+        dp = draft_params(params, 2)
+        assert dp["layers"]["wqkv"].shape[0] == 2
+        assert dp["embed"] is params["embed"]
+        assert dp["ln_f"] is params["ln_f"]
+
+    def test_slices_quantized_leaves(self):
+        qp = quantize_params(init_params(CFG))
+        dp = draft_params(qp, 3)
+        assert dp["layers"]["wqkv"]["q"].shape[0] == 3
+        assert dp["layers"]["wqkv"]["s"].shape[0] == 3
+
+
+class TestValidation:
+    def test_bad_args_rejected(self):
+        with pytest.raises(ValueError, match="draft_layers"):
+            make_generate_speculative(
+                CFG, prompt_len=8, steps=4, draft_layers=0, draft_len=2
+            )
+        with pytest.raises(ValueError, match="draft_layers"):
+            make_generate_speculative(
+                CFG, prompt_len=8, steps=4, draft_layers=5, draft_len=2
+            )
+        with pytest.raises(ValueError, match="draft_len"):
+            make_generate_speculative(
+                CFG, prompt_len=8, steps=4, draft_layers=2, draft_len=0
+            )
+        moe = BurninConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=32,
+            batch=2, moe_experts=4,
+        )
+        with pytest.raises(ValueError, match="dense configs only"):
+            make_generate_speculative(
+                moe, prompt_len=8, steps=4, draft_layers=1, draft_len=2
+            )
+
+    def test_context_headroom_enforced(self):
+        with pytest.raises(ValueError, match="fit the context"):
+            make_generate_speculative(
+                CFG, prompt_len=8, steps=54, draft_layers=2, draft_len=4
+            )
+
+
+class TestMesh:
+    @pytest.mark.slow
+    def test_mesh_speculative_healthy_and_close(self):
+        """On the mesh the sharded-decode contract applies (near-tie
+        argmax may flip under reassociated reductions), so assert health
+        + shape + prompt echo, not token equality."""
+        c = BurninConfig(
+            vocab=128, d_model=32, n_heads=4, d_ff=64, n_layers=4, seq=64,
+            batch=4,
+        )
+        mesh = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+        params = init_params(c)
+        prompt = seeded_prompt(c, c.batch, 8)
+        toks, rounds, fin = make_generate_speculative(
+            c, mesh, prompt_len=8, steps=8, draft_layers=2, draft_len=4,
+            with_stats=True,
+        )(params, prompt)
+        assert bool(fin) and toks.shape == (c.batch, 16)
+        np.testing.assert_array_equal(
+            np.asarray(toks[:, :8]), np.asarray(prompt)
+        )
+        assert 1 <= int(rounds) <= 8

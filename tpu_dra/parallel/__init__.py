@@ -38,6 +38,10 @@ slice:
   autoregressive generation (`lax.scan` token loop compiled once, masked
   full-buffer attention, per-step dropless MoE routing), sharded with the
   training layout minus the sequence axis.
+- ``tpu_dra.parallel.speculative`` — speculative decoding: layer-skip
+  self-draft + one-pass verify with exact greedy acceptance (token
+  -identical to plain decode for any draft; best case draft_len+1
+  tokens per full-model pass), all inside one compiled while_loop.
 - ``tpu_dra.parallel.quant``       — weight-only int8 serving quantization:
   symmetric per-output-channel scales, dequant fused into the consuming
   matmul (HBM reads stay int8 — decode is memory-bound, so bytes are
@@ -75,6 +79,7 @@ from tpu_dra.parallel.decode import (
     make_prefill,
 )
 from tpu_dra.parallel.quant import quantize_params
+from tpu_dra.parallel.speculative import make_generate_speculative
 
 __all__ = [
     "BurninConfig",
@@ -88,6 +93,7 @@ __all__ = [
     "make_generate",
     "make_generate_from_cache",
     "make_generate_padded",
+    "make_generate_speculative",
     "make_prefill",
     "all_gather_check",
     "hierarchical_psum",

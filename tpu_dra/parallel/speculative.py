@@ -1,0 +1,212 @@
+"""Speculative decoding: layer-skip self-draft with exact greedy acceptance.
+
+Decode is latency-bound by ONE full-model pass per token.  Speculative
+decoding breaks that bound: a cheap DRAFT model proposes ``draft_len``
+tokens autoregressively, the full model scores all of them in ONE
+batched verify pass (prefill-shaped work — MXU-friendly, the same cost
+class as a single decode step at these widths), and the longest agreeing
+prefix commits.  Every round commits at least one token (the verify
+pass's own argmax at the first disagreement is free), so the worst case
+is plain decode plus the draft overhead, and the best case is
+``draft_len + 1`` tokens per full-model pass.
+
+The draft here is the model's own first ``draft_layers`` blocks + the
+final norm + the tied logits head (layer-skip / early-exit
+self-drafting): no second set of weights, the draft shares the embedding
+and its cache is just a shallower copy of the serving cache.
+
+**Exactness is the contract, speed is the variable.**  Greedy
+speculative output equals `make_generate`'s greedy output token for
+token for ANY draft (the tests pin this with 1-layer and full-depth
+drafts alike); draft quality only changes how many rounds it takes.
+
+TPU-native mechanics:
+
+- The whole prefill → while(draft k → verify 1 → commit) loop is ONE
+  compiled program: `lax.while_loop` with static shapes, traced cache
+  frontier (`decode_forward` already takes a traced ``p0``).
+- Rejected-suffix cache entries are never rolled back — they are
+  *overwritten* by the next round's writes before any query can attend
+  to them (attention masks by position; the frontier only moves forward
+  over committed tokens).  The output buffer plays the same trick: each
+  round writes all ``k`` fed tokens at the frontier and advances by the
+  accepted count, so the unaccepted tail is overwritten in place.
+- Batched rows commit at the BATCH CONSENSUS acceptance (min over rows
+  of each row's agreeing prefix): one shared frontier, no per-row
+  bookkeeping, still exact for every row (agreement through the
+  consensus point is a property of each row individually).  B=1 — the
+  latency-serving case speculative decoding exists for — pays no
+  consensus tax.
+
+Greedy only (temperature == 0): stochastic speculative sampling needs
+the rejection-resampling correction and is out of scope, by validation
+error.  Dense configs only (the draft's truncated layer stack would
+re-route MoE capacity queues).
+
+Reference parity note: the reference driver (nvidia k8s-dra-driver) has
+no compute path at all — this extends the serving layer that exceeds it
+(SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from tpu_dra.parallel.burnin import BurninConfig
+from tpu_dra.parallel.decode import (
+    _build_prefill,
+    _check_window,
+    _fresh_cache,
+    _jit_sharded,
+    _validate,
+    decode_forward,
+)
+
+__all__ = ["draft_params", "make_generate_speculative"]
+
+
+def draft_params(params: dict, draft_layers: int) -> dict:
+    """The layer-skip draft's view of the serving params: first
+    ``draft_layers`` blocks (leading stacked-layer axis sliced — works
+    for plain and int8 ``{"q","s"}`` leaves alike), shared embed/pos and
+    the full model's final norm + tied logits head."""
+    import jax
+
+    return {
+        **params,
+        "layers": jax.tree_util.tree_map(
+            lambda a: a[:draft_layers], params["layers"]
+        ),
+    }
+
+
+def make_generate_speculative(
+    config: BurninConfig,
+    mesh=None,
+    *,
+    prompt_len: int,
+    steps: int,
+    draft_layers: int,
+    draft_len: int,
+    with_stats: bool = False,
+    quantized: bool = False,
+    kv_int8: bool = False,
+):
+    """Build the jitted speculative generation function:
+    ``fn(params, prompt (B, prompt_len)) -> (B, prompt_len + steps)``
+    — greedy, token-identical to `make_generate`'s output.
+
+    ``draft_layers``: depth of the layer-skip draft (1..n_layers).
+    ``draft_len``: tokens proposed per round (the verify pass scores
+    this many at once; needs ``prompt_len + steps + draft_len <= seq``
+    headroom because a final round may overshoot before truncation).
+    ``with_stats=True`` additionally returns ``(rounds, healthy)`` —
+    full-model passes used (the speedup is ``steps / rounds``) and the
+    all-logits-finite flag."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    _validate(c)
+    if c.moe_experts > 0:
+        raise ValueError(
+            "speculative decoding supports dense configs only: a "
+            "truncated layer stack re-routes MoE capacity queues, and "
+            "the draft would drop different tokens than training"
+        )
+    if not 1 <= draft_layers <= c.n_layers:
+        raise ValueError(
+            f"draft_layers must be in [1, {c.n_layers}], got {draft_layers}"
+        )
+    if draft_len < 1:
+        raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+    # The verify window of the last round may extend draft_len - 1 slots
+    # past the final committed position before truncation.
+    _check_window(c, prompt_len, steps + draft_len, "prompt_len")
+    import dataclasses
+
+    dc = dataclasses.replace(c, n_layers=draft_layers)
+    prefill_full = _build_prefill(c, mesh, prompt_len, None)
+    prefill_draft = _build_prefill(dc, mesh, prompt_len, None)
+
+    def run(params, prompt):
+        B = prompt.shape[0]
+        dparams = draft_params(params, draft_layers)
+        cache = _fresh_cache(c, B, mesh, kv_int8)
+        dcache = _fresh_cache(dc, B, mesh, kv_int8)
+        last, cache = prefill_full(params, prompt, cache)
+        _, dcache = prefill_draft(dparams, prompt, dcache)
+        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        fin0 = jnp.isfinite(last).all()
+
+        outbuf = jnp.zeros((B, steps + draft_len), jnp.int32)
+        k = draft_len
+
+        def cond(state):
+            _, _, _, count, _, _, _ = state
+            return count < steps
+
+        def body(state):
+            cache, dcache, outbuf, count, tok, fin, rounds = state
+            f = prompt_len + count  # cache slot of the next fed token
+
+            # Draft k candidates autoregressively through the shallow
+            # stack.  The scan runs k+1 steps feeding [tok, d1..dk]: the
+            # last step's OUTPUT (d_{k+1}) is discarded, but its INPUT
+            # d_k must pass through the draft so the draft cache holds
+            # slot f+k — a full-acceptance round advances the frontier
+            # past it, and an unwritten slot would silently corrupt
+            # every later draft's conditioning (not the output, which
+            # verify gates — just the acceptance rate).
+            def draft_step(carry, _):
+                dcache, t, pos = carry
+                lg, dcache = decode_forward(
+                    dparams, t[:, None], dcache, pos, dc, mesh
+                )
+                nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+                return (dcache, nxt, pos + 1), nxt
+
+            (dcache, _, _), drafted_T = jax.lax.scan(
+                draft_step, (dcache, tok, f), None, length=k + 1
+            )
+            drafted = drafted_T.transpose(1, 0)[:, :k]  # (B, k): d1..dk
+            fed = jnp.concatenate([tok[:, None], drafted], axis=1)  # (B, k+1)
+
+            # One full-model pass scores every fed token; g[:, j] is the
+            # target's greedy choice AFTER fed[:, j].  Feeding d_k too is
+            # the classic free bonus: full agreement commits k+1 tokens
+            # from one verify pass.
+            logits, cache = decode_forward(params, fed, cache, f, c, mesh)
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
+            fin = jnp.logical_and(fin, jnp.isfinite(logits).all())
+
+            # Per-row agreeing prefix of the k drafted continuations
+            # (fed[:, j+1] vs g[:, j]), then batch consensus.
+            agree = fed[:, 1:] == g[:, :-1]  # (B, k)
+            prefix = jnp.cumprod(agree.astype(jnp.int32), axis=-1).sum(-1)
+            n_commit = 1 + prefix.min()  # fed tokens kept, up to k+1
+
+            # Write ALL k+1 fed tokens at the frontier; the unaccepted
+            # tail is overwritten by the next round (same trick as the
+            # cache).
+            outbuf = jax.lax.dynamic_update_slice(outbuf, fed, (0, count))
+            # Next pending token: the target's choice after the last
+            # committed fed token (traced column index).
+            tok = g[:, n_commit - 1]
+            return (
+                cache, dcache, outbuf, count + n_commit, tok, fin, rounds + 1
+            )
+
+        state = (cache, dcache, outbuf, jnp.int32(0), tok, fin0,
+                 jnp.int32(0))
+        _, _, outbuf, _, _, fin, rounds = jax.lax.while_loop(
+            cond, body, state
+        )
+        tokens = jnp.concatenate([prompt, outbuf[:, :steps]], axis=1)
+        if with_stats:
+            return tokens, rounds, fin
+        return tokens
+
+    from jax.sharding import PartitionSpec as P
+
+    return _jit_sharded(
+        run, mesh, c, False, [P(("data", "fsdp"), None)], quantized=quantized
+    )
