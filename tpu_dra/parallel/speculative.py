@@ -125,15 +125,20 @@ def make_generate_speculative(
 
     dc = dataclasses.replace(c, n_layers=draft_layers)
     prefill_full = _build_prefill(c, mesh, prompt_len, None)
-    prefill_draft = _build_prefill(dc, mesh, prompt_len, None)
 
     def run(params, prompt):
         B = prompt.shape[0]
         dparams = draft_params(params, draft_layers)
         cache = _fresh_cache(c, B, mesh, kv_int8)
-        dcache = _fresh_cache(dc, B, mesh, kv_int8)
         last, cache = prefill_full(params, prompt, cache)
-        _, dcache = prefill_draft(dparams, prompt, dcache)
+        # The draft's prefill state is FREE: the layer-skip draft is the
+        # same weights' first D blocks on the same inputs, so its cache
+        # after prefill is byte-identical to the full cache's first D
+        # layers (leading axis L; slices bf16 and int8 {"q","s"} leaves
+        # alike) — no second prompt pass, no second prefill executable.
+        dcache = jax.tree_util.tree_map(
+            lambda a: a[:draft_layers], cache
+        )
         tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
         fin0 = jnp.isfinite(last).all()
 
