@@ -38,6 +38,11 @@ slice:
   autoregressive generation (`lax.scan` token loop compiled once, masked
   full-buffer attention, per-step dropless MoE routing), sharded with the
   training layout minus the sequence axis.
+- ``tpu_dra.parallel.serve``       — continuous-batching engine: fixed
+  -slot compiled decode step (`decode_step_rows` — every row at its own
+  position), per-row request lifecycle (admit → prefill+insert → decode
+  → EOS/budget finish → row freed mid-flight of everyone else); every
+  request's output equals the request run alone.
 - ``tpu_dra.parallel.speculative`` — speculative decoding: layer-skip
   self-draft + one-pass verify with exact greedy acceptance (token
   -identical to plain decode for any draft; best case draft_len+1
@@ -79,11 +84,14 @@ from tpu_dra.parallel.decode import (
     make_prefill,
 )
 from tpu_dra.parallel.quant import quantize_params
+from tpu_dra.parallel.serve import Request, ServeEngine
 from tpu_dra.parallel.speculative import make_generate_speculative
 
 __all__ = [
     "BurninConfig",
     "CollectiveReport",
+    "Request",
+    "ServeEngine",
     "SliceReport",
     "TrainReport",
     "train",

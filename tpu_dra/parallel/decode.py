@@ -66,6 +66,7 @@ __all__ = [
     "init_cache",
     "decode_forward",
     "decode_step_padded",
+    "decode_step_rows",
     "make_generate",
     "make_generate_from_cache",
     "make_generate_padded",
@@ -162,22 +163,36 @@ def _cache_update(cbuf, new, p0):
     """Write ``new`` (B, S, H, K) into cache slots [p0, p0+S) of ``cbuf``
     — a bf16 buffer (B, T, H, K), or an int8 ``{"q","s"}`` pair, in which
     case each row is quantized ONCE here (per-token-per-head symmetric
-    scale over d_head) and never re-quantized."""
+    scale over d_head) and never re-quantized.
+
+    ``p0`` may be a (B,) array of PER-ROW slots (the continuous-batching
+    engine: rows sit at different sequence positions) — then S must be 1
+    and the write is a batched scatter instead of a uniform slice."""
     import jax
     import jax.numpy as jnp
 
     from tpu_dra.parallel.quant import is_quantized_leaf
 
-    if not is_quantized_leaf(cbuf):
-        return jax.lax.dynamic_update_slice_in_dim(
-            cbuf, new.astype(jnp.bfloat16), p0, axis=1
+    per_row = getattr(p0, "ndim", 0) >= 1
+    if per_row and new.shape[1] != 1:
+        raise ValueError(
+            f"per-row cache writes are single-token (S=1), got S={new.shape[1]}"
         )
+
+    def write(buf, upd):
+        if per_row:
+            b = jnp.arange(upd.shape[0])
+            return buf.at[b, p0].set(upd[:, 0])
+        return jax.lax.dynamic_update_slice_in_dim(buf, upd, p0, axis=1)
+
+    if not is_quantized_leaf(cbuf):
+        return write(cbuf, new.astype(jnp.bfloat16))
     from tpu_dra.parallel.quant import quantize_tensor
 
     row = quantize_tensor(new, (3,))  # scale over d_head: one policy, quant.py's
     return {
-        "q": jax.lax.dynamic_update_slice_in_dim(cbuf["q"], row["q"], p0, axis=1),
-        "s": jax.lax.dynamic_update_slice_in_dim(cbuf["s"], row["s"], p0, axis=1),
+        "q": write(cbuf["q"], row["q"]),
+        "s": write(cbuf["s"], row["s"]),
     }
 
 
@@ -369,6 +384,33 @@ def decode_step_padded(params, tok, cache, lens, prompt_slots, t,
     logits, cache = _run_blocks(
         params, x, cache, prompt_slots + t, mask, c, constrain
     )
+    return logits[:, 0], cache
+
+
+def decode_step_rows(params, tok, cache, pos, config: BurninConfig, mesh=None):
+    """One decode step with PER-ROW positions: row ``b``'s token ``tok[b]``
+    lands in cache slot ``pos[b]`` (its sequence position — the engine's
+    row layout is contiguous, slot == position) and attends ``j <=
+    pos[b]``.  Returns ``(logits (B, vocab), new_cache)``.
+
+    This is the continuous-batching primitive (`parallel/serve.py`): a
+    fixed-batch compiled step where every row may be at a different point
+    of a different request's generation — `decode_forward` with a
+    scalar position is the uniform special case."""
+    import jax.numpy as jnp
+
+    c = config
+    _validate(c)
+    constrain = _make_constrain(mesh)
+    T = _cache_len(cache)
+
+    pos_emb = params["pos"][pos]  # (B, d): per-row
+    x = constrain(
+        "hidden", _embed_lookup(params["embed"], tok)[:, None, :] + pos_emb[:, None, :]
+    )
+    slots = jnp.arange(T)[None, :]  # (1, T)
+    mask = (slots <= pos[:, None])[:, None, None, :]  # (B, 1, 1, T)
+    logits, cache = _run_blocks(params, x, cache, pos, mask, c, constrain)
     return logits[:, 0], cache
 
 

@@ -1,0 +1,277 @@
+"""Continuous-batching serving engine over the compiled decode step.
+
+A real serving workload is a STREAM of requests with different prompt
+lengths, budgets, and arrival times — not one fixed batch.  The naive
+answer (run each request alone, or wait to fill a batch) wastes the chip:
+a finished row idles while its batchmates keep generating.  Continuous
+batching fixes utilization by giving every batch ROW its own lifecycle:
+
+    submit → queue → admit into a free row (prefill + insert)
+           → per-row decode steps → finish (EOS / budget) → row freed
+           → next queued request admitted, mid-flight of everyone else
+
+TPU-native shape of the problem: XLA compiles per shape, so the engine
+must run a FIXED-batch step executable forever while rows come and go.
+Three compiled functions, none ever retraced:
+
+- ``prefill1``: one request's padded prompt → a B=1 cache + last-real
+  logits (`decode_forward`; trailing pads are invisible to real prefill
+  queries by causality — the padded-batch tests pin this).
+- ``insert``:  write that B=1 cache into row ``r`` of the engine cache
+  (traced row index — one executable for any row).
+- ``step``:    `decode_step_rows` — every row at its OWN position
+  (slot == sequence position), one token for all rows per call.
+
+Inactive rows keep stepping (XLA has no ragged batch) with a frozen
+position: their writes land on one stale slot that is either overwritten
+by the row's next admission prefill or re-written by the row's own
+generation before its mask can reach it — the same
+overwrite-before-attend discipline the speculative decoder uses.
+
+The engine itself is intentionally host-side Python: admission, queues,
+budgets, and EOS detection are control decisions made BETWEEN device
+steps (one small device→host fetch per step — the price of reacting to
+finishes immediately, which is the entire point of continuous batching;
+amortize with ``steps_per_tick`` when reaction latency can lag).
+
+Greedy decoding per row (the engine's determinism contract: every
+request's output equals `make_generate_padded` run on that request
+alone — the exactness test).  Dense and MoE configs; weight/KV int8
+compose like everywhere else in the serving stack.
+
+Reference parity note: the reference driver (nvidia k8s-dra-driver) has
+no compute path at all — this is the serving-runtime layer of the
+compute stack that exceeds it (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpu_dra.parallel.burnin import BurninConfig
+from tpu_dra.parallel.decode import (
+    _check_window,
+    decode_forward,
+    decode_step_rows,
+    init_cache,
+)
+
+__all__ = ["Request", "ServeEngine"]
+
+
+@dataclass
+class Request:
+    """One submitted generation request and its accumulated output."""
+
+    id: int
+    prompt: "list[int]"
+    max_new: int
+    tokens: "list[int]" = field(default_factory=list)  # generated only
+    done: bool = False
+    finish_reason: str = ""  # "eos" | "budget"
+
+
+class ServeEngine:
+    """Fixed-slot continuous-batching engine.
+
+    ``slots``: concurrent rows (the compiled batch).  ``prompt_slots``:
+    admission pad width — prompts longer than this are rejected at
+    submit.  ``eos_token``: generation stops early when the model emits
+    it (None: budget-only).  ``steps_per_tick``: decode steps fused into
+    one device call per `tick` (finish reactions lag by at most that
+    many tokens).
+    """
+
+    def __init__(
+        self,
+        params,
+        config: BurninConfig,
+        *,
+        slots: int,
+        prompt_slots: int,
+        max_new_cap: int,
+        eos_token: "int | None" = None,
+        steps_per_tick: int = 1,
+        kv_int8: bool = False,
+        mesh=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        c = config
+        # Every row must fit prompt + its budget in the context.
+        _check_window(c, prompt_slots, max_new_cap, "prompt_slots")
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if steps_per_tick < 1:
+            raise ValueError(f"steps_per_tick must be >= 1, got {steps_per_tick}")
+        self.config = c
+        self.params = params
+        self.slots = slots
+        self.prompt_slots = prompt_slots
+        self.max_new_cap = max_new_cap
+        self.eos_token = eos_token
+        self.steps_per_tick = steps_per_tick
+        self.mesh = mesh
+
+        self._cache = init_cache(c, slots, kv_int8)
+        if mesh is not None:
+            # Lay the engine cache out per the serving spec (batch over
+            # data x fsdp, heads over model) so the jitted step inherits
+            # the sharded layout instead of replicating the dominant
+            # tensor; jit input shardings then follow the arrays.
+            from jax.sharding import NamedSharding
+
+            from tpu_dra.parallel.decode import cache_spec
+
+            leaf = cache_spec(c, kv_int8)
+            self._cache = jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+                self._cache,
+                {"k": leaf, "v": leaf},
+            )
+        self._kv_int8 = kv_int8
+        # Host-side row state: which request, its position (== number of
+        # valid tokens in the row), its remaining budget.
+        self._row_req: "list[Request | None]" = [None] * slots
+        self._pos = [0] * slots
+        self._tok = [0] * slots
+        self._queue: "list[Request]" = []
+        self._done: "list[Request]" = []
+        self._next_id = 0
+
+        def prefill1(params, prompt, length):
+            cache1 = init_cache(c, 1, kv_int8)
+            logits, cache1 = decode_forward(params, prompt, cache1, 0, c, mesh)
+            last = jnp.take_along_axis(
+                logits, (length - 1)[None, None, None], axis=1
+            )[:, 0]
+            return cache1, last
+
+        def insert(cache, cache1, row):
+            return jax.tree_util.tree_map(
+                lambda big, one: jax.lax.dynamic_update_slice_in_dim(
+                    big, one, row, axis=1
+                ),
+                cache,
+                cache1,
+            )
+
+        def step(params, cache, tok, pos, active):
+            # steps_per_tick tokens for every row in ONE device call; the
+            # per-step tokens come back for host-side finish decisions.
+            def one(carry, _):
+                cache, tok, pos = carry
+                logits, cache = decode_step_rows(params, tok, cache, pos, c, mesh)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # Inactive rows freeze: token and position pinned so their
+                # (harmless) writes stay on one stale slot.
+                nxt = jnp.where(active, nxt, tok)
+                pos = jnp.where(active, pos + 1, pos)
+                return (cache, nxt, pos), nxt
+
+            (cache, tok, pos), toks = jax.lax.scan(
+                one, (cache, tok, pos), None, length=self.steps_per_tick
+            )
+            return cache, tok, pos, toks  # toks: (steps_per_tick, B)
+
+        self._prefill1 = jax.jit(prefill1)
+        self._insert = jax.jit(insert)
+        self._step = jax.jit(step)
+
+    # -- submission ------------------------------------------------------
+    def submit(self, prompt: "list[int]", max_new: "int | None" = None) -> int:
+        """Queue a request; returns its id.  Admission happens on `tick`."""
+        if not 1 <= len(prompt) <= self.prompt_slots:
+            raise ValueError(
+                f"prompt length must be in [1, {self.prompt_slots}], "
+                f"got {len(prompt)}"
+            )
+        budget = self.max_new_cap if max_new is None else max_new
+        if not 1 <= budget <= self.max_new_cap:
+            raise ValueError(
+                f"max_new must be in [1, {self.max_new_cap}], got {budget}"
+            )
+        req = Request(id=self._next_id, prompt=list(prompt), max_new=budget)
+        self._next_id += 1
+        self._queue.append(req)
+        return req.id
+
+    # -- the engine loop -------------------------------------------------
+    def _admit(self) -> None:
+        import jax.numpy as jnp
+
+        for row in range(self.slots):
+            if self._row_req[row] is not None or not self._queue:
+                continue
+            req = self._queue.pop(0)
+            length = len(req.prompt)
+            padded = req.prompt + [0] * (self.prompt_slots - length)
+            prompt = jnp.asarray(padded, jnp.int32)[None, :]
+            cache1, last = self._prefill1(
+                self.params, prompt, jnp.int32(length)
+            )
+            self._cache = self._insert(self._cache, cache1, jnp.int32(row))
+            first = int(jnp.argmax(last[0]))
+            self._row_req[row] = req
+            self._pos[row] = length
+            self._tok[row] = first
+            self._note_token(row, first)
+
+    def _note_token(self, row: int, token: int) -> None:
+        req = self._row_req[row]
+        req.tokens.append(token)
+        if self.eos_token is not None and token == self.eos_token:
+            req.done, req.finish_reason = True, "eos"
+        elif len(req.tokens) >= req.max_new:
+            req.done, req.finish_reason = True, "budget"
+        if req.done:
+            self._done.append(req)
+            self._row_req[row] = None
+
+    def tick(self) -> "list[Request]":
+        """Admit waiting requests into free rows, run one device call
+        (``steps_per_tick`` decode steps for every row), process
+        finishes.  Returns requests completed during this tick."""
+        import jax
+        import jax.numpy as jnp
+
+        done_before = len(self._done)
+        self._admit()
+        if any(r is not None for r in self._row_req):
+            active = jnp.asarray(
+                [r is not None for r in self._row_req], bool
+            )
+            tok = jnp.asarray(self._tok, jnp.int32)
+            pos = jnp.asarray(self._pos, jnp.int32)
+            self._cache, tok, pos, toks = self._step(
+                self.params, self._cache, tok, pos, active
+            )
+            # ONE blocking fetch per tick (the module-header promise):
+            # tokens, next-token, and positions come back together.
+            toks, tok_h, pos_h = jax.device_get((toks, tok, pos))
+            self._tok = [int(t) for t in tok_h]
+            self._pos = [int(p) for p in pos_h]
+            for s in range(toks.shape[0]):
+                for row in range(self.slots):
+                    if self._row_req[row] is None:
+                        continue
+                    self._note_token(row, int(toks[s, row]))
+        return self._done[done_before:]
+
+    def run(self, until_idle: int = 10_000) -> "list[Request]":
+        """Tick until queue and rows are empty; returns all completed
+        requests in completion order.  ``until_idle`` bounds the loop."""
+        for _ in range(until_idle):
+            if not self._queue and all(r is None for r in self._row_req):
+                break
+            self.tick()
+        else:
+            raise RuntimeError("engine did not drain within the tick bound")
+        return self._done
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + sum(
+            r is not None for r in self._row_req
+        )
