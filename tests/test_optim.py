@@ -1,0 +1,158 @@
+"""Optimizer families (burnin: momentum / adamw), global-norm clipping,
+and the warmup-cosine schedule — incl. sharded state and checkpoint
+roundtrip for the adamw state shape."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.parallel.burnin import (
+    BurninConfig,
+    _clip_grads,
+    make_train_step,
+    prepare_tokens,
+    schedule_lr,
+    state_shardings,
+    train,
+)
+from tpu_dra.parallel.mesh import logical_mesh
+
+BASE = dict(
+    vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=32, batch=8
+)
+
+
+class TestAdamW:
+    def test_trains_and_beats_momentum_here(self):
+        """adamw learns the synthetic task; on this setup it converges
+        faster than the momentum baseline (not a general law — a sanity
+        check that the update math is an optimizer, not noise)."""
+        mom = train(BurninConfig(**BASE), steps=8)
+        adam = train(
+            BurninConfig(
+                **BASE, optimizer="adamw", learning_rate=3e-3,
+                weight_decay=0.01,
+            ),
+            steps=8,
+        )
+        assert mom.ok and adam.ok
+        assert adam.loss_last < mom.loss_last
+
+    def test_state_shape_and_step_counter(self):
+        c = BurninConfig(**BASE, optimizer="adamw")
+        step, state = make_train_step(c)
+        assert set(state[1].keys()) == {"m", "v", "t"}
+        assert int(state[1]["t"]) == 0
+        tokens = prepare_tokens(c)
+        state, _ = step(state, tokens)
+        state, _ = step(state, tokens)
+        assert int(state[1]["t"]) == 2
+
+    @pytest.mark.slow
+    def test_sharded_adamw_step_runs(self):
+        mesh = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+        c = BurninConfig(**BASE, optimizer="adamw", learning_rate=3e-3)
+        step, state = make_train_step(c, mesh)
+        tokens = prepare_tokens(c, mesh)
+        state, loss1 = step(state, tokens)
+        state, loss2 = step(state, tokens)
+        assert float(loss2) < float(loss1)
+        # m/v inherit the param shardings; t is replicated.
+        sh = state_shardings(c, mesh)
+        assert set(sh[1].keys()) == {"m", "v", "t"}
+
+    @pytest.mark.slow
+    def test_ckpt_roundtrip_adamw_state(self, tmp_path):
+        from tpu_dra.parallel.ckpt import restore_state, save_state
+
+        c = BurninConfig(**BASE, optimizer="adamw")
+        step, state = make_train_step(c)
+        tokens = prepare_tokens(c)
+        state, _ = step(state, tokens)
+        save_state(str(tmp_path), state, step=1)
+        restored = restore_state(str(tmp_path), c, step=1)
+        flat1 = jax.tree_util.tree_leaves(state)
+        flat2 = jax.tree_util.tree_leaves(restored)
+        assert len(flat1) == len(flat2)
+        for a, b in zip(flat1, flat2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestClipping:
+    def test_clip_bounds_global_norm(self):
+        grads = {"a": jnp.full((4,), 10.0), "b": jnp.full((3,), -10.0)}
+        clipped = _clip_grads(grads, 1.0)
+        gnorm = float(
+            jnp.sqrt(
+                sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(clipped))
+            )
+        )
+        assert abs(gnorm - 1.0) < 1e-5
+
+    def test_small_grads_untouched(self):
+        grads = {"a": jnp.asarray([0.1, -0.2])}
+        clipped = _clip_grads(grads, 1.0)
+        np.testing.assert_allclose(
+            np.asarray(clipped["a"]), np.asarray(grads["a"]), rtol=1e-6
+        )
+
+    def test_training_with_clip_stays_finite(self):
+        c = BurninConfig(
+            **BASE, optimizer="adamw", learning_rate=3e-3, grad_clip_norm=0.5
+        )
+        r = train(c, steps=6)
+        assert r.ok and np.isfinite(r.loss_last)
+
+
+class TestSchedule:
+    def test_warmup_ramps_then_cosine_decays_to_zero(self):
+        c = BurninConfig(
+            **BASE, optimizer="adamw", learning_rate=1.0,
+            lr_schedule="cosine", warmup_steps=4, total_steps=20,
+        )
+        assert abs(float(schedule_lr(c, 0)) - 0.25) < 1e-6
+        assert abs(float(schedule_lr(c, 3)) - 1.0) < 1e-6  # warmup done
+        assert abs(float(schedule_lr(c, 12)) - 0.5) < 1e-6  # midpoint
+        assert float(schedule_lr(c, 20)) < 1e-6  # decayed out
+        # Monotone decay after warmup.
+        lrs = [float(schedule_lr(c, t)) for t in range(4, 21)]
+        assert all(a >= b for a, b in zip(lrs, lrs[1:]))
+
+    def test_constant_schedule_is_flat(self):
+        c = BurninConfig(**BASE, optimizer="adamw", learning_rate=0.3)
+        for t in (0, 5, 500):
+            assert abs(float(schedule_lr(c, t)) - 0.3) < 1e-7
+
+
+class TestValidation:
+    def test_bad_optimizer_and_schedule_rejected(self):
+        with pytest.raises(ValueError, match="optimizer"):
+            make_train_step(BurninConfig(**BASE, optimizer="sgd"))
+        with pytest.raises(ValueError, match="lr_schedule"):
+            make_train_step(
+                BurninConfig(**BASE, optimizer="adamw", lr_schedule="linear")
+            )
+        with pytest.raises(ValueError, match="total_steps"):
+            make_train_step(
+                BurninConfig(**BASE, optimizer="adamw", lr_schedule="cosine")
+            )
+
+    def test_cosine_horizon_must_exceed_warmup(self):
+        """total_steps <= warmup_steps would train at lr=0 after warmup
+        — rejected, not silently stalled."""
+        with pytest.raises(ValueError, match="total_steps > warmup"):
+            make_train_step(
+                BurninConfig(
+                    **BASE, optimizer="adamw", lr_schedule="cosine",
+                    warmup_steps=10, total_steps=5,
+                )
+            )
+
+    def test_momentum_with_schedule_rejected(self):
+        with pytest.raises(ValueError, match="adamw"):
+            make_train_step(
+                BurninConfig(**BASE, lr_schedule="cosine", total_steps=5)
+            )
+        with pytest.raises(ValueError, match="adamw"):
+            make_train_step(BurninConfig(**BASE, warmup_steps=3))

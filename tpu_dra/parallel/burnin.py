@@ -98,6 +98,20 @@ class BurninConfig:
     seq: int = 128
     batch: int = 8
     learning_rate: float = 1e-2
+    # Optimizer family: "momentum" (the default — 1x-params state, the
+    # burn-in measures the slice, not the optimizer) or "adamw" (2x state,
+    # decoupled weight decay, the production-training default elsewhere).
+    optimizer: str = "momentum"
+    weight_decay: float = 0.0  # adamw only (decoupled)
+    # Global-norm gradient clipping; 0 disables.  Stateless — applies to
+    # both optimizer families.
+    grad_clip_norm: float = 0.0
+    # LR schedule, adamw only (its state carries the step counter):
+    # "constant", or "cosine" (linear warmup over warmup_steps, cosine
+    # decay to zero at total_steps).
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    total_steps: int = 0
     # Context parallelism: ring attention over the mesh's ``model`` axis
     # (sequence stays sharded through attention; heads replicated there).
     ring_attention: bool = False
@@ -575,14 +589,77 @@ def _loss(params, tokens, config: BurninConfig, mesh=None):
     return ce
 
 
+def schedule_lr(config: BurninConfig, t):
+    """Learning rate at (traced) step ``t``: linear warmup over
+    ``warmup_steps`` then, for ``lr_schedule="cosine"``, cosine decay to
+    zero at ``total_steps``.  Pure — unit-testable off-device."""
+    import jax.numpy as jnp
+
+    c = config
+    lr = jnp.asarray(c.learning_rate, jnp.float32)
+    if c.warmup_steps > 0:
+        lr = lr * jnp.minimum(1.0, (t + 1) / c.warmup_steps)
+    if c.lr_schedule == "cosine":
+        horizon = max(1, c.total_steps - c.warmup_steps)
+        frac = jnp.clip((t - c.warmup_steps) / horizon, 0.0, 1.0)
+        lr = lr * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+    return lr
+
+
+def _clip_grads(grads, clip_norm: float):
+    """Global-norm clipping (stateless): scale all gradients so their
+    joint L2 norm is at most ``clip_norm``."""
+    import jax
+    import jax.numpy as jnp
+
+    gnorm = jnp.sqrt(
+        sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree_util.tree_leaves(grads)
+        )
+    )
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
+def _validate_optim(c: BurninConfig) -> None:
+    if c.optimizer not in ("momentum", "adamw"):
+        raise ValueError(
+            f'optimizer must be "momentum" or "adamw", got {c.optimizer!r}'
+        )
+    if c.lr_schedule not in ("constant", "cosine"):
+        raise ValueError(
+            f'lr_schedule must be "constant" or "cosine", got {c.lr_schedule!r}'
+        )
+    if (c.lr_schedule != "constant" or c.warmup_steps > 0) and c.optimizer != "adamw":
+        raise ValueError(
+            "lr schedules ride the adamw state (its step counter); "
+            'momentum is constant-lr by design — set optimizer="adamw"'
+        )
+    if c.lr_schedule == "cosine" and c.total_steps < 1:
+        raise ValueError("cosine schedule needs total_steps >= 1")
+    if c.lr_schedule == "cosine" and c.total_steps <= c.warmup_steps:
+        raise ValueError(
+            f"cosine schedule needs total_steps > warmup_steps "
+            f"({c.total_steps} <= {c.warmup_steps}: every post-warmup "
+            "step would train at lr=0)"
+        )
+
+
 def make_train_step(config: BurninConfig, mesh=None, *, with_state: bool = True):
     """Build (train_step, init_state).
 
-    ``train_step(state, tokens) -> (state, loss)`` is a single jitted SGD+
-    momentum step.  With a mesh, params/momentum are fsdp/tp-sharded and the
-    batch is dp-sharded — the complete pjit training step the driver
-    dry-runs multi-chip.  Momentum (not adam) keeps optimizer state at 1x
-    params: burn-in measures the slice, not the optimizer.
+    ``train_step(state, tokens) -> (state, loss)`` is a single jitted
+    optimizer step.  With a mesh, params/optimizer state are fsdp/tp
+    -sharded and the batch is dp-sharded — the complete pjit training
+    step the driver dry-runs multi-chip.
+
+    Optimizer families (``config.optimizer``): the default SGD+momentum
+    keeps optimizer state at 1x params — burn-in measures the slice, not
+    the optimizer; ``"adamw"`` is the production-training family (m + v
+    + step counter, decoupled weight decay, optional warmup/cosine
+    schedule via `schedule_lr`).  Global-norm grad clipping
+    (``grad_clip_norm``) applies to both.
 
     ``with_state=False`` skips materializing the fresh init (returns
     ``(train_step, None)``) — the resume path restores a checkpoint into
@@ -592,12 +669,37 @@ def make_train_step(config: BurninConfig, mesh=None, *, with_state: bool = True)
     import jax.numpy as jnp
 
     c = config
+    _validate_optim(c)
     loss_fn = functools.partial(_loss, config=c, mesh=mesh)
 
     def step(state, tokens):
-        params, mom = state
+        params, opt = state
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-        mom = jax.tree_util.tree_map(lambda m, g: 0.9 * m + g, mom, grads)
+        if c.grad_clip_norm > 0:
+            grads = _clip_grads(grads, c.grad_clip_norm)
+        if c.optimizer == "adamw":
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            t = opt["t"] + 1
+            m = jax.tree_util.tree_map(
+                lambda m, g: b1 * m + (1 - b1) * g, opt["m"], grads
+            )
+            v = jax.tree_util.tree_map(
+                lambda v, g: b2 * v + (1 - b2) * jnp.square(g), opt["v"], grads
+            )
+            # Schedule indexed from 0 (update i uses schedule_lr(i)):
+            # the first update sits at the curve's start and the pinned
+            # unit-test curve IS the applied curve.  The 1-indexed ``t``
+            # is for Adam's bias corrections only.
+            lr = schedule_lr(c, opt["t"])
+            bc1 = 1 - b1**t.astype(jnp.float32)
+            bc2 = 1 - b2**t.astype(jnp.float32)
+            params = jax.tree_util.tree_map(
+                lambda p, m, v: p
+                - lr * ((m / bc1) / (jnp.sqrt(v / bc2) + eps) + c.weight_decay * p),
+                params, m, v,
+            )
+            return (params, {"m": m, "v": v, "t": t}), loss
+        mom = jax.tree_util.tree_map(lambda m, g: 0.9 * m + g, opt, grads)
         params = jax.tree_util.tree_map(lambda p, m: p - c.learning_rate * m, params, mom)
         return (params, mom), loss
 
@@ -629,8 +731,12 @@ def state_shardings(config: BurninConfig, mesh):
     import jax
     from jax.sharding import NamedSharding
 
+    from jax.sharding import PartitionSpec as P
+
     pspecs = param_specs(config, mesh)
     one = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs)
+    if config.optimizer == "adamw":
+        return (one, {"m": one, "v": one, "t": NamedSharding(mesh, P())})
     return (one, one)
 
 
@@ -721,10 +827,20 @@ def token_spec(config: BurninConfig):
 
 def _init_state(config: BurninConfig):
     import jax
+    import jax.numpy as jnp
 
     params = init_params(config)
-    mom = jax.tree_util.tree_map(lambda p: p * 0, params)
-    return (params, mom)
+    zeros = jax.tree_util.tree_map(lambda p: p * 0, params)
+    if config.optimizer == "adamw":
+        return (
+            params,
+            {
+                "m": zeros,
+                "v": jax.tree_util.tree_map(lambda p: p * 0, params),
+                "t": jnp.zeros((), jnp.int32),
+            },
+        )
+    return (params, zeros)
 
 
 def sample_tokens(config: BurninConfig, key=None):

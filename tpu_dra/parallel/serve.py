@@ -175,9 +175,32 @@ class ServeEngine:
             )
             return cache, tok, pos, toks  # toks: (steps_per_tick, B)
 
-        self._prefill1 = jax.jit(prefill1)
-        self._insert = jax.jit(insert)
-        self._step = jax.jit(step)
+        if mesh is None:
+            self._prefill1 = jax.jit(prefill1)
+            self._insert = jax.jit(insert)
+            self._step = jax.jit(step)
+        else:
+            # Pin the cache's OUT sharding on every state-threading jit:
+            # GSPMD's chosen output layout need not match the init-time
+            # device_put (decode.make_prefill pins out_shardings for the
+            # same reason), and an unpinned cache would silently drift
+            # from the serving spec after the first tick.  tok/pos/toks
+            # are tiny and stay replicated.
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from tpu_dra.parallel.decode import cache_spec
+
+            leaf = cache_spec(c, kv_int8)
+            cache_sh = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), {"k": leaf, "v": leaf}
+            )
+            rep = NamedSharding(mesh, P())
+            self._prefill1 = jax.jit(prefill1)
+            self._insert = jax.jit(insert, out_shardings=cache_sh)
+            self._step = jax.jit(
+                step, out_shardings=(cache_sh, rep, rep, rep)
+            )
 
     # -- submission ------------------------------------------------------
     def submit(self, prompt: "list[int]", max_new: "int | None" = None) -> int:
