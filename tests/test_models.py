@@ -79,3 +79,46 @@ def test_moe_family_uses_expert_axis_when_possible():
     # Indivisible count falls back to the 3-axis training mesh.
     mesh3 = family_mesh("moe", jax.devices()[:2])
     assert "expert" not in mesh3.shape
+
+
+class TestServeFamily:
+    """serve_family: the inference half of slice acceptance — a claimed
+    slice is certified for training AND serving."""
+
+    @pytest.mark.parametrize("name", ["dense", "flash", "moe"])
+    def test_servable_families_serve_healthy(self, name):
+        from tpu_dra.models import serve_family
+
+        r = serve_family(name, steps=6, prompt_len=4)
+        assert r.ok, r.error
+        assert r.tokens_per_second > 0 and r.steps == 6
+
+    def test_int8_stack_serves(self):
+        from tpu_dra.models import serve_family
+
+        r = serve_family("dense", steps=6, prompt_len=4, int8=True)
+        assert r.ok, r.error
+
+    @pytest.mark.parametrize(
+        "name", ["long_context", "long_context_a2a", "long_context_moe"]
+    )
+    def test_context_parallel_families_rejected_not_raised(self, name):
+        from tpu_dra.models import serve_family
+
+        r = serve_family(name, steps=4, prompt_len=4)
+        assert not r.ok
+        assert "context parallelism" in r.error
+
+    def test_pipelined_rejected_not_raised(self):
+        from tpu_dra.models import serve_family
+
+        r = serve_family("pipelined", steps=4, prompt_len=4)
+        assert not r.ok and r.error
+
+    def test_unknown_family_still_raises(self):
+        """Config resolution errors are caller bugs, not slice verdicts:
+        the reports-not-raises contract starts after the family exists."""
+        from tpu_dra.models import serve_family
+
+        with pytest.raises(ValueError, match="unknown model family"):
+            serve_family("nope")

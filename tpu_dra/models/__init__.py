@@ -19,7 +19,9 @@ operators and tests speak in families, not raw config fields:
 
 Each family is a ``BurninConfig`` preset plus the mesh builder that suits
 it; ``train_family`` runs the family's training step on a claimed slice and
-returns the burn-in report.
+returns the burn-in report, and ``serve_family`` runs its serving
+acceptance (health-checked KV-cache generation, optionally on the full
+int8 stack) — a slice is certified for both halves of the workload.
 """
 
 from __future__ import annotations
@@ -29,7 +31,14 @@ from typing import Callable
 
 from tpu_dra.parallel.burnin import BurninConfig, TrainReport, burnin_mesh, train
 
-__all__ = ["FAMILIES", "family_config", "family_mesh", "train_family"]
+__all__ = [
+    "FAMILIES",
+    "ServeReport",
+    "family_config",
+    "family_mesh",
+    "serve_family",
+    "train_family",
+]
 
 
 def _dense(**overrides) -> BurninConfig:
@@ -127,3 +136,81 @@ def train_family(
     # train() -> scaled_to snaps the config to the mesh (incl. the pipe
     # axis size, which family_mesh built from the requested stages).
     return train(config, mesh, steps=steps)
+
+
+@dataclasses.dataclass
+class ServeReport:
+    """Result of a family's serving acceptance on a claimed slice.
+
+    Timing is END-TO-END per request: ``request_ms`` is the full
+    prefill + decode wall time of the batched generation, and
+    ``tokens_per_second`` counts generated tokens over that same wall —
+    the acceptance answers "what does a request cost on this slice",
+    not "what is an isolated decode step" (the bench's decode stanza
+    measures that)."""
+
+    ok: bool
+    tokens_per_second: float = 0.0
+    request_ms: float = 0.0
+    batch: int = 0
+    steps: int = 0
+    error: str = ""
+
+
+def serve_family(
+    name: str,
+    devices=None,
+    *,
+    steps: int = 12,
+    prompt_len: int = 8,
+    int8: bool = False,
+    **overrides,
+) -> ServeReport:
+    """Run the named family's SERVING acceptance over the claimed slice:
+    a health-checked KV-cache generation (`parallel/decode.py`) on the
+    family's mesh — the inference counterpart of `train_family`, so a
+    slice is certified for both halves of the workload.
+
+    ``int8=True`` serves the full int8 stack (quantized weights + int8
+    KV cache).  Honors the burn-in contract: reports, never raises —
+    families whose parallelism has no decode form (context-parallel,
+    pipelined: the sequence/microbatch axes are meaningless for a
+    single-position query) come back as ``ServeReport(ok=False,
+    error=...)`` stating exactly that."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    config = family_config(name, **overrides)
+    try:
+        mesh = family_mesh(
+            name, devices, stages=config.pipeline_stages or None
+        )
+        c = config.scaled_to(mesh)
+        from tpu_dra.parallel.burnin import init_params
+        from tpu_dra.parallel.decode import make_generate
+        from tpu_dra.parallel.quant import quantize_params
+
+        gen = make_generate(
+            c, mesh, prompt_len=prompt_len, steps=steps, with_health=True,
+            quantized=int8, kv_int8=int8,
+        )
+        params = init_params(c)
+        if int8:
+            params = quantize_params(params)
+        prompt = jnp.ones((c.batch, prompt_len), jnp.int32)
+        jax.block_until_ready(gen(params, prompt))  # compile + warmup
+        t0 = time.perf_counter()
+        toks, healthy = jax.block_until_ready(gen(params, prompt))
+        dt = time.perf_counter() - t0
+        return ServeReport(
+            ok=bool(healthy) and toks.shape == (c.batch, prompt_len + steps),
+            tokens_per_second=round(c.batch * steps / dt, 1),
+            request_ms=round(dt * 1e3, 3),
+            batch=c.batch,
+            steps=steps,
+        )
+    except Exception as e:
+        return ServeReport(ok=False, error=f"{type(e).__name__}: {e}")

@@ -832,6 +832,10 @@ def _init_state(config: BurninConfig):
     params = init_params(config)
     zeros = jax.tree_util.tree_map(lambda p: p * 0, params)
     if config.optimizer == "adamw":
+        # m and v must be DISTINCT buffers: the train step donates its
+        # state (donate_argnums=0), and donating an aliased buffer twice
+        # poisons the second reference — immutability does not make
+        # sharing safe here.
         return (
             params,
             {

@@ -115,20 +115,25 @@ class ServeEngine:
         self.mesh = mesh
 
         self._cache = init_cache(c, slots, kv_int8)
+        cache_sh = None
         if mesh is not None:
-            # Lay the engine cache out per the serving spec (batch over
-            # data x fsdp, heads over model) so the jitted step inherits
-            # the sharded layout instead of replicating the dominant
-            # tensor; jit input shardings then follow the arrays.
+            # ONE cache-sharding tree, used for both the init-time layout
+            # and the jit out_shardings pin below — the two must agree by
+            # construction or the pin would fight the placement.
             from jax.sharding import NamedSharding
 
             from tpu_dra.parallel.decode import cache_spec
 
             leaf = cache_spec(c, kv_int8)
+            cache_sh = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), {"k": leaf, "v": leaf}
+            )
+            # Lay the engine cache out per the serving spec (batch over
+            # data x fsdp, heads over model) so the jitted step inherits
+            # the sharded layout instead of replicating the dominant
+            # tensor.
             self._cache = jax.tree_util.tree_map(
-                lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
-                self._cache,
-                {"k": leaf, "v": leaf},
+                jax.device_put, self._cache, cache_sh
             )
         self._kv_int8 = kv_int8
         # Host-side row state: which request, its position (== number of
@@ -180,21 +185,16 @@ class ServeEngine:
             self._insert = jax.jit(insert)
             self._step = jax.jit(step)
         else:
-            # Pin the cache's OUT sharding on every state-threading jit:
-            # GSPMD's chosen output layout need not match the init-time
-            # device_put (decode.make_prefill pins out_shardings for the
+            # Pin the cache's OUT sharding on every state-threading jit
+            # (the SAME cache_sh tree the init-time device_put used):
+            # GSPMD's chosen output layout need not match the input
+            # placement (decode.make_prefill pins out_shardings for the
             # same reason), and an unpinned cache would silently drift
             # from the serving spec after the first tick.  tok/pos/toks
             # are tiny and stay replicated.
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
-            from tpu_dra.parallel.decode import cache_spec
-
-            leaf = cache_spec(c, kv_int8)
-            cache_sh = jax.tree_util.tree_map(
-                lambda s: NamedSharding(mesh, s), {"k": leaf, "v": leaf}
-            )
             rep = NamedSharding(mesh, P())
             self._prefill1 = jax.jit(prefill1)
             self._insert = jax.jit(insert, out_shardings=cache_sh)
