@@ -182,3 +182,111 @@ class TestMesh:
             np.asarray(toks[:, :8]), np.asarray(prompt)
         )
         assert 1 <= int(rounds) <= 8
+
+
+class TestStochasticCore:
+    """The accept/resample math on analytic distributions: the output of
+    `accept_or_resample` is distributed exactly as the target softmax
+    for ANY draft — the speculative-sampling theorem, pinned
+    empirically with fixed seeds (deterministic, not flaky)."""
+
+    def test_output_matches_target_distribution(self):
+        from jax.nn import softmax
+
+        from tpu_dra.parallel.speculative import accept_or_resample
+
+        V, N = 4, 20000
+        tl = jnp.asarray([1.0, 0.2, -0.5, 0.7])
+        ql = jnp.asarray([-0.3, 0.9, 0.1, 0.0])
+        kq, kar = jax.random.split(jax.random.PRNGKey(0))
+        draft = jax.random.categorical(
+            kq, jnp.tile(ql, (N, 1)), axis=-1
+        ).astype(jnp.int32)
+        toks, acc = accept_or_resample(
+            kar, jnp.tile(tl, (N, 1)), jnp.tile(ql, (N, 1)), draft
+        )
+        emp = np.bincount(np.asarray(toks), minlength=V) / N
+        want = np.asarray(softmax(tl))
+        assert 0.5 * np.abs(emp - want).sum() < 0.02  # total variation
+        # The draft disagrees with the target, so some rejections occur.
+        assert 0.05 < float(acc.mean()) < 0.95
+
+    def test_identical_distributions_always_accept(self):
+        from tpu_dra.parallel.speculative import accept_or_resample
+
+        tl = jnp.asarray([0.3, -1.0, 0.8])
+        N = 4000
+        kq, kar = jax.random.split(jax.random.PRNGKey(1))
+        draft = jax.random.categorical(
+            kq, jnp.tile(tl, (N, 1)), axis=-1
+        ).astype(jnp.int32)
+        toks, acc = accept_or_resample(
+            kar, jnp.tile(tl, (N, 1)), jnp.tile(tl, (N, 1)), draft
+        )
+        assert float(acc.mean()) == 1.0
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(draft))
+
+    def test_residual_excludes_overrepresented_tokens(self):
+        """Where the draft puts MORE mass than the target, the residual
+        is zero: a rejection never resamples such a token."""
+        from tpu_dra.parallel.speculative import residual_sample
+
+        tl = jnp.log(jnp.asarray([0.1, 0.6, 0.3]))
+        ql = jnp.log(jnp.asarray([0.6, 0.2, 0.2]))  # token 0 over-drafted
+        toks = residual_sample(
+            jax.random.PRNGKey(2), jnp.tile(tl, (2000, 1)),
+            jnp.tile(ql, (2000, 1)),
+        )
+        assert not (np.asarray(toks) == 0).any()
+
+
+class TestStochasticGeneration:
+    def test_sampled_generation_healthy_and_in_range(self):
+        params = init_params(CFG)
+        prompt = seeded_prompt(CFG, CFG.batch, 8)
+        fn = make_generate_speculative(
+            CFG, prompt_len=8, steps=12, draft_layers=2, draft_len=4,
+            temperature=0.8, with_stats=True,
+        )
+        toks, rounds, fin = fn(params, prompt, jax.random.PRNGKey(11))
+        assert bool(fin) and toks.shape == (CFG.batch, 20)
+        arr = np.asarray(toks)
+        assert ((0 <= arr) & (arr < CFG.vocab)).all()
+        np.testing.assert_array_equal(arr[:, :8], np.asarray(prompt))
+        assert 1 <= int(rounds) <= 12
+
+    def test_perfect_draft_full_acceptance_at_temperature(self):
+        """draft == target means p == q at every position: acceptance
+        probability is exactly 1, so the sampled path gets the same
+        ceil(steps/(k+1)) round count as the greedy perfect draft —
+        the theorem's p==q corollary flowing through the whole loop."""
+        params = init_params(CFG)
+        prompt = seeded_prompt(CFG, CFG.batch, 8)
+        fn = make_generate_speculative(
+            CFG, prompt_len=8, steps=16, draft_layers=4, draft_len=7,
+            temperature=0.8, with_stats=True,
+        )
+        _, rounds, fin = fn(params, prompt, jax.random.PRNGKey(5))
+        assert bool(fin) and int(rounds) == 2
+
+    def test_missing_key_rejected(self):
+        params = init_params(CFG)
+        fn = make_generate_speculative(
+            CFG, prompt_len=8, steps=4, draft_layers=2, draft_len=2,
+            temperature=0.5,
+        )
+        with pytest.raises(ValueError, match="requires a PRNG key"):
+            fn(params, seeded_prompt(CFG, CFG.batch, 8))
+
+    def test_different_keys_diverge_same_key_repeats(self):
+        params = init_params(CFG)
+        prompt = seeded_prompt(CFG, CFG.batch, 8)
+        fn = make_generate_speculative(
+            CFG, prompt_len=8, steps=10, draft_layers=2, draft_len=3,
+            temperature=0.9,
+        )
+        a = fn(params, prompt, jax.random.PRNGKey(1))
+        b = fn(params, prompt, jax.random.PRNGKey(2))
+        a2 = fn(params, prompt, jax.random.PRNGKey(1))
+        assert (np.asarray(a) != np.asarray(b)).any()
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(a2))

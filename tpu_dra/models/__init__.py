@@ -205,12 +205,25 @@ def serve_family(
         t0 = time.perf_counter()
         toks, healthy = jax.block_until_ready(gen(params, prompt))
         dt = time.perf_counter() - t0
+        ok = bool(healthy) and toks.shape == (c.batch, prompt_len + steps)
         return ServeReport(
-            ok=bool(healthy) and toks.shape == (c.batch, prompt_len + steps),
+            ok=ok,
             tokens_per_second=round(c.batch * steps / dt, 1),
             request_ms=round(dt * 1e3, 3),
             batch=c.batch,
             steps=steps,
+            # ok=False must carry its reason (the contract): a served but
+            # unhealthy generation is a verdict, not a silent flag.
+            error=(
+                ""
+                if ok
+                else (
+                    "health check failed: non-finite logits during "
+                    "generation"
+                    if not bool(healthy)
+                    else f"unexpected output shape {tuple(toks.shape)}"
+                )
+            ),
         )
     except Exception as e:
         return ServeReport(ok=False, error=f"{type(e).__name__}: {e}")

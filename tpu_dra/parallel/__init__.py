@@ -44,9 +44,11 @@ slice:
   → EOS/budget finish → row freed mid-flight of everyone else); every
   request's output equals the request run alone.
 - ``tpu_dra.parallel.speculative`` — speculative decoding: layer-skip
-  self-draft + one-pass verify with exact greedy acceptance (token
-  -identical to plain decode for any draft; best case draft_len+1
-  tokens per full-model pass), all inside one compiled while_loop.
+  self-draft + one-pass verify, all inside one compiled while_loop.
+  Greedy: exact acceptance (token-identical to plain decode for any
+  draft).  Sampled: the stochastic accept/resample correction — output
+  distributed exactly as target-only sampling (theorem pinned on
+  analytic distributions).  Best case draft_len+1 tokens per full pass.
 - ``tpu_dra.parallel.quant``       — weight-only int8 serving quantization:
   symmetric per-output-channel scales, dequant fused into the consuming
   matmul (HBM reads stay int8 — decode is memory-bound, so bytes are

@@ -38,10 +38,16 @@ TPU-native mechanics:
   latency-serving case speculative decoding exists for — pays no
   consensus tax.
 
-Greedy only (temperature == 0): stochastic speculative sampling needs
-the rejection-resampling correction and is out of scope, by validation
-error.  Dense configs only (the draft's truncated layer stack would
-re-route MoE capacity queues).
+Sampling (temperature > 0) uses the stochastic speculative correction
+(Leviathan et al.): draft token ``x`` is accepted with probability
+``min(1, p_target(x) / p_draft(x))``; on rejection the token resamples
+from the residual ``normalize(max(0, p_target - p_draft))``, and a full
+acceptance samples the bonus token from the target's own distribution —
+the output is distributed EXACTLY as target-only sampling, for any
+draft (the pure math lives in `accept_or_resample`, unit-tested against
+analytic distributions; the integration test checks the perfect-draft
+marginal against the analytic softmax).  Dense configs only (the
+draft's truncated layer stack would re-route MoE capacity queues).
 
 Reference parity note: the reference driver (nvidia k8s-dra-driver) has
 no compute path at all — this extends the serving layer that exceeds it
@@ -60,7 +66,70 @@ from tpu_dra.parallel.decode import (
     decode_forward,
 )
 
-__all__ = ["draft_params", "make_generate_speculative"]
+__all__ = [
+    "accept_or_resample",
+    "acceptance_flags",
+    "draft_params",
+    "make_generate_speculative",
+    "residual_sample",
+]
+
+
+def acceptance_flags(u, target_logits, draft_logits, draft_tok,
+                     temperature: float = 1.0):
+    """The stochastic-speculative acceptance test, elementwise over any
+    leading shape: accept draft token ``x`` iff ``u < p(x) / q(x)`` with
+    ``p``/``q`` the temperature-scaled target/draft softmaxes.  Pure —
+    the theorem's first half, unit-tested against analytic
+    distributions."""
+    import jax.numpy as jnp
+    from jax.nn import softmax
+
+    p = softmax(target_logits / temperature, axis=-1)
+    q = softmax(draft_logits / temperature, axis=-1)
+    p_x = jnp.take_along_axis(p, draft_tok[..., None], axis=-1)[..., 0]
+    q_x = jnp.take_along_axis(q, draft_tok[..., None], axis=-1)[..., 0]
+    return u < p_x / jnp.maximum(q_x, 1e-20)
+
+
+def residual_sample(key, target_logits, draft_logits,
+                    temperature: float = 1.0):
+    """The rejection branch: draw from ``normalize(max(p - q, 0))`` —
+    the residual that makes accepted-or-resampled output exactly
+    target-distributed.  Degenerate ``p == q`` residual (all-zero mass;
+    unreachable because acceptance probability is then 1) falls back to
+    ``p``.  Shapes: logits (..., V) -> token (...,)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.nn import softmax
+
+    p = softmax(target_logits / temperature, axis=-1)
+    q = softmax(draft_logits / temperature, axis=-1)
+    resid = jnp.maximum(p - q, 0.0)
+    mass = resid.sum(-1, keepdims=True)
+    resid = jnp.where(mass > 0, resid / jnp.maximum(mass, 1e-20), p)
+    return jax.random.categorical(key, jnp.log(resid + 1e-20), axis=-1).astype(
+        jnp.int32
+    )
+
+
+def accept_or_resample(key, target_logits, draft_logits, draft_tok,
+                       temperature: float = 1.0):
+    """One full position of stochastic speculative sampling, batched:
+    returns ``(token, accepted)``.  Composition of `acceptance_flags`
+    (with a fresh uniform) and `residual_sample` — the distributional
+    guarantee (output ~ target softmax for ANY draft) is pinned by the
+    unit tests on this function."""
+    import jax
+    import jax.numpy as jnp
+
+    ku, kr = jax.random.split(key)
+    u = jax.random.uniform(ku, draft_tok.shape)
+    accepted = acceptance_flags(
+        u, target_logits, draft_logits, draft_tok, temperature
+    )
+    resampled = residual_sample(kr, target_logits, draft_logits, temperature)
+    return jnp.where(accepted, draft_tok, resampled), accepted
 
 
 def draft_params(params: dict, draft_layers: int) -> dict:
@@ -86,13 +155,22 @@ def make_generate_speculative(
     steps: int,
     draft_layers: int,
     draft_len: int,
+    temperature: float = 0.0,
     with_stats: bool = False,
     quantized: bool = False,
     kv_int8: bool = False,
 ):
     """Build the jitted speculative generation function:
-    ``fn(params, prompt (B, prompt_len)) -> (B, prompt_len + steps)``
-    — greedy, token-identical to `make_generate`'s output.
+    ``fn(params, prompt (B, prompt_len)[, key]) -> (B, prompt_len + steps)``.
+
+    ``temperature == 0``: greedy — token-identical to `make_generate`'s
+    output (exactness pinned).  ``temperature > 0``: stochastic
+    speculative sampling (key required) — accept/resample per position
+    (`acceptance_flags` / `residual_sample`), output distributed exactly
+    as target-only sampling; a row whose acceptance ran past the batch
+    consensus cut defers its already-accepted token to the next round
+    (it IS a valid target sample — the theorem — so deferral preserves
+    the distribution).
 
     ``draft_layers``: depth of the layer-skip draft (1..n_layers).
     ``draft_len``: tokens proposed per round (the verify pass scores
@@ -126,7 +204,13 @@ def make_generate_speculative(
     dc = dataclasses.replace(c, n_layers=draft_layers)
     prefill_full = _build_prefill(c, mesh, prompt_len, None)
 
-    def run(params, prompt):
+    sampled = temperature > 0.0
+
+    def run(params, prompt, key=None):
+        if sampled and key is None:
+            raise ValueError(
+                "temperature > 0 requires a PRNG key: fn(params, prompt, key)"
+            )
         B = prompt.shape[0]
         dparams = draft_params(params, draft_layers)
         cache = _fresh_cache(c, B, mesh, kv_int8)
@@ -139,19 +223,31 @@ def make_generate_speculative(
         dcache = jax.tree_util.tree_map(
             lambda a: a[:draft_layers], cache
         )
-        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        if sampled:
+            key, k0 = jax.random.split(key)
+            tok = jax.random.categorical(
+                k0, last / temperature, axis=-1
+            ).astype(jnp.int32)
+        else:
+            key = jnp.zeros((2,), jnp.uint32)  # carried, unused
+            tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
         fin0 = jnp.isfinite(last).all()
 
         outbuf = jnp.zeros((B, steps + draft_len), jnp.int32)
         k = draft_len
 
         def cond(state):
-            _, _, _, count, _, _, _ = state
+            _, _, _, count, _, _, _, _ = state
             return count < steps
 
         def body(state):
-            cache, dcache, outbuf, count, tok, fin, rounds = state
+            cache, dcache, outbuf, count, tok, fin, rounds, key = state
             f = prompt_len + count  # cache slot of the next fed token
+            if sampled:
+                key, kd, ka, kr, kb = jax.random.split(key, 5)
+                dkeys = jax.random.split(kd, k + 1)
+            else:
+                dkeys = jnp.zeros((k + 1, 2), jnp.uint32)
 
             # Draft k candidates autoregressively through the shallow
             # stack.  The scan runs k+1 steps feeding [tok, d1..dk]: the
@@ -160,49 +256,93 @@ def make_generate_speculative(
             # slot f+k — a full-acceptance round advances the frontier
             # past it, and an unwritten slot would silently corrupt
             # every later draft's conditioning (not the output, which
-            # verify gates — just the acceptance rate).
-            def draft_step(carry, _):
+            # verify gates — just the acceptance rate).  Sampled mode
+            # also collects each step's draft logits row: the acceptance
+            # ratio needs q_j(d_j).
+            def draft_step(carry, kstep):
                 dcache, t, pos = carry
                 lg, dcache = decode_forward(
                     dparams, t[:, None], dcache, pos, dc, mesh
                 )
-                nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+                row = lg[:, -1]
+                if sampled:
+                    nxt = jax.random.categorical(
+                        kstep, row / temperature, axis=-1
+                    ).astype(jnp.int32)
+                    return (dcache, nxt, pos + 1), (nxt, row)
+                nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
                 return (dcache, nxt, pos + 1), nxt
 
-            (dcache, _, _), drafted_T = jax.lax.scan(
-                draft_step, (dcache, tok, f), None, length=k + 1
+            (dcache, _, _), ys = jax.lax.scan(
+                draft_step, (dcache, tok, f), dkeys
             )
+            if sampled:
+                drafted_T, dlogits_T = ys
+                dlogits = dlogits_T.transpose(1, 0, 2)[:, :k]  # (B, k, V)
+            else:
+                drafted_T = ys
             drafted = drafted_T.transpose(1, 0)[:, :k]  # (B, k): d1..dk
             fed = jnp.concatenate([tok[:, None], drafted], axis=1)  # (B, k+1)
 
-            # One full-model pass scores every fed token; g[:, j] is the
-            # target's greedy choice AFTER fed[:, j].  Feeding d_k too is
-            # the classic free bonus: full agreement commits k+1 tokens
-            # from one verify pass.
+            # One full-model pass scores every fed token; logits[:, j] is
+            # the target distribution AFTER fed[:, j].  Feeding d_k too
+            # is the classic free bonus: full agreement commits k+1
+            # tokens from one verify pass.
             logits, cache = decode_forward(params, fed, cache, f, c, mesh)
-            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
             fin = jnp.logical_and(fin, jnp.isfinite(logits).all())
 
-            # Per-row agreeing prefix of the k drafted continuations
-            # (fed[:, j+1] vs g[:, j]), then batch consensus.
-            agree = fed[:, 1:] == g[:, :-1]  # (B, k)
-            prefix = jnp.cumprod(agree.astype(jnp.int32), axis=-1).sum(-1)
-            n_commit = 1 + prefix.min()  # fed tokens kept, up to k+1
+            if sampled:
+                # Stochastic acceptance per position, then batch
+                # consensus on the accepted-prefix length.
+                u = jax.random.uniform(ka, (B, k))
+                a = acceptance_flags(
+                    u, logits[:, :k], dlogits, drafted, temperature
+                )
+                fin = jnp.logical_and(fin, jnp.isfinite(dlogits).all())
+            else:
+                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                a = fed[:, 1:] == g[:, :-1]  # (B, k)
+            prefix = jnp.cumprod(a.astype(jnp.int32), axis=-1).sum(-1)
+            n_acc = prefix.min()
+            n_commit = 1 + n_acc  # fed tokens kept, up to k+1
 
             # Write ALL k+1 fed tokens at the frontier; the unaccepted
             # tail is overwritten by the next round (same trick as the
             # cache).
             outbuf = jax.lax.dynamic_update_slice(outbuf, fed, (0, count))
-            # Next pending token: the target's choice after the last
-            # committed fed token (traced column index).
-            tok = g[:, n_commit - 1]
+
+            # Next pending token (per row):
+            if sampled:
+                jstar = jnp.minimum(n_acc, k - 1)
+                # Full acceptance: bonus sample from the target's own
+                # distribution after d_k.  Rejection at the cut:
+                # residual resample.  A row whose acceptance ran PAST
+                # the consensus cut defers its accepted d_{jstar+1} —
+                # an accepted token IS a target sample (the theorem),
+                # so deferral preserves the distribution.
+                bonus = jax.random.categorical(
+                    kb, logits[:, k] / temperature, axis=-1
+                ).astype(jnp.int32)
+                resid = residual_sample(
+                    kr, logits[:, jstar], dlogits[:, jstar], temperature
+                )
+                tok = jnp.where(
+                    n_acc == k,
+                    bonus,
+                    jnp.where(a[:, jstar], drafted[:, jstar], resid),
+                )
+            else:
+                # The target's greedy choice after the last committed
+                # fed token (traced column index).
+                tok = g[:, n_commit - 1]
             return (
-                cache, dcache, outbuf, count + n_commit, tok, fin, rounds + 1
+                cache, dcache, outbuf, count + n_commit, tok, fin,
+                rounds + 1, key,
             )
 
         state = (cache, dcache, outbuf, jnp.int32(0), tok, fin0,
-                 jnp.int32(0))
-        _, _, outbuf, _, _, fin, rounds = jax.lax.while_loop(
+                 jnp.int32(0), key)
+        _, _, outbuf, _, _, fin, rounds, _ = jax.lax.while_loop(
             cond, body, state
         )
         tokens = jnp.concatenate([prompt, outbuf[:, :steps]], axis=1)
@@ -213,5 +353,6 @@ def make_generate_speculative(
     from jax.sharding import PartitionSpec as P
 
     return _jit_sharded(
-        run, mesh, c, False, [P(("data", "fsdp"), None)], quantized=quantized
+        run, mesh, c, sampled, [P(("data", "fsdp"), None)],
+        quantized=quantized,
     )
