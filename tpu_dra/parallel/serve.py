@@ -34,10 +34,15 @@ steps (one small device→host fetch per step — the price of reacting to
 finishes immediately, which is the entire point of continuous batching;
 amortize with ``steps_per_tick`` when reaction latency can lag).
 
-Greedy decoding per row (the engine's determinism contract: every
-request's output equals `make_generate_padded` run on that request
-alone — the exactness test).  Dense and MoE configs; weight/KV int8
-compose like everywhere else in the serving stack.
+Determinism contracts, both modes: greedy — every request's output
+equals `make_generate_padded` run on that request alone (the exactness
+test); sampled (``temperature > 0``) — each token's randomness is
+``fold_in(key(request.seed), position)``, a function of the REQUEST and
+the POSITION only, so outputs are SCHEDULING-INVARIANT: the same
+request stream produces identical per-request tokens whatever the slot
+count, admission order, or steps_per_tick (pinned by test).  Dense and
+MoE configs; weight/KV int8 compose like everywhere else in the
+serving stack.
 
 Reference parity note: the reference driver (nvidia k8s-dra-driver) has
 no compute path at all — this is the serving-runtime layer of the
@@ -51,6 +56,8 @@ from dataclasses import dataclass, field
 from tpu_dra.parallel.burnin import BurninConfig
 from tpu_dra.parallel.decode import (
     _check_window,
+    _make_pick,
+    _validate_filters,
     decode_forward,
     decode_step_rows,
     init_cache,
@@ -66,6 +73,7 @@ class Request:
     id: int
     prompt: "list[int]"
     max_new: int
+    seed: int = 0  # sampling: randomness is f(seed, position) only
     tokens: "list[int]" = field(default_factory=list)  # generated only
     done: bool = False
     finish_reason: str = ""  # "eos" | "budget"
@@ -92,6 +100,9 @@ class ServeEngine:
         max_new_cap: int,
         eos_token: "int | None" = None,
         steps_per_tick: int = 1,
+        temperature: float = 0.0,
+        top_k: "int | None" = None,
+        top_p: "float | None" = None,
         kv_int8: bool = False,
         mesh=None,
     ):
@@ -105,6 +116,7 @@ class ServeEngine:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if steps_per_tick < 1:
             raise ValueError(f"steps_per_tick must be >= 1, got {steps_per_tick}")
+        _validate_filters(c.vocab, temperature > 0, top_k, top_p)
         self.config = c
         self.params = params
         self.slots = slots
@@ -112,6 +124,7 @@ class ServeEngine:
         self.max_new_cap = max_new_cap
         self.eos_token = eos_token
         self.steps_per_tick = steps_per_tick
+        self.temperature = temperature
         self.mesh = mesh
 
         self._cache = init_cache(c, slots, kv_int8)
@@ -162,13 +175,30 @@ class ServeEngine:
                 cache1,
             )
 
-        def step(params, cache, tok, pos, active):
+        # One sampling policy for the whole stack: decode._make_pick
+        # (temperature scaling + optional top_k/top_p filters).
+        _pick = _make_pick(temperature > 0, temperature, top_k, top_p)
+
+        def pick_row(seed, p, row):
+            # Request-keyed sampling: the token landing in position p of
+            # the request with this seed draws from fold_in(key(seed), p)
+            # — randomness depends on (request, position) ONLY, never on
+            # which slot or tick served it, so outputs are SCHEDULING
+            # -INVARIANT (pinned by test across slot counts and
+            # steps_per_tick).
+            k = jax.random.fold_in(jax.random.PRNGKey(seed), p)
+            return _pick(row, k)
+
+        def step(params, cache, tok, pos, active, seeds):
             # steps_per_tick tokens for every row in ONE device call; the
             # per-step tokens come back for host-side finish decisions.
             def one(carry, _):
                 cache, tok, pos = carry
                 logits, cache = decode_step_rows(params, tok, cache, pos, c, mesh)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if temperature > 0:
+                    nxt = jax.vmap(pick_row)(seeds, pos + 1, logits)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 # Inactive rows freeze: token and position pinned so their
                 # (harmless) writes stay on one stale slot.
                 nxt = jnp.where(active, nxt, tok)
@@ -179,6 +209,8 @@ class ServeEngine:
                 one, (cache, tok, pos), None, length=self.steps_per_tick
             )
             return cache, tok, pos, toks  # toks: (steps_per_tick, B)
+
+        self._pick_row = jax.jit(pick_row) if temperature > 0 else None
 
         if mesh is None:
             self._prefill1 = jax.jit(prefill1)
@@ -203,8 +235,12 @@ class ServeEngine:
             )
 
     # -- submission ------------------------------------------------------
-    def submit(self, prompt: "list[int]", max_new: "int | None" = None) -> int:
-        """Queue a request; returns its id.  Admission happens on `tick`."""
+    def submit(self, prompt: "list[int]", max_new: "int | None" = None,
+               seed: "int | None" = None) -> int:
+        """Queue a request; returns its id.  Admission happens on `tick`.
+        ``seed`` keys this request's sampling (default: the request id) —
+        its output depends on (seed, position) only, never on
+        scheduling."""
         if not 1 <= len(prompt) <= self.prompt_slots:
             raise ValueError(
                 f"prompt length must be in [1, {self.prompt_slots}], "
@@ -215,7 +251,10 @@ class ServeEngine:
             raise ValueError(
                 f"max_new must be in [1, {self.max_new_cap}], got {budget}"
             )
-        req = Request(id=self._next_id, prompt=list(prompt), max_new=budget)
+        req = Request(
+            id=self._next_id, prompt=list(prompt), max_new=budget,
+            seed=self._next_id if seed is None else seed,
+        )
         self._next_id += 1
         self._queue.append(req)
         return req.id
@@ -235,7 +274,14 @@ class ServeEngine:
                 self.params, prompt, jnp.int32(length)
             )
             self._cache = self._insert(self._cache, cache1, jnp.int32(row))
-            first = int(jnp.argmax(last[0]))
+            if self.temperature > 0:
+                first = int(
+                    self._pick_row(
+                        jnp.int32(req.seed), jnp.int32(length), last[0]
+                    )
+                )
+            else:
+                first = int(jnp.argmax(last[0]))
             self._row_req[row] = req
             self._pos[row] = length
             self._tok[row] = first
@@ -267,8 +313,12 @@ class ServeEngine:
             )
             tok = jnp.asarray(self._tok, jnp.int32)
             pos = jnp.asarray(self._pos, jnp.int32)
+            seeds = jnp.asarray(
+                [r.seed if r is not None else 0 for r in self._row_req],
+                jnp.int32,
+            )
             self._cache, tok, pos, toks = self._step(
-                self.params, self._cache, tok, pos, active
+                self.params, self._cache, tok, pos, active, seeds
             )
             # ONE blocking fetch per tick (the module-header promise):
             # tokens, next-token, and positions come back together.
