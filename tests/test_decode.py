@@ -567,3 +567,36 @@ class TestPrefixCache:
         cont1 = make_generate_from_cache(c, start_pos=8, steps=4)(params, c1, l1)
         cont2 = make_generate_from_cache(c, start_pos=8, steps=4)(params, c2, l2)
         np.testing.assert_array_equal(np.asarray(cont1), np.asarray(cont2))
+
+
+class TestServingConfig:
+    def test_cp_and_pp_trained_weights_serve(self):
+        """serving_config strips training-only parallelism; the param
+        tree is geometry-identical, so cp/pp-trained weights load
+        straight into the decode paths (the one-call form of the
+        validation error's advice)."""
+        from tpu_dra.parallel.decode import serving_config
+
+        for kw in (
+            {"ring_attention": True},
+            {"ulysses_attention": True},
+            {"pipeline_stages": 2, "moe_experts": 2},
+        ):
+            ct = BurninConfig(
+                vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+                seq=32, batch=4, **kw,
+            )
+            params = init_params(ct)
+            cs = serving_config(ct)
+            fn = make_generate(cs, prompt_len=4, steps=4, with_health=True)
+            toks, healthy = fn(params, seeded_prompt(cs, 4, 4))
+            assert bool(healthy) and toks.shape == (4, 8)
+
+    def test_dense_config_unchanged(self):
+        from tpu_dra.parallel.decode import serving_config
+
+        c = BurninConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=32,
+            batch=4,
+        )
+        assert serving_config(c) == c

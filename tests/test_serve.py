@@ -181,6 +181,14 @@ class TestEngineValidation:
         with pytest.raises(ValueError, match="max_new"):
             eng.submit([1], 5)
 
+    def test_out_of_range_seed_rejected_at_submit(self):
+        eng = ServeEngine(
+            init_params(CFG), CFG, slots=1, prompt_slots=4, max_new_cap=2,
+            temperature=0.5,
+        )
+        with pytest.raises(ValueError, match="seed must fit int32"):
+            eng.submit([1], 2, seed=2**35)
+
     def test_zero_slots_rejected(self):
         with pytest.raises(ValueError, match="slots"):
             ServeEngine(

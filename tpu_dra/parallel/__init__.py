@@ -84,6 +84,7 @@ from tpu_dra.parallel.decode import (
     make_generate_from_cache,
     make_generate_padded,
     make_prefill,
+    serving_config,
 )
 from tpu_dra.parallel.quant import quantize_params
 from tpu_dra.parallel.serve import Request, ServeEngine
@@ -113,6 +114,7 @@ __all__ = [
     "psum_check",
     "quantize_params",
     "ring_check",
+    "serving_config",
     "slice_mesh",
     "topology_from_env",
     "validate_slice",

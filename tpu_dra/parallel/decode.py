@@ -72,6 +72,7 @@ __all__ = [
     "make_generate_padded",
     "make_prefill",
     "generate",
+    "serving_config",
 ]
 
 
@@ -100,17 +101,38 @@ def _require_key(jitted, nargs: int):
     return wrapper
 
 
+def serving_config(config: BurninConfig) -> BurninConfig:
+    """The serving view of a training config: training-only parallelism
+    stripped (ring/Ulysses context parallelism, pipeline stages — the
+    axes a single-position query cannot use), everything the PARAMS
+    depend on untouched.  cp/pp-trained weights load directly into this
+    config's decode paths: the param tree's shapes are identical (the
+    flags change sharding and schedule, not weight geometry) — this is
+    the one-call form of `_validate`'s "serve the cp-trained weights on
+    a tp mesh instead" advice."""
+    import dataclasses
+
+    return dataclasses.replace(
+        config,
+        ring_attention=False,
+        ulysses_attention=False,
+        pipeline_stages=0,
+    )
+
+
 def _validate(config: BurninConfig) -> None:
     if config.context_parallel:
         raise ValueError(
             "decode does not run under context parallelism: ring/Ulysses "
             "shard the sequence, and a decode step has a single query "
-            "position (serve the cp-trained weights on a tp mesh instead)"
+            "position — serve the cp-trained weights via "
+            "serving_config(config) (same param geometry, tp mesh)"
         )
     if config.pipeline_stages > 0:
         raise ValueError(
             "decode does not run under pipeline parallelism: a one-token "
-            "step has no microbatch stream to fill a GPipe schedule with"
+            "step has no microbatch stream to fill a GPipe schedule with "
+            "— serve the pp-trained weights via serving_config(config)"
         )
 
 

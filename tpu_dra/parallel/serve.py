@@ -175,19 +175,22 @@ class ServeEngine:
                 cache1,
             )
 
-        # One sampling policy for the whole stack: decode._make_pick
-        # (temperature scaling + optional top_k/top_p filters).
-        _pick = _make_pick(temperature > 0, temperature, top_k, top_p)
+        if temperature > 0:
+            # One sampling policy for the whole stack: decode._make_pick
+            # (temperature scaling + optional top_k/top_p filters).
+            _pick = _make_pick(True, temperature, top_k, top_p)
 
-        def pick_row(seed, p, row):
-            # Request-keyed sampling: the token landing in position p of
-            # the request with this seed draws from fold_in(key(seed), p)
-            # — randomness depends on (request, position) ONLY, never on
-            # which slot or tick served it, so outputs are SCHEDULING
-            # -INVARIANT (pinned by test across slot counts and
-            # steps_per_tick).
-            k = jax.random.fold_in(jax.random.PRNGKey(seed), p)
-            return _pick(row, k)
+            def pick_row(seed, p, row):
+                # Request-keyed sampling: the token landing in position p
+                # of the request with this seed draws from
+                # fold_in(key(seed), p) — randomness depends on (request,
+                # position) ONLY, never on which slot or tick served it,
+                # so outputs are SCHEDULING-INVARIANT (pinned by test
+                # across slot counts and steps_per_tick).
+                k = jax.random.fold_in(jax.random.PRNGKey(seed), p)
+                return _pick(row, k)
+        else:
+            pick_row = None  # greedy: step() takes the argmax branch
 
         def step(params, cache, tok, pos, active, seeds):
             # steps_per_tick tokens for every row in ONE device call; the
@@ -251,6 +254,10 @@ class ServeEngine:
             raise ValueError(
                 f"max_new must be in [1, {self.max_new_cap}], got {budget}"
             )
+        if seed is not None and not -(2**31) <= seed < 2**31:
+            # Seeds ride to the device as int32; reject here, not with an
+            # OverflowError mid-tick after other requests are in flight.
+            raise ValueError(f"seed must fit int32, got {seed}")
         req = Request(
             id=self._next_id, prompt=list(prompt), max_new=budget,
             seed=self._next_id if seed is None else seed,
