@@ -283,3 +283,51 @@ class TestSampledEngine:
                 init_params(CFG), CFG, slots=2, prompt_slots=8,
                 max_new_cap=4, top_k=5,
             )
+
+
+class TestStopSequences:
+    def test_stop_ends_request_and_frees_row(self):
+        """A request stops the moment its generated tail matches a stop
+        sequence; the freed row admits the next queued request."""
+        params = init_params(CFG)
+        # Discover the greedy continuation, then stop on its 2nd-3rd
+        # tokens as a 2-token stop sequence.
+        probe = [5, 9, 2]
+        full = isolated(params, CFG, probe, 5)
+        stop = [int(full[1]), int(full[2])]
+        eng = ServeEngine(params, CFG, slots=1, prompt_slots=8, max_new_cap=6)
+        a = eng.submit(probe, 6, stop_sequences=[stop])
+        b = eng.submit([7, 7], 2)
+        done = {r.id: r for r in eng.run()}
+        assert done[a].finish_reason == "stop"
+        # Stops at the FIRST occurrence of the pair (repeated-token
+        # continuations can match before the position the pair was
+        # lifted from); the matched suffix stays in tokens.
+        expect_len = next(
+            i + 2
+            for i in range(len(full) - 1)
+            if [int(full[i]), int(full[i + 1])] == stop
+        )
+        assert done[a].tokens == [int(t) for t in full[:expect_len]]
+        assert done[a].tokens[-2:] == stop
+        assert len(done[b].tokens) == 2
+
+    def test_single_token_stop_and_no_match_budget(self):
+        params = init_params(CFG)
+        probe = [5, 9, 2]
+        first = int(isolated(params, CFG, probe, 1)[0])
+        eng = ServeEngine(params, CFG, slots=2, prompt_slots=8, max_new_cap=4)
+        a = eng.submit(probe, 4, stop_sequences=[[first]])
+        b = eng.submit(probe, 4, stop_sequences=[[first + 1 if first + 1 < CFG.vocab else 0] * 3])
+        done = {r.id: r for r in eng.run()}
+        assert done[a].finish_reason == "stop" and done[a].tokens == [first]
+        assert done[b].finish_reason in ("budget", "stop")
+
+    def test_empty_stop_sequence_rejected(self):
+        eng = ServeEngine(
+            init_params(CFG), CFG, slots=1, prompt_slots=4, max_new_cap=2
+        )
+        with pytest.raises(ValueError, match="non-empty"):
+            eng.submit([1], 2, stop_sequences=[[]])
+        with pytest.raises(ValueError, match="int token ids"):
+            eng.submit([1], 2, stop_sequences=["</s>"])

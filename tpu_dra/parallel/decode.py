@@ -51,6 +51,7 @@ that exceeds it (SURVEY.md §5 long-context/distributed subsystems).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 from tpu_dra.parallel.burnin import (
@@ -110,8 +111,6 @@ def serving_config(config: BurninConfig) -> BurninConfig:
     flags change sharding and schedule, not weight geometry) — this is
     the one-call form of `_validate`'s "serve the cp-trained weights on
     a tp mesh instead" advice."""
-    import dataclasses
-
     return dataclasses.replace(
         config,
         ring_attention=False,

@@ -74,9 +74,10 @@ class Request:
     prompt: "list[int]"
     max_new: int
     seed: int = 0  # sampling: randomness is f(seed, position) only
+    stop_sequences: "list[list[int]]" = field(default_factory=list)
     tokens: "list[int]" = field(default_factory=list)  # generated only
     done: bool = False
-    finish_reason: str = ""  # "eos" | "budget"
+    finish_reason: str = ""  # "eos" | "budget" | "stop"
 
 
 class ServeEngine:
@@ -239,11 +240,15 @@ class ServeEngine:
 
     # -- submission ------------------------------------------------------
     def submit(self, prompt: "list[int]", max_new: "int | None" = None,
-               seed: "int | None" = None) -> int:
+               seed: "int | None" = None,
+               stop_sequences: "list[list[int]] | None" = None) -> int:
         """Queue a request; returns its id.  Admission happens on `tick`.
         ``seed`` keys this request's sampling (default: the request id) —
         its output depends on (seed, position) only, never on
-        scheduling."""
+        scheduling.  ``stop_sequences``: token sequences that end the
+        request when generated (detected host-side per token; the
+        matched stop suffix stays in ``tokens``, finish_reason
+        "stop")."""
         if not 1 <= len(prompt) <= self.prompt_slots:
             raise ValueError(
                 f"prompt length must be in [1, {self.prompt_slots}], "
@@ -258,9 +263,17 @@ class ServeEngine:
             # Seeds ride to the device as int32; reject here, not with an
             # OverflowError mid-tick after other requests are in flight.
             raise ValueError(f"seed must fit int32, got {seed}")
+        stops = [list(s) for s in (stop_sequences or [])]
+        if any(not s for s in stops):
+            raise ValueError("stop sequences must be non-empty")
+        if any(not isinstance(t, int) for s in stops for t in s):
+            # A str slips through list() as 1-char strings that can never
+            # equal int tokens: reject malformed stops up front.
+            raise ValueError("stop sequences must contain int token ids")
         req = Request(
             id=self._next_id, prompt=list(prompt), max_new=budget,
             seed=self._next_id if seed is None else seed,
+            stop_sequences=stops,
         )
         self._next_id += 1
         self._queue.append(req)
@@ -299,6 +312,10 @@ class ServeEngine:
         req.tokens.append(token)
         if self.eos_token is not None and token == self.eos_token:
             req.done, req.finish_reason = True, "eos"
+        elif any(
+            req.tokens[-len(s):] == s for s in req.stop_sequences
+        ):
+            req.done, req.finish_reason = True, "stop"
         elif len(req.tokens) >= req.max_new:
             req.done, req.finish_reason = True, "budget"
         if req.done:
