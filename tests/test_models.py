@@ -122,3 +122,30 @@ class TestServeFamily:
 
         with pytest.raises(ValueError, match="unknown model family"):
             serve_family("nope")
+
+
+def test_validate_cli_serve_flag(capsys):
+    """--family NAME --serve probes the serving half; JSON report, exit
+    code mirrors ok; --train is refused alongside it."""
+    import json
+
+    from tpu_dra.parallel.validate import main
+
+    rc = main(["--family", "dense", "--serve"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and out["ok"] and out["family"] == "dense"
+    assert out["tokens_per_second"] > 0
+
+    rc = main(["--family", "long_context", "--serve"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1 and not out["ok"]
+    assert "context parallelism" in out["error"]
+
+    rc = main(["--family", "dense", "--serve", "--train", "3"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1 and "mutually exclusive" in out["error"]
+
+    rc = main(["--serve"])
+    out = json.loads(capsys.readouterr().out.strip())
+    # No --family: the error arrives in the suite report shape.
+    assert rc == 1 and any("requires --family" in e for e in out["errors"])

@@ -256,16 +256,22 @@ def _compact(r: CollectiveReport) -> dict:
 
 def main(argv: "list[str] | None" = None) -> int:
     """CLI: ``python -m tpu_dra.parallel.validate [topology] [--train N]
-    [--family NAME]``.
+    [--family NAME [--serve]]``.
 
     ``--family`` runs one named workload family (tpu_dra/models: dense /
     long_context / moe / flash / pipelined) instead of the full acceptance
     suite — the operator's "will MY job shape run on this slice" probe.
+    ``--serve`` probes the family's SERVING half (health-checked KV-cache
+    generation, models.serve_family) instead of its training step.
     """
     argv = sys.argv[1:] if argv is None else argv
     train_steps = 0
     train_given = False
     family = None
+    serve = False
+    if "--serve" in argv:
+        argv = [a for a in argv if a != "--serve"]
+        serve = True
     if "--family" in argv:
         i = argv.index("--family")
         family = argv[i + 1] if i + 1 < len(argv) else ""
@@ -297,8 +303,10 @@ def main(argv: "list[str] | None" = None) -> int:
             return arg_error(f"--train must be >= 0, got {train_steps}")
         train_given = True
         argv = argv[:i] + argv[i + 2 :]
+    if serve and family is None:
+        return arg_error("--serve requires --family NAME")
     if family is not None:
-        from tpu_dra.models import FAMILIES, train_family
+        from tpu_dra.models import FAMILIES, serve_family, train_family
 
         def family_report(extra: dict) -> str:
             return json.dumps(
@@ -317,11 +325,20 @@ def main(argv: "list[str] | None" = None) -> int:
             return arg_error(
                 f"unknown family; choose from {sorted(FAMILIES)}"
             )
-        if train_given and train_steps == 0:
+        if serve and train_given:
+            return arg_error(
+                "--serve and --train are mutually exclusive (one probe, "
+                "one half of the workload)"
+            )
+        if not serve and train_given and train_steps == 0:
             # Suite mode's 0 means "skip training"; a family probe IS
             # training, so honor the letter of the request by refusing it
             # rather than silently running burnin.train's 2-step minimum.
-            return arg_error("--family requires --train >= 1 (it only trains)")
+            return arg_error(
+                "--family with --train requires --train >= 1 (a training "
+                "probe always trains; to probe the serving half instead, "
+                "use --family NAME --serve)"
+            )
         # Multi-host gang pods: join the distributed system from the
         # driver-injected env BEFORE touching jax.devices(), exactly as
         # the suite path does — otherwise the probe would silently cover
@@ -332,8 +349,11 @@ def main(argv: "list[str] | None" = None) -> int:
             gang = initialize_gang()
         except Exception as e:
             return arg_error(f"gang initialization failed: {type(e).__name__}: {e}")
-        kwargs = {"steps": train_steps} if train_given else {}
-        r = train_family(family, **kwargs)
+        if serve:
+            r = serve_family(family)
+        else:
+            kwargs = {"steps": train_steps} if train_given else {}
+            r = train_family(family, **kwargs)
         extra = asdict(r)
         if gang is not None:
             extra["gang"] = {"rank": gang.rank, "size": gang.size}
