@@ -283,6 +283,25 @@ class TestChunkedPrefill:
             np.asarray(toks[:, :8]), np.asarray(prompt)
         )
 
+    def test_padded_chunked_prefill_token_exact(self):
+        """Chunked padded prefill: each row's last-real logits are
+        captured from whichever window covers lens[b]-1 — token-exact vs
+        the one-shot padded pipeline for every chunk size, with lens
+        spanning first/middle/last windows."""
+        from tpu_dra.parallel.decode import make_generate_padded
+
+        p = init_params(TINY)
+        prompt = seeded_prompt(TINY, TINY.batch, 8)
+        lens = jnp.array([1, 3, 6, 8], jnp.int32)
+        one = make_generate_padded(TINY, prompt_slots=8, steps=4)(
+            p, prompt, lens
+        )
+        for chunk in (2, 4, 8):
+            got = make_generate_padded(
+                TINY, prompt_slots=8, steps=4, prefill_chunk=chunk
+            )(p, prompt, lens)
+            np.testing.assert_array_equal(np.asarray(one), np.asarray(got))
+
     def test_mesh_chunked_prefill_logits_ulp_close(self):
         """On a mesh, chunked vs one-shot prefill differ only by sharded
         reduction tiling: logits match to the repo-wide bf16 tolerance

@@ -611,41 +611,18 @@ def _jit_sharded(run, mesh, c, sampled, extra_shardings, quantized=False,
 
 def _build_prefill(c: BurninConfig, mesh, prompt_len: int,
                    prefill_chunk: "int | None"):
-    """Returns ``prefill(params, prompt, cache) -> (last_logits, cache)``
-    — one-shot or scanned-window (chunked) prefill, shared by
-    `make_generate` and `make_prefill`."""
-    import jax
+    """Uniform-length prefill — the ``lens == prompt_len`` special case
+    of `_build_prefill_padded` (one window loop to maintain, not two):
+    returns ``prefill(params, prompt, cache) -> (last_logits, cache)``,
+    shared by `make_generate`, `make_prefill`, and the speculative
+    decoder."""
     import jax.numpy as jnp
 
+    padded = _build_prefill_padded(c, mesh, prompt_len, prefill_chunk)
+
     def prefill(params, prompt, cache):
-        if prefill_chunk is None or prefill_chunk == prompt_len:
-            logits, cache = decode_forward(params, prompt, cache, 0, c, mesh)
-            return logits[:, -1], cache
-        nchunks = prompt_len // prefill_chunk
-        # (B, P) -> (nchunks, B, C): scan iterates windows in order.
-        windows = prompt.reshape(
-            prompt.shape[0], nchunks, prefill_chunk
-        ).transpose(1, 0, 2)
-
-        def one_window(carry, xs):
-            cache, _ = carry
-            window, i = xs
-            logits, cache = decode_forward(
-                params, window, cache, i * prefill_chunk, c, mesh
-            )
-            # Last-position logits ride the CARRY (only the final
-            # window's survive) — stacking them as scan ys would
-            # materialize an (nchunks, B, vocab) buffer, defeating the
-            # bounded-activation point of chunking.
-            return (cache, logits[:, -1]), None
-
-        seed = jnp.zeros((prompt.shape[0], c.vocab), jnp.float32)
-        (cache, last), _ = jax.lax.scan(
-            one_window,
-            (cache, seed),
-            (windows, jnp.arange(nchunks, dtype=jnp.int32)),
-        )
-        return last, cache
+        lens = jnp.full((prompt.shape[0],), prompt_len, jnp.int32)
+        return padded(params, prompt, lens, cache)
 
     return prefill
 
@@ -683,13 +660,60 @@ def _token_loop(params, cache, last_logits, pos0, keys, pick, c, mesh):
     return toks, last, fin
 
 
+def _build_prefill_padded(c: BurninConfig, mesh, prompt_slots: int,
+                          prefill_chunk: "int | None"):
+    """Padded-batch prefill, one-shot or chunked: returns
+    ``prefill(params, prompt, lens_c, cache) -> (last (B, vocab), cache)``
+    where ``last`` is each row's logits at its OWN last real position
+    ``lens_c[b] - 1``.  The chunked path captures that row's logits in
+    whichever window covers the position (a per-row select riding the
+    scan carry — never the full (S, V) buffer)."""
+    import jax
+    import jax.numpy as jnp
+
+    def prefill(params, prompt, lens_c, cache):
+        if prefill_chunk is None or prefill_chunk == prompt_slots:
+            logits, cache = decode_forward(params, prompt, cache, 0, c, mesh)
+            last = jnp.take_along_axis(
+                logits, (lens_c - 1)[:, None, None], axis=1
+            )[:, 0]
+            return last, cache
+        C = prefill_chunk
+        nchunks = prompt_slots // C
+        windows = prompt.reshape(
+            prompt.shape[0], nchunks, C
+        ).transpose(1, 0, 2)
+
+        def one_window(carry, xs):
+            cache, last = carry
+            window, i = xs
+            logits, cache = decode_forward(params, window, cache, i * C, c, mesh)
+            off = lens_c - 1 - i * C  # row's last real pos, window-relative
+            cand = jnp.take_along_axis(
+                logits, jnp.clip(off, 0, C - 1)[:, None, None], axis=1
+            )[:, 0]
+            hit = (off >= 0) & (off < C)
+            last = jnp.where(hit[:, None], cand, last)
+            return (cache, last), None
+
+        seed = jnp.zeros((prompt.shape[0], c.vocab), jnp.float32)
+        (cache, last), _ = jax.lax.scan(
+            one_window,
+            (cache, seed),
+            (windows, jnp.arange(nchunks, dtype=jnp.int32)),
+        )
+        return last, cache
+
+    return prefill
+
+
 def _check_chunk(c: BurninConfig, prompt_len: int,
-                 prefill_chunk: "int | None") -> None:
+                 prefill_chunk: "int | None", name: str = "prompt_len") -> None:
     if prefill_chunk is not None and (
         prefill_chunk < 1 or prompt_len % prefill_chunk != 0
     ):
         raise ValueError(
-            f"prefill_chunk must divide prompt_len, got "
+            f"prefill_chunk must divide {name}, got "
             f"{prefill_chunk} vs {prompt_len}"
         )
     if prefill_chunk is not None and prefill_chunk != prompt_len and c.moe_experts > 0:
@@ -902,6 +926,7 @@ def make_generate_padded(
     with_health: bool = False,
     quantized: bool = False,
     kv_int8: bool = False,
+    prefill_chunk: "int | None" = None,
 ):
     """Variable-length serving: build the jitted
     ``fn(params, prompt (B, prompt_slots), lens (B,)[, key]) ->
@@ -940,9 +965,11 @@ def make_generate_padded(
     c = config
     _validate(c)
     _check_window(c, prompt_slots, steps, "prompt_slots")
+    _check_chunk(c, prompt_slots, prefill_chunk, "prompt_slots")
     sampled = temperature > 0.0
     _validate_filters(c.vocab, sampled, top_k, top_p)
     pick = _make_pick(sampled, temperature, top_k, top_p)
+    prefill = _build_prefill_padded(c, mesh, prompt_slots, prefill_chunk)
 
     def run(params, prompt, lens, key=None):
         if sampled and key is None:
@@ -952,11 +979,8 @@ def make_generate_padded(
         in_contract = (lens >= 1) & (lens <= prompt_slots)
         lens_c = jnp.clip(lens, 1, prompt_slots)
         cache = _fresh_cache(c, prompt.shape[0], mesh, kv_int8)
-        logits, cache = decode_forward(params, prompt, cache, 0, c, mesh)
         # Row b's next token comes from its LAST REAL position, lens[b]-1.
-        last = jnp.take_along_axis(
-            logits, (lens_c - 1)[:, None, None], axis=1
-        )[:, 0]
+        last, cache = prefill(params, prompt, lens_c, cache)
         keys = _make_keys(sampled, key, steps)
         tok = pick(last, keys[0])
         fin = jnp.isfinite(last).all() & in_contract.all()
