@@ -331,3 +331,31 @@ class TestStopSequences:
             eng.submit([1], 2, stop_sequences=[[]])
         with pytest.raises(ValueError, match="int token ids"):
             eng.submit([1], 2, stop_sequences=["</s>"])
+
+
+class TestEngineChunkedPrefill:
+    def test_chunked_admissions_match_one_shot(self):
+        """prefill_chunk changes admission memory, never tokens: the
+        same stream through chunked and one-shot engines is identical
+        (greedy and sampled)."""
+        params = init_params(CFG)
+        reqs = [([5, 9, 2], 4), ([1, 2, 3, 4, 5, 6, 7], 3), ([8], 5)]
+
+        def run(chunk, temp):
+            eng = ServeEngine(
+                params, CFG, slots=2, prompt_slots=8, max_new_cap=5,
+                temperature=temp, prefill_chunk=chunk,
+            )
+            ids = [eng.submit(p, b, seed=i) for i, (p, b) in enumerate(reqs)]
+            done = {r.id: r for r in eng.run()}
+            return [tuple(done[i].tokens) for i in ids]
+
+        for temp in (0.0, 0.8):
+            assert run(None, temp) == run(4, temp) == run(2, temp)
+
+    def test_bad_chunk_rejected_at_build(self):
+        with pytest.raises(ValueError, match="must divide prompt_slots"):
+            ServeEngine(
+                init_params(CFG), CFG, slots=1, prompt_slots=8,
+                max_new_cap=2, prefill_chunk=3,
+            )

@@ -15,8 +15,11 @@ must run a FIXED-batch step executable forever while rows come and go.
 Three compiled functions, none ever retraced:
 
 - ``prefill1``: one request's padded prompt → a B=1 cache + last-real
-  logits (`decode_forward`; trailing pads are invisible to real prefill
-  queries by causality — the padded-batch tests pin this).
+  logits, via the shared padded prefill window loop
+  (`decode._build_prefill_padded`: one-shot by default, scanned C-token
+  windows with ``prefill_chunk`` — trailing pads are invisible to real
+  prefill queries by causality either way; the padded-batch and
+  chunked-admission tests pin this).
 - ``insert``:  write that B=1 cache into row ``r`` of the engine cache
   (traced row index — one executable for any row).
 - ``step``:    `decode_step_rows` — every row at its OWN position
@@ -55,10 +58,11 @@ from dataclasses import dataclass, field
 
 from tpu_dra.parallel.burnin import BurninConfig
 from tpu_dra.parallel.decode import (
+    _build_prefill_padded,
+    _check_chunk,
     _check_window,
     _make_pick,
     _validate_filters,
-    decode_forward,
     decode_step_rows,
     init_cache,
 )
@@ -104,6 +108,7 @@ class ServeEngine:
         temperature: float = 0.0,
         top_k: "int | None" = None,
         top_p: "float | None" = None,
+        prefill_chunk: "int | None" = None,
         kv_int8: bool = False,
         mesh=None,
     ):
@@ -118,6 +123,7 @@ class ServeEngine:
         if steps_per_tick < 1:
             raise ValueError(f"steps_per_tick must be >= 1, got {steps_per_tick}")
         _validate_filters(c.vocab, temperature > 0, top_k, top_p)
+        _check_chunk(c, prompt_slots, prefill_chunk, "prompt_slots")
         self.config = c
         self.params = params
         self.slots = slots
@@ -159,12 +165,14 @@ class ServeEngine:
         self._done: "list[Request]" = []
         self._next_id = 0
 
+        # Admission prefill: the shared padded window loop (one-shot when
+        # prefill_chunk is None) at B=1, so long prompts admit under the
+        # same bounded-activation budget the generate factories offer.
+        _prefill_one = _build_prefill_padded(c, mesh, prompt_slots, prefill_chunk)
+
         def prefill1(params, prompt, length):
             cache1 = init_cache(c, 1, kv_int8)
-            logits, cache1 = decode_forward(params, prompt, cache1, 0, c, mesh)
-            last = jnp.take_along_axis(
-                logits, (length - 1)[None, None, None], axis=1
-            )[:, 0]
+            last, cache1 = _prefill_one(params, prompt, length[None], cache1)
             return cache1, last
 
         def insert(cache, cache1, row):
