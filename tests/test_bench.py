@@ -101,8 +101,8 @@ class TestSalvageProtocol:
     def test_merge_promotes_same_build_ok_catch(self, tmp_path, monkeypatch):
         import bench
 
-        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
-        # Fingerprint is __file__-relative: compute it under the patch so
+        monkeypatch.setattr(bench, "REPO_DIR", str(tmp_path))
+        # Fingerprint is REPO_DIR-relative: compute it under the patch so
         # the catch and the merge agree on "same build".
         fp = bench._measurement_fingerprint()
         catch = {
@@ -133,7 +133,7 @@ class TestSalvageProtocol:
         catch = {"platform": "tpu", "ok": True, "fingerprint": "stale",
                  "mfu": 0.9}
         (tmp_path / ".tpu_catch_result.json").write_text(json.dumps(catch))
-        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        monkeypatch.setattr(bench, "REPO_DIR", str(tmp_path))
         live = {"platform": "cpu", "ok": True, "mfu": 0.0}
         merged = bench._merge_tpu_catch(dict(live))
         # A stale-build catch never impersonates the code under test.
