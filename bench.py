@@ -547,7 +547,7 @@ def bench_wire(samples: int = 8) -> "dict":
 def _seed_pythonpath(env: dict) -> dict:
     """Children inherit cwd, not this script-dir sys.path entry; seed
     PYTHONPATH so tpu_dra imports regardless of where bench runs."""
-    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    repo_dir = REPO_DIR
     env["PYTHONPATH"] = (
         repo_dir + os.pathsep + env["PYTHONPATH"]
         if env.get("PYTHONPATH")
@@ -1215,14 +1215,25 @@ def _probe_trail() -> "dict | None":
     capture effort, not an absence of data."""
     path = os.path.join(REPO_DIR, ".tpu_catch_history")
     try:
-        with open(path) as f:
+        # errors="replace": DOWN lines embed child stderr tails; a locale
+        # mismatch must degrade a byte, never sink the bench's one line.
+        with open(path, errors="replace") as f:
             lines = [ln.strip() for ln in f if ln.strip()]
-    except OSError:
+    except (OSError, ValueError):
         return None
     if not lines:
         return None
+    # The history is append-only across catcher RUNS; scope the trail to
+    # the CURRENT run (the suffix after the last "attempt=1" probe) so
+    # the artifact reports this hunt, not the concatenation of all prior
+    # rounds' hunts.
+    start = 0
+    for i, ln in enumerate(lines):
+        if ln.startswith("PROBING attempt=1 "):
+            start = i
+    run = lines[start:]
     counts: "dict[str, int]" = {}
-    for ln in lines:
+    for ln in run:
         state = ln.split(" ", 1)[0]
         counts[state] = counts.get(state, 0) + 1
     # Each attempt logs PROBING and then exactly one terminal state
@@ -1234,8 +1245,9 @@ def _probe_trail() -> "dict | None":
             v for k, v in counts.items() if k not in ("PROBING", "GAVE-UP")
         ),
         "states": counts,
-        "first": lines[0],
-        "last": lines[-1],
+        "first": run[0],
+        "last": run[-1],
+        "history_lines_total": len(lines),
     }
 
 

@@ -171,3 +171,55 @@ class TestSalvageProtocol:
         assert none_score == cpu == (0, 0, 0, 0)
         assert cpu < fresh_partial < fresh_ok
         assert stale_full < fresh_partial
+
+
+class TestProbeTrail:
+    """_probe_trail: the artifact-of-record evidence summary of the
+    tunnel hunt — current-run scoping, attempt counting, robustness."""
+
+    def _write(self, tmp_path, monkeypatch, lines):
+        import bench
+
+        monkeypatch.setattr(bench, "REPO_DIR", str(tmp_path))
+        (tmp_path / ".tpu_catch_history").write_text(
+            "".join(ln + "\n" for ln in lines)
+        )
+        return bench._probe_trail()
+
+    def test_counts_terminal_states_only(self, tmp_path, monkeypatch):
+        t = self._write(tmp_path, monkeypatch, [
+            "PROBING attempt=1 T1", "DOWN attempt=1 T1",
+            "PROBING attempt=2 T2", "MISSED attempt=2 T2",
+            "PROBING attempt=3 T3", "CAUGHT attempt=3 T3",
+            "PROBING attempt=4 T4",  # in-flight
+        ])
+        assert t["attempts"] == 3
+        assert t["states"]["CAUGHT"] == 1
+
+    def test_scoped_to_current_run(self, tmp_path, monkeypatch):
+        """A restart (a later 'attempt=1' probe) starts a fresh trail:
+        prior runs' lines are excluded from the counts but reflected in
+        history_lines_total."""
+        t = self._write(tmp_path, monkeypatch, [
+            "PROBING attempt=1 OLD", "DOWN attempt=1 OLD",
+            "GAVE-UP attempts=1 OLD",
+            "PROBING attempt=1 NEW", "DOWN attempt=1 NEW",
+            "PROBING attempt=2 NEW", "DOWN attempt=2 NEW",
+        ])
+        assert t["attempts"] == 2
+        assert t["first"].endswith("NEW")
+        assert t["history_lines_total"] == 7
+
+    def test_gave_up_not_an_attempt(self, tmp_path, monkeypatch):
+        t = self._write(tmp_path, monkeypatch, [
+            "PROBING attempt=1 T", "DOWN attempt=1 T", "GAVE-UP attempts=1 T",
+        ])
+        assert t["attempts"] == 1
+
+    def test_missing_or_empty_history_is_none(self, tmp_path, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(bench, "REPO_DIR", str(tmp_path))
+        assert bench._probe_trail() is None
+        (tmp_path / ".tpu_catch_history").write_text("")
+        assert bench._probe_trail() is None

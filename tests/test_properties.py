@@ -1,0 +1,147 @@
+"""Property-based tests (hypothesis) for the serving-stack primitives:
+invariants that must hold for ALL inputs, not just the examples the
+unit tests pick — sampling-filter support laws, quantization error
+bounds, schedule shape, and the acceptance/residual probability axioms.
+
+Settings: deadline disabled (jit compile time would trip it) and a
+bounded example count — these run in the fast suite."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from tpu_dra.parallel.burnin import BurninConfig, schedule_lr
+from tpu_dra.parallel.decode import filter_logits
+from tpu_dra.parallel.quant import dequantize, quantize_tensor
+from tpu_dra.parallel.speculative import acceptance_flags, residual_sample
+
+COMMON = settings(deadline=None, max_examples=12)
+
+
+def _logits(rows: int, vocab: int, seed: int):
+    return jax.random.normal(
+        jax.random.PRNGKey(seed), (rows, vocab), jnp.float32
+    ) * 3.0
+
+
+class TestFilterLogitsProperties:
+    @COMMON
+    @given(
+        vocab=st.integers(4, 64),
+        k=st.integers(1, 64),
+        seed=st.integers(0, 2**16),
+    )
+    def test_top_k_support_exactly_min_k_vocab(self, vocab, k, seed):
+        if k > vocab:
+            k = vocab
+        f = np.asarray(filter_logits(_logits(3, vocab, seed), top_k=k))
+        assert (np.isfinite(f).sum(-1) == k).all()
+
+    @COMMON
+    @given(
+        vocab=st.integers(4, 64),
+        p=st.floats(0.01, 1.0),
+        seed=st.integers(0, 2**16),
+    )
+    def test_top_p_keeps_argmax_and_nonempty(self, vocab, p, seed):
+        logits = _logits(3, vocab, seed)
+        f = np.asarray(filter_logits(logits, top_p=p))
+        fin = np.isfinite(f)
+        assert (fin.sum(-1) >= 1).all()
+        np.testing.assert_array_equal(
+            np.argmax(f, -1), np.argmax(np.asarray(logits), -1)
+        )
+
+    @COMMON
+    @given(
+        vocab=st.integers(4, 32),
+        k=st.integers(1, 32),
+        p=st.floats(0.05, 1.0),
+        seed=st.integers(0, 2**16),
+    )
+    def test_composed_support_is_intersection(self, vocab, k, p, seed):
+        if k > vocab:
+            k = vocab
+        logits = _logits(2, vocab, seed)
+        both = np.isfinite(np.asarray(filter_logits(logits, top_k=k, top_p=p)))
+        only_k = np.isfinite(np.asarray(filter_logits(logits, top_k=k)))
+        only_p = np.isfinite(np.asarray(filter_logits(logits, top_p=p)))
+        np.testing.assert_array_equal(both, only_k & only_p)
+
+
+class TestQuantizeProperties:
+    @COMMON
+    @given(
+        rows=st.integers(1, 8),
+        cols=st.integers(1, 64),
+        scale=st.floats(1e-3, 1e3),
+        seed=st.integers(0, 2**16),
+    )
+    def test_roundtrip_error_within_half_step(self, rows, cols, scale, seed):
+        w = (
+            jax.random.normal(jax.random.PRNGKey(seed), (rows, cols))
+            * scale
+        )
+        back = dequantize(quantize_tensor(w, (1,)))
+        step = np.abs(np.asarray(w)).max(axis=1, keepdims=True) / 127.0
+        err = np.abs(np.asarray(back) - np.asarray(w))
+        assert (err <= step / 2 + 1e-6 * scale).all()
+
+    @COMMON
+    @given(rows=st.integers(1, 6), cols=st.integers(1, 32))
+    def test_zero_rows_roundtrip_to_zero(self, rows, cols):
+        w = jnp.zeros((rows, cols))
+        leaf = quantize_tensor(w, (1,))
+        assert (np.asarray(dequantize(leaf)) == 0).all()
+        assert np.isfinite(np.asarray(leaf["s"])).all()
+
+
+class TestScheduleProperties:
+    @COMMON
+    @given(
+        warmup=st.integers(0, 20),
+        extra=st.integers(1, 50),
+        lr=st.floats(1e-5, 10.0),
+    )
+    def test_cosine_bounded_and_decaying_after_warmup(self, warmup, extra, lr):
+        c = BurninConfig(
+            optimizer="adamw", learning_rate=lr, lr_schedule="cosine",
+            warmup_steps=warmup, total_steps=warmup + extra,
+        )
+        lrs = [float(schedule_lr(c, t)) for t in range(warmup + extra + 1)]
+        assert all(0.0 <= v <= lr * (1 + 1e-6) for v in lrs)
+        post = lrs[warmup:]
+        assert all(a >= b - 1e-9 for a, b in zip(post, post[1:]))
+        assert lrs[-1] < 1e-6 * lr + 1e-12  # decayed out at total_steps
+
+
+class TestSpeculativeProbabilityAxioms:
+    @COMMON
+    @given(vocab=st.integers(2, 16), seed=st.integers(0, 2**16))
+    def test_identical_distributions_accept_certainly(self, vocab, seed):
+        tl = _logits(4, vocab, seed)
+        toks = jnp.argmax(tl, -1).astype(jnp.int32)
+        u = jax.random.uniform(jax.random.PRNGKey(seed + 1), (4,))
+        assert bool(acceptance_flags(u, tl, tl, toks).all())
+
+    @COMMON
+    @given(vocab=st.integers(3, 16), seed=st.integers(0, 2**16))
+    def test_residual_tokens_are_target_favored(self, vocab, seed):
+        """Every residual-sampled token must have p_target > p_draft:
+        the residual distribution is supported exactly where the target
+        out-weighs the draft."""
+        from jax.nn import softmax
+
+        tl = _logits(1, vocab, seed)[0]
+        ql = _logits(1, vocab, seed + 7)[0]
+        toks = np.asarray(
+            residual_sample(
+                jax.random.PRNGKey(seed + 3),
+                jnp.tile(tl, (256, 1)), jnp.tile(ql, (256, 1)),
+            )
+        )
+        p = np.asarray(softmax(tl))
+        q = np.asarray(softmax(ql))
+        assert (p[toks] > q[toks] - 1e-7).all()
