@@ -38,6 +38,10 @@ slice:
   autoregressive generation (`lax.scan` token loop compiled once, masked
   full-buffer attention, per-step dropless MoE routing), sharded with the
   training layout minus the sequence axis.
+- ``tpu_dra.parallel.data``        — input pipeline: seeded synthetic
+  batch streams + depth-D device prefetch (async device_put overlaps
+  every host→device copy with compute; batches land pre-placed in the
+  training layout) and the stream-fed training loop.
 - ``tpu_dra.parallel.serve``       — continuous-batching engine: fixed
   -slot compiled decode step (`decode_step_rows` — every row at its own
   position), per-row request lifecycle (admit → prefill+insert → decode
@@ -87,6 +91,11 @@ from tpu_dra.parallel.decode import (
     serving_config,
 )
 from tpu_dra.parallel.quant import quantize_params
+from tpu_dra.parallel.data import (
+    prefetch_to_device,
+    synthetic_stream,
+    train_on_stream,
+)
 from tpu_dra.parallel.serve import Request, ServeEngine
 from tpu_dra.parallel.speculative import make_generate_speculative
 
@@ -111,11 +120,14 @@ __all__ = [
     "hierarchical_psum_check",
     "logical_mesh",
     "psum_bandwidth",
+    "prefetch_to_device",
     "psum_check",
     "quantize_params",
     "ring_check",
     "serving_config",
     "slice_mesh",
+    "synthetic_stream",
     "topology_from_env",
+    "train_on_stream",
     "validate_slice",
 ]

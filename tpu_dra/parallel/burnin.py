@@ -902,19 +902,28 @@ def train(
             loss = float(jax.device_get(loss))
             times.append(time.perf_counter() - t0)
             losses.append(loss)
-        import statistics
-
-        p50 = statistics.median(times[1:])  # drop compile step
-        return TrainReport(
-            ok=losses[-1] < losses[0] and all(l == l for l in losses),  # NaN check
-            steps=len(losses),
-            loss_first=losses[0],
-            loss_last=losses[-1],
-            step_seconds_p50=p50,
-            tokens_per_second=c.batch * c.seq / p50 if p50 > 0 else 0.0,
-        )
+        return assemble_train_report(c, losses, times)
     except Exception as e:  # burn-in reports, never crashes the pod
         return TrainReport(
             ok=False, steps=0, loss_first=0.0, loss_last=0.0,
             step_seconds_p50=0.0, tokens_per_second=0.0, error=f"{type(e).__name__}: {e}",
         )
+
+
+def assemble_train_report(
+    c: BurninConfig, losses: "list[float]", times: "list[float]"
+) -> TrainReport:
+    """The one report-assembly contract for every training loop (static
+    -batch `train`, stream-fed `data.train_on_stream`): loss descent +
+    NaN check, median step time with the compile step dropped."""
+    import statistics
+
+    p50 = statistics.median(times[1:])  # drop compile step
+    return TrainReport(
+        ok=losses[-1] < losses[0] and all(l == l for l in losses),  # NaN check
+        steps=len(losses),
+        loss_first=losses[0],
+        loss_last=losses[-1],
+        step_seconds_p50=p50,
+        tokens_per_second=c.batch * c.seq / p50 if p50 > 0 else 0.0,
+    )
