@@ -80,6 +80,11 @@ from tpu_dra.parallel.collectives import (
 )
 from tpu_dra.parallel.validate import SliceReport, validate_slice
 from tpu_dra.parallel.burnin import BurninConfig, TrainReport, train
+from tpu_dra.parallel.data import (
+    prefetch_to_device,
+    synthetic_stream,
+    train_on_stream,
+)
 from tpu_dra.parallel.decode import (
     expand_cache,
     filter_logits,
@@ -91,11 +96,6 @@ from tpu_dra.parallel.decode import (
     serving_config,
 )
 from tpu_dra.parallel.quant import quantize_params
-from tpu_dra.parallel.data import (
-    prefetch_to_device,
-    synthetic_stream,
-    train_on_stream,
-)
 from tpu_dra.parallel.serve import Request, ServeEngine
 from tpu_dra.parallel.speculative import make_generate_speculative
 
@@ -119,8 +119,8 @@ __all__ = [
     "hierarchical_psum",
     "hierarchical_psum_check",
     "logical_mesh",
-    "psum_bandwidth",
     "prefetch_to_device",
+    "psum_bandwidth",
     "psum_check",
     "quantize_params",
     "ring_check",

@@ -58,6 +58,7 @@ __all__ = [
     "param_specs",
     "forward",
     "make_train_step",
+    "rope_rotate",
     "train",
     "TrainReport",
 ]
@@ -106,6 +107,14 @@ class BurninConfig:
     # Global-norm gradient clipping; 0 disables.  Stateless — applies to
     # both optimizer families.
     grad_clip_norm: float = 0.0
+    # Rotary position embeddings (GPT-NeoX split-half convention): q/k
+    # rotated by absolute position inside every attention, the additive
+    # learned position table skipped.  Supported wherever cache slot ==
+    # sequence position (dense/tp/flash/moe/pp training; uniform decode,
+    # per-row engine decode, prefix caching, speculative).  Rejected for
+    # context parallelism (per-shard offsets not wired) and the padded
+    # decode factory (its decode slots are not logical positions).
+    rope: bool = False
     # LR schedule, adamw only (its state carries the step counter):
     # "constant", or "cosine" (linear warmup over warmup_steps, cosine
     # decay to zero at total_steps).
@@ -348,7 +357,49 @@ def _rms_norm(x, scale):
     return (x / rms) * scale
 
 
-def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
+def rope_tables(positions, d_head: int, *, base: float = 10000.0):
+    """Precomputed RoPE cos/sin tables for ``positions`` ((S,) or
+    (..., S)) at head dim ``d_head`` (even).  Compute ONCE per step and
+    reuse across layers — the tables are position-only, and `_block`
+    sits under `jax.checkpoint`, which would otherwise rebuild them per
+    layer in both forward and the rematerialized backward."""
+    import jax.numpy as jnp
+
+    if d_head % 2 != 0:
+        raise ValueError(f"rope needs an even d_head, got {d_head}")
+    half = d_head // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions[..., None].astype(jnp.float32) * freqs  # (..., S, half)
+    # Broadcast over heads: (..., S, 1, half).
+    return jnp.cos(ang)[..., None, :], jnp.sin(ang)[..., None, :]
+
+
+def rope_apply(x, tables):
+    """Rotate ``x`` (..., S, H, K) by precomputed `rope_tables`."""
+    import jax.numpy as jnp
+
+    cos, sin = tables
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+def rope_rotate(x, positions, *, base: float = 10000.0):
+    """Rotary position embedding, GPT-NeoX split-half convention — the
+    one-shot form of `rope_tables` + `rope_apply` (decode paths use it:
+    one position set per call, nothing to share across layers).
+
+    Relative-position attention without any learned table, and
+    cache-friendly: a rotated K stored at its position never needs
+    re-rotation at read time."""
+    return rope_apply(x, rope_tables(positions, x.shape[-1], base=base))
+
+
+def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None,
+           rope_tab=None):
     """One pre-norm transformer block.  ``constrain(kind, arr)`` applies the
     sp/tp sharding constraints; identity when running unsharded.  With
     ``ring_mesh`` set (and a cp flavor enabled), attention runs
@@ -401,6 +452,9 @@ def _block(layer, x, *, config: BurninConfig, constrain, ring_mesh=None):
         h = constrain("hidden", h.astype(bf16))  # gather seq, enter tp region
         qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(bf16))
         q, k_, v = qkv[0], qkv[1], qkv[2]
+        if c.rope:
+            q = rope_apply(q, rope_tab)
+            k_ = rope_apply(k_, rope_tab)
         if c.flash_attention:
             # Pallas kernel: O(block) scores, never an (s, s) tensor.  On a
             # mesh, heads are tp-sharded over "model" and attention is
@@ -495,6 +549,12 @@ def forward(params, tokens, config: BurninConfig, mesh=None, *, return_aux=False
             "ring_attention and ulysses_attention are two flavors of the "
             "same context parallelism; pick one"
         )
+    if c.rope and c.context_parallel:
+        raise ValueError(
+            "rope is not supported with context parallelism: each "
+            "sequence shard would need its global position offset wired "
+            "through the ring/a2a paths"
+        )
     if c.ring_attention and c.flash_attention:
         raise ValueError(
             "ring_attention and flash_attention are mutually exclusive "
@@ -549,14 +609,22 @@ def forward(params, tokens, config: BurninConfig, mesh=None, *, return_aux=False
     # sequence-sharded layout: the residual stream is never whole on one
     # chip (inside attention, Ulysses temporarily holds the full sequence
     # for H/P heads — the ring never does).
-    x = constrain(
-        "seq" if c.context_parallel else "hidden",
-        params["embed"][tokens] + params["pos"][None, :, :],
-    )
+    emb = params["embed"][tokens]
+    if not c.rope:
+        # RoPE replaces the additive table (kept in the param tree for
+        # shape stability; rotation happens inside each attention).
+        emb = emb + params["pos"][None, :, :]
+    x = constrain("seq" if c.context_parallel else "hidden", emb)
 
+    rope_tab = (
+        rope_tables(jnp.arange(tokens.shape[1], dtype=jnp.int32), c.d_head)
+        if c.rope
+        else None
+    )
     block = jax.checkpoint(
         functools.partial(
-            _block, config=c, constrain=constrain, ring_mesh=mesh
+            _block, config=c, constrain=constrain, ring_mesh=mesh,
+            rope_tab=rope_tab,
         )
     )
 

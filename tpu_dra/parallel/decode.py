@@ -59,6 +59,7 @@ from tpu_dra.parallel.burnin import (
     _rms_norm,
     make_constrain,
     param_specs,
+    rope_rotate,
 )
 
 __all__ = [
@@ -117,6 +118,17 @@ def serving_config(config: BurninConfig) -> BurninConfig:
         ulysses_attention=False,
         pipeline_stages=0,
     )
+
+
+def _reject_rope_padded(c: BurninConfig) -> None:
+    if c.rope:
+        raise ValueError(
+            "rope is not supported on the padded decode path: its decode "
+            "steps write slot prompt_slots + t while the token's logical "
+            "position is lens[b] + t, and rope keys on logical position "
+            "— serve mixed-length rope requests with the continuous "
+            "-batching engine (contiguous rows: slot == position)"
+        )
 
 
 def _validate(config: BurninConfig) -> None:
@@ -256,6 +268,19 @@ def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain,
     h = constrain("hidden", h.astype(bf16))
     qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(bf16))
     q, k_new, v_new = qkv[0], qkv[1], qkv[2]
+    if c.rope:
+        # Positions of the S incoming tokens: slot == sequence position
+        # on every rope-supported decode path — uniform scalar p0, or
+        # per-row (B,) p0 with S == 1 (a per-row p0 with S > 1 cannot
+        # reach here: _cache_update rejects it at trace time).  Rotated
+        # K goes INTO the cache, so reads never re-rotate — same
+        # convention as training.
+        if getattr(p0, "ndim", 0) >= 1:
+            positions = p0[:, None]  # (B, 1)
+        else:
+            positions = p0 + jnp.arange(q.shape[1], dtype=jnp.int32)
+        q = rope_rotate(q, positions)
+        k_new = rope_rotate(k_new, positions)
 
     ck = _cache_update(ck, k_new, p0)
     cv = _cache_update(cv, v_new, p0)
@@ -364,8 +389,11 @@ def decode_forward(params, tokens, cache, p0, config: BurninConfig, mesh=None):
     S = tokens.shape[1]
     T = _cache_len(cache)
 
-    pos_emb = jax.lax.dynamic_slice_in_dim(params["pos"], p0, S, axis=0)
-    x = constrain("hidden", _embed_lookup(params["embed"], tokens) + pos_emb[None, :, :])
+    x = _embed_lookup(params["embed"], tokens)
+    if not c.rope:
+        pos_emb = jax.lax.dynamic_slice_in_dim(params["pos"], p0, S, axis=0)
+        x = x + pos_emb[None, :, :]
+    x = constrain("hidden", x)
 
     # Query at slice offset i sits at absolute position p0 + i: it may see
     # cache entries j <= p0 + i.  Everything later — including the zeroed
@@ -389,6 +417,7 @@ def decode_step_padded(params, tok, cache, lens, prompt_slots, t,
 
     c = config
     _validate(c)
+    _reject_rope_padded(c)
     constrain = _make_constrain(mesh)
     T = _cache_len(cache)
 
@@ -425,10 +454,10 @@ def decode_step_rows(params, tok, cache, pos, config: BurninConfig, mesh=None):
     constrain = _make_constrain(mesh)
     T = _cache_len(cache)
 
-    pos_emb = params["pos"][pos]  # (B, d): per-row
-    x = constrain(
-        "hidden", _embed_lookup(params["embed"], tok)[:, None, :] + pos_emb[:, None, :]
-    )
+    x = _embed_lookup(params["embed"], tok)[:, None, :]
+    if not c.rope:
+        x = x + params["pos"][pos][:, None, :]  # (B, 1, d): per-row
+    x = constrain("hidden", x)
     slots = jnp.arange(T)[None, :]  # (1, T)
     mask = (slots <= pos[:, None])[:, None, None, :]  # (B, 1, 1, T)
     logits, cache = _run_blocks(params, x, cache, pos, mask, c, constrain)
@@ -966,6 +995,7 @@ def make_generate_padded(
     _validate(c)
     _check_window(c, prompt_slots, steps, "prompt_slots")
     _check_chunk(c, prompt_slots, prefill_chunk, "prompt_slots")
+    _reject_rope_padded(c)
     sampled = temperature > 0.0
     _validate_filters(c.vocab, sampled, top_k, top_p)
     pick = _make_pick(sampled, temperature, top_k, top_p)
