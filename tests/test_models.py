@@ -85,7 +85,7 @@ class TestServeFamily:
     """serve_family: the inference half of slice acceptance — a claimed
     slice is certified for training AND serving."""
 
-    @pytest.mark.parametrize("name", ["dense", "flash", "moe"])
+    @pytest.mark.parametrize("name", ["dense", "flash", "moe", "rope"])
     def test_servable_families_serve_healthy(self, name):
         from tpu_dra.models import serve_family
 

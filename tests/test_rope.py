@@ -186,3 +186,21 @@ class TestRopeServingStack:
         np.testing.assert_array_equal(
             np.asarray(want), np.asarray(done[rid].tokens)
         )
+
+
+class TestRopePipeline:
+    @pytest.mark.slow
+    def test_rope_composes_with_pipeline(self):
+        """GPipe splits batch, never positions: one global table serves
+        every stage, and the pipelined rope model trains."""
+        import dataclasses
+
+        from tpu_dra.parallel.pipeline import pipeline_mesh
+
+        c = dataclasses.replace(
+            CFG, n_layers=4, seq=32, batch=8, pipeline_stages=2
+        )
+        mesh = pipeline_mesh(jax.devices(), stages=2, model=2)
+        r = train(c, mesh, steps=4)
+        assert r.ok, r.error
+        assert r.loss_last < r.loss_first

@@ -14,6 +14,8 @@ operators and tests speak in families, not raw config fields:
   sharded over the model axis, XLA-inserted all-to-all dispatch.
 - ``flash``        — the pallas flash-attention kernel on the hot path
   (single chip or tp-sharded heads).
+- ``rope``         — rotary position embeddings + the flash kernel: the
+  modern-model preset, training and serving.
 - ``pipelined``    — GPipe pipeline over a (data, pipe, model) mesh,
   composing pp with tp/sp/ep inside each stage.
 
@@ -67,6 +69,10 @@ FAMILIES: "dict[str, Callable[..., BurninConfig]]" = {
     # moe_mesh (family_mesh refuses indivisible device counts).
     "long_context_moe": _preset({"ring_attention": True, "moe_experts": 4}),
     "flash": _preset({"flash_attention": True}),
+    # The modern-model preset: rotary positions + the pallas flash
+    # kernel — trains AND serves (rope rides every slot==position
+    # decode path).
+    "rope": _preset({"rope": True, "flash_attention": True}),
     "pipelined": _preset({"pipeline_stages": 2, "moe_experts": 2}),
 }
 

@@ -59,7 +59,6 @@ from tpu_dra.parallel.burnin import (
     _rms_norm,
     make_constrain,
     param_specs,
-    rope_rotate,
 )
 
 __all__ = [
@@ -249,7 +248,7 @@ def _cache_read(cbuf):
 
 
 def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain,
-                  mask):
+                  mask, rope_tab=None):
     """One block over ``x`` (B, S, d) written to cache slots [p0, p0+S).
 
     Writes K/V into the cache slices ``ck``/``cv`` (B, T, H, K) at p0 and
@@ -269,18 +268,14 @@ def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain,
     qkv = jnp.einsum("bsd,dthk->tbshk", h, layer["wqkv"].astype(bf16))
     q, k_new, v_new = qkv[0], qkv[1], qkv[2]
     if c.rope:
-        # Positions of the S incoming tokens: slot == sequence position
-        # on every rope-supported decode path — uniform scalar p0, or
-        # per-row (B,) p0 with S == 1 (a per-row p0 with S > 1 cannot
-        # reach here: _cache_update rejects it at trace time).  Rotated
-        # K goes INTO the cache, so reads never re-rotate — same
-        # convention as training.
-        if getattr(p0, "ndim", 0) >= 1:
-            positions = p0[:, None]  # (B, 1)
-        else:
-            positions = p0 + jnp.arange(q.shape[1], dtype=jnp.int32)
-        q = rope_rotate(q, positions)
-        k_new = rope_rotate(k_new, positions)
+        from tpu_dra.parallel.burnin import rope_apply
+
+        # Tables hoisted by _run_blocks (position-only — computing them
+        # inside this per-layer scan body would rebuild them n_layers
+        # times per decode step).  Rotated K goes INTO the cache, so
+        # reads never re-rotate — same convention as training.
+        q = rope_apply(q, rope_tab)
+        k_new = rope_apply(k_new, rope_tab)
 
     ck = _cache_update(ck, k_new, p0)
     cv = _cache_update(cv, v_new, p0)
@@ -330,8 +325,22 @@ def _run_blocks(params, x, cache, p0, mask, config: BurninConfig, constrain):
 
     from tpu_dra.parallel.quant import dequantize
 
+    rope_tab = None
+    if config.rope:
+        from tpu_dra.parallel.burnin import rope_tables
+
+        # Positions of the S incoming tokens: slot == sequence position
+        # on every rope-supported decode path — uniform scalar p0, or
+        # per-row (B,) p0 with S == 1 (a per-row p0 with S > 1 cannot
+        # reach the cache write: _cache_update rejects it at trace time).
+        if getattr(p0, "ndim", 0) >= 1:
+            positions = p0[:, None]  # (B, 1)
+        else:
+            positions = p0 + jnp.arange(x.shape[1], dtype=jnp.int32)
+        rope_tab = rope_tables(positions, config.d_head)
     block = functools.partial(
-        _decode_block, config=config, constrain=constrain, mask=mask
+        _decode_block, config=config, constrain=constrain, mask=mask,
+        rope_tab=rope_tab,
     )
 
     def body(h, xs):

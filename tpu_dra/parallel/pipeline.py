@@ -96,7 +96,9 @@ def forward_pipelined(params, tokens, config, mesh):
             arr, NamedSharding(mesh, P("data", *([None] * (arr.ndim - 1))))
         )
 
-    x = params["embed"][tokens] + params["pos"][None, :, :]
+    x = params["embed"][tokens]
+    if not c.rope:
+        x = x + params["pos"][None, :, :]
     x = constrain_data(x)
     x, aux = _pipelined_blocks(params["layers"], x, config=c, mesh=mesh)
     x = constrain_data(x)
@@ -129,8 +131,22 @@ def _pipelined_blocks(layers, x, *, config, mesh):
     # pipeline mesh, and pipe is the manual axis).
     constrain = make_constrain(mesh, "data")
 
+    # RoPE: the sequence stays intact through every pipeline stage (GPipe
+    # splits batch into microbatches, never positions), so one global
+    # table serves every stage's blocks — hoisted exactly like the
+    # unpipelined forward.
+    rope_tab = None
+    if c.rope:
+        from tpu_dra.parallel.burnin import rope_tables
+
+        rope_tab = rope_tables(
+            jnp.arange(x.shape[1], dtype=jnp.int32), c.d_head
+        )
     block = jax.checkpoint(
-        functools.partial(_block, config=c, constrain=constrain, ring_mesh=None)
+        functools.partial(
+            _block, config=c, constrain=constrain, ring_mesh=None,
+            rope_tab=rope_tab,
+        )
     )
 
     def apply_stage(stage_layers, h):
