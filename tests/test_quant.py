@@ -353,3 +353,28 @@ class TestQuantSpecs:
         assert jax.tree_util.tree_structure(p) == jax.tree_util.tree_structure(
             specs
         )
+
+
+class TestQuantCheckpoint:
+    @pytest.mark.slow
+    def test_int8_tree_checkpoint_roundtrip(self, tmp_path):
+        """The deployment story: quantize once, save, load in every
+        serving replica — the {"q","s"} tree rides orbax like any other
+        pytree and serves identically after restore."""
+        import orbax.checkpoint as ocp
+
+        qp = quantize_params(init_params(TINY))
+        path = str(tmp_path / "q")
+        abstract = jax.eval_shape(lambda: qp)
+        with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+            ckptr.save(path, qp)
+            restored = ckptr.restore(path, abstract)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(qp), jax.tree_util.tree_leaves(restored)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        prompt = seeded_prompt(TINY, TINY.batch, 4)
+        fn = make_generate(TINY, prompt_len=4, steps=5, kv_int8=True)
+        np.testing.assert_array_equal(
+            np.asarray(fn(qp, prompt)), np.asarray(fn(restored, prompt))
+        )

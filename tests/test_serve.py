@@ -359,3 +359,33 @@ class TestEngineChunkedPrefill:
                 init_params(CFG), CFG, slots=1, prompt_slots=8,
                 max_new_cap=2, prefill_chunk=3,
             )
+
+
+class TestEngineSoak:
+    @pytest.mark.slow
+    def test_hundred_request_stream_drains_exactly(self):
+        """Soak: 100 mixed requests (lengths, budgets, seeds, stops)
+        through 4 slots — every request completes exactly once with a
+        budget-bounded output and its prompt-independent invariants."""
+        params = init_params(CFG)
+        eng = ServeEngine(
+            params, CFG, slots=4, prompt_slots=8, max_new_cap=6,
+            temperature=0.7, steps_per_tick=2,
+        )
+        rng = np.random.RandomState(42)
+        reqs = {}
+        for i in range(100):
+            plen = int(rng.randint(1, 9))
+            prompt = [int(x) for x in rng.randint(0, CFG.vocab, plen)]
+            budget = int(rng.randint(1, 7))
+            stops = [[int(rng.randint(0, CFG.vocab))]] if i % 7 == 0 else []
+            rid = eng.submit(prompt, budget, seed=i, stop_sequences=stops)
+            reqs[rid] = budget
+        done = eng.run(until_idle=50_000)
+        assert len(done) == 100
+        assert len({r.id for r in done}) == 100
+        for r in done:
+            assert 1 <= len(r.tokens) <= reqs[r.id]
+            assert r.finish_reason in ("budget", "stop", "eos")
+            assert all(0 <= t < CFG.vocab for t in r.tokens)
+        assert eng.pending == 0
