@@ -29,6 +29,14 @@ the TPU-native incremental path:
   two dominant HBM streams of the memory-bound decode step, ~3.5× and
   ~2× smaller respectively.
 
+Layered on this core (each with its own factory/flag, all composable):
+padded variable-length batches, chunked prefill (`prefill_chunk`),
+prefix caching (`make_prefill`/`make_generate_from_cache`), top-k/top-p
+sampling (`filter_logits`), RoPE (`BurninConfig.rope` — rotated K cached
+at insert), the int8 weight/KV stack (quant.py / ``kv_int8``), per-row
+engine decode (`decode_step_rows`, serve.py), and speculative decoding
+(speculative.py).  Usage guide: docs/SERVING.md.
+
 MoE configs are served with **per-step routing**: each generated token
 goes to its argmax expert with per-call capacity (``expert_capacity`` of
 the actual slice length), which for single-token steps can never drop a
