@@ -149,3 +149,11 @@ def test_validate_cli_serve_flag(capsys):
     out = json.loads(capsys.readouterr().out.strip())
     # No --family: the error arrives in the suite report shape.
     assert rc == 1 and any("requires --family" in e for e in out["errors"])
+
+    rc = main(["--family", "dense", "--serve", "--int8"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and out["ok"]
+
+    rc = main(["--family", "dense", "--int8"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1 and "requires --serve" in out["error"]

@@ -256,13 +256,15 @@ def _compact(r: CollectiveReport) -> dict:
 
 def main(argv: "list[str] | None" = None) -> int:
     """CLI: ``python -m tpu_dra.parallel.validate [topology] [--train N]
-    [--family NAME [--serve]]``.
+    [--family NAME [--serve [--int8]]]``.
 
     ``--family`` runs one named workload family (tpu_dra/models: dense /
     long_context / moe / flash / pipelined) instead of the full acceptance
     suite — the operator's "will MY job shape run on this slice" probe.
     ``--serve`` probes the family's SERVING half (health-checked KV-cache
-    generation, models.serve_family) instead of its training step.
+    generation, models.serve_family) instead of its training step;
+    ``--int8`` additionally serves the full int8 stack (quantized
+    weights + int8 KV cache).
     """
     argv = sys.argv[1:] if argv is None else argv
     train_steps = 0
@@ -272,6 +274,10 @@ def main(argv: "list[str] | None" = None) -> int:
     if "--serve" in argv:
         argv = [a for a in argv if a != "--serve"]
         serve = True
+    int8 = False
+    if "--int8" in argv:
+        argv = [a for a in argv if a != "--int8"]
+        int8 = True
     if "--family" in argv:
         i = argv.index("--family")
         family = argv[i + 1] if i + 1 < len(argv) else ""
@@ -305,6 +311,8 @@ def main(argv: "list[str] | None" = None) -> int:
         argv = argv[:i] + argv[i + 2 :]
     if serve and family is None:
         return arg_error("--serve requires --family NAME")
+    if int8 and not serve:
+        return arg_error("--int8 requires --serve (it configures the serving probe)")
     if family is not None:
         from tpu_dra.models import FAMILIES, serve_family, train_family
 
@@ -350,7 +358,7 @@ def main(argv: "list[str] | None" = None) -> int:
         except Exception as e:
             return arg_error(f"gang initialization failed: {type(e).__name__}: {e}")
         if serve:
-            r = serve_family(family)
+            r = serve_family(family, int8=int8)
         else:
             kwargs = {"steps": train_steps} if train_given else {}
             r = train_family(family, **kwargs)
