@@ -204,6 +204,14 @@ class ServeEngine:
         def step(params, cache, tok, pos, active, seeds):
             # steps_per_tick tokens for every row in ONE device call; the
             # per-step tokens come back for host-side finish decisions.
+            # A row that hits its budget mid-tick keeps stepping on
+            # device until the tick ends (active was snapshotted at tick
+            # start): its surplus tokens are discarded host-side, and in
+            # the worst case its position walks past the context end —
+            # benign because out-of-bounds scatter writes are DROPPED by
+            # jax semantics (and the row's state is reset at its next
+            # admission).  The soak test runs steps_per_tick=2 over 100
+            # requests to exercise exactly this lag.
             def one(carry, _):
                 cache, tok, pos = carry
                 logits, cache = decode_step_rows(params, tok, cache, pos, c, mesh)
