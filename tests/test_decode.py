@@ -600,3 +600,83 @@ class TestServingConfig:
             batch=4,
         )
         assert serving_config(c) == c
+
+
+class TestLogprobs:
+    def test_greedy_logprobs_match_full_forward_oracle(self):
+        """Each generated token's reported logprob equals the raw-model
+        log-softmax of the full forward at its producing position."""
+        from jax.nn import log_softmax
+
+        c = BurninConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=32,
+            batch=4,
+        )
+        params = init_params(c)
+        prompt = seeded_prompt(c, c.batch, 6)
+        toks, lps = make_generate(
+            c, prompt_len=6, steps=5, with_logprobs=True
+        )(params, prompt)
+        assert lps.shape == (c.batch, 5)
+        full = np.zeros((c.batch, c.seq), np.int32)
+        full[:, :11] = np.asarray(toks)
+        lg = forward(params, jnp.asarray(full), c)
+        for j in range(5):
+            want = jnp.take_along_axis(
+                log_softmax(lg[:, 5 + j].astype(jnp.float32)),
+                toks[:, 6 + j][:, None], 1,
+            )[:, 0]
+            np.testing.assert_allclose(
+                np.asarray(want), np.asarray(lps[:, j]), atol=3e-2, rtol=0
+            )
+
+    def test_sampled_logprobs_are_raw_model_not_shaped(self):
+        """temperature/top-k shape the SAMPLING distribution; the
+        reported logprob is the raw model's at the chosen token — so it
+        must stay <= 0 and equal the raw log-softmax, not the filtered
+        one."""
+        from jax.nn import log_softmax
+
+        c = BurninConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=32,
+            batch=4,
+        )
+        params = init_params(c)
+        prompt = seeded_prompt(c, c.batch, 6)
+        toks, lps = make_generate(
+            c, prompt_len=6, steps=4, temperature=0.8, top_k=10,
+            with_logprobs=True,
+        )(params, prompt, jax.random.PRNGKey(5))
+        assert float(jnp.max(lps)) <= 0.0
+        # First generated token: check against the prefill logits.
+        lg, _ = decode_forward(
+            params, prompt, init_cache(c, c.batch), 0, c
+        )
+        want0 = jnp.take_along_axis(
+            log_softmax(lg[:, -1].astype(jnp.float32)),
+            toks[:, 6][:, None], 1,
+        )[:, 0]
+        np.testing.assert_allclose(
+            np.asarray(want0), np.asarray(lps[:, 0]), atol=3e-2, rtol=0
+        )
+
+    def test_from_cache_logprobs_match_one_shot(self):
+        from tpu_dra.parallel.decode import (
+            make_generate_from_cache,
+            make_prefill,
+        )
+
+        c = BurninConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=32,
+            batch=4,
+        )
+        params = init_params(c)
+        prompt = seeded_prompt(c, c.batch, 6)
+        _, lps = make_generate(
+            c, prompt_len=6, steps=5, with_logprobs=True
+        )(params, prompt)
+        cache, last = make_prefill(c, prompt_len=6)(params, prompt)
+        _, lps2 = make_generate_from_cache(
+            c, start_pos=6, steps=5, with_logprobs=True
+        )(params, cache, last)
+        np.testing.assert_array_equal(np.asarray(lps), np.asarray(lps2))

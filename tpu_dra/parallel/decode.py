@@ -673,17 +673,31 @@ def _build_prefill(c: BurninConfig, mesh, prompt_len: int,
     return prefill
 
 
+def _chosen_logprob(logits, tok):
+    """RAW model log-probability of the chosen token — log-softmax of the
+    unscaled logits at ``tok`` (the API-conventional logprob: temperature
+    and filters shape the SAMPLING distribution, the reported number is
+    the model's)."""
+    import jax.numpy as jnp
+    from jax.nn import log_softmax
+
+    lp = log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(lp, tok[:, None], axis=-1)[:, 0]
+
+
 def _token_loop(params, cache, last_logits, pos0, keys, pick, c, mesh):
     """The compiled generation loop from a prefilled state: sample the
     first token from ``last_logits`` (the logits at position pos0-1),
     then scan ``len(keys) - 1`` cached decode steps starting at pos0.
     Returns ``(toks (steps-1, B) fed tokens, last (B,) final sample,
+    lps (B, steps) raw-model logprob of every generated token,
     fin all-logits-finite flag)`` — shared by `make_generate` and
     `make_generate_from_cache`."""
     import jax
     import jax.numpy as jnp
 
     tok = pick(last_logits, keys[0])
+    lp0 = _chosen_logprob(last_logits, tok)
     fin = jnp.isfinite(last_logits).all()
 
     def step(carry, xs):
@@ -693,17 +707,21 @@ def _token_loop(params, cache, last_logits, pos0, keys, pick, c, mesh):
             params, tok[:, None], cache, pos, c, mesh
         )
         nxt = pick(logits[:, -1], k)
+        lp = _chosen_logprob(logits[:, -1], nxt)
         fin = jnp.logical_and(fin, jnp.isfinite(logits[:, -1]).all())
-        return (cache, nxt, pos + 1, fin), tok
+        return (cache, nxt, pos + 1, fin), (tok, lp)
 
     # steps - 1 cached decode steps: the prefill already sampled token
     # 1 of `steps`, and the final sampled token is never fed back.
     # toks collects the token FED at each step; `last` is the final
-    # sample — together the generated continuation.
-    (_, last, _, fin), toks = jax.lax.scan(
+    # sample — together the generated continuation.  Each scan step's lp
+    # belongs to the token it CHOSE (nxt), so the generated tokens'
+    # logprobs are [lp0, lps...] in order.
+    (_, last, _, fin), (toks, lps) = jax.lax.scan(
         step, (cache, tok, jnp.int32(pos0), fin), keys[1:]
     )
-    return toks, last, fin
+    lps_full = jnp.concatenate([lp0[:, None], lps.transpose(1, 0)], axis=1)
+    return toks, last, lps_full, fin
 
 
 def _build_prefill_padded(c: BurninConfig, mesh, prompt_slots: int,
@@ -782,6 +800,7 @@ def make_generate(
     top_k: "int | None" = None,
     top_p: "float | None" = None,
     with_health: bool = False,
+    with_logprobs: bool = False,
     quantized: bool = False,
     kv_int8: bool = False,
     prefill_chunk: "int | None" = None,
@@ -818,6 +837,12 @@ def make_generate(
     program — benchmarks get a meaningful ok bit without compiling a
     second probe executable (argmax output alone can't show NaN: it
     silently picks index 0).
+
+    ``with_logprobs=True`` additionally returns the ``(B, steps)``
+    RAW-model log-probabilities of the generated tokens (temperature and
+    filters shape the sampling distribution; the reported number is the
+    model's).  Output ordering with both flags:
+    ``(tokens, logprobs, healthy)``.
     """
     import jax
     import jax.numpy as jnp
@@ -839,10 +864,16 @@ def make_generate(
         cache = _fresh_cache(c, prompt.shape[0], mesh, kv_int8)
         last_logits, cache = prefill(params, prompt, cache)
         keys = _make_keys(sampled, key, steps)
-        toks, last, fin = _token_loop(
+        toks, last, lps, fin = _token_loop(
             params, cache, last_logits, prompt_len, keys, pick, c, mesh
         )
-        return _assemble(prompt, toks, last, fin, with_health)
+        out = _assemble(prompt, toks, last, fin, with_health)
+        if not with_logprobs:
+            return out
+        if with_health:
+            tokens, healthy = out
+            return tokens, lps, healthy
+        return out, lps
 
     from jax.sharding import PartitionSpec as P
 
@@ -901,6 +932,7 @@ def make_generate_from_cache(
     top_k: "int | None" = None,
     top_p: "float | None" = None,
     with_health: bool = False,
+    with_logprobs: bool = False,
     quantized: bool = False,
     kv_int8: bool = False,
 ):
@@ -913,7 +945,9 @@ def make_generate_from_cache(
     so the same prefilled state fans out to any number of continuations
     with different keys/filters, paying the prefix cost once.  With
     ``prompt_len == start_pos``, prefill + from-cache reproduces
-    `make_generate`'s continuation exactly (pinned by test)."""
+    `make_generate`'s continuation exactly (pinned by test).
+    ``with_logprobs``/``with_health`` extend the output to
+    ``(tokens[, logprobs][, healthy])`` exactly as in `make_generate`."""
     import jax.numpy as jnp
 
     c = config
@@ -930,11 +964,16 @@ def make_generate_from_cache(
                 "fn(params, cache, last_logits, key)"
             )
         keys = _make_keys(sampled, key, steps)
-        toks, last, fin = _token_loop(
+        toks, last, lps, fin = _token_loop(
             params, cache, last_logits, start_pos, keys, pick, c, mesh
         )
         out = jnp.concatenate([toks.transpose(1, 0), last[:, None]], axis=1)
-        return (out, fin) if with_health else out
+        parts = (out,)
+        if with_logprobs:
+            parts = parts + (lps,)
+        if with_health:
+            parts = parts + (fin,)
+        return parts if len(parts) > 1 else out
 
     from jax.sharding import PartitionSpec as P
 
