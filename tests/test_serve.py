@@ -389,3 +389,45 @@ class TestEngineSoak:
             assert r.finish_reason in ("budget", "stop", "eos")
             assert all(0 <= t < CFG.vocab for t in r.tokens)
         assert eng.pending == 0
+
+
+class TestEngineLogprobs:
+    def test_logprobs_match_uniform_generate_oracle(self):
+        """Every engine request accumulates the raw-model logprob of
+        each generated token — identical to the uniform factory's
+        with_logprobs output for the same prompt."""
+        from tpu_dra.parallel.decode import make_generate
+
+        params = init_params(CFG)
+        prompt = [5, 9, 2]
+        _, want = make_generate(
+            CFG, prompt_len=3, steps=5, with_logprobs=True
+        )(params, jnp.asarray([prompt] * CFG.batch, jnp.int32))
+        eng = ServeEngine(
+            params, CFG, slots=2, prompt_slots=8, max_new_cap=5,
+            with_logprobs=True,
+        )
+        rid = eng.submit(prompt, 5)
+        done = {r.id: r for r in eng.run()}
+        np.testing.assert_allclose(
+            np.asarray(want[0]), np.asarray(done[rid].logprobs), atol=1e-5
+        )
+
+    def test_sampled_logprobs_nonpositive_and_per_token(self):
+        eng = ServeEngine(
+            init_params(CFG), CFG, slots=2, prompt_slots=8, max_new_cap=4,
+            temperature=0.9, steps_per_tick=2, with_logprobs=True,
+        )
+        a = eng.submit([1, 2, 3], 4, seed=3)
+        done = {r.id: r for r in eng.run()}
+        req = done[a]
+        assert len(req.logprobs) == len(req.tokens) == 4
+        assert all(lp <= 0.0 for lp in req.logprobs)
+
+    def test_default_engine_skips_logprobs(self):
+        eng = ServeEngine(
+            init_params(CFG), CFG, slots=1, prompt_slots=4, max_new_cap=2
+        )
+        a = eng.submit([1, 2], 2)
+        done = {r.id: r for r in eng.run()}
+        assert done[a].logprobs == [] and len(done[a].tokens) == 2

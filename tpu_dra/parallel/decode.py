@@ -867,13 +867,13 @@ def make_generate(
         toks, last, lps, fin = _token_loop(
             params, cache, last_logits, prompt_len, keys, pick, c, mesh
         )
-        out = _assemble(prompt, toks, last, fin, with_health)
-        if not with_logprobs:
-            return out
+        tokens = _assemble(prompt, toks, last, fin, False)
+        parts = (tokens,)
+        if with_logprobs:
+            parts = parts + (lps,)
         if with_health:
-            tokens, healthy = out
-            return tokens, lps, healthy
-        return out, lps
+            parts = parts + (fin,)
+        return parts if len(parts) > 1 else tokens
 
     from jax.sharding import PartitionSpec as P
 

@@ -61,6 +61,7 @@ from tpu_dra.parallel.decode import (
     _build_prefill_padded,
     _check_chunk,
     _check_window,
+    _chosen_logprob,
     _make_pick,
     _validate_filters,
     decode_step_rows,
@@ -80,6 +81,10 @@ class Request:
     seed: int = 0  # sampling: randomness is f(seed, position) only
     stop_sequences: "list[list[int]]" = field(default_factory=list)
     tokens: "list[int]" = field(default_factory=list)  # generated only
+    # Raw-model log-probability of each generated token (same convention
+    # as the generate factories' with_logprobs: the model's log-softmax
+    # at the chosen token, not the temperature/filter-shaped one).
+    logprobs: "list[float]" = field(default_factory=list)
     done: bool = False
     finish_reason: str = ""  # "eos" | "budget" | "stop"
 
@@ -108,6 +113,7 @@ class ServeEngine:
         temperature: float = 0.0,
         top_k: "int | None" = None,
         top_p: "float | None" = None,
+        with_logprobs: bool = False,
         prefill_chunk: "int | None" = None,
         kv_int8: bool = False,
         mesh=None,
@@ -132,6 +138,7 @@ class ServeEngine:
         self.eos_token = eos_token
         self.steps_per_tick = steps_per_tick
         self.temperature = temperature
+        self.with_logprobs = with_logprobs
         self.mesh = mesh
 
         self._cache = init_cache(c, slots, kv_int8)
@@ -201,6 +208,19 @@ class ServeEngine:
         else:
             pick_row = None  # greedy: step() takes the argmax branch
 
+        def first_token(seed, length, row):
+            # The admission's first token + its raw-model logprob in ONE
+            # compiled call — one device round-trip per admission, not
+            # two.
+            if temperature > 0:
+                tok = pick_row(seed, length, row)
+            else:
+                tok = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            lp = _chosen_logprob(row[None], tok[None])[0]
+            return tok, lp
+
+        self._first_token = jax.jit(first_token)
+
         def step(params, cache, tok, pos, active, seeds):
             # steps_per_tick tokens for every row in ONE device call; the
             # per-step tokens come back for host-side finish decisions.
@@ -219,18 +239,21 @@ class ServeEngine:
                     nxt = jax.vmap(pick_row)(seeds, pos + 1, logits)
                 else:
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if with_logprobs:
+                    lp = _chosen_logprob(logits, nxt)  # raw-model, per row
+                else:
+                    lp = jnp.zeros(nxt.shape, jnp.float32)
                 # Inactive rows freeze: token and position pinned so their
                 # (harmless) writes stay on one stale slot.
                 nxt = jnp.where(active, nxt, tok)
                 pos = jnp.where(active, pos + 1, pos)
-                return (cache, nxt, pos), nxt
+                return (cache, nxt, pos), (nxt, lp)
 
-            (cache, tok, pos), toks = jax.lax.scan(
+            (cache, tok, pos), (toks, lps) = jax.lax.scan(
                 one, (cache, tok, pos), None, length=self.steps_per_tick
             )
-            return cache, tok, pos, toks  # toks: (steps_per_tick, B)
-
-        self._pick_row = jax.jit(pick_row) if temperature > 0 else None
+            # toks/lps: (steps_per_tick, B)
+            return cache, tok, pos, toks, lps
 
         if mesh is None:
             self._prefill1 = jax.jit(prefill1)
@@ -251,7 +274,7 @@ class ServeEngine:
             self._prefill1 = jax.jit(prefill1)
             self._insert = jax.jit(insert, out_shardings=cache_sh)
             self._step = jax.jit(
-                step, out_shardings=(cache_sh, rep, rep, rep)
+                step, out_shardings=(cache_sh, rep, rep, rep, rep)
             )
 
     # -- submission ------------------------------------------------------
@@ -310,22 +333,24 @@ class ServeEngine:
                 self.params, prompt, jnp.int32(length)
             )
             self._cache = self._insert(self._cache, cache1, jnp.int32(row))
-            if self.temperature > 0:
-                first = int(
-                    self._pick_row(
-                        jnp.int32(req.seed), jnp.int32(length), last[0]
-                    )
+            import jax
+
+            tok0, lp0_dev = jax.device_get(
+                self._first_token(
+                    jnp.int32(req.seed), jnp.int32(length), last[0]
                 )
-            else:
-                first = int(jnp.argmax(last[0]))
+            )  # one fused call, one fetch
+            first, lp0 = int(tok0), float(lp0_dev)
             self._row_req[row] = req
             self._pos[row] = length
             self._tok[row] = first
-            self._note_token(row, first)
+            self._note_token(row, first, lp0)
 
-    def _note_token(self, row: int, token: int) -> None:
+    def _note_token(self, row: int, token: int, logprob: float) -> None:
         req = self._row_req[row]
         req.tokens.append(token)
+        if self.with_logprobs:
+            req.logprobs.append(logprob)
         if self.eos_token is not None and token == self.eos_token:
             req.done, req.finish_reason = True, "eos"
         elif any(
@@ -357,19 +382,21 @@ class ServeEngine:
                 [r.seed if r is not None else 0 for r in self._row_req],
                 jnp.int32,
             )
-            self._cache, tok, pos, toks = self._step(
+            self._cache, tok, pos, toks, lps = self._step(
                 self.params, self._cache, tok, pos, active, seeds
             )
             # ONE blocking fetch per tick (the module-header promise):
-            # tokens, next-token, and positions come back together.
-            toks, tok_h, pos_h = jax.device_get((toks, tok, pos))
+            # tokens, logprobs, next-token, and positions come together.
+            toks, lps, tok_h, pos_h = jax.device_get((toks, lps, tok, pos))
             self._tok = [int(t) for t in tok_h]
             self._pos = [int(p) for p in pos_h]
             for s in range(toks.shape[0]):
                 for row in range(self.slots):
                     if self._row_req[row] is None:
                         continue
-                    self._note_token(row, int(toks[s, row]))
+                    self._note_token(
+                        row, int(toks[s, row]), float(lps[s, row])
+                    )
         return self._done[done_before:]
 
     def run(self, until_idle: int = 10_000) -> "list[Request]":
