@@ -7,7 +7,7 @@ IMAGE   ?= tpu-dra-driver
 TAG     ?= latest
 
 .PHONY: all test lint generate-crds check-generate native native-test \
-        demo-quickstart bench image clean help
+        demo-quickstart bench image clean help observability-smoke
 
 all: lint test
 
@@ -42,6 +42,12 @@ demo-quickstart:
 bench:
 	$(PYTHON) bench.py
 
+# Starts a MetricsServer, scrapes /metrics, asserts every line of the
+# exposition parses under the Prometheus text-format grammar
+# (docs/OBSERVABILITY.md).
+observability-smoke:
+	$(PYTHON) -m pytest tests/test_observability_smoke.py -q -m 'not slow'
+
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
@@ -52,4 +58,4 @@ clean:
 
 help:
 	@echo "targets: test lint generate-crds check-generate native native-test"
-	@echo "         demo-quickstart bench image clean"
+	@echo "         demo-quickstart bench observability-smoke image clean"

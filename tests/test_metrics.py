@@ -76,3 +76,81 @@ def test_http_endpoint_serves_metrics_health_debug():
 
 
 import urllib.error  # noqa: E402
+
+
+def test_label_value_escaping():
+    """Prometheus text-format: label values escape backslash, quote, LF."""
+    c = Counter("esc_total", "escaping")
+    c.inc(path='a\\b', msg='say "hi"\nbye')
+    text = c.collect()
+    assert 'esc_total{msg="say \\"hi\\"\\nbye",path="a\\\\b"} 1.0' in text
+    # Exposition output stays one line per sample: HELP, TYPE, the sample —
+    # an unescaped LF would split the sample across two lines.
+    assert len(text.splitlines()) == 3
+
+
+def test_build_info_gauge():
+    from tpu_dra.utils.metrics import REGISTRY, set_build_info
+    from tpu_dra.version import version_string
+
+    set_build_info("test-component")
+    text = REGISTRY.expose()
+    assert "# TYPE tpu_dra_build_info gauge" in text
+    line = next(
+        l for l in text.splitlines()
+        if l.startswith("tpu_dra_build_info{") and "test-component" in l
+    )
+    assert 'component="test-component"' in line
+    assert version_string().split(" ")[0] in line
+    assert line.endswith(" 1.0")
+
+
+def _get_code(url):
+    try:
+        return urllib.request.urlopen(url).status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_debug_query_param_validation():
+    server = MetricsServer("127.0.0.1:0", registry=Registry())
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        for bad in ("-1", "nan", "inf", "0", "bogus"):
+            assert _get_code(f"{base}/debug/profile?seconds={bad}") == 400
+        for bad in ("-5", "0", "nan", "x"):
+            assert _get_code(f"{base}/debug/traces?limit={bad}") == 400
+        assert _get_code(f"{base}/debug/traces?format=xml") == 400
+        assert _get_code(f"{base}/debug/traces") == 200
+    finally:
+        server.stop()
+
+
+def test_debug_traces_endpoint():
+    import json
+
+    from tpu_dra.utils import trace
+
+    server = MetricsServer("127.0.0.1:0", registry=Registry())
+    server.start()
+    try:
+        with trace.span("endpoint-probe", claim_uid="u-endpoint") as sp:
+            pass
+        trace_id = sp.context.trace_id
+        base = f"http://127.0.0.1:{server.port}"
+        doc = json.loads(
+            urllib.request.urlopen(
+                f"{base}/debug/traces?trace_id={trace_id}"
+            ).read().decode()
+        )
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert [e["name"] for e in xs] == ["endpoint-probe"]
+        assert xs[0]["args"]["trace_id"] == trace_id
+        text = urllib.request.urlopen(
+            f"{base}/debug/traces?trace_id={trace_id}&format=text"
+        ).read().decode()
+        assert "endpoint-probe" in text
+        assert "claim_uid=u-endpoint" in text
+    finally:
+        server.stop()

@@ -1,0 +1,272 @@
+"""Unit tests for the tracing subsystem (tpu_dra/utils/trace.py):
+traceparent parse/serialize, span nesting + ambient propagation, the
+ring-buffer exporter, renderings, the JSON log formatter, and the wire
+codec's traceparent field."""
+
+import json
+import logging
+
+import pytest
+
+from tpu_dra.plugin import wire
+from tpu_dra.utils import trace
+from tpu_dra.utils.metrics import REGISTRY
+
+
+# -- traceparent --------------------------------------------------------------
+
+def test_traceparent_round_trip():
+    ctx = trace.TraceContext.new()
+    assert len(ctx.trace_id) == 32
+    assert len(ctx.span_id) == 16
+    parsed = trace.parse_traceparent(ctx.to_traceparent())
+    assert parsed == ctx
+
+
+def test_traceparent_parse_canonical_form():
+    ctx = trace.parse_traceparent(
+        "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+    )
+    assert ctx is not None
+    assert ctx.trace_id == "0af7651916cd43dd8448eb211c80319c"
+    assert ctx.span_id == "b7ad6b7169203331"
+    assert ctx.flags == "01"
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "",
+        None,
+        "garbage",
+        "00-short-b7ad6b7169203331-01",  # trace id wrong length
+        "00-0af7651916cd43dd8448eb211c80319c-short-01",  # span id wrong length
+        "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331",  # 3 parts
+        "zz-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01",  # version
+        "ff-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01",  # reserved
+        "00-00000000000000000000000000000000-b7ad6b7169203331-01",  # zero tid
+        "00-0af7651916cd43dd8448eb211c80319c-0000000000000000-01",  # zero sid
+        "00-0af7651916cd43dd8448eb211c80319X-b7ad6b7169203331-01",  # non-hex
+    ],
+)
+def test_traceparent_rejects_malformed(bad):
+    assert trace.parse_traceparent(bad) is None
+
+
+def test_child_keeps_trace_id():
+    ctx = trace.TraceContext.new()
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id
+
+
+# -- spans + ambient propagation ---------------------------------------------
+
+def test_span_nesting_and_export():
+    exporter = trace.SpanExporter()
+    with trace.span("parent", exporter=exporter, claim_uid="u-1") as parent:
+        with trace.span("child", exporter=exporter) as child:
+            assert child.context.trace_id == parent.context.trace_id
+            assert child.parent_id == parent.context.span_id
+            # claim_uid rides down the tree
+            assert child.attributes["claim_uid"] == "u-1"
+        assert trace.current_span() is parent
+    assert trace.current_span() is None
+    records = exporter.spans()
+    assert [r["name"] for r in records] == ["child", "parent"]  # exit order
+    assert {r["trace_id"] for r in records} == {parent.context.trace_id}
+
+
+def test_span_explicit_parent_beats_ambient():
+    exporter = trace.SpanExporter()
+    remote = trace.TraceContext.new()
+    with trace.span("ambient", exporter=exporter):
+        with trace.span("joined", exporter=exporter, parent=remote) as sp:
+            assert sp.context.trace_id == remote.trace_id
+            assert sp.parent_id == remote.span_id
+
+
+def test_span_error_status_on_exception():
+    exporter = trace.SpanExporter()
+    with pytest.raises(RuntimeError):
+        with trace.span("boom", exporter=exporter):
+            raise RuntimeError("chip on fire")
+    (record,) = exporter.spans()
+    assert record["status"] == "ERROR"
+    assert "chip on fire" in record["status_message"]
+    assert record["events"][0]["name"] == "exception"
+
+
+def test_span_events_and_attributes():
+    exporter = trace.SpanExporter()
+    with trace.span("op", exporter=exporter, node="node-1") as sp:
+        sp.set_attribute("devices", 4)
+        sp.add_event("cdi_emit", count=4)
+    (record,) = exporter.spans()
+    assert record["attributes"] == {"node": "node-1", "devices": 4}
+    assert record["events"][0]["name"] == "cdi_emit"
+    assert record["events"][0]["attributes"] == {"count": 4}
+
+
+def test_span_moves_metrics():
+    before = REGISTRY.expose()
+    with trace.span("metrics-probe", exporter=trace.SpanExporter()):
+        pass
+    after = REGISTRY.expose()
+    line = 'tpu_dra_trace_spans_total{name="metrics-probe",status="OK"} 1.0'
+    assert line not in before
+    assert line in after
+    assert 'tpu_dra_span_seconds_count{name="metrics-probe"} 1' in after
+
+
+def test_inject_returns_ambient_or_empty():
+    assert trace.inject() == ""
+    with trace.span("live", exporter=trace.SpanExporter()) as sp:
+        assert trace.inject() == sp.context.to_traceparent()
+
+
+# -- exporter ring buffer -----------------------------------------------------
+
+def test_exporter_ring_buffer_caps():
+    exporter = trace.SpanExporter(capacity=5)
+    for i in range(12):
+        exporter.export(
+            {"name": f"s{i}", "trace_id": "t", "span_id": str(i),
+             "parent_id": "", "component": "c", "thread": "m",
+             "start_unix_s": float(i), "duration_s": 0.0, "status": "OK",
+             "status_message": "", "attributes": {}, "events": []}
+        )
+    records = exporter.spans()
+    assert len(records) == 5
+    assert records[0]["name"] == "s7"  # oldest evicted
+    assert exporter.spans(limit=2)[0]["name"] == "s10"
+
+
+def test_exporter_trace_id_filter():
+    exporter = trace.SpanExporter()
+    with trace.span("a", exporter=exporter) as a:
+        pass
+    with trace.span("b", exporter=exporter):
+        pass
+    only_a = exporter.spans(trace_id=a.context.trace_id)
+    assert [r["name"] for r in only_a] == ["a"]
+
+
+# -- renderings ---------------------------------------------------------------
+
+def test_chrome_trace_format():
+    exporter = trace.SpanExporter()
+    with trace.span("outer", exporter=exporter, claim_uid="u-9"):
+        with trace.span("inner", exporter=exporter):
+            pass
+    doc = trace.chrome_trace(exporter.spans())
+    events = doc["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert {e["name"] for e in xs} == {"outer", "inner"}
+    for e in xs:
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["dur"] >= 0 and e["ts"] > 0
+        assert e["args"]["trace_id"]
+    assert any(m["name"] == "process_name" for m in metas)
+    assert any(m["name"] == "thread_name" for m in metas)
+    json.dumps(doc)  # must be serializable as-is
+
+
+def test_render_tree_nesting():
+    exporter = trace.SpanExporter()
+    with trace.span("root-op", exporter=exporter, claim_uid="u-2"):
+        with trace.span("child-op", exporter=exporter):
+            pass
+    text = trace.render_tree(exporter.spans())
+    root_line = next(l for l in text.splitlines() if "root-op" in l)
+    child_line = next(l for l in text.splitlines() if "child-op" in l)
+    # child indented deeper than root
+    assert len(child_line) - len(child_line.lstrip()) > len(root_line) - len(
+        root_line.lstrip()
+    )
+    assert "claim_uid=u-2" in text
+
+
+def test_render_tree_orphan_parent_prints_at_root():
+    exporter = trace.SpanExporter()
+    remote = trace.TraceContext.new()
+    with trace.span("half", exporter=exporter, parent=remote):
+        pass
+    text = trace.render_tree(exporter.spans())
+    assert "half" in text
+
+
+# -- JSON log formatter -------------------------------------------------------
+
+def _format_one(formatter, logger_name="test", msg="hello %s", args=("world",)):
+    record = logging.LogRecord(
+        logger_name, logging.INFO, __file__, 1, msg, args, None
+    )
+    return json.loads(formatter.format(record))
+
+
+def test_json_log_formatter_stamps_trace_context():
+    formatter = trace.JsonLogFormatter(component="controller")
+    with trace.span(
+        "logging-span", exporter=trace.SpanExporter(), claim_uid="u-7"
+    ) as sp:
+        out = _format_one(formatter)
+        assert out["msg"] == "hello world"
+        assert out["level"] == "info"
+        assert out["logger"] == "test"
+        assert out["component"] == "controller"
+        assert out["trace_id"] == sp.context.trace_id
+        assert out["span_id"] == sp.context.span_id
+        assert out["claim_uid"] == "u-7"
+
+
+def test_json_log_formatter_without_span():
+    out = _format_one(trace.JsonLogFormatter())
+    assert "trace_id" not in out
+    assert "claim_uid" not in out
+
+
+def test_json_log_formatter_exception():
+    formatter = trace.JsonLogFormatter()
+    try:
+        raise ValueError("bad")
+    except ValueError:
+        import sys
+
+        record = logging.LogRecord(
+            "t", logging.ERROR, __file__, 1, "failed", (), sys.exc_info()
+        )
+    out = json.loads(formatter.format(record))
+    assert "ValueError: bad" in out["exc"]
+
+
+# -- wire codec traceparent field --------------------------------------------
+
+def test_wire_prepare_request_carries_traceparent():
+    tp = trace.TraceContext.new().to_traceparent()
+    msg = wire.NodePrepareResourceRequest(
+        namespace="ns", claim_uid="u", claim_name="c", traceparent=tp
+    )
+    decoded = wire.NodePrepareResourceRequest.decode(msg.encode())
+    assert decoded.traceparent == tp
+    assert decoded.claim_uid == "u"
+
+
+def test_wire_traceparent_skipped_by_old_decoder():
+    """A decoder without field 5 (a stock kubelet) skips it silently."""
+
+    class LegacyRequest(wire.WireMessage):
+        FIELDS = {
+            1: ("namespace", str),
+            2: ("claim_uid", str),
+            3: ("claim_name", str),
+            4: ("resource_handle", str),
+        }
+
+    msg = wire.NodePrepareResourceRequest(
+        namespace="ns", claim_uid="u", traceparent="00-aa-bb-01"
+    )
+    decoded = LegacyRequest.decode(msg.encode())
+    assert decoded.claim_uid == "u"
+    assert not hasattr(decoded, "traceparent")

@@ -1,0 +1,235 @@
+"""End-to-end claim-lifecycle trace propagation over the SimCluster.
+
+The acceptance scenario of the observability layer: one allocation driven
+through the simulated apiserver yields ONE trace id visible in the
+controller's spans, the node plugin's spans (joined via the per-claim NAS
+annotation the controller stamps at commit time), the JSON log lines on
+both sides, and the MetricsServer's ``/debug/traces`` endpoint (Chrome
+trace JSON + text tree)."""
+
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from tpu_dra.api.k8s import (
+    Pod,
+    PodResourceClaim,
+    PodResourceClaimSource,
+    PodSpec,
+    ResourceClaimSpec,
+    ResourceClaimParametersReference,
+    ResourceClaimTemplate,
+    ResourceClaimTemplateSpec,
+    ResourceClass,
+)
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.tpu_v1alpha1 import (
+    GROUP_NAME,
+    TpuClaimParameters,
+    TpuClaimParametersSpec,
+)
+from tpu_dra.sim import SimCluster
+from tpu_dra.utils import trace
+from tpu_dra.utils.metrics import MetricsServer
+from tpu_dra.utils.trace import JsonLogFormatter
+
+NS = "default"
+
+
+class _JsonCapture(logging.Handler):
+    """Collects records formatted by JsonLogFormatter at emit time (so the
+    ambient span context is the emitting thread's, exactly as a real
+    stderr handler would see it)."""
+
+    def __init__(self):
+        super().__init__()
+        self.setFormatter(JsonLogFormatter())
+        self.lines = []
+
+    def emit(self, record):
+        self.lines.append(self.format(record))
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    cluster = SimCluster(str(tmp_path), nodes=1, mesh="2x2x1")
+    cluster.start()
+    cluster.clientset.resource_classes().create(
+        ResourceClass(
+            metadata=ObjectMeta(name="tpu.google.com"), driver_name=GROUP_NAME
+        )
+    )
+    yield cluster
+    cluster.stop()
+
+
+def test_one_trace_spans_controller_and_plugin(cluster):
+    capture = _JsonCapture()
+    root = logging.getLogger()
+    old_level = root.level
+    root.addHandler(capture)
+    root.setLevel(logging.INFO)
+    try:
+        cluster.clientset.tpu_claim_parameters(NS).create(
+            TpuClaimParameters(
+                metadata=ObjectMeta(name="one-tpu", namespace=NS),
+                spec=TpuClaimParametersSpec(count=1),
+            )
+        )
+        claim_spec = ResourceClaimSpec(
+            resource_class_name="tpu.google.com",
+            parameters_ref=ResourceClaimParametersReference(
+                api_group=GROUP_NAME, kind="TpuClaimParameters", name="one-tpu"
+            ),
+        )
+        cluster.clientset.resource_claim_templates(NS).create(
+            ResourceClaimTemplate(
+                metadata=ObjectMeta(name="one-tpu-template", namespace=NS),
+                spec=ResourceClaimTemplateSpec(spec=claim_spec),
+            )
+        )
+        cluster.clientset.pods(NS).create(
+            Pod(
+                metadata=ObjectMeta(name="traced-pod", namespace=NS),
+                spec=PodSpec(
+                    resource_claims=[
+                        PodResourceClaim(
+                            name="tpu",
+                            source=PodResourceClaimSource(
+                                resource_claim_template_name="one-tpu-template"
+                            ),
+                        )
+                    ]
+                ),
+            )
+        )
+        cluster.wait_for_pod_running(NS, "traced-pod")
+        claim = cluster.clientset.resource_claims(NS).get("traced-pod-tpu")
+        uid = claim.metadata.uid
+
+        # -- one trace id across both processes' spans -----------------------
+        spans = [
+            r
+            for r in trace.EXPORTER.spans()
+            if r["attributes"].get("claim_uid") == uid
+        ]
+        by_name = {r["name"]: r for r in spans}
+        assert "controller.allocate_claim" in by_name  # reconciler root
+        assert "controller.allocate" in by_name  # driver commit
+        assert "plugin.node_prepare" in by_name  # the other process
+        trace_id = by_name["controller.allocate_claim"]["trace_id"]
+        assert by_name["controller.allocate"]["trace_id"] == trace_id
+        assert by_name["plugin.node_prepare"]["trace_id"] == trace_id
+        # The plugin span is parented INTO the controller's trace (via the
+        # NAS annotation), not just sharing an id by accident.
+        assert by_name["plugin.node_prepare"]["parent_id"] != ""
+
+        # -- the committed NAS carries the annotation ------------------------
+        nas = cluster.clientset.node_allocation_states("tpu-dra").get("node-0")
+        tp = nas.metadata.annotations[trace.nas_annotation_key(uid)]
+        assert trace.parse_traceparent(tp).trace_id == trace_id
+
+        # -- JSON log lines on both sides carry the same trace id ------------
+        logs = [json.loads(line) for line in capture.lines]
+        controller_logs = [
+            l for l in logs
+            if l.get("trace_id") == trace_id and "allocated claim" in l["msg"]
+        ]
+        plugin_logs = [
+            l for l in logs
+            if l.get("trace_id") == trace_id and "prepared claim" in l["msg"]
+        ]
+        assert controller_logs and controller_logs[0]["claim_uid"] == uid
+        assert plugin_logs and plugin_logs[0]["claim_uid"] == uid
+
+        # -- /debug/traces returns the joined tree ---------------------------
+        server = MetricsServer("127.0.0.1:0")
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            doc = json.loads(
+                urllib.request.urlopen(
+                    f"{base}/debug/traces?trace_id={trace_id}"
+                ).read().decode()
+            )
+            names = {
+                e["name"] for e in doc["traceEvents"] if e["ph"] == "X"
+            }
+            assert {
+                "controller.allocate_claim",
+                "controller.allocate",
+                "plugin.node_prepare",
+            } <= names
+            tree = urllib.request.urlopen(
+                f"{base}/debug/traces?trace_id={trace_id}&format=text"
+            ).read().decode()
+            assert tree.startswith(f"trace {trace_id}")
+            assert "controller.allocate_claim" in tree
+            assert "plugin.node_prepare" in tree
+        finally:
+            server.stop()
+
+        # -- deallocation prunes the annotation ------------------------------
+        cluster.delete_pod(NS, "traced-pod")
+        cluster.clientset.resource_claims(NS).delete("traced-pod-tpu")
+        import time
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            nas = cluster.clientset.node_allocation_states("tpu-dra").get(
+                "node-0"
+            )
+            if trace.nas_annotation_key(uid) not in nas.metadata.annotations:
+                break
+            time.sleep(0.05)
+        assert trace.nas_annotation_key(uid) not in nas.metadata.annotations
+    finally:
+        root.removeHandler(capture)
+        root.setLevel(old_level)
+
+
+def test_wire_traceparent_joins_plugin_trace(tmp_path):
+    """Without any NAS annotation, an explicit traceparent on the prepare
+    call parents the plugin span — the kubelet gRPC path."""
+    from tests.helpers import make_plugin_stack
+    from tpu_dra.api import nas_v1alpha1 as nascrd
+    from tpu_dra.client.apiserver import FakeApiServer
+    from tpu_dra.client.clientset import ClientSet
+    from tpu_dra.client.nasclient import NasClient
+    from tpu_dra.plugin.driver import NodeDriver
+
+    clientset = ClientSet(FakeApiServer())
+    _, _, state = make_plugin_stack(tmp_path, clientset)
+    nas = nascrd.NodeAllocationState(
+        metadata=ObjectMeta(name="node-1", namespace="tpu-dra")
+    )
+    driver = NodeDriver(
+        nas, NasClient(nas, clientset), state, start_gc=False
+    )
+    try:
+        # Allocate uid-1 directly in the NAS (controller shortcut).
+        driver._client.get()
+        chip = nas.spec.allocatable_devices[0].tpu
+        nas.spec.allocated_claims["uid-1"] = nascrd.AllocatedDevices(
+            tpu=nascrd.AllocatedTpus(
+                devices=[nascrd.AllocatedTpu(uuid=chip.uuid, coord=chip.coord)]
+            )
+        )
+        driver._client.update(nas.spec)
+
+        remote = trace.TraceContext.new()
+        driver.node_prepare_resource(
+            "uid-1", traceparent=remote.to_traceparent()
+        )
+        record = next(
+            r
+            for r in reversed(trace.EXPORTER.spans())
+            if r["name"] == "plugin.node_prepare"
+            and r["attributes"].get("claim_uid") == "uid-1"
+        )
+        assert record["trace_id"] == remote.trace_id
+        assert record["parent_id"] == remote.span_id
+    finally:
+        driver.shutdown()

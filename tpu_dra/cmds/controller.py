@@ -64,6 +64,11 @@ class ControllerApp:
             )
 
     def start(self) -> None:
+        from tpu_dra.utils import trace
+        from tpu_dra.utils.metrics import set_build_info
+
+        trace.set_component("controller")
+        set_build_info("controller")
         if self.metrics_server:
             self.metrics_server.start()
             logger.info("http endpoint on %s", self.args.http_endpoint)

@@ -18,6 +18,19 @@ def _env_default(var: str, default):
     return os.environ.get(var, default)
 
 
+def _log_format(value: str) -> str:
+    """Normalize + validate a log format.  Used as the argparse ``type`` so
+    it runs on the env-derived string default too (which ``choices`` alone
+    would not check): LOG_FORMAT=JSON normalizes, LOG_FORMAT=jsn errors
+    instead of silently logging text."""
+    normalized = value.strip().lower()
+    if normalized not in ("text", "json"):
+        raise argparse.ArgumentTypeError(
+            f"must be 'text' or 'json', got {value!r}"
+        )
+    return normalized
+
+
 def add_kube_flags(parser: argparse.ArgumentParser) -> None:
     g = parser.add_argument_group("kubernetes client")
     g.add_argument(
@@ -64,11 +77,24 @@ def add_logging_flags(parser: argparse.ArgumentParser) -> None:
         help="log verbosity [LOG_LEVEL]",
     )
     g.add_argument(
+        "--log-format",
+        # LOG_JSON=1 only moves the DEFAULT; an explicit --log-format=text
+        # still wins over the deprecated env alias.
+        default=_env_default(
+            "LOG_FORMAT", "json" if os.environ.get("LOG_JSON") == "1" else "text"
+        ),
+        type=_log_format,
+        help="text or json; json = one JSON object per log line, stamped "
+        "with the ambient trace context (trace_id/span_id/claim_uid, "
+        "utils/trace.py) [LOG_FORMAT]",
+    )
+    g.add_argument(
         "--log-json",
-        action="store_true",
-        default=_env_default("LOG_JSON", "") == "1",
-        help="one JSON object per log line (reference logging.go JSON "
-        "feature gate) [LOG_JSON=1]",
+        action="store_const",
+        const="json",
+        dest="log_format",
+        help="deprecated alias for --log-format=json (reference logging.go "
+        "JSON feature gate) [LOG_JSON=1]",
     )
 
 
@@ -106,25 +132,12 @@ def add_http_flags(parser: argparse.ArgumentParser) -> None:
     )
 
 
-class _JsonFormatter(logging.Formatter):
-    def format(self, record: logging.LogRecord) -> str:
-        import json
-
-        out = {
-            "ts": self.formatTime(record),
-            "level": record.levelname.lower(),
-            "logger": record.name,
-            "msg": record.getMessage(),
-        }
-        if record.exc_info:
-            out["exc"] = self.formatException(record.exc_info)
-        return json.dumps(out)
-
-
 def setup_logging(args: argparse.Namespace) -> None:
     handler = logging.StreamHandler(sys.stderr)
-    if args.log_json:
-        handler.setFormatter(_JsonFormatter())
+    if getattr(args, "log_format", "text") == "json":
+        from tpu_dra.utils.trace import JsonLogFormatter
+
+        handler.setFormatter(JsonLogFormatter())
     else:
         handler.setFormatter(
             logging.Formatter("%(asctime)s %(levelname).1s %(name)s: %(message)s")

@@ -181,7 +181,11 @@ class PluginApp:
     def start(self) -> None:
         from tpu_dra.plugin.driver import NodeDriver
         from tpu_dra.plugin.kubeletplugin import DRAPluginServer
+        from tpu_dra.utils import trace
+        from tpu_dra.utils.metrics import set_build_info
 
+        trace.set_component("plugin")
+        set_build_info("plugin")
         if self.metrics_server:
             self.metrics_server.start()
         # NodeDriver's constructor runs the NotReady→publish→Ready handshake.

@@ -14,6 +14,7 @@ reference leaves ComputeInstance claims registered-but-unimplemented
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Any
 
@@ -34,6 +35,7 @@ from tpu_dra.controller.nodelock import PerNodeMutex
 from tpu_dra.controller.subslice_allocator import SubsliceDriver
 from tpu_dra.controller.tpu_allocator import TpuDriver
 from tpu_dra.controller.types import ClaimAllocation
+from tpu_dra.utils import trace
 from tpu_dra.utils.metrics import (
     ALLOCATE_SECONDS,
     INFORMER_FALLBACKS,
@@ -45,6 +47,8 @@ from tpu_dra.utils.metrics import (
 
 DRIVER_NAME = tpucrd.GROUP_NAME
 DRIVER_API_GROUP = tpucrd.GROUP_NAME
+
+logger = logging.getLogger(__name__)
 
 
 def _params_key(ca: ClaimAllocation) -> str:
@@ -137,9 +141,6 @@ class ControllerDriver:
         on a coordinator, runs the repair — the level-triggered backstop
         behind the event-triggered checks (assign/commit/deallocate), so no
         interleaving can leave a gang split-brained past one sweep."""
-        import logging
-
-        logger = logging.getLogger(__name__)
         # ONE namespace listing feeds gang discovery and every per-gang
         # scan; only the actual repair writes re-read fresh state (under
         # the node locks).
@@ -188,9 +189,7 @@ class ControllerDriver:
                 try:
                     self.audit_gangs()
                 except Exception:
-                    import logging
-
-                    logging.getLogger(__name__).exception("gang audit failed")
+                    logger.exception("gang audit failed")
 
         self._auditor_thread = threading.Thread(
             target=loop, name="gang-auditor", daemon=True
@@ -356,7 +355,12 @@ class ControllerDriver:
         class_params: tpucrd.DeviceClassParametersSpec,
         selected_node: str,
     ) -> AllocationResult:
-        with ALLOCATE_SECONDS.time(), self.lock.locked(selected_node):
+        with trace.span(
+            "controller.allocate",
+            claim_uid=claim.metadata.uid,
+            claim=claim.metadata.name,
+            node=selected_node,
+        ) as sp, ALLOCATE_SECONDS.time(), self.lock.locked(selected_node):
             nas, client = self._nas_client(selected_node)
             client.get()
 
@@ -366,6 +370,7 @@ class ControllerDriver:
                 # after the NAS commit): report the class's real shareability
                 # — the reference hardcodes true here (driver.go:134), which
                 # would advertise an exclusive claim as shareable.
+                sp.add_event("idempotent_retry")
                 return build_allocation_result(
                     selected_node, bool(class_params.shareable)
                 )
@@ -409,12 +414,25 @@ class ControllerDriver:
                     selected_node,
                 )
                 gang_name = claim_params.gang.name
-            client.update(nas.spec)
+            # Serialize this trace into the NAS annotation the node plugin
+            # reads at prepare time — the allocation's only cross-process
+            # channel, so the traceparent rides the same write.
+            nas.metadata.annotations[trace.nas_annotation_key(claim_uid)] = (
+                trace.inject()
+            )
+            with trace.span("controller.nas.update", node=selected_node):
+                client.update(nas.spec)
             self._note_node_write(selected_node, nas)
             self.gangs.commit(
                 claim_uid, claim.metadata.namespace, gang_name
             )
             on_success()
+            logger.info(
+                "allocated claim %s/%s on node %s",
+                claim.metadata.namespace,
+                claim.metadata.name,
+                selected_node,
+            )
         if gang_name is not None and self.gangs.take_repair_hint(
             claim.metadata.namespace, gang_name
         ):
@@ -431,9 +449,7 @@ class ControllerDriver:
                     on_write=self._note_node_write,
                 )
             except Exception:
-                import logging
-
-                logging.getLogger(__name__).exception(
+                logger.exception(
                     "gang %s coordinator repair failed (will retry on next "
                     "member allocation)",
                     gang_name,
@@ -441,6 +457,14 @@ class ControllerDriver:
         return build_allocation_result(selected_node, bool(class_params.shareable))
 
     def deallocate(self, claim: ResourceClaim) -> None:
+        with trace.span(
+            "controller.deallocate",
+            claim_uid=claim.metadata.uid,
+            claim=claim.metadata.name,
+        ):
+            self._deallocate(claim)
+
+    def _deallocate(self, claim: ResourceClaim) -> None:
         # Drop any pending (uncommitted) allocation regardless of NAS state —
         # the claim may never have reached the NAS, or may have been
         # re-cached by a concurrent scheduling pass.
@@ -499,6 +523,10 @@ class ControllerDriver:
             else:
                 raise ValueError(f"unknown AllocatedDevices type: {allocated.type()}")
             del nas.spec.allocated_claims[claim_uid]
+            # Drop the claim's traceparent annotation with its allocation.
+            nas.metadata.annotations.pop(
+                trace.nas_annotation_key(claim_uid), None
+            )
             client.update(nas.spec)
             self._note_node_write(selected_node, nas)
         if gang is not None and gang[2] == 0:
@@ -513,9 +541,7 @@ class ControllerDriver:
                     on_write=self._note_node_write,
                 )
             except Exception:
-                import logging
-
-                logging.getLogger(__name__).exception(
+                logger.exception(
                     "gang %s coordinator repair after rank-0 deallocate "
                     "failed",
                     gang[1],
@@ -572,8 +598,16 @@ class ControllerDriver:
     ) -> None:
         # Claim liveness is node-independent: resolve the dead pending set
         # once per fan-out, outside the per-node locks, then drop the dead
-        # entries cheaply inside each node's pass.
-        with UNSUITABLE_SECONDS.time():
+        # entries cheaply inside each node's pass.  (The per-node probes run
+        # on pool threads; contextvars don't cross them, so only this
+        # umbrella span is recorded — which is the granularity that matters
+        # for "why is scheduling slow".)
+        with trace.span(
+            "controller.unsuitable_nodes",
+            pod=pod.metadata.name,
+            claims=len(cas),
+            nodes=len(potential_nodes),
+        ), UNSUITABLE_SECONDS.time():
             dead = self._dead_pending_claims(potential_nodes)
             claims_fp = tuple(
                 sorted(

@@ -42,6 +42,7 @@ from tpu_dra.client.apiserver import ApiError, ConflictError, NotFoundError
 from tpu_dra.client.clientset import ClientSet
 from tpu_dra.controller.driver import ControllerDriver
 from tpu_dra.controller.types import ClaimAllocation
+from tpu_dra.utils import trace
 from tpu_dra.utils.metrics import SYNC_TOTAL, WORKQUEUE_DEPTH
 from tpu_dra.utils.events import TYPE_NORMAL, TYPE_WARNING, EventRecorder
 
@@ -354,18 +355,30 @@ class Controller:
         publish allocation + reservedFor in claim status."""
         if claim.status.allocation is not None:
             return
-        claims_client = self.clientset.resource_claims(claim.metadata.namespace)
-        if FINALIZER not in claim.metadata.finalizers:
-            claim.metadata.finalizers.append(FINALIZER)
-            claim = claims_client.update(claim)
-        allocation = self.driver.allocate(
-            claim, claim_params, resource_class, class_params, selected_node
-        )
-        claim.status.allocation = allocation
-        claim.status.driver_name = self.driver_name
-        if selected_user is not None:
-            claim.status.reserved_for.append(selected_user)
-        claims_client.update_status(claim)
+        # The trace ROOT for one claim's allocation lifecycle: the driver's
+        # controller.allocate span nests under it, the committed NAS
+        # annotation carries its context to the node plugin, and the plugin's
+        # plugin.node_prepare joins the same trace id on the other side.
+        with trace.span(
+            "controller.allocate_claim",
+            claim_uid=claim.metadata.uid,
+            claim=claim.metadata.name,
+            namespace=claim.metadata.namespace,
+            node=selected_node,
+        ):
+            claims_client = self.clientset.resource_claims(claim.metadata.namespace)
+            if FINALIZER not in claim.metadata.finalizers:
+                claim.metadata.finalizers.append(FINALIZER)
+                claim = claims_client.update(claim)
+            allocation = self.driver.allocate(
+                claim, claim_params, resource_class, class_params, selected_node
+            )
+            claim.status.allocation = allocation
+            claim.status.driver_name = self.driver_name
+            if selected_user is not None:
+                claim.status.reserved_for.append(selected_user)
+            with trace.span("controller.claim.update_status"):
+                claims_client.update_status(claim)
         # Immediate mode arrives with selected_node="" — report the node the
         # driver actually chose (recorded in the allocation's node selector).
         self.recorder.eventf(

@@ -30,6 +30,7 @@ from tpu_dra.client.apiserver import ApiError
 from tpu_dra.client.nasclient import NasClient
 from tpu_dra.client.retry import retry_on_conflict
 from tpu_dra.plugin.device_state import DeviceState
+from tpu_dra.utils import trace
 from tpu_dra.utils.metrics import ALLOCATED_CHIPS, PREPARE_SECONDS
 
 logger = logging.getLogger(__name__)
@@ -92,15 +93,41 @@ class NodeDriver:
 
     # -- gRPC-facing handlers ------------------------------------------------
 
-    def node_prepare_resource(self, claim_uid: str) -> list[str]:
+    def node_prepare_resource(
+        self, claim_uid: str, traceparent: str = ""
+    ) -> list[str]:
         """Idempotent prepare; returns qualified CDI device names
-        (driver.go:103-126)."""
+        (driver.go:103-126).
+
+        Trace parenting, best source first: the RPC's explicit traceparent,
+        the caller's ambient span, then the per-claim NAS annotation the
+        controller stamped when it committed the allocation — so the plugin
+        joins the allocating trace even when the kubelet (which knows
+        nothing of tracing) sits between the two processes."""
         with PREPARE_SECONDS.time():
             with self._lock:
                 is_prepared, devices = self._is_prepared(claim_uid)
+                # _is_prepared just refreshed the NAS: read the annotation
+                # under the same lock, from the same fresh copy.
+                parent = (
+                    trace.extract(traceparent)
+                    or trace.current_context()
+                    or trace.extract(
+                        self._nas.metadata.annotations.get(
+                            trace.nas_annotation_key(claim_uid), ""
+                        )
+                    )
+                )
+            with trace.span(
+                "plugin.node_prepare",
+                parent=parent,
+                claim_uid=claim_uid,
+                node=self._nas.metadata.name,
+            ) as sp:
                 if is_prepared:
+                    sp.add_event("idempotent_hit")
                     return devices
-            return self._prepare(claim_uid)
+                return self._prepare(claim_uid)
 
     def node_unprepare_resource(self, claim_uid: str) -> None:
         """Deliberate no-op — deferred to the NAS-watch GC
@@ -132,7 +159,10 @@ class NodeDriver:
         # per-claim concurrency story.  If the claim is deallocated while we
         # prepare, the NAS-watch GC unprepares it (deferred-unprepare
         # semantics, driver.go:128-133).
-        result = self._state.prepare(claim_uid, allocated)
+        with trace.span("plugin.device_prepare") as sp:
+            result = self._state.prepare(claim_uid, allocated)
+            sp.set_attribute("cdi_devices", len(result))
+            sp.add_event("cdi_emit", devices=list(result))
 
         # Phase 3 (locked, conflict-retried): publish the prepared state.
         def publish():
@@ -140,7 +170,14 @@ class NodeDriver:
                 self._client.get()
                 self._client.update(self._state.get_updated_spec(self._nas.spec))
 
-        retry_on_conflict(publish)
+        with trace.span("plugin.nas.publish"):
+            retry_on_conflict(publish)
+        logger.info(
+            "prepared claim %s on node %s (%d CDI device(s))",
+            claim_uid,
+            self._nas.metadata.name,
+            len(result),
+        )
         return result
 
     def unprepare(self, claim_uid: str) -> None:
@@ -157,7 +194,15 @@ class NodeDriver:
                 self._state.unprepare(claim_uid)
                 self._client.update(self._state.get_updated_spec(self._nas.spec))
 
-        retry_on_conflict(attempt)
+        # Fresh trace root: the controller prunes the claim's traceparent
+        # annotation in the same write that removes the allocation, so the
+        # GC's deferred unprepare has no parent to join.
+        with trace.span(
+            "plugin.unprepare",
+            claim_uid=claim_uid,
+            node=self._nas.metadata.name,
+        ):
+            retry_on_conflict(attempt)
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -26,6 +26,7 @@ import grpc
 
 from tpu_dra.plugin import wire
 from tpu_dra.plugin.driver import NodeDriver
+from tpu_dra.utils import trace
 
 logger = logging.getLogger(__name__)
 
@@ -73,7 +74,9 @@ class DRAPluginServer:
     ) -> wire.NodePrepareResourceResponse:
         logger.info("NodePrepareResource: %r", request)
         try:
-            devices = self._driver.node_prepare_resource(request.claim_uid)
+            devices = self._driver.node_prepare_resource(
+                request.claim_uid, traceparent=request.traceparent
+            )
         except Exception as e:
             logger.exception("NodePrepareResource failed")
             context.abort(grpc.StatusCode.INTERNAL, str(e))
@@ -207,7 +210,7 @@ class DRAClient:
 
     def node_prepare_resource(
         self, namespace: str, claim_uid: str, claim_name: str = "",
-        resource_handle: str = "",
+        resource_handle: str = "", traceparent: str = "",
     ) -> list[str]:
         call = self._channel.unary_unary(
             f"/{DRA_SERVICE}/NodePrepareResource",
@@ -220,6 +223,8 @@ class DRAClient:
                 claim_uid=claim_uid,
                 claim_name=claim_name,
                 resource_handle=resource_handle,
+                # Default: propagate the caller's ambient span, if any.
+                traceparent=traceparent or trace.inject(),
             )
         )
         return list(response.cdi_devices)

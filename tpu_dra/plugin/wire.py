@@ -125,11 +125,16 @@ class WireMessage:
 
 
 class NodePrepareResourceRequest(WireMessage):
+    # Field 5 is a driver-private extension carrying the W3C traceparent of
+    # the caller's span (utils/trace.py); decoders without it skip the field
+    # (proto3 unknown-field rule), so the wire stays compatible with stock
+    # kubelets — which simply never set it.
     FIELDS = {
         1: ("namespace", str),
         2: ("claim_uid", str),
         3: ("claim_name", str),
         4: ("resource_handle", str),
+        5: ("traceparent", str),
     }
 
 
@@ -138,6 +143,9 @@ class NodePrepareResourceResponse(WireMessage):
 
 
 class NodeUnprepareResourceRequest(WireMessage):
+    # No traceparent here: NodeUnprepareResource is a deliberate no-op
+    # (plugin/driver.py) and the deferred GC unprepare starts its own trace
+    # root, so the field would be wire surface nothing reads.
     FIELDS = {
         1: ("namespace", str),
         2: ("claim_uid", str),

@@ -15,10 +15,14 @@ Endpoints (paths configurable, matching the reference's --metrics-path /
 - ``GET /healthz`` / ``/readyz``  liveness/readiness
 - ``GET <pprof-path>/threads``    all-thread stack dump (goroutine analog)
 - ``GET <pprof-path>/profile?seconds=N``  all-thread sampling profile
+- ``GET <pprof-path>/traces?trace_id=&limit=&format=``  finished spans from
+  the in-memory exporter (utils/trace.py) as Chrome-trace-viewer JSON, or a
+  plain-text tree with ``format=text``
 """
 
 from __future__ import annotations
 
+import math
 import sys
 import threading
 import time
@@ -27,10 +31,23 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 
+def _escape_label_value(value: str) -> str:
+    """Escape per the Prometheus text-format spec: label values escape
+    backslash, double-quote, and line feed."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt_labels(labels: "dict[str, str]") -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
+    )
     return "{" + inner + "}"
 
 
@@ -213,6 +230,25 @@ INFORMER_FALLBACKS = REGISTRY.counter(
     "Fan-out NAS reads that fell back to a GET (unsynced cache or "
     "rv fence rejected a stale copy)",
 )
+TRACE_SPANS_TOTAL = REGISTRY.counter(
+    "tpu_dra_trace_spans_total",
+    "Finished trace spans by span name and OK/ERROR status (utils/trace.py)",
+)
+SPAN_SECONDS = REGISTRY.histogram(
+    "tpu_dra_span_seconds", "Trace span duration by span name"
+)
+BUILD_INFO = REGISTRY.gauge(
+    "tpu_dra_build_info",
+    "Build/version info; value is always 1, the labels carry the payload",
+)
+
+
+def set_build_info(component: str) -> None:
+    """Publish this binary's version as the conventional build-info gauge
+    (value 1, labels carry the payload) — called by each cmd at startup."""
+    from tpu_dra.version import version_string
+
+    BUILD_INFO.set(1, component=component, version=version_string())
 
 
 def _dump_threads() -> str:
@@ -255,6 +291,33 @@ def _profile(seconds: float, hz: float = 67.0) -> str:
     return "\n".join(out)
 
 
+class _BadQuery(ValueError):
+    """A malformed/out-of-range query parameter: surfaces as HTTP 400, not
+    the generic 500 an uncaught ValueError would produce."""
+
+
+def _query_float(query: dict, name: str, default: float, cap: float) -> float:
+    raw = query.get(name, [str(default)])[0]
+    try:
+        value = float(raw)
+    except ValueError:
+        raise _BadQuery(f"{name} must be a number, got {raw!r}") from None
+    if math.isnan(value) or math.isinf(value) or value <= 0:
+        raise _BadQuery(f"{name} must be a positive finite number, got {raw!r}")
+    return min(value, cap)
+
+
+def _query_int(query: dict, name: str, default: int, cap: int) -> int:
+    raw = query.get(name, [str(default)])[0]
+    try:
+        value = int(raw)
+    except ValueError:
+        raise _BadQuery(f"{name} must be an integer, got {raw!r}") from None
+    if value <= 0:
+        raise _BadQuery(f"{name} must be positive, got {raw!r}")
+    return min(value, cap)
+
+
 class MetricsServer:
     """Serve metrics + health + debug on one address, in a daemon thread."""
 
@@ -291,12 +354,45 @@ class MetricsServer:
                     elif parsed.path == f"{outer.pprof_path}/threads":
                         self._send(200, _dump_threads())
                     elif parsed.path == f"{outer.pprof_path}/profile":
-                        secs = float(parse_qs(parsed.query).get("seconds", ["5"])[0])
+                        query = parse_qs(parsed.query)
+                        secs = _query_float(query, "seconds", 5.0, cap=60.0)
                         self._send(200, _profile(secs))
+                    elif parsed.path == f"{outer.pprof_path}/traces":
+                        self._send_traces(parse_qs(parsed.query))
                     else:
                         self._send(404, "not found\n")
+                except _BadQuery as e:
+                    self._send(400, f"{e}\n")
                 except Exception as e:
                     self._send(500, f"{e}\n")
+
+            def _send_traces(self, query: dict) -> None:
+                # Local import: trace.py moves metrics on span exit, so the
+                # module pair must not form an import cycle at load time.
+                from tpu_dra.utils import trace
+
+                limit = _query_int(
+                    query, "limit", 1024, cap=trace.EXPORTER.capacity
+                )
+                trace_id = query.get("trace_id", [""])[0]
+                fmt = query.get("format", ["json"])[0]
+                if fmt not in ("json", "text"):
+                    raise _BadQuery(
+                        f"format must be json or text, got {fmt!r}"
+                    )
+                records = trace.EXPORTER.spans(
+                    trace_id=trace_id or None, limit=limit
+                )
+                if fmt == "text":
+                    self._send(200, trace.render_tree(records))
+                else:
+                    import json
+
+                    self._send(
+                        200,
+                        json.dumps(trace.chrome_trace(records)),
+                        "application/json",
+                    )
 
             def _send(self, code: int, body: str, ctype: str = "text/plain"):
                 data = body.encode()

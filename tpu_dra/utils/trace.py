@@ -1,0 +1,455 @@
+"""Claim-lifecycle distributed tracing (no third-party deps, the metrics.py
+house style).
+
+The controller and node plugin never talk directly — every allocation flows
+controller -> NAS CRD -> plugin -> CDI (api/nas_v1alpha1.py module doc), so
+"why is this claim stuck/slow?" is unanswerable from any single process's
+logs.  This module provides the missing per-request layer:
+
+- ``TraceContext``  — W3C-traceparent-style identity (32-hex trace id,
+  16-hex span id, 2-hex flags), serialized as
+  ``00-<trace_id>-<span_id>-<flags>`` so the wire form is directly usable
+  as an HTTP header / gRPC metadata value / object annotation.
+- ``Span``          — context manager with attributes, timestamped events,
+  and OK/ERROR status; exceptions escaping the block mark the span ERROR
+  (message recorded) and re-raise.
+- ambient propagation — a contextvar carries the active span, so nested
+  ``span()`` calls parent automatically and the JSON log formatter can
+  stamp trace/span ids onto every record without plumbing.
+- ``SpanExporter``  — lock-protected in-memory ring buffer of finished
+  spans, queried by the MetricsServer's ``/debug/traces`` endpoint
+  (Chrome-trace-viewer JSON or a plain-text tree).
+
+Cross-process propagation uses the channels the system already has:
+the controller serializes ``inject()`` into a per-claim NAS annotation
+(``nas_annotation_key``) when it commits an allocation, and the kubelet
+gRPC requests carry a ``traceparent`` field (plugin/wire.py) — so one trace
+covers Allocate -> NAS write -> informer pickup -> NodePrepareResource ->
+CDI emit.
+
+Every finished span also moves the ``tpu_dra_trace_spans_total`` counter and
+``tpu_dra_span_seconds`` histogram (utils/metrics.py), so traces and metrics
+cross-reference by span name.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+
+TRACEPARENT_VERSION = "00"
+
+# Annotation prefix on NAS objects carrying the allocating trace's context,
+# one key per claim uid: "trace.tpu.resource.google.com/<claim-uid>".
+NAS_ANNOTATION_PREFIX = "trace.tpu.resource.google.com"
+
+
+def nas_annotation_key(claim_uid: str) -> str:
+    return f"{NAS_ANNOTATION_PREFIX}/{claim_uid}"
+
+
+# -- trace context (W3C traceparent) -----------------------------------------
+
+
+def _rand_hex(nbytes: int) -> str:
+    value = os.urandom(nbytes).hex()
+    if set(value) == {"0"}:  # all-zero ids are invalid per W3C
+        return _rand_hex(nbytes)
+    return value
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Identity of one span within one trace."""
+
+    trace_id: str  # 32 lowercase hex chars
+    span_id: str  # 16 lowercase hex chars
+    flags: str = "01"  # sampled
+
+    def to_traceparent(self) -> str:
+        return f"{TRACEPARENT_VERSION}-{self.trace_id}-{self.span_id}-{self.flags}"
+
+    @classmethod
+    def new(cls) -> "TraceContext":
+        return cls(trace_id=_rand_hex(16), span_id=_rand_hex(8))
+
+    def child(self) -> "TraceContext":
+        return TraceContext(
+            trace_id=self.trace_id, span_id=_rand_hex(8), flags=self.flags
+        )
+
+
+# Strict lowercase-hex runs only: int(s, 16) would admit underscores, sign
+# prefixes, and whitespace, none of which are valid traceparent bytes.
+_HEX_RE = re.compile(r"[0-9a-f]+\Z")
+
+
+def _is_hex(s: str) -> bool:
+    return _HEX_RE.match(s) is not None
+
+
+def parse_traceparent(value: str) -> "TraceContext | None":
+    """Parse a traceparent string; None on any malformation (callers always
+    have the fallback of starting a fresh trace)."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if len(version) != 2 or not _is_hex(version) or version == "ff":
+        return None
+    if len(trace_id) != 32 or not _is_hex(trace_id) or set(trace_id) == {"0"}:
+        return None
+    if len(span_id) != 16 or not _is_hex(span_id) or set(span_id) == {"0"}:
+        return None
+    if len(flags) != 2 or not _is_hex(flags):
+        return None
+    return TraceContext(trace_id=trace_id, span_id=span_id, flags=flags)
+
+
+# Kept under the name the propagation call sites read naturally.
+extract = parse_traceparent
+
+
+# -- ambient propagation ------------------------------------------------------
+
+_CURRENT: "contextvars.ContextVar[Span | None]" = contextvars.ContextVar(
+    "tpu_dra_current_span", default=None
+)
+
+# Which binary this process is ("controller", "plugin", ...); stamps spans so
+# the Chrome trace viewer groups them into per-component tracks even when a
+# trace crosses processes.  The in-process SimCluster leaves it at the
+# default and relies on span-name prefixes instead.
+_COMPONENT = "tpu-dra"
+
+
+def set_component(name: str) -> None:
+    global _COMPONENT
+    _COMPONENT = name
+
+
+def current_span() -> "Span | None":
+    return _CURRENT.get()
+
+
+def current_context() -> "TraceContext | None":
+    span = _CURRENT.get()
+    return span.context if span is not None else None
+
+
+def inject(context: "TraceContext | None" = None) -> str:
+    """The traceparent to hand to the next hop ("" when no trace is live)."""
+    ctx = context or current_context()
+    return ctx.to_traceparent() if ctx is not None else ""
+
+
+# -- spans --------------------------------------------------------------------
+
+
+@dataclass
+class SpanEvent:
+    name: str
+    offset_s: float  # seconds since span start
+    attributes: dict = field(default_factory=dict)
+
+
+class Span:
+    """One timed operation.  Use via ``with trace.span(...) as sp:``."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        parent: "TraceContext | None" = None,
+        exporter: "SpanExporter | None" = None,
+        **attributes,
+    ):
+        self.name = name
+        self.attributes = {k: v for k, v in attributes.items() if v is not None}
+        ambient = _CURRENT.get()
+        if parent is not None:
+            self.context = parent.child()
+            self.parent_id = parent.span_id
+        elif ambient is not None:
+            self.context = ambient.context.child()
+            self.parent_id = ambient.context.span_id
+        else:
+            self.context = TraceContext.new()
+            self.parent_id = ""
+        # claim_uid rides down the span tree so every log line under an
+        # allocation carries it, not just the span that named it.
+        if "claim_uid" not in self.attributes and ambient is not None:
+            inherited = ambient.attributes.get("claim_uid")
+            if inherited is not None:
+                self.attributes["claim_uid"] = inherited
+        self.component = _COMPONENT
+        self.status = "OK"
+        self.status_message = ""
+        self.events: "list[SpanEvent]" = []
+        self._exporter = exporter
+        self._start_unix = 0.0
+        self._start_perf = 0.0
+        self.duration_s = 0.0
+        self._token: "contextvars.Token | None" = None
+
+    # -- recording ----------------------------------------------------------
+
+    def set_attribute(self, key: str, value) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, **attributes) -> None:
+        offset = time.perf_counter() - self._start_perf if self._start_perf else 0.0
+        self.events.append(SpanEvent(name, offset, dict(attributes)))
+
+    def set_status(self, status: str, message: str = "") -> None:
+        self.status = status
+        self.status_message = message
+
+    # -- context manager -----------------------------------------------------
+
+    def __enter__(self) -> "Span":
+        self._start_unix = time.time()
+        self._start_perf = time.perf_counter()
+        self._token = _CURRENT.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.duration_s = time.perf_counter() - self._start_perf
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+            self._token = None
+        if exc is not None:
+            self.status = "ERROR"
+            self.status_message = f"{type(exc).__name__}: {exc}"
+            self.events.append(
+                SpanEvent(
+                    "exception",
+                    self.duration_s,
+                    {"type": type(exc).__name__, "message": str(exc)},
+                )
+            )
+        (self._exporter or EXPORTER).export(self._record())
+        from tpu_dra.utils.metrics import SPAN_SECONDS, TRACE_SPANS_TOTAL
+
+        TRACE_SPANS_TOTAL.inc(name=self.name, status=self.status)
+        SPAN_SECONDS.observe(self.duration_s, name=self.name)
+        return False  # never swallow
+
+    def _record(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.context.trace_id,
+            "span_id": self.context.span_id,
+            "parent_id": self.parent_id,
+            "component": self.component,
+            "thread": threading.current_thread().name,
+            "start_unix_s": self._start_unix,
+            "duration_s": self.duration_s,
+            "status": self.status,
+            "status_message": self.status_message,
+            "attributes": dict(self.attributes),
+            "events": [
+                {"name": e.name, "offset_s": e.offset_s, "attributes": e.attributes}
+                for e in self.events
+            ],
+        }
+
+
+def span(
+    name: str,
+    *,
+    parent: "TraceContext | None" = None,
+    exporter: "SpanExporter | None" = None,
+    **attributes,
+) -> Span:
+    """A span context manager: parented to ``parent`` when given, else to
+    the ambient span, else a fresh trace root."""
+    return Span(name, parent=parent, exporter=exporter, **attributes)
+
+
+# -- exporter -----------------------------------------------------------------
+
+DEFAULT_CAPACITY = 4096
+
+
+class SpanExporter:
+    """Lock-protected in-memory ring buffer of finished span records.
+
+    Bounded so a long-lived process can't grow without limit; the debug
+    endpoint is for "what just happened", not long-term storage."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._spans: "list[dict]" = []
+
+    def export(self, record: dict) -> None:
+        with self._lock:
+            self._spans.append(record)
+            if len(self._spans) > self.capacity:
+                del self._spans[: len(self._spans) - self.capacity]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def spans(
+        self, trace_id: "str | None" = None, limit: "int | None" = None
+    ) -> "list[dict]":
+        """Newest-last snapshot, optionally filtered to one trace; ``limit``
+        keeps the most recent N after filtering."""
+        with self._lock:
+            out = list(self._spans)
+        if trace_id:
+            out = [r for r in out if r["trace_id"] == trace_id]
+        if limit is not None and limit < len(out):
+            out = out[len(out) - limit:]
+        return out
+
+
+EXPORTER = SpanExporter()
+
+
+# -- renderings ---------------------------------------------------------------
+
+
+def chrome_trace(records: "list[dict]") -> dict:
+    """Chrome trace-viewer JSON (chrome://tracing, Perfetto's legacy JSON
+    importer): complete "X" events in microseconds, with process/thread
+    metadata naming the component/thread tracks."""
+    pids: "dict[str, int]" = {}
+    tids: "dict[tuple[int, str], int]" = {}
+    events: "list[dict]" = []
+    for r in records:
+        pid = pids.setdefault(r["component"], len(pids) + 1)
+        tid = tids.setdefault((pid, r["thread"]), len(tids) + 1)
+        events.append(
+            {
+                "ph": "X",
+                "name": r["name"],
+                "cat": "tpu_dra",
+                "pid": pid,
+                "tid": tid,
+                "ts": r["start_unix_s"] * 1e6,
+                "dur": r["duration_s"] * 1e6,
+                "args": {
+                    "trace_id": r["trace_id"],
+                    "span_id": r["span_id"],
+                    "parent_id": r["parent_id"],
+                    "status": r["status"],
+                    **r["attributes"],
+                },
+            }
+        )
+    for component, pid in pids.items():
+        events.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": component},
+            }
+        )
+    for (pid, thread), tid in tids.items():
+        events.append(
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": thread},
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def render_tree(records: "list[dict]") -> str:
+    """Plain-text parent/child tree, one trace per block, spans in start
+    order.  Spans whose parent is outside the buffer print at root level."""
+    by_trace: "dict[str, list[dict]]" = {}
+    for r in records:
+        by_trace.setdefault(r["trace_id"], []).append(r)
+    out: "list[str]" = []
+    for trace_id in sorted(by_trace):
+        spans = sorted(by_trace[trace_id], key=lambda r: r["start_unix_s"])
+        ids = {r["span_id"] for r in spans}
+        children: "dict[str, list[dict]]" = {}
+        roots: "list[dict]" = []
+        for r in spans:
+            if r["parent_id"] and r["parent_id"] in ids:
+                children.setdefault(r["parent_id"], []).append(r)
+            else:
+                roots.append(r)
+        out.append(f"trace {trace_id} ({len(spans)} span(s))")
+
+        def emit(r: dict, depth: int) -> None:
+            attrs = " ".join(
+                f"{k}={v}" for k, v in sorted(r["attributes"].items())
+            )
+            line = (
+                f"{'  ' * depth}- {r['name']} "
+                f"[{r['component']}] {r['duration_s'] * 1e3:.2f}ms "
+                f"{r['status']}"
+            )
+            if r["status_message"]:
+                line += f" ({r['status_message']})"
+            if attrs:
+                line += f" {attrs}"
+            out.append(line)
+            for event in r["events"]:
+                out.append(
+                    f"{'  ' * (depth + 1)}@{event['offset_s'] * 1e3:.2f}ms "
+                    f"{event['name']}"
+                )
+            for child in children.get(r["span_id"], []):
+                emit(child, depth + 1)
+
+        for root in roots:
+            emit(root, 1)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+# -- structured logging -------------------------------------------------------
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per log line, stamped with the ambient trace context
+    (trace_id/span_id/claim_uid) so log lines and spans cross-reference.
+
+    Replaces/extends the plain formatter the reference's JSON logging
+    feature gate selects (pkg/flags/logging.go); wired by
+    ``--log-format=json`` (cmds/flags.py)."""
+
+    def __init__(self, component: "str | None" = None):
+        super().__init__()
+        self._component = component
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": self.formatTime(record),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        component = self._component or _COMPONENT
+        if component:
+            out["component"] = component
+        active = current_span()
+        if active is not None:
+            out["trace_id"] = active.context.trace_id
+            out["span_id"] = active.context.span_id
+            claim_uid = active.attributes.get("claim_uid")
+            if claim_uid:
+                out["claim_uid"] = claim_uid
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
