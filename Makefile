@@ -7,7 +7,8 @@ IMAGE   ?= tpu-dra-driver
 TAG     ?= latest
 
 .PHONY: all test lint generate-crds check-generate native native-test \
-        demo-quickstart bench image clean help observability-smoke
+        demo-quickstart bench image clean help observability-smoke \
+        perf-smoke
 
 all: lint test
 
@@ -48,6 +49,12 @@ bench:
 observability-smoke:
 	$(PYTHON) -m pytest tests/test_observability_smoke.py -q -m 'not slow'
 
+# In-process 8-node scheduling fan-out benchmark: asserts the availability
+# snapshot / placement caches hit (> 50% on repeated waves) and that the
+# cache counters appear in the metrics exposition (docs/PERFORMANCE.md).
+perf-smoke:
+	$(PYTHON) -m pytest tests/test_perf_smoke.py -q -m 'not slow'
+
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
@@ -58,4 +65,5 @@ clean:
 
 help:
 	@echo "targets: test lint generate-crds check-generate native native-test"
-	@echo "         demo-quickstart bench observability-smoke image clean"
+	@echo "         demo-quickstart bench observability-smoke perf-smoke"
+	@echo "         image clean"

@@ -175,6 +175,7 @@ def bench_fleet_scale(
                 "p95_s": round(out["p95_s"], 4),
                 "load_1m_start": out["load_1m_start"],
                 "cpu_s_per_pod": out["cpu_s_per_pod"],
+                "placement_cache_hit_rate": out["placement_cache_hit_rate"],
             }
         )
         if best is None or out["p95_s"] < best["p95_s"]:
@@ -191,7 +192,7 @@ def bench_fleet_scale(
 def _fleet_scale_once(
     nodes: int = 64, waves: int = 3, pods_per_wave: int = 16
 ) -> "dict":
-    """One fleet-scale attempt (VERDICT r3 weak #7): 64 nodes x 4 chips,
+    """One fleet-scale attempt (VERDICT r3 weak #7): ``nodes`` x 4 chips,
     pods with 2x2x1 topology claims churning against fragmentation.
 
     Each wave creates ``pods_per_wave`` pods concurrently, waits for all to
@@ -199,7 +200,9 @@ def _fleet_scale_once(
     wave.  Reports p50/p95 claim->Running across waves plus the
     UnsuitableNodes fan-out wall time (one scheduler pass probing every
     node under its per-node lock — the cost that grows with fleet size,
-    controller/driver.py unsuitable_nodes)."""
+    controller/driver.py unsuitable_nodes) and the placement-cache hit
+    rate (availability snapshots + search memos, docs/PERFORMANCE.md) the
+    repeated-wave workload achieves."""
     from tpu_dra.api.k8s import (
         Pod,
         PodResourceClaim,
@@ -235,6 +238,13 @@ def _fleet_scale_once(
         cluster.controller_driver.unsuitable_nodes = timed_fanout
         import os as _os
 
+        from tpu_dra.utils.metrics import (
+            PLACEMENT_CACHE_HITS,
+            PLACEMENT_CACHE_MISSES,
+        )
+
+        cache_hits0 = PLACEMENT_CACHE_HITS.total()
+        cache_misses0 = PLACEMENT_CACHE_MISSES.total()
         load_start = _os.getloadavg()[0] if hasattr(_os, "getloadavg") else -1.0
         cpu_t0 = time.process_time()
         cluster.start()
@@ -318,6 +328,9 @@ def _fleet_scale_once(
                 return values[int(q * (len(values) - 1))] if values else 0.0
 
             cpu_s = time.process_time() - cpu_t0
+            cache_hits = PLACEMENT_CACHE_HITS.total() - cache_hits0
+            cache_misses = PLACEMENT_CACHE_MISSES.total() - cache_misses0
+            cache_total = cache_hits + cache_misses
             return {
                 "nodes": nodes,
                 "chips": nodes * 4,
@@ -328,12 +341,176 @@ def _fleet_scale_once(
                 "fanout_p50_s": pct(fans, 0.50),
                 "fanout_p95_s": pct(fans, 0.95),
                 "fanout_samples": len(fans),
+                "placement_cache_hit_rate": round(
+                    cache_hits / cache_total if cache_total else 0.0, 4
+                ),
+                "placement_cache_hits": cache_hits,
+                "placement_cache_misses": cache_misses,
                 "load_1m_start": round(load_start, 2),
                 "cpu_s_per_pod": round(cpu_s / max(1, len(latencies)), 4),
                 "target_met": bool(lat and pct(lat, 0.95) < TARGET_S),
             }
         finally:
             cluster.stop()
+
+
+def bench_fanout_scale(
+    nodes: int = 128, pods: int = 16, passes: int = 6
+) -> "dict":
+    """Isolated UnsuitableNodes fan-out at 2x the north-star node count
+    (ISSUE 2 acceptance: fan-out p95 and placement-cache hit rate at 128
+    nodes).
+
+    The full-stack fleet stanza keeps its 64-node shape for round-over
+    -round comparability; at 128 nodes the in-process simulator (one full
+    node-plugin stack + watch threads per node) dominates wall time on
+    small CI boxes and would measure the sim, not the driver.  This stanza
+    isolates the path the acceptance names: ``nodes`` Ready NAS objects
+    behind the real informer, ``pods`` pods re-probed ``passes`` times (the
+    reconciler's repeated-wave reality — it re-syncs a scheduling context
+    on every watch tick), with a commit between waves so the own-write
+    invalidation path is exercised too.  Reports wall time per full
+    fan-out and the placement-cache hit rate over the workload."""
+    from tpu_dra.api import nas_v1alpha1 as nascrd
+    from tpu_dra.api.k8s import (
+        Pod,
+        ResourceClaim,
+        ResourceClaimSpec,
+        ResourceClass,
+    )
+    from tpu_dra.api.meta import ObjectMeta
+    from tpu_dra.api.tpu_v1alpha1 import (
+        DeviceClassParametersSpec,
+        TpuClaimParametersSpec,
+    )
+    from tpu_dra.client.apiserver import FakeApiServer
+    from tpu_dra.client.clientset import ClientSet
+    from tpu_dra.controller.driver import ControllerDriver
+    from tpu_dra.controller.types import ClaimAllocation
+    from tpu_dra.utils.metrics import (
+        PLACEMENT_CACHE_HITS,
+        PLACEMENT_CACHE_MISSES,
+    )
+
+    ns = "tpu-dra"
+    cs = ClientSet(FakeApiServer())
+    nas_client = cs.node_allocation_states(ns)
+    node_names = [f"fan-n{i}" for i in range(nodes)]
+    for i, name in enumerate(node_names):
+        devices = [
+            nascrd.AllocatableDevice(
+                tpu=nascrd.AllocatableTpu(
+                    index=j,
+                    uuid=f"{name}-chip-{j}",
+                    coord=(j % 2, j // 2, 0),
+                    ici_domain=name,
+                    cores=4,
+                    hbm_bytes=16 * 1024**3,
+                    product="tpu-v5e",
+                    generation="v5e",
+                    libtpu_version="1.10.0",
+                    runtime_version="2.0.0",
+                )
+            )
+            for j in range(4)
+        ]
+        nas_client.create(
+            nascrd.NodeAllocationState(
+                metadata=ObjectMeta(name=name, namespace=ns),
+                spec=nascrd.NodeAllocationStateSpec(
+                    allocatable_devices=devices, host_topology="2x2x1"
+                ),
+                status=nascrd.STATUS_READY,
+            )
+        )
+
+    driver = ControllerDriver(cs, ns)
+    hits0 = PLACEMENT_CACHE_HITS.total()
+    misses0 = PLACEMENT_CACHE_MISSES.total()
+    times: "list[float]" = []
+    try:
+        driver.start_nas_informer()
+        workload = []
+        for p in range(pods):
+            claim = cs.resource_claims(NS).create(
+                ResourceClaim(
+                    metadata=ObjectMeta(name=f"fan-c{p}", namespace=NS),
+                    spec=ResourceClaimSpec(
+                        resource_class_name="tpu.google.com"
+                    ),
+                )
+            )
+            workload.append(
+                (
+                    Pod(metadata=ObjectMeta(name=f"fan-p{p}", uid=f"fu{p}")),
+                    ClaimAllocation(
+                        claim=claim,
+                        class_=ResourceClass(),
+                        # One chip per claim: a pod's tentative pick is
+                        # seeded on EVERY suitable node, so whole-node
+                        # claims would let the first pod transiently
+                        # occupy the fleet (realistic, but it would turn
+                        # the whole stanza into one suitable + 15
+                        # trivially-unsuitable pods).
+                        claim_parameters=TpuClaimParametersSpec(count=1),
+                        class_parameters=DeviceClassParametersSpec(True),
+                    ),
+                )
+            )
+
+        def wave():
+            for pod, ca in workload:
+                if ca.claim.status.allocation is not None:
+                    continue
+                ca.unsuitable_nodes = []
+                t0 = time.perf_counter()
+                driver.unsuitable_nodes(pod, [ca], node_names)
+                times.append(time.perf_counter() - t0)
+
+        for _ in range(passes):
+            wave()
+        # Commit the pods that probed suitable (own-write invalidation +
+        # fragmentation; a tentative pick reserves a chip on EVERY node, so
+        # only the first ~chips-per-node pods fit before commits free the
+        # fleet-wide reservations), then everyone re-probes the changed
+        # fleet.
+        for k, (pod, ca) in enumerate(workload):
+            if ca.claim.status.allocation is not None:
+                continue
+            suitable = sorted(set(node_names) - set(ca.unsuitable_nodes))
+            if not suitable:
+                continue
+            ca.claim.status.allocation = driver.allocate(
+                ca.claim, ca.claim_parameters, ca.class_,
+                ca.class_parameters, suitable[k % len(suitable)],
+            )
+        for _ in range(passes):
+            wave()
+    finally:
+        driver.close()
+
+    hits = PLACEMENT_CACHE_HITS.total() - hits0
+    misses = PLACEMENT_CACHE_MISSES.total() - misses0
+    total = hits + misses
+    fans = sorted(times)
+
+    def pct(values, q):
+        return values[int(q * (len(values) - 1))] if values else 0.0
+
+    return {
+        "nodes": nodes,
+        "pods": pods,
+        "passes": passes * 2,
+        "fanout_p50_s": round(pct(fans, 0.50), 4),
+        "fanout_p95_s": round(pct(fans, 0.95), 4),
+        "fanout_max_s": round(fans[-1], 4) if fans else 0.0,
+        "fanout_samples": len(fans),
+        "placement_cache_hit_rate": round(
+            hits / total if total else 0.0, 4
+        ),
+        "placement_cache_hits": hits,
+        "placement_cache_misses": misses,
+    }
 
 
 def bench_wire(samples: int = 8) -> "dict":
@@ -1261,6 +1438,13 @@ def main() -> int:
         compute["tunnel_probe_trail"] = trail
     alloc = bench_claim_to_running(SAMPLES)
     fleet = bench_fleet_scale()
+    try:
+        # Isolated fan-out at 2x the north-star node count (ISSUE 2): the
+        # per-pass probe cost + cache hit rate, without the per-node sim
+        # stacks the full fleet stanza drags in.
+        fleet["fanout_128"] = bench_fanout_scale()
+    except Exception as e:
+        fleet["fanout_128"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         wire = bench_wire()
     except Exception as e:  # the wire rung must not sink the whole bench

@@ -1,0 +1,319 @@
+"""Availability snapshot cache (controller/availability.py): rv + pending
+fencing, invalidation by informer events / own writes / pending mutations,
+cross-pod snapshot + placement-memo reuse, and the correctness bar — a
+stale snapshot must never admit a double-booking (the commit path
+re-validates under the per-node lock)."""
+
+import dataclasses
+import time
+
+import pytest
+
+from helpers import make_plugin_stack
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api.k8s import (
+    Pod,
+    ResourceClaim,
+    ResourceClaimSpec,
+    ResourceClass,
+)
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.tpu_v1alpha1 import (
+    DeviceClassParametersSpec,
+    TpuClaimParametersSpec,
+)
+from tpu_dra.client import ClientSet, FakeApiServer, NasClient
+from tpu_dra.controller.availability import build_snapshot
+from tpu_dra.controller.driver import ControllerDriver
+from tpu_dra.controller.types import ClaimAllocation
+from tpu_dra.plugin.driver import NodeDriver
+from tpu_dra.utils.metrics import (
+    PLACEMENT_CACHE_HITS,
+    SNAPSHOT_HITS,
+    SNAPSHOT_INVALIDATIONS,
+)
+
+NS = "default"
+DRIVER_NS = "tpu-dra"
+NODE = "node-1"
+
+
+@pytest.fixture
+def cs():
+    return ClientSet(FakeApiServer())
+
+
+@pytest.fixture
+def driver(cs):
+    d = ControllerDriver(cs, DRIVER_NS)
+    yield d
+    d.close()
+
+
+def publish_node(tmp_path, cs, node=NODE, **kwargs):
+    """Run a real node plugin once to publish a Ready NAS."""
+    _, _, state = make_plugin_stack(tmp_path, cs, node=node, **kwargs)
+    nas = nascrd.NodeAllocationState(
+        metadata=ObjectMeta(name=node, namespace=DRIVER_NS)
+    )
+    NodeDriver(nas, NasClient(nas, cs), state, start_gc=False)
+    return state
+
+
+def make_ca(cs, name="c1", count=1):
+    claim = cs.resource_claims(NS).create(
+        ResourceClaim(
+            metadata=ObjectMeta(name=name, namespace=NS),
+            spec=ResourceClaimSpec(resource_class_name="tpu.google.com"),
+        )
+    )
+    return ClaimAllocation(
+        claim=claim,
+        class_=ResourceClass(),
+        claim_parameters=TpuClaimParametersSpec(count=count),
+        class_parameters=DeviceClassParametersSpec(True),
+    )
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def probe(driver, ca, pod=None, node=NODE):
+    driver.unsuitable_nodes(pod or Pod(), [ca], [node])
+    return ca
+
+
+class TestSnapshotInvalidation:
+    def test_probe_builds_and_reuses_snapshot(self, tmp_path, cs, driver):
+        publish_node(tmp_path, cs)
+        driver.start_nas_informer()
+        ca = probe(driver, make_ca(cs))
+        assert len(driver.availability) == 1
+        # The first (seeding) probe bumps the pending version AFTER its
+        # snapshot was built, so reachability starts with the second pass
+        # (which re-seeds the identical pick — no further bump).
+        driver._probe_memo.clear()
+        ca.unsuitable_nodes = []
+        probe(driver, ca)
+        rv = driver.nas_informer.get(NODE).metadata.resource_version
+        pvs = driver._pending_versions(NODE)
+        assert driver.availability.lookup(NODE, rv, pvs) is not None
+
+    def test_informer_event_busts_snapshot(self, tmp_path, cs, driver):
+        publish_node(tmp_path, cs)
+        driver.start_nas_informer()
+        probe(driver, make_ca(cs))
+        assert len(driver.availability) == 1
+        before = SNAPSHOT_INVALIDATIONS.value(reason="informer_event")
+
+        # Any NAS write by ANY actor (here: out-of-band annotation touch)
+        # flows through the watch and evicts the node's snapshot.
+        client = cs.node_allocation_states(DRIVER_NS)
+        nas = client.get(NODE)
+        nas.metadata.annotations["touched"] = "1"
+        client.update(nas)
+        assert wait_for(lambda: len(driver.availability) == 0)
+        assert SNAPSHOT_INVALIDATIONS.value(reason="informer_event") > before
+
+    def test_own_write_busts_snapshot(self, tmp_path, cs, driver):
+        publish_node(tmp_path, cs)
+        driver.start_nas_informer()
+        ca = probe(driver, make_ca(cs))
+        assert ca.unsuitable_nodes == []
+        assert len(driver.availability) == 1
+        before = SNAPSHOT_INVALIDATIONS.value(reason="own_write")
+
+        # Committing the claim writes the NAS: the _note_node_write fence
+        # must evict the snapshot synchronously (not waiting on the watch).
+        driver.allocate(
+            ca.claim, ca.claim_parameters, ResourceClass(),
+            DeviceClassParametersSpec(True), NODE,
+        )
+        assert SNAPSHOT_INVALIDATIONS.value(reason="own_write") > before
+
+    def test_pending_mutation_busts_snapshot(self, tmp_path, cs, driver):
+        publish_node(tmp_path, cs)
+        driver.start_nas_informer()
+        ca = probe(driver, make_ca(cs))
+        driver._probe_memo.clear()
+        ca.unsuitable_nodes = []
+        probe(driver, ca)  # second pass: snapshot now keyed at steady state
+        rv = driver.nas_informer.get(NODE).metadata.resource_version
+        assert (
+            driver.availability.lookup(NODE, rv, driver._pending_versions(NODE))
+            is not None
+        )
+
+        # A pending-cache mutation bumps the node's version: the snapshot
+        # keyed at the old version becomes unreachable.
+        driver.tpu.pending_allocated_claims.set(
+            "ghost-uid", NODE, nascrd.AllocatedDevices()
+        )
+        assert (
+            driver.availability.lookup(NODE, rv, driver._pending_versions(NODE))
+            is None
+        )
+
+    def test_reseeding_identical_pick_keeps_snapshot_reachable(
+        self, tmp_path, cs, driver
+    ):
+        # The flip side of the mutation fence: re-seeding an UNCHANGED pick
+        # (every re-probe of a steady-state node does this) must not bump
+        # the version, or a wave of pods would churn every node's
+        # fingerprint on every pass.
+        publish_node(tmp_path, cs)
+        driver.start_nas_informer()
+        ca = probe(driver, make_ca(cs))
+        pvs = driver._pending_versions(NODE)
+        driver._probe_memo.clear()  # force the pass below to re-run in full
+        ca.unsuitable_nodes = []
+        probe(driver, ca)
+        assert driver._pending_versions(NODE) == pvs
+
+    def test_snapshot_and_placement_memo_shared_across_pods(
+        self, tmp_path, cs, driver
+    ):
+        publish_node(tmp_path, cs)  # 4 chips
+        driver.start_nas_informer()
+        # An unsatisfiable probe seeds nothing, so the node's fingerprint
+        # holds still and a DIFFERENT pod's identical request reuses both
+        # the snapshot and the memoized (failed) placement search.
+        pod_a = Pod(metadata=ObjectMeta(name="pod-a", uid="ua"))
+        probe(driver, make_ca(cs, name="big-a", count=64), pod=pod_a)
+        hits_before = (SNAPSHOT_HITS.total(), PLACEMENT_CACHE_HITS.total())
+
+        pod_b = Pod(metadata=ObjectMeta(name="pod-b", uid="ub"))
+        ca_b = probe(driver, make_ca(cs, name="big-b", count=64), pod=pod_b)
+        assert ca_b.unsuitable_nodes == [NODE]
+        assert SNAPSHOT_HITS.total() > hits_before[0]
+        assert PLACEMENT_CACHE_HITS.total() > hits_before[1]
+
+
+class TestStaleSnapshotFence:
+    def test_stale_snapshot_cannot_double_book(self, tmp_path, cs, driver):
+        """Force a snapshot that shows chips free which are actually
+        committed: the probe may admit the placement (advisory), but the
+        commit path re-reads the NAS under the node lock and the promote
+        guard must reject the overlap — no double-booking, ever."""
+        publish_node(tmp_path, cs)  # 4 chips
+        driver.start_nas_informer()
+        driver.nas_informer.wait_synced(5.0)
+
+        client = cs.node_allocation_states(DRIVER_NS)
+        clean = client.get(NODE)
+        chips = [
+            d.tpu for d in clean.spec.allocatable_devices if d.tpu is not None
+        ]
+
+        # Out-of-band actor commits a claim holding two chips directly in
+        # the NAS (bypassing this driver's pending cache and write fence).
+        stranger = nascrd.AllocatedDevices(
+            claim_info=nascrd.ClaimInfo(namespace=NS, name="stranger", uid="s-1"),
+            tpu=nascrd.AllocatedTpus(
+                devices=[
+                    nascrd.AllocatedTpu(uuid=chips[0].uuid, coord=chips[0].coord),
+                    nascrd.AllocatedTpu(uuid=chips[1].uuid, coord=chips[1].coord),
+                ]
+            ),
+        )
+        taken = client.get(NODE)
+        taken.spec.allocated_claims["s-1"] = stranger
+        client.update(taken)
+        assert wait_for(
+            lambda: driver.nas_informer.get(NODE) is not None
+            and "s-1"
+            in driver.nas_informer.get(NODE).spec.allocated_claims
+        )
+
+        # Forge staleness: a snapshot built from the PRE-write document,
+        # re-keyed to the current rv + pending versions so the cache serves
+        # it (simulates any invalidation hole).
+        new_rv = driver.nas_informer.get(NODE).metadata.resource_version
+        pvs = driver._pending_versions(NODE)
+        stale = dataclasses.replace(
+            build_snapshot(NODE, clean, pvs), resource_version=str(new_rv)
+        )
+        driver.availability.store(stale)
+        assert len(stale.free_chips) == 4  # the lie: all chips free
+
+        # The advisory probe, fed the stale snapshot, admits a 4-chip
+        # placement that overlaps the stranger's chips...
+        ca = probe(driver, make_ca(cs, name="victim", count=4))
+        assert ca.unsuitable_nodes == []
+
+        # ...but the commit path re-validates against committed truth under
+        # the node lock and rejects it.
+        with pytest.raises(RuntimeError, match="overlaps committed"):
+            driver.allocate(
+                ca.claim, ca.claim_parameters, ResourceClass(),
+                DeviceClassParametersSpec(True), NODE,
+            )
+        nas = client.get(NODE)
+        assert ca.claim.metadata.uid not in nas.spec.allocated_claims
+        assert set(
+            d.uuid for d in nas.spec.allocated_claims["s-1"].tpu.devices
+        ) == {chips[0].uuid, chips[1].uuid}
+
+        # The rejected pick was dropped (version bump), so the forged
+        # snapshot is unreachable and the re-probe sees the truth: the node
+        # cannot fit 4 chips any more.
+        ca.unsuitable_nodes = []
+        probe(driver, ca)
+        assert ca.unsuitable_nodes == [NODE]
+
+
+class TestBatchAllocate:
+    def test_pod_claims_commit_in_one_nas_update(self, tmp_path, cs, driver):
+        publish_node(tmp_path, cs)
+        pod = Pod(metadata=ObjectMeta(name="p", uid="pu"))
+        cas = [make_ca(cs, name=f"c-{i}", count=1) for i in range(3)]
+        driver.unsuitable_nodes(pod, cas, [NODE])
+        assert all(ca.unsuitable_nodes == [] for ca in cas)
+
+        updates = []
+        orig_update = NasClient.update
+
+        def counting_update(self, spec):
+            updates.append(1)
+            return orig_update(self, spec)
+
+        NasClient.update = counting_update
+        try:
+            results = driver.allocate_batch(cas, NODE)
+        finally:
+            NasClient.update = orig_update
+        assert len(updates) == 1  # one apiserver round trip for the pod
+        assert set(results) == {ca.claim.metadata.uid for ca in cas}
+        nas = cs.node_allocation_states(DRIVER_NS).get(NODE)
+        for ca in cas:
+            assert ca.claim.metadata.uid in nas.spec.allocated_claims
+
+    def test_batch_partial_failure_commits_prefix_and_raises(
+        self, tmp_path, cs, driver
+    ):
+        publish_node(tmp_path, cs)  # 4 chips
+        pod = Pod(metadata=ObjectMeta(name="p2", uid="pu2"))
+        good = make_ca(cs, name="good", count=2)
+        driver.unsuitable_nodes(pod, [good], [NODE])
+        assert good.unsuitable_nodes == []
+        # A claim with NO pending pick: its promote fails retryably.
+        bad = make_ca(cs, name="bad", count=1)
+
+        with pytest.raises(RuntimeError, match="no allocations generated"):
+            driver.allocate_batch([good, bad], NODE)
+        nas = cs.node_allocation_states(DRIVER_NS).get(NODE)
+        # The sequential-path contract: claims before the failure committed.
+        assert good.claim.metadata.uid in nas.spec.allocated_claims
+        assert bad.claim.metadata.uid not in nas.spec.allocated_claims
+        # Retry is idempotent for the committed prefix.
+        driver.unsuitable_nodes(pod, [bad], [NODE])
+        results = driver.allocate_batch([good, bad], NODE)
+        assert set(results) == {
+            good.claim.metadata.uid, bad.claim.metadata.uid
+        }

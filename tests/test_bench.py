@@ -63,6 +63,19 @@ def test_bench_northstar_mesh_stanza():
     assert out["mesh"] == {"data": 2, "fsdp": 4, "model": 4, "expert": 2}
 
 
+def test_bench_fanout_scale_small():
+    """The isolated fan-out stanza (ISSUE 2): probes complete, the report
+    carries the acceptance keys, and the repeated-wave workload actually
+    hits the placement cache."""
+    import bench
+
+    out = bench.bench_fanout_scale(nodes=12, pods=4, passes=3)
+    assert out["nodes"] == 12
+    assert out["fanout_samples"] > 0
+    assert 0 <= out["fanout_p50_s"] <= out["fanout_p95_s"] < 30
+    assert out["placement_cache_hit_rate"] > 0.5
+
+
 def test_bench_wire_small():
     import bench
 

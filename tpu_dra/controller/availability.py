@@ -1,0 +1,320 @@
+"""Per-node availability snapshots for the scheduling fan-out.
+
+The UnsuitableNodes fan-out is the controller's hottest path: for every pod
+in a scheduling wave it probes every potential node, and each probe used to
+rebuild the node's entire free-availability picture — free whole chips, free
+subslice candidate placements, free core intervals — from the NAS plus the
+pending cache, then run the placement search from scratch.  That is
+O(pods x nodes x chips) work per wave even when nothing on a node changed
+(PAPER.md §1: the controller's view of a node is exactly the NAS the
+informer streams, so "nothing changed" is precisely decidable).
+
+This module makes the availability computation incremental:
+
+- ``NodeSnapshot`` — one node's free-availability summary, fenced by the
+  exact inputs it was computed from: the NAS ``resourceVersion`` and the
+  three per-node pending-cache mutation counters.  Any committed write or
+  pending mutation changes a fence component, so a stale snapshot is
+  unreachable by key (and additionally evicted by the event hooks below).
+- ``AvailabilityCache`` — the per-node snapshot store.  ``lookup`` serves a
+  snapshot only when every fence component matches the caller's current
+  state; the driver wires ``invalidate`` to NAS-informer events and to its
+  own committed writes (``_note_node_write``), so entries are also dropped
+  eagerly instead of lingering until a key mismatch.
+- ``build_snapshot`` — the one place the free-availability maps are
+  computed (the allocators consume them; previously each allocator rebuilt
+  its own slice of this picture on every probe).
+
+Correctness bar (ISSUE 2): a stale snapshot must never admit a
+double-booking.  Snapshots only ever feed the *advisory* scheduling probe;
+the commit path (``ControllerDriver.allocate``) re-reads the NAS fresh
+under the per-node lock and the allocators' promote-time overlap guards
+re-validate every pending pick against committed truth — so the worst a
+stale snapshot can cause is one scheduling retry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api.topology import Placement
+from tpu_dra.utils.metrics import (
+    SNAPSHOT_AGE,
+    SNAPSHOT_HITS,
+    SNAPSHOT_INVALIDATIONS,
+    SNAPSHOT_MISSES,
+)
+
+
+@dataclass(frozen=True)
+class SubslicePlacement:
+    """A concrete candidate: profile placed at a core interval of a chip
+    (MigDevicePlacement analog, mig.go:44-47)."""
+
+    parent_uuid: str
+    placement: Placement
+
+    def overlaps(self, other: "SubslicePlacement") -> bool:
+        return (
+            self.parent_uuid == other.parent_uuid
+            and self.placement.overlaps(other.placement)
+        )
+
+
+@dataclass(frozen=True)
+class NodeSnapshot:
+    """One node's free-availability picture at an exact (NAS rv, pending
+    versions) point.  All maps are treated as read-only by consumers —
+    snapshots are shared across probes."""
+
+    node: str
+    # Fence: the NAS resourceVersion string the snapshot was built from.
+    # Only informer-served reads carry a trustworthy rv (a GET fallback can
+    # race a write mid-pass), so snapshots are only built on that path.
+    resource_version: str
+    # Fence: (tpu, subslice, core) pending-cache mutation counters at build.
+    pending_versions: tuple[int, int, int]
+    built_at: float  # monotonic; feeds the snapshot-age gauge
+    # Free whole chips: uuid -> AllocatableTpu, after removing chips held by
+    # committed+pending whole-chip claims, subslice parents, and core parents.
+    free_chips: "dict[str, nascrd.AllocatableTpu]"
+    # Free subslice candidates: profile -> placements not overlapping any
+    # committed+pending subslice/core claim.
+    subslice_candidates: "dict[str, list[SubslicePlacement]]"
+    # Free core intervals inside each allocated subslice claim:
+    # parent claim uid -> unit-size free placements.
+    core_free_intervals: "dict[str, list[Placement]]"
+
+    @property
+    def fingerprint(self) -> tuple:
+        """The snapshot's identity — embedded in placement-memo keys so a
+        cached search result can only replay against bit-identical inputs."""
+        return (self.node, self.resource_version) + self.pending_versions
+
+
+# -- availability computation (the one implementation; allocators consume) --
+
+
+def compute_free_chips(
+    crd: nascrd.NodeAllocationState,
+) -> "dict[str, nascrd.AllocatableTpu]":
+    """Whole-chip availability: allocatable minus already-allocated (whole
+    chips, subslice parents, and — defense-in-depth — dangling core claims'
+    parents), gpu.go:114-135."""
+    available: "dict[str, nascrd.AllocatableTpu]" = {}
+    for device in crd.spec.allocatable_devices:
+        if device.type() == nascrd.TPU_DEVICE_TYPE:
+            available[device.tpu.uuid] = device.tpu
+
+    for allocation in crd.spec.allocated_claims.values():
+        if allocation.type() == nascrd.TPU_DEVICE_TYPE:
+            for dev in allocation.tpu.devices:
+                available.pop(dev.uuid, None)
+        elif allocation.type() == nascrd.SUBSLICE_DEVICE_TYPE:
+            for dev in allocation.subslice.devices:
+                available.pop(dev.parent_uuid, None)
+        elif allocation.type() == nascrd.CORE_DEVICE_TYPE:
+            # A dangling core claim (parent subslice deallocated out from
+            # under it) still pins its chip.
+            for dev in allocation.core.devices:
+                available.pop(dev.parent_uuid, None)
+    return available
+
+
+def compute_subslice_candidates(
+    crd: nascrd.NodeAllocationState,
+) -> "dict[str, list[SubslicePlacement]]":
+    """profile -> candidate placements on every partitionable chip, minus
+    those overlapping already-allocated subslices/cores (mig.go:122-169)."""
+    parents: "dict[str, list[str]]" = {}
+    for device in crd.spec.allocatable_devices:
+        if device.type() != nascrd.TPU_DEVICE_TYPE:
+            continue
+        if not device.tpu.partitionable:
+            continue
+        parents.setdefault(device.tpu.product, []).append(device.tpu.uuid)
+
+    candidates: "dict[str, list[SubslicePlacement]]" = {}
+    for device in crd.spec.allocatable_devices:
+        if device.type() != nascrd.SUBSLICE_DEVICE_TYPE:
+            continue
+        entry = []
+        for parent_uuid in parents.get(device.subslice.parent_product, []):
+            for p in device.subslice.placements:
+                entry.append(SubslicePlacement(parent_uuid, p))
+        candidates[device.subslice.profile] = entry
+
+    for allocation in crd.spec.allocated_claims.values():
+        if allocation.type() == nascrd.SUBSLICE_DEVICE_TYPE:
+            taken_devices = [
+                SubslicePlacement(d.parent_uuid, d.placement)
+                for d in allocation.subslice.devices
+            ]
+        elif allocation.type() == nascrd.CORE_DEVICE_TYPE:
+            # Core claims occupy real cores on the parent chip too — without
+            # this, a dangling core claim's interval could be re-carved into
+            # a fresh overlapping subslice.
+            taken_devices = [
+                SubslicePlacement(d.parent_uuid, d.placement)
+                for d in allocation.core.devices
+            ]
+        else:
+            continue
+        for taken in taken_devices:
+            for profile in candidates:
+                candidates[profile] = [
+                    c for c in candidates[profile] if not c.overlaps(taken)
+                ]
+    return candidates
+
+
+def compute_free_intervals(
+    crd: nascrd.NodeAllocationState,
+    parent_uid: str,
+    parent_dev: nascrd.AllocatedSubslice,
+) -> "list[Placement]":
+    """Free unit gaps of one allocated subslice claim's placement: parent
+    cores minus core claims already carved from this parent claim."""
+    start = parent_dev.placement.start
+    size = parent_dev.placement.size
+    taken = [False] * size
+    for allocation in crd.spec.allocated_claims.values():
+        if allocation.core is None:
+            continue
+        for dev in allocation.core.devices:
+            if dev.subslice_claim_uid != parent_uid:
+                continue
+            for c in range(
+                dev.placement.start, dev.placement.start + dev.placement.size
+            ):
+                if start <= c < start + size:
+                    taken[c - start] = True
+    return [Placement(start + i, 1) for i in range(size) if not taken[i]]
+
+
+def compute_core_free_intervals(
+    crd: nascrd.NodeAllocationState,
+) -> "dict[str, list[Placement]]":
+    """Free core intervals for every allocated subslice claim on the node."""
+    out: "dict[str, list[Placement]]" = {}
+    for uid, allocation in crd.spec.allocated_claims.items():
+        if allocation.subslice is None or not allocation.subslice.devices:
+            continue
+        out[uid] = compute_free_intervals(
+            crd, uid, allocation.subslice.devices[0]
+        )
+    return out
+
+
+def build_snapshot(
+    node: str,
+    crd: nascrd.NodeAllocationState,
+    pending_versions: tuple[int, int, int],
+) -> NodeSnapshot:
+    """Compute one node's snapshot from a merged (NAS + pending) document.
+    The caller must have synced the pending caches into ``crd`` first —
+    ``pending_versions`` fences exactly that merged state."""
+    return NodeSnapshot(
+        node=node,
+        resource_version=str(crd.metadata.resource_version or ""),
+        pending_versions=pending_versions,
+        built_at=time.monotonic(),
+        free_chips=compute_free_chips(crd),
+        subslice_candidates=compute_subslice_candidates(crd),
+        core_free_intervals=compute_core_free_intervals(crd),
+    )
+
+
+# Which cache currently backs the process-global snapshot-age gauge (see
+# register_age_gauge).
+_AGE_GAUGE_LOCK = threading.Lock()
+_AGE_GAUGE_OWNER: "AvailabilityCache | None" = None
+
+
+class AvailabilityCache:
+    """Per-node NodeSnapshot store with rv + pending-version fencing.
+
+    One snapshot per node (the latest); bounded by fleet size.  Reads are
+    served only on an exact fence match, so the cache can never hand out a
+    picture older than the caller's own view of the node."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snapshots: "dict[str, NodeSnapshot]" = {}
+
+    def lookup(
+        self,
+        node: str,
+        resource_version: str,
+        pending_versions: tuple[int, int, int],
+    ) -> "NodeSnapshot | None":
+        with self._lock:
+            snap = self._snapshots.get(node)
+        if (
+            snap is not None
+            and snap.resource_version == str(resource_version or "")
+            and snap.pending_versions == pending_versions
+        ):
+            SNAPSHOT_HITS.inc()
+            return snap
+        SNAPSHOT_MISSES.inc()
+        return None
+
+    def store(self, snap: NodeSnapshot) -> None:
+        with self._lock:
+            self._snapshots[snap.node] = snap
+
+    def invalidate(self, node: str, reason: str) -> None:
+        """Evict a node's snapshot (informer event / own committed write).
+        Key fencing already makes stale entries unreachable; eager eviction
+        keeps memory and the age gauge honest, and the reason label makes
+        invalidation traffic observable."""
+        with self._lock:
+            dropped = self._snapshots.pop(node, None) is not None
+        if dropped:
+            SNAPSHOT_INVALIDATIONS.inc(reason=reason)
+
+    def invalidate_all(self, reason: str) -> None:
+        """Evict everything (informer relist: per-node deltas unknown)."""
+        with self._lock:
+            dropped = len(self._snapshots)
+            self._snapshots.clear()
+        if dropped:
+            SNAPSHOT_INVALIDATIONS.inc(dropped, reason=reason)
+
+    def max_age_s(self) -> float:
+        """Age of the oldest cached snapshot (the snapshot-age gauge's
+        sample; 0 when empty)."""
+        now = time.monotonic()
+        with self._lock:
+            if not self._snapshots:
+                return 0.0
+            oldest = min(s.built_at for s in self._snapshots.values())
+        return now - oldest
+
+    def register_age_gauge(self) -> None:
+        """Claim the (unlabeled, process-global) age gauge.  Registration
+        is last-writer-wins across caches — same tradeoff as
+        WORKQUEUE_DEPTH — but unregistration is owner-guarded so a closing
+        driver can never silence a still-running one's sampler."""
+        global _AGE_GAUGE_OWNER
+        with _AGE_GAUGE_LOCK:
+            _AGE_GAUGE_OWNER = self
+            SNAPSHOT_AGE.set_function(self.max_age_s)
+
+    def unregister_age_gauge(self) -> None:
+        """Drop the scrape-time sampler so the process-global registry
+        doesn't pin this cache after its driver closes — only if this
+        cache is still the registered owner."""
+        global _AGE_GAUGE_OWNER
+        with _AGE_GAUGE_LOCK:
+            if _AGE_GAUGE_OWNER is self:
+                _AGE_GAUGE_OWNER = None
+                SNAPSHOT_AGE.remove_function()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._snapshots)

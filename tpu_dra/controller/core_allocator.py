@@ -33,6 +33,7 @@ from tpu_dra.api import serde
 from tpu_dra.api import tpu_v1alpha1 as tpucrd
 from tpu_dra.api.k8s import Pod, ResourceClaim
 from tpu_dra.api.topology import Placement
+from tpu_dra.controller.availability import NodeSnapshot, compute_free_intervals
 from tpu_dra.controller.pending import PerNodeAllocatedClaims
 from tpu_dra.controller.types import ClaimAllocation
 
@@ -143,14 +144,12 @@ class CoreDriver:
     def deallocate(self, crd: nascrd.NodeAllocationState, claim: ResourceClaim) -> None:
         self.pending_allocated_claims.remove(claim.metadata.uid)
 
-    def unsuitable_node(
-        self,
-        crd: nascrd.NodeAllocationState,
-        pod: Pod,
-        corecas: list[ClaimAllocation],
-        allcas: list[ClaimAllocation],
-        potential_node: str,
+    def sync_pending(
+        self, crd: nascrd.NodeAllocationState, potential_node: str
     ) -> None:
+        """Re-sync the pending cache with the NAS truth (see
+        TpuDriver.sync_pending)."""
+
         def sync(claim_uid: str, allocation: nascrd.AllocatedDevices) -> None:
             if claim_uid in crd.spec.allocated_claims:
                 self.pending_allocated_claims.remove(claim_uid)
@@ -159,10 +158,29 @@ class CoreDriver:
 
         self.pending_allocated_claims.visit_node(potential_node, sync)
 
+    def unsuitable_node(
+        self,
+        crd: nascrd.NodeAllocationState,
+        pod: Pod,
+        corecas: list[ClaimAllocation],
+        allcas: list[ClaimAllocation],
+        potential_node: str,
+        snapshot: "NodeSnapshot | None" = None,
+        presynced: bool = False,
+        stats: "dict | None" = None,
+    ) -> None:
+        if not presynced:
+            self.sync_pending(crd, potential_node)
+
         if not corecas:
             return
 
-        placements = self._allocate(crd, pod, corecas)
+        # Core searches have no memo layer (the parents are usually placed
+        # in the same pass); a cache-eligible probe that reaches them ran a
+        # real search.
+        if stats is not None:
+            stats["core"] = "miss"
+        placements = self._allocate(crd, pod, corecas, snapshot)
         if placements is None or len(placements) != len(corecas):
             for other in allcas:
                 other.unsuitable_nodes.append(potential_node)
@@ -229,30 +247,26 @@ class CoreDriver:
     def _free_intervals(
         self, crd: nascrd.NodeAllocationState, parent_uid: str,
         parent_dev: nascrd.AllocatedSubslice,
+        snapshot: "NodeSnapshot | None" = None,
     ) -> "list[Placement]":
         """Free unit gaps of the parent placement: parent cores minus core
-        claims already carved from this parent claim."""
-        start = parent_dev.placement.start
-        size = parent_dev.placement.size
-        taken = [False] * size
-        for allocation in crd.spec.allocated_claims.values():
-            if allocation.core is None:
-                continue
-            for dev in allocation.core.devices:
-                if dev.subslice_claim_uid != parent_uid:
-                    continue
-                for c in range(dev.placement.start, dev.placement.start + dev.placement.size):
-                    if start <= c < start + size:
-                        taken[c - start] = True
-        return [
-            Placement(start + i, 1) for i in range(size) if not taken[i]
-        ]
+        claims already carved from this parent claim.  Served from the node
+        snapshot when the parent was already allocated at snapshot time
+        (parents placed earlier in THIS pass are absent from it and compute
+        live); within a pass crd gains no core claims until after the
+        search, so the snapshot's intervals stay exact."""
+        if snapshot is not None:
+            cached = snapshot.core_free_intervals.get(parent_uid)
+            if cached is not None:
+                return cached  # read-only: consumers never mutate intervals
+        return compute_free_intervals(crd, parent_uid, parent_dev)
 
     def _allocate(
         self,
         crd: nascrd.NodeAllocationState,
         pod: Pod,
         corecas: list[ClaimAllocation],
+        snapshot: "NodeSnapshot | None" = None,
     ) -> "dict[str, CorePlacement] | None":
         possible: dict[str, list[CorePlacement]] = {}
         for ca in corecas:
@@ -273,7 +287,7 @@ class CoreDriver:
             for parent_uid, parent_dev in self._parents_by_name(
                 crd, pod, params.subslice_claim_name
             ):
-                free = self._free_intervals(crd, parent_uid, parent_dev)
+                free = self._free_intervals(crd, parent_uid, parent_dev, snapshot)
                 # Contiguous runs of `want` free cores.
                 free_starts = {p.start for p in free}
                 for p in free:

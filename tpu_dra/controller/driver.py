@@ -16,6 +16,10 @@ from __future__ import annotations
 
 import logging
 import threading
+import time as _time
+# Hoisted to module level: both used on the scheduling hot path (every
+# fan-out / every probe), where a per-call import is measurable overhead.
+from concurrent.futures import ThreadPoolExecutor, wait
 from typing import Any
 
 from tpu_dra.api import nas_v1alpha1 as nascrd, tpu_v1alpha1 as tpucrd
@@ -28,18 +32,22 @@ from tpu_dra.api.k8s import (
     get_selected_node,
 )
 from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.client.apiserver import ApiError, NotFoundError
 from tpu_dra.client.clientset import ClientSet
 from tpu_dra.client.nasclient import NasClient
+from tpu_dra.controller.availability import AvailabilityCache, build_snapshot
 from tpu_dra.controller.core_allocator import CoreDriver
 from tpu_dra.controller.nodelock import PerNodeMutex
 from tpu_dra.controller.subslice_allocator import SubsliceDriver
 from tpu_dra.controller.tpu_allocator import TpuDriver
-from tpu_dra.controller.types import ClaimAllocation
+from tpu_dra.controller.types import ClaimAllocation, params_fingerprint
 from tpu_dra.utils import trace
 from tpu_dra.utils.metrics import (
     ALLOCATE_SECONDS,
     INFORMER_FALLBACKS,
     INFORMER_READS,
+    PLACEMENT_CACHE_HITS,
+    PLACEMENT_CACHE_MISSES,
     PROBE_MEMO_HITS,
     PROBE_MEMO_MISSES,
     UNSUITABLE_SECONDS,
@@ -49,17 +57,6 @@ DRIVER_NAME = tpucrd.GROUP_NAME
 DRIVER_API_GROUP = tpucrd.GROUP_NAME
 
 logger = logging.getLogger(__name__)
-
-
-def _params_key(ca: ClaimAllocation) -> str:
-    """Canonical fingerprint of a claim's resolved parameters (probe memo
-    key component — two passes with identical params + identical node state
-    derive identical verdicts)."""
-    import json
-
-    from tpu_dra.api import serde
-
-    return json.dumps(serde.to_dict(ca.claim_parameters), sort_keys=True)
 
 
 class ControllerDriver:
@@ -89,7 +86,14 @@ class ControllerDriver:
         # fall back to a fresh GET.
         self._node_write_rv: "dict[str, int]" = {}
         self._write_rv_lock = threading.Lock()
-        # Probe memo: (node, pod, nas rv, pending versions, claim-set key)
+        # Availability snapshot cache (controller/availability.py): one
+        # per-node free-state summary, fenced by NAS resourceVersion +
+        # pending-cache versions, invalidated by informer events and our
+        # own committed writes.  A probe that misses every memo still skips
+        # the full availability rebuild when the node hasn't changed.
+        self.availability = AvailabilityCache()
+        self.availability.register_age_gauge()
+        # Probe memo: (snapshot fingerprint, pod, claim-set key)
         # -> which of those claims found the node unsuitable.  The
         # reconciler re-syncs a PodSchedulingContext on every watch tick
         # (its own status writes included), so probe passes repeat in
@@ -105,7 +109,11 @@ class ControllerDriver:
         self._probe_memo: "dict[tuple, tuple[float, dict[str, bool]]]" = {}
         self._probe_memo_lock = threading.Lock()
         self.PROBE_MEMO_CAP = 8192
-        self.PROBE_MEMO_TTL_S = 2.0
+        # 5s: long enough that a fleet-sized seeding pass (which can take
+        # seconds on small boxes) doesn't expire its own entries before
+        # the replay wave, still two orders of magnitude under the 300s
+        # pending TTL the window is bounding against.
+        self.PROBE_MEMO_TTL_S = 5.0
         # The dead-pending sweep costs one claim GET per distinct pending
         # entry per fan-out; with W pods scheduling concurrently that is
         # O(W²) GETs per wave for a result that rarely changes.  It is
@@ -128,10 +136,20 @@ class ControllerDriver:
             return
         from tpu_dra.controller.nasinformer import NasInformer
 
-        self.nas_informer = NasInformer(self.clientset, self.namespace)
+        self.nas_informer = NasInformer(
+            self.clientset, self.namespace, on_event=self._on_nas_event
+        )
         self.nas_informer.start()
         if wait_synced_s:
             self.nas_informer.wait_synced(wait_synced_s)
+
+    def _on_nas_event(self, node: "str | None") -> None:
+        """Informer hook: a NAS changed (or a relist replaced the store,
+        node=None) — evict the affected availability snapshot(s)."""
+        if node is None:
+            self.availability.invalidate_all("informer_relist")
+        else:
+            self.availability.invalidate(node, "informer_event")
 
     # -- gang audit loop ------------------------------------------------------
 
@@ -242,7 +260,10 @@ class ControllerDriver:
         return nas, NasClient(nas, self.clientset)
 
     def _note_node_write(self, node: str, nas: nascrd.NodeAllocationState) -> None:
-        """Record our committed write's resourceVersion (informer fence)."""
+        """Record our committed write's resourceVersion (informer fence)
+        and evict the node's availability snapshot — the free-state picture
+        it summarizes just changed under it."""
+        self.availability.invalidate(node, "own_write")
         try:
             rv = int(nas.metadata.resource_version or "0")
         except (TypeError, ValueError):
@@ -355,106 +376,186 @@ class ControllerDriver:
         class_params: tpucrd.DeviceClassParametersSpec,
         selected_node: str,
     ) -> AllocationResult:
-        with trace.span(
-            "controller.allocate",
-            claim_uid=claim.metadata.uid,
-            claim=claim.metadata.name,
-            node=selected_node,
-        ) as sp, ALLOCATE_SECONDS.time(), self.lock.locked(selected_node):
-            nas, client = self._nas_client(selected_node)
-            client.get()
+        ca = ClaimAllocation(
+            claim=claim,
+            class_=resource_class,
+            claim_parameters=claim_params,
+            class_parameters=class_params,
+        )
+        return self.allocate_batch([ca], selected_node)[claim.metadata.uid]
 
-            claim_uid = claim.metadata.uid
-            if claim_uid in nas.spec.allocated_claims:
-                # Idempotent retry (e.g. claim-status write lost a conflict
-                # after the NAS commit): report the class's real shareability
-                # — the reference hardcodes true here (driver.go:134), which
-                # would advertise an exclusive claim as shareable.
-                sp.add_event("idempotent_retry")
-                return build_allocation_result(
-                    selected_node, bool(class_params.shareable)
-                )
-
-            if nas.status != nascrd.STATUS_READY:
-                raise RuntimeError(f"NodeAllocationState status: {nas.status}")
-
-            if isinstance(claim_params, tpucrd.TpuClaimParametersSpec):
-                on_success = self.tpu.allocate(
-                    nas, claim, claim_params, class_params, selected_node
-                )
-            elif isinstance(claim_params, tpucrd.SubsliceClaimParametersSpec):
-                on_success = self.subslice.allocate(
-                    nas, claim, claim_params, class_params, selected_node
-                )
-            elif isinstance(claim_params, tpucrd.CoreClaimParametersSpec):
-                on_success = self.core.allocate(
-                    nas, claim, claim_params, class_params, selected_node
-                )
-            else:
-                raise ValueError(
-                    f"unknown claim parameters type: {type(claim_params).__name__}"
-                )
-
-            allocated = nas.spec.allocated_claims[claim_uid]
-            allocated.claim_info = nascrd.ClaimInfo(
-                namespace=claim.metadata.namespace,
-                name=claim.metadata.name,
-                uid=claim_uid,
+    def _promote_locked(
+        self, nas: nascrd.NodeAllocationState, ca: ClaimAllocation,
+        selected_node: str,
+    ) -> "tuple[Any, str | None]":
+        """Promote one claim's pending pick into the in-memory NAS (caller
+        holds the node lock and has GET a fresh document).  Returns the
+        pending-cache on_success callback and the gang name (if any)."""
+        claim, claim_params = ca.claim, ca.claim_parameters
+        class_params = ca.class_parameters
+        if isinstance(claim_params, tpucrd.TpuClaimParametersSpec):
+            on_success = self.tpu.allocate(
+                nas, claim, claim_params, class_params, selected_node
             )
-            gang_name = None
-            if (
-                isinstance(claim_params, tpucrd.TpuClaimParametersSpec)
-                and claim_params.gang is not None
-                and allocated.tpu is not None
-            ):
-                allocated.tpu.gang = self.gangs.assign(
-                    claim_params.gang,
-                    claim.metadata.namespace,
-                    claim_uid,
-                    selected_node,
-                )
-                gang_name = claim_params.gang.name
-            # Serialize this trace into the NAS annotation the node plugin
-            # reads at prepare time — the allocation's only cross-process
-            # channel, so the traceparent rides the same write.
-            nas.metadata.annotations[trace.nas_annotation_key(claim_uid)] = (
-                trace.inject()
+        elif isinstance(claim_params, tpucrd.SubsliceClaimParametersSpec):
+            on_success = self.subslice.allocate(
+                nas, claim, claim_params, class_params, selected_node
             )
-            with trace.span("controller.nas.update", node=selected_node):
-                client.update(nas.spec)
-            self._note_node_write(selected_node, nas)
-            self.gangs.commit(
-                claim_uid, claim.metadata.namespace, gang_name
+        elif isinstance(claim_params, tpucrd.CoreClaimParametersSpec):
+            on_success = self.core.allocate(
+                nas, claim, claim_params, class_params, selected_node
             )
-            on_success()
-            logger.info(
-                "allocated claim %s/%s on node %s",
+        else:
+            raise ValueError(
+                f"unknown claim parameters type: {type(claim_params).__name__}"
+            )
+
+        claim_uid = claim.metadata.uid
+        allocated = nas.spec.allocated_claims[claim_uid]
+        allocated.claim_info = nascrd.ClaimInfo(
+            namespace=claim.metadata.namespace,
+            name=claim.metadata.name,
+            uid=claim_uid,
+        )
+        gang_name = None
+        if (
+            isinstance(claim_params, tpucrd.TpuClaimParametersSpec)
+            and claim_params.gang is not None
+            and allocated.tpu is not None
+        ):
+            allocated.tpu.gang = self.gangs.assign(
+                claim_params.gang,
                 claim.metadata.namespace,
-                claim.metadata.name,
+                claim_uid,
                 selected_node,
             )
-        if gang_name is not None and self.gangs.take_repair_hint(
-            claim.metadata.namespace, gang_name
-        ):
-            # Outside the node lock (repair writes other nodes' NAS under
-            # their own locks): reconcile members committed against a
-            # tentative or since-moved rank-0 coordinator.  Best-effort:
-            # the allocation itself already committed, so a repair failure
-            # must not surface as an allocation failure — the hint fires
-            # again on the next assign, and the plugin-side refresh is
-            # level-triggered.
-            try:
-                self.gangs.repair_coordinators(
-                    claim.metadata.namespace, gang_name, node_lock=self.lock,
-                    on_write=self._note_node_write,
-                )
-            except Exception:
-                logger.exception(
-                    "gang %s coordinator repair failed (will retry on next "
-                    "member allocation)",
-                    gang_name,
-                )
-        return build_allocation_result(selected_node, bool(class_params.shareable))
+            gang_name = claim_params.gang.name
+        # Serialize this trace into the NAS annotation the node plugin
+        # reads at prepare time — the allocation's only cross-process
+        # channel, so the traceparent rides the same write.
+        nas.metadata.annotations[trace.nas_annotation_key(claim_uid)] = (
+            trace.inject()
+        )
+        return on_success, gang_name
+
+    def allocate_batch(
+        self,
+        cas: list[ClaimAllocation],
+        selected_node: str,
+        parents: "dict[str, trace.TraceContext] | None" = None,
+    ) -> "dict[str, AllocationResult]":
+        """Commit every claim of one pod on the scheduler-selected node with
+        ONE NAS update.  The per-claim path used to pay one GET + one UPDATE
+        apiserver round trip per claim; a pod's claims all land on the same
+        node, so the whole batch shares a single locked GET/UPDATE pair.
+
+        Semantics match the sequential path: claims promote in order; if
+        one fails, the claims promoted before it still commit (one update)
+        and the error propagates — the reconciler's retry then takes the
+        idempotent path for the committed ones.  ``parents`` optionally
+        maps claim uid -> the claim's lifecycle trace root so each claim's
+        commit spans join its own trace."""
+        parents = parents or {}
+        results: "dict[str, AllocationResult]" = {}
+        # (ca, on_success, gang_name, per-claim trace context):
+        promoted: "list[tuple[ClaimAllocation, Any, str | None, Any]]" = []
+        error: "Exception | None" = None
+        with ALLOCATE_SECONDS.time(), self.lock.locked(selected_node):
+            nas, client = self._nas_client(selected_node)
+            client.get()
+            for ca in cas:
+                claim = ca.claim
+                claim_uid = claim.metadata.uid
+                with trace.span(
+                    "controller.allocate",
+                    parent=parents.get(claim_uid),
+                    claim_uid=claim_uid,
+                    claim=claim.metadata.name,
+                    node=selected_node,
+                ) as sp:
+                    if claim_uid in nas.spec.allocated_claims:
+                        # Idempotent retry (e.g. claim-status write lost a
+                        # conflict after the NAS commit): report the class's
+                        # real shareability — the reference hardcodes true
+                        # here (driver.go:134), which would advertise an
+                        # exclusive claim as shareable.
+                        sp.add_event("idempotent_retry")
+                        results[claim_uid] = build_allocation_result(
+                            selected_node, bool(ca.class_parameters.shareable)
+                        )
+                        continue
+                    if nas.status != nascrd.STATUS_READY:
+                        raise RuntimeError(
+                            f"NodeAllocationState status: {nas.status}"
+                        )
+                    try:
+                        on_success, gang_name = self._promote_locked(
+                            nas, ca, selected_node
+                        )
+                    except Exception as e:
+                        # Commit what already promoted, then re-raise: the
+                        # sequential path would have committed those claims
+                        # before ever attempting this one.
+                        sp.set_status("ERROR", str(e))
+                        error = e
+                        break
+                    promoted.append((ca, on_success, gang_name, sp.context))
+                    results[claim_uid] = build_allocation_result(
+                        selected_node, bool(ca.class_parameters.shareable)
+                    )
+            if promoted:
+                with trace.span(
+                    "controller.nas.update",
+                    node=selected_node,
+                    claims=len(promoted),
+                ):
+                    client.update(nas.spec)
+                self._note_node_write(selected_node, nas)
+                for ca, on_success, gang_name, ctx in promoted:
+                    claim = ca.claim
+                    with trace.span(
+                        "controller.allocate.commit",
+                        parent=ctx,
+                        claim_uid=claim.metadata.uid,
+                        node=selected_node,
+                    ):
+                        self.gangs.commit(
+                            claim.metadata.uid,
+                            claim.metadata.namespace,
+                            gang_name,
+                        )
+                        on_success()
+                        logger.info(
+                            "allocated claim %s/%s on node %s",
+                            claim.metadata.namespace,
+                            claim.metadata.name,
+                            selected_node,
+                        )
+        # Outside the node lock (repair writes other nodes' NAS under
+        # their own locks): reconcile members committed against a
+        # tentative or since-moved rank-0 coordinator.  Best-effort:
+        # the allocation itself already committed, so a repair failure
+        # must not surface as an allocation failure — the hint fires
+        # again on the next assign, and the plugin-side refresh is
+        # level-triggered.
+        for ca, _, gang_name, _ in promoted:
+            if gang_name is not None and self.gangs.take_repair_hint(
+                ca.claim.metadata.namespace, gang_name
+            ):
+                try:
+                    self.gangs.repair_coordinators(
+                        ca.claim.metadata.namespace, gang_name,
+                        node_lock=self.lock, on_write=self._note_node_write,
+                    )
+                except Exception:
+                    logger.exception(
+                        "gang %s coordinator repair failed (will retry on "
+                        "next member allocation)",
+                        gang_name,
+                    )
+        if error is not None:
+            raise error
+        return results
 
     def deallocate(self, claim: ResourceClaim) -> None:
         with trace.span(
@@ -567,8 +668,6 @@ class ControllerDriver:
             if self._fanout_closed:
                 raise RuntimeError("controller driver is closed")
             if self._fanout_pool is None:
-                from concurrent.futures import ThreadPoolExecutor
-
                 self._fanout_pool = ThreadPoolExecutor(
                     max_workers=self.FANOUT_PARALLELISM,
                     thread_name_prefix="fanout",
@@ -592,6 +691,7 @@ class ControllerDriver:
         informer, self.nas_informer = self.nas_informer, None
         if informer is not None:
             informer.stop()
+        self.availability.unregister_age_gauge()
 
     def unsuitable_nodes(
         self, pod: Pod, cas: list[ClaimAllocation], potential_nodes: list[str]
@@ -608,37 +708,40 @@ class ControllerDriver:
             claims=len(cas),
             nodes=len(potential_nodes),
         ), UNSUITABLE_SECONDS.time():
-            dead = self._dead_pending_claims(potential_nodes)
-            claims_fp = tuple(
-                sorted(
-                    (ca.claim.metadata.uid, _params_key(ca)) for ca in cas
-                )
-            )
-            if len(potential_nodes) > 1:
-                from concurrent.futures import wait
-
-                futures = [
-                    self._fanout_executor().submit(
-                        self._unsuitable_node, pod, cas, node, dead, claims_fp
+            try:
+                dead = self._dead_pending_claims(potential_nodes)
+                claims_fp = tuple(
+                    sorted(
+                        (ca.claim.metadata.uid, params_fingerprint(ca))
+                        for ca in cas
                     )
-                    for node in potential_nodes
-                ]
-                # Join ALL probes before raising (as the old per-call
-                # context manager did): a straggler left running would race
-                # a retry's pass over the same ClaimAllocation lists and
-                # squat on the shared pool's threads.
-                wait(futures)
-                for future in futures:
-                    future.result()
-            else:
-                for node in potential_nodes:
-                    self._unsuitable_node(pod, cas, node, dead, claims_fp)
-        # Canonical order (sorted, deduped): the pool appends in completion
-        # order, and an order-flapping list would make the reconciler's
-        # status comparison see a "change" every pass and rewrite the
-        # PodSchedulingContext for free.
-        for ca in cas:
-            ca.unsuitable_nodes = sorted(set(ca.unsuitable_nodes))
+                )
+                if len(potential_nodes) > 1:
+                    futures = [
+                        self._fanout_executor().submit(
+                            self._unsuitable_node, pod, cas, node, dead,
+                            claims_fp,
+                        )
+                        for node in potential_nodes
+                    ]
+                    # Join ALL probes before raising (as the old per-call
+                    # context manager did): a straggler left running would
+                    # race a retry's pass over the same ClaimAllocation
+                    # lists and squat on the shared pool's threads.
+                    wait(futures)
+                    for future in futures:
+                        future.result()
+                else:
+                    for node in potential_nodes:
+                        self._unsuitable_node(pod, cas, node, dead, claims_fp)
+            finally:
+                # Canonical order (sorted, deduped) — in a ``finally`` so a
+                # probe exception can't leave order-flapping lists behind:
+                # the pool appends in completion order, and the reconciler's
+                # status comparison would see a "change" every pass and
+                # rewrite the PodSchedulingContext for free.
+                for ca in cas:
+                    ca.unsuitable_nodes = sorted(set(ca.unsuitable_nodes))
 
     def _dead_pending_claims(self, nodes: list[str]) -> "frozenset[str]":
         """Pending-cache claim UIDs whose claim no longer exists.
@@ -660,10 +763,6 @@ class ControllerDriver:
         is re-verified one TTL late — level-triggered healing absorbs
         that.
         """
-        import time as _time
-
-        from tpu_dra.client.apiserver import NotFoundError
-
         infos: dict[str, nascrd.ClaimInfo] = {}
         for subdriver in (self.tpu, self.subslice, self.core):
             for node in nodes:
@@ -700,6 +799,13 @@ class ControllerDriver:
             self._dead_memo = (now, membership, result)
         return result
 
+    def _pending_versions(self, node: str) -> "tuple[int, int, int]":
+        return (
+            self.tpu.pending_allocated_claims.version(node),
+            self.subslice.pending_allocated_claims.version(node),
+            self.core.pending_allocated_claims.version(node),
+        )
+
     def _unsuitable_node(
         self,
         pod: Pod,
@@ -708,9 +814,42 @@ class ControllerDriver:
         dead_pending: set[str] | None = None,
         claims_fp: "tuple | None" = None,
     ) -> None:
-        from tpu_dra.client.apiserver import ApiError
-
         with self.lock.locked(potential_node):
+            # Memo FAST PATH: the verdict memo keys on (rv, pending
+            # versions, pod, claims) — all readable without materializing
+            # the NAS copy.  A hit replays the verdict before paying the
+            # pickle round-trip that dominates a steady-state probe.
+            if claims_fp is not None and not dead_pending:
+                informer = self.nas_informer
+                if informer is not None and informer.synced():
+                    rv_entry = informer.resource_version(potential_node)
+                    if rv_entry is not None:
+                        with self._write_rv_lock:
+                            fence = self._node_write_rv.get(potential_node, 0)
+                        if rv_entry[0] >= fence:
+                            key = (
+                                (potential_node, rv_entry[1])
+                                + self._pending_versions(potential_node),
+                                pod.metadata.uid or pod.metadata.name,
+                                claims_fp,
+                            )
+                            now = _time.monotonic()
+                            with self._probe_memo_lock:
+                                entry = self._probe_memo.get(key)
+                            if (
+                                entry is not None
+                                and now - entry[0] <= self.PROBE_MEMO_TTL_S
+                            ):
+                                PROBE_MEMO_HITS.inc()
+                                PLACEMENT_CACHE_HITS.inc()
+                                for ca in allcas:
+                                    if entry[1].get(
+                                        ca.claim.metadata.uid, False
+                                    ):
+                                        ca.unsuitable_nodes.append(
+                                            potential_node
+                                        )
+                                return
             # Informer path: the cached copy is private (pickle round-trip)
             # and rv-fenced against our own writes (_informer_nas) — the
             # pending-pick disjointness argument needs every picker to see
@@ -741,21 +880,21 @@ class ControllerDriver:
                         uid, potential_node
                     )
 
-            # Memo path: only when the probe's inputs are fully
+            # Cache-eligible only when the probe's inputs are fully
             # fingerprintable (informer-served NAS — its rv IS the state;
             # a GET fallback may race a write mid-pass) and no dead-pending
             # cleanup just mutated state unaccounted for.
-            memo_key = None
-            if from_informer and not dead_pending and claims_fp is not None:
-                import time as _time
+            fingerprintable = from_informer and not dead_pending
+            rv = nas.metadata.resource_version
 
+            # Verdict memo: the whole probe replayed (fastest layer; keyed
+            # by pod identity too — subslice affinity verdicts depend on
+            # the pod name).
+            memo_key = None
+            if fingerprintable and claims_fp is not None:
                 memo_key = (
-                    potential_node,
+                    (potential_node, rv) + self._pending_versions(potential_node),
                     pod.metadata.uid or pod.metadata.name,
-                    nas.metadata.resource_version,
-                    self.tpu.pending_allocated_claims.version(potential_node),
-                    self.subslice.pending_allocated_claims.version(potential_node),
-                    self.core.pending_allocated_claims.version(potential_node),
                     claims_fp,
                 )
                 now = _time.monotonic()
@@ -763,6 +902,7 @@ class ControllerDriver:
                     entry = self._probe_memo.get(memo_key)
                 if entry is not None and now - entry[0] <= self.PROBE_MEMO_TTL_S:
                     PROBE_MEMO_HITS.inc()
+                    PLACEMENT_CACHE_HITS.inc()
                     for ca in allcas:
                         if entry[1].get(ca.claim.metadata.uid, False):
                             ca.unsuitable_nodes.append(potential_node)
@@ -771,6 +911,25 @@ class ControllerDriver:
             lengths = {
                 ca.claim.metadata.uid: len(ca.unsuitable_nodes) for ca in allcas
             }
+
+            # Pending sync for ALL kinds up front (it used to run inside
+            # each allocator mid-pass): the availability snapshot must
+            # summarize NAS + pending uniformly, and hoisting also lets the
+            # whole-chip pass see pending subslice/core picks it previously
+            # missed until commit time.
+            for subdriver in (self.tpu, self.subslice, self.core):
+                subdriver.sync_pending(nas, potential_node)
+
+            # Availability snapshot: the node's free-state summary, reused
+            # across pods/retries while (rv, pending versions) hold still.
+            # Sync may have promoted/dropped entries, so re-read versions.
+            snapshot = None
+            if fingerprintable:
+                pvs = self._pending_versions(potential_node)
+                snapshot = self.availability.lookup(potential_node, rv, pvs)
+                if snapshot is None:
+                    snapshot = build_snapshot(potential_node, nas, pvs)
+                    self.availability.store(snapshot)
 
             per_kind: dict[str, list[ClaimAllocation]] = {
                 tpucrd.TPU_CLAIM_PARAMETERS_KIND: [],
@@ -796,34 +955,45 @@ class ControllerDriver:
 
             # Parent-first ordering: chips before subslices before cores —
             # each affinity level resolves against freshly-placed parents
-            # (driver.go:284-296, extended one level down).
+            # (driver.go:284-296, extended one level down).  ``stats``
+            # collects what each search layer did so the probe counts as
+            # exactly ONE placement-cache hit or miss: skipped-everywhere
+            # -> hit, any search ran in full -> miss, nothing to search ->
+            # neither (cache-eligible probes only — GET-fallback reads
+            # have no cache in play).
+            stats: "dict[str, str] | None" = {} if snapshot is not None else None
             self.tpu.unsuitable_node(
                 nas, pod, per_kind[tpucrd.TPU_CLAIM_PARAMETERS_KIND], allcas,
-                potential_node,
+                potential_node, snapshot=snapshot, presynced=True, stats=stats,
             )
             self.subslice.unsuitable_node(
                 nas, pod, per_kind[tpucrd.SUBSLICE_CLAIM_PARAMETERS_KIND], allcas,
-                potential_node,
+                potential_node, snapshot=snapshot, presynced=True,
+                # The subslice search memo is sound only when no whole-chip
+                # claims were placed earlier in this same pass (they change
+                # the parent-holder picture beyond the snapshot's ken).
+                parents_clean=not per_kind[tpucrd.TPU_CLAIM_PARAMETERS_KIND],
+                stats=stats,
             )
             self.core.unsuitable_node(
                 nas, pod, per_kind[tpucrd.CORE_CLAIM_PARAMETERS_KIND], allcas,
-                potential_node,
+                potential_node, snapshot=snapshot, presynced=True, stats=stats,
             )
+            if stats:
+                if "miss" in stats.values():
+                    PLACEMENT_CACHE_MISSES.inc()
+                else:
+                    PLACEMENT_CACHE_HITS.inc()
 
             if memo_key is not None:
-                import time as _time
-
                 # Re-key on the POST-pass pending versions: a memo hit then
                 # certifies the pass's seeded picks are still in place (the
                 # TTL bounds the residual race with lock-free removals).
                 stored_key = (
-                    memo_key[0],
+                    (potential_node, rv)
+                    + self._pending_versions(potential_node),
                     memo_key[1],
                     memo_key[2],
-                    self.tpu.pending_allocated_claims.version(potential_node),
-                    self.subslice.pending_allocated_claims.version(potential_node),
-                    self.core.pending_allocated_claims.version(potential_node),
-                    memo_key[6],
                 )
                 verdict = {
                     ca.claim.metadata.uid: potential_node

@@ -52,16 +52,22 @@ def _rv_int(obj) -> int:
 class NasInformer:
     """LIST+WATCH cache of one namespace's NodeAllocationState objects."""
 
-    def __init__(self, clientset, namespace: str):
+    def __init__(self, clientset, namespace: str, on_event=None):
         self._client = clientset.node_allocation_states(namespace)
         self._lock = threading.Lock()
-        # name -> (resourceVersion as int, pickled typed object)
-        self._store: "dict[str, tuple[int, bytes]]" = {}
+        # name -> (resourceVersion as int, pickled typed object, raw rv)
+        self._store: "dict[str, tuple[int, bytes, str]]" = {}
         self._generation = 0
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._thread: "threading.Thread | None" = None
         self._watch = None
+        # Change hook: called with the node name after each applied event,
+        # and with None after a relist replaced the whole store (per-node
+        # deltas unknown).  The controller driver uses it to evict the
+        # node's availability snapshot.  Called OUTSIDE the store lock so a
+        # callback may re-enter informer reads.
+        self._on_event = on_event
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -94,6 +100,16 @@ class NasInformer:
             entry = self._store.get(name)
         return pickle.loads(entry[1]) if entry is not None else None
 
+    def resource_version(self, name: str) -> "tuple[int, str] | None":
+        """The cached NAS's resourceVersion as (orderable int, raw string)
+        WITHOUT materializing a copy — the scheduling fan-out's memo fast
+        path keys on the rv alone, and unpickling a fleet-sized NAS per
+        probe just to read one metadata field was the dominant cost of a
+        memo hit."""
+        with self._lock:
+            entry = self._store.get(name)
+        return (entry[0], entry[2]) if entry is not None else None
+
     def generation(self) -> int:
         with self._lock:
             return self._generation
@@ -118,12 +134,14 @@ class NasInformer:
                     o.metadata.name: (
                         _rv_int(o),
                         pickle.dumps(o, protocol=pickle.HIGHEST_PROTOCOL),
+                        str(o.metadata.resource_version or ""),
                     )
                     for o in objs
                 }
                 with self._lock:
                     self._store = fresh
                     self._generation += 1
+                self._notify(None)
                 self._synced.set()
                 for event in self._watch:
                     self._apply(event)
@@ -138,6 +156,14 @@ class NasInformer:
                 if watch is not None:
                     watch.stop()
             self._stop.wait(RELIST_BACKOFF_S)
+
+    def _notify(self, name: "str | None") -> None:
+        if self._on_event is None:
+            return
+        try:
+            self._on_event(name)
+        except Exception:
+            logger.exception("nas informer on_event hook failed")
 
     def _apply(self, event: dict) -> None:
         obj = event.get("object")
@@ -157,5 +183,7 @@ class NasInformer:
                 self._store[name] = (
                     rv,
                     pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+                    str(obj.metadata.resource_version or ""),
                 )
             self._generation += 1
+        self._notify(name)

@@ -78,11 +78,19 @@ class PerNodeAllocatedClaims:
 
     def set(self, claim_uid: str, node: str, devices: AllocatedDevices) -> None:
         with self._lock:
+            existing = self._allocations.get(claim_uid, {}).get(node)
             self._allocations.setdefault(claim_uid, {})[node] = serde.deepcopy(
                 devices
             )
             self._stamped[claim_uid] = time.monotonic()
-            self._bump(node)
+            # Re-seeding an unchanged pick leaves the availability picture
+            # untouched, so it must not bump the mutation counter: the
+            # scheduling caches key on these versions, and a wave of pods
+            # re-probing steady-state nodes would otherwise churn every
+            # node's fingerprint on every pass (structural dataclass
+            # equality — the entries are small).
+            if existing != devices:
+                self._bump(node)
 
     def visit_node(
         self, node: str, visitor: Callable[[str, AllocatedDevices], None]

@@ -22,33 +22,26 @@ chip, optionally affine to the pod's whole-chip claim via ``tpu_claim_name``
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from typing import Callable
 
 from tpu_dra.api import nas_v1alpha1 as nascrd
 from tpu_dra.api import serde
 from tpu_dra.api import tpu_v1alpha1 as tpucrd
 from tpu_dra.api.k8s import Pod, ResourceClaim
-from tpu_dra.api.topology import Placement
+from tpu_dra.controller.availability import (
+    NodeSnapshot,
+    SubslicePlacement,
+    compute_subslice_candidates,
+)
 from tpu_dra.controller.pending import PerNodeAllocatedClaims
-from tpu_dra.controller.types import ClaimAllocation
-
+from tpu_dra.controller.types import (
+    ClaimAllocation,
+    SearchMemo,
+    params_fingerprint,
+)
 OnSuccessCallback = Callable[[], None]
 
-
-@dataclass(frozen=True)
-class SubslicePlacement:
-    """A concrete candidate: profile placed at a core interval of a chip
-    (MigDevicePlacement analog, mig.go:44-47)."""
-
-    parent_uuid: str
-    placement: Placement
-
-    def overlaps(self, other: "SubslicePlacement") -> bool:
-        return (
-            self.parent_uuid == other.parent_uuid
-            and self.placement.overlaps(other.placement)
-        )
+__all__ = ["SubsliceDriver", "SubslicePlacement"]
 
 
 class SubsliceDriver:
@@ -60,6 +53,12 @@ class SubsliceDriver:
         # before its parent legitimately promotes first) from "parent
         # deallocated / chip stolen" (stale pick — reject).
         self._parent_pending = parent_pending
+        # Backtracking-search results keyed by (snapshot fingerprint, pod
+        # affinity component, ordered params fingerprints); only consulted
+        # when the search inputs are fully covered by the snapshot (no
+        # whole-chip claims placed earlier in the same pass, all subslice
+        # claims fresh).
+        self.search_memo = SearchMemo()
 
     def validate_claim_parameters(
         self, params: tpucrd.SubsliceClaimParametersSpec
@@ -163,14 +162,12 @@ class SubsliceDriver:
     def deallocate(self, crd: nascrd.NodeAllocationState, claim: ResourceClaim) -> None:
         self.pending_allocated_claims.remove(claim.metadata.uid)
 
-    def unsuitable_node(
-        self,
-        crd: nascrd.NodeAllocationState,
-        pod: Pod,
-        subcas: list[ClaimAllocation],
-        allcas: list[ClaimAllocation],
-        potential_node: str,
+    def sync_pending(
+        self, crd: nascrd.NodeAllocationState, potential_node: str
     ) -> None:
+        """Re-sync the pending cache with the NAS truth (see
+        TpuDriver.sync_pending)."""
+
         def sync(claim_uid: str, allocation: nascrd.AllocatedDevices) -> None:
             if claim_uid in crd.spec.allocated_claims:
                 self.pending_allocated_claims.remove(claim_uid)
@@ -179,6 +176,21 @@ class SubsliceDriver:
 
         self.pending_allocated_claims.visit_node(potential_node, sync)
 
+    def unsuitable_node(
+        self,
+        crd: nascrd.NodeAllocationState,
+        pod: Pod,
+        subcas: list[ClaimAllocation],
+        allcas: list[ClaimAllocation],
+        potential_node: str,
+        snapshot: "NodeSnapshot | None" = None,
+        presynced: bool = False,
+        parents_clean: bool = False,
+        stats: "dict | None" = None,
+    ) -> None:
+        if not presynced:
+            self.sync_pending(crd, potential_node)
+
         # A pod with no subslice claims is trivially satisfiable here — the
         # reference passes this case because len(nil) == len(empty migcas)
         # (mig.go:85-91); without this guard an empty candidate map would
@@ -186,7 +198,7 @@ class SubsliceDriver:
         if not subcas:
             return
 
-        placements = self._allocate(crd, pod, subcas)
+        placements = self._allocate(crd, pod, subcas, snapshot, parents_clean, stats)
         if placements is None or len(placements) != len(subcas):
             for other in allcas:
                 other.unsuitable_nodes.append(potential_node)
@@ -228,48 +240,9 @@ class SubsliceDriver:
     def _available(
         self, crd: nascrd.NodeAllocationState
     ) -> dict[str, list[SubslicePlacement]]:
-        """profile -> candidate placements on every partitionable chip,
-        minus those overlapping already-allocated subslices (mig.go:122-169)."""
-        parents: dict[str, list[str]] = {}
-        for device in crd.spec.allocatable_devices:
-            if device.type() != nascrd.TPU_DEVICE_TYPE:
-                continue
-            if not device.tpu.partitionable:
-                continue
-            parents.setdefault(device.tpu.product, []).append(device.tpu.uuid)
-
-        candidates: dict[str, list[SubslicePlacement]] = {}
-        for device in crd.spec.allocatable_devices:
-            if device.type() != nascrd.SUBSLICE_DEVICE_TYPE:
-                continue
-            entry = []
-            for parent_uuid in parents.get(device.subslice.parent_product, []):
-                for p in device.subslice.placements:
-                    entry.append(SubslicePlacement(parent_uuid, p))
-            candidates[device.subslice.profile] = entry
-
-        for allocation in crd.spec.allocated_claims.values():
-            if allocation.type() == nascrd.SUBSLICE_DEVICE_TYPE:
-                taken_devices = [
-                    SubslicePlacement(d.parent_uuid, d.placement)
-                    for d in allocation.subslice.devices
-                ]
-            elif allocation.type() == nascrd.CORE_DEVICE_TYPE:
-                # Core claims occupy real cores on the parent chip too —
-                # without this, a dangling core claim's interval could be
-                # re-carved into a fresh overlapping subslice.
-                taken_devices = [
-                    SubslicePlacement(d.parent_uuid, d.placement)
-                    for d in allocation.core.devices
-                ]
-            else:
-                continue
-            for taken in taken_devices:
-                for profile in candidates:
-                    candidates[profile] = [
-                        c for c in candidates[profile] if not c.overlaps(taken)
-                    ]
-        return candidates
+        """profile -> free candidate placements (the availability module's
+        computation; kept as a method for callers probing one node ad hoc)."""
+        return compute_subslice_candidates(crd)
 
     def _parent_claim_info(
         self, crd: nascrd.NodeAllocationState
@@ -290,8 +263,77 @@ class SubsliceDriver:
         crd: nascrd.NodeAllocationState,
         pod: Pod,
         subcas: list[ClaimAllocation],
+        snapshot: "NodeSnapshot | None" = None,
+        parents_clean: bool = False,
+        stats: "dict | None" = None,
     ) -> dict[str, SubslicePlacement] | None:
-        available = self._available(crd)
+        # The backtracking search is memoizable only when the snapshot
+        # covers every input: the candidate map (always snapshot-derived),
+        # the whole-chip holders (``parents_clean``: no TPU claims were
+        # placed earlier in this pass, so crd's whole-chip state == the
+        # snapshot's), and no claim carries a pre-existing entry (those are
+        # uid-specific).  The pod component enters the key only when an
+        # affinity name is in play — plain subslice claims replay across
+        # pods.
+        def has_existing(ca: ClaimAllocation) -> bool:
+            entry = crd.spec.allocated_claims.get(ca.claim.metadata.uid)
+            return entry is not None and entry.subslice is not None
+
+        memo_key = None
+        fresh = not any(has_existing(ca) for ca in subcas)
+        if snapshot is not None and parents_clean and fresh:
+            pod_component = (
+                pod.metadata.name
+                if any(ca.claim_parameters.tpu_claim_name for ca in subcas)
+                else ""
+            )
+            memo_key = (
+                snapshot.fingerprint,
+                pod_component,
+                tuple(params_fingerprint(ca) for ca in subcas),
+            )
+            cached = self.search_memo.get(memo_key)
+            if cached is not None:
+                if stats is not None:
+                    stats["subslice"] = "hit"
+                verdict, placements = cached
+                if not verdict:
+                    return None
+                return {
+                    ca.claim.metadata.uid: placement
+                    for ca, placement in zip(subcas, placements)
+                }
+
+        # The search is about to run in full (memo miss, or memo-ineligible
+        # pass): either way the cache did not save it.
+        if stats is not None:
+            stats["subslice"] = "miss"
+        result = self._search(crd, pod, subcas, snapshot)
+        if memo_key is not None:
+            if result is None or len(result) != len(subcas):
+                self.search_memo.put(memo_key, (False, None))
+            else:
+                self.search_memo.put(
+                    memo_key,
+                    (
+                        True,
+                        [result[ca.claim.metadata.uid] for ca in subcas],
+                    ),
+                )
+        return result
+
+    def _search(
+        self,
+        crd: nascrd.NodeAllocationState,
+        pod: Pod,
+        subcas: list[ClaimAllocation],
+        snapshot: "NodeSnapshot | None" = None,
+    ) -> dict[str, SubslicePlacement] | None:
+        available = (
+            snapshot.subslice_candidates
+            if snapshot is not None
+            else compute_subslice_candidates(crd)
+        )
         parent_info = self._parent_claim_info(crd)
 
         possible: dict[str, list[SubslicePlacement]] = {}

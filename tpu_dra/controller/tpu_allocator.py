@@ -26,9 +26,14 @@ from tpu_dra.api import tpu_v1alpha1 as tpucrd
 from tpu_dra.api.k8s import Pod, ResourceClaim
 from tpu_dra.api.selector import glob_matches
 from tpu_dra.api.topology import Topology
+from tpu_dra.controller.availability import NodeSnapshot, compute_free_chips
 from tpu_dra.controller.pending import PerNodeAllocatedClaims
 from tpu_dra.controller.placement import place_count, place_topology
-from tpu_dra.controller.types import ClaimAllocation
+from tpu_dra.controller.types import (
+    ClaimAllocation,
+    SearchMemo,
+    params_fingerprint,
+)
 from tpu_dra.utils.quantity import Quantity
 
 OnSuccessCallback = Callable[[], None]
@@ -37,6 +42,11 @@ OnSuccessCallback = Callable[[], None]
 class TpuDriver:
     def __init__(self):
         self.pending_allocated_claims = PerNodeAllocatedClaims()
+        # ICI-contiguous search results keyed by (snapshot fingerprint,
+        # ordered params fingerprints of the fresh claims): identical
+        # probes across pods of one wave and across reconcile retries
+        # replay the placed block instead of re-running the search.
+        self.search_memo = SearchMemo()
 
     def validate_claim_parameters(
         self, params: tpucrd.TpuClaimParametersSpec
@@ -124,15 +134,14 @@ class TpuDriver:
     def deallocate(self, crd: nascrd.NodeAllocationState, claim: ResourceClaim) -> None:
         self.pending_allocated_claims.remove(claim.metadata.uid)
 
-    def unsuitable_node(
-        self,
-        crd: nascrd.NodeAllocationState,
-        pod: Pod,
-        tpucas: list[ClaimAllocation],
-        allcas: list[ClaimAllocation],
-        potential_node: str,
+    def sync_pending(
+        self, crd: nascrd.NodeAllocationState, potential_node: str
     ) -> None:
-        # Re-sync pending cache with the NAS truth (gpu.go:69-76).
+        """Re-sync the pending cache with the NAS truth (gpu.go:69-76):
+        promote-committed entries are dropped from the cache, live pending
+        picks are merged into the (private) NAS copy so availability
+        computation sees them as taken."""
+
         def sync(claim_uid: str, allocation: nascrd.AllocatedDevices) -> None:
             if claim_uid in crd.spec.allocated_claims:
                 self.pending_allocated_claims.remove(claim_uid)
@@ -141,7 +150,21 @@ class TpuDriver:
 
         self.pending_allocated_claims.visit_node(potential_node, sync)
 
-        allocated = self._allocate(crd, tpucas)
+    def unsuitable_node(
+        self,
+        crd: nascrd.NodeAllocationState,
+        pod: Pod,
+        tpucas: list[ClaimAllocation],
+        allcas: list[ClaimAllocation],
+        potential_node: str,
+        snapshot: "NodeSnapshot | None" = None,
+        presynced: bool = False,
+        stats: "dict | None" = None,
+    ) -> None:
+        if not presynced:
+            self.sync_pending(crd, potential_node)
+
+        allocated = self._allocate(crd, tpucas, snapshot, stats)
         for ca in tpucas:
             claim_uid = ca.claim.metadata.uid
             params: tpucrd.TpuClaimParametersSpec = ca.claim_parameters
@@ -177,28 +200,14 @@ class TpuDriver:
         self,
         crd: nascrd.NodeAllocationState,
         tpucas: list[ClaimAllocation],
+        snapshot: "NodeSnapshot | None" = None,
+        stats: "dict | None" = None,
     ) -> dict[str, tuple[list[nascrd.AllocatedTpu], Topology | None]]:
         """Tentatively place every claim; availability = allocatable minus
-        already-allocated (whole chips and subslice parents), gpu.go:114-135."""
-        available: dict[str, nascrd.AllocatableTpu] = {}
-        for device in crd.spec.allocatable_devices:
-            if device.type() == nascrd.TPU_DEVICE_TYPE:
-                available[device.tpu.uuid] = device.tpu
-
-        for allocation in crd.spec.allocated_claims.values():
-            if allocation.type() == nascrd.TPU_DEVICE_TYPE:
-                for dev in allocation.tpu.devices:
-                    available.pop(dev.uuid, None)
-            elif allocation.type() == nascrd.SUBSLICE_DEVICE_TYPE:
-                for dev in allocation.subslice.devices:
-                    available.pop(dev.parent_uuid, None)
-            elif allocation.type() == nascrd.CORE_DEVICE_TYPE:
-                # Defense-in-depth: a dangling core claim (parent subslice
-                # deallocated out from under it) still pins its chip.
-                for dev in allocation.core.devices:
-                    available.pop(dev.parent_uuid, None)
-
+        already-allocated (whole chips and subslice parents), gpu.go:114-135
+        — served from the node snapshot when one matches this exact state."""
         allocated: dict[str, tuple[list[nascrd.AllocatedTpu], Topology | None]] = {}
+        fresh: list[ClaimAllocation] = []
         for ca in tpucas:
             claim_uid = ca.claim.metadata.uid
             existing = crd.spec.allocated_claims.get(claim_uid)
@@ -209,8 +218,42 @@ class TpuDriver:
                     else None
                 )
                 allocated[claim_uid] = (list(existing.tpu.devices), topo)
-                continue
+            else:
+                fresh.append(ca)
+        if not fresh:
+            return allocated
 
+        # Existing entries never touch `available` (they are already
+        # excluded from the snapshot's free set), so the search outcome for
+        # the fresh claims is a pure function of (snapshot, params order) —
+        # memoizable across claim uids and pods.
+        memo_key = None
+        if snapshot is not None:
+            memo_key = (
+                snapshot.fingerprint,
+                tuple(params_fingerprint(ca) for ca in fresh),
+            )
+            cached = self.search_memo.get(memo_key)
+            if cached is not None:
+                if stats is not None:
+                    stats["tpu"] = "hit"
+                for ca, (devices, topo) in zip(fresh, cached):
+                    allocated[ca.claim.metadata.uid] = (
+                        [serde.deepcopy(d) for d in devices],
+                        topo,
+                    )
+                return allocated
+            if stats is not None:
+                stats["tpu"] = "miss"
+
+        available = (
+            dict(snapshot.free_chips)
+            if snapshot is not None
+            else compute_free_chips(crd)
+        )
+        placed_results: list[tuple[list[nascrd.AllocatedTpu], Topology | None]] = []
+        for ca in fresh:
+            claim_uid = ca.claim.metadata.uid
             params: tpucrd.TpuClaimParametersSpec = ca.claim_parameters
             eligible = {
                 uuid: chip
@@ -226,6 +269,7 @@ class TpuDriver:
                     # granted here would be fiction.  Count claims remain
                     # fine; topology claims are unsuitable.
                     allocated[claim_uid] = ([], None)
+                    placed_results.append(([], None))
                     continue
                 placed = place_topology(
                     Topology.parse(params.topology), set(free_coords)
@@ -246,7 +290,16 @@ class TpuDriver:
             for chip in chips:
                 available.pop(chip.uuid, None)
             allocated[claim_uid] = (devices, topo)
+            placed_results.append((devices, topo))
 
+        if memo_key is not None:
+            self.search_memo.put(
+                memo_key,
+                [
+                    ([serde.deepcopy(d) for d in devices], topo)
+                    for devices, topo in placed_results
+                ],
+            )
         return allocated
 
 

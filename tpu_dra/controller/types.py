@@ -7,6 +7,9 @@ controller.ClaimAllocation, vendor/.../controller/controller.go:93-104).
 
 from __future__ import annotations
 
+import json
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -22,6 +25,64 @@ class ClaimAllocation:
     # The pod-local claim entry name (PodClaimName upstream).
     pod_claim_name: str = ""
     unsuitable_nodes: list[str] = field(default_factory=list)
+    # Canonical fingerprint of the resolved claim parameters, computed once
+    # per fan-out by params_fingerprint() (cache key component).
+    params_fp: str | None = None
     # Filled by Allocate on success:
     allocation: AllocationResult | None = None
     error: Exception | None = None
+
+
+def params_fingerprint(ca: ClaimAllocation) -> str:
+    """Canonical fingerprint of a claim's resolved parameters (placement
+    cache key component — two searches with identical params + identical
+    availability derive identical placements).  Cached on the
+    ClaimAllocation so one fan-out serializes each claim's params once,
+    not once per node probed."""
+    if ca.params_fp is None:
+        from tpu_dra.api import serde
+
+        ca.params_fp = json.dumps(serde.to_dict(ca.claim_parameters), sort_keys=True)
+    return ca.params_fp
+
+
+class SearchMemo:
+    """TTL + capacity bounded memo for placement-search results.
+
+    Keys embed the availability-snapshot fingerprint (NAS resourceVersion +
+    per-node pending-cache versions), so a hit certifies the search inputs
+    are bit-identical to the stored pass's.  The TTL exists for the same
+    reason as the driver's verdict memo: lock-free pending removals can
+    race the post-pass version read, and a short entry lifetime bounds the
+    residual window.  At capacity the memo is cleared wholesale — entries
+    are cheap to recompute and a scan-based LRU would put a sort on the
+    hot path."""
+
+    def __init__(self, cap: int = 4096, ttl_s: float = 5.0):
+        self.cap = cap
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._entries: "dict[tuple, tuple[float, Any]]" = {}
+
+    def get(self, key: tuple) -> Any:
+        """The stored value, or None when absent/expired."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+        if entry is None or now - entry[0] > self.ttl_s:
+            return None
+        return entry[1]
+
+    def put(self, key: tuple, value: Any) -> None:
+        with self._lock:
+            if len(self._entries) >= self.cap:
+                self._entries.clear()
+            self._entries[key] = (time.monotonic(), value)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -62,6 +62,18 @@ class Counter:
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
+    def value(self, **labels: str) -> float:
+        """Current value of one series (bench/test readback — the text
+        exposition is for scrapers, not for in-process deltas)."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def total(self) -> float:
+        """Sum across every label combination of this counter."""
+        with self._lock:
+            return sum(self._values.values())
+
     def collect(self) -> str:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -196,7 +208,9 @@ class Registry:
 REGISTRY = Registry()
 
 ALLOCATE_SECONDS = REGISTRY.histogram(
-    "tpu_dra_allocate_seconds", "Controller Allocate() latency per claim"
+    "tpu_dra_allocate_seconds",
+    "Controller Allocate() commit latency per batch (one NAS update "
+    "covers all of a pod's claims)",
 )
 UNSUITABLE_SECONDS = REGISTRY.histogram(
     "tpu_dra_unsuitable_nodes_seconds", "Controller UnsuitableNodes() latency per pod"
@@ -220,6 +234,33 @@ PROBE_MEMO_HITS = REGISTRY.counter(
 PROBE_MEMO_MISSES = REGISTRY.counter(
     "tpu_dra_probe_memo_misses_total",
     "Scheduling probes that ran the full placement search",
+)
+PLACEMENT_CACHE_HITS = REGISTRY.counter(
+    "tpu_dra_placement_cache_hits_total",
+    "Placement searches served from a cache layer (verdict memo or "
+    "per-allocator search memo) instead of running the search",
+)
+PLACEMENT_CACHE_MISSES = REGISTRY.counter(
+    "tpu_dra_placement_cache_misses_total",
+    "Placement searches that ran in full (cache-eligible probes only)",
+)
+SNAPSHOT_HITS = REGISTRY.counter(
+    "tpu_dra_availability_snapshot_hits_total",
+    "Per-node availability snapshots served from the cache "
+    "(rv + pending-version fence matched)",
+)
+SNAPSHOT_MISSES = REGISTRY.counter(
+    "tpu_dra_availability_snapshot_misses_total",
+    "Availability lookups that rebuilt the node's free-state summary",
+)
+SNAPSHOT_INVALIDATIONS = REGISTRY.counter(
+    "tpu_dra_availability_snapshot_invalidations_total",
+    "Snapshot evictions by reason (informer_event, informer_relist, "
+    "own_write)",
+)
+SNAPSHOT_AGE = REGISTRY.gauge(
+    "tpu_dra_availability_snapshot_age_seconds",
+    "Age of the oldest cached availability snapshot at scrape time",
 )
 INFORMER_READS = REGISTRY.counter(
     "tpu_dra_nas_informer_reads_total",
