@@ -8,7 +8,7 @@ TAG     ?= latest
 
 .PHONY: all test lint generate-crds check-generate native native-test \
         demo-quickstart bench image clean help observability-smoke \
-        perf-smoke
+        perf-smoke explain-smoke
 
 all: lint test
 
@@ -55,6 +55,14 @@ observability-smoke:
 perf-smoke:
 	$(PYTHON) -m pytest tests/test_perf_smoke.py -q -m 'not slow'
 
+# Boots kubesim, drives one unplaceable claim, and asserts the full
+# "why is my pod Pending?" story: `tpudra explain` prints a non-empty
+# per-node reason breakdown, /debug/decisions returns it as JSON, the
+# claim carries a compressed Warning Event, and the rejection/prepare/e2e
+# metrics appear in the exposition (docs/OBSERVABILITY.md).
+explain-smoke:
+	$(PYTHON) -m pytest tests/test_explain_smoke.py -q -m 'not slow'
+
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
@@ -66,4 +74,4 @@ clean:
 help:
 	@echo "targets: test lint generate-crds check-generate native native-test"
 	@echo "         demo-quickstart bench observability-smoke perf-smoke"
-	@echo "         image clean"
+	@echo "         explain-smoke image clean"

@@ -1,0 +1,330 @@
+"""Placement-decision flight recorder (tpu_dra/controller/decisions.py):
+ring-buffer bounds + dropped counter, query filters, reason-code summaries,
+allocator reason structuring (incl. memo replay), the /debug/decisions
+endpoint, and EventRecorder compression/ApiError tolerance."""
+
+import json
+import urllib.error
+import urllib.request
+
+from helpers import make_nas, make_pod
+from helpers import make_ca as make_ca_helper
+from tpu_dra.api import tpu_v1alpha1 as tpucrd
+from tpu_dra.api.k8s import ResourceClaim
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.controller import decisions
+from tpu_dra.controller.decisions import (
+    DecisionRecord,
+    FlightRecorder,
+    ReasonCode,
+)
+from tpu_dra.controller.tpu_allocator import TpuDriver
+
+NODE = "node-1"
+
+
+def make_ca(name="claim-1", count=None, topology=None):
+    return make_ca_helper(
+        tpucrd.TpuClaimParametersSpec(count=count, topology=topology),
+        name=name,
+    )
+
+
+class TestFlightRecorderRing:
+    def test_bounds_and_dropped_counter(self):
+        rec = FlightRecorder(capacity=8)
+        for i in range(20):
+            rec.record(DecisionRecord(node=f"n{i}"))
+        got = rec.query()
+        assert len(got) == 8
+        assert rec.dropped == 12
+        assert rec.recorded == 20
+        # Oldest evicted, newest kept, seq strictly monotonic.
+        assert [r.node for r in got] == [f"n{i}" for i in range(12, 20)]
+        seqs = [r.seq for r in got]
+        assert seqs == sorted(seqs) and seqs[-1] == 20
+
+    def test_query_filters_and_limit(self):
+        rec = FlightRecorder(capacity=64)
+        for node in ("a", "b"):
+            for claim in ("c1", "c2"):
+                rec.record(
+                    DecisionRecord(
+                        node=node, claim=claim, claim_uid=f"uid-{claim}",
+                        pod=f"pod-{claim}",
+                    )
+                )
+        assert len(rec.query(node="a")) == 2
+        assert len(rec.query(claim="c1")) == 2
+        assert len(rec.query(claim="uid-c2")) == 2  # uid matches too
+        assert len(rec.query(pod="pod-c1", node="b")) == 1
+        assert len(rec.query(limit=3)) == 3
+
+    def test_unsuitable_records_move_rejections_counter(self):
+        from tpu_dra.utils.metrics import REJECTIONS_TOTAL
+
+        before = REJECTIONS_TOTAL.value(reason=ReasonCode.INSUFFICIENT_CHIPS)
+        rec = FlightRecorder(capacity=4)
+        rec.record(
+            DecisionRecord(
+                verdict=decisions.UNSUITABLE,
+                reason=ReasonCode.INSUFFICIENT_CHIPS,
+            )
+        )
+        rec.record(DecisionRecord(verdict=decisions.SUITABLE))
+        after = REJECTIONS_TOTAL.value(reason=ReasonCode.INSUFFICIENT_CHIPS)
+        assert after == before + 1
+
+
+class TestSummaries:
+    def test_summarize_uses_latest_verdict_per_node(self):
+        recs = [
+            DecisionRecord(node="a", verdict=decisions.UNSUITABLE,
+                           reason=ReasonCode.INSUFFICIENT_CHIPS),
+            DecisionRecord(node="b", verdict=decisions.UNSUITABLE,
+                           reason=ReasonCode.TOPOLOGY_MISMATCH),
+            # Node a re-probed and now fits: latest wins.
+            DecisionRecord(node="a", verdict=decisions.SUITABLE),
+        ]
+        assert decisions.summarize(recs) == (
+            "1/2 nodes suitable: 1/2 TopologyMismatch"
+        )
+
+    def test_summarize_rejections_stable_and_compressed(self):
+        rejections = {
+            "n1": (ReasonCode.INSUFFICIENT_CHIPS, "d1"),
+            "n2": (ReasonCode.INSUFFICIENT_CHIPS, "d2"),
+            "n3": (ReasonCode.NODE_NOT_READY, "d3"),
+        }
+        msg = decisions.summarize_rejections(rejections, 4)
+        assert msg == (
+            "1/4 nodes suitable: 2/4 InsufficientChips, 1/4 NodeNotReady"
+        )
+        # Deterministic: same mix -> same message (Event compression key).
+        assert msg == decisions.summarize_rejections(dict(rejections), 4)
+
+    def test_render_text_groups_by_claim(self):
+        recs = [
+            DecisionRecord(claim="c", node="n1",
+                           verdict=decisions.UNSUITABLE,
+                           reason=ReasonCode.CORES_EXHAUSTED, detail="why",
+                           provenance=decisions.PROVENANCE_MEMO),
+        ]
+        text = decisions.render_text(recs)
+        assert "claim c" in text
+        assert "CoresExhausted: why" in text
+        assert "[memo]" in text
+
+
+class TestAllocatorReasons:
+    def test_insufficient_chips(self):
+        driver = TpuDriver()
+        ca = make_ca(count=16)
+        driver.unsuitable_node(make_nas(), make_pod(), [ca], [ca], NODE)
+        assert ca.unsuitable_nodes == [NODE]
+        code, detail = ca.node_rejections[NODE]
+        assert code == ReasonCode.INSUFFICIENT_CHIPS
+        assert "16" in detail
+
+    def test_topology_mismatch_vs_no_host_topology(self):
+        driver = TpuDriver()
+        # 4 chips on a 2x2 host mesh: a 4x1x1 line cannot embed.
+        ca = make_ca(topology="4x1x1")
+        driver.unsuitable_node(make_nas(), make_pod(), [ca], [ca], NODE)
+        assert ca.node_rejections[NODE][0] == ReasonCode.TOPOLOGY_MISMATCH
+
+        degraded = make_nas()
+        degraded.spec.host_topology = ""
+        ca2 = make_ca(topology="2x2x1")
+        driver.unsuitable_node(degraded, make_pod(), [ca2], [ca2], NODE)
+        assert ca2.node_rejections[NODE][0] == ReasonCode.NO_HOST_TOPOLOGY
+
+    def test_gang_peer_carries_triggering_claim_reason(self):
+        driver = TpuDriver()
+        fits = make_ca(name="ok", count=1)
+        wont = make_ca(name="hungry", count=99)
+        driver.unsuitable_node(make_nas(), make_pod(), [fits, wont],
+                               [fits, wont], NODE)
+        assert fits.node_rejections[NODE][0] == ReasonCode.INSUFFICIENT_CHIPS
+        assert "hungry" in fits.node_rejections[NODE][1]
+
+    def test_search_memo_replays_reason(self):
+        """The memoized search must reproduce the failure reason, not just
+        the empty placement (the flight recorder's memo-provenance path)."""
+        from tpu_dra.controller.availability import build_snapshot
+
+        driver = TpuDriver()
+        snapshot = build_snapshot(NODE, make_nas(), (0, 0, 0))
+        ca = make_ca(name="a", count=16)
+        driver.unsuitable_node(make_nas(), make_pod(), [ca], [ca], NODE,
+                               snapshot=snapshot)
+        stats: dict = {}
+        # Different claim uid, identical params + snapshot -> memo hit.
+        ca2 = make_ca(name="b", count=16)
+        driver.unsuitable_node(make_nas(), make_pod(), [ca2], [ca2], NODE,
+                               snapshot=snapshot, stats=stats)
+        assert stats["tpu"] == "hit"
+        assert ca2.node_rejections[NODE][0] == ReasonCode.INSUFFICIENT_CHIPS
+
+
+class TestReusedClaimAllocation:
+    def test_stale_rejection_cleared_on_reprobe(self, tmp_path):
+        """A ClaimAllocation reused across passes (the bench/retry pattern:
+        only unsuitable_nodes is reset) must not leak an earlier pass's
+        rejection into a later pass's verdict — the memo store and the
+        flight recorder read node_rejections as THIS pass's truth."""
+        from helpers import make_plugin_stack
+        from tpu_dra.api.nas_v1alpha1 import (
+            STATUS_NOT_READY,
+            NodeAllocationState,
+        )
+        from tpu_dra.client import ClientSet, FakeApiServer, NasClient
+        from tpu_dra.controller.driver import ControllerDriver
+        from tpu_dra.plugin.driver import NodeDriver
+
+        cs = ClientSet(FakeApiServer())
+        driver = ControllerDriver(cs, "tpu-dra")
+        _, _, state = make_plugin_stack(tmp_path, cs, node=NODE)
+        nas = NodeAllocationState(
+            metadata=ObjectMeta(name=NODE, namespace="tpu-dra")
+        )
+        node_driver = NodeDriver(nas, NasClient(nas, cs), state, start_gc=False)
+        try:
+            # Pass 1: node NotReady -> rejected with NodeNotReady.
+            client = NasClient(
+                NodeAllocationState(
+                    metadata=ObjectMeta(name=NODE, namespace="tpu-dra")
+                ),
+                cs,
+            )
+            client.get()
+            client.update_status(STATUS_NOT_READY)
+            ca = make_ca(count=1)
+            driver.unsuitable_nodes(make_pod(), [ca], [NODE])
+            assert ca.unsuitable_nodes == [NODE]
+            assert ca.node_rejections[NODE][0] == ReasonCode.NODE_NOT_READY
+
+            # Node recovers; caller reuses the CA, resetting only the list.
+            client.get()
+            client.update_status("Ready")
+            ca.unsuitable_nodes = []
+            driver.unsuitable_nodes(make_pod(), [ca], [NODE])
+            assert ca.unsuitable_nodes == []
+            assert NODE not in ca.node_rejections  # stale rejection gone
+        finally:
+            driver.close()
+            node_driver.shutdown()
+
+
+class TestDecisionsEndpoint:
+    def test_json_text_and_validation(self):
+        from tpu_dra.utils.metrics import MetricsServer, Registry
+
+        decisions.RECORDER.record(
+            DecisionRecord(
+                claim="ep-claim", claim_uid="ep-uid", node="ep-node",
+                verdict=decisions.UNSUITABLE,
+                reason=ReasonCode.INSUFFICIENT_CHIPS, detail="d",
+                provenance=decisions.PROVENANCE_SNAPSHOT,
+            )
+        )
+        server = MetricsServer("127.0.0.1:0", registry=Registry())
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            doc = json.loads(
+                urllib.request.urlopen(
+                    f"{base}/debug/decisions?claim=ep-claim"
+                ).read().decode()
+            )
+            assert doc["decisions"]
+            rec = doc["decisions"][-1]
+            assert rec["reason"] == ReasonCode.INSUFFICIENT_CHIPS
+            assert rec["provenance"] == "snapshot"
+            assert "dropped" in doc and "summary" in doc
+            text = urllib.request.urlopen(
+                f"{base}/debug/decisions?claim=ep-claim&format=text"
+            ).read().decode()
+            assert "ep-node" in text and "InsufficientChips" in text
+
+            def code_of(url):
+                try:
+                    return urllib.request.urlopen(url).status
+                except urllib.error.HTTPError as e:
+                    return e.code
+
+            assert code_of(f"{base}/debug/decisions?format=xml") == 400
+            for bad in ("-1", "0", "x"):
+                assert code_of(
+                    f"{base}/debug/decisions?limit={bad}"
+                ) == 400
+        finally:
+            server.stop()
+
+
+class TestEventRecorderContract:
+    def test_repeat_events_bump_count_and_last_timestamp(self, monkeypatch):
+        from tpu_dra.client.apiserver import FakeApiServer
+        from tpu_dra.client.clientset import ClientSet
+        from tpu_dra.utils import events as events_mod
+        from tpu_dra.utils.events import TYPE_WARNING, EventRecorder
+
+        cs = ClientSet(FakeApiServer())
+        claim = cs.resource_claims("ns").create(
+            ResourceClaim(metadata=ObjectMeta(name="c", namespace="ns"))
+        )
+        recorder = EventRecorder(cs)
+        stamps = iter(
+            ["2026-08-03T00:00:00Z", "2026-08-03T00:00:05Z"]
+        )
+        monkeypatch.setattr(events_mod, "_now", lambda: next(stamps))
+        recorder.event(claim, TYPE_WARNING, "NoSuitableNode", "msg")
+        recorder.event(claim, TYPE_WARNING, "NoSuitableNode", "msg")
+        evs = cs.events("ns").list()
+        assert len(evs) == 1
+        assert evs[0].count == 2
+        assert evs[0].first_timestamp == "2026-08-03T00:00:00Z"
+        assert evs[0].last_timestamp == "2026-08-03T00:00:05Z"
+
+    def test_never_raises_on_api_error(self):
+        from tpu_dra.client.apiserver import ApiError
+        from tpu_dra.utils.events import TYPE_WARNING, EventRecorder
+
+        class ExplodingClients:
+            def events(self, namespace):
+                raise ApiError("apiserver down")
+
+        claim = ResourceClaim(metadata=ObjectMeta(name="c", namespace="ns"))
+        recorder = EventRecorder(ExplodingClients())
+        # Contract: best-effort, never raises on ApiError.
+        recorder.event(claim, TYPE_WARNING, "NoSuitableNode", "msg")
+
+    def test_update_api_error_tolerated(self):
+        """Compression path: GET succeeds, UPDATE hits an ApiError storm —
+        still swallowed."""
+        from tpu_dra.client.apiserver import ApiError, FakeApiServer
+        from tpu_dra.client.clientset import ClientSet
+        from tpu_dra.utils.events import TYPE_WARNING, EventRecorder
+
+        cs = ClientSet(FakeApiServer())
+        claim = cs.resource_claims("ns").create(
+            ResourceClaim(metadata=ObjectMeta(name="c", namespace="ns"))
+        )
+        recorder = EventRecorder(cs)
+        recorder.event(claim, TYPE_WARNING, "R", "m")
+
+        real = cs.events("ns")
+
+        class FailingUpdate:
+            def __getattr__(self, name):
+                return getattr(real, name)
+
+            def update(self, obj):
+                raise ApiError("conflict storm")
+
+        class Clients:
+            def events(self, namespace):
+                return FailingUpdate()
+
+        EventRecorder(Clients()).event(claim, TYPE_WARNING, "R", "m")
+        assert cs.events("ns").list()[0].count == 1  # unchanged, no raise

@@ -1,0 +1,222 @@
+"""`make explain-smoke`: the full "why is my pod Pending?" story on a
+kubesim cluster.  One unplaceable claim must surface a per-node structured
+reason breakdown through every layer the flight recorder feeds:
+
+- the controller-internal flight recorder (memo-replayed rejections too),
+- the MetricsServer's /debug/decisions endpoint (JSON + text),
+- the `tpudra explain` CLI against that live endpoint,
+- a compressed Warning Event on the ResourceClaim,
+- tpu_dra_rejections_total{reason=...} in the exposition,
+
+and a placeable claim must land tpu_dra_node_prepare_seconds samples +
+the claim e2e latency histogram in the plugin/controller exposition.
+"""
+
+import io
+import json
+import time
+import urllib.request
+
+from tpu_dra.api.k8s import (
+    Pod,
+    PodResourceClaim,
+    PodResourceClaimSource,
+    PodSpec,
+    ResourceClaimParametersReference,
+    ResourceClaimSpec,
+    ResourceClaimTemplate,
+    ResourceClaimTemplateSpec,
+    ResourceClass,
+)
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.tpu_v1alpha1 import (
+    GROUP_NAME,
+    TpuClaimParameters,
+    TpuClaimParametersSpec,
+)
+from tpu_dra.cmds import explain as explain_cmd
+from tpu_dra.controller import decisions
+from tpu_dra.sim import SimCluster
+from tpu_dra.utils.metrics import REGISTRY, MetricsServer
+
+NS = "default"
+
+
+def setup_workload(cluster, *, count, params_name, template):
+    cluster.clientset.tpu_claim_parameters(NS).create(
+        TpuClaimParameters(
+            metadata=ObjectMeta(name=params_name, namespace=NS),
+            spec=TpuClaimParametersSpec(count=count),
+        )
+    )
+    cluster.clientset.resource_claim_templates(NS).create(
+        ResourceClaimTemplate(
+            metadata=ObjectMeta(name=template, namespace=NS),
+            spec=ResourceClaimTemplateSpec(
+                spec=ResourceClaimSpec(
+                    resource_class_name="tpu.google.com",
+                    parameters_ref=ResourceClaimParametersReference(
+                        api_group=GROUP_NAME,
+                        kind="TpuClaimParameters",
+                        name=params_name,
+                    ),
+                )
+            ),
+        )
+    )
+
+
+def make_pod(name, template):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=NS),
+        spec=PodSpec(
+            resource_claims=[
+                PodResourceClaim(
+                    name="tpu",
+                    source=PodResourceClaimSource(
+                        resource_claim_template_name=template
+                    ),
+                )
+            ]
+        ),
+    )
+
+
+def wait_for(predicate, timeout=30.0, poll=0.05, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(poll)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_explain_smoke(tmp_path):
+    decisions.RECORDER.clear()
+    # 2 nodes x 4 chips each; the "hungry" claim asks for 64 -> unplaceable
+    # everywhere, the "small" claim asks 1 -> placeable (drives the prepare
+    # path so the plugin-side histograms fill).
+    cluster = SimCluster(str(tmp_path), nodes=2, mesh="2x2x1")
+    cluster.start()
+    try:
+        cluster.clientset.resource_classes().create(
+            ResourceClass(
+                metadata=ObjectMeta(name="tpu.google.com"),
+                driver_name=GROUP_NAME,
+            )
+        )
+        setup_workload(
+            cluster, count=64, params_name="hungry", template="hungry-template"
+        )
+        setup_workload(
+            cluster, count=1, params_name="small", template="small-template"
+        )
+        cluster.clientset.pods(NS).create(
+            make_pod("stuck-pod", "hungry-template")
+        )
+        cluster.clientset.pods(NS).create(
+            make_pod("happy-pod", "small-template")
+        )
+        cluster.wait_for_pod_running(NS, "happy-pod", timeout=30)
+
+        claim_name = "stuck-pod-tpu"
+
+        # -- flight recorder: every node rejected with a structured reason
+        def both_nodes_rejected():
+            recs = decisions.RECORDER.query(claim=claim_name)
+            nodes = {
+                r.node
+                for r in recs
+                if r.verdict == decisions.UNSUITABLE and r.reason
+            }
+            return recs if {"node-0", "node-1"} <= nodes else None
+
+        records = wait_for(both_nodes_rejected, what="per-node rejections")
+        latest = decisions.latest_per_node(
+            [r for r in records if r.verdict == decisions.UNSUITABLE]
+        )
+        for rec in latest.values():
+            assert rec.reason == decisions.ReasonCode.INSUFFICIENT_CHIPS
+            assert "64" in rec.detail
+
+        # -- memo-replayed rejections keep their reason (steady-state
+        # re-syncs hit the verdict memo within its TTL)
+        def memo_replay():
+            return [
+                r
+                for r in decisions.RECORDER.query(claim=claim_name)
+                if r.provenance == decisions.PROVENANCE_MEMO and r.reason
+            ]
+
+        replayed = wait_for(memo_replay, what="memo-replayed rejection")
+        assert replayed[0].reason == decisions.ReasonCode.INSUFFICIENT_CHIPS
+
+        # -- compressed Warning Event on the claim
+        def warning_event():
+            evs = [
+                e
+                for e in cluster.clientset.events(NS).list()
+                if e.involved_object.name == claim_name
+                and e.reason == "NoSuitableNode"
+            ]
+            return evs or None
+
+        events = wait_for(warning_event, what="NoSuitableNode event")
+        assert len(events) == 1  # compressed, not piling up
+        assert "0/2 nodes suitable" in events[0].message
+        assert "2/2 InsufficientChips" in events[0].message
+        assert events[0].type == "Warning"
+        ev_count = events[0].count
+
+        def event_compressed():
+            evs = warning_event()
+            return evs if evs and evs[0].count > ev_count else None
+
+        wait_for(event_compressed, what="event count bump (compression)")
+
+        # -- /debug/decisions endpoint + tpudra explain CLI
+        server = MetricsServer("127.0.0.1:0")
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            doc = json.loads(
+                urllib.request.urlopen(
+                    f"{base}/debug/decisions?claim={claim_name}"
+                ).read().decode()
+            )
+            assert doc["decisions"], "endpoint returned no decisions"
+            reasons = {
+                d["reason"] for d in doc["decisions"] if d["reason"]
+            }
+            assert decisions.ReasonCode.INSUFFICIENT_CHIPS in reasons
+            assert "InsufficientChips" in doc["summary"]
+
+            out = io.StringIO()
+            rc = explain_cmd.explain(
+                explain_cmd.parse_args(
+                    ["explain", claim_name, "--controller", base]
+                ),
+                out=out,
+            )
+            assert rc == 0
+            printed = out.getvalue()
+            assert printed.strip(), "explain printed nothing"
+            assert "node-0" in printed and "node-1" in printed
+            assert "InsufficientChips" in printed
+            assert "0/2 nodes suitable" in printed
+        finally:
+            server.stop()
+
+        # -- metrics: rejection reasons + prepare/e2e histograms exposed
+        text = REGISTRY.expose()
+        assert (
+            'tpu_dra_rejections_total{reason="InsufficientChips"}' in text
+        )
+        assert 'tpu_dra_node_prepare_seconds_count{operation="prepare"}' in text
+        assert 'tpu_dra_claim_e2e_seconds_count{phase="allocated"}' in text
+        assert 'tpu_dra_claim_e2e_seconds_count{phase="prepared"}' in text
+        assert 'tpu_dra_claim_e2e_seconds_count{phase="e2e"}' in text
+        assert 'tpu_dra_allocated_chips{node="node-0",state="prepared"}' in text
+    finally:
+        cluster.stop()

@@ -181,6 +181,34 @@ class TestEngineValidation:
         with pytest.raises(ValueError, match="max_new"):
             eng.submit([1], 5)
 
+    def test_out_of_range_prompt_token_rejected_at_submit(self):
+        """An out-of-vocab id would silently clamp in the embedding gather
+        and produce plausible-but-wrong output; bools are int subclasses
+        that would embed as 0/1 (ADVICE.md round 5)."""
+        eng = ServeEngine(
+            init_params(CFG), CFG, slots=1, prompt_slots=4, max_new_cap=2
+        )
+        with pytest.raises(ValueError, match="prompt token ids"):
+            eng.submit([1, CFG.vocab])
+        with pytest.raises(ValueError, match="prompt token ids"):
+            eng.submit([-1])
+        with pytest.raises(ValueError, match="prompt token ids"):
+            eng.submit([True, 2])
+        with pytest.raises(ValueError, match="prompt token ids"):
+            eng.submit([1.0])
+        eng.submit([0, CFG.vocab - 1])  # boundary ids are fine
+
+    def test_bool_stop_sequence_token_rejected_at_submit(self):
+        """bool passes isinstance(int) and compares equal to token 1 —
+        [[True]] must not validate (ADVICE.md round 5)."""
+        eng = ServeEngine(
+            init_params(CFG), CFG, slots=1, prompt_slots=4, max_new_cap=2
+        )
+        with pytest.raises(ValueError, match="int token ids"):
+            eng.submit([1], stop_sequences=[[True]])
+        with pytest.raises(ValueError, match="int token ids"):
+            eng.submit([1], stop_sequences=[[1, False]])
+
     def test_out_of_range_seed_rejected_at_submit(self):
         eng = ServeEngine(
             init_params(CFG), CFG, slots=1, prompt_slots=4, max_new_cap=2,

@@ -8,5 +8,11 @@ from one image, Dockerfile.ubuntu:50-53):
 - ``python -m tpu_dra.cmds.set_nas_status`` init/preStop NAS status flipper
   (reference cmd/set-nas-status/main.go:37)
 
+Plus the operator CLI (no reference analog):
+
+- ``python -m tpu_dra.cmds.explain`` / ``tpudra explain <claim>``
+  "why is my pod Pending?" — per-node placement-decision breakdown from
+  the controller's flight recorder (controller/decisions.py)
+
 Shared flag groups live in flags.py (reference pkg/flags/*).
 """

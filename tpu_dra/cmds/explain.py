@@ -1,0 +1,155 @@
+"""tpudra — the operator CLI.  `tpudra explain <claim>` answers "why is
+my pod Pending?" from the controller's placement-decision flight recorder
+(controller/decisions.py) without log archaeology:
+
+    $ tpudra explain my-pod-tpu --controller http://controller:8080
+    claim my-pod-tpu — 0/4 nodes suitable: 3/4 InsufficientChips, 1/4 NodeNotReady
+      node-0   unsuitable  InsufficientChips: requested 8 chip(s), 4 free ...  [snapshot]
+      node-1   unsuitable  InsufficientChips: requested 8 chip(s), 4 free ...  [memo]
+      ...
+
+It queries the live controller's ``/debug/decisions`` endpoint (the same
+MetricsServer that serves /metrics and /debug/traces — works against a
+real deployment or a kubesim rung controller), and with ``--apiserver``
+additionally prints the claim's Events (the compressed Warning the
+reconciler records on unplaceable claims).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from tpu_dra.cmds import flags
+from tpu_dra.version import version_string
+
+
+def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="tpudra",
+        description="operator CLI for the TPU DRA driver",
+    )
+    parser.add_argument("--version", action="version", version=version_string())
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    explain = sub.add_parser(
+        "explain",
+        help="per-node placement-decision breakdown for a ResourceClaim",
+    )
+    explain.add_argument("claim", help="ResourceClaim name (or uid)")
+    explain.add_argument(
+        "--controller",
+        default=flags._env_default("TPUDRA_CONTROLLER", "http://127.0.0.1:8080"),
+        help="controller debug HTTP endpoint (--http-endpoint of the "
+        "controller binary) [TPUDRA_CONTROLLER]",
+    )
+    explain.add_argument(
+        "--pprof-path",
+        default="/debug",
+        help="controller debug path prefix (matches its --pprof-path)",
+    )
+    explain.add_argument(
+        "--apiserver",
+        default="",
+        help="also fetch the claim's Events from this apiserver URL",
+    )
+    explain.add_argument(
+        "--namespace",
+        default=flags._env_default("POD_NAMESPACE", "default"),
+        help="claim namespace for the Events lookup [POD_NAMESPACE]",
+    )
+    explain.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output form (text: per-node tree; json: raw records)",
+    )
+    explain.add_argument(
+        "--limit", type=int, default=256,
+        help="max decision records to fetch",
+    )
+    return parser.parse_args(argv)
+
+
+def _fetch_decisions(args: argparse.Namespace) -> dict:
+    query = urllib.parse.urlencode(
+        {"claim": args.claim, "format": "json", "limit": args.limit}
+    )
+    base = args.controller.rstrip("/")
+    pprof = "/" + args.pprof_path.strip("/")
+    url = f"{base}{pprof}/decisions?{query}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _fetch_events(args: argparse.Namespace) -> "list":
+    from tpu_dra.client.clientset import ClientSet
+    from tpu_dra.client.restserver import ClusterConfig, RestApiServer
+
+    clientset = ClientSet(
+        RestApiServer(ClusterConfig(server=args.apiserver), qps=100, burst=200)
+    )
+    events = clientset.events(args.namespace).list()
+    return [e for e in events if e.involved_object.name == args.claim]
+
+
+def explain(args: argparse.Namespace, out=sys.stdout) -> int:
+    from tpu_dra.controller import decisions
+
+    try:
+        doc = _fetch_decisions(args)
+    except (urllib.error.URLError, OSError) as e:
+        print(
+            f"error: cannot reach controller at {args.controller}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+
+    records = [decisions.DecisionRecord(**r) for r in doc.get("decisions", [])]
+    if args.format == "json":
+        print(json.dumps(doc, indent=2), file=out)
+    elif not records:
+        print(
+            f"no placement decisions recorded for claim {args.claim!r} "
+            f"(recorded={doc.get('recorded', 0)}, "
+            f"dropped={doc.get('dropped', 0)}; is the claim pending and "
+            "the controller scheduling it?)",
+            file=out,
+        )
+    else:
+        print(decisions.render_text(records), end="", file=out)
+        if doc.get("dropped"):
+            print(
+                f"(flight recorder wrapped: {doc['dropped']} older "
+                "record(s) dropped)",
+                file=out,
+            )
+
+    if args.apiserver:
+        try:
+            events = _fetch_events(args)
+        except Exception as e:
+            print(f"error: events lookup failed: {e}", file=sys.stderr)
+            return 1
+        if events and args.format != "json":
+            print("\nevents:", file=out)
+            for ev in sorted(events, key=lambda e: e.last_timestamp):
+                print(
+                    f"  {ev.type:<8} {ev.reason:<16} x{ev.count}  "
+                    f"{ev.message}",
+                    file=out,
+                )
+    return 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    args = parse_args(argv)
+    if args.command == "explain":
+        return explain(args)
+    return 2  # unreachable: subparsers are required
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

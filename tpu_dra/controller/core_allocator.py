@@ -33,7 +33,9 @@ from tpu_dra.api import serde
 from tpu_dra.api import tpu_v1alpha1 as tpucrd
 from tpu_dra.api.k8s import Pod, ResourceClaim
 from tpu_dra.api.topology import Placement
+from tpu_dra.controller import decisions
 from tpu_dra.controller.availability import NodeSnapshot, compute_free_intervals
+from tpu_dra.controller.decisions import ReasonCode
 from tpu_dra.controller.pending import PerNodeAllocatedClaims
 from tpu_dra.controller.types import ClaimAllocation
 
@@ -111,6 +113,12 @@ class CoreDriver:
             parent = crd.spec.allocated_claims.get(dev.subslice_claim_uid)
             if parent is None or parent.subslice is None:
                 self.pending_allocated_claims.remove_node(claim_uid, selected_node)
+                decisions.record_conflict(
+                    claim,
+                    selected_node,
+                    f"parent subslice claim {dev.subslice_claim_uid} no "
+                    "longer allocated; dropped for re-placement",
+                )
                 raise RuntimeError(
                     f"parent subslice claim {dev.subslice_claim_uid} of core "
                     f"claim '{claim_uid}' is no longer allocated on "
@@ -129,6 +137,12 @@ class CoreDriver:
                     ):
                         self.pending_allocated_claims.remove_node(
                             claim_uid, selected_node
+                        )
+                        decisions.record_conflict(
+                            claim,
+                            selected_node,
+                            f"pending core pick overlaps committed core "
+                            f"claim '{uid}'; dropped for re-placement",
                         )
                         raise RuntimeError(
                             f"pending core allocation for claim "
@@ -180,10 +194,14 @@ class CoreDriver:
         # real search.
         if stats is not None:
             stats["core"] = "miss"
-        placements = self._allocate(crd, pod, corecas, snapshot)
+        placements, reason = self._allocate(crd, pod, corecas, snapshot)
         if placements is None or len(placements) != len(corecas):
+            code, detail = reason or (
+                ReasonCode.CORES_EXHAUSTED,
+                f"no placement for {len(corecas)} core claim(s)",
+            )
             for other in allcas:
-                other.unsuitable_nodes.append(potential_node)
+                decisions.reject(other, potential_node, code, detail)
             return
 
         parent_sharing = self._parent_sharing(crd)
@@ -267,7 +285,7 @@ class CoreDriver:
         pod: Pod,
         corecas: list[ClaimAllocation],
         snapshot: "NodeSnapshot | None" = None,
-    ) -> "dict[str, CorePlacement] | None":
+    ) -> "tuple[dict[str, CorePlacement] | None, tuple[str, str] | None]":
         possible: dict[str, list[CorePlacement]] = {}
         for ca in corecas:
             claim_uid = ca.claim.metadata.uid
@@ -283,10 +301,18 @@ class CoreDriver:
 
             params: tpucrd.CoreClaimParametersSpec = ca.claim_parameters
             want = core_count_of(params.profile)
-            candidates: list[CorePlacement] = []
-            for parent_uid, parent_dev in self._parents_by_name(
+            parents = self._parents_by_name(
                 crd, pod, params.subslice_claim_name
-            ):
+            )
+            if not parents:
+                return None, (
+                    ReasonCode.PARENT_CLAIM_MISSING,
+                    f"claim {ca.claim.metadata.name!r}: no allocated "
+                    f"subslice claim matches "
+                    f"{params.subslice_claim_name!r} on this node",
+                )
+            candidates: list[CorePlacement] = []
+            for parent_uid, parent_dev in parents:
                 free = self._free_intervals(crd, parent_uid, parent_dev, snapshot)
                 # Contiguous runs of `want` free cores.
                 free_starts = {p.start for p in free}
@@ -300,7 +326,12 @@ class CoreDriver:
                             )
                         )
             if not candidates:
-                return None
+                return None, (
+                    ReasonCode.CORES_EXHAUSTED,
+                    f"claim {ca.claim.metadata.name!r}: no run of {want} "
+                    f"contiguous free core(s) left in parent subslice "
+                    f"claim {params.subslice_claim_name!r}",
+                )
             possible[claim_uid] = candidates
 
         order = [ca.claim.metadata.uid for ca in corecas]
@@ -319,4 +350,10 @@ class CoreDriver:
                 del chosen[uid]
             return False
 
-        return dict(chosen) if search(0) else None
+        if search(0):
+            return dict(chosen), None
+        return None, (
+            ReasonCode.CORES_EXHAUSTED,
+            f"per-claim core runs exist but no mutually non-overlapping "
+            f"combination for {len(corecas)} core claim(s)",
+        )

@@ -1,0 +1,314 @@
+"""Placement-decision flight recorder — "why is my pod Pending?".
+
+The UnsuitableNodes fan-out (driver.py) probes every potential node for
+every pending claim and historically kept only what the scheduler needs:
+the node-name list.  The *why* — not enough chips?  no contiguous ICI
+block?  parent subslice gone? — evaporated, and after the snapshot/memo
+caches (PR 2) a verdict can come from three different code paths (fresh
+probe, snapshot-backed search, verdict-memo replay) with no record of
+which one fired.  The reference driver shares the blind spot: its
+UnsuitableNodes plumbing (driver.go:228-298) returns bare node lists.
+
+This module is the missing black box:
+
+- ``ReasonCode``      — the closed vocabulary of structured rejection
+  reasons every allocator now attaches to a verdict (plus free-text
+  detail).  Codes, not prose, so operators can aggregate and alert.
+- ``DecisionRecord``  — one (pod, claim, node) placement verdict:
+  suitable / unsuitable / allocated / conflict, reason code + detail,
+  cache provenance (fresh | snapshot | memo), trace id, monotonic seq.
+- ``FlightRecorder``  — lock-protected bounded ring buffer of records
+  with a dropped-records counter; queried by the MetricsServer's
+  ``/debug/decisions`` endpoint and the ``tpudra explain`` CLI.
+- ``summarize``       — the compressed per-reason breakdown used for
+  Warning Events on ResourceClaims ("3/4 nodes InsufficientChips,
+  1/4 NodeNotReady").
+
+Every unsuitable record also moves ``tpu_dra_rejections_total{reason=}``
+(utils/metrics.py), so dashboards see the reason mix without scraping
+the debug endpoint.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass
+
+from tpu_dra.utils.metrics import REJECTIONS_TOTAL
+
+
+class ReasonCode:
+    """Structured rejection reasons (the closed vocabulary).
+
+    Keep these stable: they are metric label values, Event message
+    components, and the thing operators grep runbooks for.
+    """
+
+    # Whole-chip (TPU) claims
+    INSUFFICIENT_CHIPS = "InsufficientChips"  # fewer free matching chips than requested
+    TOPOLOGY_MISMATCH = "TopologyMismatch"  # chips exist, no contiguous ICI block
+    NO_HOST_TOPOLOGY = "NoHostTopology"  # degraded node: no ICI bounds published
+    # Subslice claims
+    SUBSLICE_UNSATISFIABLE = "SubsliceUnsatisfiable"  # no free profile placement combo
+    PARENT_AFFINITY_UNSATISFIED = "ParentAffinityUnsatisfied"  # affinity names no usable parent
+    # Core claims
+    CORES_EXHAUSTED = "CoresExhausted"  # parent exists, no contiguous free core run
+    PARENT_CLAIM_MISSING = "ParentClaimMissing"  # named parent subslice claim not allocated
+    # Node / apiserver state
+    NODE_NOT_READY = "NodeNotReady"  # NAS status != Ready
+    NAS_GET_FAILED = "NasGetFailed"  # NAS unreadable during the probe
+    # Commit-time staleness: a pending pick conflicted with committed state
+    # under the node lock (promote guard) and was dropped for re-placement.
+    STALE_NAS = "StaleNAS"
+
+    ALL = (
+        INSUFFICIENT_CHIPS,
+        TOPOLOGY_MISMATCH,
+        NO_HOST_TOPOLOGY,
+        SUBSLICE_UNSATISFIABLE,
+        PARENT_AFFINITY_UNSATISFIED,
+        CORES_EXHAUSTED,
+        PARENT_CLAIM_MISSING,
+        NODE_NOT_READY,
+        NAS_GET_FAILED,
+        STALE_NAS,
+    )
+
+
+# Verdicts
+SUITABLE = "suitable"
+UNSUITABLE = "unsuitable"
+ALLOCATED = "allocated"
+CONFLICT = "conflict"  # promote-time guard dropped a stale pending pick
+
+# Cache provenance: which path produced the verdict.
+PROVENANCE_FRESH = "fresh"  # GET-path probe, full availability rebuild
+PROVENANCE_SNAPSHOT = "snapshot"  # informer-served probe over a node snapshot
+PROVENANCE_MEMO = "memo"  # verdict-memo fast path replayed a prior pass
+
+
+@dataclass
+class DecisionRecord:
+    """One placement decision for one (pod, claim, node) triple."""
+
+    seq: int = 0  # recorder-assigned, monotonic per process
+    ts_unix: float = 0.0
+    pod: str = ""
+    namespace: str = ""
+    claim_uid: str = ""
+    claim: str = ""  # claim name
+    node: str = ""
+    verdict: str = SUITABLE
+    reason: str = ""  # ReasonCode.* when verdict is unsuitable/conflict
+    detail: str = ""
+    provenance: str = PROVENANCE_FRESH
+    trace_id: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "ts_unix": self.ts_unix,
+            "pod": self.pod,
+            "namespace": self.namespace,
+            "claim_uid": self.claim_uid,
+            "claim": self.claim,
+            "node": self.node,
+            "verdict": self.verdict,
+            "reason": self.reason,
+            "detail": self.detail,
+            "provenance": self.provenance,
+            "trace_id": self.trace_id,
+        }
+
+
+DEFAULT_CAPACITY = 4096
+
+
+class FlightRecorder:
+    """Bounded, lock-protected ring buffer of DecisionRecords.
+
+    Like the trace exporter it answers "what just happened", not
+    long-term storage: at capacity the oldest record is evicted and the
+    ``dropped`` counter moves, so consumers can tell a quiet recorder
+    from one that wrapped."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        # deque(maxlen): O(1) eviction — record() sits on the scheduling
+        # fan-out hot path, and a full list-based ring would memmove
+        # `capacity` slots per append under the lock.
+        self._records: "collections.deque[DecisionRecord]" = collections.deque(
+            maxlen=capacity
+        )
+        self._seq = 0
+        self._dropped = 0
+
+    def record(self, rec: DecisionRecord) -> DecisionRecord:
+        """Stamp seq/timestamp, append (evicting at capacity), and move
+        the rejection counter when the verdict is a rejection."""
+        if not rec.ts_unix:
+            rec.ts_unix = time.time()
+        with self._lock:
+            self._seq += 1
+            rec.seq = self._seq
+            if len(self._records) == self.capacity:
+                self._dropped += 1  # append below evicts the oldest
+            self._records.append(rec)
+        if rec.verdict in (UNSUITABLE, CONFLICT) and rec.reason:
+            REJECTIONS_TOTAL.inc(reason=rec.reason)
+        return rec
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    @property
+    def recorded(self) -> int:
+        """Total records ever recorded (monotonic, survives eviction)."""
+        with self._lock:
+            return self._seq
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._dropped = 0
+
+    def query(
+        self,
+        claim: "str | None" = None,
+        node: "str | None" = None,
+        pod: "str | None" = None,
+        limit: "int | None" = None,
+    ) -> "list[DecisionRecord]":
+        """Oldest-first snapshot; ``claim`` matches name or uid; ``limit``
+        keeps the most recent N after filtering."""
+        with self._lock:
+            out = list(self._records)
+        if claim:
+            out = [r for r in out if claim in (r.claim, r.claim_uid)]
+        if node:
+            out = [r for r in out if r.node == node]
+        if pod:
+            out = [r for r in out if r.pod == pod]
+        if limit is not None and limit < len(out):
+            out = out[len(out) - limit:]
+        return out
+
+
+# The process-wide recorder, shared like trace.EXPORTER: the controller
+# writes it, the MetricsServer's /debug/decisions endpoint reads it.
+RECORDER = FlightRecorder()
+
+
+def latest_per_node(records: "list[DecisionRecord]") -> "dict[str, DecisionRecord]":
+    """node -> its most recent record (records arrive oldest-first)."""
+    latest: "dict[str, DecisionRecord]" = {}
+    for rec in records:
+        latest[rec.node] = rec
+    return latest
+
+
+def _format_breakdown(ok: int, total: int, reasons: "dict[str, int]") -> str:
+    """The ONE formatter behind both summaries: "ok/total nodes suitable:
+    n/total Code, ...".  Deterministic ((-count, code) order) because the
+    string doubles as the Warning-Event message whose stability the
+    apiserver-side compression keys on."""
+    head = f"{ok}/{total} nodes suitable"
+    if not reasons:
+        return head
+    parts = ", ".join(
+        f"{n}/{total} {code}"
+        for code, n in sorted(reasons.items(), key=lambda kv: (-kv[1], kv[0]))
+    )
+    return f"{head}: {parts}"
+
+
+def summarize(records: "list[DecisionRecord]") -> str:
+    """Compressed per-reason breakdown over each node's LATEST verdict:
+    "0/4 nodes suitable: 3/4 InsufficientChips, 1/4 NodeNotReady"."""
+    latest = latest_per_node(records)
+    if not latest:
+        return "no placement decisions recorded"
+    ok = sum(1 for r in latest.values() if r.verdict in (SUITABLE, ALLOCATED))
+    reasons: "dict[str, int]" = {}
+    for rec in latest.values():
+        if rec.verdict == UNSUITABLE:
+            code = rec.reason or "Unknown"
+            reasons[code] = reasons.get(code, 0) + 1
+    return _format_breakdown(ok, len(latest), reasons)
+
+
+def render_text(records: "list[DecisionRecord]") -> str:
+    """Plain-text per-claim tree: one block per claim, one line per node
+    (latest verdict), newest probe information wins."""
+    by_claim: "dict[str, list[DecisionRecord]]" = {}
+    for rec in records:
+        by_claim.setdefault(rec.claim or rec.claim_uid, []).append(rec)
+    out: "list[str]" = []
+    for claim in sorted(by_claim):
+        recs = by_claim[claim]
+        out.append(f"claim {claim} — {summarize(recs)}")
+        latest = latest_per_node(recs)
+        for node in sorted(latest):
+            rec = latest[node]
+            line = f"  {node:<16} {rec.verdict:<10}"
+            if rec.reason:
+                line += f" {rec.reason}"
+            if rec.detail:
+                line += f": {rec.detail}"
+            line += f"  [{rec.provenance}]"
+            out.append(line)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def record_conflict(claim, node: str, detail: str) -> None:
+    """Flight-record a promote-time conflict: a pending pick collided with
+    committed state under the node lock (the allocators' staleness guard)
+    and was dropped for re-placement.  These are the StaleNAS rejections —
+    invisible in the fan-out, very visible to whoever's pod just bounced."""
+    from tpu_dra.utils import trace
+
+    ctx = trace.current_context()
+    RECORDER.record(
+        DecisionRecord(
+            namespace=claim.metadata.namespace,
+            claim_uid=claim.metadata.uid,
+            claim=claim.metadata.name,
+            node=node,
+            verdict=CONFLICT,
+            reason=ReasonCode.STALE_NAS,
+            detail=detail,
+            trace_id=ctx.trace_id if ctx is not None else "",
+        )
+    )
+
+
+def summarize_rejections(
+    node_rejections: "dict[str, tuple[str, str]]", total_nodes: int
+) -> str:
+    """Per-reason breakdown of one fan-out's rejections (the Warning-Event
+    message body): "0/16 nodes suitable: 12/16 InsufficientChips,
+    4/16 TopologyMismatch"."""
+    reasons: "dict[str, int]" = {}
+    for code, _ in node_rejections.values():
+        reasons[code] = reasons.get(code, 0) + 1
+    return _format_breakdown(
+        total_nodes - len(node_rejections), total_nodes, reasons
+    )
+
+
+def reject(ca, node: str, code: str, detail: str) -> None:
+    """Mark ``node`` unsuitable for ``ca`` with a structured reason.
+
+    The allocators' replacement for a bare ``unsuitable_nodes.append``:
+    the node list keeps its scheduler contract while the (code, detail)
+    pair lands in ``ca.node_rejections`` for the flight recorder, the
+    verdict memo, and the claim's Warning Event.  First reason wins —
+    allocators run parent-first (chips → subslices → cores), so the
+    earliest rejection is the most specific one."""
+    ca.unsuitable_nodes.append(node)
+    ca.node_rejections.setdefault(node, (code, detail))

@@ -35,15 +35,19 @@ from tpu_dra.api.meta import ObjectMeta
 from tpu_dra.client.apiserver import ApiError, NotFoundError
 from tpu_dra.client.clientset import ClientSet
 from tpu_dra.client.nasclient import NasClient
+from tpu_dra.controller import decisions
 from tpu_dra.controller.availability import AvailabilityCache, build_snapshot
 from tpu_dra.controller.core_allocator import CoreDriver
+from tpu_dra.controller.decisions import ReasonCode
 from tpu_dra.controller.nodelock import PerNodeMutex
 from tpu_dra.controller.subslice_allocator import SubsliceDriver
 from tpu_dra.controller.tpu_allocator import TpuDriver
 from tpu_dra.controller.types import ClaimAllocation, params_fingerprint
 from tpu_dra.utils import trace
+from tpu_dra.utils.events import parse_time
 from tpu_dra.utils.metrics import (
     ALLOCATE_SECONDS,
+    CLAIM_E2E_SECONDS,
     INFORMER_FALLBACKS,
     INFORMER_READS,
     PLACEMENT_CACHE_HITS,
@@ -94,7 +98,9 @@ class ControllerDriver:
         self.availability = AvailabilityCache()
         self.availability.register_age_gauge()
         # Probe memo: (snapshot fingerprint, pod, claim-set key)
-        # -> which of those claims found the node unsuitable.  The
+        # -> per-claim verdict: None (suitable) or the structured
+        # (ReasonCode, detail) rejection, so a replay reproduces the *why*
+        # for the flight recorder, not just the node list.  The
         # reconciler re-syncs a PodSchedulingContext on every watch tick
         # (its own status writes included), so probe passes repeat in
         # bursts deriving identical verdicts from identical state; the memo
@@ -106,7 +112,9 @@ class ControllerDriver:
         # removals can race the post-pass version read, and memo hits skip
         # the set() calls that refresh pending TTL stamps — a short entry
         # lifetime bounds both to one memo window.
-        self._probe_memo: "dict[tuple, tuple[float, dict[str, bool]]]" = {}
+        self._probe_memo: (
+            "dict[tuple, tuple[float, dict[str, tuple[str, str] | None]]]"
+        ) = {}
         self._probe_memo_lock = threading.Lock()
         self.PROBE_MEMO_CAP = 8192
         # 5s: long enough that a fleet-sized seeding pass (which can take
@@ -436,6 +444,14 @@ class ControllerDriver:
         nas.metadata.annotations[trace.nas_annotation_key(claim_uid)] = (
             trace.inject()
         )
+        # Lifecycle timestamps ride the same channel: the plugin observes
+        # allocated->prepared / created->prepared into
+        # tpu_dra_claim_e2e_seconds without a controller round trip.
+        created = parse_time(claim.metadata.creation_timestamp)
+        now = _time.time()
+        nas.metadata.annotations[trace.e2e_annotation_key(claim_uid)] = (
+            f"{created if created is not None else now:.3f} {now:.3f}"
+        )
         return on_success, gang_name
 
     def allocate_batch(
@@ -531,6 +547,24 @@ class ControllerDriver:
                             claim.metadata.name,
                             selected_node,
                         )
+                        decisions.RECORDER.record(
+                            decisions.DecisionRecord(
+                                namespace=claim.metadata.namespace,
+                                claim_uid=claim.metadata.uid,
+                                claim=claim.metadata.name,
+                                node=selected_node,
+                                verdict=decisions.ALLOCATED,
+                                trace_id=ctx.trace_id,
+                            )
+                        )
+                        created = parse_time(
+                            claim.metadata.creation_timestamp
+                        )
+                        if created is not None:
+                            CLAIM_E2E_SECONDS.observe(
+                                max(_time.time() - created, 0.0),
+                                phase="allocated",
+                            )
         # Outside the node lock (repair writes other nodes' NAS under
         # their own locks): reconcile members committed against a
         # tentative or since-moved rank-0 coordinator.  Best-effort:
@@ -624,9 +658,13 @@ class ControllerDriver:
             else:
                 raise ValueError(f"unknown AllocatedDevices type: {allocated.type()}")
             del nas.spec.allocated_claims[claim_uid]
-            # Drop the claim's traceparent annotation with its allocation.
+            # Drop the claim's traceparent + lifecycle annotations with its
+            # allocation.
             nas.metadata.annotations.pop(
                 trace.nas_annotation_key(claim_uid), None
+            )
+            nas.metadata.annotations.pop(
+                trace.e2e_annotation_key(claim_uid), None
             )
             client.update(nas.spec)
             self._note_node_write(selected_node, nas)
@@ -707,7 +745,11 @@ class ControllerDriver:
             pod=pod.metadata.name,
             claims=len(cas),
             nodes=len(potential_nodes),
-        ), UNSUITABLE_SECONDS.time():
+        ) as sp, UNSUITABLE_SECONDS.time():
+            # The umbrella span's trace id stamps every per-node decision
+            # record (contextvars don't cross the pool threads, so it is
+            # threaded explicitly).
+            trace_id = sp.context.trace_id
             try:
                 dead = self._dead_pending_claims(potential_nodes)
                 claims_fp = tuple(
@@ -720,7 +762,7 @@ class ControllerDriver:
                     futures = [
                         self._fanout_executor().submit(
                             self._unsuitable_node, pod, cas, node, dead,
-                            claims_fp,
+                            claims_fp, trace_id,
                         )
                         for node in potential_nodes
                     ]
@@ -733,7 +775,9 @@ class ControllerDriver:
                         future.result()
                 else:
                     for node in potential_nodes:
-                        self._unsuitable_node(pod, cas, node, dead, claims_fp)
+                        self._unsuitable_node(
+                            pod, cas, node, dead, claims_fp, trace_id
+                        )
             finally:
                 # Canonical order (sorted, deduped) — in a ``finally`` so a
                 # probe exception can't leave order-flapping lists behind:
@@ -806,6 +850,51 @@ class ControllerDriver:
             self.core.pending_allocated_claims.version(node),
         )
 
+    def _record_decisions(
+        self,
+        pod: Pod,
+        allcas: list[ClaimAllocation],
+        node: str,
+        provenance: str,
+        trace_id: str,
+    ) -> None:
+        """One flight-recorder entry per claim for this node's verdict,
+        structured reason included (ca.node_rejections)."""
+        for ca in allcas:
+            rej = ca.node_rejections.get(node)
+            decisions.RECORDER.record(
+                decisions.DecisionRecord(
+                    pod=pod.metadata.name,
+                    namespace=ca.claim.metadata.namespace,
+                    claim_uid=ca.claim.metadata.uid,
+                    claim=ca.claim.metadata.name,
+                    node=node,
+                    verdict=decisions.UNSUITABLE if rej else decisions.SUITABLE,
+                    reason=rej[0] if rej else "",
+                    detail=rej[1] if rej else "",
+                    provenance=provenance,
+                    trace_id=trace_id,
+                )
+            )
+
+    def _replay_memo_verdict(
+        self,
+        pod: Pod,
+        allcas: list[ClaimAllocation],
+        potential_node: str,
+        verdict: "dict[str, tuple[str, str] | None]",
+        trace_id: str,
+    ) -> None:
+        """Apply a memoized probe verdict, structured reasons included —
+        the fast path must not lose the *why* the full pass derived."""
+        for ca in allcas:
+            rej = verdict.get(ca.claim.metadata.uid)
+            if rej:
+                decisions.reject(ca, potential_node, rej[0], rej[1])
+        self._record_decisions(
+            pod, allcas, potential_node, decisions.PROVENANCE_MEMO, trace_id
+        )
+
     def _unsuitable_node(
         self,
         pod: Pod,
@@ -813,7 +902,17 @@ class ControllerDriver:
         potential_node: str,
         dead_pending: set[str] | None = None,
         claims_fp: "tuple | None" = None,
+        trace_id: str = "",
     ) -> None:
+        # This probe is about to derive THIS node's verdict from scratch:
+        # drop any rejection a previous pass left for it (callers — bench,
+        # retries — reuse ClaimAllocations across passes), so the memo
+        # store and the flight recorder below read only this pass's
+        # verdict, never a stale one that would mark a now-suitable node
+        # unsuitable.  Distinct keys per pool thread, same discipline as
+        # the unsuitable_nodes appends.
+        for ca in allcas:
+            ca.node_rejections.pop(potential_node, None)
         with self.lock.locked(potential_node):
             # Memo FAST PATH: the verdict memo keys on (rv, pending
             # versions, pod, claims) — all readable without materializing
@@ -842,13 +941,10 @@ class ControllerDriver:
                             ):
                                 PROBE_MEMO_HITS.inc()
                                 PLACEMENT_CACHE_HITS.inc()
-                                for ca in allcas:
-                                    if entry[1].get(
-                                        ca.claim.metadata.uid, False
-                                    ):
-                                        ca.unsuitable_nodes.append(
-                                            potential_node
-                                        )
+                                self._replay_memo_verdict(
+                                    pod, allcas, potential_node, entry[1],
+                                    trace_id,
+                                )
                                 return
             # Informer path: the cached copy is private (pickle round-trip)
             # and rv-fenced against our own writes (_informer_nas) — the
@@ -865,13 +961,35 @@ class ControllerDriver:
                 nas, client = self._nas_client(potential_node)
                 try:
                     client.get()
-                except ApiError:
+                except ApiError as e:
                     for ca in allcas:
-                        ca.unsuitable_nodes.append(potential_node)
+                        decisions.reject(
+                            ca,
+                            potential_node,
+                            ReasonCode.NAS_GET_FAILED,
+                            f"NodeAllocationState unreadable: {e}",
+                        )
+                    self._record_decisions(
+                        pod, allcas, potential_node,
+                        decisions.PROVENANCE_FRESH, trace_id,
+                    )
                     return
             if nas.status != nascrd.STATUS_READY:
                 for ca in allcas:
-                    ca.unsuitable_nodes.append(potential_node)
+                    decisions.reject(
+                        ca,
+                        potential_node,
+                        ReasonCode.NODE_NOT_READY,
+                        f"NodeAllocationState status is "
+                        f"{nas.status or 'unset'!r}",
+                    )
+                self._record_decisions(
+                    pod, allcas, potential_node,
+                    decisions.PROVENANCE_SNAPSHOT
+                    if from_informer
+                    else decisions.PROVENANCE_FRESH,
+                    trace_id,
+                )
                 return
 
             for uid in dead_pending or ():
@@ -903,14 +1021,11 @@ class ControllerDriver:
                 if entry is not None and now - entry[0] <= self.PROBE_MEMO_TTL_S:
                     PROBE_MEMO_HITS.inc()
                     PLACEMENT_CACHE_HITS.inc()
-                    for ca in allcas:
-                        if entry[1].get(ca.claim.metadata.uid, False):
-                            ca.unsuitable_nodes.append(potential_node)
+                    self._replay_memo_verdict(
+                        pod, allcas, potential_node, entry[1], trace_id
+                    )
                     return
             PROBE_MEMO_MISSES.inc()
-            lengths = {
-                ca.claim.metadata.uid: len(ca.unsuitable_nodes) for ca in allcas
-            }
 
             # Pending sync for ALL kinds up front (it used to run inside
             # each allocator mid-pass): the availability snapshot must
@@ -985,6 +1100,14 @@ class ControllerDriver:
                 else:
                     PLACEMENT_CACHE_HITS.inc()
 
+            self._record_decisions(
+                pod, allcas, potential_node,
+                decisions.PROVENANCE_SNAPSHOT
+                if snapshot is not None
+                else decisions.PROVENANCE_FRESH,
+                trace_id,
+            )
+
             if memo_key is not None:
                 # Re-key on the POST-pass pending versions: a memo hit then
                 # certifies the pass's seeded picks are still in place (the
@@ -995,9 +1118,14 @@ class ControllerDriver:
                     memo_key[1],
                     memo_key[2],
                 )
+                # claim uid -> (ReasonCode, detail) | None: the memo stores
+                # the structured reason so its replay can reproduce it —
+                # within one fan-out each node is probed exactly once, so
+                # node_rejections[node] IS this pass's verdict.
                 verdict = {
-                    ca.claim.metadata.uid: potential_node
-                    in ca.unsuitable_nodes[lengths[ca.claim.metadata.uid]:]
+                    ca.claim.metadata.uid: ca.node_rejections.get(
+                        potential_node
+                    )
                     for ca in allcas
                 }
                 with self._probe_memo_lock:

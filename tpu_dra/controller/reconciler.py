@@ -447,6 +447,31 @@ class Controller:
                 selected_node,
             )
 
+    def _record_unplaceable(
+        self, claims: "list[ClaimAllocation]", potential_nodes: "list[str]"
+    ) -> None:
+        """Warning Event on every claim the fan-out found unplaceable,
+        carrying the compressed per-reason breakdown ("0/16 nodes
+        suitable: 12/16 InsufficientChips, 4/16 TopologyMismatch").
+
+        The message is a pure function of the current rejection mix, so a
+        stuck claim's repeat syncs bump count/lastTimestamp on ONE Event
+        (EventRecorder's apiserver-side compression) instead of piling up
+        objects — and the message itself answers "why is my pod Pending?"
+        from a bare `kubectl describe resourceclaim`."""
+        from tpu_dra.controller import decisions
+
+        total = len(potential_nodes)
+        for ca in claims:
+            if not total or set(potential_nodes) - set(ca.unsuitable_nodes):
+                continue  # at least one node can still take it
+            self.recorder.event(
+                ca.claim,
+                TYPE_WARNING,
+                "NoSuitableNode",
+                decisions.summarize_rejections(ca.node_rejections, total),
+            )
+
     # -- pod scheduling negotiation (controller.go:568-735) ------------------
 
     def _check_pod_claim(
@@ -530,6 +555,7 @@ class Controller:
 
         if sc.spec.potential_nodes:
             self.driver.unsuitable_nodes(pod, claims, sc.spec.potential_nodes)
+            self._record_unplaceable(claims, sc.spec.potential_nodes)
 
         selected_node = sc.spec.selected_node
         if selected_node:

@@ -28,11 +28,13 @@ from tpu_dra.api import nas_v1alpha1 as nascrd
 from tpu_dra.api import serde
 from tpu_dra.api import tpu_v1alpha1 as tpucrd
 from tpu_dra.api.k8s import Pod, ResourceClaim
+from tpu_dra.controller import decisions
 from tpu_dra.controller.availability import (
     NodeSnapshot,
     SubslicePlacement,
     compute_subslice_candidates,
 )
+from tpu_dra.controller.decisions import ReasonCode
 from tpu_dra.controller.pending import PerNodeAllocatedClaims
 from tpu_dra.controller.types import (
     ClaimAllocation,
@@ -151,6 +153,12 @@ class SubsliceDriver:
             # other nodes' state remain valid (and are re-synced by the
             # retry's fan-out regardless).
             self.pending_allocated_claims.remove_node(claim_uid, selected_node)
+            decisions.record_conflict(
+                claim,
+                selected_node,
+                f"pending subslice pick overlaps committed placement(s) "
+                f"{sorted(set(conflicts))}; dropped for re-placement",
+            )
             raise RuntimeError(
                 f"pending subslice allocation for claim '{claim_uid}' "
                 f"overlaps committed placement(s) {sorted(set(conflicts))} "
@@ -198,10 +206,17 @@ class SubsliceDriver:
         if not subcas:
             return
 
-        placements = self._allocate(crd, pod, subcas, snapshot, parents_clean, stats)
+        placements, reason = self._allocate(
+            crd, pod, subcas, snapshot, parents_clean, stats
+        )
         if placements is None or len(placements) != len(subcas):
+            code, detail = reason or (
+                ReasonCode.SUBSLICE_UNSATISFIABLE,
+                f"no placement combination for {len(subcas)} subslice "
+                "claim(s)",
+            )
             for other in allcas:
-                other.unsuitable_nodes.append(potential_node)
+                decisions.reject(other, potential_node, code, detail)
             return
 
         parent_info = self._parent_claim_info(crd)
@@ -266,15 +281,16 @@ class SubsliceDriver:
         snapshot: "NodeSnapshot | None" = None,
         parents_clean: bool = False,
         stats: "dict | None" = None,
-    ) -> dict[str, SubslicePlacement] | None:
-        # The backtracking search is memoizable only when the snapshot
-        # covers every input: the candidate map (always snapshot-derived),
-        # the whole-chip holders (``parents_clean``: no TPU claims were
-        # placed earlier in this pass, so crd's whole-chip state == the
-        # snapshot's), and no claim carries a pre-existing entry (those are
-        # uid-specific).  The pod component enters the key only when an
-        # affinity name is in play — plain subslice claims replay across
-        # pods.
+    ) -> "tuple[dict[str, SubslicePlacement] | None, tuple[str, str] | None]":
+        # Returns (placements-or-None, failure (ReasonCode, detail) when
+        # the search failed).  The backtracking search is memoizable only
+        # when the snapshot covers every input: the candidate map (always
+        # snapshot-derived), the whole-chip holders (``parents_clean``: no
+        # TPU claims were placed earlier in this pass, so crd's whole-chip
+        # state == the snapshot's), and no claim carries a pre-existing
+        # entry (those are uid-specific).  The pod component enters the key
+        # only when an affinity name is in play — plain subslice claims
+        # replay across pods.
         def has_existing(ca: ClaimAllocation) -> bool:
             entry = crd.spec.allocated_claims.get(ca.claim.metadata.uid)
             return entry is not None and entry.subslice is not None
@@ -296,31 +312,34 @@ class SubsliceDriver:
             if cached is not None:
                 if stats is not None:
                     stats["subslice"] = "hit"
-                verdict, placements = cached
+                verdict, placements, reason = cached
                 if not verdict:
-                    return None
+                    # Replay the memoized failure reason, not just the
+                    # verdict — "why" must survive the fast path.
+                    return None, reason
                 return {
                     ca.claim.metadata.uid: placement
                     for ca, placement in zip(subcas, placements)
-                }
+                }, None
 
         # The search is about to run in full (memo miss, or memo-ineligible
         # pass): either way the cache did not save it.
         if stats is not None:
             stats["subslice"] = "miss"
-        result = self._search(crd, pod, subcas, snapshot)
+        result, reason = self._search(crd, pod, subcas, snapshot)
         if memo_key is not None:
             if result is None or len(result) != len(subcas):
-                self.search_memo.put(memo_key, (False, None))
+                self.search_memo.put(memo_key, (False, None, reason))
             else:
                 self.search_memo.put(
                     memo_key,
                     (
                         True,
                         [result[ca.claim.metadata.uid] for ca in subcas],
+                        None,
                     ),
                 )
-        return result
+        return result, reason
 
     def _search(
         self,
@@ -328,7 +347,7 @@ class SubsliceDriver:
         pod: Pod,
         subcas: list[ClaimAllocation],
         snapshot: "NodeSnapshot | None" = None,
-    ) -> dict[str, SubslicePlacement] | None:
+    ) -> "tuple[dict[str, SubslicePlacement] | None, tuple[str, str] | None]":
         available = (
             snapshot.subslice_candidates
             if snapshot is not None
@@ -339,6 +358,7 @@ class SubsliceDriver:
         possible: dict[str, list[SubslicePlacement]] = {}
         for ca in subcas:
             claim_uid = ca.claim.metadata.uid
+            name = ca.claim.metadata.name
             existing = crd.spec.allocated_claims.get(claim_uid)
             if existing is not None and existing.subslice is not None:
                 dev = existing.subslice.devices[0]
@@ -350,7 +370,11 @@ class SubsliceDriver:
             params: tpucrd.SubsliceClaimParametersSpec = ca.claim_parameters
             candidates = available.get(params.profile)
             if not candidates:
-                return None
+                return None, (
+                    ReasonCode.SUBSLICE_UNSATISFIABLE,
+                    f"claim {name!r}: no free {params.profile} placement on "
+                    "any partitionable chip",
+                )
 
             filtered = []
             for cand in candidates:
@@ -368,11 +392,25 @@ class SubsliceDriver:
                 if not params.tpu_claim_name:
                     filtered.append(cand)
             if not filtered:
-                return None
+                if params.tpu_claim_name:
+                    return None, (
+                        ReasonCode.PARENT_AFFINITY_UNSATISFIED,
+                        f"claim {name!r}: {len(candidates)} free "
+                        f"{params.profile} placement(s) exist but none on a "
+                        f"chip held by claim {params.tpu_claim_name!r}",
+                    )
+                return None, (
+                    ReasonCode.SUBSLICE_UNSATISFIABLE,
+                    f"claim {name!r}: every candidate parent chip for "
+                    f"{params.profile} is whole-allocated",
+                )
             possible[claim_uid] = filtered
 
         if not possible:
-            return None
+            return None, (
+                ReasonCode.SUBSLICE_UNSATISFIABLE,
+                "no subslice candidates on this node",
+            )
 
         # Backtracking search for a mutually non-overlapping combination
         # (mig.go:231-262), pruning overlaps at each step.
@@ -392,4 +430,10 @@ class SubsliceDriver:
                 del chosen[uid]
             return False
 
-        return dict(chosen) if search(0) else None
+        if search(0):
+            return dict(chosen), None
+        return None, (
+            ReasonCode.SUBSLICE_UNSATISFIABLE,
+            f"per-claim placements exist but no mutually non-overlapping "
+            f"combination for {len(subcas)} subslice claim(s)",
+        )

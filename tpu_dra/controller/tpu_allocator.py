@@ -26,7 +26,9 @@ from tpu_dra.api import tpu_v1alpha1 as tpucrd
 from tpu_dra.api.k8s import Pod, ResourceClaim
 from tpu_dra.api.selector import glob_matches
 from tpu_dra.api.topology import Topology
+from tpu_dra.controller import decisions
 from tpu_dra.controller.availability import NodeSnapshot, compute_free_chips
+from tpu_dra.controller.decisions import ReasonCode
 from tpu_dra.controller.pending import PerNodeAllocatedClaims
 from tpu_dra.controller.placement import place_count, place_topology
 from tpu_dra.controller.types import (
@@ -123,6 +125,12 @@ class TpuDriver:
         if overlap:
             # Only this node's pick is invalid; other nodes' picks stand.
             self.pending_allocated_claims.remove_node(claim_uid, selected_node)
+            decisions.record_conflict(
+                claim,
+                selected_node,
+                f"pending pick overlaps committed device(s) "
+                f"{sorted(overlap)}; dropped for re-placement",
+            )
             raise RuntimeError(
                 f"pending allocation for claim '{claim_uid}' overlaps "
                 f"committed device(s) {sorted(overlap)} on node "
@@ -164,7 +172,7 @@ class TpuDriver:
         if not presynced:
             self.sync_pending(crd, potential_node)
 
-        allocated = self._allocate(crd, tpucas, snapshot, stats)
+        allocated, reasons = self._allocate(crd, tpucas, snapshot, stats)
         for ca in tpucas:
             claim_uid = ca.claim.metadata.uid
             params: tpucrd.TpuClaimParametersSpec = ca.claim_parameters
@@ -176,9 +184,22 @@ class TpuDriver:
             devices, topo = allocated.get(claim_uid, ([], None))
             if requested != len(devices):
                 # Gang semantics: one unsatisfiable claim poisons the node
-                # for every claim of the pod (gpu.go:85-90).
+                # for every claim of the pod (gpu.go:85-90) — the poisoned
+                # peers carry the triggering claim's reason.
+                code, detail = reasons.get(claim_uid) or (
+                    ReasonCode.INSUFFICIENT_CHIPS,
+                    f"requested {requested} chip(s), placed {len(devices)}",
+                )
+                name = ca.claim.metadata.name
                 for other in allcas:
-                    other.unsuitable_nodes.append(potential_node)
+                    decisions.reject(
+                        other,
+                        potential_node,
+                        code,
+                        detail
+                        if other is ca
+                        else f"pod claim {name!r}: {detail}",
+                    )
                 return
 
             result = nascrd.AllocatedDevices(
@@ -202,11 +223,20 @@ class TpuDriver:
         tpucas: list[ClaimAllocation],
         snapshot: "NodeSnapshot | None" = None,
         stats: "dict | None" = None,
-    ) -> dict[str, tuple[list[nascrd.AllocatedTpu], Topology | None]]:
+    ) -> tuple[
+        dict[str, tuple[list[nascrd.AllocatedTpu], Topology | None]],
+        dict[str, tuple[str, str]],
+    ]:
         """Tentatively place every claim; availability = allocatable minus
         already-allocated (whole chips and subslice parents), gpu.go:114-135
-        — served from the node snapshot when one matches this exact state."""
+        — served from the node snapshot when one matches this exact state.
+
+        Returns (allocated, reasons): ``reasons`` maps the uid of every
+        claim that failed to fully place to its structured (ReasonCode,
+        detail).  Reasons are memoized alongside the placements so a memo
+        replay reproduces the rejection, not just the verdict."""
         allocated: dict[str, tuple[list[nascrd.AllocatedTpu], Topology | None]] = {}
+        reasons: dict[str, tuple[str, str]] = {}
         fresh: list[ClaimAllocation] = []
         for ca in tpucas:
             claim_uid = ca.claim.metadata.uid
@@ -221,7 +251,7 @@ class TpuDriver:
             else:
                 fresh.append(ca)
         if not fresh:
-            return allocated
+            return allocated, reasons
 
         # Existing entries never touch `available` (they are already
         # excluded from the snapshot's free set), so the search outcome for
@@ -237,12 +267,14 @@ class TpuDriver:
             if cached is not None:
                 if stats is not None:
                     stats["tpu"] = "hit"
-                for ca, (devices, topo) in zip(fresh, cached):
+                for ca, (devices, topo, reason) in zip(fresh, cached):
                     allocated[ca.claim.metadata.uid] = (
                         [serde.deepcopy(d) for d in devices],
                         topo,
                     )
-                return allocated
+                    if reason is not None:
+                        reasons[ca.claim.metadata.uid] = reason
+                return allocated, reasons
             if stats is not None:
                 stats["tpu"] = "miss"
 
@@ -251,7 +283,15 @@ class TpuDriver:
             if snapshot is not None
             else compute_free_chips(crd)
         )
-        placed_results: list[tuple[list[nascrd.AllocatedTpu], Topology | None]] = []
+        # (devices, topo, reason-or-None) per fresh claim, in order — the
+        # memo value (keyed by params fingerprints, uid-free).
+        placed_results: list[tuple] = []
+
+        def fail(claim_uid: str, code: str, detail: str) -> None:
+            reasons[claim_uid] = (code, detail)
+            allocated[claim_uid] = ([], None)
+            placed_results.append(([], None, (code, detail)))
+
         for ca in fresh:
             claim_uid = ca.claim.metadata.uid
             params: tpucrd.TpuClaimParametersSpec = ca.claim_parameters
@@ -268,19 +308,48 @@ class TpuDriver:
                     # chip coords are arbitrary, so an ICI-contiguous block
                     # granted here would be fiction.  Count claims remain
                     # fine; topology claims are unsuitable.
-                    allocated[claim_uid] = ([], None)
-                    placed_results.append(([], None))
+                    fail(
+                        claim_uid,
+                        ReasonCode.NO_HOST_TOPOLOGY,
+                        f"topology {params.topology} requested but the node "
+                        "published no ICI bounds",
+                    )
                     continue
-                placed = place_topology(
-                    Topology.parse(params.topology), set(free_coords)
-                )
+                want = Topology.parse(params.topology)
+                if want.size > len(eligible):
+                    fail(
+                        claim_uid,
+                        ReasonCode.INSUFFICIENT_CHIPS,
+                        f"topology {params.topology} needs {want.size} "
+                        f"chip(s), {len(eligible)} free match the selector "
+                        f"({len(available)} free total)",
+                    )
+                    continue
+                placed = place_topology(want, set(free_coords))
+                if placed is None:
+                    fail(
+                        claim_uid,
+                        ReasonCode.TOPOLOGY_MISMATCH,
+                        f"no free ICI-contiguous {params.topology} block "
+                        f"among {len(eligible)} eligible chip(s)",
+                    )
+                    continue
                 # The *placed* orientation is recorded (it may be a rotation
                 # of the request): device order + topology string together
                 # define the claimed mesh for the node plugin's env injection.
-                block, topo = placed if placed is not None else ([], None)
+                block, topo = placed
                 chips = [free_coords[c] for c in block]
             else:
-                block, topo = place_count(params.count or 0, set(free_coords))
+                count = params.count or 0
+                if count > len(eligible):
+                    fail(
+                        claim_uid,
+                        ReasonCode.INSUFFICIENT_CHIPS,
+                        f"requested {count} chip(s), {len(eligible)} free "
+                        f"match the selector ({len(available)} free total)",
+                    )
+                    continue
+                block, topo = place_count(count, set(free_coords))
                 chips = [free_coords[c] for c in block]
 
             devices = [
@@ -290,17 +359,17 @@ class TpuDriver:
             for chip in chips:
                 available.pop(chip.uuid, None)
             allocated[claim_uid] = (devices, topo)
-            placed_results.append((devices, topo))
+            placed_results.append((devices, topo, None))
 
         if memo_key is not None:
             self.search_memo.put(
                 memo_key,
                 [
-                    ([serde.deepcopy(d) for d in devices], topo)
-                    for devices, topo in placed_results
+                    ([serde.deepcopy(d) for d in devices], topo, reason)
+                    for devices, topo, reason in placed_results
                 ],
             )
-        return allocated
+        return allocated, reasons
 
 
 def selector_matches_tpu(

@@ -25,6 +25,11 @@ class ClaimAllocation:
     # The pod-local claim entry name (PodClaimName upstream).
     pod_claim_name: str = ""
     unsuitable_nodes: list[str] = field(default_factory=list)
+    # node -> (ReasonCode, detail) for every node this fan-out rejected —
+    # the structured *why* behind unsuitable_nodes (controller/decisions.py
+    # reject()); feeds the flight recorder, verdict-memo replay, and the
+    # claim's compressed Warning Event.
+    node_rejections: dict[str, tuple[str, str]] = field(default_factory=dict)
     # Canonical fingerprint of the resolved claim parameters, computed once
     # per fan-out by params_fingerprint() (cache key component).
     params_fp: str | None = None

@@ -288,6 +288,19 @@ class ServeEngine:
         request when generated (detected host-side per token; the
         matched stop suffix stays in ``tokens``, finish_reason
         "stop")."""
+        for t in prompt:
+            # bool is an int subclass and would silently embed as 0/1; an
+            # out-of-range id silently clamps in the embedding gather —
+            # both produce plausible-but-wrong output instead of an error.
+            if (
+                isinstance(t, bool)
+                or not isinstance(t, int)
+                or not 0 <= t < self.config.vocab
+            ):
+                raise ValueError(
+                    f"prompt token ids must be ints in "
+                    f"[0, {self.config.vocab}), got {t!r}"
+                )
         if not 1 <= len(prompt) <= self.prompt_slots:
             raise ValueError(
                 f"prompt length must be in [1, {self.prompt_slots}], "
@@ -305,9 +318,14 @@ class ServeEngine:
         stops = [list(s) for s in (stop_sequences or [])]
         if any(not s for s in stops):
             raise ValueError("stop sequences must be non-empty")
-        if any(not isinstance(t, int) for s in stops for t in s):
+        if any(
+            not isinstance(t, int) or isinstance(t, bool)
+            for s in stops
+            for t in s
+        ):
             # A str slips through list() as 1-char strings that can never
-            # equal int tokens: reject malformed stops up front.
+            # equal int tokens, and bools are int subclasses that compare
+            # equal to token ids 0/1: reject malformed stops up front.
             raise ValueError("stop sequences must contain int token ids")
         req = Request(
             id=self._next_id, prompt=list(prompt), max_new=budget,

@@ -15,10 +15,15 @@ final norm + the tied logits head (layer-skip / early-exit
 self-drafting): no second set of weights, the draft shares the embedding
 and its cache is just a shallower copy of the serving cache.
 
-**Exactness is the contract, speed is the variable.**  Greedy
-speculative output equals `make_generate`'s greedy output token for
-token for ANY draft (the tests pin this with 1-layer and full-depth
-drafts alike); draft quality only changes how many rounds it takes.
+**Exactness is the contract, speed is the variable.**  On a single
+device, greedy speculative output equals `make_generate`'s greedy
+output token for token for ANY draft (the tests pin this with 1-layer
+and full-depth drafts alike); draft quality only changes how many
+rounds it takes.  On a mesh the usual sharded-decode contract applies
+instead (the same caveat as chunked prefill): the verify pass scores
+S = draft_len+1 positions with differently-shaped einsums than the
+S = 1 decode step, so sharded bf16 reductions may tile differently —
+logits are ulp-close and a near-tie argmax may flip.
 
 TPU-native mechanics:
 
@@ -163,8 +168,11 @@ def make_generate_speculative(
     """Build the jitted speculative generation function:
     ``fn(params, prompt (B, prompt_len)[, key]) -> (B, prompt_len + steps)``.
 
-    ``temperature == 0``: greedy — token-identical to `make_generate`'s
-    output (exactness pinned).  ``temperature > 0``: stochastic
+    ``temperature == 0``: greedy — single-device, token-identical to
+    `make_generate`'s output (exactness pinned); on a mesh, bf16-ulp-close
+    logits where a near-tie argmax may flip (the repo-wide sharded-decode
+    contract — the verify pass's S=k+1 einsums tile differently than the
+    S=1 step).  ``temperature > 0``: stochastic
     speculative sampling (key required) — accept/resample per position
     (`acceptance_flags` / `residual_sample`), output distributed exactly
     as target-only sampling; a row whose acceptance ran past the batch

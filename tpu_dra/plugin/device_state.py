@@ -93,6 +93,22 @@ class DeviceState:
     def cdi(self) -> CDIHandler:
         return self._cdi
 
+    def prepared_chip_count(self) -> int:
+        """Distinct chips with at least one prepared device on this node —
+        the plugin's OWN truth for the tpu_dra_allocated_chips{state=
+        "prepared"} gauge (the NAS-derived series is the controller's)."""
+        with self._lock:
+            chips: set[str] = set()
+            for entry in self._prepared.values():
+                devs = entry.devices
+                if devs.tpu is not None:
+                    chips.update(d.uuid for d in devs.tpu.devices)
+                if devs.subslice is not None:
+                    chips.update(d.parent_uuid for d in devs.subslice.devices)
+                if devs.core is not None:
+                    chips.update(d.parent_uuid for d in devs.core.devices)
+        return len(chips)
+
     # -- prepare / unprepare -------------------------------------------------
 
     def prepare(self, claim_uid: str, allocated: nascrd.AllocatedDevices) -> list[str]:

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 
 from tpu_dra.api import nas_v1alpha1 as nascrd
 from tpu_dra.client.apiserver import ApiError
@@ -31,7 +32,11 @@ from tpu_dra.client.nasclient import NasClient
 from tpu_dra.client.retry import retry_on_conflict
 from tpu_dra.plugin.device_state import DeviceState
 from tpu_dra.utils import trace
-from tpu_dra.utils.metrics import ALLOCATED_CHIPS, PREPARE_SECONDS
+from tpu_dra.utils.metrics import (
+    ALLOCATED_CHIPS,
+    CLAIM_E2E_SECONDS,
+    PREPARE_SECONDS,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -81,7 +86,15 @@ class NodeDriver:
                 total += len(devs.devices) if devs else 0
             return total
 
-        ALLOCATED_CHIPS.set_function(_allocated_count, node=nas.metadata.name)
+        # Two truths, two series: "allocated" is the controller's view
+        # (NAS allocatedClaims), "prepared" is this plugin's own device
+        # state — a persistent gap between them is a stuck prepare or GC.
+        ALLOCATED_CHIPS.set_function(
+            _allocated_count, node=nas.metadata.name, state="allocated"
+        )
+        ALLOCATED_CHIPS.set_function(
+            state.prepared_chip_count, node=nas.metadata.name, state="prepared"
+        )
 
         if start_gc:
             self._gc_thread = threading.Thread(
@@ -104,10 +117,10 @@ class NodeDriver:
         controller stamped when it committed the allocation — so the plugin
         joins the allocating trace even when the kubelet (which knows
         nothing of tracing) sits between the two processes."""
-        with PREPARE_SECONDS.time():
+        with PREPARE_SECONDS.time(operation="prepare"):
             with self._lock:
                 is_prepared, devices = self._is_prepared(claim_uid)
-                # _is_prepared just refreshed the NAS: read the annotation
+                # _is_prepared just refreshed the NAS: read the annotations
                 # under the same lock, from the same fresh copy.
                 parent = (
                     trace.extract(traceparent)
@@ -116,6 +129,11 @@ class NodeDriver:
                         self._nas.metadata.annotations.get(
                             trace.nas_annotation_key(claim_uid), ""
                         )
+                    )
+                )
+                lifecycle = trace.parse_e2e_annotation(
+                    self._nas.metadata.annotations.get(
+                        trace.e2e_annotation_key(claim_uid), ""
                     )
                 )
             with trace.span(
@@ -127,11 +145,29 @@ class NodeDriver:
                 if is_prepared:
                     sp.add_event("idempotent_hit")
                     return devices
-                return self._prepare(claim_uid)
+                result = self._prepare(claim_uid)
+                # First (non-idempotent) prepare completed: close the
+                # claim's lifecycle histogram phases using the timestamps
+                # the controller stamped at allocation commit — the
+                # cross-process join the e2e metric needs.
+                if lifecycle is not None:
+                    created, allocated_at = lifecycle
+                    done = time.time()
+                    CLAIM_E2E_SECONDS.observe(
+                        max(done - allocated_at, 0.0), phase="prepared"
+                    )
+                    CLAIM_E2E_SECONDS.observe(
+                        max(done - created, 0.0), phase="e2e"
+                    )
+                return result
 
     def node_unprepare_resource(self, claim_uid: str) -> None:
         """Deliberate no-op — deferred to the NAS-watch GC
-        (driver.go:128-133)."""
+        (driver.go:128-133).  Still timed: the RPC's (near-zero) latency
+        in the histogram documents the deferred-unprepare contract, and
+        the GC's real teardown shows up as operation="gc_unprepare"."""
+        with PREPARE_SECONDS.time(operation="unprepare"):
+            pass
 
     def _is_prepared(self, claim_uid: str) -> tuple[bool, list[str]]:
         self._client.get()
@@ -197,7 +233,7 @@ class NodeDriver:
         # Fresh trace root: the controller prunes the claim's traceparent
         # annotation in the same write that removes the allocation, so the
         # GC's deferred unprepare has no parent to join.
-        with trace.span(
+        with PREPARE_SECONDS.time(operation="gc_unprepare"), trace.span(
             "plugin.unprepare",
             claim_uid=claim_uid,
             node=self._nas.metadata.name,
@@ -211,7 +247,12 @@ class NodeDriver:
         self._stop.set()
         if self._gc_thread is not None:
             self._gc_thread.join(timeout=5)
-        ALLOCATED_CHIPS.remove_function(node=self._nas.metadata.name)
+        ALLOCATED_CHIPS.remove_function(
+            node=self._nas.metadata.name, state="allocated"
+        )
+        ALLOCATED_CHIPS.remove_function(
+            node=self._nas.metadata.name, state="prepared"
+        )
 
         def flip():
             self._client.get()

@@ -14,6 +14,7 @@ conflict storm must never break the reconcile path that tried to record.
 
 from __future__ import annotations
 
+import calendar
 import hashlib
 import logging
 import time
@@ -31,6 +32,17 @@ TYPE_WARNING = "Warning"
 
 def _now() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def parse_time(ts: str) -> "float | None":
+    """Unix seconds for a k8s RFC3339 timestamp (the apiserver's
+    creationTimestamp format); None on anything malformed."""
+    if not ts:
+        return None
+    try:
+        return float(calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ")))
+    except ValueError:
+        return None
 
 
 def object_reference(obj) -> ObjectReference:

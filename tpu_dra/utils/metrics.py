@@ -282,6 +282,23 @@ BUILD_INFO = REGISTRY.gauge(
     "tpu_dra_build_info",
     "Build/version info; value is always 1, the labels carry the payload",
 )
+REJECTIONS_TOTAL = REGISTRY.counter(
+    "tpu_dra_rejections_total",
+    "Placement rejections by structured reason code "
+    "(controller/decisions.py ReasonCode)",
+)
+# Claim lifecycle latency: created -> allocated is a controller-side
+# observation from the claim's creationTimestamp; allocated -> prepared and
+# created -> prepared are plugin-side, joined across processes via the
+# per-claim e2e NAS annotation the controller stamps next to the traceparent
+# (utils/trace.py e2e_annotation_key).  Buckets stretch past the request
+# defaults: scheduling negotiation legitimately takes tens of seconds.
+CLAIM_E2E_SECONDS = REGISTRY.histogram(
+    "tpu_dra_claim_e2e_seconds",
+    "Claim lifecycle latency by phase: allocated (created->allocated), "
+    "prepared (allocated->prepared), e2e (created->prepared)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0),
+)
 
 
 def set_build_info(component: str) -> None:
@@ -400,6 +417,8 @@ class MetricsServer:
                         self._send(200, _profile(secs))
                     elif parsed.path == f"{outer.pprof_path}/traces":
                         self._send_traces(parse_qs(parsed.query))
+                    elif parsed.path == f"{outer.pprof_path}/decisions":
+                        self._send_decisions(parse_qs(parsed.query))
                     else:
                         self._send(404, "not found\n")
                 except _BadQuery as e:
@@ -432,6 +451,43 @@ class MetricsServer:
                     self._send(
                         200,
                         json.dumps(trace.chrome_trace(records)),
+                        "application/json",
+                    )
+
+            def _send_decisions(self, query: dict) -> None:
+                # Local import, like _send_traces: the recorder lives with
+                # the controller package and must not couple at load time.
+                from tpu_dra.controller import decisions
+
+                limit = _query_int(
+                    query, "limit", 256, cap=decisions.RECORDER.capacity
+                )
+                fmt = query.get("format", ["json"])[0]
+                if fmt not in ("json", "text"):
+                    raise _BadQuery(
+                        f"format must be json or text, got {fmt!r}"
+                    )
+                records = decisions.RECORDER.query(
+                    claim=query.get("claim", [""])[0] or None,
+                    node=query.get("node", [""])[0] or None,
+                    pod=query.get("pod", [""])[0] or None,
+                    limit=limit,
+                )
+                if fmt == "text":
+                    self._send(200, decisions.render_text(records))
+                else:
+                    import json
+
+                    self._send(
+                        200,
+                        json.dumps(
+                            {
+                                "decisions": [r.to_dict() for r in records],
+                                "dropped": decisions.RECORDER.dropped,
+                                "recorded": decisions.RECORDER.recorded,
+                                "summary": decisions.summarize(records),
+                            }
+                        ),
                         "application/json",
                     )
 

@@ -49,9 +49,35 @@ TRACEPARENT_VERSION = "00"
 # one key per claim uid: "trace.tpu.resource.google.com/<claim-uid>".
 NAS_ANNOTATION_PREFIX = "trace.tpu.resource.google.com"
 
+# Sibling annotation carrying the claim's lifecycle timestamps
+# ("<created-unix> <allocated-unix>"), written/pruned in the same NAS
+# updates as the traceparent.  It is the cross-process join the
+# tpu_dra_claim_e2e_seconds histogram needs: the plugin can observe
+# created->prepared / allocated->prepared without ever talking to the
+# controller (the two processes only share the NAS object).
+E2E_ANNOTATION_PREFIX = "e2e.tpu.resource.google.com"
+
 
 def nas_annotation_key(claim_uid: str) -> str:
     return f"{NAS_ANNOTATION_PREFIX}/{claim_uid}"
+
+
+def e2e_annotation_key(claim_uid: str) -> str:
+    return f"{E2E_ANNOTATION_PREFIX}/{claim_uid}"
+
+
+def parse_e2e_annotation(value: str) -> "tuple[float, float] | None":
+    """(created_unix, allocated_unix) or None on any malformation."""
+    parts = value.split()
+    if len(parts) != 2:
+        return None
+    try:
+        created, allocated = float(parts[0]), float(parts[1])
+    except ValueError:
+        return None
+    if created <= 0 or allocated <= 0:
+        return None
+    return created, allocated
 
 
 # -- trace context (W3C traceparent) -----------------------------------------
