@@ -8,7 +8,7 @@ TAG     ?= latest
 
 .PHONY: all test lint generate-crds check-generate native native-test \
         demo-quickstart bench image clean help observability-smoke \
-        perf-smoke explain-smoke
+        perf-smoke explain-smoke serve-smoke
 
 all: lint test
 
@@ -63,6 +63,13 @@ perf-smoke:
 explain-smoke:
 	$(PYTHON) -m pytest tests/test_explain_smoke.py -q -m 'not slow'
 
+# Shared-system-prompt stream through the prefix-cached serve engine on
+# CPU: asserts a > 50% hit rate, prefill tokens avoided, cache-on ==
+# cache-off greedy tokens, and the tpu_dra_serve_prefix_* counters in the
+# metrics exposition (docs/SERVING.md "Automatic prefix caching").
+serve-smoke:
+	$(PYTHON) -m pytest tests/test_serve_smoke.py -q -m 'not slow'
+
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
@@ -74,4 +81,4 @@ clean:
 help:
 	@echo "targets: test lint generate-crds check-generate native native-test"
 	@echo "         demo-quickstart bench observability-smoke perf-smoke"
-	@echo "         explain-smoke image clean"
+	@echo "         explain-smoke serve-smoke image clean"

@@ -1262,6 +1262,121 @@ def bench_compute(timeout_s: float = 600.0) -> "dict":
         }
 
 
+_SERVE_PREFIX_CHILD = r"""
+import json
+import statistics
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from tpu_dra.parallel.burnin import BurninConfig, init_params
+from tpu_dra.parallel.serve import ServeEngine
+
+# Big enough that the 224-token shared-prefix prefill DOMINATES an
+# admission on CPU (the stanza measures admission-work displacement; at
+# toy width, dispatch noise and decode steps swamp the saving), small
+# enough for CI tens-of-seconds.
+CFG = BurninConfig(
+    vocab=256, d_model=128, n_heads=8, d_ff=512, n_layers=6, seq=288,
+    batch=4,
+)
+PROMPT_SLOTS, SYSTEM_LEN, N_REQS, MAX_NEW = 256, 224, 12, 4
+SYSTEM = [int(x) for x in jax.random.randint(
+    jax.random.PRNGKey(11), (SYSTEM_LEN,), 0, CFG.vocab
+)]
+# The north-star shape of real traffic: one shared system prompt, short
+# per-user tails.
+REQS = [
+    (SYSTEM + [int(x) for x in jax.random.randint(
+        jax.random.PRNGKey(100 + i), (16,), 0, CFG.vocab)], MAX_NEW)
+    for i in range(N_REQS)
+]
+params = init_params(CFG)
+
+
+def run(pool_slots):
+    eng = ServeEngine(
+        params, CFG, slots=4, prompt_slots=PROMPT_SLOTS,
+        max_new_cap=MAX_NEW, prefix_cache_slots=pool_slots,
+        prefix_window=32 if pool_slots else None,
+    )
+    # Warmup drains the one-time compiles (prefill/step, and on the
+    # cached engine the copy + suffix executables) so TTFT measures
+    # steady-state admission, not tracing.
+    for p, b in REQS[:2]:
+        eng.submit(p, b)
+    eng.run()
+    base = eng.prefix_stats
+    t0 = time.perf_counter()
+    ids = [eng.submit(p, b) for p, b in REQS]
+    done = {r.id: r for r in eng.run()}
+    wall = time.perf_counter() - t0
+    ttfts = sorted(done[i].ttft_s for i in ids)
+    toks = sum(len(done[i].tokens) for i in ids)
+    stats = eng.prefix_stats
+    delta = {k: stats[k] - base[k] for k in (
+        "hits", "misses", "evictions",
+        "prefill_tokens_computed", "prefill_tokens_reused",
+    )}
+    return {
+        "ttft_p50_s": round(statistics.median(ttfts), 4),
+        "ttft_p95_s": round(ttfts[int(0.95 * (len(ttfts) - 1))], 4),
+        "tokens_per_s": round(toks / wall, 1),
+        "wall_s": round(wall, 3),
+        "prefill_tokens_per_req": round(
+            delta["prefill_tokens_computed"] / len(ids), 1
+        ),
+        **delta,
+    }, [tuple(done[i].tokens) for i in ids]
+
+
+off, toks_off = run(0)
+on, toks_on = run(16)
+total = on["hits"] + on["misses"]
+out = {
+    "platform": "cpu",
+    "config": {
+        "prompt_slots": PROMPT_SLOTS, "system_len": SYSTEM_LEN,
+        "requests": N_REQS, "max_new": MAX_NEW, "slots": 4,
+        "pool_slots": 16,
+    },
+    "cache_off": off,
+    "cache_on": on,
+    "prefix_hit_rate": round(on["hits"] / max(1, total), 3),
+    "prefill_tokens_avoided": on["prefill_tokens_reused"],
+    "ttft_p50_uplift": round(off["ttft_p50_s"] / max(1e-9, on["ttft_p50_s"]), 2),
+    # The exactness contract IS part of the measurement: a speedup that
+    # changed tokens would be a bug report, not a benchmark.
+    "greedy_identical": toks_off == toks_on,
+    "ok": toks_off == toks_on and on["hits"] > 0,
+}
+print("BENCHJSON:" + json.dumps(out), flush=True)
+"""
+
+
+def bench_serve_prefix(timeout_s: float = 300.0) -> "dict":
+    """Serve-engine prefix-cache stanza (ISSUE 4): a shared-system-prompt
+    request stream through the continuous-batching engine with the
+    automatic prefix cache off vs on — TTFT p50/p95, tokens/s, hit rate,
+    and prefill tokens avoided.  CPU-pinned in a killable child (the same
+    BENCHJSON protocol as the compute stanzas): the number measures the
+    ENGINE's admission-work displacement, which is platform-shaped the
+    same way everywhere decode is memory/compute-bound."""
+    import subprocess
+
+    env = _seed_pythonpath(dict(os.environ))
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        return _run_bench_child(
+            _SERVE_PREFIX_CHILD, env, timeout_s, empty_result={}
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"exceeded {timeout_s:.0f}s"}
+    except Exception as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
 def bench_northstar_mesh(timeout_s: float = 420.0) -> "dict":
     """Compile + execute the full dp x fsdp x tp x ep composition on a
     64-virtual-device CPU mesh (the BASELINE v5e-256 north-star shape at
@@ -1450,6 +1565,7 @@ def main() -> int:
     except Exception as e:  # the wire rung must not sink the whole bench
         wire = {"ok": False, "error": f"{type(e).__name__}: {e}"}
     northstar = bench_northstar_mesh()
+    serve_prefix = bench_serve_prefix()
     p50 = alloc["p50_s"]
     line = {
         "metric": "claim_to_pod_running_p50",
@@ -1477,6 +1593,10 @@ def main() -> int:
             # 64-virtual-device compile+execute of the full dp x fsdp x
             # tp x ep composition — the north-star gang shape.
             "northstar_mesh": northstar,
+            # Serve-engine automatic prefix cache: shared-system-prompt
+            # stream, TTFT/tokens-per-s/hit-rate cache-off vs cache-on
+            # (greedy outputs asserted identical inside the stanza).
+            "serve_prefix": serve_prefix,
             "compute": compute,
         },
     }

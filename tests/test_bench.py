@@ -29,6 +29,13 @@ def test_bench_emits_one_json_line(monkeypatch):
         "bench_northstar_mesh",
         lambda: {"devices": 64, "ok": True, "stubbed": True},
     )
+    # Same reason: the serve-prefix child compiles a d_model=128 engine
+    # twice; its own coverage is test_bench_serve_prefix_stanza.
+    monkeypatch.setattr(
+        bench,
+        "bench_serve_prefix",
+        lambda: {"ok": True, "prefix_hit_rate": 1.0, "stubbed": True},
+    )
     import io
     from contextlib import redirect_stdout
 
@@ -44,7 +51,8 @@ def test_bench_emits_one_json_line(monkeypatch):
     assert {"value", "unit", "vs_baseline", "extras"} <= parsed.keys()
     extras = parsed["extras"]
     assert {
-        "rung", "target_s", "fleet", "wire", "northstar_mesh", "compute"
+        "rung", "target_s", "fleet", "wire", "northstar_mesh",
+        "serve_prefix", "compute",
     } <= extras.keys()
     assert extras["fleet"]["target_met"]
     assert extras["wire"]["target_met"]
@@ -61,6 +69,24 @@ def test_bench_northstar_mesh_stanza():
     assert out.get("ok"), out
     assert out["devices"] == 64
     assert out["mesh"] == {"data": 2, "fsdp": 4, "model": 4, "expert": 2}
+
+
+@pytest.mark.slow
+def test_bench_serve_prefix_stanza():
+    """The serve-engine prefix-cache stanza (ISSUE 4): the child must
+    report a real hit rate, reduced TTFT/prefill work, and — inside the
+    stanza itself — greedy token-identity cache-on vs cache-off."""
+    import bench
+
+    out = bench.bench_serve_prefix()
+    assert out.get("ok"), out
+    assert out["greedy_identical"]
+    assert out["prefix_hit_rate"] > 0.5
+    assert out["prefill_tokens_avoided"] > 0
+    assert (
+        out["cache_on"]["prefill_tokens_per_req"]
+        < out["cache_off"]["prefill_tokens_per_req"]
+    )
 
 
 def test_bench_fanout_scale_small():

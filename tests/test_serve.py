@@ -181,6 +181,23 @@ class TestEngineValidation:
         with pytest.raises(ValueError, match="max_new"):
             eng.submit([1], 5)
 
+    def test_length_validation_is_eager_and_leaves_engine_clean(self):
+        """Over-long and empty prompts fail AT SUBMIT with a clear
+        ValueError — never later inside the padded admission prefill with
+        other requests mid-flight — and a rejected submit leaves nothing
+        queued (regression: the engine must stay usable after)."""
+        eng = ServeEngine(
+            init_params(CFG), CFG, slots=2, prompt_slots=4, max_new_cap=4
+        )
+        with pytest.raises(ValueError, match=r"prompt length.*\[1, 4\]"):
+            eng.submit([1] * 5)
+        with pytest.raises(ValueError, match="prompt length"):
+            eng.submit([])
+        assert eng.pending == 0
+        rid = eng.submit([1] * 4)  # the boundary length admits fine
+        done = {r.id: r for r in eng.run()}
+        assert len(done[rid].tokens) == 4
+
     def test_out_of_range_prompt_token_rejected_at_submit(self):
         """An out-of-vocab id would silently clamp in the embedding gather
         and produce plausible-but-wrong output; bools are int subclasses

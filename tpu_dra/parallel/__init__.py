@@ -47,6 +47,10 @@ slice:
   position), per-row request lifecycle (admit → prefill+insert → decode
   → EOS/budget finish → row freed mid-flight of everyone else); every
   request's output equals the request run alone.
+- ``tpu_dra.parallel.prefixcache`` — automatic shared-prefix KV reuse for
+  the engine: host radix index over admitted token runs + a bounded
+  device pool of B=1 cache segments (LRU + refcount eviction); hot
+  prefixes admit at O(suffix) via device copy + suffix-only prefill.
 - ``tpu_dra.parallel.speculative`` — speculative decoding: layer-skip
   self-draft + one-pass verify, all inside one compiled while_loop.
   Greedy: exact acceptance (token-identical to plain decode for any
@@ -95,6 +99,7 @@ from tpu_dra.parallel.decode import (
     make_prefill,
     serving_config,
 )
+from tpu_dra.parallel.prefixcache import PrefixCache
 from tpu_dra.parallel.quant import quantize_params
 from tpu_dra.parallel.serve import Request, ServeEngine
 from tpu_dra.parallel.speculative import make_generate_speculative
@@ -102,6 +107,7 @@ from tpu_dra.parallel.speculative import make_generate_speculative
 __all__ = [
     "BurninConfig",
     "CollectiveReport",
+    "PrefixCache",
     "Request",
     "ServeEngine",
     "SliceReport",

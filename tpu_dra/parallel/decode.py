@@ -70,6 +70,7 @@ from tpu_dra.parallel.burnin import (
 )
 
 __all__ = [
+    "copy_prefix_into_row",
     "expand_cache",
     "filter_logits",
     "init_cache",
@@ -765,6 +766,127 @@ def _build_prefill_padded(c: BurninConfig, mesh, prompt_slots: int,
             one_window,
             (cache, seed),
             (windows, jnp.arange(nchunks, dtype=jnp.int32)),
+        )
+        return last, cache
+
+    return prefill
+
+
+def copy_prefix_into_row(dst, dst_row, src, src_row, length):
+    """Copy cache positions ``[0, length)`` of batch row ``src_row`` of
+    ``src`` into batch row ``dst_row`` of ``dst``; positions ``[length, T)``
+    of the destination row are left untouched.
+
+    All three of ``dst_row``/``src_row``/``length`` may be TRACED, so one
+    jitted executable serves every (pool row, engine row, hit length)
+    combination — the same one-executable-for-any-row discipline as the
+    engine's ``insert``.  Works on both cache storage formats (bf16 and the
+    int8 ``{"q","s"}`` pair: every leaf carries T on axis 2, so one
+    position mask broadcasts over values and scales alike).
+
+    This is the device half of the engine's automatic prefix cache
+    (`parallel/prefixcache.py`): a causal KV entry at position j depends
+    only on tokens [0, j], so the first ``length`` positions of a resident
+    prefix row are valid for ANY request sharing those first ``length``
+    tokens — copying them replaces recomputing the prefix."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(d, s):
+        seg = jax.lax.dynamic_slice_in_dim(s, src_row, 1, axis=1)
+        cur = jax.lax.dynamic_slice_in_dim(d, dst_row, 1, axis=1)
+        keep = (jnp.arange(d.shape[2]) < length)[None, None, :, None, None]
+        return jax.lax.dynamic_update_slice_in_dim(
+            d, jnp.where(keep, seg, cur), dst_row, axis=1
+        )
+
+    return jax.tree_util.tree_map(leaf, dst, src)
+
+
+def _check_prefix_window(c: BurninConfig, prompt_slots: int,
+                         window: int) -> None:
+    if not 1 <= window <= prompt_slots or prompt_slots % window != 0:
+        raise ValueError(
+            f"prefix window must divide prompt_slots, got "
+            f"{window} vs {prompt_slots}"
+        )
+    if c.moe_experts > 0:
+        raise ValueError(
+            "the suffix prefill is not supported with moe_experts > 0: "
+            "its windowed passes would restart the per-expert capacity "
+            "queue, so routing (and drops) would diverge from the one-shot "
+            "prefill's — the same invariant that rejects prefill_chunk "
+            "(serve MoE with the prefix cache disabled)"
+        )
+
+
+def _build_prefill_suffix(c: BurninConfig, mesh, prompt_slots: int,
+                          window: int):
+    """Suffix-only variant of `_build_prefill_padded`: returns
+    ``prefill(params, prompt, lens_c, cache, *, first_window) ->
+    (last, cache)`` that prefills the padded prompt ON TOP of a cache
+    whose positions ``[0, first_window * W)`` are already resident (a
+    copied prefix — `copy_prefix_into_row`), never computing the
+    resident part.
+
+    XLA compiles per shape, so the split point cannot be a traced value
+    without paying for the prefix anyway: a ``lax.cond`` per window
+    skips the FLOPs but still threads the cache carry through every
+    skipped iteration (measured ~2 ms per skip at bench scale — the
+    conditional's identity arm copies the carry).  Instead
+    ``first_window`` is STATIC: the prompt's grid-aligned W-token
+    windows before it are sliced out of the trace entirely, and the scan
+    runs only windows ``[first_window, prompt_slots/W)`` at their
+    absolute offsets — the resident prefix costs literally nothing.  One
+    executable per distinct ``first_window`` value: a BOUNDED family of
+    at most ``prompt_slots/W`` traces (the engine's jit cache fills it
+    lazily), which is the fixed-shape answer to a dynamic split — same
+    spirit as the two-trace prefill/step split of `decode_forward`.
+
+    The first running window recomputes its pre-split positions (its
+    start is ``first_window * W <= p0``, overwriting identical KV —
+    single-device window passes are value-exact, the chunked-prefill
+    contract), so any copy length inside the window is served by the
+    same executable.  ``last`` is each row's logits at its own last real
+    position ``lens_c[b] - 1``; the caller contract ``first_window * W
+    <= min(lens_c) - 1`` keeps that window in the running range (a
+    full-prompt hit still recomputes its final position: first-token
+    logits come from compute, never from storage).
+    ``first_window == 0`` degenerates to the plain chunked prefill."""
+    import jax
+    import jax.numpy as jnp
+
+    _check_prefix_window(c, prompt_slots, window)
+    W = window
+    nwin = prompt_slots // W
+
+    def prefill(params, prompt, lens_c, cache, *, first_window=0):
+        if not 0 <= first_window < nwin:
+            raise ValueError(
+                f"first_window must be in [0, {nwin}), got {first_window}"
+            )
+        windows = prompt.reshape(
+            prompt.shape[0], nwin, W
+        ).transpose(1, 0, 2)[first_window:]
+
+        def one_window(carry, xs):
+            cache, last = carry
+            window_toks, i = xs
+            logits, cache = decode_forward(
+                params, window_toks, cache, i * W, c, mesh
+            )
+            off = lens_c - 1 - i * W  # last real pos, window-relative
+            cand = jnp.take_along_axis(
+                logits, jnp.clip(off, 0, W - 1)[:, None, None], axis=1
+            )[:, 0]
+            hit = (off >= 0) & (off < W)
+            return (cache, jnp.where(hit[:, None], cand, last)), None
+
+        seed = jnp.zeros((prompt.shape[0], c.vocab), jnp.float32)
+        (cache, last), _ = jax.lax.scan(
+            one_window,
+            (cache, seed),
+            (windows, jnp.arange(first_window, nwin, dtype=jnp.int32)),
         )
         return last, cache
 

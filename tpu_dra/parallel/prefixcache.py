@@ -1,0 +1,314 @@
+"""Automatic shared-prefix KV reuse for the continuous-batching engine.
+
+Real serving traffic is dominated by shared prompt prefixes — system
+prompts, few-shot templates, multi-turn histories — and the engine used
+to pay a full ``prefill1`` for every admission even when thousands of
+requests share the same first K tokens.  This module is the host side of
+the fix (the same insight as vLLM's block reuse and SGLang's
+RadixAttention, adapted to XLA's fixed-shape compilation constraint):
+
+- **Radix index** (`_Node`): a path-compressed trie over the token
+  sequences of admitted prompts.  Lookup walks the request's tokens as
+  deep as they match and returns the longest usable resident prefix —
+  causal KV at position j depends only on tokens [0, j], so a stored
+  segment of length k serves ANY request sharing its first m <= k tokens
+  at length m, including requests that diverge mid-edge (the
+  shared-system-prompt pattern: terminals differ, the shared run matches).
+- **Bounded device pool**: one batched KV cache (`decode.init_cache` at
+  ``B = pool_slots``) whose rows hold B=1 prefix segments.  The pool is
+  the only device memory this cache owns; everything else is host-side
+  bookkeeping, so capacity is a single knob.
+- **LRU + refcount eviction**: admission pins (refcounts) the entries it
+  reads and writes for as long as the row is mid-decode, so an actively
+  shared prefix can never be evicted under pressure; among unpinned
+  entries the least recently used slot is recycled.
+
+The device half lives in `decode.py`: `copy_prefix_into_row` (one
+executable for any (row, length) hit) and `_build_prefill_suffix` (the
+windowed suffix prefill whose STATIC first-window index slices the
+resident windows out of the trace — a bounded executable family, one
+member per suffix window count; see its docstring for why a traced
+``lax.cond`` skip was measured and rejected).  `serve.ServeEngine`
+wires the two halves together at admission; greedy outputs are token-identical with the cache on vs off
+(the engine's exactness contract — pinned by
+``tests/test_serve_prefix.py``).
+
+Hit/miss/eviction counts move both per-instance fields (bench/test
+readback) and the process-global Prometheus counters
+``tpu_dra_serve_prefix_{hits,misses,evictions}_total``
+(`utils/metrics.py`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpu_dra.utils.metrics import (
+    SERVE_PREFIX_EVICTIONS,
+    SERVE_PREFIX_HITS,
+    SERVE_PREFIX_MISSES,
+)
+
+__all__ = ["PrefixCache", "PrefixEntry"]
+
+
+class _Node:
+    """One radix-tree node: ``edge`` is the token run from the parent,
+    ``children`` keys on the first token of each child edge (token runs
+    are path-compressed), ``entry`` is the resident pool segment for the
+    prefix ending exactly here (terminals; splits create pass-through
+    nodes with no entry)."""
+
+    __slots__ = ("edge", "children", "entry", "parent")
+
+    def __init__(self, edge: "list[int]", parent: "_Node | None"):
+        self.edge = edge
+        self.children: "dict[int, _Node]" = {}
+        self.entry: "PrefixEntry | None" = None
+        self.parent = parent
+
+
+@dataclass
+class PrefixEntry:
+    """A resident prefix segment: pool row ``slot`` holds valid KV for
+    cache positions ``[0, length)``.  ``refcount > 0`` pins the entry
+    against eviction (held by every engine row whose admission read or
+    wrote it, released when the request finishes)."""
+
+    slot: int
+    length: int
+    refcount: int = 0
+    last_used: int = 0
+    node: "_Node | None" = field(default=None, repr=False)
+
+
+class PrefixCache:
+    """Host-side index + bounded device pool of shared prompt prefixes.
+
+    The cache never touches ``params`` and never computes: it stores what
+    admissions already computed and hands back (entry, usable length)
+    pairs.  The caller owns the device copies (`decode.copy_prefix_into_row`
+    against ``self.pool``) and the pin lifecycle (`acquire`/`release`).
+    """
+
+    def __init__(self, config, pool_slots: int, *, kv_int8: bool = False,
+                 mesh=None):
+        from tpu_dra.parallel.decode import init_cache
+
+        if pool_slots < 1:
+            raise ValueError(
+                f"prefix pool needs at least one slot, got {pool_slots}"
+            )
+        self.config = config
+        self.pool_slots = pool_slots
+        # The pool IS a KV cache — rows are B=1 segments, so the storage
+        # format (and the int8 option) is exactly the engine cache's.
+        # On a mesh its placement is left to GSPMD through the engine's
+        # copy jits (B=1 row traffic is tiny next to the engine cache;
+        # pinning a pool layout would only constrain the copies).
+        del mesh
+        self.pool = init_cache(config, pool_slots, kv_int8)
+        self._free: "list[int]" = list(range(pool_slots))
+        self._root = _Node([], None)
+        self._entries: "list[PrefixEntry]" = []
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- lookup ----------------------------------------------------------
+    def _walk(self, tokens: "list[int]"):
+        """Deepest reach of ``tokens`` in the tree: returns
+        ``(node, matched)`` where ``matched`` tokens are shared with every
+        entry in ``node``'s subtree (``node`` may be only partially
+        entered — its edge matched past ``matched - depth(parent)``
+        tokens, which still bounds the shared run from below)."""
+        node, depth = self._root, 0
+        while depth < len(tokens):
+            child = node.children.get(tokens[depth])
+            if child is None:
+                return node, depth
+            common = 0
+            rest = tokens[depth:]
+            for a, b in zip(child.edge, rest):
+                if a != b:
+                    break
+                common += 1
+            depth += common
+            if common < len(child.edge):
+                # Diverged mid-edge: everything below `child` still
+                # shares the first `depth` tokens.
+                return child, depth
+            node = child
+        return node, depth
+
+    def _best_in_subtree(self, node: "_Node") -> "PrefixEntry | None":
+        """Hottest resident entry at or below ``node`` (any one is
+        usable at the matched length; most-recently-used keeps the walk
+        aligned with the LRU policy).  Pools are small, DFS is cheap."""
+        best = node.entry
+        stack = list(node.children.values())
+        while stack:
+            n = stack.pop()
+            if n.entry is not None and (
+                best is None or n.entry.last_used > best.last_used
+            ):
+                best = n.entry
+            stack.extend(n.children.values())
+        return best
+
+    def match(self, tokens: "list[int]", min_use: int = 1):
+        """Longest usable resident prefix of ``tokens``: returns
+        ``(entry, use_len, matched_raw)``.  ``use_len`` is capped at
+        ``len(tokens) - 1`` — the engine must always compute at least the
+        last prompt position (first-token logits come from compute, not
+        storage).  ``matched_raw`` is the uncapped overlap, so the caller
+        can tell "this exact prompt is already resident"
+        (``matched_raw >= len(tokens)``) and skip a duplicate insert.
+        ``min_use``: matches shorter than this count as misses (the
+        engine passes its suffix-window width — a sub-window match saves
+        no compute, so treating it as a hit would only add copy traffic).
+        Counts one hit or miss."""
+        node, matched = self._walk(tokens)
+        use = min(matched, len(tokens) - 1)
+        entry = None
+        if use > 0:
+            # A matched non-root node always has a resident entry in its
+            # subtree: _detach prunes entry-less childless chains on
+            # every eviction, and inserts build path + entry atomically
+            # — so this lookup cannot come back empty for use > 0 (the
+            # None guard below is belt-and-braces, not a reachable
+            # fallback).
+            entry = self._best_in_subtree(node)
+            if entry is not None:
+                use = min(use, entry.length)
+        if entry is None or use < max(1, min_use):
+            self.misses += 1
+            SERVE_PREFIX_MISSES.inc()
+            return None, 0, matched
+        self.hits += 1
+        SERVE_PREFIX_HITS.inc()
+        # A hit is a use: refresh recency so the LRU victim is the entry
+        # no lookup has touched longest, not merely the oldest insert.
+        self._tick += 1
+        entry.last_used = self._tick
+        return entry, use, matched
+
+    # -- pinning ---------------------------------------------------------
+    def acquire(self, entry: PrefixEntry) -> None:
+        entry.refcount += 1
+        self._tick += 1
+        entry.last_used = self._tick
+
+    def release(self, entry: PrefixEntry) -> None:
+        if entry.refcount <= 0:
+            raise RuntimeError("release without matching acquire")
+        entry.refcount -= 1
+
+    # -- insertion / eviction --------------------------------------------
+    def _evict_lru(self) -> "int | None":
+        victims = [e for e in self._entries if e.refcount == 0]
+        if not victims:
+            return None
+        victim = min(victims, key=lambda e: e.last_used)
+        self._detach(victim)
+        self.evictions += 1
+        SERVE_PREFIX_EVICTIONS.inc()
+        return victim.slot
+
+    def _detach(self, entry: PrefixEntry) -> None:
+        node = entry.node
+        entry.node = None
+        node.entry = None
+        self._entries.remove(entry)
+        # Prune now-useless leaves so the index stays proportional to
+        # resident entries, not to everything ever admitted.
+        while (
+            node is not None
+            and node.parent is not None
+            and node.entry is None
+            and not node.children
+        ):
+            parent = node.parent
+            del parent.children[node.edge[0]]
+            node = parent
+
+    def insert(self, tokens: "list[int]") -> "PrefixEntry | None":
+        """Index ``tokens`` as a resident prefix and return its entry,
+        pre-pinned (``refcount == 1`` — the admitting row holds it until
+        the request finishes; callers must `release`).  Allocates a pool
+        slot, evicting the LRU unpinned entry when full; returns ``None``
+        (and stores nothing) when every slot is pinned by mid-decode rows
+        — the pool is a bound, not a promise.  The caller then copies the
+        prompt's B=1 KV into ``entry.slot`` via `copy_prefix_into_row`."""
+        if not tokens:
+            raise ValueError("cannot index an empty prefix")
+        node, depth = self._walk(tokens)
+        if (
+            depth == len(tokens)
+            and depth == self._node_depth(node)
+            and node.entry is not None
+        ):
+            # The exact prefix is already resident (callers normally skip
+            # this via matched_raw, but a capped match can land here when
+            # the terminal's own row was what matched): keep the existing
+            # row — checked BEFORE allocating a slot, so a duplicate
+            # insert into a full pool never evicts an innocent entry.
+            self.acquire(node.entry)
+            return node.entry
+        if self._free:
+            slot = self._free.pop()
+        else:
+            slot = self._evict_lru()
+            if slot is None:
+                return None
+            # Eviction prunes empty branches, which can detach the node
+            # the pre-eviction walk returned — re-walk against the
+            # post-prune tree.
+            node, depth = self._walk(tokens)
+        if depth < self._node_depth(node):
+            node = self._split(node, depth)
+        if depth < len(tokens):
+            child = _Node(list(tokens[depth:]), node)
+            node.children[tokens[depth]] = child
+            node = child
+        self._tick += 1
+        entry = PrefixEntry(
+            slot=slot, length=len(tokens), refcount=1,
+            last_used=self._tick, node=node,
+        )
+        node.entry = entry
+        self._entries.append(entry)
+        return entry
+
+    def _node_depth(self, node: "_Node") -> int:
+        d = 0
+        while node.parent is not None:
+            d += len(node.edge)
+            node = node.parent
+        return d
+
+    def _split(self, node: "_Node", depth: int) -> "_Node":
+        """Split ``node``'s edge so a node boundary lands at ``depth``
+        (the walk diverged mid-edge); returns the new upper node."""
+        offset = depth - self._node_depth(node.parent)
+        upper = _Node(node.edge[:offset], node.parent)
+        node.parent.children[upper.edge[0]] = upper
+        node.edge = node.edge[offset:]
+        node.parent = upper
+        upper.children[node.edge[0]] = node
+        return upper
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def resident(self) -> int:
+        return len(self._entries)
+
+    def stats(self) -> "dict[str, int]":
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "resident": self.resident,
+            "pool_slots": self.pool_slots,
+        }

@@ -25,6 +25,27 @@ Three compiled functions, none ever retraced:
 - ``step``:    `decode_step_rows` — every row at its OWN position
   (slot == sequence position), one token for all rows per call.
 
+With ``prefix_cache_slots > 0`` admission grows an automatic shared
+-prefix cache (`prefixcache.PrefixCache` — host radix index over admitted
+token runs + a bounded device pool of B=1 KV segments, LRU + refcount
+eviction) and two more compiled functions:
+
+- ``admit_hit``: `decode.copy_prefix_into_row` (traced pool row + traced
+  hit length — one trace for any hit) fused with
+  `decode._build_prefill_suffix` — the longest resident prefix is copied
+  instead of recomputed and only the SUFFIX windows run (the resident
+  windows are sliced out of the trace by a static first-window index: a
+  family bounded by prompt_slots/prefix_window executables, filled
+  lazily), so admission cost drops from O(prompt_len) to O(suffix_len)
+  for hot prefixes — the shared-system-prompt workload's TTFT lever.
+- ``pool_write``: the same copy executable pointed the other way, parking
+  the admitted prompt's KV in the pool for future admissions.
+
+The determinism contracts below hold with the cache ON or OFF (greedy
+outputs are token-identical either way — copied KV equals recomputed KV,
+and the suffix windows are the chunked-prefill discipline, value-exact
+single-device; pinned by ``tests/test_serve_prefix.py``).
+
 Inactive rows keep stepping (XLA has no ragged batch) with a frozen
 position: their writes land on one stale slot that is either overwritten
 by the row's next admission prefill or re-written by the row's own
@@ -54,19 +75,25 @@ compute stack that exceeds it (SURVEY.md §5).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 from tpu_dra.parallel.burnin import BurninConfig
 from tpu_dra.parallel.decode import (
     _build_prefill_padded,
+    _build_prefill_suffix,
     _check_chunk,
+    _check_prefix_window,
     _check_window,
     _chosen_logprob,
     _make_pick,
     _validate_filters,
+    copy_prefix_into_row,
     decode_step_rows,
     init_cache,
 )
+from tpu_dra.parallel.prefixcache import PrefixCache
+from tpu_dra.utils.metrics import SERVE_PREFILL_TOKENS, SERVE_TTFT_SECONDS
 
 __all__ = ["Request", "ServeEngine"]
 
@@ -87,6 +114,15 @@ class Request:
     logprobs: "list[float]" = field(default_factory=list)
     done: bool = False
     finish_reason: str = ""  # "eos" | "budget" | "stop"
+    # Prefix-cache participation (engines built with prefix_cache_slots):
+    # the submit-time opt-out, and per-request observability — how many
+    # prompt tokens admission copied from a resident prefix instead of
+    # prefilling, and the submit -> first-token latency (queue wait
+    # included; 0.0 until the first token lands).
+    use_prefix_cache: bool = True
+    prefix_reused: int = 0
+    submitted_at: float = 0.0
+    ttft_s: float = 0.0
 
 
 class ServeEngine:
@@ -98,6 +134,19 @@ class ServeEngine:
     it (None: budget-only).  ``steps_per_tick``: decode steps fused into
     one device call per `tick` (finish reactions lag by at most that
     many tokens).
+
+    ``prefix_cache_slots``: rows in the automatic shared-prefix KV pool
+    (0 = off, the default — admission behavior and memory are exactly the
+    pre-cache engine's).  When on, each admission reuses the longest
+    resident prefix of its prompt (device copy + suffix-only prefill) and
+    parks its own prompt's KV for future admissions; greedy outputs stay
+    token-identical to the cache-off engine and sampled outputs stay
+    scheduling-invariant.  Dense configs only (a windowed MoE prefill
+    would re-route capacity queues — rejected at build, like
+    ``prefill_chunk``).  ``prefix_window``: suffix-prefill window width
+    (must divide ``prompt_slots``; default ``prefill_chunk`` when set,
+    else ~``prompt_slots/4`` rounded to a divisor) — the granularity at
+    which resident windows are skipped.
     """
 
     def __init__(
@@ -116,6 +165,8 @@ class ServeEngine:
         with_logprobs: bool = False,
         prefill_chunk: "int | None" = None,
         kv_int8: bool = False,
+        prefix_cache_slots: int = 0,
+        prefix_window: "int | None" = None,
         mesh=None,
     ):
         import jax
@@ -130,6 +181,10 @@ class ServeEngine:
             raise ValueError(f"steps_per_tick must be >= 1, got {steps_per_tick}")
         _validate_filters(c.vocab, temperature > 0, top_k, top_p)
         _check_chunk(c, prompt_slots, prefill_chunk, "prompt_slots")
+        if prefix_cache_slots < 0:
+            raise ValueError(
+                f"prefix_cache_slots must be >= 0, got {prefix_cache_slots}"
+            )
         self.config = c
         self.params = params
         self.slots = slots
@@ -168,9 +223,13 @@ class ServeEngine:
         self._row_req: "list[Request | None]" = [None] * slots
         self._pos = [0] * slots
         self._tok = [0] * slots
+        # Prefix-pool entries each mid-decode row holds pinned (the one
+        # its admission read + the one it wrote), released on finish.
+        self._row_pins: "list[list]" = [[] for _ in range(slots)]
         self._queue: "list[Request]" = []
         self._done: "list[Request]" = []
         self._next_id = 0
+        self._prefill_tokens = {"computed": 0, "reused": 0}
 
         # Admission prefill: the shared padded window loop (one-shot when
         # prefill_chunk is None) at B=1, so long prompts admit under the
@@ -190,6 +249,60 @@ class ServeEngine:
                 cache,
                 cache1,
             )
+
+        if prefix_cache_slots > 0:
+            if prefix_window is not None:
+                w = prefix_window
+            elif prefill_chunk is not None:
+                w = prefill_chunk
+            else:
+                # Skip granularity ~ a quarter prompt: coarse enough that
+                # a hit runs few scan passes (and the static-window
+                # executable family stays small), fine enough that the
+                # first running window wastes little pre-split recompute.
+                cap = max(1, prompt_slots // 4)
+                w = max(
+                    d for d in range(1, cap + 1) if prompt_slots % d == 0
+                )
+            _check_prefix_window(c, prompt_slots, w)
+            self.prefix_window = w
+            self._prefix = PrefixCache(
+                c, prefix_cache_slots, kv_int8=kv_int8, mesh=mesh
+            )
+            _suffix_one = _build_prefill_suffix(c, mesh, prompt_slots, w)
+
+            def admit_hit(params, prompt, length, p0, pool, slot,
+                          first_window):
+                # The hit admission in ONE compiled call: stage the
+                # resident prefix (positions [0, p0) of pool row `slot`)
+                # into a fresh B=1 cache, then run only the suffix
+                # windows on top of it.  slot/p0/length are traced (any
+                # pool row, any copy length); first_window is static —
+                # one executable per suffix window count, a family
+                # bounded by prompt_slots/prefix_window (see
+                # decode._build_prefill_suffix).
+                cache1 = init_cache(c, 1, kv_int8)
+                cache1 = copy_prefix_into_row(cache1, 0, pool, slot, p0)
+                last, cache1 = _suffix_one(
+                    params, prompt, length[None], cache1,
+                    first_window=first_window,
+                )
+                return cache1, last
+
+            def pool_write(pool, cache1, slot, length):
+                return copy_prefix_into_row(pool, slot, cache1, 0, length)
+
+            self._admit_hit = jax.jit(admit_hit, static_argnums=(6,))
+            # Donate the pool: the caller immediately rebinds
+            # self._prefix.pool to the result, and without donation XLA
+            # materializes a whole fresh pool (pool_slots full-context
+            # KV rows) just to update one row.  Backends that don't
+            # implement donation (CPU) ignore it and fall back to the
+            # copy — correct either way.
+            self._pool_write = jax.jit(pool_write, donate_argnums=(0,))
+        else:
+            self.prefix_window = None
+            self._prefix = None
 
         if temperature > 0:
             # One sampling policy for the whole stack: decode._make_pick
@@ -255,8 +368,11 @@ class ServeEngine:
             # toks/lps: (steps_per_tick, B)
             return cache, tok, pos, toks, lps
 
+        # prefill1's B=1 output is tiny and unsharded either way — one
+        # construction for both the single-device and mesh engines (the
+        # sharding discipline lives on the state-threading jits below).
+        self._prefill1 = jax.jit(prefill1)
         if mesh is None:
-            self._prefill1 = jax.jit(prefill1)
             self._insert = jax.jit(insert)
             self._step = jax.jit(step)
         else:
@@ -271,7 +387,6 @@ class ServeEngine:
             from jax.sharding import PartitionSpec as P
 
             rep = NamedSharding(mesh, P())
-            self._prefill1 = jax.jit(prefill1)
             self._insert = jax.jit(insert, out_shardings=cache_sh)
             self._step = jax.jit(
                 step, out_shardings=(cache_sh, rep, rep, rep, rep)
@@ -280,14 +395,22 @@ class ServeEngine:
     # -- submission ------------------------------------------------------
     def submit(self, prompt: "list[int]", max_new: "int | None" = None,
                seed: "int | None" = None,
-               stop_sequences: "list[list[int]] | None" = None) -> int:
+               stop_sequences: "list[list[int]] | None" = None,
+               use_prefix_cache: bool = True) -> int:
         """Queue a request; returns its id.  Admission happens on `tick`.
         ``seed`` keys this request's sampling (default: the request id) —
         its output depends on (seed, position) only, never on
         scheduling.  ``stop_sequences``: token sequences that end the
         request when generated (detected host-side per token; the
         matched stop suffix stays in ``tokens``, finish_reason
-        "stop")."""
+        "stop").  ``use_prefix_cache=False`` opts this request out of
+        the engine's prefix cache (no reuse, no pool insertion — for
+        privacy-scoped prompts or A/B measurement); a no-op on engines
+        built without ``prefix_cache_slots``.
+
+        Every contract violation raises HERE, eagerly — a bad prompt
+        must never surface later as an opaque failure inside the padded
+        admission prefill with other requests mid-flight."""
         for t in prompt:
             # bool is an int subclass and would silently embed as 0/1; an
             # out-of-range id silently clamps in the embedding gather —
@@ -331,12 +454,77 @@ class ServeEngine:
             id=self._next_id, prompt=list(prompt), max_new=budget,
             seed=self._next_id if seed is None else seed,
             stop_sequences=stops,
+            use_prefix_cache=bool(use_prefix_cache),
+            submitted_at=time.perf_counter(),
         )
         self._next_id += 1
         self._queue.append(req)
         return req.id
 
     # -- the engine loop -------------------------------------------------
+    def _admit_prefill(self, req: Request, prompt, length: int):
+        """One admission's prefill: the prefix-cache split when enabled
+        (longest resident prefix → device copy, suffix → windowed
+        prefill, prompt KV parked in the pool), the plain full prefill
+        otherwise.  Returns ``(cache1, last, pins)`` — ``pins`` are the
+        pool entries this row holds against eviction until it finishes."""
+        import jax.numpy as jnp
+
+        cacheable = self._prefix is not None and req.use_prefix_cache
+        entry, m, m_raw = (
+            # A sub-window match is a miss by construction (min_use): the
+            # suffix prefill would run every window anyway.
+            self._prefix.match(req.prompt, min_use=self.prefix_window)
+            if cacheable
+            else (None, 0, 0)
+        )
+        pins = []
+        if entry is not None:
+            self._prefix.acquire(entry)
+            pins.append(entry)
+            # Copy exactly the window-aligned part of the match: the
+            # first running window recomputes from its grid start, so
+            # copying [fw * W, m) would be overwritten anyway — and the
+            # reused/computed split stays honest (reused = positions
+            # whose compute was actually skipped).
+            fw = m // self.prefix_window
+            p0 = fw * self.prefix_window
+            cache1, last = self._admit_hit(
+                self.params, prompt, jnp.int32(length), jnp.int32(p0),
+                self._prefix.pool, jnp.int32(entry.slot), fw,
+            )
+            req.prefix_reused = p0
+            self._prefill_tokens["reused"] += p0
+            self._prefill_tokens["computed"] += length - p0
+            SERVE_PREFILL_TOKENS.inc(p0, kind="reused")
+            SERVE_PREFILL_TOKENS.inc(length - p0, kind="computed")
+        else:
+            cache1, last = self._prefill1(
+                self.params, prompt, jnp.int32(length)
+            )
+            self._prefill_tokens["computed"] += length
+            SERVE_PREFILL_TOKENS.inc(length, kind="computed")
+        if (
+            cacheable
+            and m_raw < length
+            and length >= self.prefix_window
+        ):
+            # Park this prompt's KV for future admissions — unless the
+            # exact prompt is already resident (m_raw >= length: a
+            # duplicate row would only waste a slot) or the prompt is
+            # shorter than one suffix window (a future match could never
+            # clear min_use, so the entry would be un-hittable: pure
+            # pool pressure + a wasted device write).  insert() returns
+            # None when every slot is pinned by mid-decode rows.
+            new_entry = self._prefix.insert(req.prompt)
+            if new_entry is not None:
+                self._prefix.pool = self._pool_write(
+                    self._prefix.pool, cache1,
+                    jnp.int32(new_entry.slot), jnp.int32(length),
+                )
+                pins.append(new_entry)
+        return cache1, last, pins
+
     def _admit(self) -> None:
         import jax.numpy as jnp
 
@@ -347,9 +535,7 @@ class ServeEngine:
             length = len(req.prompt)
             padded = req.prompt + [0] * (self.prompt_slots - length)
             prompt = jnp.asarray(padded, jnp.int32)[None, :]
-            cache1, last = self._prefill1(
-                self.params, prompt, jnp.int32(length)
-            )
+            cache1, last, pins = self._admit_prefill(req, prompt, length)
             self._cache = self._insert(self._cache, cache1, jnp.int32(row))
             import jax
 
@@ -362,11 +548,15 @@ class ServeEngine:
             self._row_req[row] = req
             self._pos[row] = length
             self._tok[row] = first
+            self._row_pins[row] = pins
             self._note_token(row, first, lp0)
 
     def _note_token(self, row: int, token: int, logprob: float) -> None:
         req = self._row_req[row]
         req.tokens.append(token)
+        if len(req.tokens) == 1:
+            req.ttft_s = time.perf_counter() - req.submitted_at
+            SERVE_TTFT_SECONDS.observe(req.ttft_s)
         if self.with_logprobs:
             req.logprobs.append(logprob)
         if self.eos_token is not None and token == self.eos_token:
@@ -380,6 +570,11 @@ class ServeEngine:
         if req.done:
             self._done.append(req)
             self._row_req[row] = None
+            # The finished row no longer needs its prefix entries held
+            # against eviction.
+            for entry in self._row_pins[row]:
+                self._prefix.release(entry)
+            self._row_pins[row] = []
 
     def tick(self) -> "list[Request]":
         """Admit waiting requests into free rows, run one device call
@@ -433,3 +628,22 @@ class ServeEngine:
         return len(self._queue) + sum(
             r is not None for r in self._row_req
         )
+
+    @property
+    def prefix_stats(self) -> "dict[str, int]":
+        """This engine's prefix-cache counters (bench/test readback; the
+        process-global Prometheus counters aggregate across engines):
+        hits/misses/evictions/resident/pool_slots from the cache, plus
+        the admission prefill token split — ``prefill_tokens_reused`` is
+        exactly the prefill work the cache avoided."""
+        stats = (
+            self._prefix.stats()
+            if self._prefix is not None
+            else {
+                "hits": 0, "misses": 0, "evictions": 0,
+                "resident": 0, "pool_slots": 0,
+            }
+        )
+        stats["prefill_tokens_computed"] = self._prefill_tokens["computed"]
+        stats["prefill_tokens_reused"] = self._prefill_tokens["reused"]
+        return stats

@@ -299,6 +299,38 @@ CLAIM_E2E_SECONDS = REGISTRY.histogram(
     "prepared (allocated->prepared), e2e (created->prepared)",
     buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0),
 )
+# Serving-engine prefix cache (parallel/prefixcache.py): admissions whose
+# prompt reused a resident shared-prefix KV segment vs paid a full prefill,
+# and pool rows recycled under pressure.
+SERVE_PREFIX_HITS = REGISTRY.counter(
+    "tpu_dra_serve_prefix_hits_total",
+    "Engine admissions that reused a resident shared-prefix KV segment "
+    "(suffix-only prefill)",
+)
+SERVE_PREFIX_MISSES = REGISTRY.counter(
+    "tpu_dra_serve_prefix_misses_total",
+    "Engine admissions that found no usable resident prefix (full prefill)",
+)
+SERVE_PREFIX_EVICTIONS = REGISTRY.counter(
+    "tpu_dra_serve_prefix_evictions_total",
+    "Prefix-pool rows recycled (LRU among unpinned entries) to admit a "
+    "new prefix",
+)
+SERVE_PREFILL_TOKENS = REGISTRY.counter(
+    "tpu_dra_serve_prefill_tokens_total",
+    "Prompt tokens at admission by kind: computed (ran through prefill) "
+    "vs reused (copied from a resident prefix segment)",
+)
+# TTFT = submit -> first generated token, queue wait included (that IS the
+# user-visible latency under load).  Sub-5ms buckets matter: a prefix hit
+# turns a multi-window prefill into a copy + one window.
+SERVE_TTFT_SECONDS = REGISTRY.histogram(
+    "tpu_dra_serve_ttft_seconds",
+    "Serve-engine time to first token per request (submit to first "
+    "generated token, queue wait included)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0),
+)
 
 
 def set_build_info(component: str) -> None:
