@@ -8,7 +8,7 @@ TAG     ?= latest
 
 .PHONY: all test lint generate-crds check-generate native native-test \
         demo-quickstart bench image clean help observability-smoke \
-        perf-smoke explain-smoke serve-smoke
+        perf-smoke explain-smoke serve-smoke serve-obs-smoke
 
 all: lint test
 
@@ -70,6 +70,15 @@ explain-smoke:
 serve-smoke:
 	$(PYTHON) -m pytest tests/test_serve_smoke.py -q -m 'not slow'
 
+# Serving telemetry floor: drives a small engine stream, scrapes /metrics
+# and /debug/engine over HTTP, asserts the TPOT/queue-wait/SLO series and
+# per-engine gauges appear, the step flight recorder serves the ring, a
+# request's spans are visible in /debug/traces by trace id, and every
+# finished request carries a complete monotone timeline
+# (docs/OBSERVABILITY.md "Serving telemetry").
+serve-obs-smoke:
+	$(PYTHON) -m pytest tests/test_serve_obs_smoke.py -q -m 'not slow'
+
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
@@ -81,4 +90,4 @@ clean:
 help:
 	@echo "targets: test lint generate-crds check-generate native native-test"
 	@echo "         demo-quickstart bench observability-smoke perf-smoke"
-	@echo "         explain-smoke serve-smoke image clean"
+	@echo "         explain-smoke serve-smoke serve-obs-smoke image clean"

@@ -75,7 +75,10 @@ def test_bench_northstar_mesh_stanza():
 def test_bench_serve_prefix_stanza():
     """The serve-engine prefix-cache stanza (ISSUE 4): the child must
     report a real hit rate, reduced TTFT/prefill work, and — inside the
-    stanza itself — greedy token-identity cache-on vs cache-off."""
+    stanza itself — greedy token-identity cache-on vs cache-off.  ISSUE 5
+    adds the telemetry extras: TPOT/queue-wait percentiles per mode, and
+    the telemetry-on-vs-off throughput noise check (instrumentation must
+    not regress the hot loop)."""
     import bench
 
     out = bench.bench_serve_prefix()
@@ -87,6 +90,13 @@ def test_bench_serve_prefix_stanza():
         out["cache_on"]["prefill_tokens_per_req"]
         < out["cache_off"]["prefill_tokens_per_req"]
     )
+    for mode in ("cache_on", "cache_off"):
+        for key in ("tpot_p50_s", "tpot_p95_s", "queue_wait_p95_s"):
+            assert key in out[mode], (mode, key, out[mode])
+        assert out[mode]["tpot_p50_s"] > 0
+    tel = out["telemetry"]
+    assert {"tokens_per_s_on", "tokens_per_s_off", "ratio"} <= tel.keys()
+    assert tel["within_noise"], tel
 
 
 def test_bench_fanout_scale_small():

@@ -31,6 +31,90 @@ def test_gauge_function_sampled_at_scrape():
     assert "depth 7.0" in g.collect()
 
 
+def test_gauge_sampler_failure_counted_and_last_good_reexposed():
+    """A raising set_function callback must not silently vanish from the
+    exposition: the failure moves tpu_dra_metric_sample_errors_total
+    (labeled with the gauge's name) and the series re-exposes its last
+    good sample."""
+    from tpu_dra.utils.metrics import METRIC_SAMPLE_ERRORS
+
+    g = Gauge("sampled", "sampler health")
+    state = {"v": 3.0, "boom": False}
+
+    def fn():
+        if state["boom"]:
+            raise RuntimeError("broken sampler")
+        return state["v"]
+
+    g.set_function(fn, src="x")
+    assert 'sampled{src="x"} 3.0' in g.collect()
+    before = METRIC_SAMPLE_ERRORS.value(metric="sampled")
+    state["boom"] = True
+    text = g.collect()
+    assert 'sampled{src="x"} 3.0' in text  # last good value held
+    assert METRIC_SAMPLE_ERRORS.value(metric="sampled") == before + 1
+    g.collect()  # every failed scrape counts
+    assert METRIC_SAMPLE_ERRORS.value(metric="sampled") == before + 2
+    state["boom"] = False
+    state["v"] = 9.0
+    assert 'sampled{src="x"} 9.0' in g.collect()  # recovery resumes
+
+    # A sampler that NEVER produced a good value has nothing to re-expose:
+    # counted, series absent (not a fake zero).
+    g.set_function(lambda: 1 / 0, src="y")
+    text = g.collect()
+    assert 'src="y"' not in text
+    assert METRIC_SAMPLE_ERRORS.value(metric="sampled") == before + 3
+
+
+def test_gauge_sampler_none_retires_series():
+    """Returning None is the owner-is-gone signal (the serve engine's
+    weakref samplers): fn and series are dropped, without an error."""
+    from tpu_dra.utils.metrics import METRIC_SAMPLE_ERRORS
+
+    g = Gauge("weakly", "weakref-backed")
+    alive = [7.0]
+    g.set_function(lambda: alive[0], owner="a")
+    assert 'weakly{owner="a"} 7.0' in g.collect()
+    before = METRIC_SAMPLE_ERRORS.value(metric="weakly")
+    alive[0] = None
+    text = g.collect()
+    assert 'owner="a"' not in text
+    assert METRIC_SAMPLE_ERRORS.value(metric="weakly") == before
+    # Retired means retired: a later scrape doesn't resurrect it.
+    alive[0] = 7.0
+    assert 'owner="a"' not in g.collect()
+
+
+def test_serve_latency_bucket_edges_pinned():
+    """Purpose-fit buckets for the serving histograms: DEFAULT_BUCKETS
+    bottom out at 5ms, useless for TPOT; these edges are part of the
+    dashboard contract, pin them in the exposition."""
+    from tpu_dra.utils.metrics import (
+        DEFAULT_BUCKETS,
+        SERVE_QUEUE_WAIT_SECONDS,
+        SERVE_TPOT_SECONDS,
+        SERVE_TTFT_SECONDS,
+    )
+
+    assert DEFAULT_BUCKETS[0] == 0.005  # the motivation, stated
+    # TPOT: sub-ms-dense, nothing past 1s (that's a stall, not latency).
+    assert SERVE_TPOT_SECONDS.buckets[0] == 0.0002
+    assert SERVE_TPOT_SECONDS.buckets[-1] == 1.0
+    SERVE_TPOT_SECONDS.observe(0.0004)
+    text = SERVE_TPOT_SECONDS.collect()
+    assert 'le="0.0002"' in text and 'le="0.0005"' in text
+    # Queue wait: sub-ms (idle) through a minute (saturated).
+    assert SERVE_QUEUE_WAIT_SECONDS.buckets[0] == 0.0005
+    assert SERVE_QUEUE_WAIT_SECONDS.buckets[-1] == 60.0
+    SERVE_QUEUE_WAIT_SECONDS.observe(0.01)
+    assert 'le="60.0"' in SERVE_QUEUE_WAIT_SECONDS.collect()
+    # TTFT retuned: 0.5ms floor (prefix-hit admissions), 30s tail
+    # (queue-wait-dominated saturation).
+    assert SERVE_TTFT_SECONDS.buckets[0] == 0.0005
+    assert SERVE_TTFT_SECONDS.buckets[-1] == 30.0
+
+
 def test_histogram_buckets_cumulative():
     h = Histogram("lat", "latency", buckets=(0.1, 1.0))
     for v in (0.05, 0.5, 5.0):

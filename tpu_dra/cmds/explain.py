@@ -13,6 +13,16 @@ MetricsServer that serves /metrics and /debug/traces — works against a
 real deployment or a kubesim rung controller), and with ``--apiserver``
 additionally prints the claim's Events (the compressed Warning the
 reconciler records on unplaceable claims).
+
+`tpudra serve-stats` is the serving-side sibling — "why is my request
+slow?" — rendering a live snapshot of a serve engine's step flight
+recorder from the ``/debug/engine`` endpoint (utils/servestats.py):
+
+    $ tpudra serve-stats --endpoint http://serve-host:8080
+    42 tick(s), 12 admitted (9 prefix hit(s)), 12 finished, 480 token(s)
+    @ 86.0/s, occupancy mean 3.4, queue max 7, step p50 11.02ms p95
+    14.80ms, goodput 0.92 (11 met / 1 missed)
+    ...one row per tick...
 """
 
 from __future__ import annotations
@@ -69,6 +79,35 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
     explain.add_argument(
         "--limit", type=int, default=256,
         help="max decision records to fetch",
+    )
+
+    stats = sub.add_parser(
+        "serve-stats",
+        help="live serve-engine step/SLO snapshot from /debug/engine",
+    )
+    stats.add_argument(
+        "--endpoint",
+        default=flags._env_default("TPUDRA_ENGINE", "http://127.0.0.1:8080"),
+        help="serve process debug HTTP endpoint (its MetricsServer "
+        "address) [TPUDRA_ENGINE]",
+    )
+    stats.add_argument(
+        "--pprof-path",
+        default="/debug",
+        help="debug path prefix (matches the server's --pprof-path)",
+    )
+    stats.add_argument(
+        "--engine",
+        default="",
+        help="only this engine's rows (the ServeEngine name label)",
+    )
+    stats.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output form (text: summary + per-tick rows; json: raw)",
+    )
+    stats.add_argument(
+        "--limit", type=int, default=256,
+        help="max step records to fetch",
     )
     return parser.parse_args(argv)
 
@@ -144,10 +183,74 @@ def explain(args: argparse.Namespace, out=sys.stdout) -> int:
     return 0
 
 
+def _fetch_engine(args: argparse.Namespace) -> dict:
+    query = urllib.parse.urlencode(
+        {
+            "format": "json",
+            "limit": args.limit,
+            **({"engine": args.engine} if args.engine else {}),
+        }
+    )
+    base = args.endpoint.rstrip("/")
+    pprof = "/" + args.pprof_path.strip("/")
+    url = f"{base}{pprof}/engine?{query}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def serve_stats(args: argparse.Namespace, out=None) -> int:
+    from tpu_dra.utils import servestats
+
+    # Resolve the stream at CALL time: an import-time sys.stdout default
+    # would freeze whatever stream was active when this module first
+    # loaded (pytest capture, a redirected launcher).
+    out = sys.stdout if out is None else out
+    try:
+        doc = _fetch_engine(args)
+    except (urllib.error.URLError, OSError) as e:
+        print(
+            f"error: cannot reach serve endpoint at {args.endpoint}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+
+    if args.format == "json":
+        print(json.dumps(doc, indent=2), file=out)
+        return 0
+    # Tolerate version skew with the serve host: keep only the fields
+    # this build's StepRecord knows (a newer host's extra keys must not
+    # crash the CLI whose whole job is talking to remote processes).
+    known = servestats.StepRecord.__dataclass_fields__.keys()
+    records = [
+        servestats.StepRecord(**{k: v for k, v in r.items() if k in known})
+        for r in doc.get("steps", [])
+    ]
+    if not records:
+        which = f" for engine {args.engine!r}" if args.engine else ""
+        print(
+            f"no engine steps recorded{which} "
+            f"(recorded={doc.get('recorded', 0)}, "
+            f"dropped={doc.get('dropped', 0)}; is a ServeEngine ticking "
+            "with telemetry on?)",
+            file=out,
+        )
+    else:
+        print(servestats.render_text(records), end="", file=out)
+        if doc.get("dropped"):
+            print(
+                f"(flight recorder wrapped: {doc['dropped']} older "
+                "record(s) dropped)",
+                file=out,
+            )
+    return 0
+
+
 def main(argv: "list[str] | None" = None) -> int:
     args = parse_args(argv)
     if args.command == "explain":
         return explain(args)
+    if args.command == "serve-stats":
+        return serve_stats(args)
     return 2  # unreachable: subparsers are required
 
 

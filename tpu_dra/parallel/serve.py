@@ -68,6 +68,19 @@ count, admission order, or steps_per_tick (pinned by test).  Dense and
 MoE configs; weight/KV int8 compose like everywhere else in the
 serving stack.
 
+Runtime telemetry (docs/OBSERVABILITY.md "Serving telemetry"): every
+request carries a full lifecycle timeline (enqueued -> admitted ->
+first_token -> finished, queue wait, per-token arrival deltas) and one
+trace id whose spans (``serve.queue`` / ``serve.admit`` /
+``serve.decode`` under a ``serve.request`` root) land in the same ring
+exporter as the claim-lifecycle traces — `/debug/traces` shows request
+timelines beside control-plane ones.  Every ``tick()`` appends a
+StepRecord (occupancy, queue depth, admissions, completions, tokens,
+step wall time) to the engine flight recorder served by
+``/debug/engine`` and the ``tpudra serve-stats`` CLI; TTFT/TPOT/queue
+-wait histograms, queue-depth/occupancy gauges, and optional TTFT/TPOT
+SLO targets with goodput counters ride the process metrics registry.
+
 Reference parity note: the reference driver (nvidia k8s-dra-driver) has
 no compute path at all — this is the serving-runtime layer of the
 compute stack that exceeds it (SURVEY.md §5).
@@ -75,7 +88,9 @@ compute stack that exceeds it (SURVEY.md §5).
 
 from __future__ import annotations
 
+import itertools
 import time
+import weakref
 from dataclasses import dataclass, field
 
 from tpu_dra.parallel.burnin import BurninConfig
@@ -93,9 +108,41 @@ from tpu_dra.parallel.decode import (
     init_cache,
 )
 from tpu_dra.parallel.prefixcache import PrefixCache
-from tpu_dra.utils.metrics import SERVE_PREFILL_TOKENS, SERVE_TTFT_SECONDS
+from tpu_dra.utils import servestats, trace
+from tpu_dra.utils.metrics import (
+    SERVE_BATCH_OCCUPANCY,
+    SERVE_PREFILL_TOKENS,
+    SERVE_QUEUE_DEPTH,
+    SERVE_QUEUE_WAIT_SECONDS,
+    SERVE_SLO_TOTAL,
+    SERVE_TPOT_SECONDS,
+    SERVE_TTFT_SECONDS,
+)
 
 __all__ = ["Request", "ServeEngine"]
+
+# Default engine names for the per-engine gauge/flight-recorder label.
+_ENGINE_IDS = itertools.count()
+
+
+def _unix_of(perf_t: float) -> float:
+    """Map an engine perf_counter timestamp onto the wall clock for span
+    records (the timeline runs on the monotonic clock; chrome-trace wants
+    unix time — debug-grade precision is fine)."""
+    return time.time() - (time.perf_counter() - perf_t)
+
+
+def _weak_sampler(ref: "weakref.ref", fn):
+    """A scrape-time gauge callback holding only a weakref to the engine:
+    returning None retires the series once the engine is collected
+    (Gauge.set_function contract), so the process-global gauges never pin
+    a dead engine's device arrays."""
+
+    def sample():
+        eng = ref()
+        return None if eng is None else fn(eng)
+
+    return sample
 
 
 @dataclass
@@ -123,6 +170,32 @@ class Request:
     prefix_reused: int = 0
     submitted_at: float = 0.0
     ttft_s: float = 0.0
+    # Lifecycle timeline (host perf_counter clock, monotonic):
+    # enqueued (== submitted_at) <= admitted <= first_token <= finished.
+    # queue_wait_s = admitted - enqueued; ttft_s = first_token - enqueued
+    # (so queue_wait_s <= ttft_s always); tpot_s is the mean inter-token
+    # arrival gap (0.0 until a second token lands).
+    enqueued_at: float = 0.0
+    admitted_at: float = 0.0
+    first_token_at: float = 0.0
+    finished_at: float = 0.0
+    queue_wait_s: float = 0.0
+    tpot_s: float = 0.0
+    # Host arrival gap before each generated token AFTER the first (the
+    # TPOT samples).  With steps_per_tick > 1 a fused batch of tokens
+    # arrives in one device fetch: the whole gap lands on the batch's
+    # first token and the rest read ~0 — the honest host-side view.
+    token_deltas: "list[float]" = field(default_factory=list)
+    # SLO verdicts stamped at finish when the engine has targets
+    # configured: {"ttft"|"tpot"|"request": "met"|"missed"} ("request" =
+    # every evaluated target met — the goodput bit).
+    slo: "dict[str, str]" = field(default_factory=dict)
+    # Trace identity: every span of this request (serve.queue /
+    # serve.admit / serve.decode under the serve.request root) carries
+    # this id — `/debug/traces?trace_id=` shows the whole timeline.
+    trace_id: str = ""
+    trace_ctx: "object | None" = field(default=None, repr=False)
+    _last_token_at: float = field(default=0.0, repr=False)
 
 
 class ServeEngine:
@@ -147,6 +220,17 @@ class ServeEngine:
     (must divide ``prompt_slots``; default ``prefill_chunk`` when set,
     else ~``prompt_slots/4`` rounded to a divisor) — the granularity at
     which resident windows are skipped.
+
+    ``ttft_slo_s`` / ``tpot_slo_s``: optional latency targets; every
+    finished request gets met/missed verdicts (``Request.slo``, the
+    ``tpu_dra_serve_slo_total{slo,verdict}`` counters — ``slo="request"``
+    is the goodput series: every evaluated target met).
+    ``telemetry`` (default on): per-request trace spans, the step flight
+    recorder (``/debug/engine``), and per-token TPOT observations —
+    turn off to measure the engine bare (the bench stanza's noise
+    check).  ``name``: the label value for this engine's queue-depth /
+    batch-occupancy gauge series and flight-recorder rows (default
+    ``engine-<n>``); `close()` retires the gauge series deterministically.
     """
 
     def __init__(
@@ -167,6 +251,10 @@ class ServeEngine:
         kv_int8: bool = False,
         prefix_cache_slots: int = 0,
         prefix_window: "int | None" = None,
+        ttft_slo_s: "float | None" = None,
+        tpot_slo_s: "float | None" = None,
+        telemetry: bool = True,
+        name: "str | None" = None,
         mesh=None,
     ):
         import jax
@@ -185,6 +273,9 @@ class ServeEngine:
             raise ValueError(
                 f"prefix_cache_slots must be >= 0, got {prefix_cache_slots}"
             )
+        for knob, value in (("ttft_slo_s", ttft_slo_s), ("tpot_slo_s", tpot_slo_s)):
+            if value is not None and not value > 0:
+                raise ValueError(f"{knob} must be > 0, got {value}")
         self.config = c
         self.params = params
         self.slots = slots
@@ -230,6 +321,35 @@ class ServeEngine:
         self._done: "list[Request]" = []
         self._next_id = 0
         self._prefill_tokens = {"computed": 0, "reused": 0}
+
+        # -- runtime telemetry (docs/OBSERVABILITY.md "Serving telemetry").
+        # `telemetry` gates the per-event machinery (request spans, the
+        # step flight recorder, per-token TPOT observations); per-request
+        # summary metrics (TTFT/queue-wait histograms, SLO counters) and
+        # the Request timeline fields are always on — they are one
+        # observation per request, not per token.
+        self.telemetry = telemetry
+        self.ttft_slo_s = ttft_slo_s
+        self.tpot_slo_s = tpot_slo_s
+        self.name = name or f"engine-{next(_ENGINE_IDS)}"
+        self._slo_met = 0
+        self._slo_missed = 0
+        self._tokens_emitted = 0
+        # Scrape-time gauges, one series per engine.  The sampler holds a
+        # weakref: a collected engine's series retires itself at the next
+        # scrape, and close() retires it deterministically.  Two live
+        # engines sharing a `name` would overwrite each other's series —
+        # pass distinct names when running several engines in-process.
+        ref = weakref.ref(self)
+        SERVE_QUEUE_DEPTH.set_function(
+            _weak_sampler(ref, lambda e: len(e._queue)), engine=self.name
+        )
+        SERVE_BATCH_OCCUPANCY.set_function(
+            _weak_sampler(
+                ref, lambda e: sum(r is not None for r in e._row_req)
+            ),
+            engine=self.name,
+        )
 
         # Admission prefill: the shared padded window loop (one-shot when
         # prefill_chunk is None) at B=1, so long prompts admit under the
@@ -450,12 +570,15 @@ class ServeEngine:
             # equal int tokens, and bools are int subclasses that compare
             # equal to token ids 0/1: reject malformed stops up front.
             raise ValueError("stop sequences must contain int token ids")
+        now = time.perf_counter()
+        ctx = trace.TraceContext.new()
         req = Request(
             id=self._next_id, prompt=list(prompt), max_new=budget,
             seed=self._next_id if seed is None else seed,
             stop_sequences=stops,
             use_prefix_cache=bool(use_prefix_cache),
-            submitted_at=time.perf_counter(),
+            submitted_at=now, enqueued_at=now,
+            trace_id=ctx.trace_id, trace_ctx=ctx,
         )
         self._next_id += 1
         self._queue.append(req)
@@ -525,13 +648,28 @@ class ServeEngine:
                 pins.append(new_entry)
         return cache1, last, pins
 
-    def _admit(self) -> None:
+    def _admit(self) -> "tuple[int, int]":
+        """Fill free rows from the queue; returns ``(admitted,
+        prefix_hits)`` for this tick's flight-recorder row."""
         import jax.numpy as jnp
 
+        admitted = hits = 0
         for row in range(self.slots):
             if self._row_req[row] is not None or not self._queue:
                 continue
             req = self._queue.pop(0)
+            t_admit = time.perf_counter()
+            req.admitted_at = t_admit
+            req.queue_wait_s = t_admit - req.enqueued_at
+            SERVE_QUEUE_WAIT_SECONDS.observe(req.queue_wait_s)
+            if self.telemetry:
+                # Retro span: the wait ended just now, started at submit.
+                trace.emit_span(
+                    "serve.queue", parent=req.trace_ctx,
+                    start_unix_s=_unix_of(req.enqueued_at),
+                    duration_s=req.queue_wait_s,
+                    request=req.id, queue_depth=len(self._queue),
+                )
             length = len(req.prompt)
             padded = req.prompt + [0] * (self.prompt_slots - length)
             prompt = jnp.asarray(padded, jnp.int32)[None, :]
@@ -550,13 +688,39 @@ class ServeEngine:
             self._tok[row] = first
             self._row_pins[row] = pins
             self._note_token(row, first, lp0)
+            if self.telemetry:
+                trace.emit_span(
+                    "serve.admit", parent=req.trace_ctx,
+                    start_unix_s=_unix_of(t_admit),
+                    duration_s=time.perf_counter() - t_admit,
+                    request=req.id, row=row, prompt_len=length,
+                    prefix_hit=req.prefix_reused > 0,
+                    prefix_reused=req.prefix_reused,
+                    suffix_len=length - req.prefix_reused,
+                )
+            admitted += 1
+            hits += req.prefix_reused > 0
+        return admitted, hits
 
     def _note_token(self, row: int, token: int, logprob: float) -> None:
         req = self._row_req[row]
+        now = time.perf_counter()
         req.tokens.append(token)
         if len(req.tokens) == 1:
-            req.ttft_s = time.perf_counter() - req.submitted_at
+            req.first_token_at = now
+            req.ttft_s = now - req.submitted_at
             SERVE_TTFT_SECONDS.observe(req.ttft_s)
+            if self.ttft_slo_s is not None:
+                verdict = "met" if req.ttft_s <= self.ttft_slo_s else "missed"
+                req.slo["ttft"] = verdict
+                SERVE_SLO_TOTAL.inc(slo="ttft", verdict=verdict)
+        else:
+            delta = now - req._last_token_at
+            req.token_deltas.append(delta)
+            if self.telemetry:
+                SERVE_TPOT_SECONDS.observe(delta)
+        req._last_token_at = now
+        self._tokens_emitted += 1
         if self.with_logprobs:
             req.logprobs.append(logprob)
         if self.eos_token is not None and token == self.eos_token:
@@ -568,23 +732,76 @@ class ServeEngine:
         elif len(req.tokens) >= req.max_new:
             req.done, req.finish_reason = True, "budget"
         if req.done:
-            self._done.append(req)
-            self._row_req[row] = None
-            # The finished row no longer needs its prefix entries held
-            # against eviction.
-            for entry in self._row_pins[row]:
-                self._prefix.release(entry)
-            self._row_pins[row] = []
+            self._finish(row, req, now)
+
+    def _finish(self, row: int, req: Request, now: float) -> None:
+        """Close out a finished request: timeline tail, TPOT mean, SLO
+        verdicts, the serve.decode + serve.request spans, row release."""
+        req.finished_at = now
+        if req.token_deltas:
+            req.tpot_s = sum(req.token_deltas) / len(req.token_deltas)
+            if self.tpot_slo_s is not None:
+                verdict = "met" if req.tpot_s <= self.tpot_slo_s else "missed"
+                req.slo["tpot"] = verdict
+                SERVE_SLO_TOTAL.inc(slo="tpot", verdict=verdict)
+        if self.ttft_slo_s is not None or self.tpot_slo_s is not None:
+            # The goodput bit: every evaluated target met.  (A one-token
+            # request under a tpot-only SLO has no evaluated target and
+            # counts met — nothing it was held to was missed.)
+            verdict = "missed" if "missed" in req.slo.values() else "met"
+            req.slo["request"] = verdict
+            SERVE_SLO_TOTAL.inc(slo="request", verdict=verdict)
+            if verdict == "met":
+                self._slo_met += 1
+            else:
+                self._slo_missed += 1
+        if self.telemetry:
+            trace.emit_span(
+                "serve.decode", parent=req.trace_ctx,
+                start_unix_s=_unix_of(req.first_token_at),
+                duration_s=req.finished_at - req.first_token_at,
+                request=req.id, tokens=len(req.tokens),
+                finish_reason=req.finish_reason,
+                tpot_s=round(req.tpot_s, 6) if req.token_deltas else None,
+            )
+            # The trace ROOT, emitted last (its identity IS the request's
+            # TraceContext, so the three phase spans above parent to it).
+            trace.emit_span(
+                "serve.request", context=req.trace_ctx,
+                start_unix_s=_unix_of(req.enqueued_at),
+                duration_s=req.finished_at - req.enqueued_at,
+                request=req.id, prompt_len=len(req.prompt),
+                tokens=len(req.tokens), finish_reason=req.finish_reason,
+                queue_wait_s=round(req.queue_wait_s, 6),
+                ttft_s=round(req.ttft_s, 6),
+                prefix_reused=req.prefix_reused,
+                slo=req.slo.get("request"),
+            )
+        self._done.append(req)
+        self._row_req[row] = None
+        # The finished row no longer needs its prefix entries held
+        # against eviction.
+        for entry in self._row_pins[row]:
+            self._prefix.release(entry)
+        self._row_pins[row] = []
 
     def tick(self) -> "list[Request]":
         """Admit waiting requests into free rows, run one device call
         (``steps_per_tick`` decode steps for every row), process
-        finishes.  Returns requests completed during this tick."""
+        finishes.  Returns requests completed during this tick.  With
+        ``telemetry`` on, every tick appends one StepRecord to the
+        process-global engine flight recorder (``/debug/engine``)."""
         import jax
         import jax.numpy as jnp
 
+        t0 = time.perf_counter()
         done_before = len(self._done)
-        self._admit()
+        toks_before = self._tokens_emitted
+        admitted, prefix_hits = self._admit()
+        # Occupancy/queue as the device step sees them: after this tick's
+        # admissions, before its finishes.
+        occupancy = sum(r is not None for r in self._row_req)
+        queue_depth = len(self._queue)
         if any(r is not None for r in self._row_req):
             active = jnp.asarray(
                 [r is not None for r in self._row_req], bool
@@ -610,7 +827,24 @@ class ServeEngine:
                     self._note_token(
                         row, int(toks[s, row]), float(lps[s, row])
                     )
-        return self._done[done_before:]
+        finished = self._done[done_before:]
+        if self.telemetry:
+            servestats.RECORDER.record(
+                servestats.StepRecord(
+                    engine=self.name,
+                    occupancy=occupancy,
+                    slots=self.slots,
+                    queue_depth=queue_depth,
+                    admitted=admitted,
+                    prefix_hits=prefix_hits,
+                    finished=len(finished),
+                    tokens=self._tokens_emitted - toks_before,
+                    step_wall_s=time.perf_counter() - t0,
+                    slo_met=self._slo_met,
+                    slo_missed=self._slo_missed,
+                )
+            )
+        return finished
 
     def run(self, until_idle: int = 10_000) -> "list[Request]":
         """Tick until queue and rows are empty; returns all completed
@@ -622,6 +856,14 @@ class ServeEngine:
         else:
             raise RuntimeError("engine did not drain within the tick bound")
         return self._done
+
+    def close(self) -> None:
+        """Retire this engine's scrape-time gauge series.  The weakref
+        samplers would retire them at the next scrape after collection
+        anyway; close() makes teardown deterministic for tests and for
+        embedding servers that recycle engine names."""
+        SERVE_QUEUE_DEPTH.remove_function(engine=self.name)
+        SERVE_BATCH_OCCUPANCY.remove_function(engine=self.name)
 
     @property
     def pending(self) -> int:

@@ -83,6 +83,12 @@ class Counter:
         return "\n".join(out)
 
 
+# Rebound to the real counter once the default registry below exists;
+# Gauge.collect reads the global at call time, so the placeholder only
+# matters during this module's own import.
+METRIC_SAMPLE_ERRORS: "Counter | None" = None
+
+
 class Gauge:
     def __init__(self, name: str, help_: str):
         self.name, self.help = name, help_
@@ -95,14 +101,23 @@ class Gauge:
             self._values[tuple(sorted(labels.items()))] = float(value)
 
     def set_function(self, fn, **labels: str) -> None:
-        """Sample a callable at scrape time (e.g. workqueue depth)."""
+        """Sample a callable at scrape time (e.g. workqueue depth).
+
+        Contract at scrape: a raising callback moves
+        ``tpu_dra_metric_sample_errors_total{metric=<this gauge>}`` and the
+        series re-exposes its LAST GOOD sample (a broken sampler must not
+        silently vanish from the exposition); a callback returning ``None``
+        retires the series entirely (the owner is gone — the weakref
+        teardown path)."""
         with self._lock:
             self._fns[tuple(sorted(labels.items()))] = fn
 
     def remove_function(self, **labels: str) -> None:
         """Drop a sampled callable (and its series) — call on owner shutdown
         so the process-global registry doesn't pin dead object graphs."""
-        key = tuple(sorted(labels.items()))
+        self._remove_key(tuple(sorted(labels.items())))
+
+    def _remove_key(self, key: tuple) -> None:
         with self._lock:
             self._fns.pop(key, None)
             self._values.pop(key, None)
@@ -110,13 +125,34 @@ class Gauge:
     def collect(self) -> str:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._lock:
-            values = dict(sorted(self._values.items()))
+            values = dict(self._values)
             fns = list(self._fns.items())
+        sampled: "dict[tuple, float]" = {}
         for key, fn in fns:
             try:
-                values[key] = float(fn())
+                raw = fn()
+                value = None if raw is None else float(raw)
             except Exception:
-                pass
+                # Count the failure and fall through to the stored
+                # last-good sample (if any): a broken sampler shows up in
+                # sample_errors_total instead of vanishing.
+                if METRIC_SAMPLE_ERRORS is not None:
+                    METRIC_SAMPLE_ERRORS.inc(metric=self.name)
+                continue
+            if value is None:
+                # The sampler's owner is gone: retire fn + series.
+                self._remove_key(key)
+                values.pop(key, None)
+                continue
+            sampled[key] = value
+        if sampled:
+            values.update(sampled)
+            with self._lock:
+                # Remember the good samples so a later callback failure
+                # re-exposes them instead of dropping the series.
+                for key, v in sampled.items():
+                    if key in self._fns:  # not retired meanwhile
+                        self._values[key] = v
         for key, v in sorted(values.items()) or [((), 0.0)]:
             out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
         return "\n".join(out)
@@ -323,13 +359,59 @@ SERVE_PREFILL_TOKENS = REGISTRY.counter(
 )
 # TTFT = submit -> first generated token, queue wait included (that IS the
 # user-visible latency under load).  Sub-5ms buckets matter: a prefix hit
-# turns a multi-window prefill into a copy + one window.
+# turns a multi-window prefill into a copy + one window.  The tail extends
+# to 30s: under saturation TTFT is dominated by queue wait, and a request
+# parked behind a full batch legitimately waits tens of seconds.
 SERVE_TTFT_SECONDS = REGISTRY.histogram(
     "tpu_dra_serve_ttft_seconds",
     "Serve-engine time to first token per request (submit to first "
     "generated token, queue wait included)",
-    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-             1.0, 2.5, 5.0, 10.0),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+)
+# Inter-token latency (TPOT).  DEFAULT_BUCKETS bottom out at 5ms — useless
+# here: a healthy decode step is sub-millisecond on real silicon, so the
+# edges start at 0.2ms and stay sub-second-dense (the whole distribution
+# lives there; anything past 1s is a stall, not a latency).
+SERVE_TPOT_SECONDS = REGISTRY.histogram(
+    "tpu_dra_serve_tpot_seconds",
+    "Serve-engine inter-token latency per generated token after the first "
+    "(time-per-output-token; host arrival gaps, steps_per_tick fusion "
+    "attributes a fused batch's gap to its first token)",
+    buckets=(0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+             0.1, 0.25, 0.5, 1.0),
+)
+# Queue wait = submit -> admission into a batch row.  Near-zero when the
+# engine has free slots, unbounded under saturation — so the edges span
+# sub-ms (idle) through a minute (badly overcommitted).
+SERVE_QUEUE_WAIT_SECONDS = REGISTRY.histogram(
+    "tpu_dra_serve_queue_wait_seconds",
+    "Serve-engine queue wait per request (submit to admission into a "
+    "batch row)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+)
+# SLO/goodput accounting: per-request verdicts against the engine's
+# configured TTFT/TPOT targets (ServeEngine ttft_slo_s / tpot_slo_s).
+# goodput = rate(slo="request", verdict="met") / rate(slo="request").
+SERVE_SLO_TOTAL = REGISTRY.counter(
+    "tpu_dra_serve_slo_total",
+    "Serve-engine SLO verdicts per finished request: slo is ttft, tpot, "
+    "or request (every configured target met), verdict is met or missed",
+)
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "tpu_dra_serve_queue_depth",
+    "Requests waiting for a batch row, per engine (sampled at scrape)",
+)
+SERVE_BATCH_OCCUPANCY = REGISTRY.gauge(
+    "tpu_dra_serve_batch_occupancy",
+    "Batch rows mid-decode, per engine (sampled at scrape; compare with "
+    "the engine's slots for utilization)",
+)
+METRIC_SAMPLE_ERRORS = REGISTRY.counter(
+    "tpu_dra_metric_sample_errors_total",
+    "Gauge set_function callbacks that raised at scrape time, by metric "
+    "name (the series re-exposes its last good sample)",
 )
 
 
@@ -451,6 +533,8 @@ class MetricsServer:
                         self._send_traces(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/decisions":
                         self._send_decisions(parse_qs(parsed.query))
+                    elif parsed.path == f"{outer.pprof_path}/engine":
+                        self._send_engine(parse_qs(parsed.query))
                     else:
                         self._send(404, "not found\n")
                 except _BadQuery as e:
@@ -518,6 +602,42 @@ class MetricsServer:
                                 "dropped": decisions.RECORDER.dropped,
                                 "recorded": decisions.RECORDER.recorded,
                                 "summary": decisions.summarize(records),
+                            }
+                        ),
+                        "application/json",
+                    )
+
+            def _send_engine(self, query: dict) -> None:
+                # Local import, like its siblings — and servestats lives in
+                # utils (jax-free) precisely so this endpoint never drags
+                # the compute stack into a control-plane binary.
+                from tpu_dra.utils import servestats
+
+                limit = _query_int(
+                    query, "limit", 256, cap=servestats.RECORDER.capacity
+                )
+                fmt = query.get("format", ["json"])[0]
+                if fmt not in ("json", "text"):
+                    raise _BadQuery(
+                        f"format must be json or text, got {fmt!r}"
+                    )
+                records = servestats.RECORDER.query(
+                    engine=query.get("engine", [""])[0] or None,
+                    limit=limit,
+                )
+                if fmt == "text":
+                    self._send(200, servestats.render_text(records))
+                else:
+                    import json
+
+                    self._send(
+                        200,
+                        json.dumps(
+                            {
+                                "steps": [r.to_dict() for r in records],
+                                "dropped": servestats.RECORDER.dropped,
+                                "recorded": servestats.RECORDER.recorded,
+                                "summary": servestats.summarize(records),
                             }
                         ),
                         "application/json",

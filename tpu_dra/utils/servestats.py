@@ -1,0 +1,219 @@
+"""Serve-engine step flight recorder — "why was my request slow?".
+
+The continuous-batching engine (parallel/serve.py) makes all of its
+decisions host-side between device steps: who admits, who finishes, how
+deep the queue is, how full the batch is.  Like the scheduler's fan-out
+before controller/decisions.py, those decisions historically evaporated —
+a slow request could be queue wait, a cold admission prefill, or a
+starved batch, and nothing distinguished them after the fact.
+
+This module is the serving analog of the placement-decision recorder:
+
+- ``StepRecord``           — one engine ``tick()``: batch occupancy,
+  queue depth, admissions (and how many were prefix hits), completions,
+  tokens emitted, step wall time, cumulative SLO verdict counts.
+- ``EngineFlightRecorder`` — lock-protected bounded ring of StepRecords
+  with a dropped counter (the controller FlightRecorder shape), queried
+  by the MetricsServer's ``/debug/engine`` endpoint and the
+  ``tpudra serve-stats`` CLI.
+- ``summarize``            — windowed aggregates (occupancy, queue
+  depth, tokens/s, step-time percentiles, goodput) computed from the
+  ring, so one snapshot answers "is the engine starved, saturated, or
+  missing its SLOs?".
+
+It lives in ``utils`` (not ``parallel``) deliberately: the module is
+pure host-side bookkeeping with no jax dependency, so ``/debug/engine``
+can be served from any binary without dragging the compute stack into a
+control-plane process the way ``import tpu_dra.parallel`` would.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class StepRecord:
+    """One engine ``tick()``: the between-device-steps control state."""
+
+    seq: int = 0  # recorder-assigned, monotonic per process
+    ts_unix: float = 0.0
+    engine: str = ""  # ServeEngine.name — one recorder serves many engines
+    occupancy: int = 0  # rows mid-decode after this tick's admissions
+    slots: int = 0  # the engine's compiled batch width
+    queue_depth: int = 0  # requests still waiting after admissions
+    admitted: int = 0  # requests admitted this tick
+    prefix_hits: int = 0  # of those, admissions that reused a resident prefix
+    finished: int = 0  # requests completed this tick
+    tokens: int = 0  # tokens emitted this tick (all rows)
+    step_wall_s: float = 0.0  # host wall time of the whole tick
+    # Cumulative per-engine SLO verdicts at record time (finished requests
+    # with every configured SLO met vs any missed) — cumulative, not
+    # per-tick, so goodput survives ring eviction.
+    slo_met: int = 0
+    slo_missed: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "ts_unix": self.ts_unix,
+            "engine": self.engine,
+            "occupancy": self.occupancy,
+            "slots": self.slots,
+            "queue_depth": self.queue_depth,
+            "admitted": self.admitted,
+            "prefix_hits": self.prefix_hits,
+            "finished": self.finished,
+            "tokens": self.tokens,
+            "step_wall_s": self.step_wall_s,
+            "slo_met": self.slo_met,
+            "slo_missed": self.slo_missed,
+        }
+
+
+DEFAULT_CAPACITY = 4096
+
+
+class EngineFlightRecorder:
+    """Bounded, lock-protected ring buffer of StepRecords.
+
+    The controller FlightRecorder contract: at capacity the oldest record
+    is evicted and ``dropped`` moves, so a consumer can tell a quiet
+    engine from a recorder that wrapped."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._records: "collections.deque[StepRecord]" = collections.deque(
+            maxlen=capacity
+        )
+        self._seq = 0
+        self._dropped = 0
+
+    def record(self, rec: StepRecord) -> StepRecord:
+        if not rec.ts_unix:
+            rec.ts_unix = time.time()
+        with self._lock:
+            self._seq += 1
+            rec.seq = self._seq
+            if len(self._records) == self.capacity:
+                self._dropped += 1  # append below evicts the oldest
+            self._records.append(rec)
+        return rec
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    @property
+    def recorded(self) -> int:
+        """Total records ever recorded (monotonic, survives eviction)."""
+        with self._lock:
+            return self._seq
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._dropped = 0
+
+    def query(
+        self,
+        engine: "str | None" = None,
+        limit: "int | None" = None,
+    ) -> "list[StepRecord]":
+        """Oldest-first snapshot, optionally one engine's; ``limit`` keeps
+        the most recent N after filtering."""
+        with self._lock:
+            out = list(self._records)
+        if engine:
+            out = [r for r in out if r.engine == engine]
+        if limit is not None and limit < len(out):
+            out = out[len(out) - limit:]
+        return out
+
+
+# The process-wide recorder, shared like trace.EXPORTER and
+# decisions.RECORDER: engines write it, /debug/engine reads it.
+RECORDER = EngineFlightRecorder()
+
+
+def _pctl(sorted_vals: "list[float]", q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[int(q * (len(sorted_vals) - 1))]
+
+
+def summarize(records: "list[StepRecord]") -> dict:
+    """Windowed aggregates over the given records (one engine's, or the
+    mixed stream): utilization, throughput, step-time percentiles, and
+    goodput from the latest cumulative SLO counts per engine."""
+    if not records:
+        return {"ticks": 0}
+    walls = sorted(r.step_wall_s for r in records)
+    tokens = sum(r.tokens for r in records)
+    wall = sum(walls)
+    # Cumulative SLO counts: the LAST record per engine carries the
+    # engine's running totals.
+    last_per_engine: "dict[str, StepRecord]" = {}
+    for r in records:
+        last_per_engine[r.engine] = r
+    met = sum(r.slo_met for r in last_per_engine.values())
+    missed = sum(r.slo_missed for r in last_per_engine.values())
+    out = {
+        "ticks": len(records),
+        "engines": sorted(last_per_engine),
+        "admitted": sum(r.admitted for r in records),
+        "prefix_hits": sum(r.prefix_hits for r in records),
+        "finished": sum(r.finished for r in records),
+        "tokens": tokens,
+        "tokens_per_s": round(tokens / wall, 1) if wall > 0 else 0.0,
+        "occupancy_mean": round(
+            sum(r.occupancy for r in records) / len(records), 2
+        ),
+        "queue_depth_max": max(r.queue_depth for r in records),
+        "step_wall_p50_s": round(_pctl(walls, 0.5), 6),
+        "step_wall_p95_s": round(_pctl(walls, 0.95), 6),
+        "slo_met": met,
+        "slo_missed": missed,
+    }
+    if met + missed:
+        out["goodput"] = round(met / (met + missed), 3)
+    return out
+
+
+def render_text(records: "list[StepRecord]") -> str:
+    """Plain-text snapshot: the summary line plus one row per tick,
+    newest last (the ``format=text`` form of ``/debug/engine``)."""
+    if not records:
+        return "no engine steps recorded\n"
+    s = summarize(records)
+    head = (
+        f"{s['ticks']} tick(s), {s['admitted']} admitted "
+        f"({s['prefix_hits']} prefix hit(s)), {s['finished']} finished, "
+        f"{s['tokens']} token(s) @ {s['tokens_per_s']}/s, "
+        f"occupancy mean {s['occupancy_mean']}, "
+        f"queue max {s['queue_depth_max']}, "
+        f"step p50 {s['step_wall_p50_s'] * 1e3:.2f}ms "
+        f"p95 {s['step_wall_p95_s'] * 1e3:.2f}ms"
+    )
+    if "goodput" in s:
+        head += (
+            f", goodput {s['goodput']} "
+            f"({s['slo_met']} met / {s['slo_missed']} missed)"
+        )
+    out = [head]
+    out.append(
+        f"{'seq':>6} {'engine':<12} {'occ':>5} {'queue':>5} {'adm':>4} "
+        f"{'hit':>4} {'fin':>4} {'tok':>5} {'wall_ms':>8}"
+    )
+    for r in records:
+        out.append(
+            f"{r.seq:>6} {r.engine:<12} {r.occupancy:>3}/{r.slots:<1} "
+            f"{r.queue_depth:>5} {r.admitted:>4} {r.prefix_hits:>4} "
+            f"{r.finished:>4} {r.tokens:>5} {r.step_wall_s * 1e3:>8.2f}"
+        )
+    return "\n".join(out) + "\n"

@@ -301,6 +301,61 @@ def span(
     return Span(name, parent=parent, exporter=exporter, **attributes)
 
 
+def emit_span(
+    name: str,
+    *,
+    start_unix_s: float,
+    duration_s: float,
+    context: "TraceContext | None" = None,
+    parent: "TraceContext | None" = None,
+    exporter: "SpanExporter | None" = None,
+    status: str = "OK",
+    status_message: str = "",
+    **attributes,
+) -> TraceContext:
+    """Export a RETROACTIVELY-timed span — measured boundaries, no ``with``
+    block.
+
+    The serve engine needs this shape: a request's queue span runs from
+    ``submit()`` to its admission many ``tick()`` calls later, across
+    other requests' work — there is no lexical block to wrap, only two
+    timestamps the engine already holds.  ``context`` fixes the span's own
+    identity (pass the request's root TraceContext to make this span the
+    trace root); otherwise the span is a child of ``parent`` (fresh trace
+    when neither is given).  Returns the span's context so callers can
+    parent further spans under it.
+
+    Same exit contract as ``Span.__exit__``: the record lands in the ring
+    exporter and moves the span counter/duration metrics, so retro spans
+    and ``with`` spans are indistinguishable to ``/debug/traces``."""
+    if context is not None:
+        ctx, parent_id = context, ""
+    elif parent is not None:
+        ctx, parent_id = parent.child(), parent.span_id
+    else:
+        ctx, parent_id = TraceContext.new(), ""
+    record = {
+        "name": name,
+        "trace_id": ctx.trace_id,
+        "span_id": ctx.span_id,
+        "parent_id": parent_id,
+        "component": _COMPONENT,
+        "thread": threading.current_thread().name,
+        "start_unix_s": start_unix_s,
+        "duration_s": duration_s,
+        "status": status,
+        "status_message": status_message,
+        "attributes": {k: v for k, v in attributes.items() if v is not None},
+        "events": [],
+    }
+    (exporter or EXPORTER).export(record)
+    from tpu_dra.utils.metrics import SPAN_SECONDS, TRACE_SPANS_TOTAL
+
+    TRACE_SPANS_TOTAL.inc(name=name, status=status)
+    SPAN_SECONDS.observe(duration_s, name=name)
+    return ctx
+
+
 # -- exporter -----------------------------------------------------------------
 
 DEFAULT_CAPACITY = 4096
