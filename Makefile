@@ -8,7 +8,7 @@ TAG     ?= latest
 
 .PHONY: all test lint generate-crds check-generate native native-test \
         demo-quickstart bench image clean help observability-smoke \
-        perf-smoke explain-smoke serve-smoke serve-obs-smoke
+        perf-smoke explain-smoke serve-smoke serve-obs-smoke chaos-smoke
 
 all: lint test
 
@@ -79,6 +79,15 @@ serve-smoke:
 serve-obs-smoke:
 	$(PYTHON) -m pytest tests/test_serve_obs_smoke.py -q -m 'not slow'
 
+# Fast seeded CPU-only recovery floor: one scripted node kill must
+# re-place the claim on the survivor with a recorded NodeNotReady
+# eviction (flight recorder + metrics), and the revived node must come
+# back Ready drained (docs/RESILIENCE.md).  The full mixed train+serve
+# fault schedule is `bench.py` stanza "chaos"; the long soak is
+# tests/test_chaos.py (slow-marked).
+chaos-smoke:
+	$(PYTHON) -m pytest tests/test_chaos_smoke.py -q -m 'not slow'
+
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
@@ -90,4 +99,5 @@ clean:
 help:
 	@echo "targets: test lint generate-crds check-generate native native-test"
 	@echo "         demo-quickstart bench observability-smoke perf-smoke"
-	@echo "         explain-smoke serve-smoke serve-obs-smoke image clean"
+	@echo "         explain-smoke serve-smoke serve-obs-smoke chaos-smoke"
+	@echo "         image clean"

@@ -1414,6 +1414,337 @@ def bench_serve_prefix(timeout_s: float = 300.0) -> "dict":
         return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
 
+_CHAOS_CHILD = r"""
+import json
+import statistics
+import tempfile
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+SEED = 42
+out = {"platform": "cpu", "seed": SEED}
+
+
+def emit():
+    print("BENCHJSON:" + json.dumps(out), flush=True)
+
+
+def pctl(vals, q):
+    s = sorted(vals)
+    return s[int(q * (len(s) - 1))] if s else 0.0
+
+
+# ---- Part A: control plane — gang re-placement under seeded node kills ----
+from tpu_dra.api.k8s import (
+    Pod, PodResourceClaim, PodResourceClaimSource, PodSpec,
+    ResourceClaimParametersReference, ResourceClaimSpec,
+    ResourceClaimTemplate, ResourceClaimTemplateSpec, ResourceClass,
+)
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.tpu_v1alpha1 import (
+    GROUP_NAME, GangConfig, TpuClaimParameters, TpuClaimParametersSpec,
+)
+from tpu_dra.client.apiserver import FakeApiServer
+from tpu_dra.controller import decisions
+from tpu_dra.sim import SimCluster
+from tpu_dra.sim.faults import (
+    KILL_NODE, OUTAGE_END, OUTAGE_START, REVIVE_NODE, ChaosPlan,
+    FlakyApiServer,
+)
+
+NS, DRIVER_NS = "default", "tpu-dra"
+GANG = 3
+
+
+def gang_members(cluster):
+    members = {}
+    for nas in cluster.clientset.node_allocation_states(DRIVER_NS).list():
+        for uid, alloc in nas.spec.allocated_claims.items():
+            if alloc.tpu is not None and alloc.tpu.gang is not None:
+                members[uid] = (
+                    nas.metadata.name, alloc.tpu.gang.rank,
+                    alloc.tpu.gang.coordinator, nas.status,
+                )
+    return members
+
+
+def wait_reformed(cluster, excluded, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        m = gang_members(cluster)
+        pods_ok = True
+        try:
+            for i in range(GANG):
+                pod = cluster.clientset.pods(NS).get(f"worker-{i}")
+                if pod.status.phase != "Running" or pod.spec.node_name == excluded:
+                    pods_ok = False
+        except Exception:
+            pods_ok = False
+        if (
+            pods_ok
+            and len(m) == GANG
+            and excluded not in {v[0] for v in m.values()}
+            and sorted(v[1] for v in m.values()) == list(range(GANG))
+            and len({v[2] for v in m.values()}) == 1
+        ):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+tmp = tempfile.mkdtemp()
+flaky = FlakyApiServer(FakeApiServer(), seed=SEED)
+cluster = SimCluster(
+    tmp, nodes=4, mesh="2x1x1", multihost_slice=True,
+    recreate_evicted=True, server=flaky,
+)
+cluster.start()
+cluster.clientset.resource_classes().create(ResourceClass(
+    metadata=ObjectMeta(name="tpu.google.com"), driver_name=GROUP_NAME
+))
+cluster.clientset.tpu_claim_parameters(NS).create(TpuClaimParameters(
+    metadata=ObjectMeta(name="gang-member", namespace=NS),
+    spec=TpuClaimParametersSpec(
+        count=2, gang=GangConfig(name="ring", size=GANG, port=8476)
+    ),
+))
+cluster.clientset.resource_claim_templates(NS).create(ResourceClaimTemplate(
+    metadata=ObjectMeta(name="gang-template", namespace=NS),
+    spec=ResourceClaimTemplateSpec(spec=ResourceClaimSpec(
+        resource_class_name="tpu.google.com",
+        parameters_ref=ResourceClaimParametersReference(
+            api_group=GROUP_NAME, kind="TpuClaimParameters",
+            name="gang-member",
+        ),
+    )),
+))
+for i in range(GANG):
+    cluster.clientset.pods(NS).create(Pod(
+        metadata=ObjectMeta(name=f"worker-{i}", namespace=NS),
+        spec=PodSpec(resource_claims=[PodResourceClaim(
+            name="tpu",
+            source=PodResourceClaimSource(
+                resource_claim_template_name="gang-template"
+            ),
+        )]),
+    ))
+for i in range(GANG):
+    cluster.wait_for_pod_running(NS, f"worker-{i}", timeout=120)
+
+# The seeded fault schedule.  Kill victims are remapped at fire time onto
+# a node currently hosting a gang member (a seeded kill of the one idle
+# spare would measure nothing); the remap is reported.
+plan = ChaosPlan.seeded(
+    SEED, [n.name for n in cluster.nodes], kills=2, horizon_s=1.0,
+    down_s=0.4, outages=1, outage_s=0.2, min_survivors=3,
+)
+recoveries, killed, remap = [], [], {}
+try:
+    for ev in plan.events:
+        if ev.action == OUTAGE_START:
+            flaky.pause()
+        elif ev.action == OUTAGE_END:
+            flaky.resume()
+        elif ev.action == KILL_NODE:
+            occupied = {v[0] for v in gang_members(cluster).values()}
+            victim = ev.target if ev.target in occupied else sorted(occupied)[0]
+            remap[ev.target] = victim
+            killed.append(victim)
+            t0 = time.monotonic()
+            cluster.kill_node(victim)
+            assert wait_reformed(cluster, victim, timeout=120), (
+                f"gang never re-formed after killing {victim}"
+            )
+            recoveries.append(time.monotonic() - t0)
+        elif ev.action == REVIVE_NODE:
+            cluster.revive_node(remap.get(ev.target, ev.target))
+            time.sleep(0.1)
+    evictions = [
+        r for r in decisions.RECORDER.query()
+        if r.verdict == decisions.EVICTED
+    ]
+    every_kill_recorded = all(
+        any(
+            r.node == v and r.reason == decisions.ReasonCode.NODE_NOT_READY
+            for r in evictions
+        )
+        for v in killed
+    )
+    out["control_plane"] = {
+        "nodes": 4, "gang_size": GANG, "kills": len(killed),
+        "recovery_p50_s": round(pctl(recoveries, 0.5), 3),
+        "recovery_p95_s": round(pctl(recoveries, 0.95), 3),
+        "evictions_recorded": len(evictions),
+        "every_kill_recorded": every_kill_recorded,
+        "victim_remap": remap,
+        "faults_injected": flaky.faults_injected,
+        "fault_breakdown": flaky.fault_breakdown(),
+        "plan": plan.to_dict(),
+        "ok": every_kill_recorded and bool(recoveries),
+    }
+finally:
+    flaky.resume()
+    cluster.stop()
+emit()
+
+# ---- Part B: elastic training — resume on a resized mesh ----
+import numpy as np
+
+from tpu_dra.parallel import ckpt
+from tpu_dra.parallel.burnin import BurninConfig
+from tpu_dra.parallel.mesh import logical_mesh
+
+TRAIN = BurninConfig(
+    n_layers=1, seq=32, d_model=32, d_ff=64, n_heads=4, batch=8, vocab=64
+)
+mesh8 = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+mesh4 = logical_mesh(jax.devices()[:4], data=1, fsdp=2, model=2)
+root = tempfile.mkdtemp()
+_, full = ckpt.train_with_resume(
+    TRAIN, mesh8, root + "/full", steps=4, save_every=100
+)
+_, before = ckpt.train_with_resume(
+    TRAIN, mesh8, root + "/elastic", steps=3, save_every=1
+)
+t0 = time.monotonic()
+final, after = ckpt.train_with_resume(
+    TRAIN, mesh4, root + "/elastic", steps=1, save_every=1
+)
+resume_wall = time.monotonic() - t0
+continuity = bool(
+    np.allclose(before, full[:3], rtol=1e-5, atol=1e-6)
+    and np.allclose(after, full[3:4], rtol=2e-3, atol=1e-4)
+)
+out["elastic_train"] = {
+    "devices_before": 8, "devices_after": 4,
+    "resumed_from_step": 3, "final_step": final,
+    "loss_continuity_ok": continuity,
+    "resume_wall_s": round(resume_wall, 3),
+    "ok": continuity and final == 4,
+}
+emit()
+
+# ---- Part C: warm serve-engine restart + goodput under chaos ----
+from tpu_dra.parallel.burnin import init_params
+from tpu_dra.parallel.serve import ServeEngine
+
+SRV = BurninConfig(
+    vocab=128, d_model=64, n_heads=4, d_ff=128, n_layers=2, seq=96, batch=2
+)
+params = init_params(SRV)
+SYSTEM = [int(x) for x in jax.random.randint(
+    jax.random.PRNGKey(3), (48,), 0, SRV.vocab
+)]
+REQS = [
+    SYSTEM + [int(x) for x in jax.random.randint(
+        jax.random.PRNGKey(100 + i), (8,), 0, SRV.vocab)]
+    for i in range(10)
+]
+MAX_NEW = 4
+
+
+def new_engine(name):
+    return ServeEngine(
+        params, SRV, slots=2, prompt_slots=64, max_new_cap=MAX_NEW,
+        prefix_cache_slots=8, prefix_window=16,
+        ttft_slo_s=5.0, tpot_slo_s=2.0, name=name,
+    )
+
+
+t_wall0 = time.monotonic()
+pre = new_engine("chaos-pre")
+for p in REQS[:5]:
+    pre.submit(p, MAX_NEW)
+done_pre = pre.run()
+index = pre.export_prefix_index()
+pre.close()  # the kill
+t_gap0 = time.monotonic()
+warm = new_engine("chaos-warm")
+warmed = warm.warm_start(index)
+restart_gap = time.monotonic() - t_gap0
+hits0 = warm.prefix_stats["hits"]
+for p in REQS[5:]:
+    warm.submit(p, MAX_NEW)
+done_warm = warm.run()
+total_wall = time.monotonic() - t_wall0
+warm.close()
+
+cold = new_engine("chaos-cold")
+for p in REQS:
+    cold.submit(p, MAX_NEW)
+done_cold = cold.run()
+cold.close()
+
+chaos_tokens = [tuple(r.tokens) for r in done_pre + done_warm]
+cold_tokens = [tuple(r.tokens) for r in done_cold]
+token_identical = chaos_tokens == cold_tokens
+finished = done_pre + done_warm
+met = [r for r in finished if r.slo.get("request") == "met"]
+met_tokens = sum(len(r.tokens) for r in met)
+warm_hits = warm.prefix_stats["hits"] - hits0
+out["warm_serve"] = {
+    "requests": len(REQS),
+    "warmed_prefixes": warmed,
+    "restart_gap_s": round(restart_gap, 3),
+    "token_identical": token_identical,
+    "warm_first_wave_hits": warm_hits,
+    "slo_met_requests": len(met),
+    # Goodput under chaos: SLO-met tokens / wall time over the WHOLE
+    # timeline — pre-kill serving, the restart gap, and the warm engine
+    # (the PR-5 goodput verdicts re-cut as a chaos metric).
+    "goodput_tokens_per_s": round(met_tokens / max(1e-9, total_wall), 1),
+    "wall_s": round(total_wall, 3),
+    "ok": token_identical and warmed > 0 and warm_hits >= len(REQS) - 5,
+}
+out["recovery_p50_s"] = out["control_plane"]["recovery_p50_s"]
+out["recovery_p95_s"] = out["control_plane"]["recovery_p95_s"]
+out["goodput_under_chaos_tokens_per_s"] = out["warm_serve"][
+    "goodput_tokens_per_s"
+]
+out["ok"] = bool(
+    out["control_plane"]["ok"]
+    and out["elastic_train"]["ok"]
+    and out["warm_serve"]["ok"]
+)
+emit()
+"""
+
+
+def bench_chaos(timeout_s: float = 420.0) -> "dict":
+    """Chaos stanza (ISSUE 6): a mixed train+serve workload under a seeded
+    ChaosPlan, all three planes exercised by the same fault schedule —
+    (A) a 3-member gang on kubesim re-places through two scripted node
+    kills + an apiserver outage (recovery p50/p95, every kill leaving a
+    recorded NodeNotReady eviction), (B) training resumes from the latest
+    complete checkpoint on a mesh HALF the size with loss continuity,
+    (C) a killed serve engine restarts warm from its checkpointed radix
+    index, token-identical to a cold engine, with goodput-under-chaos
+    (SLO-met tokens / wall time across the kill) as the headline metric.
+    CPU-pinned in a killable child on an 8-virtual-device mesh (the
+    elastic half needs devices to resize across)."""
+    import re
+    import subprocess
+
+    env = _seed_pythonpath(dict(os.environ))
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            env.get("XLA_FLAGS", ""),
+        ).strip()
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    try:
+        return _run_bench_child(_CHAOS_CHILD, env, timeout_s, empty_result={})
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"exceeded {timeout_s:.0f}s"}
+    except Exception as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
 def bench_northstar_mesh(timeout_s: float = 420.0) -> "dict":
     """Compile + execute the full dp x fsdp x tp x ep composition on a
     64-virtual-device CPU mesh (the BASELINE v5e-256 north-star shape at
@@ -1603,6 +1934,7 @@ def main() -> int:
         wire = {"ok": False, "error": f"{type(e).__name__}: {e}"}
     northstar = bench_northstar_mesh()
     serve_prefix = bench_serve_prefix()
+    chaos = bench_chaos()
     p50 = alloc["p50_s"]
     line = {
         "metric": "claim_to_pod_running_p50",
@@ -1634,6 +1966,11 @@ def main() -> int:
             # stream, TTFT/tokens-per-s/hit-rate cache-off vs cache-on
             # (greedy outputs asserted identical inside the stanza).
             "serve_prefix": serve_prefix,
+            # Goodput under chaos: gang re-placement recovery p50/p95
+            # through seeded node kills, elastic resume on a halved mesh,
+            # and warm serve-engine restart (docs/RESILIENCE.md) — the
+            # recovery floor later PRs must not regress.
+            "chaos": chaos,
             "compute": compute,
         },
     }

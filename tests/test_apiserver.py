@@ -135,6 +135,85 @@ class TestOptimisticConcurrency:
             retry_on_conflict(always_stale, steps=3)
 
 
+class TestRetryOnUnavailable:
+    """client/retry.py retry_on_unavailable: capped exponential backoff +
+    full jitter for 503-class ApiErrors — the OUTAGE retry family,
+    distinct from the constant-base conflict loop."""
+
+    def test_retries_503_until_success(self):
+        from tpu_dra.client.retry import retry_on_unavailable
+        from tpu_dra.sim.faults import UnavailableError
+
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise UnavailableError("down")
+            return "up"
+
+        assert (
+            retry_on_unavailable(flaky, steps=5, base_s=0.001, cap_s=0.01)
+            == "up"
+        )
+        assert len(calls) == 3
+
+    def test_does_not_retry_client_errors(self):
+        from tpu_dra.client.apiserver import NotFoundError
+        from tpu_dra.client.retry import retry_on_unavailable
+
+        calls = []
+
+        def missing():
+            calls.append(1)
+            raise NotFoundError("nope")
+
+        with pytest.raises(NotFoundError):
+            retry_on_unavailable(missing, steps=5, base_s=0.001)
+        assert len(calls) == 1, "4xx must never be retried as unavailability"
+
+    def test_does_not_swallow_conflicts(self):
+        from tpu_dra.client.retry import retry_on_unavailable
+
+        def conflicted():
+            raise ConflictError("race")
+
+        with pytest.raises(ConflictError):
+            retry_on_unavailable(conflicted, steps=5, base_s=0.001)
+
+    def test_exhaustion_raises_last_error(self):
+        from tpu_dra.client.retry import retry_on_unavailable
+        from tpu_dra.sim.faults import UnavailableError
+
+        calls = []
+
+        def down():
+            calls.append(1)
+            raise UnavailableError("still down")
+
+        with pytest.raises(UnavailableError):
+            retry_on_unavailable(down, steps=4, base_s=0.001, cap_s=0.005)
+        assert len(calls) == 4
+
+    def test_backoff_is_capped_exponential_with_full_jitter(self):
+        import random
+
+        from tpu_dra.client.retry import backoff_s
+
+        rng = random.Random(0)
+        for attempt in range(10):
+            ceiling = min(2.0, 0.05 * (2 ** attempt))
+            for _ in range(20):
+                d = backoff_s(attempt, base_s=0.05, cap_s=2.0, rng=rng)
+                assert 0.0 <= d <= ceiling
+        # Full jitter: draws differ (not a constant backoff in disguise).
+        draws = {
+            round(backoff_s(5, base_s=0.05, cap_s=2.0, rng=rng), 6)
+            for _ in range(10)
+        }
+        assert len(draws) > 1
+
+
 class TestStatusSubresource:
     def test_update_status_keeps_spec(self, server):
         obj = server.create(

@@ -36,6 +36,13 @@ def test_bench_emits_one_json_line(monkeypatch):
         "bench_serve_prefix",
         lambda: {"ok": True, "prefix_hit_rate": 1.0, "stubbed": True},
     )
+    # And the chaos child (kubesim gang kills + two training meshes +
+    # three engines); its own coverage is test_bench_chaos_stanza.
+    monkeypatch.setattr(
+        bench,
+        "bench_chaos",
+        lambda: {"ok": True, "recovery_p95_s": 0.0, "stubbed": True},
+    )
     import io
     from contextlib import redirect_stdout
 
@@ -52,7 +59,7 @@ def test_bench_emits_one_json_line(monkeypatch):
     extras = parsed["extras"]
     assert {
         "rung", "target_s", "fleet", "wire", "northstar_mesh",
-        "serve_prefix", "compute",
+        "serve_prefix", "chaos", "compute",
     } <= extras.keys()
     assert extras["fleet"]["target_met"]
     assert extras["wire"]["target_met"]
@@ -97,6 +104,32 @@ def test_bench_serve_prefix_stanza():
     tel = out["telemetry"]
     assert {"tokens_per_s_on", "tokens_per_s_off", "ratio"} <= tel.keys()
     assert tel["within_noise"], tel
+
+
+@pytest.mark.slow
+def test_bench_chaos_stanza():
+    """The chaos stanza (ISSUE 6): recovery percentiles and goodput-under
+    -chaos are reported, and the three acceptance assertions hold inside
+    the child — every killed node's claims re-placed with a recorded
+    NodeNotReady reason, elastic resume with loss continuity on the
+    halved mesh, and warm-restart greedy outputs token-identical to a
+    cold engine."""
+    import bench
+
+    out = bench.bench_chaos()
+    assert out.get("ok"), out
+    assert "recovery_p95_s" in out and out["recovery_p95_s"] > 0
+    assert "goodput_under_chaos_tokens_per_s" in out
+    cp = out["control_plane"]
+    assert cp["every_kill_recorded"] and cp["kills"] >= 1
+    assert cp["faults_injected"] > 0
+    assert out["elastic_train"]["loss_continuity_ok"]
+    assert out["elastic_train"]["devices_after"] < out["elastic_train"][
+        "devices_before"
+    ]
+    ws = out["warm_serve"]
+    assert ws["token_identical"] and ws["warmed_prefixes"] > 0
+    assert ws["goodput_tokens_per_s"] > 0
 
 
 def test_bench_fanout_scale_small():

@@ -1,0 +1,97 @@
+"""`make chaos-smoke`: the fast, seeded, CPU-only recovery floor.
+
+One scripted node kill under a running claim must drive the whole
+recovery story end to end (docs/RESILIENCE.md):
+
+- the claim re-places on the surviving node and its pod runs again,
+- the placement flight recorder carries the victim's ``evicted`` verdict
+  with reason ``NodeNotReady`` (what `tpudra explain` renders),
+- ``tpu_dra_claim_evictions_total`` and the NodeNotReady rejection series
+  appear in the metrics exposition,
+- the revived node returns Ready with its NAS drained of the old claim.
+
+Control-plane only — no engine compiles, no training — so the floor stays
+inside CI seconds; the full mixed-plane schedule lives in `bench.py
+chaos` and the slow soak in tests/test_chaos.py.
+"""
+
+import time
+
+from test_chaos import DRIVER_NS, NS, make_pod, setup_workload
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.controller import decisions
+from tpu_dra.sim import SimCluster
+from tpu_dra.utils.metrics import REGISTRY
+
+
+def test_node_kill_recovery_floor(tmp_path):
+    cluster = SimCluster(
+        str(tmp_path), nodes=2, mesh="2x2x1", recreate_evicted=True
+    )
+    cluster.start()
+    try:
+        setup_workload(cluster)
+        cluster.clientset.pods(NS).create(make_pod("smoke-victim"))
+        cluster.wait_for_pod_running(NS, "smoke-victim", timeout=60)
+        victim_node = cluster.clientset.pods(NS).get(
+            "smoke-victim"
+        ).spec.node_name
+
+        t0 = time.monotonic()
+        cluster.kill_node(victim_node)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                pod = cluster.clientset.pods(NS).get("smoke-victim")
+                if (
+                    pod.status.phase == "Running"
+                    and pod.spec.node_name != victim_node
+                ):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.05)
+        else:
+            raise AssertionError("claim never re-placed after the kill")
+        recovery_s = time.monotonic() - t0
+
+        # The victim's explanation: an evicted/NodeNotReady record in the
+        # flight recorder, rendered the way `tpudra explain` shows it.
+        evicted = [
+            r
+            for r in decisions.RECORDER.query(node=victim_node)
+            if r.verdict == decisions.EVICTED
+        ]
+        assert evicted, "no eviction record for the killed node"
+        assert all(
+            r.reason == decisions.ReasonCode.NODE_NOT_READY for r in evicted
+        )
+        rendered = decisions.render_text(
+            decisions.RECORDER.query(claim=evicted[0].claim_uid)
+        )
+        assert "evicted" in rendered and "NodeNotReady" in rendered
+
+        # Metrics floor: the eviction counter and reason series moved.
+        text = REGISTRY.expose()
+        assert "tpu_dra_claim_evictions_total" in text
+        assert 'reason="NodeNotReady"' in text
+
+        # Revive: the node returns Ready with the old claim drained.
+        cluster.revive_node(victim_node)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            nas = cluster.clientset.node_allocation_states(DRIVER_NS).get(
+                victim_node
+            )
+            if nas.status == nascrd.STATUS_READY:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("revived node never went Ready")
+        assert not nas.spec.allocated_claims
+
+        # The floor itself: seeded, in-process recovery is fast; a huge
+        # regression here means the sweep or eviction path wedged.
+        assert recovery_s < 30, f"recovery took {recovery_s:.1f}s"
+    finally:
+        cluster.stop()

@@ -1,11 +1,18 @@
 """Checkpoint/resume of the sharded burn-in state (tpu_dra/parallel/ckpt.py).
 
-The decisive property: a run preempted at step k and resumed from its
+The decisive properties: a run preempted at step k and resumed from its
 checkpoint produces the SAME losses as an uninterrupted run — on the
-sharded mesh, with arrays restored directly into the mesh shardings.
+sharded mesh, with arrays restored directly into the mesh shardings; a
+kill at ANY instant mid-save can never surface a half checkpoint
+(atomic write → fsync → rename commit, docs/RESILIENCE.md); and a gang
+re-formed on a RESIZED mesh resumes the same run with its frozen shapes
+remapped onto the new mesh (elastic resume).
 """
 
 from __future__ import annotations
+
+import os
+import shutil
 
 import pytest
 import jax
@@ -13,6 +20,8 @@ import numpy as np
 
 from tpu_dra.parallel.burnin import BurninConfig, burnin_mesh
 from tpu_dra.parallel.ckpt import (
+    COMPLETE_MARKER,
+    complete_steps,
     latest_step,
     restore_state,
     save_state,
@@ -20,6 +29,11 @@ from tpu_dra.parallel.ckpt import (
 )
 
 CFG = BurninConfig(n_layers=2, seq=64, d_model=64, d_ff=128)
+# Tiny config for the corruption/elastic tests: they run several
+# save/restore cycles and (elastic) two mesh compiles.
+SMALL = BurninConfig(
+    n_layers=1, seq=32, d_model=32, d_ff=64, n_heads=4, batch=8, vocab=64
+)
 
 
 @pytest.mark.slow
@@ -62,3 +76,114 @@ def test_restore_lands_in_mesh_shardings(tmp_path):
 
 def test_latest_step_empty(tmp_path):
     assert latest_step(tmp_path / "nope") is None
+
+
+class TestAtomicCommit:
+    """Satellite: a truncated/partial step dir must be skipped by
+    latest_step, and restore must fall back to the previous complete
+    step."""
+
+    def _save_two(self, tmp_path):
+        from tpu_dra.parallel.burnin import make_train_step
+
+        _, state = make_train_step(SMALL, None)
+        save_state(tmp_path, state, step=1)
+        save_state(tmp_path, state, step=2)
+        return state
+
+    def test_partial_dir_without_marker_is_skipped(self, tmp_path):
+        self._save_two(tmp_path)
+        # A pre-commit writer died: digit-named dir, no _COMPLETE marker.
+        os.makedirs(tmp_path / "7")
+        assert latest_step(tmp_path) == 2
+        assert complete_steps(tmp_path) == [1, 2]
+        # Tmp orphans (the mid-save state) are invisible too.
+        os.makedirs(tmp_path / ".tmp.9.deadbeef")
+        assert latest_step(tmp_path) == 2
+
+    def test_save_commits_marker_atomically(self, tmp_path):
+        self._save_two(tmp_path)
+        assert os.path.exists(tmp_path / "2" / COMPLETE_MARKER)
+        # No tmp residue after a clean commit.
+        assert not [d for d in os.listdir(tmp_path) if d.startswith(".tmp")]
+
+    def test_restore_falls_back_to_previous_complete_step(self, tmp_path):
+        state = self._save_two(tmp_path)
+        # Torn storage UNDER a surviving marker: gut step 2's payload.
+        step2 = tmp_path / "2"
+        for entry in os.listdir(step2):
+            if entry != COMPLETE_MARKER:
+                p = step2 / entry
+                shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+        restored = restore_state(tmp_path, SMALL)  # no explicit step
+        np.testing.assert_array_equal(
+            np.asarray(restored[0]["embed"]), np.asarray(state[0]["embed"])
+        )
+
+    def test_restore_raises_when_nothing_complete(self, tmp_path):
+        os.makedirs(tmp_path / "3")  # partial only
+        with pytest.raises(FileNotFoundError):
+            restore_state(tmp_path, SMALL)
+
+    def test_resave_replaces_incomplete_occupant(self, tmp_path):
+        """A marker-less (truncated) dir at a step must NOT swallow a
+        fresh save of that step — otherwise the run wedges in a
+        retrain-and-discard loop, re-reaching the step forever."""
+        from tpu_dra.parallel.burnin import make_train_step
+
+        _, state = make_train_step(SMALL, None)
+        os.makedirs(tmp_path / "1")  # truncated occupant, no marker
+        assert latest_step(tmp_path) is None
+        save_state(tmp_path, state, step=1)
+        assert latest_step(tmp_path) == 1
+        restored = restore_state(tmp_path, SMALL, step=1)
+        np.testing.assert_array_equal(
+            np.asarray(restored[0]["embed"]), np.asarray(state[0]["embed"])
+        )
+
+
+class TestElasticResume:
+    """Tentpole: resume the SAME run on a resized mesh — the frozen
+    shapes remap (data/fsdp/tp resharding) and the loss stream
+    continues."""
+
+    @pytest.mark.slow
+    def test_resume_on_shrunk_mesh_keeps_loss_continuity(self, tmp_path):
+        from tpu_dra.parallel.mesh import logical_mesh
+
+        mesh8 = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+        mesh4 = logical_mesh(jax.devices()[:4], data=1, fsdp=2, model=2)
+
+        # Uninterrupted 8-device reference.
+        _, full = train_with_resume(
+            SMALL, mesh8, tmp_path / "full", steps=5, save_every=100
+        )
+        # Preempted at step 3, gang re-forms on HALF the hosts.
+        _, first = train_with_resume(
+            SMALL, mesh8, tmp_path / "elastic", steps=3, save_every=1
+        )
+        final, second = train_with_resume(
+            SMALL, mesh4, tmp_path / "elastic", steps=2, save_every=1
+        )
+        assert final == 5
+        np.testing.assert_allclose(first, full[:3], rtol=1e-5, atol=1e-6)
+        # Cross-mesh numerics: reductions re-associate on the resized
+        # mesh, so continuity is allclose, not bit-equal.
+        np.testing.assert_allclose(second, full[3:], rtol=2e-3, atol=1e-4)
+
+    @pytest.mark.slow
+    def test_incompatible_resize_raises_up_front(self, tmp_path):
+        from tpu_dra.parallel.mesh import logical_mesh
+
+        mesh2 = logical_mesh(jax.devices()[:2], data=1, fsdp=1, model=2)
+        train_with_resume(
+            SMALL, mesh2, tmp_path / "run", steps=1, save_every=1
+        )
+        # A mesh whose axes the frozen shapes cannot divide: batch=8
+        # with data*fsdp=8 and model=1 works, but growing model to 8
+        # (n_heads=4 % 8 != 0) must be rejected, not silently re-padded.
+        mesh8 = logical_mesh(jax.devices(), data=1, fsdp=1, model=8)
+        with pytest.raises(ValueError, match="elastic resume"):
+            train_with_resume(
+                SMALL, mesh8, tmp_path / "run", steps=1, save_every=1
+            )

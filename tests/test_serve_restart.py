@@ -1,0 +1,154 @@
+"""Warm serve-engine restart (ISSUE 6 tentpole seam 3).
+
+A killed engine's prefix cache is device state and dies with it; what
+survives is the host-side radix INDEX (token runs + hit counts).  A
+restarted engine re-prefills the hottest runs from that checkpoint before
+admitting traffic, so the first post-restart wave of shared-prefix
+admissions hits — and because warming RECOMPUTES KV from the weights, the
+warm engine's greedy outputs are token-identical to the pre-kill engine's
+on the same stream (the prefix cache's exactness contract).
+
+Also pins the clean-death satellite: submit()/tick() after close() raise
+a crisp RuntimeError, never a weakref/jit AttributeError.
+"""
+
+import jax
+import pytest
+
+from tpu_dra.parallel.burnin import BurninConfig, init_params
+from tpu_dra.parallel.serve import ServeEngine
+
+CFG = BurninConfig(
+    vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=64, batch=2
+)
+PARAMS = init_params(CFG)
+SYSTEM = [int(x) for x in jax.random.randint(
+    jax.random.PRNGKey(1), (24,), 0, CFG.vocab
+)]
+REQS = [
+    SYSTEM
+    + [
+        int(x)
+        for x in jax.random.randint(jax.random.PRNGKey(10 + i), (4,), 0, CFG.vocab)
+    ]
+    for i in range(6)
+]
+
+
+def engine(**kw):
+    kw.setdefault("prefix_cache_slots", 4)
+    kw.setdefault("prefix_window", 8)
+    return ServeEngine(
+        PARAMS, CFG, slots=2, prompt_slots=32, max_new_cap=4, **kw
+    )
+
+
+def run_stream(eng):
+    for p in REQS:
+        eng.submit(p, 4)
+    return [tuple(r.tokens) for r in eng.run()]
+
+
+class TestWarmRestart:
+    def test_warm_restart_token_identical_and_first_wave_hits(self):
+        # Pre-kill engine serves the stream, then dies.
+        pre = engine(name="restart-pre")
+        tokens_pre = run_stream(pre)
+        index = pre.export_prefix_index()
+        assert index["version"] == 1
+        assert index["entries"], "serving left nothing resident"
+        assert all(
+            isinstance(e["tokens"], list) and e["hits"] >= 0
+            for e in index["entries"]
+        )
+        # Hottest first.
+        hits = [e["hits"] for e in index["entries"]]
+        assert hits == sorted(hits, reverse=True)
+        pre.close()
+
+        # Restarted engine rebuilds residency BEFORE admitting traffic.
+        warm = engine(name="restart-warm")
+        warmed = warm.warm_start(index)
+        assert warmed > 0
+        assert warm.prefix_stats["resident"] == warmed
+        base_hits = warm.prefix_stats["hits"]
+
+        tokens_warm = run_stream(warm)
+        # Greedy token identity with the pre-kill engine on the same
+        # stream: warming changes latency, never tokens.
+        assert tokens_warm == tokens_pre
+        # The whole first wave rides the warmed pool (every admission
+        # shares the system prefix, which warming made resident).
+        assert warm.prefix_stats["hits"] - base_hits >= len(REQS)
+        warm.close()
+
+    def test_warm_start_skips_stale_runs_and_respects_top_k(self):
+        eng = engine(name="restart-edge")
+        index = {
+            "version": 1,
+            "entries": [
+                {"tokens": SYSTEM, "hits": 9},
+                {"tokens": [0] * 3, "hits": 8},        # < prefix_window
+                {"tokens": [999] * 16, "hits": 7},     # out-of-vocab
+                {"tokens": [1] * 64, "hits": 6},       # > prompt_slots
+                {"tokens": [2] * 16, "hits": 5},
+                {"tokens": [3] * 16, "hits": 4},
+            ],
+        }
+        assert eng.warm_start(index, top_k=2) == 2
+        assert eng.prefix_stats["resident"] == 2
+        eng.close()
+
+    def test_warm_start_top_k_clamped_to_pool(self):
+        """top_k beyond the pool must not churn: warming pool_slots+N
+        runs would evict the hottest already-warmed entries to admit
+        colder ones.  The budget clamps to the pool instead."""
+        eng = engine(name="restart-clamp")  # pool_slots=4
+        index = {
+            "entries": [
+                {"tokens": [t] * 16, "hits": 10 - t} for t in range(6)
+            ],
+        }
+        assert eng.warm_start(index, top_k=10) == 4
+        stats = eng.prefix_stats
+        assert stats["resident"] == 4
+        # The HOTTEST runs are the residents: each matches in full.
+        for t in range(4):
+            entry, use, _ = eng._prefix.match([t] * 16 + [63])
+            assert entry is not None and use == 16, (t, use)
+        eng.close()
+
+    def test_warm_start_requires_prefix_cache_and_idle_engine(self):
+        bare = engine(name="restart-bare", prefix_cache_slots=0,
+                      prefix_window=None)
+        with pytest.raises(ValueError, match="no prefix cache"):
+            bare.export_prefix_index()
+        with pytest.raises(ValueError, match="no prefix cache"):
+            bare.warm_start({"entries": []})
+        bare.close()
+
+        busy = engine(name="restart-busy")
+        busy.submit(SYSTEM, 2)
+        with pytest.raises(RuntimeError, match="before admitting"):
+            busy.warm_start({"entries": []})
+        busy.run()
+        busy.close()
+
+
+class TestCleanDeath:
+    def test_submit_and_tick_after_close_raise_runtime_error(self):
+        eng = engine(name="death")
+        eng.submit(SYSTEM, 2)
+        eng.run()
+        index = eng.export_prefix_index()  # checkpoint from the dying engine
+        eng.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.submit(SYSTEM, 2)
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.tick()
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.warm_start(index)
+        # The checkpoint stays readable after death (taken either side).
+        assert eng.export_prefix_index()["entries"]
+        # close() is idempotent.
+        eng.close()

@@ -1,9 +1,21 @@
-"""Conflict retry helper (client-go retry.RetryOnConflict analog).
+"""Retry helpers for the two distinct apiserver failure families.
 
-Every NAS read-modify-write in the reference is wrapped in RetryOnConflict
+``retry_on_conflict`` (client-go retry.RetryOnConflict analog): every NAS
+read-modify-write in the reference is wrapped in RetryOnConflict
 (cmd/nvidia-dra-plugin/driver.go:50,149,174; cmd/set-nas-status/main.go:100)
 with client-go's DefaultRetry backoff (10ms base, factor 1.0, 5 steps,
-jitter 0.1).
+jitter 0.1).  Conflicts are CHEAP and self-resolving — another writer won
+a race that a prompt re-read settles — so the backoff is a constant base.
+
+``retry_on_unavailable`` is for the OTHER family: 5xx-class ApiErrors (503
+"apiserver unavailable", outage windows, load-shedding).  Those are NOT
+self-resolving on a re-read — the server is down, and a constant-base
+retry loop is a hot loop that joins the thundering herd the moment the
+server returns.  So: capped EXPONENTIAL backoff with FULL jitter
+(sleep ~ U(0, min(cap, base * 2^attempt)), the AWS-architecture-blog
+discipline that decorrelates a fleet of retriers).  Client errors (4xx:
+NotFound, Conflict, validation) are never retried here — they would never
+heal, and Conflict has its own loop above.
 """
 
 from __future__ import annotations
@@ -12,13 +24,17 @@ import random
 import time
 from typing import Callable, TypeVar
 
-from tpu_dra.client.apiserver import ConflictError
+from tpu_dra.client.apiserver import ApiError, ConflictError
 
 T = TypeVar("T")
 
 DEFAULT_RETRY_STEPS = 5
 DEFAULT_RETRY_BASE_S = 0.01
 DEFAULT_RETRY_JITTER = 0.1
+
+UNAVAILABLE_RETRY_STEPS = 6
+UNAVAILABLE_RETRY_BASE_S = 0.05
+UNAVAILABLE_RETRY_CAP_S = 2.0
 
 
 def retry_on_conflict(fn: Callable[[], T], steps: int = DEFAULT_RETRY_STEPS) -> T:
@@ -35,5 +51,53 @@ def retry_on_conflict(fn: Callable[[], T], steps: int = DEFAULT_RETRY_STEPS) -> 
             last = e
             if attempt < steps - 1:
                 time.sleep(DEFAULT_RETRY_BASE_S * (1 + random.random() * DEFAULT_RETRY_JITTER))
+    assert last is not None
+    raise last
+
+
+def is_unavailable(e: Exception) -> bool:
+    """True for retryable server-side unavailability: an ApiError whose
+    code is 5xx (503 "apiserver unavailable", injected outage faults).
+    Conflict/NotFound/validation (4xx) are NOT unavailability — retrying
+    them blind would mask real bugs."""
+    return isinstance(e, ApiError) and 500 <= getattr(e, "code", 0) < 600
+
+
+def backoff_s(
+    attempt: int,
+    *,
+    base_s: float = UNAVAILABLE_RETRY_BASE_S,
+    cap_s: float = UNAVAILABLE_RETRY_CAP_S,
+    rng: "random.Random | None" = None,
+) -> float:
+    """Capped-exponential-with-full-jitter delay for retry ``attempt``
+    (0-based): U(0, min(cap, base * 2^attempt)).  Exposed separately so
+    long-lived loops (the NAS informer's relist) can apply the same
+    discipline across iterations without a bounded-steps wrapper."""
+    ceiling = min(cap_s, base_s * (2 ** attempt))
+    return (rng.random() if rng is not None else random.random()) * ceiling
+
+
+def retry_on_unavailable(
+    fn: Callable[[], T],
+    steps: int = UNAVAILABLE_RETRY_STEPS,
+    *,
+    base_s: float = UNAVAILABLE_RETRY_BASE_S,
+    cap_s: float = UNAVAILABLE_RETRY_CAP_S,
+) -> T:
+    """Run ``fn``, retrying 503-class ApiErrors up to ``steps`` attempts
+    with capped exponential backoff and full jitter.  Anything that is
+    not server-side unavailability (ConflictError included — it has its
+    own constant-base loop) propagates immediately."""
+    last: ApiError | None = None
+    for attempt in range(steps):
+        try:
+            return fn()
+        except ApiError as e:
+            if not is_unavailable(e):
+                raise
+            last = e
+            if attempt < steps - 1:
+                time.sleep(backoff_s(attempt, base_s=base_s, cap_s=cap_s))
     assert last is not None
     raise last

@@ -82,6 +82,10 @@ SUITABLE = "suitable"
 UNSUITABLE = "unsuitable"
 ALLOCATED = "allocated"
 CONFLICT = "conflict"  # promote-time guard dropped a stale pending pick
+# The recovery sweep (controller/recovery.py) found this claim allocated on
+# a dead node and requested deallocation for re-placement — the victim's
+# answer to "why did my running claim move?" in `tpudra explain`.
+EVICTED = "evicted"
 
 # Cache provenance: which path produced the verdict.
 PROVENANCE_FRESH = "fresh"  # GET-path probe, full availability rebuild
@@ -157,7 +161,7 @@ class FlightRecorder:
             if len(self._records) == self.capacity:
                 self._dropped += 1  # append below evicts the oldest
             self._records.append(rec)
-        if rec.verdict in (UNSUITABLE, CONFLICT) and rec.reason:
+        if rec.verdict in (UNSUITABLE, CONFLICT, EVICTED) and rec.reason:
             REJECTIONS_TOTAL.inc(reason=rec.reason)
         return rec
 
@@ -236,7 +240,7 @@ def summarize(records: "list[DecisionRecord]") -> str:
     ok = sum(1 for r in latest.values() if r.verdict in (SUITABLE, ALLOCATED))
     reasons: "dict[str, int]" = {}
     for rec in latest.values():
-        if rec.verdict == UNSUITABLE:
+        if rec.verdict in (UNSUITABLE, EVICTED):
             code = rec.reason or "Unknown"
             reasons[code] = reasons.get(code, 0) + 1
     return _format_breakdown(ok, len(latest), reasons)
@@ -284,6 +288,41 @@ def record_conflict(claim, node: str, detail: str) -> None:
             detail=detail,
             trace_id=ctx.trace_id if ctx is not None else "",
         )
+    )
+
+
+def record_eviction(claim, node: str, detail: str) -> None:
+    """Flight-record a node-failure eviction: the claim was allocated on
+    ``node``, the node went NotReady, and recovery (the sweep in
+    controller/recovery.py, or the deallocate path draining a dead node)
+    is moving it so the claim (and its gang) re-places on survivors.  The
+    record is the victim's explanation — `tpudra explain <claim>` shows
+    the eviction beside the subsequent re-placement verdicts.  Callers
+    dedupe per incident; this also moves
+    ``tpu_dra_claim_evictions_total{reason=NodeNotReady}``."""
+    from tpu_dra.utils.metrics import CLAIM_EVICTIONS
+
+    CLAIM_EVICTIONS.inc(reason=ReasonCode.NODE_NOT_READY)
+    RECORDER.record(
+        DecisionRecord(
+            namespace=claim.metadata.namespace,
+            claim_uid=claim.metadata.uid,
+            claim=claim.metadata.name,
+            node=node,
+            verdict=EVICTED,
+            reason=ReasonCode.NODE_NOT_READY,
+            detail=detail,
+        )
+    )
+
+
+def has_eviction_record(claim_uid: str, node: str) -> bool:
+    """True when the ring already holds an eviction record for this
+    (claim, node) incident — the deallocate path's dedup against the
+    recovery sweep's earlier record."""
+    return any(
+        r.verdict == EVICTED and r.node == node
+        for r in RECORDER.query(claim=claim_uid)
     )
 
 

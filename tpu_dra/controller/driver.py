@@ -618,6 +618,20 @@ class ControllerDriver:
             allocated = nas.spec.allocated_claims.get(claim_uid)
             if allocated is None:
                 return
+            if nas.status != nascrd.STATUS_READY and not (
+                decisions.has_eviction_record(claim_uid, selected_node)
+            ):
+                # Draining a dead node: this deallocation IS an eviction —
+                # record the why even when the recovery sweep never saw
+                # the claim (kubesim's owner-GC cascade can race the
+                # sweep), so `tpudra explain` always carries the victim's
+                # NodeNotReady reason.
+                decisions.record_eviction(
+                    claim,
+                    selected_node,
+                    f"deallocated from {nas.status or 'unset'!r} node "
+                    f"{selected_node} for re-placement",
+                )
             if allocated.tpu is not None and allocated.tpu.gang is not None:
                 gang = (
                     allocated.claim_info.namespace

@@ -33,6 +33,7 @@ import threading
 
 from tpu_dra.api import nas_v1alpha1 as nascrd
 from tpu_dra.api import serde
+from tpu_dra.client.retry import backoff_s, retry_on_unavailable
 
 logger = logging.getLogger(__name__)
 
@@ -120,6 +121,11 @@ class NasInformer:
     # -- internals -----------------------------------------------------------
 
     def _run(self) -> None:
+        # Consecutive relist failures: a paused/dead apiserver must not be
+        # hot-looped at a constant period — the wait below grows
+        # (capped exponential, full jitter via retry.backoff_s) until a
+        # relist succeeds, then resets.
+        failures = 0
         while not self._stop.is_set():
             try:
                 # Subscribe BEFORE the snapshot (the node plugin's GC uses
@@ -127,9 +133,13 @@ class NasInformer:
                 # LIST and WATCH would otherwise be lost until a relist that
                 # may never come.  The rv guard in _apply makes the overlap
                 # harmless — a buffered event older than the listed object
-                # is discarded.
-                self._watch = self._client.watch()
-                objs = self._client.list()
+                # is discarded.  Both calls retry 503-class unavailability
+                # in place (capped exponential + full jitter,
+                # client/retry.py) so one transient blip doesn't discard a
+                # healthy subscribe-list pair.
+                self._watch = retry_on_unavailable(self._client.watch)
+                objs = retry_on_unavailable(self._client.list)
+                failures = 0
                 fresh = {
                     o.metadata.name: (
                         _rv_int(o),
@@ -150,12 +160,22 @@ class NasInformer:
             except Exception:
                 if self._stop.is_set():
                     return
+                failures += 1
                 logger.exception("nas informer list/watch failed; relisting")
             finally:
                 watch, self._watch = self._watch, None
                 if watch is not None:
                     watch.stop()
-            self._stop.wait(RELIST_BACKOFF_S)
+            # Healthy watch end: prompt relist.  Under a persisting outage
+            # the wait escalates so the informer rides out the window
+            # instead of hammering a down apiserver in lockstep with every
+            # other client (full jitter decorrelates the herd).
+            self._stop.wait(
+                RELIST_BACKOFF_S
+                if failures == 0
+                else RELIST_BACKOFF_S
+                + backoff_s(failures - 1, base_s=RELIST_BACKOFF_S, cap_s=30.0)
+            )
 
     def _notify(self, name: "str | None") -> None:
         if self._on_event is None:

@@ -155,6 +155,7 @@ class Controller:
         workers: int = DEFAULT_WORKERS,
         recheck_period_s: float = DEFAULT_RECHECK_PERIOD_S,
         error_backoff_base_s: float = ERROR_BACKOFF_BASE_S,
+        node_recovery_period_s: "float | None" = None,
     ):
         self.driver = driver
         self.clientset = clientset
@@ -164,6 +165,27 @@ class Controller:
         # Events on claims, as the vendored controller records them
         # (controller.go:162-178, :348-350).
         self.recorder = EventRecorder(clientset)
+        # Node-failure recovery (controller/recovery.py): a periodic sweep
+        # that turns claims allocated on NotReady nodes into deallocation
+        # requests this loop then re-places.  None -> the default period;
+        # <= 0 disables the sweep entirely.
+        from tpu_dra.controller.recovery import (
+            DEFAULT_SWEEP_PERIOD_S,
+            NodeRecovery,
+            RecoveryLoop,
+        )
+
+        period = (
+            DEFAULT_SWEEP_PERIOD_S
+            if node_recovery_period_s is None
+            else node_recovery_period_s
+        )
+        self.node_recovery = NodeRecovery(
+            clientset, self.recorder, namespace=driver.namespace
+        )
+        self._recovery_loop = (
+            RecoveryLoop(self.node_recovery, period) if period > 0 else None
+        )
         self._queue = _DelayQueue()
         self._retries: dict[tuple, int] = {}
         self._threads: list[threading.Thread] = []
@@ -175,38 +197,90 @@ class Controller:
     def start(self) -> None:
         WORKQUEUE_DEPTH.set_function(self._queue.depth)
         for kind in ("ResourceClaim", "PodSchedulingContext"):
-            watch = self.clientset.server.watch(kind)
-            self._watches.append(watch)
             t = threading.Thread(
-                target=self._watch_loop, args=(kind, watch), daemon=True
+                target=self._watch_loop, args=(kind,), daemon=True
             )
             t.start()
             self._threads.append(t)
-        # Prime the queue with existing objects (informer initial list).
-        for claim in self.clientset.resource_claims("").list_all_namespaces():
-            self._enqueue("ResourceClaim", claim.metadata)
-        for sc in self.clientset.pod_scheduling_contexts("").list_all_namespaces():
-            self._enqueue("PodSchedulingContext", sc.metadata)
         for i in range(self.workers):
             t = threading.Thread(
                 target=self._worker_loop, name=f"controller-worker-{i}", daemon=True
             )
             t.start()
             self._threads.append(t)
+        if self._recovery_loop is not None:
+            self._recovery_loop.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._recovery_loop is not None:
+            self._recovery_loop.stop()
         self._queue.close()
-        for watch in self._watches:
+        for watch in list(self._watches):
             watch.stop()
         for t in self._threads:
             t.join(timeout=5)
 
-    def _watch_loop(self, kind: str, watch) -> None:
-        for event in watch:
-            meta = event["object"].get("metadata", {})
-            key = (kind, meta.get("namespace", ""), meta.get("name", ""))
-            self._queue.add(key)
+    def _watch_loop(self, kind: str) -> None:
+        """Watch ``kind`` forever, RECONNECTING on stream loss.
+
+        A dropped/torn watch (apiserver outage, LB reset — sim/faults.py
+        tears streams on pause) used to kill this thread silently, leaving
+        the controller deaf to new claims for the rest of the process.
+        Real controllers relist-and-rewatch; so does this loop: subscribe
+        first, then prime the queue with a full LIST (heals events missed
+        during the gap — the same subscribe-before-list order as the NAS
+        informer), then consume until the stream dies, with jittered
+        backoff between attempts."""
+        failures = 0
+        while not self._stop.is_set():
+            watch = None
+            try:
+                watch = self.clientset.server.watch(kind)
+                self._watches.append(watch)
+                if self._stop.is_set():
+                    # stop() sets the flag BEFORE snapshotting
+                    # self._watches, so a watch appended after its
+                    # snapshot is exactly one whose loop sees the flag
+                    # here — bail and let finally stop it, instead of
+                    # blocking forever in a stream nobody will close.
+                    return
+                lister = (
+                    self.clientset.resource_claims("")
+                    if kind == "ResourceClaim"
+                    else self.clientset.pod_scheduling_contexts("")
+                )
+                for obj in lister.list_all_namespaces():
+                    self._enqueue(kind, obj.metadata)
+                failures = 0
+                for event in watch:
+                    obj = event.get("object") or {}
+                    meta = obj.get("metadata", {})
+                    key = (kind, meta.get("namespace", ""), meta.get("name", ""))
+                    self._queue.add(key)
+                    if self._stop.is_set():
+                        return
+            except Exception as e:
+                if self._stop.is_set():
+                    return
+                failures += 1
+                logger.warning(
+                    "%s watch lost (%s); resubscribing + relisting", kind, e
+                )
+            finally:
+                if watch is not None:
+                    try:
+                        self._watches.remove(watch)
+                    except ValueError:
+                        pass
+                    watch.stop()
+            from tpu_dra.client.retry import backoff_s
+
+            self._stop.wait(
+                0.01 if failures == 0 else backoff_s(
+                    failures - 1, base_s=0.05, cap_s=5.0
+                )
+            )
 
     def _enqueue(self, kind: str, metadata, delay: float = 0.0) -> None:
         self._queue.add((kind, metadata.namespace, metadata.name), delay)

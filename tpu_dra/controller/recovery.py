@@ -1,0 +1,190 @@
+"""Node-failure recovery — re-placing claims stranded on dead nodes.
+
+The reference driver has no answer to a node dying under allocated claims:
+the NAS keeps advertising the allocation, the claim keeps its node
+selector, and the workload is simply gone (SURVEY.md §5 — "the NAS CRD is
+the checkpoint" covers controller restarts, not node loss).  This sweep is
+the missing half of that story, mirroring what the upstream DRA stack gets
+from the node-lifecycle controller + deallocation-requested protocol:
+
+1. A node's NAS goes NotReady under allocated claims (the node-lifecycle
+   controller's lease-expiry verdict — in the sim, `SimCluster.kill_node`).
+2. The sweep records an ``evicted`` decision with reason ``NodeNotReady``
+   in the placement flight recorder (`tpudra explain <claim>` shows the
+   victim why it moved) and a Warning Event on the claim.
+3. It prunes ``reservedFor`` consumers that are gone or bound to the dead
+   node (the force-delete analog — kubesim's eviction deletes those pods,
+   but recovery must not deadlock on a pod nothing will delete) and sets
+   ``deallocationRequested``.
+4. The reconciler's ordinary ``sync_claim`` path then deallocates —
+   freeing the dead NAS entry and the gang rank (gang_tracker's committed
+   scan stops seeing the victim, so the re-placed member takes the freed
+   rank and the coordinator repair path converges rank-0 churn) — and the
+   recreated pod's scheduling negotiation re-places the whole gang on
+   surviving nodes (the fan-out already rejects NotReady nodes).
+
+The sweep is level-triggered and idempotent: every pass re-derives the
+victim set from the apiserver, acts only where state still needs moving,
+and records the decision/event once per (node incident, claim).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.client.apiserver import ApiError, NotFoundError
+from tpu_dra.controller import decisions
+from tpu_dra.utils.events import TYPE_WARNING
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_SWEEP_PERIOD_S = 5.0
+
+
+class NodeRecovery:
+    """Periodic sweep turning NotReady nodes' allocated claims into
+    deallocation requests the reconciler re-places."""
+
+    def __init__(self, clientset, recorder, *, namespace: str = "tpu-dra"):
+        self._clientset = clientset
+        self._recorder = recorder
+        self._namespace = namespace
+        # (node, claim uid) incidents already recorded, so repeat sweeps
+        # over a still-converging claim don't spam the flight recorder.
+        # Cleared per node when it returns Ready — the next incident on
+        # the same node records fresh.
+        self._recorded: "set[tuple[str, str]]" = set()
+        self._lock = threading.Lock()
+        # Observability for tests/benches: claims this instance ever
+        # requested recovery for.
+        self.evicted_claims: "list[tuple[str, str]]" = []
+
+    def sweep(self) -> int:
+        """One pass; returns how many claims recovery acted on."""
+        try:
+            nases = self._clientset.node_allocation_states(
+                self._namespace
+            ).list()
+        except ApiError as e:
+            logger.warning("node recovery sweep: NAS list failed: %s", e)
+            return 0
+        acted = 0
+        for nas in nases:
+            node = nas.metadata.name
+            if nas.status == nascrd.STATUS_READY:
+                with self._lock:
+                    self._recorded = {
+                        k for k in self._recorded if k[0] != node
+                    }
+                continue
+            for claim_uid, alloc in list(nas.spec.allocated_claims.items()):
+                info = alloc.claim_info
+                if info is None or not info.namespace:
+                    continue  # pre-claim_info allocation: nothing to drive
+                try:
+                    if self._recover_claim(node, nas.status, claim_uid, info):
+                        acted += 1
+                except ApiError as e:
+                    logger.warning(
+                        "recovery of claim %s on dead node %s failed "
+                        "(next sweep retries): %s",
+                        claim_uid, node, e,
+                    )
+        return acted
+
+    def _recover_claim(self, node, node_status, claim_uid, info) -> bool:
+        claims = self._clientset.resource_claims(info.namespace)
+        try:
+            claim = claims.get(info.name)
+        except NotFoundError:
+            return False  # claim gone; its NAS entry dies with deallocate/GC
+        if claim.metadata.uid != claim_uid:
+            return False  # a successor claim reused the name
+        if claim.status.allocation is None:
+            return False  # already deallocated; reconciler mid-flight
+
+        detail = (
+            f"node {node} is {node_status or 'unset'!r} with this claim "
+            f"allocated; requesting deallocation for re-placement"
+        )
+        key = (node, claim_uid)
+        with self._lock:
+            first_time = key not in self._recorded
+            self._recorded.add(key)
+        if first_time:
+            decisions.record_eviction(claim, node, detail)
+            self._recorder.event(claim, TYPE_WARNING, "NodeNotReady", detail)
+            self.evicted_claims.append((claim_uid, node))
+
+        # Prune consumers that cannot release the claim themselves: pods
+        # that are gone, deleting, or bound to the dead node (kubesim's
+        # eviction deletes those, but a wedged kubelet must not deadlock
+        # recovery).  Surviving consumers elsewhere keep the claim in use
+        # — a shared claim is NOT yanked from under a live pod on a
+        # healthy node.
+        changed = False
+        kept = []
+        for ref in claim.status.reserved_for:
+            if ref.resource == "pods" and self._pod_releasable(
+                claim.metadata.namespace, ref.name, ref.uid, node
+            ):
+                changed = True
+                continue
+            kept.append(ref)
+        if changed:
+            claim.status.reserved_for = kept
+        if not kept and not claim.status.deallocation_requested:
+            claim.status.deallocation_requested = True
+            changed = True
+        if changed:
+            claims.update_status(claim)
+        return changed or first_time
+
+    def _pod_releasable(self, namespace, name, uid, node) -> bool:
+        try:
+            pod = self._clientset.pods(namespace).get(name)
+        except NotFoundError:
+            return True
+        if pod.metadata.uid != uid:
+            return True  # the reservation's pod is gone; a namesake lives
+        if pod.metadata.deletion_timestamp:
+            return True
+        return pod.spec.node_name == node
+
+
+class RecoveryLoop:
+    """Background periodic sweep, owned by the reconciler Controller."""
+
+    def __init__(self, recovery: NodeRecovery, period_s: float):
+        self._recovery = recovery
+        self._period_s = period_s
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        # Monotonic timestamps of sweeps that acted on at least one claim
+        # (benches read recovery latency off these).
+        self.acted_at: "list[float]" = []
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="node-recovery", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._period_s):
+            try:
+                if self._recovery.sweep():
+                    self.acted_at.append(time.monotonic())
+            except Exception:
+                logger.exception("node recovery sweep failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

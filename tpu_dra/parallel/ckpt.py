@@ -1,4 +1,4 @@
-"""Sharding-aware checkpoint/resume for the burn-in training state.
+"""Sharding-aware, crash-safe checkpoint/resume for the burn-in state.
 
 The control plane's checkpoint story is "the NAS CRD is the checkpoint"
 (allocation state lives in the apiserver and is re-adopted on restart —
@@ -18,11 +18,44 @@ TPU-first specifics:
 - The train-state layout is the burn-in's plain pytree; abstract target
   construction uses ``jax.eval_shape`` over ``_init_state`` so the
   checkpoint schema is derived from the model code, never duplicated.
+
+Crash safety (docs/RESILIENCE.md): every step commits atomically — orbax
+writes into a hidden tmp dir, a ``_COMPLETE`` sentinel is fsynced inside
+it, and only then is the dir renamed to its step number (rename is the
+commit point; the parent dir is fsynced after).  A kill at ANY instant
+therefore leaves either a fully complete step dir or a ``.tmp`` orphan
+that :func:`latest_step` ignores — a half checkpoint can never be picked.
+:func:`restore_state` with no explicit step walks complete steps newest
+-first and falls back to the previous complete step if a restore fails
+(bit rot, torn storage), so resume always lands on SOME consistent state.
+
+Elastic resume: the run's tensor shapes are frozen at first save
+(``runmeta.json`` records the scaled config).  ``train_with_resume`` on a
+RESIZED mesh — the gang lost a node and re-formed smaller, or grew back —
+restores the latest complete checkpoint with the saved shapes and remaps
+the data/fsdp/tp sharding onto the new mesh (orbax materializes directly
+into the new ``NamedSharding``s), then continues stepping.  The saved
+shapes must divide the new mesh's axes (power-of-two slices shrink
+cleanly); an incompatible resize raises up front rather than producing a
+silently re-padded model.
 """
 
 from __future__ import annotations
 
-__all__ = ["save_state", "restore_state", "latest_step", "train_with_resume"]
+import logging
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "save_state",
+    "restore_state",
+    "latest_step",
+    "complete_steps",
+    "train_with_resume",
+]
+
+COMPLETE_MARKER = "_COMPLETE"
+RUNMETA = "runmeta.json"
 
 
 def _state_shardings(config, mesh):
@@ -37,16 +70,92 @@ def _state_shardings(config, mesh):
     return state_shardings(config, mesh)
 
 
+def _fsync_dir(path) -> None:
+    import os
+
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def save_state(path, state, *, step: int) -> None:
-    """Persist (params, momentum) at ``path``/<step> (atomic per orbax)."""
+    """Persist (params, momentum) at ``path``/<step>, atomically.
+
+    Write → fsync → rename: orbax saves into ``.tmp.<step>.<pid>``, the
+    ``_COMPLETE`` sentinel is fsynced inside it, and the one-shot rename
+    to ``<step>`` is the commit point (fsynced parent).  A kill mid-save
+    leaves only a ``.tmp`` orphan that ``latest_step`` skips."""
+    import os
+    import uuid
+
     import orbax.checkpoint as ocp
 
+    root = os.fspath(path)
+    os.makedirs(root, exist_ok=True)
+    tmp = os.path.join(root, f".tmp.{step}.{uuid.uuid4().hex[:8]}")
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
-        ckptr.save(_step_dir(path, step), state)
+        ckptr.save(tmp, state)
+    marker = os.path.join(tmp, COMPLETE_MARKER)
+    with open(marker, "w") as f:
+        f.write(str(step))
+        f.flush()
+        os.fsync(f.fileno())
+    final = _step_dir(path, step)
+    import shutil
+
+    if os.path.exists(os.path.join(final, COMPLETE_MARKER)):
+        # Idempotent re-save of an already-COMMITTED step (a retried
+        # preemption window): the committed dir wins; drop the twin.
+        shutil.rmtree(tmp, ignore_errors=True)
+    else:
+        if os.path.exists(final):
+            # An incomplete/corrupt occupant (marker-less truncated dir,
+            # or a complete-but-unrestorable dir being re-saved after a
+            # fallback retrain): the fresh commit replaces it — keeping
+            # it would discard this good save and wedge the run in a
+            # retrain-and-discard loop at this step forever.
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+    _fsync_dir(root)
 
 
-def restore_state(path, config, mesh=None, *, step: int):
-    """Restore (params, momentum) into this process's mesh shardings."""
+def restore_state(path, config, mesh=None, *, step: "int | None" = None):
+    """Restore (params, momentum) into this process's mesh shardings.
+
+    ``step=None`` restores the newest COMPLETE step, falling back to the
+    previous complete step when a restore fails (torn storage under a
+    marker that lied, bit rot) — resume always lands on some consistent
+    state or raises with every attempt's reason.  An explicit ``step``
+    restores exactly that dir (no fallback)."""
+    if step is not None:
+        return _restore_step(path, config, mesh, step)
+    return _restore_latest(path, config, mesh)[0]
+
+
+def _restore_latest(path, config, mesh):
+    """(state, step) from the newest restorable complete checkpoint."""
+    steps = complete_steps(path)
+    if not steps:
+        raise FileNotFoundError(f"no complete checkpoint under {path!r}")
+    errors = []
+    for s in reversed(steps):
+        try:
+            return _restore_step(path, config, mesh, s), s
+        except Exception as e:  # fall back to the previous complete step
+            logger.warning(
+                "checkpoint step %d under %s failed to restore (%s); "
+                "falling back to the previous complete step", s, path, e,
+            )
+            errors.append(f"step {s}: {e}")
+    raise RuntimeError(
+        f"every complete checkpoint under {path!r} failed to restore: "
+        + "; ".join(errors)
+    )
+
+
+def _restore_step(path, config, mesh, step: int):
     import jax
     import orbax.checkpoint as ocp
 
@@ -64,27 +173,83 @@ def restore_state(path, config, mesh=None, *, step: int):
         return ckptr.restore(_step_dir(path, step), abstract)
 
 
+def complete_steps(path) -> "list[int]":
+    """Sorted steps under ``path`` whose dirs carry the ``_COMPLETE``
+    sentinel.  Tmp orphans (non-digit names) and truncated step dirs
+    (digit name, no sentinel — a pre-atomic-commit writer died, or the
+    marker itself was torn away) are both skipped."""
+    import os
+
+    try:
+        names = os.listdir(path)
+    except FileNotFoundError:
+        return []
+    steps = []
+    for name in names:
+        if not name.isdigit():
+            continue
+        if os.path.exists(os.path.join(path, name, COMPLETE_MARKER)):
+            steps.append(int(name))
+    return sorted(steps)
+
+
 def latest_step(path) -> "int | None":
-    """Highest step saved under ``path``, or None when empty/absent.
+    """Highest COMPLETE step saved under ``path``, or None when empty or
+    absent.
 
     Deliberately a flat <path>/<step> layout managed here rather than
     ocp.CheckpointManager: the burn-in needs save/restore/latest only, and
     a handler-level Checkpointer keeps the dependency surface to orbax's
-    stable core (saves are still atomic per orbax's commit protocol;
-    non-digit entries like in-progress tmp dirs are skipped)."""
-    import os
-
-    try:
-        steps = [int(d) for d in os.listdir(path) if d.isdigit()]
-    except FileNotFoundError:
-        return None
-    return max(steps) if steps else None
+    stable core.  Completeness is this module's own write→fsync→rename
+    commit (see save_state), so a kill mid-save can never surface a half
+    checkpoint here."""
+    steps = complete_steps(path)
+    return steps[-1] if steps else None
 
 
 def _step_dir(path, step: int) -> str:
     import os
 
     return os.path.join(os.fspath(path), str(step))
+
+
+# -- run metadata: the schema freeze behind elastic resume -------------------
+
+
+def _write_runmeta(path, config) -> None:
+    """Record the run's SCALED config (the checkpoint schema) atomically.
+    Idempotent: an existing runmeta is left alone — the first writer
+    froze the shapes for the life of the run."""
+    import dataclasses
+    import json
+    import os
+
+    root = os.fspath(path)
+    os.makedirs(root, exist_ok=True)
+    final = os.path.join(root, RUNMETA)
+    if os.path.exists(final):
+        return
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dataclasses.asdict(config), f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, final)
+    _fsync_dir(root)
+
+
+def _read_runmeta(path):
+    """The frozen scaled config, or None (pre-runmeta checkpoint dirs)."""
+    import json
+    import os
+
+    from tpu_dra.parallel.burnin import BurninConfig
+
+    try:
+        with open(os.path.join(os.fspath(path), RUNMETA)) as f:
+            return BurninConfig(**json.load(f))
+    except FileNotFoundError:
+        return None
 
 
 def train_with_resume(
@@ -96,9 +261,20 @@ def train_with_resume(
     save_every: "int | None" = None,
 ):
     """Run burn-in training with checkpointing; resumes from the latest
-    step under ``path`` when one exists.  Returns (final_step, losses) —
-    ``losses`` covers only the steps run in THIS invocation, so a resumed
-    run's continuity is checkable against the pre-preemption run.
+    COMPLETE step under ``path`` when one exists.  Returns (final_step,
+    losses) — ``losses`` covers only the steps run in THIS invocation, so
+    a resumed run's continuity is checkable against the pre-preemption
+    run.
+
+    **Elastic**: the run's tensor shapes are frozen at first start
+    (runmeta.json).  Resuming on a DIFFERENT mesh — the gang re-formed
+    on fewer (or more) hosts after a node kill — keeps the frozen shapes
+    and remaps data/fsdp/tp sharding onto the new mesh: the restore
+    materializes every array directly into the new mesh's
+    ``NamedSharding``s and the synthetic batch is re-placed to match.
+    The frozen shapes must divide the new mesh's axes (checked up
+    front); the loss stream continues from the checkpointed state, so
+    continuity across the resize is assertable.
 
     ``save_every=None`` saves once at the end (each save here is a
     synchronous orbax write that stalls the step loop — frequent saves are
@@ -107,14 +283,29 @@ def train_with_resume(
 
     from tpu_dra.parallel.burnin import make_train_step, prepare_tokens
 
-    c = config if mesh is None else config.scaled_to(mesh)
+    frozen = _read_runmeta(path)
     start = latest_step(path)
+    if frozen is not None:
+        c = frozen
+        if mesh is not None and c.scaled_to(mesh) != c:
+            raise ValueError(
+                f"checkpointed run shapes (batch={c.batch}, "
+                f"d_model={c.d_model}, n_heads={c.n_heads}, d_ff={c.d_ff}, "
+                f"seq={c.seq}, vocab={c.vocab}) do not divide the resized "
+                f"mesh {dict(mesh.shape)}: elastic resume needs every "
+                f"frozen dim to shard evenly on the new mesh"
+            )
+    else:
+        c = config if mesh is None else config.scaled_to(mesh)
+    _write_runmeta(path, c)
     if start is not None:
         # Resume: build the step WITHOUT materializing a fresh init (the
         # restore is about to fill HBM; two copies would double peak state
-        # memory at exactly the restore moment).
+        # memory at exactly the restore moment).  The restore walks
+        # complete steps newest-first with fallback, and the loop
+        # continues from the step that actually restored.
         step_fn, _ = make_train_step(c, mesh, with_state=False)
-        state = restore_state(path, c, mesh, step=start)
+        state, start = _restore_latest(path, c, mesh)
     else:
         step_fn, state = make_train_step(c, mesh)
         start = 0

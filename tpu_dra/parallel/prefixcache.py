@@ -73,12 +73,15 @@ class PrefixEntry:
     """A resident prefix segment: pool row ``slot`` holds valid KV for
     cache positions ``[0, length)``.  ``refcount > 0`` pins the entry
     against eviction (held by every engine row whose admission read or
-    wrote it, released when the request finishes)."""
+    wrote it, released when the request finishes).  ``hits`` counts
+    lookups this entry served — the hotness signal the warm-restart
+    checkpoint (export_index) ranks by."""
 
     slot: int
     length: int
     refcount: int = 0
     last_used: int = 0
+    hits: int = 0
     node: "_Node | None" = field(default=None, repr=False)
 
 
@@ -187,6 +190,7 @@ class PrefixCache:
             SERVE_PREFIX_MISSES.inc()
             return None, 0, matched
         self.hits += 1
+        entry.hits += 1
         SERVE_PREFIX_HITS.inc()
         # A hit is a use: refresh recency so the LRU victim is the entry
         # no lookup has touched longest, not merely the oldest insert.
@@ -298,6 +302,37 @@ class PrefixCache:
         node.parent = upper
         upper.children[node.edge[0]] = node
         return upper
+
+    # -- warm-restart checkpoint (host-side only) ------------------------
+    @staticmethod
+    def _tokens_of(node: "_Node") -> "list[int]":
+        """The full token run a terminal node indexes (root→node edges)."""
+        parts: "list[list[int]]" = []
+        while node is not None and node.parent is not None:
+            parts.append(node.edge)
+            node = node.parent
+        out: "list[int]" = []
+        for edge in reversed(parts):
+            out.extend(edge)
+        return out
+
+    def export_index(self) -> "list[dict]":
+        """The radix index as plain data — token runs + hit counts +
+        recency, hottest first.  Host-side ONLY (no device KV rides
+        along): a restarted engine re-prefills these runs to rebuild pool
+        residency (`ServeEngine.warm_start`), which is exactly why the
+        checkpoint stays tiny and trivially serializable (json)."""
+        entries = sorted(
+            self._entries, key=lambda e: (-e.hits, -e.last_used)
+        )
+        return [
+            {
+                "tokens": self._tokens_of(e.node),
+                "hits": e.hits,
+                "last_used": e.last_used,
+            }
+            for e in entries
+        ]
 
     # -- introspection ---------------------------------------------------
     @property

@@ -320,6 +320,7 @@ class ServeEngine:
         self._queue: "list[Request]" = []
         self._done: "list[Request]" = []
         self._next_id = 0
+        self._closed = False
         self._prefill_tokens = {"computed": 0, "reused": 0}
 
         # -- runtime telemetry (docs/OBSERVABILITY.md "Serving telemetry").
@@ -531,6 +532,7 @@ class ServeEngine:
         Every contract violation raises HERE, eagerly — a bad prompt
         must never surface later as an opaque failure inside the padded
         admission prefill with other requests mid-flight."""
+        self._check_open()
         for t in prompt:
             # bool is an int subclass and would silently embed as 0/1; an
             # out-of-range id silently clamps in the embedding gather —
@@ -794,6 +796,7 @@ class ServeEngine:
         import jax
         import jax.numpy as jnp
 
+        self._check_open()
         t0 = time.perf_counter()
         done_before = len(self._done)
         toks_before = self._tokens_emitted
@@ -858,12 +861,125 @@ class ServeEngine:
         return self._done
 
     def close(self) -> None:
-        """Retire this engine's scrape-time gauge series.  The weakref
-        samplers would retire them at the next scrape after collection
-        anyway; close() makes teardown deterministic for tests and for
-        embedding servers that recycle engine names."""
+        """Kill this engine: retire its scrape-time gauge series and mark
+        it closed so ``submit()``/``tick()`` raise a clean RuntimeError
+        instead of a weakref/jit AttributeError — the chaos harness kills
+        engines on purpose and needs crisp death semantics.  The weakref
+        samplers would retire the gauges at the next scrape after
+        collection anyway; close() makes teardown deterministic for tests
+        and for embedding servers that recycle engine names.  Idempotent;
+        host-side state (done requests, the prefix index for
+        ``export_prefix_index``) stays readable after close."""
+        self._closed = True
         SERVE_QUEUE_DEPTH.remove_function(engine=self.name)
         SERVE_BATCH_OCCUPANCY.remove_function(engine=self.name)
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise RuntimeError(
+                f"ServeEngine {self.name!r} is closed: no further "
+                "submissions or ticks (restart with a fresh engine; "
+                "warm_start() rebuilds the prefix cache)"
+            )
+
+    # -- warm restart (docs/RESILIENCE.md) --------------------------------
+    def export_prefix_index(self) -> dict:
+        """The prefix cache's radix index as plain json-able data — token
+        runs + hit counts, host-side only (no device KV).  This is the
+        engine's warm-restart checkpoint: a restarted engine passes it to
+        `warm_start` to rebuild pool residency by re-prefilling the
+        hottest runs before admitting traffic.  Readable after close()
+        (the checkpoint is typically taken from the dying engine)."""
+        if self._prefix is None:
+            raise ValueError(
+                "engine has no prefix cache (prefix_cache_slots=0): "
+                "nothing to checkpoint"
+            )
+        return {
+            "version": 1,
+            "prefix_window": self.prefix_window,
+            "entries": self._prefix.export_index(),
+        }
+
+    def warm_start(self, index: dict, *, top_k: "int | None" = None) -> int:
+        """Rebuild prefix-cache residency from a checkpointed index
+        BEFORE admitting traffic: re-prefill the top-K hottest token runs
+        and park their KV in the pool, so the first post-restart wave of
+        shared-prefix admissions hits instead of paying cold prefills.
+        Returns the number of prefixes warmed.
+
+        Recompute, not restore: KV is re-derived from the weights, so a
+        warm engine's outputs are token-identical to a cold one by the
+        cache's exactness contract — warming changes latency, never
+        tokens (pinned by test).  Runs whose tokens no longer validate
+        (vocab/window/slot changes across the restart) are skipped, not
+        fatal; warming stops early when the pool fills.  The engine must
+        be idle (no queued or mid-decode requests)."""
+        import jax.numpy as jnp
+
+        self._check_open()
+        if self._prefix is None:
+            raise ValueError(
+                "engine has no prefix cache (prefix_cache_slots=0): "
+                "cannot warm-start"
+            )
+        if self._queue or any(r is not None for r in self._row_req):
+            raise RuntimeError(
+                "warm_start must run before admitting traffic "
+                "(queue and rows must be empty)"
+            )
+        entries = list(index.get("entries", ()))
+        # Hottest first (export order already is; re-sort so hand-built
+        # or merged indexes behave the same), bounded by the pool.
+        entries.sort(
+            key=lambda e: (-e.get("hits", 0), -e.get("last_used", 0))
+        )
+        # Clamped to the pool: warming MORE than pool_slots would evict
+        # the hottest already-warmed (unpinned) entries to make room for
+        # colder ones — ending with the coldest resident, inverted from
+        # intent, while paying the extra prefills.
+        budget = (
+            self._prefix.pool_slots
+            if top_k is None
+            else min(top_k, self._prefix.pool_slots)
+        )
+        warmed = 0
+        for item in entries:
+            if warmed >= budget:
+                break
+            tokens = item.get("tokens") or []
+            if (
+                not isinstance(tokens, list)
+                or len(tokens) < self.prefix_window
+                or len(tokens) > self.prompt_slots
+                or any(
+                    isinstance(t, bool)
+                    or not isinstance(t, int)
+                    or not 0 <= t < self.config.vocab
+                    for t in tokens
+                )
+            ):
+                continue  # stale/incompatible run: skip, don't die
+            entry = self._prefix.insert(tokens)
+            if entry is None:
+                break  # every slot pinned (cannot happen pre-traffic)
+            length = len(tokens)
+            padded = tokens + [0] * (self.prompt_slots - length)
+            prompt = jnp.asarray(padded, jnp.int32)[None, :]
+            cache1, _ = self._prefill1(
+                self.params, prompt, jnp.int32(length)
+            )
+            self._prefix.pool = self._pool_write(
+                self._prefix.pool, cache1,
+                jnp.int32(entry.slot), jnp.int32(length),
+            )
+            # Seed hotness so pre-kill popularity keeps steering LRU.
+            entry.hits = int(item.get("hits", 0))
+            self._prefix.release(entry)  # insert pre-pins; nothing decodes
+            self._prefill_tokens["computed"] += length
+            SERVE_PREFILL_TOKENS.inc(length, kind="computed")
+            warmed += 1
+        return warmed
 
     @property
     def pending(self) -> int:

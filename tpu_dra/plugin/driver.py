@@ -244,21 +244,32 @@ class NodeDriver:
 
     def shutdown(self) -> None:
         """Flip NotReady and stop the GC (driver.go:93-101 + signal path)."""
-        self._stop.set()
-        if self._gc_thread is not None:
-            self._gc_thread.join(timeout=5)
-        ALLOCATED_CHIPS.remove_function(
-            node=self._nas.metadata.name, state="allocated"
-        )
-        ALLOCATED_CHIPS.remove_function(
-            node=self._nas.metadata.name, state="prepared"
-        )
+        self.crash()
 
         def flip():
             self._client.get()
             self._client.update_status(nascrd.STATUS_NOT_READY)
 
         retry_on_conflict(flip)
+
+    def crash(self) -> None:
+        """Ungraceful death: stop the GC and retire the gauges WITHOUT the
+        NotReady write — the kubelet vanished mid-flight, so nothing
+        cleans the NAS.  The chaos layer (sim/faults.py ChaosPlan) uses
+        this to strand allocated claims exactly the way a powered-off
+        node would; the node-lifecycle controller (kubesim) then flips
+        the NAS NotReady after its grace, and the control-plane recovery
+        sweep (controller/recovery.py) re-places the stranded claims."""
+        self._stop.set()
+        if self._gc_thread is not None:
+            self._gc_thread.join(timeout=5)
+            self._gc_thread = None
+        ALLOCATED_CHIPS.remove_function(
+            node=self._nas.metadata.name, state="allocated"
+        )
+        ALLOCATED_CHIPS.remove_function(
+            node=self._nas.metadata.name, state="prepared"
+        )
 
     # -- stale-state GC (driver.go:198-343) ----------------------------------
 

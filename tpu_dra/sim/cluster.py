@@ -12,7 +12,7 @@ import logging
 from tpu_dra.api import nas_v1alpha1 as nascrd
 from tpu_dra.api.k8s import Node
 from tpu_dra.api.meta import ObjectMeta
-from tpu_dra.client.apiserver import FakeApiServer
+from tpu_dra.client.apiserver import AlreadyExistsError, FakeApiServer
 from tpu_dra.client.clientset import ClientSet
 from tpu_dra.client.nasclient import NasClient
 from tpu_dra.controller.driver import ControllerDriver
@@ -72,7 +72,12 @@ class SimNode:
         self.driver: NodeDriver | None = None
 
     def start(self) -> None:
-        self.clientset.nodes().create(Node(metadata=ObjectMeta(name=self.name)))
+        try:
+            self.clientset.nodes().create(
+                Node(metadata=ObjectMeta(name=self.name))
+            )
+        except AlreadyExistsError:
+            pass  # revive after a crash: the Node object survived
         nas = nascrd.NodeAllocationState(
             metadata=ObjectMeta(name=self.name, namespace=self.namespace)
         )
@@ -87,6 +92,15 @@ class SimNode:
     def stop(self) -> None:
         if self.driver is not None:
             self.driver.shutdown()
+            self.driver = None
+
+    def crash(self) -> None:
+        """Ungraceful death: the plugin stops without touching the NAS —
+        allocated/prepared claims stay advertised, status stays Ready —
+        exactly what a powered-off node leaves behind."""
+        if self.driver is not None:
+            self.driver.crash()
+            self.driver = None
 
 
 class SimCluster:
@@ -103,6 +117,8 @@ class SimCluster:
         server=None,
         exec_proxies: bool = False,
         multihost_slice: bool = False,
+        evict_after_s: "float | None" = None,
+        recreate_evicted: bool = False,
     ):
         # ``server`` lets chaos tests wrap the store (sim/faults.py).
         # ``exec_proxies`` makes KubeSim actually run tpu-runtime-proxy
@@ -154,6 +170,7 @@ class SimCluster:
             workers=workers,
             recheck_period_s=0.2,
             error_backoff_base_s=0.02,
+            node_recovery_period_s=0.2,  # sim scale, like recheck_period_s
         )
         self.kubesim = KubeSim(
             self.clientset,
@@ -161,6 +178,8 @@ class SimCluster:
             namespace=namespace,
             poll_s=poll_s,
             exec_proxies=exec_proxies,
+            evict_after_s=evict_after_s,
+            recreate_evicted=recreate_evicted,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -183,11 +202,51 @@ class SimCluster:
     def node(self, name: str) -> SimNode:
         return next(n for n in self.nodes if n.name == name)
 
+    # -- chaos hooks (sim/faults.py ChaosRunner) ------------------------------
+
+    def kill_node(self, name: str) -> None:
+        """Kill a node the way chaos means it: the plugin crashes without
+        any NAS cleanup (allocated claims stranded), then the simulated
+        node-lifecycle controller flips the NAS NotReady — the lease
+        -expiry verdict the recovery sweep and the scheduling fan-out key
+        off.  Idempotent; a killed node's NAS write retries conflicts."""
+        from tpu_dra.client.retry import retry_on_conflict
+
+        self.node(name).crash()
+
+        def flip():
+            nas = nascrd.NodeAllocationState(
+                metadata=ObjectMeta(name=name, namespace=self.namespace)
+            )
+            client = NasClient(nas, self.clientset)
+            client.get()
+            if nas.status != nascrd.STATUS_NOT_READY:
+                client.update_status(nascrd.STATUS_NOT_READY)
+
+        retry_on_conflict(flip)
+
+    def revive_node(self, name: str) -> None:
+        """Restart the node's plugin stack: a fresh NodeDriver re-adopts
+        the surviving device state from disk and the NAS spec (crash
+        recovery), republishes, and flips Ready — after which its GC
+        unprepares any claim the controller deallocated while the node
+        was dead."""
+        node = self.node(name)
+        if node.driver is not None:
+            return  # already alive
+        node.start()
+
     # -- scheduler / kubelet / deployment-controller sim ----------------------
 
     def _prepare(self, node_name: str, claim) -> "list[str]":
         """In-process kubelet prepare: call the node's driver directly."""
-        return self.node(node_name).driver.node_prepare_resource(claim.metadata.uid)
+        driver = self.node(node_name).driver
+        if driver is None:
+            # Crashed/killed node: the kubelet is unreachable.  The pod
+            # stays bound-but-not-Running until the node-lifecycle
+            # eviction moves it.
+            raise RuntimeError(f"node {node_name} is down")
+        return driver.node_prepare_resource(claim.metadata.uid)
 
     def wait_for_pod_running(self, namespace: str, name: str, timeout: float = 10.0):
         return self.kubesim.wait_for_pod_running(namespace, name, timeout)

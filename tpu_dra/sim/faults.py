@@ -1,4 +1,4 @@
-"""Fault injection for the apiserver seam.
+"""Fault injection for the apiserver seam, and the chaos schedule above it.
 
 The reference has no fault-injection testing at all (SURVEY.md §4/§5:
 "no fault injection anywhere") even though its entire correctness story
@@ -16,7 +16,24 @@ Injected faults (all independently configurable):
 - ``latency_s``      — uniform extra delay per call (0..latency_s)
 
 Reads and writes can be targeted separately; a seeded RNG makes every run
-reproducible.  ``pause()`` gives scripted outage windows.
+reproducible.  ``pause()`` gives scripted outage windows: while paused every
+call fails AND every live watch stream stalls (its next ``next()`` raises,
+ending the serving stream), so clients are forced through their real
+reconnect/relist paths, not just their per-call retries.  Every injected
+fault is counted both in total (``faults_injected``) and per verb
+(``fault_breakdown()``), so an outage test can assert *which* seam actually
+took the hit (e.g. the informer's watch stream, not merely its LIST).
+
+Above the call-level faults sits the scripted chaos layer
+(docs/RESILIENCE.md):
+
+- ``ChaosPlan``    — a seeded, reproducible schedule of cluster-level
+  events: node kills/revives, watch-stream tears, apiserver outage
+  windows.  A plan is data (sorted ``ChaosEvent``s), so benches can log
+  exactly what was inflicted.
+- ``ChaosRunner``  — executes a plan against callbacks (SimCluster's
+  kill_node/revive_node, a FlakyApiServer's pause/break_watches) on a
+  background thread, recording what fired and when.
 """
 
 from __future__ import annotations
@@ -25,6 +42,7 @@ import random
 import threading
 import time
 import weakref
+from dataclasses import dataclass, field
 
 from tpu_dra.client.apiserver import ApiError, ConflictError
 
@@ -64,16 +82,40 @@ class FlakyApiServer:
         # run; explicit stop() still drops eagerly.
         self._live_watches = weakref.WeakSet()
         self.faults_injected = 0
+        # verb -> injected fault count ("watch" covers stalled/torn
+        # streams), so outage tests can assert WHICH seam took the hit.
+        self.faults_by_verb: "dict[str, int]" = {}
         self.calls = 0
 
     # -- scripted outages -----------------------------------------------------
 
     def pause(self) -> None:
-        """Hard outage: every subsequent call fails until resume()."""
+        """Hard outage: every subsequent call fails until resume(), and
+        every live watch stream stalls — it is torn (poisoned) so its next
+        ``next()`` raises, ending the serving stream even if it was
+        already blocked inside the store when the outage began.  Watch
+        consumers (informers, the plugin GC) must therefore go through
+        their reconnect/relist path rather than riding an event stream
+        that silently outlived the outage — and reconnecting fails until
+        resume(), exercising their backoff."""
         self._paused.set()
+        self.break_watches()
 
     def resume(self) -> None:
         self._paused.clear()
+
+    @property
+    def paused(self) -> bool:
+        return self._paused.is_set()
+
+    def fault_breakdown(self) -> "dict[str, int]":
+        """Injected-fault counts by verb (a private copy)."""
+        with self._lock:
+            return dict(self.faults_by_verb)
+
+    def _count_fault(self, verb: str) -> None:
+        self.faults_injected += 1
+        self.faults_by_verb[verb] = self.faults_by_verb.get(verb, 0) + 1
 
     # -- fault gate -----------------------------------------------------------
 
@@ -81,7 +123,7 @@ class FlakyApiServer:
         with self._lock:
             self.calls += 1
             if self._paused.is_set():
-                self.faults_injected += 1
+                self._count_fault(verb)
                 raise UnavailableError("apiserver paused (scripted outage)")
             latency = self._rng.uniform(0, self.latency_s) if self.latency_s else 0
             roll = self._rng.random()
@@ -92,11 +134,11 @@ class FlakyApiServer:
         allowed = self.writes_fail if is_write else self.reads_fail
         if allowed and roll < self.error_rate:
             with self._lock:
-                self.faults_injected += 1
+                self._count_fault(verb)
             raise UnavailableError(f"injected fault on {verb}")
         if is_write and verb != "delete" and conflict_roll < self.conflict_rate:
             with self._lock:
-                self.faults_injected += 1
+                self._count_fault(verb)
             raise ConflictError(f"injected conflict on {verb}")
 
     # -- protocol -------------------------------------------------------------
@@ -138,10 +180,16 @@ class FlakyApiServer:
         return self.inner.events_since(since_rv, kind, namespace, name)
 
     def watch(self, kind, namespace=None, name=None):
-        # Subscription itself stays reliable (missed-event semantics are
-        # exercised by the event-log replay tests), but live streams are
-        # breakable: break_watches() poisons every open stream so wire-rung
-        # chaos can force real clients through their reconnect/relist paths.
+        # Subscription itself stays reliable against RATE-based faults
+        # (missed-event semantics are exercised by the event-log replay
+        # tests), but a scripted outage refuses new subscriptions like any
+        # other call, and live streams are breakable: break_watches()
+        # poisons every open stream so wire-rung chaos can force real
+        # clients through their reconnect/relist paths.
+        with self._lock:
+            if self._paused.is_set():
+                self._count_fault("watch")
+                raise UnavailableError("apiserver paused (scripted outage)")
         wrapper = _BreakableWatch(self.inner.watch(kind, namespace, name), self)
         with self._lock:
             self._live_watches.add(wrapper)
@@ -149,10 +197,14 @@ class FlakyApiServer:
 
     def break_watches(self) -> None:
         """Tear every live watch stream (the load-balancer-reset analog):
-        the next ``next()`` on each raises, ending the serving stream, and
-        wire clients must reconnect from their last seen resourceVersion."""
+        a consumer blocked in ``next()`` gets a clean stream end, any
+        later ``next()`` raises — either way the stream is dead and the
+        client must reconnect from its last seen resourceVersion.  Each
+        torn stream counts as one injected "watch" fault."""
         with self._lock:
             watches = list(self._live_watches)
+            for _ in watches:
+                self._count_fault("watch")
         for w in watches:
             w.poison()
 
@@ -171,10 +223,27 @@ class _BreakableWatch:
 
     def poison(self) -> None:
         self._poisoned.set()
+        # Wake a consumer already blocked inside the store's queue: a None
+        # ends its current next() (clean stream end), and every LATER
+        # next() raises on the flag above — either way the stream is dead
+        # and the consumer must reconnect.
+        try:
+            self._inner.deliver(None)
+        except Exception:
+            pass
 
     def next(self, timeout: "float | None" = None):
+        # Both tears count as injected "watch" faults, so outage tests can
+        # assert the STREAM (not just the calls) took the hit and the
+        # consumer really went through its resync path.
         if self._poisoned.is_set():
+            with self._owner._lock:
+                self._owner._count_fault("watch")
             raise UnavailableError("watch stream torn (scripted)")
+        if self._owner._paused.is_set():
+            with self._owner._lock:
+                self._owner._count_fault("watch")
+            raise UnavailableError("watch stream stalled (scripted outage)")
         return self._inner.next(timeout)
 
     def __iter__(self):
@@ -190,3 +259,230 @@ class _BreakableWatch:
     def stop(self) -> None:
         self._owner._drop_watch(self)
         self._inner.stop()
+
+
+# ---------------------------------------------------------------------------
+# Scripted cluster-level chaos: plans and their runner.
+# ---------------------------------------------------------------------------
+
+KILL_NODE = "kill_node"
+REVIVE_NODE = "revive_node"
+BREAK_WATCHES = "break_watches"
+OUTAGE_START = "outage_start"
+OUTAGE_END = "outage_end"
+
+_ACTIONS = (KILL_NODE, REVIVE_NODE, BREAK_WATCHES, OUTAGE_START, OUTAGE_END)
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One scripted fault: ``action`` fires ``at_s`` seconds after the
+    runner starts; ``target`` names the victim node for kill/revive
+    (empty for cluster-wide actions)."""
+
+    at_s: float
+    action: str
+    target: str = ""
+
+    def __post_init__(self):
+        if self.action not in _ACTIONS:
+            raise ValueError(f"unknown chaos action: {self.action!r}")
+        if self.at_s < 0:
+            raise ValueError(f"chaos event offset must be >= 0, got {self.at_s}")
+        if self.action in (KILL_NODE, REVIVE_NODE) and not self.target:
+            raise ValueError(f"{self.action} needs a target node")
+
+    def to_dict(self) -> dict:
+        return {"at_s": self.at_s, "action": self.action, "target": self.target}
+
+
+@dataclass
+class ChaosPlan:
+    """A reproducible fault schedule — pure data, sorted by fire time.
+
+    Plans come from :meth:`seeded` (a deterministic random schedule for a
+    given seed) or are hand-built for targeted tests.  ``validate()``
+    rejects schedules that kill a dead node or revive a live one, so a
+    bad hand-written script fails at build time, not mid-soak."""
+
+    events: "list[ChaosEvent]" = field(default_factory=list)
+    seed: "int | None" = None
+
+    def __post_init__(self):
+        self.events = sorted(self.events, key=lambda e: e.at_s)
+        self.validate()
+
+    def validate(self) -> None:
+        down: "set[str]" = set()
+        outage = False
+        for ev in self.events:
+            if ev.action == KILL_NODE:
+                if ev.target in down:
+                    raise ValueError(f"{ev.target} killed twice without revive")
+                down.add(ev.target)
+            elif ev.action == REVIVE_NODE:
+                if ev.target not in down:
+                    raise ValueError(f"{ev.target} revived while alive")
+                down.discard(ev.target)
+            elif ev.action == OUTAGE_START:
+                if outage:
+                    raise ValueError("outage started twice without outage_end")
+                outage = True
+            elif ev.action == OUTAGE_END:
+                if not outage:
+                    raise ValueError("outage_end without outage_start")
+                outage = False
+        if outage:
+            raise ValueError("plan ends inside an outage window (no outage_end)")
+
+    @property
+    def horizon_s(self) -> float:
+        return self.events[-1].at_s if self.events else 0.0
+
+    def kills(self) -> "list[ChaosEvent]":
+        return [e for e in self.events if e.action == KILL_NODE]
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "events": [e.to_dict() for e in self.events],
+        }
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        nodes: "list[str]",
+        *,
+        kills: int = 1,
+        horizon_s: float = 10.0,
+        down_s: float = 1.0,
+        watch_breaks: int = 0,
+        outages: int = 0,
+        outage_s: float = 0.3,
+        min_survivors: int = 1,
+    ) -> "ChaosPlan":
+        """A deterministic random schedule: ``kills`` node kills (each
+        revived ``down_s`` later), ``watch_breaks`` stream tears, and
+        ``outages`` apiserver pause windows of ``outage_s``, all placed
+        uniformly over ``horizon_s``.  At most ``len(nodes) -
+        min_survivors`` nodes are ever down at once, so a plan can never
+        script away the capacity recovery needs to land on."""
+        if not nodes and kills:
+            raise ValueError("cannot script node kills with no nodes")
+        rng = random.Random(seed)
+        events: "list[ChaosEvent]" = []
+        # Kill schedule: stagger kills so concurrent downtime never exceeds
+        # the survivor floor (kills are sorted; each victim revives before
+        # enough later kills stack up only if the floor demands it).
+        max_down = max(0, len(nodes) - min_survivors)
+        if kills and max_down == 0:
+            raise ValueError(
+                f"min_survivors={min_survivors} leaves no killable node "
+                f"among {len(nodes)}"
+            )
+        down_windows: "list[tuple[float, float, str]]" = []
+        for _ in range(kills):
+            victim = rng.choice(nodes)
+            for _attempt in range(64):
+                t = rng.uniform(0, horizon_s)
+                end = t + down_s
+                overlapping = [
+                    w for w in down_windows if not (end <= w[0] or t >= w[1])
+                ]
+                if victim in [w[2] for w in overlapping]:
+                    continue  # same node already down in this window
+                if len(overlapping) < max_down:
+                    break
+            else:
+                continue  # couldn't place this kill; keep the plan legal
+            down_windows.append((t, end, victim))
+            events.append(ChaosEvent(t, KILL_NODE, victim))
+            events.append(ChaosEvent(end, REVIVE_NODE, victim))
+        for _ in range(watch_breaks):
+            events.append(ChaosEvent(rng.uniform(0, horizon_s), BREAK_WATCHES))
+        for _ in range(outages):
+            t = rng.uniform(0, max(0.0, horizon_s - outage_s))
+            events.append(ChaosEvent(t, OUTAGE_START))
+            events.append(ChaosEvent(t + outage_s, OUTAGE_END))
+        return cls(events=events, seed=seed)
+
+
+class ChaosRunner:
+    """Executes a ChaosPlan on a background thread.
+
+    Decoupled from SimCluster by callbacks — ``kill(node)`` /
+    ``revive(node)`` — and from the fault wrapper by an optional
+    ``flaky`` (FlakyApiServer) for watch tears and outage windows.
+    ``executed`` records ``(monotonic_offset_s, ChaosEvent)`` for every
+    action that fired, so a bench can correlate recovery latencies with
+    the exact injection times."""
+
+    def __init__(
+        self,
+        plan: ChaosPlan,
+        *,
+        kill=None,
+        revive=None,
+        flaky: "FlakyApiServer | None" = None,
+    ):
+        self.plan = plan
+        self._kill = kill
+        self._revive = revive
+        self._flaky = flaky
+        self.executed: "list[tuple[float, ChaosEvent]]" = []
+        self.errors: "list[tuple[ChaosEvent, Exception]]" = []
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._t0 = 0.0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._t0 = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name="chaos-runner", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        for ev in self.plan.events:
+            delay = self._t0 + ev.at_s - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            try:
+                self._fire(ev)
+            except Exception as e:  # chaos must not crash the harness
+                self.errors.append((ev, e))
+            self.executed.append((time.monotonic() - self._t0, ev))
+
+    def _fire(self, ev: ChaosEvent) -> None:
+        if ev.action == KILL_NODE and self._kill is not None:
+            self._kill(ev.target)
+        elif ev.action == REVIVE_NODE and self._revive is not None:
+            self._revive(ev.target)
+        elif ev.action == BREAK_WATCHES and self._flaky is not None:
+            self._flaky.break_watches()
+        elif ev.action == OUTAGE_START and self._flaky is not None:
+            self._flaky.pause()
+        elif ev.action == OUTAGE_END and self._flaky is not None:
+            self._flaky.resume()
+
+    def join(self, timeout: "float | None" = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def stop(self) -> None:
+        """Abort the remaining schedule; always resumes a paused apiserver
+        (a stopped runner must never leave a permanent outage behind)."""
+        self._stop.set()
+        self.join(timeout=5)
+        if self._flaky is not None:
+            self._flaky.resume()
+
+    @property
+    def done(self) -> bool:
+        t = self._thread
+        return t is not None and not t.is_alive()

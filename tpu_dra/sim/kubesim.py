@@ -67,6 +67,8 @@ class KubeSim:
         namespace: str = "tpu-dra",
         poll_s: float = 0.01,
         exec_proxies: bool = False,
+        evict_after_s: "float | None" = None,
+        recreate_evicted: bool = False,
     ):
         self.clientset = clientset
         self.namespace = namespace
@@ -76,6 +78,18 @@ class KubeSim:
         self._stop = threading.Event()
         self._threads: "list[threading.Thread]" = []
         self._proxy_procs: "dict[str, object]" = {}  # name -> subprocess.Popen
+        # Node-lifecycle eviction (the kube-controller-manager's
+        # node-lifecycle controller): pods bound to a node whose NAS has
+        # been NotReady for evict_after_s are force-deleted, and — with
+        # ``recreate_evicted`` — recreated fresh (same name/spec, new uid,
+        # unbound), the StatefulSet-ish restart the chaos gang workloads
+        # rely on to re-place on surviving nodes.
+        self.evict_after_s = (
+            5 * poll_s if evict_after_s is None else evict_after_s
+        )
+        self.recreate_evicted = recreate_evicted
+        self._not_ready_since: "dict[str, float]" = {}
+        self.evicted: "list[tuple[str, str, str]]" = []  # (ns, pod, node)
         # ready_nodes memo: (monotonic deadline, names).  A real scheduler
         # reads node state from an informer cache, not a LIST per pod; one
         # poll interval of staleness matches that model and takes the
@@ -87,7 +101,11 @@ class KubeSim:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
-        for target in (self._scheduler_loop, self._deployment_controller_loop):
+        for target in (
+            self._scheduler_loop,
+            self._deployment_controller_loop,
+            self._node_lifecycle_loop,
+        ):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
@@ -134,6 +152,66 @@ class KubeSim:
             except Exception:
                 logger.exception("scheduler iteration failed")
             self._stop.wait(self.poll_s)
+
+    def _node_lifecycle_loop(self) -> None:
+        """The node-lifecycle controller: evict pods bound to nodes whose
+        NAS stayed NotReady past the grace window.  Eviction uses the same
+        teardown as a user delete (reservedFor dropped, owner-GC cascades
+        template claims), so the DRA deallocation path runs exactly as it
+        would for a drained node; with ``recreate_evicted`` the pod comes
+        back fresh for the scheduler to re-place on survivors."""
+        while not self._stop.is_set():
+            try:
+                self._evict_dead_node_pods()
+            except Exception:
+                logger.exception("node lifecycle iteration failed")
+            self._stop.wait(self.poll_s)
+
+    def _evict_dead_node_pods(self) -> None:
+        now = time.monotonic()
+        dead: "set[str]" = set()
+        for nas in self.clientset.node_allocation_states(self.namespace).list():
+            node = nas.metadata.name
+            if nas.status == nascrd.STATUS_READY:
+                self._not_ready_since.pop(node, None)
+                continue
+            since = self._not_ready_since.setdefault(node, now)
+            if now - since >= self.evict_after_s:
+                dead.add(node)
+        if not dead:
+            return
+        for pod in self.clientset.pods("").list_all_namespaces():
+            if pod.spec.node_name not in dead or pod.metadata.deletion_timestamp:
+                continue
+            namespace, name = pod.metadata.namespace, pod.metadata.name
+            spec_copy = serde.deepcopy(pod.spec) if self.recreate_evicted else None
+            try:
+                self.delete_pod(namespace, name)
+            except NotFoundError:
+                continue
+            except ApiError:
+                continue  # transient; next poll retries
+            self.evicted.append((namespace, name, pod.spec.node_name))
+            logger.info(
+                "evicted pod %s/%s from dead node %s",
+                namespace, name, pod.spec.node_name,
+            )
+            if spec_copy is not None:
+                # Fresh incarnation: same name and claim entries, new uid,
+                # unbound — template claims re-instantiate once the old
+                # pod's owner-GC'd claim finishes deleting.
+                spec_copy.node_name = ""
+                try:
+                    self.clientset.pods(namespace).create(
+                        Pod(
+                            metadata=ObjectMeta(
+                                name=name, namespace=namespace
+                            ),
+                            spec=spec_copy,
+                        )
+                    )
+                except (AlreadyExistsError, ApiError):
+                    pass
 
     def _deployment_controller_loop(self) -> None:
         """Reconcile Deployments: either actually run proxy daemons as local
@@ -249,6 +327,22 @@ class KubeSim:
             template_name = pod_claim.source.resource_claim_template_name
             try:
                 claim = claims_client.get(name)
+                if template_name and (
+                    claim.metadata.deletion_timestamp
+                    or (
+                        claim.metadata.owner_references
+                        and pod.metadata.uid
+                        not in {
+                            o.uid for o in claim.metadata.owner_references
+                        }
+                    )
+                ):
+                    # A prior incarnation's claim is still dying (eviction
+                    # owner-GC + deallocation finalizer): wait for the name
+                    # to free rather than negotiating against a corpse —
+                    # the real resource-claim-controller recreates only
+                    # after the old object is gone.
+                    return []
             except NotFoundError:
                 if not template_name:
                     return []  # referenced claim doesn't exist (yet)

@@ -323,6 +323,11 @@ REJECTIONS_TOTAL = REGISTRY.counter(
     "Placement rejections by structured reason code "
     "(controller/decisions.py ReasonCode)",
 )
+CLAIM_EVICTIONS = REGISTRY.counter(
+    "tpu_dra_claim_evictions_total",
+    "Allocated claims evicted for re-placement by the node-failure "
+    "recovery sweep (controller/recovery.py), by reason code",
+)
 # Claim lifecycle latency: created -> allocated is a controller-side
 # observation from the claim's creationTimestamp; allocated -> prepared and
 # created -> prepared are plugin-side, joined across processes via the
