@@ -8,7 +8,8 @@ TAG     ?= latest
 
 .PHONY: all test lint generate-crds check-generate native native-test \
         demo-quickstart bench image clean help observability-smoke \
-        perf-smoke explain-smoke serve-smoke serve-obs-smoke chaos-smoke
+        perf-smoke explain-smoke serve-smoke serve-obs-smoke chaos-smoke \
+        fleet-smoke
 
 all: lint test
 
@@ -88,6 +89,15 @@ serve-obs-smoke:
 chaos-smoke:
 	$(PYTHON) -m pytest tests/test_chaos_smoke.py -q -m 'not slow'
 
+# Seeded 2-replica serve fleet on CPU: the second shared-prefix request
+# routes by AFFINITY to the replica that served the first (and hits its
+# prefix cache), /debug/fleet serves the placement flight recorder over
+# HTTP, the tpu_dra_fleet_* series appear in the exposition, and
+# `tpudra fleet-stats` renders the snapshot (docs/SERVING.md "Serve
+# fleet").  The scaling measurement is `bench.py` stanza "serve_fleet".
+fleet-smoke:
+	$(PYTHON) -m pytest tests/test_fleet_smoke.py -q -m 'not slow'
+
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
@@ -100,4 +110,4 @@ help:
 	@echo "targets: test lint generate-crds check-generate native native-test"
 	@echo "         demo-quickstart bench observability-smoke perf-smoke"
 	@echo "         explain-smoke serve-smoke serve-obs-smoke chaos-smoke"
-	@echo "         image clean"
+	@echo "         fleet-smoke image clean"

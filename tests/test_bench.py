@@ -43,6 +43,13 @@ def test_bench_emits_one_json_line(monkeypatch):
         "bench_chaos",
         lambda: {"ok": True, "recovery_p95_s": 0.0, "stubbed": True},
     )
+    # And the fleet child (eleven engines across four fleets); its own
+    # coverage is test_bench_serve_fleet_stanza.
+    monkeypatch.setattr(
+        bench,
+        "bench_serve_fleet",
+        lambda: {"ok": True, "scaling": {"x2": 2.0}, "stubbed": True},
+    )
     import io
     from contextlib import redirect_stdout
 
@@ -59,7 +66,7 @@ def test_bench_emits_one_json_line(monkeypatch):
     extras = parsed["extras"]
     assert {
         "rung", "target_s", "fleet", "wire", "northstar_mesh",
-        "serve_prefix", "chaos", "compute",
+        "serve_prefix", "serve_fleet", "chaos", "compute",
     } <= extras.keys()
     assert extras["fleet"]["target_met"]
     assert extras["wire"]["target_met"]
@@ -104,6 +111,38 @@ def test_bench_serve_prefix_stanza():
     tel = out["telemetry"]
     assert {"tokens_per_s_on", "tokens_per_s_off", "ratio"} <= tel.keys()
     assert tel["within_noise"], tel
+
+
+@pytest.mark.slow
+def test_bench_serve_fleet_stanza():
+    """The serve-fleet stanza (ISSUE 7): 1/2/4 prefix-affinity-routed
+    replicas on a shared-system-prompt stream must report aggregate
+    tokens/s with >= 1.7x scaling at 2 replicas, affinity routing must
+    beat seeded random routing on TTFT p50 at the same fleet size, and
+    greedy outputs must be token-identical across every fleet size and
+    routing policy (asserted inside the child; re-pinned here)."""
+    import bench
+
+    out = bench.bench_serve_fleet()
+    assert out.get("ok"), out
+    assert out["greedy_identical"]
+    fleets = out["fleets"]
+    assert {"n1", "n2", "n4", "rand4"} <= fleets.keys()
+    for tag, n in (("n1", 1), ("n2", 2), ("n4", 4), ("rand4", 4)):
+        assert fleets[tag]["replicas"] == n
+        assert fleets[tag]["tokens_per_s"] > 0
+    assert out["scaling"]["x2"] >= 1.7
+    assert out["scaling"]["x4"] >= 3.0
+    avr = out["affinity_vs_random"]
+    assert avr["ttft_p50_affinity_s"] < avr["ttft_p50_random_s"]
+    assert avr["hit_rate_affinity"] > avr["hit_rate_random"]
+    # The capacity story: hit rate recovers as families-per-replica
+    # shrinks (the router partitions the prefix working set).
+    assert (
+        fleets["n1"]["hit_rate"]
+        < fleets["n2"]["hit_rate"]
+        < fleets["n4"]["hit_rate"]
+    )
 
 
 @pytest.mark.slow

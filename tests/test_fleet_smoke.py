@@ -1,0 +1,124 @@
+"""`make fleet-smoke`: the CI-fast functional floor for the serve fleet
+(docs/SERVING.md "Serve fleet").
+
+One seeded 2-replica fleet, one shared-system-prompt stream, the whole
+story asserted in a few seconds: the second same-prefix request routes
+by AFFINITY to the replica that served the first (and actually hits its
+prefix cache), `/debug/fleet` serves the placement flight recorder over
+real HTTP (json + text + 400 on bad queries), the ``tpu_dra_fleet_*``
+series appear in the Prometheus exposition, and `tpudra fleet-stats`
+renders the snapshot.
+"""
+
+import io
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_dra.fleet import stats as fleetstats
+from tpu_dra.fleet.fleet import ServeFleet
+from tpu_dra.parallel.burnin import BurninConfig, init_params
+from tpu_dra.parallel.serve import ServeEngine
+from tpu_dra.utils.metrics import REGISTRY, MetricsServer
+
+CFG = BurninConfig(
+    vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=32, batch=2
+)
+
+
+def test_fleet_routes_by_affinity_and_exposes_debug_endpoint():
+    params = init_params(CFG)
+    system = [5, 9, 2, 7, 11, 3, 8, 1]
+
+    def eng(name):
+        return ServeEngine(
+            params, CFG, slots=2, prompt_slots=16, max_new_cap=4,
+            prefix_cache_slots=4, prefix_window=4, name=name,
+        )
+
+    fleet = ServeFleet(
+        [eng("smoke-0"), eng("smoke-1")], seed=42, name="fleet-smoke"
+    )
+    server = MetricsServer("127.0.0.1:0")
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        # First request: cold, lands somewhere by load.
+        fid0 = fleet.submit(system + [20], 2)
+        fleet.run()
+        home = fleet.result(fid0).replica
+        hits_before = fleet.engine(home).prefix_stats["hits"]
+        # Second request, same system prefix: AFFINITY to the same
+        # replica, and a real prefix-cache hit there.
+        fid1 = fleet.submit(system + [21], 2)
+        fleet.run()
+        assert fleet.result(fid1).replica == home
+        assert fleet.result(fid1).prefix_reused > 0
+        assert fleet.engine(home).prefix_stats["hits"] > hits_before
+        records = fleetstats.RECORDER.query(fleet="fleet-smoke")
+        assert [r.reason for r in records] == ["load", "affinity"]
+
+        # /debug/fleet over real HTTP: json with records + summary.
+        with urllib.request.urlopen(
+            f"{base}/debug/fleet?fleet=fleet-smoke"
+        ) as resp:
+            doc = json.loads(resp.read().decode())
+        assert doc["recorded"] >= 2
+        placements = doc["placements"]
+        assert [p["reason"] for p in placements] == ["load", "affinity"]
+        assert placements[1]["replica"] == home
+        assert placements[1]["matched"] > 0
+        assert doc["summary"]["by_replica"][home] == 2
+        # format=text renders the table; filters narrow.
+        with urllib.request.urlopen(
+            f"{base}/debug/fleet?fleet=fleet-smoke&format=text"
+        ) as resp:
+            text = resp.read().decode()
+        assert "affinity" in text and home in text
+        with urllib.request.urlopen(
+            f"{base}/debug/fleet?fleet=fleet-smoke&reason=affinity"
+        ) as resp:
+            only = json.loads(resp.read().decode())["placements"]
+        assert len(only) == 1 and only[0]["reason"] == "affinity"
+        # Bad queries are 400s, like every sibling endpoint.
+        for bad in ("limit=0", "limit=x", "format=yaml"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{base}/debug/fleet?{bad}")
+            assert e.value.code == 400
+
+        # The fleet series are in the exposition and moved.
+        fleet.scale_hint()
+        expo = REGISTRY.expose()
+        for name in (
+            "tpu_dra_fleet_routed_total",
+            "tpu_dra_fleet_digest_age_seconds",
+            "tpu_dra_fleet_load_skew",
+            "tpu_dra_fleet_queue_depth",
+            "tpu_dra_fleet_scale_hints_total",
+        ):
+            assert name in expo, f"{name} missing from the exposition"
+        routed = [
+            ln for ln in expo.splitlines()
+            if ln.startswith("tpu_dra_fleet_routed_total{")
+        ]
+        assert any('reason="affinity"' in ln for ln in routed), routed
+
+        # The CLI renders the same snapshot (no curl required).
+        from tpu_dra.cmds.explain import fleet_stats, parse_args
+
+        out = io.StringIO()
+        rc = fleet_stats(
+            parse_args(
+                ["fleet-stats", "--endpoint", base, "--fleet",
+                 "fleet-smoke"]
+            ),
+            out=out,
+        )
+        assert rc == 0
+        rendered = out.getvalue()
+        assert "affinity" in rendered and home in rendered
+    finally:
+        server.stop()
+        fleet.close()

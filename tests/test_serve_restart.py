@@ -135,6 +135,93 @@ class TestWarmRestart:
         busy.close()
 
 
+class TestClosedAndEmptyCheckpoints:
+    """ISSUE 7 satellite: the stays-readable-after-close contract pinned
+    on its own (including the empty-cache corner), and warm_start's
+    skip-not-raise behavior on empty exported indexes."""
+
+    def test_export_on_closed_engine_with_empty_cache(self):
+        # An engine can die before anything was resident: its checkpoint
+        # is EMPTY, and must still be readable after close — the
+        # checkpoint is typically taken from the dying engine.
+        eng = engine(name="empty-export")
+        eng.close()
+        index = eng.export_prefix_index()
+        assert index["version"] == 1 and index["entries"] == []
+        # warm_start on the empty checkpoint SKIPS (0 warmed), never
+        # raises — a restart after a crash-at-boot must not crash again.
+        warm = engine(name="empty-warm")
+        assert warm.warm_start(index) == 0
+        assert warm.warm_start({"version": 1, "entries": []}) == 0
+        assert warm.warm_start({"entries": None}) == 0
+        assert warm.warm_start({}) == 0
+        assert warm.prefix_stats["resident"] == 0
+        # The engine is fully servable after the no-op warm starts.
+        warm.submit(SYSTEM, 2)
+        assert warm.run()[0].tokens
+        warm.close()
+
+    def test_prefix_digest_readable_after_close(self):
+        eng = engine(name="digest-after-close")
+        run_stream(eng)
+        eng.close()
+        digest = eng.prefix_digest()
+        assert digest.replica == "digest-after-close"
+        assert digest.entries > 0
+        matched, _ = digest.lookup(SYSTEM + [0])
+        assert matched >= 8  # the shared system prefix is claimed
+
+
+class TestFleetFacingSurface:
+    """The serve-layer growth the fleet rides on (ISSUE 7 tentpole seam):
+    peek without counters, backdated timelines, request lookup."""
+
+    def test_peek_prefix_moves_no_counters(self):
+        eng = engine(name="peek")
+        eng.submit(REQS[0], 2)
+        eng.run()
+        stats = eng.prefix_stats
+        assert eng.peek_prefix(SYSTEM + [0]) >= 8
+        assert eng.peek_prefix([63] * 8) == 0
+        after = eng.prefix_stats
+        assert (after["hits"], after["misses"]) == (
+            stats["hits"], stats["misses"],
+        )
+        # Epoch moves with residency, not with peeks.
+        assert after["epoch"] == stats["epoch"] > 0
+        eng.close()
+
+    def test_submit_backdates_enqueued_at_but_never_forward(self):
+        import time
+
+        eng = engine(name="backdate")
+        t0 = time.perf_counter() - 1.5
+        rid = eng.request(eng.submit(REQS[0], 2, enqueued_at=t0)).id
+        eng.run()
+        req = eng.request(rid)
+        assert req.done
+        # The fleet-side 1.5s is in the timeline.
+        assert req.queue_wait_s >= 1.5
+        assert req.ttft_s >= req.queue_wait_s
+        # A FUTURE enqueued_at clamps to now: waits never go negative.
+        rid2 = eng.submit(REQS[1], 2, enqueued_at=time.perf_counter() + 99)
+        eng.run()
+        req2 = eng.request(rid2)
+        assert 0.0 <= req2.queue_wait_s <= req2.ttft_s
+        eng.close()
+
+    def test_request_lookup_and_replica_stamp(self):
+        eng = engine(name="lookup")
+        rid = eng.submit(REQS[0], 2)
+        assert eng.request(rid) is not None
+        assert eng.request(rid).replica == "lookup"
+        assert eng.request(9999) is None
+        eng.run()
+        assert eng.request(rid).done
+        assert eng.replica_id == "lookup"
+        eng.close()
+
+
 class TestCleanDeath:
     def test_submit_and_tick_after_close_raise_runtime_error(self):
         eng = engine(name="death")

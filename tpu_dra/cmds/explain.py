@@ -23,6 +23,12 @@ recorder from the ``/debug/engine`` endpoint (utils/servestats.py):
     @ 86.0/s, occupancy mean 3.4, queue max 7, step p50 11.02ms p95
     14.80ms, goodput 0.92 (11 met / 1 missed)
     ...one row per tick...
+
+`tpudra fleet-stats` is the fleet-router layer above it — "why did my
+request land on THAT replica?" — rendering the placement flight
+recorder from ``/debug/fleet`` (tpu_dra/fleet/stats.py): per-replica
+placement counts, affinity/load/spill reason breakdown, digest ages,
+and the per-replica loads each decision saw.
 """
 
 from __future__ import annotations
@@ -108,6 +114,46 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
     stats.add_argument(
         "--limit", type=int, default=256,
         help="max step records to fetch",
+    )
+
+    fleet = sub.add_parser(
+        "fleet-stats",
+        help="fleet router placement snapshot from /debug/fleet",
+    )
+    fleet.add_argument(
+        "--endpoint",
+        default=flags._env_default("TPUDRA_FLEET", "http://127.0.0.1:8080"),
+        help="fleet process debug HTTP endpoint (its MetricsServer "
+        "address) [TPUDRA_FLEET]",
+    )
+    fleet.add_argument(
+        "--pprof-path",
+        default="/debug",
+        help="debug path prefix (matches the server's --pprof-path)",
+    )
+    fleet.add_argument(
+        "--fleet",
+        default="",
+        help="only this fleet's placements (the ServeFleet name)",
+    )
+    fleet.add_argument(
+        "--replica",
+        default="",
+        help="only placements that landed on this replica",
+    )
+    fleet.add_argument(
+        "--reason",
+        default="",
+        help="only placements with this reason "
+        "(affinity | load | spill | random | round_robin)",
+    )
+    fleet.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output form (text: summary + per-placement rows; json: raw)",
+    )
+    fleet.add_argument(
+        "--limit", type=int, default=256,
+        help="max placement records to fetch",
     )
     return parser.parse_args(argv)
 
@@ -245,12 +291,77 @@ def serve_stats(args: argparse.Namespace, out=None) -> int:
     return 0
 
 
+def _fetch_fleet(args: argparse.Namespace) -> dict:
+    query = urllib.parse.urlencode(
+        {
+            "format": "json",
+            "limit": args.limit,
+            **({"fleet": args.fleet} if args.fleet else {}),
+            **({"replica": args.replica} if args.replica else {}),
+            **({"reason": args.reason} if args.reason else {}),
+        }
+    )
+    base = args.endpoint.rstrip("/")
+    pprof = "/" + args.pprof_path.strip("/")
+    url = f"{base}{pprof}/fleet?{query}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def fleet_stats(args: argparse.Namespace, out=None) -> int:
+    from tpu_dra.fleet import stats as fleetstats
+
+    # Call-time stream resolution, like serve_stats (the import-time
+    # sys.stdout default would freeze pytest's capture object).
+    out = sys.stdout if out is None else out
+    try:
+        doc = _fetch_fleet(args)
+    except (urllib.error.URLError, OSError) as e:
+        print(
+            f"error: cannot reach fleet endpoint at {args.endpoint}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+
+    if args.format == "json":
+        print(json.dumps(doc, indent=2), file=out)
+        return 0
+    # Version-skew tolerance, like serve-stats: drop unknown fields.
+    known = fleetstats.PlacementRecord.__dataclass_fields__.keys()
+    records = [
+        fleetstats.PlacementRecord(
+            **{k: v for k, v in r.items() if k in known}
+        )
+        for r in doc.get("placements", [])
+    ]
+    if not records:
+        which = f" for fleet {args.fleet!r}" if args.fleet else ""
+        print(
+            f"no fleet placements recorded{which} "
+            f"(recorded={doc.get('recorded', 0)}, "
+            f"dropped={doc.get('dropped', 0)}; is a ServeFleet routing "
+            "requests?)",
+            file=out,
+        )
+    else:
+        print(fleetstats.render_text(records), end="", file=out)
+        if doc.get("dropped"):
+            print(
+                f"(flight recorder wrapped: {doc['dropped']} older "
+                "record(s) dropped)",
+                file=out,
+            )
+    return 0
+
+
 def main(argv: "list[str] | None" = None) -> int:
     args = parse_args(argv)
     if args.command == "explain":
         return explain(args)
     if args.command == "serve-stats":
         return serve_stats(args)
+    if args.command == "fleet-stats":
+        return fleet_stats(args)
     return 2  # unreachable: subparsers are required
 
 

@@ -1,0 +1,50 @@
+"""tpu_dra.fleet — the cluster-level serving tier (ROADMAP open item 2).
+
+One `ServeEngine` is per-node actuation; this package is the layer that
+makes N of them serve as ONE system:
+
+- ``tpu_dra.fleet.digest`` — compact, gossipable summaries of each
+  replica's resident KV prefixes (hashed window-aligned token-run
+  prefixes + hit counts, built on ``export_prefix_index``).
+- ``tpu_dra.fleet.router`` — `PrefixRouter`: place each request on the
+  replica already holding its longest prompt prefix, shed to a colder
+  replica past a configurable load skew, with goodput-aware load.
+- ``tpu_dra.fleet.fleet``  — `ServeFleet`: owns the replicas, the
+  fleet-level queue, live digest refresh + staleness spill, threaded
+  ticks, and the `scale_hint()` autoscaling signal.
+- ``tpu_dra.fleet.stats``  — the jax-free placement flight recorder
+  behind ``/debug/fleet`` and ``tpudra fleet-stats``.
+
+``digest``/``router``/``stats`` are jax-free by design (a router is
+control-plane code); only ``fleet`` touches engines.  `ServeFleet` is
+re-exported lazily so ``from tpu_dra.fleet import ServeFleet`` works
+without making ``import tpu_dra.fleet.stats`` (as a control-plane binary
+would) drag in the compute stack.
+
+See docs/SERVING.md "Serve fleet" for the routing algorithm and
+docs/OBSERVABILITY.md for ``/debug/fleet`` and the
+``tpu_dra_fleet_*`` metrics.
+"""
+
+from __future__ import annotations
+
+__all__ = ["PrefixRouter", "ReplicaDigest", "ServeFleet"]
+
+
+def __getattr__(name: str):
+    # PEP 562 lazy exports: ServeFleet imports parallel/serve (jax);
+    # resolving it on ATTRIBUTE access keeps `import tpu_dra.fleet` and
+    # its jax-free submodules importable from control-plane processes.
+    if name == "ServeFleet":
+        from tpu_dra.fleet.fleet import ServeFleet
+
+        return ServeFleet
+    if name == "PrefixRouter":
+        from tpu_dra.fleet.router import PrefixRouter
+
+        return PrefixRouter
+    if name == "ReplicaDigest":
+        from tpu_dra.fleet.digest import ReplicaDigest
+
+        return ReplicaDigest
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,144 @@
+"""Prefix digests — the gossipable summary of one replica's KV residency.
+
+The fleet router (`tpu_dra/fleet/router.py`) wants to answer "which
+replica already holds the longest prefix of this prompt?" without
+shipping every replica's full radix index around: indexes carry whole
+token runs (kilobytes per entry, user content included), and in a real
+deployment the answer must survive a network hop to a router that never
+sees the KV itself.
+
+A ``ReplicaDigest`` is that answer's data structure: for every resident
+token run, the run's WINDOW-ALIGNED prefixes (the granularity at which
+the engine can actually skip prefill work — a sub-window match saves
+nothing, exactly the engine's ``min_use`` rule) are hashed down to 8
+bytes each and stored as ``hash -> hits``.  Lookup hashes the request
+prompt's window-aligned prefixes longest-first and returns the first
+resident length.  Properties that matter:
+
+- **Compact and content-free**: a few hundred bytes per resident entry,
+  no token runs — safe to gossip, log, or expose on ``/debug/fleet``.
+- **Conservative by construction**: a digest can only claim prefixes
+  that WERE resident at build time.  It can go stale (the entry evicted
+  since) — placement verifies against the live engine and falls back to
+  load routing, counted as ``reason="spill"`` — but a fresh digest never
+  invents a hit.  Hash collisions (8-byte keyspace) are theoretically
+  possible and land in the same spill path: the verify, not the digest,
+  is the source of truth.
+- **Epoch-fenced**: the digest carries the prefix cache's residency
+  epoch (`PrefixCache.epoch` — bumped on every insert/eviction), so a
+  holder knows to refresh by comparing integers, not contents.
+
+Built on `ServeEngine.export_prefix_index()` (the warm-restart
+checkpoint) via `ServeEngine.prefix_digest()`; jax-free ON PURPOSE so
+routers and control-plane binaries can hold digests without dragging in
+the compute stack (the ``servestats`` discipline).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+
+__all__ = ["ReplicaDigest", "build_digest", "empty_digest"]
+
+
+def hash_run(tokens: "list[int]") -> str:
+    """Stable 8-byte digest of a token run (hex).  The token ids are
+    joined unambiguously (comma-separated decimal), so distinct runs
+    never alias by concatenation."""
+    data = b",".join(b"%d" % t for t in tokens)
+    return hashlib.blake2b(data, digest_size=8).hexdigest()
+
+
+@dataclass
+class ReplicaDigest:
+    """One replica's resident-prefix summary at a point in time.
+
+    ``prefixes`` maps ``hash_run(tokens[:k*window]) -> hits`` for every
+    resident run and every window multiple k; ``max_len`` bounds the
+    longest claimable prefix so lookups stop early; ``epoch`` is the
+    source cache's residency epoch at build time; ``built_at`` (wall
+    clock) feeds the ``tpu_dra_fleet_digest_age_seconds`` gauge."""
+
+    replica: str
+    window: int = 1
+    epoch: int = 0
+    built_at: float = 0.0
+    max_len: int = 0
+    prefixes: "dict[str, int]" = field(default_factory=dict)
+
+    @property
+    def entries(self) -> int:
+        return len(self.prefixes)
+
+    def age_s(self, now: "float | None" = None) -> float:
+        return max(0.0, (time.time() if now is None else now) - self.built_at)
+
+    def lookup(self, tokens: "list[int]") -> "tuple[int, int]":
+        """Longest window-aligned prefix of ``tokens`` this digest
+        claims resident: ``(matched_len, hits)`` — ``(0, 0)`` when
+        nothing matches.  Longest-first probing, so cost is bounded by
+        ``max_len / window`` hashes per lookup."""
+        if self.window < 1 or self.max_len < 1:
+            return 0, 0
+        # The engine always recomputes the last prompt position, so a
+        # whole-prompt match is only usable at len - 1 — mirror the
+        # cache's cap here so the router's promise matches what the
+        # engine can deliver.
+        limit = min(len(tokens) - 1, self.max_len)
+        for k in range(limit // self.window, 0, -1):
+            h = hash_run(tokens[: k * self.window])
+            hits = self.prefixes.get(h)
+            if hits is not None:
+                return k * self.window, hits
+        return 0, 0
+
+    def to_dict(self) -> dict:
+        """json-able form for ``/debug/fleet`` and the CLI — sizes and
+        identity, not the hash table (which is transport detail)."""
+        return {
+            "replica": self.replica,
+            "window": self.window,
+            "epoch": self.epoch,
+            "built_at": self.built_at,
+            "age_s": round(self.age_s(), 3),
+            "entries": self.entries,
+            "max_len": self.max_len,
+        }
+
+
+def empty_digest(replica: str) -> ReplicaDigest:
+    """The digest of an engine with no prefix cache (or nothing
+    resident): matches nothing, so affinity routing simply never picks
+    the replica — it still serves by load."""
+    return ReplicaDigest(replica=replica, window=1, built_at=time.time())
+
+
+def build_digest(index: dict, *, replica: str, epoch: int = 0,
+                 window: "int | None" = None) -> ReplicaDigest:
+    """Digest an exported prefix index (`ServeEngine.export_prefix_index`
+    output: ``{"prefix_window": W, "entries": [{"tokens", "hits"}...]}``).
+    Every resident run contributes all of its window-aligned prefixes;
+    a prefix shared by several runs keeps the hottest run's hit count
+    (the router only uses hits to break exact ties)."""
+    if window is None:
+        window = index.get("prefix_window") or 1
+    window = int(window)
+    if window < 1:
+        raise ValueError(f"digest window must be >= 1, got {window}")
+    prefixes: "dict[str, int]" = {}
+    max_len = 0
+    for entry in index.get("entries") or ():
+        tokens = entry.get("tokens") or []
+        hits = int(entry.get("hits", 0))
+        aligned = (len(tokens) // window) * window
+        for k in range(1, aligned // window + 1):
+            h = hash_run(tokens[: k * window])
+            if hits > prefixes.get(h, -1):
+                prefixes[h] = hits
+        max_len = max(max_len, aligned)
+    return ReplicaDigest(
+        replica=replica, window=window, epoch=epoch,
+        built_at=time.time(), max_len=max_len, prefixes=prefixes,
+    )

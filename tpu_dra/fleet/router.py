@@ -1,0 +1,179 @@
+"""Prefix-affinity request routing across serve-engine replicas.
+
+One engine's prefix cache turns a shared-system-prompt stream's
+admissions from O(prompt) into O(suffix) — but a fleet of N engines only
+keeps that property if requests LAND where their prefix lives.  Spray
+requests randomly and every replica must hold every hot prefix: the
+fleet's effective cache is one replica's pool.  Route by affinity and
+the pools PARTITION the prefix working set — N small pools behave like
+one N-times-larger cache, which is where the near-linear aggregate
+throughput on shared-prefix traffic comes from (the ``serve_fleet``
+bench stanza measures exactly this).
+
+The router is deliberately dumb and stateless about requests (placement
+is per-request, no sessions): given a prompt and a snapshot of replica
+state (`ReplicaView`: digest + live queue depth / batch occupancy /
+rolling goodput), it answers with one `Placement`:
+
+1. **Affinity** — the replica whose digest claims the longest resident
+   window-aligned prefix of the prompt wins (ties: hotter entry, then
+   lower load).  ``reason="affinity"``.
+2. **Load shedding** — affinity is a preference, not a command: when the
+   affinity winner's load exceeds the coldest replica's by more than
+   ``load_skew`` (in rounds-of-work-per-slot), the request sheds to the
+   coldest replica instead (``reason="load"``).  Recomputing a prefix is
+   cheaper than queueing behind a hot spot.
+3. **No match** — least-loaded replica (``reason="load"``).
+
+Load is ``(queue_depth + occupancy) / slots`` — how many rounds of work
+are already committed per compiled batch row — plus a goodput penalty:
+a replica missing its SLOs (rolling goodput < 1 from the PR-5 step
+flight recorder) looks ``goodput_weight * (1 - goodput)`` rounds more
+loaded, so degraded replicas shed traffic before they melt.
+
+Digest staleness is the CALLER's job: the fleet verifies an affinity
+placement against the live engine (`ServeEngine.peek_prefix`) and
+re-routes by load with ``reason="spill"`` when the promised prefix was
+evicted between digest refresh and placement — see
+`tpu_dra/fleet/fleet.py`.  ``policy="random"`` (seeded) and
+``policy="round_robin"`` exist as the control arms for benchmarks.
+
+jax-free on purpose, like `digest.py`: a router is control-plane code.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+from tpu_dra.fleet.digest import ReplicaDigest
+
+__all__ = ["Placement", "PrefixRouter", "ReplicaView"]
+
+POLICIES = ("affinity", "random", "round_robin")
+
+# Placement reason vocabulary (the ``reason`` label of
+# ``tpu_dra_fleet_routed_total``): affinity = digest match won; load =
+# no usable match, or the match shed to a colder replica; spill = the
+# fleet's live verify found the digest stale (entry evicted since
+# refresh) and fell back to load routing; random / round_robin = the
+# benchmark control policies.
+AFFINITY, LOAD, SPILL = "affinity", "load", "spill"
+
+
+@dataclass
+class ReplicaView:
+    """One replica's routing-relevant state at placement time."""
+
+    name: str
+    digest: "ReplicaDigest | None" = None
+    queue_depth: int = 0
+    occupancy: int = 0
+    slots: int = 1
+    goodput: "float | None" = None  # rolling, None = no SLO signal
+
+
+@dataclass
+class Placement:
+    """The router's answer: where, why, and on what evidence."""
+
+    replica: str
+    reason: str
+    matched: int = 0  # digest-claimed prefix tokens (affinity only)
+    load: float = 0.0  # chosen replica's load at placement
+    digest_age_s: float = 0.0  # chosen replica's digest age (0 if none)
+    # Loads of every candidate at decision time (observability: the
+    # ``/debug/fleet`` record shows what the router saw, not just what
+    # it picked).
+    loads: "dict[str, float]" = field(default_factory=dict)
+
+
+class PrefixRouter:
+    """Stateless-per-request placement policy over `ReplicaView`s.
+
+    ``load_skew``: how much hotter (rounds per slot) the affinity winner
+    may run than the coldest replica before the request sheds to the
+    cold one.  0 disables stickiness entirely (any imbalance sheds);
+    large values trust affinity absolutely.  ``goodput_weight``: rounds
+    of phantom load added per unit of missed goodput.  ``seed`` makes
+    the random policy reproducible."""
+
+    def __init__(self, *, policy: str = "affinity", load_skew: float = 2.0,
+                 goodput_weight: float = 1.0, seed: int = 0):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"policy must be one of {POLICIES}, got {policy!r}"
+            )
+        if load_skew < 0:
+            raise ValueError(f"load_skew must be >= 0, got {load_skew}")
+        self.policy = policy
+        self.load_skew = load_skew
+        self.goodput_weight = goodput_weight
+        self._rng = random.Random(seed)
+        self._rr = 0
+
+    def load_of(self, view: ReplicaView) -> float:
+        load = (view.queue_depth + view.occupancy) / max(1, view.slots)
+        if view.goodput is not None:
+            load += self.goodput_weight * (1.0 - view.goodput)
+        return load
+
+    def route(self, prompt: "list[int]",
+              views: "list[ReplicaView]") -> Placement:
+        """Place ``prompt`` on one of ``views``; raises ValueError on an
+        empty fleet (zero replicas is a config error, not a queue)."""
+        if not views:
+            raise ValueError("cannot route: no replicas")
+        loads = {v.name: round(self.load_of(v), 4) for v in views}
+        if self.policy == "random":
+            pick = self._rng.choice(views)
+            return Placement(
+                replica=pick.name, reason="random",
+                load=loads[pick.name], loads=loads,
+                digest_age_s=pick.digest.age_s() if pick.digest else 0.0,
+            )
+        if self.policy == "round_robin":
+            pick = views[self._rr % len(views)]
+            self._rr += 1
+            return Placement(
+                replica=pick.name, reason="round_robin",
+                load=loads[pick.name], loads=loads,
+                digest_age_s=pick.digest.age_s() if pick.digest else 0.0,
+            )
+
+        coldest = min(views, key=lambda v: (loads[v.name], v.name))
+        best, best_key = None, (0, 0, 0.0)
+        for v in views:
+            if v.digest is None:
+                continue
+            matched, hits = v.digest.lookup(prompt)
+            if matched <= 0:
+                continue
+            # Longest match wins; among equals the hotter entry, then
+            # the colder replica (negated load — higher key wins).
+            key = (matched, hits, -loads[v.name])
+            if best is None or key > best_key:
+                best, best_key = v, key
+        if best is None:
+            return Placement(
+                replica=coldest.name, reason=LOAD,
+                load=loads[coldest.name], loads=loads,
+                digest_age_s=(
+                    coldest.digest.age_s() if coldest.digest else 0.0
+                ),
+            )
+        if loads[best.name] - loads[coldest.name] > self.load_skew:
+            # Shed: the prefix is there but the queue in front of it
+            # costs more than recomputing the prefill somewhere cold.
+            return Placement(
+                replica=coldest.name, reason=LOAD,
+                load=loads[coldest.name], loads=loads,
+                digest_age_s=(
+                    coldest.digest.age_s() if coldest.digest else 0.0
+                ),
+            )
+        return Placement(
+            replica=best.name, reason=AFFINITY, matched=best_key[0],
+            load=loads[best.name], loads=loads,
+            digest_age_s=best.digest.age_s(),
+        )

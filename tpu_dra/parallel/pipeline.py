@@ -160,16 +160,12 @@ def _pipelined_blocks(layers, x, *, config, mesh):
         )
         return h, aux
 
-    @functools.partial(
-        jax.shard_map,
-        mesh=mesh,
-        # Only the layer stack is pipe-mapped; activations are replicated
-        # over pipe and stay GLOBAL over the auto axes (data/model).
-        in_specs=(P("pipe"), P()),
-        out_specs=(P(), P()),
-        axis_names={"pipe"},
-        check_vma=False,  # per-stage state diverges until the final psum
+    # Only the layer stack is pipe-mapped; activations are replicated
+    # over pipe and stay GLOBAL over the auto axes (data/model).
+    smap_kwargs = dict(
+        mesh=mesh, in_specs=(P("pipe"), P()), out_specs=(P(), P())
     )
+
     def run(stage_layers, xb):
         # stage_layers: this rank's (L/P, ...) slice of every layer leaf.
         # xb: the (global-batch, S, D) activations — every stage holds
@@ -218,5 +214,22 @@ def _pipelined_blocks(layers, x, *, config, mesh):
         # mean.  (data/model are auto axes: aux is already global there.)
         aux = lax.psum(aux, "pipe") / M
         return outs.reshape(xb.shape), aux
+
+    # Checking is off either way (per-stage state diverges until the
+    # final psum); on older jax the partial-manual form is the
+    # experimental API's ``auto=`` (everything but pipe stays auto).
+    try:
+        from jax import shard_map  # jax >= 0.8 API
+
+        run = shard_map(
+            run, **smap_kwargs, axis_names={"pipe"}, check_vma=False
+        )
+    except (ImportError, TypeError):  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+        run = shard_map(
+            run, **smap_kwargs,
+            auto=frozenset(mesh.axis_names) - {"pipe"}, check_rep=False,
+        )
 
     return run(layers, x)

@@ -118,6 +118,11 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # Residency epoch: bumped whenever the SET of resident prefixes
+        # changes (insert / eviction).  A consumer holding a derived view
+        # of the index — the fleet router's prefix digest — compares
+        # epochs to know its view is stale without diffing token runs.
+        self.epoch = 0
 
     # -- lookup ----------------------------------------------------------
     def _walk(self, tokens: "list[int]"):
@@ -198,6 +203,23 @@ class PrefixCache:
         entry.last_used = self._tick
         return entry, use, matched
 
+    def peek(self, tokens: "list[int]", min_use: int = 1) -> int:
+        """`match` as a pure question: the usable resident-prefix length
+        of ``tokens`` (0 when it would miss) WITHOUT moving hit/miss
+        counters, hotness, or recency.  The fleet router's staleness
+        probe: placement verifies a digest-promised prefix against the
+        live index here, and a verify must not inflate the stats or
+        re-warm an entry the engine never used."""
+        node, matched = self._walk(tokens)
+        use = min(matched, len(tokens) - 1)
+        if use <= 0:
+            return 0
+        entry = self._best_in_subtree(node)
+        if entry is None:
+            return 0
+        use = min(use, entry.length)
+        return use if use >= max(1, min_use) else 0
+
     # -- pinning ---------------------------------------------------------
     def acquire(self, entry: PrefixEntry) -> None:
         entry.refcount += 1
@@ -217,6 +239,7 @@ class PrefixCache:
         victim = min(victims, key=lambda e: e.last_used)
         self._detach(victim)
         self.evictions += 1
+        self.epoch += 1
         SERVE_PREFIX_EVICTIONS.inc()
         return victim.slot
 
@@ -283,6 +306,7 @@ class PrefixCache:
         )
         node.entry = entry
         self._entries.append(entry)
+        self.epoch += 1
         return entry
 
     def _node_depth(self, node: "_Node") -> int:
@@ -346,4 +370,5 @@ class PrefixCache:
             "evictions": self.evictions,
             "resident": self.resident,
             "pool_slots": self.pool_slots,
+            "epoch": self.epoch,
         }

@@ -170,6 +170,10 @@ class Request:
     prefix_reused: int = 0
     submitted_at: float = 0.0
     ttft_s: float = 0.0
+    # The engine that served this request (ServeEngine.name, stamped at
+    # submit) — fleet results self-identify their replica, and ids are
+    # only unique per engine, so (replica, id) is the fleet-wide key.
+    replica: str = ""
     # Lifecycle timeline (host perf_counter clock, monotonic):
     # enqueued (== submitted_at) <= admitted <= first_token <= finished.
     # queue_wait_s = admitted - enqueued; ttft_s = first_token - enqueued
@@ -319,6 +323,7 @@ class ServeEngine:
         self._row_pins: "list[list]" = [[] for _ in range(slots)]
         self._queue: "list[Request]" = []
         self._done: "list[Request]" = []
+        self._by_id: "dict[int, Request]" = {}
         self._next_id = 0
         self._closed = False
         self._prefill_tokens = {"computed": 0, "reused": 0}
@@ -517,7 +522,8 @@ class ServeEngine:
     def submit(self, prompt: "list[int]", max_new: "int | None" = None,
                seed: "int | None" = None,
                stop_sequences: "list[list[int]] | None" = None,
-               use_prefix_cache: bool = True) -> int:
+               use_prefix_cache: bool = True,
+               enqueued_at: "float | None" = None) -> int:
         """Queue a request; returns its id.  Admission happens on `tick`.
         ``seed`` keys this request's sampling (default: the request id) —
         its output depends on (seed, position) only, never on
@@ -527,12 +533,48 @@ class ServeEngine:
         "stop").  ``use_prefix_cache=False`` opts this request out of
         the engine's prefix cache (no reuse, no pool insertion — for
         privacy-scoped prompts or A/B measurement); a no-op on engines
-        built without ``prefix_cache_slots``.
+        built without ``prefix_cache_slots``.  ``enqueued_at``: backdate
+        the timeline's enqueue point (a ``perf_counter`` timestamp, only
+        ever moved EARLIER) — a fleet front-end that parked the request
+        in its own queue passes the original arrival time so
+        ``queue_wait_s``/``ttft_s`` keep measuring what the USER waited,
+        not what this engine saw.
 
         Every contract violation raises HERE, eagerly — a bad prompt
         must never surface later as an opaque failure inside the padded
         admission prefill with other requests mid-flight."""
         self._check_open()
+        budget, stops = self.validate_request(
+            prompt, max_new, seed, stop_sequences
+        )
+        now = time.perf_counter()
+        # Backdate only: a future enqueued_at would make waits negative.
+        t0 = now if enqueued_at is None else min(float(enqueued_at), now)
+        ctx = trace.TraceContext.new()
+        req = Request(
+            id=self._next_id, prompt=list(prompt), max_new=budget,
+            seed=self._next_id if seed is None else seed,
+            stop_sequences=stops,
+            use_prefix_cache=bool(use_prefix_cache),
+            submitted_at=t0, enqueued_at=t0,
+            replica=self.name,
+            trace_id=ctx.trace_id, trace_ctx=ctx,
+        )
+        self._next_id += 1
+        self._queue.append(req)
+        self._by_id[req.id] = req
+        return req.id
+
+    def validate_request(
+        self, prompt: "list[int]", max_new: "int | None" = None,
+        seed: "int | None" = None,
+        stop_sequences: "list[list[int]] | None" = None,
+    ) -> "tuple[int, list[list[int]]]":
+        """`submit`'s eager contract checks, callable WITHOUT submitting:
+        returns the normalized ``(budget, stop_sequences)``.  A fleet
+        front-end that may park a request in its own queue validates
+        here at arrival — a bad prompt must fail at the caller, never
+        minutes later when fleet capacity finally frees."""
         for t in prompt:
             # bool is an int subclass and would silently embed as 0/1; an
             # out-of-range id silently clamps in the embedding gather —
@@ -572,19 +614,7 @@ class ServeEngine:
             # equal int tokens, and bools are int subclasses that compare
             # equal to token ids 0/1: reject malformed stops up front.
             raise ValueError("stop sequences must contain int token ids")
-        now = time.perf_counter()
-        ctx = trace.TraceContext.new()
-        req = Request(
-            id=self._next_id, prompt=list(prompt), max_new=budget,
-            seed=self._next_id if seed is None else seed,
-            stop_sequences=stops,
-            use_prefix_cache=bool(use_prefix_cache),
-            submitted_at=now, enqueued_at=now,
-            trace_id=ctx.trace_id, trace_ctx=ctx,
-        )
-        self._next_id += 1
-        self._queue.append(req)
-        return req.id
+        return budget, stops
 
     # -- the engine loop -------------------------------------------------
     def _admit_prefill(self, req: Request, prompt, length: int):
@@ -928,7 +958,10 @@ class ServeEngine:
                 "warm_start must run before admitting traffic "
                 "(queue and rows must be empty)"
             )
-        entries = list(index.get("entries", ()))
+        # `or ()`: an empty/None entries field is a legitimate checkpoint
+        # (an engine can die before anything was resident) — warming from
+        # it is a no-op, never an error.
+        entries = list(index.get("entries") or ())
         # Hottest first (export order already is; re-sort so hand-built
         # or merged indexes behave the same), bounded by the pool.
         entries.sort(
@@ -981,6 +1014,70 @@ class ServeEngine:
             warmed += 1
         return warmed
 
+    # -- fleet-facing surface (tpu_dra/fleet/, docs/SERVING.md) ----------
+    def request(self, rid: int) -> "Request | None":
+        """The Request object for a submitted id (queued, mid-decode, or
+        finished) — the fleet's result lookup; None for unknown ids."""
+        return self._by_id.get(rid)
+
+    @property
+    def replica_id(self) -> str:
+        """This engine's identity as a fleet replica — the ``name`` the
+        digest, router placements, and metric labels all key on."""
+        return self.name
+
+    @property
+    def prefix_epoch(self) -> int:
+        """Residency epoch of the prefix cache (bumped on every insert or
+        eviction; 0 forever without a cache).  A fleet compares this to
+        its cached digest's epoch to refresh lazily."""
+        return self._prefix.epoch if self._prefix is not None else 0
+
+    @property
+    def slo_counts(self) -> "tuple[int, int]":
+        """(met, missed) request-SLO verdict totals — the goodput inputs
+        the fleet's scale_hint aggregates across replicas."""
+        return self._slo_met, self._slo_missed
+
+    def peek_prefix(self, prompt: "list[int]") -> int:
+        """Usable resident-prefix length for ``prompt`` RIGHT NOW (0 on a
+        would-be miss or a cache-less engine), without moving hit/miss
+        counters or recency — the router's placement-time verification
+        that a digest-promised prefix is still resident."""
+        if self._prefix is None:
+            return 0
+        return self._prefix.peek(prompt, min_use=self.prefix_window)
+
+    def prefix_digest(self):
+        """A compact, queryable summary of this engine's resident
+        prefixes — hashed window-aligned token-run prefixes with hit
+        counts (`tpu_dra.fleet.digest.build_digest` over
+        `export_prefix_index`).  The fleet router matches request
+        prompts against it to find the replica already holding the
+        longest prefix; engines without a prefix cache export an empty
+        digest (they simply never win affinity).  Host-side only, cheap
+        to rebuild — refresh whenever ``prefix_epoch`` moved.  Readable
+        after close(), like the index it summarizes."""
+        from tpu_dra.fleet.digest import build_digest, empty_digest
+
+        if self._prefix is None:
+            return empty_digest(self.name)
+        return build_digest(
+            self.export_prefix_index(),
+            replica=self.name,
+            epoch=self._prefix.epoch,
+        )
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a batch row (admitted rows excluded)."""
+        return len(self._queue)
+
+    @property
+    def occupancy(self) -> int:
+        """Batch rows currently mid-decode."""
+        return sum(r is not None for r in self._row_req)
+
     @property
     def pending(self) -> int:
         return len(self._queue) + sum(
@@ -999,7 +1096,7 @@ class ServeEngine:
             if self._prefix is not None
             else {
                 "hits": 0, "misses": 0, "evictions": 0,
-                "resident": 0, "pool_slots": 0,
+                "resident": 0, "pool_slots": 0, "epoch": 0,
             }
         )
         stats["prefill_tokens_computed"] = self._prefill_tokens["computed"]

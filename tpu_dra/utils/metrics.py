@@ -413,6 +413,35 @@ SERVE_BATCH_OCCUPANCY = REGISTRY.gauge(
     "Batch rows mid-decode, per engine (sampled at scrape; compare with "
     "the engine's slots for utilization)",
 )
+# Serve-fleet router (tpu_dra/fleet/): placements across engine replicas
+# by reason, plus the routing-health gauges — digest freshness, load
+# balance, and the fleet-level overflow queue.
+FLEET_ROUTED = REGISTRY.counter(
+    "tpu_dra_fleet_routed_total",
+    "Fleet router placements by replica and reason: affinity (digest "
+    "match won), load (no match, or the match shed to a colder "
+    "replica), spill (digest stale at placement — live verify missed), "
+    "random / round_robin (benchmark control policies)",
+)
+FLEET_DIGEST_AGE = REGISTRY.gauge(
+    "tpu_dra_fleet_digest_age_seconds",
+    "Age of each replica's cached prefix digest at scrape (per fleet "
+    "and replica; 0 until first built)",
+)
+FLEET_LOAD_SKEW = REGISTRY.gauge(
+    "tpu_dra_fleet_load_skew",
+    "Spread between the most and least loaded replica of a fleet, in "
+    "rounds of committed work per batch row ((queue+occupancy)/slots)",
+)
+FLEET_QUEUE_DEPTH = REGISTRY.gauge(
+    "tpu_dra_fleet_queue_depth",
+    "Requests parked at fleet level because every replica was at its "
+    "admission cap (per fleet, sampled at scrape)",
+)
+FLEET_SCALE_HINTS = REGISTRY.counter(
+    "tpu_dra_fleet_scale_hints_total",
+    "ServeFleet.scale_hint() verdicts by hint (grow, shrink, hold)",
+)
 METRIC_SAMPLE_ERRORS = REGISTRY.counter(
     "tpu_dra_metric_sample_errors_total",
     "Gauge set_function callbacks that raised at scrape time, by metric "
@@ -540,6 +569,8 @@ class MetricsServer:
                         self._send_decisions(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/engine":
                         self._send_engine(parse_qs(parsed.query))
+                    elif parsed.path == f"{outer.pprof_path}/fleet":
+                        self._send_fleet(parse_qs(parsed.query))
                     else:
                         self._send(404, "not found\n")
                 except _BadQuery as e:
@@ -643,6 +674,46 @@ class MetricsServer:
                                 "dropped": servestats.RECORDER.dropped,
                                 "recorded": servestats.RECORDER.recorded,
                                 "summary": servestats.summarize(records),
+                            }
+                        ),
+                        "application/json",
+                    )
+
+            def _send_fleet(self, query: dict) -> None:
+                # Local import, like its siblings — fleet.stats is
+                # jax-free by design, so this endpoint serves from any
+                # binary without dragging in the compute stack.
+                from tpu_dra.fleet import stats as fleetstats
+
+                limit = _query_int(
+                    query, "limit", 256, cap=fleetstats.RECORDER.capacity
+                )
+                fmt = query.get("format", ["json"])[0]
+                if fmt not in ("json", "text"):
+                    raise _BadQuery(
+                        f"format must be json or text, got {fmt!r}"
+                    )
+                records = fleetstats.RECORDER.query(
+                    fleet=query.get("fleet", [""])[0] or None,
+                    replica=query.get("replica", [""])[0] or None,
+                    reason=query.get("reason", [""])[0] or None,
+                    limit=limit,
+                )
+                if fmt == "text":
+                    self._send(200, fleetstats.render_text(records))
+                else:
+                    import json
+
+                    self._send(
+                        200,
+                        json.dumps(
+                            {
+                                "placements": [
+                                    r.to_dict() for r in records
+                                ],
+                                "dropped": fleetstats.RECORDER.dropped,
+                                "recorded": fleetstats.RECORDER.recorded,
+                                "summary": fleetstats.summarize(records),
                             }
                         ),
                         "application/json",
