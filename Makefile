@@ -6,12 +6,14 @@ PYTHON  ?= python
 IMAGE   ?= tpu-dra-driver
 TAG     ?= latest
 
-.PHONY: all test lint generate-crds check-generate native native-test \
-        demo-quickstart bench image clean help observability-smoke \
-        perf-smoke explain-smoke serve-smoke serve-obs-smoke chaos-smoke \
-        fleet-smoke
+.PHONY: all test lint analyze generate-crds check-generate native \
+        native-test demo-quickstart bench image clean help \
+        observability-smoke perf-smoke explain-smoke serve-smoke \
+        serve-obs-smoke chaos-smoke fleet-smoke
 
-all: lint test
+# `analyze` runs the full rule registry — the L-style rules lint would
+# run plus the whole-repo invariants — so `all` needs only one pass.
+all: analyze test
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -21,6 +23,13 @@ test-all: native
 
 lint:
 	$(PYTHON) tools/lint.py
+
+# Whole-repo invariant analysis (docs/ANALYSIS.md): import layering +
+# jax-free gate, clock/lock discipline, tpu_dra_* metric drift vs
+# docs/OBSERVABILITY.md, exception discipline.  AST-only — never imports
+# jax — so it runs in seconds on any control-plane box.
+analyze:
+	$(PYTHON) tools/analyze.py
 
 # CRD manifests from the API dataclasses (controller-gen analog).
 generate-crds:
@@ -107,7 +116,7 @@ clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
 
 help:
-	@echo "targets: test lint generate-crds check-generate native native-test"
-	@echo "         demo-quickstart bench observability-smoke perf-smoke"
-	@echo "         explain-smoke serve-smoke serve-obs-smoke chaos-smoke"
-	@echo "         fleet-smoke image clean"
+	@echo "targets: test lint analyze generate-crds check-generate native"
+	@echo "         native-test demo-quickstart bench observability-smoke"
+	@echo "         perf-smoke explain-smoke serve-smoke serve-obs-smoke"
+	@echo "         chaos-smoke fleet-smoke image clean"

@@ -35,8 +35,28 @@ class TestLinter:
     def test_catches_library_print(self, tmp_path):
         assert "L005" in self._findings(tmp_path, "print('hi')\n")
 
-    def test_noqa_suppresses(self, tmp_path):
-        assert self._findings(tmp_path, "import os  # noqa\nx = 1\n") == []
+    def test_code_scoped_noqa_suppresses(self, tmp_path):
+        assert self._findings(
+            tmp_path, "import os  # noqa: L002\nx = 1\n"
+        ) == []
+
+    def test_noqa_scoped_to_other_code_does_not_suppress(self, tmp_path):
+        assert "L002" in self._findings(
+            tmp_path, "import os  # noqa: L003\nx = 1\n"
+        )
+
+    def test_bare_noqa_still_suppresses_but_is_flagged(self, tmp_path):
+        # Backward compatible: the bare form waives every rule on the
+        # line — and is itself reported (L006) so it cannot hide.
+        assert self._findings(
+            tmp_path, "import os  # noqa\nx = 1\n"
+        ) == ["L006"]
+
+    def test_noqa_in_string_literal_is_data(self, tmp_path):
+        # Only real comments suppress; a noqa marker inside a string
+        # literal is data, not a suppression.
+        src = 'import os\ns = "this line mentions # noqa in a string"\n'
+        assert "L002" in self._findings(tmp_path, src)
 
     def test_string_annotations_count_as_usage(self, tmp_path):
         src = (

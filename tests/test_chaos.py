@@ -248,7 +248,7 @@ class TestClaimEvents:
 
     def test_repeat_events_compress(self, tmp_path):
         from tpu_dra.client.clientset import ClientSet
-        from tpu_dra.utils.events import TYPE_WARNING, EventRecorder
+        from tpu_dra.client.events import TYPE_WARNING, EventRecorder
 
         cs = ClientSet(FakeApiServer())
         claim = cs.resource_claims(NS).create(
@@ -528,10 +528,12 @@ class TestNodeKillRecovery:
                 raise AssertionError(
                     f"gang never re-formed on survivors: {members}"
                 )
-            # Both worker pods are Running again off the dead node.
+            # Both worker pods are Running again off the dead node.  The
+            # NAS gang view converges before the recreated pod finishes
+            # its run pipeline, so wait rather than assert the phase.
             for i in range(2):
+                cluster.wait_for_pod_running(NS, f"worker-{i}", timeout=90)
                 pod = cluster.clientset.pods(NS).get(f"worker-{i}")
-                assert pod.status.phase == "Running"
                 assert pod.spec.node_name != victim_node
         finally:
             cluster.stop()

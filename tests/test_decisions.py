@@ -266,8 +266,8 @@ class TestEventRecorderContract:
     def test_repeat_events_bump_count_and_last_timestamp(self, monkeypatch):
         from tpu_dra.client.apiserver import FakeApiServer
         from tpu_dra.client.clientset import ClientSet
-        from tpu_dra.utils import events as events_mod
-        from tpu_dra.utils.events import TYPE_WARNING, EventRecorder
+        from tpu_dra.client import events as events_mod
+        from tpu_dra.client.events import TYPE_WARNING, EventRecorder
 
         cs = ClientSet(FakeApiServer())
         claim = cs.resource_claims("ns").create(
@@ -288,7 +288,7 @@ class TestEventRecorderContract:
 
     def test_never_raises_on_api_error(self):
         from tpu_dra.client.apiserver import ApiError
-        from tpu_dra.utils.events import TYPE_WARNING, EventRecorder
+        from tpu_dra.client.events import TYPE_WARNING, EventRecorder
 
         class ExplodingClients:
             def events(self, namespace):
@@ -304,7 +304,7 @@ class TestEventRecorderContract:
         still swallowed."""
         from tpu_dra.client.apiserver import ApiError, FakeApiServer
         from tpu_dra.client.clientset import ClientSet
-        from tpu_dra.utils.events import TYPE_WARNING, EventRecorder
+        from tpu_dra.client.events import TYPE_WARNING, EventRecorder
 
         cs = ClientSet(FakeApiServer())
         claim = cs.resource_claims("ns").create(

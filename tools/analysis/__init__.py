@@ -1,0 +1,59 @@
+"""tpudra-analyze — whole-repo invariant analysis (the .golangci.yaml analog).
+
+tools/lint.py checked file-local style; this package is where the
+invariants the repo actually depends on become regressions-by-CI instead
+of tribal knowledge:
+
+- ``core``         — Finding, Module/Repo model, the rule registry, and
+  code-scoped ``# noqa: CODE`` suppression shared by every rule.
+- ``importgraph``  — transitive import graph over ``tpu_dra/``, eager
+  (module top-level) edges distinguished from lazy (function-body /
+  TYPE_CHECKING) ones.
+- ``layering``     — A1xx: the declared package layer DAG and the
+  jax-free gate (control-plane modules may not reach jax/tpu_dra.parallel
+  even transitively; sanctioned lazy escapes whitelisted explicitly).
+- ``clocks``       — A2xx: wall-clock discipline in timeline/telemetry
+  modules that must run on perf_counter/monotonic.
+- ``locks``        — A3xx: blocking calls inside ``with self._lock:``
+  bodies, and a repo-wide lock-acquisition-order graph that fails on
+  cycles.
+- ``metricsdrift`` — A4xx: the ``tpu_dra_*`` metric registry vs its call
+  sites vs the docs/OBSERVABILITY.md tables.
+- ``exceptions``   — A5xx: watch/retry loops may not swallow exceptions
+  without logging or re-raising.
+- ``style``        — L0xx: the legacy tools/lint.py file-local rules,
+  ported onto the same registry (lint.py is now a thin shim).
+
+Run: ``python tools/analyze.py`` / ``make analyze``; rule reference in
+docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from analysis.core import (  # noqa: L002 — re-exports are the package API
+    Finding,
+    Repo,
+    all_rules,
+    run_rules,
+)
+from analysis import (  # noqa: L002 — importing registers each family's rules
+    clocks,
+    exceptions,
+    layering,
+    locks,
+    metricsdrift,
+    style,
+)
+
+__all__ = [
+    "Finding",
+    "Repo",
+    "all_rules",
+    "run_rules",
+    "clocks",
+    "exceptions",
+    "layering",
+    "locks",
+    "metricsdrift",
+    "style",
+]

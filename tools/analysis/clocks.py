@@ -1,0 +1,58 @@
+"""A2xx — clock discipline in timeline/telemetry modules.
+
+Request timelines (``parallel/serve.py``), span durations
+(``utils/trace.py``), digest ages and flight-recorder sequencing all
+promise *monotonic* arithmetic: ``perf_counter``/``monotonic`` deltas
+that an NTP step or a suspended VM cannot turn negative.  A single
+``time.time()`` subtraction quietly breaks ``queue_wait_s <= ttft_s``
+and every percentile downstream of it.
+
+- **A201** — a wall-clock read (``time.time``, ``time.ctime``,
+  ``datetime.now/utcnow/today``) inside a module declared
+  monotonic-only (``Config.monotonic_modules``).  Epoch *anchors* (a
+  ``ts_unix`` display stamp, mapping a perf timestamp onto the wall
+  clock for chrome-trace) are legitimate — and must say so with a
+  code-scoped ``# noqa: A201 — why`` at the call site, which is exactly
+  the discipline: every wall-clock read in a timeline module is a
+  deliberate, reviewed decision.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import Finding, call_name, rule
+
+WALL_CLOCK_CALLS = {
+    "time.time",
+    "time.time_ns",
+    "time.ctime",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.today",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+    "date.today",
+}
+
+
+@rule("A201", "clocks",
+      "wall-clock read in a monotonic-only timeline/telemetry module")
+def check_wall_clock(repo):
+    monotonic = set(repo.config.monotonic_modules)
+    for mod in repo.package_modules():
+        if mod.rel not in monotonic:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in WALL_CLOCK_CALLS:
+                yield Finding(
+                    mod.rel, node.lineno, "A201",
+                    f"{name}() in monotonic-only module: timelines use "
+                    f"perf_counter/monotonic; if this is a deliberate "
+                    f"epoch anchor, mark it `# noqa: A201 — <why>`",
+                )

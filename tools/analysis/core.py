@@ -1,0 +1,345 @@
+"""Analyzer core: the file model, the rule registry, and suppression.
+
+Every rule is a function ``(repo: Repo) -> Iterable[Finding]`` registered
+under a stable code (``A101``, ``L002``, ...).  The runner parses each
+file once, hands every rule the same ``Repo`` (modules + config + cached
+import graph), and filters findings through code-scoped ``# noqa``
+comments — so a suppression names WHICH invariant it waives:
+
+    built_at = time.time()  # noqa: A201 — epoch anchor, not a duration
+
+A bare ``# noqa`` still suppresses every code on its line (backward
+compatibility with the original linter), but is itself flagged as L006
+so it cannot hide silently.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    path: str  # repo-relative
+    line: int
+    code: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+@dataclass
+class Module:
+    """One parsed source file."""
+
+    rel: str  # repo-relative path, forward slashes
+    source: str
+    tree: ast.AST
+    lines: "list[str]"
+    name: "str | None" = None  # dotted module name when under a package root
+    _comments: "dict[int, str] | None" = None
+
+    @property
+    def comments(self) -> "dict[int, str]":
+        """lineno -> comment text, via the tokenizer — a ``# noqa``
+        inside a string literal is data, not a suppression."""
+        if self._comments is None:
+            out: "dict[int, str]" = {}
+            try:
+                tokens = tokenize.generate_tokens(
+                    io.StringIO(self.source).readline
+                )
+                for tok in tokens:
+                    if tok.type == tokenize.COMMENT:
+                        out[tok.start[0]] = tok.string
+            except (tokenize.TokenError, IndentationError):
+                pass
+            self._comments = out
+        return self._comments
+
+
+@dataclass
+class Config:
+    """Project invariants the graph rules check against.
+
+    The defaults are THIS repo's layering contract (see docs/ANALYSIS.md);
+    fixture tests override fields to exercise the rules in isolation.
+    """
+
+    package_root: str = "tpu_dra"
+    # Declared layer DAG: package -> packages it may import EAGERLY
+    # (module top-level).  Lazy (function-body) imports are exempt here;
+    # the jax-free gate below polices where lazy edges may lead.
+    # "<root>" is the package's own __init__/version modules.
+    layers: "dict[str, tuple[str, ...]]" = field(default_factory=lambda: {
+        "<root>": ("<root>",),
+        "utils": ("utils", "<root>"),
+        "api": ("api", "utils", "<root>"),
+        "client": ("client", "api", "utils", "<root>"),
+        "controller": ("controller", "client", "api", "utils", "<root>"),
+        "plugin": ("plugin", "client", "api", "utils", "<root>"),
+        "proxy": ("proxy", "utils", "<root>"),
+        "sim": ("sim", "controller", "plugin", "client", "api", "utils",
+                "<root>"),
+        "cmds": ("cmds", "sim", "controller", "plugin", "proxy", "client",
+                 "api", "utils", "fleet", "<root>"),
+        "deploy": ("deploy", "client", "sim", "api", "utils", "<root>"),
+        # fleet is jax-free BY DESIGN (a router is control-plane code);
+        # engines are handed in as objects, never imported eagerly.
+        "fleet": ("fleet", "utils", "<root>"),
+        # jax-land: parallel/models may import anything below themselves.
+        "parallel": ("parallel", "models", "fleet", "api", "utils", "<root>"),
+        "models": ("models", "parallel", "api", "utils", "<root>"),
+    })
+    # Import roots that mean "the compute stack came in".
+    jax_roots: "tuple[str, ...]" = ("jax", "jaxlib", "flax", "optax", "orbax")
+    # Layers allowed to reach jax_roots / jax-land packages eagerly.
+    jax_layers: "tuple[str, ...]" = ("parallel", "models")
+    # Modules in jax-free layers that are ALLOWED to touch jax-land:
+    # the declared engine-touching seams.  fleet/fleet.py drives
+    # ServeEngine replicas (today they are handed in as objects; this
+    # entry sanctions the seam if it ever imports them) — and it is only
+    # reachable lazily, via the PEP 562 __getattr__ in fleet/__init__.py,
+    # so `import tpu_dra.fleet` stays jax-free for control-plane binaries.
+    jax_allowed_modules: "tuple[str, ...]" = ("tpu_dra.fleet.fleet",)
+    # Sanctioned lazy escapes into jax-land from jax-free modules:
+    # (source module, import target prefix) pairs.  Empty today — every
+    # current lazy edge lands in jax-free code — but any future
+    # "import the engine on first call" shortcut must be named here.
+    lazy_jax_allowed: "tuple[tuple[str, str], ...]" = ()
+    # Timeline/telemetry modules that must run on perf_counter/monotonic:
+    # any wall-clock read here needs a code-scoped noqa naming WHY.
+    monotonic_modules: "tuple[str, ...]" = (
+        "tpu_dra/utils/servestats.py",
+        "tpu_dra/utils/trace.py",
+        "tpu_dra/fleet/stats.py",
+        "tpu_dra/fleet/digest.py",
+        "tpu_dra/fleet/router.py",
+        "tpu_dra/fleet/fleet.py",
+        "tpu_dra/controller/decisions.py",
+        "tpu_dra/parallel/serve.py",
+    )
+    # Where the metric registry lives and which doc must list every metric.
+    metric_prefix: str = "tpu_dra_"
+    metric_doc: str = "docs/OBSERVABILITY.md"
+    # Library prefixes where print() is banned (style L005).
+    print_allowed_prefixes: "tuple[str, ...]" = (
+        "tpu_dra/cmds/",
+        "tpu_dra/sim/kubectl.py",
+        "tpu_dra/sim/kubesim.py",
+        "tpu_dra/sim/httpapiserver.py",
+        "tpu_dra/deploy/__main__.py",
+        "tpu_dra/api/crdgen.py",
+        "tpu_dra/parallel/validate.py",  # JSON-report CLI (driver entry point)
+        "tools/",
+        "demo/",
+        "tests/",
+    )
+
+
+@dataclass
+class Repo:
+    """Everything a rule may look at: parsed modules, docs, config."""
+
+    modules: "dict[str, Module]"  # rel -> Module
+    docs: "dict[str, str]" = field(default_factory=dict)  # rel -> text
+    config: Config = field(default_factory=Config)
+    _graph: "object | None" = None  # cached ImportGraph
+
+    @property
+    def graph(self):
+        if self._graph is None:
+            from analysis.importgraph import ImportGraph
+
+            self._graph = ImportGraph.build(self)
+        return self._graph
+
+    def package_modules(self) -> "list[Module]":
+        """Modules under the configured package root, sorted by rel."""
+        prefix = self.config.package_root + "/"
+        return [m for rel, m in sorted(self.modules.items())
+                if rel.startswith(prefix)]
+
+    @classmethod
+    def from_sources(cls, files: "dict[str, str]",
+                     docs: "dict[str, str] | None" = None,
+                     config: "Config | None" = None) -> "Repo":
+        """Build a Repo from in-memory sources (the fixture-test path)."""
+        config = config or Config()
+        modules = {}
+        for rel, source in files.items():
+            rel = rel.replace(os.sep, "/")
+            modules[rel] = Module(
+                rel=rel,
+                source=source,
+                tree=ast.parse(source, filename=rel),
+                lines=source.splitlines(),
+                name=module_name(rel, config.package_root),
+            )
+        return cls(modules=modules, docs=dict(docs or {}), config=config)
+
+    @classmethod
+    def load(cls, root: str, roots: "list[str] | None" = None,
+             config: "Config | None" = None) -> "tuple[Repo, list[Finding]]":
+        """Parse every .py file under ``roots`` (repo-relative).  Files
+        that fail to parse become L001 findings instead of modules, so a
+        syntax error surfaces once and graph rules see a clean tree."""
+        config = config or Config()
+        roots = roots or [config.package_root, "tests", "demo", "tools"]
+        modules: "dict[str, Module]" = {}
+        errors: "list[Finding]" = []
+        for top in roots:
+            base = os.path.join(root, top)
+            if os.path.isfile(base):
+                paths = [base]
+            else:
+                paths = [
+                    os.path.join(dirpath, name)
+                    for dirpath, _, names in os.walk(base)
+                    for name in names
+                    if name.endswith(".py")
+                ]
+            for path in sorted(paths):
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                if rel in modules:
+                    continue
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                try:
+                    tree = ast.parse(source, filename=rel)
+                except SyntaxError as e:
+                    errors.append(Finding(
+                        rel, e.lineno or 0, "L001", f"syntax error: {e.msg}"
+                    ))
+                    continue
+                modules[rel] = Module(
+                    rel=rel, source=source, tree=tree,
+                    lines=source.splitlines(),
+                    name=module_name(rel, config.package_root),
+                )
+        docs = {}
+        doc_rel = config.metric_doc
+        doc_path = os.path.join(root, doc_rel)
+        if os.path.exists(doc_path):
+            with open(doc_path, encoding="utf-8") as f:
+                docs[doc_rel] = f.read()
+        return cls(modules=modules, docs=docs, config=config), errors
+
+
+def module_name(rel: str, package_root: str) -> "str | None":
+    """``tpu_dra/fleet/stats.py`` -> ``tpu_dra.fleet.stats`` (None outside
+    the package root).  ``__init__.py`` maps to the package itself."""
+    if rel != package_root + ".py" and not rel.startswith(package_root + "/"):
+        return None
+    parts = rel[:-3].split("/")  # strip .py
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+# --- rule registry ----------------------------------------------------------
+
+@dataclass
+class Rule:
+    code: str
+    family: str
+    summary: str
+    fn: "object"
+
+
+_RULES: "dict[str, Rule]" = {}
+
+
+def rule(code: str, family: str, summary: str):
+    """Register ``fn(repo) -> Iterable[Finding]`` under ``code``."""
+
+    def deco(fn):
+        if code in _RULES:
+            raise ValueError(f"duplicate rule code {code}")
+        _RULES[code] = Rule(code=code, family=family, summary=summary, fn=fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> "list[Rule]":
+    return [r for _, r in sorted(_RULES.items())]
+
+
+# --- suppression ------------------------------------------------------------
+
+_NOQA_RE = re.compile(r"#\s*noqa(?P<scoped>:\s*(?P<codes>[A-Za-z0-9_, \t-]+))?")
+
+
+def noqa_codes(line: str) -> "set[str] | None":
+    """None when the line has no noqa; empty set for bare ``# noqa``
+    (suppress all); otherwise the set of codes it names."""
+    m = _NOQA_RE.search(line)
+    if not m:
+        return None
+    if not m.group("scoped"):
+        return set()
+    codes = m.group("codes")
+    # "A201 — justification" / "A201,L002": codes end at the first token
+    # that is not a code or separator.
+    out = set()
+    for token in re.split(r"[,\s]+", codes.strip()):
+        if re.fullmatch(r"[A-Za-z]+[0-9]+", token):
+            out.add(token.upper())
+        elif token:
+            break
+    return out
+
+
+def suppressed(finding: Finding, module: Module) -> bool:
+    comment = module.comments.get(finding.line)
+    if comment is None:
+        return False
+    codes = noqa_codes(comment)
+    if codes is None:
+        return False
+    if not codes:  # bare noqa: suppress everything except its own flag
+        return finding.code != "L006"
+    return finding.code in codes
+
+
+def run_rules(repo: Repo, select: "set[str] | None" = None) -> "list[Finding]":
+    """Run every registered rule (or the selected codes) and filter
+    through per-line suppressions."""
+    findings: "list[Finding]" = []
+    for r in all_rules():
+        if select and r.code not in select:
+            continue
+        findings.extend(r.fn(repo))
+    kept = []
+    for f in findings:
+        mod = repo.modules.get(f.path)
+        if mod is not None and suppressed(f, mod):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.code))
+    return kept
+
+
+# --- shared AST helpers -----------------------------------------------------
+
+def dotted(node: ast.AST) -> "str | None":
+    """``a.b.c`` attribute/name chain as text (None for anything else)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> "str | None":
+    return dotted(node.func)

@@ -1,0 +1,93 @@
+"""A5xx — exception discipline in watch/retry loops.
+
+The controller's watch loops, the informer's relist loop, and the serve
+fleet's drain threads all follow the same contract: a failure may be
+*absorbed* (the loop lives on) but never *erased* — it must be logged,
+recorded, or re-raised, or a dead watch stream degrades into a silent
+steady-state of stale caches.
+
+- **A501** — a broad handler (``except Exception`` / ``BaseException``
+  / bare ``except``) inside a ``while``/``for`` loop whose body neither
+  raises nor calls anything: just ``pass`` / ``continue`` / ``break``.
+  Narrow handlers (``except NotFoundError: pass``) stay legal — they
+  encode a decision about one failure, not a blanket shrug.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import Finding, call_name, rule
+
+BROAD = {"Exception", "BaseException"}
+
+# Calls that do not count as "handling" the exception: a
+# sleep-then-retry handler erases the error exactly like `pass` does.
+SHRUG_CALLS = {"sleep"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in BROAD
+    if isinstance(t, ast.Attribute):
+        return t.attr in BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            (isinstance(e, ast.Name) and e.id in BROAD)
+            or (isinstance(e, ast.Attribute) and e.attr in BROAD)
+            for e in t.elts
+        )
+    return False
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the body is pure shrug: no raise, and no call beyond
+    backoff sleeps (``except Exception: time.sleep(1)`` is the canonical
+    silent dead-watch loop, not evidence of handling)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.Call):
+            name = call_name(node) or ""
+            if name.split(".")[-1] not in SHRUG_CALLS:
+                return False
+    return True
+
+
+def _loops_with_handlers(tree: ast.AST):
+    """Yield broad handlers that live inside a loop body, without
+    crossing into nested function definitions (a closure's loop is that
+    closure's business on ITS scan)."""
+
+    def gen(node, in_loop):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                yield from gen(child, False)
+            elif isinstance(child, (ast.While, ast.For, ast.AsyncFor)):
+                yield from gen(child, True)
+            elif isinstance(child, ast.ExceptHandler):
+                if in_loop and _is_broad(child):
+                    yield child
+                yield from gen(child, in_loop)
+            else:
+                yield from gen(child, in_loop)
+
+    yield from gen(tree, False)
+
+
+@rule("A501", "exceptions",
+      "watch/retry loop swallows exceptions without logging or re-raising")
+def check_swallowed_in_loops(repo):
+    for mod in repo.package_modules():
+        for handler in _loops_with_handlers(mod.tree):
+            if _swallows(handler):
+                label = ast.unparse(handler.type) if handler.type else "bare"
+                yield Finding(
+                    mod.rel, handler.lineno, "A501",
+                    f"broad handler ({label}) inside a loop swallows the "
+                    f"exception silently — log it, record it, or re-raise",
+                )

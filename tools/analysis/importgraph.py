@@ -1,0 +1,153 @@
+"""Transitive import graph over the package root.
+
+Edges are extracted per module and tagged **eager** (module top level —
+the import runs when the module does) or **lazy** (inside a function
+body, or under ``if TYPE_CHECKING:`` — the import runs on call/never).
+The distinction is the whole point: the layering contract governs eager
+edges, because those are the ones a control-plane binary pays at import
+time; lazy edges are the sanctioned escape hatch and get their own gate.
+
+Targets resolve to internal module names when the target lives in the
+repo (relative imports included), otherwise to the external root
+(``jax``, ``numpy``, ...).  ``from pkg import name`` resolves to
+``pkg.name`` when that is a module, else ``pkg``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Edge:
+    src: str  # module name
+    target: str  # module name or external root
+    lineno: int
+    lazy: bool
+
+
+@dataclass
+class ImportGraph:
+    modules: "set[str]"  # internal module names
+    edges: "list[Edge]"
+    eager: "dict[str, set[str]]" = field(default_factory=dict)
+    lazy: "dict[str, set[str]]" = field(default_factory=dict)
+
+    @classmethod
+    def build(cls, repo) -> "ImportGraph":
+        names = {m.name for m in repo.package_modules() if m.name}
+        # Parent packages exist implicitly (tpu_dra.fleet for fleet/__init__).
+        packages = set()
+        for n in names:
+            parts = n.split(".")
+            for i in range(1, len(parts)):
+                packages.add(".".join(parts[:i]))
+        known = names | packages
+        edges: "list[Edge]" = []
+        seen: "set[tuple[str, str, int, bool]]" = set()
+        for mod in repo.package_modules():
+            if not mod.name:
+                continue
+            for target, lineno, lazy in _imports(mod.tree, mod.name, mod.rel):
+                resolved = _resolve(target, known)
+                key = (mod.name, resolved, lineno, lazy)
+                if key in seen:
+                    continue  # from x import a, b: one edge, not three
+                seen.add(key)
+                edges.append(Edge(
+                    src=mod.name, target=resolved, lineno=lineno, lazy=lazy,
+                ))
+        graph = cls(modules=names, edges=edges)
+        for e in edges:
+            bucket = graph.lazy if e.lazy else graph.eager
+            bucket.setdefault(e.src, set()).add(e.target)
+        return graph
+
+    def eager_reach(self, start: str) -> "dict[str, str]":
+        """Everything transitively reachable from ``start`` over eager
+        edges, mapped to its BFS predecessor (for path rendering).
+        External roots are terminal; a package name expands to its
+        __init__ module's edges (same name here)."""
+        parents: "dict[str, str]" = {}
+        frontier = [start]
+        while frontier:
+            nxt = []
+            for node in frontier:
+                for target in self.eager.get(node, ()):
+                    if target not in parents and target != start:
+                        parents[target] = node
+                        if target in self.modules:
+                            nxt.append(target)
+            frontier = nxt
+        return parents
+
+    def path_to(self, start: str, end: str, parents: "dict[str, str]") -> str:
+        hops = [end]
+        while hops[-1] != start:
+            hops.append(parents[hops[-1]])
+        return " -> ".join(reversed(hops))
+
+
+def _resolve(target: str, known: "set[str]") -> str:
+    """Internal dotted name if the target is in-repo, else the external
+    root segment."""
+    if target in known:
+        return target
+    # from pkg import name — longest known prefix wins.
+    parts = target.split(".")
+    for i in range(len(parts) - 1, 0, -1):
+        prefix = ".".join(parts[:i])
+        if prefix in known:
+            return prefix
+    return parts[0]
+
+
+def _imports(tree: ast.AST, module: str, rel: str):
+    """Yield (dotted target, lineno, lazy) for every import statement."""
+    is_pkg = rel.endswith("/__init__.py")
+
+    def walk(node, lazy: bool):
+        for child in ast.iter_child_nodes(node):
+            child_lazy = lazy
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                child_lazy = True
+            elif isinstance(child, ast.If) and _is_type_checking(child.test):
+                # Annotation-only imports never run.
+                child_lazy = True
+            if isinstance(child, ast.Import):
+                for alias in child.names:
+                    yield alias.name, child.lineno, lazy
+            elif isinstance(child, ast.ImportFrom):
+                base = _relative_base(child, module, is_pkg)
+                if base is None:
+                    continue
+                for alias in child.names:
+                    target = f"{base}.{alias.name}" if base else alias.name
+                    yield target, child.lineno, lazy
+            else:
+                yield from walk(child, child_lazy)
+
+    yield from walk(tree, False)
+
+
+def _is_type_checking(test: ast.AST) -> bool:
+    return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or (
+        isinstance(test, ast.Attribute) and test.attr == "TYPE_CHECKING"
+    )
+
+
+def _relative_base(node: ast.ImportFrom, module: str, is_pkg: bool) -> "str | None":
+    """Absolute dotted base of a ``from`` import (None for __future__)."""
+    if node.level == 0:
+        return node.module if node.module != "__future__" else None
+    # Relative: level 1 from a package __init__ is the package itself;
+    # from a plain module it is the containing package.
+    parts = module.split(".")
+    strip = node.level - 1 if is_pkg else node.level
+    base_parts = parts[: len(parts) - strip] if strip else parts
+    if not base_parts:
+        return node.module
+    base = ".".join(base_parts)
+    return f"{base}.{node.module}" if node.module else base

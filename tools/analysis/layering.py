@@ -1,0 +1,126 @@
+"""A1xx — package layer DAG and the jax-free gate.
+
+The repo's control plane (``utils``, ``api``, ``client``, ``controller``,
+``plugin``, ``proxy``, ``sim``, ``cmds``, ``fleet``, ``deploy``) is
+jax-free ON PURPOSE: a scheduler binary or a ``/debug/fleet`` endpoint
+must never pay a jax import.  PRs 1-7 kept that true by comment and
+convention; these rules make it a CI invariant:
+
+- **A101** — an eager import edge violates the declared layer DAG
+  (``Config.layers``): e.g. ``utils`` importing ``client``.
+- **A102** — a jax-free module transitively reaches jax-land
+  (``jax``/``tpu_dra.parallel``/``tpu_dra.models``) over EAGER edges.
+  The message shows the offending import chain.
+- **A103** — a lazy import of jax-land from a jax-free module that is
+  not on the explicit whitelist (``Config.lazy_jax_allowed``) — the PEP
+  562 re-export in ``tpu_dra/fleet/__init__.py`` is the shape of a
+  sanctioned entry.
+"""
+
+from __future__ import annotations
+
+from analysis.core import Finding, rule
+
+
+def _layer(name: str, root: str) -> str:
+    """tpu_dra.fleet.stats -> "fleet"; tpu_dra / tpu_dra.version -> <root>."""
+    parts = name.split(".")
+    if name == root or len(parts) == 2 and parts[1] == "version":
+        return "<root>"
+    return parts[1] if len(parts) > 1 else "<root>"
+
+
+def _in_jax_land(target: str, cfg) -> bool:
+    if target.split(".")[0] in cfg.jax_roots:
+        return True
+    for layer in cfg.jax_layers:
+        prefix = f"{cfg.package_root}.{layer}"
+        if target == prefix or target.startswith(prefix + "."):
+            return True
+    return False
+
+
+@rule("A101", "layering", "eager import edge violates the declared layer DAG")
+def check_layer_dag(repo):
+    cfg = repo.config
+    root = cfg.package_root
+    graph = repo.graph
+    rel_by_name = {m.name: m.rel for m in repo.package_modules() if m.name}
+    for edge in graph.edges:
+        if edge.lazy:
+            continue
+        if not (edge.target == root or edge.target.startswith(root + ".")):
+            continue  # external imports are not the DAG's business
+        src_layer = _layer(edge.src, root)
+        dst_layer = _layer(edge.target, root)
+        allowed = cfg.layers.get(src_layer)
+        if allowed is None:
+            yield Finding(
+                rel_by_name.get(edge.src, edge.src), edge.lineno, "A101",
+                f"package {src_layer!r} has no declared layer "
+                f"(add it to the DAG in tools/analysis/core.py)",
+            )
+        elif dst_layer not in allowed:
+            yield Finding(
+                rel_by_name.get(edge.src, edge.src), edge.lineno, "A101",
+                f"layer {src_layer!r} may not import {dst_layer!r} "
+                f"({edge.src} -> {edge.target}); allowed: "
+                f"{', '.join(allowed)}",
+            )
+
+
+@rule("A102", "layering",
+      "jax-free module reaches jax-land transitively over eager imports")
+def check_jax_free(repo):
+    cfg = repo.config
+    root = cfg.package_root
+    graph = repo.graph
+    for mod in repo.package_modules():
+        if not mod.name or _layer(mod.name, root) in cfg.jax_layers:
+            continue
+        if mod.name in cfg.jax_allowed_modules:
+            continue  # the declared engine-touching seam
+        parents = graph.eager_reach(mod.name)
+        hits = sorted(t for t in parents if _in_jax_land(t, cfg))
+        if not hits:
+            continue
+        # One finding per module, on the first-hop import line when the
+        # leak is direct, with the full chain named either way.
+        chain = graph.path_to(mod.name, hits[0], parents)
+        # Anchor the finding on this module's import that starts the chain.
+        first_hop = chain.split(" -> ")[1]
+        lineno = next(
+            (e.lineno for e in graph.edges
+             if e.src == mod.name and not e.lazy and e.target == first_hop),
+            1,
+        )
+        yield Finding(
+            mod.rel, lineno, "A102",
+            f"jax-free module {mod.name} reaches {hits[0]} eagerly "
+            f"(chain: {chain}); make the import lazy and whitelist it, "
+            f"or move the module into jax-land",
+        )
+
+
+@rule("A103", "layering",
+      "unsanctioned lazy import of jax-land from a jax-free module")
+def check_lazy_whitelist(repo):
+    cfg = repo.config
+    root = cfg.package_root
+    allowed = set(cfg.lazy_jax_allowed)
+    rel_by_name = {m.name: m.rel for m in repo.package_modules() if m.name}
+    for edge in repo.graph.edges:
+        if not edge.lazy or not _in_jax_land(edge.target, cfg):
+            continue
+        if _layer(edge.src, root) in cfg.jax_layers \
+                or edge.src in cfg.jax_allowed_modules:
+            continue  # jax-land (and declared seams) may lazy-import it
+        if any(edge.src == src and (edge.target == tgt
+                                    or edge.target.startswith(tgt + "."))
+               for src, tgt in allowed):
+            continue
+        yield Finding(
+            rel_by_name.get(edge.src, edge.src), edge.lineno, "A103",
+            f"lazy import of {edge.target} from jax-free {edge.src} is not "
+            f"whitelisted (Config.lazy_jax_allowed)",
+        )

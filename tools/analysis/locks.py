@@ -1,0 +1,234 @@
+"""A3xx — lock discipline.
+
+Every flight recorder, cache, and registry in this repo is a
+lock-protected structure on a hot path: the engine tick, the scheduling
+fan-out, the metrics scrape.  Two invariants keep them honest:
+
+- **A301** — no blocking call (``sleep``, subprocess, socket/HTTP I/O,
+  ``Event.wait``, jax dispatch) while a ``with ...lock:`` body is open.
+  A recorder that sleeps under its lock stalls every engine tick behind
+  it; a jax dispatch under the availability-cache lock serializes the
+  whole fan-out behind a compile.
+- **A302** — the repo-wide lock-acquisition-*order* graph must be
+  acyclic.  Locks are keyed ``<module>.<Class>.<attr>``; nesting lock B
+  inside lock A's body adds the edge A -> B, and a cycle (A -> B
+  somewhere, B -> A somewhere else) is a deadlock waiting for the right
+  interleaving.  Acquiring the same non-reentrant key inside itself in
+  one function is the degenerate cycle and is reported too.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import Finding, call_name, dotted, rule
+
+# Terminal call names that block the calling thread.  `.join` is absent
+# on purpose (str.join would drown the signal); thread joins under a
+# lock are caught by their `.wait(` siblings in practice.
+BLOCKING_CALLS = {
+    "time.sleep",
+    "sleep",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.Popen",
+    "urllib.request.urlopen",
+    "urlopen",
+    "socket.create_connection",
+}
+BLOCKING_SUFFIXES = (".wait", ".acquire", ".sleep", ".urlopen", ".result")
+# Any call into the jax namespace is device dispatch (or worse, a
+# compile) — never under a control-plane lock.
+BLOCKING_ROOTS = ("jax",)
+
+
+def _lock_key(expr: ast.AST, class_name: str, module: str) -> "str | None":
+    """``self._lock`` / ``self.lock.locked(...)`` / ``GLOBAL_LOCK`` ->
+    a stable lock identity, None when the context manager is clearly not
+    a lock."""
+    if isinstance(expr, ast.Call):
+        # with self.lock.locked(node): — the acquiring call form.
+        fn = dotted(expr.func)
+        if fn and (fn.endswith(".locked") or fn.endswith(".acquire_timeout")):
+            return f"{module}:{class_name}.{fn}"
+        return None
+    name = dotted(expr)
+    if not name:
+        return None
+    leaf = name.split(".")[-1]
+    if leaf == "lock" or leaf.endswith("_lock") or leaf.endswith("_LOCK") \
+            or leaf == "LOCK":
+        return f"{module}:{class_name}.{name}"
+    return None
+
+
+def _is_blocking(node: ast.Call) -> "str | None":
+    name = call_name(node)
+    if not name:
+        return None
+    if name in BLOCKING_CALLS:
+        return name
+    if name.split(".")[0] in BLOCKING_ROOTS:
+        return name
+    for suffix in BLOCKING_SUFFIXES:
+        if name.endswith(suffix):
+            return name
+    return None
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """Walk one function body tracking the stack of held locks."""
+
+    def __init__(self, module_rel: str, class_name: str):
+        self.module_rel = module_rel
+        self.class_name = class_name
+        self.held: "list[str]" = []
+        self.findings: "list[Finding]" = []
+        self.order_edges: "list[tuple[str, str, int]]" = []
+
+    def visit_With(self, node: ast.With):
+        self._with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith):
+        self._with(node)
+
+    def _with(self, node):
+        keys = []
+        for item in node.items:
+            key = _lock_key(item.context_expr, self.class_name,
+                            self.module_rel)
+            if key:
+                keys.append(key)
+        for key in keys:
+            for outer in self.held:
+                self.order_edges.append((outer, key, node.lineno))
+        self.held.extend(keys)
+        for child in node.body:
+            self.visit(child)
+        if keys:
+            del self.held[len(self.held) - len(keys):]
+        # context_expr of non-lock items may still contain calls to check.
+        for item in node.items:
+            self.visit(item.context_expr)
+
+    def visit_Call(self, node: ast.Call):
+        if self.held:
+            name = _is_blocking(node)
+            # Nested lock acquisitions surface via the order graph, not
+            # as blocking calls — `.acquire` on a DIFFERENT lock is
+            # ordering; on anything else it still blocks.
+            if name and not name.endswith(".acquire"):
+                self.findings.append(Finding(
+                    self.module_rel, node.lineno, "A301",
+                    f"blocking call {name}() while holding "
+                    f"{' + '.join(self.held)}",
+                ))
+        self.generic_visit(node)
+
+    # A nested def or lambda runs later, not under the enclosing lock:
+    # skip it here — every def gets its own scanner pass.
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _functions(tree):
+    """Every (FunctionDef, enclosing class name) in the module, nested
+    defs included."""
+    out = []
+
+    def rec(node, class_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                rec(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((child, class_name))
+                rec(child, class_name)
+            else:
+                rec(child, class_name)
+
+    rec(tree, "<module>")
+    return out
+
+
+def _scan_module(mod):
+    """All findings + order edges for one module."""
+    findings: "list[Finding]" = []
+    edges: "list[tuple[str, str, int]]" = []
+    # Import-time code first: module- and class-body statements execute
+    # on import, so a `with _LOCK:` there holds the lock across import.
+    # The scanner skips def/lambda bodies, which get their own pass below.
+    scanner = _FunctionScanner(mod.rel, "<module>")
+    for child in mod.tree.body:
+        scanner.visit(child)
+    findings.extend(scanner.findings)
+    edges.extend(scanner.order_edges)
+    for fn, class_name in _functions(mod.tree):
+        scanner = _FunctionScanner(mod.rel, class_name)
+        for child in fn.body:
+            scanner.visit(child)
+        findings.extend(scanner.findings)
+        edges.extend(scanner.order_edges)
+    return findings, edges
+
+
+@rule("A301", "locks", "blocking call while holding a lock")
+def check_blocking_under_lock(repo):
+    for mod in repo.package_modules():
+        findings, _ = _scan_module(mod)
+        yield from findings
+
+
+@rule("A302", "locks", "cycle in the lock-acquisition-order graph")
+def check_lock_order(repo):
+    edges: "dict[str, set[str]]" = {}
+    where: "dict[tuple[str, str], tuple[str, int]]" = {}
+    for mod in repo.package_modules():
+        _, mod_edges = _scan_module(mod)
+        for outer, inner, lineno in mod_edges:
+            edges.setdefault(outer, set()).add(inner)
+            where.setdefault((outer, inner), (mod.rel, lineno))
+    # Self-nesting: with self._lock: ... with self._lock: — non-reentrant
+    # threading.Lock deadlocks immediately.
+    reported = set()
+    for outer, inners in edges.items():
+        if outer in inners:
+            rel, lineno = where[(outer, outer)]
+            reported.add((outer, outer))
+            yield Finding(
+                rel, lineno, "A302",
+                f"lock {outer} re-acquired while already held "
+                f"(non-reentrant self-deadlock)",
+            )
+    # Cycles across functions/modules: DFS with a path stack.
+    def find_cycle(start):
+        # Self-edges are reported as self-deadlocks above, not as cycles.
+        stack = [(start, [start])]
+        seen = {start}
+        while stack:
+            node, path = stack.pop()
+            for nxt in edges.get(node, ()):
+                if nxt == start and len(path) > 1:
+                    return path + [start]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    for start in sorted(edges):
+        cycle = find_cycle(start)
+        if not cycle:
+            continue
+        key = tuple(sorted(set(cycle)))
+        if key in reported:
+            continue
+        reported.add(key)
+        rel, lineno = where[(cycle[0], cycle[1])]
+        yield Finding(
+            rel, lineno, "A302",
+            "lock-order cycle: " + " -> ".join(cycle),
+        )

@@ -1,0 +1,227 @@
+"""A4xx — metric registry drift.
+
+The ``tpu_dra_*`` vocabulary is an API: dashboards, the bench harness,
+and docs/OBSERVABILITY.md all join on metric names and label keys.  The
+registry itself (``utils/metrics.py``) is the single source of truth,
+so drift is detectable statically:
+
+- **A401** — the same metric name registered twice.
+- **A402** — label-key drift across call sites: every ``.inc(...)`` /
+  ``.observe(...)`` / ``.set(...)`` / ``.set_function(...)`` /
+  ``.time(...)`` of one metric must pass the same label-key set, or the
+  series fans out into unjoinable shards (``{reason=...}`` here, bare
+  there).
+- **A403** — a registered metric absent from the docs/OBSERVABILITY.md
+  tables (the doc is the operator contract; an undocumented metric is
+  unfinished work).
+- **A404** — a ``tpu_dra_*`` name in the doc that no code registers
+  (stale doc — the worse direction: operators alert on ghosts).
+
+Doc parsing understands the conventions the doc already uses:
+``name{label,label}`` label annotations are stripped,
+``prefix_{a,b,c}_suffix`` brace alternation is expanded, ``_bucket`` /
+``_sum`` / ``_count`` map back to their histogram, and ``name_*`` globs
+are ignored (prose, not a registration claim).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from analysis.core import Finding, dotted, rule
+
+REGISTER_CALLS = ("counter", "gauge", "histogram")
+LABELED_CALLS = {"inc", "observe", "set", "set_function", "time"}
+
+
+def registrations(repo):
+    """(name, kind, rel, lineno, var) for every ``REGISTRY.counter("x")``
+    -style registration with a literal name, plus var->name aliases from
+    ``VAR = REGISTRY.counter(...)`` assignments."""
+    out = []
+    prefix = repo.config.metric_prefix
+    for mod in repo.package_modules():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.Assign, ast.Expr)):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Attribute)
+                    and value.func.attr in REGISTER_CALLS
+                    and value.args
+                    and isinstance(value.args[0], ast.Constant)
+                    and isinstance(value.args[0].value, str)
+                    and value.args[0].value.startswith(prefix)):
+                continue
+            var = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                var = dotted(node.targets[0])
+            out.append((value.args[0].value, value.func.attr, mod.rel,
+                        node.lineno, var))
+    return out
+
+
+def call_sites(repo, var_to_name: "dict[str, str]"):
+    """(metric name, frozenset(label keys) | None, rel, lineno) for every
+    mutating call on a registered metric variable.  None label set means
+    the site passes dynamic ``**labels`` and cannot be checked."""
+    out = []
+    for mod in repo.package_modules():
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in LABELED_CALLS):
+                continue
+            base = dotted(node.func.value)
+            if base is None:
+                continue
+            leaf = base.split(".")[-1]
+            name = var_to_name.get(leaf)
+            if name is None:
+                continue
+            keys = set()
+            dynamic = False
+            for kw in node.keywords:
+                if kw.arg is None:
+                    dynamic = True
+                else:
+                    keys.add(kw.arg)
+            out.append((name, None if dynamic else frozenset(keys),
+                        mod.rel, node.lineno))
+    return out
+
+
+@rule("A401", "metrics", "metric name registered more than once")
+def check_duplicate_registration(repo):
+    seen: "dict[str, tuple[str, int]]" = {}
+    for name, _, rel, lineno, _ in registrations(repo):
+        if name in seen:
+            first_rel, first_line = seen[name]
+            yield Finding(
+                rel, lineno, "A401",
+                f"metric {name!r} already registered at "
+                f"{first_rel}:{first_line}",
+            )
+        else:
+            seen[name] = (rel, lineno)
+
+
+@rule("A402", "metrics", "label-key drift across a metric's call sites")
+def check_label_consistency(repo):
+    regs = registrations(repo)
+    # Call sites resolve metrics by variable leaf name (imports strip the
+    # module path), so a leaf bound to DIFFERENT metrics in different
+    # modules is ambiguous — drop it rather than conflate the two
+    # metrics' call sites into a spurious (or masked) drift report.
+    leaf_names: "dict[str, set[str]]" = {}
+    for name, _, _, _, var in regs:
+        if var:
+            leaf_names.setdefault(var.split(".")[-1], set()).add(name)
+    var_to_name = {leaf: next(iter(names))
+                   for leaf, names in leaf_names.items() if len(names) == 1}
+    by_metric: "dict[str, dict[frozenset, tuple[str, int]]]" = {}
+    for name, keys, rel, lineno in call_sites(repo, var_to_name):
+        if keys is None:
+            continue
+        by_metric.setdefault(name, {}).setdefault(keys, (rel, lineno))
+    for name, shapes in sorted(by_metric.items()):
+        if len(shapes) <= 1:
+            continue
+        rendered = sorted(
+            ("{" + ",".join(sorted(k)) + "}", rel, lineno)
+            for k, (rel, lineno) in shapes.items()
+        )
+        first = rendered[0]
+        for shape, rel, lineno in rendered[1:]:
+            yield Finding(
+                rel, lineno, "A402",
+                f"metric {name!r} labeled {shape} here but {first[0]} at "
+                f"{first[1]}:{first[2]} — one series shape per metric",
+            )
+
+
+# --- doc cross-check --------------------------------------------------------
+
+_DOC_TOKEN = re.compile(
+    r"tpu_dra_[a-zA-Z0-9_]*(?:\{[^}\n]*\}[a-zA-Z0-9_]*)*"
+)
+
+
+def doc_metric_names(text: str, prefix: str):
+    """(name, lineno) for every metric the doc claims, with label
+    annotations stripped and brace alternation expanded."""
+    out = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        for m in _DOC_TOKEN.finditer(line):
+            token = m.group(0)
+            end = m.end()
+            if end < len(line) and line[end] == "*":
+                continue  # `tpu_dra_fleet_*` glob: prose, not a claim
+            for name in _expand(token):
+                if name.startswith(prefix) and name != prefix:
+                    out.append((name, lineno))
+    return out
+
+
+def _expand(token: str) -> "list[str]":
+    m = re.search(r"\{([^}]*)\}", token)
+    if not m:
+        return [token]
+    head, tail = token[: m.start()], token[m.end():]
+    inner = m.group(1)
+    # `name{label,label}` annotation: braces at the end of a complete
+    # name, nothing following.  `pre_{a,b}_post` alternation: the name
+    # continues after the brace.
+    if not tail or not re.match(r"[a-zA-Z0-9_]", tail):
+        return [head + tail] if head else []
+    alts = [a.strip() for a in inner.split(",")]
+    if not all(re.fullmatch(r"[a-zA-Z0-9_]+", a) for a in alts):
+        return [head + tail]
+    out = []
+    for alt in alts:
+        out.extend(_expand(head + alt + tail))
+    return out
+
+
+_HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+@rule("A403", "metrics", "registered metric missing from the metrics doc")
+def check_doc_presence(repo):
+    doc_rel = repo.config.metric_doc
+    text = repo.docs.get(doc_rel)
+    if text is None:
+        return
+    documented = {n for n, _ in doc_metric_names(text, repo.config.metric_prefix)}
+    for name, _, rel, lineno, _ in registrations(repo):
+        if name not in documented:
+            yield Finding(
+                rel, lineno, "A403",
+                f"metric {name!r} is not documented in {doc_rel}",
+            )
+
+
+@rule("A404", "metrics", "doc names a metric the code does not register")
+def check_doc_staleness(repo):
+    doc_rel = repo.config.metric_doc
+    text = repo.docs.get(doc_rel)
+    if text is None:
+        return
+    registered = {name for name, _, _, _, _ in registrations(repo)}
+    if not registered:
+        return  # doc-only fixture or metrics module not in scope
+    reported = set()
+    for name, lineno in doc_metric_names(text, repo.config.metric_prefix):
+        base = name
+        for suffix in _HISTO_SUFFIXES:
+            if name.endswith(suffix) and name[: -len(suffix)] in registered:
+                base = name[: -len(suffix)]
+                break
+        if base in registered or (name, lineno) in reported:
+            continue
+        reported.add((name, lineno))
+        yield Finding(
+            doc_rel, lineno, "A404",
+            f"{doc_rel} documents {name!r} but no code registers it",
+        )

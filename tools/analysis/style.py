@@ -1,0 +1,155 @@
+"""L0xx — the legacy tools/lint.py file-local rules, on the registry.
+
+Same codes, same semantics (tools/lint.py is now a thin shim over
+these), plus L006 — previously an unassigned code — for bare ``# noqa``
+comments now that suppressions are code-scoped:
+
+- **L001** syntax error (files that fail ``ast.parse``)
+- **L002** unused module-scope import (``__all__`` and string
+  annotations count as usage)
+- **L003** mutable default argument
+- **L004** bare ``except:``
+- **L005** ``print()`` in library code
+- **L006** bare ``# noqa`` (scope it: ``# noqa: L002`` — a blanket
+  suppression hides every future rule on that line too)
+- **L007** tab character in source
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import Finding, noqa_codes, rule
+
+
+def _identifierish(text: str):
+    token = ""
+    for ch in text:
+        if ch.isidentifier() if not token else (ch.isalnum() or ch == "_"):
+            token += ch
+        else:
+            if token:
+                yield token
+            token = ""
+    if token:
+        yield token
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+    # Names referenced from string annotations ("list[Topology] | None").
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            for token in _identifierish(node.value):
+                used.add(token)
+    return used
+
+
+def _names_in_all(tree: ast.AST) -> set:
+    in_all = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == "__all__":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        for element in node.value.elts:
+                            if isinstance(element, ast.Constant):
+                                in_all.add(element.value)
+    return in_all
+
+
+@rule("L002", "style", "unused module-scope import")
+def check_unused_imports(repo):
+    for mod in repo.modules.values():
+        used = _used_names(mod.tree)
+        in_all = _names_in_all(mod.tree)
+        for node in mod.tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = (alias.asname or alias.name).split(".")[0]
+                    if name not in used and name not in in_all:
+                        yield Finding(
+                            mod.rel, node.lineno, "L002",
+                            f"unused import {name!r}",
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    name = alias.asname or alias.name
+                    if name not in used and name not in in_all:
+                        yield Finding(
+                            mod.rel, node.lineno, "L002",
+                            f"unused import {name!r}",
+                        )
+
+
+@rule("L003", "style", "mutable default argument")
+def check_mutable_defaults(repo):
+    for mod in repo.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for default in node.args.defaults + node.args.kw_defaults:
+                    if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                        yield Finding(
+                            mod.rel, node.lineno, "L003",
+                            f"mutable default argument in {node.name}()",
+                        )
+
+
+@rule("L004", "style", "bare except:")
+def check_bare_except(repo):
+    for mod in repo.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield Finding(mod.rel, node.lineno, "L004", "bare except:")
+
+
+@rule("L005", "style", "print() in library code")
+def check_library_print(repo):
+    allowed = repo.config.print_allowed_prefixes
+    root = repo.config.package_root + "/"
+    for mod in repo.modules.values():
+        if not mod.rel.startswith(root):
+            continue
+        if any(mod.rel.startswith(p) for p in allowed):
+            continue
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                yield Finding(
+                    mod.rel, node.lineno, "L005", "print() in library code"
+                )
+
+
+@rule("L006", "style", "bare # noqa (use code-scoped # noqa: CODE)")
+def check_bare_noqa(repo):
+    for mod in repo.modules.values():
+        for lineno, comment in sorted(mod.comments.items()):
+            codes = noqa_codes(comment)
+            if codes is not None and not codes:
+                yield Finding(
+                    mod.rel, lineno, "L006",
+                    "bare # noqa suppresses every rule on this line — "
+                    "scope it: # noqa: CODE[,CODE]",
+                )
+
+
+@rule("L007", "style", "tab character in source")
+def check_tabs(repo):
+    for mod in repo.modules.values():
+        if "\t" in mod.source:
+            line = mod.source[: mod.source.index("\t")].count("\n") + 1
+            yield Finding(mod.rel, line, "L007", "tab character in source")

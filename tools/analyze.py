@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""tpudra-analyze CLI — run the whole-repo invariant analysis.
+
+    python tools/analyze.py [paths...] [--select CODES] [--list-rules]
+
+Default paths: tpu_dra tests demo tools.  Exit 1 on findings, 0 clean.
+The graph rules (layering, locks, metrics) always see the full package
+tree; positional paths only filter which files' findings are REPORTED,
+so `python tools/analyze.py tpu_dra/fleet` never hides a cross-package
+violation by narrowing the graph.
+
+AST-only by construction: this process must never import jax (or
+tpu_dra itself) — the analyzer has to be runnable from any control-plane
+CI box in seconds.  tests/test_analysis.py enforces that with an import
+tripwire.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TOOLS_DIR)
+if TOOLS_DIR not in sys.path:
+    sys.path.insert(0, TOOLS_DIR)
+
+# Importing the package registers every rule family (analysis/__init__).
+from analysis.core import Repo, all_rules, run_rules  # noqa: E402
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpudra-analyze", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="report findings only under these paths")
+    parser.add_argument("--select", default="",
+                        help="comma-separated rule codes to run (e.g. "
+                             "A101,A402); default: all")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.code}  [{r.family}]  {r.summary}")
+        return 0
+
+    select = {c.strip().upper() for c in args.select.split(",") if c.strip()}
+    repo, parse_errors = Repo.load(REPO_ROOT)
+    findings = list(parse_errors) if not select or "L001" in select else []
+    findings += run_rules(repo, select=select or None)
+
+    if args.paths:
+        prefixes = tuple(p.rstrip("/") for p in args.paths)
+        findings = [
+            f for f in findings
+            if any(f.path == p or f.path.startswith(p + "/")
+                   for p in prefixes)
+        ]
+
+    for finding in findings:
+        print(finding)
+    print(
+        f"analyze: {len(repo.modules)} files, {len(all_rules())} rules, "
+        f"{len(findings)} finding(s)",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

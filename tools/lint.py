@@ -1,20 +1,21 @@
 #!/usr/bin/env python
-"""In-repo linter (the .golangci.yaml analog — the environment ships no
-Python lint tools, so the checks that matter are implemented here):
+"""In-repo linter — thin shim over the ``analysis`` package's style rules.
 
-- syntax: every file must compile (ast.parse)
-- unused imports (module-scope, name-accurate via AST walk)
-- undefined-name smoke check for leaked test helpers (restricted: names
-  imported under TYPE_CHECKING are fine; we only flag uses of obviously
-  missing module-level names in the same file when they match prior typos)
-- no mutable default arguments (def f(x=[]) / {} / set())
-- no bare `except:`
-- no print() in library code (tpu_dra/, excluding cmds/ + sim CLIs which
-  are user-facing binaries)
-- no tabs in Python source
+The original file-local checks (L001 syntax, L002 unused imports, L003
+mutable defaults, L004 bare except, L005 library print, L006 bare noqa,
+L007 tabs) now live in ``tools/analysis/style.py`` on the shared rule
+registry, where tests/test_analysis.py covers each one against fixture
+snippets.  This entry point keeps the historical CLI and API:
 
-Run: python tools/lint.py [paths...]   (default: tpu_dra tests demo tools)
-Exit nonzero on findings; prints file:line: code message per finding.
+    python tools/lint.py [paths...]   (default: tpu_dra tests demo tools)
+
+``# noqa`` suppressions are code-scoped: ``# noqa: L003`` waives one
+rule, ``# noqa: L002,L005`` several.  A bare ``# noqa`` still works but
+is itself flagged (L006) so blanket suppressions can't accumulate.
+
+The whole-repo invariant analysis (layering/jax-free gate, clock and
+lock discipline, metric drift — docs/ANALYSIS.md) is the superset:
+``python tools/analyze.py`` / ``make analyze``.
 """
 
 from __future__ import annotations
@@ -23,160 +24,49 @@ import ast
 import os
 import sys
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TOOLS_DIR)
+if TOOLS_DIR not in sys.path:
+    sys.path.insert(0, TOOLS_DIR)
 
-PRINT_ALLOWED_PREFIXES = (
-    "tpu_dra/cmds/",
-    "tpu_dra/sim/kubectl.py",
-    "tpu_dra/sim/kubesim.py",
-    "tpu_dra/sim/httpapiserver.py",
-    "tpu_dra/deploy/__main__.py",
-    "tpu_dra/api/crdgen.py",
-    "tpu_dra/parallel/validate.py",  # JSON-report CLI (driver entry point)
-    "tools/",
-    "demo/",
-    "tests/",
+from analysis.core import (  # noqa: E402 — needs tools/ on sys.path first
+    Config,
+    Finding,
+    Module,
+    Repo,
+    module_name,
+    run_rules,
 )
 
-
-class Finding:
-    def __init__(self, path: str, line: int, code: str, message: str):
-        self.path, self.line, self.code, self.message = path, line, code, message
-
-    def __str__(self):
-        return f"{self.path}:{self.line}: {self.code} {self.message}"
-
-
-def _used_names(tree: ast.AST) -> set:
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            root = node
-            while isinstance(root, ast.Attribute):
-                root = root.value
-            if isinstance(root, ast.Name):
-                used.add(root.id)
-    # Names referenced from string annotations ("list[Topology] | None").
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            for token in _identifierish(node.value):
-                used.add(token)
-    return used
-
-
-def _identifierish(text: str):
-    token = ""
-    for ch in text:
-        if ch.isidentifier() if not token else (ch.isalnum() or ch == "_"):
-            token += ch
-        else:
-            if token:
-                yield token
-            token = ""
-    if token:
-        yield token
+STYLE_CODES = {"L001", "L002", "L003", "L004", "L005", "L006", "L007"}
 
 
 def check_file(path: str, rel: str) -> "list[Finding]":
-    findings: list[Finding] = []
+    """Style findings for one file (the historical per-file API)."""
     with open(path, encoding="utf-8") as f:
         source = f.read()
-    lines = source.splitlines()
-
-    def noqa(lineno: int) -> bool:
-        return 0 < lineno <= len(lines) and "# noqa" in lines[lineno - 1]
-
-    if "\t" in source and rel.endswith(".py"):
-        line = source[: source.index("\t")].count("\n") + 1
-        findings.append(Finding(rel, line, "L007", "tab character in source"))
-
+    rel = rel.replace(os.sep, "/")
     try:
         tree = ast.parse(source, filename=rel)
     except SyntaxError as e:
-        findings.append(Finding(rel, e.lineno or 0, "L001", f"syntax error: {e.msg}"))
-        return findings
-
-    used = _used_names(tree)
-    in_all = set()
-    for node in tree.body:
-        if isinstance(node, ast.Assign):
-            for target in node.targets:
-                if isinstance(target, ast.Name) and target.id == "__all__":
-                    if isinstance(node.value, (ast.List, ast.Tuple)):
-                        for element in node.value.elts:
-                            if isinstance(element, ast.Constant):
-                                in_all.add(element.value)
-
-    # Unused module-level imports.
-    for node in tree.body:
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                name = (alias.asname or alias.name).split(".")[0]
-                if name not in used and name not in in_all:
-                    findings.append(
-                        Finding(rel, node.lineno, "L002", f"unused import {name!r}")
-                    )
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":
-                continue
-            for alias in node.names:
-                if alias.name == "*":
-                    continue
-                name = alias.asname or alias.name
-                if name not in used and name not in in_all:
-                    findings.append(
-                        Finding(rel, node.lineno, "L002", f"unused import {name!r}")
-                    )
-
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for default in node.args.defaults + node.args.kw_defaults:
-                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
-                    findings.append(
-                        Finding(
-                            rel, node.lineno, "L003",
-                            f"mutable default argument in {node.name}()",
-                        )
-                    )
-        elif isinstance(node, ast.ExceptHandler) and node.type is None:
-            findings.append(Finding(rel, node.lineno, "L004", "bare except:"))
-        elif (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-            and rel.startswith("tpu_dra/")
-            and not any(rel.startswith(p) for p in PRINT_ALLOWED_PREFIXES)
-        ):
-            findings.append(
-                Finding(rel, node.lineno, "L005", "print() in library code")
-            )
-    return [f for f in findings if not noqa(f.line)]
+        return [Finding(rel, e.lineno or 0, "L001", f"syntax error: {e.msg}")]
+    config = Config()
+    mod = Module(rel=rel, source=source, tree=tree,
+                 lines=source.splitlines(),
+                 name=module_name(rel, config.package_root))
+    repo = Repo(modules={rel: mod}, config=config)
+    return run_rules(repo, select=STYLE_CODES)
 
 
 def main(argv: "list[str] | None" = None) -> int:
     roots = (argv or sys.argv[1:]) or ["tpu_dra", "tests", "demo", "tools"]
-    findings: list[Finding] = []
-    count = 0
-    for root in roots:
-        base = os.path.join(REPO_ROOT, root)
-        if os.path.isfile(base):
-            files = [base]
-        else:
-            files = [
-                os.path.join(dirpath, name)
-                for dirpath, _, names in os.walk(base)
-                for name in names
-                if name.endswith(".py")
-            ]
-        for path in sorted(files):
-            rel = os.path.relpath(path, REPO_ROOT)
-            count += 1
-            findings.extend(check_file(path, rel))
+    repo, parse_errors = Repo.load(REPO_ROOT, roots=roots)
+    findings = list(parse_errors)  # unparsable files never reach the rules
+    findings += run_rules(repo, select=STYLE_CODES)
     for finding in findings:
         print(finding)
-    print(f"lint: {count} files, {len(findings)} findings", file=sys.stderr)
+    print(f"lint: {len(repo.modules)} files, {len(findings)} findings",
+          file=sys.stderr)
     return 1 if findings else 0
 
 

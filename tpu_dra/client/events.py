@@ -10,6 +10,11 @@ Event object instead of piling up new ones.
 
 Recording is best-effort by contract: an unreachable apiserver or a
 conflict storm must never break the reconcile path that tried to record.
+
+Lives in ``client`` (not ``utils``): the recorder is a clientset consumer
+through and through, and ``utils`` sits below ``api``/``client`` in the
+layer DAG (tools/analyze.py A101) — this module was the one upward
+import that kept ``utils`` from being a true bottom layer.
 """
 
 from __future__ import annotations

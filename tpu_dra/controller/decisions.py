@@ -154,7 +154,7 @@ class FlightRecorder:
         """Stamp seq/timestamp, append (evicting at capacity), and move
         the rejection counter when the verdict is a rejection."""
         if not rec.ts_unix:
-            rec.ts_unix = time.time()
+            rec.ts_unix = time.time()  # noqa: A201 — display stamp, not a duration
         with self._lock:
             self._seq += 1
             rec.seq = self._seq

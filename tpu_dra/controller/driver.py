@@ -44,7 +44,7 @@ from tpu_dra.controller.subslice_allocator import SubsliceDriver
 from tpu_dra.controller.tpu_allocator import TpuDriver
 from tpu_dra.controller.types import ClaimAllocation, params_fingerprint
 from tpu_dra.utils import trace
-from tpu_dra.utils.events import parse_time
+from tpu_dra.client.events import parse_time
 from tpu_dra.utils.metrics import (
     ALLOCATE_SECONDS,
     CLAIM_E2E_SECONDS,

@@ -44,7 +44,7 @@ from tpu_dra.controller.driver import ControllerDriver
 from tpu_dra.controller.types import ClaimAllocation
 from tpu_dra.utils import trace
 from tpu_dra.utils.metrics import SYNC_TOTAL, WORKQUEUE_DEPTH
-from tpu_dra.utils.events import TYPE_NORMAL, TYPE_WARNING, EventRecorder
+from tpu_dra.client.events import TYPE_NORMAL, TYPE_WARNING, EventRecorder
 
 logger = logging.getLogger(__name__)
 

@@ -37,7 +37,7 @@ import time
 from tpu_dra.api import nas_v1alpha1 as nascrd
 from tpu_dra.client.apiserver import ApiError, NotFoundError
 from tpu_dra.controller import decisions
-from tpu_dra.utils.events import TYPE_WARNING
+from tpu_dra.client.events import TYPE_WARNING
 
 logger = logging.getLogger(__name__)
 

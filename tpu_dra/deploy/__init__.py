@@ -1,1 +1,3 @@
-from tpu_dra.deploy.helmlite import render_chart  # noqa: F401
+from tpu_dra.deploy.helmlite import render_chart  # noqa: L002,F401 — re-export
+
+__all__ = ["render_chart"]

@@ -58,8 +58,11 @@ class ReplicaDigest:
     ``prefixes`` maps ``hash_run(tokens[:k*window]) -> hits`` for every
     resident run and every window multiple k; ``max_len`` bounds the
     longest claimable prefix so lookups stop early; ``epoch`` is the
-    source cache's residency epoch at build time; ``built_at`` (wall
-    clock) feeds the ``tpu_dra_fleet_digest_age_seconds`` gauge."""
+    source cache's residency epoch at build time; ``built_at`` is on the
+    **monotonic clock** (same discipline as the availability cache's
+    snapshot age) — it exists only to feed
+    ``tpu_dra_fleet_digest_age_seconds`` and the staleness spill, and an
+    NTP step must not fake a digest fresh or ancient."""
 
     replica: str
     window: int = 1
@@ -73,7 +76,11 @@ class ReplicaDigest:
         return len(self.prefixes)
 
     def age_s(self, now: "float | None" = None) -> float:
-        return max(0.0, (time.time() if now is None else now) - self.built_at)
+        """Seconds since build; ``now`` (when given) must come from
+        ``time.monotonic()`` like ``built_at`` does."""
+        return max(
+            0.0, (time.monotonic() if now is None else now) - self.built_at
+        )
 
     def lookup(self, tokens: "list[int]") -> "tuple[int, int]":
         """Longest window-aligned prefix of ``tokens`` this digest
@@ -112,7 +119,7 @@ def empty_digest(replica: str) -> ReplicaDigest:
     """The digest of an engine with no prefix cache (or nothing
     resident): matches nothing, so affinity routing simply never picks
     the replica — it still serves by load."""
-    return ReplicaDigest(replica=replica, window=1, built_at=time.time())
+    return ReplicaDigest(replica=replica, window=1, built_at=time.monotonic())
 
 
 def build_digest(index: dict, *, replica: str, epoch: int = 0,
@@ -140,5 +147,5 @@ def build_digest(index: dict, *, replica: str, epoch: int = 0,
         max_len = max(max_len, aligned)
     return ReplicaDigest(
         replica=replica, window=window, epoch=epoch,
-        built_at=time.time(), max_len=max_len, prefixes=prefixes,
+        built_at=time.monotonic(), max_len=max_len, prefixes=prefixes,
     )

@@ -81,7 +81,9 @@ class FleetFlightRecorder:
 
     def record(self, rec: PlacementRecord) -> PlacementRecord:
         if not rec.ts_unix:
-            rec.ts_unix = time.time()
+            # Epoch anchor for display/joins; ages on the record were
+            # measured monotonic by the router.
+            rec.ts_unix = time.time()  # noqa: A201 — display stamp, not a duration
         with self._lock:
             self._seq += 1
             rec.seq = self._seq

@@ -129,7 +129,7 @@ def _unix_of(perf_t: float) -> float:
     """Map an engine perf_counter timestamp onto the wall clock for span
     records (the timeline runs on the monotonic clock; chrome-trace wants
     unix time — debug-grade precision is fine)."""
-    return time.time() - (time.perf_counter() - perf_t)
+    return time.time() - (time.perf_counter() - perf_t)  # noqa: A201 — epoch anchor
 
 
 def _weak_sampler(ref: "weakref.ref", fn):

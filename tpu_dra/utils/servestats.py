@@ -95,7 +95,9 @@ class EngineFlightRecorder:
 
     def record(self, rec: StepRecord) -> StepRecord:
         if not rec.ts_unix:
-            rec.ts_unix = time.time()
+            # Epoch anchor for display/joins; durations (step_wall_s)
+            # arrive perf_counter-measured by the caller.
+            rec.ts_unix = time.time()  # noqa: A201 — display stamp, not a duration
         with self._lock:
             self._seq += 1
             rec.seq = self._seq

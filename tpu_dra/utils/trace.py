@@ -242,7 +242,9 @@ class Span:
     # -- context manager -----------------------------------------------------
 
     def __enter__(self) -> "Span":
-        self._start_unix = time.time()
+        # Epoch anchor for chrome-trace export; the duration below is
+        # measured on perf_counter, never from this stamp.
+        self._start_unix = time.time()  # noqa: A201 — epoch anchor, not a duration
         self._start_perf = time.perf_counter()
         self._token = _CURRENT.set(self)
         return self
