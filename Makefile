@@ -9,7 +9,7 @@ TAG     ?= latest
 .PHONY: all test lint analyze generate-crds check-generate native \
         native-test demo-quickstart bench image clean help \
         observability-smoke perf-smoke explain-smoke serve-smoke \
-        serve-obs-smoke chaos-smoke fleet-smoke
+        serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke
 
 # `analyze` runs the full rule registry — the L-style rules lint would
 # run plus the whole-repo invariants — so `all` needs only one pass.
@@ -107,6 +107,17 @@ chaos-smoke:
 fleet-smoke:
 	$(PYTHON) -m pytest tests/test_fleet_smoke.py -q -m 'not slow'
 
+# The cluster observability plane end to end (docs/OBSERVABILITY.md
+# "Cluster observability plane"): a real plugin subprocess + the
+# in-process controller under one ObsCollector — one merged trace tree
+# carries both processes' spans for the same claim; a seeded node kill
+# drives the eviction-spike alert pending -> firing -> resolved off
+# scraped metrics; `tpudra top`/`alerts` render; /debug/cluster
+# validates queries; and the analyzer certifies obs/ jax-free,
+# monotonic-clocked, drift-free.  Runs in `make all` via `test`.
+obs-top-smoke:
+	$(PYTHON) -m pytest tests/test_obs_top_smoke.py -q -m 'not slow'
+
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
@@ -119,4 +130,4 @@ help:
 	@echo "targets: test lint analyze generate-crds check-generate native"
 	@echo "         native-test demo-quickstart bench observability-smoke"
 	@echo "         perf-smoke explain-smoke serve-smoke serve-obs-smoke"
-	@echo "         chaos-smoke fleet-smoke image clean"
+	@echo "         chaos-smoke fleet-smoke obs-top-smoke image clean"

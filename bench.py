@@ -1751,8 +1751,39 @@ flaky = FlakyApiServer(FakeApiServer(), seed=SEED)
 cluster = SimCluster(
     tmp, nodes=4, mesh="2x1x1", multihost_slice=True,
     recreate_evicted=True, server=flaky,
+    metrics_endpoint="127.0.0.1:0",
 )
 cluster.start()
+
+# ---- The cluster observability plane over the chaos run (ISSUE 9) ----
+# Two panes: the sim's own MetricsServer (auto-registered) is the
+# controller pane; a second server stands in for the victim node's
+# plugin endpoint — the first kill takes it down (scrape-down must fire
+# and resolve), the revive brings a fresh server up on the same port.
+import os as _os
+
+from tpu_dra.obs import alerts as obsalerts
+from tpu_dra.obs.collector import ObsCollector
+from tpu_dra.utils.metrics import MetricsServer
+
+node_pane = MetricsServer("127.0.0.1:0")
+node_pane.start()
+node_pane_port = node_pane.port
+obs_snap = tempfile.mkdtemp()
+collector = ObsCollector(
+    interval_s=0.05,
+    timeout_s=2.0,
+    rules=[
+        obsalerts.eviction_spike(
+            rate_threshold=0.05, window_s=2.0, for_s=0.1
+        ),
+        obsalerts.scrape_down(for_s=0.1),
+    ],
+    recorder=obsalerts.AlertFlightRecorder(),
+    snapshot_dir=obs_snap,
+    auto_discover_local=True,  # adopts the SimCluster pane
+)
+collector.start()
 cluster.clientset.resource_classes().create(ResourceClass(
     metadata=ObjectMeta(name="tpu.google.com"), driver_name=GROUP_NAME
 ))
@@ -1804,6 +1835,11 @@ try:
             victim = ev.target if ev.target in occupied else sorted(occupied)[0]
             remap[ev.target] = victim
             killed.append(victim)
+            if node_pane is not None:
+                # The victim's plugin endpoint dies with the node: the
+                # collector must see the scrape-down, not an exception.
+                node_pane.stop()
+                node_pane = None
             t0 = time.monotonic()
             cluster.kill_node(victim)
             assert wait_reformed(cluster, victim, timeout=120), (
@@ -1812,6 +1848,12 @@ try:
             recoveries.append(time.monotonic() - t0)
         elif ev.action == REVIVE_NODE:
             cluster.revive_node(remap.get(ev.target, ev.target))
+            if node_pane is None:
+                # The revived node's endpoint returns on the SAME port
+                # (allow_reuse_address), so the same scrape target
+                # transitions back up and the alert resolves.
+                node_pane = MetricsServer(f"127.0.0.1:{node_pane_port}")
+                node_pane.start()
             time.sleep(0.1)
     evictions = [
         r for r in decisions.RECORDER.query()
@@ -1824,6 +1866,39 @@ try:
         )
         for v in killed
     )
+    # The observability plane's verdict on the same chaos: both alerts
+    # must complete their lifecycle (the eviction wave and the dead
+    # endpoint fire, then resolve once the storm passes and the node
+    # pane returns).  Wait out the rate windows before judging.
+    obs_deadline = time.monotonic() + 30
+    while time.monotonic() < obs_deadline:
+        status = {s["rule"]: s["state"] for s in collector.engine.status()}
+        if all(st == "ok" for st in status.values()):
+            break
+        time.sleep(0.1)
+    collector.stop()
+    hist = [
+        (e.rule, e.prev_state, e.state)
+        for e in collector.engine.recorder.query()
+    ]
+
+    def lifecycle(rule):
+        states = [s for r, _, s in hist if r == rule]
+        return {
+            "pending": "pending" in states,
+            "firing": "firing" in states,
+            "resolved": "resolved" in states,
+        }
+
+    eviction_alert = lifecycle("ClaimEvictionSpike")
+    scrape_alert = lifecycle("ScrapeDown")
+    post_mortem = collector.dump_snapshot(reason="post-chaos")
+    obs_ok = bool(
+        all(eviction_alert.values())
+        and all(scrape_alert.values())
+        and collector.rounds > 10
+        and _os.path.isdir(post_mortem)
+    )
     out["control_plane"] = {
         "nodes": 4, "gang_size": GANG, "kills": len(killed),
         "recovery_p50_s": round(pctl(recoveries, 0.5), 3),
@@ -1834,9 +1909,20 @@ try:
         "faults_injected": flaky.faults_injected,
         "fault_breakdown": flaky.fault_breakdown(),
         "plan": plan.to_dict(),
-        "ok": every_kill_recorded and bool(recoveries),
+        "obs": {
+            "eviction_alert": eviction_alert,
+            "scrape_down_alert": scrape_alert,
+            "alert_events": len(hist),
+            "scrape_rounds": collector.rounds,
+            "snapshots": len(_os.listdir(obs_snap)),
+            "ok": obs_ok,
+        },
+        "ok": every_kill_recorded and bool(recoveries) and obs_ok,
     }
 finally:
+    collector.close()
+    if node_pane is not None:
+        node_pane.stop()
     flaky.resume()
     cluster.stop()
 emit()

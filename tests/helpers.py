@@ -163,3 +163,51 @@ class DeploymentReadinessStub:
 
     def stop(self):
         self._sim.stop()
+
+
+# --- Prometheus exposition helpers ------------------------------------------
+# One grammar for every test that reads an exposition: the shared parser
+# (tpu_dra/obs/promparse.py) the cluster collector scrapes with.  Strict
+# mode everywhere — a test fixture producing out-of-grammar text IS the
+# escaping bug class these helpers exist to catch.
+
+def metric_samples(text: str):
+    from tpu_dra.obs import promparse
+
+    return promparse.parse(text, strict=True)
+
+
+def metric_value(text: str, name: str, **labels) -> "float | None":
+    """First matching series' value (labels are a subset match); None
+    when the series is absent — absent is not zero."""
+    from tpu_dra.obs import promparse
+
+    return promparse.value(metric_samples(text), name, **labels)
+
+
+def metric_total(text: str, name: str, **labels) -> float:
+    """Sum across every matching series (Counter.total(), exposition-side)."""
+    from tpu_dra.obs import promparse
+
+    return promparse.total(metric_samples(text), name, **labels)
+
+
+def assert_metrics_exposed(text: str, names) -> None:
+    """Every name is a declared family in the exposition (TYPE line plus
+    parseable samples — histograms may expose only their children)."""
+    from tpu_dra.obs import promparse
+
+    families = promparse.parse_families(text, strict=True)
+    for name in names:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[: -len(suffix)]
+                break
+        assert base in families, f"{name} missing from the exposition"
+        # A family minted from bare sample lines has type "untyped" —
+        # that means the # TYPE header regressed, which the literal
+        # string greps these helpers replaced used to catch.
+        assert families[base].type != "untyped", (
+            f"{base} exposed without a # TYPE declaration"
+        )

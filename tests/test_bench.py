@@ -162,6 +162,14 @@ def test_bench_chaos_stanza():
     cp = out["control_plane"]
     assert cp["every_kill_recorded"] and cp["kills"] >= 1
     assert cp["faults_injected"] > 0
+    # The obs plane rode the same chaos (ISSUE 9): the eviction-spike and
+    # scrape-down alerts completed pending -> firing -> resolved, and a
+    # post-mortem snapshot landed on disk.
+    obs = cp["obs"]
+    assert obs["ok"], obs
+    assert all(obs["eviction_alert"].values())
+    assert all(obs["scrape_down_alert"].values())
+    assert obs["snapshots"] >= 1 and obs["scrape_rounds"] > 10
     assert out["elastic_train"]["loss_continuity_ok"]
     assert out["elastic_train"]["devices_after"] < out["elastic_train"][
         "devices_before"

@@ -17,6 +17,7 @@ import urllib.request
 
 import pytest
 
+import helpers
 from tpu_dra.fleet import stats as fleetstats
 from tpu_dra.fleet.fleet import ServeFleet
 from tpu_dra.parallel.burnin import BurninConfig, init_params
@@ -91,19 +92,19 @@ def test_fleet_routes_by_affinity_and_exposes_debug_endpoint():
         # The fleet series are in the exposition and moved.
         fleet.scale_hint()
         expo = REGISTRY.expose()
-        for name in (
-            "tpu_dra_fleet_routed_total",
-            "tpu_dra_fleet_digest_age_seconds",
-            "tpu_dra_fleet_load_skew",
-            "tpu_dra_fleet_queue_depth",
-            "tpu_dra_fleet_scale_hints_total",
-        ):
-            assert name in expo, f"{name} missing from the exposition"
-        routed = [
-            ln for ln in expo.splitlines()
-            if ln.startswith("tpu_dra_fleet_routed_total{")
-        ]
-        assert any('reason="affinity"' in ln for ln in routed), routed
+        helpers.assert_metrics_exposed(
+            expo,
+            (
+                "tpu_dra_fleet_routed_total",
+                "tpu_dra_fleet_digest_age_seconds",
+                "tpu_dra_fleet_load_skew",
+                "tpu_dra_fleet_queue_depth",
+                "tpu_dra_fleet_scale_hints_total",
+            ),
+        )
+        assert helpers.metric_total(
+            expo, "tpu_dra_fleet_routed_total", reason="affinity"
+        ) > 0
 
         # The CLI renders the same snapshot (no curl required).
         from tpu_dra.cmds.explain import fleet_stats, parse_args

@@ -1,0 +1,631 @@
+"""The cluster observability plane (tpu_dra/obs/): collector scrape
+health + series rings, alert state machine + default rules, cross
+-endpoint trace assembly, /debug/index and /debug/cluster, the ring
+-dropped metric, the post-mortem snapshot, and the `tpudra top` /
+`tpudra alerts` CLIs."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_dra.obs import alerts as obsalerts
+from tpu_dra.obs import promparse
+from tpu_dra.obs.collector import Endpoint, ObsCollector, set_active
+from tpu_dra.utils import trace
+from tpu_dra.utils.metrics import (
+    RING_DROPPED,
+    MetricsServer,
+    Registry,
+    running_servers,
+)
+
+
+def _get(url: str) -> str:
+    return urllib.request.urlopen(url, timeout=5).read().decode()
+
+
+def make_collector(*endpoints, **kw):
+    """A collector wired for test isolation: private alert recorder (the
+    global one is shared process state) and explicit rules."""
+    kw.setdefault("recorder", obsalerts.AlertFlightRecorder())
+    kw.setdefault("rules", obsalerts.default_rules(window_s=5.0))
+    return ObsCollector(list(endpoints), **kw)
+
+
+@pytest.fixture
+def rig():
+    """A throwaway registry + server + collector pointed at it."""
+    reg = Registry()
+    server = MetricsServer("127.0.0.1:0", registry=reg)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    collector = make_collector(Endpoint(url, name="ep0"))
+    try:
+        yield reg, server, url, collector
+    finally:
+        collector.close()
+        set_active(None)
+        try:
+            server.stop()
+        except Exception:
+            pass
+
+
+class TestCollectorScrape:
+    def test_scrape_health_and_series(self, rig):
+        reg, _, _, collector = rig
+        reg.counter("t_obs_a_total", "x").inc(3.0, kind="k")
+        events = collector.scrape_once()
+        assert events == []  # nothing alertable on a healthy scrape
+        (health,) = collector.endpoint_health()
+        assert health["up"] and health["endpoint"] == "ep0"
+        assert health["consecutive_failures"] == 0
+        assert health["series"] >= 1
+        assert health["staleness_s"] is not None
+        assert collector.value("t_obs_a_total", kind="k") == 3.0
+        assert collector.rounds == 1
+
+    def test_failed_scrape_degrades_to_stale_data(self, rig):
+        reg, server, url, collector = rig
+        reg.counter("t_obs_b_total", "x").inc(7.0)
+        collector.scrape_once()
+        assert collector.value("t_obs_b_total") == 7.0
+        server.stop()
+        # Scraping a dead endpoint must not raise; the endpoint goes
+        # down but the last good samples stay queryable.
+        collector.scrape_once()
+        (health,) = collector.endpoint_health()
+        assert not health["up"]
+        assert health["consecutive_failures"] == 1
+        assert health["error"]
+        assert health["staleness_s"] is not None
+        assert collector.value("t_obs_b_total") == 7.0  # stale, kept
+
+    def test_counter_rate_across_scrapes(self, rig):
+        reg, _, _, collector = rig
+        c = reg.counter("t_obs_rate_total", "x")
+        c.inc(1.0)
+        collector.scrape_once()
+        time.sleep(0.02)
+        c.inc(5.0)
+        collector.scrape_once()
+        rate = collector.rate("t_obs_rate_total", window_s=60.0)
+        assert rate > 0  # 5 increase over ~20ms
+        # Gauge delta, signed.
+        g = reg.gauge("t_obs_depth", "x")
+        g.set(10.0)
+        collector.scrape_once()
+        g.set(4.0)
+        time.sleep(0.01)
+        collector.scrape_once()
+        assert collector.delta("t_obs_depth", window_s=60.0) == -6.0
+        assert collector.max_value("t_obs_depth") == 4.0
+
+    def test_series_born_between_scrapes_counts_as_increase(self, rig):
+        """A counter's first inc mints its labeled series; the collector
+        seeds a zero at the previous scrape so the burst is a rate, not
+        an invisible single point — the eviction-wave case."""
+        reg, _, _, collector = rig
+        c = reg.counter("t_obs_burst_total", "x")
+        collector.scrape_once()
+        c.inc(4.0, reason="NodeNotReady")
+        time.sleep(0.02)
+        collector.scrape_once()
+        assert collector.rate("t_obs_burst_total", window_s=60.0) > 0
+        # Gauges get no synthetic zero: a gauge's first sample is a
+        # level, not an increase.
+        g = reg.gauge("t_obs_level", "x")
+        g.set(100.0)
+        time.sleep(0.02)
+        collector.scrape_once()
+        assert collector.delta("t_obs_level", window_s=60.0) == 0.0
+
+    def test_injected_clock_windows_deterministically(self, rig):
+        """scrape_once(now_mono=) drives the WHOLE evaluation clock —
+        ring stamps, rate()/delta() windows, and staleness — so fake
+        times nowhere near real monotonic still window correctly."""
+        reg, _, _, collector = rig
+        c = reg.counter("t_obs_det_total", "x")
+        c.inc(1.0)
+        collector.scrape_once(now_mono=1000.0)
+        c.inc(9.0)
+        collector.scrape_once(now_mono=1002.0)
+        rate = collector.rate("t_obs_det_total", window_s=60.0)
+        assert rate == pytest.approx(9.0 / 2.0)
+        (health,) = collector.endpoint_health()
+        assert health["up"]
+        assert health["staleness_s"] == pytest.approx(0.0)
+
+    def test_remove_endpoint_during_inflight_scrape_stays_removed(self, rig):
+        """remove_endpoint racing an in-flight scrape: the write-back
+        re-checks registration under the lock, so the removed endpoint's
+        rings and up/staleness series are not resurrected."""
+        reg, _, _, collector = rig
+        reg.counter("t_obs_gone_total", "x").inc(1.0)
+        collector.scrape_once()  # healthy baseline, series present
+        orig_get = collector._get
+
+        def racy_get(url):
+            text = orig_get(url)
+            collector.remove_endpoint("ep0")
+            return text
+
+        collector._get = racy_get
+        assert collector.scrape_endpoint("ep0") is False
+        assert collector.endpoints() == []
+        assert collector.value("t_obs_gone_total") is None
+        expo = collector.registry.expose()
+        assert 'tpu_dra_obs_up{endpoint="ep0"}' not in expo
+        assert 'tpu_dra_obs_scrape_staleness_seconds{endpoint="ep0"}' not in expo
+
+    def test_auto_discover_local(self):
+        server = MetricsServer("127.0.0.1:0")
+        server.start()
+        collector = make_collector(auto_discover_local=True)
+        try:
+            assert server in running_servers()
+            collector.scrape_once()
+            names = collector.endpoints()
+            assert f"local:{server.port}" in names
+        finally:
+            collector.close()
+            server.stop()
+        assert server not in running_servers()
+
+    def test_unknown_endpoint_scrape_returns_false(self, rig):
+        _, _, _, collector = rig
+        assert collector.scrape_endpoint("nope") is False
+
+    def test_remove_endpoint_drops_rings(self, rig):
+        reg, _, _, collector = rig
+        reg.counter("t_obs_gone_total", "x").inc()
+        collector.scrape_once()
+        assert collector.value("t_obs_gone_total") is not None
+        collector.remove_endpoint("ep0")
+        assert collector.endpoints() == []
+        assert collector.value("t_obs_gone_total") is None
+
+
+class FakeView:
+    """Minimal alert-rule view: canned rates/levels + endpoint health."""
+
+    def __init__(self, rates=None, deltas=None, maxes=None, health=()):
+        self.rates = rates or {}
+        self.deltas = deltas or {}
+        self.maxes = maxes or {}
+        self.health = list(health)
+
+    def rate(self, name, *, window_s=60.0, endpoint=None, **labels):
+        key = (name,) + tuple(sorted(labels.items()))
+        return self.rates.get(key, self.rates.get((name,), 0.0))
+
+    def delta(self, name, *, window_s=60.0, endpoint=None, **labels):
+        return self.deltas.get(name, 0.0)
+
+    def max_value(self, name, *, endpoint=None, **labels):
+        return self.maxes.get(name)
+
+    def endpoint_health(self, now_mono=None):
+        return self.health
+
+
+class TestAlertEngine:
+    def engine(self, rule):
+        return obsalerts.AlertEngine(
+            [rule], recorder=obsalerts.AlertFlightRecorder()
+        )
+
+    def test_pending_firing_resolved_lifecycle(self):
+        rule = obsalerts.AlertRule(
+            name="Test", expr=lambda v: (v.rate("x") > 1, v.rate("x"), "d"),
+            for_s=1.0,
+        )
+        eng = self.engine(rule)
+        hot = FakeView(rates={("x",): 5.0})
+        cold = FakeView(rates={("x",): 0.0})
+        t0 = 100.0
+        ev = eng.evaluate(hot, now_mono=t0)
+        assert [(e.prev_state, e.state) for e in ev] == [("ok", "pending")]
+        # Still inside for_s: no transition.
+        assert eng.evaluate(hot, now_mono=t0 + 0.5) == []
+        ev = eng.evaluate(hot, now_mono=t0 + 1.1)
+        assert [(e.prev_state, e.state) for e in ev] == [
+            ("pending", "firing")
+        ]
+        assert eng.firing() == ["Test"]
+        ev = eng.evaluate(cold, now_mono=t0 + 2.0)
+        assert [(e.prev_state, e.state) for e in ev] == [
+            ("firing", "resolved")
+        ]
+        # Resolved decays to ok quietly.
+        assert eng.evaluate(cold, now_mono=t0 + 3.0) == []
+        (status,) = eng.status(now_mono=t0 + 3.0)
+        assert status["state"] == "ok"
+        assert status["transitions"] == 3
+
+    def test_pending_clears_without_firing(self):
+        rule = obsalerts.AlertRule(
+            name="Blip", expr=lambda v: (v.rate("x") > 1, 0.0, ""),
+            for_s=10.0,
+        )
+        eng = self.engine(rule)
+        eng.evaluate(FakeView(rates={("x",): 5.0}), now_mono=0.0)
+        ev = eng.evaluate(FakeView(), now_mono=1.0)
+        assert [(e.prev_state, e.state) for e in ev] == [("pending", "ok")]
+
+    def test_for_zero_fires_in_one_round(self):
+        rule = obsalerts.AlertRule(
+            name="Now", expr=lambda v: (True, 1.0, ""), for_s=0.0
+        )
+        eng = self.engine(rule)
+        ev = eng.evaluate(FakeView(), now_mono=0.0)
+        assert [e.state for e in ev] == ["pending", "firing"]
+
+    def test_broken_rule_reports_error_not_raise(self):
+        def boom(view):
+            raise RuntimeError("rule bug")
+
+        eng = self.engine(obsalerts.AlertRule(name="Broken", expr=boom))
+        assert eng.evaluate(FakeView(), now_mono=0.0) == []
+        (status,) = eng.status()
+        assert "rule bug" in status["error"]
+        assert status["state"] == "ok"
+
+    def test_recorder_ring_bounds_and_dropped_metric(self):
+        rec = obsalerts.AlertFlightRecorder(capacity=3)
+        before = RING_DROPPED.value(ring="obs_alerts")
+        for i in range(5):
+            rec.record(obsalerts.AlertEvent(rule=f"r{i}", state="firing"))
+        assert rec.recorded == 5
+        assert rec.dropped == 2
+        assert len(rec.query()) == 3
+        assert RING_DROPPED.value(ring="obs_alerts") == before + 2
+        assert [e.rule for e in rec.query(limit=1)][0] == "r4"
+        assert rec.query(rule="r3")[0].rule == "r3"
+        assert all(e.state == "firing" for e in rec.query(state="firing"))
+
+
+class TestDefaultRules:
+    def fire(self, rule, view):
+        fired, value, detail = rule.expr(view)
+        return fired, detail
+
+    def test_goodput_burn_rate(self):
+        rule = obsalerts.goodput_burn_rate(slo_target=0.95, burn_threshold=2.0)
+        quiet = FakeView()
+        assert self.fire(rule, quiet) == (False, "no SLO-evaluated traffic in window")
+        hot = FakeView(rates={
+            ("tpu_dra_serve_slo_total", ("slo", "request"), ("verdict", "met")): 1.0,
+            ("tpu_dra_serve_slo_total", ("slo", "request"), ("verdict", "missed")): 1.0,
+        })
+        fired, detail = self.fire(rule, hot)
+        assert fired and "error budget" in detail  # 50% missed = 10x budget
+        ok = FakeView(rates={
+            ("tpu_dra_serve_slo_total", ("slo", "request"), ("verdict", "met")): 99.0,
+            ("tpu_dra_serve_slo_total", ("slo", "request"), ("verdict", "missed")): 1.0,
+        })
+        assert not self.fire(rule, ok)[0]  # 1% missed = 0.2x budget
+
+    def test_eviction_spike(self):
+        rule = obsalerts.eviction_spike(rate_threshold=0.1)
+        assert not self.fire(rule, FakeView())[0]
+        assert self.fire(
+            rule, FakeView(rates={("tpu_dra_claim_evictions_total",): 1.0})
+        )[0]
+
+    def test_fleet_queue_growth(self):
+        rule = obsalerts.fleet_queue_growth(growth_threshold=4.0)
+        assert not self.fire(
+            rule, FakeView(deltas={"tpu_dra_fleet_queue_depth": 2.0})
+        )[0]
+        assert self.fire(
+            rule, FakeView(deltas={"tpu_dra_fleet_queue_depth": 9.0})
+        )[0]
+
+    def test_digest_staleness(self):
+        rule = obsalerts.digest_staleness(stale_after_s=10.0)
+        assert not self.fire(rule, FakeView())[0]  # no fleet at all
+        assert not self.fire(
+            rule, FakeView(maxes={"tpu_dra_fleet_digest_age_seconds": 5.0})
+        )[0]
+        assert self.fire(
+            rule, FakeView(maxes={"tpu_dra_fleet_digest_age_seconds": 60.0})
+        )[0]
+
+    def test_scrape_down(self):
+        rule = obsalerts.scrape_down()
+        assert not self.fire(rule, FakeView())[0]  # nothing configured
+        up = [{"endpoint": "a", "up": True}]
+        down = [{"endpoint": "a", "up": True}, {"endpoint": "b", "up": False}]
+        assert not self.fire(rule, FakeView(health=up))[0]
+        fired, detail = self.fire(rule, FakeView(health=down))
+        assert fired and "b" in detail
+
+    def test_default_rules_names_are_stable(self):
+        names = [r.name for r in obsalerts.default_rules()]
+        assert names == [
+            "ServeGoodputBurnRate",
+            "FleetQueueGrowth",
+            "ClaimEvictionSpike",
+            "FleetDigestStale",
+            "ScrapeDown",
+        ]
+
+
+class TestRingDropped:
+    def test_span_exporter_overflow_moves_ring_dropped(self):
+        exporter = trace.SpanExporter(capacity=3)
+        before = RING_DROPPED.value(ring="trace")
+        for i in range(5):
+            with trace.span(f"rd.{i}", exporter=exporter):
+                pass
+        assert exporter.dropped == 2
+        assert exporter.recorded == 5
+        assert RING_DROPPED.value(ring="trace") == before + 2
+
+    def test_engine_and_fleet_recorders_move_ring_dropped(self):
+        from tpu_dra.fleet.stats import FleetFlightRecorder, PlacementRecord
+        from tpu_dra.utils.servestats import EngineFlightRecorder, StepRecord
+
+        before = RING_DROPPED.value(ring="engine")
+        rec = EngineFlightRecorder(capacity=2)
+        for _ in range(4):
+            rec.record(StepRecord(engine="e"))
+        assert RING_DROPPED.value(ring="engine") == before + 2
+        before = RING_DROPPED.value(ring="fleet")
+        frec = FleetFlightRecorder(capacity=2)
+        for _ in range(3):
+            frec.record(PlacementRecord(fleet="f"))
+        assert RING_DROPPED.value(ring="fleet") == before + 1
+
+    def test_decisions_recorder_moves_ring_dropped(self):
+        from tpu_dra.controller.decisions import DecisionRecord, FlightRecorder
+
+        before = RING_DROPPED.value(ring="decisions")
+        rec = FlightRecorder(capacity=2)
+        for _ in range(5):
+            rec.record(DecisionRecord(claim="c"))
+        assert RING_DROPPED.value(ring="decisions") == before + 3
+
+
+class TestDebugIndex:
+    def test_index_lists_capabilities(self, rig):
+        _, _, url, _ = rig
+        doc = json.loads(_get(url + "/debug/index"))
+        assert doc["component"]
+        assert doc["version"]
+        eps = doc["endpoints"]
+        assert "/metrics" in eps and eps["/metrics"]["kind"] == "metrics"
+        assert "/debug/index" in eps
+        assert "/debug/traces" in eps
+        assert eps["/debug/traces"]["recorded"] >= 0
+        # servestats is imported in this process (the test suite drags it
+        # in), so the engine ring must be listed with counts.
+        assert "/debug/engine" in eps
+        assert set(eps["/debug/engine"]) == {"kind", "recorded", "dropped"}
+
+    def test_index_reflects_active_collector(self, rig):
+        _, _, url, collector = rig
+        doc = json.loads(_get(url + "/debug/index"))
+        assert "/debug/cluster" not in doc["endpoints"]
+        set_active(collector)
+        try:
+            doc = json.loads(_get(url + "/debug/index"))
+            assert doc["endpoints"]["/debug/cluster"]["active"]
+        finally:
+            set_active(None)
+
+
+class TestTraceAssembly:
+    def test_raw_format_and_dedup_across_endpoints(self, rig):
+        """Two endpoints serving one process's exporter: the merged view
+        keeps one copy of each span, annotated with BOTH endpoints."""
+        _, server, url, _ = rig
+        trace.EXPORTER.clear()
+        with trace.span("obs.parent", claim_uid="u1"):
+            with trace.span("obs.child"):
+                pass
+        second = MetricsServer("127.0.0.1:0")
+        second.start()
+        collector = make_collector(
+            Endpoint(f"http://127.0.0.1:{server.port}", name="a"),
+            Endpoint(f"http://127.0.0.1:{second.port}", name="b"),
+        )
+        try:
+            raw = json.loads(_get(url + "/debug/traces?format=raw"))
+            assert {"spans", "recorded", "dropped"} <= raw.keys()
+            collector.scrape_once()
+            spans = collector.fetch_spans()
+            names = [s["name"] for s in spans]
+            assert "obs.parent" in names and "obs.child" in names
+            by_name = {s["name"]: s for s in spans}
+            assert sorted(by_name["obs.parent"]["endpoints"]) == ["a", "b"]
+            # One copy per span despite two endpoints returning it.
+            assert len([n for n in names if n == "obs.child"]) == 1
+            tree = collector.assemble_trace_tree()
+            assert "obs.parent" in tree and "obs.child" in tree
+            chrome = collector.assemble_chrome_trace()
+            assert any(
+                e.get("name") == "obs.parent"
+                for e in chrome["traceEvents"]
+            )
+            # Filtering by trace id narrows the join.
+            tid = by_name["obs.parent"]["trace_id"]
+            only = collector.fetch_spans(trace_id=tid)
+            assert {s["trace_id"] for s in only} == {tid}
+        finally:
+            collector.close()
+            second.stop()
+
+    def test_fetch_skips_unreachable_endpoints(self):
+        collector = make_collector(
+            Endpoint("http://127.0.0.1:1", name="dead")
+        )
+        try:
+            assert collector.fetch_spans() == []
+        finally:
+            collector.close()
+
+    def test_traces_rejects_unknown_format(self, rig):
+        _, _, url, _ = rig
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(url + "/debug/traces?format=xml")
+        assert err.value.code == 400
+
+
+class TestClusterEndpoint:
+    def test_no_active_collector(self, rig):
+        _, _, url, _ = rig
+        set_active(None)
+        doc = json.loads(_get(url + "/debug/cluster"))
+        assert doc["collector"] is None and doc["endpoints"] == []
+        text = _get(url + "/debug/cluster?format=text")
+        assert "no collector active" in text
+
+    def test_doc_text_alerts_and_filters(self, rig):
+        reg, server, url, collector = rig
+        reg.counter("t_obs_c_total", "x").inc()
+        collector.scrape_once()
+        obs_server = collector.serve()
+        base = f"http://127.0.0.1:{obs_server.port}"
+        doc = json.loads(_get(base + "/debug/cluster"))
+        assert doc["collector"] == "obs"
+        assert doc["endpoints_up"] == 1
+        (row,) = doc["endpoints"]
+        assert row["endpoint"] == "ep0" and row["up"]
+        assert {"spans_per_s", "goodput", "evictions_per_s"} <= row.keys()
+        assert {a["rule"] for a in doc["alerts"]} == {
+            r.name for r in collector.engine.rules
+        }
+        text = _get(base + "/debug/cluster?format=text")
+        assert "ep0" in text and "endpoint(s) up" in text
+        alerts_text = _get(base + "/debug/cluster?format=alerts")
+        assert "ScrapeDown" in alerts_text
+        filtered = json.loads(_get(base + "/debug/cluster?endpoint=nope"))
+        assert filtered["endpoints"] == []
+        ruled = json.loads(_get(base + "/debug/cluster?rule=ScrapeDown"))
+        assert [a["rule"] for a in ruled["alerts"]] == ["ScrapeDown"]
+        # The collector's own registry is what /metrics serves here.
+        exposition = _get(base + "/metrics")
+        samples = promparse.parse(exposition, strict=True)
+        assert promparse.value(samples, "tpu_dra_obs_up", endpoint="ep0") == 1.0
+        assert promparse.total(samples, "tpu_dra_obs_scrapes_total") >= 1.0
+        assert "tpu_dra_obs_scrape_duration_seconds_count" in promparse.names(
+            samples
+        )
+
+    @pytest.mark.parametrize(
+        "query",
+        [
+            "format=bogus",
+            "limit=0",
+            "limit=x",
+            "window=-1",
+            "window=nan",
+            "window=inf",
+        ],
+    )
+    def test_bad_queries_are_400(self, rig, query):
+        _, _, url, collector = rig
+        set_active(collector)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(url + "/debug/cluster?" + query)
+        assert err.value.code == 400
+
+
+class TestSnapshot:
+    def test_dump_writes_the_post_mortem(self, rig, tmp_path):
+        reg, _, _, collector = rig
+        reg.counter("t_obs_snap_total", "x").inc()
+        collector.scrape_once()
+        path = collector.dump_snapshot(str(tmp_path), reason="test")
+        files = sorted(os.listdir(path))
+        assert "cluster.json" in files
+        assert "rings.json" in files
+        assert "traces.json" in files
+        assert any(f.startswith("exposition-") for f in files)
+        doc = json.loads(open(os.path.join(path, "cluster.json")).read())
+        assert doc["reason"] == "test"
+        assert doc["endpoints"][0]["endpoint"] == "ep0"
+        rings = json.loads(open(os.path.join(path, "rings.json")).read())
+        assert any("t_obs_snap_total" in k for k in rings)
+
+    def test_firing_alert_triggers_snapshot(self, tmp_path):
+        """The chaos contract: a rule transitioning to firing dumps the
+        post-mortem without anyone asking."""
+        collector = make_collector(
+            Endpoint("http://127.0.0.1:1", name="dead"),
+            rules=[obsalerts.scrape_down(for_s=0.0)],
+            snapshot_dir=str(tmp_path),
+        )
+        try:
+            collector.scrape_once()
+            snaps = os.listdir(str(tmp_path))
+            assert len(snaps) == 1
+        finally:
+            collector.close()
+
+    def test_dump_without_dir_raises(self, rig):
+        _, _, _, collector = rig
+        with pytest.raises(ValueError):
+            collector.dump_snapshot()
+
+
+class TestTopCli:
+    def test_top_and_alerts_render(self, rig, capsys):
+        from tpu_dra.cmds import explain as cli
+
+        reg, _, _, collector = rig
+        reg.counter("t_obs_cli_total", "x").inc()
+        collector.scrape_once()
+        obs_server = collector.serve()
+        base = f"http://127.0.0.1:{obs_server.port}"
+        assert cli.main(["top", "--endpoint", base]) == 0
+        out = capsys.readouterr().out
+        assert "ep0" in out and "endpoint(s) up" in out
+        assert cli.main(["top", "--endpoint", base, "--format", "json"]) == 0
+        assert json.loads(capsys.readouterr().out)["collector"] == "obs"
+        assert cli.main(["alerts", "--endpoint", base]) == 0
+        out = capsys.readouterr().out
+        assert "ScrapeDown" in out
+        assert (
+            cli.main(
+                ["alerts", "--endpoint", base, "--rule", "ScrapeDown"]
+            )
+            == 0
+        )
+        out = capsys.readouterr().out
+        assert "ScrapeDown" in out and "FleetQueueGrowth" not in out
+
+    def test_top_against_collectorless_process(self, rig, capsys):
+        from tpu_dra.cmds import explain as cli
+
+        _, _, url, _ = rig
+        set_active(None)
+        assert cli.main(["top", "--endpoint", url]) == 0
+        assert "no collector active" in capsys.readouterr().out
+
+    def test_top_unreachable_endpoint(self, capsys):
+        from tpu_dra.cmds import explain as cli
+
+        assert cli.main(["top", "--endpoint", "http://127.0.0.1:1"]) == 1
+        assert "cannot reach" in capsys.readouterr().err
+
+    def test_shared_endpoint_env_fallback(self, monkeypatch):
+        from tpu_dra.cmds import explain as cli
+
+        monkeypatch.setenv("TPUDRA_ENDPOINT", "http://everything:9")
+        args = cli.parse_args(["top"])
+        assert args.endpoint == "http://everything:9"
+        args = cli.parse_args(["serve-stats"])
+        assert args.endpoint == "http://everything:9"
+        args = cli.parse_args(["explain", "c"])
+        assert args.controller == "http://everything:9"
+        # The specific env still wins over the shared one.
+        monkeypatch.setenv("TPUDRA_ENGINE", "http://engine:9")
+        args = cli.parse_args(["serve-stats"])
+        assert args.endpoint == "http://engine:9"

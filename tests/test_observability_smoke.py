@@ -2,43 +2,18 @@
 every line of the exposition parses under the Prometheus text-format
 grammar (``make observability-smoke`` runs exactly this file).
 
-The parser here is deliberately strict about the pieces the escaping bug
-class corrupts: label values must be double-quoted with only ``\\\\``,
-``\\"`` and ``\\n`` escapes, and every sample must fit on one line."""
+The grammar lives in ``tpu_dra/obs/promparse.py`` — the SAME parser the
+cluster collector scrapes with — so this smoke certifies the exposition
+against exactly what production consumers parse, instead of a test-local
+regex re-implementation.  Strictness matters for the escaping bug class:
+label values must be double-quoted with only ``\\\\``, ``\\"`` and
+``\\n`` escapes, and every sample must fit on one line."""
 
-import re
 import urllib.request
 
+from tpu_dra.obs import promparse
 from tpu_dra.utils import trace
 from tpu_dra.utils.metrics import MetricsServer, set_build_info
-
-METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
-LABEL_NAME = r"[a-zA-Z_][a-zA-Z0-9_]*"
-# Label values: any run of non-special chars or a valid escape sequence.
-LABEL_VALUE = r'"(?:[^"\\\n]|\\\\|\\"|\\n)*"'
-LABEL_PAIR = f"{LABEL_NAME}={LABEL_VALUE}"
-LABELS = r"\{" + f"{LABEL_PAIR}(?:,{LABEL_PAIR})*" + r"\}"
-FLOAT = r"[+-]?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|Inf|NaN)"
-SAMPLE_RE = re.compile(f"^{METRIC_NAME}(?:{LABELS})? {FLOAT}$")
-HELP_RE = re.compile(f"^# HELP {METRIC_NAME} .*$")
-TYPE_RE = re.compile(f"^# TYPE {METRIC_NAME} (counter|gauge|histogram|summary)$")
-
-
-def assert_exposition_parses(body: str) -> int:
-    """Every non-empty line must match the text-format grammar; returns the
-    number of sample lines checked."""
-    samples = 0
-    for i, line in enumerate(body.splitlines(), 1):
-        if not line:
-            continue
-        if line.startswith("# HELP "):
-            assert HELP_RE.match(line), f"line {i}: bad HELP: {line!r}"
-        elif line.startswith("# TYPE "):
-            assert TYPE_RE.match(line), f"line {i}: bad TYPE: {line!r}"
-        else:
-            assert SAMPLE_RE.match(line), f"line {i}: bad sample: {line!r}"
-            samples += 1
-    return samples
 
 
 def test_metrics_exposition_parses_end_to_end():
@@ -55,7 +30,10 @@ def test_metrics_exposition_parses_end_to_end():
     throwaway.counter("esc_probe_total", "escape probe").inc(
         kind='we\\ird "kind"\nwith newline', outcome="ok"
     )
-    assert assert_exposition_parses(throwaway.expose()) == 1
+    samples = promparse.parse(throwaway.expose(), strict=True)
+    assert len(samples) == 1
+    # The parser round-trips the escapes back to the original value.
+    assert samples[0].labeldict["kind"] == 'we\\ird "kind"\nwith newline'
 
     server = MetricsServer("127.0.0.1:0")
     server.start()
@@ -67,8 +45,13 @@ def test_metrics_exposition_parses_end_to_end():
         )
     finally:
         server.stop()
-    samples = assert_exposition_parses(body)
+    samples = promparse.assert_valid(body)
     assert samples > 10  # the default registry is populated
-    assert "tpu_dra_build_info" in body
-    assert "tpu_dra_trace_spans_total" in body
-    assert "tpu_dra_span_seconds_bucket" in body
+    families = promparse.parse_families(body, strict=True)
+    assert families["tpu_dra_build_info"].type == "gauge"
+    assert families["tpu_dra_trace_spans_total"].type == "counter"
+    assert families["tpu_dra_span_seconds"].type == "histogram"
+    assert any(
+        s.name == "tpu_dra_span_seconds_bucket"
+        for s in families["tpu_dra_span_seconds"].samples
+    )

@@ -112,13 +112,16 @@ def test_fanout_cache_smoke(tmp_path):
 
 
 def test_new_counters_in_exposition():
-    text = REGISTRY.expose()
-    for name in (
-        "tpu_dra_placement_cache_hits_total",
-        "tpu_dra_placement_cache_misses_total",
-        "tpu_dra_availability_snapshot_hits_total",
-        "tpu_dra_availability_snapshot_misses_total",
-        "tpu_dra_availability_snapshot_invalidations_total",
-        "tpu_dra_availability_snapshot_age_seconds",
-    ):
-        assert f"# TYPE {name}" in text, f"{name} missing from exposition"
+    from helpers import assert_metrics_exposed
+
+    assert_metrics_exposed(
+        REGISTRY.expose(),
+        (
+            "tpu_dra_placement_cache_hits_total",
+            "tpu_dra_placement_cache_misses_total",
+            "tpu_dra_availability_snapshot_hits_total",
+            "tpu_dra_availability_snapshot_misses_total",
+            "tpu_dra_availability_snapshot_invalidations_total",
+            "tpu_dra_availability_snapshot_age_seconds",
+        ),
+    )

@@ -1,0 +1,88 @@
+"""The shared Prometheus exposition parser (tpu_dra/obs/promparse.py):
+round-trips against the in-repo registry, the escaping bug class, strict
+-mode grammar enforcement, and the sample-query helpers every consumer
+(collector, smokes, bench) joins on."""
+
+import pytest
+
+from tpu_dra.obs import promparse
+from tpu_dra.utils.metrics import Registry
+
+
+def test_parse_counter_gauge_and_labels():
+    text = (
+        "# HELP x_total things\n"
+        "# TYPE x_total counter\n"
+        'x_total{a="1",b="two"} 3\n'
+        "x_total 4.5\n"
+        "# TYPE g gauge\n"
+        "g -0.25\n"
+    )
+    samples = promparse.parse(text, strict=True)
+    assert len(samples) == 3
+    assert promparse.value(samples, "x_total", a="1", b="two") == 3.0
+    assert promparse.value(samples, "x_total", a="1") == 3.0  # subset match
+    assert promparse.value(samples, "g") == -0.25
+    assert promparse.total(samples, "x_total") == 7.5
+    assert promparse.names(samples) == {"x_total", "g"}
+    assert promparse.value(samples, "missing") is None
+    assert promparse.total(samples, "missing") == 0.0
+
+
+def test_label_value_unescaping():
+    text = 'm{k="we\\\\ird \\"quoted\\"\\nnewline"} 1\n'
+    (sample,) = promparse.parse(text, strict=True)
+    assert sample.labeldict["k"] == 'we\\ird "quoted"\nnewline'
+
+
+def test_strict_raises_lenient_skips():
+    bad = "ok_total 1\nthis is not a sample\n"
+    with pytest.raises(promparse.PromParseError, match="line 2"):
+        promparse.parse(bad, strict=True)
+    samples = promparse.parse(bad)
+    assert [s.name for s in samples] == ["ok_total"]
+    # Malformed label block: unquoted value.
+    with pytest.raises(promparse.PromParseError):
+        promparse.parse("m{k=raw} 1", strict=True)
+    # Bad comment lines only fail strict mode.
+    assert promparse.parse("# bogus comment\nv 1", strict=False)
+    with pytest.raises(promparse.PromParseError):
+        promparse.parse("# bogus comment\nv 1", strict=True)
+
+
+def test_parse_families_groups_histogram_children():
+    reg = Registry()
+    hist = reg.histogram("h_seconds", "a histogram", buckets=(0.1, 1.0))
+    hist.observe(0.05, op="x")
+    hist.observe(5.0, op="x")
+    counter = reg.counter("c_total", "a counter")
+    counter.inc(2.0)
+    families = promparse.parse_families(reg.expose(), strict=True)
+    assert families["h_seconds"].type == "histogram"
+    assert families["c_total"].type == "counter"
+    child_names = {s.name for s in families["h_seconds"].samples}
+    assert child_names == {"h_seconds_bucket", "h_seconds_sum", "h_seconds_count"}
+    assert promparse.value(
+        families["h_seconds"].samples, "h_seconds_count", op="x"
+    ) == 2.0
+    # +Inf bucket parses as float('inf').
+    inf = promparse.value(
+        families["h_seconds"].samples, "h_seconds_bucket", op="x", le="+Inf"
+    )
+    assert inf == 2.0
+
+
+def test_registry_roundtrip_default_registry():
+    """The process-global registry's exposition parses strictly — the
+    observability smoke's contract, via the shared grammar."""
+    from tpu_dra.utils.metrics import REGISTRY
+
+    count = promparse.assert_valid(REGISTRY.expose())
+    assert count > 10
+
+
+def test_assert_valid_rejects_out_of_grammar():
+    with pytest.raises(promparse.PromParseError):
+        promparse.assert_valid('m{k="unterminated} 1')
+    with pytest.raises(promparse.PromParseError):
+        promparse.assert_valid("m NaN")  # grammar-legal, registry-illegal

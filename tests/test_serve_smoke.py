@@ -7,6 +7,7 @@ real hit rate, prefill tokens actually avoided, greedy outputs identical
 to the cache-off engine, and the serve-prefix counters + TTFT histogram
 present in the Prometheus exposition."""
 
+import helpers
 from tpu_dra.parallel.burnin import BurninConfig, init_params
 from tpu_dra.parallel.serve import ServeEngine
 from tpu_dra.utils.metrics import REGISTRY
@@ -43,17 +44,17 @@ def test_shared_prefix_stream_hits_and_exposes_counters():
     assert all(t > 0.0 for t in done_ttft)
 
     text = REGISTRY.expose()
-    for name in (
-        "tpu_dra_serve_prefix_hits_total",
-        "tpu_dra_serve_prefix_misses_total",
-        "tpu_dra_serve_prefix_evictions_total",
-        "tpu_dra_serve_prefill_tokens_total",
-        "tpu_dra_serve_ttft_seconds_bucket",
-    ):
-        assert name in text, f"{name} missing from the exposition"
+    helpers.assert_metrics_exposed(
+        text,
+        (
+            "tpu_dra_serve_prefix_hits_total",
+            "tpu_dra_serve_prefix_misses_total",
+            "tpu_dra_serve_prefix_evictions_total",
+            "tpu_dra_serve_prefill_tokens_total",
+            "tpu_dra_serve_ttft_seconds_bucket",
+        ),
+    )
     # The engine above really moved the process-global counters.
-    hits_line = [
-        ln for ln in text.splitlines()
-        if ln.startswith("tpu_dra_serve_prefix_hits_total")
-    ][0]
-    assert float(hits_line.rsplit(" ", 1)[1]) >= stats["hits"]
+    assert helpers.metric_total(
+        text, "tpu_dra_serve_prefix_hits_total"
+    ) >= stats["hits"]

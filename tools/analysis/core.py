@@ -88,11 +88,15 @@ class Config:
         "sim": ("sim", "controller", "plugin", "client", "api", "utils",
                 "<root>"),
         "cmds": ("cmds", "sim", "controller", "plugin", "proxy", "client",
-                 "api", "utils", "fleet", "<root>"),
+                 "api", "utils", "fleet", "obs", "<root>"),
         "deploy": ("deploy", "client", "sim", "api", "utils", "<root>"),
         # fleet is jax-free BY DESIGN (a router is control-plane code);
         # engines are handed in as objects, never imported eagerly.
         "fleet": ("fleet", "utils", "<root>"),
+        # obs is the cluster observability plane: scrapes OTHER processes
+        # over HTTP, so it needs nothing above utils — and must stay
+        # jax-free so the collector runs in any binary (or its own pod).
+        "obs": ("obs", "utils", "<root>"),
         # jax-land: parallel/models may import anything below themselves.
         "parallel": ("parallel", "models", "fleet", "api", "utils", "<root>"),
         "models": ("models", "parallel", "api", "utils", "<root>"),
@@ -124,6 +128,9 @@ class Config:
         "tpu_dra/fleet/fleet.py",
         "tpu_dra/controller/decisions.py",
         "tpu_dra/parallel/serve.py",
+        "tpu_dra/obs/collector.py",
+        "tpu_dra/obs/alerts.py",
+        "tpu_dra/obs/cluster.py",
     )
     # Where the metric registry lives and which doc must list every metric.
     metric_prefix: str = "tpu_dra_"

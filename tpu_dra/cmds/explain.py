@@ -29,6 +29,18 @@ request land on THAT replica?" — rendering the placement flight
 recorder from ``/debug/fleet`` (tpu_dra/fleet/stats.py): per-replica
 placement counts, affinity/load/spill reason breakdown, digest ages,
 and the per-replica loads each decision saw.
+
+`tpudra top` and `tpudra alerts` are the CLUSTER pane (tpu_dra/obs/):
+they query a running collector's ``/debug/cluster`` endpoint for the
+whole fleet at once — per-endpoint scrape health and derived rates,
+plus the alert rule states.  ``top --watch`` redraws like its
+namesake.
+
+Every subcommand talks to a debug HTTP endpoint through the same
+plumbing (`fetch_debug`): a per-command flag/env (``TPUDRA_CONTROLLER``,
+``TPUDRA_ENGINE``, ``TPUDRA_FLEET``, ``TPUDRA_OBS``) falling back to the
+shared ``TPUDRA_ENDPOINT`` — set ONE env var when everything runs behind
+one address, as it does in the sim rungs.
 """
 
 from __future__ import annotations
@@ -36,12 +48,73 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 
 from tpu_dra.cmds import flags
 from tpu_dra.version import version_string
+
+DEFAULT_ENDPOINT = "http://127.0.0.1:8080"
+
+
+def _endpoint_default(env: str) -> str:
+    """Endpoint resolution order: the subcommand's own env, then the
+    shared TPUDRA_ENDPOINT, then localhost."""
+    return flags._env_default(
+        env, flags._env_default("TPUDRA_ENDPOINT", DEFAULT_ENDPOINT)
+    )
+
+
+def _add_endpoint_args(
+    parser: argparse.ArgumentParser,
+    *,
+    env: str,
+    what: str,
+    flag: str = "--endpoint",
+) -> None:
+    """The shared --endpoint/--pprof-path pair every subcommand needs
+    (explain keeps its historical --controller spelling via ``flag``)."""
+    parser.add_argument(
+        flag,
+        default=_endpoint_default(env),
+        help=f"{what} debug HTTP endpoint (its MetricsServer address) "
+        f"[{env}, TPUDRA_ENDPOINT]",
+    )
+    parser.add_argument(
+        "--pprof-path",
+        default="/debug",
+        help="debug path prefix (matches the server's --pprof-path)",
+    )
+
+
+def fetch_debug(
+    endpoint: str,
+    pprof_path: str,
+    name: str,
+    params: "dict | None" = None,
+    timeout: float = 10.0,
+) -> dict:
+    """GET ``<endpoint><pprof>/<name>?format=json&...`` and parse it —
+    the one HTTP path every subcommand (and nothing else) uses.  Empty
+    /None params are dropped so call sites can pass optional filters
+    unconditionally."""
+    query = urllib.parse.urlencode(
+        {
+            "format": "json",
+            **{
+                k: v
+                for k, v in (params or {}).items()
+                if v not in ("", None)
+            },
+        }
+    )
+    base = endpoint.rstrip("/")
+    pprof = "/" + pprof_path.strip("/")
+    url = f"{base}{pprof}/{name}?{query}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
 
 
 def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
@@ -57,16 +130,9 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
         help="per-node placement-decision breakdown for a ResourceClaim",
     )
     explain.add_argument("claim", help="ResourceClaim name (or uid)")
-    explain.add_argument(
-        "--controller",
-        default=flags._env_default("TPUDRA_CONTROLLER", "http://127.0.0.1:8080"),
-        help="controller debug HTTP endpoint (--http-endpoint of the "
-        "controller binary) [TPUDRA_CONTROLLER]",
-    )
-    explain.add_argument(
-        "--pprof-path",
-        default="/debug",
-        help="controller debug path prefix (matches its --pprof-path)",
+    _add_endpoint_args(
+        explain, env="TPUDRA_CONTROLLER", what="controller",
+        flag="--controller",
     )
     explain.add_argument(
         "--apiserver",
@@ -91,17 +157,7 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
         "serve-stats",
         help="live serve-engine step/SLO snapshot from /debug/engine",
     )
-    stats.add_argument(
-        "--endpoint",
-        default=flags._env_default("TPUDRA_ENGINE", "http://127.0.0.1:8080"),
-        help="serve process debug HTTP endpoint (its MetricsServer "
-        "address) [TPUDRA_ENGINE]",
-    )
-    stats.add_argument(
-        "--pprof-path",
-        default="/debug",
-        help="debug path prefix (matches the server's --pprof-path)",
-    )
+    _add_endpoint_args(stats, env="TPUDRA_ENGINE", what="serve process")
     stats.add_argument(
         "--engine",
         default="",
@@ -120,17 +176,7 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
         "fleet-stats",
         help="fleet router placement snapshot from /debug/fleet",
     )
-    fleet.add_argument(
-        "--endpoint",
-        default=flags._env_default("TPUDRA_FLEET", "http://127.0.0.1:8080"),
-        help="fleet process debug HTTP endpoint (its MetricsServer "
-        "address) [TPUDRA_FLEET]",
-    )
-    fleet.add_argument(
-        "--pprof-path",
-        default="/debug",
-        help="debug path prefix (matches the server's --pprof-path)",
-    )
+    _add_endpoint_args(fleet, env="TPUDRA_FLEET", what="fleet process")
     fleet.add_argument(
         "--fleet",
         default="",
@@ -155,18 +201,60 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
         "--limit", type=int, default=256,
         help="max placement records to fetch",
     )
+
+    top = sub.add_parser(
+        "top",
+        help="live cluster dashboard from a collector's /debug/cluster",
+    )
+    _add_endpoint_args(top, env="TPUDRA_OBS", what="obs collector")
+    top.add_argument(
+        "--window", type=float, default=60.0,
+        help="rate window in seconds for the derived columns",
+    )
+    top.add_argument(
+        "--watch", type=float, nargs="?", const=2.0, default=0.0,
+        metavar="SECONDS",
+        help="redraw every SECONDS (default 2 when given bare) until "
+        "interrupted; omit for a one-shot snapshot",
+    )
+    top.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output form (text: the dashboard; json: the raw document)",
+    )
+    top.add_argument(
+        "--limit", type=int, default=256,
+        help="max alert transition events to fetch",
+    )
+
+    alerts = sub.add_parser(
+        "alerts",
+        help="alert rule states + transitions from /debug/cluster",
+    )
+    _add_endpoint_args(alerts, env="TPUDRA_OBS", what="obs collector")
+    alerts.add_argument(
+        "--rule", default="",
+        help="only this rule's state and transitions",
+    )
+    alerts.add_argument(
+        "--window", type=float, default=60.0,
+        help="rate window in seconds for rule evaluation display",
+    )
+    alerts.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output form (text: states + transitions; json: raw)",
+    )
+    alerts.add_argument(
+        "--limit", type=int, default=256,
+        help="max alert transition events to fetch",
+    )
     return parser.parse_args(argv)
 
 
 def _fetch_decisions(args: argparse.Namespace) -> dict:
-    query = urllib.parse.urlencode(
-        {"claim": args.claim, "format": "json", "limit": args.limit}
+    return fetch_debug(
+        args.controller, args.pprof_path, "decisions",
+        {"claim": args.claim, "limit": args.limit},
     )
-    base = args.controller.rstrip("/")
-    pprof = "/" + args.pprof_path.strip("/")
-    url = f"{base}{pprof}/decisions?{query}"
-    with urllib.request.urlopen(url, timeout=10) as resp:
-        return json.loads(resp.read().decode())
 
 
 def _fetch_events(args: argparse.Namespace) -> "list":
@@ -230,18 +318,10 @@ def explain(args: argparse.Namespace, out=sys.stdout) -> int:
 
 
 def _fetch_engine(args: argparse.Namespace) -> dict:
-    query = urllib.parse.urlencode(
-        {
-            "format": "json",
-            "limit": args.limit,
-            **({"engine": args.engine} if args.engine else {}),
-        }
+    return fetch_debug(
+        args.endpoint, args.pprof_path, "engine",
+        {"limit": args.limit, "engine": args.engine},
     )
-    base = args.endpoint.rstrip("/")
-    pprof = "/" + args.pprof_path.strip("/")
-    url = f"{base}{pprof}/engine?{query}"
-    with urllib.request.urlopen(url, timeout=10) as resp:
-        return json.loads(resp.read().decode())
 
 
 def serve_stats(args: argparse.Namespace, out=None) -> int:
@@ -292,20 +372,15 @@ def serve_stats(args: argparse.Namespace, out=None) -> int:
 
 
 def _fetch_fleet(args: argparse.Namespace) -> dict:
-    query = urllib.parse.urlencode(
+    return fetch_debug(
+        args.endpoint, args.pprof_path, "fleet",
         {
-            "format": "json",
             "limit": args.limit,
-            **({"fleet": args.fleet} if args.fleet else {}),
-            **({"replica": args.replica} if args.replica else {}),
-            **({"reason": args.reason} if args.reason else {}),
-        }
+            "fleet": args.fleet,
+            "replica": args.replica,
+            "reason": args.reason,
+        },
     )
-    base = args.endpoint.rstrip("/")
-    pprof = "/" + args.pprof_path.strip("/")
-    url = f"{base}{pprof}/fleet?{query}"
-    with urllib.request.urlopen(url, timeout=10) as resp:
-        return json.loads(resp.read().decode())
 
 
 def fleet_stats(args: argparse.Namespace, out=None) -> int:
@@ -354,6 +429,92 @@ def fleet_stats(args: argparse.Namespace, out=None) -> int:
     return 0
 
 
+def _fetch_cluster(args: argparse.Namespace) -> dict:
+    return fetch_debug(
+        args.endpoint, args.pprof_path, "cluster",
+        {
+            "limit": args.limit,
+            "window": args.window,
+            "rule": getattr(args, "rule", ""),
+        },
+    )
+
+
+def top(args: argparse.Namespace, out=None) -> int:
+    from tpu_dra.obs import cluster as obscluster
+
+    # Call-time stream resolution, like serve_stats.
+    out = sys.stdout if out is None else out
+    try:
+        while True:
+            doc = None
+            try:
+                doc = _fetch_cluster(args)
+            except (urllib.error.URLError, OSError) as e:
+                # One-shot: a dead collector is the answer (rc 1).  Watch
+                # mode: a top must survive blips — show down, retry.
+                if not args.watch:
+                    print(
+                        f"error: cannot reach collector at "
+                        f"{args.endpoint}: {e}",
+                        file=sys.stderr,
+                    )
+                    return 1
+                print("\x1b[2J\x1b[H", end="", file=out)
+                print(
+                    f"collector at {args.endpoint} unreachable: {e} "
+                    "(retrying)",
+                    file=out,
+                )
+            if doc is not None:
+                if args.format == "json":
+                    print(json.dumps(doc, indent=2), file=out)
+                else:
+                    if args.watch:
+                        # ANSI clear + home: redraw in place, the top
+                        # idiom.
+                        print("\x1b[2J\x1b[H", end="", file=out)
+                    if doc.get("collector") is None:
+                        print(
+                            "no collector active at this endpoint (start "
+                            "an ObsCollector and serve() it, or point "
+                            "--endpoint at one)",
+                            file=out,
+                        )
+                    else:
+                        print(
+                            obscluster.render_text(doc), end="", file=out
+                        )
+            if not args.watch:
+                return 0
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        # Ctrl-C anywhere in the watch loop (including mid-fetch) is a
+        # clean exit, not a traceback.
+        return 0
+
+
+def alerts_cmd(args: argparse.Namespace, out=None) -> int:
+    from tpu_dra.obs import cluster as obscluster
+
+    out = sys.stdout if out is None else out
+    try:
+        doc = _fetch_cluster(args)
+    except (urllib.error.URLError, OSError) as e:
+        print(
+            f"error: cannot reach collector at {args.endpoint}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    if args.format == "json":
+        print(json.dumps(doc, indent=2), file=out)
+    elif doc.get("collector") is None:
+        print("no collector active at this endpoint", file=out)
+    else:
+        print(obscluster.render_alerts_text(doc), end="", file=out)
+    return 0
+
+
 def main(argv: "list[str] | None" = None) -> int:
     args = parse_args(argv)
     if args.command == "explain":
@@ -362,6 +523,10 @@ def main(argv: "list[str] | None" = None) -> int:
         return serve_stats(args)
     if args.command == "fleet-stats":
         return fleet_stats(args)
+    if args.command == "top":
+        return top(args)
+    if args.command == "alerts":
+        return alerts_cmd(args)
     return 2  # unreachable: subparsers are required
 
 

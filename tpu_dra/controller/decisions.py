@@ -36,7 +36,7 @@ import threading
 import time
 from dataclasses import dataclass
 
-from tpu_dra.utils.metrics import REJECTIONS_TOTAL
+from tpu_dra.utils.metrics import REJECTIONS_TOTAL, RING_DROPPED
 
 
 class ReasonCode:
@@ -155,12 +155,16 @@ class FlightRecorder:
         the rejection counter when the verdict is a rejection."""
         if not rec.ts_unix:
             rec.ts_unix = time.time()  # noqa: A201 — display stamp, not a duration
+        dropped = False
         with self._lock:
             self._seq += 1
             rec.seq = self._seq
             if len(self._records) == self.capacity:
                 self._dropped += 1  # append below evicts the oldest
+                dropped = True
             self._records.append(rec)
+        if dropped:
+            RING_DROPPED.inc(ring="decisions")
         if rec.verdict in (UNSUITABLE, CONFLICT, EVICTED) and rec.reason:
             REJECTIONS_TOTAL.inc(reason=rec.reason)
         return rec

@@ -1,0 +1,29 @@
+"""Cluster observability plane — cross-process scrape, trace assembly,
+and SLO burn-rate alerting (docs/OBSERVABILITY.md "Cluster plane").
+
+The reference driver's operators never look at one process: the cluster
+is a controller Deployment, a plugin DaemonSet per node, and serving on
+top.  PRs 1/3/5/7 gave every binary excellent *local* telemetry
+(``/metrics`` plus the ``/debug/*`` ring buffers); this package is the
+pane of glass over all of them:
+
+- ``promparse``   — the shared Prometheus text-exposition parser
+  (scraper and tests use ONE grammar, not per-test regexes).
+- ``collector``   — ``ObsCollector``: polls every configured endpoint on
+  a monotonic interval, retains bounded series rings (counters get
+  rates), joins ``/debug/traces`` spans across processes by trace id,
+  and serves ``/debug/cluster`` from its own MetricsServer.
+- ``alerts``      — declarative rules with burn-rate semantics and
+  for-duration pending → firing → resolved state, recorded in an alert
+  flight recorder (the ``controller/decisions.py`` ring shape).
+- ``cluster``     — the ``/debug/cluster`` document and the ``tpudra
+  top`` / ``tpudra alerts`` renderings.
+
+jax-free ON PURPOSE (the ``fleet``/``servestats`` discipline, enforced
+by the A101-A103 gate): the collector is control-plane code that must
+run in any binary — or its own tiny pod — without paying a jax import.
+"""
+
+from tpu_dra.obs import alerts, cluster, collector, promparse  # noqa: F401
+
+__all__ = ["alerts", "cluster", "collector", "promparse"]

@@ -1,0 +1,464 @@
+"""Alert rules with burn-rate semantics — "something watches the metrics".
+
+Every prior observability PR made trouble *visible* (rings, traces,
+`/debug/*`); nothing made it *loud*.  This module is the watching half
+of the cluster plane: a small declarative rule set evaluated by the
+``ObsCollector`` after every scrape round, with the state semantics
+operators expect from Prometheus alerting —
+
+- a rule's expression fires against the collector's windowed **rates**
+  (counters become per-second rates via the series rings, so a burst of
+  evictions is a spike, not a forever-tripped total);
+- ``for_s`` de-bounces: fired continuously that long = ``pending`` →
+  ``firing`` (scrape blips never page);
+- clearing a ``firing`` rule transitions ``resolved``, then quietly
+  back to ``ok`` — every transition lands in the alert flight recorder
+  (the ``controller/decisions.py`` ring shape) and moves
+  ``tpu_dra_obs_alerts_total{rule,state}`` on the collector's registry.
+
+The default rule set covers the failure modes the existing planes
+actually exhibit: serve-goodput SLO **burn rate** (error budget spent
+per unit time, the SRE-workbook shape), fleet queue growth, claim
+eviction spikes (node kills), prefix-digest staleness, and scrape-down.
+
+Rule expressions receive the collector itself and use its view protocol
+(``rate`` / ``delta`` / ``max_value`` / ``endpoint_health``), so custom
+rules are one lambda away; a raising expression marks the rule's status
+with the error instead of killing the evaluation loop.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass
+
+# Alert lifecycle states.  PENDING/FIRING/RESOLVED are transition events
+# (recorded + counted); OK is the quiet steady state — entering it is
+# recorded only from PENDING (a blip that cleared before its
+# for-duration: the cancelled page is worth seeing), while the
+# RESOLVED -> OK decay is silent (resolved was the notification).
+OK = "ok"
+PENDING = "pending"
+FIRING = "firing"
+RESOLVED = "resolved"
+
+
+@dataclass
+class AlertRule:
+    """One declarative rule: a named expression with for-duration."""
+
+    name: str
+    expr: "object"  # callable(view) -> (fired: bool, value: float, detail: str)
+    for_s: float = 0.0  # continuous fire time before pending -> firing
+    severity: str = "warn"  # warn | page (rendering/priority only)
+    description: str = ""
+
+
+@dataclass
+class AlertStatus:
+    """Current state of one rule (the /debug/cluster ``alerts`` rows)."""
+
+    rule: str = ""
+    severity: str = "warn"
+    state: str = OK
+    since_mono: float = 0.0  # when the current state was entered
+    value: float = 0.0  # latest expression value
+    detail: str = ""
+    error: str = ""  # last expression failure, "" when healthy
+    transitions: int = 0
+
+    def to_dict(self, now_mono: "float | None" = None) -> dict:
+        now = time.monotonic() if now_mono is None else now_mono
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "state": self.state,
+            "for_s": round(max(0.0, now - self.since_mono), 3)
+            if self.since_mono
+            else 0.0,
+            "value": self.value,
+            "detail": self.detail,
+            "error": self.error,
+            "transitions": self.transitions,
+        }
+
+
+@dataclass
+class AlertEvent:
+    """One state transition (the flight-recorder record)."""
+
+    seq: int = 0
+    ts_unix: float = 0.0
+    rule: str = ""
+    severity: str = "warn"
+    state: str = OK  # the state entered
+    prev_state: str = OK
+    value: float = 0.0
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "ts_unix": self.ts_unix,
+            "rule": self.rule,
+            "severity": self.severity,
+            "state": self.state,
+            "prev_state": self.prev_state,
+            "value": self.value,
+            "detail": self.detail,
+        }
+
+
+DEFAULT_CAPACITY = 4096
+
+
+class AlertFlightRecorder:
+    """Bounded, lock-protected ring of AlertEvents (the controller
+    FlightRecorder contract: eviction at capacity moves ``dropped`` and
+    the shared ``tpu_dra_ring_dropped_total{ring="obs_alerts"}``)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._records: "collections.deque[AlertEvent]" = collections.deque(
+            maxlen=capacity
+        )
+        self._seq = 0
+        self._dropped = 0
+
+    def record(self, rec: AlertEvent) -> AlertEvent:
+        if not rec.ts_unix:
+            # Epoch anchor for display/joins; state ages are monotonic.
+            rec.ts_unix = time.time()  # noqa: A201 — display stamp, not a duration
+        dropped = False
+        with self._lock:
+            self._seq += 1
+            rec.seq = self._seq
+            if len(self._records) == self.capacity:
+                self._dropped += 1  # append below evicts the oldest
+                dropped = True
+            self._records.append(rec)
+        if dropped:
+            from tpu_dra.utils.metrics import RING_DROPPED
+
+            RING_DROPPED.inc(ring="obs_alerts")
+        return rec
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    @property
+    def recorded(self) -> int:
+        """Total events ever recorded (monotonic, survives eviction)."""
+        with self._lock:
+            return self._seq
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._dropped = 0
+
+    def query(
+        self,
+        rule: "str | None" = None,
+        state: "str | None" = None,
+        limit: "int | None" = None,
+    ) -> "list[AlertEvent]":
+        """Oldest-first snapshot, filtered; ``limit`` keeps the most
+        recent N after filtering."""
+        with self._lock:
+            out = list(self._records)
+        if rule:
+            out = [r for r in out if r.rule == rule]
+        if state:
+            out = [r for r in out if r.state == state]
+        if limit is not None and limit < len(out):
+            out = out[len(out) - limit:]
+        return out
+
+
+# The process-wide recorder, shared like decisions.RECORDER: alert
+# engines write it, /debug/cluster reads it through the collector.
+RECORDER = AlertFlightRecorder()
+
+
+class AlertEngine:
+    """Evaluates a rule set against a collector view and runs the
+    ok → pending → firing → resolved state machine per rule."""
+
+    def __init__(
+        self,
+        rules: "list[AlertRule]",
+        *,
+        recorder: "AlertFlightRecorder | None" = None,
+        alerts_total=None,  # Counter with {rule,state} labels, or None
+    ):
+        self.rules = list(rules)
+        self.recorder = recorder if recorder is not None else RECORDER
+        self._alerts_total = alerts_total
+        self._lock = threading.Lock()
+        self._status: "dict[str, AlertStatus]" = {
+            r.name: AlertStatus(rule=r.name, severity=r.severity)
+            for r in self.rules
+        }
+
+    def evaluate(self, view, now_mono: "float | None" = None) -> "list[AlertEvent]":
+        """One evaluation round; returns the transitions it produced.
+        Expressions run OUTSIDE the engine lock (they acquire the
+        collector's lock through the view protocol)."""
+        now = time.monotonic() if now_mono is None else now_mono
+        results: "list[tuple[AlertRule, bool, float, str, str]]" = []
+        for rule in self.rules:
+            try:
+                fired, value, detail = rule.expr(view)
+                results.append((rule, bool(fired), float(value), detail, ""))
+            except Exception as e:  # a broken rule reports, not raises
+                results.append(
+                    (rule, False, 0.0, "", f"{type(e).__name__}: {e}")
+                )
+        events: "list[AlertEvent]" = []
+        with self._lock:
+            for rule, fired, value, detail, error in results:
+                status = self._status[rule.name]
+                status.value, status.detail, status.error = value, detail, error
+                transitions = self._advance(rule, status, fired, now)
+                events.extend(transitions)
+        for ev in events:
+            self.recorder.record(ev)
+            if self._alerts_total is not None:
+                self._alerts_total.inc(rule=ev.rule, state=ev.state)
+        return events
+
+    def _advance(
+        self, rule: AlertRule, status: AlertStatus, fired: bool, now: float
+    ) -> "list[AlertEvent]":
+        """State machine for one rule; may produce pending AND firing in
+        one round (for_s=0 — the Prometheus for-less rule shape)."""
+        out: "list[AlertEvent]" = []
+
+        def enter(state: str) -> None:
+            out.append(
+                AlertEvent(
+                    rule=rule.name,
+                    severity=rule.severity,
+                    state=state,
+                    prev_state=status.state,
+                    value=status.value,
+                    detail=status.detail,
+                )
+            )
+            status.state = state
+            status.since_mono = now
+            status.transitions += 1
+
+        if fired:
+            if status.state in (OK, RESOLVED):
+                enter(PENDING)
+            if status.state == PENDING and now - status.since_mono >= rule.for_s:
+                enter(FIRING)
+        else:
+            if status.state == PENDING:
+                enter(OK)
+            elif status.state == FIRING:
+                enter(RESOLVED)
+            elif status.state == RESOLVED:
+                # Quiet decay back to ok: resolved was the notification.
+                status.state = OK
+                status.since_mono = now
+        return out
+
+    def status(self, now_mono: "float | None" = None) -> "list[dict]":
+        with self._lock:
+            return [
+                self._status[r.name].to_dict(now_mono) for r in self.rules
+            ]
+
+    def firing(self) -> "list[str]":
+        with self._lock:
+            return [
+                name
+                for name, s in self._status.items()
+                if s.state == FIRING
+            ]
+
+
+# --- the default rule set ----------------------------------------------------
+
+
+def goodput_burn_rate(
+    *,
+    slo_target: float = 0.95,
+    burn_threshold: float = 2.0,
+    window_s: float = 60.0,
+    for_s: float = 0.0,
+) -> AlertRule:
+    """Serve goodput error-budget burn rate: the fraction of requests
+    missing their SLO (``tpu_dra_serve_slo_total{slo="request"}``)
+    divided by the error budget (1 − target).  Burn 1.0 = spending
+    budget exactly as provisioned; the default threshold 2.0 pages when
+    the budget drains at twice that pace (the multiwindow SRE-workbook
+    shape, reduced to the collector's single configurable window)."""
+    budget = max(1e-9, 1.0 - slo_target)
+
+    def expr(view):
+        missed = view.rate(
+            "tpu_dra_serve_slo_total",
+            window_s=window_s,
+            slo="request",
+            verdict="missed",
+        )
+        met = view.rate(
+            "tpu_dra_serve_slo_total",
+            window_s=window_s,
+            slo="request",
+            verdict="met",
+        )
+        if missed + met <= 0:
+            return False, 0.0, "no SLO-evaluated traffic in window"
+        burn = (missed / (missed + met)) / budget
+        return (
+            burn > burn_threshold,
+            round(burn, 3),
+            f"{burn:.2f}x error budget ({missed:.3f}/s missed of "
+            f"{missed + met:.3f}/s)",
+        )
+
+    return AlertRule(
+        name="ServeGoodputBurnRate",
+        expr=expr,
+        for_s=for_s,
+        severity="page",
+        description=f"goodput error budget burning > {burn_threshold}x "
+        f"(target {slo_target})",
+    )
+
+
+def fleet_queue_growth(
+    *,
+    growth_threshold: float = 4.0,
+    window_s: float = 60.0,
+    for_s: float = 0.0,
+) -> AlertRule:
+    """Fleet-level overflow queue growing across the window: every
+    replica at its admission cap and demand still rising."""
+
+    def expr(view):
+        growth = view.delta(
+            "tpu_dra_fleet_queue_depth", window_s=window_s
+        )
+        return (
+            growth > growth_threshold,
+            round(growth, 3),
+            f"fleet queue grew {growth:+.1f} over {window_s:.0f}s",
+        )
+
+    return AlertRule(
+        name="FleetQueueGrowth",
+        expr=expr,
+        for_s=for_s,
+        severity="warn",
+        description=f"fleet overflow queue grew > {growth_threshold} in "
+        f"the window",
+    )
+
+
+def eviction_spike(
+    *,
+    rate_threshold: float = 0.1,
+    window_s: float = 60.0,
+    for_s: float = 0.0,
+) -> AlertRule:
+    """Claim evictions (``tpu_dra_claim_evictions_total`` — the recovery
+    sweep draining dead nodes) arriving faster than the background rate:
+    a node-kill wave in progress."""
+
+    def expr(view):
+        rate = view.rate(
+            "tpu_dra_claim_evictions_total", window_s=window_s
+        )
+        return (
+            rate > rate_threshold,
+            round(rate, 4),
+            f"{rate:.3f} evictions/s over {window_s:.0f}s",
+        )
+
+    return AlertRule(
+        name="ClaimEvictionSpike",
+        expr=expr,
+        for_s=for_s,
+        severity="page",
+        description=f"claim evictions > {rate_threshold}/s (node failures "
+        "being drained)",
+    )
+
+
+def digest_staleness(
+    *, stale_after_s: float = 300.0, for_s: float = 0.0
+) -> AlertRule:
+    """A fleet replica's prefix digest has not refreshed in too long:
+    affinity routing is running on stale promises (spill storm ahead)."""
+
+    def expr(view):
+        age = view.max_value("tpu_dra_fleet_digest_age_seconds")
+        if age is None:
+            return False, 0.0, "no fleet digests exposed"
+        return (
+            age > stale_after_s,
+            round(age, 3),
+            f"oldest digest {age:.1f}s old",
+        )
+
+    return AlertRule(
+        name="FleetDigestStale",
+        expr=expr,
+        for_s=for_s,
+        severity="warn",
+        description=f"a replica digest is older than {stale_after_s:.0f}s",
+    )
+
+
+def scrape_down(*, for_s: float = 0.0) -> AlertRule:
+    """One or more scrape targets unreachable — the observability plane's
+    own liveness.  Fires from scrape health, not from scraped data, so
+    it works when a process dies taking its exposition with it."""
+
+    def expr(view):
+        health = view.endpoint_health()
+        down = sorted(h["endpoint"] for h in health if not h["up"])
+        if not health:
+            return False, 0.0, "no endpoints configured"
+        return (
+            bool(down),
+            float(len(down)),
+            f"{len(down)}/{len(health)} endpoint(s) down: "
+            + ", ".join(down)
+            if down
+            else f"all {len(health)} endpoint(s) up",
+        )
+
+    return AlertRule(
+        name="ScrapeDown",
+        expr=expr,
+        for_s=for_s,
+        severity="page",
+        description="a configured scrape endpoint is unreachable",
+    )
+
+
+def default_rules(
+    *, window_s: float = 60.0, for_s: float = 0.0
+) -> "list[AlertRule]":
+    """The stock rule set over the telemetry the repo already emits.
+    ``window_s``/``for_s`` scale the whole set together — CI smokes run
+    them at sim timescales (sub-second), deployments at minutes."""
+    return [
+        goodput_burn_rate(window_s=window_s, for_s=for_s),
+        fleet_queue_growth(window_s=window_s, for_s=for_s),
+        eviction_spike(window_s=window_s, for_s=for_s),
+        digest_staleness(stale_after_s=max(window_s * 5, 1.0), for_s=for_s),
+        scrape_down(for_s=for_s),
+    ]

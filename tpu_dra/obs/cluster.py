@@ -1,0 +1,198 @@
+"""The cluster pane — ``/debug/cluster`` document and the ``tpudra
+top`` / ``tpudra alerts`` renderings.
+
+``cluster_doc`` reduces the collector's state to one JSON document: per
+-endpoint scrape health plus the handful of derived signals an operator
+triages by (span throughput, serve occupancy/queue, goodput, eviction
+and rejection rates — each computed from the series rings over a query
+-able window), current alert status, and the recent alert transitions.
+``render_text`` is the same document as a terminal dashboard (what
+``tpudra top`` draws, and ``/debug/cluster?format=text`` serves);
+``render_alerts_text`` is the alert-centric cut for ``tpudra alerts``.
+
+Pure functions over the collector — no HTTP, no jax — so the CLI can
+render a fetched JSON document byte-identically to the server's text
+form.
+"""
+
+from __future__ import annotations
+
+
+def endpoint_row(collector, health: dict, window_s: float) -> dict:
+    """One endpoint's health dict + the derived per-endpoint signals."""
+    name = health["endpoint"]
+    goodput = None
+    met = collector.rate(
+        "tpu_dra_serve_slo_total",
+        window_s=window_s,
+        endpoint=name,
+        slo="request",
+        verdict="met",
+    )
+    missed = collector.rate(
+        "tpu_dra_serve_slo_total",
+        window_s=window_s,
+        endpoint=name,
+        slo="request",
+        verdict="missed",
+    )
+    if met + missed > 0:
+        goodput = round(met / (met + missed), 3)
+    out = dict(health)
+    out.update(
+        {
+            "spans_per_s": round(
+                collector.rate(
+                    "tpu_dra_trace_spans_total",
+                    window_s=window_s,
+                    endpoint=name,
+                ),
+                3,
+            ),
+            "occupancy": collector.value(
+                "tpu_dra_serve_batch_occupancy", endpoint=name
+            ),
+            "queue_depth": collector.value(
+                "tpu_dra_serve_queue_depth", endpoint=name
+            ),
+            "goodput": goodput,
+            "evictions_per_s": round(
+                collector.rate(
+                    "tpu_dra_claim_evictions_total",
+                    window_s=window_s,
+                    endpoint=name,
+                ),
+                4,
+            ),
+            "rejections_per_s": round(
+                collector.rate(
+                    "tpu_dra_rejections_total",
+                    window_s=window_s,
+                    endpoint=name,
+                ),
+                4,
+            ),
+        }
+    )
+    return out
+
+
+def cluster_doc(
+    collector,
+    *,
+    endpoint: "str | None" = None,
+    rule: "str | None" = None,
+    limit: int = 256,
+    window_s: float = 60.0,
+) -> dict:
+    """The /debug/cluster JSON document (filters mirror the query
+    parameters; the renderings below consume exactly this shape)."""
+    health = collector.endpoint_health()
+    if endpoint:
+        health = [h for h in health if h["endpoint"] == endpoint]
+    rows = [endpoint_row(collector, h, window_s) for h in health]
+    alerts = collector.engine.status()
+    if rule:
+        alerts = [a for a in alerts if a["rule"] == rule]
+    recorder = collector.engine.recorder
+    events = recorder.query(rule=rule or None, limit=limit)
+    up = sum(1 for h in rows if h["up"])
+    return {
+        "collector": collector.name,
+        "rounds": collector.rounds,
+        "window_s": window_s,
+        "endpoints": rows,
+        "endpoints_up": up,
+        "endpoints_total": len(rows),
+        "alerts": alerts,
+        "firing": [a["rule"] for a in alerts if a["state"] == "firing"],
+        "alert_events": [e.to_dict() for e in events],
+        "recorded": recorder.recorded,
+        "dropped": recorder.dropped,
+    }
+
+
+def _fmt(value, width: int, precision: int = 1) -> str:
+    """Right-aligned cell; '-' for None (a signal the endpoint does not
+    emit is different from a zero)."""
+    if value is None:
+        return "-".rjust(width)
+    if isinstance(value, float):
+        return f"{value:.{precision}f}".rjust(width)
+    return str(value).rjust(width)
+
+
+def render_text(doc: dict) -> str:
+    """The ``tpudra top`` dashboard: fleet summary line, one row per
+    endpoint, then the firing/pending alerts."""
+    head = (
+        f"collector {doc['collector']}: {doc['endpoints_up']}/"
+        f"{doc['endpoints_total']} endpoint(s) up, round {doc['rounds']}, "
+        f"window {doc['window_s']:.0f}s"
+    )
+    firing = doc.get("firing", [])
+    head += (
+        f", FIRING: {', '.join(firing)}" if firing else ", no alerts firing"
+    )
+    out = [head]
+    out.append(
+        f"{'endpoint':<22} {'up':<4} {'stale_s':>7} {'scrape_ms':>9} "
+        f"{'series':>6} {'spans/s':>8} {'occ':>5} {'queue':>5} "
+        f"{'goodput':>7} {'evic/s':>7} {'rej/s':>7}"
+    )
+    for row in doc["endpoints"]:
+        out.append(
+            f"{row['endpoint']:<22} {'UP' if row['up'] else 'DOWN':<4} "
+            f"{_fmt(row['staleness_s'], 7)} "
+            f"{_fmt(row['scrape_duration_s'] * 1e3, 9, 2)} "
+            f"{_fmt(row['series'], 6)} {_fmt(row['spans_per_s'], 8)} "
+            f"{_fmt(row['occupancy'], 5, 0)} {_fmt(row['queue_depth'], 5, 0)} "
+            f"{_fmt(row['goodput'], 7, 3)} {_fmt(row['evictions_per_s'], 7, 3)} "
+            f"{_fmt(row['rejections_per_s'], 7, 3)}"
+        )
+    if not doc["endpoints"]:
+        out.append("(no endpoints configured)")
+    active = [a for a in doc["alerts"] if a["state"] != "ok"]
+    if active:
+        out.append("alerts:")
+        for a in active:
+            line = (
+                f"  {a['rule']:<24} {a['state']:<9} {a['severity']:<5} "
+                f"for {a['for_s']:.1f}s  {a['detail']}"
+            )
+            out.append(line)
+    return "\n".join(out) + "\n"
+
+
+def render_alerts_text(doc: dict) -> str:
+    """The ``tpudra alerts`` cut: every rule's current state, then the
+    recent transition history (newest last)."""
+    out = [
+        f"collector {doc['collector']}: {len(doc['alerts'])} rule(s), "
+        f"{len(doc.get('firing', []))} firing"
+    ]
+    out.append(
+        f"{'rule':<26} {'state':<9} {'sev':<5} {'for_s':>8} "
+        f"{'value':>10} detail"
+    )
+    for a in doc["alerts"]:
+        out.append(
+            f"{a['rule']:<26} {a['state']:<9} {a['severity']:<5} "
+            f"{a['for_s']:>8.1f} {a['value']:>10.3f} "
+            f"{a['detail'] or a['error']}"
+        )
+    events = doc.get("alert_events", [])
+    if events:
+        out.append("transitions:")
+        for e in events:
+            out.append(
+                f"  #{e['seq']:<5} {e['rule']:<26} "
+                f"{e['prev_state']:>8} -> {e['state']:<9} "
+                f"value {e['value']:.3f}  {e['detail']}"
+            )
+    if doc.get("dropped"):
+        out.append(
+            f"(alert recorder wrapped: {doc['dropped']} older event(s) "
+            "dropped)"
+        )
+    return "\n".join(out) + "\n"

@@ -1,0 +1,707 @@
+"""ObsCollector — the cross-process scrape/aggregate half of the plane.
+
+One collector polls a configured set of endpoints (controller +
+plugins + serve engines/fleets — anything running a ``MetricsServer``)
+on a monotonic-clock interval and keeps, per endpoint:
+
+- **scrape health** as first-class data: ``up``, consecutive failures,
+  scrape duration, and staleness (seconds since the last good scrape).
+  A failed scrape degrades to stale-marked data — the last good samples
+  stay queryable — and NEVER raises out of the poll loop.
+- the parsed samples of the last good exposition (``obs/promparse.py``)
+  plus bounded in-memory **series rings** per series, so counters get
+  windowed rates/deltas (the alert rules' food) without a TSDB.
+- the ``/debug/index`` capability document, so the collector only asks
+  a process for the rings it actually serves.
+
+On top of the per-endpooint state it assembles **cross-process traces**:
+``/debug/traces?format=raw`` from every capable endpoint, spans joined
+by trace id and deduped by span id, so the controller's ``Allocate``
+span and the plugin's ``NodePrepareResource`` span finally render as
+one claim lifecycle (text tree or merged Chrome trace JSON).
+
+The collector owns its OWN metrics registry (``tpu_dra_obs_*`` —
+scrape health and alert transitions), serves ``/debug/cluster`` from
+its own ``MetricsServer`` (``serve()``), evaluates the alert rule set
+after every round (``obs/alerts.py``), and can dump a post-mortem
+snapshot (all rings + last exposition per endpoint) to disk — the
+chaos path triggers that on firing alerts.
+
+In-process discovery: every ``MetricsServer.start()`` registers itself
+in a process-local set, so sim rigs and benches pass
+``auto_discover_local=True`` instead of wiring ports by hand.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import concurrent.futures
+import os
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from tpu_dra.obs import promparse
+from tpu_dra.obs.alerts import AlertEngine, default_rules
+from tpu_dra.utils.metrics import Registry
+
+logger = logging.getLogger(__name__)
+
+# Ring points per series: at the default 5s interval this is ~40 minutes
+# of history — rate windows, not long-term storage.
+DEFAULT_RING_POINTS = 512
+
+
+class Endpoint:
+    """One scrape target: a base URL plus its path layout."""
+
+    def __init__(
+        self,
+        url: str,
+        *,
+        name: "str | None" = None,
+        metrics_path: str = "/metrics",
+        pprof_path: str = "/debug",
+    ):
+        self.url = url.rstrip("/")
+        parsed = urllib.parse.urlparse(self.url)
+        self.name = name or parsed.netloc or self.url
+        self.metrics_path = metrics_path
+        self.pprof_path = "/" + pprof_path.strip("/")
+
+
+class EndpointState:
+    """Scrape health + last good data for one endpoint.  Mutated only by
+    the collector under its lock; exposed as dicts."""
+
+    def __init__(self, endpoint: Endpoint):
+        self.endpoint = endpoint
+        self.up = False
+        self.scrapes = 0
+        self.failures = 0  # consecutive
+        self.last_attempt_mono = 0.0
+        self.last_ok_mono = 0.0
+        self.last_duration_s = 0.0
+        self.error = ""
+        self.last_text = ""  # last GOOD exposition (post-mortem food)
+        self.samples: "list[promparse.Sample]" = []
+        self.index: "dict | None" = None  # /debug/index capability doc
+
+    def staleness_s(self, now_mono: "float | None" = None) -> "float | None":
+        """Seconds since the last good scrape; None before the first."""
+        if not self.last_ok_mono:
+            return None
+        now = time.monotonic() if now_mono is None else now_mono
+        return max(0.0, now - self.last_ok_mono)
+
+    def serves(self, path: str) -> bool:
+        """Capability check from /debug/index; unknown (no index yet, or
+        a pre-index build) means optimistically yes."""
+        if not self.index or "endpoints" not in self.index:
+            return True
+        return path in self.index["endpoints"]
+
+    def to_dict(self, now_mono: "float | None" = None) -> dict:
+        stale = self.staleness_s(now_mono)
+        return {
+            "endpoint": self.endpoint.name,
+            "url": self.endpoint.url,
+            "up": self.up,
+            "scrapes": self.scrapes,
+            "consecutive_failures": self.failures,
+            "scrape_duration_s": round(self.last_duration_s, 6),
+            "staleness_s": None if stale is None else round(stale, 3),
+            "error": self.error,
+            "series": len(self.samples),
+            "component": (self.index or {}).get("component", ""),
+        }
+
+
+class SeriesRing:
+    """Bounded (t_monotonic, value) points for one series.  Appended by
+    the scrape thread under the collector lock; readers snapshot the
+    points under the same lock and compute with the helpers below."""
+
+    __slots__ = ("points",)
+
+    def __init__(self, maxlen: int = DEFAULT_RING_POINTS):
+        self.points: "collections.deque[tuple[float, float]]" = (
+            collections.deque(maxlen=maxlen)
+        )
+
+    def add(self, t_mono: float, value: float) -> None:
+        self.points.append((t_mono, value))
+
+
+def _window(points, window_s: float, now_mono: float):
+    cutoff = now_mono - window_s
+    return [p for p in points if p[0] >= cutoff]
+
+
+def _rate(points, window_s: float, now_mono: float) -> "float | None":
+    """Counter increase/second over the window, None with < 2 points.
+    Resets (a restarted process's counter dropping) contribute the
+    post-reset value, the Prometheus ``increase`` convention."""
+    pts = _window(points, window_s, now_mono)
+    if len(pts) < 2:
+        return None
+    span = pts[-1][0] - pts[0][0]
+    if span <= 0:
+        return None
+    increase = 0.0
+    for (_, prev), (_, cur) in zip(pts, pts[1:]):
+        increase += cur - prev if cur >= prev else cur
+    return increase / span
+
+
+def _delta(points, window_s: float, now_mono: float) -> "float | None":
+    """Gauge change over the window (signed), None with < 2 points."""
+    pts = _window(points, window_s, now_mono)
+    if len(pts) < 2:
+        return None
+    return pts[-1][1] - pts[0][1]
+
+
+# The process-wide active collector, read by MetricsServer's
+# /debug/cluster handler (the trace.EXPORTER / decisions.RECORDER shape:
+# one ambient instance per process, injectable in tests).
+ACTIVE: "ObsCollector | None" = None
+
+
+def set_active(collector: "ObsCollector | None") -> None:
+    global ACTIVE
+    ACTIVE = collector
+
+
+class ObsCollector:
+    """Scrape, retain, rate, alert.  See the module docstring."""
+
+    def __init__(
+        self,
+        endpoints: "list[Endpoint | str] | tuple" = (),
+        *,
+        interval_s: float = 5.0,
+        timeout_s: float = 5.0,
+        ring_points: int = DEFAULT_RING_POINTS,
+        rules: "list | None" = None,
+        registry: "Registry | None" = None,
+        recorder=None,  # alerts.AlertFlightRecorder, defaults to the global
+        snapshot_dir: "str | None" = None,
+        auto_discover_local: bool = False,
+        name: str = "obs",
+    ):
+        self.name = name
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.ring_points = ring_points
+        self.snapshot_dir = snapshot_dir
+        self.auto_discover_local = auto_discover_local
+        self._lock = threading.Lock()
+        self._states: "dict[str, EndpointState]" = {}
+        # series name -> {(endpoint name, label pairs): SeriesRing} —
+        # name-first so a rate()/value() lookup touches only its own
+        # series, not every ring of every endpoint.
+        self._rings: "dict[str, dict[tuple[str, tuple], SeriesRing]]" = {}
+        self._pool = None  # lazy scrape ThreadPoolExecutor (>1 endpoint)
+        self._now_override: "float | None" = None  # scrape_once(now_mono=)
+        self._rounds = 0
+        self._snapshots = 0
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._server = None
+
+        self.registry = registry if registry is not None else Registry()
+        self._up_gauge = self.registry.gauge(
+            "tpu_dra_obs_up",
+            "Scrape health per endpoint: 1 when the last scrape succeeded",
+        )
+        self._staleness_gauge = self.registry.gauge(
+            "tpu_dra_obs_scrape_staleness_seconds",
+            "Seconds since the last successful scrape of each endpoint "
+            "(monotonic clock)",
+        )
+        self._scrapes_total = self.registry.counter(
+            "tpu_dra_obs_scrapes_total",
+            "Scrape attempts per endpoint by outcome (ok, error)",
+        )
+        self._scrape_seconds = self.registry.histogram(
+            "tpu_dra_obs_scrape_duration_seconds",
+            "Wall time of each endpoint scrape (exposition fetch + parse)",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0),
+        )
+        alerts_total = self.registry.counter(
+            "tpu_dra_obs_alerts_total",
+            "Alert state transitions by rule and entered state "
+            "(pending, firing, resolved; ok = a pending that cleared "
+            "before its for-duration elapsed)",
+        )
+        self.engine = AlertEngine(
+            default_rules() if rules is None else rules,
+            recorder=recorder,
+            alerts_total=alerts_total,
+        )
+        for ep in endpoints:
+            self.add_endpoint(ep)
+
+    # -- endpoint set ---------------------------------------------------------
+
+    def add_endpoint(self, endpoint: "Endpoint | str", **kw) -> Endpoint:
+        ep = endpoint if isinstance(endpoint, Endpoint) else Endpoint(endpoint, **kw)
+        with self._lock:
+            if ep.name not in self._states:
+                self._states[ep.name] = EndpointState(ep)
+        self._up_gauge.set(0, endpoint=ep.name)
+        return ep
+
+    def remove_endpoint(self, name: str) -> None:
+        # Health-series retirement happens under the collector lock so it
+        # serializes with scrape_endpoint's write-back: an in-flight
+        # scrape that finishes after the removal re-checks registration
+        # under the same lock and drops its result.
+        with self._lock:
+            self._states.pop(name, None)
+            for bucket in self._rings.values():
+                for key in [k for k in bucket if k[0] == name]:
+                    del bucket[key]
+            # Retire the endpoint's scrape-health series too — a removed
+            # target must not keep exposing a frozen up/staleness forever.
+            self._up_gauge.remove(endpoint=name)
+            self._staleness_gauge.remove(endpoint=name)
+
+    def endpoints(self) -> "list[str]":
+        with self._lock:
+            return sorted(self._states)
+
+    def _discover_local(self) -> None:
+        """Adopt every MetricsServer running in THIS process (sim rigs,
+        benches, tests): the wiring auto-registers what it starts."""
+        from tpu_dra.utils import metrics
+
+        for server in metrics.running_servers():
+            url = f"http://127.0.0.1:{server.port}"
+            name = f"local:{server.port}"
+            with self._lock:
+                known = name in self._states
+            if not known:
+                self.add_endpoint(
+                    Endpoint(
+                        url,
+                        name=name,
+                        metrics_path=server.metrics_path,
+                        pprof_path=server.pprof_path,
+                    )
+                )
+
+    # -- scraping -------------------------------------------------------------
+
+    def _get(self, url: str) -> str:
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+            return resp.read().decode()
+
+    def scrape_endpoint(self, name: str, now_mono: "float | None" = None) -> bool:
+        """One endpoint, one scrape.  All I/O outside the lock; never
+        raises — failure marks the endpoint down and keeps stale data."""
+        with self._lock:
+            state = self._states.get(name)
+        if state is None:
+            return False
+        ep = state.endpoint
+        now = time.monotonic() if now_mono is None else now_mono
+        t0 = time.perf_counter()
+        text, index, error = "", None, ""
+        try:
+            text = self._get(ep.url + ep.metrics_path)
+            if state.index is None:
+                try:
+                    index = json.loads(
+                        self._get(f"{ep.url}{ep.pprof_path}/index")
+                    )
+                except Exception:
+                    index = {}  # pre-index build: capabilities unknown
+        except Exception as e:
+            error = f"{type(e).__name__}: {e}"
+        duration = time.perf_counter() - t0
+        ok = not error
+        samples: "list[promparse.Sample]" = []
+        cumulative: "set[str]" = set()
+        if ok:
+            families = promparse.parse_families(text)
+            for fam in families.values():
+                samples.extend(fam.samples)
+                if fam.type in ("counter", "histogram"):
+                    cumulative.update(s.name for s in fam.samples)
+        with self._lock:
+            if self._states.get(name) is not state:
+                # Removed (or replaced) while the scrape was in flight —
+                # drop the result so remove_endpoint's retirement of the
+                # rings and health series sticks instead of being
+                # resurrected by a stale write-back.
+                return False
+            state.last_attempt_mono = now
+            state.last_duration_s = duration
+            state.scrapes += 1
+            if ok:
+                prev_ok = state.last_ok_mono
+                state.up = True
+                state.failures = 0
+                state.error = ""
+                state.last_ok_mono = now
+                state.last_text = text
+                state.samples = samples
+                if index is not None:
+                    state.index = index
+                for s in samples:
+                    bucket = self._rings.setdefault(s.name, {})
+                    key = (name, s.labels)
+                    ring = bucket.get(key)
+                    if ring is None:
+                        ring = bucket[key] = SeriesRing(self.ring_points)
+                        # A cumulative series BORN between two scrapes of
+                        # a live endpoint is an increase from zero (a
+                        # counter's first inc mints its labeled series) —
+                        # seed it so rate() sees the burst instead of a
+                        # single unusable point.
+                        if prev_ok and s.name in cumulative:
+                            ring.add(prev_ok, 0.0)
+                    ring.add(now, s.value)
+            else:
+                state.up = False
+                state.failures += 1
+                state.error = error
+            # Metric emission stays inside the collector lock so a
+            # concurrent remove_endpoint can't retire the health series
+            # between our registration check and these writes (the
+            # metric objects take only their own locks; no samplers
+            # reach back into the collector).
+            self._up_gauge.set(1 if ok else 0, endpoint=name)
+            stale = state.staleness_s(now)
+            # No staleness series before the first successful scrape: a
+            # target that never came up must not read as perfectly fresh
+            # (absent ≠ zero — up=0 is its signal until then).
+            if stale is not None:
+                self._staleness_gauge.set(stale, endpoint=name)
+            self._scrapes_total.inc(
+                endpoint=name, outcome="ok" if ok else "error"
+            )
+            self._scrape_seconds.observe(duration, endpoint=name)
+        if error:
+            logger.debug("scrape of %s failed: %s", ep.url, error)
+        return ok
+
+    def scrape_once(self, now_mono: "float | None" = None) -> "list":
+        """One full round: (re)discover, scrape every endpoint, evaluate
+        the alert rules.  Returns the alert transitions produced.
+
+        Endpoints scrape CONCURRENTLY (scrape_endpoint is lock
+        -disciplined; I/O happens outside the collector lock), each
+        stamping its own monotonic time — one blackholed target costs
+        the round one timeout_s, not one per endpoint, and never skews
+        the healthy endpoints' rate windows.  An explicit ``now_mono``
+        (deterministic tests) is passed through to every endpoint AND
+        becomes the clock rate()/delta()/endpoint_health() window
+        against, so the whole evaluation runs on the injected time."""
+        if self.auto_discover_local:
+            self._discover_local()
+        names = self.endpoints()
+        if len(names) <= 1:
+            for name in names:
+                self.scrape_endpoint(name, now_mono=now_mono)
+        else:
+            if self._pool is None:
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=8,
+                    thread_name_prefix=f"obs-scrape-{self.name}",
+                )
+            # scrape_endpoint never raises, so the barrier can't either.
+            list(
+                self._pool.map(
+                    lambda n: self.scrape_endpoint(n, now_mono=now_mono),
+                    names,
+                )
+            )
+        with self._lock:
+            self._rounds += 1
+            self._now_override = now_mono
+        events = self.engine.evaluate(self, now_mono=now_mono)
+        if self.snapshot_dir and any(e.state == "firing" for e in events):
+            try:
+                self.dump_snapshot(
+                    reason="+".join(
+                        e.rule for e in events if e.state == "firing"
+                    )
+                )
+            except Exception:
+                logger.exception("post-mortem snapshot failed")
+        return events
+
+    @property
+    def rounds(self) -> int:
+        with self._lock:
+            return self._rounds
+
+    # -- the alert-rule view protocol ----------------------------------------
+
+    def _view_now(self) -> float:
+        """The clock the view windows against: the last round's injected
+        now_mono when one was given (so deterministic tests window the
+        same fake time the ring points were stamped with), else real
+        monotonic."""
+        with self._lock:
+            override = self._now_override
+        return time.monotonic() if override is None else override
+
+    def _matching_points(
+        self, name: str, endpoint, labels
+    ) -> "list[list[tuple[float, float]]]":
+        """Snapshot of each matching series' ring points, taken under the
+        lock (the scrape thread appends concurrently; deque iteration
+        during an append raises)."""
+        with self._lock:
+            return [
+                list(ring.points)
+                for (ep, pairs), ring in self._rings.get(name, {}).items()
+                if (endpoint is None or ep == endpoint)
+                and all(dict(pairs).get(k) == str(v) for k, v in labels.items())
+            ]
+
+    def rate(
+        self,
+        name: str,
+        *,
+        window_s: float = 60.0,
+        endpoint: "str | None" = None,
+        **labels: str,
+    ) -> float:
+        """Summed counter rate/second across matching series (0.0 when no
+        series has enough points — rules treat missing as quiet)."""
+        now = self._view_now()
+        rates = [
+            r
+            for pts in self._matching_points(name, endpoint, labels)
+            if (r := _rate(pts, window_s, now)) is not None
+        ]
+        return sum(rates) if rates else 0.0
+
+    def delta(
+        self,
+        name: str,
+        *,
+        window_s: float = 60.0,
+        endpoint: "str | None" = None,
+        **labels: str,
+    ) -> float:
+        """Summed gauge change across matching series over the window."""
+        now = self._view_now()
+        deltas = [
+            d
+            for pts in self._matching_points(name, endpoint, labels)
+            if (d := _delta(pts, window_s, now)) is not None
+        ]
+        return sum(deltas) if deltas else 0.0
+
+    def max_value(
+        self,
+        name: str,
+        *,
+        endpoint: "str | None" = None,
+        **labels: str,
+    ) -> "float | None":
+        """Max of the latest points across matching series (None when the
+        series does not exist anywhere — distinct from zero)."""
+        values = [
+            pts[-1][1]
+            for pts in self._matching_points(name, endpoint, labels)
+            if pts
+        ]
+        return max(values) if values else None
+
+    def value(
+        self,
+        name: str,
+        *,
+        endpoint: "str | None" = None,
+        **labels: str,
+    ) -> "float | None":
+        """Sum of the latest points across matching series (the scraped
+        analog of ``Counter.total()``); None when absent."""
+        values = [
+            pts[-1][1]
+            for pts in self._matching_points(name, endpoint, labels)
+            if pts
+        ]
+        return sum(values) if values else None
+
+    def endpoint_health(self, now_mono: "float | None" = None) -> "list[dict]":
+        if now_mono is None:
+            now_mono = self._view_now()
+        with self._lock:
+            states = list(self._states.values())
+        return [s.to_dict(now_mono) for s in states]
+
+    # -- cross-process trace assembly ----------------------------------------
+
+    def fetch_spans(
+        self,
+        trace_id: "str | None" = None,
+        limit: int = 4096,
+    ) -> "list[dict]":
+        """Raw span records from every capable endpoint, joined by trace
+        id and deduped by (trace_id, span_id) — duplicates happen when
+        two endpoints serve one process's exporter (the in-process sim).
+        Each record gains an ``endpoints`` list naming every endpoint
+        that returned it; fetch failures skip the endpoint (the merged
+        view is best-effort by design)."""
+        with self._lock:
+            states = list(self._states.values())
+        merged: "dict[tuple[str, str], dict]" = {}
+        for state in states:
+            ep = state.endpoint
+            if not state.serves(f"{ep.pprof_path}/traces"):
+                continue
+            query = {"format": "raw", "limit": limit}
+            if trace_id:
+                query["trace_id"] = trace_id
+            url = (
+                f"{ep.url}{ep.pprof_path}/traces?"
+                + urllib.parse.urlencode(query)
+            )
+            try:
+                doc = json.loads(self._get(url))
+            except Exception as e:
+                logger.debug("trace fetch from %s failed: %s", ep.url, e)
+                continue
+            for rec in doc.get("spans", []):
+                key = (rec.get("trace_id", ""), rec.get("span_id", ""))
+                kept = merged.setdefault(key, rec)
+                kept.setdefault("endpoints", [])
+                if ep.name not in kept["endpoints"]:
+                    kept["endpoints"].append(ep.name)
+        records = sorted(
+            merged.values(), key=lambda r: r.get("start_unix_s", 0.0)
+        )
+        return records
+
+    def assemble_trace_tree(self, trace_id: "str | None" = None) -> str:
+        """The merged claim lifecycle as a text tree (trace.render_tree
+        over the cross-endpoint join)."""
+        from tpu_dra.utils import trace
+
+        return trace.render_tree(self.fetch_spans(trace_id))
+
+    def assemble_chrome_trace(self, trace_id: "str | None" = None) -> dict:
+        """The merged view as Chrome trace JSON — one file, every
+        process's spans on its own component track."""
+        from tpu_dra.utils import trace
+
+        return trace.chrome_trace(self.fetch_spans(trace_id))
+
+    # -- post-mortem snapshot -------------------------------------------------
+
+    def dump_snapshot(
+        self, dir_path: "str | None" = None, reason: str = ""
+    ) -> str:
+        """Write the whole plane to disk: per-endpoint last exposition,
+        series rings, scrape health, alert status + events, and the
+        merged trace view.  Returns the snapshot directory.  This is the
+        post-mortem the chaos path triggers when an alert fires."""
+        base = dir_path or self.snapshot_dir
+        if not base:
+            raise ValueError("no snapshot directory configured")
+        with self._lock:
+            self._snapshots += 1
+            seq = self._snapshots
+            states = list(self._states.values())
+            rings = {
+                f"{ep}|{name}|"
+                + ",".join(f"{k}={v}" for k, v in labels): list(ring.points)
+                for name, bucket in self._rings.items()
+                for (ep, labels), ring in bucket.items()
+            }
+        path = os.path.join(base, f"obs-snapshot-{seq:04d}")
+        os.makedirs(path, exist_ok=True)
+        health = [s.to_dict() for s in states]
+        spans = self.fetch_spans()
+        doc = {
+            "reason": reason,
+            "collector": self.name,
+            "ts_unix": time.time(),  # noqa: A201 — snapshot stamp for the operator
+            "rounds": self.rounds,
+            "endpoints": health,
+            "alerts": self.engine.status(),
+            "alert_events": [
+                e.to_dict() for e in self.engine.recorder.query()
+            ],
+        }
+        with open(os.path.join(path, "cluster.json"), "w") as f:
+            json.dump(doc, f, indent=2)
+        with open(os.path.join(path, "rings.json"), "w") as f:
+            json.dump(rings, f)
+        with open(os.path.join(path, "traces.json"), "w") as f:
+            json.dump({"spans": spans}, f)
+        for state in states:
+            if not state.last_text:
+                continue
+            fname = "exposition-" + state.endpoint.name.replace(
+                "/", "_"
+            ).replace(":", "_") + ".txt"
+            with open(os.path.join(path, fname), "w") as f:
+                f.write(state.last_text)
+        logger.info("post-mortem snapshot %s (%s)", path, reason or "manual")
+        return path
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        """Poll in a daemon thread every ``interval_s`` (monotonic)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.scrape_once()
+                except Exception:
+                    logger.exception("scrape round failed")
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, name=f"obs-collector-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def serve(self, address: str = "127.0.0.1:0"):
+        """Start a MetricsServer over the collector's OWN registry (the
+        ``tpu_dra_obs_*`` series) and make this collector the process's
+        ACTIVE one, so the server's ``/debug/cluster`` answers from it.
+        Returns the server (caller reads ``.port``)."""
+        from tpu_dra.utils.metrics import MetricsServer
+
+        server = MetricsServer(address, registry=self.registry)
+        server.start()
+        self._server = server
+        set_active(self)
+        return server
+
+    def close(self) -> None:
+        """Stop polling, stop the serve() server, release ACTIVE."""
+        self.stop()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+        if ACTIVE is self:
+            set_active(None)

@@ -1,0 +1,197 @@
+"""Prometheus text-exposition parser — the ONE grammar in the tree.
+
+Before this module every consumer of an exposition re-implemented a
+slice of the format: the observability smoke carried its own regex
+grammar, serve/fleet smokes grepped for substrings, and the bench
+stanzas eyeballed raw lines.  The cluster collector
+(``tpu_dra/obs/collector.py``) needs real parsed samples (names, label
+sets, float values) to compute rates and joins, so the grammar now
+lives here once and everyone — scraper, tests, CLIs — shares it.
+
+The grammar is the subset the in-repo registry (``utils/metrics.py``)
+emits, which is also the subset the escaping bug class corrupts: label
+values are double-quoted with only ``\\\\``, ``\\"`` and ``\\n``
+escapes, every sample fits on one line, and ``# HELP`` / ``# TYPE``
+comment lines carry metadata.  ``parse(strict=True)`` raises on any
+line outside the grammar (the smoke-test mode); the scraper uses the
+default lenient mode where a malformed line is counted, not fatal —
+a half-written exposition from a dying process must degrade, not throw.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+METRIC_NAME_RE = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+LABEL_NAME_RE = r"[a-zA-Z_][a-zA-Z0-9_]*"
+# Label values: any run of non-special chars or a valid escape sequence.
+LABEL_VALUE_RE = r'"(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+FLOAT_RE = r"[+-]?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|Inf|NaN|inf|nan)"
+
+_SAMPLE_RE = re.compile(
+    rf"^(?P<name>{METRIC_NAME_RE})"
+    rf"(?:\{{(?P<labels>{LABEL_NAME_RE}={LABEL_VALUE_RE}"
+    rf"(?:,{LABEL_NAME_RE}={LABEL_VALUE_RE})*)\}})?"
+    rf" (?P<value>{FLOAT_RE})$"
+)
+_LABEL_RE = re.compile(
+    rf"(?P<name>{LABEL_NAME_RE})=(?P<value>{LABEL_VALUE_RE})"
+)
+_HELP_RE = re.compile(rf"^# HELP (?P<name>{METRIC_NAME_RE}) (?P<help>.*)$")
+_TYPE_RE = re.compile(
+    rf"^# TYPE (?P<name>{METRIC_NAME_RE}) "
+    r"(?P<type>counter|gauge|histogram|summary|untyped)$"
+)
+
+
+class PromParseError(ValueError):
+    """A line outside the exposition grammar (strict mode only)."""
+
+
+@dataclass(frozen=True)
+class Sample:
+    """One exposition sample: ``name{labels} value``."""
+
+    name: str
+    labels: "tuple[tuple[str, str], ...]"  # sorted, hashable
+    value: float
+
+    @property
+    def labeldict(self) -> "dict[str, str]":
+        return dict(self.labels)
+
+    def key(self) -> "tuple[str, tuple[tuple[str, str], ...]]":
+        """Series identity: (name, sorted label pairs)."""
+        return (self.name, self.labels)
+
+
+@dataclass
+class Family:
+    """One metric family: TYPE/HELP metadata plus its samples (including
+    ``_bucket``/``_sum``/``_count`` children for histograms)."""
+
+    name: str
+    type: str = "untyped"
+    help: str = ""
+    samples: "list[Sample]" = field(default_factory=list)
+
+
+def _unescape(raw: str) -> str:
+    return (
+        raw.replace("\\\\", "\x00")
+        .replace('\\"', '"')
+        .replace("\\n", "\n")
+        .replace("\x00", "\\")
+    )
+
+
+def _parse_labels(raw: "str | None") -> "tuple[tuple[str, str], ...]":
+    if not raw:
+        return ()
+    pairs = []
+    for m in _LABEL_RE.finditer(raw):
+        pairs.append((m.group("name"), _unescape(m.group("value")[1:-1])))
+    return tuple(sorted(pairs))
+
+
+def parse(text: str, strict: bool = False) -> "list[Sample]":
+    """Parse an exposition into samples.  ``strict`` raises
+    ``PromParseError`` on the first malformed line (with its number);
+    otherwise malformed lines are skipped — scrapes of a wedged process
+    must degrade to partial data, never to an exception."""
+    out: "list[Sample]" = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if strict and not (_HELP_RE.match(line) or _TYPE_RE.match(line)):
+                raise PromParseError(f"line {lineno}: bad comment: {line!r}")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            if strict:
+                raise PromParseError(f"line {lineno}: bad sample: {line!r}")
+            continue
+        out.append(
+            Sample(
+                name=m.group("name"),
+                labels=_parse_labels(m.group("labels")),
+                value=float(m.group("value")),
+            )
+        )
+    return out
+
+
+def parse_families(text: str, strict: bool = False) -> "dict[str, Family]":
+    """Samples grouped under their TYPE/HELP metadata.  Histogram children
+    (``_bucket``/``_sum``/``_count``) group under the declared family."""
+    families: "dict[str, Family]" = {}
+    for line in text.splitlines():
+        hm = _HELP_RE.match(line)
+        if hm:
+            fam = families.setdefault(hm.group("name"), Family(hm.group("name")))
+            fam.help = hm.group("help")
+            continue
+        tm = _TYPE_RE.match(line)
+        if tm:
+            fam = families.setdefault(tm.group("name"), Family(tm.group("name")))
+            fam.type = tm.group("type")
+    for sample in parse(text, strict=strict):
+        base = sample.name
+        if base not in families:
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix) and base[: -len(suffix)] in families:
+                    base = base[: -len(suffix)]
+                    break
+        families.setdefault(base, Family(base)).samples.append(sample)
+    return families
+
+
+def _matches(sample: Sample, name: str, labels: "dict[str, str]") -> bool:
+    if sample.name != name:
+        return False
+    have = sample.labeldict
+    return all(have.get(k) == str(v) for k, v in labels.items())
+
+
+def value(
+    samples: "list[Sample]", name: str, **labels: str
+) -> "float | None":
+    """The value of the first series matching ``name`` whose labels are a
+    superset of ``labels``; None when absent (absent ≠ zero — a counter
+    that never moved has no series)."""
+    for s in samples:
+        if _matches(s, name, labels):
+            return s.value
+    return None
+
+
+def total(samples: "list[Sample]", name: str, **labels: str) -> float:
+    """Sum across every series of ``name`` whose labels are a superset of
+    ``labels`` (the exposition-side analog of ``Counter.total()``)."""
+    return sum(s.value for s in samples if _matches(s, name, labels))
+
+
+def series(
+    samples: "list[Sample]", name: str, **labels: str
+) -> "list[Sample]":
+    """Every series of ``name`` whose labels are a superset of ``labels``."""
+    return [s for s in samples if _matches(s, name, labels)]
+
+
+def names(samples: "list[Sample]") -> "set[str]":
+    return {s.name for s in samples}
+
+
+def assert_valid(text: str) -> int:
+    """Strict whole-exposition validation; returns the number of sample
+    lines (the observability smoke's contract, now on the shared
+    grammar).  NaN values are accepted by the grammar but rejected here:
+    the in-repo registry never legitimately emits one."""
+    samples = parse(text, strict=True)
+    for s in samples:
+        if math.isnan(s.value):
+            raise PromParseError(f"NaN sample in {s.name}")
+    return len(samples)

@@ -119,7 +119,13 @@ class SimCluster:
         multihost_slice: bool = False,
         evict_after_s: "float | None" = None,
         recreate_evicted: bool = False,
+        metrics_endpoint: "str | None" = None,
     ):
+        # ``metrics_endpoint`` (e.g. "127.0.0.1:0") starts a MetricsServer
+        # with the cluster, serving this process's registry and /debug
+        # rings over HTTP; started servers self-register, so an
+        # ObsCollector(auto_discover_local=True) adopts the sim's pane
+        # without any port plumbing.
         # ``server`` lets chaos tests wrap the store (sim/faults.py).
         # ``exec_proxies`` makes KubeSim actually run tpu-runtime-proxy
         # Deployments as local daemon processes (with real devnode files to
@@ -181,10 +187,17 @@ class SimCluster:
             evict_after_s=evict_after_s,
             recreate_evicted=recreate_evicted,
         )
+        self._metrics_endpoint = metrics_endpoint
+        self.metrics_server = None
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
+        if self._metrics_endpoint and self.metrics_server is None:
+            from tpu_dra.utils.metrics import MetricsServer
+
+            self.metrics_server = MetricsServer(self._metrics_endpoint)
+            self.metrics_server.start()
         for node in self.nodes:
             node.start()
         self.controller.start()
@@ -198,6 +211,9 @@ class SimCluster:
         self.controller_driver.close()
         for node in self.nodes:
             node.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
     def node(self, name: str) -> SimNode:
         return next(n for n in self.nodes if n.name == name)

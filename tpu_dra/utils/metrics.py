@@ -27,6 +27,7 @@ import sys
 import threading
 import time
 import traceback
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -112,10 +113,15 @@ class Gauge:
         with self._lock:
             self._fns[tuple(sorted(labels.items()))] = fn
 
-    def remove_function(self, **labels: str) -> None:
-        """Drop a sampled callable (and its series) — call on owner shutdown
-        so the process-global registry doesn't pin dead object graphs."""
+    def remove(self, **labels: str) -> None:
+        """Retire one labeled series (set() or sampled) — call when the
+        labeled entity is deconfigured or a sampler's owner shuts down,
+        so the exposition stops carrying a frozen last value (and the
+        process-global registry doesn't pin dead object graphs)."""
         self._remove_key(tuple(sorted(labels.items())))
+
+    # Historical name from when only sampled series could be retired.
+    remove_function = remove
 
     def _remove_key(self, key: tuple) -> None:
         with self._lock:
@@ -447,6 +453,15 @@ METRIC_SAMPLE_ERRORS = REGISTRY.counter(
     "Gauge set_function callbacks that raised at scrape time, by metric "
     "name (the series re-exposes its last good sample)",
 )
+# Every bounded ring in the tree (trace exporter, decision/engine/fleet
+# flight recorders, obs alert events) moves this when eviction at
+# capacity drops a record — ring overflow is alertable, not only visible
+# inside each /debug/* payload's own `dropped` field.
+RING_DROPPED = REGISTRY.counter(
+    "tpu_dra_ring_dropped_total",
+    "Records evicted from bounded telemetry rings by ring name (trace, "
+    "decisions, engine, fleet, obs_alerts)",
+)
 
 
 def set_build_info(component: str) -> None:
@@ -524,6 +539,91 @@ def _query_int(query: dict, name: str, default: int, cap: int) -> int:
     return min(value, cap)
 
 
+# Every RUNNING MetricsServer in this process (start() registers,
+# stop() removes; weak so a dropped server never pins itself).  The
+# cluster collector's auto-discovery reads it: sim rigs and benches get
+# their endpoints adopted without wiring ports by hand.
+_SERVERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def running_servers() -> "list[MetricsServer]":
+    return list(_SERVERS)
+
+
+def _ring_info(module_name: str, getter) -> "dict | None":
+    """Ring metadata for /debug/index — ONLY when the owning module is
+    already loaded.  An unloaded subsystem means this process does not
+    serve that ring (a serve binary has no decisions recorder), and the
+    index must not pay the import to find out."""
+    mod = sys.modules.get(module_name)
+    if mod is None:
+        return None
+    try:
+        return getter(mod)
+    except Exception:
+        return {}
+
+
+def debug_index(server: "MetricsServer") -> dict:
+    """The capability document behind ``/debug/index``: which endpoints
+    this process actually serves, with ring population counts so a
+    scraper can skip empty rings.  ``component`` is the binary identity
+    (trace.set_component), the cross-process join's track name."""
+    pprof = server.pprof_path
+    endpoints: "dict[str, dict]" = {
+        server.metrics_path: {"kind": "metrics"},
+        "/healthz": {"kind": "health"},
+        "/readyz": {"kind": "health"},
+        f"{pprof}/index": {"kind": "index"},
+        f"{pprof}/threads": {"kind": "debug"},
+        f"{pprof}/profile": {"kind": "debug"},
+    }
+    traces = _ring_info(
+        "tpu_dra.utils.trace",
+        lambda m: {
+            "kind": "ring",
+            "recorded": m.EXPORTER.recorded,
+            "dropped": m.EXPORTER.dropped,
+        },
+    )
+    endpoints[f"{pprof}/traces"] = traces if traces is not None else {
+        "kind": "ring", "recorded": 0, "dropped": 0,
+    }
+    for path, module, attr in (
+        ("decisions", "tpu_dra.controller.decisions", "RECORDER"),
+        ("engine", "tpu_dra.utils.servestats", "RECORDER"),
+        ("fleet", "tpu_dra.fleet.stats", "RECORDER"),
+    ):
+        info = _ring_info(
+            module,
+            lambda m, attr=attr: {
+                "kind": "ring",
+                "recorded": getattr(m, attr).recorded,
+                "dropped": getattr(m, attr).dropped,
+            },
+        )
+        if info is not None:
+            endpoints[f"{pprof}/{path}"] = info
+    cluster = _ring_info(
+        "tpu_dra.obs.collector",
+        lambda m: {
+            "kind": "cluster",
+            "active": m.ACTIVE is not None,
+            "endpoints": len(m.ACTIVE.endpoints()) if m.ACTIVE else 0,
+        },
+    )
+    if cluster is not None and cluster.get("active"):
+        endpoints[f"{pprof}/cluster"] = cluster
+    component = _ring_info("tpu_dra.utils.trace", lambda m: m._COMPONENT)
+    from tpu_dra.version import version_string
+
+    return {
+        "component": component or "tpu-dra",
+        "version": version_string(),
+        "endpoints": endpoints,
+    }
+
+
 class MetricsServer:
     """Serve metrics + health + debug on one address, in a daemon thread."""
 
@@ -557,6 +657,14 @@ class MetricsServer:
                     elif parsed.path == "/readyz":
                         ready = outer.ready_check()
                         self._send(200 if ready else 503, "ok\n" if ready else "not ready\n")
+                    elif parsed.path == f"{outer.pprof_path}/index":
+                        import json
+
+                        self._send(
+                            200,
+                            json.dumps(debug_index(outer)),
+                            "application/json",
+                        )
                     elif parsed.path == f"{outer.pprof_path}/threads":
                         self._send(200, _dump_threads())
                     elif parsed.path == f"{outer.pprof_path}/profile":
@@ -571,6 +679,8 @@ class MetricsServer:
                         self._send_engine(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/fleet":
                         self._send_fleet(parse_qs(parsed.query))
+                    elif parsed.path == f"{outer.pprof_path}/cluster":
+                        self._send_cluster(parse_qs(parsed.query))
                     else:
                         self._send(404, "not found\n")
                 except _BadQuery as e:
@@ -588,15 +698,32 @@ class MetricsServer:
                 )
                 trace_id = query.get("trace_id", [""])[0]
                 fmt = query.get("format", ["json"])[0]
-                if fmt not in ("json", "text"):
+                if fmt not in ("json", "text", "raw"):
                     raise _BadQuery(
-                        f"format must be json or text, got {fmt!r}"
+                        f"format must be json, text, or raw, got {fmt!r}"
                     )
                 records = trace.EXPORTER.spans(
                     trace_id=trace_id or None, limit=limit
                 )
                 if fmt == "text":
                     self._send(200, trace.render_tree(records))
+                elif fmt == "raw":
+                    # Machine form for the cluster collector's cross
+                    # -process join: the exporter's records verbatim
+                    # (chrome JSON is a rendering, not a transport).
+                    import json
+
+                    self._send(
+                        200,
+                        json.dumps(
+                            {
+                                "spans": records,
+                                "recorded": trace.EXPORTER.recorded,
+                                "dropped": trace.EXPORTER.dropped,
+                            }
+                        ),
+                        "application/json",
+                    )
                 else:
                     import json
 
@@ -719,6 +846,59 @@ class MetricsServer:
                         "application/json",
                     )
 
+            def _send_cluster(self, query: dict) -> None:
+                # Local import, like its siblings — obs is jax-free by
+                # design, so any binary can host the collector pane.
+                from tpu_dra.obs import cluster as obscluster
+                from tpu_dra.obs import collector as obscollector
+
+                limit = _query_int(query, "limit", 256, cap=4096)
+                window = _query_float(query, "window", 60.0, cap=3600.0)
+                fmt = query.get("format", ["json"])[0]
+                if fmt not in ("json", "text", "alerts"):
+                    raise _BadQuery(
+                        f"format must be json, text, or alerts, got {fmt!r}"
+                    )
+                active = obscollector.ACTIVE
+                if active is None:
+                    if fmt == "json":
+                        import json
+
+                        self._send(
+                            200,
+                            json.dumps(
+                                {
+                                    "collector": None,
+                                    "endpoints": [],
+                                    "alerts": [],
+                                    "alert_events": [],
+                                    "recorded": 0,
+                                    "dropped": 0,
+                                }
+                            ),
+                            "application/json",
+                        )
+                    else:
+                        self._send(
+                            200, "no collector active in this process\n"
+                        )
+                    return
+                doc = obscluster.cluster_doc(
+                    active,
+                    endpoint=query.get("endpoint", [""])[0] or None,
+                    rule=query.get("rule", [""])[0] or None,
+                    limit=limit,
+                    window_s=window,
+                )
+                if fmt == "text":
+                    self._send(200, obscluster.render_text(doc))
+                elif fmt == "alerts":
+                    self._send(200, obscluster.render_alerts_text(doc))
+                else:
+                    import json
+
+                    self._send(200, json.dumps(doc), "application/json")
+
             def _send(self, code: int, body: str, ctype: str = "text/plain"):
                 data = body.encode()
                 self.send_response(code)
@@ -739,8 +919,10 @@ class MetricsServer:
             target=self._server.serve_forever, name="metrics-http", daemon=True
         )
         self._thread.start()
+        _SERVERS.add(self)
 
     def stop(self) -> None:
+        _SERVERS.discard(self)
         self._server.shutdown()
         self._server.server_close()
         if self._thread:

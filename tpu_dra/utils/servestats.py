@@ -98,12 +98,18 @@ class EngineFlightRecorder:
             # Epoch anchor for display/joins; durations (step_wall_s)
             # arrive perf_counter-measured by the caller.
             rec.ts_unix = time.time()  # noqa: A201 — display stamp, not a duration
+        dropped = False
         with self._lock:
             self._seq += 1
             rec.seq = self._seq
             if len(self._records) == self.capacity:
                 self._dropped += 1  # append below evicts the oldest
+                dropped = True
             self._records.append(rec)
+        if dropped:
+            from tpu_dra.utils.metrics import RING_DROPPED
+
+            RING_DROPPED.inc(ring="engine")
         return rec
 
     @property

@@ -373,16 +373,41 @@ class SpanExporter:
         self.capacity = capacity
         self._lock = threading.Lock()
         self._spans: "list[dict]" = []
+        self._exported = 0
+        self._dropped = 0
 
     def export(self, record: dict) -> None:
+        overflow = 0
         with self._lock:
             self._spans.append(record)
+            self._exported += 1
             if len(self._spans) > self.capacity:
-                del self._spans[: len(self._spans) - self.capacity]
+                overflow = len(self._spans) - self.capacity
+                del self._spans[:overflow]
+                self._dropped += overflow
+        if overflow:
+            # Lazy import, matching Span.__exit__: the metrics module
+            # must not couple to this one at load time.
+            from tpu_dra.utils.metrics import RING_DROPPED
+
+            RING_DROPPED.inc(overflow, ring="trace")
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted by the ring bound (the wrapped-buffer tell)."""
+        with self._lock:
+            return self._dropped
+
+    @property
+    def recorded(self) -> int:
+        """Total spans ever exported (monotonic, survives eviction)."""
+        with self._lock:
+            return self._exported
 
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._dropped = 0
 
     def spans(
         self, trace_id: "str | None" = None, limit: "int | None" = None
