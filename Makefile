@@ -9,7 +9,7 @@ TAG     ?= latest
 .PHONY: all test lint analyze generate-crds check-generate native \
         native-test demo-quickstart bench image clean help \
         observability-smoke perf-smoke explain-smoke serve-smoke \
-        serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke
+        serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke paged-smoke
 
 # `analyze` runs the full rule registry — the L-style rules lint would
 # run plus the whole-repo invariants — so `all` needs only one pass.
@@ -80,6 +80,15 @@ explain-smoke:
 serve-smoke:
 	$(PYTHON) -m pytest tests/test_serve_smoke.py -q -m 'not slow'
 
+# Paged KV pool floor (docs/SERVING.md "Paged KV pool"): the second
+# shared-prefix request's admission must ALIAS resident blocks (alias
+# counter moves, zero device copies), the partial prompt block must COW,
+# the tpu_dra_serve_kv_* series must appear in the exposition, and
+# greedy tokens must be identical to the row-backed layout.  The
+# occupancy/HBM measurement is `bench.py` stanza "serve_prefix".
+paged-smoke:
+	$(PYTHON) -m pytest tests/test_paged_smoke.py -q -m 'not slow'
+
 # Serving telemetry floor: drives a small engine stream, scrapes /metrics
 # and /debug/engine over HTTP, asserts the TPOT/queue-wait/SLO series and
 # per-engine gauges appear, the step flight recorder serves the ring, a
@@ -130,4 +139,5 @@ help:
 	@echo "targets: test lint analyze generate-crds check-generate native"
 	@echo "         native-test demo-quickstart bench observability-smoke"
 	@echo "         perf-smoke explain-smoke serve-smoke serve-obs-smoke"
-	@echo "         chaos-smoke fleet-smoke obs-top-smoke image clean"
+	@echo "         chaos-smoke fleet-smoke obs-top-smoke paged-smoke"
+	@echo "         image clean"

@@ -92,7 +92,11 @@ def test_bench_serve_prefix_stanza():
     stanza itself — greedy token-identity cache-on vs cache-off.  ISSUE 5
     adds the telemetry extras: TPOT/queue-wait percentiles per mode, and
     the telemetry-on-vs-off throughput noise check (instrumentation must
-    not regress the hot loop)."""
+    not regress the hot loop).  ISSUE 10 re-grounds it on the paged KV
+    pool: zero copied prefix tokens (alias blocks replace the row
+    layout's per-hit device copies), per-request block footprint, a
+    token-identical row-layout control arm, and the paged_occupancy
+    sub-stanza (strictly higher concurrency at equal HBM)."""
     import bench
 
     out = bench.bench_serve_prefix()
@@ -104,10 +108,21 @@ def test_bench_serve_prefix_stanza():
         out["cache_on"]["prefill_tokens_per_req"]
         < out["cache_off"]["prefill_tokens_per_req"]
     )
-    for mode in ("cache_on", "cache_off"):
+    for mode in ("cache_on", "cache_off", "rows_cache_on"):
         for key in ("tpot_p50_s", "tpot_p95_s", "queue_wait_p95_s"):
             assert key in out[mode], (mode, key, out[mode])
         assert out[mode]["tpot_p50_s"] > 0
+    # The paged acceptance: prefix-hit admission does ZERO device
+    # copies — the alias counter replaces the copied tokens — while the
+    # per-request footprint is blocks, not a worst-case row.
+    on = out["cache_on"]
+    assert on["alias_blocks"] > 0
+    assert on["copied_prefix_tokens"] == 0
+    assert on["kv_blocks_per_req_p50"] > 0
+    assert 0 < on["alias_rate"] <= 1
+    occ = out["paged_occupancy"]
+    assert occ["paged_max_concurrent"] > occ["rows_max_concurrent"]
+    assert occ["long_req_blocks"] > 0
     tel = out["telemetry"]
     assert {"tokens_per_s_on", "tokens_per_s_off", "ratio"} <= tel.keys()
     assert tel["within_noise"], tel

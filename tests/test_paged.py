@@ -1,0 +1,410 @@
+"""Paged KV pool (tpu_dra/parallel/paged.py + prefixcache.PagedPrefixCache
++ the ServeEngine kv_layout="paged" wiring): block allocator semantics,
+block-backed radix entries, cross-layout greedy token identity, zero-copy
+prefix aliasing with COW of the shared partial block, block-demand
+admission control (park-don't-deadlock when everything is pinned), and
+per-request context length beyond the equal-HBM row bound."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.parallel.burnin import init_params
+from tpu_dra.parallel.paged import (
+    BlockAllocator,
+    copy_block,
+    init_block_pool,
+)
+from tpu_dra.parallel.prefixcache import PagedPrefixCache
+from tpu_dra.parallel.serve import ServeEngine
+
+from test_serve import CFG
+from test_serve_prefix import SHARED, STREAM, isolated
+
+
+def _engine(params, config=CFG, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prompt_slots", 8)
+    kw.setdefault("max_new_cap", 5)
+    return ServeEngine(params, config, **kw)
+
+
+def _drain(eng, reqs, seeds=None):
+    ids = [
+        eng.submit(p, b, seed=None if seeds is None else seeds[i])
+        for i, (p, b) in enumerate(reqs)
+    ]
+    done = {r.id: r for r in eng.run()}
+    return [tuple(done[i].tokens) for i in ids]
+
+
+class TestBlockAllocator:
+    """Pure host bookkeeping — no jax, no device."""
+
+    def test_scratch_block_never_allocated(self):
+        a = BlockAllocator(4)
+        got = a.alloc(3)
+        assert got is not None and 0 not in got
+        assert a.alloc(1) is None  # scratch is not allocatable headroom
+        assert a.refcount(0) == 1  # immortal
+
+    def test_alloc_is_all_or_nothing(self):
+        a = BlockAllocator(4)
+        assert a.alloc(5) is None
+        assert a.free_count == 3  # nothing stranded by the refusal
+        assert a.alloc(3) is not None and a.free_count == 0
+
+    def test_refcounts_free_only_at_zero(self):
+        a = BlockAllocator(3)
+        (b1, b2) = a.alloc(2)
+        a.ref([b1])  # a second owner (a radix entry alias)
+        a.unref([b1, b2])
+        assert a.free_count == 1  # b2 freed, b1 still owned
+        assert a.allocated_count == 1
+        a.unref([b1])
+        assert a.free_count == 2
+
+    def test_aliased_counts_shared_blocks(self):
+        a = BlockAllocator(4)
+        blocks = a.alloc(2)
+        assert a.aliased_count == 0
+        a.ref(blocks[:1])
+        assert a.aliased_count == 1
+
+    def test_misuse_raises(self):
+        a = BlockAllocator(3)
+        with pytest.raises(RuntimeError, match="unowned"):
+            a.ref([0])  # scratch is nobody's to share
+        with pytest.raises(RuntimeError, match="unowned"):
+            a.unref([1])  # free block
+        (b,) = a.alloc(1)
+        a.unref([b])
+        with pytest.raises(RuntimeError, match="unowned"):
+            a.unref([b])  # double free
+        with pytest.raises(ValueError, match=">= 2 blocks"):
+            BlockAllocator(1)
+
+
+class TestPagedPrefixCache:
+    """Block-backed radix entries: same index semantics as the row cache
+    (those are pinned in test_serve_prefix), plus the block-reference
+    lifecycle the row form doesn't have.  Host-only — no device pool."""
+
+    def test_insert_refs_blocks_and_evict_unrefs(self):
+        a = BlockAllocator(8)
+        pc = PagedPrefixCache(2, a)
+        blocks = a.alloc(3)
+        e = pc.insert([1, 2, 3, 4, 5], blocks)
+        assert e.blocks == blocks and e.length == 5
+        assert all(a.refcount(b) == 2 for b in blocks)  # caller + entry
+        a.unref(blocks)  # caller (the table) releases at finish
+        assert all(a.refcount(b) == 1 for b in blocks)
+        pc.release(e)
+        assert pc.evict_one()
+        assert a.free_count == 7  # entry eviction freed them
+
+    def test_entry_cap_evicts_lru_and_respects_pins(self):
+        a = BlockAllocator(16)
+        pc = PagedPrefixCache(2, a)
+        ba = a.alloc(1)
+        bb = a.alloc(1)
+        ea = pc.insert([1, 1, 1], ba)
+        eb = pc.insert([2, 2, 2], bb)
+        a.unref(ba), a.unref(bb)
+        pc.release(eb)  # ea stays pinned
+        bc = a.alloc(1)
+        ec = pc.insert([3, 3, 3], bc)  # at cap: must evict eb, never ea
+        a.unref(bc)
+        assert ec is not None and pc.evictions == 1
+        assert pc.match([2, 2, 2, 5])[0] is None  # eb gone
+        assert pc.match([1, 1, 1, 5])[0] is ea    # pinned survivor
+        # Every resident entry pinned (ea and ec): insert refuses.
+        bd = a.alloc(1)
+        assert pc.insert([4, 4, 4], bd) is None
+        assert a.refcount(bd[0]) == 1  # refused insert took no reference
+        pc.release(ea)
+        assert pc.insert([4, 4, 4], bd) is not None
+
+    def test_exact_resident_reuses_entry_without_touching_blocks(self):
+        a = BlockAllocator(8)
+        pc = PagedPrefixCache(4, a)
+        b1 = a.alloc(2)
+        e = pc.insert([7, 7, 7, 7], b1)
+        pc.release(e)
+        b2 = a.alloc(2)
+        again = pc.insert([7, 7, 7, 7], b2)
+        assert again is e and e.blocks == b1
+        assert a.refcount(b2[0]) == 1  # duplicate insert ignored b2
+
+    def test_evict_one_false_when_all_pinned(self):
+        a = BlockAllocator(8)
+        pc = PagedPrefixCache(2, a)
+        e = pc.insert([1, 2, 3], a.alloc(2))
+        assert e.refcount == 1  # born pinned
+        assert not pc.evict_one()
+
+
+class TestCopyBlock:
+    @pytest.mark.parametrize("kv_int8", [False, True])
+    def test_copies_one_block_leaves_rest(self, kv_int8):
+        import jax
+
+        pool = init_block_pool(CFG, 4, 2, kv_int8)
+        key = jax.random.PRNGKey(0)
+        pool = jax.tree_util.tree_map(
+            lambda a: jax.random.normal(
+                jax.random.fold_in(key, a.size), a.shape
+            ).astype(a.dtype),
+            pool,
+        )
+        out = jax.jit(copy_block)(pool, jnp.int32(3), jnp.int32(1))
+        for o, p in zip(
+            jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(pool)
+        ):
+            o, p = np.asarray(o), np.asarray(p)
+            np.testing.assert_array_equal(o[:, 3], p[:, 1])
+            np.testing.assert_array_equal(o[:, :3], p[:, :3])
+
+
+class TestPagedEngineExactness:
+    def test_greedy_identical_paged_vs_rows_vs_isolated(self):
+        """THE acceptance contract: the paged engine's greedy outputs are
+        token-identical to the pre-refactor row engine's (cache on both
+        sides) and to every request run alone — while admissions alias
+        blocks instead of copying and the partial prompt blocks COW."""
+        params = init_params(CFG)
+        rows = _engine(
+            params, kv_layout="rows", prefix_cache_slots=8
+        )
+        out_rows = _drain(rows, STREAM)
+        paged = _engine(params, prefix_cache_slots=8)
+        assert paged.kv_layout == "paged"
+        out_paged = _drain(paged, STREAM)
+        assert out_paged == out_rows
+        stats = paged.prefix_stats
+        assert stats["hits"] >= 5
+        assert stats["prefill_tokens_reused"] > 0
+        kv = paged.kv_block_stats
+        # Zero-copy aliasing did the reuse (the row layout's per-hit
+        # device copy has no paged analog), and the unaligned prompts'
+        # partial blocks were COW-privatized.
+        assert kv["alias_blocks_total"] > 0
+        assert kv["cow_blocks_total"] > 0
+        for (prompt, budget), got in zip(STREAM, out_paged):
+            want = isolated(params, CFG, prompt, budget)
+            np.testing.assert_array_equal(want[:budget], np.asarray(got))
+
+    def test_eviction_under_block_pressure_stays_exact(self):
+        """kv_blocks far below the stream's parked working set: constant
+        entry eviction (and block recycling) must never corrupt an
+        admission aliasing a surviving entry's blocks."""
+        params = init_params(CFG)
+        rng = np.random.RandomState(1)
+        families = [[int(x) for x in rng.randint(0, CFG.vocab, 5)]
+                    for _ in range(4)]
+        reqs = []
+        for i in range(16):
+            fam = families[i % 4]
+            reqs.append((fam + [int(rng.randint(0, CFG.vocab))],
+                         int(rng.randint(1, 5))))
+        off = _drain(_engine(params, slots=3), reqs)
+        eng = _engine(
+            params, slots=3, prefix_cache_slots=4, kv_blocks=24
+        )
+        on = _drain(eng, reqs)
+        assert on == off
+        assert eng.prefix_stats["evictions"] > 0
+        assert eng.prefix_stats["hits"] > 0
+        # Everything released: allocated == the DISTINCT blocks still
+        # held by resident (unpinned) entries — entries sharing a prefix
+        # alias the same blocks — and nothing leaked past them.
+        kv = eng.kv_block_stats
+        held = {b for e in eng._prefix._entries for b in e.blocks}
+        assert kv["blocks_allocated"] == len(held)
+
+    def test_sampled_outputs_layout_and_scheduling_invariant(self):
+        """Sampled randomness is f(seed, position) and paged logits are
+        value-identical — so sampled outputs match across layouts AND
+        across slot counts / tick sizes."""
+        params = init_params(CFG)
+        seeds = [101, 202, 303, 404, 505, 606, 707, 808]
+        rows = _engine(
+            params, temperature=0.8, kv_layout="rows",
+            prefix_cache_slots=8,
+        )
+        a = _drain(rows, STREAM, seeds=seeds)
+        paged = _engine(
+            params, temperature=0.8, prefix_cache_slots=8, slots=4,
+            steps_per_tick=2,
+        )
+        b = _drain(paged, STREAM, seeds=seeds)
+        assert a == b
+
+
+class TestBlockAdmissionControl:
+    def test_all_blocks_pinned_parks_request_then_admits(self):
+        """The block-pool analog of 'insert returns None when all slots
+        pinned' (PR 4): a request whose demand cannot be met while every
+        block is pinned by a mid-decode row PARKS in the queue — no
+        deadlock, no eviction of a pinned entry — and admits as soon as
+        the finisher frees blocks."""
+        params = init_params(CFG)
+        # Floor-sized pool: 8 allocatable blocks.  A (7 tokens + budget
+        # 4 => 6 table columns + 1 COW) takes 7 of them.
+        eng = _engine(
+            params, prompt_slots=8, max_new_cap=4,
+            prefix_cache_slots=2, prefix_window=2, kv_blocks=9,
+        )
+        a = eng.submit(list(SHARED) + [1], 4)
+        eng.tick()  # admit a
+        assert eng.occupancy == 1
+        assert eng.kv_block_stats["blocks_free"] <= 1
+        b = eng.submit([30, 31, 32], 4)  # needs 4 blocks: cannot fit
+        eng.tick()
+        # b parked: a's entry is pinned (a is mid-decode), so admission
+        # control must neither admit nor evict.
+        assert eng.queue_depth == 1
+        assert eng.prefix_stats["evictions"] == 0
+        done = {r.id: r for r in eng.run()}
+        assert len(done) == 2  # no deadlock: b admitted after a finished
+        assert done[b].finish_reason == "budget"
+        np.testing.assert_array_equal(
+            isolated(params, CFG, [30, 31, 32], 4)[:4],
+            np.asarray(done[b].tokens),
+        )
+
+    def test_fifo_head_blocks_tail_admissions(self):
+        """Strict FIFO under block pressure: a small request behind a
+        too-big head waits with it (no starvation of large requests)."""
+        params = init_params(CFG)
+        eng = _engine(
+            params, prompt_slots=8, max_new_cap=4, kv_blocks=7, slots=2
+        )
+        a = eng.submit([1] * 7, 4)   # 6 columns: fits (6 of 6 free)
+        eng.tick()
+        big = eng.submit([2] * 7, 4)  # 6 columns: must wait
+        small = eng.submit([3, 4], 1)  # 2 columns: COULD fit, must wait
+        eng.tick()
+        assert eng.queue_depth == 2  # both parked behind the FIFO head
+        done = {r.id: r for r in eng.run()}
+        assert {a, big, small} == set(done)
+
+
+class TestPerRequestContextLength:
+    def test_occupancy_beyond_equal_hbm_row_bound(self):
+        """One engine, one long request + many short ones: the paged
+        pool (32 blocks x W=2 = 64 KV positions + scratch) matches the
+        HBM of a TWO-row engine (2 rows x config.seq=32 positions), yet
+        sustains 6 concurrent requests — and the long request (context
+        16 > the 10 positions/row an equal-HBM 6-row engine could
+        afford) decodes token-identically to its isolated reference."""
+        params = init_params(CFG)
+        eng = _engine(
+            params, slots=6, prompt_slots=8, max_new_cap=8,
+            kv_blocks=33, prefix_window=2,
+        )
+        long_req = eng.submit([7, 3, 9, 1, 4, 6, 2, 8], 8)  # 16 positions
+        shorts = [eng.submit([10 + i, 20 + i], 4) for i in range(5)]
+        eng.tick()
+        old_bound = 2  # rows at equal HBM: (33-1)*2 // CFG.seq
+        assert eng.occupancy == 6 > old_bound
+        done = {r.id: r for r in eng.run()}
+        assert len(done) == 6
+        np.testing.assert_array_equal(
+            isolated(params, CFG, [7, 3, 9, 1, 4, 6, 2, 8], 8)[:8],
+            np.asarray(done[long_req].tokens),
+        )
+        for i, rid in enumerate(shorts):
+            np.testing.assert_array_equal(
+                isolated(params, CFG, [10 + i, 20 + i], 4)[:4],
+                np.asarray(done[rid].tokens),
+            )
+
+
+class TestPagedKnobs:
+    def test_moe_auto_falls_back_to_rows_and_explicit_paged_rejected(self):
+        import dataclasses
+
+        moe = dataclasses.replace(CFG, moe_experts=2, d_ff=32)
+        eng = ServeEngine(
+            init_params(moe), moe, slots=1, prompt_slots=8, max_new_cap=2
+        )
+        assert eng.kv_layout == "rows"
+        with pytest.raises(ValueError, match="kv_layout='paged'"):
+            ServeEngine(
+                init_params(moe), moe, slots=1, prompt_slots=8,
+                max_new_cap=2, kv_layout="paged",
+            )
+
+    def test_bad_knobs_rejected(self):
+        params = init_params(CFG)
+        with pytest.raises(ValueError, match="kv_layout"):
+            _engine(params, kv_layout="striped")
+        with pytest.raises(ValueError, match="kv_blocks only applies"):
+            _engine(params, kv_layout="rows", kv_blocks=8)
+        with pytest.raises(ValueError, match="kv_blocks must be >="):
+            _engine(params, kv_blocks=3)
+        with pytest.raises(ValueError, match="block grid"):
+            _engine(params, prefill_chunk=4, prefix_window=2)
+
+    def test_shared_blocks_are_never_written(self):
+        """The COW invariant, asserted structurally: while requests are
+        mid-decode, every block with more than one owner belongs to a
+        parked entry's window-aligned prompt span — the table cell for
+        the partial block (the one decode writes) is always private."""
+        params = init_params(CFG)
+        eng = _engine(params, prefix_cache_slots=8, max_new_cap=5)
+        eng.submit(list(SHARED) + [1], 5)
+        eng.tick()  # admit: prompt parked, partial block COW-privatized
+        row_blocks = [int(b) for b in eng._table[0] if b]
+        length = len(SHARED) + 1
+        w = eng._block_size
+        writable_from = length // w  # decode writes blocks >= this col
+        for col, blk in enumerate(row_blocks):
+            if col >= writable_from:
+                assert eng._balloc.refcount(blk) == 1, (col, blk)
+        assert eng.kv_block_stats["cow_blocks_total"] == 1
+
+    # Composition matrix rides the slow tier, mirroring the row cache's
+    # discipline (each underlying path has tier-1 exactness coverage).
+    @pytest.mark.slow
+    def test_int8_stack_composes_with_paged(self):
+        from tpu_dra.parallel.quant import quantize_params
+
+        qp = quantize_params(init_params(CFG))
+        off = _drain(
+            _engine(qp, kv_int8=True, kv_layout="rows"), STREAM
+        )
+        eng = _engine(qp, kv_int8=True, prefix_cache_slots=8)
+        on = _drain(eng, STREAM)
+        assert on == off and eng.prefix_stats["hits"] > 0
+
+    @pytest.mark.slow
+    def test_rope_composes_with_paged(self):
+        import dataclasses
+
+        rcfg = dataclasses.replace(CFG, rope=True)
+        params = init_params(rcfg)
+        off = _drain(_engine(params, config=rcfg, kv_layout="rows"), STREAM)
+        eng = _engine(params, config=rcfg, prefix_cache_slots=8)
+        on = _drain(eng, STREAM)
+        assert on == off and eng.prefix_stats["hits"] > 0
+
+    @pytest.mark.slow
+    def test_mesh_paged_engine_drains_with_hits(self):
+        import jax
+
+        from tpu_dra.parallel.mesh import logical_mesh
+
+        mesh = logical_mesh(jax.devices(), data=2, fsdp=2, model=2)
+        params = init_params(CFG)
+        eng = ServeEngine(
+            params, CFG, slots=4, prompt_slots=8, max_new_cap=3,
+            mesh=mesh, prefix_cache_slots=4,
+        )
+        assert eng.kv_layout == "paged"
+        ids = [eng.submit(SHARED[:4] + [i + 1], 3) for i in range(6)]
+        done = {r.id: r for r in eng.run()}
+        assert len(done) == 6
+        assert all(len(done[i].tokens) == 3 for i in ids)
+        assert eng.prefix_stats["hits"] > 0

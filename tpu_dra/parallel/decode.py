@@ -257,14 +257,20 @@ def _cache_read(cbuf):
 
 
 def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain,
-                  mask, rope_tab=None):
+                  mask, rope_tab=None, kv_io=None):
     """One block over ``x`` (B, S, d) written to cache slots [p0, p0+S).
 
     Writes K/V into the cache slices ``ck``/``cv`` (B, T, H, K) at p0 and
     attends the queries over the full buffer under ``mask`` (broadcastable
     to (B, 1, S, T); invalid slots score -1e30 exactly like training's
     tril).  Identical math (same casts, same einsum contractions) to the
-    training `_block`'s tp branch, minus gradients and checkpointing."""
+    training `_block`'s tp branch, minus gradients and checkpointing.
+
+    ``kv_io`` swaps the cache addressing without touching the math: an
+    object with ``write(buf, new, p0) -> buf`` and ``read(buf) ->
+    (B, T, H, K)`` (default: the contiguous `_cache_update`/`_cache_read`
+    pair; `paged._PagedKV` gathers/scatters through a block table — the
+    attention einsums are shared, so the two layouts cannot drift)."""
     import jax
     import jax.numpy as jnp
 
@@ -286,14 +292,20 @@ def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain,
         q = rope_apply(q, rope_tab)
         k_new = rope_apply(k_new, rope_tab)
 
-    ck = _cache_update(ck, k_new, p0)
-    cv = _cache_update(cv, v_new, p0)
+    if kv_io is None:
+        ck = _cache_update(ck, k_new, p0)
+        cv = _cache_update(cv, v_new, p0)
+        k_all, v_all = _cache_read(ck), _cache_read(cv)
+    else:
+        ck = kv_io.write(ck, k_new, p0)
+        cv = kv_io.write(cv, v_new, p0)
+        k_all, v_all = kv_io.read(ck), kv_io.read(cv)
 
-    scores = jnp.einsum("bshk,bthk->bhst", q, _cache_read(ck)) / (c.d_head**0.5)
+    scores = jnp.einsum("bshk,bthk->bhst", q, k_all) / (c.d_head**0.5)
     scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
     probs = jnp.exp(scores - scores.max(-1, keepdims=True))
     probs = (probs / probs.sum(-1, keepdims=True)).astype(bf16)
-    att = jnp.einsum("bhst,bthk->bshk", probs, _cache_read(cv))
+    att = jnp.einsum("bhst,bthk->bshk", probs, v_all)
     att = jnp.einsum("bshk,hkd->bsd", att, layer["wo"].astype(bf16))
     x = x + att
 
@@ -320,10 +332,12 @@ def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain,
     return x, ck, cv
 
 
-def _run_blocks(params, x, cache, p0, mask, config: BurninConfig, constrain):
+def _run_blocks(params, x, cache, p0, mask, config: BurninConfig, constrain,
+                kv_io=None):
     """Layer scan + final norm + logits, shared by the uniform and padded
-    paths.  ``x``: embedded inputs (B, S, d); ``mask`` broadcastable to
-    (B, 1, S, T).
+    paths (and, via ``kv_io``, the paged block-table paths — the cache
+    may be a block pool whose per-layer leaves scan identically).  ``x``:
+    embedded inputs (B, S, d); ``mask`` broadcastable to (B, 1, S, T).
 
     Accepts int8-quantized params (quant.quantize_params) transparently:
     each scanned layer's ``{"q","s"}`` leaves are dequantized inside the
@@ -349,7 +363,7 @@ def _run_blocks(params, x, cache, p0, mask, config: BurninConfig, constrain):
         rope_tab = rope_tables(positions, config.d_head)
     block = functools.partial(
         _decode_block, config=config, constrain=constrain, mask=mask,
-        rope_tab=rope_tab,
+        rope_tab=rope_tab, kv_io=kv_io,
     )
 
     def body(h, xs):

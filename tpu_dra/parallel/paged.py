@@ -1,0 +1,398 @@
+"""Paged KV pool: block-granular device KV with per-request block tables.
+
+The row-backed serving cache (`decode.init_cache` at ``B = slots``) gives
+every request a full engine-max-length KV row, so occupancy is bounded by
+the LONGEST possible request and every radix-cache hit pays an O(prefix)
+device copy into its row (`decode.copy_prefix_into_row`).  This module is
+the PagedAttention/RadixAttention answer (vLLM's block tables, SGLang's
+radix sharing) reshaped for XLA's fixed-shape compilation:
+
+- **Block pool** (`init_block_pool`): ONE device KV allocation of
+  ``num_blocks`` fixed-size blocks — ``{"k","v"}`` of
+  ``(L, NB, W, H, d_head)`` (int8 ``{"q","s"}`` pairs compose exactly
+  like the row cache's).  Block size W is the engine's suffix-prefill
+  window, so the prefill grid and the storage grid coincide: every
+  prefill window fills exactly one block.
+- **Block tables**: each request row reads/writes KV through a
+  ``(B, NW)`` int32 table mapping logical block j -> physical block id.
+  Attention gathers ``pool[table]`` into the same masked
+  ``(B, NW*W, H, K)`` shape the dense step attends over — ONE compiled
+  step executable for ANY table contents, the jit-stability answer to
+  per-request context lengths.  Extra masked tail positions contribute
+  exact ``0.0`` terms to the softmax contractions, so paged and
+  contiguous attention are value-identical (the engine's token-identity
+  contract rides on this).
+- **Scratch block 0**: never allocated, permanently referenced.  Freed
+  table rows are zeroed, so a finished row's frozen in-flight writes
+  (the engine keeps stepping inactive rows — XLA has no ragged batch)
+  land in scratch instead of corrupting a reallocated block, and
+  unallocated table columns read masked garbage instead of faulting.
+- **BlockAllocator**: the host-side free list + per-block refcounts.
+  A block may be referenced by several owners at once — a request's
+  table cell, and any number of radix-cache entries aliasing it
+  (`prefixcache.PagedPrefixCache`).  The engine's invariant: a block
+  with more than one reference is NEVER written — the partial last
+  prompt block a parked entry shares with its live request is resolved
+  by copy-on-write (`copy_block`) at admission, because the first
+  decode token's write into it is certain.
+
+The engine wiring (admission accounting, alias/COW bookkeeping, the
+FIFO block-demand admission gate) lives in `serve.ServeEngine`
+(``kv_layout="paged"``); the host radix index over block-backed entries
+is `prefixcache.PagedPrefixCache`.  Usage guide: docs/SERVING.md
+"Paged KV pool".
+"""
+
+from __future__ import annotations
+
+from tpu_dra.parallel.burnin import BurninConfig
+from tpu_dra.parallel.decode import (
+    _check_prefix_window,
+    _embed_lookup,
+    _make_constrain,
+    _run_blocks,
+    _validate,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "block_pool_spec",
+    "copy_block",
+    "init_block_pool",
+    "make_paged_prefill",
+    "paged_decode_step_rows",
+]
+
+
+def init_block_pool(config: BurninConfig, num_blocks: int, block_size: int,
+                    kv_int8: bool = False):
+    """Zeroed block pool: ``{"k","v"}`` of ``(L, NB, W, H, d_head)`` bf16
+    (or the int8 ``{"q","s"}`` pair — same storage convention as
+    `decode.init_cache`, with the per-request batch/T dims replaced by
+    the shared block dims).  Block 0 is the caller's scratch block."""
+    import jax.numpy as jnp
+
+    c = config
+    if num_blocks < 2:
+        raise ValueError(
+            f"block pool needs >= 2 blocks (block 0 is scratch), "
+            f"got {num_blocks}"
+        )
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    shape = (c.n_layers, num_blocks, block_size, c.n_heads, c.d_head)
+    if not kv_int8:
+        return {
+            "k": jnp.zeros(shape, jnp.bfloat16),
+            "v": jnp.zeros(shape, jnp.bfloat16),
+        }
+    sshape = shape[:-1] + (1,)
+    return {
+        "k": {"q": jnp.zeros(shape, jnp.int8),
+              "s": jnp.zeros(sshape, jnp.float32)},
+        "v": {"q": jnp.zeros(shape, jnp.int8),
+              "s": jnp.zeros(sshape, jnp.float32)},
+    }
+
+
+def block_pool_spec(config: BurninConfig, kv_int8: bool = False):
+    """PartitionSpec for the pool: heads over the tp axis, everything
+    else whole.  Blocks are SHARED storage addressed by per-request
+    tables, so the row cache's batch-over-data×fsdp sharding has no
+    analog here — the batch dimension lives in the gather indices, not
+    the storage."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, None, "model", None)
+    if not kv_int8:
+        return spec
+    return {"q": spec, "s": spec}
+
+
+class _PagedKV:
+    """`decode._run_blocks` kv_io adapter: reads gather the whole table
+    reach ``(B, NW*W, H, K)`` through the block table; writes scatter
+    into table-addressed blocks — one token per row (decode: per-row
+    positions) or one full W-token block (prefill windows).  Rows must
+    target distinct blocks for writes (the engine's exclusive-ownership
+    invariant; the shared scratch block is write-racy by design and
+    never read unmasked)."""
+
+    def __init__(self, table, block_size: int):
+        self.table = table  # (B, NW) int32
+        self.W = block_size
+
+    def read(self, cbuf):
+        import jax.numpy as jnp
+
+        from tpu_dra.parallel.quant import dequantize, is_quantized_leaf
+
+        def gather(buf):
+            g = buf[self.table]  # (B, NW, W, H, K')
+            return g.reshape(
+                g.shape[0], g.shape[1] * g.shape[2], *g.shape[3:]
+            )
+
+        if is_quantized_leaf(cbuf):
+            out = dequantize(
+                {"q": gather(cbuf["q"]), "s": gather(cbuf["s"])}
+            )
+        else:
+            out = gather(cbuf)
+        return out.astype(jnp.bfloat16)
+
+    def write(self, cbuf, new, p0):
+        import jax.numpy as jnp
+
+        from tpu_dra.parallel.quant import is_quantized_leaf, quantize_tensor
+
+        rows = jnp.arange(new.shape[0])
+        per_row = getattr(p0, "ndim", 0) >= 1
+        if per_row:
+            if new.shape[1] != 1:
+                raise ValueError(
+                    f"per-row paged writes are single-token (S=1), "
+                    f"got S={new.shape[1]}"
+                )
+            blk = self.table[rows, p0 // self.W]  # (B,)
+            off = p0 % self.W
+
+            def put(buf, upd):
+                return buf.at[blk, off].set(upd[:, 0])
+        else:
+            if new.shape[1] != self.W:
+                raise ValueError(
+                    f"scalar-p0 paged writes fill one block (S=W="
+                    f"{self.W}), got S={new.shape[1]}"
+                )
+            # A scalar p0 is a window start on the W grid: the write
+            # fills block column p0 // W of every row.
+            blk = self.table[rows, p0 // self.W]  # (B,)
+
+            def put(buf, upd):
+                return buf.at[blk].set(upd)
+
+        if not is_quantized_leaf(cbuf):
+            return put(cbuf, new.astype(jnp.bfloat16))
+        row = quantize_tensor(new, (3,))  # same policy as _cache_update
+        return {
+            "q": put(cbuf["q"], row["q"]),
+            "s": put(cbuf["s"], row["s"]),
+        }
+
+
+def _pool_block_size(pool) -> int:
+    """Block width W of a pool in either storage format."""
+    k = pool["k"]
+    return (k["q"] if isinstance(k, dict) else k).shape[2]
+
+
+def paged_decode_step_rows(params, tok, pool, table, pos,
+                           config: BurninConfig, mesh=None):
+    """One decode step with PER-ROW positions through block tables: row
+    ``b``'s token lands in block ``table[b, pos[b] // W]`` at offset
+    ``pos[b] % W`` and attends ``j <= pos[b]`` over the table-gathered
+    pool.  Returns ``(logits (B, vocab), new_pool)`` — the paged twin of
+    `decode.decode_step_rows`, value-identical to it row for row (the
+    gather only reorders storage, and the wider/narrower masked tail
+    adds exact-zero softmax terms)."""
+    import jax.numpy as jnp
+
+    c = config
+    _validate(c)
+    constrain = _make_constrain(mesh)
+    W = _pool_block_size(pool)
+    t_eff = table.shape[1] * W
+
+    x = _embed_lookup(params["embed"], tok)[:, None, :]
+    if not c.rope:
+        x = x + params["pos"][pos][:, None, :]  # (B, 1, d): per-row
+    x = constrain("hidden", x)
+    slots = jnp.arange(t_eff)[None, :]  # (1, NW*W)
+    mask = (slots <= pos[:, None])[:, None, None, :]  # (B, 1, 1, NW*W)
+    logits, pool = _run_blocks(
+        params, x, pool, pos, mask, c, constrain,
+        kv_io=_PagedKV(table, W),
+    )
+    return logits[:, 0], pool
+
+
+def make_paged_prefill(config: BurninConfig, mesh, prompt_slots: int,
+                       window: int):
+    """Block-table prefill: returns ``prefill(params, prompt, lens_c,
+    pool, table, first_window) -> (last, pool)`` scanning the padded
+    prompt's W-token windows ``[first_window, prompt_slots/W)``, each
+    window writing its KV into block ``table[:, i]`` and attending over
+    the table-gathered pool.
+
+    This is `decode._build_prefill_suffix` re-aimed at the pool: the
+    windows before ``first_window`` are sliced out of the trace (STATIC
+    index — a bounded executable family, one member per suffix window
+    count; see the suffix builder's docstring for why a traced skip was
+    measured and rejected), but the resident prefix is never staged into
+    a scratch cache — the aliased blocks already sit behind the table,
+    so a prefix hit costs ZERO device copies.  ``last`` is each row's
+    logits at its own last real position; the suffix windows are the
+    chunked-prefill discipline (value-exact single-device).  Windows
+    covering only trailing pads write garbage into the row's own decode
+    blocks (overwritten by decode before the mask can reach them — the
+    row engine's overwrite-before-attend discipline) or into scratch."""
+    import jax
+    import jax.numpy as jnp
+
+    c = config
+    _check_prefix_window(c, prompt_slots, window)
+    W = window
+    nwin = prompt_slots // W
+    constrain = _make_constrain(mesh)
+
+    def prefill(params, prompt, lens_c, pool, table, first_window=0):
+        if not 0 <= first_window < nwin:
+            raise ValueError(
+                f"first_window must be in [0, {nwin}), got {first_window}"
+            )
+        t_eff = table.shape[1] * W
+        kv = _PagedKV(table, W)
+        windows = prompt.reshape(
+            prompt.shape[0], nwin, W
+        ).transpose(1, 0, 2)[first_window:]
+
+        def one_window(carry, xs):
+            pool, last = carry
+            window_toks, i = xs
+            p0 = i * W
+            x = _embed_lookup(params["embed"], window_toks)
+            if not c.rope:
+                pos_emb = jax.lax.dynamic_slice_in_dim(
+                    params["pos"], p0, W, axis=0
+                )
+                x = x + pos_emb[None, :, :]
+            x = constrain("hidden", x)
+            valid = (
+                jnp.arange(t_eff)[None, :]
+                <= p0 + jnp.arange(W)[:, None]
+            )  # (W, NW*W)
+            logits, pool = _run_blocks(
+                params, x, pool, p0, valid[None, None], c, constrain,
+                kv_io=kv,
+            )
+            off = lens_c - 1 - p0  # last real pos, window-relative
+            cand = jnp.take_along_axis(
+                logits, jnp.clip(off, 0, W - 1)[:, None, None], axis=1
+            )[:, 0]
+            hit = (off >= 0) & (off < W)
+            return (pool, jnp.where(hit[:, None], cand, last)), None
+
+        seed = jnp.zeros((prompt.shape[0], c.vocab), jnp.float32)
+        (pool, last), _ = jax.lax.scan(
+            one_window,
+            (pool, seed),
+            (windows, jnp.arange(first_window, nwin, dtype=jnp.int32)),
+        )
+        return last, pool
+
+    return prefill
+
+
+def copy_block(pool, dst, src):
+    """Copy physical block ``src`` into block ``dst`` (every layer, both
+    storage formats; ``dst``/``src`` may be traced — one executable for
+    any pair).  This is the COW primitive: the engine copies the partial
+    last prompt block a parked radix entry shares with its live request,
+    so the request's decode writes land in a private block and shared
+    blocks stay immutable."""
+    import jax
+
+    def leaf(b):
+        seg = jax.lax.dynamic_slice_in_dim(b, src, 1, axis=1)
+        return jax.lax.dynamic_update_slice_in_dim(b, seg, dst, axis=1)
+
+    return jax.tree_util.tree_map(leaf, pool)
+
+
+class BlockAllocator:
+    """Host-side free list + per-block refcounts over a device block
+    pool.  Pure bookkeeping — owns no device memory and never imports
+    jax, so the radix cache and tests can exercise admission accounting
+    without a backend.
+
+    Block 0 is the SCRATCH block: never handed out, permanently
+    referenced — freed table rows are zeroed onto it so frozen in-flight
+    writes of finished engine rows can never reach a reallocated block.
+
+    Reference semantics: ``alloc`` hands out blocks at refcount 1 (the
+    caller's table cell); ``ref`` adds an owner (a radix entry aliasing
+    the block, or a second request's table cell); ``unref`` drops one and
+    returns the block to the free list at zero.  A block with refcount
+    >= 2 is shared and must never be written (the engine's COW rule)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"allocator needs >= 2 blocks (block 0 is scratch), "
+                f"got {num_blocks}"
+            )
+        self.num_blocks = num_blocks
+        self._ref = [0] * num_blocks
+        self._ref[0] = 1  # scratch: immortal, never in the free list
+        # LIFO free list, low ids first out — keeps tests deterministic.
+        self._free = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated_count(self) -> int:
+        """Blocks currently owned by at least one table cell or entry
+        (scratch excluded)."""
+        return self.num_blocks - 1 - len(self._free)
+
+    @property
+    def aliased_count(self) -> int:
+        """Blocks with more than one owner — the shared (immutable)
+        fraction of the pool."""
+        return sum(1 for r in self._ref[1:] if r >= 2)
+
+    def refcount(self, block: int) -> int:
+        return self._ref[block]
+
+    def alloc(self, n: int) -> "list[int] | None":
+        """``n`` fresh blocks at refcount 1, or None (and no allocation)
+        when fewer than ``n`` are free — all-or-nothing, so a partial
+        admission can never strand half its blocks."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        return out
+
+    def ref(self, blocks) -> None:
+        for b in blocks:
+            if b == 0 or self._ref[b] <= 0:
+                raise RuntimeError(
+                    f"ref of unowned block {b} (scratch or free)"
+                )
+        for b in blocks:
+            self._ref[b] += 1
+
+    def unref(self, blocks) -> None:
+        for b in blocks:
+            if b == 0 or self._ref[b] <= 0:
+                raise RuntimeError(
+                    f"unref of unowned block {b} (scratch or free)"
+                )
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
+
+    def stats(self) -> "dict[str, int]":
+        return {
+            "blocks_total": self.num_blocks,
+            "blocks_free": self.free_count,
+            "blocks_allocated": self.allocated_count,
+            "blocks_aliased": self.aliased_count,
+        }

@@ -14,24 +14,33 @@ RadixAttention, adapted to XLA's fixed-shape compilation constraint):
   segment of length k serves ANY request sharing its first m <= k tokens
   at length m, including requests that diverge mid-edge (the
   shared-system-prompt pattern: terminals differ, the shared run matches).
-- **Bounded device pool**: one batched KV cache (`decode.init_cache` at
-  ``B = pool_slots``) whose rows hold B=1 prefix segments.  The pool is
-  the only device memory this cache owns; everything else is host-side
-  bookkeeping, so capacity is a single knob.
 - **LRU + refcount eviction**: admission pins (refcounts) the entries it
   reads and writes for as long as the row is mid-decode, so an actively
   shared prefix can never be evicted under pressure; among unpinned
   entries the least recently used slot is recycled.
 
-The device half lives in `decode.py`: `copy_prefix_into_row` (one
-executable for any (row, length) hit) and `_build_prefill_suffix` (the
-windowed suffix prefill whose STATIC first-window index slices the
-resident windows out of the trace — a bounded executable family, one
-member per suffix window count; see its docstring for why a traced
-``lax.cond`` skip was measured and rejected).  `serve.ServeEngine`
-wires the two halves together at admission; greedy outputs are token-identical with the cache on vs off
-(the engine's exactness contract — pinned by
-``tests/test_serve_prefix.py``).
+Two storage backends share the index (`_RadixIndex`):
+
+- **`PrefixCache`** — the row-backed form: a bounded device pool (ONE
+  `decode.init_cache` at ``B = pool_slots``) whose rows hold B=1 prefix
+  segments; a hit is a device COPY into the admitted row
+  (`decode.copy_prefix_into_row`).  Kept as the MoE-serving and A/B
+  baseline layout (``ServeEngine(kv_layout="rows")``).
+- **`PagedPrefixCache`** — the paged form (docs/SERVING.md "Paged KV
+  pool"): entries hold refcounted BLOCK-ID LISTS into the engine's
+  single block pool (`paged.BlockAllocator`) instead of owning any
+  device memory.  A hit is a zero-copy ALIAS: the matching window-
+  aligned blocks are written into the new request's block table with a
+  refcount each.  Eviction drops the entry's references — blocks return
+  to the free list only when no live table still points at them — and
+  is triggered both by the resident-entry cap and by the engine's
+  block-demand admission control (`evict_one`).
+
+The device halves live in `decode.py` (row copy + suffix prefill) and
+`paged.py` (block-table gather/scatter attention, COW block copy).
+Greedy outputs are token-identical with the cache on vs off, and paged
+vs row-backed (the engine's exactness contract — pinned by
+``tests/test_serve_prefix.py`` and ``tests/test_paged.py``).
 
 Hit/miss/eviction counts move both per-instance fields (bench/test
 readback) and the process-global Prometheus counters
@@ -49,7 +58,7 @@ from tpu_dra.utils.metrics import (
     SERVE_PREFIX_MISSES,
 )
 
-__all__ = ["PrefixCache", "PrefixEntry"]
+__all__ = ["PagedPrefixCache", "PrefixCache", "PrefixEntry"]
 
 
 class _Node:
@@ -70,12 +79,15 @@ class _Node:
 
 @dataclass
 class PrefixEntry:
-    """A resident prefix segment: pool row ``slot`` holds valid KV for
-    cache positions ``[0, length)``.  ``refcount > 0`` pins the entry
-    against eviction (held by every engine row whose admission read or
-    wrote it, released when the request finishes).  ``hits`` counts
-    lookups this entry served — the hotness signal the warm-restart
-    checkpoint (export_index) ranks by."""
+    """A resident prefix segment: valid KV for cache positions
+    ``[0, length)``, stored either in pool row ``slot`` (row-backed) or
+    in the block-id list ``blocks`` (paged — ``slot`` is -1 and each
+    listed block carries one allocator reference held by this entry).
+    ``refcount > 0`` pins the entry against eviction (held by every
+    engine row whose admission read or wrote it, released when the
+    request finishes).  ``hits`` counts lookups this entry served — the
+    hotness signal the warm-restart checkpoint (export_index) ranks
+    by."""
 
     slot: int
     length: int
@@ -83,35 +95,16 @@ class PrefixEntry:
     last_used: int = 0
     hits: int = 0
     node: "_Node | None" = field(default=None, repr=False)
+    blocks: "list[int] | None" = None
 
 
-class PrefixCache:
-    """Host-side index + bounded device pool of shared prompt prefixes.
+class _RadixIndex:
+    """The storage-agnostic radix index: walk/match/peek semantics, the
+    pin lifecycle, LRU victim selection, tree surgery, and the
+    warm-restart export.  Subclasses own storage: slot allocation for
+    the row pool, block references for the paged pool."""
 
-    The cache never touches ``params`` and never computes: it stores what
-    admissions already computed and hands back (entry, usable length)
-    pairs.  The caller owns the device copies (`decode.copy_prefix_into_row`
-    against ``self.pool``) and the pin lifecycle (`acquire`/`release`).
-    """
-
-    def __init__(self, config, pool_slots: int, *, kv_int8: bool = False,
-                 mesh=None):
-        from tpu_dra.parallel.decode import init_cache
-
-        if pool_slots < 1:
-            raise ValueError(
-                f"prefix pool needs at least one slot, got {pool_slots}"
-            )
-        self.config = config
-        self.pool_slots = pool_slots
-        # The pool IS a KV cache — rows are B=1 segments, so the storage
-        # format (and the int8 option) is exactly the engine cache's.
-        # On a mesh its placement is left to GSPMD through the engine's
-        # copy jits (B=1 row traffic is tiny next to the engine cache;
-        # pinning a pool layout would only constrain the copies).
-        del mesh
-        self.pool = init_cache(config, pool_slots, kv_int8)
-        self._free: "list[int]" = list(range(pool_slots))
+    def __init__(self):
         self._root = _Node([], None)
         self._entries: "list[PrefixEntry]" = []
         self._tick = 0
@@ -207,9 +200,9 @@ class PrefixCache:
         """`match` as a pure question: the usable resident-prefix length
         of ``tokens`` (0 when it would miss) WITHOUT moving hit/miss
         counters, hotness, or recency.  The fleet router's staleness
-        probe: placement verifies a digest-promised prefix against the
-        live index here, and a verify must not inflate the stats or
-        re-warm an entry the engine never used."""
+        probe — and the paged engine's admission-control estimator (the
+        block demand a hit would save must be known before deciding the
+        request fits)."""
         node, matched = self._walk(tokens)
         use = min(matched, len(tokens) - 1)
         if use <= 0:
@@ -231,17 +224,12 @@ class PrefixCache:
             raise RuntimeError("release without matching acquire")
         entry.refcount -= 1
 
-    # -- insertion / eviction --------------------------------------------
-    def _evict_lru(self) -> "int | None":
+    # -- eviction / tree surgery -----------------------------------------
+    def _pick_victim(self) -> "PrefixEntry | None":
         victims = [e for e in self._entries if e.refcount == 0]
         if not victims:
             return None
-        victim = min(victims, key=lambda e: e.last_used)
-        self._detach(victim)
-        self.evictions += 1
-        self.epoch += 1
-        SERVE_PREFIX_EVICTIONS.inc()
-        return victim.slot
+        return min(victims, key=lambda e: e.last_used)
 
     def _detach(self, entry: PrefixEntry) -> None:
         node = entry.node
@@ -260,50 +248,38 @@ class PrefixCache:
             del parent.children[node.edge[0]]
             node = parent
 
-    def insert(self, tokens: "list[int]") -> "PrefixEntry | None":
-        """Index ``tokens`` as a resident prefix and return its entry,
-        pre-pinned (``refcount == 1`` — the admitting row holds it until
-        the request finishes; callers must `release`).  Allocates a pool
-        slot, evicting the LRU unpinned entry when full; returns ``None``
-        (and stores nothing) when every slot is pinned by mid-decode rows
-        — the pool is a bound, not a promise.  The caller then copies the
-        prompt's B=1 KV into ``entry.slot`` via `copy_prefix_into_row`."""
-        if not tokens:
-            raise ValueError("cannot index an empty prefix")
+    # -- insertion helpers -----------------------------------------------
+    def _exact_resident(self, tokens: "list[int]") -> "PrefixEntry | None":
+        """The entry indexing EXACTLY ``tokens``, if resident (callers
+        normally skip duplicates via matched_raw, but a capped match can
+        land here when the terminal's own run was what matched)."""
         node, depth = self._walk(tokens)
         if (
             depth == len(tokens)
             and depth == self._node_depth(node)
             and node.entry is not None
         ):
-            # The exact prefix is already resident (callers normally skip
-            # this via matched_raw, but a capped match can land here when
-            # the terminal's own row was what matched): keep the existing
-            # row — checked BEFORE allocating a slot, so a duplicate
-            # insert into a full pool never evicts an innocent entry.
-            self.acquire(node.entry)
             return node.entry
-        if self._free:
-            slot = self._free.pop()
-        else:
-            slot = self._evict_lru()
-            if slot is None:
-                return None
-            # Eviction prunes empty branches, which can detach the node
-            # the pre-eviction walk returned — re-walk against the
-            # post-prune tree.
-            node, depth = self._walk(tokens)
+        return None
+
+    def _attach(self, tokens: "list[int]") -> "_Node":
+        """Build (or reuse) the terminal node for ``tokens``, splitting
+        edges as needed.  Walks the CURRENT tree — callers re-invoke
+        after any eviction, since pruning can detach nodes an earlier
+        walk returned."""
+        node, depth = self._walk(tokens)
         if depth < self._node_depth(node):
             node = self._split(node, depth)
         if depth < len(tokens):
             child = _Node(list(tokens[depth:]), node)
             node.children[tokens[depth]] = child
             node = child
+        return node
+
+    def _register(self, entry: PrefixEntry, node: "_Node") -> PrefixEntry:
         self._tick += 1
-        entry = PrefixEntry(
-            slot=slot, length=len(tokens), refcount=1,
-            last_used=self._tick, node=node,
-        )
+        entry.last_used = self._tick
+        entry.node = node
         node.entry = entry
         self._entries.append(entry)
         self.epoch += 1
@@ -372,3 +348,137 @@ class PrefixCache:
             "pool_slots": self.pool_slots,
             "epoch": self.epoch,
         }
+
+
+class PrefixCache(_RadixIndex):
+    """Row-backed: host index + bounded device pool of shared prompt
+    prefixes (B=1 segments in one batched KV cache).
+
+    The cache never touches ``params`` and never computes: it stores what
+    admissions already computed and hands back (entry, usable length)
+    pairs.  The caller owns the device copies (`decode.copy_prefix_into_row`
+    against ``self.pool``) and the pin lifecycle (`acquire`/`release`).
+    """
+
+    def __init__(self, config, pool_slots: int, *, kv_int8: bool = False,
+                 mesh=None):
+        from tpu_dra.parallel.decode import init_cache
+
+        if pool_slots < 1:
+            raise ValueError(
+                f"prefix pool needs at least one slot, got {pool_slots}"
+            )
+        super().__init__()
+        self.config = config
+        self.pool_slots = pool_slots
+        # The pool IS a KV cache — rows are B=1 segments, so the storage
+        # format (and the int8 option) is exactly the engine cache's.
+        # On a mesh its placement is left to GSPMD through the engine's
+        # copy jits (B=1 row traffic is tiny next to the engine cache;
+        # pinning a pool layout would only constrain the copies).
+        del mesh
+        self.pool = init_cache(config, pool_slots, kv_int8)
+        self._free: "list[int]" = list(range(pool_slots))
+
+    def _evict_lru(self) -> "int | None":
+        victim = self._pick_victim()
+        if victim is None:
+            return None
+        self._detach(victim)
+        self.evictions += 1
+        self.epoch += 1
+        SERVE_PREFIX_EVICTIONS.inc()
+        return victim.slot
+
+    def insert(self, tokens: "list[int]") -> "PrefixEntry | None":
+        """Index ``tokens`` as a resident prefix and return its entry,
+        pre-pinned (``refcount == 1`` — the admitting row holds it until
+        the request finishes; callers must `release`).  Allocates a pool
+        slot, evicting the LRU unpinned entry when full; returns ``None``
+        (and stores nothing) when every slot is pinned by mid-decode rows
+        — the pool is a bound, not a promise.  The caller then copies the
+        prompt's B=1 KV into ``entry.slot`` via `copy_prefix_into_row`."""
+        if not tokens:
+            raise ValueError("cannot index an empty prefix")
+        existing = self._exact_resident(tokens)
+        if existing is not None:
+            # The exact prefix is already resident: keep the existing row
+            # — checked BEFORE allocating a slot, so a duplicate insert
+            # into a full pool never evicts an innocent entry.
+            self.acquire(existing)
+            return existing
+        if self._free:
+            slot = self._free.pop()
+        else:
+            slot = self._evict_lru()
+            if slot is None:
+                return None
+        # Eviction prunes empty branches, which can detach the node a
+        # pre-eviction walk returned — _attach walks the current tree.
+        node = self._attach(tokens)
+        entry = PrefixEntry(slot=slot, length=len(tokens), refcount=1)
+        return self._register(entry, node)
+
+
+class PagedPrefixCache(_RadixIndex):
+    """Paged: the radix index over BLOCK-BACKED entries.  Owns no device
+    memory — each entry holds a list of block ids into the engine's
+    block pool, one `paged.BlockAllocator` reference per block.  A hit
+    is an alias (the engine refs the window-aligned prefix blocks into
+    the new request's table — zero device copies); parking a prompt is
+    free (the entry refs the blocks the admission just wrote).
+
+    ``max_entries`` caps the RESIDENT ENTRY count (the knob the engine's
+    ``prefix_cache_slots`` maps to); the real storage bound is the block
+    pool, enforced by the engine's admission control via `evict_one`."""
+
+    def __init__(self, max_entries: int, allocator):
+        if max_entries < 1:
+            raise ValueError(
+                f"prefix pool needs at least one slot, got {max_entries}"
+            )
+        super().__init__()
+        self.pool_slots = max_entries
+        self._alloc = allocator
+
+    def evict_one(self) -> bool:
+        """Evict the LRU unpinned entry, dropping its block references
+        (blocks free only when no live table still points at them).
+        False when every entry is pinned by mid-decode rows — the
+        engine's admission control then parks the request instead of
+        corrupting a pinned prefix."""
+        victim = self._pick_victim()
+        if victim is None:
+            return False
+        blocks = victim.blocks or []
+        victim.blocks = None
+        self._detach(victim)
+        self._alloc.unref(blocks)
+        self.evictions += 1
+        self.epoch += 1
+        SERVE_PREFIX_EVICTIONS.inc()
+        return True
+
+    def insert(self, tokens: "list[int]",
+               blocks: "list[int]") -> "PrefixEntry | None":
+        """Index ``tokens`` as a resident prefix backed by ``blocks``
+        (the admission's prompt blocks, ``ceil(len(tokens) / W)`` of
+        them — the entry takes one allocator reference per block, the
+        caller keeps its own).  Pre-pinned like the row form; returns
+        the EXISTING entry (blocks untouched) when the exact run is
+        already resident, and ``None`` when the entry cap is reached
+        with every resident entry pinned."""
+        if not tokens:
+            raise ValueError("cannot index an empty prefix")
+        existing = self._exact_resident(tokens)
+        if existing is not None:
+            self.acquire(existing)
+            return existing
+        if len(self._entries) >= self.pool_slots and not self.evict_one():
+            return None
+        self._alloc.ref(blocks)
+        node = self._attach(tokens)
+        entry = PrefixEntry(
+            slot=-1, length=len(tokens), refcount=1, blocks=list(blocks)
+        )
+        return self._register(entry, node)

@@ -25,26 +25,45 @@ Three compiled functions, none ever retraced:
 - ``step``:    `decode_step_rows` — every row at its OWN position
   (slot == sequence position), one token for all rows per call.
 
+Those are the ``kv_layout="rows"`` executables.  The DEFAULT layout for
+dense configs is ``kv_layout="paged"`` (docs/SERVING.md "Paged KV
+pool"): one block-granular device pool (`paged.init_block_pool`, block
+size = the prefix window) addressed through per-request ``(slots, NW)``
+block tables, so each request holds only the blocks its own context
+needs — occupancy is bounded by actual block demand, not by slots ×
+engine-max context.  Three compiled functions replace prefill1/insert:
+
+- ``paged prefill`` (`paged.make_paged_prefill`): the padded prompt's
+  suffix windows written straight into table-addressed blocks (static
+  first-window index, the same bounded executable family as the suffix
+  prefill) — no B=1 staging cache, no insert.
+- ``paged step`` (`paged.paged_decode_step_rows`): per-row positions
+  through the table gather — one executable for ANY table contents.
+- ``copy_block``: the COW primitive (see below).
+
 With ``prefix_cache_slots > 0`` admission grows an automatic shared
--prefix cache (`prefixcache.PrefixCache` — host radix index over admitted
-token runs + a bounded device pool of B=1 KV segments, LRU + refcount
-eviction) and two more compiled functions:
+-prefix cache (host radix index over admitted token runs, LRU + refcount
+eviction — `prefixcache`).  Row layout: a hit is a device COPY
+(`decode.copy_prefix_into_row` fused with `decode._build_prefill_suffix`
+as ``admit_hit``; ``pool_write`` parks the prompt) from a separate
+B=pool_slots device pool.  Paged layout: entries hold refcounted block
+lists into THE pool, a hit ALIASES the window-aligned prefix blocks
+into the new table (zero device copies, O(1) admission), parking is
+free (the entry refs the blocks admission just wrote), and the one
+block a parked entry shares writably with its live request — the
+partial last prompt block — is privatized by an eager COW copy, so
+shared blocks are never written.  Free-block accounting doubles as real
+admission control: the FIFO head admits only when its worst-case block
+demand (minus the alias credit) fits, evicting unpinned LRU entries
+under pressure and PARKING in the queue when every block is pinned by
+mid-decode rows.
 
-- ``admit_hit``: `decode.copy_prefix_into_row` (traced pool row + traced
-  hit length — one trace for any hit) fused with
-  `decode._build_prefill_suffix` — the longest resident prefix is copied
-  instead of recomputed and only the SUFFIX windows run (the resident
-  windows are sliced out of the trace by a static first-window index: a
-  family bounded by prompt_slots/prefix_window executables, filled
-  lazily), so admission cost drops from O(prompt_len) to O(suffix_len)
-  for hot prefixes — the shared-system-prompt workload's TTFT lever.
-- ``pool_write``: the same copy executable pointed the other way, parking
-  the admitted prompt's KV in the pool for future admissions.
-
-The determinism contracts below hold with the cache ON or OFF (greedy
-outputs are token-identical either way — copied KV equals recomputed KV,
-and the suffix windows are the chunked-prefill discipline, value-exact
-single-device; pinned by ``tests/test_serve_prefix.py``).
+The determinism contracts below hold with the cache ON or OFF and with
+either layout (greedy outputs are token-identical — copied/aliased KV
+equals recomputed KV, the suffix windows are the chunked-prefill
+discipline, and the paged gather only reorders storage while masked
+tail positions add exact-zero softmax terms; pinned by
+``tests/test_serve_prefix.py`` and ``tests/test_paged.py``).
 
 Inactive rows keep stepping (XLA has no ragged batch) with a frozen
 position: their writes land on one stale slot that is either overwritten
@@ -93,6 +112,8 @@ import time
 import weakref
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from tpu_dra.parallel.burnin import BurninConfig
 from tpu_dra.parallel.decode import (
     _build_prefill_padded,
@@ -107,10 +128,21 @@ from tpu_dra.parallel.decode import (
     decode_step_rows,
     init_cache,
 )
-from tpu_dra.parallel.prefixcache import PrefixCache
+from tpu_dra.parallel.paged import (
+    BlockAllocator,
+    block_pool_spec,
+    copy_block,
+    init_block_pool,
+    make_paged_prefill,
+    paged_decode_step_rows,
+)
+from tpu_dra.parallel.prefixcache import PagedPrefixCache, PrefixCache
 from tpu_dra.utils import servestats, trace
 from tpu_dra.utils.metrics import (
     SERVE_BATCH_OCCUPANCY,
+    SERVE_KV_ALIAS,
+    SERVE_KV_BLOCKS,
+    SERVE_KV_COW,
     SERVE_PREFILL_TOKENS,
     SERVE_QUEUE_DEPTH,
     SERVE_QUEUE_WAIT_SECONDS,
@@ -168,6 +200,11 @@ class Request:
     # included; 0.0 until the first token lands).
     use_prefix_cache: bool = True
     prefix_reused: int = 0
+    # Paged engines: KV blocks this request's block table held while
+    # mid-decode (aliased prefix blocks included) — the per-request
+    # footprint the bench's kv_blocks_per_req percentiles report.  0 on
+    # row-layout engines.
+    kv_blocks: int = 0
     submitted_at: float = 0.0
     ttft_s: float = 0.0
     # The engine that served this request (ServeEngine.name, stamped at
@@ -212,18 +249,35 @@ class ServeEngine:
     one device call per `tick` (finish reactions lag by at most that
     many tokens).
 
-    ``prefix_cache_slots``: rows in the automatic shared-prefix KV pool
-    (0 = off, the default — admission behavior and memory are exactly the
-    pre-cache engine's).  When on, each admission reuses the longest
-    resident prefix of its prompt (device copy + suffix-only prefill) and
-    parks its own prompt's KV for future admissions; greedy outputs stay
-    token-identical to the cache-off engine and sampled outputs stay
-    scheduling-invariant.  Dense configs only (a windowed MoE prefill
-    would re-route capacity queues — rejected at build, like
+    ``kv_layout``: ``"paged"`` (default for dense configs) stores KV in
+    one block-granular device pool addressed through per-request block
+    tables — per-request context length, block-demand admission control,
+    zero-copy prefix aliasing; ``"rows"`` is the legacy per-request
+    -full-row layout (the MoE-serving path — paged prefill is windowed,
+    which would re-route MoE capacity queues — and the A/B baseline the
+    bench compares against).  ``kv_blocks``: total blocks in the paged
+    pool, scratch block included (default: every slot can hold a
+    worst-case request plus, when the prefix cache is on, headroom for
+    the cached entries' prompt blocks and one COW block per slot —
+    ``slots * ceil((prompt_slots + max_new_cap) / W) + 1 +
+    prefix_cache_slots * prompt_slots / W + slots``); must cover at
+    least one worst-case request.  Greedy outputs are token-identical across
+    layouts (pinned by ``tests/test_paged.py``).
+
+    ``prefix_cache_slots``: resident entries in the automatic shared
+    -prefix cache (0 = off, the default — admission behavior and memory
+    are exactly the pre-cache engine's).  When on, each admission reuses
+    the longest resident prefix of its prompt (paged: block aliases into
+    the table, zero device copies; rows: device copy + suffix-only
+    prefill) and parks its own prompt's KV for future admissions; greedy
+    outputs stay token-identical to the cache-off engine and sampled
+    outputs stay scheduling-invariant.  Dense configs only (a windowed
+    MoE prefill would re-route capacity queues — rejected at build, like
     ``prefill_chunk``).  ``prefix_window``: suffix-prefill window width
-    (must divide ``prompt_slots``; default ``prefill_chunk`` when set,
-    else ~``prompt_slots/4`` rounded to a divisor) — the granularity at
-    which resident windows are skipped.
+    AND the paged block size (must divide ``prompt_slots``; default
+    ``prefill_chunk`` when set, else ~``prompt_slots/4`` rounded to a
+    divisor) — the granularity at which resident windows are skipped or
+    aliased.
 
     ``ttft_slo_s`` / ``tpot_slo_s``: optional latency targets; every
     finished request gets met/missed verdicts (``Request.slo``, the
@@ -253,6 +307,8 @@ class ServeEngine:
         with_logprobs: bool = False,
         prefill_chunk: "int | None" = None,
         kv_int8: bool = False,
+        kv_layout: "str | None" = None,
+        kv_blocks: "int | None" = None,
         prefix_cache_slots: int = 0,
         prefix_window: "int | None" = None,
         ttft_slo_s: "float | None" = None,
@@ -280,6 +336,55 @@ class ServeEngine:
         for knob, value in (("ttft_slo_s", ttft_slo_s), ("tpot_slo_s", tpot_slo_s)):
             if value is not None and not value > 0:
                 raise ValueError(f"{knob} must be > 0, got {value}")
+        if kv_layout is None:
+            # Paged is the default the moment the config supports it:
+            # MoE serves on rows because the paged prefill is inherently
+            # windowed, and per-window capacity queues would re-route
+            # tokens vs the one-shot oracle (the prefill_chunk invariant).
+            kv_layout = "rows" if c.moe_experts > 0 else "paged"
+        if kv_layout not in ("paged", "rows"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'rows', got {kv_layout!r}"
+            )
+        if kv_layout == "paged" and c.moe_experts > 0:
+            raise ValueError(
+                "kv_layout='paged' is not supported with moe_experts > 0: "
+                "the block-table prefill runs in windows, which would "
+                "restart the per-expert capacity queues and diverge from "
+                "the one-shot routing (serve MoE with kv_layout='rows')"
+            )
+        if kv_blocks is not None and kv_layout != "paged":
+            raise ValueError("kv_blocks only applies to kv_layout='paged'")
+        self._kv_layout = kv_layout
+
+        # The suffix-window width doubles as the paged block size, so it
+        # is derived whenever EITHER consumer needs it.
+        w = None
+        if kv_layout == "paged" or prefix_cache_slots > 0:
+            if prefix_window is not None:
+                w = prefix_window
+            elif prefill_chunk is not None:
+                w = prefill_chunk
+            else:
+                # Skip granularity ~ a quarter prompt: coarse enough that
+                # a hit runs few scan passes (and the static-window
+                # executable family stays small), fine enough that the
+                # first running window wastes little pre-split recompute.
+                cap = max(1, prompt_slots // 4)
+                w = max(
+                    d for d in range(1, cap + 1) if prompt_slots % d == 0
+                )
+            _check_prefix_window(c, prompt_slots, w)
+        if (
+            kv_layout == "paged"
+            and prefill_chunk is not None
+            and prefill_chunk != w
+        ):
+            raise ValueError(
+                f"paged prefill runs on the block grid: prefill_chunk "
+                f"({prefill_chunk}) must equal the block size ({w}) or "
+                f"be left unset"
+            )
         self.config = c
         self.params = params
         self.slots = slots
@@ -291,27 +396,77 @@ class ServeEngine:
         self.with_logprobs = with_logprobs
         self.mesh = mesh
 
-        self._cache = init_cache(c, slots, kv_int8)
-        cache_sh = None
-        if mesh is not None:
-            # ONE cache-sharding tree, used for both the init-time layout
-            # and the jit out_shardings pin below — the two must agree by
-            # construction or the pin would fight the placement.
-            from jax.sharding import NamedSharding
+        cache_sh = pool_sh = None
+        if kv_layout == "rows":
+            self._cache = init_cache(c, slots, kv_int8)
+            if mesh is not None:
+                # ONE cache-sharding tree, used for both the init-time
+                # layout and the jit out_shardings pin below — the two
+                # must agree by construction or the pin would fight the
+                # placement.
+                from jax.sharding import NamedSharding
 
-            from tpu_dra.parallel.decode import cache_spec
+                from tpu_dra.parallel.decode import cache_spec
 
-            leaf = cache_spec(c, kv_int8)
-            cache_sh = jax.tree_util.tree_map(
-                lambda s: NamedSharding(mesh, s), {"k": leaf, "v": leaf}
+                leaf = cache_spec(c, kv_int8)
+                cache_sh = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(mesh, s), {"k": leaf, "v": leaf}
+                )
+                # Lay the engine cache out per the serving spec (batch
+                # over data x fsdp, heads over model) so the jitted step
+                # inherits the sharded layout instead of replicating the
+                # dominant tensor.
+                self._cache = jax.tree_util.tree_map(
+                    jax.device_put, self._cache, cache_sh
+                )
+        else:
+            self._block_size = w
+            # Static table width: enough columns for the longest legal
+            # request (prompt_slots + max_new_cap).  Shorter requests
+            # leave trailing columns at 0 — the scratch block, where pad
+            # -window and frozen-row writes land and masked reads don't
+            # matter.
+            self._table_cols = -(-(prompt_slots + max_new_cap) // w)
+            # Default: every slot can hold a worst-case request, plus —
+            # when the prefix cache is on — headroom for the cached
+            # entries' prompt blocks and one COW block per slot.  A COW
+            # block only ever exists with a cache (it privatizes the
+            # block a parked entry shares).
+            cache_extra = (
+                prefix_cache_slots * (prompt_slots // w) + slots
+                if prefix_cache_slots > 0
+                else 0
             )
-            # Lay the engine cache out per the serving spec (batch over
-            # data x fsdp, heads over model) so the jitted step inherits
-            # the sharded layout instead of replicating the dominant
-            # tensor.
-            self._cache = jax.tree_util.tree_map(
-                jax.device_put, self._cache, cache_sh
+            nb = (
+                kv_blocks
+                if kv_blocks is not None
+                else slots * self._table_cols + 1 + cache_extra
             )
+            # Floor: one worst-case request (its table columns, a COW
+            # block when a cache could park it) + scratch — below this
+            # some legal submit could never admit, and run() would spin
+            # to its tick bound.
+            floor = self._table_cols + 1 + (1 if prefix_cache_slots else 0)
+            if nb < floor:
+                raise ValueError(
+                    f"kv_blocks must be >= {floor} (one worst-case "
+                    f"request + scratch), got {nb}"
+                )
+            self._balloc = BlockAllocator(nb)
+            self._pool = init_block_pool(c, nb, w, kv_int8)
+            self._table = np.zeros((slots, self._table_cols), np.int32)
+            self._kv_counts = {"alias_blocks": 0, "cow_blocks": 0,
+                               "alloc_blocks": 0}
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+
+                leaf = block_pool_spec(c, kv_int8)
+                pool_sh = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(mesh, s), {"k": leaf, "v": leaf}
+                )
+                self._pool = jax.tree_util.tree_map(
+                    jax.device_put, self._pool, pool_sh
+                )
         self._kv_int8 = kv_int8
         # Host-side row state: which request, its position (== number of
         # valid tokens in the row), its remaining budget.
@@ -356,76 +511,91 @@ class ServeEngine:
             ),
             engine=self.name,
         )
-
-        # Admission prefill: the shared padded window loop (one-shot when
-        # prefill_chunk is None) at B=1, so long prompts admit under the
-        # same bounded-activation budget the generate factories offer.
-        _prefill_one = _build_prefill_padded(c, mesh, prompt_slots, prefill_chunk)
-
-        def prefill1(params, prompt, length):
-            cache1 = init_cache(c, 1, kv_int8)
-            last, cache1 = _prefill_one(params, prompt, length[None], cache1)
-            return cache1, last
-
-        def insert(cache, cache1, row):
-            return jax.tree_util.tree_map(
-                lambda big, one: jax.lax.dynamic_update_slice_in_dim(
-                    big, one, row, axis=1
-                ),
-                cache,
-                cache1,
-            )
-
-        if prefix_cache_slots > 0:
-            if prefix_window is not None:
-                w = prefix_window
-            elif prefill_chunk is not None:
-                w = prefill_chunk
-            else:
-                # Skip granularity ~ a quarter prompt: coarse enough that
-                # a hit runs few scan passes (and the static-window
-                # executable family stays small), fine enough that the
-                # first running window wastes little pre-split recompute.
-                cap = max(1, prompt_slots // 4)
-                w = max(
-                    d for d in range(1, cap + 1) if prompt_slots % d == 0
+        if kv_layout == "paged":
+            # Block-state gauges, one series triple per engine: free is
+            # the admission-control headroom, allocated the live working
+            # set (tables + resident prefix entries), aliased the shared
+            # immutable fraction (docs/OBSERVABILITY.md).
+            for state, sample in (
+                ("free", lambda e: e._balloc.free_count),
+                ("allocated", lambda e: e._balloc.allocated_count),
+                ("aliased", lambda e: e._balloc.aliased_count),
+            ):
+                SERVE_KV_BLOCKS.set_function(
+                    _weak_sampler(ref, sample),
+                    engine=self.name, state=state,
                 )
-            _check_prefix_window(c, prompt_slots, w)
-            self.prefix_window = w
-            self._prefix = PrefixCache(
-                c, prefix_cache_slots, kv_int8=kv_int8, mesh=mesh
-            )
-            _suffix_one = _build_prefill_suffix(c, mesh, prompt_slots, w)
 
-            def admit_hit(params, prompt, length, p0, pool, slot,
-                          first_window):
-                # The hit admission in ONE compiled call: stage the
-                # resident prefix (positions [0, p0) of pool row `slot`)
-                # into a fresh B=1 cache, then run only the suffix
-                # windows on top of it.  slot/p0/length are traced (any
-                # pool row, any copy length); first_window is static —
-                # one executable per suffix window count, a family
-                # bounded by prompt_slots/prefix_window (see
-                # decode._build_prefill_suffix).
+        if kv_layout == "rows":
+            # Admission prefill: the shared padded window loop (one-shot
+            # when prefill_chunk is None) at B=1, so long prompts admit
+            # under the same bounded-activation budget the generate
+            # factories offer.
+            _prefill_one = _build_prefill_padded(
+                c, mesh, prompt_slots, prefill_chunk
+            )
+
+            def prefill1(params, prompt, length):
                 cache1 = init_cache(c, 1, kv_int8)
-                cache1 = copy_prefix_into_row(cache1, 0, pool, slot, p0)
-                last, cache1 = _suffix_one(
-                    params, prompt, length[None], cache1,
-                    first_window=first_window,
+                last, cache1 = _prefill_one(
+                    params, prompt, length[None], cache1
                 )
                 return cache1, last
 
-            def pool_write(pool, cache1, slot, length):
-                return copy_prefix_into_row(pool, slot, cache1, 0, length)
+            def insert(cache, cache1, row):
+                return jax.tree_util.tree_map(
+                    lambda big, one: jax.lax.dynamic_update_slice_in_dim(
+                        big, one, row, axis=1
+                    ),
+                    cache,
+                    cache1,
+                )
 
-            self._admit_hit = jax.jit(admit_hit, static_argnums=(6,))
-            # Donate the pool: the caller immediately rebinds
-            # self._prefix.pool to the result, and without donation XLA
-            # materializes a whole fresh pool (pool_slots full-context
-            # KV rows) just to update one row.  Backends that don't
-            # implement donation (CPU) ignore it and fall back to the
-            # copy — correct either way.
-            self._pool_write = jax.jit(pool_write, donate_argnums=(0,))
+        if prefix_cache_slots > 0:
+            self.prefix_window = w
+            if kv_layout == "paged":
+                # The paged cache owns no device memory: entries are
+                # refcounted block-id lists into THE pool, so parking and
+                # aliasing are host bookkeeping + table writes.
+                self._prefix = PagedPrefixCache(
+                    prefix_cache_slots, self._balloc
+                )
+            else:
+                self._prefix = PrefixCache(
+                    c, prefix_cache_slots, kv_int8=kv_int8, mesh=mesh
+                )
+                _suffix_one = _build_prefill_suffix(c, mesh, prompt_slots, w)
+
+                def admit_hit(params, prompt, length, p0, pool, slot,
+                              first_window):
+                    # The hit admission in ONE compiled call: stage the
+                    # resident prefix (positions [0, p0) of pool row
+                    # `slot`) into a fresh B=1 cache, then run only the
+                    # suffix windows on top of it.  slot/p0/length are
+                    # traced (any pool row, any copy length);
+                    # first_window is static — one executable per suffix
+                    # window count, a family bounded by
+                    # prompt_slots/prefix_window (see
+                    # decode._build_prefill_suffix).
+                    cache1 = init_cache(c, 1, kv_int8)
+                    cache1 = copy_prefix_into_row(cache1, 0, pool, slot, p0)
+                    last, cache1 = _suffix_one(
+                        params, prompt, length[None], cache1,
+                        first_window=first_window,
+                    )
+                    return cache1, last
+
+                def pool_write(pool, cache1, slot, length):
+                    return copy_prefix_into_row(pool, slot, cache1, 0, length)
+
+                self._admit_hit = jax.jit(admit_hit, static_argnums=(6,))
+                # Donate the pool: the caller immediately rebinds
+                # self._prefix.pool to the result, and without donation
+                # XLA materializes a whole fresh pool (pool_slots
+                # full-context KV rows) just to update one row.  Backends
+                # that don't implement donation (CPU) ignore it and fall
+                # back to the copy — correct either way.
+                self._pool_write = jax.jit(pool_write, donate_argnums=(0,))
         else:
             self.prefix_window = None
             self._prefix = None
@@ -460,6 +630,23 @@ class ServeEngine:
 
         self._first_token = jax.jit(first_token)
 
+        def sample_step(logits, tok, pos, active, seeds):
+            # The shared per-step tail of both layouts' device loops:
+            # sample/argmax, logprob, and the inactive-row freeze (token
+            # and position pinned so a frozen row's harmless writes stay
+            # on one stale slot — scratch block 0 in the paged layout).
+            if temperature > 0:
+                nxt = jax.vmap(pick_row)(seeds, pos + 1, logits)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if with_logprobs:
+                lp = _chosen_logprob(logits, nxt)  # raw-model, per row
+            else:
+                lp = jnp.zeros(nxt.shape, jnp.float32)
+            nxt = jnp.where(active, nxt, tok)
+            pos = jnp.where(active, pos + 1, pos)
+            return nxt, pos, lp
+
         def step(params, cache, tok, pos, active, seeds):
             # steps_per_tick tokens for every row in ONE device call; the
             # per-step tokens come back for host-side finish decisions.
@@ -474,18 +661,7 @@ class ServeEngine:
             def one(carry, _):
                 cache, tok, pos = carry
                 logits, cache = decode_step_rows(params, tok, cache, pos, c, mesh)
-                if temperature > 0:
-                    nxt = jax.vmap(pick_row)(seeds, pos + 1, logits)
-                else:
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                if with_logprobs:
-                    lp = _chosen_logprob(logits, nxt)  # raw-model, per row
-                else:
-                    lp = jnp.zeros(nxt.shape, jnp.float32)
-                # Inactive rows freeze: token and position pinned so their
-                # (harmless) writes stay on one stale slot.
-                nxt = jnp.where(active, nxt, tok)
-                pos = jnp.where(active, pos + 1, pos)
+                nxt, pos, lp = sample_step(logits, tok, pos, active, seeds)
                 return (cache, nxt, pos), (nxt, lp)
 
             (cache, tok, pos), (toks, lps) = jax.lax.scan(
@@ -494,29 +670,84 @@ class ServeEngine:
             # toks/lps: (steps_per_tick, B)
             return cache, tok, pos, toks, lps
 
-        # prefill1's B=1 output is tiny and unsharded either way — one
-        # construction for both the single-device and mesh engines (the
-        # sharding discipline lives on the state-threading jits below).
-        self._prefill1 = jax.jit(prefill1)
-        if mesh is None:
-            self._insert = jax.jit(insert)
-            self._step = jax.jit(step)
-        else:
-            # Pin the cache's OUT sharding on every state-threading jit
-            # (the SAME cache_sh tree the init-time device_put used):
-            # GSPMD's chosen output layout need not match the input
-            # placement (decode.make_prefill pins out_shardings for the
-            # same reason), and an unpinned cache would silently drift
-            # from the serving spec after the first tick.  tok/pos/toks
-            # are tiny and stay replicated.
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
+        def step_paged(params, pool, table, tok, pos, active, seeds):
+            # The paged twin: same tick contract, KV addressed through
+            # the snapshot block table.  An overrun row (budget hit mid
+            # -tick, or frozen after finish) writes through a clamped or
+            # zeroed table cell into its own tail block or scratch —
+            # never into another request's blocks, because freed rows'
+            # tables are zeroed before their blocks can be reallocated.
+            def one(carry, _):
+                pool, tok, pos = carry
+                logits, pool = paged_decode_step_rows(
+                    params, tok, pool, table, pos, c, mesh
+                )
+                nxt, pos, lp = sample_step(logits, tok, pos, active, seeds)
+                return (pool, nxt, pos), (nxt, lp)
 
-            rep = NamedSharding(mesh, P())
-            self._insert = jax.jit(insert, out_shardings=cache_sh)
-            self._step = jax.jit(
-                step, out_shardings=(cache_sh, rep, rep, rep, rep)
+            (pool, tok, pos), (toks, lps) = jax.lax.scan(
+                one, (pool, tok, pos), None, length=self.steps_per_tick
             )
+            return pool, tok, pos, toks, lps
+
+        if kv_layout == "paged":
+            _prefill_paged = make_paged_prefill(c, mesh, prompt_slots, w)
+            # Donate the pool through every state-threading jit: the
+            # caller immediately rebinds self._pool, and without donation
+            # XLA would materialize a whole fresh pool per call just to
+            # touch a few blocks.  CPU ignores donation (falls back to
+            # the copy) — correct either way, same discipline as the row
+            # layout's pool_write.
+            if mesh is None:
+                self._paged_prefill = jax.jit(
+                    _prefill_paged, static_argnums=(5,), donate_argnums=(3,)
+                )
+                self._paged_step = jax.jit(step_paged, donate_argnums=(1,))
+                self._copy_block = jax.jit(copy_block, donate_argnums=(0,))
+            else:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                rep = NamedSharding(mesh, P())
+                # Pin the pool's OUT sharding on every jit that threads
+                # it (same reason as the row cache: GSPMD's chosen output
+                # layout need not match the input placement).
+                self._paged_prefill = jax.jit(
+                    _prefill_paged, static_argnums=(5,),
+                    donate_argnums=(3,), out_shardings=(rep, pool_sh),
+                )
+                self._paged_step = jax.jit(
+                    step_paged, donate_argnums=(1,),
+                    out_shardings=(pool_sh, rep, rep, rep, rep),
+                )
+                self._copy_block = jax.jit(
+                    copy_block, donate_argnums=(0,), out_shardings=pool_sh
+                )
+        else:
+            # prefill1's B=1 output is tiny and unsharded either way —
+            # one construction for both the single-device and mesh
+            # engines (the sharding discipline lives on the
+            # state-threading jits below).
+            self._prefill1 = jax.jit(prefill1)
+            if mesh is None:
+                self._insert = jax.jit(insert)
+                self._step = jax.jit(step)
+            else:
+                # Pin the cache's OUT sharding on every state-threading
+                # jit (the SAME cache_sh tree the init-time device_put
+                # used): GSPMD's chosen output layout need not match the
+                # input placement (decode.make_prefill pins out_shardings
+                # for the same reason), and an unpinned cache would
+                # silently drift from the serving spec after the first
+                # tick.  tok/pos/toks are tiny and stay replicated.
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                rep = NamedSharding(mesh, P())
+                self._insert = jax.jit(insert, out_shardings=cache_sh)
+                self._step = jax.jit(
+                    step, out_shardings=(cache_sh, rep, rep, rep, rep)
+                )
 
     # -- submission ------------------------------------------------------
     def submit(self, prompt: "list[int]", max_new: "int | None" = None,
@@ -617,6 +848,141 @@ class ServeEngine:
         return budget, stops
 
     # -- the engine loop -------------------------------------------------
+    def _paged_demand(self, req: Request, use: int) -> "tuple[int, int]":
+        """Worst-case block demand of admitting ``req`` given a usable
+        resident-prefix length ``use``: returns ``(need, total_cols)``.
+        ``need`` counts fresh allocations — total table columns minus the
+        aliased full windows, plus the COW block when the prompt might
+        park with a partial last block (an OVERESTIMATE by one when the
+        exact prompt turns out to be already resident: admission control
+        is allowed to be conservative, never optimistic)."""
+        length = len(req.prompt)
+        w = self._block_size
+        total_cols = -(-(length + req.max_new) // w)
+        fw = use // w
+        cacheable = self._prefix is not None and req.use_prefix_cache
+        cow = 1 if (cacheable and length >= w and length % w) else 0
+        return total_cols - fw + cow, total_cols
+
+    def _ensure_admittable(self, req: Request) -> bool:
+        """Block-demand admission control for the FIFO head: evict LRU
+        unpinned prefix entries until ``req``'s worst-case demand fits
+        the free list, or report False (the request PARKS in the queue —
+        pinned entries and live tables are never touched, so a full pool
+        of mid-decode refcounts delays admission instead of corrupting
+        it).  Re-peeks after every eviction: evicting an entry can
+        shrink the very alias credit the demand was counting on."""
+        if self._kv_layout != "paged":
+            return True
+        while True:
+            use = (
+                self._prefix.peek(req.prompt, min_use=self._block_size)
+                if self._prefix is not None and req.use_prefix_cache
+                else 0
+            )
+            need, _ = self._paged_demand(req, use)
+            if self._balloc.free_count >= need:
+                return True
+            if self._prefix is None or not self._prefix.evict_one():
+                return False
+
+    def _admit_paged(self, req: Request, row: int, prompt, length: int):
+        """One paged admission: match → alias the window-aligned prefix
+        blocks into a fresh table row (zero device copies) → allocate
+        the suffix + decode blocks → block-table suffix prefill → park
+        the prompt's blocks as a radix entry → COW the shared partial
+        last block.  Returns ``(last, pins)``.  The caller ran
+        `_ensure_admittable`, so allocations cannot fail mid-way."""
+        import jax.numpy as jnp
+
+        w = self._block_size
+        cacheable = self._prefix is not None and req.use_prefix_cache
+        entry, m, m_raw = (
+            self._prefix.match(req.prompt, min_use=w)
+            if cacheable
+            else (None, 0, 0)
+        )
+        pins = []
+        total_cols = -(-(length + req.max_new) // w)
+        fw = 0
+        cols: "list[int]" = []
+        if entry is not None:
+            self._prefix.acquire(entry)
+            pins.append(entry)
+            # Alias exactly the window-aligned part of the match: the
+            # first running window recomputes from its grid start, so an
+            # aliased partial window would be overwritten anyway — and
+            # the reused/computed split stays honest (reused = positions
+            # whose compute was actually skipped).
+            fw = m // w
+            cols = list(entry.blocks[:fw])
+            self._balloc.ref(cols)
+            self._kv_counts["alias_blocks"] += fw
+            SERVE_KV_ALIAS.inc(fw, engine=self.name)
+            p0 = fw * w
+            req.prefix_reused = p0
+            self._prefill_tokens["reused"] += p0
+            self._prefill_tokens["computed"] += length - p0
+            SERVE_PREFILL_TOKENS.inc(p0, kind="reused")
+            SERVE_PREFILL_TOKENS.inc(length - p0, kind="computed")
+        else:
+            self._prefill_tokens["computed"] += length
+            SERVE_PREFILL_TOKENS.inc(length, kind="computed")
+        own = self._balloc.alloc(total_cols - fw)
+        if own is None:  # _ensure_admittable holds this invariant
+            raise RuntimeError(
+                "paged admission accounting violated: demand was cleared "
+                "but the allocator came up short"
+            )
+        cols += own
+        self._kv_counts["alloc_blocks"] += len(own)
+        table_row = np.zeros((self._table_cols,), np.int32)
+        table_row[:total_cols] = cols
+        last, self._pool = self._paged_prefill(
+            self.params, prompt, jnp.asarray([length], jnp.int32),
+            self._pool, jnp.asarray(table_row[None, :]), fw,
+        )
+        if (
+            cacheable
+            and m_raw < length
+            and length >= w
+        ):
+            # Park this prompt's blocks for future admissions — unless
+            # the exact prompt is already resident (a duplicate entry
+            # would only waste an index slot) or the prompt is shorter
+            # than one window (a future match could never clear min_use).
+            # Parking is free: the entry just refs the blocks the
+            # prefill above wrote.  insert() returns None when the
+            # resident-entry cap is reached with every entry pinned.
+            prompt_cols = -(-length // w)
+            new_entry = self._prefix.insert(req.prompt, cols[:prompt_cols])
+            if new_entry is not None:
+                pins.append(new_entry)
+                if length % w:
+                    # COW: the partial last prompt block is now shared
+                    # (entry + this table) and the first decode token's
+                    # write into it is certain — privatize it for the
+                    # table eagerly, so shared blocks are NEVER written.
+                    # The entry keeps the original (pristine prompt KV).
+                    lb = prompt_cols - 1
+                    nb = self._balloc.alloc(1)
+                    if nb is None:
+                        raise RuntimeError(
+                            "paged admission accounting violated: no "
+                            "block left for the COW copy"
+                        )
+                    self._pool = self._copy_block(
+                        self._pool, jnp.int32(nb[0]), jnp.int32(cols[lb])
+                    )
+                    self._balloc.unref([cols[lb]])  # table's claim moves
+                    cols[lb] = nb[0]
+                    table_row[lb] = nb[0]
+                    self._kv_counts["cow_blocks"] += 1
+                    SERVE_KV_COW.inc(engine=self.name)
+        self._table[row, :] = table_row
+        req.kv_blocks = total_cols
+        return last, pins
+
     def _admit_prefill(self, req: Request, prompt, length: int):
         """One admission's prefill: the prefix-cache split when enabled
         (longest resident prefix → device copy, suffix → windowed
@@ -682,13 +1048,20 @@ class ServeEngine:
 
     def _admit(self) -> "tuple[int, int]":
         """Fill free rows from the queue; returns ``(admitted,
-        prefix_hits)`` for this tick's flight-recorder row."""
+        prefix_hits)`` for this tick's flight-recorder row.  Paged
+        engines additionally gate the FIFO head on block demand: when
+        the head's worst-case need doesn't fit even after evicting every
+        unpinned prefix entry, admission STOPS for this tick (strict
+        FIFO — nothing behind the head jumps it) and retries next tick,
+        when a finisher may have freed blocks."""
         import jax.numpy as jnp
 
         admitted = hits = 0
         for row in range(self.slots):
             if self._row_req[row] is not None or not self._queue:
                 continue
+            if not self._ensure_admittable(self._queue[0]):
+                break
             req = self._queue.pop(0)
             t_admit = time.perf_counter()
             req.admitted_at = t_admit
@@ -705,8 +1078,11 @@ class ServeEngine:
             length = len(req.prompt)
             padded = req.prompt + [0] * (self.prompt_slots - length)
             prompt = jnp.asarray(padded, jnp.int32)[None, :]
-            cache1, last, pins = self._admit_prefill(req, prompt, length)
-            self._cache = self._insert(self._cache, cache1, jnp.int32(row))
+            if self._kv_layout == "paged":
+                last, pins = self._admit_paged(req, row, prompt, length)
+            else:
+                cache1, last, pins = self._admit_prefill(req, prompt, length)
+                self._cache = self._insert(self._cache, cache1, jnp.int32(row))
             import jax
 
             tok0, lp0_dev = jax.device_get(
@@ -811,6 +1187,15 @@ class ServeEngine:
             )
         self._done.append(req)
         self._row_req[row] = None
+        if self._kv_layout == "paged":
+            # Drop the table's block references (each non-scratch cell
+            # holds exactly one) and zero the row onto scratch, so the
+            # row's frozen in-flight writes can never reach a block a
+            # later admission reallocates.  Blocks a resident prefix
+            # entry still references stay allocated.
+            row_blocks = [int(b) for b in self._table[row] if b]
+            self._balloc.unref(row_blocks)
+            self._table[row, :] = 0
         # The finished row no longer needs its prefix entries held
         # against eviction.
         for entry in self._row_pins[row]:
@@ -845,9 +1230,18 @@ class ServeEngine:
                 [r.seed if r is not None else 0 for r in self._row_req],
                 jnp.int32,
             )
-            self._cache, tok, pos, toks, lps = self._step(
-                self.params, self._cache, tok, pos, active, seeds
-            )
+            if self._kv_layout == "paged":
+                # Snapshot the host tables for this tick's device call —
+                # tiny (slots × NW int32), rebuilt per tick so admissions
+                # and finishes take effect at the next step.
+                self._pool, tok, pos, toks, lps = self._paged_step(
+                    self.params, self._pool, jnp.asarray(self._table),
+                    tok, pos, active, seeds,
+                )
+            else:
+                self._cache, tok, pos, toks, lps = self._step(
+                    self.params, self._cache, tok, pos, active, seeds
+                )
             # ONE blocking fetch per tick (the module-header promise):
             # tokens, logprobs, next-token, and positions come together.
             toks, lps, tok_h, pos_h = jax.device_get((toks, lps, tok, pos))
@@ -903,6 +1297,9 @@ class ServeEngine:
         self._closed = True
         SERVE_QUEUE_DEPTH.remove_function(engine=self.name)
         SERVE_BATCH_OCCUPANCY.remove_function(engine=self.name)
+        if self._kv_layout == "paged":
+            for state in ("free", "allocated", "aliased"):
+                SERVE_KV_BLOCKS.remove(engine=self.name, state=state)
 
     def _check_open(self) -> None:
         if self._closed:
@@ -993,19 +1390,45 @@ class ServeEngine:
                 )
             ):
                 continue  # stale/incompatible run: skip, don't die
-            entry = self._prefix.insert(tokens)
-            if entry is None:
-                break  # every slot pinned (cannot happen pre-traffic)
             length = len(tokens)
             padded = tokens + [0] * (self.prompt_slots - length)
             prompt = jnp.asarray(padded, jnp.int32)[None, :]
-            cache1, _ = self._prefill1(
-                self.params, prompt, jnp.int32(length)
-            )
-            self._prefix.pool = self._pool_write(
-                self._prefix.pool, cache1,
-                jnp.int32(entry.slot), jnp.int32(length),
-            )
+            if self._kv_layout == "paged":
+                # Re-prefill straight into freshly allocated blocks
+                # through a standalone table row, then hand ownership to
+                # the parked entry (no engine row is involved, so the
+                # table row is transient host data).
+                cols_n = -(-length // self._block_size)
+                while (
+                    self._balloc.free_count < cols_n
+                    and self._prefix.evict_one()
+                ):
+                    pass
+                own = self._balloc.alloc(cols_n)
+                if own is None:
+                    break  # pool exhausted by pinned entries
+                table_row = np.zeros((self._table_cols,), np.int32)
+                table_row[:cols_n] = own
+                _, self._pool = self._paged_prefill(
+                    self.params, prompt, jnp.asarray([length], jnp.int32),
+                    self._pool, jnp.asarray(table_row[None, :]), 0,
+                )
+                entry = self._prefix.insert(tokens, own)
+                if entry is None:
+                    self._balloc.unref(own)
+                    break  # entry cap reached with everything pinned
+                self._balloc.unref(own)  # ownership moved to the entry
+            else:
+                entry = self._prefix.insert(tokens)
+                if entry is None:
+                    break  # every slot pinned (cannot happen pre-traffic)
+                cache1, _ = self._prefill1(
+                    self.params, prompt, jnp.int32(length)
+                )
+                self._prefix.pool = self._pool_write(
+                    self._prefix.pool, cache1,
+                    jnp.int32(entry.slot), jnp.int32(length),
+                )
             # Seed hotness so pre-kill popularity keeps steering LRU.
             entry.hits = int(item.get("hits", 0))
             self._prefix.release(entry)  # insert pre-pins; nothing decodes
@@ -1085,12 +1508,36 @@ class ServeEngine:
         )
 
     @property
+    def kv_layout(self) -> str:
+        """The engine's KV storage layout: ``"paged"`` (block pool +
+        per-request block tables) or ``"rows"`` (one engine-max row per
+        slot)."""
+        return self._kv_layout
+
+    @property
+    def kv_block_stats(self) -> "dict[str, int]":
+        """Paged engines: the block allocator's live accounting
+        (blocks_total/free/allocated/aliased) plus this engine's
+        cumulative admission counters — blocks aliased zero-copy,
+        COW-copied, and freshly allocated.  Empty dict on row-layout
+        engines (absent is not zero: the rows engine has no blocks to
+        account)."""
+        if self._kv_layout != "paged":
+            return {}
+        stats = self._balloc.stats()
+        stats["alias_blocks_total"] = self._kv_counts["alias_blocks"]
+        stats["cow_blocks_total"] = self._kv_counts["cow_blocks"]
+        stats["alloc_blocks_total"] = self._kv_counts["alloc_blocks"]
+        return stats
+
+    @property
     def prefix_stats(self) -> "dict[str, int]":
         """This engine's prefix-cache counters (bench/test readback; the
         process-global Prometheus counters aggregate across engines):
         hits/misses/evictions/resident/pool_slots from the cache, plus
         the admission prefill token split — ``prefill_tokens_reused`` is
-        exactly the prefill work the cache avoided."""
+        exactly the prefill work the cache avoided (paged: aliased
+        instead of copied — zero device copies either way)."""
         stats = (
             self._prefix.stats()
             if self._prefix is not None

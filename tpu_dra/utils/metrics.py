@@ -419,6 +419,30 @@ SERVE_BATCH_OCCUPANCY = REGISTRY.gauge(
     "Batch rows mid-decode, per engine (sampled at scrape; compare with "
     "the engine's slots for utilization)",
 )
+# Paged KV pool (parallel/paged.py, ServeEngine kv_layout="paged"):
+# block-granular occupancy plus the zero-copy admission counters — an
+# alias replaces the row layout's per-hit device copy, a COW copy
+# privatizes the one partial prompt block a parked entry shares with
+# its live request.
+SERVE_KV_BLOCKS = REGISTRY.gauge(
+    "tpu_dra_serve_kv_blocks",
+    "Paged KV pool blocks per engine by state: free (allocatable), "
+    "allocated (owned by a live block table or a resident prefix "
+    "entry; scratch block excluded), aliased (more than one owner — "
+    "the shared, immutable fraction); sampled at scrape",
+)
+SERVE_KV_ALIAS = REGISTRY.counter(
+    "tpu_dra_serve_kv_alias_total",
+    "Blocks aliased into a request's block table at admission instead "
+    "of being copied or recomputed (a prefix hit's zero-copy reuse, "
+    "counted in blocks)",
+)
+SERVE_KV_COW = REGISTRY.counter(
+    "tpu_dra_serve_kv_cow_total",
+    "Copy-on-write block copies at admission: the partial last prompt "
+    "block a parked prefix entry shares with its live request is "
+    "privatized so decode writes never touch a shared block",
+)
 # Serve-fleet router (tpu_dra/fleet/): placements across engine replicas
 # by reason, plus the routing-health gauges — digest freshness, load
 # balance, and the fleet-level overflow queue.
