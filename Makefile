@@ -9,11 +9,14 @@ TAG     ?= latest
 .PHONY: all test lint analyze generate-crds check-generate native \
         native-test demo-quickstart bench image clean help \
         observability-smoke perf-smoke explain-smoke serve-smoke \
-        serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke paged-smoke
+        serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke paged-smoke \
+        kernel-smoke
 
 # `analyze` runs the full rule registry — the L-style rules lint would
 # run plus the whole-repo invariants — so `all` needs only one pass.
-all: analyze test
+# `kernel-smoke` fails fast (seconds) on a Pallas-kernel/gather drift
+# before `test` pays for the full suite.
+all: analyze kernel-smoke test
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -89,6 +92,15 @@ serve-smoke:
 paged-smoke:
 	$(PYTHON) -m pytest tests/test_paged_smoke.py -q -m 'not slow'
 
+# Pallas paged-attention kernel floor (docs/SERVING.md "Attention
+# backends"): interpret-mode kernel vs jnp gather greedy TOKEN IDENTITY
+# on a tiny engine config, in seconds — the fail-fast gate on kernel
+# drift (mask, table addressing, online-softmax statistics, dequant).
+# The closeness/composition suites are tests/test_kernels.py; the
+# measured arm is `bench.py` stanza "serve_prefix" key "pallas".
+kernel-smoke:
+	$(PYTHON) -m pytest tests/test_kernel_smoke.py -q -m 'not slow'
+
 # Serving telemetry floor: drives a small engine stream, scrapes /metrics
 # and /debug/engine over HTTP, asserts the TPOT/queue-wait/SLO series and
 # per-engine gauges appear, the step flight recorder serves the ring, a
@@ -140,4 +152,4 @@ help:
 	@echo "         native-test demo-quickstart bench observability-smoke"
 	@echo "         perf-smoke explain-smoke serve-smoke serve-obs-smoke"
 	@echo "         chaos-smoke fleet-smoke obs-top-smoke paged-smoke"
-	@echo "         image clean"
+	@echo "         kernel-smoke image clean"

@@ -118,9 +118,29 @@ def test_bench_serve_prefix_stanza():
     on = out["cache_on"]
     assert on["alias_blocks"] > 0
     assert on["copied_prefix_tokens"] == 0
+    # ISSUE 11 half (a): the scheduling arms — token identity is baked
+    # into greedy_identical/ok; the step accounting must show the fused
+    # tick paying and continuous not, with tokens/s guarded in ok.
+    sched = out["scheduling"]
+    assert sched["continuous"]["wasted_steps"] == 0
+    assert sched["tick"]["wasted_steps"] > 0
+    assert sched["continuous_vs_tick_tokens_per_s"] > 0
+    occ = out["paged_occupancy"]
+    assert occ["continuous"]["wasted_steps"] == 0
+    assert occ["tick"]["wasted_steps"] > 0
+    assert occ["device_steps_saved"] > 0
+    assert (
+        occ["continuous"]["step_slot_utilization"]
+        > occ["tick"]["step_slot_utilization"]
+    )
+    # ISSUE 11 half (b): the kernel arm ran in interpret mode and was
+    # greedy-identical to the gather backend (throughput reported,
+    # honestly un-gated on CPU).
+    assert out["pallas"]["interpret_mode"]
+    assert out["pallas"]["greedy_identical_vs_gather"]
+    assert out["pallas"]["tokens_per_s"] > 0
     assert on["kv_blocks_per_req_p50"] > 0
     assert 0 < on["alias_rate"] <= 1
-    occ = out["paged_occupancy"]
     assert occ["paged_max_concurrent"] > occ["rows_max_concurrent"]
     assert occ["long_req_blocks"] > 0
     tel = out["telemetry"]

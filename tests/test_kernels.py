@@ -1,0 +1,280 @@
+"""Pallas paged-attention kernel (tpu_dra/parallel/kernels/paged_attn.py
++ the paged._PagedPallasKV / ServeEngine attn_backend wiring): kernel
+math against the gather path's dense reference, greedy token-identity
+through the full engine, sampled logprob closeness, int8 pool
+composition, and backend knob validation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.parallel.burnin import init_params
+from tpu_dra.parallel.kernels import paged_attention
+from tpu_dra.parallel.paged import (
+    _PagedPallasKV,
+    init_block_pool,
+    paged_decode_step_rows,
+)
+from tpu_dra.parallel.quant import quantize_tensor
+from tpu_dra.parallel.serve import ServeEngine
+
+from test_serve import CFG
+from test_serve_prefix import STREAM, isolated
+
+
+def _engine(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prompt_slots", 8)
+    kw.setdefault("max_new_cap", 5)
+    return ServeEngine(params, CFG, **kw)
+
+
+def _drain(eng, reqs, seeds=None):
+    ids = [
+        eng.submit(p, b, seed=None if seeds is None else seeds[i])
+        for i, (p, b) in enumerate(reqs)
+    ]
+    done = {r.id: r for r in eng.run()}
+    return [done[i] for i in ids]
+
+
+def _dense_reference(q, k_pool, v_pool, table, pos):
+    """The gather path's exact math (`paged._PagedKV.read` + the dense
+    masked einsums of `decode._decode_block`), as a standalone oracle."""
+    B, NW = table.shape
+    W = k_pool.shape[1]
+    K = k_pool.shape[-1]
+    k_all = k_pool[table].reshape(B, NW * W, *k_pool.shape[2:])
+    v_all = v_pool[table].reshape(B, NW * W, *v_pool.shape[2:])
+    scores = jnp.einsum("bshk,bthk->bhst", q[:, None], k_all) / (K**0.5)
+    slots = jnp.arange(NW * W)[None, :]
+    mask = (slots <= pos[:, None])[:, None, None, :]
+    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+    probs = jnp.exp(scores - scores.max(-1, keepdims=True))
+    probs = (probs / probs.sum(-1, keepdims=True)).astype(jnp.bfloat16)
+    return jnp.einsum("bhst,bthk->bshk", probs, v_all)[:, 0]
+
+
+def _random_pool(rng, nb, w, h, k):
+    kp = jnp.asarray(rng.randn(nb, w, h, k), jnp.bfloat16)
+    vp = jnp.asarray(rng.randn(nb, w, h, k), jnp.bfloat16)
+    return kp, vp
+
+
+class TestKernelMath:
+    def test_matches_dense_reference_over_random_tables(self):
+        """Block-streamed online softmax == the materialized gather's
+        dense softmax, to bf16 tolerance, across rows whose tables mix
+        real blocks, scratch-0 tail columns, and partial last blocks."""
+        rng = np.random.RandomState(0)
+        kp, vp = _random_pool(rng, 11, 4, 4, 8)
+        table = jnp.asarray(
+            [[1, 2, 3, 0], [4, 5, 6, 7], [8, 9, 0, 0]], jnp.int32
+        )
+        pos = jnp.asarray([0, 15, 6], jnp.int32)  # first / last / mid
+        q = jnp.asarray(rng.randn(3, 4, 8), jnp.bfloat16)
+        want = np.asarray(_dense_reference(q, kp, vp, table, pos), np.float32)
+        got = np.asarray(paged_attention(q, kp, vp, table, pos), np.float32)
+        np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+    def test_masked_tail_blocks_do_not_leak(self):
+        """Positions past pos[b] — including whole scratch columns —
+        must contribute nothing: poisoning them changes no output."""
+        rng = np.random.RandomState(1)
+        kp, vp = _random_pool(rng, 8, 4, 2, 8)
+        table = jnp.asarray([[1, 2, 0, 0]], jnp.int32)
+        pos = jnp.asarray([5], jnp.int32)
+        q = jnp.asarray(rng.randn(1, 2, 8), jnp.bfloat16)
+        base = np.asarray(paged_attention(q, kp, vp, table, pos))
+        poison_k = kp.at[0].set(99.0).at[2, 2:].set(77.0)  # scratch + tail
+        poison_v = vp.at[0].set(-55.0).at[2, 2:].set(33.0)
+        got = np.asarray(paged_attention(q, poison_k, poison_v, table, pos))
+        np.testing.assert_array_equal(base, got)
+
+    def test_int8_pool_matches_dequantized_dense(self):
+        """The {"q","s"} pool streams int8 blocks and dequantizes in
+        VMEM — output matches the dense reference over the dequantized
+        pool to the same tolerance."""
+        rng = np.random.RandomState(2)
+        kp, vp = _random_pool(rng, 9, 4, 4, 8)
+        k8 = quantize_tensor(kp.astype(jnp.float32), (3,))
+        v8 = quantize_tensor(vp.astype(jnp.float32), (3,))
+        kd = (k8["q"].astype(jnp.float32) * k8["s"]).astype(jnp.bfloat16)
+        vd = (v8["q"].astype(jnp.float32) * v8["s"]).astype(jnp.bfloat16)
+        table = jnp.asarray([[3, 1, 4], [5, 2, 6]], jnp.int32)
+        pos = jnp.asarray([11, 2], jnp.int32)
+        q = jnp.asarray(rng.randn(2, 4, 8), jnp.bfloat16)
+        want = np.asarray(_dense_reference(q, kd, vd, table, pos), np.float32)
+        got = np.asarray(
+            paged_attention(q, k8, v8, table, pos), np.float32
+        )
+        np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+    def test_step_logits_close_and_argmax_identical(self):
+        """Through the full per-row decode step: pallas and gather
+        backends agree to bf16-ulp logits and identical argmax."""
+        params = init_params(CFG)
+        rng = np.random.RandomState(3)
+        pool = init_block_pool(CFG, 12, 4)
+        pool = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(rng.randn(*a.shape), a.dtype), pool
+        )
+        table = jnp.asarray([[1, 2, 3, 0], [4, 5, 6, 7]], jnp.int32)
+        pos = jnp.asarray([5, 13], jnp.int32)
+        tok = jnp.asarray([3, 9], jnp.int32)
+        lg_g, _ = paged_decode_step_rows(
+            params, tok, pool, table, pos, CFG, backend="gather"
+        )
+        lg_p, _ = paged_decode_step_rows(
+            params, tok, pool, table, pos, CFG, backend="pallas"
+        )
+        lg_g = np.asarray(lg_g, np.float32)
+        lg_p = np.asarray(lg_p, np.float32)
+        np.testing.assert_allclose(lg_p, lg_g, atol=5e-2, rtol=5e-2)
+        np.testing.assert_array_equal(
+            lg_g.argmax(-1), lg_p.argmax(-1)
+        )
+
+    def test_bad_shapes_and_backend_rejected(self):
+        rng = np.random.RandomState(4)
+        kp, vp = _random_pool(rng, 4, 2, 2, 8)
+        table = jnp.zeros((1, 2), jnp.int32)
+        pos = jnp.zeros((1,), jnp.int32)
+        with pytest.raises(ValueError, match="q must be"):
+            paged_attention(
+                jnp.zeros((2, 2, 8), jnp.bfloat16), kp, vp, table, pos
+            )
+        with pytest.raises(ValueError, match="pool leaves"):
+            paged_attention(
+                jnp.zeros((1, 2, 8), jnp.bfloat16), kp[0], vp[0], table, pos
+            )
+        with pytest.raises(ValueError, match="backend"):
+            paged_decode_step_rows(
+                init_params(CFG), jnp.zeros((1,), jnp.int32),
+                init_block_pool(CFG, 4, 2), table, pos, CFG,
+                backend="triton",
+            )
+        kv = _PagedPallasKV(table, 2, pos)
+        with pytest.raises(ValueError, match="S=1"):
+            kv.attend(jnp.zeros((1, 3, 2, 8), jnp.bfloat16), kp, vp)
+
+
+class TestEngineBackendIdentity:
+    def test_greedy_identity_pallas_vs_gather_with_prefix_cache(self):
+        """THE half-(b) acceptance: the pallas engine's greedy outputs
+        are token-identical to the gather engine's over the shared
+        -prefix stream — aliasing, COW, parking, and eviction all
+        running — and match every request run alone."""
+        params = init_params(CFG)
+        gather = _engine(
+            params, prefix_cache_slots=8, attn_backend="gather"
+        )
+        out_g = [tuple(r.tokens) for r in _drain(gather, STREAM)]
+        pallas = _engine(
+            params, prefix_cache_slots=8, attn_backend="pallas"
+        )
+        assert pallas.attn_backend == "pallas"
+        out_p = [tuple(r.tokens) for r in _drain(pallas, STREAM)]
+        assert out_p == out_g
+        assert pallas.kv_block_stats["alias_blocks_total"] > 0
+        for (prompt, budget), got in zip(STREAM, out_p):
+            np.testing.assert_array_equal(
+                isolated(params, CFG, prompt, budget)[:budget],
+                np.asarray(got),
+            )
+
+    def test_sampled_logprobs_close_across_backends(self):
+        """Sampled mode: same seeds → same tokens (randomness is
+        f(seed, position); the bf16-ulp logit shift cannot move a
+        categorical draw except at measure-zero ties) and per-token
+        raw-model logprobs equal to tolerance."""
+        params = init_params(CFG)
+        seeds = [9, 8, 7, 6, 5, 4, 3, 2]
+        a = _drain(
+            _engine(
+                params, temperature=0.8, with_logprobs=True,
+                attn_backend="gather",
+            ),
+            STREAM, seeds=seeds,
+        )
+        b = _drain(
+            _engine(
+                params, temperature=0.8, with_logprobs=True,
+                attn_backend="pallas",
+            ),
+            STREAM, seeds=seeds,
+        )
+        assert [tuple(r.tokens) for r in a] == [tuple(r.tokens) for r in b]
+        for ra, rb in zip(a, b):
+            np.testing.assert_allclose(
+                ra.logprobs, rb.logprobs, atol=5e-2
+            )
+
+    def test_pallas_composes_with_continuous_scheduling(self):
+        """Both tentpole halves at once: per-step join/leave over the
+        kernel backend, token-identical to the fused-tick gather engine."""
+        params = init_params(CFG)
+        want = [
+            tuple(r.tokens)
+            for r in _drain(
+                _engine(params, scheduling="tick", attn_backend="gather"),
+                STREAM,
+            )
+        ]
+        got = [
+            tuple(r.tokens)
+            for r in _drain(
+                _engine(
+                    params, scheduling="continuous", steps_per_tick=3,
+                    attn_backend="pallas",
+                ),
+                STREAM,
+            )
+        ]
+        assert got == want
+
+    @pytest.mark.slow
+    def test_int8_kv_composes_with_pallas(self):
+        """int8 {"q","s"} pool blocks dequantize inside the kernel —
+        token-identical to the int8 gather engine."""
+        from tpu_dra.parallel.quant import quantize_params
+
+        qp = quantize_params(init_params(CFG))
+        reqs = STREAM[:4]
+        want = [
+            tuple(r.tokens)
+            for r in _drain(
+                _engine(qp, kv_int8=True, attn_backend="gather"), reqs
+            )
+        ]
+        got = [
+            tuple(r.tokens)
+            for r in _drain(
+                _engine(qp, kv_int8=True, attn_backend="pallas"), reqs
+            )
+        ]
+        assert got == want
+
+
+class TestBackendKnobs:
+    def test_auto_resolves_to_gather_off_tpu(self):
+        eng = _engine(init_params(CFG))
+        assert eng.attn_backend == "gather"  # CPU: interpret-only
+
+    def test_pallas_requires_paged_layout(self):
+        with pytest.raises(ValueError, match="kv_layout='paged'"):
+            _engine(
+                init_params(CFG), kv_layout="rows", attn_backend="pallas"
+            )
+
+    def test_pallas_rejects_mesh(self):
+        from tpu_dra.parallel.mesh import logical_mesh
+
+        mesh = logical_mesh(jax.devices()[:1], data=1, fsdp=1, model=1)
+        with pytest.raises(ValueError, match="single-device"):
+            _engine(init_params(CFG), mesh=mesh, attn_backend="pallas")
+
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ValueError, match="attn_backend"):
+            _engine(init_params(CFG), attn_backend="cuda")

@@ -128,6 +128,11 @@ class Config:
         "tpu_dra/fleet/fleet.py",
         "tpu_dra/controller/decisions.py",
         "tpu_dra/parallel/serve.py",
+        # The decode hot loop's kernels: a wall-clock read inside a
+        # kernel wrapper would silently skew every latency number the
+        # engine derives around it.
+        "tpu_dra/parallel/kernels/__init__.py",
+        "tpu_dra/parallel/kernels/paged_attn.py",
         "tpu_dra/obs/collector.py",
         "tpu_dra/obs/alerts.py",
         "tpu_dra/obs/cluster.py",

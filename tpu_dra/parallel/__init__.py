@@ -26,6 +26,10 @@ slice:
   with K/V blocks rotating over an ICI ring (ppermute + online softmax).
 - ``tpu_dra.parallel.flash``       — pallas flash-attention kernel for the
   single-chip hot path (streamed K/V tiles, VMEM online-softmax carry).
+- ``tpu_dra.parallel.kernels``     — serving-side pallas kernels: the
+  paged-attention kernel (block tables steer the DMA via scalar
+  prefetch; no KV gather ever materializes) behind
+  ``ServeEngine(attn_backend="pallas")``.
 - ``tpu_dra.parallel.moe``         — expert parallelism: switch-routed MoE
   MLP with XLA-inserted all-to-all; experts ride the ``model`` axis on the
   training mesh, or their own ``expert`` axis on ``moe_mesh`` with each

@@ -270,7 +270,12 @@ def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain,
     object with ``write(buf, new, p0) -> buf`` and ``read(buf) ->
     (B, T, H, K)`` (default: the contiguous `_cache_update`/`_cache_read`
     pair; `paged._PagedKV` gathers/scatters through a block table — the
-    attention einsums are shared, so the two layouts cannot drift)."""
+    attention einsums are shared, so the two layouts cannot drift).  A
+    kv_io that additionally defines ``attend(q, ck, cv) -> (B, S, H, K)``
+    owns the whole attention contraction: ``read`` is never called and no
+    full-context buffer materializes (`paged._PagedPallasKV` pushes it
+    into the Pallas paged-attention kernel — its causal-by-position mask
+    must match the ``mask`` this path would have applied)."""
     import jax
     import jax.numpy as jnp
 
@@ -299,13 +304,22 @@ def _decode_block(layer, x, ck, cv, p0, *, config: BurninConfig, constrain,
     else:
         ck = kv_io.write(ck, k_new, p0)
         cv = kv_io.write(cv, v_new, p0)
-        k_all, v_all = kv_io.read(ck), kv_io.read(cv)
+        k_all = v_all = None
+        if not hasattr(kv_io, "attend"):
+            k_all, v_all = kv_io.read(ck), kv_io.read(cv)
 
-    scores = jnp.einsum("bshk,bthk->bhst", q, k_all) / (c.d_head**0.5)
-    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
-    probs = jnp.exp(scores - scores.max(-1, keepdims=True))
-    probs = (probs / probs.sum(-1, keepdims=True)).astype(bf16)
-    att = jnp.einsum("bhst,bthk->bshk", probs, v_all)
+    if k_all is None:
+        # The kv_io owns the contraction (Pallas paged attention): KV is
+        # read block-by-block inside the kernel, never materialized as a
+        # full-context buffer, and the causal mask lives on its per-row
+        # positions.
+        att = kv_io.attend(q, ck, cv)
+    else:
+        scores = jnp.einsum("bshk,bthk->bhst", q, k_all) / (c.d_head**0.5)
+        scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+        probs = jnp.exp(scores - scores.max(-1, keepdims=True))
+        probs = (probs / probs.sum(-1, keepdims=True)).astype(bf16)
+        att = jnp.einsum("bhst,bthk->bshk", probs, v_all)
     att = jnp.einsum("bshk,hkd->bsd", att, layer["wo"].astype(bf16))
     x = x + att
 

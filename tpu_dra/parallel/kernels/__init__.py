@@ -1,0 +1,21 @@
+"""tpu_dra.parallel.kernels — Pallas device kernels for the serving hot
+loop.
+
+The rest of ``parallel/`` talks to the accelerator through XLA-compiled
+jnp programs; this package is the layer below that, where an op's memory
+traffic — not its FLOPs — is the product (PAPER.md's L0 lesson: the
+lowest layer must talk to the hardware in its own terms).  First
+resident: `paged_attn.paged_attention`, the block-table decode-attention
+kernel that replaces the paged serve engine's ``(B, NW*W, H, K)`` gather
+with a flash-style online-softmax walk over exactly the pool blocks each
+row's table names (``ServeEngine(attn_backend="pallas")``).
+
+Kernels are TPU-targeted but hardware-free testable: every entry point
+auto-selects ``pallas_call(interpret=True)`` off-TPU (the `flash.py`
+discipline), so CPU CI asserts token identity against the gather path
+and real silicon gets the compiled kernel from the same call site.
+"""
+
+from tpu_dra.parallel.kernels.paged_attn import paged_attention
+
+__all__ = ["paged_attention"]

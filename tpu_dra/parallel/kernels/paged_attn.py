@@ -1,0 +1,270 @@
+"""Pallas paged attention — block-table decode attention without the
+gather.
+
+The paged serve engine's jnp path (`paged._PagedKV.read`) materializes
+the WHOLE table reach ``(B, NW*W, H, K)`` per layer per decode step just
+so the dense masked einsums can attend over it — for one single-position
+query per row, that is a pool-sized HBM copy to compute a vector.  This
+kernel attends block-by-block instead:
+
+- **grid (B, NW), block axis innermost**: one program per (row, table
+  column).  The block index map reads the SCALAR-PREFETCHED table
+  (`pltpu.PrefetchScalarGridSpec`), so each step DMAs exactly physical
+  block ``table[b, j]`` from the pool into VMEM — the pool is never
+  gathered, reshaped, or copied; KV bytes stream straight from where
+  they live (vLLM's PagedAttention shape, Pallas-native).
+- **two block-streaming passes, token-identical to the gather**: pass 1
+  folds the flash online-softmax recurrence into the per-row softmax
+  statistics ``(m, l)`` (running max + rescaled denominator in VMEM
+  scratch, the `flash.py` discipline; K blocks only — V is never read).
+  Pass 2 re-streams the K/V blocks and accumulates the output with the
+  probabilities ROUNDED TO bf16 — the exact point the dense path rounds
+  (``probs.astype(bf16)`` before its V einsum) — into an f32 VMEM
+  accumulator.  Scores round through bf16 exactly where the dense
+  einsum's output does, masking uses the same ``-1e30`` sentinel.  The
+  result is bitwise the gather path's ``att`` up to f32 reduction
+  order, which the bf16 roundings absorb — greedy tokens are IDENTICAL
+  (the engine contract `make kernel-smoke` and tests/test_kernels.py
+  pin), not merely close.  A single-pass unrounded-accumulator variant
+  was measured to flip near-tie argmaxes on toy models and rejected:
+  exactness is the serving stack's currency.  Cost of the second pass:
+  the K stream is read twice (V once) — still a fraction of the
+  gather's full-pool copy, and blocks wholly past ``pos[b]`` skip
+  their FLOPs with ``@pl.when`` in both passes.
+- **int8 KV composes**: a quantized pool's ``{"q","s"}`` leaves arrive
+  as separate refs and dequantize per block in VMEM — HBM traffic stays
+  int8 + one scale per token-head, exactly the gather path's contract.
+
+Hardware-free validation: ``interpret=None`` auto-selects the Pallas
+interpreter off-TPU (the `flash.py` discipline), so CPU CI runs the real
+kernel logic; on TPU the same call site compiles.  The engine wiring is
+``ServeEngine(attn_backend="pallas")`` -> `paged._PagedPallasKV`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_attention"]
+
+_NEG_INF = -1e30
+
+
+def _block_kv(quantized, refs):
+    """The bf16 view of one streamed block from its ref(s): a plain
+    (1, W, H, K) ref, or the int8 ``(q, s)`` ref pair dequantized in
+    VMEM (the gather path's `_cache_read` contract, per block)."""
+    if not quantized:
+        return refs[0][0]
+    qref, sref = refs
+    return (qref[0].astype(jnp.float32) * sref[0]).astype(jnp.bfloat16)
+
+
+def _scores(q_ref, k_blk, sqrt_d):
+    """One block's masked-path scores, rounded exactly like the dense
+    einsum: f32 MXU accumulation -> the einsum's bf16 output -> scaled
+    in bf16 -> widened to f32 for the softmax."""
+    s = jnp.einsum(
+        "hk,whk->hw", q_ref[0], k_blk, preferred_element_type=jnp.float32
+    )
+    return (s.astype(jnp.bfloat16) / sqrt_d).astype(jnp.float32)
+
+
+def _visible(j, W, p):
+    # (1, W): the block's absolute positions against the row's own query
+    # position — the dense path's `slots <= pos` causal mask, blockwise.
+    off = j * W + jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
+    return off <= p
+
+
+def _paged_ml_kernel(
+    table_ref, pos_ref, q_ref, *rest, nwin, block_size, sqrt_d, quantized,
+):
+    """Pass 1: per-row softmax statistics (m, l) by the online
+    recurrence, K blocks streamed through the table."""
+    from jax.experimental import pallas as pl
+
+    if quantized:
+        kq_ref, ks_ref, m_out, l_out, m_ref, l_ref = rest
+        k_refs = (kq_ref, ks_ref)
+    else:
+        k_ref, m_out, l_out, m_ref, l_ref = rest
+        k_refs = (k_ref,)
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    W = block_size
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    p = pos_ref[b]
+
+    # A block starting past the query position is entirely masked: skip
+    # its FLOPs (the DMA still lands — on the decode step's tiny
+    # per-block work the mask is the clearer contract).
+    @pl.when(j * W <= p)
+    def _fold():
+        s = _scores(q_ref, _block_kv(quantized, k_refs), sqrt_d)
+        vis = _visible(j, W, p)
+        s = jnp.where(vis, s, _NEG_INF)
+        m = m_ref[:]  # (H, 1)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        pexp = jnp.where(vis, jnp.exp(s - m_new), 0.0)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * jnp.exp(m - m_new) + pexp.sum(
+            axis=-1, keepdims=True
+        )
+
+    @pl.when(j == nwin - 1)
+    def _emit():
+        m_out[0] = m_ref[:]
+        l_out[0] = l_ref[:]
+
+
+def _paged_att_kernel(
+    table_ref, pos_ref, q_ref, *rest, nwin, block_size, sqrt_d, quantized,
+):
+    """Pass 2: the output contraction with DENSE-path rounding — each
+    block's probabilities ``exp(s - m) / l`` cast to bf16 (exactly where
+    the gather path casts ``probs``) before folding ``p @ v`` into the
+    f32 accumulator."""
+    from jax.experimental import pallas as pl
+
+    if quantized:
+        (kq_ref, ks_ref, vq_ref, vs_ref, m_ref, l_ref, o_ref,
+         acc_ref) = rest
+        k_refs, v_refs = (kq_ref, ks_ref), (vq_ref, vs_ref)
+    else:
+        k_ref, v_ref, m_ref, l_ref, o_ref, acc_ref = rest
+        k_refs, v_refs = (k_ref,), (v_ref,)
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    W = block_size
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    p = pos_ref[b]
+
+    @pl.when(j * W <= p)
+    def _fold():
+        s = _scores(q_ref, _block_kv(quantized, k_refs), sqrt_d)
+        vis = _visible(j, W, p)
+        s = jnp.where(vis, s, _NEG_INF)
+        # l >= 1 whenever any position is visible (position 0 of block
+        # table[b, 0] always is for pos >= 0); the clamp only shields
+        # frozen rows reading scratch garbage.
+        l = jnp.maximum(l_ref[0], 1e-30)  # (H, 1)
+        probs = (
+            jnp.where(vis, jnp.exp(s - m_ref[0]), 0.0) / l
+        ).astype(jnp.bfloat16)
+        acc_ref[:] = acc_ref[:] + jnp.einsum(
+            "hw,whk->hk", probs, _block_kv(quantized, v_refs),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nwin - 1)
+    def _emit():
+        o_ref[0] = acc_ref[:].astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, table, pos, *, interpret=None):
+    """One decode step's attention for B rows straight off the block
+    pool: row ``b``'s single query ``q[b]`` attends positions ``j <=
+    pos[b]`` of the context its block table names, reading each physical
+    block through the table (K streams twice — the statistics pass and
+    the contraction pass — V once; nothing is ever gathered).
+
+    ``q``: (B, H, K) bf16 — the already-rotated per-row queries.
+    ``k_pool``/``v_pool``: one LAYER's pool leaves — (NB, W, H, K) bf16,
+    or the int8 ``{"q": (NB, W, H, K), "s": (NB, W, H, 1)}`` pair.
+    ``table``: (B, NW) int32 physical block ids (0 = scratch: masked
+    garbage, never visible).  ``pos``: (B,) int32 per-row positions.
+    Returns (B, H, K) bf16 — the gather path's ``att``, token-identity
+    -exact, without its ``(B, NW*W, H, K)`` materialization.
+
+    ``interpret=None`` auto-selects: compiled on TPU, Pallas interpreter
+    elsewhere (CPU CI runs the same kernel logic hardware-free)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from tpu_dra.parallel.quant import is_quantized_leaf
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    quantized = is_quantized_leaf(k_pool)
+    kq = k_pool["q"] if quantized else k_pool
+    if kq.ndim != 4:
+        raise ValueError(
+            f"pool leaves must be (NB, W, H, K) per layer, got {kq.shape}"
+        )
+    _, W, H, K = kq.shape
+    B, NW = table.shape
+    if q.shape != (B, H, K):
+        raise ValueError(
+            f"q must be (B, H, K) = ({B}, {H}, {K}), got {q.shape}"
+        )
+    opts = dict(nwin=NW, block_size=W, sqrt_d=K**0.5, quantized=quantized)
+
+    def pool_spec(last):
+        # THE paged read: the index map dereferences the prefetched
+        # table, so grid step (b, j) DMAs physical block table[b, j] —
+        # no gather ever materializes.
+        return pl.BlockSpec(
+            (1, W, H, last), lambda b, j, tab, pos: (tab[b, j], 0, 0, 0)
+        )
+
+    def row_spec(last):
+        return pl.BlockSpec((1, H, last), lambda b, j, tab, pos: (b, 0, 0))
+
+    # One streamed tensor = one spec (bf16) or a (values, scales) spec
+    # pair (int8) — identical shapes for K and V.
+    blk_specs = (
+        [pool_spec(K), pool_spec(1)] if quantized else [pool_spec(K)]
+    )
+    k_args = (k_pool["q"], k_pool["s"]) if quantized else (k_pool,)
+    v_args = (v_pool["q"], v_pool["s"]) if quantized else (v_pool,)
+
+    # Pass 1: softmax statistics.  K blocks only — V never streams here.
+    m, l = pl.pallas_call(
+        functools.partial(_paged_ml_kernel, **opts),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, H, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, 1), jnp.float32),
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # table + pos steer the DMA
+            grid=(B, NW),  # block axis innermost: scratch carries
+            in_specs=[row_spec(K), *blk_specs],
+            out_specs=(row_spec(1), row_spec(1)),
+            scratch_shapes=[
+                pltpu.VMEM((H, 1), jnp.float32),  # running max
+                pltpu.VMEM((H, 1), jnp.float32),  # running denominator
+            ],
+        ),
+        interpret=interpret,
+    )(table, pos, q, *k_args)
+
+    # Pass 2: the contraction, probabilities bf16-rounded per the dense
+    # path, f32 accumulation across blocks.
+    return pl.pallas_call(
+        functools.partial(_paged_att_kernel, **opts),
+        out_shape=jax.ShapeDtypeStruct((B, H, K), jnp.bfloat16),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, NW),
+            in_specs=[row_spec(K), *blk_specs, *blk_specs,
+                      row_spec(1), row_spec(1)],
+            out_specs=row_spec(K),
+            scratch_shapes=[
+                pltpu.VMEM((H, K), jnp.float32),  # running numerator
+            ],
+        ),
+        interpret=interpret,
+    )(table, pos, q, *k_args, *v_args, m, l)

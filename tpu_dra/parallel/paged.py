@@ -181,6 +181,36 @@ class _PagedKV:
         }
 
 
+class _PagedPallasKV(_PagedKV):
+    """The Pallas attention backend: writes scatter through the table
+    exactly like `_PagedKV`, but the READ side is gone — ``attend``
+    pushes the whole contraction into `kernels.paged_attention`, which
+    walks the pool block-by-block with online softmax instead of
+    materializing the ``(B, NW*W, H, K)`` gather (`decode._decode_block`
+    calls it in place of read + the dense einsums).  Decode steps only
+    (one query per row at its own position — exactly the mask the dense
+    path would have built from ``pos``)."""
+
+    def __init__(self, table, block_size: int, pos, interpret=None):
+        super().__init__(table, block_size)
+        self.pos = pos  # (B,) per-row query positions
+        self.interpret = interpret
+
+    def attend(self, q, ck, cv):
+        from tpu_dra.parallel.kernels import paged_attention
+
+        if q.shape[1] != 1:
+            raise ValueError(
+                f"pallas paged attention is the decode-step kernel "
+                f"(S=1 queries), got S={q.shape[1]}"
+            )
+        out = paged_attention(
+            q[:, 0], ck, cv, self.table, self.pos,
+            interpret=self.interpret,
+        )
+        return out[:, None]
+
+
 def _pool_block_size(pool) -> int:
     """Block width W of a pool in either storage format."""
     k = pool["k"]
@@ -188,18 +218,29 @@ def _pool_block_size(pool) -> int:
 
 
 def paged_decode_step_rows(params, tok, pool, table, pos,
-                           config: BurninConfig, mesh=None):
+                           config: BurninConfig, mesh=None,
+                           backend: str = "gather"):
     """One decode step with PER-ROW positions through block tables: row
     ``b``'s token lands in block ``table[b, pos[b] // W]`` at offset
     ``pos[b] % W`` and attends ``j <= pos[b]`` over the table-gathered
     pool.  Returns ``(logits (B, vocab), new_pool)`` — the paged twin of
     `decode.decode_step_rows`, value-identical to it row for row (the
     gather only reorders storage, and the wider/narrower masked tail
-    adds exact-zero softmax terms)."""
+    adds exact-zero softmax terms).
+
+    ``backend`` picks the attention read path: ``"gather"`` is the jnp
+    pool-gather + dense masked einsums (bitwise the contract above, runs
+    anywhere); ``"pallas"`` routes the contraction through the paged
+    -attention kernel — KV streams block-by-block, logits agree to
+    bf16-ulp (greedy-token-identical; see `kernels.paged_attn`)."""
     import jax.numpy as jnp
 
     c = config
     _validate(c)
+    if backend not in ("gather", "pallas"):
+        raise ValueError(
+            f"backend must be 'gather' or 'pallas', got {backend!r}"
+        )
     constrain = _make_constrain(mesh)
     W = _pool_block_size(pool)
     t_eff = table.shape[1] * W
@@ -210,9 +251,13 @@ def paged_decode_step_rows(params, tok, pool, table, pos,
     x = constrain("hidden", x)
     slots = jnp.arange(t_eff)[None, :]  # (1, NW*W)
     mask = (slots <= pos[:, None])[:, None, None, :]  # (B, 1, 1, NW*W)
+    kv_io = (
+        _PagedPallasKV(table, W, pos)
+        if backend == "pallas"
+        else _PagedKV(table, W)
+    )
     logits, pool = _run_blocks(
-        params, x, pool, pos, mask, c, constrain,
-        kv_io=_PagedKV(table, W),
+        params, x, pool, pos, mask, c, constrain, kv_io=kv_io,
     )
     return logits[:, 0], pool
 

@@ -38,7 +38,16 @@ engine-max context.  Three compiled functions replace prefill1/insert:
   first-window index, the same bounded executable family as the suffix
   prefill) — no B=1 staging cache, no insert.
 - ``paged step`` (`paged.paged_decode_step_rows`): per-row positions
-  through the table gather — one executable for ANY table contents.
+  through the table — one executable for ANY table contents.  The
+  attention read side is selected by ``attn_backend``: ``"gather"``
+  materializes the masked ``(B, NW*W, H, K)`` pool gather and attends
+  with the dense einsums (bitwise the row layout's math, runs
+  anywhere); ``"pallas"`` streams KV block-by-block through the paged
+  -attention kernel (`kernels.paged_attn` — flash-style online softmax
+  driven by the block table, no gather ever materializes; greedy
+  -token-identical, logits to bf16-ulp).  ``"auto"`` picks pallas on
+  TPU, gather elsewhere (off-TPU the kernel runs in interpret mode —
+  a correctness path, not a fast one).
 - ``copy_block``: the COW primitive (see below).
 
 With ``prefix_cache_slots > 0`` admission grows an automatic shared
@@ -73,9 +82,25 @@ overwrite-before-attend discipline the speculative decoder uses.
 
 The engine itself is intentionally host-side Python: admission, queues,
 budgets, and EOS detection are control decisions made BETWEEN device
-steps (one small device→host fetch per step — the price of reacting to
-finishes immediately, which is the entire point of continuous batching;
-amortize with ``steps_per_tick`` when reaction latency can lag).
+calls, and ``scheduling`` picks the granularity those decisions run at:
+
+- ``"continuous"`` (default): every device call is ONE decode step, and
+  join/leave happens between steps — a row that finishes at step ``s``
+  is freed immediately and the FIFO head takes its slot at step ``s+1``
+  of the SAME tick (the per-call host snapshot of tables/active masks
+  makes that a host-side edit, never a recompile).  No device step is
+  ever spent on a finished request (``tpu_dra_serve_wasted_steps_total``
+  stays 0) and occupancy tracks offered load, not tick boundaries.
+- ``"tick"``: the legacy fused form — ``steps_per_tick`` steps in one
+  device call, finishes reacted to at the tick boundary.  One fetch
+  amortizes the whole fused batch (fewer host round-trips on a
+  high-latency link), bought with up to ``steps_per_tick - 1`` wasted
+  steps per finisher (counted by the metric) and admission latency.
+
+Either way there is exactly ONE blocking device→host fetch per device
+call: one per step under continuous scheduling, one per tick under
+fused ticks, plus one per ADMISSION WAVE (all of a wave's first tokens
+and logprobs come back together, however many rows were filled).
 
 Determinism contracts, both modes: greedy — every request's output
 equals `make_generate_padded` run on that request alone (the exactness
@@ -149,12 +174,29 @@ from tpu_dra.utils.metrics import (
     SERVE_SLO_TOTAL,
     SERVE_TPOT_SECONDS,
     SERVE_TTFT_SECONDS,
+    SERVE_WASTED_STEPS,
 )
 
 __all__ = ["Request", "ServeEngine"]
 
 # Default engine names for the per-engine gauge/flight-recorder label.
 _ENGINE_IDS = itertools.count()
+
+# The hot loop's lazy-import seam: jax lands here ONCE (first engine
+# construction) so the per-call bodies below (`_admit`, `tick` — entered
+# thousands of times a second) never repeat the import-machinery lookup,
+# while importing tpu_dra.parallel.serve itself stays jax-free.
+_jax = _jnp = None
+
+
+def _jax_mods():
+    global _jax, _jnp
+    if _jax is None:
+        import jax
+        import jax.numpy as jnp
+
+        _jax, _jnp = jax, jnp
+    return _jax, _jnp
 
 
 def _unix_of(perf_t: float) -> float:
@@ -245,9 +287,19 @@ class ServeEngine:
     ``slots``: concurrent rows (the compiled batch).  ``prompt_slots``:
     admission pad width — prompts longer than this are rejected at
     submit.  ``eos_token``: generation stops early when the model emits
-    it (None: budget-only).  ``steps_per_tick``: decode steps fused into
-    one device call per `tick` (finish reactions lag by at most that
-    many tokens).
+    it (None: budget-only).  ``steps_per_tick``: decode steps each
+    `tick` runs.  ``scheduling`` sets their granularity:
+    ``"continuous"`` (default) runs them as single-step device calls
+    with join/leave BETWEEN steps — a mid-tick finisher frees its row
+    for the FIFO head at the very next step and no step is ever spent
+    on a finished request; ``"tick"`` fuses all of them into one device
+    call (one fetch amortizes the batch; finish reactions lag by at
+    most ``steps_per_tick`` tokens and each finisher wastes the fused
+    call's remaining steps — counted by
+    ``tpu_dra_serve_wasted_steps_total``).  With ``steps_per_tick=1``
+    the two are the same schedule.  Outputs are identical either way
+    (greedy exactness + sampled scheduling-invariance, pinned by
+    ``tests/test_continuous.py``).
 
     ``kv_layout``: ``"paged"`` (default for dense configs) stores KV in
     one block-granular device pool addressed through per-request block
@@ -255,7 +307,16 @@ class ServeEngine:
     zero-copy prefix aliasing; ``"rows"`` is the legacy per-request
     -full-row layout (the MoE-serving path — paged prefill is windowed,
     which would re-route MoE capacity queues — and the A/B baseline the
-    bench compares against).  ``kv_blocks``: total blocks in the paged
+    bench compares against).  ``attn_backend`` (paged only): how the
+    decode step reads KV — ``"gather"`` materializes the masked pool
+    gather for the dense einsums (runs anywhere, the compat path);
+    ``"pallas"`` streams KV block-by-block through the paged-attention
+    kernel (no gather materializes; greedy-token-identical, logits to
+    bf16-ulp; off-TPU it runs in Pallas interpret mode — a correctness
+    path, not a fast one); ``"auto"`` (default) picks pallas on TPU and
+    gather elsewhere.  Single-device engines only for pallas (the
+    sharded engine stays on gather until a shard_mapped kernel lands).
+    ``kv_blocks``: total blocks in the paged
     pool, scratch block included (default: every slot can hold a
     worst-case request plus, when the prefix cache is on, headroom for
     the cached entries' prompt blocks and one COW block per slot —
@@ -301,6 +362,8 @@ class ServeEngine:
         max_new_cap: int,
         eos_token: "int | None" = None,
         steps_per_tick: int = 1,
+        scheduling: str = "continuous",
+        attn_backend: str = "auto",
         temperature: float = 0.0,
         top_k: "int | None" = None,
         top_p: "float | None" = None,
@@ -317,8 +380,7 @@ class ServeEngine:
         name: "str | None" = None,
         mesh=None,
     ):
-        import jax
-        import jax.numpy as jnp
+        jax, jnp = _jax_mods()
 
         c = config
         # Every row must fit prompt + its budget in the context.
@@ -327,6 +389,11 @@ class ServeEngine:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if steps_per_tick < 1:
             raise ValueError(f"steps_per_tick must be >= 1, got {steps_per_tick}")
+        if scheduling not in ("continuous", "tick"):
+            raise ValueError(
+                f"scheduling must be 'continuous' or 'tick', "
+                f"got {scheduling!r}"
+            )
         _validate_filters(c.vocab, temperature > 0, top_k, top_p)
         _check_chunk(c, prompt_slots, prefill_chunk, "prompt_slots")
         if prefix_cache_slots < 0:
@@ -356,6 +423,39 @@ class ServeEngine:
         if kv_blocks is not None and kv_layout != "paged":
             raise ValueError("kv_blocks only applies to kv_layout='paged'")
         self._kv_layout = kv_layout
+        if attn_backend not in ("auto", "gather", "pallas"):
+            raise ValueError(
+                f"attn_backend must be 'auto', 'gather', or 'pallas', "
+                f"got {attn_backend!r}"
+            )
+        if attn_backend == "pallas":
+            if kv_layout != "paged":
+                raise ValueError(
+                    "attn_backend='pallas' is the paged-attention kernel: "
+                    "it requires kv_layout='paged' (the rows layout has "
+                    "no block tables to stream)"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "attn_backend='pallas' serves single-device engines "
+                    "only for now: the sharded engine stays on the "
+                    "gather path until a shard_mapped kernel lands "
+                    "(pass attn_backend='gather' or 'auto')"
+                )
+        if attn_backend == "auto":
+            # Pallas where it pays (real TPU, paged, single-device);
+            # the gather everywhere else — off-TPU the kernel only runs
+            # under the Pallas interpreter, a correctness path.
+            attn_backend = (
+                "pallas"
+                if (
+                    kv_layout == "paged"
+                    and mesh is None
+                    and jax.default_backend() == "tpu"
+                )
+                else "gather"
+            )
+        self._attn_backend = attn_backend
 
         # The suffix-window width doubles as the paged block size, so it
         # is derived whenever EITHER consumer needs it.
@@ -392,6 +492,19 @@ class ServeEngine:
         self.max_new_cap = max_new_cap
         self.eos_token = eos_token
         self.steps_per_tick = steps_per_tick
+        self.scheduling = scheduling
+        # Steps fused into ONE device call: all of them under "tick",
+        # exactly one under "continuous" (join/leave runs between calls).
+        self._steps_per_call = 1 if scheduling == "continuous" else steps_per_tick
+        # Device steps spent on rows whose request had already finished
+        # earlier in the same fused call (surplus tokens discarded) —
+        # structurally 0 under continuous scheduling.
+        self._wasted_steps = 0
+        # Total device decode steps executed (each steps every slot) —
+        # with wasted_steps, the bench's occupancy-tracks-offered-load
+        # arithmetic: same tokens in fewer steps == rows refilled
+        # mid-tick instead of idling to the boundary.
+        self._device_steps = 0
         self.temperature = temperature
         self.with_logprobs = with_logprobs
         self.mesh = mesh
@@ -617,18 +730,19 @@ class ServeEngine:
         else:
             pick_row = None  # greedy: step() takes the argmax branch
 
-        def first_token(seed, length, row):
-            # The admission's first token + its raw-model logprob in ONE
-            # compiled call — one device round-trip per admission, not
-            # two.
+        def first_tokens(seeds, lengths, rows):
+            # A whole admission WAVE's first tokens + raw-model logprobs
+            # in ONE compiled call — one device round-trip per wave, not
+            # per admitted request (`_admit` collects every admission's
+            # last-position logits first, then fetches once; the
+            # executable family is bounded by the wave size <= slots).
             if temperature > 0:
-                tok = pick_row(seed, length, row)
+                toks = jax.vmap(pick_row)(seeds, lengths, rows)
             else:
-                tok = jnp.argmax(row, axis=-1).astype(jnp.int32)
-            lp = _chosen_logprob(row[None], tok[None])[0]
-            return tok, lp
+                toks = jnp.argmax(rows, axis=-1).astype(jnp.int32)
+            return toks, _chosen_logprob(rows, toks)
 
-        self._first_token = jax.jit(first_token)
+        self._first_tokens = jax.jit(first_tokens)
 
         def sample_step(logits, tok, pos, active, seeds):
             # The shared per-step tail of both layouts' device loops:
@@ -648,16 +762,18 @@ class ServeEngine:
             return nxt, pos, lp
 
         def step(params, cache, tok, pos, active, seeds):
-            # steps_per_tick tokens for every row in ONE device call; the
-            # per-step tokens come back for host-side finish decisions.
-            # A row that hits its budget mid-tick keeps stepping on
-            # device until the tick ends (active was snapshotted at tick
-            # start): its surplus tokens are discarded host-side, and in
-            # the worst case its position walks past the context end —
-            # benign because out-of-bounds scatter writes are DROPPED by
-            # jax semantics (and the row's state is reset at its next
-            # admission).  The soak test runs steps_per_tick=2 over 100
-            # requests to exercise exactly this lag.
+            # _steps_per_call tokens for every row in ONE device call
+            # (all of steps_per_tick under "tick" scheduling, exactly one
+            # under "continuous"); the per-step tokens come back for
+            # host-side finish decisions.  Under fused ticks a row that
+            # hits its budget mid-call keeps stepping on device until the
+            # call ends (active was snapshotted at call start): its
+            # surplus tokens are discarded host-side (counted as wasted
+            # steps), and in the worst case its position walks past the
+            # context end — benign because out-of-bounds scatter writes
+            # are DROPPED by jax semantics (and the row's state is reset
+            # at its next admission).  The soak test runs steps_per_tick=2
+            # over 100 requests to exercise the per-step join.
             def one(carry, _):
                 cache, tok, pos = carry
                 logits, cache = decode_step_rows(params, tok, cache, pos, c, mesh)
@@ -665,28 +781,31 @@ class ServeEngine:
                 return (cache, nxt, pos), (nxt, lp)
 
             (cache, tok, pos), (toks, lps) = jax.lax.scan(
-                one, (cache, tok, pos), None, length=self.steps_per_tick
+                one, (cache, tok, pos), None, length=self._steps_per_call
             )
-            # toks/lps: (steps_per_tick, B)
+            # toks/lps: (_steps_per_call, B)
             return cache, tok, pos, toks, lps
 
         def step_paged(params, pool, table, tok, pos, active, seeds):
-            # The paged twin: same tick contract, KV addressed through
-            # the snapshot block table.  An overrun row (budget hit mid
-            # -tick, or frozen after finish) writes through a clamped or
-            # zeroed table cell into its own tail block or scratch —
+            # The paged twin: same call contract, KV addressed through
+            # the snapshot block table (attention read path per
+            # attn_backend: dense einsums over the pool gather, or the
+            # Pallas block-streaming kernel).  An overrun row (budget hit
+            # mid-call, or frozen after finish) writes through a clamped
+            # or zeroed table cell into its own tail block or scratch —
             # never into another request's blocks, because freed rows'
             # tables are zeroed before their blocks can be reallocated.
             def one(carry, _):
                 pool, tok, pos = carry
                 logits, pool = paged_decode_step_rows(
-                    params, tok, pool, table, pos, c, mesh
+                    params, tok, pool, table, pos, c, mesh,
+                    backend=self._attn_backend,
                 )
                 nxt, pos, lp = sample_step(logits, tok, pos, active, seeds)
                 return (pool, nxt, pos), (nxt, lp)
 
             (pool, tok, pos), (toks, lps) = jax.lax.scan(
-                one, (pool, tok, pos), None, length=self.steps_per_tick
+                one, (pool, tok, pos), None, length=self._steps_per_call
             )
             return pool, tok, pos, toks, lps
 
@@ -893,7 +1012,7 @@ class ServeEngine:
         the prompt's blocks as a radix entry → COW the shared partial
         last block.  Returns ``(last, pins)``.  The caller ran
         `_ensure_admittable`, so allocations cannot fail mid-way."""
-        import jax.numpy as jnp
+        jnp = _jax_mods()[1]
 
         w = self._block_size
         cacheable = self._prefix is not None and req.use_prefix_cache
@@ -989,7 +1108,7 @@ class ServeEngine:
         prefill, prompt KV parked in the pool), the plain full prefill
         otherwise.  Returns ``(cache1, last, pins)`` — ``pins`` are the
         pool entries this row holds against eviction until it finishes."""
-        import jax.numpy as jnp
+        jnp = _jax_mods()[1]
 
         cacheable = self._prefix is not None and req.use_prefix_cache
         entry, m, m_raw = (
@@ -1051,12 +1170,19 @@ class ServeEngine:
         prefix_hits)`` for this tick's flight-recorder row.  Paged
         engines additionally gate the FIFO head on block demand: when
         the head's worst-case need doesn't fit even after evicting every
-        unpinned prefix entry, admission STOPS for this tick (strict
-        FIFO — nothing behind the head jumps it) and retries next tick,
-        when a finisher may have freed blocks."""
-        import jax.numpy as jnp
+        unpinned prefix entry, admission STOPS for this wave (strict
+        FIFO — nothing behind the head jumps it) and retries at the next
+        step or tick, when a finisher may have freed blocks.
+
+        The whole wave shares ONE first-token call and ONE blocking
+        fetch: each admission's prefill leaves its last-position logits
+        on device, and every first token + logprob comes back together
+        (the module-header fetch contract — per admission wave, never
+        per admitted request)."""
+        jax, jnp = _jax_mods()
 
         admitted = hits = 0
+        wave: "list[tuple[int, Request, object, float]]" = []
         for row in range(self.slots):
             if self._row_req[row] is not None or not self._queue:
                 continue
@@ -1083,31 +1209,36 @@ class ServeEngine:
             else:
                 cache1, last, pins = self._admit_prefill(req, prompt, length)
                 self._cache = self._insert(self._cache, cache1, jnp.int32(row))
-            import jax
-
-            tok0, lp0_dev = jax.device_get(
-                self._first_token(
-                    jnp.int32(req.seed), jnp.int32(length), last[0]
-                )
-            )  # one fused call, one fetch
-            first, lp0 = int(tok0), float(lp0_dev)
             self._row_req[row] = req
             self._pos[row] = length
-            self._tok[row] = first
             self._row_pins[row] = pins
-            self._note_token(row, first, lp0)
-            if self.telemetry:
-                trace.emit_span(
-                    "serve.admit", parent=req.trace_ctx,
-                    start_unix_s=_unix_of(t_admit),
-                    duration_s=time.perf_counter() - t_admit,
-                    request=req.id, row=row, prompt_len=length,
-                    prefix_hit=req.prefix_reused > 0,
-                    prefix_reused=req.prefix_reused,
-                    suffix_len=length - req.prefix_reused,
-                )
+            wave.append((row, req, last[0], t_admit))
             admitted += 1
             hits += req.prefix_reused > 0
+        if wave:
+            toks, lps = jax.device_get(
+                self._first_tokens(
+                    jnp.asarray([r.seed for _, r, _, _ in wave], jnp.int32),
+                    jnp.asarray(
+                        [len(r.prompt) for _, r, _, _ in wave], jnp.int32
+                    ),
+                    jnp.stack([last for _, _, last, _ in wave]),
+                )
+            )  # one fused call, one fetch, the whole wave
+            for i, (row, req, _, t_admit) in enumerate(wave):
+                self._tok[row] = int(toks[i])
+                self._note_token(row, int(toks[i]), float(lps[i]))
+                if self.telemetry:
+                    trace.emit_span(
+                        "serve.admit", parent=req.trace_ctx,
+                        start_unix_s=_unix_of(t_admit),
+                        duration_s=time.perf_counter() - t_admit,
+                        request=req.id, row=row,
+                        prompt_len=len(req.prompt),
+                        prefix_hit=req.prefix_reused > 0,
+                        prefix_reused=req.prefix_reused,
+                        suffix_len=len(req.prompt) - req.prefix_reused,
+                    )
         return admitted, hits
 
     def _note_token(self, row: int, token: int, logprob: float) -> None:
@@ -1202,58 +1333,86 @@ class ServeEngine:
             self._prefix.release(entry)
         self._row_pins[row] = []
 
-    def tick(self) -> "list[Request]":
-        """Admit waiting requests into free rows, run one device call
-        (``steps_per_tick`` decode steps for every row), process
-        finishes.  Returns requests completed during this tick.  With
-        ``telemetry`` on, every tick appends one StepRecord to the
-        process-global engine flight recorder (``/debug/engine``)."""
-        import jax
-        import jax.numpy as jnp
+    def _step_once(self) -> None:
+        """One device call (``_steps_per_call`` fused decode steps), its
+        single blocking fetch, and the host-side token processing.  Rows
+        active at call start that finish mid-call burn the call's
+        remaining steps — their surplus tokens are discarded here and
+        counted as wasted (``tpu_dra_serve_wasted_steps_total``); under
+        continuous scheduling a call is one step, so the count stays 0
+        structurally."""
+        jax, jnp = _jax_mods()
+        self._device_steps += self._steps_per_call
+        stepped = [r is not None for r in self._row_req]
+        active = jnp.asarray(stepped, bool)
+        tok = jnp.asarray(self._tok, jnp.int32)
+        pos = jnp.asarray(self._pos, jnp.int32)
+        seeds = jnp.asarray(
+            [r.seed if r is not None else 0 for r in self._row_req],
+            jnp.int32,
+        )
+        if self._kv_layout == "paged":
+            # Snapshot the host tables for this device call — tiny
+            # (slots × NW int32), rebuilt per call so joins and leaves
+            # take effect at the very next step.
+            self._pool, tok, pos, toks, lps = self._paged_step(
+                self.params, self._pool, jnp.asarray(self._table),
+                tok, pos, active, seeds,
+            )
+        else:
+            self._cache, tok, pos, toks, lps = self._step(
+                self.params, self._cache, tok, pos, active, seeds
+            )
+        # ONE blocking fetch per device call (the module-header promise):
+        # tokens, logprobs, next-token, and positions come together.
+        toks, lps, tok_h, pos_h = jax.device_get((toks, lps, tok, pos))
+        self._tok = [int(t) for t in tok_h]
+        self._pos = [int(p) for p in pos_h]
+        for s in range(toks.shape[0]):
+            for row in range(self.slots):
+                if self._row_req[row] is None:
+                    if stepped[row]:
+                        # The fused call kept stepping this row after
+                        # its request finished at an earlier step of the
+                        # same call: FLOPs spent, token discarded.
+                        self._wasted_steps += 1
+                        SERVE_WASTED_STEPS.inc(engine=self.name)
+                    continue
+                self._note_token(
+                    row, int(toks[s, row]), float(lps[s, row])
+                )
 
+    def tick(self) -> "list[Request]":
+        """Admit waiting requests into free rows, run ``steps_per_tick``
+        decode steps (one fused device call under ``scheduling="tick"``;
+        single-step device calls with join/leave BETWEEN steps under
+        ``"continuous"``), process finishes.  Returns requests completed
+        during this tick.  With ``telemetry`` on, every tick appends one
+        StepRecord to the process-global engine flight recorder
+        (``/debug/engine``)."""
         self._check_open()
         t0 = time.perf_counter()
         done_before = len(self._done)
         toks_before = self._tokens_emitted
         admitted, prefix_hits = self._admit()
-        # Occupancy/queue as the device step sees them: after this tick's
-        # admissions, before its finishes.
+        # Occupancy/queue as the first device call sees them: after the
+        # tick's opening admissions, before its finishes.
         occupancy = sum(r is not None for r in self._row_req)
         queue_depth = len(self._queue)
-        if any(r is not None for r in self._row_req):
-            active = jnp.asarray(
-                [r is not None for r in self._row_req], bool
-            )
-            tok = jnp.asarray(self._tok, jnp.int32)
-            pos = jnp.asarray(self._pos, jnp.int32)
-            seeds = jnp.asarray(
-                [r.seed if r is not None else 0 for r in self._row_req],
-                jnp.int32,
-            )
-            if self._kv_layout == "paged":
-                # Snapshot the host tables for this tick's device call —
-                # tiny (slots × NW int32), rebuilt per tick so admissions
-                # and finishes take effect at the next step.
-                self._pool, tok, pos, toks, lps = self._paged_step(
-                    self.params, self._pool, jnp.asarray(self._table),
-                    tok, pos, active, seeds,
-                )
-            else:
-                self._cache, tok, pos, toks, lps = self._step(
-                    self.params, self._cache, tok, pos, active, seeds
-                )
-            # ONE blocking fetch per tick (the module-header promise):
-            # tokens, logprobs, next-token, and positions come together.
-            toks, lps, tok_h, pos_h = jax.device_get((toks, lps, tok, pos))
-            self._tok = [int(t) for t in tok_h]
-            self._pos = [int(p) for p in pos_h]
-            for s in range(toks.shape[0]):
-                for row in range(self.slots):
-                    if self._row_req[row] is None:
-                        continue
-                    self._note_token(
-                        row, int(toks[s, row]), float(lps[s, row])
-                    )
+        calls = self.steps_per_tick if self.scheduling == "continuous" else 1
+        for s in range(calls):
+            if s:
+                # Step-granularity join: rows freed by the previous
+                # step's finishes hand their slot to the FIFO head NOW,
+                # mid-tick (the admission prefill emits the joiner's
+                # first token, and its first decode step runs in this
+                # very call).
+                a, h = self._admit()
+                admitted += a
+                prefix_hits += h
+            if not any(r is not None for r in self._row_req):
+                break
+            self._step_once()
         finished = self._done[done_before:]
         if self.telemetry:
             servestats.RECORDER.record(
@@ -1342,7 +1501,7 @@ class ServeEngine:
         (vocab/window/slot changes across the restart) are skipped, not
         fatal; warming stops early when the pool fills.  The engine must
         be idle (no queued or mid-decode requests)."""
-        import jax.numpy as jnp
+        jnp = _jax_mods()[1]
 
         self._check_open()
         if self._prefix is None:
@@ -1513,6 +1672,33 @@ class ServeEngine:
         per-request block tables) or ``"rows"`` (one engine-max row per
         slot)."""
         return self._kv_layout
+
+    @property
+    def attn_backend(self) -> str:
+        """The RESOLVED decode attention read path: ``"gather"`` (masked
+        pool gather + dense einsums; always the answer on rows layouts)
+        or ``"pallas"`` (the block-streaming paged-attention kernel) —
+        ``attn_backend="auto"`` has already been decided by the time the
+        engine exists."""
+        return self._attn_backend
+
+    @property
+    def wasted_steps(self) -> int:
+        """Device decode steps this engine spent on rows whose request
+        had already finished earlier in the same fused call (surplus
+        tokens discarded host-side) — the tick-granularity overhead.
+        Structurally 0 under ``scheduling="continuous"``; the bench's
+        tick-vs-continuous arms read this (the process-global counter is
+        ``tpu_dra_serve_wasted_steps_total``)."""
+        return self._wasted_steps
+
+    @property
+    def device_steps(self) -> int:
+        """Total device decode steps this engine has executed (each one
+        steps every slot; admission prefills excluded).  Emitting the
+        same tokens in fewer device steps is the continuous-batching
+        win the bench's occupancy probe measures."""
+        return self._device_steps
 
     @property
     def kv_block_stats(self) -> "dict[str, int]":

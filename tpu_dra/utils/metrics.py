@@ -437,6 +437,13 @@ SERVE_KV_ALIAS = REGISTRY.counter(
     "of being copied or recomputed (a prefix hit's zero-copy reuse, "
     "counted in blocks)",
 )
+SERVE_WASTED_STEPS = REGISTRY.counter(
+    "tpu_dra_serve_wasted_steps_total",
+    "Device decode steps executed for a batch row whose request had "
+    "already finished earlier in the same fused tick (the surplus token "
+    "is discarded host-side) — the tick-granularity overhead that "
+    "scheduling='continuous' removes; 0 under continuous batching",
+)
 SERVE_KV_COW = REGISTRY.counter(
     "tpu_dra_serve_kv_cow_total",
     "Copy-on-write block copies at admission: the partial last prompt "
