@@ -10,13 +10,14 @@ TAG     ?= latest
         native-test demo-quickstart bench image clean help \
         observability-smoke perf-smoke explain-smoke serve-smoke \
         serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke paged-smoke \
-        kernel-smoke
+        kernel-smoke kv-smoke
 
 # `analyze` runs the full rule registry — the L-style rules lint would
 # run plus the whole-repo invariants — so `all` needs only one pass.
-# `kernel-smoke` fails fast (seconds) on a Pallas-kernel/gather drift
-# before `test` pays for the full suite.
-all: analyze kernel-smoke test
+# `kernel-smoke` fails fast (seconds) on a Pallas-kernel/gather drift,
+# `kv-smoke` on a /debug/kv or KVPoolPressure regression, before `test`
+# pays for the full suite.
+all: analyze kernel-smoke kv-smoke test
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -101,6 +102,14 @@ paged-smoke:
 kernel-smoke:
 	$(PYTHON) -m pytest tests/test_kernel_smoke.py -q -m 'not slow'
 
+# KV-pool introspection floor (docs/OBSERVABILITY.md "/debug/kv"): a
+# paged engine serves /debug/kv over HTTP (json/text/filters/400s),
+# `tpudra kv` renders it, the collector's capability discovery adopts
+# the endpoint, and KVPoolPressure completes pending -> firing ->
+# resolved over injected-clock scrapes of a starved pool.
+kv-smoke:
+	$(PYTHON) -m pytest tests/test_kv_smoke.py -q -m 'not slow'
+
 # Serving telemetry floor: drives a small engine stream, scrapes /metrics
 # and /debug/engine over HTTP, asserts the TPOT/queue-wait/SLO series and
 # per-engine gauges appear, the step flight recorder serves the ring, a
@@ -152,4 +161,4 @@ help:
 	@echo "         native-test demo-quickstart bench observability-smoke"
 	@echo "         perf-smoke explain-smoke serve-smoke serve-obs-smoke"
 	@echo "         chaos-smoke fleet-smoke obs-top-smoke paged-smoke"
-	@echo "         kernel-smoke image clean"
+	@echo "         kernel-smoke kv-smoke image clean"

@@ -192,6 +192,46 @@ def metric_total(text: str, name: str, **labels) -> float:
     return promparse.total(metric_samples(text), name, **labels)
 
 
+def assert_kv_conserved(engine) -> None:
+    """Block-accounting conservation for a paged ServeEngine, checked
+    from FIRST PRINCIPLES against the engine's own state (never against
+    the allocator's cached counts alone): every block is free, allocated,
+    or scratch (free + allocated + 1 == pool size), and every allocated
+    block's refcount equals its OWNER COUNT — one per live block-table
+    cell pointing at it plus one per resident prefix entry holding it.
+    Call between ticks during alias/COW/evict churn; a leak (refcount
+    without an owner) or a use-after-free (owner without a refcount)
+    fails here long before it corrupts tokens."""
+    assert engine.kv_layout == "paged", "conservation is a paged contract"
+    balloc = engine._balloc
+    stats = balloc.stats()
+    assert (
+        stats["blocks_free"] + stats["blocks_allocated"] + 1
+        == stats["blocks_total"]
+    ), stats
+    owners = {0: 1}  # scratch: the allocator's own immortal reference
+    for row, req in enumerate(engine._row_req):
+        if req is None:
+            # A freed row must be fully zeroed onto scratch — a stale
+            # block id here is exactly the frozen-write corruption the
+            # zeroing discipline exists to prevent.
+            assert not engine._table[row].any(), (row, engine._table[row])
+            continue
+        for b in engine._table[row]:
+            if b:
+                owners[int(b)] = owners.get(int(b), 0) + 1
+    if engine._prefix is not None:
+        for entry in engine._prefix.export_blocks():
+            for b in entry["blocks"]:
+                owners[b] = owners.get(b, 0) + 1
+    for b in range(stats["blocks_total"]):
+        assert balloc.refcount(b) == owners.get(b, 0), (
+            f"block {b}: refcount {balloc.refcount(b)} != "
+            f"{owners.get(b, 0)} owner(s) "
+            f"(owners counted from tables + prefix entries + scratch)"
+        )
+
+
 def assert_metrics_exposed(text: str, names) -> None:
     """Every name is a declared family in the exposition (TYPE line plus
     parseable samples — histograms may expose only their children)."""

@@ -128,6 +128,16 @@ def test_bench_serve_prefix_stanza():
     occ = out["paged_occupancy"]
     assert occ["continuous"]["wasted_steps"] == 0
     assert occ["tick"]["wasted_steps"] > 0
+    # ISSUE 12: phase accounting closes on the measured stream, and the
+    # KVPoolPressure alert completed pending -> firing -> resolved over
+    # the collector on the starved over-subscribed pool.
+    assert out["phases"]["closure_min"] >= 0.95
+    assert set(out["phases"]) >= {"admit", "dispatch", "fetch", "host"}
+    kvp = out["kv_pressure"]
+    assert kvp["completed"]
+    assert kvp["alert_states"] == ["pending", "firing", "resolved"]
+    assert kvp["alias_blocks_before_pressure"] > 0
+    assert kvp["debug_kv_engines"] == 1
     assert occ["device_steps_saved"] > 0
     assert (
         occ["continuous"]["step_slot_utilization"]

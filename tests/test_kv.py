@@ -1,0 +1,437 @@
+"""KV pool introspection (ISSUE 12): per-block records in
+`paged.BlockAllocator`, the jax-free `tpu_dra/obs/kv.py` document
+builder + provider registry, the `/debug/kv` endpoint, the `tpudra kv`
+CLI, and block-accounting conservation under alias/COW/evict churn
+(tests/helpers.assert_kv_conserved)."""
+
+import json
+import random
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_dra.obs import kv as obskv
+from tpu_dra.parallel.burnin import init_params
+from tpu_dra.parallel.paged import BlockAllocator
+from tpu_dra.utils.metrics import REGISTRY, MetricsServer
+
+from helpers import assert_kv_conserved, metric_total
+from test_serve import CFG
+
+
+class TestBlockRecords:
+    """Allocator-side introspection: pure host bookkeeping, no jax."""
+
+    def test_alloc_stamps_birth_and_origin(self):
+        a = BlockAllocator(8, name="rec-test")
+        got = a.alloc(2, step=7)
+        recs = {r["block"]: r for r in a.block_records(current_step=9)}
+        for b in got:
+            assert recs[b]["origin"] == "computed"
+            assert recs[b]["birth_step"] == 7
+            assert recs[b]["last_touch_step"] == 7
+            assert recs[b]["idle_steps"] == 2
+            assert recs[b]["age_s"] >= 0.0
+            assert recs[b]["refcount"] == 1
+        (cow,) = a.alloc(1, step=9, origin="cow")
+        assert a.block_records()[-1]["origin"] == "cow" or any(
+            r["block"] == cow and r["origin"] == "cow"
+            for r in a.block_records()
+        )
+
+    def test_ref_and_unref_touch(self):
+        a = BlockAllocator(8)
+        got = a.alloc(2, step=1)
+        a.ref(got, step=5)
+        recs = {r["block"]: r for r in a.block_records()}
+        assert all(recs[b]["last_touch_step"] == 5 for b in got)
+        assert all(recs[b]["birth_step"] == 1 for b in got)
+
+    def test_free_observes_block_age(self):
+        a = BlockAllocator(4, name="age-test")
+        before = metric_total(
+            REGISTRY.expose(), "tpu_dra_serve_kv_block_age_seconds_count",
+            engine="age-test",
+        )
+        got = a.alloc(2)
+        a.ref(got[:1])  # a second owner on the first block
+        a.unref(got)  # frees got[1] only — one age observation
+        after = metric_total(
+            REGISTRY.expose(), "tpu_dra_serve_kv_block_age_seconds_count",
+            engine="age-test",
+        )
+        assert after == before + 1
+        a.unref(got[:1])  # the last owner lets go — second observation
+        assert metric_total(
+            REGISTRY.expose(), "tpu_dra_serve_kv_block_age_seconds_count",
+            engine="age-test",
+        ) == before + 2
+
+    def test_free_runs_reflect_fragmentation(self):
+        a = BlockAllocator(10)
+        assert a.free_runs() == [9]  # one pristine run, scratch excluded
+        got = a.alloc(9)
+        assert a.free_runs() == []
+        # Free a checkerboard: blocks 2, 4, 6 -> three 1-runs.
+        for b in (2, 4, 6):
+            a.unref([b])
+        assert a.free_runs() == [1, 1, 1]
+        a.unref([3])  # 2..4 coalesce around the still-held 5
+        assert sorted(a.free_runs()) == [1, 3]
+
+    def test_records_exclude_free_and_scratch(self):
+        a = BlockAllocator(6)
+        got = a.alloc(3)
+        a.unref(got[:1])
+        recs = a.block_records()
+        listed = {r["block"] for r in recs}
+        assert 0 not in listed and got[0] not in listed
+        assert listed == set(got[1:])
+
+
+class FakeSnap:
+    """A canned provider: returns the given snapshot until told to die
+    (None = the collected-owner contract)."""
+
+    def __init__(self, snap):
+        self.snap = snap
+
+    def __call__(self):
+        return self.snap
+
+
+def _snap(name="fake-0", **kw):
+    base = {
+        "engine": name,
+        "layout": "paged",
+        "block_size": 4,
+        "table_cols": 3,
+        "device_steps": 10,
+        "blocks_total": 9,
+        "blocks_free": 3,
+        "blocks_allocated": 5,
+        "blocks_aliased": 2,
+        "alias_blocks_total": 7,
+        "cow_blocks_total": 1,
+        "alloc_blocks_total": 12,
+        "free_runs": [1, 2],
+        "blocks": [
+            {"block": 1, "refcount": 3, "origin": "computed",
+             "birth_step": 0, "last_touch_step": 10, "idle_steps": 0,
+             "age_s": 2.0, "owners": ["req:1", "entry:8t", "req:2"]},
+            {"block": 2, "refcount": 1, "origin": "cow",
+             "birth_step": 8, "last_touch_step": 8, "idle_steps": 2,
+             "age_s": 0.2, "owners": ["req:1"]},
+        ],
+    }
+    base.update(kw)
+    return base
+
+
+@pytest.fixture
+def registry():
+    """A clean slate around each registry test: real engines from other
+    suites may be registered in this process — snapshot and restore."""
+    saved = {n: obskv._PROVIDERS[n] for n in obskv.providers()}
+    obskv._PROVIDERS.clear()
+    yield obskv
+    obskv._PROVIDERS.clear()
+    obskv._PROVIDERS.update(saved)
+
+
+class TestKvDoc:
+    """The jax-free document builder over the provider registry."""
+
+    def test_doc_shape_and_derived_distributions(self, registry):
+        registry.register("fake-0", FakeSnap(_snap()))
+        doc = registry.kv_doc()
+        assert doc["count"] == 1
+        (e,) = doc["engines"]
+        assert e["engine"] == "fake-0"
+        assert e["occupancy"] == round(5 / 8, 3)
+        assert e["free_fraction"] == round(3 / 8, 3)
+        sharing = {s["refcount"]: s["blocks"] for s in e["sharing"]}
+        assert sharing == {3: 1, 1: 1}
+        frag = e["fragmentation"]
+        assert frag["runs"] == 2 and frag["longest_run"] == 2
+        assert sum(r["count"] for r in frag["histogram"]) == 2
+        assert sum(r["count"] for r in e["age_histogram"]) == 2
+        assert sum(r["count"] for r in e["heat_histogram"]) == 2
+        # Most-shared block renders first.
+        assert e["blocks"][0]["block"] == 1
+
+    def test_engine_filter_and_limit(self, registry):
+        registry.register("fake-a", FakeSnap(_snap("fake-a")))
+        registry.register("fake-b", FakeSnap(_snap("fake-b")))
+        doc = registry.kv_doc(engine="fake-b")
+        assert [e["engine"] for e in doc["engines"]] == ["fake-b"]
+        assert registry.kv_doc(engine="nope")["count"] == 0
+        doc = registry.kv_doc(limit=1)
+        assert all(
+            len(e["blocks"]) == 1 and e["blocks_omitted"] == 1
+            for e in doc["engines"]
+        )
+
+    def test_dead_provider_auto_unregisters(self, registry):
+        dead = FakeSnap(None)
+        registry.register("gone", dead)
+        registry.register("alive", FakeSnap(_snap("alive")))
+        doc = registry.kv_doc()
+        assert [e["engine"] for e in doc["engines"]] == ["alive"]
+        assert registry.providers() == ["alive"]
+
+    def test_raising_provider_is_skipped_not_dropped(self, registry):
+        """A transient failure (an engine mid-teardown race) skips this
+        read but keeps the registration — only a None return (collected
+        owner) retires a provider permanently."""
+        def boom():
+            raise RuntimeError("mid-teardown")
+
+        registry.register("boom", boom)
+        assert registry.kv_doc()["count"] == 0
+        assert registry.providers() == ["boom"]
+
+    def test_render_text(self, registry):
+        assert "no paged KV pools" in obskv.render_text(
+            {"engines": [], "count": 0}
+        )
+        registry.register("fake-0", FakeSnap(_snap()))
+        text = obskv.render_text(registry.kv_doc())
+        assert "engine fake-0" in text
+        assert "fragmentation: 3 free in 2 run(s), longest 2" in text
+        assert "7 aliased zero-copy" in text and "1 COW" in text
+        assert "req:1,entry:8t,req:2" in text
+        assert "cow" in text
+
+
+def _mini_engine(params, **kw):
+    from tpu_dra.parallel.serve import ServeEngine
+
+    kw.setdefault("slots", 2)
+    kw.setdefault("prompt_slots", 8)
+    kw.setdefault("max_new_cap", 5)
+    kw.setdefault("prefix_cache_slots", 4)
+    kw.setdefault("prefix_window", 2)
+    return ServeEngine(params, CFG, **kw)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG)
+
+
+class TestEngineSnapshot:
+    @pytest.mark.slow  # engine compile + stream (~4s); tier-1 keeps the
+    # smoke's snapshot coverage (test_kv_smoke drives the same surface)
+    def test_snapshot_owners_and_registration(self, params):
+        eng = _mini_engine(params, name="kv-snap-test")
+        try:
+            assert "kv-snap-test" in obskv.providers()
+            system = [3, 1, 4, 1]
+            for t in (5, 9):
+                eng.submit(system + [t], 2)
+            eng.run()
+            snap = eng.kv_snapshot()
+            assert snap["engine"] == "kv-snap-test"
+            assert snap["block_size"] == 2
+            assert snap["blocks_total"] == snap["blocks_free"] + snap[
+                "blocks_allocated"
+            ] + 1
+            # Post-drain, only prefix entries own blocks: every record's
+            # owners are entry tags, and aliased shared-prefix blocks
+            # carry one tag per entry.
+            recs = snap["blocks"]
+            assert recs and all(
+                all(o.startswith("entry:") for o in r["owners"])
+                for r in recs
+            )
+            assert any(r["refcount"] >= 2 for r in recs)
+            for r in recs:
+                assert r["refcount"] == len(r["owners"])
+            # The registered provider serves this snapshot to /debug/kv.
+            doc = obskv.kv_doc(engine="kv-snap-test")
+            assert doc["count"] == 1
+        finally:
+            eng.close()
+        assert "kv-snap-test" not in obskv.providers()
+
+    @pytest.mark.slow  # same: a dedicated 1-slot engine compile
+    def test_mid_decode_owner_is_the_request(self, params):
+        eng = _mini_engine(params, name="kv-owner-test", slots=1)
+        try:
+            eng.submit([7, 7, 6, 5], 3)
+            eng.tick()  # admitted, mid-decode
+            rid = eng._row_req[0].id
+            snap = eng.kv_snapshot()
+            tagged = [
+                r for r in snap["blocks"]
+                if f"req:{rid}" in r["owners"]
+            ]
+            assert tagged, snap["blocks"]
+            eng.run()
+        finally:
+            eng.close()
+
+    def test_rows_engine_has_no_snapshot_or_provider(self, params):
+        eng = _mini_engine(
+            params, name="kv-rows-test", kv_layout="rows",
+        )
+        try:
+            assert eng.kv_snapshot() is None
+            assert "kv-rows-test" not in obskv.providers()
+        finally:
+            eng.close()
+
+
+class TestConservation:
+    @pytest.mark.slow  # engine compile + ~20 asserted ticks; tier-1
+    # keeps conservation coverage via test_paged (per-tick asserts in
+    # the eviction-churn test) and test_kv_smoke
+    def test_conserved_under_randomized_churn(self, params):
+        """The satellite contract: free + allocated + scratch == pool
+        and refcount == owner-count after randomized admission/finish/
+        evict sequences — checked between EVERY tick of a stream sized
+        to force alias, COW, eviction, and park-on-pressure paths."""
+        rng = random.Random(12)
+        # kv_blocks barely above the floor: admission pressure evicts
+        # entries and parks requests, the churn under test.
+        eng = _mini_engine(
+            params, name="kv-churn-test", kv_blocks=16,
+        )
+        try:
+            system = [9, 8, 7, 6]
+            pending = []
+            for i in range(14):
+                prompt = system[: rng.choice((2, 4))] + [
+                    rng.randrange(CFG.vocab) for _ in range(rng.randint(1, 3))
+                ]
+                pending.append((prompt, rng.randint(1, 4)))
+            assert_kv_conserved(eng)
+            for prompt, budget in pending:
+                eng.submit(prompt, budget)
+                # Interleave ticks with submits so admission waves hit
+                # every pool state the stream can produce.
+                if rng.random() < 0.7:
+                    eng.tick()
+                    assert_kv_conserved(eng)
+            for _ in range(200):
+                if not eng.pending:
+                    break
+                eng.tick()
+                assert_kv_conserved(eng)
+            assert not eng.pending
+            stats = eng.kv_block_stats
+            assert stats["alias_blocks_total"] > 0  # churn really aliased
+            assert eng.prefix_stats["evictions"] > 0  # and really evicted
+        finally:
+            eng.close()
+
+
+@pytest.fixture(scope="module")
+def server(params):
+    eng = _mini_engine(params, name="kv-http-test")
+    system = [2, 4, 6, 8]
+    for t in (1, 3, 5):
+        eng.submit(system + [t], 2)
+    eng.run()
+    srv = MetricsServer("127.0.0.1:0")
+    srv.start()
+    yield f"http://127.0.0.1:{srv.port}", eng
+    srv.stop()
+    eng.close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+class TestKvEndpoint:
+    def test_json_document(self, server):
+        url, eng = server
+        doc = json.loads(_get(url + "/debug/kv?engine=kv-http-test"))
+        assert doc["count"] == 1
+        (e,) = doc["engines"]
+        assert e["engine"] == "kv-http-test"
+        for key in (
+            "blocks_total", "blocks_free", "blocks_allocated",
+            "blocks_aliased", "occupancy", "free_fraction",
+            "age_histogram", "heat_histogram", "sharing",
+            "fragmentation", "blocks",
+        ):
+            assert key in e, key
+        assert e["blocks"], "a drained prefix-cached engine parks blocks"
+
+    def test_text_and_filters(self, server):
+        url, _ = server
+        text = _get(url + "/debug/kv?format=text&engine=kv-http-test")
+        assert "engine kv-http-test" in text
+        assert "fragmentation:" in text and "sharing:" in text
+        # Unknown engine: empty document, not an error.
+        doc = json.loads(_get(url + "/debug/kv?engine=nope"))
+        assert doc == {"engines": [], "count": 0}
+
+    def test_bad_queries_are_400(self, server):
+        url, _ = server
+        for query in ("format=xml", "limit=0", "limit=x", "limit=-3"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(url + f"/debug/kv?{query}")
+            assert exc.value.code == 400, query
+
+    def test_index_advertises_kv(self, server):
+        url, _ = server
+        doc = json.loads(_get(url + "/debug/index"))
+        assert "/debug/kv" in doc["endpoints"]
+        assert doc["endpoints"]["/debug/kv"]["engines"] >= 1
+
+    def test_metrics_exposed(self, server):
+        url, _ = server
+        text = _get(url + "/metrics")
+        from helpers import assert_metrics_exposed
+
+        assert_metrics_exposed(
+            text,
+            (
+                "tpu_dra_serve_kv_block_age_seconds",
+                "tpu_dra_serve_kv_free_run_blocks",
+                "tpu_dra_serve_step_phase_seconds",
+            ),
+        )
+        assert metric_total(
+            text, "tpu_dra_serve_kv_free_run_blocks_count",
+            engine="kv-http-test",
+        ) > 0
+
+
+class TestKvCLI:
+    def test_renders_live_snapshot(self, server, capsys):
+        url, _ = server
+        from tpu_dra.cmds import explain
+
+        rc = explain.main(
+            ["kv", "--endpoint", url, "--engine", "kv-http-test"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "engine kv-http-test" in out and "fragmentation:" in out
+
+    def test_json_and_empty_filter(self, server, capsys):
+        url, _ = server
+        from tpu_dra.cmds import explain
+
+        rc = explain.main(["kv", "--endpoint", url, "--format", "json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert "engines" in doc
+        rc = explain.main(["kv", "--endpoint", url, "--engine", "nope"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "no paged KV pools" in out
+
+    def test_unreachable_endpoint_is_an_error(self):
+        from tpu_dra.cmds import explain
+
+        rc = explain.main(
+            ["kv", "--endpoint", "http://127.0.0.1:1", "--limit", "2"]
+        )
+        assert rc == 1

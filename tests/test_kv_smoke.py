@@ -1,0 +1,140 @@
+"""`make kv-smoke` — the ISSUE 12 story end to end, in CI seconds: a
+paged engine serves `/debug/kv` over HTTP (json/text/filters/400s),
+`tpudra kv` renders the same document, the collector's capability
+discovery adopts the endpoint, and `KVPoolPressure` completes
+pending -> firing -> resolved over injected-clock scrapes of a starved
+pool."""
+
+import gc
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_dra.obs.alerts import AlertFlightRecorder, kv_pool_pressure
+from tpu_dra.obs.collector import Endpoint, ObsCollector
+from tpu_dra.parallel.burnin import BurninConfig, init_params
+from tpu_dra.parallel.serve import ServeEngine
+from tpu_dra.utils.metrics import MetricsServer
+
+from helpers import assert_kv_conserved
+
+CFG = BurninConfig(
+    vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=32, batch=4
+)
+SYSTEM = [5, 9, 2, 7]
+
+
+@pytest.fixture(scope="module")
+def rig():
+    # Retire any dead engines' weakref gauge series left by earlier test
+    # modules before this module scrapes the process-global registry.
+    gc.collect()
+    params = init_params(CFG)
+    # kv_blocks at the floor (one worst-case request + COW + scratch):
+    # the over-subscribed phase below must actually starve the pool.
+    eng = ServeEngine(
+        params, CFG, slots=2, prompt_slots=8, max_new_cap=5,
+        prefix_cache_slots=4, prefix_window=2, kv_blocks=9,
+        name="kv-smoke",
+    )
+    srv = MetricsServer("127.0.0.1:0")
+    srv.start()
+    yield eng, f"http://127.0.0.1:{srv.port}"
+    srv.stop()
+    eng.close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def test_kv_story_over_http(rig, capsys):
+    eng, url = rig
+
+    # -- 1. shared-prefix traffic: aliases + parked entries ------------------
+    for t in (1, 3):
+        eng.submit(SYSTEM + [t], 2)
+    eng.run()
+    assert_kv_conserved(eng)
+    assert eng.kv_block_stats["alias_blocks_total"] > 0
+
+    # -- 2. /debug/kv over HTTP: json, text, filters, 400s -------------------
+    doc = json.loads(_get(url + "/debug/kv?engine=kv-smoke"))
+    assert doc["count"] == 1
+    (e,) = doc["engines"]
+    assert e["blocks_allocated"] > 0 and e["blocks"]
+    assert e["fragmentation"]["runs"] >= 1
+    assert any(r["count"] for r in e["age_histogram"])
+    text = _get(url + "/debug/kv?format=text")
+    assert "engine kv-smoke" in text and "fragmentation:" in text
+    assert json.loads(_get(url + "/debug/kv?engine=nope")) == {
+        "engines": [], "count": 0,
+    }
+    for bad in ("format=xml", "limit=0", "limit=x"):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(url + f"/debug/kv?{bad}")
+        assert exc.value.code == 400, bad
+    index = json.loads(_get(url + "/debug/index"))
+    assert "/debug/kv" in index["endpoints"]
+    assert "phase_s" in index["endpoints"]["/debug/engine"]["fields"]
+
+    # -- 3. the CLI renders the same document --------------------------------
+    from tpu_dra.cmds import explain
+
+    rc = explain.main(["kv", "--endpoint", url, "--engine", "kv-smoke"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "engine kv-smoke" in out and "sharing:" in out
+
+    # -- 4. KVPoolPressure lifecycle over the collector ----------------------
+    recorder = AlertFlightRecorder()
+    collector = ObsCollector(
+        [Endpoint(url, name="serve")],
+        rules=[
+            kv_pool_pressure(
+                free_frac_threshold=0.35, window_s=8.0, for_s=2.0
+            )
+        ],
+        recorder=recorder,
+    )
+    try:
+        # Capability discovery adopted the endpoint: the fleet-wide KV
+        # view is one call, no hand-wiring (the /debug/index satellite).
+        collector.scrape_once(now_mono=1000.0)
+        kv_docs = collector.fetch_kv()
+        assert [d["engine"] for d in kv_docs] == ["kv-smoke"]
+        assert kv_docs[0]["endpoint"] == "serve"
+
+        # Baseline alias traffic inside the rate window: another
+        # shared-prefix request aliases resident blocks between scrapes.
+        eng.submit(SYSTEM + [11], 2)
+        eng.run()
+        collector.scrape_once(now_mono=1004.0)
+        assert collector.engine.status()[0]["state"] == "ok"
+
+        # Starve the pool: two worst-case requests mid-decode pin nearly
+        # every block; no new aliases land -> the alias rate's recent
+        # half-window falls below the full window while free drains.
+        eng.submit(list(range(20, 27)), 5, use_prefix_cache=False)
+        eng.submit(list(range(30, 37)), 5, use_prefix_cache=False)
+        eng.tick()  # admit + first steps; stays mid-decode
+        assert_kv_conserved(eng)
+        events = collector.scrape_once(now_mono=1006.0)
+        assert [e.state for e in events] == ["pending"]
+        events = collector.scrape_once(now_mono=1008.5)  # for_s elapsed
+        assert [e.state for e in events] == ["firing"]
+
+        # Recovery: drain the stream and evict the parked entries — the
+        # free fraction comes back and the alert resolves.
+        eng.run()
+        while eng._prefix.evict_one():
+            pass
+        assert_kv_conserved(eng)
+        events = collector.scrape_once(now_mono=1010.0)
+        assert [e.state for e in events] == ["resolved"]
+        states = [ev.state for ev in recorder.query()]
+        assert states == ["pending", "firing", "resolved"]
+    finally:
+        collector.close()

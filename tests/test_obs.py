@@ -191,15 +191,22 @@ class TestCollectorScrape:
 
 
 class FakeView:
-    """Minimal alert-rule view: canned rates/levels + endpoint health."""
+    """Minimal alert-rule view: canned rates/levels + endpoint health.
+    Rates resolve most-specific first: ``(name, window_s)`` (a rule that
+    compares two windows of one series, like KVPoolPressure), then
+    ``(name,) + labels``, then ``(name,)``."""
 
-    def __init__(self, rates=None, deltas=None, maxes=None, health=()):
+    def __init__(self, rates=None, deltas=None, maxes=None, values=None,
+                 health=()):
         self.rates = rates or {}
         self.deltas = deltas or {}
         self.maxes = maxes or {}
+        self.values = values or {}
         self.health = list(health)
 
     def rate(self, name, *, window_s=60.0, endpoint=None, **labels):
+        if (name, window_s) in self.rates:
+            return self.rates[(name, window_s)]
         key = (name,) + tuple(sorted(labels.items()))
         return self.rates.get(key, self.rates.get((name,), 0.0))
 
@@ -208,6 +215,10 @@ class FakeView:
 
     def max_value(self, name, *, endpoint=None, **labels):
         return self.maxes.get(name)
+
+    def value(self, name, *, endpoint=None, **labels):
+        key = (name,) + tuple(sorted(labels.items()))
+        return self.values.get(key, self.values.get((name,)))
 
     def endpoint_health(self, now_mono=None):
         return self.health
@@ -345,6 +356,48 @@ class TestDefaultRules:
         fired, detail = self.fire(rule, FakeView(health=down))
         assert fired and "b" in detail
 
+    def test_kv_pool_pressure(self):
+        rule = obsalerts.kv_pool_pressure(
+            free_frac_threshold=0.2, window_s=60.0
+        )
+        # No paged pools exposed: quiet, with the reason in the detail.
+        fired, detail = self.fire(rule, FakeView())
+        assert not fired and "no paged" in detail
+        starved_falling = FakeView(
+            values={
+                ("tpu_dra_serve_kv_blocks", ("state", "free")): 2.0,
+                ("tpu_dra_serve_kv_blocks", ("state", "allocated")): 38.0,
+            },
+            rates={
+                # Recent half-window alias rate below the full window:
+                # sharing is decaying while the pool drains.
+                ("tpu_dra_serve_kv_alias_total", 30.0): 0.1,
+                ("tpu_dra_serve_kv_alias_total", 60.0): 2.0,
+            },
+        )
+        fired, detail = self.fire(rule, starved_falling)
+        assert fired and "free 5.0%" in detail
+        # Same starvation but sharing still climbing: healthy saturation.
+        starved_climbing = FakeView(
+            values=starved_falling.values,
+            rates={
+                ("tpu_dra_serve_kv_alias_total", 30.0): 3.0,
+                ("tpu_dra_serve_kv_alias_total", 60.0): 2.0,
+            },
+        )
+        assert not self.fire(rule, starved_climbing)[0]
+        # Starved with sharing already dead (no alias traffic at all)
+        # fires too — a cache-less paged pool can still starve.
+        assert self.fire(rule, FakeView(values=starved_falling.values))[0]
+        # Plenty of headroom: quiet regardless of the alias trend.
+        roomy = FakeView(
+            values={
+                ("tpu_dra_serve_kv_blocks", ("state", "free")): 30.0,
+                ("tpu_dra_serve_kv_blocks", ("state", "allocated")): 10.0,
+            }
+        )
+        assert not self.fire(rule, roomy)[0]
+
     def test_default_rules_names_are_stable(self):
         names = [r.name for r in obsalerts.default_rules()]
         assert names == [
@@ -352,6 +405,7 @@ class TestDefaultRules:
             "FleetQueueGrowth",
             "ClaimEvictionSpike",
             "FleetDigestStale",
+            "KVPoolPressure",
             "ScrapeDown",
         ]
 
@@ -404,9 +458,25 @@ class TestDebugIndex:
         assert "/debug/traces" in eps
         assert eps["/debug/traces"]["recorded"] >= 0
         # servestats is imported in this process (the test suite drags it
-        # in), so the engine ring must be listed with counts.
+        # in), so the engine ring must be listed with counts — and must
+        # advertise the step-phase record shape, the capability a
+        # collector checks before asking for phase data.
         assert "/debug/engine" in eps
-        assert set(eps["/debug/engine"]) == {"kind", "recorded", "dropped"}
+        assert set(eps["/debug/engine"]) == {
+            "kind", "recorded", "dropped", "fields",
+        }
+        assert "phase_s" in eps["/debug/engine"]["fields"]
+        # /debug/kv is advertised exactly when obs.kv is LOADED (paged
+        # engines load it when they register; tpu_dra.obs itself keeps
+        # it lazy so a collector binary doesn't advertise an empty
+        # endpoint).  Load it here and re-fetch: the capability appears.
+        from tpu_dra.obs import kv as _obskv  # noqa: F401
+
+        doc = json.loads(_get(url + "/debug/index"))
+        eps = doc["endpoints"]
+        assert "/debug/kv" in eps
+        assert eps["/debug/kv"]["kind"] == "kv"
+        assert eps["/debug/kv"]["engines"] >= 0
 
     def test_index_reflects_active_collector(self, rig):
         _, _, url, collector = rig

@@ -18,6 +18,7 @@ from tpu_dra.parallel.paged import (
 from tpu_dra.parallel.prefixcache import PagedPrefixCache
 from tpu_dra.parallel.serve import ServeEngine
 
+from helpers import assert_kv_conserved
 from test_serve import CFG
 from test_serve_prefix import SHARED, STREAM, isolated
 
@@ -211,7 +212,15 @@ class TestPagedEngineExactness:
         eng = _engine(
             params, slots=3, prefix_cache_slots=4, kv_blocks=24
         )
-        on = _drain(eng, reqs)
+        ids = [eng.submit(p, b) for p, b in reqs]
+        # Conservation BETWEEN ticks while the churn runs (the ISSUE 12
+        # helper): free + allocated + scratch == pool and refcount ==
+        # owner-count at every between-steps boundary, not only at rest.
+        while eng.pending:
+            eng.tick()
+            assert_kv_conserved(eng)
+        done = {r.id: r for r in eng._done}
+        on = [tuple(done[i].tokens) for i in ids]
         assert on == off
         assert eng.prefix_stats["evictions"] > 0
         assert eng.prefix_stats["hits"] > 0
@@ -265,6 +274,7 @@ class TestBlockAdmissionControl:
         # control must neither admit nor evict.
         assert eng.queue_depth == 1
         assert eng.prefix_stats["evictions"] == 0
+        assert_kv_conserved(eng)  # parking must not strand any blocks
         done = {r.id: r for r in eng.run()}
         assert len(done) == 2  # no deadlock: b admitted after a finished
         assert done[b].finish_reason == "budget"

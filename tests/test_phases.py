@@ -1,0 +1,203 @@
+"""Step-phase profiler (ISSUE 12): every engine tick decomposed into
+admit/dispatch/fetch/host on the monotonic clock — phase accounting must
+CLOSE (the phases tile the tick), ride StepRecord/`/debug/engine`/the
+``tpu_dra_serve_step_phase_seconds`` histogram, vanish with
+``telemetry=False``, and arm the ``profile_steps`` jax.profiler deep
+mode."""
+
+import os
+
+import pytest
+
+from tpu_dra.parallel.burnin import init_params
+from tpu_dra.parallel.serve import ServeEngine
+from tpu_dra.utils import servestats
+from tpu_dra.utils.metrics import REGISTRY
+
+from helpers import metric_total
+from test_serve import CFG
+
+N_REQS = 6
+
+
+@pytest.fixture(scope="module")
+def stream():
+    params = init_params(CFG)
+    eng = ServeEngine(
+        params, CFG, slots=2, prompt_slots=8, max_new_cap=4,
+        prefix_cache_slots=4, name="phase-test",
+    )
+    system = [5, 9, 2, 7]
+    for t in range(1, N_REQS + 1):
+        eng.submit(system + [t], 3)
+    eng.run()
+    yield eng
+    eng.close()
+
+
+def _records(eng):
+    return servestats.RECORDER.query(engine=eng.name)
+
+
+class TestPhaseAccounting:
+    def test_phases_close_on_worked_ticks(self, stream):
+        """The acceptance bar: sum(phase_s) / step_wall_s >= 0.95 on
+        every tick that did device work — the four phases tile the tick,
+        the residue is loop control and record construction.  A 1ms
+        ABSOLUTE residual is also accepted: the glue between stamps is
+        a fixed few-hundred-µs of interpreter work (plus whatever GC
+        pause lands there), which is >5% only of toy sub-5ms ticks —
+        on any real tick the relative bar governs."""
+        recs = [r for r in _records(stream) if r.tokens > 0]
+        assert recs, "the stream must have recorded worked ticks"
+        for r in recs:
+            total = sum(r.phase_s.values())
+            assert set(r.phase_s) == set(servestats.PHASES)
+            assert total <= r.step_wall_s * 1.001  # phases never overlap
+            residual = r.step_wall_s - total
+            assert total >= 0.95 * r.step_wall_s or residual <= 0.001, (
+                r.seq, r.phase_s, r.step_wall_s
+            )
+
+    def test_phase_semantics(self, stream):
+        """Admissions land in admit, decode work in dispatch+fetch, token
+        processing in host — a tick that admitted pays admit-phase time,
+        and every worked tick pays nonzero dispatch and fetch."""
+        recs = _records(stream)
+        admitting = [r for r in recs if r.admitted]
+        assert admitting
+        assert all(r.phase_s["admit"] > 0 for r in admitting)
+        worked = [r for r in recs if r.tokens > r.admitted]
+        assert worked  # ticks whose tokens came from decode steps
+        for r in worked:
+            assert r.phase_s["dispatch"] > 0
+            assert r.phase_s["fetch"] > 0
+            assert r.phase_s["host"] > 0
+
+    def test_record_dict_and_summary_carry_phases(self, stream):
+        recs = _records(stream)
+        d = recs[0].to_dict()
+        assert set(d["phase_s"]) == set(servestats.PHASES)
+        summary = servestats.summarize(recs)
+        phases = summary["phases"]
+        assert set(phases) == set(servestats.PHASES)
+        for p in servestats.PHASES:
+            assert {"p50_s", "p95_s", "fraction"} <= phases[p].keys()
+        # The fractions cover >= 95% of recorded wall (closure, summed).
+        assert sum(v["fraction"] for v in phases.values()) >= 0.95
+        dom, frac = servestats.dominant_phase(phases)
+        assert dom in servestats.PHASES and frac == phases[dom]["fraction"]
+
+    def test_render_text_shows_phases(self, stream):
+        text = servestats.render_text(_records(stream))
+        assert "phases:" in text and "dominant:" in text
+        for p in servestats.PHASES:
+            assert p in text
+
+    def test_histogram_series_per_phase(self, stream):
+        text = REGISTRY.expose()
+        for p in servestats.PHASES:
+            assert metric_total(
+                text, "tpu_dra_serve_step_phase_seconds_count",
+                engine="phase-test", phase=p,
+            ) > 0, p
+
+    def test_summarize_without_phases_omits_them(self):
+        recs = [servestats.StepRecord(engine="old", tokens=1,
+                                      step_wall_s=0.01)]
+        assert "phases" not in servestats.summarize(recs)
+        assert "phases:" not in servestats.render_text(recs)
+
+
+class TestTelemetryOff:
+    def test_no_phase_records_or_observations(self):
+        params = init_params(CFG)
+        before = metric_total(
+            REGISTRY.expose(), "tpu_dra_serve_step_phase_seconds_count",
+            engine="phase-off-test",
+        )
+        eng = ServeEngine(
+            params, CFG, slots=1, prompt_slots=8, max_new_cap=3,
+            telemetry=False, name="phase-off-test",
+        )
+        try:
+            eng.submit([1, 2, 3], 2)
+            eng.run()
+            assert servestats.RECORDER.query(engine="phase-off-test") == []
+            assert metric_total(
+                REGISTRY.expose(),
+                "tpu_dra_serve_step_phase_seconds_count",
+                engine="phase-off-test",
+            ) == before
+        finally:
+            eng.close()
+
+
+@pytest.mark.slow
+class TestProfileSteps:
+    """slow: each jax.profiler capture costs ~10-20s of trace writing
+    on CPU — the 870s tier-1 cap cannot afford three of them (CI runs
+    --runslow)."""
+
+    def test_capture_arms_counts_down_and_writes_a_trace(
+        self, stream, tmp_path
+    ):
+        eng = stream
+        trace_dir = str(tmp_path / "trace")
+        got = eng.profile_steps(2, trace_dir)
+        assert got == trace_dir and eng.profiling
+        eng.submit([5, 9, 2, 7, 1], 3)
+        eng.run()
+        assert not eng.profiling
+        assert eng.profile_error == "", eng.profile_error
+        files = [
+            os.path.join(r, f)
+            for r, _, fs in os.walk(trace_dir)
+            for f in fs
+        ]
+        assert files, "the deep profile must leave a device trace on disk"
+
+    def test_knob_validation_and_single_capture(self, stream):
+        with pytest.raises(ValueError, match="n >= 1"):
+            stream.profile_steps(0)
+        stream.profile_steps(1)
+        try:
+            with pytest.raises(RuntimeError, match="already armed"):
+                stream.profile_steps(1)
+        finally:
+            # Drain the armed capture so later tests see a quiet engine —
+            # budget 2 so at least one DEVICE call runs (a budget-1
+            # request finishes at its admission token and would leave
+            # the capture armed forever).
+            stream.submit([1, 2], 2)
+            stream.run()
+        assert not stream.profiling
+
+    def test_default_dir_is_minted(self, stream):
+        d = stream.profile_steps(1)
+        assert os.path.isdir(d)
+        stream.submit([3, 4], 2)
+        stream.run()
+        assert not stream.profiling
+
+    def test_close_stops_inflight_capture(self, tmp_path):
+        """The jax.profiler session is process-wide: a capture left
+        running by a closed engine would wedge every later start_trace
+        — close() must stop it."""
+        params = init_params(CFG)
+        eng = ServeEngine(
+            params, CFG, slots=1, prompt_slots=8, max_new_cap=4,
+            name="phase-close-test",
+        )
+        eng.profile_steps(5, str(tmp_path / "t"))
+        eng.submit([1, 2, 3], 3)
+        eng.tick()  # the capture starts; 4 of 5 calls still armed
+        assert eng.profiling
+        eng.close()
+        assert not eng.profiling
+        assert eng.profile_error == "", eng.profile_error
+        # The session really was released: a fresh capture can start.
+        import jax
+
+        jax.profiler.start_trace(str(tmp_path / "t2"))
+        jax.profiler.stop_trace()

@@ -136,6 +136,10 @@ class Config:
         "tpu_dra/obs/collector.py",
         "tpu_dra/obs/alerts.py",
         "tpu_dra/obs/cluster.py",
+        "tpu_dra/obs/kv.py",
+        # Block birth/age records feed the /debug/kv age histograms: a
+        # wall-clock read here would let an NTP step fake block ages.
+        "tpu_dra/parallel/paged.py",
     )
     # Where the metric registry lives and which doc must list every metric.
     metric_prefix: str = "tpu_dra_"

@@ -24,6 +24,12 @@ recorder from the ``/debug/engine`` endpoint (utils/servestats.py):
     14.80ms, goodput 0.92 (11 met / 1 missed)
     ...one row per tick...
 
+`tpudra kv` looks inside the paged KV pool the same process serves —
+"where did my blocks go?" — rendering ``/debug/kv`` (tpu_dra/obs/kv.py):
+pool occupancy, per-block age/heat, the alias-sharing distribution, and
+free-list fragmentation, the inputs block-level eviction and defrag
+decisions are made from.
+
 `tpudra fleet-stats` is the fleet-router layer above it — "why did my
 request land on THAT replica?" — rendering the placement flight
 recorder from ``/debug/fleet`` (tpu_dra/fleet/stats.py): per-replica
@@ -170,6 +176,27 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
     stats.add_argument(
         "--limit", type=int, default=256,
         help="max step records to fetch",
+    )
+
+    kv = sub.add_parser(
+        "kv",
+        help="paged KV pool introspection from /debug/kv (occupancy, "
+        "block age/heat, sharing, fragmentation)",
+    )
+    _add_endpoint_args(kv, env="TPUDRA_ENGINE", what="serve process")
+    kv.add_argument(
+        "--engine",
+        default="",
+        help="only this engine's pool (the ServeEngine name)",
+    )
+    kv.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output form (text: per-pool summary + block table; "
+        "json: the raw document)",
+    )
+    kv.add_argument(
+        "--limit", type=int, default=256,
+        help="max per-block records to fetch per engine",
     )
 
     fleet = sub.add_parser(
@@ -371,6 +398,43 @@ def serve_stats(args: argparse.Namespace, out=None) -> int:
     return 0
 
 
+def _fetch_kv(args: argparse.Namespace) -> dict:
+    return fetch_debug(
+        args.endpoint, args.pprof_path, "kv",
+        {"limit": args.limit, "engine": args.engine},
+    )
+
+
+def kv_cmd(args: argparse.Namespace, out=None) -> int:
+    from tpu_dra.obs import kv as obskv
+
+    # Call-time stream resolution, like serve_stats.
+    out = sys.stdout if out is None else out
+    try:
+        doc = _fetch_kv(args)
+    except (urllib.error.URLError, OSError) as e:
+        print(
+            f"error: cannot reach serve endpoint at {args.endpoint}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    if args.format == "json":
+        print(json.dumps(doc, indent=2), file=out)
+    elif not doc.get("engines"):
+        which = f" named {args.engine!r}" if args.engine else ""
+        print(
+            f"no paged KV pools registered{which} at this endpoint "
+            "(rows-layout engines have no blocks; is a paged ServeEngine "
+            "running in that process?)",
+            file=out,
+        )
+    else:
+        # render_text consumes the fetched document, so the CLI output
+        # is byte-identical to /debug/kv?format=text on the server.
+        print(obskv.render_text(doc), end="", file=out)
+    return 0
+
+
 def _fetch_fleet(args: argparse.Namespace) -> dict:
     return fetch_debug(
         args.endpoint, args.pprof_path, "fleet",
@@ -521,6 +585,8 @@ def main(argv: "list[str] | None" = None) -> int:
         return explain(args)
     if args.command == "serve-stats":
         return serve_stats(args)
+    if args.command == "kv":
+        return kv_cmd(args)
     if args.command == "fleet-stats":
         return fleet_stats(args)
     if args.command == "top":

@@ -18,6 +18,9 @@ pane of glass over all of them:
   flight recorder (the ``controller/decisions.py`` ring shape).
 - ``cluster``     — the ``/debug/cluster`` document and the ``tpudra
   top`` / ``tpudra alerts`` renderings.
+- ``kv``          — KV-pool introspection: the ``/debug/kv`` document
+  and the ``tpudra kv`` rendering over engine-registered pool
+  snapshot providers (per-block age/heat, sharing, fragmentation).
 
 jax-free ON PURPOSE (the ``fleet``/``servestats`` discipline, enforced
 by the A101-A103 gate): the collector is control-plane code that must
@@ -26,4 +29,18 @@ run in any binary — or its own tiny pod — without paying a jax import.
 
 from tpu_dra.obs import alerts, cluster, collector, promparse  # noqa: F401
 
-__all__ = ["alerts", "cluster", "collector", "promparse"]
+__all__ = ["alerts", "cluster", "collector", "kv", "promparse"]
+
+
+def __getattr__(name: str):
+    # `kv` loads LAZILY on purpose (the fleet/__init__ PEP 562 shape):
+    # /debug/index advertises /debug/kv exactly when the module is
+    # loaded, and it is the paged engines that load it (registering
+    # their snapshot providers) — a collector pod or rows-layout binary
+    # that merely imports tpu_dra.obs must not advertise an empty
+    # introspection endpoint and draw useless fetch_kv traffic.
+    if name == "kv":
+        import importlib
+
+        return importlib.import_module("tpu_dra.obs.kv")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -19,7 +19,9 @@ operators expect from Prometheus alerting —
 The default rule set covers the failure modes the existing planes
 actually exhibit: serve-goodput SLO **burn rate** (error budget spent
 per unit time, the SRE-workbook shape), fleet queue growth, claim
-eviction spikes (node kills), prefix-digest staleness, and scrape-down.
+eviction spikes (node kills), prefix-digest staleness, paged KV pool
+pressure (free blocks low while zero-copy sharing falls), and
+scrape-down.
 
 Rule expressions receive the collector itself and use its view protocol
 (``rate`` / ``delta`` / ``max_value`` / ``endpoint_health``), so custom
@@ -421,6 +423,54 @@ def digest_staleness(
     )
 
 
+def kv_pool_pressure(
+    *,
+    free_frac_threshold: float = 0.1,
+    window_s: float = 60.0,
+    for_s: float = 0.0,
+) -> AlertRule:
+    """Paged KV pool starving: the free-block fraction
+    (``tpu_dra_serve_kv_blocks{state}``) is below threshold while
+    zero-copy sharing (``tpu_dra_serve_kv_alias_total``) is falling —
+    the eviction-storm signature: admission pressure evicts prefix
+    entries, which shrinks the alias credit, which raises every later
+    admission's block demand further.  "Falling" compares the alias
+    rate over the recent half-window against the full window (or no
+    alias traffic at all — a starved pool with sharing already dead
+    fires too); a busy pool whose sharing still climbs is healthy
+    saturation, not pressure."""
+
+    def expr(view):
+        free = view.value("tpu_dra_serve_kv_blocks", state="free")
+        allocated = view.value("tpu_dra_serve_kv_blocks", state="allocated")
+        if free is None or allocated is None or free + allocated <= 0:
+            return False, 0.0, "no paged KV pools exposed"
+        frac = free / (free + allocated)
+        recent = view.rate(
+            "tpu_dra_serve_kv_alias_total",
+            window_s=max(1e-9, window_s / 2),
+        )
+        baseline = view.rate(
+            "tpu_dra_serve_kv_alias_total", window_s=window_s
+        )
+        falling = baseline <= 0.0 or recent < baseline
+        return (
+            frac < free_frac_threshold and falling,
+            round(frac, 4),
+            f"free {frac:.1%} of pool, alias rate "
+            f"{recent:.2f}/s recent vs {baseline:.2f}/s window",
+        )
+
+    return AlertRule(
+        name="KVPoolPressure",
+        expr=expr,
+        for_s=for_s,
+        severity="warn",
+        description=f"paged KV free blocks < {free_frac_threshold:.0%} "
+        "of pool while zero-copy alias rate falls (eviction storm)",
+    )
+
+
 def scrape_down(*, for_s: float = 0.0) -> AlertRule:
     """One or more scrape targets unreachable — the observability plane's
     own liveness.  Fires from scrape health, not from scraped data, so
@@ -460,5 +510,6 @@ def default_rules(
         fleet_queue_growth(window_s=window_s, for_s=for_s),
         eviction_spike(window_s=window_s, for_s=for_s),
         digest_staleness(stale_after_s=max(window_s * 5, 1.0), for_s=for_s),
+        kv_pool_pressure(window_s=window_s, for_s=for_s),
         scrape_down(for_s=for_s),
     ]

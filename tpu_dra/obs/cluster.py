@@ -4,8 +4,10 @@ top`` / ``tpudra alerts`` renderings.
 ``cluster_doc`` reduces the collector's state to one JSON document: per
 -endpoint scrape health plus the handful of derived signals an operator
 triages by (span throughput, serve occupancy/queue, goodput, eviction
-and rejection rates — each computed from the series rings over a query
--able window), current alert status, and the recent alert transitions.
+and rejection rates, the dominant step phase, paged-KV free-block
+fraction, and wasted steps — each computed from the series rings over a
+query-able window), current alert status, and the recent alert
+transitions.
 ``render_text`` is the same document as a terminal dashboard (what
 ``tpudra top`` draws, and ``/debug/cluster?format=text`` serves);
 ``render_alerts_text`` is the alert-centric cut for ``tpudra alerts``.
@@ -38,9 +40,47 @@ def endpoint_row(collector, health: dict, window_s: float) -> dict:
     )
     if met + missed > 0:
         goodput = round(met / (met + missed), 3)
+    # Step-phase attribution: the per-phase histogram _sum series rate
+    # is seconds-of-phase per second of wall — the phase with the
+    # largest share of the window is where this endpoint's engine steps
+    # went (None when the endpoint exposes no phase series).
+    phase_rates = {
+        p: collector.rate(
+            "tpu_dra_serve_step_phase_seconds_sum",
+            window_s=window_s,
+            endpoint=name,
+            phase=p,
+        )
+        for p in ("admit", "dispatch", "fetch", "host")
+    }
+    phase_total = sum(phase_rates.values())
+    dominant_phase = dominant_phase_frac = None
+    if phase_total > 0:
+        dominant_phase = max(phase_rates, key=phase_rates.get)
+        dominant_phase_frac = round(
+            phase_rates[dominant_phase] / phase_total, 3
+        )
+    # Paged-pool headroom: free / (free + allocated) across this
+    # endpoint's engines (None when no paged pool is exposed — absent
+    # is not zero, a rows engine has no blocks).
+    kv_free = collector.value(
+        "tpu_dra_serve_kv_blocks", endpoint=name, state="free"
+    )
+    kv_alloc = collector.value(
+        "tpu_dra_serve_kv_blocks", endpoint=name, state="allocated"
+    )
+    kv_free_frac = None
+    if kv_free is not None and kv_alloc is not None and kv_free + kv_alloc > 0:
+        kv_free_frac = round(kv_free / (kv_free + kv_alloc), 3)
     out = dict(health)
     out.update(
         {
+            "dominant_phase": dominant_phase,
+            "dominant_phase_frac": dominant_phase_frac,
+            "kv_free_frac": kv_free_frac,
+            "wasted_steps": collector.value(
+                "tpu_dra_serve_wasted_steps_total", endpoint=name
+            ),
             "spans_per_s": round(
                 collector.rate(
                     "tpu_dra_trace_spans_total",
@@ -138,9 +178,17 @@ def render_text(doc: dict) -> str:
     out.append(
         f"{'endpoint':<22} {'up':<4} {'stale_s':>7} {'scrape_ms':>9} "
         f"{'series':>6} {'spans/s':>8} {'occ':>5} {'queue':>5} "
-        f"{'goodput':>7} {'evic/s':>7} {'rej/s':>7}"
+        f"{'goodput':>7} {'evic/s':>7} {'rej/s':>7} {'phase':>12} "
+        f"{'kvfree':>6} {'wasted':>6}"
     )
     for row in doc["endpoints"]:
+        if row.get("dominant_phase"):
+            phase = (
+                f"{row['dominant_phase']} "
+                f"{row['dominant_phase_frac']:.0%}"
+            )
+        else:
+            phase = "-"
         out.append(
             f"{row['endpoint']:<22} {'UP' if row['up'] else 'DOWN':<4} "
             f"{_fmt(row['staleness_s'], 7)} "
@@ -148,7 +196,9 @@ def render_text(doc: dict) -> str:
             f"{_fmt(row['series'], 6)} {_fmt(row['spans_per_s'], 8)} "
             f"{_fmt(row['occupancy'], 5, 0)} {_fmt(row['queue_depth'], 5, 0)} "
             f"{_fmt(row['goodput'], 7, 3)} {_fmt(row['evictions_per_s'], 7, 3)} "
-            f"{_fmt(row['rejections_per_s'], 7, 3)}"
+            f"{_fmt(row['rejections_per_s'], 7, 3)} {phase:>12} "
+            f"{_fmt(row.get('kv_free_frac'), 6, 3)} "
+            f"{_fmt(row.get('wasted_steps'), 6, 0)}"
         )
     if not doc["endpoints"]:
         out.append("(no endpoints configured)")

@@ -586,6 +586,40 @@ class ObsCollector:
         )
         return records
 
+    # -- cross-process KV introspection ---------------------------------------
+
+    def fetch_kv(self, engine: "str | None" = None) -> "list[dict]":
+        """Merged ``/debug/kv`` engine documents from every endpoint
+        whose ``/debug/index`` advertises the path (capability
+        discovery — a process without a paged pool is never asked).
+        Each document gains an ``endpoint`` field naming where it came
+        from; fetch failures skip the endpoint, the fleet-wide pool view
+        is best-effort like the trace join."""
+        with self._lock:
+            states = list(self._states.values())
+        out: "list[dict]" = []
+        for state in states:
+            ep = state.endpoint
+            if not state.serves(f"{ep.pprof_path}/kv"):
+                continue
+            query = {"format": "json"}
+            if engine:
+                query["engine"] = engine
+            url = (
+                f"{ep.url}{ep.pprof_path}/kv?"
+                + urllib.parse.urlencode(query)
+            )
+            try:
+                doc = json.loads(self._get(url))
+            except Exception as e:
+                logger.debug("kv fetch from %s failed: %s", ep.url, e)
+                continue
+            for eng_doc in doc.get("engines", []):
+                merged = dict(eng_doc)
+                merged["endpoint"] = ep.name
+                out.append(merged)
+        return out
+
     def assemble_trace_tree(self, trace_id: "str | None" = None) -> str:
         """The merged claim lifecycle as a text tree (trace.render_tree
         over the cross-endpoint join)."""

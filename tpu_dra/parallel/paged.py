@@ -45,6 +45,8 @@ is `prefixcache.PagedPrefixCache`.  Usage guide: docs/SERVING.md
 
 from __future__ import annotations
 
+import time
+
 from tpu_dra.parallel.burnin import BurninConfig
 from tpu_dra.parallel.decode import (
     _check_prefix_window,
@@ -53,6 +55,7 @@ from tpu_dra.parallel.decode import (
     _run_blocks,
     _validate,
 )
+from tpu_dra.utils.metrics import SERVE_KV_BLOCK_AGE_SECONDS
 
 __all__ = [
     "BlockAllocator",
@@ -369,19 +372,38 @@ class BlockAllocator:
     caller's table cell); ``ref`` adds an owner (a radix entry aliasing
     the block, or a second request's table cell); ``unref`` drops one and
     returns the block to the free list at zero.  A block with refcount
-    >= 2 is shared and must never be written (the engine's COW rule)."""
+    >= 2 is shared and must never be written (the engine's COW rule).
 
-    def __init__(self, num_blocks: int):
+    Introspection (docs/OBSERVABILITY.md "/debug/kv"): every allocated
+    block carries a host-side record — birth time (monotonic clock),
+    birth/last-touch step (the caller's device-step counter), and origin
+    (``computed`` for fresh prefill blocks, ``cow`` for copy-on-write
+    privatizations) — maintained only on the alloc/ref/unref paths
+    (admission and finish), never per token.  Freeing a block observes
+    its residency lifetime into
+    ``tpu_dra_serve_kv_block_age_seconds{engine=name}``."""
+
+    def __init__(self, num_blocks: int, name: str = ""):
         if num_blocks < 2:
             raise ValueError(
                 f"allocator needs >= 2 blocks (block 0 is scratch), "
                 f"got {num_blocks}"
             )
         self.num_blocks = num_blocks
+        # The owning engine's name — the label on the block-age series
+        # (mutable: the engine assigns it after it knows its own name).
+        self.name = name
         self._ref = [0] * num_blocks
         self._ref[0] = 1  # scratch: immortal, never in the free list
         # LIFO free list, low ids first out — keeps tests deterministic.
         self._free = list(range(num_blocks - 1, 0, -1))
+        # Per-block records (scratch row 0 unused): parallel lists, not
+        # dicts, so the admission path writes fixed slots instead of
+        # allocating — the "host-side and allocation-free" discipline.
+        self._birth_mono = [0.0] * num_blocks
+        self._birth_step = [0] * num_blocks
+        self._touch_step = [0] * num_blocks
+        self._origin = [""] * num_blocks
 
     @property
     def free_count(self) -> int:
@@ -402,20 +424,33 @@ class BlockAllocator:
     def refcount(self, block: int) -> int:
         return self._ref[block]
 
-    def alloc(self, n: int) -> "list[int] | None":
+    def alloc(self, n: int, *, step: int = 0,
+              origin: str = "computed") -> "list[int] | None":
         """``n`` fresh blocks at refcount 1, or None (and no allocation)
         when fewer than ``n`` are free — all-or-nothing, so a partial
-        admission can never strand half its blocks."""
+        admission can never strand half its blocks.  ``step``/``origin``
+        stamp the introspection records (the engine passes its device
+        -step counter; tests may omit both)."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
+        now = time.monotonic()
         for b in out:
+            # Publish-after-init: the record fields land BEFORE the
+            # refcount makes the block visible to a concurrent
+            # `block_records` walk (the /debug/kv scrape thread), so a
+            # brand-new block can never be read with the previous
+            # tenant's birth/origin.
+            self._birth_mono[b] = now
+            self._birth_step[b] = step
+            self._touch_step[b] = step
+            self._origin[b] = origin
             self._ref[b] = 1
         return out
 
-    def ref(self, blocks) -> None:
+    def ref(self, blocks, *, step: "int | None" = None) -> None:
         for b in blocks:
             if b == 0 or self._ref[b] <= 0:
                 raise RuntimeError(
@@ -423,16 +458,81 @@ class BlockAllocator:
                 )
         for b in blocks:
             self._ref[b] += 1
+            if step is not None:
+                self._touch_step[b] = step
 
-    def unref(self, blocks) -> None:
+    def unref(self, blocks, *, step: "int | None" = None) -> None:
+        now = None
         for b in blocks:
             if b == 0 or self._ref[b] <= 0:
                 raise RuntimeError(
                     f"unref of unowned block {b} (scratch or free)"
                 )
             self._ref[b] -= 1
+            if step is not None:
+                self._touch_step[b] = step
             if self._ref[b] == 0:
                 self._free.append(b)
+                if now is None:
+                    now = time.monotonic()
+                # The block's whole residency is known exactly once — at
+                # the moment its last owner lets go.
+                SERVE_KV_BLOCK_AGE_SECONDS.observe(
+                    now - self._birth_mono[b], engine=self.name
+                )
+                self._origin[b] = ""
+
+    def free_runs(self) -> "list[int]":
+        """Lengths of the contiguous free-block runs (block-id order,
+        scratch excluded) — the free-list fragmentation signal: a pool
+        with free blocks but only short runs cannot hand a long request
+        a dense allocation, which is the defrag trigger the ROADMAP's
+        scheduler item consumes.  O(num_blocks), snapshot/telemetry
+        paths only."""
+        runs: "list[int]" = []
+        run = 0
+        for b in range(1, self.num_blocks):
+            if self._ref[b] == 0:
+                run += 1
+            elif run:
+                runs.append(run)
+                run = 0
+        if run:
+            runs.append(run)
+        return runs
+
+    def block_records(
+        self,
+        owners: "dict[int, list[str]] | None" = None,
+        now_mono: "float | None" = None,
+        current_step: int = 0,
+    ) -> "list[dict]":
+        """One introspection record per ALLOCATED block (scratch and free
+        blocks excluded): refcount, origin, birth/last-touch step, age
+        on the monotonic clock, and the owner tags the caller resolved
+        from its own state (the allocator tracks counts, not names —
+        the engine knows which request/entry each reference belongs
+        to)."""
+        now = time.monotonic() if now_mono is None else now_mono
+        out = []
+        for b in range(1, self.num_blocks):
+            if self._ref[b] <= 0:
+                continue
+            out.append(
+                {
+                    "block": b,
+                    "refcount": self._ref[b],
+                    "origin": self._origin[b],
+                    "birth_step": self._birth_step[b],
+                    "last_touch_step": self._touch_step[b],
+                    "idle_steps": max(
+                        0, current_step - self._touch_step[b]
+                    ),
+                    "age_s": round(max(0.0, now - self._birth_mono[b]), 6),
+                    "owners": list((owners or {}).get(b, ())),
+                }
+            )
+        return out
 
     def stats(self) -> "dict[str, int]":
         return {

@@ -482,3 +482,20 @@ class PagedPrefixCache(_RadixIndex):
             slot=-1, length=len(tokens), refcount=1, blocks=list(blocks)
         )
         return self._register(entry, node)
+
+    def export_blocks(self) -> "list[dict]":
+        """The resident entries' block holdings as plain data — one dict
+        per entry (token-run length, hotness, pin count, block-id list).
+        The introspection counterpart of `export_index`: /debug/kv's
+        owner resolution and the conservation assertion
+        (tests/helpers.assert_kv_conserved) read the entry side of the
+        refcounts from here instead of poking the radix tree."""
+        return [
+            {
+                "length": e.length,
+                "hits": e.hits,
+                "refcount": e.refcount,
+                "blocks": list(e.blocks or ()),
+            }
+            for e in self._entries
+        ]

@@ -168,10 +168,12 @@ from tpu_dra.utils.metrics import (
     SERVE_KV_ALIAS,
     SERVE_KV_BLOCKS,
     SERVE_KV_COW,
+    SERVE_KV_FREE_RUN_BLOCKS,
     SERVE_PREFILL_TOKENS,
     SERVE_QUEUE_DEPTH,
     SERVE_QUEUE_WAIT_SECONDS,
     SERVE_SLO_TOTAL,
+    SERVE_STEP_PHASE_SECONDS,
     SERVE_TPOT_SECONDS,
     SERVE_TTFT_SECONDS,
     SERVE_WASTED_STEPS,
@@ -609,6 +611,39 @@ class ServeEngine:
         self._slo_met = 0
         self._slo_missed = 0
         self._tokens_emitted = 0
+        # Step-phase accumulator (docs/OBSERVABILITY.md "Step-phase
+        # profiler"): one dict reused across ticks — `tick()` zeroes the
+        # values and `_admit`/`_step_once` add perf_counter-measured
+        # spans into it, so the hot loop stamps clocks but never
+        # allocates.  The per-tick copy into StepRecord.phase_s happens
+        # only with telemetry on.
+        self._phase_acc = dict.fromkeys(servestats.PHASES, 0.0)
+        # Deep-profile state (`profile_steps`): a countdown of device
+        # calls to capture under jax.profiler before stopping the trace.
+        self._profile_left = 0
+        self._profile_started = False
+        self._profile_dir = ""
+        self._profile_error = ""
+        self._kv_frag_ticks = 0  # free-run observation sampling counter
+        if kv_layout == "paged":
+            # The allocator labels its block-age observations with the
+            # engine name, and the jax-free introspection registry
+            # (obs/kv.py) gets a weakref-backed snapshot provider: a
+            # collected engine's provider retires itself (returns None),
+            # close() retires it deterministically — the gauge-sampler
+            # discipline.  Lazy import: serve.py must not couple the
+            # compute stack to obs at load time (the layer DAG has no
+            # parallel -> obs eager edge).
+            self._balloc.name = self.name
+            from tpu_dra.obs import kv as obskv
+
+            ref_kv = weakref.ref(self)
+            obskv.register(
+                self.name,
+                lambda: (
+                    lambda e: None if e is None else e.kv_snapshot()
+                )(ref_kv()),
+            )
         # Scrape-time gauges, one series per engine.  The sampler holds a
         # weakref: a collected engine's series retires itself at the next
         # scrape, and close() retires it deterministically.  Two live
@@ -1035,7 +1070,7 @@ class ServeEngine:
             # whose compute was actually skipped).
             fw = m // w
             cols = list(entry.blocks[:fw])
-            self._balloc.ref(cols)
+            self._balloc.ref(cols, step=self._device_steps)
             self._kv_counts["alias_blocks"] += fw
             SERVE_KV_ALIAS.inc(fw, engine=self.name)
             p0 = fw * w
@@ -1047,7 +1082,7 @@ class ServeEngine:
         else:
             self._prefill_tokens["computed"] += length
             SERVE_PREFILL_TOKENS.inc(length, kind="computed")
-        own = self._balloc.alloc(total_cols - fw)
+        own = self._balloc.alloc(total_cols - fw, step=self._device_steps)
         if own is None:  # _ensure_admittable holds this invariant
             raise RuntimeError(
                 "paged admission accounting violated: demand was cleared "
@@ -1084,7 +1119,9 @@ class ServeEngine:
                     # table eagerly, so shared blocks are NEVER written.
                     # The entry keeps the original (pristine prompt KV).
                     lb = prompt_cols - 1
-                    nb = self._balloc.alloc(1)
+                    nb = self._balloc.alloc(
+                        1, step=self._device_steps, origin="cow"
+                    )
                     if nb is None:
                         raise RuntimeError(
                             "paged admission accounting violated: no "
@@ -1093,7 +1130,9 @@ class ServeEngine:
                     self._pool = self._copy_block(
                         self._pool, jnp.int32(nb[0]), jnp.int32(cols[lb])
                     )
-                    self._balloc.unref([cols[lb]])  # table's claim moves
+                    self._balloc.unref(
+                        [cols[lb]], step=self._device_steps
+                    )  # table's claim moves
                     cols[lb] = nb[0]
                     table_row[lb] = nb[0]
                     self._kv_counts["cow_blocks"] += 1
@@ -1181,6 +1220,7 @@ class ServeEngine:
         per admitted request)."""
         jax, jnp = _jax_mods()
 
+        t_phase = time.perf_counter()  # the whole wave is admit-phase work
         admitted = hits = 0
         wave: "list[tuple[int, Request, object, float]]" = []
         for row in range(self.slots):
@@ -1239,6 +1279,7 @@ class ServeEngine:
                         prefix_reused=req.prefix_reused,
                         suffix_len=len(req.prompt) - req.prefix_reused,
                     )
+        self._phase_acc["admit"] += time.perf_counter() - t_phase
         return admitted, hits
 
     def _note_token(self, row: int, token: int, logprob: float) -> None:
@@ -1325,13 +1366,72 @@ class ServeEngine:
             # later admission reallocates.  Blocks a resident prefix
             # entry still references stay allocated.
             row_blocks = [int(b) for b in self._table[row] if b]
-            self._balloc.unref(row_blocks)
+            self._balloc.unref(row_blocks, step=self._device_steps)
             self._table[row, :] = 0
         # The finished row no longer needs its prefix entries held
         # against eviction.
         for entry in self._row_pins[row]:
             self._prefix.release(entry)
         self._row_pins[row] = []
+
+    def profile_steps(self, n: int, trace_dir: "str | None" = None) -> str:
+        """Arm the DEEP profiler (docs/OBSERVABILITY.md "Step-phase
+        profiler"): capture a ``jax.profiler`` device trace for the next
+        ``n`` device calls, written under ``trace_dir`` (a fresh temp
+        directory when omitted).  Returns the directory; load it in
+        TensorBoard/XProf or fetch it from the serving host.  This is
+        the opt-in heavyweight layer above the always-on phase stamps —
+        the phases say WHICH phase is slow, the device trace says why.
+        One capture at a time; the trace starts at the next device call
+        and stops by itself (``profiling`` reads the armed state, and a
+        profiler backend failure lands in ``profile_error`` instead of
+        taking the serving loop down)."""
+        self._check_open()
+        if n < 1:
+            raise ValueError(f"profile_steps needs n >= 1, got {n}")
+        if self._profile_left > 0:
+            raise RuntimeError(
+                "a step profile is already armed; wait for it to finish"
+            )
+        if trace_dir is None:
+            import tempfile
+
+            trace_dir = tempfile.mkdtemp(
+                prefix=f"tpudra-profile-{self.name}-"
+            )
+        self._profile_dir = trace_dir
+        self._profile_error = ""
+        self._profile_started = False
+        self._profile_left = n
+        return trace_dir
+
+    @property
+    def profiling(self) -> bool:
+        """True while a `profile_steps` capture is armed or running."""
+        return self._profile_left > 0
+
+    @property
+    def profile_error(self) -> str:
+        """The last jax.profiler start/stop failure ("" when healthy) —
+        a missing profiler backend degrades to this, never an exception
+        mid-tick."""
+        return self._profile_error
+
+    def _start_profile(self, jax) -> None:
+        try:
+            jax.profiler.start_trace(self._profile_dir)
+            self._profile_started = True
+        except Exception as e:
+            self._profile_error = f"{type(e).__name__}: {e}"
+            self._profile_left = 0
+
+    def _stop_profile(self, jax) -> None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            self._profile_error = f"{type(e).__name__}: {e}"
+        self._profile_started = False
+        self._profile_left = 0
 
     def _step_once(self) -> None:
         """One device call (``_steps_per_call`` fused decode steps), its
@@ -1342,6 +1442,9 @@ class ServeEngine:
         continuous scheduling a call is one step, so the count stays 0
         structurally."""
         jax, jnp = _jax_mods()
+        if self._profile_left > 0 and not self._profile_started:
+            self._start_profile(jax)
+        t0 = time.perf_counter()
         self._device_steps += self._steps_per_call
         stepped = [r is not None for r in self._row_req]
         active = jnp.asarray(stepped, bool)
@@ -1363,9 +1466,16 @@ class ServeEngine:
             self._cache, tok, pos, toks, lps = self._step(
                 self.params, self._cache, tok, pos, active, seeds
             )
+        # Dispatch ends where the blocking fetch begins: everything up
+        # to here (array staging + the async device-call issue) is the
+        # step's host-side launch cost.
+        t1 = time.perf_counter()
+        self._phase_acc["dispatch"] += t1 - t0
         # ONE blocking fetch per device call (the module-header promise):
         # tokens, logprobs, next-token, and positions come together.
         toks, lps, tok_h, pos_h = jax.device_get((toks, lps, tok, pos))
+        t2 = time.perf_counter()
+        self._phase_acc["fetch"] += t2 - t1
         self._tok = [int(t) for t in tok_h]
         self._pos = [int(p) for p in pos_h]
         for s in range(toks.shape[0]):
@@ -1381,6 +1491,11 @@ class ServeEngine:
                 self._note_token(
                     row, int(toks[s, row]), float(lps[s, row])
                 )
+        self._phase_acc["host"] += time.perf_counter() - t2
+        if self._profile_started:
+            self._profile_left -= 1
+            if self._profile_left <= 0:
+                self._stop_profile(jax)
 
     def tick(self) -> "list[Request]":
         """Admit waiting requests into free rows, run ``steps_per_tick``
@@ -1392,6 +1507,8 @@ class ServeEngine:
         (``/debug/engine``)."""
         self._check_open()
         t0 = time.perf_counter()
+        for p in self._phase_acc:
+            self._phase_acc[p] = 0.0
         done_before = len(self._done)
         toks_before = self._tokens_emitted
         admitted, prefix_hits = self._admit()
@@ -1415,6 +1532,31 @@ class ServeEngine:
             self._step_once()
         finished = self._done[done_before:]
         if self.telemetry:
+            # Wall stamp taken BEFORE the metric observations below, so
+            # the recorded phase fractions divide by the tick the phases
+            # actually tiled, not tick + recording overhead.
+            step_wall = time.perf_counter() - t0
+            phases = dict(self._phase_acc)
+            for p, v in phases.items():
+                if v > 0.0:
+                    SERVE_STEP_PHASE_SECONDS.observe(
+                        v, engine=self.name, phase=p
+                    )
+            if self._kv_layout == "paged" and (admitted or finished):
+                # The pool's shape only changes on admissions/finishes:
+                # observe the free-run length distribution then (the
+                # fragmentation signal behind
+                # tpu_dra_serve_kv_free_run_blocks) — SAMPLED every 8th
+                # shape-changing tick, because the scan is O(pool) and a
+                # production pool under continuous batching changes
+                # shape nearly every tick (the first shape change always
+                # observes, so short tests and cold starts see data).
+                if self._kv_frag_ticks % 8 == 0:
+                    for run in self._balloc.free_runs():
+                        SERVE_KV_FREE_RUN_BLOCKS.observe(
+                            run, engine=self.name
+                        )
+                self._kv_frag_ticks += 1
             servestats.RECORDER.record(
                 servestats.StepRecord(
                     engine=self.name,
@@ -1425,7 +1567,8 @@ class ServeEngine:
                     prefix_hits=prefix_hits,
                     finished=len(finished),
                     tokens=self._tokens_emitted - toks_before,
-                    step_wall_s=time.perf_counter() - t0,
+                    step_wall_s=step_wall,
+                    phase_s=phases,
                     slo_met=self._slo_met,
                     slo_missed=self._slo_missed,
                 )
@@ -1454,11 +1597,21 @@ class ServeEngine:
         host-side state (done requests, the prefix index for
         ``export_prefix_index``) stays readable after close."""
         self._closed = True
+        if self._profile_started:
+            # The jax.profiler session is PROCESS-wide: a capture left
+            # running by a closed (or chaos-killed) engine would grow
+            # its trace forever and wedge every later profile_steps at
+            # start_trace — stop it with the engine.
+            self._stop_profile(_jax_mods()[0])
+        self._profile_left = 0
         SERVE_QUEUE_DEPTH.remove_function(engine=self.name)
         SERVE_BATCH_OCCUPANCY.remove_function(engine=self.name)
         if self._kv_layout == "paged":
             for state in ("free", "allocated", "aliased"):
                 SERVE_KV_BLOCKS.remove(engine=self.name, state=state)
+            from tpu_dra.obs import kv as obskv
+
+            obskv.unregister(self.name)
 
     def _check_open(self) -> None:
         if self._closed:
@@ -1715,6 +1868,58 @@ class ServeEngine:
         stats["cow_blocks_total"] = self._kv_counts["cow_blocks"]
         stats["alloc_blocks_total"] = self._kv_counts["alloc_blocks"]
         return stats
+
+    def kv_snapshot(self) -> "dict | None":
+        """The pool introspection snapshot behind ``/debug/kv`` (the
+        `tpu_dra.obs.kv` provider contract): `kv_block_stats` plus the
+        free-run lengths and one record per allocated block — refcount,
+        origin, birth/last-touch step, age, and owner tags resolved
+        from THIS engine's state (``req:<id>`` for live block-table
+        cells, ``entry:<len>t`` for resident radix entries; a shared
+        block lists every owner).  Host-side only, O(pool) — a
+        snapshot-time walk, never hot-path work.  ``None`` on
+        row-layout engines (nothing to introspect).  Readable after
+        close(), like the prefix index.
+
+        Consistency: BEST-EFFORT, the per-engine gauge-sampler
+        discipline — the scrape thread walks live state without
+        stopping the engine, so a snapshot taken mid-admission can see
+        a block allocated whose table cell is not yet written (an
+        owner-less record for one read).  The allocator publishes each
+        block's record fields before its refcount, so a visible block
+        always carries ITS OWN birth/origin — never a prior tenant's.
+        Decisions that need an exact view (eviction victim selection)
+        run on the engine thread against the allocator directly."""
+        if self._kv_layout != "paged":
+            return None
+        owners: "dict[int, list[str]]" = {}
+        for row, req in enumerate(self._row_req):
+            if req is None:
+                continue
+            tag = f"req:{req.id}"
+            for b in self._table[row]:
+                if b:
+                    owners.setdefault(int(b), []).append(tag)
+        if self._prefix is not None:
+            for entry in self._prefix.export_blocks():
+                tag = f"entry:{entry['length']}t"
+                for b in entry["blocks"]:
+                    owners.setdefault(b, []).append(tag)
+        snap = self.kv_block_stats
+        snap.update(
+            {
+                "engine": self.name,
+                "layout": "paged",
+                "block_size": self._block_size,
+                "table_cols": self._table_cols,
+                "device_steps": self._device_steps,
+                "free_runs": self._balloc.free_runs(),
+                "blocks": self._balloc.block_records(
+                    owners=owners, current_step=self._device_steps
+                ),
+            }
+        )
+        return snap
 
     @property
     def prefix_stats(self) -> "dict[str, int]":

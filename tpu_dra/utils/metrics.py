@@ -450,6 +450,41 @@ SERVE_KV_COW = REGISTRY.counter(
     "block a parked prefix entry shares with its live request is "
     "privatized so decode writes never touch a shared block",
 )
+# Step-phase profiler (docs/OBSERVABILITY.md "Step-phase profiler"):
+# every engine tick's wall time decomposed into the four host-observed
+# phases — where a slow step went, per engine.  Sub-ms floor: on real
+# silicon dispatch/host are tens of microseconds and only fetch should
+# carry the device time.
+SERVE_STEP_PHASE_SECONDS = REGISTRY.histogram(
+    "tpu_dra_serve_step_phase_seconds",
+    "Serve-engine tick wall time by phase per engine: admit (placement "
+    "+ prefix match + block alloc + admission prefill), dispatch "
+    "(decode device-call issue), fetch (the one blocking device_get "
+    "per call), host (token processing and finish bookkeeping)",
+    buckets=(0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+             0.1, 0.25, 0.5, 1.0, 2.5),
+)
+# KV-pool introspection (docs/OBSERVABILITY.md "/debug/kv"): block
+# residency lifetimes and free-list fragmentation.  Age is observed at
+# free time (the block's whole residency is known then); free-run
+# lengths are observed on ticks that changed the pool's shape.
+SERVE_KV_BLOCK_AGE_SECONDS = REGISTRY.histogram(
+    "tpu_dra_serve_kv_block_age_seconds",
+    "Residency lifetime of a paged KV block per engine, observed when "
+    "its last reference drops and it returns to the free list "
+    "(monotonic clock) — long-lived blocks are hot shared prefixes, "
+    "short-lived ones decode churn",
+    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0),
+)
+SERVE_KV_FREE_RUN_BLOCKS = REGISTRY.histogram(
+    "tpu_dra_serve_kv_free_run_blocks",
+    "Length in blocks of each contiguous free run in a paged KV pool, "
+    "observed per engine on every 8th tick that admitted or finished "
+    "requests (the scan is O(pool), so shape-changing ticks are "
+    "sampled) — the fragmentation signal: many short runs while free "
+    "blocks exist means the pool needs defragmentation",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+)
 # Serve-fleet router (tpu_dra/fleet/): placements across engine replicas
 # by reason, plus the routing-health gauges — digest freshness, load
 # balance, and the fleet-level overflow queue.
@@ -635,6 +670,21 @@ def debug_index(server: "MetricsServer") -> dict:
         )
         if info is not None:
             endpoints[f"{pprof}/{path}"] = info
+    if f"{pprof}/engine" in endpoints:
+        # Record-shape capability: StepRecords in this build carry the
+        # step-phase decomposition — a collector that wants phase data
+        # checks here instead of probing a record and guessing.
+        endpoints[f"{pprof}/engine"]["fields"] = ["phase_s"]
+    kv = _ring_info(
+        "tpu_dra.obs.kv",
+        lambda m: {"kind": "kv", "engines": len(m.providers())},
+    )
+    if kv is not None:
+        # The module loads when the first paged engine registers its
+        # snapshot provider — an unloaded obs.kv means this process has
+        # no paged pool to introspect, and the index must not pay the
+        # import to find out (the ring discipline above).
+        endpoints[f"{pprof}/kv"] = kv
     cluster = _ring_info(
         "tpu_dra.obs.collector",
         lambda m: {
@@ -708,6 +758,8 @@ class MetricsServer:
                         self._send_decisions(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/engine":
                         self._send_engine(parse_qs(parsed.query))
+                    elif parsed.path == f"{outer.pprof_path}/kv":
+                        self._send_kv(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/fleet":
                         self._send_fleet(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/cluster":
@@ -836,6 +888,29 @@ class MetricsServer:
                         ),
                         "application/json",
                     )
+
+            def _send_kv(self, query: dict) -> None:
+                # Local import, like its siblings — obs.kv is jax-free by
+                # design, so this endpoint serves from any binary; the
+                # registered snapshot providers carry the engine data in.
+                from tpu_dra.obs import kv as obskv
+
+                limit = _query_int(query, "limit", 256, cap=4096)
+                fmt = query.get("format", ["json"])[0]
+                if fmt not in ("json", "text"):
+                    raise _BadQuery(
+                        f"format must be json or text, got {fmt!r}"
+                    )
+                doc = obskv.kv_doc(
+                    engine=query.get("engine", [""])[0] or None,
+                    limit=limit,
+                )
+                if fmt == "text":
+                    self._send(200, obskv.render_text(doc))
+                else:
+                    import json
+
+                    self._send(200, json.dumps(doc), "application/json")
 
             def _send_fleet(self, query: dict) -> None:
                 # Local import, like its siblings — fleet.stats is

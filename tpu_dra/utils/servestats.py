@@ -32,7 +32,14 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+
+# The engine tick's phase decomposition (parallel/serve.py stamps these
+# on the monotonic clock): admit = placement + prefix match + block
+# alloc + admission prefill; dispatch = decode device-call issue; fetch
+# = the one blocking device_get per call; host = token processing and
+# finish/park bookkeeping.  The order here is the rendering order.
+PHASES = ("admit", "dispatch", "fetch", "host")
 
 
 @dataclass
@@ -50,6 +57,12 @@ class StepRecord:
     finished: int = 0  # requests completed this tick
     tokens: int = 0  # tokens emitted this tick (all rows)
     step_wall_s: float = 0.0  # host wall time of the whole tick
+    # Phase decomposition of step_wall_s (PHASES above, seconds each,
+    # perf_counter-measured by the engine).  The phases tile the tick:
+    # sum(phase_s.values()) / step_wall_s closes to >= 0.95 on any tick
+    # that did device work (pinned by test) — the residue is loop
+    # control and record construction.
+    phase_s: "dict[str, float]" = field(default_factory=dict)
     # Cumulative per-engine SLO verdicts at record time (finished requests
     # with every configured SLO met vs any missed) — cumulative, not
     # per-tick, so goodput survives ring eviction.
@@ -69,6 +82,7 @@ class StepRecord:
             "finished": self.finished,
             "tokens": self.tokens,
             "step_wall_s": self.step_wall_s,
+            "phase_s": {k: round(v, 9) for k, v in self.phase_s.items()},
             "slo_met": self.slo_met,
             "slo_missed": self.slo_missed,
         }
@@ -188,9 +202,36 @@ def summarize(records: "list[StepRecord]") -> dict:
         "slo_met": met,
         "slo_missed": missed,
     }
+    # Phase summary over the ticks that carry a decomposition (older
+    # records and telemetry-off engines record none — absent, not zero):
+    # per-phase p50/p95 plus its fraction of total recorded wall time,
+    # so one snapshot answers "where do my steps go?".
+    phased = [r for r in records if r.phase_s]
+    if phased:
+        phased_wall = sum(r.step_wall_s for r in phased)
+        phases: "dict[str, dict]" = {}
+        for p in PHASES:
+            vals = sorted(r.phase_s.get(p, 0.0) for r in phased)
+            total = sum(vals)
+            phases[p] = {
+                "p50_s": round(_pctl(vals, 0.5), 6),
+                "p95_s": round(_pctl(vals, 0.95), 6),
+                "fraction": round(total / phased_wall, 3)
+                if phased_wall > 0
+                else 0.0,
+            }
+        out["phases"] = phases
     if met + missed:
         out["goodput"] = round(met / (met + missed), 3)
     return out
+
+
+def dominant_phase(phases: "dict[str, dict]") -> "tuple[str, float]":
+    """The phase owning the largest fraction of step wall time (from a
+    `summarize` ``phases`` dict) — the one-cell answer ``tpudra top``
+    and the text render show.  Returns ``(name, fraction)``."""
+    best = max(PHASES, key=lambda p: phases.get(p, {}).get("fraction", 0.0))
+    return best, phases.get(best, {}).get("fraction", 0.0)
 
 
 def render_text(records: "list[StepRecord]") -> str:
@@ -214,14 +255,33 @@ def render_text(records: "list[StepRecord]") -> str:
             f"({s['slo_met']} met / {s['slo_missed']} missed)"
         )
     out = [head]
+    if "phases" in s:
+        dom, frac = dominant_phase(s["phases"])
+        out.append(
+            "phases: "
+            + "  ".join(
+                f"{p} {s['phases'][p]['fraction']:.0%} "
+                f"(p50 {s['phases'][p]['p50_s'] * 1e3:.2f}ms "
+                f"p95 {s['phases'][p]['p95_s'] * 1e3:.2f}ms)"
+                for p in PHASES
+            )
+            + f" — dominant: {dom} {frac:.0%}"
+        )
     out.append(
         f"{'seq':>6} {'engine':<12} {'occ':>5} {'queue':>5} {'adm':>4} "
-        f"{'hit':>4} {'fin':>4} {'tok':>5} {'wall_ms':>8}"
+        f"{'hit':>4} {'fin':>4} {'tok':>5} {'wall_ms':>8} {'phase':>12}"
     )
     for r in records:
+        if r.phase_s:
+            p, v = max(r.phase_s.items(), key=lambda kv: kv[1])
+            frac = v / r.step_wall_s if r.step_wall_s > 0 else 0.0
+            phase = f"{p} {frac:.0%}"
+        else:
+            phase = "-"
         out.append(
             f"{r.seq:>6} {r.engine:<12} {r.occupancy:>3}/{r.slots:<1} "
             f"{r.queue_depth:>5} {r.admitted:>4} {r.prefix_hits:>4} "
-            f"{r.finished:>4} {r.tokens:>5} {r.step_wall_s * 1e3:>8.2f}"
+            f"{r.finished:>4} {r.tokens:>5} {r.step_wall_s * 1e3:>8.2f} "
+            f"{phase:>12}"
         )
     return "\n".join(out) + "\n"
