@@ -10,14 +10,16 @@ TAG     ?= latest
         native-test demo-quickstart bench image clean help \
         observability-smoke perf-smoke explain-smoke serve-smoke \
         serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke paged-smoke \
-        kernel-smoke kv-smoke
+        kernel-smoke kv-smoke swap-smoke
 
 # `analyze` runs the full rule registry — the L-style rules lint would
 # run plus the whole-repo invariants — so `all` needs only one pass.
 # `kernel-smoke` fails fast (seconds) on a Pallas-kernel/gather drift,
-# `kv-smoke` on a /debug/kv or KVPoolPressure regression, before `test`
-# pays for the full suite.
-all: analyze kernel-smoke kv-smoke test
+# `kv-smoke` on a /debug/kv or KVPoolPressure regression, and
+# `swap-smoke` on a KV-memory-hierarchy regression (preempt/swap
+# identity, host-tier metrics, KVSwapThrash), before `test` pays for
+# the full suite.
+all: analyze kernel-smoke kv-smoke swap-smoke test
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -110,6 +112,16 @@ kernel-smoke:
 kv-smoke:
 	$(PYTHON) -m pytest tests/test_kv_smoke.py -q -m 'not slow'
 
+# KV memory hierarchy floor (docs/SERVING.md "KV memory hierarchy"): a
+# floor-sized paged engine preempts a low-priority decode for a
+# high-priority arrival, the parked blocks are visible over HTTP
+# (kv_blocks{state="host"}, kv_swaps_total{direction}, /debug/kv host
+# line, /debug/engine preempted counts), the victim swaps back in and
+# finishes token-identically, and KVSwapThrash completes pending ->
+# firing -> resolved over injected-clock scrapes.
+swap-smoke:
+	$(PYTHON) -m pytest tests/test_swap_smoke.py -q -m 'not slow'
+
 # Serving telemetry floor: drives a small engine stream, scrapes /metrics
 # and /debug/engine over HTTP, asserts the TPOT/queue-wait/SLO series and
 # per-engine gauges appear, the step flight recorder serves the ring, a
@@ -161,4 +173,4 @@ help:
 	@echo "         native-test demo-quickstart bench observability-smoke"
 	@echo "         perf-smoke explain-smoke serve-smoke serve-obs-smoke"
 	@echo "         chaos-smoke fleet-smoke obs-top-smoke paged-smoke"
-	@echo "         kernel-smoke kv-smoke image clean"
+	@echo "         kernel-smoke kv-smoke swap-smoke image clean"

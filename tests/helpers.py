@@ -195,11 +195,16 @@ def metric_total(text: str, name: str, **labels) -> float:
 def assert_kv_conserved(engine) -> None:
     """Block-accounting conservation for a paged ServeEngine, checked
     from FIRST PRINCIPLES against the engine's own state (never against
-    the allocator's cached counts alone): every block is free, allocated,
-    or scratch (free + allocated + 1 == pool size), and every allocated
+    the allocator's cached counts alone), across BOTH tiers of the KV
+    memory hierarchy.  Device: every block is free, allocated, or
+    scratch (free + allocated + 1 == pool size), and every allocated
     block's refcount equals its OWNER COUNT — one per live block-table
     cell pointing at it plus one per resident prefix entry holding it.
-    Call between ticks during alias/COW/evict churn; a leak (refcount
+    Host: used + free slots == host capacity, and every used host slot
+    is owned by EXACTLY ONE swapped-out request's swap state (the
+    host-tier refcount — exclusive ownership until swap-in frees the
+    slot), with the swapped flag and the state dict agreeing.  Call
+    between ticks during alias/COW/evict/swap churn; a leak (refcount
     without an owner) or a use-after-free (owner without a refcount)
     fails here long before it corrupts tokens."""
     assert engine.kv_layout == "paged", "conservation is a paged contract"
@@ -209,6 +214,27 @@ def assert_kv_conserved(engine) -> None:
         stats["blocks_free"] + stats["blocks_allocated"] + 1
         == stats["blocks_total"]
     ), stats
+    # Host tier: capacity partition + exclusive slot ownership.
+    host = engine._host_pool
+    assert host.used_count + host.free_count == host.capacity, host.stats()
+    slot_owners: "dict[int, int]" = {}
+    for rid, state in engine._swap_state.items():
+        req = engine._by_id[rid]
+        assert req.swapped, f"swap state for a non-swapped request {rid}"
+        assert any(q is req for q in engine._queue), (
+            f"swapped request {rid} not queued"
+        )
+        for slot in state["host_slots"]:
+            slot_owners[slot] = slot_owners.get(slot, 0) + 1
+    assert sorted(slot_owners) == host.used_slots(), (
+        sorted(slot_owners), host.used_slots(),
+    )
+    assert all(n == 1 for n in slot_owners.values()), slot_owners
+    for req in engine._queue:
+        if req.swapped:
+            assert req.id in engine._swap_state, (
+                f"swapped request {req.id} has no swap state"
+            )
     owners = {0: 1}  # scratch: the allocator's own immortal reference
     for row, req in enumerate(engine._row_req):
         if req is None:

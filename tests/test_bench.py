@@ -143,6 +143,25 @@ def test_bench_serve_prefix_stanza():
         occ["continuous"]["step_slot_utilization"]
         > occ["tick"]["step_slot_utilization"]
     )
+    # ISSUE 13: the over-subscribed stream (working set >> HBM) — the
+    # KV memory hierarchy must sustain strictly more in-flight requests
+    # than park-only admission at equal HBM, with real swap traffic in
+    # both directions, and the swapped requests' greedy tokens
+    # identical to the never-swapped run (asserted in-child and pinned
+    # here).
+    over = occ["oversubscribed"]
+    assert over["greedy_identical_swapped_vs_never_swapped"]
+    assert (
+        over["hierarchy"]["peak_inflight"]
+        > over["park_only"]["peak_inflight"]
+    )
+    assert over["inflight_uplift"] > 1
+    assert over["hierarchy"]["preemptions"] > 0
+    assert over["hierarchy"]["swap_out_blocks"] > 0
+    assert over["hierarchy"]["swap_in_blocks"] > 0
+    assert over["hierarchy"]["swapped_requests"] > 0
+    assert over["park_only"]["preemptions"] == 0
+    assert over["park_only"]["swap_out_blocks"] == 0
     # ISSUE 11 half (b): the kernel arm ran in interpret mode and was
     # greedy-identical to the gather backend (throughput reported,
     # honestly un-gated on CPU).

@@ -406,6 +406,7 @@ class TestDefaultRules:
             "ClaimEvictionSpike",
             "FleetDigestStale",
             "KVPoolPressure",
+            "KVSwapThrash",
             "ScrapeDown",
         ]
 

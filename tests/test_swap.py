@@ -1,0 +1,443 @@
+"""KV memory hierarchy (tpu_dra/parallel/swap.py + the ServeEngine
+host-tier wiring): host block pool ownership, age-x-heat victim policy,
+block-granular LRU trims in PagedPrefixCache, preemptive admission with
+token-identical swap-out/swap-in, priority head selection, and two-tier
+conservation under swap churn."""
+
+import pytest
+
+from tpu_dra.parallel.burnin import init_params
+from tpu_dra.parallel.paged import BlockAllocator
+from tpu_dra.parallel.prefixcache import PagedPrefixCache
+from tpu_dra.parallel.swap import AgeHeatPolicy, HostBlockPool
+from tpu_dra.parallel.serve import ServeEngine
+
+from helpers import assert_kv_conserved
+from test_serve import CFG, isolated
+
+LONG = [5, 9, 2, 7, 11, 3]
+SHORT = [1, 2, 3]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG)
+
+
+def _tight_engine(params, **kw):
+    """Floor-sized pool: one worst-case request (ceil((8+5)/2) = 7
+    table columns + scratch = 8 blocks) — any second admission must
+    preempt or park."""
+    kw.setdefault("slots", 2)
+    kw.setdefault("prompt_slots", 8)
+    kw.setdefault("max_new_cap", 5)
+    kw.setdefault("prefix_window", 2)
+    kw.setdefault("kv_blocks", 8)
+    return ServeEngine(params, CFG, **kw)
+
+
+class TestHostBlockPool:
+    """Pure host bookkeeping — no jax, no device."""
+
+    def test_store_load_free_roundtrip(self):
+        pool = HostBlockPool(2)
+        s1 = pool.store({"k": "payload-1"})
+        s2 = pool.store({"k": "payload-2"})
+        assert pool.store({"k": "payload-3"}) is None  # full, nothing lost
+        assert pool.load(s1) == {"k": "payload-1"}
+        assert pool.load(s2) == {"k": "payload-2"}
+        assert pool.used_count == 2 and pool.free_count == 0
+        pool.free(s1)
+        assert pool.used_count == 1 and pool.used_slots() == [s2]
+        assert pool.store({"k": "payload-4"}) is not None
+
+    def test_unowned_slot_raises(self):
+        pool = HostBlockPool(1)
+        with pytest.raises(RuntimeError):
+            pool.load(0)
+        slot = pool.store("x")
+        pool.free(slot)
+        with pytest.raises(RuntimeError):
+            pool.free(slot)
+
+    def test_zero_capacity_disables(self):
+        pool = HostBlockPool(0)
+        assert pool.store("x") is None
+        assert pool.stats() == {
+            "host_capacity": 0, "host_used": 0, "host_free": 0
+        }
+
+    def test_negative_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            HostBlockPool(-1)
+
+
+def _cand(row, blocks, records):
+    return {"row": row, "priority": 0, "blocks": blocks,
+            "records": records}
+
+
+def _rec(block, *, age_s, idle, ref=1):
+    return {
+        "block": block, "refcount": ref, "age_s": age_s,
+        "idle_steps": idle, "origin": "computed",
+        "birth_step": 0, "last_touch_step": 0, "owners": [],
+    }
+
+
+class TestAgeHeatPolicy:
+    def test_cold_old_row_beats_hot_young(self):
+        records = {
+            1: _rec(1, age_s=100.0, idle=500),
+            2: _rec(2, age_s=0.1, idle=0),
+        }
+        pick = AgeHeatPolicy().pick(
+            [_cand(0, [1], records), _cand(1, [2], records)],
+            free_blocks=set(), num_blocks=8,
+        )
+        assert pick == 0
+
+    def test_defrag_gain_breaks_coldness_near_ties(self):
+        # Rows equally cold, but releasing row 1's block 3 knits free
+        # blocks {2, 4} into one run of 3 — the defrag signal wins.
+        records = {
+            6: _rec(6, age_s=10.0, idle=10),
+            3: _rec(3, age_s=10.0, idle=10),
+        }
+        pick = AgeHeatPolicy(defrag_weight=10.0).pick(
+            [_cand(0, [6], records), _cand(1, [3], records)],
+            free_blocks={2, 4}, num_blocks=8,
+        )
+        assert pick == 1
+
+    def test_shared_blocks_earn_no_defrag_credit(self):
+        # Both candidates' blocks would knit the free runs {4},{6} into
+        # one — but row 0's block is refcount-2 (still held by a prefix
+        # entry after the swap-out), so only row 1's release actually
+        # extends a run.
+        records = {
+            5: _rec(5, age_s=10.0, idle=10, ref=2),
+            3: _rec(3, age_s=10.0, idle=10),
+        }
+        free = {2, 4}
+        pick = AgeHeatPolicy(defrag_weight=10.0).pick(
+            [_cand(0, [5], records), _cand(1, [3], records)],
+            free_blocks=free, num_blocks=8,
+        )
+        assert pick == 1
+
+    def test_empty_candidates_decline(self):
+        assert AgeHeatPolicy().pick(
+            [], free_blocks=set(), num_blocks=8
+        ) is None
+
+    def test_negative_weight_rejected(self):
+        with pytest.raises(ValueError):
+            AgeHeatPolicy(defrag_weight=-1.0)
+
+
+class TestBlockGranularLRU:
+    """PagedPrefixCache with block_size: entries shrink before they die
+    (the allocator stands in for the device pool — pure host checks)."""
+
+    def _parked_entry(self, cache, alloc, tokens, step):
+        blocks = alloc.alloc(-(-len(tokens) // 2), step=step)
+        entry = cache.insert(tokens, blocks)
+        cache.release(entry)
+        alloc.unref(blocks)  # ownership moves to the entry
+        return entry
+
+    def test_trim_takes_coldest_tail_and_shrinks_entry(self):
+        a = BlockAllocator(12)
+        pc = PagedPrefixCache(4, a, block_size=2)
+        cold = self._parked_entry(pc, a, [1, 2, 3, 4, 5, 6], step=1)
+        hot = self._parked_entry(pc, a, [9, 8, 7, 6], step=50)
+        epoch = pc.epoch
+        assert pc.evict_one(current_step=60)
+        assert cold.length == 4 and len(cold.blocks) == 2
+        assert hot.length == 4  # the hot entry untouched
+        assert pc.resident == 2  # shrunk, not dead
+        assert pc.trimmed_blocks == 1 and pc.evictions == 0
+        assert pc.epoch == epoch + 1  # digests must refresh
+        # The trimmed entry still serves at its new (capped) length.
+        entry, use, _ = pc.match([1, 2, 3, 4, 5, 6], min_use=2)
+        assert entry is cold and use == 4
+
+    def test_trim_to_death_detaches_entry(self):
+        a = BlockAllocator(8)
+        pc = PagedPrefixCache(2, a, block_size=2)
+        self._parked_entry(pc, a, [1, 2, 3, 4], step=1)
+        free0 = a.free_count
+        assert pc.evict_one() and pc.resident == 1  # 2 blocks -> 1
+        assert pc.evict_one() and pc.resident == 0  # below one window
+        assert not pc.evict_one()  # nothing left
+        assert a.free_count == free0 + 2
+        assert pc.evictions == 1  # one entry DIED; the rest were trims
+
+    def test_pinned_entries_never_trimmed(self):
+        a = BlockAllocator(8)
+        pc = PagedPrefixCache(2, a, block_size=2)
+        blocks = a.alloc(2)
+        pc.insert([1, 2, 3, 4], blocks)  # pre-pinned, never released
+        a.unref(blocks)
+        assert not pc.evict_one()
+        assert pc.resident == 1 and pc.trimmed_blocks == 0
+
+    def test_reextension_after_trim(self):
+        a = BlockAllocator(12)
+        pc = PagedPrefixCache(4, a, block_size=2)
+        entry = self._parked_entry(pc, a, [1, 2, 3, 4, 5, 6], step=1)
+        assert pc.evict_one()
+        assert entry.length == 4
+        # A new admission of the full run recomputed everything: insert
+        # swaps the stub's block list for the fresh one, full length.
+        fresh = a.alloc(3, step=9)
+        again = pc.insert([1, 2, 3, 4, 5, 6], fresh)
+        assert again is entry and entry.length == 6
+        assert entry.blocks == list(fresh)
+        pc.release(again)
+        a.unref(fresh)
+        for b in fresh:
+            assert a.refcount(b) == 1  # the entry's own reference
+
+    def test_entry_cap_still_evicts_whole_entries(self):
+        # The resident-entry cap bounds entry COUNT: insert at cap must
+        # kill an entry whole, not shave a block off one.
+        a = BlockAllocator(12)
+        pc = PagedPrefixCache(1, a, block_size=2)
+        self._parked_entry(pc, a, [1, 2, 3, 4], step=1)
+        b2 = a.alloc(2, step=2)
+        e2 = pc.insert([7, 7, 7, 7], b2)
+        assert e2 is not None and pc.resident == 1
+        assert pc.evictions == 1
+
+    def test_without_block_size_evicts_whole_entries(self):
+        # Direct constructions (no block_size) keep the legacy whole
+        # -entry semantics.
+        a = BlockAllocator(8)
+        pc = PagedPrefixCache(2, a)
+        self._parked_entry(pc, a, [1, 2, 3, 4], step=1)
+        free0 = a.free_count
+        assert pc.evict_one()
+        assert pc.resident == 0 and a.free_count == free0 + 2
+
+
+class TestPreemption:
+    """The engine flow: preempt -> swap-out -> swap-in -> token
+    -identical finish, with two-tier conservation between every tick
+    (the swap churn contract)."""
+
+    def _drain_conserved(self, eng, bound=200):
+        for _ in range(bound):
+            if not eng.pending:
+                return
+            eng.tick()
+            assert_kv_conserved(eng)
+        raise AssertionError("engine did not drain")
+
+    def test_preempt_swap_roundtrip_token_identical(self, params):
+        eng = _tight_engine(params, name="swap-rt")
+        try:
+            victim = eng.submit(LONG, 5, priority=0)
+            eng.tick()  # the long admits and emits its first token
+            assert_kv_conserved(eng)
+            assert eng.occupancy == 1
+            preemptor = eng.submit(SHORT, 5, priority=5)
+            self._drain_conserved(eng)
+            v, p = eng.request(victim), eng.request(preemptor)
+            # The victim was preempted, parked on host, restored, and
+            # finished with EXACTLY the tokens of an uncontended run.
+            assert v.preemptions == 1 and v.preempted_by == [preemptor]
+            assert v.swap_out_blocks > 0
+            assert v.swap_in_blocks == v.swap_out_blocks
+            assert v.swapped_s > 0 and not v.swapped
+            # TPOT measures decode, not the host-parked stall: the
+            # stall is accounted once in swapped_s, so the arrival
+            # gaps plus the stall must fit inside the decode span —
+            # a delta spanning the park would break this.
+            assert (
+                sum(v.token_deltas) + v.swapped_s
+                <= (v.finished_at - v.first_token_at) + 1e-6
+            ), (v.token_deltas, v.swapped_s)
+            assert v.tokens == list(isolated(params, CFG, LONG, 5))
+            assert p.tokens == list(isolated(params, CFG, SHORT, 5))
+            stats = eng.kv_block_stats
+            assert stats["swap_out_blocks_total"] == v.swap_out_blocks
+            assert stats["swap_in_blocks_total"] == v.swap_in_blocks
+            assert stats["preemptions_total"] == 1
+            assert stats["blocks_host"] == 0  # everything restored
+        finally:
+            eng.close()
+
+    # The three dedicated-engine-compile variants below are slow-marked
+    # for the tier-1 wall budget (CI --runslow keeps them); the
+    # round-trip identity + knob validation stay tier-1 as the
+    # hierarchy's core guard.
+    @pytest.mark.slow
+    def test_park_only_when_host_tier_disabled(self, params):
+        eng = _tight_engine(params, host_kv_blocks=0, name="swap-off")
+        try:
+            victim = eng.submit(LONG, 5, priority=0)
+            eng.tick()
+            eng.submit(SHORT, 5, priority=5)
+            eng.tick()
+            # No host tier: the high-priority head PARKS (pre-hierarchy
+            # behavior), the low-priority decode keeps its row.
+            assert eng.request(victim).preemptions == 0
+            assert eng.queue_depth == 1
+            self._drain_conserved(eng)
+            assert eng.kv_block_stats["preemptions_total"] == 0
+        finally:
+            eng.close()
+
+    @pytest.mark.slow
+    def test_equal_priority_never_preempts(self, params):
+        eng = _tight_engine(params, name="swap-eq")
+        try:
+            first = eng.submit(LONG, 5)
+            eng.tick()
+            eng.submit(SHORT, 5)  # same (default) priority: must wait
+            eng.tick()
+            assert eng.request(first).preemptions == 0
+            self._drain_conserved(eng)
+            assert eng.kv_block_stats["preemptions_total"] == 0
+        finally:
+            eng.close()
+
+    @pytest.mark.slow
+    def test_priority_orders_admission_fifo_within_class(self, params):
+        # Roomy pool, one slot: admission order is pure head selection.
+        eng = ServeEngine(
+            params, CFG, slots=1, prompt_slots=8, max_new_cap=2,
+            prefix_window=2, name="swap-prio",
+        )
+        try:
+            low1 = eng.submit([1, 2], 2, priority=0)
+            low2 = eng.submit([3, 4], 2, priority=0)
+            high = eng.submit([5, 6], 2, priority=7)
+            done = [r.id for r in eng.run()]
+            assert done.index(high) < done.index(low1) < done.index(low2)
+        finally:
+            eng.close()
+
+    def test_trimmed_entry_reextends_through_admission(self, params):
+        # The shrink-then-regrow contract END TO END: a trimmed entry's
+        # full run still sits in the radix tree, so the admission gate
+        # must park on entry LENGTH, not on the raw tree match — else
+        # the stub never re-extends and every future admission
+        # recomputes the trimmed tail forever.
+        eng = ServeEngine(
+            params, CFG, slots=2, prompt_slots=8, max_new_cap=5,
+            prefix_window=2, prefix_cache_slots=4, name="swap-regrow",
+        )
+        try:
+            eng.submit(LONG, 5)  # LONG: 6 tokens = 3 full windows
+            eng.run()
+            (entry,) = eng._prefix.export_blocks()
+            assert entry["length"] == 6 and len(entry["blocks"]) == 3
+            assert eng._prefix.evict_one(current_step=eng.device_steps)
+            (entry,) = eng._prefix.export_blocks()
+            assert entry["length"] == 4 and len(entry["blocks"]) == 2
+            rid = eng.submit(LONG, 5)  # re-admission recomputes the tail
+            eng.run()
+            assert_kv_conserved(eng)
+            (entry,) = eng._prefix.export_blocks()
+            assert entry["length"] == 6 and len(entry["blocks"]) == 3
+            assert eng.request(rid).prefix_reused == 4  # aliased the stub
+            assert eng.request(rid).tokens == list(
+                isolated(params, CFG, LONG, 5)
+            )
+        finally:
+            eng.close()
+
+    def test_knob_validation(self, params):
+        with pytest.raises(ValueError, match="host_kv_blocks"):
+            _tight_engine(params, host_kv_blocks=-1)
+        with pytest.raises(ValueError, match="host_kv_blocks"):
+            ServeEngine(
+                params, CFG, slots=1, prompt_slots=8, max_new_cap=2,
+                kv_layout="rows", host_kv_blocks=4,
+            )
+        with pytest.raises(ValueError, match="swap_policy"):
+            ServeEngine(
+                params, CFG, slots=1, prompt_slots=8, max_new_cap=2,
+                kv_layout="rows", swap_policy=AgeHeatPolicy(),
+            )
+        eng = _tight_engine(params, name="swap-val")
+        try:
+            with pytest.raises(ValueError, match="priority"):
+                eng.submit(SHORT, 2, priority=True)
+            with pytest.raises(ValueError, match="priority"):
+                eng.submit(SHORT, 2, priority=2**40)
+            assert eng.queue_depth == 0  # rejected submits leave it clean
+        finally:
+            eng.close()
+
+
+@pytest.mark.slow
+class TestSwapChurn:
+    """Heavier flows: prefix-cache interaction and randomized churn —
+    CI --runslow keeps them, tier-1 stays inside its budget."""
+
+    def test_preempt_with_prefix_cache_releases_pins(self, params):
+        # Floor + cache headroom: the victim's admission parks a prefix
+        # entry and pins it; swap-out must release the pin so the
+        # block-granular LRU can reclaim the entry's blocks.
+        eng = ServeEngine(
+            params, CFG, slots=2, prompt_slots=8, max_new_cap=5,
+            prefix_window=2, prefix_cache_slots=2, kv_blocks=12,
+            name="swap-pins",
+        )
+        try:
+            victim = eng.submit(LONG, 5, priority=0)
+            eng.tick()
+            assert_kv_conserved(eng)
+            preemptor = eng.submit(SHORT + [4, 5, 6], 5, priority=5)
+            for _ in range(200):
+                if not eng.pending:
+                    break
+                eng.tick()
+                assert_kv_conserved(eng)
+            v = eng.request(victim)
+            assert v.preemptions >= 1
+            assert v.tokens == list(isolated(params, CFG, LONG, 5))
+            assert eng.request(preemptor).tokens == list(
+                isolated(params, CFG, SHORT + [4, 5, 6], 5)
+            )
+        finally:
+            eng.close()
+
+    def test_randomized_priority_churn_conserves_and_matches(self, params):
+        import jax
+
+        eng = ServeEngine(
+            params, CFG, slots=2, prompt_slots=8, max_new_cap=4,
+            prefix_window=2, prefix_cache_slots=2, kv_blocks=10,
+            name="swap-churn",
+        )
+        try:
+            key = jax.random.PRNGKey(3)
+            reqs = []
+            for i in range(12):
+                key, k1, k2 = jax.random.split(key, 3)
+                n = int(jax.random.randint(k1, (), 2, 8))
+                prompt = [
+                    int(x)
+                    for x in jax.random.randint(k2, (n,), 0, CFG.vocab)
+                ]
+                reqs.append((prompt, 2 + i % 3, i % 3))
+            ids = [
+                eng.submit(p, b, priority=pr) for p, b, pr in reqs
+            ]
+            for _ in range(400):
+                if not eng.pending:
+                    break
+                eng.tick()
+                assert_kv_conserved(eng)
+            assert not eng.pending
+            for rid, (prompt, budget, _) in zip(ids, reqs):
+                assert eng.request(rid).tokens == list(
+                    isolated(params, CFG, prompt, budget)
+                ), rid
+        finally:
+            eng.close()

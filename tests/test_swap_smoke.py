@@ -1,0 +1,150 @@
+"""`make swap-smoke` — the KV memory hierarchy end to end, in CI
+seconds: a floor-sized paged engine preempts a low-priority mid-decode
+request for a high-priority arrival (swap-out to the host tier), the
+parked state is visible over HTTP (`tpu_dra_serve_kv_blocks{state=
+"host"}`, `tpu_dra_serve_kv_swaps_total{direction}`, the /debug/kv host
+-tier line), the victim swaps back in and finishes TOKEN-IDENTICALLY to
+an uncontended run, and `KVSwapThrash` completes pending -> firing ->
+resolved over injected-clock scrapes of a thrashing pool."""
+
+import gc
+import json
+import urllib.request
+
+import pytest
+
+from tpu_dra.obs.alerts import AlertFlightRecorder, kv_swap_thrash
+from tpu_dra.obs.collector import Endpoint, ObsCollector
+from tpu_dra.parallel.burnin import BurninConfig, init_params
+from tpu_dra.parallel.serve import ServeEngine
+from tpu_dra.utils.metrics import MetricsServer
+
+from helpers import assert_kv_conserved, metric_total, metric_value
+
+CFG = BurninConfig(
+    vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=32, batch=4
+)
+LONG = [5, 9, 2, 7, 11, 3]
+SHORT = [1, 2, 3]
+
+
+@pytest.fixture(scope="module")
+def rig():
+    gc.collect()  # retire dead engines' weakref series first
+    params = init_params(CFG)
+    # kv_blocks at the floor (one worst-case request + scratch): any
+    # second admission must preempt or park — preemption is the point.
+    eng = ServeEngine(
+        params, CFG, slots=2, prompt_slots=8, max_new_cap=5,
+        prefix_window=2, kv_blocks=8, name="swap-smoke",
+    )
+    srv = MetricsServer("127.0.0.1:0")
+    srv.start()
+    yield params, eng, f"http://127.0.0.1:{srv.port}"
+    srv.stop()
+    eng.close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def test_swap_story_over_http(rig):
+    params, eng, url = rig
+    from test_serve import isolated
+
+    # -- 1. preempt: the low-priority long loses its row mid-decode ----------
+    victim = eng.submit(LONG, 5, priority=0)
+    eng.tick()
+    assert eng.occupancy == 1
+    preemptor = eng.submit(SHORT, 5, priority=5)
+    eng.tick()
+    assert_kv_conserved(eng)
+    v = eng.request(victim)
+    assert v.swapped and v.preemptions == 1 and v.preempted_by == [preemptor]
+
+    # -- 2. the parked state is HTTP-visible ---------------------------------
+    text = _get(url + "/metrics")
+    assert metric_value(
+        text, "tpu_dra_serve_kv_blocks", engine="swap-smoke", state="host"
+    ) == v.swap_out_blocks
+    assert metric_total(
+        text, "tpu_dra_serve_kv_swaps_total",
+        engine="swap-smoke", direction="out",
+    ) == v.swap_out_blocks
+    doc = json.loads(_get(url + "/debug/kv?engine=swap-smoke"))
+    (e,) = doc["engines"]
+    assert e["blocks_host"] == v.swap_out_blocks
+    assert e["swap_out_blocks_total"] == v.swap_out_blocks
+    assert e["preemptions_total"] == 1
+    kv_text = _get(url + "/debug/kv?format=text")
+    assert "host tier:" in kv_text and "preemption(s)" in kv_text
+
+    # -- 3. swap-in restores token-identically -------------------------------
+    for _ in range(200):
+        if not eng.pending:
+            break
+        eng.tick()
+        assert_kv_conserved(eng)
+    v, p = eng.request(victim), eng.request(preemptor)
+    assert not v.swapped and v.done and p.done
+    assert v.tokens == list(isolated(params, CFG, LONG, 5))
+    assert p.tokens == list(isolated(params, CFG, SHORT, 5))
+    text = _get(url + "/metrics")
+    assert metric_total(
+        text, "tpu_dra_serve_kv_swaps_total",
+        engine="swap-smoke", direction="in",
+    ) == v.swap_in_blocks
+    assert metric_value(
+        text, "tpu_dra_serve_kv_blocks", engine="swap-smoke", state="host"
+    ) == 0
+
+    # -- 4. /debug/engine carries the preemption counts ----------------------
+    engine_doc = json.loads(_get(url + "/debug/engine?engine=swap-smoke"))
+    assert sum(s["preempted"] for s in engine_doc["steps"]) == 1
+    assert sum(s["swapped_in"] for s in engine_doc["steps"]) == 1
+
+    # -- 5. KVSwapThrash lifecycle over the collector ------------------------
+    recorder = AlertFlightRecorder()
+    collector = ObsCollector(
+        [Endpoint(url, name="serve")],
+        rules=[
+            kv_swap_thrash(
+                swap_in_per_s=0.1, free_frac_threshold=0.5,
+                window_s=8.0, for_s=2.0,
+            )
+        ],
+        recorder=recorder,
+    )
+    try:
+        collector.scrape_once(now_mono=1000.0)
+        assert collector.engine.status()[0]["state"] == "ok"
+        # Thrash: another preemption cycle lands swap-IN traffic inside
+        # the rate window while the floor-sized pool stays full.
+        vic2 = eng.submit(LONG, 5, priority=0)
+        eng.tick()
+        pre2 = eng.submit(SHORT + [4], 5, priority=5)
+        eng.tick()  # preempts vic2 (swap-out)
+        while not eng.request(pre2).done:
+            eng.tick()  # drains the preemptor
+        eng.tick()  # vic2 swaps back IN and is mid-decode: pool full
+        assert eng.request(vic2).swap_in_blocks > 0
+        assert not eng.request(vic2).done
+        assert_kv_conserved(eng)
+        events = collector.scrape_once(now_mono=1004.0)
+        assert [ev.state for ev in events] == ["pending"]
+        events = collector.scrape_once(now_mono=1006.5)  # for_s elapsed
+        assert [ev.state for ev in events] == ["firing"]
+        # Recovery: the pool drains, swap-in traffic stops, free returns.
+        eng.run()
+        assert eng.request(vic2).tokens == list(
+            isolated(params, CFG, LONG, 5)
+        )
+        events = collector.scrape_once(now_mono=1030.0)
+        assert [ev.state for ev in events] == ["resolved"]
+        assert [ev.state for ev in recorder.query()] == [
+            "pending", "firing", "resolved"
+        ]
+    finally:
+        collector.close()

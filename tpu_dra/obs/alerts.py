@@ -20,8 +20,8 @@ The default rule set covers the failure modes the existing planes
 actually exhibit: serve-goodput SLO **burn rate** (error budget spent
 per unit time, the SRE-workbook shape), fleet queue growth, claim
 eviction spikes (node kills), prefix-digest staleness, paged KV pool
-pressure (free blocks low while zero-copy sharing falls), and
-scrape-down.
+pressure (free blocks low while zero-copy sharing falls), KV swap
+thrash (sustained host-tier swap-in on a full pool), and scrape-down.
 
 Rule expressions receive the collector itself and use its view protocol
 (``rate`` / ``delta`` / ``max_value`` / ``endpoint_health``), so custom
@@ -471,6 +471,53 @@ def kv_pool_pressure(
     )
 
 
+def kv_swap_thrash(
+    *,
+    swap_in_per_s: float = 1.0,
+    free_frac_threshold: float = 0.25,
+    window_s: float = 60.0,
+    for_s: float = 0.0,
+) -> AlertRule:
+    """KV memory hierarchy thrashing: a sustained swap-IN rate
+    (``tpu_dra_serve_kv_swaps_total{direction="in"}``) while the device
+    pool stays nearly full — preempted requests are being restored only
+    to be preempted again, so the pool is cycling the same blocks
+    through the host tier instead of making progress.  Swap-OUT alone
+    does not fire (one preemption under a burst is the hierarchy
+    WORKING); it is the restore traffic on a pool with no headroom that
+    marks the working set as genuinely larger than HBM + scheduler
+    churn — the operator's cue to add replicas, shrink contexts, or
+    raise the interactive tier's capacity."""
+
+    def expr(view):
+        free = view.value("tpu_dra_serve_kv_blocks", state="free")
+        allocated = view.value("tpu_dra_serve_kv_blocks", state="allocated")
+        if free is None or allocated is None or free + allocated <= 0:
+            return False, 0.0, "no paged KV pools exposed"
+        frac = free / (free + allocated)
+        rate_in = view.rate(
+            "tpu_dra_serve_kv_swaps_total",
+            window_s=window_s,
+            direction="in",
+        )
+        return (
+            rate_in >= swap_in_per_s and frac < free_frac_threshold,
+            round(rate_in, 4),
+            f"swap-in {rate_in:.2f} blocks/s with free {frac:.1%} "
+            "of pool",
+        )
+
+    return AlertRule(
+        name="KVSwapThrash",
+        expr=expr,
+        for_s=for_s,
+        severity="warn",
+        description=f"host-tier swap-in rate >= {swap_in_per_s:g} "
+        f"blocks/s while free blocks < {free_frac_threshold:.0%} of "
+        "pool (requests cycling through the swap tier)",
+    )
+
+
 def scrape_down(*, for_s: float = 0.0) -> AlertRule:
     """One or more scrape targets unreachable — the observability plane's
     own liveness.  Fires from scrape health, not from scraped data, so
@@ -511,5 +558,6 @@ def default_rules(
         eviction_spike(window_s=window_s, for_s=for_s),
         digest_staleness(stale_after_s=max(window_s * 5, 1.0), for_s=for_s),
         kv_pool_pressure(window_s=window_s, for_s=for_s),
+        kv_swap_thrash(window_s=window_s, for_s=for_s),
         scrape_down(for_s=for_s),
     ]

@@ -5,7 +5,7 @@ top`` / ``tpudra alerts`` renderings.
 -endpoint scrape health plus the handful of derived signals an operator
 triages by (span throughput, serve occupancy/queue, goodput, eviction
 and rejection rates, the dominant step phase, paged-KV free-block
-fraction, and wasted steps — each computed from the series rings over a
+fraction, host-tier swap rate, and wasted steps — each computed from the series rings over a
 query-able window), current alert status, and the recent alert
 transitions.
 ``render_text`` is the same document as a terminal dashboard (what
@@ -72,12 +72,32 @@ def endpoint_row(collector, health: dict, window_s: float) -> dict:
     kv_free_frac = None
     if kv_free is not None and kv_alloc is not None and kv_free + kv_alloc > 0:
         kv_free_frac = round(kv_free / (kv_free + kv_alloc), 3)
+    # Swap traffic (the KV memory hierarchy): blocks/s moving between
+    # HBM and the host tier, both directions summed — None when the
+    # endpoint has never exposed the series (absent is not zero; a
+    # rows-layout or pre-hierarchy endpoint has no swap tier).
+    swaps_per_s = None
+    if (
+        collector.value(
+            "tpu_dra_serve_kv_swaps_total", endpoint=name
+        )
+        is not None
+    ):
+        swaps_per_s = round(
+            collector.rate(
+                "tpu_dra_serve_kv_swaps_total",
+                window_s=window_s,
+                endpoint=name,
+            ),
+            3,
+        )
     out = dict(health)
     out.update(
         {
             "dominant_phase": dominant_phase,
             "dominant_phase_frac": dominant_phase_frac,
             "kv_free_frac": kv_free_frac,
+            "swaps_per_s": swaps_per_s,
             "wasted_steps": collector.value(
                 "tpu_dra_serve_wasted_steps_total", endpoint=name
             ),
@@ -179,7 +199,7 @@ def render_text(doc: dict) -> str:
         f"{'endpoint':<22} {'up':<4} {'stale_s':>7} {'scrape_ms':>9} "
         f"{'series':>6} {'spans/s':>8} {'occ':>5} {'queue':>5} "
         f"{'goodput':>7} {'evic/s':>7} {'rej/s':>7} {'phase':>12} "
-        f"{'kvfree':>6} {'wasted':>6}"
+        f"{'kvfree':>6} {'swap/s':>6} {'wasted':>6}"
     )
     for row in doc["endpoints"]:
         if row.get("dominant_phase"):
@@ -198,6 +218,7 @@ def render_text(doc: dict) -> str:
             f"{_fmt(row['goodput'], 7, 3)} {_fmt(row['evictions_per_s'], 7, 3)} "
             f"{_fmt(row['rejections_per_s'], 7, 3)} {phase:>12} "
             f"{_fmt(row.get('kv_free_frac'), 6, 3)} "
+            f"{_fmt(row.get('swaps_per_s'), 6, 1)} "
             f"{_fmt(row.get('wasted_steps'), 6, 0)}"
         )
     if not doc["endpoints"]:

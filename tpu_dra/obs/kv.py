@@ -20,10 +20,13 @@ for the CLI, byte-identical to the server's text form.
 
 Snapshot contract (what a provider returns; `ServeEngine.kv_snapshot`):
 ``engine``, ``block_size``, ``device_steps``, the four
-``blocks_total/free/allocated/aliased`` counts, the cumulative
-``alias/cow/alloc_blocks_total`` admission counters, ``free_runs`` (the
-contiguous free-run lengths), and ``blocks`` — one record per allocated
-block with ``refcount``, ``origin`` (computed | cow), ``birth_step``,
+``blocks_total/free/allocated/aliased`` counts, the host-tier fields
+``blocks_host`` / ``host_capacity`` / ``swap_out_blocks_total`` /
+``swap_in_blocks_total`` / ``preemptions_total`` (docs/SERVING.md "KV
+memory hierarchy"), the cumulative ``alias/cow/alloc_blocks_total``
+admission counters, ``free_runs`` (the contiguous free-run lengths),
+and ``blocks`` — one record per allocated block with ``refcount``,
+``origin`` (computed | cow | swapin), ``birth_step``,
 ``last_touch_step``, ``idle_steps``, ``age_s``, and resolved ``owners``
 tags (``req:<id>`` table cells, ``entry:<len>t`` radix entries).
 """
@@ -151,6 +154,11 @@ def engine_doc(snap: dict, limit: int = 256) -> dict:
         "alias_blocks_total": snap.get("alias_blocks_total", 0),
         "cow_blocks_total": snap.get("cow_blocks_total", 0),
         "alloc_blocks_total": snap.get("alloc_blocks_total", 0),
+        "blocks_host": snap.get("blocks_host", 0),
+        "host_capacity": snap.get("host_capacity", 0),
+        "swap_out_blocks_total": snap.get("swap_out_blocks_total", 0),
+        "swap_in_blocks_total": snap.get("swap_in_blocks_total", 0),
+        "preemptions_total": snap.get("preemptions_total", 0),
         "age_histogram": _bucketize(
             (b["age_s"] for b in blocks), AGE_BUCKETS_S
         ),
@@ -212,6 +220,15 @@ def render_text(doc: dict) -> str:
             f"{e['alias_blocks_total']} aliased zero-copy, "
             f"{e['cow_blocks_total']} COW"
         )
+        if e["host_capacity"]:
+            out.append(
+                f"  host tier: {e['blocks_host']}/{e['host_capacity']} "
+                f"block(s) resident, {e['swap_out_blocks_total']} "
+                f"swapped out / {e['swap_in_blocks_total']} in, "
+                f"{e['preemptions_total']} preemption(s)"
+            )
+        else:
+            out.append("  host tier: disabled (park-only admission)")
         frag = e["fragmentation"]
         out.append(
             f"  fragmentation: {frag['free_blocks']} free in "

@@ -64,6 +64,8 @@ __all__ = [
     "init_block_pool",
     "make_paged_prefill",
     "paged_decode_step_rows",
+    "read_block",
+    "write_block",
 ]
 
 
@@ -342,6 +344,37 @@ def make_paged_prefill(config: BurninConfig, mesh, prompt_slots: int,
     return prefill
 
 
+def read_block(pool, src):
+    """Slice physical block ``src`` out of the pool — every layer, both
+    storage formats, ``src`` traced (ONE executable for any block).  The
+    swap-OUT primitive: the engine ``device_get``s the result into the
+    host tier (`swap.HostBlockPool`), a bounded per-block DMA.  Leaves
+    keep the sliced blocks axis (``(L, 1, W, H, d_head)``) so
+    `write_block` can write the same tree back verbatim."""
+    import jax
+
+    def leaf(b):
+        return jax.lax.dynamic_slice_in_dim(b, src, 1, axis=1)
+
+    return jax.tree_util.tree_map(leaf, pool)
+
+
+def write_block(pool, dst, data):
+    """Write a `read_block`-shaped single-block tree into physical block
+    ``dst`` (traced — one executable; callers donate the pool).  The
+    swap-IN primitive: ``data`` is the host-tier payload exactly as
+    `read_block` fetched it, so the round trip is bit-identical and a
+    swapped request's restored KV equals its never-swapped KV."""
+    import jax
+
+    def leaf(b, d):
+        return jax.lax.dynamic_update_slice_in_dim(
+            b, d.astype(b.dtype), dst, axis=1
+        )
+
+    return jax.tree_util.tree_map(leaf, pool, data)
+
+
 def copy_block(pool, dst, src):
     """Copy physical block ``src`` into block ``dst`` (every layer, both
     storage formats; ``dst``/``src`` may be traced — one executable for
@@ -379,8 +412,10 @@ class BlockAllocator:
     birth/last-touch step (the caller's device-step counter), and origin
     (``computed`` for fresh prefill blocks, ``cow`` for copy-on-write
     privatizations) — maintained only on the alloc/ref/unref paths
-    (admission and finish), never per token.  Freeing a block observes
-    its residency lifetime into
+    (admission and finish), never per token.  Origins: ``computed``
+    (fresh prefill), ``cow`` (copy-on-write privatization), ``swapin``
+    (restored from the host swap tier).  Freeing a block observes its
+    residency lifetime into
     ``tpu_dra_serve_kv_block_age_seconds{engine=name}``."""
 
     def __init__(self, num_blocks: int, name: str = ""):
@@ -423,6 +458,13 @@ class BlockAllocator:
 
     def refcount(self, block: int) -> int:
         return self._ref[block]
+
+    def last_touch_step(self, block: int) -> int:
+        """Device step of the block's last ownership event (alloc /
+        ref / unref) — the per-block heat signal the block-granular LRU
+        (`prefixcache.PagedPrefixCache.evict_one`) and the swap victim
+        policy (`swap.AgeHeatPolicy`) rank coldness by."""
+        return self._touch_step[block]
 
     def alloc(self, n: int, *, step: int = 0,
               origin: str = "computed") -> "list[int] | None":

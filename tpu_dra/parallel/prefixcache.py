@@ -430,23 +430,49 @@ class PagedPrefixCache(_RadixIndex):
 
     ``max_entries`` caps the RESIDENT ENTRY count (the knob the engine's
     ``prefix_cache_slots`` maps to); the real storage bound is the block
-    pool, enforced by the engine's admission control via `evict_one`."""
+    pool, enforced by the engine's admission control via `evict_one`.
 
-    def __init__(self, max_entries: int, allocator):
+    Eviction is BLOCK-GRANULAR when ``block_size`` is given (the engine
+    always passes its block width): under pressure `evict_one` trims the
+    coldest unpinned entry's TAIL block — the shared hot head (the part
+    every family member aliases) stays resident while the cold
+    per-prompt tail returns to the pool, so entries SHRINK before they
+    die.  Coldness comes from the allocator's per-block heat records
+    (`BlockAllocator.last_touch_step`), and tails whose release would
+    actually free a block (refcount 1) outrank still-shared ones.  An
+    entry trimmed below one usable window is detached outright (a
+    sub-window stub can never clear ``min_use``), and a later admission
+    of the full run RE-EXTENDS the trimmed entry (`insert` swaps in the
+    recomputed block list).  Without ``block_size`` (direct test
+    constructions) `evict_one` falls back to whole-entry eviction."""
+
+    def __init__(self, max_entries: int, allocator,
+                 *, block_size: "int | None" = None):
         if max_entries < 1:
             raise ValueError(
                 f"prefix pool needs at least one slot, got {max_entries}"
             )
+        if block_size is not None and block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {block_size}"
+            )
         super().__init__()
         self.pool_slots = max_entries
         self._alloc = allocator
+        self._block_size = block_size
+        # Tail blocks trimmed off still-resident entries (the partial
+        # evictions `evictions` does not count — that stays whole-entry
+        # deaths, the series consumers already chart).
+        self.trimmed_blocks = 0
 
-    def evict_one(self) -> bool:
-        """Evict the LRU unpinned entry, dropping its block references
-        (blocks free only when no live table still points at them).
-        False when every entry is pinned by mid-decode rows — the
-        engine's admission control then parks the request instead of
-        corrupting a pinned prefix."""
+    def evict_entry(self) -> bool:
+        """Evict the LRU unpinned entry WHOLE, dropping its block
+        references (blocks free only when no live table still points at
+        them).  False when every entry is pinned by mid-decode rows —
+        the engine's admission control then parks the request instead
+        of corrupting a pinned prefix.  The entry-cap path (`insert`)
+        uses this form directly: the cap bounds entry COUNT, which only
+        a whole-entry death reduces."""
         victim = self._pick_victim()
         if victim is None:
             return False
@@ -459,6 +485,54 @@ class PagedPrefixCache(_RadixIndex):
         SERVE_PREFIX_EVICTIONS.inc()
         return True
 
+    def _trim_victim(self) -> "PrefixEntry | None":
+        """The unpinned entry with the COLDEST tail block: freeable
+        (refcount 1) tails first, then least-recently-touched block,
+        then LRU entry — the block-granular analog of `_pick_victim`."""
+        best = None
+        best_key = None
+        for e in self._entries:
+            if e.refcount > 0 or not e.blocks:
+                continue
+            tail = e.blocks[-1]
+            key = (
+                self._alloc.refcount(tail) > 1,
+                self._alloc.last_touch_step(tail),
+                e.last_used,
+            )
+            if best is None or key < best_key:
+                best, best_key = e, key
+        return best
+
+    def evict_one(self, current_step: "int | None" = None) -> bool:
+        """Release one block's worth of cache claim, coldest-tail-first
+        (see the class docstring); ``current_step`` stamps the
+        allocator's heat records.  False when every entry is pinned —
+        the engine then escalates to preemption or parks."""
+        if self._block_size is None:
+            return self.evict_entry()
+        victim = self._trim_victim()
+        if victim is None:
+            return False
+        tail = victim.blocks.pop()
+        self._alloc.unref([tail], step=current_step)
+        self.trimmed_blocks += 1
+        new_len = len(victim.blocks) * self._block_size
+        if new_len >= self._block_size:
+            # Shrink: the head stays usable at the new window-aligned
+            # length (match/peek cap on entry.length, so the tree needs
+            # no surgery).  Residency changed — digests must refresh.
+            victim.length = min(victim.length, new_len)
+            self.epoch += 1
+        else:
+            # Trimmed below one window: a stub no lookup can use.
+            victim.blocks = None
+            self._detach(victim)
+            self.evictions += 1
+            self.epoch += 1
+            SERVE_PREFIX_EVICTIONS.inc()
+        return True
+
     def insert(self, tokens: "list[int]",
                blocks: "list[int]") -> "PrefixEntry | None":
         """Index ``tokens`` as a resident prefix backed by ``blocks``
@@ -466,15 +540,29 @@ class PagedPrefixCache(_RadixIndex):
         them — the entry takes one allocator reference per block, the
         caller keeps its own).  Pre-pinned like the row form; returns
         the EXISTING entry (blocks untouched) when the exact run is
-        already resident, and ``None`` when the entry cap is reached
-        with every resident entry pinned."""
+        already resident AT FULL LENGTH — an entry the block-granular
+        LRU trimmed is RE-EXTENDED instead (the admission recomputed
+        the whole prompt, so its block list replaces the stub's).
+        ``None`` when the entry cap is reached with every resident
+        entry pinned."""
         if not tokens:
             raise ValueError("cannot index an empty prefix")
         existing = self._exact_resident(tokens)
         if existing is not None:
+            if existing.length < len(tokens):
+                # Re-extension: ref the new list BEFORE unreffing the
+                # old — the shared head blocks appear in both, and a
+                # transient zero refcount would free them under a live
+                # table.
+                old = existing.blocks or []
+                self._alloc.ref(blocks)
+                existing.blocks = list(blocks)
+                existing.length = len(tokens)
+                self._alloc.unref(old)
+                self.epoch += 1
             self.acquire(existing)
             return existing
-        if len(self._entries) >= self.pool_slots and not self.evict_one():
+        if len(self._entries) >= self.pool_slots and not self.evict_entry():
             return None
         self._alloc.ref(blocks)
         node = self._attach(tokens)
@@ -482,6 +570,11 @@ class PagedPrefixCache(_RadixIndex):
             slot=-1, length=len(tokens), refcount=1, blocks=list(blocks)
         )
         return self._register(entry, node)
+
+    def stats(self) -> "dict[str, int]":
+        out = super().stats()
+        out["trimmed_blocks"] = self.trimmed_blocks
+        return out
 
     def export_blocks(self) -> "list[dict]":
         """The resident entries' block holdings as plain data — one dict

@@ -62,10 +62,14 @@ free (the entry refs the blocks admission just wrote), and the one
 block a parked entry shares writably with its live request — the
 partial last prompt block — is privatized by an eager COW copy, so
 shared blocks are never written.  Free-block accounting doubles as real
-admission control: the FIFO head admits only when its worst-case block
-demand (minus the alias credit) fits, evicting unpinned LRU entries
-under pressure and PARKING in the queue when every block is pinned by
-mid-decode rows.
+admission control: the admission head (highest ``submit(priority=)``,
+strict FIFO within a class) admits only when its worst-case block
+demand (minus the alias credit) fits, escalating under pressure
+through block-granular LRU eviction of unpinned entries (cold tail
+blocks first) and — on engines with a host swap tier — PREEMPTION of a
+strictly-lower-priority mid-decode row (its blocks swap to pinned host
+memory and restore token-identically; docs/SERVING.md "KV memory
+hierarchy"), PARKING in the queue only when all three rungs fail.
 
 The determinism contracts below hold with the cache ON or OFF and with
 either layout (greedy outputs are token-identical — copied/aliased KV
@@ -160,8 +164,11 @@ from tpu_dra.parallel.paged import (
     init_block_pool,
     make_paged_prefill,
     paged_decode_step_rows,
+    read_block,
+    write_block,
 )
 from tpu_dra.parallel.prefixcache import PagedPrefixCache, PrefixCache
+from tpu_dra.parallel.swap import AgeHeatPolicy, HostBlockPool
 from tpu_dra.utils import servestats, trace
 from tpu_dra.utils.metrics import (
     SERVE_BATCH_OCCUPANCY,
@@ -169,6 +176,7 @@ from tpu_dra.utils.metrics import (
     SERVE_KV_BLOCKS,
     SERVE_KV_COW,
     SERVE_KV_FREE_RUN_BLOCKS,
+    SERVE_KV_SWAPS,
     SERVE_PREFILL_TOKENS,
     SERVE_QUEUE_DEPTH,
     SERVE_QUEUE_WAIT_SECONDS,
@@ -229,6 +237,13 @@ class Request:
     prompt: "list[int]"
     max_new: int
     seed: int = 0  # sampling: randomness is f(seed, position) only
+    # Admission priority (higher admits first; equal priorities are
+    # strict FIFO).  On paged engines with a host swap tier, a waiting
+    # higher-priority request may PREEMPT a strictly-lower-priority
+    # mid-decode row: its blocks swap to host and it resumes
+    # token-identically once pressure clears (docs/SERVING.md "KV
+    # memory hierarchy").
+    priority: int = 0
     stop_sequences: "list[list[int]]" = field(default_factory=list)
     tokens: "list[int]" = field(default_factory=list)  # generated only
     # Raw-model log-probability of each generated token (same convention
@@ -249,6 +264,18 @@ class Request:
     # footprint the bench's kv_blocks_per_req percentiles report.  0 on
     # row-layout engines.
     kv_blocks: int = 0
+    # Preemption surface — "why was I preempted" stays answerable from
+    # the Request alone (and from /debug/engine's per-tick preempted
+    # counts): how many times this request was swapped out to the host
+    # tier, which request ids displaced it, whether it is parked on
+    # host RIGHT NOW, the blocks DMAed each way, and the total seconds
+    # it spent host-resident (decode stalled, state preserved).
+    preemptions: int = 0
+    preempted_by: "list[int]" = field(default_factory=list)
+    swapped: bool = False
+    swap_out_blocks: int = 0
+    swap_in_blocks: int = 0
+    swapped_s: float = 0.0
     submitted_at: float = 0.0
     ttft_s: float = 0.0
     # The engine that served this request (ServeEngine.name, stamped at
@@ -281,6 +308,7 @@ class Request:
     trace_id: str = ""
     trace_ctx: "object | None" = field(default=None, repr=False)
     _last_token_at: float = field(default=0.0, repr=False)
+    _swapped_at: float = field(default=0.0, repr=False)
 
 
 class ServeEngine:
@@ -326,6 +354,20 @@ class ServeEngine:
     prefix_cache_slots * prompt_slots / W + slots``); must cover at
     least one worst-case request.  Greedy outputs are token-identical across
     layouts (pinned by ``tests/test_paged.py``).
+
+    ``host_kv_blocks`` (paged only): capacity of the HOST swap tier in
+    blocks (docs/SERVING.md "KV memory hierarchy"; default 2x the
+    usable device pool, lazily allocated; 0 disables swap — the
+    park-only engine).  With the tier on, a waiting request may
+    PREEMPT a strictly-lower-priority mid-decode row: the victim's
+    blocks DMA to host (`paged.read_block` per block — a table rewrite
+    plus bounded copies, never a recompute), its row and blocks free
+    immediately, and it swaps back in token-identically once blocks
+    free (``submit(priority=)`` ranks admission; equal priorities stay
+    strict FIFO and never preempt each other).  ``swap_policy``: the
+    victim-selection object (`swap.VictimPolicy`; default
+    `swap.AgeHeatPolicy` — age x heat scored on the allocator's block
+    records, defrag-aware via the free-run signal).
 
     ``prefix_cache_slots``: resident entries in the automatic shared
     -prefix cache (0 = off, the default — admission behavior and memory
@@ -374,6 +416,8 @@ class ServeEngine:
         kv_int8: bool = False,
         kv_layout: "str | None" = None,
         kv_blocks: "int | None" = None,
+        host_kv_blocks: "int | None" = None,
+        swap_policy=None,
         prefix_cache_slots: int = 0,
         prefix_window: "int | None" = None,
         ttft_slo_s: "float | None" = None,
@@ -424,6 +468,20 @@ class ServeEngine:
             )
         if kv_blocks is not None and kv_layout != "paged":
             raise ValueError("kv_blocks only applies to kv_layout='paged'")
+        if host_kv_blocks is not None:
+            if kv_layout != "paged":
+                raise ValueError(
+                    "host_kv_blocks only applies to kv_layout='paged' "
+                    "(the rows layout has no blocks to swap)"
+                )
+            if host_kv_blocks < 0:
+                raise ValueError(
+                    f"host_kv_blocks must be >= 0, got {host_kv_blocks}"
+                )
+        if swap_policy is not None and kv_layout != "paged":
+            raise ValueError(
+                "swap_policy only applies to kv_layout='paged'"
+            )
         self._kv_layout = kv_layout
         if attn_backend not in ("auto", "gather", "pallas"):
             raise ValueError(
@@ -572,6 +630,23 @@ class ServeEngine:
             self._table = np.zeros((slots, self._table_cols), np.int32)
             self._kv_counts = {"alias_blocks": 0, "cow_blocks": 0,
                                "alloc_blocks": 0}
+            # The host swap tier (docs/SERVING.md "KV memory
+            # hierarchy"): a bounded host-side block pool preempted
+            # requests' KV parks in.  Default capacity = 2x the usable
+            # device pool — host RAM is cheap next to HBM and slots are
+            # lazily allocated; 0 disables preemption entirely (the
+            # pre-hierarchy park-only engine, the bench's control arm).
+            host_nb = 2 * (nb - 1) if host_kv_blocks is None else host_kv_blocks
+            self._host_pool = HostBlockPool(host_nb)
+            self._swap_policy = swap_policy or AgeHeatPolicy()
+            # Host-side state of swapped-out requests: req.id -> the
+            # row snapshot swap-in restores (host slots in table-column
+            # order, the frozen position, the pending next token).
+            self._swap_state: "dict[int, dict]" = {}
+            self._swap_counts = {
+                "out_blocks": 0, "in_blocks": 0,
+                "preemptions": 0, "in_requests": 0,
+            }
             if mesh is not None:
                 from jax.sharding import NamedSharding
 
@@ -668,6 +743,7 @@ class ServeEngine:
                 ("free", lambda e: e._balloc.free_count),
                 ("allocated", lambda e: e._balloc.allocated_count),
                 ("aliased", lambda e: e._balloc.aliased_count),
+                ("host", lambda e: e._host_pool.used_count),
             ):
                 SERVE_KV_BLOCKS.set_function(
                     _weak_sampler(ref, sample),
@@ -706,7 +782,7 @@ class ServeEngine:
                 # refcounted block-id lists into THE pool, so parking and
                 # aliasing are host bookkeeping + table writes.
                 self._prefix = PagedPrefixCache(
-                    prefix_cache_slots, self._balloc
+                    prefix_cache_slots, self._balloc, block_size=w
                 )
             else:
                 self._prefix = PrefixCache(
@@ -858,6 +934,10 @@ class ServeEngine:
                 )
                 self._paged_step = jax.jit(step_paged, donate_argnums=(1,))
                 self._copy_block = jax.jit(copy_block, donate_argnums=(0,))
+                # Swap DMA primitives: one executable each (traced
+                # block index; fixed single-block payload shape).
+                self._read_block = jax.jit(read_block)
+                self._write_block = jax.jit(write_block, donate_argnums=(0,))
             else:
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
@@ -876,6 +956,12 @@ class ServeEngine:
                 )
                 self._copy_block = jax.jit(
                     copy_block, donate_argnums=(0,), out_shardings=pool_sh
+                )
+                # Swap DMA on a mesh: the fetched single-block tree is
+                # tiny — replicate it; the pool keeps its serving spec.
+                self._read_block = jax.jit(read_block)
+                self._write_block = jax.jit(
+                    write_block, donate_argnums=(0,), out_shardings=pool_sh
                 )
         else:
             # prefill1's B=1 output is tiny and unsharded either way —
@@ -908,7 +994,8 @@ class ServeEngine:
                seed: "int | None" = None,
                stop_sequences: "list[list[int]] | None" = None,
                use_prefix_cache: bool = True,
-               enqueued_at: "float | None" = None) -> int:
+               enqueued_at: "float | None" = None,
+               priority: int = 0) -> int:
         """Queue a request; returns its id.  Admission happens on `tick`.
         ``seed`` keys this request's sampling (default: the request id) —
         its output depends on (seed, position) only, never on
@@ -923,14 +1010,19 @@ class ServeEngine:
         ever moved EARLIER) — a fleet front-end that parked the request
         in its own queue passes the original arrival time so
         ``queue_wait_s``/``ttft_s`` keep measuring what the USER waited,
-        not what this engine saw.
+        not what this engine saw.  ``priority``: admission priority —
+        the highest-priority waiting request is always the admission
+        head (equal priorities stay strict FIFO), and on paged engines
+        with a host swap tier a waiting request may preempt a
+        strictly-lower-priority mid-decode row (docs/SERVING.md "KV
+        memory hierarchy").
 
         Every contract violation raises HERE, eagerly — a bad prompt
         must never surface later as an opaque failure inside the padded
         admission prefill with other requests mid-flight."""
         self._check_open()
         budget, stops = self.validate_request(
-            prompt, max_new, seed, stop_sequences
+            prompt, max_new, seed, stop_sequences, priority
         )
         now = time.perf_counter()
         # Backdate only: a future enqueued_at would make waits negative.
@@ -939,6 +1031,7 @@ class ServeEngine:
         req = Request(
             id=self._next_id, prompt=list(prompt), max_new=budget,
             seed=self._next_id if seed is None else seed,
+            priority=priority,
             stop_sequences=stops,
             use_prefix_cache=bool(use_prefix_cache),
             submitted_at=t0, enqueued_at=t0,
@@ -954,6 +1047,7 @@ class ServeEngine:
         self, prompt: "list[int]", max_new: "int | None" = None,
         seed: "int | None" = None,
         stop_sequences: "list[list[int]] | None" = None,
+        priority: int = 0,
     ) -> "tuple[int, list[list[int]]]":
         """`submit`'s eager contract checks, callable WITHOUT submitting:
         returns the normalized ``(budget, stop_sequences)``.  A fleet
@@ -987,6 +1081,16 @@ class ServeEngine:
             # Seeds ride to the device as int32; reject here, not with an
             # OverflowError mid-tick after other requests are in flight.
             raise ValueError(f"seed must fit int32, got {seed}")
+        if (
+            isinstance(priority, bool)
+            or not isinstance(priority, int)
+            or not -(2**31) <= priority < 2**31
+        ):
+            # bool is an int subclass and would silently rank True
+            # above every default-priority request.
+            raise ValueError(
+                f"priority must be an int (int32 range), got {priority!r}"
+            )
         stops = [list(s) for s in (stop_sequences or [])]
         if any(not s for s in stops):
             raise ValueError("stop sequences must be non-empty")
@@ -1019,26 +1123,215 @@ class ServeEngine:
         return total_cols - fw + cow, total_cols
 
     def _ensure_admittable(self, req: Request) -> bool:
-        """Block-demand admission control for the FIFO head: evict LRU
-        unpinned prefix entries until ``req``'s worst-case demand fits
-        the free list, or report False (the request PARKS in the queue —
-        pinned entries and live tables are never touched, so a full pool
-        of mid-decode refcounts delays admission instead of corrupting
-        it).  Re-peeks after every eviction: evicting an entry can
-        shrink the very alias credit the demand was counting on."""
+        """Block-demand admission control for the admission head, three
+        escalating rungs (docs/SERVING.md "KV memory hierarchy"):
+
+        1. fit — the head's worst-case demand (a swapped request's
+           exact restore demand) already fits the free list;
+        2. block-granular LRU — trim the coldest unpinned prefix
+           entries' tail blocks (`PagedPrefixCache.evict_one`; the hot
+           shared heads stay resident, entries shrink before they die);
+        3. preempt — swap a STRICTLY-lower-priority mid-decode row's
+           blocks out to the host tier (`_try_preempt`), freeing its
+           row and blocks without losing its progress.
+
+        False parks the head in the queue — pinned entries and live
+        equal/higher-priority tables are never touched, so a full pool
+        delays admission instead of corrupting it.  Re-peeks after
+        every rung: eviction can shrink the very alias credit the
+        demand was counting on."""
         if self._kv_layout != "paged":
             return True
         while True:
-            use = (
-                self._prefix.peek(req.prompt, min_use=self._block_size)
-                if self._prefix is not None and req.use_prefix_cache
-                else 0
-            )
-            need, _ = self._paged_demand(req, use)
+            if req.swapped:
+                # Restore demand is exact: the blocks it held, no alias
+                # credit, no COW (its parked entries were released at
+                # swap-out).
+                need = len(self._swap_state[req.id]["host_slots"])
+            else:
+                use = (
+                    self._prefix.peek(req.prompt, min_use=self._block_size)
+                    if self._prefix is not None and req.use_prefix_cache
+                    else 0
+                )
+                need, _ = self._paged_demand(req, use)
             if self._balloc.free_count >= need:
                 return True
-            if self._prefix is None or not self._prefix.evict_one():
-                return False
+            if self._prefix is not None and self._prefix.evict_one(
+                current_step=self._device_steps
+            ):
+                continue
+            if self._try_preempt(req):
+                continue
+            return False
+
+    def _try_preempt(self, req: Request) -> bool:
+        """Swap ONE mid-decode row out to the host tier to make room
+        for ``req``: candidates are rows whose request has strictly
+        lower priority (equal priorities park, never thrash), has its
+        first token fetched (a row admitted in the current wave is
+        mid-flight device-side), and whose block count fits the host
+        pool's free slots.  The pluggable victim policy ranks them on
+        the allocator's age/heat records and the free-run defrag
+        signal; False (no candidate, no host headroom, or the policy
+        declined) sends the caller to parking."""
+        if self._host_pool.capacity == 0:
+            return False
+        records = None
+        candidates = []
+        for row, victim in enumerate(self._row_req):
+            if (
+                victim is None
+                or victim.priority >= req.priority
+                or not victim.tokens
+            ):
+                continue
+            blocks = [int(b) for b in self._table[row] if b]
+            if len(blocks) > self._host_pool.free_count:
+                continue
+            if records is None:
+                records = {
+                    r["block"]: r
+                    for r in self._balloc.block_records(
+                        current_step=self._device_steps
+                    )
+                }
+            candidates.append(
+                {
+                    "row": row,
+                    "priority": victim.priority,
+                    "blocks": blocks,
+                    "records": records,
+                }
+            )
+        if not candidates:
+            return False
+        free = {
+            b
+            for b in range(1, self._balloc.num_blocks)
+            if self._balloc.refcount(b) == 0
+        }
+        row = self._swap_policy.pick(
+            candidates, free_blocks=free, num_blocks=self._balloc.num_blocks
+        )
+        if row is None or self._row_req[row] is None:
+            return False
+        self._swap_out(row, by=req)
+        return True
+
+    def _swap_out(self, row: int, by: Request) -> None:
+        """Preempt row ``row``: DMA each of its blocks to a host slot
+        (`read_block` + ``device_get`` — bounded, one block at a time,
+        never a recompute), drop the table's device references, release
+        its prefix pins (the entries become evictable — swap exists to
+        free HBM), and park the request back in the queue with its
+        position and pending token frozen.  Swap-in (`_swap_in`)
+        restores the row token-identically."""
+        jax, jnp = _jax_mods()
+
+        req = self._row_req[row]
+        now = time.perf_counter()
+        blocks = [int(b) for b in self._table[row] if b]
+        host_slots = []
+        for b in blocks:
+            data = jax.device_get(self._read_block(self._pool, jnp.int32(b)))
+            slot = self._host_pool.store(data)
+            if slot is None:  # _try_preempt checked the headroom
+                raise RuntimeError(
+                    "host swap accounting violated: pool filled mid-swap"
+                )
+            host_slots.append(slot)
+        self._balloc.unref(blocks, step=self._device_steps)
+        # Zero onto scratch BEFORE the row's blocks can be reallocated
+        # — the frozen row keeps stepping (the _finish discipline).
+        self._table[row, :] = 0
+        for entry in self._row_pins[row]:
+            self._prefix.release(entry)
+        self._row_pins[row] = []
+        self._swap_state[req.id] = {
+            "host_slots": host_slots,
+            "pos": self._pos[row],
+            "tok": self._tok[row],
+        }
+        self._row_req[row] = None
+        req.swapped = True
+        req.preemptions += 1
+        req.preempted_by.append(by.id)
+        req.swap_out_blocks += len(blocks)
+        req._swapped_at = now
+        # Back into the queue: head selection orders by (priority,
+        # enqueued_at), so the victim resumes ahead of younger equals
+        # once blocks free — no special re-queue position needed.
+        self._queue.append(req)
+        self._swap_counts["out_blocks"] += len(blocks)
+        self._swap_counts["preemptions"] += 1
+        SERVE_KV_SWAPS.inc(len(blocks), engine=self.name, direction="out")
+        if self.telemetry:
+            trace.emit_span(
+                "serve.swapout", parent=req.trace_ctx,
+                start_unix_s=_unix_of(now),
+                duration_s=time.perf_counter() - now,
+                request=req.id, blocks=len(blocks),
+                preempted_by=by.id,
+                reason=(
+                    f"preempted by request {by.id} "
+                    f"(priority {by.priority} > {req.priority})"
+                ),
+            )
+
+    def _swap_in(self, req: Request, row: int) -> None:
+        """Restore a swapped-out request into free row ``row``: allocate
+        fresh device blocks, DMA each host slot's payload back in
+        (`write_block` — the exact bytes `_swap_out` fetched, so greedy
+        decode continues token-identically), rebuild the table row, and
+        unfreeze position and pending token.  The caller cleared the
+        demand through `_ensure_admittable`."""
+        jnp = _jax_mods()[1]
+
+        now = time.perf_counter()
+        state = self._swap_state.pop(req.id)
+        host_slots = state["host_slots"]
+        own = self._balloc.alloc(
+            len(host_slots), step=self._device_steps, origin="swapin"
+        )
+        if own is None:
+            raise RuntimeError(
+                "swap-in accounting violated: demand was cleared but "
+                "the allocator came up short"
+            )
+        for b, slot in zip(own, host_slots):
+            self._pool = self._write_block(
+                self._pool, jnp.int32(b), self._host_pool.load(slot)
+            )
+            self._host_pool.free(slot)
+        self._kv_counts["alloc_blocks"] += len(own)
+        table_row = np.zeros((self._table_cols,), np.int32)
+        table_row[: len(own)] = own
+        self._table[row, :] = table_row
+        self._row_req[row] = req
+        self._row_pins[row] = []
+        self._pos[row] = state["pos"]
+        self._tok[row] = state["tok"]
+        req.swapped = False
+        req.swapped_s += now - req._swapped_at
+        req.swap_in_blocks += len(own)
+        # TPOT measures DECODE: the host-parked stall is accounted once
+        # in swapped_s, so the first post-restore token's arrival gap
+        # must start at the restore, not at the pre-preemption token —
+        # otherwise one swap inflates tpot_s/SLO verdicts with
+        # scheduler time on an engine whose decode is healthy.
+        req._last_token_at = now
+        self._swap_counts["in_blocks"] += len(own)
+        self._swap_counts["in_requests"] += 1
+        SERVE_KV_SWAPS.inc(len(own), engine=self.name, direction="in")
+        if self.telemetry:
+            trace.emit_span(
+                "serve.swapin", parent=req.trace_ctx,
+                start_unix_s=_unix_of(req._swapped_at),
+                duration_s=now - req._swapped_at,
+                request=req.id, row=row, blocks=len(own),
+                parked_s=round(now - req._swapped_at, 6),
+            )
 
     def _admit_paged(self, req: Request, row: int, prompt, length: int):
         """One paged admission: match → alias the window-aligned prefix
@@ -1098,13 +1391,23 @@ class ServeEngine:
         )
         if (
             cacheable
-            and m_raw < length
             and length >= w
+            and (
+                m_raw < length
+                or entry is None
+                or entry.length < length
+            )
         ):
             # Park this prompt's blocks for future admissions — unless
-            # the exact prompt is already resident (a duplicate entry
-            # would only waste an index slot) or the prompt is shorter
-            # than one window (a future match could never clear min_use).
+            # the exact prompt is already resident AT FULL LENGTH (a
+            # duplicate entry would only waste an index slot) or the
+            # prompt is shorter than one window (a future match could
+            # never clear min_use).  The extra arms catch entries the
+            # block-granular LRU TRIMMED: the full run still sits in
+            # the radix tree (trimming does no tree surgery, so
+            # m_raw == length), but the usable entry is shorter — this
+            # admission recomputed the tail, and insert() RE-EXTENDS
+            # the stub with the fresh block list (shrink-then-regrow).
             # Parking is free: the entry just refs the blocks the
             # prefill above wrote.  insert() returns None when the
             # resident-entry cap is reached with every entry pinned.
@@ -1204,31 +1507,56 @@ class ServeEngine:
                 pins.append(new_entry)
         return cache1, last, pins
 
+    def _head_index(self) -> int:
+        """The admission head's queue index: highest priority first,
+        earliest original enqueue time among equals — so default
+        -priority traffic stays strict FIFO, and a swapped-out victim
+        (re-queued with its original stamp) resumes ahead of younger
+        requests of its own class the moment blocks free."""
+        best = 0
+        for i in range(1, len(self._queue)):
+            r, b = self._queue[i], self._queue[best]
+            if (r.priority, -r.enqueued_at) > (b.priority, -b.enqueued_at):
+                best = i
+        return best
+
     def _admit(self) -> "tuple[int, int]":
         """Fill free rows from the queue; returns ``(admitted,
-        prefix_hits)`` for this tick's flight-recorder row.  Paged
-        engines additionally gate the FIFO head on block demand: when
-        the head's worst-case need doesn't fit even after evicting every
-        unpinned prefix entry, admission STOPS for this wave (strict
-        FIFO — nothing behind the head jumps it) and retries at the next
-        step or tick, when a finisher may have freed blocks.
+        prefix_hits)`` for this tick's flight-recorder row.  The
+        admission head is the highest-priority waiting request (strict
+        FIFO among equals — nothing jumps its class's head); a head that
+        was preempted earlier swaps back in (`_swap_in`) instead of
+        prefilling.  Paged engines gate the head on block demand: when
+        its worst-case need doesn't fit even after block-granular LRU
+        eviction and (for strictly-lower-priority rows) preemption,
+        admission STOPS for this wave and retries at the next step or
+        tick, when a finisher may have freed blocks.
 
         The whole wave shares ONE first-token call and ONE blocking
         fetch: each admission's prefill leaves its last-position logits
         on device, and every first token + logprob comes back together
         (the module-header fetch contract — per admission wave, never
-        per admitted request)."""
+        per admitted request).  Swap-ins join no wave: their next token
+        is already frozen host-side."""
         jax, jnp = _jax_mods()
 
         t_phase = time.perf_counter()  # the whole wave is admit-phase work
         admitted = hits = 0
         wave: "list[tuple[int, Request, object, float]]" = []
-        for row in range(self.slots):
-            if self._row_req[row] is not None or not self._queue:
-                continue
-            if not self._ensure_admittable(self._queue[0]):
+        while self._queue and any(r is None for r in self._row_req):
+            head = self._head_index()
+            if not self._ensure_admittable(self._queue[head]):
                 break
-            req = self._queue.pop(0)
+            # Re-scan for the row AFTER admission control: preemption
+            # may have freed a different (even lower-numbered) row than
+            # any pre-picked one.
+            row = next(
+                r for r in range(self.slots) if self._row_req[r] is None
+            )
+            req = self._queue.pop(head)
+            if req.swapped:
+                self._swap_in(req, row)
+                continue
             t_admit = time.perf_counter()
             req.admitted_at = t_admit
             req.queue_wait_s = t_admit - req.enqueued_at
@@ -1511,6 +1839,11 @@ class ServeEngine:
             self._phase_acc[p] = 0.0
         done_before = len(self._done)
         toks_before = self._tokens_emitted
+        if self._kv_layout == "paged":
+            preempt_before = self._swap_counts["preemptions"]
+            swapin_before = self._swap_counts["in_requests"]
+        else:
+            preempt_before = swapin_before = 0
         admitted, prefix_hits = self._admit()
         # Occupancy/queue as the first device call sees them: after the
         # tick's opening admissions, before its finishes.
@@ -1557,6 +1890,15 @@ class ServeEngine:
                             run, engine=self.name
                         )
                 self._kv_frag_ticks += 1
+            if self._kv_layout == "paged":
+                preempted = (
+                    self._swap_counts["preemptions"] - preempt_before
+                )
+                swapped_in = (
+                    self._swap_counts["in_requests"] - swapin_before
+                )
+            else:
+                preempted = swapped_in = 0
             servestats.RECORDER.record(
                 servestats.StepRecord(
                     engine=self.name,
@@ -1569,6 +1911,8 @@ class ServeEngine:
                     tokens=self._tokens_emitted - toks_before,
                     step_wall_s=step_wall,
                     phase_s=phases,
+                    preempted=preempted,
+                    swapped_in=swapped_in,
                     slo_met=self._slo_met,
                     slo_missed=self._slo_missed,
                 )
@@ -1607,7 +1951,7 @@ class ServeEngine:
         SERVE_QUEUE_DEPTH.remove_function(engine=self.name)
         SERVE_BATCH_OCCUPANCY.remove_function(engine=self.name)
         if self._kv_layout == "paged":
-            for state in ("free", "allocated", "aliased"):
+            for state in ("free", "allocated", "aliased", "host"):
                 SERVE_KV_BLOCKS.remove(engine=self.name, state=state)
             from tpu_dra.obs import kv as obskv
 
@@ -1867,6 +2211,14 @@ class ServeEngine:
         stats["alias_blocks_total"] = self._kv_counts["alias_blocks"]
         stats["cow_blocks_total"] = self._kv_counts["cow_blocks"]
         stats["alloc_blocks_total"] = self._kv_counts["alloc_blocks"]
+        # The host swap tier (docs/SERVING.md "KV memory hierarchy"):
+        # blocks currently parked on host, the tier's capacity, and the
+        # cumulative swap traffic + preemption count.
+        stats["blocks_host"] = self._host_pool.used_count
+        stats["host_capacity"] = self._host_pool.capacity
+        stats["swap_out_blocks_total"] = self._swap_counts["out_blocks"]
+        stats["swap_in_blocks_total"] = self._swap_counts["in_blocks"]
+        stats["preemptions_total"] = self._swap_counts["preemptions"]
         return stats
 
     def kv_snapshot(self) -> "dict | None":

@@ -429,7 +429,18 @@ SERVE_KV_BLOCKS = REGISTRY.gauge(
     "Paged KV pool blocks per engine by state: free (allocatable), "
     "allocated (owned by a live block table or a resident prefix "
     "entry; scratch block excluded), aliased (more than one owner — "
-    "the shared, immutable fraction); sampled at scrape",
+    "the shared, immutable fraction), host (swapped out to the "
+    "host-tier pool, held by a preempted mid-decode request); sampled "
+    "at scrape",
+)
+SERVE_KV_SWAPS = REGISTRY.counter(
+    "tpu_dra_serve_kv_swaps_total",
+    "Paged KV blocks moved between HBM and the host swap tier per "
+    "engine: direction='out' is a preemption parking a mid-decode "
+    "request's blocks to host (a block-table rewrite + bounded DMA, "
+    "never a recompute), direction='in' the token-identical restore — "
+    "a sustained 'in' rate on a full pool is swap thrash (the "
+    "KVSwapThrash alert)",
 )
 SERVE_KV_ALIAS = REGISTRY.counter(
     "tpu_dra_serve_kv_alias_total",

@@ -55,6 +55,12 @@ class StepRecord:
     admitted: int = 0  # requests admitted this tick
     prefix_hits: int = 0  # of those, admissions that reused a resident prefix
     finished: int = 0  # requests completed this tick
+    # KV memory hierarchy (paged engines with a host swap tier): rows
+    # preempted to host this tick, and swapped-out requests restored
+    # into a row this tick (restores are re-admissions but join no
+    # first-token wave, so they are counted apart from `admitted`).
+    preempted: int = 0
+    swapped_in: int = 0
     tokens: int = 0  # tokens emitted this tick (all rows)
     step_wall_s: float = 0.0  # host wall time of the whole tick
     # Phase decomposition of step_wall_s (PHASES above, seconds each,
@@ -80,6 +86,8 @@ class StepRecord:
             "admitted": self.admitted,
             "prefix_hits": self.prefix_hits,
             "finished": self.finished,
+            "preempted": self.preempted,
+            "swapped_in": self.swapped_in,
             "tokens": self.tokens,
             "step_wall_s": self.step_wall_s,
             "phase_s": {k: round(v, 9) for k, v in self.phase_s.items()},
@@ -191,6 +199,8 @@ def summarize(records: "list[StepRecord]") -> dict:
         "admitted": sum(r.admitted for r in records),
         "prefix_hits": sum(r.prefix_hits for r in records),
         "finished": sum(r.finished for r in records),
+        "preempted": sum(r.preempted for r in records),
+        "swapped_in": sum(r.swapped_in for r in records),
         "tokens": tokens,
         "tokens_per_s": round(tokens / wall, 1) if wall > 0 else 0.0,
         "occupancy_mean": round(
@@ -243,6 +253,13 @@ def render_text(records: "list[StepRecord]") -> str:
     head = (
         f"{s['ticks']} tick(s), {s['admitted']} admitted "
         f"({s['prefix_hits']} prefix hit(s)), {s['finished']} finished, "
+    )
+    if s.get("preempted") or s.get("swapped_in"):
+        head += (
+            f"{s['preempted']} preempted / {s['swapped_in']} swapped "
+            "back in, "
+        )
+    head += (
         f"{s['tokens']} token(s) @ {s['tokens_per_s']}/s, "
         f"occupancy mean {s['occupancy_mean']}, "
         f"queue max {s['queue_depth_max']}, "
