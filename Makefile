@@ -10,16 +10,17 @@ TAG     ?= latest
         native-test demo-quickstart bench image clean help \
         observability-smoke perf-smoke explain-smoke serve-smoke \
         serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke paged-smoke \
-        kernel-smoke kv-smoke swap-smoke
+        kernel-smoke kv-smoke swap-smoke requests-smoke
 
 # `analyze` runs the full rule registry — the L-style rules lint would
 # run plus the whole-repo invariants — so `all` needs only one pass.
 # `kernel-smoke` fails fast (seconds) on a Pallas-kernel/gather drift,
-# `kv-smoke` on a /debug/kv or KVPoolPressure regression, and
-# `swap-smoke` on a KV-memory-hierarchy regression (preempt/swap
-# identity, host-tier metrics, KVSwapThrash), before `test` pays for
-# the full suite.
-all: analyze kernel-smoke kv-smoke swap-smoke test
+# `kv-smoke` on a /debug/kv or KVPoolPressure regression, `swap-smoke`
+# on a KV-memory-hierarchy regression (preempt/swap identity, host-tier
+# metrics, KVSwapThrash), and `requests-smoke` on a request-attribution
+# regression (fleet-rooted traces, waterfall closure, per-class SLO
+# burn), before `test` pays for the full suite.
+all: analyze kernel-smoke kv-smoke swap-smoke requests-smoke test
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -122,6 +123,18 @@ kv-smoke:
 swap-smoke:
 	$(PYTHON) -m pytest tests/test_swap_smoke.py -q -m 'not slow'
 
+# Request latency attribution floor (docs/OBSERVABILITY.md "Request
+# latency attribution"): a fleet-routed request (affinity, spill, and
+# preempted cases) renders as ONE trace rooted at fleet.route (the
+# spill as a span event, never a fresh trace), every finished request's
+# waterfall closes (phases tile submit->finish incl host-parked time),
+# /debug/requests serves json/text/filters/400s, `tpudra requests` /
+# `tpudra waterfall` render, and a per-class SLOClassBurn completes
+# pending -> firing -> resolved over the collector while the
+# preemption-protected high class stays within SLO.
+requests-smoke:
+	$(PYTHON) -m pytest tests/test_requests_smoke.py -q -m 'not slow'
+
 # Serving telemetry floor: drives a small engine stream, scrapes /metrics
 # and /debug/engine over HTTP, asserts the TPOT/queue-wait/SLO series and
 # per-engine gauges appear, the step flight recorder serves the ring, a
@@ -173,4 +186,5 @@ help:
 	@echo "         native-test demo-quickstart bench observability-smoke"
 	@echo "         perf-smoke explain-smoke serve-smoke serve-obs-smoke"
 	@echo "         chaos-smoke fleet-smoke obs-top-smoke paged-smoke"
-	@echo "         kernel-smoke kv-smoke swap-smoke image clean"
+	@echo "         kernel-smoke kv-smoke swap-smoke requests-smoke"
+	@echo "         image clean"

@@ -26,7 +26,8 @@ from tpu_dra.fleet.router import PrefixRouter, ReplicaView
 from tpu_dra.fleet import stats as fleetstats
 from tpu_dra.parallel.burnin import BurninConfig, init_params
 from tpu_dra.parallel.serve import ServeEngine
-from tpu_dra.utils.metrics import FLEET_ROUTED
+from tpu_dra.utils import trace
+from tpu_dra.utils.metrics import FLEET_ROUTE_TOTAL, FLEET_ROUTED
 
 CFG = BurninConfig(
     vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=64, batch=2
@@ -359,6 +360,31 @@ class TestFleetQueue:
         assert placed_order == sorted(placed_order)
         fleet.close()
 
+    def test_fleet_queue_places_by_priority_within_class_fifo(self):
+        """The fleet queue honors the same classes the engines enforce:
+        a high-priority arrival parked fleet-side places BEFORE the
+        low-priority flood that arrived first (a priority-blind front
+        door would defeat engine preemption), while default-priority
+        traffic stays strict FIFO."""
+        fleet = ServeFleet(
+            [engine("pq-0", slots=1)],
+            max_queue_per_replica=1, name="fleet-pq",
+        )
+        lows = [fleet.submit(SYS_A + tail(i), 2) for i in range(4)]
+        high = fleet.submit(SYS_B + tail(9), 2, priority=7)
+        assert fleet.fleet_stats()["fleet_queue_depth"] >= 3
+        fleet.run()
+        placed = [
+            r.request for r in fleetstats.RECORDER.query(fleet="fleet-pq")
+        ]
+        lows_placed = [f for f in placed if f in lows]
+        # The high jumped every fleet-queued low that had not yet been
+        # handed to the engine; the lows kept their arrival order.
+        assert placed.index(high) < placed.index(lows_placed[-1])
+        assert lows_placed == sorted(lows_placed)
+        assert fleet.result(high).done
+        fleet.close()
+
     def test_max_queue_zero_rejected(self):
         e = engine("cap-zero")
         try:
@@ -419,6 +445,74 @@ class TestDigestStaleness:
             r.reason for r in fleetstats.RECORDER.query(fleet="fleet-ev")
         ]
         assert "spill" not in reasons
+        fleet.close()
+
+
+class TestTraceRouting:
+    """ISSUE 14: the fleet opens the trace ROOT per routed request
+    (fleet.route) and hands its context into the engine, so a routed
+    request's whole journey — routing, queue, admission, decode — is
+    ONE trace; a spill re-routes under the SAME trace id with the
+    re-route recorded as a span event, never a fresh trace."""
+
+    def test_routed_request_is_one_trace_rooted_at_fleet_route(self):
+        fleet = ServeFleet([engine("tr-0")], name="fleet-tr")
+        fleet.submit(SYS_A + tail(0), 2)
+        fleet.run()
+        fid = fleet.submit(SYS_A + tail(1), 2, priority=3)
+        fleet.run()
+        req = fleet.result(fid)
+        assert req.priority == 3  # fleet priority reached the engine
+        spans = trace.EXPORTER.spans(trace_id=req.trace_id)
+        by_name = {s["name"]: s for s in spans}
+        assert {"fleet.route", "serve.request", "serve.queue",
+                "serve.admit", "serve.decode"} <= by_name.keys()
+        roots = [s for s in spans if not s["parent_id"]]
+        assert [r["name"] for r in roots] == ["fleet.route"]
+        root = by_name["fleet.route"]
+        assert root["attributes"]["outcome"] == "affinity"
+        assert root["attributes"]["replica"] == "tr-0"
+        assert root["attributes"]["matched"] > 0
+        assert by_name["serve.request"]["parent_id"] == root["span_id"]
+        # The placement record joins /debug/fleet to the trace.
+        rec = fleetstats.RECORDER.query(fleet="fleet-tr")[-1]
+        assert rec.trace_id == req.trace_id
+        fleet.close()
+
+    def test_spill_reroutes_under_same_trace_as_span_event(self):
+        """The digest promised st-0, the live verify found it stale, the
+        request landed elsewhere: one trace id spans the promised AND
+        the landing replica, with the re-route as a `spill` event on
+        the fleet.route root — not a fresh trace."""
+        fleet = ServeFleet(
+            [engine("sp-0"), engine("sp-1")],
+            digest_refresh="manual", name="fleet-spill-trace",
+        )
+        fleet._digests["sp-0"] = build_digest(
+            index_of((SYS_A, 5)), replica="sp-0", epoch=99
+        )
+        fleet._digests["sp-1"] = empty_digest("sp-1")
+        spills_before = FLEET_ROUTE_TOTAL.value(outcome="spill")
+        fid = fleet.submit(SYS_A + tail(0), 2)
+        fleet.run()
+        req = fleet.result(fid)
+        spans = trace.EXPORTER.spans(trace_id=req.trace_id)
+        roots = [s for s in spans if not s["parent_id"]]
+        assert [r["name"] for r in roots] == ["fleet.route"]
+        root = roots[0]
+        assert root["attributes"]["outcome"] == "spill"
+        (event,) = root["events"]
+        assert event["name"] == "spill"
+        assert event["attributes"]["from_replica"] == "sp-0"
+        assert event["attributes"]["to_replica"] == req.replica
+        # The landing replica's serve spans are in the SAME trace.
+        serve_req = next(
+            s for s in spans if s["name"] == "serve.request"
+        )
+        assert serve_req["parent_id"] == root["span_id"]
+        assert FLEET_ROUTE_TOTAL.value(
+            outcome="spill"
+        ) == spills_before + 1
         fleet.close()
 
 

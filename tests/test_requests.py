@@ -1,0 +1,457 @@
+"""Request latency attribution (ISSUE 14, tpu_dra/obs/requests.py):
+the waterfall reduction tiles submit->finish (closure >= 0.95, host
+-resident preemption time included), the flight recorder filters, the
+per-class summaries aggregate TTFT/TPOT/goodput, the renderings draw,
+and the per-class ``SLOClassBurn`` rule runs the pending -> firing ->
+resolved state machine off ``/debug/requests``-shaped aggregates."""
+
+import pytest
+
+from tpu_dra.obs import requests as obsreq
+from tpu_dra.obs.alerts import (
+    FIRING,
+    OK,
+    PENDING,
+    RESOLVED,
+    AlertEngine,
+    AlertFlightRecorder,
+    ClassSLO,
+    slo_class_burn,
+)
+from tpu_dra.parallel.serve import Request
+from tpu_dra.utils.metrics import REGISTRY
+
+from helpers import metric_total
+
+
+def finished_request(
+    rid=0, *, priority=0, enqueued=100.0, admitted=100.5,
+    first_token=100.7, finished=101.7, swapped_s=0.0, swap_dma_s=0.0,
+    preemptions=0, tokens=(1, 2, 3), slo=None, engine="unit-eng",
+    trace_id="t" * 32,
+):
+    """A hand-built finished Request with a complete monotone timeline —
+    the reduction is duck-typed host-side data, no engine needed."""
+    req = Request(
+        id=rid, prompt=[1, 2, 3, 4], max_new=8, priority=priority,
+        tokens=list(tokens), done=True, finish_reason="budget",
+        replica=engine, trace_id=trace_id,
+    )
+    req.enqueued_at = req.submitted_at = enqueued
+    req.admitted_at = admitted
+    req.first_token_at = first_token
+    req.finished_at = finished
+    req.queue_wait_s = admitted - enqueued
+    req.ttft_s = first_token - enqueued
+    req.tpot_s = 0.01 if len(tokens) > 1 else 0.0
+    req.swapped_s = swapped_s
+    req.swap_dma_s = swap_dma_s
+    req.preemptions = preemptions
+    req.slo = dict(slo or {})
+    return req
+
+
+class TestReduction:
+    def test_phases_tile_submit_to_finish(self):
+        rec = obsreq.reduce_request(finished_request())
+        assert set(rec.phase_s) == set(obsreq.PHASES)
+        assert rec.phase_s["queue"] == pytest.approx(0.5)
+        assert rec.phase_s["admit"] == pytest.approx(0.2)
+        assert rec.phase_s["decode"] == pytest.approx(1.0)
+        assert rec.phase_s["preempted-host"] == 0.0
+        assert rec.phase_s["swap-dma"] == 0.0
+        assert rec.total_s == pytest.approx(1.7)
+        assert rec.closure == pytest.approx(1.0)
+
+    def test_preempted_request_attributes_hosted_and_dma_time(self):
+        """The swapped window (swap-out start -> swap-in completion)
+        splits into genuinely-parked time and measured DMA; decode
+        excludes both — the five phases still tile the total."""
+        rec = obsreq.reduce_request(
+            finished_request(
+                finished=102.7, swapped_s=0.6, swap_dma_s=0.1,
+                preemptions=1,
+            )
+        )
+        assert rec.phase_s["preempted-host"] == pytest.approx(0.5)
+        assert rec.phase_s["swap-dma"] == pytest.approx(0.1)
+        assert rec.phase_s["decode"] == pytest.approx(2.0 - 0.6)
+        assert sum(rec.phase_s.values()) == pytest.approx(rec.total_s)
+        assert rec.closure >= 0.95
+        assert rec.preemptions == 1
+
+    def test_dma_clamped_into_swapped_window(self):
+        # A clock oddity reporting more DMA than window costs closure,
+        # never a negative parked bar.
+        rec = obsreq.reduce_request(
+            finished_request(swapped_s=0.1, swap_dma_s=0.5)
+        )
+        assert rec.phase_s["preempted-host"] == 0.0
+        assert rec.phase_s["swap-dma"] == pytest.approx(0.1)
+
+    def test_unfinished_request_reduces_to_none(self):
+        req = finished_request()
+        req.done = False
+        assert obsreq.reduce_request(req) is None
+
+    def test_identity_and_outcome_fields(self):
+        rec = obsreq.reduce_request(
+            finished_request(
+                rid=7, priority=3, slo={"request": "met"},
+            )
+        )
+        assert (rec.request, rec.cls, rec.engine) == (7, 3, "unit-eng")
+        assert rec.slo == "met" and rec.trace_id == "t" * 32
+        d = rec.to_dict()
+        assert d["class"] == 3 and set(d["phase_s"]) == set(obsreq.PHASES)
+
+
+class TestRecorderAndDoc:
+    def test_observe_finished_records_and_moves_phase_metric(self):
+        before = metric_total(
+            REGISTRY.expose(),
+            "tpu_dra_serve_request_phase_seconds_count",
+            engine="metric-eng",
+        )
+        req = finished_request(priority=2, engine="metric-eng")
+        rec = obsreq.observe_finished(req)
+        assert rec.seq > 0
+        text = REGISTRY.expose()
+        # One observation per NONZERO phase (queue/admit/decode here),
+        # labeled by the priority class.
+        for phase in ("queue", "admit", "decode"):
+            assert metric_total(
+                text, "tpu_dra_serve_request_phase_seconds_count",
+                engine="metric-eng", phase=phase, **{"class": "2"},
+            ) >= 1, phase
+        assert metric_total(
+            text, "tpu_dra_serve_request_phase_seconds_count",
+            engine="metric-eng",
+        ) == before + 3
+
+    def test_query_filters_and_doc_shape(self):
+        for rid, (prio, tid) in enumerate(
+            [(0, "a" * 32), (5, "b" * 32), (5, "c" * 32)]
+        ):
+            obsreq.observe_finished(
+                finished_request(
+                    rid=rid, priority=prio, engine="filter-eng",
+                    trace_id=tid,
+                )
+            )
+        assert len(
+            obsreq.RECORDER.query(engine="filter-eng", cls=5)
+        ) == 2
+        assert [
+            r.request
+            for r in obsreq.RECORDER.query(
+                engine="filter-eng", trace_id="b" * 32
+            )
+        ] == [1]
+        doc = obsreq.requests_doc(engine="filter-eng", cls=5, limit=1)
+        assert len(doc["requests"]) == 1  # limit keeps the newest
+        assert doc["summary"]["classes"].keys() == {"5"}
+        assert doc["recorded"] == obsreq.RECORDER.recorded
+
+    def test_summarize_per_class_percentiles_and_goodput(self):
+        recs = [
+            obsreq.reduce_request(
+                finished_request(
+                    rid=i, priority=1, finished=101.0 + i,
+                    slo={"request": "met" if i < 3 else "missed"},
+                )
+            )
+            for i in range(4)
+        ]
+        s = obsreq.summarize(recs)
+        c = s["classes"]["1"]
+        assert c["requests"] == 4
+        assert c["goodput"] == pytest.approx(0.75)
+        assert c["ttft_p50_s"] == pytest.approx(0.7)
+        assert c["closure_min"] >= 0.95
+        # No SLO configured -> goodput is None, never 0 (absent != zero).
+        bare = obsreq.summarize(
+            [obsreq.reduce_request(finished_request())]
+        )
+        assert bare["classes"]["0"]["goodput"] is None
+        # One-token requests contribute no TPOT sample.
+        single = obsreq.summarize(
+            [obsreq.reduce_request(finished_request(tokens=(9,)))]
+        )
+        assert single["classes"]["0"]["tpot_p95_s"] is None
+
+    def test_in_flight_providers_merge_and_retire(self):
+        obsreq.register(
+            "prov-a",
+            lambda: {
+                "engine": "prov-a",
+                "classes": {"0": {"queued": 2, "decoding": 1, "swapped": 0}},
+            },
+        )
+        obsreq.register(
+            "prov-b",
+            lambda: {
+                "engine": "prov-b",
+                "classes": {"0": {"queued": 0, "decoding": 1, "swapped": 1}},
+            },
+        )
+        try:
+            live = obsreq.in_flight()
+            assert live["0"] == {
+                "queued": 2, "decoding": 2, "swapped": 1, "in_flight": 5,
+            }
+            assert obsreq.in_flight(engine="prov-b")["0"]["in_flight"] == 2
+        finally:
+            obsreq.unregister("prov-a")
+            obsreq.unregister("prov-b")
+        # A dead provider (returns None) retires itself at the next read.
+        obsreq.register("prov-dead", lambda: None)
+        assert obsreq.in_flight() == {} or "prov-dead" not in obsreq.providers()
+        assert "prov-dead" not in obsreq.providers()
+
+    def test_renderings(self):
+        obsreq.observe_finished(
+            finished_request(
+                rid=11, priority=2, engine="render-eng",
+                swapped_s=0.3, swap_dma_s=0.05, preemptions=1,
+                trace_id="d" * 32,
+            )
+        )
+        doc = obsreq.requests_doc(engine="render-eng")
+        text = obsreq.render_text(doc)
+        assert "class" in text and "render-eng" in text
+        wf = obsreq.render_waterfall(
+            obsreq.requests_doc(trace_id="d" * 32)
+        )
+        for phase in obsreq.PHASES:
+            assert phase in wf, phase
+        assert "1 preemption(s)" in wf
+        # A clean request's waterfall hides the swap phases.
+        obsreq.observe_finished(
+            finished_request(rid=12, engine="render-eng", trace_id="e" * 32)
+        )
+        wf_clean = obsreq.render_waterfall(
+            obsreq.requests_doc(trace_id="e" * 32)
+        )
+        assert "preempted-host" not in wf_clean
+        # Unknown trace: an explanation, not a stack trace.
+        assert "no finished request matches" in obsreq.render_waterfall(
+            obsreq.requests_doc(trace_id="f" * 32)
+        )
+
+
+class TestClosureUnderChurn:
+    """Property-style pin of the acceptance bar (ISSUE 14): on a churny
+    paged engine with preemption enabled, EVERY finished request's
+    waterfall closes — the phases tile submit->finish including the
+    host-resident time — with closure >= 0.95.  The engine is sized at
+    the admission floor so high-priority arrivals preempt mid-decode
+    lows (the swap-smoke shape), and the property is asserted over the
+    whole mixed stream, not a single curated request."""
+
+    def test_every_finished_request_closes(self):
+        from tpu_dra.parallel.burnin import BurninConfig, init_params
+        from tpu_dra.parallel.serve import ServeEngine
+
+        cfg = BurninConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+            seq=32, batch=4,
+        )
+        eng = ServeEngine(
+            init_params(cfg), cfg, slots=2, prompt_slots=8, max_new_cap=5,
+            prefix_window=2, kv_blocks=8, name="churn-eng",
+        )
+        try:
+            rids = []
+            # Interleave submits with ticks so lows are mid-decode when
+            # highs arrive: every high admission must preempt or park.
+            # (4 rounds = 8 mixed requests: enough churn for repeated
+            # preemption without spending tier-1 budget on more ticks.)
+            for i in range(4):
+                rids.append(
+                    eng.submit([5, 9, 2, 7, 11, (i % 5) + 1], 5, priority=0)
+                )
+                eng.tick()
+                rids.append(
+                    eng.submit([1, 2, (i % 5) + 1], 4, priority=5)
+                )
+                eng.tick()
+            eng.run()
+            reqs = [eng.request(r) for r in rids]
+            assert all(r.done for r in reqs)
+            preempted = [r for r in reqs if r.preemptions]
+            assert preempted, "the floor-sized pool must have preempted"
+            for req in reqs:
+                rec = obsreq.reduce_request(req)
+                assert rec.closure >= 0.95, (req.id, rec.phase_s)
+                assert all(v >= 0.0 for v in rec.phase_s.values())
+                assert sum(rec.phase_s.values()) <= rec.total_s * 1.001
+                if req.preemptions:
+                    # Host-resident time is attributed, not lost: the
+                    # parked window lands in the swap phases.
+                    hosted = (
+                        rec.phase_s["preempted-host"]
+                        + rec.phase_s["swap-dma"]
+                    )
+                    assert hosted == pytest.approx(
+                        req.swapped_s, rel=1e-6
+                    )
+                    assert hosted > 0.0
+                    assert rec.phase_s["swap-dma"] > 0.0
+            # The ring saw every finish, classes split by priority.
+            doc = obsreq.requests_doc(engine="churn-eng", limit=64)
+            assert doc["summary"]["requests"] == len(reqs)
+            assert set(doc["summary"]["classes"]) == {"0", "5"}
+            assert doc["summary"]["classes"]["0"]["preemptions"] >= 1
+            assert doc["summary"]["closure_min"] >= 0.95
+        finally:
+            eng.close()
+
+
+class _FakeRequestsView:
+    """The collector surface SLOClassBurn consumes: fetch_requests
+    returning /debug/requests-shaped documents.  Records the queries it
+    was asked, and honors the server-side class filter the way
+    /debug/requests does."""
+
+    def __init__(self):
+        self.classes = {}
+        self.queries = []
+
+    def set_class(self, cls, **agg):
+        self.classes[str(cls)] = agg
+
+    def fetch_requests(self, engine=None, cls=None, limit=256):
+        self.queries.append({"engine": engine, "cls": cls, "limit": limit})
+        classes = {
+            c: agg
+            for c, agg in self.classes.items()
+            if cls is None or c == str(cls)
+        }
+        return [
+            {
+                "endpoint": "fake",
+                "summary": {"classes": classes},
+                "in_flight": {},
+            }
+        ]
+
+
+class TestSLOClassBurn:
+    def test_rule_lifecycle_pending_firing_resolved(self):
+        view = _FakeRequestsView()
+        recorder = AlertFlightRecorder()
+        engine = AlertEngine(
+            [
+                slo_class_burn(
+                    ClassSLO(cls=0, ttft_p95_s=0.1), for_s=2.0
+                )
+            ],
+            recorder=recorder,
+        )
+        # Quiet: no traffic for the class yet.
+        engine.evaluate(view, now_mono=0.0)
+        assert engine.status()[0]["state"] == OK
+        # Violation: observed p95 over the objective -> pending, then
+        # firing once for_s elapses, then resolved when it clears.
+        view.set_class(0, requests=8, ttft_p95_s=0.5, tpot_p95_s=None)
+        events = engine.evaluate(view, now_mono=10.0)
+        assert [e.state for e in events] == [PENDING]
+        events = engine.evaluate(view, now_mono=13.0)
+        assert [e.state for e in events] == [FIRING]
+        assert engine.status()[0]["value"] == pytest.approx(5.0)
+        view.set_class(0, requests=8, ttft_p95_s=0.05, tpot_p95_s=None)
+        events = engine.evaluate(view, now_mono=20.0)
+        assert [e.state for e in events] == [RESOLVED]
+        assert [e.state for e in recorder.query()] == [
+            PENDING, FIRING, RESOLVED,
+        ]
+
+    def test_per_class_rules_are_independent(self):
+        view = _FakeRequestsView()
+        view.set_class(0, requests=8, ttft_p95_s=0.5)
+        view.set_class(5, requests=8, ttft_p95_s=0.01)
+        engine = AlertEngine(
+            [
+                slo_class_burn(ClassSLO(cls=0, ttft_p95_s=0.1)),
+                slo_class_burn(ClassSLO(cls=5, ttft_p95_s=0.1)),
+            ],
+            recorder=AlertFlightRecorder(),
+        )
+        engine.evaluate(view, now_mono=0.0)
+        states = {s["rule"]: s["state"] for s in engine.status()}
+        # for_s=0: the violated class fires in one round, the healthy
+        # class stays quiet — isolation is per-rule by construction.
+        assert states["SLOClassBurn-class0"] == FIRING
+        assert states["SLOClassBurn-class5"] == OK
+
+    def test_quiet_class_never_fires_and_tpot_objective_checks(self):
+        view = _FakeRequestsView()
+        view.set_class(1, requests=2, ttft_p95_s=9.9, tpot_p95_s=9.9)
+        rule = slo_class_burn(
+            ClassSLO(cls=1, tpot_p95_s=0.1), min_requests=4
+        )
+        fired, value, detail = rule.expr(view)
+        assert not fired and "quiet" in detail
+        rule = slo_class_burn(ClassSLO(cls=1, tpot_p95_s=0.1))
+        fired, value, detail = rule.expr(view)
+        assert fired and value == pytest.approx(99.0)
+        assert "tpot p95" in detail
+
+    def test_rule_windows_per_class_not_cross_class(self):
+        """The rule must pass the class filter server-side: its window
+        is the CLASS's most recent N records, so a flood in another
+        class can never displace the watched class out of the window
+        and silently resolve (or never fire) its page."""
+        view = _FakeRequestsView()
+        view.set_class(2, requests=8, ttft_p95_s=0.5)
+        rule = slo_class_burn(
+            ClassSLO(cls=2, ttft_p95_s=0.1), window_requests=16
+        )
+        fired, _, _ = rule.expr(view)
+        assert fired
+        assert view.queries == [{"engine": None, "cls": 2, "limit": 16}]
+
+    def test_class_slo_validation(self):
+        with pytest.raises(ValueError, match="no objective"):
+            ClassSLO(cls=0)
+        with pytest.raises(ValueError, match="ttft_p95_s"):
+            ClassSLO(cls=0, ttft_p95_s=0.0)
+
+
+class TestCollectorRequestFetch:
+    def test_class_filter_passed_and_memoized_per_round(self):
+        """One evaluation cycle's per-class rules + the cluster doc
+        share fetches: fetch_requests memoizes per (query, round), and
+        a new scrape round invalidates."""
+        import json as jsonlib
+
+        from tpu_dra.obs.collector import Endpoint, ObsCollector
+
+        collector = ObsCollector([Endpoint("http://127.0.0.1:9", name="e")])
+        try:
+            state = collector._states["e"]
+            state.index = {"endpoints": {"/debug/requests": {}}}
+            calls = []
+
+            def fake_get(url):
+                calls.append(url)
+                return jsonlib.dumps(
+                    {"requests": [], "summary": {"requests": 0},
+                     "in_flight": {}}
+                )
+
+            collector._get = fake_get
+            docs = collector.fetch_requests(cls=2, limit=8)
+            assert docs[0]["endpoint"] == "e"
+            assert "class=2" in calls[0] and "limit=8" in calls[0]
+            collector.fetch_requests(cls=2, limit=8)
+            assert len(calls) == 1  # same query, same round: memoized
+            collector.fetch_requests(cls=3, limit=8)
+            assert len(calls) == 2  # different query: fetched
+            with collector._lock:
+                collector._rounds += 1  # a new round invalidates
+            collector.fetch_requests(cls=2, limit=8)
+            assert len(calls) == 3
+        finally:
+            collector.close()

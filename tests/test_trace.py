@@ -142,6 +142,56 @@ def test_exporter_ring_buffer_caps():
     assert exporter.spans(limit=2)[0]["name"] == "s10"
 
 
+def test_emit_span_context_with_parent_nests_without_new_identity():
+    """context= fixes the span's identity and parent= sets its parent
+    pointer INDEPENDENTLY — the fleet shape: the engine's serve.request
+    span reuses the context minted at engine submit while nesting under
+    the router's fleet.route root."""
+    exporter = trace.SpanExporter()
+    root = trace.TraceContext.new()
+    child = root.child()
+    trace.emit_span(
+        "serve.request", context=child, parent=root,
+        start_unix_s=1.0, duration_s=0.5, exporter=exporter,
+    )
+    (rec,) = exporter.spans()
+    assert rec["trace_id"] == root.trace_id
+    assert rec["span_id"] == child.span_id  # identity preserved
+    assert rec["parent_id"] == root.span_id  # nested, not a root
+
+
+def test_emit_span_events_ride_the_record():
+    """A routing decision's re-route is an EVENT on the span, not a
+    fresh trace: the record carries it and render_tree prints it."""
+    exporter = trace.SpanExporter()
+    ctx = trace.emit_span(
+        "fleet.route", start_unix_s=1.0, duration_s=0.2,
+        exporter=exporter,
+        events=[{"name": "spill", "offset_s": 0.1,
+                 "attributes": {"from_replica": "r0", "to_replica": "r1"}}],
+    )
+    (rec,) = exporter.spans(trace_id=ctx.trace_id)
+    assert rec["events"] == [
+        {"name": "spill", "offset_s": 0.1,
+         "attributes": {"from_replica": "r0", "to_replica": "r1"}}
+    ]
+    assert "spill" in trace.render_tree([rec])
+
+
+def test_exporter_overflow_moves_spans_dropped_counter():
+    from tpu_dra.utils.metrics import TRACE_SPANS_DROPPED
+
+    before = TRACE_SPANS_DROPPED.total()
+    exporter = trace.SpanExporter(capacity=2)
+    for i in range(5):
+        trace.emit_span(
+            f"s{i}", start_unix_s=float(i), duration_s=0.0,
+            exporter=exporter,
+        )
+    assert exporter.dropped == 3
+    assert TRACE_SPANS_DROPPED.total() == before + 3
+
+
 def test_exporter_trace_id_filter():
     exporter = trace.SpanExporter()
     with trace.span("a", exporter=exporter) as a:

@@ -137,6 +137,9 @@ class Config:
         "tpu_dra/obs/alerts.py",
         "tpu_dra/obs/cluster.py",
         "tpu_dra/obs/kv.py",
+        # Request waterfalls are derived from the engines' monotonic
+        # timelines: a wall-clock read here would skew every phase bar.
+        "tpu_dra/obs/requests.py",
         # Block birth/age records feed the /debug/kv age histograms: a
         # wall-clock read here would let an NTP step fake block ages.
         "tpu_dra/parallel/paged.py",

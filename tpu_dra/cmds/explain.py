@@ -30,6 +30,13 @@ pool occupancy, per-block age/heat, the alias-sharing distribution, and
 free-list fragmentation, the inputs block-level eviction and defrag
 decisions are made from.
 
+`tpudra requests` and `tpudra waterfall <trace-id>` are the request
+-attribution pair — "WHERE did this user's latency go?" — rendering
+``/debug/requests`` (tpu_dra/obs/requests.py): per-priority-class
+TTFT/TPOT/goodput aggregates with live in-flight counts, and one
+request's submit→finish decomposed into the canonical phases
+(queue / admit / decode / preempted-host / swap-dma) as a waterfall.
+
 `tpudra fleet-stats` is the fleet-router layer above it — "why did my
 request land on THAT replica?" — rendering the placement flight
 recorder from ``/debug/fleet`` (tpu_dra/fleet/stats.py): per-replica
@@ -197,6 +204,53 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
     kv.add_argument(
         "--limit", type=int, default=256,
         help="max per-block records to fetch per engine",
+    )
+
+    reqs = sub.add_parser(
+        "requests",
+        help="per-request latency attribution from /debug/requests "
+        "(per-class TTFT/TPOT/goodput aggregates + waterfall rows)",
+    )
+    _add_endpoint_args(reqs, env="TPUDRA_ENGINE", what="serve process")
+    reqs.add_argument(
+        "--engine",
+        default="",
+        help="only this engine's requests (the ServeEngine name)",
+    )
+    reqs.add_argument(
+        "--class",
+        dest="cls",
+        default="",
+        help="only this priority class (the submit(priority=) value)",
+    )
+    reqs.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output form (text: class table + per-request rows; "
+        "json: the raw document)",
+    )
+    reqs.add_argument(
+        "--limit", type=int, default=256,
+        help="max request records to fetch",
+    )
+
+    waterfall = sub.add_parser(
+        "waterfall",
+        help="one request's phase waterfall (queue/admit/decode/"
+        "preempted-host/swap-dma) by trace id",
+    )
+    waterfall.add_argument(
+        "trace_id",
+        help="the request's trace id (Request.trace_id, a /debug/fleet "
+        "placement row, or /debug/traces)",
+    )
+    _add_endpoint_args(waterfall, env="TPUDRA_ENGINE", what="serve process")
+    waterfall.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output form (text: the waterfall; json: the raw document)",
+    )
+    waterfall.add_argument(
+        "--limit", type=int, default=16,
+        help="max matching request records to fetch",
     )
 
     fleet = sub.add_parser(
@@ -435,6 +489,65 @@ def kv_cmd(args: argparse.Namespace, out=None) -> int:
     return 0
 
 
+def _fetch_requests(args: argparse.Namespace, trace_id: str = "") -> dict:
+    return fetch_debug(
+        args.endpoint, args.pprof_path, "requests",
+        {
+            "limit": args.limit,
+            "engine": getattr(args, "engine", ""),
+            "class": getattr(args, "cls", ""),
+            "trace_id": trace_id,
+        },
+    )
+
+
+def requests_cmd(args: argparse.Namespace, out=None) -> int:
+    from tpu_dra.obs import requests as obsreq
+
+    # Call-time stream resolution, like serve_stats.
+    out = sys.stdout if out is None else out
+    try:
+        doc = _fetch_requests(args)
+    except (urllib.error.URLError, OSError) as e:
+        print(
+            f"error: cannot reach serve endpoint at {args.endpoint}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    if args.format == "json":
+        print(json.dumps(doc, indent=2), file=out)
+    else:
+        # render_text consumes the fetched document, so the CLI output
+        # is byte-identical to /debug/requests?format=text on the server.
+        print(obsreq.render_text(doc), end="", file=out)
+        if doc.get("dropped"):
+            print(
+                f"(request recorder wrapped: {doc['dropped']} older "
+                "record(s) dropped)",
+                file=out,
+            )
+    return 0
+
+
+def waterfall_cmd(args: argparse.Namespace, out=None) -> int:
+    from tpu_dra.obs import requests as obsreq
+
+    out = sys.stdout if out is None else out
+    try:
+        doc = _fetch_requests(args, trace_id=args.trace_id)
+    except (urllib.error.URLError, OSError) as e:
+        print(
+            f"error: cannot reach serve endpoint at {args.endpoint}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    if args.format == "json":
+        print(json.dumps(doc, indent=2), file=out)
+    else:
+        print(obsreq.render_waterfall(doc), end="", file=out)
+    return 0
+
+
 def _fetch_fleet(args: argparse.Namespace) -> dict:
     return fetch_debug(
         args.endpoint, args.pprof_path, "fleet",
@@ -587,6 +700,10 @@ def main(argv: "list[str] | None" = None) -> int:
         return serve_stats(args)
     if args.command == "kv":
         return kv_cmd(args)
+    if args.command == "requests":
+        return requests_cmd(args)
+    if args.command == "waterfall":
+        return waterfall_cmd(args)
     if args.command == "fleet-stats":
         return fleet_stats(args)
     if args.command == "top":

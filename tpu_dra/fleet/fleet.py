@@ -30,16 +30,28 @@ goodput — is already host-side, json-able state):
   ``max_queue_per_replica`` waiters; when EVERY replica is at cap the
   request parks in the fleet queue and is placed when capacity frees —
   so a burst commits to the replica that frees up first, not to
-  whichever was least-bad at arrival.  Engine timelines are backdated
-  to fleet arrival (``submit(enqueued_at=...)``), so ``queue_wait_s``
-  and TTFT keep measuring what the user experienced.
+  whichever was least-bad at arrival.  Placement order is
+  priority-first (highest ``submit(priority=)``, strict FIFO within a
+  class — the engines' admission discipline lifted to the front door,
+  so a high-priority arrival routes past a parked low-priority flood
+  instead of behind it).  Engine timelines are backdated to fleet
+  arrival (``submit(enqueued_at=...)``), so ``queue_wait_s`` and TTFT
+  keep measuring what the user experienced.
 - **Autoscaling signal**: `scale_hint()` folds aggregate goodput (the
   PR-5 SLO verdicts) and queue growth into grow / shrink / hold — the
   number a kubesim autoscaler (or a human) acts on.
 - **Telemetry**: every placement lands in the fleet flight recorder
   (``/debug/fleet``, `tpu_dra/fleet/stats.py`) and moves
-  ``tpu_dra_fleet_routed_total{replica,reason}``; scrape-time gauges
-  cover fleet queue depth, load skew, and per-replica digest age.
+  ``tpu_dra_fleet_routed_total{replica,reason}`` +
+  ``tpu_dra_fleet_route_total{outcome}``; scrape-time gauges cover
+  fleet queue depth, load skew, and per-replica digest age.  Each
+  routed request also opens ONE fleet-wide trace: `submit` mints the
+  root context, placement emits the ``fleet.route`` root span (replica,
+  outcome, digest evidence; a spill is a span EVENT on it, never a
+  fresh trace) and hands the context into ``ServeEngine.submit``, so
+  the engine's ``serve.*`` spans parent under it —
+  ``/debug/traces?trace_id=`` shows routing through decode as one tree
+  (docs/OBSERVABILITY.md "Request latency attribution").
 
 Determinism: greedy outputs are token-identical whatever the routing
 policy — every replica runs the same params/config, and each engine's
@@ -73,11 +85,12 @@ from tpu_dra.fleet.router import (
     PrefixRouter,
     ReplicaView,
 )
-from tpu_dra.utils import servestats
+from tpu_dra.utils import servestats, trace
 from tpu_dra.utils.metrics import (
     FLEET_DIGEST_AGE,
     FLEET_LOAD_SKEW,
     FLEET_QUEUE_DEPTH,
+    FLEET_ROUTE_TOTAL,
     FLEET_ROUTED,
     FLEET_SCALE_HINTS,
 )
@@ -87,6 +100,11 @@ __all__ = ["ServeFleet"]
 GROW, SHRINK, HOLD = "grow", "shrink", "hold"
 
 DIGEST_REFRESH_MODES = ("auto", "manual")
+
+
+# The perf_counter -> wall-clock anchor for retro span records (one
+# shared conversion; see trace.unix_of).
+_unix_of = trace.unix_of
 
 
 def _digest_age(fleet, replica: str) -> float:
@@ -120,6 +138,15 @@ class _Pending:
     stop_sequences: "list[list[int]] | None"
     use_prefix_cache: bool
     enqueued_at: float
+    priority: int = 0
+    # The request's fleet-wide trace root, minted at submit: the
+    # fleet.route span takes this identity at placement and the engine
+    # parents its serve.* spans under it, so one trace id covers the
+    # whole routed journey (docs/OBSERVABILITY.md "Request latency
+    # attribution").
+    trace_ctx: "trace.TraceContext | None" = field(
+        default=None, repr=False
+    )
     placement: "Placement | None" = field(default=None, repr=False)
 
 
@@ -328,18 +355,24 @@ class ServeFleet:
     def submit(self, prompt: "list[int]", max_new: "int | None" = None,
                *, seed: "int | None" = None,
                stop_sequences: "list[list[int]] | None" = None,
-               use_prefix_cache: bool = True) -> int:
+               use_prefix_cache: bool = True,
+               priority: int = 0) -> int:
         """Route a request into the fleet; returns a FLEET-wide id (use
         `result()` to fetch the finished Request).  Validation happens
         here, eagerly, against the replica contract (engines share one
         config) — even when the request parks in the fleet queue.  When
         every replica is at its admission cap the request waits
         fleet-side and is placed by a later `tick()`; its timeline is
-        backdated so queue wait and TTFT still start NOW."""
+        backdated so queue wait and TTFT still start NOW.  ``priority``
+        flows through to the chosen replica's admission control
+        (``ServeEngine.submit(priority=)``): the per-class isolation the
+        engines enforce — priority admission and, on swap-tier engines,
+        preemption — is addressable from the fleet front door, and the
+        request's priority is its SLO class in ``/debug/requests``."""
         self._check_open()
         # Any replica's validator speaks for all (one shared config).
         next(iter(self._engines.values())).validate_request(
-            prompt, max_new, seed, stop_sequences
+            prompt, max_new, seed, stop_sequences, priority
         )
         fid = self._next_fid
         self._next_fid += 1
@@ -348,16 +381,51 @@ class ServeFleet:
             stop_sequences=stop_sequences,
             use_prefix_cache=use_prefix_cache,
             enqueued_at=time.perf_counter(),
+            priority=priority,
+            trace_ctx=trace.TraceContext.new(),
         )
         self._by_fid[fid] = None
-        # FIFO discipline: while older requests wait fleet-side, a new
-        # arrival joins the back of the line — placing it immediately
-        # would let it jump capacity that freed since the last tick and
-        # starve the parked requests.
+        # Queue discipline: while older requests wait fleet-side, a new
+        # arrival joins the line — placing it immediately would let it
+        # jump capacity that freed since the last tick.  The line is
+        # priority-ordered at PLACEMENT (`_queue_head`), strict FIFO
+        # within a class: a priority-blind fleet queue would park
+        # high-priority arrivals behind a low-priority flood and defeat
+        # the very preemption the engines run (the front door must honor
+        # the same classes the admission control does).
         if self._queue or not self._try_place(item):
             with self._lock:
                 self._queue.append(item)
         return fid
+
+    def _place_queued(self) -> None:
+        """Drain the fleet queue into freed capacity, highest priority
+        first and earliest fleet arrival among equals —
+        `ServeEngine._head_index` lifted to the fleet tier, so
+        default-priority traffic stays strict FIFO and a high-priority
+        arrival routes past a parked low-priority flood instead of
+        behind it.  ONE sorted pass per tick (submit/tick are not
+        re-entrant, so the snapshot is exact): a 10k-deep flood drains
+        in O(N log N), not a head-rescan per placement.  Placement
+        stops at the first unplaceable item in priority order — the
+        head-of-line discipline, now per class ordering."""
+        if not self._queue:
+            return
+        pending = sorted(
+            self._queue, key=lambda r: (-r.priority, r.enqueued_at)
+        )
+        placed: "set[int]" = set()
+        for item in pending:
+            if not self._try_place(item):
+                break
+            placed.add(item.fid)
+        if placed:
+            with self._lock:
+                remaining = [
+                    i for i in self._queue if i.fid not in placed
+                ]
+                self._queue.clear()
+                self._queue.extend(remaining)
 
     def _open_views(self) -> "list[ReplicaView]":
         return [
@@ -386,6 +454,7 @@ class ServeFleet:
             )
         else:
             placement = self.router.route(item.prompt, views)
+        route_events: "list[dict]" = []
         if placement.reason == AFFINITY:
             eng = self._engines[placement.replica]
             if eng.peek_prefix(item.prompt) <= 0:
@@ -394,6 +463,7 @@ class ServeFleet:
                 # to load routing, and count the spill — the router's
                 # staleness story in one branch.
                 stale_age = placement.digest_age_s
+                affinity_replica = placement.replica
                 self._digests.pop(placement.replica, None)
                 coldest = min(
                     views,
@@ -404,12 +474,46 @@ class ServeFleet:
                     load=placement.loads[coldest.name],
                     loads=placement.loads, digest_age_s=stale_age,
                 )
+                # The re-route is an EVENT on the request's one routing
+                # span, never a fresh trace: /debug/traces?trace_id=
+                # shows the promised replica, the landing replica, and
+                # everything the landing replica then did, in one tree.
+                route_events.append(
+                    {
+                        "name": "spill",
+                        "offset_s": round(
+                            time.perf_counter() - item.enqueued_at, 9
+                        ),
+                        "attributes": {
+                            "from_replica": affinity_replica,
+                            "to_replica": coldest.name,
+                            "digest_age_s": round(stale_age, 4),
+                        },
+                    }
+                )
         eng = self._engines[placement.replica]
         rid = eng.submit(
             item.prompt, item.max_new, seed=item.seed,
             stop_sequences=item.stop_sequences,
             use_prefix_cache=item.use_prefix_cache,
             enqueued_at=item.enqueued_at,
+            priority=item.priority,
+            trace_parent=item.trace_ctx,
+        )
+        # The fleet-wide trace ROOT, retro-emitted now that the route is
+        # decided: identity = the context minted at fleet submit (which
+        # the engine's serve.request just parented under), duration =
+        # fleet arrival -> engine handoff (routing work + any fleet
+        # -side queue wait), attributes = the placement's evidence.
+        now = time.perf_counter()
+        trace.emit_span(
+            "fleet.route", context=item.trace_ctx,
+            start_unix_s=_unix_of(item.enqueued_at),
+            duration_s=now - item.enqueued_at,
+            events=route_events,
+            fleet=self.name, request=item.fid,
+            queue_depth=len(self._queue),
+            **placement.span_attributes(),
         )
         with self._lock:
             self._by_fid[item.fid] = (placement.replica, rid)
@@ -418,6 +522,7 @@ class ServeFleet:
                 self._routed.get(placement.reason, 0) + 1
             )
         FLEET_ROUTED.inc(replica=placement.replica, reason=placement.reason)
+        FLEET_ROUTE_TOTAL.inc(outcome=placement.reason)
         stats.RECORDER.record(
             stats.PlacementRecord(
                 fleet=self.name, request=item.fid,
@@ -425,6 +530,7 @@ class ServeFleet:
                 matched=placement.matched, load=placement.load,
                 digest_age_s=round(placement.digest_age_s, 4),
                 queue_depth=len(self._queue), loads=placement.loads,
+                trace_id=item.trace_ctx.trace_id,
             )
         )
         return True
@@ -436,9 +542,7 @@ class ServeFleet:
         engines release the GIL inside XLA, so replica steps overlap on
         multi-core hosts).  Returns the requests that finished."""
         self._check_open()
-        while self._queue and self._try_place(self._queue[0]):
-            with self._lock:
-                self._queue.popleft()
+        self._place_queued()
         busy = [e for e in self._engines.values() if e.pending]
         if self._pool is not None and len(busy) > 1:
             finished_lists = list(

@@ -87,6 +87,22 @@ class Placement:
     # it picked).
     loads: "dict[str, float]" = field(default_factory=dict)
 
+    def span_attributes(self) -> dict:
+        """The placement as span attributes — the payload of the
+        ``fleet.route`` ROOT span the fleet opens per routed request
+        (docs/OBSERVABILITY.md "Request latency attribution"): where the
+        request landed, why (``outcome`` = the reason vocabulary above),
+        how many prompt tokens the digest claimed resident, and the
+        load/digest evidence the decision stood on.  One shape for every
+        outcome, so trace queries never branch on reason."""
+        return {
+            "replica": self.replica,
+            "outcome": self.reason,
+            "matched": self.matched,
+            "load": round(self.load, 4),
+            "digest_age_s": round(self.digest_age_s, 4),
+        }
+
 
 class PrefixRouter:
     """Stateless-per-request placement policy over `ReplicaView`s.

@@ -45,6 +45,10 @@ class PlacementRecord:
     digest_age_s: float = 0.0
     queue_depth: int = 0  # fleet-level queue length at placement
     loads: "dict[str, float]" = field(default_factory=dict)
+    # The request's fleet-wide trace id (the fleet.route root span's
+    # identity): /debug/fleet rows and /debug/traces join on it, so a
+    # placement record resolves to the full request waterfall.
+    trace_id: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -59,6 +63,7 @@ class PlacementRecord:
             "digest_age_s": self.digest_age_s,
             "queue_depth": self.queue_depth,
             "loads": dict(self.loads),
+            "trace_id": self.trace_id,
         }
 
 

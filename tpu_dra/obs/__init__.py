@@ -21,6 +21,10 @@ pane of glass over all of them:
 - ``kv``          — KV-pool introspection: the ``/debug/kv`` document
   and the ``tpudra kv`` rendering over engine-registered pool
   snapshot providers (per-block age/heat, sharing, fragmentation).
+- ``requests``    — request latency attribution: the ``/debug/requests``
+  document (per-request waterfall phase decomposition, per-priority
+  -class TTFT/TPOT/goodput aggregates) behind ``tpudra requests`` /
+  ``tpudra waterfall`` and the per-class ``SLOClassBurn`` rules.
 
 jax-free ON PURPOSE (the ``fleet``/``servestats`` discipline, enforced
 by the A101-A103 gate): the collector is control-plane code that must
@@ -29,18 +33,19 @@ run in any binary — or its own tiny pod — without paying a jax import.
 
 from tpu_dra.obs import alerts, cluster, collector, promparse  # noqa: F401
 
-__all__ = ["alerts", "cluster", "collector", "kv", "promparse"]
+__all__ = ["alerts", "cluster", "collector", "kv", "promparse", "requests"]
 
 
 def __getattr__(name: str):
-    # `kv` loads LAZILY on purpose (the fleet/__init__ PEP 562 shape):
-    # /debug/index advertises /debug/kv exactly when the module is
-    # loaded, and it is the paged engines that load it (registering
-    # their snapshot providers) — a collector pod or rows-layout binary
-    # that merely imports tpu_dra.obs must not advertise an empty
-    # introspection endpoint and draw useless fetch_kv traffic.
-    if name == "kv":
+    # `kv` and `requests` load LAZILY on purpose (the fleet/__init__
+    # PEP 562 shape): /debug/index advertises /debug/kv and
+    # /debug/requests exactly when the module is loaded, and it is the
+    # engines that load them (registering their snapshot/class
+    # providers) — a collector pod or control-plane binary that merely
+    # imports tpu_dra.obs must not advertise an empty introspection
+    # endpoint and draw useless fetch traffic.
+    if name in ("kv", "requests"):
         import importlib
 
-        return importlib.import_module("tpu_dra.obs.kv")
+        return importlib.import_module(f"tpu_dra.obs.{name}")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -22,11 +22,16 @@ per unit time, the SRE-workbook shape), fleet queue growth, claim
 eviction spikes (node kills), prefix-digest staleness, paged KV pool
 pressure (free blocks low while zero-copy sharing falls), KV swap
 thrash (sustained host-tier swap-in on a full pool), and scrape-down.
+Deployments with priority classes add per-class latency objectives on
+top: a ``ClassSLO`` per class through ``slo_class_burn`` (the
+``SLOClassBurn-class<N>`` rules), evaluated from the ``/debug/requests``
+per-class aggregates — the measurement side of QoS isolation.
 
 Rule expressions receive the collector itself and use its view protocol
-(``rate`` / ``delta`` / ``max_value`` / ``endpoint_health``), so custom
-rules are one lambda away; a raising expression marks the rule's status
-with the error instead of killing the evaluation loop.
+(``rate`` / ``delta`` / ``max_value`` / ``endpoint_health`` /
+``fetch_requests``), so custom rules are one lambda away; a raising
+expression marks the rule's status with the error instead of killing
+the evaluation loop.
 """
 
 from __future__ import annotations
@@ -515,6 +520,116 @@ def kv_swap_thrash(
         description=f"host-tier swap-in rate >= {swap_in_per_s:g} "
         f"blocks/s while free blocks < {free_frac_threshold:.0%} of "
         "pool (requests cycling through the swap tier)",
+    )
+
+
+@dataclass(frozen=True)
+class ClassSLO:
+    """One priority class's declarative latency objectives: TTFT p95
+    and/or TPOT p95 ceilings in seconds (at least one must be set).
+    The class is the admission priority (``submit(priority=)``), which
+    is also the ``class`` label of
+    ``tpu_dra_serve_request_phase_seconds`` and the key of the
+    ``/debug/requests`` per-class aggregates — one vocabulary from
+    submit to alert."""
+
+    cls: int
+    ttft_p95_s: "float | None" = None
+    tpot_p95_s: "float | None" = None
+
+    def __post_init__(self):
+        if self.ttft_p95_s is None and self.tpot_p95_s is None:
+            raise ValueError(
+                f"ClassSLO for class {self.cls} sets no objective: give "
+                "ttft_p95_s and/or tpot_p95_s"
+            )
+        for knob in ("ttft_p95_s", "tpot_p95_s"):
+            value = getattr(self, knob)
+            if value is not None and not value > 0:
+                raise ValueError(f"{knob} must be > 0, got {value}")
+
+
+def slo_class_burn(
+    slo: ClassSLO,
+    *,
+    min_requests: int = 1,
+    window_requests: int = 64,
+    for_s: float = 0.0,
+) -> AlertRule:
+    """Per-priority-class SLO burn: the class's observed TTFT/TPOT p95
+    over the most recent ``window_requests`` finished requests (the
+    ``/debug/requests`` aggregates, fetched from every capable endpoint
+    — ``view.fetch_requests``) against its declared ceilings.  The
+    value is the worst observed/objective ratio; > 1 fires.  One rule
+    instance per class, so a low-priority flood can fire ITS class
+    while the preemption-protected high class stays quiet — per-class
+    isolation measured, not assumed (the ROADMAP item-5 QoS stretch's
+    measurement side).  Quiet classes (< ``min_requests`` finished in
+    the window) never fire: absent traffic is not a missed objective."""
+
+    def expr(view):
+        requests = 0
+        worst_ttft: "float | None" = None
+        worst_tpot: "float | None" = None
+        # cls= pushes the class filter server-side: the window is THIS
+        # class's most recent records, so another class's flood can
+        # never displace the watched class out of its own window.
+        for doc in view.fetch_requests(cls=slo.cls, limit=window_requests):
+            agg = (doc.get("summary", {}).get("classes") or {}).get(
+                str(slo.cls)
+            )
+            if not agg:
+                continue
+            requests += agg.get("requests", 0)
+            # Worst across endpoints: an SLO holds fleet-wide only if
+            # it holds on every replica's recent window (p95s cannot be
+            # merged exactly from summaries; max is the conservative
+            # join).
+            ttft = agg.get("ttft_p95_s")
+            if ttft is not None:
+                worst_ttft = (
+                    ttft if worst_ttft is None else max(worst_ttft, ttft)
+                )
+            tpot = agg.get("tpot_p95_s")
+            if tpot is not None:
+                worst_tpot = (
+                    tpot if worst_tpot is None else max(worst_tpot, tpot)
+                )
+        if requests < min_requests:
+            return (
+                False, 0.0,
+                f"class {slo.cls}: {requests} finished request(s) in "
+                "window (quiet)",
+            )
+        burn = 0.0
+        parts = []
+        for label, observed, target in (
+            ("ttft p95", worst_ttft, slo.ttft_p95_s),
+            ("tpot p95", worst_tpot, slo.tpot_p95_s),
+        ):
+            if target is None or observed is None:
+                continue
+            burn = max(burn, observed / target)
+            parts.append(f"{label} {observed:.4f}s vs {target:.4f}s")
+        detail = f"class {slo.cls}: " + (
+            "; ".join(parts) if parts else "no objective-matched samples"
+        )
+        return burn > 1.0, round(burn, 3), detail
+
+    objectives = ", ".join(
+        f"{label} < {target:g}s"
+        for label, target in (
+            ("TTFT p95", slo.ttft_p95_s), ("TPOT p95", slo.tpot_p95_s)
+        )
+        if target is not None
+    )
+    return AlertRule(
+        name=f"SLOClassBurn-class{slo.cls}",
+        expr=expr,
+        for_s=for_s,
+        severity="page",
+        description=f"priority class {slo.cls} out of SLO ({objectives}) "
+        f"over its last {window_requests} finished requests",
     )
 
 

@@ -6,8 +6,10 @@ top`` / ``tpudra alerts`` renderings.
 triages by (span throughput, serve occupancy/queue, goodput, eviction
 and rejection rates, the dominant step phase, paged-KV free-block
 fraction, host-tier swap rate, and wasted steps — each computed from the series rings over a
-query-able window), current alert status, and the recent alert
-transitions.
+query-able window), per-priority-class request rows (in-flight, TTFT
+/TPOT p95, goodput, preemptions — merged from every endpoint's
+``/debug/requests`` aggregates), current alert status, and the recent
+alert transitions.
 ``render_text`` is the same document as a terminal dashboard (what
 ``tpudra top`` draws, and ``/debug/cluster?format=text`` serves);
 ``render_alerts_text`` is the alert-centric cut for ``tpudra alerts``.
@@ -137,6 +139,49 @@ def endpoint_row(collector, health: dict, window_s: float) -> dict:
     return out
 
 
+def class_rows(collector) -> "list[dict]":
+    """Per-priority-class fleet rows from the ``/debug/requests``
+    aggregates (collector.fetch_requests): live in-flight counts and
+    preemptions SUM across endpoints, TTFT/TPOT p95 join by MAX (the
+    conservative cross-endpoint read of a percentile), goodput
+    recomputes from the summed verdict counts.  Highest class first —
+    the tier an operator protects reads first.  Empty when no endpoint
+    serves request attribution (a control-plane-only cluster), so the
+    dashboard section simply does not render."""
+    rows: "dict[str, dict]" = {}
+
+    def row(cls: str) -> dict:
+        return rows.setdefault(
+            cls,
+            {
+                "class": cls, "in_flight": 0, "requests": 0,
+                "preemptions": 0, "ttft_p95_s": None, "tpot_p95_s": None,
+                "slo_met": 0, "slo_missed": 0, "goodput": None,
+            },
+        )
+
+    for doc in collector.fetch_requests():
+        for cls, agg in (doc.get("summary", {}).get("classes") or {}).items():
+            r = row(cls)
+            r["requests"] += agg.get("requests", 0)
+            r["preemptions"] += agg.get("preemptions", 0)
+            r["slo_met"] += agg.get("slo_met", 0)
+            r["slo_missed"] += agg.get("slo_missed", 0)
+            for key in ("ttft_p95_s", "tpot_p95_s"):
+                value = agg.get(key)
+                if value is not None:
+                    r[key] = (
+                        value if r[key] is None else max(r[key], value)
+                    )
+        for cls, live in (doc.get("in_flight") or {}).items():
+            row(cls)["in_flight"] += live.get("in_flight", 0)
+    for r in rows.values():
+        verdicts = r["slo_met"] + r["slo_missed"]
+        if verdicts:
+            r["goodput"] = round(r["slo_met"] / verdicts, 3)
+    return sorted(rows.values(), key=lambda r: int(r["class"]), reverse=True)
+
+
 def cluster_doc(
     collector,
     *,
@@ -164,6 +209,7 @@ def cluster_doc(
         "endpoints": rows,
         "endpoints_up": up,
         "endpoints_total": len(rows),
+        "classes": class_rows(collector),
         "alerts": alerts,
         "firing": [a["rule"] for a in alerts if a["state"] == "firing"],
         "alert_events": [e.to_dict() for e in events],
@@ -223,6 +269,24 @@ def render_text(doc: dict) -> str:
         )
     if not doc["endpoints"]:
         out.append("(no endpoints configured)")
+    classes = doc.get("classes", [])
+    if classes:
+        out.append("classes:")
+        out.append(
+            f"  {'class':>5} {'inflight':>8} {'reqs':>5} "
+            f"{'ttft_p95_ms':>11} {'tpot_p95_ms':>11} {'goodput':>7} "
+            f"{'preempt':>7}"
+        )
+        for c in classes:
+            ttft = c["ttft_p95_s"]
+            tpot = c["tpot_p95_s"]
+            out.append(
+                f"  {c['class']:>5} {c['in_flight']:>8} "
+                f"{c['requests']:>5} "
+                f"{_fmt(None if ttft is None else ttft * 1e3, 11, 2)} "
+                f"{_fmt(None if tpot is None else tpot * 1e3, 11, 2)} "
+                f"{_fmt(c['goodput'], 7, 3)} {c['preemptions']:>7}"
+            )
     active = [a for a in doc["alerts"] if a["state"] != "ok"]
     if active:
         out.append("alerts:")

@@ -207,6 +207,10 @@ class ObsCollector:
         # series, not every ring of every endpoint.
         self._rings: "dict[str, dict[tuple[str, tuple], SeriesRing]]" = {}
         self._pool = None  # lazy scrape ThreadPoolExecutor (>1 endpoint)
+        # fetch_requests memo for the current scrape round: (round,
+        # {query key: documents}) — per-class rules and the cluster doc
+        # share one fetch per distinct query per round.
+        self._requests_memo: "tuple[int, dict]" = (-1, {})
         self._now_override: "float | None" = None  # scrape_once(now_mono=)
         self._rounds = 0
         self._snapshots = 0
@@ -618,6 +622,71 @@ class ObsCollector:
                 merged = dict(eng_doc)
                 merged["endpoint"] = ep.name
                 out.append(merged)
+        return out
+
+    # -- cross-process request attribution -------------------------------------
+
+    def fetch_requests(
+        self,
+        engine: "str | None" = None,
+        cls: "int | None" = None,
+        limit: int = 256,
+    ) -> "list[dict]":
+        """``/debug/requests`` documents from every endpoint whose
+        ``/debug/index`` advertises the path (capability discovery — a
+        control-plane process with no engines is never asked).  Each
+        document gains an ``endpoint`` field naming where it came from;
+        fetch failures skip the endpoint, best-effort like the trace
+        join.  ``cls`` passes the server-side ``class=`` filter through:
+        a per-class consumer (the ``SLOClassBurn`` rules) windows over
+        THAT CLASS's most recent records, so a flood in another class
+        can never displace the class it is watching out of the window.
+        The per-class summaries inside are PER-ENDPOINT on purpose:
+        percentiles do not merge exactly, so consumers (the
+        ``SLOClassBurn`` rules, the ``tpudra top`` class rows) join
+        them conservatively instead of this method faking a fleet-wide
+        percentile.
+
+        Results are memoized PER SCRAPE ROUND (keyed on the query): one
+        evaluation cycle's N per-class rules plus the cluster doc share
+        fetches instead of re-GETting identical documents from every
+        endpoint."""
+        key = (engine, cls, limit)
+        with self._lock:
+            rounds = self._rounds
+            memo_round, memo = self._requests_memo
+            if memo_round == rounds and key in memo:
+                return memo[key]
+            states = list(self._states.values())
+        out: "list[dict]" = []
+        for state in states:
+            ep = state.endpoint
+            if not state.serves(f"{ep.pprof_path}/requests"):
+                continue
+            query = {"format": "json", "limit": limit}
+            if engine:
+                query["engine"] = engine
+            if cls is not None:
+                query["class"] = cls
+            url = (
+                f"{ep.url}{ep.pprof_path}/requests?"
+                + urllib.parse.urlencode(query)
+            )
+            try:
+                doc = json.loads(self._get(url))
+            except Exception as e:
+                logger.debug("requests fetch from %s failed: %s", ep.url, e)
+                continue
+            doc["endpoint"] = ep.name
+            out.append(doc)
+        with self._lock:
+            # The I/O ran outside the lock; re-key against the CURRENT
+            # round so a result that straddled a round boundary never
+            # poisons the new round's memo.
+            if self._requests_memo[0] != self._rounds:
+                self._requests_memo = (self._rounds, {})
+            if self._requests_memo[0] == rounds:
+                self._requests_memo[1][key] = out
         return out
 
     def assemble_trace_tree(self, trace_id: "str | None" = None) -> str:

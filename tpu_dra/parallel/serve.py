@@ -209,11 +209,9 @@ def _jax_mods():
     return _jax, _jnp
 
 
-def _unix_of(perf_t: float) -> float:
-    """Map an engine perf_counter timestamp onto the wall clock for span
-    records (the timeline runs on the monotonic clock; chrome-trace wants
-    unix time — debug-grade precision is fine)."""
-    return time.time() - (time.perf_counter() - perf_t)  # noqa: A201 — epoch anchor
+# The perf_counter -> wall-clock anchor for retro span records (one
+# shared conversion; see trace.unix_of).
+_unix_of = trace.unix_of
 
 
 def _weak_sampler(ref: "weakref.ref", fn):
@@ -275,7 +273,13 @@ class Request:
     swapped: bool = False
     swap_out_blocks: int = 0
     swap_in_blocks: int = 0
+    # swapped_s covers swap-out START through swap-in COMPLETION (the
+    # whole window decode was stalled); swap_dma_s is the measured block
+    # -DMA share of that window, both directions — obs/requests.py
+    # splits the window into the `preempted-host` and `swap-dma`
+    # waterfall phases from exactly these two numbers.
     swapped_s: float = 0.0
+    swap_dma_s: float = 0.0
     submitted_at: float = 0.0
     ttft_s: float = 0.0
     # The engine that served this request (ServeEngine.name, stamped at
@@ -305,8 +309,13 @@ class Request:
     # Trace identity: every span of this request (serve.queue /
     # serve.admit / serve.decode under the serve.request root) carries
     # this id — `/debug/traces?trace_id=` shows the whole timeline.
+    # When a fleet router submitted the request it hands its own span
+    # context down (`submit(trace_parent=)`): trace_id is then the
+    # FLEET trace and serve.request parents under the fleet.route root,
+    # so one trace id covers routing + queue + admission + decode.
     trace_id: str = ""
     trace_ctx: "object | None" = field(default=None, repr=False)
+    trace_parent: "object | None" = field(default=None, repr=False)
     _last_token_at: float = field(default=0.0, repr=False)
     _swapped_at: float = field(default=0.0, repr=False)
 
@@ -719,6 +728,22 @@ class ServeEngine:
                     lambda e: None if e is None else e.kv_snapshot()
                 )(ref_kv()),
             )
+        # Request latency attribution (docs/OBSERVABILITY.md "Request
+        # latency attribution"): _finish reduces every finished request
+        # into the jax-free waterfall ring, and the provider registered
+        # here serves the LIVE per-priority-class occupancy half of
+        # /debug/requests (weakref-backed, the kv-provider discipline).
+        # Lazy import like obs.kv: no eager parallel -> obs edge.
+        from tpu_dra.obs import requests as obsreq
+
+        self._obsreq = obsreq
+        ref_req = weakref.ref(self)
+        obsreq.register(
+            self.name,
+            lambda: (
+                lambda e: None if e is None else e.request_class_stats()
+            )(ref_req()),
+        )
         # Scrape-time gauges, one series per engine.  The sampler holds a
         # weakref: a collected engine's series retires itself at the next
         # scrape, and close() retires it deterministically.  Two live
@@ -995,7 +1020,8 @@ class ServeEngine:
                stop_sequences: "list[list[int]] | None" = None,
                use_prefix_cache: bool = True,
                enqueued_at: "float | None" = None,
-               priority: int = 0) -> int:
+               priority: int = 0,
+               trace_parent: "trace.TraceContext | None" = None) -> int:
         """Queue a request; returns its id.  Admission happens on `tick`.
         ``seed`` keys this request's sampling (default: the request id) —
         its output depends on (seed, position) only, never on
@@ -1015,7 +1041,10 @@ class ServeEngine:
         head (equal priorities stay strict FIFO), and on paged engines
         with a host swap tier a waiting request may preempt a
         strictly-lower-priority mid-decode row (docs/SERVING.md "KV
-        memory hierarchy").
+        memory hierarchy").  ``trace_parent``: the submitting tier's
+        span context (the fleet router's ``fleet.route`` root) — the
+        request's spans then join THAT trace instead of opening a fresh
+        one, so a fleet-routed request renders as one end-to-end trace.
 
         Every contract violation raises HERE, eagerly — a bad prompt
         must never surface later as an opaque failure inside the padded
@@ -1024,10 +1053,21 @@ class ServeEngine:
         budget, stops = self.validate_request(
             prompt, max_new, seed, stop_sequences, priority
         )
+        if trace_parent is not None and not isinstance(
+            trace_parent, trace.TraceContext
+        ):
+            raise ValueError(
+                "trace_parent must be a utils.trace.TraceContext, got "
+                f"{type(trace_parent).__name__}"
+            )
         now = time.perf_counter()
         # Backdate only: a future enqueued_at would make waits negative.
         t0 = now if enqueued_at is None else min(float(enqueued_at), now)
-        ctx = trace.TraceContext.new()
+        ctx = (
+            trace_parent.child()
+            if trace_parent is not None
+            else trace.TraceContext.new()
+        )
         req = Request(
             id=self._next_id, prompt=list(prompt), max_new=budget,
             seed=self._next_id if seed is None else seed,
@@ -1037,6 +1077,7 @@ class ServeEngine:
             submitted_at=t0, enqueued_at=t0,
             replica=self.name,
             trace_id=ctx.trace_id, trace_ctx=ctx,
+            trace_parent=trace_parent,
         )
         self._next_id += 1
         self._queue.append(req)
@@ -1241,6 +1282,10 @@ class ServeEngine:
                     "host swap accounting violated: pool filled mid-swap"
                 )
             host_slots.append(slot)
+        # The outbound DMA's share of the swapped window, accumulated so
+        # the request-waterfall reduction (obs/requests.py) can split
+        # swapped_s into genuinely-parked time vs transfer cost.
+        req.swap_dma_s += time.perf_counter() - now
         self._balloc.unref(blocks, step=self._device_steps)
         # Zero onto scratch BEFORE the row's blocks can be reallocated
         # — the frozen row keeps stepping (the _finish discipline).
@@ -1312,15 +1357,21 @@ class ServeEngine:
         self._row_pins[row] = []
         self._pos[row] = state["pos"]
         self._tok[row] = state["tok"]
+        # The swapped window closes at restore COMPLETION: the inbound
+        # DMA above stalled decode exactly like the parked time did, so
+        # it belongs inside swapped_s (and its measured share lands in
+        # swap_dma_s — the waterfall's `swap-dma` phase).
+        restored = time.perf_counter()
         req.swapped = False
-        req.swapped_s += now - req._swapped_at
+        req.swapped_s += restored - req._swapped_at
+        req.swap_dma_s += restored - now
         req.swap_in_blocks += len(own)
         # TPOT measures DECODE: the host-parked stall is accounted once
         # in swapped_s, so the first post-restore token's arrival gap
         # must start at the restore, not at the pre-preemption token —
         # otherwise one swap inflates tpot_s/SLO verdicts with
         # scheduler time on an engine whose decode is healthy.
-        req._last_token_at = now
+        req._last_token_at = restored
         self._swap_counts["in_blocks"] += len(own)
         self._swap_counts["in_requests"] += 1
         SERVE_KV_SWAPS.inc(len(own), engine=self.name, direction="in")
@@ -1328,9 +1379,9 @@ class ServeEngine:
             trace.emit_span(
                 "serve.swapin", parent=req.trace_ctx,
                 start_unix_s=_unix_of(req._swapped_at),
-                duration_s=now - req._swapped_at,
+                duration_s=restored - req._swapped_at,
                 request=req.id, row=row, blocks=len(own),
-                parked_s=round(now - req._swapped_at, 6),
+                parked_s=round(restored - req._swapped_at, 6),
             )
 
     def _admit_paged(self, req: Request, row: int, prompt, length: int):
@@ -1672,10 +1723,14 @@ class ServeEngine:
                 finish_reason=req.finish_reason,
                 tpot_s=round(req.tpot_s, 6) if req.token_deltas else None,
             )
-            # The trace ROOT, emitted last (its identity IS the request's
-            # TraceContext, so the three phase spans above parent to it).
+            # The request span, emitted last (its identity IS the
+            # request's TraceContext, so the phase spans above parent to
+            # it).  Engine-local submits make it the trace ROOT; a fleet
+            # -routed request parents it under the router's fleet.route
+            # span instead — one trace, routing through decode.
             trace.emit_span(
                 "serve.request", context=req.trace_ctx,
+                parent=req.trace_parent,
                 start_unix_s=_unix_of(req.enqueued_at),
                 duration_s=req.finished_at - req.enqueued_at,
                 request=req.id, prompt_len=len(req.prompt),
@@ -1685,6 +1740,12 @@ class ServeEngine:
                 prefix_reused=req.prefix_reused,
                 slo=req.slo.get("request"),
             )
+        # Request latency attribution (docs/OBSERVABILITY.md "Request
+        # latency attribution"): one reduction per finished request into
+        # the jax-free waterfall ring + the per-class phase histogram —
+        # one observation per request, the always-on tier (like the
+        # TTFT/queue-wait histograms), never per token.
+        self._obsreq.observe_finished(req)
         self._done.append(req)
         self._row_req[row] = None
         if self._kv_layout == "paged":
@@ -1956,6 +2017,7 @@ class ServeEngine:
             from tpu_dra.obs import kv as obskv
 
             obskv.unregister(self.name)
+        self._obsreq.unregister(self.name)
 
     def _check_open(self) -> None:
         if self._closed:
@@ -2146,6 +2208,35 @@ class ServeEngine:
             replica=self.name,
             epoch=self._prefix.epoch,
         )
+
+    def request_class_stats(self) -> dict:
+        """Live per-priority-class occupancy — the ``obs/requests``
+        provider payload behind ``/debug/requests`` ``in_flight`` and
+        the ``tpudra top`` class rows: for each class with work in
+        flight, how many requests are queued (waiting for a row),
+        decoding (mid-flight in a row), and swapped (preempted to the
+        host tier, parked in the queue with state preserved).  Host-side
+        list walks only, the gauge-sampler consistency contract (a
+        scrape racing the serve loop may read a request mid-move — a
+        count, never a crash).  Classes key as strings: the payload is
+        json-able by construction."""
+        classes: "dict[int, dict]" = {}
+
+        def bump(cls: int, key: str) -> None:
+            row = classes.setdefault(
+                cls, {"queued": 0, "decoding": 0, "swapped": 0}
+            )
+            row[key] += 1
+
+        for r in list(self._queue):
+            bump(r.priority, "swapped" if r.swapped else "queued")
+        for r in list(self._row_req):
+            if r is not None:
+                bump(r.priority, "decoding")
+        return {
+            "engine": self.name,
+            "classes": {str(c): v for c, v in sorted(classes.items())},
+        }
 
     @property
     def queue_depth(self) -> int:

@@ -496,6 +496,25 @@ SERVE_KV_FREE_RUN_BLOCKS = REGISTRY.histogram(
     "blocks exist means the pool needs defragmentation",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
 )
+# Request latency attribution (docs/OBSERVABILITY.md "Request latency
+# attribution"): every finished request's submit->finish wall time
+# decomposed into the canonical waterfall phases, labeled by priority
+# class — the per-class SLO rules (obs/alerts.py SLOClassBurn) and the
+# `tpudra requests` aggregates are derived from the same decomposition
+# (obs/requests.py), this histogram is its scrapeable form.  Buckets
+# span prefix-hit admissions (sub-ms) through saturated queue waits and
+# host-parked preemption stalls (tens of seconds).
+SERVE_REQUEST_PHASE_SECONDS = REGISTRY.histogram(
+    "tpu_dra_serve_request_phase_seconds",
+    "Per-request submit->finish wall time by waterfall phase and "
+    "priority class: queue (submit to admission), admit (placement + "
+    "prefill to first token), decode (first token to finish, host"
+    "-parked time excluded), preempted-host (parked in the host swap "
+    "tier mid-decode), swap-dma (block DMA of the preemption round "
+    "trip); the phases tile submit->finish (closure >= 0.95)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+)
 # Serve-fleet router (tpu_dra/fleet/): placements across engine replicas
 # by reason, plus the routing-health gauges — digest freshness, load
 # balance, and the fleet-level overflow queue.
@@ -505,6 +524,14 @@ FLEET_ROUTED = REGISTRY.counter(
     "match won), load (no match, or the match shed to a colder "
     "replica), spill (digest stale at placement — live verify missed), "
     "random / round_robin (benchmark control policies)",
+)
+FLEET_ROUTE_TOTAL = REGISTRY.counter(
+    "tpu_dra_fleet_route_total",
+    "Fleet root spans (fleet.route) opened per routed request by "
+    "outcome: affinity, load, spill, random, round_robin — the "
+    "trace-side sibling of tpu_dra_fleet_routed_total{replica,reason} "
+    "(one increment per request-level trace root, replica-agnostic, so "
+    "an outcome-mix dashboard needs no replica fan-in)",
 )
 FLEET_DIGEST_AGE = REGISTRY.gauge(
     "tpu_dra_fleet_digest_age_seconds",
@@ -537,7 +564,14 @@ METRIC_SAMPLE_ERRORS = REGISTRY.counter(
 RING_DROPPED = REGISTRY.counter(
     "tpu_dra_ring_dropped_total",
     "Records evicted from bounded telemetry rings by ring name (trace, "
-    "decisions, engine, fleet, obs_alerts)",
+    "decisions, engine, fleet, requests, obs_alerts)",
+)
+TRACE_SPANS_DROPPED = REGISTRY.counter(
+    "tpu_dra_trace_spans_dropped_total",
+    "Finished spans evicted from the in-memory ring exporter by the "
+    "capacity bound (utils/trace.py SpanExporter) — a climbing rate "
+    "means a busy engine is quietly losing the tail of every trace "
+    "before /debug/traces or the cluster collector reads it",
 )
 
 
@@ -670,6 +704,10 @@ def debug_index(server: "MetricsServer") -> dict:
         ("decisions", "tpu_dra.controller.decisions", "RECORDER"),
         ("engine", "tpu_dra.utils.servestats", "RECORDER"),
         ("fleet", "tpu_dra.fleet.stats", "RECORDER"),
+        # Loaded by the first ServeEngine construction (it registers its
+        # in-flight class provider there) — a control-plane binary never
+        # advertises an empty request ring, the obs.kv discipline.
+        ("requests", "tpu_dra.obs.requests", "RECORDER"),
     ):
         info = _ring_info(
             module,
@@ -769,6 +807,8 @@ class MetricsServer:
                         self._send_decisions(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/engine":
                         self._send_engine(parse_qs(parsed.query))
+                    elif parsed.path == f"{outer.pprof_path}/requests":
+                        self._send_requests(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/kv":
                         self._send_kv(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/fleet":
@@ -899,6 +939,44 @@ class MetricsServer:
                         ),
                         "application/json",
                     )
+
+            def _send_requests(self, query: dict) -> None:
+                # Local import, like its siblings — obs.requests is
+                # jax-free by design (the servestats inversion), so the
+                # request waterfalls serve from any binary that ran an
+                # engine, never dragging the compute stack in here.
+                from tpu_dra.obs import requests as obsreq
+
+                limit = _query_int(
+                    query, "limit", 256, cap=obsreq.RECORDER.capacity
+                )
+                fmt = query.get("format", ["json"])[0]
+                if fmt not in ("json", "text"):
+                    raise _BadQuery(
+                        f"format must be json or text, got {fmt!r}"
+                    )
+                cls_raw = query.get("class", [""])[0]
+                cls = None
+                if cls_raw:
+                    try:
+                        cls = int(cls_raw)
+                    except ValueError:
+                        raise _BadQuery(
+                            f"class must be an integer priority, got "
+                            f"{cls_raw!r}"
+                        ) from None
+                doc = obsreq.requests_doc(
+                    engine=query.get("engine", [""])[0] or None,
+                    cls=cls,
+                    trace_id=query.get("trace_id", [""])[0] or None,
+                    limit=limit,
+                )
+                if fmt == "text":
+                    self._send(200, obsreq.render_text(doc))
+                else:
+                    import json
+
+                    self._send(200, json.dumps(doc), "application/json")
 
             def _send_kv(self, query: dict) -> None:
                 # Local import, like its siblings — obs.kv is jax-free by

@@ -177,6 +177,16 @@ def inject(context: "TraceContext | None" = None) -> str:
     return ctx.to_traceparent() if ctx is not None else ""
 
 
+def unix_of(perf_t: float) -> float:
+    """Map a ``perf_counter`` timestamp onto the wall clock for span
+    records: timelines run on the monotonic clock, chrome-trace wants
+    unix time, and debug-grade precision is fine.  The one conversion
+    every retro-span emitter (serve engine, fleet router) shares — a
+    drift between two private copies would skew one component's spans
+    against the rest of the same trace."""
+    return time.time() - (time.perf_counter() - perf_t)  # noqa: A201 — epoch anchor
+
+
 # -- spans --------------------------------------------------------------------
 
 
@@ -313,6 +323,7 @@ def emit_span(
     exporter: "SpanExporter | None" = None,
     status: str = "OK",
     status_message: str = "",
+    events: "list[dict] | None" = None,
     **attributes,
 ) -> TraceContext:
     """Export a RETROACTIVELY-timed span — measured boundaries, no ``with``
@@ -323,15 +334,23 @@ def emit_span(
     other requests' work — there is no lexical block to wrap, only two
     timestamps the engine already holds.  ``context`` fixes the span's own
     identity (pass the request's root TraceContext to make this span the
-    trace root); otherwise the span is a child of ``parent`` (fresh trace
-    when neither is given).  Returns the span's context so callers can
-    parent further spans under it.
+    trace root); ``parent`` sets the parent pointer — combine BOTH to
+    emit a span whose identity was minted earlier (the fleet router's
+    per-request context, handed down so the engine's spans parent under
+    it) while still nesting it under an outer span.  With only
+    ``parent`` the span is a fresh child; with neither, a fresh trace
+    root.  ``events`` attaches timestamped span events (dicts with
+    ``name``/``offset_s``/``attributes`` — the ``SpanEvent`` record
+    shape): a re-route decision inside a routing span is an event on
+    that span, never a fresh trace.  Returns the span's context so
+    callers can parent further spans under it.
 
     Same exit contract as ``Span.__exit__``: the record lands in the ring
     exporter and moves the span counter/duration metrics, so retro spans
     and ``with`` spans are indistinguishable to ``/debug/traces``."""
     if context is not None:
-        ctx, parent_id = context, ""
+        ctx = context
+        parent_id = parent.span_id if parent is not None else ""
     elif parent is not None:
         ctx, parent_id = parent.child(), parent.span_id
     else:
@@ -348,7 +367,7 @@ def emit_span(
         "status": status,
         "status_message": status_message,
         "attributes": {k: v for k, v in attributes.items() if v is not None},
-        "events": [],
+        "events": [dict(e) for e in (events or ())],
     }
     (exporter or EXPORTER).export(record)
     from tpu_dra.utils.metrics import SPAN_SECONDS, TRACE_SPANS_TOTAL
@@ -387,10 +406,15 @@ class SpanExporter:
                 self._dropped += overflow
         if overflow:
             # Lazy import, matching Span.__exit__: the metrics module
-            # must not couple to this one at load time.
-            from tpu_dra.utils.metrics import RING_DROPPED
+            # must not couple to this one at load time.  The dedicated
+            # spans-dropped counter is the trace plane's own loss signal
+            # (RING_DROPPED is the shared cross-ring form): a busy
+            # engine overwriting the tail of every trace was previously
+            # silent to anything watching only trace-shaped series.
+            from tpu_dra.utils.metrics import RING_DROPPED, TRACE_SPANS_DROPPED
 
             RING_DROPPED.inc(overflow, ring="trace")
+            TRACE_SPANS_DROPPED.inc(overflow)
 
     @property
     def dropped(self) -> int:
