@@ -10,7 +10,7 @@ TAG     ?= latest
         native-test demo-quickstart bench image clean help \
         observability-smoke perf-smoke explain-smoke serve-smoke \
         serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke paged-smoke \
-        kernel-smoke kv-smoke swap-smoke requests-smoke
+        kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke
 
 # `analyze` runs the full rule registry — the L-style rules lint would
 # run plus the whole-repo invariants — so `all` needs only one pass.
@@ -19,8 +19,11 @@ TAG     ?= latest
 # on a KV-memory-hierarchy regression (preempt/swap identity, host-tier
 # metrics, KVSwapThrash), and `requests-smoke` on a request-attribution
 # regression (fleet-rooted traces, waterfall closure, per-class SLO
-# burn), before `test` pays for the full suite.
-all: analyze kernel-smoke kv-smoke swap-smoke requests-smoke test
+# burn), before `test` pays for the full suite.  `obs-scale-smoke`
+# fails fast on an obs-plane-at-scale regression (cardinality
+# governance, ObsCardinalityBreach lifecycle, obs self-telemetry,
+# worst-K/paged operator surfaces).
+all: analyze kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke test
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -173,6 +176,17 @@ fleet-smoke:
 obs-top-smoke:
 	$(PYTHON) -m pytest tests/test_obs_top_smoke.py -q -m 'not slow'
 
+# The obs plane at scale (docs/OBSERVABILITY.md "Obs plane at scale"):
+# a path-routed synthetic fleet under one collector drives the
+# cardinality-governance arm — a churning endpoint blows its series
+# budget, ObsCardinalityBreach walks pending -> firing -> resolved off
+# the collector's own self-telemetry while neighbor rates stay exact —
+# and the operator surfaces (`tpudra top --top/--all`, paged
+# /debug/cluster) render at fleet size.  The 1024-endpoint scaling
+# measurement is `bench.py` stanza "obs_scale".
+obs-scale-smoke:
+	$(PYTHON) -m pytest tests/test_obs_scale_smoke.py -q -m 'not slow'
+
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
@@ -187,4 +201,5 @@ help:
 	@echo "         perf-smoke explain-smoke serve-smoke serve-obs-smoke"
 	@echo "         chaos-smoke fleet-smoke obs-top-smoke paged-smoke"
 	@echo "         kernel-smoke kv-smoke swap-smoke requests-smoke"
+	@echo "         obs-scale-smoke"
 	@echo "         image clean"

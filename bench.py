@@ -2068,6 +2068,209 @@ def bench_serve_fleet(timeout_s: float = 420.0) -> "dict":
         return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
 
+def bench_obs_scale(
+    endpoints: int = 1024,
+    rounds: int = 6,
+    interval_s: float = 5.0,
+    round_p95_budget_s: float = 10.0,
+    rule_eval_budget_s: float = 0.5,
+) -> "dict":
+    """Obs-plane scale stanza (ISSUE 16): ONE collector over ``endpoints``
+    synthetic exposition endpoints (path-routed off a single threading
+    HTTP server — the scrape plane sees 1024 distinct scrape targets, the
+    bench pays one listener), driven ``rounds`` injected-clock rounds.
+
+    Gates: scrape-round wall p95 under ``round_p95_budget_s``, per-round
+    alert-rule evaluation cost under ``rule_eval_budget_s``, and ZERO
+    dropped series for in-budget endpoints.  The governance arm: one
+    endpoint churns brand-new series every scrape until it exhausts its
+    per-endpoint budget — ``ObsCardinalityBreach`` must fire while every
+    OTHER endpoint's ``rate()`` stays positive and unperturbed.  Jax-free
+    (the obs plane's own discipline), so it runs in-process."""
+    import http.server
+    import threading
+
+    from tpu_dra.obs import promparse
+    from tpu_dra.obs.alerts import AlertFlightRecorder, default_rules
+    from tpu_dra.obs.collector import Endpoint, ObsCollector
+
+    breach_idx = 0
+    scrape_counts: "dict[int, int]" = {}
+    count_lock = threading.Lock()
+
+    class SynthHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet: 1024 * rounds request lines
+            pass
+
+        def do_GET(self):
+            parts = self.path.split("/")
+            # /ep/<i>/metrics or /ep/<i>/debug/index
+            try:
+                idx = int(parts[2])
+            except (IndexError, ValueError):
+                self.send_error(404)
+                return
+            if self.path.endswith("/debug/index"):
+                body = json.dumps(
+                    {
+                        "component": "bench-synth",
+                        "endpoints": {"/metrics": {"kind": "metrics"}},
+                    }
+                )
+                ctype = "application/json"
+            elif self.path.endswith("/metrics"):
+                with count_lock:
+                    k = scrape_counts.get(idx, 0) + 1
+                    scrape_counts[idx] = k
+                lines = [
+                    "# TYPE tpu_dra_bench_ticks_total counter",
+                    f"tpu_dra_bench_ticks_total {100 * k}",
+                    "# TYPE tpu_dra_bench_load gauge",
+                    f"tpu_dra_bench_load {idx % 7}",
+                    "# TYPE tpu_dra_bench_shard_total counter",
+                ]
+                lines += [
+                    f'tpu_dra_bench_shard_total{{shard="s{j}"}} {k * (j + 1)}'
+                    for j in range(4)
+                ]
+                if idx == breach_idx:
+                    # The cardinality offender: four NEVER-seen-before
+                    # series per scrape (a per-request label value bug).
+                    lines.append(
+                        "# TYPE tpu_dra_bench_churn_total counter"
+                    )
+                    lines += [
+                        f'tpu_dra_bench_churn_total{{key="k{4 * k + j}"}} 1'
+                        for j in range(4)
+                    ]
+                body = "\n".join(lines) + "\n"
+                ctype = "text/plain; version=0.0.4"
+            else:
+                self.send_error(404)
+                return
+            payload = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    class SynthServer(http.server.ThreadingHTTPServer):
+        daemon_threads = True
+        # 32 scrape workers connect simultaneously; the default backlog
+        # of 5 overflows the SYN queue and every overflowed connect eats
+        # a ~1s TCP retransmit — which would bench the bench, not the
+        # collector.  Real deployments scrape 1024 DISTINCT listeners.
+        request_queue_size = 1024
+
+    server = None
+    collector = None
+    try:
+        server = SynthServer(("127.0.0.1", 0), SynthHandler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        port = server.server_address[1]
+
+        collector = ObsCollector(
+            [
+                Endpoint(
+                    f"http://127.0.0.1:{port}/ep/{i}",
+                    name=f"ep{i:04d}",
+                    metrics_path="/metrics",
+                    pprof_path="/debug",
+                )
+                for i in range(endpoints)
+            ],
+            interval_s=interval_s,
+            timeout_s=10.0,
+            rules=default_rules(window_s=4 * interval_s),
+            recorder=AlertFlightRecorder(),
+            scrape_workers=32,
+            series_budget_per_endpoint=12,
+        )
+        walls = []
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            collector.scrape_once(now_mono=1000.0 + interval_s * r)
+            walls.append(time.perf_counter() - t0)
+        walls.sort()
+        round_p95 = walls[min(len(walls) - 1, int(0.95 * len(walls)))]
+
+        health = {h["endpoint"]: h for h in collector.endpoint_health()}
+        breach_name = f"ep{breach_idx:04d}"
+        in_budget_dropped = sum(
+            h["series_dropped"]
+            for name, h in health.items()
+            if name != breach_name
+        )
+        breach_dropped = health[breach_name]["series_dropped"]
+        all_up = all(h["up"] for h in health.values())
+
+        # Rule-eval cost from the collector's own self-telemetry (the
+        # whole point of obs-observes-obs: the gate reads the metric).
+        self_samples = promparse.parse(collector.registry.expose())
+        eval_s = promparse.total(
+            self_samples, "tpu_dra_obs_rule_eval_seconds_sum"
+        )
+        eval_per_round = eval_s / max(1, rounds)
+
+        states = {s["rule"]: s["state"] for s in collector.engine.status()}
+        breach_fired = any(
+            e.rule == "ObsCardinalityBreach" and e.state == "firing"
+            for e in collector.engine.recorder.query()
+        )
+        # Neighbor intactness: a sample of non-breach endpoints must show
+        # a positive, roughly-correct ticks rate (100 per interval).
+        neighbor_rates = [
+            collector.rate(
+                "tpu_dra_bench_ticks_total",
+                window_s=4 * interval_s,
+                endpoint=f"ep{i:04d}",
+            )
+            for i in (1, endpoints // 2, endpoints - 1)
+        ]
+        expected = 100.0 / interval_s
+        neighbors_intact = all(
+            0.5 * expected <= r <= 2.0 * expected for r in neighbor_rates
+        )
+        stats = collector.round_stats
+        ok = bool(
+            all_up
+            and round_p95 < round_p95_budget_s
+            and eval_per_round < rule_eval_budget_s
+            and in_budget_dropped == 0
+            and breach_dropped > 0
+            and breach_fired
+            and neighbors_intact
+        )
+        return {
+            "endpoints": endpoints,
+            "rounds": rounds,
+            "round_wall_p50_s": round(walls[len(walls) // 2], 4),
+            "round_wall_p95_s": round(round_p95, 4),
+            "round_p95_budget_s": round_p95_budget_s,
+            "rule_eval_s_per_round": round(eval_per_round, 5),
+            "rule_eval_budget_s": rule_eval_budget_s,
+            "series_total": stats.get("series_total", 0),
+            "ring_bytes": stats.get("ring_bytes", 0),
+            "all_endpoints_up": all_up,
+            "in_budget_series_dropped": in_budget_dropped,
+            "breach_series_dropped": breach_dropped,
+            "breach_alert_fired": breach_fired,
+            "breach_alert_state": states.get("ObsCardinalityBreach", ""),
+            "neighbor_rates_per_s": [round(r, 3) for r in neighbor_rates],
+            "neighbors_intact": neighbors_intact,
+            "ok": ok,
+        }
+    except Exception as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    finally:
+        if collector is not None:
+            collector.close()
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+
+
 _CHAOS_CHILD = r"""
 import json
 import statistics
@@ -2676,6 +2879,7 @@ def main() -> int:
     serve_prefix = bench_serve_prefix()
     serve_fleet = bench_serve_fleet()
     chaos = bench_chaos()
+    obs_scale = bench_obs_scale()
     p50 = alloc["p50_s"]
     line = {
         "metric": "claim_to_pod_running_p50",
@@ -2717,6 +2921,11 @@ def main() -> int:
             # and warm serve-engine restart (docs/RESILIENCE.md) — the
             # recovery floor later PRs must not regress.
             "chaos": chaos,
+            # Obs plane at scale: ONE collector over 1024 synthetic
+            # endpoints — scrape-round p95, rule-eval cost, cardinality
+            # governance (breach alert fires, neighbors unperturbed)
+            # (docs/OBSERVABILITY.md "Obs plane at scale").
+            "obs_scale": obs_scale,
             "compute": compute,
         },
     }

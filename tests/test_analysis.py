@@ -404,6 +404,51 @@ class TestMetricDriftRules:
         )
         assert got == []
 
+    def test_a405_unbounded_label_value_fires(self):
+        got = findings({
+            "tpu_dra/utils/m.py": METRIC_MODULE +
+                "def f(request_id):\n"
+                '    M.inc(reason=request_id)\n',
+        }, docs=self.DOC, select={"A405"})
+        assert [f.code for f in got] == ["A405"]
+        assert "request_id" in got[0].message
+        assert "unbounded" in got[0].message
+
+    def test_a405_sees_through_str_and_fstrings(self):
+        # Stringifying an id does not bound it — `str(uid)` and
+        # f-string interpolation are the common laundering shapes.
+        got = codes({
+            "tpu_dra/utils/m.py": METRIC_MODULE +
+                "def f(rec):\n"
+                "    M.inc(reason=str(rec.claim_uid))\n"
+                "def g(trace_id):\n"
+                '    M.inc(reason=f"t-{trace_id}")\n',
+        }, docs=self.DOC, select={"A405"})
+        assert got == ["A405", "A405"]
+        # Suffix matching: anything *_id / *_uid smells per-request.
+        got = codes({
+            "tpu_dra/utils/m.py": METRIC_MODULE +
+                "def f(pod_uid):\n"
+                "    M.inc(reason=pod_uid)\n",
+        }, docs=self.DOC, select={"A405"})
+        assert got == ["A405"]
+
+    def test_a405_bounded_vocabulary_clean(self):
+        # Closed vocabularies — literals, enum-ish locals, outcome
+        # flags — are exactly what labels are FOR; no finding.  And the
+        # denylist applies to label VALUES on registered metrics only,
+        # not to arbitrary calls that happen to mention an id.
+        got = codes({
+            "tpu_dra/utils/m.py": METRIC_MODULE +
+                "def f(reason, kind, outcome):\n"
+                '    M.inc(reason="NodeNotReady")\n'
+                "    M.inc(reason=reason)\n"
+                "    M.inc(2.0, reason=kind)\n"
+                "def g(request_id, log):\n"
+                "    log.info(request_id=request_id)\n",
+        }, docs=self.DOC, select={"A405"})
+        assert got == []
+
 
 class TestExceptionRule:
     def test_a501_swallow_in_loop_fires(self):
@@ -601,7 +646,7 @@ class TestRepoGate:
         got = {r.code for r in all_rules()}
         # The five project-invariant families plus the legacy style set.
         assert {"A101", "A102", "A103", "A201", "A301", "A302",
-                "A401", "A402", "A403", "A404", "A501"} <= got
+                "A401", "A402", "A403", "A404", "A405", "A501"} <= got
         assert {"L002", "L003", "L004", "L005", "L006", "L007"} <= got
         families = {r.family for r in all_rules()}
         assert {"layering", "clocks", "locks", "metrics", "exceptions",

@@ -50,6 +50,13 @@ def test_bench_emits_one_json_line(monkeypatch):
         "bench_serve_fleet",
         lambda: {"ok": True, "scaling": {"x2": 2.0}, "stubbed": True},
     )
+    # And the 1024-endpoint obs-scale stanza; its own coverage is
+    # test_bench_obs_scale_small (and the full size runs in `make bench`).
+    monkeypatch.setattr(
+        bench,
+        "bench_obs_scale",
+        lambda: {"ok": True, "endpoints": 1024, "stubbed": True},
+    )
     import io
     from contextlib import redirect_stdout
 
@@ -66,7 +73,7 @@ def test_bench_emits_one_json_line(monkeypatch):
     extras = parsed["extras"]
     assert {
         "rung", "target_s", "fleet", "wire", "northstar_mesh",
-        "serve_prefix", "serve_fleet", "chaos", "compute",
+        "serve_prefix", "serve_fleet", "chaos", "obs_scale", "compute",
     } <= extras.keys()
     assert extras["fleet"]["target_met"]
     assert extras["wire"]["target_met"]
@@ -263,6 +270,27 @@ def test_bench_wire_small():
     assert out["samples"] == 2
     assert 0 < out["p50_s"] < 30
     assert out["target_met"]
+
+
+def test_bench_obs_scale_small():
+    """The obs-scale stanza (ISSUE 16) at a CI-friendly endpoint count:
+    every gate holds — round wall under budget, zero refused series on
+    in-budget endpoints, the governance breach fires, and the breach
+    endpoint's neighbors keep exact rates."""
+    import bench
+
+    out = bench.bench_obs_scale(endpoints=24, rounds=4)
+    assert out["ok"], out
+    assert out["endpoints"] == 24
+    assert out["all_endpoints_up"]
+    assert out["in_budget_series_dropped"] == 0
+    assert out["breach_series_dropped"] > 0
+    assert out["breach_alert_fired"]
+    assert out["neighbors_intact"]
+    assert out["round_wall_p95_s"] < out["round_p95_budget_s"]
+    assert out["rule_eval_s_per_round"] < out["rule_eval_budget_s"]
+    assert out["series_total"] > 24  # every endpoint minted its series
+    assert out["ring_bytes"] > 0
 
 
 class TestSalvageProtocol:

@@ -28,6 +28,10 @@ REQS = [
 
 
 class TestSchedulingIdentity:
+    # Tier-1 wall budget: the randomized-arrival sweep is ~20s; the
+    # serial-admission wave identity below stays fast.  CI --runslow
+    # keeps it.
+    @pytest.mark.slow
     def test_greedy_identity_continuous_vs_tick_random_arrivals(self):
         """THE half-(a) contract: per-step join/leave changes WHEN rows
         fill, never WHAT they emit.  Randomized arrival orders, requests

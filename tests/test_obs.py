@@ -408,6 +408,7 @@ class TestDefaultRules:
             "KVPoolPressure",
             "KVSwapThrash",
             "ScrapeDown",
+            "ObsCardinalityBreach",
         ]
 
 
@@ -598,6 +599,8 @@ class TestClusterEndpoint:
             "window=-1",
             "window=nan",
             "window=inf",
+            "offset=-1",
+            "offset=x",
         ],
     )
     def test_bad_queries_are_400(self, rig, query):
@@ -700,3 +703,472 @@ class TestTopCli:
         monkeypatch.setenv("TPUDRA_ENGINE", "http://engine:9")
         args = cli.parse_args(["serve-stats"])
         assert args.endpoint == "http://engine:9"
+
+
+class TestSeriesRingTiers:
+    """The two-tier ring: raw head + coarse downsampled tail must answer
+    rate()/delta() exactly like an un-downsampled oracle, at fixed
+    memory."""
+
+    def _fill(self, ring, *, reset_at=None, n=2000, step=3.0):
+        from tpu_dra.obs import collector as obscol
+
+        oracle = []
+        value = 0.0
+        for i in range(n):
+            t = float(i)  # one sample per second
+            if reset_at is not None and i == reset_at:
+                value = 2.0  # the restarted-process counter reset
+            else:
+                value += step
+            ring.add(t, value)
+            oracle.append((t, value))
+        assert isinstance(ring, obscol.SeriesRing)
+        return oracle, float(n - 1)
+
+    def test_ring_rate_matches_undownsampled_oracle(self):
+        from tpu_dra.obs import collector as obscol
+
+        ring = obscol.SeriesRing(
+            64, coarse_buckets=256, coarse_width_s=60.0
+        )
+        oracle, now = self._fill(ring, reset_at=700)
+        snap = ring.snapshot()
+        rows, points = snap
+        # The downsample actually engaged: most history lives coarse.
+        assert len(points) == 64 and len(rows) > 10
+        for window in (10.0, 63.0, 200.0, 500.0, 1999.0, 5000.0):
+            got = obscol._ring_rate(snap, window, now)
+            want = obscol._rate(oracle, window, now)
+            assert got == pytest.approx(want, rel=1e-9), window
+
+    def test_ring_delta_matches_undownsampled_oracle(self):
+        from tpu_dra.obs import collector as obscol
+
+        ring = obscol.SeriesRing(
+            64, coarse_buckets=256, coarse_width_s=60.0
+        )
+        # A sawtooth gauge so delta is not trivially monotone.  Windows
+        # whose cutoff lands ON a 60s bucket boundary (or in the raw
+        # head, or before all data) are the exactness contract; a cutoff
+        # INSIDE a bucket anchors conservatively at the bucket's last
+        # sample, which a sawtooth makes visible — checked separately.
+        oracle = []
+        for i in range(1500):
+            t, v = float(i), float((i * 7) % 101)
+            ring.add(t, v)
+            oracle.append((t, v))
+        snap = ring.snapshot()
+        for window in (10.0, 59.0, 299.0, 899.0, 1499.0, 9000.0):
+            got = obscol._ring_delta(snap, window, 1499.0)
+            want = obscol._delta(oracle, window, 1499.0)
+            assert got == pytest.approx(want, rel=1e-9), window
+        # The straddling case: anchored at the cutoff bucket's LAST
+        # sample, so the delta is newest minus that anchor — a defined,
+        # conservative read, not garbage.
+        got = obscol._ring_delta(snap, 700.0, 1499.0)
+        cutoff = 1499.0 - 700.0
+        rows = [r for r in snap[0] if r[1] >= cutoff]
+        anchor = rows[0][3]  # straddling bucket's last sample
+        assert got == pytest.approx(oracle[-1][1] - anchor, rel=1e-9)
+
+    def test_ring_memory_is_bounded_under_soak(self):
+        from tpu_dra.obs import collector as obscol
+
+        ring = obscol.SeriesRing(32, coarse_buckets=8, coarse_width_s=10.0)
+        sizes = set()
+        for i in range(20000):
+            ring.add(float(i), float(i))
+            if i > 1000:
+                sizes.add(ring.nbytes())
+        # Past saturation the footprint is CONSTANT — the soak cannot
+        # grow it no matter how long the collector runs.
+        assert sizes == {ring.nbytes()}
+        assert len(ring.points) == 32 and len(ring.coarse) == 8
+
+
+class TestCardinalityGovernance:
+    def _scrape_text(self, collector, texts):
+        """Route the collector's HTTP through a per-round script: the
+        metrics GET serves ``texts[round]``, the index GET fails (the
+        pre-index-build path)."""
+        calls = {"round": -1}
+
+        def fake_get(url):
+            if url.endswith("/index"):
+                raise OSError("no index")
+            return texts[calls["round"]]
+
+        collector._get = fake_get
+        return calls
+
+    def test_budget_drops_new_series_keeps_existing_updating(self):
+        collector = make_collector(
+            Endpoint("http://127.0.0.1:9", name="noisy"),
+            rules=[],
+            series_budget_per_endpoint=3,
+        )
+        try:
+            base = "# TYPE t_gov_total counter\n"
+            texts = [
+                base + 't_gov_total{k="a"} 1\nt_gov_total{k="b"} 1\n',
+                base
+                + 't_gov_total{k="a"} 5\n'
+                + "".join(
+                    f't_gov_total{{k="x{i}"}} 1\n' for i in range(6)
+                ),
+                base + 't_gov_total{k="a"} 9\n',
+            ]
+            calls = self._scrape_text(collector, texts)
+            for r in range(3):
+                calls["round"] = r
+                collector.scrape_once(now_mono=100.0 + 5 * r)
+            (health,) = collector.endpoint_health()
+            # 2 minted round one + 1 more under the budget of 3; the
+            # other 5 refused — and refused AGAIN next round (no ring, so
+            # every presentation re-attempts the mint).
+            assert health["series_kept"] == 3
+            assert health["series_dropped"] == 5
+            # The budget refuses NEW series; existing ones keep updating.
+            assert collector.value("t_gov_total", k="a") == 9.0
+            assert (
+                collector.rate("t_gov_total", window_s=60.0, k="a") > 0
+            )
+            # The refusals are themselves a metric (the governance
+            # signal the breach alert windows over; it lives in a
+            # SELF_ENDPOINT ring, outside any endpoint's own budget).
+            assert (
+                collector.value("tpu_dra_obs_series_dropped_total") == 5.0
+            )
+        finally:
+            collector.close()
+
+    def test_global_budget_spans_endpoints(self):
+        collector = make_collector(
+            Endpoint("http://127.0.0.1:8", name="a"),
+            Endpoint("http://127.0.0.1:9", name="b"),
+            rules=[],
+            series_budget_total=1,
+        )
+        try:
+            collector._get = (
+                lambda url: (_ for _ in ()).throw(OSError("no index"))
+                if url.endswith("/index")
+                else "# TYPE t_glob_total counter\nt_glob_total 1\n"
+            )
+            collector.scrape_once(now_mono=100.0)
+            healths = {
+                h["endpoint"]: h for h in collector.endpoint_health()
+            }
+            # One endpoint got the only global slot; the other's series
+            # was refused — which one depends on scrape order, the SUM
+            # is the invariant.
+            kept = sum(h["series_kept"] for h in healths.values())
+            dropped = sum(h["series_dropped"] for h in healths.values())
+            assert (kept, dropped) == (1, 1)
+        finally:
+            collector.close()
+
+    def test_breach_alert_lifecycle_and_neighbor_isolation(self):
+        """The governance arm of the scale story: one endpoint blows its
+        budget; ObsCardinalityBreach goes pending -> firing -> resolved
+        while the OTHER endpoint's rates never flinch."""
+        collector = make_collector(
+            Endpoint("http://127.0.0.1:8", name="noisy"),
+            Endpoint("http://127.0.0.1:9", name="calm"),
+            rules=[
+                obsalerts.obs_cardinality_breach(window_s=30.0, for_s=4.0)
+            ],
+            series_budget_per_endpoint=2,
+        )
+        try:
+            rounds = {"n": 0}
+
+            def fake_get(url):
+                if url.endswith("/index"):
+                    raise OSError("no index")
+                r = rounds["n"]
+                if ":8/" in url or url.rstrip("/").endswith(":8"):
+                    body = "t_noisy_total 1\n"
+                    if 1 <= r <= 3:  # churn: 3 brand-new series a round
+                        body += "".join(
+                            f't_noisy_total{{k="r{r}c{i}"}} 1\n'
+                            for i in range(3)
+                        )
+                    return "# TYPE t_noisy_total counter\n" + body
+                return (
+                    "# TYPE t_calm_total counter\n"
+                    f"t_calm_total {10 * (r + 1)}\n"
+                )
+
+            collector._get = fake_get
+            states = []
+            for r in range(10):
+                rounds["n"] = r
+                collector.scrape_once(now_mono=100.0 + 5 * r)
+                states.append(
+                    {
+                        s["rule"]: s["state"]
+                        for s in collector.engine.status()
+                    }["ObsCardinalityBreach"]
+                )
+            seen = [e.state for e in collector.engine.recorder.query(
+                rule="ObsCardinalityBreach"
+            )]
+            assert "pending" in seen and "firing" in seen
+            assert "resolved" in seen  # drops left the window eventually
+            # Post-resolution quiet rounds decay resolved back to ok.
+            assert states[-1] in ("resolved", "ok")
+            # The firing detail names the offender.
+            fired = [
+                e for e in collector.engine.recorder.query(
+                    rule="ObsCardinalityBreach"
+                )
+                if e.state == "firing"
+            ]
+            assert "noisy" in fired[0].detail
+            # Neighbor isolation: calm's counter advanced 10 per round
+            # throughout — 2/s at the injected 5s cadence, unperturbed.
+            rate = collector.rate(
+                "t_calm_total", window_s=30.0, endpoint="calm"
+            )
+            assert rate == pytest.approx(2.0)
+            healths = {
+                h["endpoint"]: h for h in collector.endpoint_health()
+            }
+            assert healths["calm"]["series_dropped"] == 0
+            assert healths["noisy"]["series_dropped"] > 0
+        finally:
+            collector.close()
+
+
+class TestScrapeScheduler:
+    def test_round_budget_defers_to_next_round(self):
+        collector = make_collector(
+            Endpoint("http://127.0.0.1:8", name="a"),
+            Endpoint("http://127.0.0.1:9", name="b"),
+            rules=[],
+            round_budget_s=0.0,  # the budget is ALREADY spent
+        )
+        try:
+            collector.scrape_once(now_mono=100.0)
+            stats = collector.round_stats
+            assert stats["deferred"] == 2
+            healths = {
+                h["endpoint"]: h for h in collector.endpoint_health()
+            }
+            assert all(h["scrapes"] == 0 for h in healths.values())
+            # Lift the budget: the deferred endpoints get their visit
+            # (deferred-first priority) and the debt clears.
+            collector.round_budget_s = None
+            collector._get = (
+                lambda url: (_ for _ in ()).throw(OSError("no index"))
+                if url.endswith("/index")
+                else "# TYPE t_def_total counter\nt_def_total 1\n"
+            )
+            collector.scrape_once(now_mono=105.0)
+            assert collector.round_stats["deferred"] == 0
+            healths = {
+                h["endpoint"]: h for h in collector.endpoint_health()
+            }
+            assert all(h["scrapes"] == 1 for h in healths.values())
+        finally:
+            collector.close()
+
+    def test_slow_endpoint_degrades_to_longer_interval(self, rig):
+        reg, _, url, _ = rig
+        reg.counter("t_slow_total", "x").inc()
+        collector = make_collector(
+            Endpoint(url, name="slowpoke"),
+            rules=[],
+            slow_scrape_s=0.0,  # every real scrape is "slow"
+            degrade_factor=2,
+        )
+        try:
+            collector.scrape_once(now_mono=100.0)
+            (health,) = collector.endpoint_health(now_mono=100.0)
+            assert health["degraded"] and health["up"]
+            scrapes_after_first = health["scrapes"]
+            # The next round SKIPS it (longer effective interval) —
+            # up stays true, staleness simply grows.
+            collector.scrape_once(now_mono=105.0)
+            (health,) = collector.endpoint_health(now_mono=105.0)
+            assert health["scrapes"] == scrapes_after_first
+            assert health["up"]
+            assert health["staleness_s"] == pytest.approx(5.0)
+            assert collector.round_stats["skipped_degraded"] == 1
+            # Round 3 is its degrade_factor-th round: visited again.
+            collector.scrape_once(now_mono=110.0)
+            (health,) = collector.endpoint_health(now_mono=110.0)
+            assert health["scrapes"] == scrapes_after_first + 1
+        finally:
+            collector.close()
+
+    def test_phase_is_deterministic_and_spread(self):
+        collector = make_collector(rules=[])
+        try:
+            for i in range(64):
+                collector.add_endpoint(
+                    Endpoint(f"http://127.0.0.1:{7000 + i}", name=f"p{i}")
+                )
+            with collector._lock:
+                phases = [
+                    s.phase for s in collector._states.values()
+                ]
+            assert all(0.0 <= p < 1.0 for p in phases)
+            # crc32 phases spread: no slice of 8 hoards the fleet.
+            slices = [int(p * 8) for p in phases]
+            assert max(slices.count(s) for s in range(8)) < 32
+        finally:
+            collector.close()
+
+
+class TestSnapshotBounds:
+    def test_exposition_truncation_is_marked(self, tmp_path):
+        collector = make_collector(
+            Endpoint("http://127.0.0.1:9", name="bigep"),
+            rules=[],
+            snapshot_max_exposition_bytes=200,
+        )
+        try:
+            big = "# TYPE t_big_total counter\n" + "".join(
+                f't_big_total{{k="k{i}"}} 1\n' for i in range(100)
+            )
+            collector._get = (
+                lambda url: (_ for _ in ()).throw(OSError("no index"))
+                if url.endswith("/index")
+                else big
+            )
+            collector.scrape_once(now_mono=100.0)
+            path = collector.dump_snapshot(str(tmp_path), reason="caps")
+            expo = open(
+                os.path.join(path, "exposition-bigep.txt")
+            ).read()
+            assert "# TRUNCATED by snapshot_max_exposition_bytes=200" in expo
+            assert len(expo) < len(big)
+            doc = json.loads(
+                open(os.path.join(path, "cluster.json")).read()
+            )
+            assert doc["truncation"]["exposition_truncated"] == ["bigep"]
+        finally:
+            collector.close()
+
+    def test_total_budget_degrades_rings_to_inventory(self, rig, tmp_path):
+        reg, _, _, collector = rig
+        reg.counter("t_tot_total", "x").inc()
+        collector.scrape_once()
+        collector.snapshot_max_total_bytes = 64  # nothing fits
+        path = collector.dump_snapshot(str(tmp_path), reason="tiny")
+        rings = json.loads(open(os.path.join(path, "rings.json")).read())
+        # The payload degraded to a per-series inventory, not nothing.
+        assert rings and all(
+            v.get("truncated") and isinstance(v["points"], int)
+            for v in rings.values()
+        )
+        doc = json.loads(open(os.path.join(path, "cluster.json")).read())
+        assert doc["truncation"]["rings_truncated"]
+        assert doc["truncation"]["expositions_skipped"] >= 1
+        # cluster.json itself is never sacrificed: full health survives.
+        assert doc["endpoints"][0]["endpoint"] == "ep0"
+
+
+class TestClusterPaging:
+    def _three_endpoint_collector(self):
+        collector = make_collector(
+            Endpoint("http://127.0.0.1:7", name="a"),
+            Endpoint("http://127.0.0.1:8", name="b"),
+            Endpoint("http://127.0.0.1:9", name="c"),
+            rules=[],
+        )
+        collector.scrape_once(now_mono=100.0)
+        return collector
+
+    def test_doc_offset_pages_and_totals_stay_global(self):
+        from tpu_dra.obs import cluster as obscluster
+
+        collector = self._three_endpoint_collector()
+        try:
+            doc = obscluster.cluster_doc(collector, limit=1, offset=1)
+            assert [r["endpoint"] for r in doc["endpoints"]] == ["b"]
+            assert doc["endpoints_total"] == 3
+            assert doc["endpoints_offset"] == 1
+            # Aggregates are computed over the FULL set, not the page.
+            assert doc["endpoints_up"] == 0
+            tail = obscluster.cluster_doc(collector, limit=5, offset=2)
+            assert [r["endpoint"] for r in tail["endpoints"]] == ["c"]
+            beyond = obscluster.cluster_doc(collector, limit=5, offset=9)
+            assert beyond["endpoints"] == []
+            assert beyond["endpoints_total"] == 3
+        finally:
+            collector.close()
+
+    def test_text_rendering_notes_the_page_and_top(self):
+        from tpu_dra.obs import cluster as obscluster
+
+        collector = self._three_endpoint_collector()
+        try:
+            doc = obscluster.cluster_doc(collector, limit=2)
+            text = obscluster.render_text(doc)
+            assert "endpoints 1-2 of 3" in text
+            full = obscluster.cluster_doc(collector)
+            text = obscluster.render_text(full, top=1)
+            assert "showing 1 worst of 3" in text
+            # The aggregate line rides along so the page still answers
+            # "how is the fleet" without fetching every row.
+            assert "Σ" in text or "all endpoints" in text
+        finally:
+            collector.close()
+
+    def test_http_paging_json_and_text_agree(self, rig):
+        _, _, url, collector = rig
+        collector.add_endpoint(Endpoint("http://127.0.0.1:8", name="x1"))
+        collector.add_endpoint(Endpoint("http://127.0.0.1:9", name="x2"))
+        collector.scrape_once()
+        set_active(collector)
+        doc = json.loads(_get(url + "/debug/cluster?limit=1&offset=2"))
+        assert len(doc["endpoints"]) == 1
+        assert doc["endpoints_total"] == 3
+        assert doc["endpoints_offset"] == 2
+        page_name = doc["endpoints"][0]["endpoint"]
+        text = _get(url + "/debug/cluster?format=text&limit=1&offset=2")
+        assert page_name in text and "endpoints 3-3 of 3" in text
+
+
+class TestTruncatedScrape:
+    def test_torn_exposition_does_not_fake_a_counter_reset(self):
+        """A scrape that dies mid-transfer hands the parser a torn final
+        record; if its half-written digits ingested, the NEXT good scrape
+        would read as a counter reset and rate() would over-count.  The
+        collector parses with drop_partial_tail, so the torn sample never
+        lands and the rate over the outage is exact."""
+        collector = make_collector(
+            Endpoint("http://127.0.0.1:9", name="torn"), rules=[]
+        )
+        try:
+            texts = [
+                "# TYPE t_torn_total counter\nt_torn_total 100\n",
+                # 200's transfer died after the first digit: a complete
+                # record would say 200, the torn bytes say 2.
+                "# TYPE t_torn_total counter\nt_torn_total 2",
+                "# TYPE t_torn_total counter\nt_torn_total 300\n",
+            ]
+            calls = {"round": 0}
+
+            def fake_get(url):
+                if url.endswith("/index"):
+                    raise OSError("no index")
+                return texts[calls["round"]]
+
+            collector._get = fake_get
+            for r in range(3):
+                calls["round"] = r
+                collector.scrape_once(now_mono=100.0 + 5 * r)
+            # The torn round kept the endpoint up (the fetch succeeded)
+            # but ingested nothing new; the ring sees 100 -> 300, an
+            # increase of 200 over 10s — NOT 2 + 298 (what a phantom
+            # reset at the torn value would have produced).
+            assert collector.value("t_torn_total") == 300.0
+            rate = collector.rate("t_torn_total", window_s=60.0)
+            assert rate == pytest.approx(200.0 / 10.0)
+        finally:
+            collector.close()

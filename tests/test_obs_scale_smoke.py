@@ -1,0 +1,251 @@
+"""`make obs-scale-smoke`: the obs plane's scale governance, end to end
+(docs/OBSERVABILITY.md "Obs plane at scale").
+
+Two floors in CI seconds, over a REAL scrape path (one threading HTTP
+server path-routing N synthetic exposition endpoints — the collector
+sees N distinct scrape targets):
+
+1. **The governance arm** — one endpoint churns brand-new series every
+   scrape until its per-endpoint budget refuses them; the
+   ``ObsCardinalityBreach`` alert walks pending → firing → resolved off
+   the collector's OWN ``tpu_dra_obs_series_dropped_total`` self-rings
+   while every other endpoint's rates stay exact.  Obs self-telemetry
+   (round wall, series per endpoint, ring bytes, rule-eval cost) is
+   asserted present in the collector's own exposition — obs observes
+   obs.
+2. **The operator surface at scale** — ``tpudra top --top K`` renders
+   the worst-K cut with the fleet aggregate line, ``--all`` the full
+   listing, and ``/debug/cluster`` pages with ``limit=``/``offset=``
+   (same totals either way, 400 on malformed paging queries).
+"""
+
+import http.server
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_dra.obs import alerts as obsalerts
+from tpu_dra.obs import promparse
+from tpu_dra.obs.collector import Endpoint, ObsCollector, set_active
+
+BREACH = 0  # index of the endpoint that churns unbounded series
+
+
+def _get(url: str) -> str:
+    return urllib.request.urlopen(url, timeout=5).read().decode()
+
+
+class _SynthHandler(http.server.BaseHTTPRequestHandler):
+    """Path-routed synthetic fleet: /ep/<i>/metrics serves a steadily
+    advancing counter (plus shard-labeled series); the breach endpoint
+    additionally presents never-seen-before series while its ``churn``
+    flag is up."""
+
+    churn = True
+    counts: "dict[int, int]" = {}
+    lock = threading.Lock()
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        parts = self.path.split("/")
+        try:
+            idx = int(parts[2])
+        except (IndexError, ValueError):
+            self.send_error(404)
+            return
+        if self.path.endswith("/debug/index"):
+            body = json.dumps(
+                {
+                    "component": "synth",
+                    "endpoints": {"/metrics": {"kind": "metrics"}},
+                }
+            )
+        elif self.path.endswith("/metrics"):
+            with self.lock:
+                k = self.counts.get(idx, 0) + 1
+                self.counts[idx] = k
+            lines = [
+                "# TYPE t_scale_ticks_total counter",
+                f"t_scale_ticks_total {100 * k}",
+                "# TYPE t_scale_shard_total counter",
+            ]
+            lines += [
+                f't_scale_shard_total{{shard="s{j}"}} {k * (j + 1)}'
+                for j in range(3)
+            ]
+            if idx == BREACH and type(self).churn:
+                lines.append("# TYPE t_scale_churn_total counter")
+                lines += [
+                    f't_scale_churn_total{{key="k{3 * k + j}"}} 1'
+                    for j in range(3)
+                ]
+            body = "\n".join(lines) + "\n"
+        else:
+            self.send_error(404)
+            return
+        payload = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+class _SynthServer(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+    # Concurrent scrape workers connect at once; the default backlog of
+    # 5 would add ~1s SYN-retransmit stalls that are not the collector's.
+    request_queue_size = 256
+
+
+@pytest.fixture
+def fleet():
+    """(collector, handler class) over 24 synthetic endpoints with a
+    per-endpoint series budget the breach endpoint will blow."""
+    handler = type(
+        "Handler", (_SynthHandler,), {"counts": {}, "churn": True}
+    )
+    server = _SynthServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_address[1]
+    collector = ObsCollector(
+        [
+            Endpoint(
+                f"http://127.0.0.1:{port}/ep/{i}",
+                name=f"ep{i:03d}",
+                metrics_path="/metrics",
+                pprof_path="/debug",
+            )
+            for i in range(24)
+        ],
+        interval_s=5.0,
+        rules=[
+            obsalerts.obs_cardinality_breach(window_s=20.0, for_s=4.0)
+        ],
+        recorder=obsalerts.AlertFlightRecorder(),
+        scrape_workers=8,
+        series_budget_per_endpoint=8,
+    )
+    try:
+        yield collector, handler
+    finally:
+        collector.close()
+        set_active(None)
+        server.shutdown()
+        server.server_close()
+
+
+def test_governance_breach_lifecycle_and_self_telemetry(fleet):
+    collector, handler = fleet
+
+    def state() -> str:
+        return {
+            s["rule"]: s["state"] for s in collector.engine.status()
+        }["ObsCardinalityBreach"]
+
+    # Churn rounds: the breach endpoint presents 3 brand-new series per
+    # scrape against a budget of 8 — refusals start on round 3 and the
+    # alert fires off the collector's own dropped-series rate.
+    for r in range(5):
+        collector.scrape_once(now_mono=1000.0 + 5 * r)
+    assert state() == "firing", state()
+    fired = [
+        e
+        for e in collector.engine.recorder.query(
+            rule="ObsCardinalityBreach"
+        )
+        if e.state == "firing"
+    ]
+    assert "ep000" in fired[0].detail  # the offender is named
+
+    # Stop the churn; once the refusals age out of the window the alert
+    # resolves on its own.
+    handler.churn = False
+    final = "firing"
+    for r in range(5, 12):
+        collector.scrape_once(now_mono=1000.0 + 5 * r)
+        final = state()
+        if final in ("resolved", "ok"):
+            break
+    assert final in ("resolved", "ok"), final
+    transitions = [
+        (e.prev_state, e.state)
+        for e in collector.engine.recorder.query(
+            rule="ObsCardinalityBreach"
+        )
+    ]
+    assert ("ok", "pending") in transitions
+    assert ("pending", "firing") in transitions
+    assert ("firing", "resolved") in transitions
+
+    # Neighbor isolation: every in-budget endpoint kept minting nothing
+    # and rating exactly (100 ticks per 5s round = 20/s).
+    healths = {h["endpoint"]: h for h in collector.endpoint_health()}
+    assert all(
+        h["series_dropped"] == 0
+        for name, h in healths.items()
+        if name != "ep000"
+    )
+    assert healths["ep000"]["series_dropped"] > 0
+    for name in ("ep001", "ep012", "ep023"):
+        rate = collector.rate(
+            "t_scale_ticks_total", window_s=20.0, endpoint=name
+        )
+        assert rate == pytest.approx(20.0), name
+
+    # Obs observes obs: the collector's own registry exposes its cost,
+    # and the governance counter agrees with the health rows.
+    samples = promparse.parse(collector.registry.expose())
+    names = promparse.names(samples)
+    assert "tpu_dra_obs_scrape_round_seconds_count" in names
+    assert "tpu_dra_obs_series" in names
+    assert "tpu_dra_obs_ring_bytes" in names
+    assert "tpu_dra_obs_rule_eval_seconds_count" in names
+    assert promparse.total(
+        samples, "tpu_dra_obs_series_dropped_total"
+    ) == float(healths["ep000"]["series_dropped"])
+    assert promparse.value(
+        samples, "tpu_dra_obs_series", endpoint="ep001"
+    ) == float(healths["ep001"]["series_kept"])
+    stats = collector.round_stats
+    assert stats["series_total"] > 24 and stats["ring_bytes"] > 0
+
+
+def test_top_k_paging_and_cluster_queries(fleet, capsys):
+    from tpu_dra.cmds import explain as cli
+
+    collector, _ = fleet
+    for r in range(3):
+        collector.scrape_once(now_mono=1000.0 + 5 * r)
+    obs_server = collector.serve()
+    base = f"http://127.0.0.1:{obs_server.port}"
+
+    # Worst-K: the breach endpoint's refused series rank it into the cut.
+    assert cli.main(["top", "--endpoint", base, "--top", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "showing 3 worst of 24" in out
+    assert "Σ 24 endpoint(s):" in out
+    assert "ep000" in out
+    # --all keeps the full listing.
+    assert cli.main(["top", "--endpoint", base, "--all"]) == 0
+    out = capsys.readouterr().out
+    assert "showing" not in out
+    assert out.count("ep0") >= 24
+
+    # HTTP paging: totals are fleet-wide on every page; rows page.
+    doc = json.loads(_get(base + "/debug/cluster?limit=10&offset=20"))
+    assert doc["endpoints_total"] == 24
+    assert doc["endpoints_offset"] == 20
+    assert [r["endpoint"] for r in doc["endpoints"]] == [
+        f"ep{i:03d}" for i in range(20, 24)
+    ]
+    text = _get(base + "/debug/cluster?format=text&limit=10&offset=20")
+    assert "endpoints 21-24 of 24" in text
+    for bad in ("offset=-1", "offset=x", "limit=0"):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(base + "/debug/cluster?" + bad)
+        assert err.value.code == 400

@@ -315,8 +315,12 @@ def test_eviction_alert_lifecycle_and_top(tmp_path, capsys):
             [Endpoint(sim_url, name="sim")],
             interval_s=0.05,
             rules=[
+                # The window must tolerate scrape-thread starvation on a
+                # loaded single-core runner: with 1.5s, two scrape points
+                # never straddle the eviction inside one eval window when
+                # rounds stall, and the alert silently never leaves ok.
                 obsalerts.eviction_spike(
-                    rate_threshold=0.05, window_s=1.5, for_s=0.1
+                    rate_threshold=0.05, window_s=6.0, for_s=0.1
                 ),
                 obsalerts.scrape_down(),
             ],

@@ -195,6 +195,9 @@ class TestValidateSlice:
         assert not report.ok
         assert any("gang size is 1" in e for e in report.errors)
 
+    # Tier-1 wall budget: the failure paths above are fast; the full
+    # 8-device burn-in (~13s) runs in CI --runslow.
+    @pytest.mark.slow
     def test_full_burn_in_passes(self):
         report = validate_slice(topology="4x2x1", env={})
         assert report.ok, report.errors

@@ -86,3 +86,50 @@ def test_assert_valid_rejects_out_of_grammar():
         promparse.assert_valid('m{k="unterminated} 1')
     with pytest.raises(promparse.PromParseError):
         promparse.assert_valid("m NaN")  # grammar-legal, registry-illegal
+
+
+def test_drop_partial_tail_trims_torn_final_record():
+    """A scrape cut mid-transfer (dying process, truncated read) ends
+    mid-record; drop_partial_tail degrades to the complete prefix so the
+    torn value never ingests — a torn counter digit string would read as
+    a counter reset one round later."""
+    full = "a_total 100\nb_total 250\n"
+    torn = full + "c_total 99"  # the trailing newline never arrived
+    samples = promparse.parse(torn, drop_partial_tail=True)
+    assert [s.name for s in samples] == ["a_total", "b_total"]
+    # Default behavior is unchanged: a newline-less final line parses
+    # (in-memory expositions are built without a trailing newline all
+    # over the tests and smokes).
+    samples = promparse.parse(torn)
+    assert promparse.value(samples, "c_total") == 99.0
+    # A complete text loses nothing under the flag.
+    assert len(promparse.parse(full, drop_partial_tail=True)) == 2
+
+
+def test_drop_partial_tail_on_torn_metadata_and_families():
+    # Truncation mid-# TYPE line must not mistype the family: the torn
+    # comment is trimmed BEFORE the metadata scan.
+    torn = (
+        "# TYPE a_total counter\n"
+        "a_total 1\n"
+        "# TYPE b_total coun"  # torn inside the TYPE token
+    )
+    families = promparse.parse_families(torn, drop_partial_tail=True)
+    assert families["a_total"].type == "counter"
+    assert "b_total" not in families
+    # Torn label block: the unparseable tail is gone, not an error, even
+    # under strict (the surviving prefix is grammar-clean).
+    torn = 'a_total 1\nb_total{k="va'
+    families = promparse.parse_families(
+        torn, strict=True, drop_partial_tail=True
+    )
+    assert set(families) == {"a_total"}
+
+
+def test_drop_partial_tail_never_raises_lenient():
+    # Pathological truncations: empty, no newline at all, newline-only.
+    assert promparse.parse("", drop_partial_tail=True) == []
+    assert promparse.parse("a_tot", drop_partial_tail=True) == []
+    assert promparse.parse("\n", drop_partial_tail=True) == []
+    samples = promparse.parse("a_total 1\n\x00garbage", drop_partial_tail=True)
+    assert [s.name for s in samples] == ["a_total"]

@@ -291,6 +291,9 @@ class TestSampledEngine:
         done = {r.id: r for r in eng.run()}
         return [tuple(done[i].tokens) for i in ids]
 
+    # Tier-1 wall budget: the sampled-invariance contract also runs
+    # (fast) in test_continuous; CI --runslow keeps this sweep.
+    @pytest.mark.slow
     def test_outputs_scheduling_invariant(self):
         """Same stream, same seeds — identical per-request outputs for
         every slot count, admission order, and tick size."""
@@ -379,6 +382,9 @@ class TestStopSequences:
 
 
 class TestEngineChunkedPrefill:
+    # Tier-1 wall budget: two full engine compiles (~16s).  CI
+    # --runslow keeps it.
+    @pytest.mark.slow
     def test_chunked_admissions_match_one_shot(self):
         """prefill_chunk changes admission memory, never tokens: the
         same stream through chunked and one-shot engines is identical

@@ -40,6 +40,9 @@ class TestExactness:
             )(params, prompt)
             np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
 
+    # Tier-1 wall budget: the k>1 depth sweep above pins the same
+    # token-identity contract; CI --runslow keeps the edge cases.
+    @pytest.mark.slow
     def test_draft_len_one_and_overshoot_steps(self):
         """k=1 degenerates to verify-only; steps not divisible by the
         per-round commit still truncates to exactly `steps` tokens."""

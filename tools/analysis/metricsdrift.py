@@ -16,6 +16,12 @@ so drift is detectable statically:
   unfinished work).
 - **A404** — a ``tpu_dra_*`` name in the doc that no code registers
   (stale doc — the worse direction: operators alert on ghosts).
+- **A405** — a label value at a mutating call site that derives from an
+  unbounded source (request ids, uids, trace/span ids — anything with
+  per-request cardinality).  Labels are a small closed vocabulary;
+  per-request identity belongs in trace spans and request records.  The
+  obs collector's ingest budgets catch this at runtime (series dropped,
+  ``ObsCardinalityBreach``); A405 catches it before it ships.
 
 Doc parsing understands the conventions the doc already uses:
 ``name{label,label}`` label annotations are stripped,
@@ -139,6 +145,79 @@ def check_label_consistency(repo):
                 f"metric {name!r} labeled {shape} here but {first[0]} at "
                 f"{first[1]}:{first[2]} — one series shape per metric",
             )
+
+
+# Identifier leaves that smell like per-request/unbounded identity when
+# used as a label VALUE.  Exact lowercase leaves plus id-ish suffixes —
+# the vocabulary the repo's own request/claim/trace planes use for
+# unbounded identity, not a generic English list.
+_UNBOUNDED_LEAVES = {
+    "rid", "req_id", "request_id", "uid", "uuid", "guid", "request",
+    "trace_id", "span_id", "claim_uid", "pod_uid", "request_uid",
+}
+_UNBOUNDED_SUFFIXES = ("_id", "_uid", "_uuid", "_guid")
+
+
+def _unbounded_source(node) -> "str | None":
+    """The offending identifier when a label-value expression derives
+    from an unbounded source, else None.  Looks through ``str(x)`` and
+    f-strings — stringifying an id does not bound it."""
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        base = dotted(node)
+        if base is None:
+            return None
+        leaf = base.split(".")[-1].lower()
+        if leaf in _UNBOUNDED_LEAVES or leaf.endswith(_UNBOUNDED_SUFFIXES):
+            return base
+        return None
+    if isinstance(node, ast.Call):
+        if (isinstance(node.func, ast.Name) and node.func.id == "str"
+                and node.args):
+            return _unbounded_source(node.args[0])
+        return None
+    if isinstance(node, ast.JoinedStr):
+        for part in node.values:
+            if isinstance(part, ast.FormattedValue):
+                found = _unbounded_source(part.value)
+                if found:
+                    return found
+    return None
+
+
+@rule("A405", "metrics", "metric label value from an unbounded source")
+def check_unbounded_label_values(repo):
+    regs = registrations(repo)
+    leaf_names: "dict[str, set[str]]" = {}
+    for name, _, _, _, var in regs:
+        if var:
+            leaf_names.setdefault(var.split(".")[-1], set()).add(name)
+    var_to_name = {leaf: next(iter(names))
+                   for leaf, names in leaf_names.items() if len(names) == 1}
+    for mod in repo.package_modules():
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in LABELED_CALLS):
+                continue
+            base = dotted(node.func.value)
+            if base is None:
+                continue
+            name = var_to_name.get(base.split(".")[-1])
+            if name is None:
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                source = _unbounded_source(kw.value)
+                if source:
+                    yield Finding(
+                        mod.rel, node.lineno, "A405",
+                        f"metric {name!r} label {kw.arg!r} takes its "
+                        f"value from {source!r} — per-request identity "
+                        "has unbounded cardinality; label values must "
+                        "be a small closed vocabulary (put the id in a "
+                        "trace span or request record instead)",
+                    )
 
 
 # --- doc cross-check --------------------------------------------------------

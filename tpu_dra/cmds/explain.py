@@ -304,7 +304,20 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
     )
     top.add_argument(
         "--limit", type=int, default=256,
-        help="max alert transition events to fetch",
+        help="max alert transition events (and endpoint rows) to fetch",
+    )
+    top.add_argument(
+        "--offset", type=int, default=0,
+        help="endpoint-row page offset (pairs with --limit)",
+    )
+    top.add_argument(
+        "--top", type=int, default=16, metavar="K",
+        help="past K endpoints, show only the K worst (by down/"
+        "staleness/load) plus an aggregate summary row",
+    )
+    top.add_argument(
+        "--all", action="store_true",
+        help="always list every fetched endpoint (disables --top)",
     )
 
     alerts = sub.add_parser(
@@ -611,6 +624,7 @@ def _fetch_cluster(args: argparse.Namespace) -> dict:
         args.endpoint, args.pprof_path, "cluster",
         {
             "limit": args.limit,
+            "offset": getattr(args, "offset", 0),
             "window": args.window,
             "rule": getattr(args, "rule", ""),
         },
@@ -659,8 +673,18 @@ def top(args: argparse.Namespace, out=None) -> int:
                             file=out,
                         )
                     else:
+                        # Past K endpoints the full listing scrolls off
+                        # any terminal: show the K worst plus the
+                        # aggregate summary row; --all keeps everything.
+                        top_k = (
+                            None
+                            if getattr(args, "all", False)
+                            else getattr(args, "top", None)
+                        )
                         print(
-                            obscluster.render_text(doc), end="", file=out
+                            obscluster.render_text(doc, top=top_k),
+                            end="",
+                            file=out,
                         )
             if not args.watch:
                 return 0

@@ -203,10 +203,12 @@ class AlertEngine:
         *,
         recorder: "AlertFlightRecorder | None" = None,
         alerts_total=None,  # Counter with {rule,state} labels, or None
+        eval_seconds=None,  # Histogram with {rule} label, or None
     ):
         self.rules = list(rules)
         self.recorder = recorder if recorder is not None else RECORDER
         self._alerts_total = alerts_total
+        self._eval_seconds = eval_seconds
         self._lock = threading.Lock()
         self._status: "dict[str, AlertStatus]" = {
             r.name: AlertStatus(rule=r.name, severity=r.severity)
@@ -220,12 +222,22 @@ class AlertEngine:
         now = time.monotonic() if now_mono is None else now_mono
         results: "list[tuple[AlertRule, bool, float, str, str]]" = []
         for rule in self.rules:
+            t0 = time.perf_counter()
             try:
                 fired, value, detail = rule.expr(view)
                 results.append((rule, bool(fired), float(value), detail, ""))
             except Exception as e:  # a broken rule reports, not raises
                 results.append(
                     (rule, False, 0.0, "", f"{type(e).__name__}: {e}")
+                )
+            # Per-rule evaluation cost ("obs observes obs"): an
+            # expensive expression — a fetch-heavy per-class rule, a
+            # wide rate() — shows up here before it eats the scrape
+            # interval.  Failures are timed too; a rule erroring slowly
+            # is worse than one erroring fast.
+            if self._eval_seconds is not None:
+                self._eval_seconds.observe(
+                    time.perf_counter() - t0, rule=rule.name
                 )
         events: "list[AlertEvent]" = []
         with self._lock:
@@ -661,6 +673,60 @@ def scrape_down(*, for_s: float = 0.0) -> AlertRule:
     )
 
 
+def obs_cardinality_breach(
+    *, window_s: float = 60.0, for_s: float = 0.0
+) -> AlertRule:
+    """A scrape target is minting series faster than its budget: the
+    collector refused new series this window
+    (``tpu_dra_obs_series_dropped_total`` — the governance counter the
+    collector mirrors into its own SELF_ENDPOINT rings each round).
+    Drops RECUR every round while the endpoint keeps presenting
+    unminted series, so the rate stays positive for as long as the
+    breach lasts and falls back to zero — resolving the alert — once
+    the endpoint's exposition shrinks back under budget (or the
+    endpoint is removed).  Existing series keep updating throughout;
+    this alert is the operator's cue that NEW telemetry from the named
+    endpoint is being discarded."""
+
+    def expr(view):
+        total = view.rate(
+            "tpu_dra_obs_series_dropped_total", window_s=window_s
+        )
+        if total <= 0:
+            return False, 0.0, "no series refused at ingest in window"
+        # Name the offenders from scrape health (cumulative per-endpoint
+        # refusal counts) — worst first, bounded detail.
+        offenders = sorted(
+            (
+                (h.get("series_dropped", 0), h["endpoint"])
+                for h in view.endpoint_health()
+                if h.get("series_dropped", 0) > 0
+            ),
+            reverse=True,
+        )
+        named = ", ".join(
+            f"{ep} ({dropped} refused)" for dropped, ep in offenders[:4]
+        )
+        if len(offenders) > 4:
+            named += f", +{len(offenders) - 4} more"
+        return (
+            True,
+            round(total, 4),
+            f"{total:.2f} series/s refused at ingest: "
+            + (named or "offender not yet in scrape health"),
+        )
+
+    return AlertRule(
+        name="ObsCardinalityBreach",
+        expr=expr,
+        for_s=for_s,
+        severity="warn",
+        description="an endpoint exhausted its series budget; its new "
+        "series are being dropped at ingest (existing series still "
+        "update)",
+    )
+
+
 def default_rules(
     *, window_s: float = 60.0, for_s: float = 0.0
 ) -> "list[AlertRule]":
@@ -675,4 +741,5 @@ def default_rules(
         kv_pool_pressure(window_s=window_s, for_s=for_s),
         kv_swap_thrash(window_s=window_s, for_s=for_s),
         scrape_down(for_s=for_s),
+        obs_cardinality_breach(window_s=window_s, for_s=for_s),
     ]

@@ -188,27 +188,41 @@ def cluster_doc(
     endpoint: "str | None" = None,
     rule: "str | None" = None,
     limit: int = 256,
+    offset: int = 0,
     window_s: float = 60.0,
 ) -> dict:
     """The /debug/cluster JSON document (filters mirror the query
-    parameters; the renderings below consume exactly this shape)."""
+    parameters; the renderings below consume exactly this shape).
+
+    ``limit``/``offset`` page the ENDPOINT rows (sorted by name, so
+    pages are stable across rounds) — a 1024-endpoint doc is fetchable
+    in pages instead of one giant response.  The fleet summary fields
+    (``endpoints_up``/``endpoints_total``) always cover the FULL
+    filtered set, and the expensive per-row derived signals are computed
+    only for the page actually returned.  ``limit`` also caps the alert
+    transition events, as before."""
     health = collector.endpoint_health()
     if endpoint:
         health = [h for h in health if h["endpoint"] == endpoint]
-    rows = [endpoint_row(collector, h, window_s) for h in health]
+    health.sort(key=lambda h: h["endpoint"])
+    up = sum(1 for h in health if h["up"])
+    total = len(health)
+    page = health[offset: offset + limit] if limit else health[offset:]
+    rows = [endpoint_row(collector, h, window_s) for h in page]
     alerts = collector.engine.status()
     if rule:
         alerts = [a for a in alerts if a["rule"] == rule]
     recorder = collector.engine.recorder
     events = recorder.query(rule=rule or None, limit=limit)
-    up = sum(1 for h in rows if h["up"])
     return {
         "collector": collector.name,
         "rounds": collector.rounds,
+        "round_stats": getattr(collector, "round_stats", {}),
         "window_s": window_s,
         "endpoints": rows,
         "endpoints_up": up,
-        "endpoints_total": len(rows),
+        "endpoints_total": total,
+        "endpoints_offset": offset,
         "classes": class_rows(collector),
         "alerts": alerts,
         "firing": [a["rule"] for a in alerts if a["state"] == "firing"],
@@ -228,9 +242,50 @@ def _fmt(value, width: int, precision: int = 1) -> str:
     return str(value).rjust(width)
 
 
-def render_text(doc: dict) -> str:
+def _badness(row: dict) -> float:
+    """How much an endpoint deserves a spot in the worst-K view: down
+    dominates, then staleness, lost goodput, queue, eviction/rejection
+    pressure, and refused series.  Heuristic for triage ordering only —
+    never an alerting signal."""
+    score = 0.0
+    if not row.get("up"):
+        score += 1000.0
+    score += row.get("staleness_s") or 0.0
+    if row.get("goodput") is not None:
+        score += (1.0 - row["goodput"]) * 100.0
+    score += (row.get("queue_depth") or 0.0)
+    score += (row.get("evictions_per_s") or 0.0) * 10.0
+    score += (row.get("rejections_per_s") or 0.0) * 10.0
+    score += float(row.get("series_dropped") or 0)
+    return score
+
+
+def _summary_line(rows: "list[dict]") -> str:
+    """One aggregate row over every endpoint IN THE DOC: the fleet at a
+    glance when the per-endpoint listing is truncated to the worst K."""
+    stale = [r["staleness_s"] for r in rows if r.get("staleness_s") is not None]
+    goodputs = [r["goodput"] for r in rows if r.get("goodput") is not None]
+    parts = [
+        f"spans/s {sum(r.get('spans_per_s') or 0.0 for r in rows):.1f}",
+        f"queue {sum(int(r.get('queue_depth') or 0) for r in rows)}",
+        f"evic/s {sum(r.get('evictions_per_s') or 0.0 for r in rows):.3f}",
+        f"rej/s {sum(r.get('rejections_per_s') or 0.0 for r in rows):.3f}",
+        f"series {sum(int(r.get('series') or 0) for r in rows)}",
+        f"dropped series {sum(int(r.get('series_dropped') or 0) for r in rows)}",
+    ]
+    if goodputs:
+        parts.append(f"goodput {min(goodputs):.3f} worst")
+    if stale:
+        parts.append(f"stale {max(stale):.1f}s worst")
+    return f"Σ {len(rows)} endpoint(s): " + ", ".join(parts)
+
+
+def render_text(doc: dict, *, top: "int | None" = None) -> str:
     """The ``tpudra top`` dashboard: fleet summary line, one row per
-    endpoint, then the firing/pending alerts."""
+    endpoint, then the firing/pending alerts.  ``top`` truncates the
+    per-endpoint table to the K worst rows (``_badness`` order) plus an
+    aggregate summary row — the high-endpoint-count mode; None keeps
+    the full listing."""
     head = (
         f"collector {doc['collector']}: {doc['endpoints_up']}/"
         f"{doc['endpoints_total']} endpoint(s) up, round {doc['rounds']}, "
@@ -241,13 +296,17 @@ def render_text(doc: dict) -> str:
         f", FIRING: {', '.join(firing)}" if firing else ", no alerts firing"
     )
     out = [head]
+    rows = doc["endpoints"]
+    truncated_to_worst = top is not None and len(rows) > top
+    if truncated_to_worst:
+        rows = sorted(rows, key=_badness, reverse=True)[:top]
     out.append(
         f"{'endpoint':<22} {'up':<4} {'stale_s':>7} {'scrape_ms':>9} "
         f"{'series':>6} {'spans/s':>8} {'occ':>5} {'queue':>5} "
         f"{'goodput':>7} {'evic/s':>7} {'rej/s':>7} {'phase':>12} "
         f"{'kvfree':>6} {'swap/s':>6} {'wasted':>6}"
     )
-    for row in doc["endpoints"]:
+    for row in rows:
         if row.get("dominant_phase"):
             phase = (
                 f"{row['dominant_phase']} "
@@ -269,6 +328,22 @@ def render_text(doc: dict) -> str:
         )
     if not doc["endpoints"]:
         out.append("(no endpoints configured)")
+    if truncated_to_worst:
+        out.append(_summary_line(doc["endpoints"]))
+        out.append(
+            f"(showing {top} worst of {len(doc['endpoints'])} "
+            "endpoint(s); --all for the full listing)"
+        )
+    shown = len(doc["endpoints"])
+    total = doc.get("endpoints_total", shown)
+    offset = doc.get("endpoints_offset", 0)
+    if shown < total:
+        # The doc itself is one page of a larger fleet: say which page,
+        # in both text and json the same query parameters apply.
+        out.append(
+            f"(endpoints {offset + 1}-{offset + shown} of {total}; "
+            "page with ?limit=&offset=)"
+        )
     classes = doc.get("classes", [])
     if classes:
         out.append("classes:")

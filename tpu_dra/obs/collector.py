@@ -44,6 +44,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import zlib
 
 from tpu_dra.obs import promparse
 from tpu_dra.obs.alerts import AlertEngine, default_rules
@@ -54,6 +55,20 @@ logger = logging.getLogger(__name__)
 # Ring points per series: at the default 5s interval this is ~40 minutes
 # of history — rate windows, not long-term storage.
 DEFAULT_RING_POINTS = 512
+
+# The downsampled long-horizon tier behind the raw head: points evicted
+# from the raw deque fold into fixed-width coarse buckets, so an
+# hours-long alert window reads bucket aggregates instead of needing an
+# unbounded raw ring.  128 buckets x 60s extends the default ~40 minutes
+# of raw history by ~2 hours of coarse history at a fixed memory cost.
+DEFAULT_COARSE_BUCKETS = 128
+DEFAULT_COARSE_WIDTH_S = 60.0
+
+# The synthetic endpoint name the collector's own telemetry rings live
+# under ("obs observes obs"): written at the end of every round, never
+# scraped over HTTP, queryable through the same rate()/value() protocol
+# the alert rules already speak.
+SELF_ENDPOINT = "obs:self"
 
 
 class Endpoint:
@@ -90,6 +105,17 @@ class EndpointState:
         self.last_text = ""  # last GOOD exposition (post-mortem food)
         self.samples: "list[promparse.Sample]" = []
         self.index: "dict | None" = None  # /debug/index capability doc
+        # Scheduler state: a deterministic phase in [0, 1) spreads this
+        # endpoint across the scrape interval (no thundering round);
+        # degraded endpoints run at a longer effective interval.
+        self.phase = (zlib.crc32(endpoint.name.encode()) % 4096) / 4096.0
+        self.degraded = False
+        self.next_round = 0  # earliest round eligible when degraded
+        self.deferred = 0  # scrapes pushed to the next round by budget
+        # Cardinality governance: rings this endpoint minted vs series
+        # its expositions presented that the budget refused.
+        self.series_kept = 0
+        self.series_dropped = 0
 
     def staleness_s(self, now_mono: "float | None" = None) -> "float | None":
         """Seconds since the last good scrape; None before the first."""
@@ -117,24 +143,102 @@ class EndpointState:
             "staleness_s": None if stale is None else round(stale, 3),
             "error": self.error,
             "series": len(self.samples),
+            "series_kept": self.series_kept,
+            "series_dropped": self.series_dropped,
+            "degraded": self.degraded,
             "component": (self.index or {}).get("component", ""),
         }
 
 
+class CoarseBucket:
+    """One fixed-width downsample bucket: min/max/last/sum/count of the
+    raw points folded into it, plus the counter-reset-tolerant increase
+    accumulated WITHIN the bucket (raw points fold in eviction order, so
+    consecutive folds are consecutive samples and the increase is exact,
+    resets included — something min/max/last alone cannot reconstruct)."""
+
+    __slots__ = (
+        "t_first", "t_last", "first", "last", "vmin", "vmax", "vsum",
+        "count", "increase",
+    )
+
+    def __init__(self, t_mono: float, value: float):
+        self.t_first = self.t_last = t_mono
+        self.first = self.last = value
+        self.vmin = self.vmax = self.vsum = value
+        self.count = 1
+        self.increase = 0.0
+
+    def fold(self, t_mono: float, value: float) -> None:
+        self.increase += (
+            value - self.last if value >= self.last else value
+        )
+        self.t_last = t_mono
+        self.last = value
+        self.vmin = min(self.vmin, value)
+        self.vmax = max(self.vmax, value)
+        self.vsum += value
+        self.count += 1
+
+    def row(self) -> "tuple[float, float, float, float, float]":
+        """The immutable query snapshot: (t_first, t_last, first, last,
+        increase) — what the windowed helpers below consume."""
+        return (self.t_first, self.t_last, self.first, self.last,
+                self.increase)
+
+
 class SeriesRing:
-    """Bounded (t_monotonic, value) points for one series.  Appended by
-    the scrape thread under the collector lock; readers snapshot the
-    points under the same lock and compute with the helpers below."""
+    """Two-tier bounded history for one series: a raw (t_monotonic,
+    value) head deque plus a downsampled coarse tail.  A point evicted
+    from the full raw head folds into the newest coarse bucket (a new
+    bucket opens every ``coarse_width_s``), so the tiers stay contiguous
+    — coarse covers strictly older time than raw, with no gap and no
+    overlap — and total memory is fixed regardless of how long the
+    scrape soak runs.  Appended by the scrape thread under the collector
+    lock; readers snapshot both tiers under the same lock and compute
+    with the helpers below."""
 
-    __slots__ = ("points",)
+    __slots__ = ("points", "coarse", "coarse_width_s")
 
-    def __init__(self, maxlen: int = DEFAULT_RING_POINTS):
+    def __init__(
+        self,
+        maxlen: int = DEFAULT_RING_POINTS,
+        *,
+        coarse_buckets: int = DEFAULT_COARSE_BUCKETS,
+        coarse_width_s: float = DEFAULT_COARSE_WIDTH_S,
+    ):
         self.points: "collections.deque[tuple[float, float]]" = (
             collections.deque(maxlen=maxlen)
         )
+        self.coarse: "collections.deque[CoarseBucket]" = collections.deque(
+            maxlen=max(1, coarse_buckets)
+        )
+        self.coarse_width_s = coarse_width_s
 
     def add(self, t_mono: float, value: float) -> None:
+        if len(self.points) == self.points.maxlen:
+            self._fold(*self.points[0])  # evicted below: downsample it
         self.points.append((t_mono, value))
+
+    def _fold(self, t_mono: float, value: float) -> None:
+        bucket = self.coarse[-1] if self.coarse else None
+        if (
+            bucket is not None
+            and t_mono < bucket.t_first + self.coarse_width_s
+        ):
+            bucket.fold(t_mono, value)
+        else:
+            self.coarse.append(CoarseBucket(t_mono, value))
+
+    def snapshot(self) -> "tuple[list[tuple], list[tuple[float, float]]]":
+        """(coarse rows, raw points) copied under the caller's lock —
+        buckets mutate in place on fold, so readers take value copies."""
+        return [b.row() for b in self.coarse], list(self.points)
+
+    def nbytes(self) -> int:
+        """Estimated retained bytes, for the obs self-telemetry gauge —
+        a sizing signal, not an allocator audit."""
+        return 120 + 64 * len(self.points) + 144 * len(self.coarse)
 
 
 def _window(points, window_s: float, now_mono: float):
@@ -166,6 +270,77 @@ def _delta(points, window_s: float, now_mono: float) -> "float | None":
     return pts[-1][1] - pts[0][1]
 
 
+def _coarse_anchor(rows, cutoff: float):
+    """The in-window anchor the coarse tier contributes: (t_anchor,
+    v_anchor, increase_after_anchor) over buckets whose newest sample is
+    inside the window.  A bucket straddling the cutoff anchors at its
+    LAST sample and contributes none of its internal increase — the
+    conservative read; downsampling cannot recover where inside the
+    bucket the cutoff fell.  Returns None when no bucket reaches the
+    window."""
+    rows = [r for r in rows if r[1] >= cutoff]
+    if not rows:
+        return None
+    t_first, t_last, first, last, inc = rows[0]
+    if t_first >= cutoff:
+        anchor_t, anchor_v, increase = t_first, first, inc
+    else:
+        anchor_t, anchor_v, increase = t_last, last, 0.0
+    prev_last = last
+    for t_first, t_last, first, last, inc in rows[1:]:
+        # Boundary increase between consecutive buckets (reset-aware),
+        # then the bucket's internal increase.
+        increase += first - prev_last if first >= prev_last else first
+        increase += inc
+        prev_last = last
+    return anchor_t, anchor_v, increase, prev_last
+
+
+def _ring_rate(snap, window_s: float, now_mono: float) -> "float | None":
+    """Counter increase/second over the window across BOTH tiers.  When
+    the window fits inside the raw head this is exactly the flat-ring
+    ``_rate``; a longer window walks the coarse tail first — per-bucket
+    internal increases plus reset-aware boundary increases — and the
+    result matches an un-downsampled oracle ring whenever the cutoff
+    falls at or before the coarse data (partial buckets read
+    conservatively)."""
+    rows, points = snap
+    cutoff = now_mono - window_s
+    if not rows or (points and points[0][0] <= cutoff):
+        return _rate(points, window_s, now_mono)
+    anchored = _coarse_anchor(rows, cutoff)
+    if anchored is None:
+        return _rate(points, window_s, now_mono)
+    anchor_t, _, increase, prev_last = anchored
+    for _, cur in points:
+        increase += cur - prev_last if cur >= prev_last else cur
+        prev_last = cur
+    t_newest = points[-1][0] if points else rows[-1][1]
+    span = t_newest - anchor_t
+    if span <= 0:
+        return None
+    return increase / span
+
+
+def _ring_delta(snap, window_s: float, now_mono: float) -> "float | None":
+    """Gauge change over the window across both tiers (signed)."""
+    rows, points = snap
+    cutoff = now_mono - window_s
+    if not rows or (points and points[0][0] <= cutoff):
+        return _delta(points, window_s, now_mono)
+    anchored = _coarse_anchor(rows, cutoff)
+    if anchored is None:
+        return _delta(points, window_s, now_mono)
+    anchor_t, anchor_v, _, _ = anchored
+    if points:
+        t_newest, v_newest = points[-1]
+    else:
+        t_newest, v_newest = rows[-1][1], rows[-1][3]
+    if t_newest <= anchor_t:
+        return None
+    return v_newest - anchor_v
+
+
 # The process-wide active collector, read by MetricsServer's
 # /debug/cluster handler (the trace.EXPORTER / decisions.RECORDER shape:
 # one ambient instance per process, injectable in tests).
@@ -187,25 +362,62 @@ class ObsCollector:
         interval_s: float = 5.0,
         timeout_s: float = 5.0,
         ring_points: int = DEFAULT_RING_POINTS,
+        coarse_buckets: int = DEFAULT_COARSE_BUCKETS,
+        coarse_width_s: float = DEFAULT_COARSE_WIDTH_S,
         rules: "list | None" = None,
         registry: "Registry | None" = None,
         recorder=None,  # alerts.AlertFlightRecorder, defaults to the global
         snapshot_dir: "str | None" = None,
+        snapshot_max_exposition_bytes: int = 256 * 1024,
+        snapshot_max_total_bytes: int = 16 * 1024 * 1024,
         auto_discover_local: bool = False,
+        scrape_workers: int = 8,
+        stagger_slices: int = 8,
+        round_budget_s: "float | None" = None,
+        slow_scrape_s: "float | None" = None,
+        degrade_factor: int = 4,
+        series_budget_per_endpoint: "int | None" = None,
+        series_budget_total: "int | None" = None,
         name: str = "obs",
     ):
         self.name = name
         self.interval_s = interval_s
         self.timeout_s = timeout_s
         self.ring_points = ring_points
+        self.coarse_buckets = coarse_buckets
+        self.coarse_width_s = coarse_width_s
         self.snapshot_dir = snapshot_dir
+        self.snapshot_max_exposition_bytes = snapshot_max_exposition_bytes
+        self.snapshot_max_total_bytes = snapshot_max_total_bytes
         self.auto_discover_local = auto_discover_local
+        self.scrape_workers = max(1, scrape_workers)
+        # Scrape-plane scale knobs: the background loop ticks
+        # ``stagger_slices`` times per interval, each tick scraping the
+        # endpoints whose phase falls in that slice (no thundering
+        # round); a round that exceeds ``round_budget_s`` defers the
+        # rest to the next round (they keep priority); an endpoint whose
+        # scrape runs past ``slow_scrape_s`` degrades to every
+        # ``degrade_factor``-th round — up/staleness semantics
+        # unchanged, its staleness simply grows between visits.
+        self.stagger_slices = max(1, stagger_slices)
+        self.round_budget_s = round_budget_s
+        self.slow_scrape_s = slow_scrape_s
+        self.degrade_factor = max(2, degrade_factor)
+        # Cardinality governance: budgets enforced at ring mint — an
+        # over-budget endpoint keeps UPDATING its existing series but
+        # new series are dropped and counted, so one misbehaving
+        # process cannot grow the collector without bound.
+        self.series_budget_per_endpoint = series_budget_per_endpoint
+        self.series_budget_total = series_budget_total
         self._lock = threading.Lock()
         self._states: "dict[str, EndpointState]" = {}
         # series name -> {(endpoint name, label pairs): SeriesRing} —
         # name-first so a rate()/value() lookup touches only its own
         # series, not every ring of every endpoint.
         self._rings: "dict[str, dict[tuple[str, tuple], SeriesRing]]" = {}
+        self._series_total = 0  # rings minted across all endpoints
+        self._last_round_mono: "float | None" = None
+        self._round_stats: dict = {}
         self._pool = None  # lazy scrape ThreadPoolExecutor (>1 endpoint)
         # fetch_requests memo for the current scrape round: (round,
         # {query key: documents}) — per-class rules and the cluster doc
@@ -244,10 +456,45 @@ class ObsCollector:
             "(pending, firing, resolved; ok = a pending that cleared "
             "before its for-duration elapsed)",
         )
+        # Obs self-telemetry ("obs observes obs"): the collector's own
+        # cost on its own registry, so serve() makes the obs plane
+        # itself scrapeable — and mirrored into rings under
+        # SELF_ENDPOINT each round so alert rules can window over it.
+        self._round_seconds = self.registry.histogram(
+            "tpu_dra_obs_scrape_round_seconds",
+            "Wall time of each full scrape round (every due endpoint "
+            "scraped + rules evaluated)",
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                     5.0, 10.0, 30.0),
+        )
+        self._series_gauge = self.registry.gauge(
+            "tpu_dra_obs_series",
+            "Series rings retained per endpoint (after cardinality "
+            "governance)",
+        )
+        self._ring_bytes_gauge = self.registry.gauge(
+            "tpu_dra_obs_ring_bytes",
+            "Estimated bytes retained by all series rings (raw heads + "
+            "coarse tiers)",
+        )
+        self._series_dropped = self.registry.counter(
+            "tpu_dra_obs_series_dropped_total",
+            "New series refused at ingest per endpoint (the per-endpoint "
+            "or global series budget was exhausted; existing series keep "
+            "updating)",
+        )
+        rule_eval_seconds = self.registry.histogram(
+            "tpu_dra_obs_rule_eval_seconds",
+            "Wall time of each alert rule's expression per evaluation "
+            "round",
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                     0.025, 0.05, 0.1, 0.25, 1.0),
+        )
         self.engine = AlertEngine(
             default_rules() if rules is None else rules,
             recorder=recorder,
             alerts_total=alerts_total,
+            eval_seconds=rule_eval_seconds,
         )
         for ep in endpoints:
             self.add_endpoint(ep)
@@ -272,10 +519,22 @@ class ObsCollector:
             for bucket in self._rings.values():
                 for key in [k for k in bucket if k[0] == name]:
                     del bucket[key]
+                    self._series_total -= 1
+                # The collector's own per-endpoint telemetry about the
+                # removed target goes too (self rings never counted
+                # toward _series_total, so no decrement here).
+                for key in [
+                    k
+                    for k in bucket
+                    if k[0] == SELF_ENDPOINT
+                    and dict(k[1]).get("endpoint") == name
+                ]:
+                    del bucket[key]
             # Retire the endpoint's scrape-health series too — a removed
             # target must not keep exposing a frozen up/staleness forever.
             self._up_gauge.remove(endpoint=name)
             self._staleness_gauge.remove(endpoint=name)
+            self._series_gauge.remove(endpoint=name)
 
     def endpoints(self) -> "list[str]":
         with self._lock:
@@ -334,7 +593,11 @@ class ObsCollector:
         samples: "list[promparse.Sample]" = []
         cumulative: "set[str]" = set()
         if ok:
-            families = promparse.parse_families(text)
+            # drop_partial_tail: a dying process's half-written final
+            # line must not ingest as a torn value (which would read as
+            # a counter reset next round) — degrade to the parsed
+            # prefix.
+            families = promparse.parse_families(text, drop_partial_tail=True)
             for fam in families.values():
                 samples.extend(fam.samples)
                 if fam.type in ("counter", "histogram"):
@@ -349,6 +612,7 @@ class ObsCollector:
             state.last_attempt_mono = now
             state.last_duration_s = duration
             state.scrapes += 1
+            state.deferred = 0  # it got its visit; priority spent
             if ok:
                 prev_ok = state.last_ok_mono
                 state.up = True
@@ -359,12 +623,35 @@ class ObsCollector:
                 state.samples = samples
                 if index is not None:
                     state.index = index
+                dropped = 0
                 for s in samples:
                     bucket = self._rings.setdefault(s.name, {})
                     key = (name, s.labels)
                     ring = bucket.get(key)
                     if ring is None:
-                        ring = bucket[key] = SeriesRing(self.ring_points)
+                        # Cardinality governance happens HERE, at mint:
+                        # an over-budget endpoint keeps updating the
+                        # series it already owns, but a new series is
+                        # refused and counted — ingest stays bounded no
+                        # matter what one process's exposition grows to.
+                        if (
+                            self.series_budget_per_endpoint is not None
+                            and state.series_kept
+                            >= self.series_budget_per_endpoint
+                        ) or (
+                            self.series_budget_total is not None
+                            and self._series_total
+                            >= self.series_budget_total
+                        ):
+                            dropped += 1
+                            continue
+                        ring = bucket[key] = SeriesRing(
+                            self.ring_points,
+                            coarse_buckets=self.coarse_buckets,
+                            coarse_width_s=self.coarse_width_s,
+                        )
+                        state.series_kept += 1
+                        self._series_total += 1
                         # A cumulative series BORN between two scrapes of
                         # a live endpoint is an increase from zero (a
                         # counter's first inc mints its labeled series) —
@@ -373,6 +660,18 @@ class ObsCollector:
                         if prev_ok and s.name in cumulative:
                             ring.add(prev_ok, 0.0)
                     ring.add(now, s.value)
+                if dropped:
+                    state.series_dropped += dropped
+                    self._series_dropped.inc(dropped, endpoint=name)
+                # Slow-scrape degradation: a target that costs more wall
+                # than the threshold moves to a longer effective interval
+                # (every degrade_factor-th round); recovery restores it.
+                # up/staleness semantics are untouched — a degraded
+                # endpoint is simply visited less often.
+                if self.slow_scrape_s is not None:
+                    state.degraded = duration > self.slow_scrape_s
+                    if state.degraded:
+                        state.next_round = self._rounds + self.degrade_factor
             else:
                 state.up = False
                 state.failures += 1
@@ -411,26 +710,125 @@ class ObsCollector:
         against, so the whole evaluation runs on the injected time."""
         if self.auto_discover_local:
             self._discover_local()
-        names = self.endpoints()
+        t0 = time.perf_counter()
+        names, skipped = self._due_endpoints()
+        deferred = self._scrape_batch(names, now_mono, t0)
+        return self._finish_round(now_mono, t0, deferred, skipped)
+
+    def _due_endpoints(self) -> "tuple[list[str], int]":
+        """The endpoints this round should visit, deferred-first (budget
+        victims keep priority) then phase order, minus degraded ones
+        still waiting out their longer effective interval."""
+        with self._lock:
+            round_no = self._rounds
+            due = []
+            skipped = 0
+            for name, state in self._states.items():
+                if state.degraded and round_no < state.next_round:
+                    skipped += 1
+                    continue
+                due.append((-state.deferred, state.phase, name))
+        due.sort()
+        return [n for _, _, n in due], skipped
+
+    def _scrape_batch(
+        self,
+        names: "list[str]",
+        now_mono: "float | None",
+        t0: float,
+    ) -> "list[str]":
+        """Scrape ``names`` (concurrently past one endpoint), stopping
+        submission once the round's wall budget is spent; returns the
+        endpoints the budget pushed to the next round.  scrape_endpoint
+        never raises, so neither does the barrier."""
         if len(names) <= 1:
             for name in names:
                 self.scrape_endpoint(name, now_mono=now_mono)
-        else:
-            if self._pool is None:
-                self._pool = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=8,
-                    thread_name_prefix=f"obs-scrape-{self.name}",
-                )
-            # scrape_endpoint never raises, so the barrier can't either.
+            return []
+        if self._pool is None:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.scrape_workers,
+                thread_name_prefix=f"obs-scrape-{self.name}",
+            )
+        pending = list(names)
+        while pending:
+            if (
+                self.round_budget_s is not None
+                and time.perf_counter() - t0 > self.round_budget_s
+            ):
+                return pending
+            chunk = pending[: self.scrape_workers]
+            pending = pending[self.scrape_workers:]
             list(
                 self._pool.map(
                     lambda n: self.scrape_endpoint(n, now_mono=now_mono),
-                    names,
+                    chunk,
                 )
             )
+        return []
+
+    def _finish_round(
+        self,
+        now_mono: "float | None",
+        t0: float,
+        deferred: "list[str]",
+        skipped_degraded: int,
+    ) -> "list":
+        """Close one scrape round: advance the round clock, refresh the
+        obs self-telemetry (registry gauges AND the SELF_ENDPOINT rings
+        the stock rules window over), evaluate the alert rules, and
+        trigger the post-mortem snapshot on firing."""
+        wall = time.perf_counter() - t0
+        now = time.monotonic() if now_mono is None else now_mono
         with self._lock:
             self._rounds += 1
             self._now_override = now_mono
+            prev_round = self._last_round_mono
+            self._last_round_mono = now
+            for name in deferred:
+                state = self._states.get(name)
+                if state is not None:
+                    state.deferred += 1
+            per_endpoint = {
+                name: (state.series_kept, state.series_dropped)
+                for name, state in self._states.items()
+            }
+            ring_bytes = sum(
+                ring.nbytes()
+                for bucket in self._rings.values()
+                for ring in bucket.values()
+            )
+            self._round_stats = {
+                "round_seconds": round(wall, 6),
+                "endpoints_due": len(per_endpoint) - skipped_degraded,
+                "deferred": len(deferred),
+                "skipped_degraded": skipped_degraded,
+                "series_total": self._series_total,
+                "ring_bytes": ring_bytes,
+            }
+        self._round_seconds.observe(wall)
+        self._ring_bytes_gauge.set(ring_bytes)
+        for name, (kept, _) in per_endpoint.items():
+            self._series_gauge.set(kept, endpoint=name)
+        with self._lock:
+            for name, (kept, dropped) in per_endpoint.items():
+                labels = (("endpoint", name),)
+                ring, _ = self._self_ring("tpu_dra_obs_series", labels)
+                ring.add(now, float(kept))
+                ring, minted = self._self_ring(
+                    "tpu_dra_obs_series_dropped_total", labels
+                )
+                # A cumulative self-series minted mid-run starts from
+                # zero at the previous round, same as a scraped counter
+                # born between scrapes — rate() must see the first drop
+                # burst, not a single unusable point.
+                if minted and prev_round is not None:
+                    ring.add(prev_round, 0.0)
+                ring.add(now, float(dropped))
+            ring, _ = self._self_ring("tpu_dra_obs_ring_bytes", ())
+            ring.add(now, float(ring_bytes))
+            ring, _ = self._self_ring("tpu_dra_obs_scrape_round_seconds", ())
+            ring.add(now, wall)
         events = self.engine.evaluate(self, now_mono=now_mono)
         if self.snapshot_dir and any(e.state == "firing" for e in events):
             try:
@@ -443,10 +841,38 @@ class ObsCollector:
                 logger.exception("post-mortem snapshot failed")
         return events
 
+    def _self_ring(
+        self, name: str, labels: tuple
+    ) -> "tuple[SeriesRing, bool]":
+        """The SELF_ENDPOINT ring for one self-telemetry series (minted
+        on first use, caller holds the lock).  Self rings bypass the
+        cardinality budgets — their count is bounded by construction
+        (two per endpoint plus two globals) and the governance signal
+        itself must never be governed away."""
+        bucket = self._rings.setdefault(name, {})
+        key = (SELF_ENDPOINT, labels)
+        ring = bucket.get(key)
+        if ring is not None:
+            return ring, False
+        ring = bucket[key] = SeriesRing(
+            self.ring_points,
+            coarse_buckets=self.coarse_buckets,
+            coarse_width_s=self.coarse_width_s,
+        )
+        return ring, True
+
     @property
     def rounds(self) -> int:
         with self._lock:
             return self._rounds
+
+    @property
+    def round_stats(self) -> dict:
+        """The last finished round's scheduler/governance summary (wall
+        seconds, deferred + degraded-skip counts, series total, ring
+        bytes) — the cluster doc's obs-cost row."""
+        with self._lock:
+            return dict(self._round_stats)
 
     # -- the alert-rule view protocol ----------------------------------------
 
@@ -459,19 +885,29 @@ class ObsCollector:
             override = self._now_override
         return time.monotonic() if override is None else override
 
-    def _matching_points(
+    def _matching_rings(
         self, name: str, endpoint, labels
-    ) -> "list[list[tuple[float, float]]]":
-        """Snapshot of each matching series' ring points, taken under the
-        lock (the scrape thread appends concurrently; deque iteration
-        during an append raises)."""
+    ) -> "list[tuple[list, list]]":
+        """Two-tier snapshot (coarse rows, raw points) of each matching
+        series' ring, taken under the lock (the scrape thread appends
+        and folds concurrently; deque iteration during an append
+        raises)."""
         with self._lock:
             return [
-                list(ring.points)
+                ring.snapshot()
                 for (ep, pairs), ring in self._rings.get(name, {}).items()
                 if (endpoint is None or ep == endpoint)
                 and all(dict(pairs).get(k) == str(v) for k, v in labels.items())
             ]
+
+    @staticmethod
+    def _latest(snap) -> "float | None":
+        rows, points = snap
+        if points:
+            return points[-1][1]
+        if rows:
+            return rows[-1][3]  # the newest coarse bucket's last sample
+        return None
 
     def rate(
         self,
@@ -482,12 +918,14 @@ class ObsCollector:
         **labels: str,
     ) -> float:
         """Summed counter rate/second across matching series (0.0 when no
-        series has enough points — rules treat missing as quiet)."""
+        series has enough points — rules treat missing as quiet).  A
+        window longer than the raw head transparently extends into the
+        coarse tier."""
         now = self._view_now()
         rates = [
             r
-            for pts in self._matching_points(name, endpoint, labels)
-            if (r := _rate(pts, window_s, now)) is not None
+            for snap in self._matching_rings(name, endpoint, labels)
+            if (r := _ring_rate(snap, window_s, now)) is not None
         ]
         return sum(rates) if rates else 0.0
 
@@ -499,12 +937,13 @@ class ObsCollector:
         endpoint: "str | None" = None,
         **labels: str,
     ) -> float:
-        """Summed gauge change across matching series over the window."""
+        """Summed gauge change across matching series over the window
+        (both tiers, like ``rate``)."""
         now = self._view_now()
         deltas = [
             d
-            for pts in self._matching_points(name, endpoint, labels)
-            if (d := _delta(pts, window_s, now)) is not None
+            for snap in self._matching_rings(name, endpoint, labels)
+            if (d := _ring_delta(snap, window_s, now)) is not None
         ]
         return sum(deltas) if deltas else 0.0
 
@@ -518,9 +957,9 @@ class ObsCollector:
         """Max of the latest points across matching series (None when the
         series does not exist anywhere — distinct from zero)."""
         values = [
-            pts[-1][1]
-            for pts in self._matching_points(name, endpoint, labels)
-            if pts
+            v
+            for snap in self._matching_rings(name, endpoint, labels)
+            if (v := self._latest(snap)) is not None
         ]
         return max(values) if values else None
 
@@ -534,9 +973,9 @@ class ObsCollector:
         """Sum of the latest points across matching series (the scraped
         analog of ``Counter.total()``); None when absent."""
         values = [
-            pts[-1][1]
-            for pts in self._matching_points(name, endpoint, labels)
-            if pts
+            v
+            for snap in self._matching_rings(name, endpoint, labels)
+            if (v := self._latest(snap)) is not None
         ]
         return sum(values) if values else None
 
@@ -711,7 +1150,15 @@ class ObsCollector:
         """Write the whole plane to disk: per-endpoint last exposition,
         series rings, scrape health, alert status + events, and the
         merged trace view.  Returns the snapshot directory.  This is the
-        post-mortem the chaos path triggers when an alert fires."""
+        post-mortem the chaos path triggers when an alert fires.
+
+        Output is BOUNDED: each raw exposition is capped at
+        ``snapshot_max_exposition_bytes`` (with a trailing truncation
+        marker line) and the whole snapshot at
+        ``snapshot_max_total_bytes`` — a firing alert on a 1024-endpoint
+        cluster must not write an unbounded post-mortem to disk.  What
+        was truncated or skipped is recorded under ``truncation`` inside
+        ``cluster.json`` (written last, never dropped)."""
         base = dir_path or self.snapshot_dir
         if not base:
             raise ValueError("no snapshot directory configured")
@@ -721,7 +1168,10 @@ class ObsCollector:
             states = list(self._states.values())
             rings = {
                 f"{ep}|{name}|"
-                + ",".join(f"{k}={v}" for k, v in labels): list(ring.points)
+                + ",".join(f"{k}={v}" for k, v in labels): {
+                    "points": list(ring.points),
+                    "coarse": [b.row() for b in ring.coarse],
+                }
                 for name, bucket in self._rings.items()
                 for (ep, labels), ring in bucket.items()
             }
@@ -729,49 +1179,136 @@ class ObsCollector:
         os.makedirs(path, exist_ok=True)
         health = [s.to_dict() for s in states]
         spans = self.fetch_spans()
+        trunc = {
+            "exposition_truncated": [],
+            "expositions_skipped": 0,
+            "rings_truncated": False,
+            "traces_truncated": False,
+        }
+        budget = self.snapshot_max_total_bytes
+        rings_blob = json.dumps(rings)
+        if len(rings_blob) > budget:
+            # Keep the series inventory (key -> retained point/bucket
+            # counts) when the payloads won't fit — the post-mortem still
+            # answers "what series existed and how big were they".
+            trunc["rings_truncated"] = True
+            rings_blob = json.dumps(
+                {
+                    k: {
+                        "points": len(v["points"]),
+                        "coarse": len(v["coarse"]),
+                        "truncated": True,
+                    }
+                    for k, v in rings.items()
+                }
+            )
+        with open(os.path.join(path, "rings.json"), "w") as f:
+            f.write(rings_blob)
+        budget -= len(rings_blob)
+        traces_blob = json.dumps({"spans": spans})
+        if len(traces_blob) > max(0, budget):
+            trunc["traces_truncated"] = True
+            traces_blob = json.dumps({"spans": [], "truncated": True})
+        with open(os.path.join(path, "traces.json"), "w") as f:
+            f.write(traces_blob)
+        budget -= len(traces_blob)
+        for state in states:
+            if not state.last_text:
+                continue
+            text = state.last_text
+            if len(text) > self.snapshot_max_exposition_bytes:
+                text = (
+                    text[: self.snapshot_max_exposition_bytes]
+                    + "\n# TRUNCATED by snapshot_max_exposition_bytes="
+                    + f"{self.snapshot_max_exposition_bytes}\n"
+                )
+                trunc["exposition_truncated"].append(state.endpoint.name)
+            if len(text) > budget:
+                trunc["expositions_skipped"] += 1
+                continue
+            budget -= len(text)
+            fname = "exposition-" + state.endpoint.name.replace(
+                "/", "_"
+            ).replace(":", "_") + ".txt"
+            with open(os.path.join(path, fname), "w") as f:
+                f.write(text)
         doc = {
             "reason": reason,
             "collector": self.name,
             "ts_unix": time.time(),  # noqa: A201 — snapshot stamp for the operator
             "rounds": self.rounds,
+            "round_stats": self.round_stats,
             "endpoints": health,
             "alerts": self.engine.status(),
             "alert_events": [
                 e.to_dict() for e in self.engine.recorder.query()
             ],
+            "truncation": trunc,
         }
         with open(os.path.join(path, "cluster.json"), "w") as f:
             json.dump(doc, f, indent=2)
-        with open(os.path.join(path, "rings.json"), "w") as f:
-            json.dump(rings, f)
-        with open(os.path.join(path, "traces.json"), "w") as f:
-            json.dump({"spans": spans}, f)
-        for state in states:
-            if not state.last_text:
-                continue
-            fname = "exposition-" + state.endpoint.name.replace(
-                "/", "_"
-            ).replace(":", "_") + ".txt"
-            with open(os.path.join(path, fname), "w") as f:
-                f.write(state.last_text)
         logger.info("post-mortem snapshot %s (%s)", path, reason or "manual")
         return path
 
     # -- lifecycle ------------------------------------------------------------
 
+    def _staggered_round(self, slices: int, tick_s: float) -> None:
+        """One background round spread across ``slices`` phase ticks:
+        each tick scrapes the due endpoints whose deterministic phase
+        falls in that slice (no thundering round), the wall budget can
+        defer a tail slice's endpoints to the next round, and the round
+        finishes (self-telemetry + rule evaluation) after the last
+        slice."""
+        if self.auto_discover_local:
+            self._discover_local()
+        t0 = time.perf_counter()
+        with self._lock:
+            round_no = self._rounds
+            groups: "list[list]" = [[] for _ in range(slices)]
+            skipped = 0
+            for name, state in self._states.items():
+                if state.degraded and round_no < state.next_round:
+                    skipped += 1
+                    continue
+                idx = min(slices - 1, int(state.phase * slices))
+                groups[idx].append((-state.deferred, state.phase, name))
+        deferred: "list[str]" = []
+        for group in groups:
+            if self._stop.is_set():
+                return
+            group.sort()
+            names = [n for _, _, n in group]
+            if (
+                self.round_budget_s is not None
+                and time.perf_counter() - t0 > self.round_budget_s
+            ):
+                deferred.extend(names)
+            else:
+                deferred.extend(self._scrape_batch(names, None, t0))
+            self._stop.wait(tick_s)
+        self._finish_round(None, t0, deferred, skipped)
+
     def start(self) -> None:
-        """Poll in a daemon thread every ``interval_s`` (monotonic)."""
+        """Poll in a daemon thread every ``interval_s`` (monotonic),
+        phase-staggered across ``stagger_slices`` ticks per interval."""
         if self._thread is not None:
             return
         self._stop.clear()
 
         def loop():
+            slices = self.stagger_slices
             while not self._stop.is_set():
                 try:
-                    self.scrape_once()
+                    if slices <= 1:
+                        self.scrape_once()
+                        self._stop.wait(self.interval_s)
+                    else:
+                        self._staggered_round(
+                            slices, self.interval_s / slices
+                        )
                 except Exception:
                     logger.exception("scrape round failed")
-                self._stop.wait(self.interval_s)
+                    self._stop.wait(self.interval_s)
 
         self._thread = threading.Thread(
             target=loop, name=f"obs-collector-{self.name}", daemon=True

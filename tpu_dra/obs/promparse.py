@@ -96,11 +96,26 @@ def _parse_labels(raw: "str | None") -> "tuple[tuple[str, str], ...]":
     return tuple(sorted(pairs))
 
 
-def parse(text: str, strict: bool = False) -> "list[Sample]":
+def parse(
+    text: str,
+    strict: bool = False,
+    *,
+    drop_partial_tail: bool = False,
+) -> "list[Sample]":
     """Parse an exposition into samples.  ``strict`` raises
     ``PromParseError`` on the first malformed line (with its number);
     otherwise malformed lines are skipped — scrapes of a wedged process
-    must degrade to partial data, never to an exception."""
+    must degrade to partial data, never to an exception.
+
+    ``drop_partial_tail`` treats a final line with no newline terminator
+    as half-written and discards it even when it happens to parse: a
+    dying process truncated mid-record can leave ``...total 12`` on the
+    wire for a sample whose full value was ``123``, and ingesting the
+    torn ``12`` would read as a counter reset (rate spike) on the next
+    scrape.  The scraper passes this; document/test consumers parsing
+    complete strings keep the default and the last line counts."""
+    if drop_partial_tail and text and not text.endswith("\n"):
+        text = text[: text.rfind("\n") + 1]  # no newline at all: empty
     out: "list[Sample]" = []
     for lineno, line in enumerate(text.splitlines(), 1):
         if not line.strip():
@@ -124,9 +139,19 @@ def parse(text: str, strict: bool = False) -> "list[Sample]":
     return out
 
 
-def parse_families(text: str, strict: bool = False) -> "dict[str, Family]":
+def parse_families(
+    text: str,
+    strict: bool = False,
+    *,
+    drop_partial_tail: bool = False,
+) -> "dict[str, Family]":
     """Samples grouped under their TYPE/HELP metadata.  Histogram children
-    (``_bucket``/``_sum``/``_count``) group under the declared family."""
+    (``_bucket``/``_sum``/``_count``) group under the declared family.
+    ``drop_partial_tail`` discards an unterminated final line before
+    parsing (see ``parse``) — metadata lines included, a torn ``# TYPE``
+    must not mistype the family."""
+    if drop_partial_tail and text and not text.endswith("\n"):
+        text = text[: text.rfind("\n") + 1]
     families: "dict[str, Family]" = {}
     for line in text.splitlines():
         hm = _HELP_RE.match(line)

@@ -639,14 +639,16 @@ def _query_float(query: dict, name: str, default: float, cap: float) -> float:
     return min(value, cap)
 
 
-def _query_int(query: dict, name: str, default: int, cap: int) -> int:
+def _query_int(
+    query: dict, name: str, default: int, cap: int, minimum: int = 1
+) -> int:
     raw = query.get(name, [str(default)])[0]
     try:
         value = int(raw)
     except ValueError:
         raise _BadQuery(f"{name} must be an integer, got {raw!r}") from None
-    if value <= 0:
-        raise _BadQuery(f"{name} must be positive, got {raw!r}")
+    if value < minimum:
+        raise _BadQuery(f"{name} must be >= {minimum}, got {raw!r}")
     return min(value, cap)
 
 
@@ -1048,6 +1050,11 @@ class MetricsServer:
                 from tpu_dra.obs import collector as obscollector
 
                 limit = _query_int(query, "limit", 256, cap=4096)
+                # offset pages the endpoint rows (0 = first page, so its
+                # floor differs from limit's).
+                offset = _query_int(
+                    query, "offset", 0, cap=1_000_000, minimum=0
+                )
                 window = _query_float(query, "window", 60.0, cap=3600.0)
                 fmt = query.get("format", ["json"])[0]
                 if fmt not in ("json", "text", "alerts"):
@@ -1083,6 +1090,7 @@ class MetricsServer:
                     endpoint=query.get("endpoint", [""])[0] or None,
                     rule=query.get("rule", [""])[0] or None,
                     limit=limit,
+                    offset=offset,
                     window_s=window,
                 )
                 if fmt == "text":
