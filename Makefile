@@ -10,7 +10,8 @@ TAG     ?= latest
         native-test demo-quickstart bench image clean help \
         observability-smoke perf-smoke explain-smoke serve-smoke \
         serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke paged-smoke \
-        kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke
+        kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke \
+        disagg-smoke
 
 # `analyze` runs the full rule registry — the L-style rules lint would
 # run plus the whole-repo invariants — so `all` needs only one pass.
@@ -22,8 +23,10 @@ TAG     ?= latest
 # burn), before `test` pays for the full suite.  `obs-scale-smoke`
 # fails fast on an obs-plane-at-scale regression (cardinality
 # governance, ObsCardinalityBreach lifecycle, obs self-telemetry,
-# worst-K/paged operator surfaces).
-all: analyze kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke test
+# worst-K/paged operator surfaces), and `disagg-smoke` on a
+# disaggregated-serving regression (block-table handoff identity, tier
+# metrics, the /debug/cluster tier column, PrefillBacklogGrowth).
+all: analyze kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke disagg-smoke test
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -125,6 +128,15 @@ kv-smoke:
 # firing -> resolved over injected-clock scrapes.
 swap-smoke:
 	$(PYTHON) -m pytest tests/test_swap_smoke.py -q -m 'not slow'
+
+# Disaggregated-serving floor (docs/SERVING.md "Disaggregated
+# serving"): a two-tier DisaggServer hands a prefilled request off as a
+# block table and finishes it token-identically, the tier topology and
+# handoff counters are visible over HTTP and in the /debug/cluster tier
+# column, and PrefillBacklogGrowth completes pending -> firing ->
+# resolved on a backlogged server.
+disagg-smoke:
+	$(PYTHON) -m pytest tests/test_disagg_smoke.py -q -m 'not slow'
 
 # Request latency attribution floor (docs/OBSERVABILITY.md "Request
 # latency attribution"): a fleet-routed request (affinity, spill, and

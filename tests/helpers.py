@@ -192,29 +192,36 @@ def metric_total(text: str, name: str, **labels) -> float:
     return promparse.total(metric_samples(text), name, **labels)
 
 
-def assert_kv_conserved(engine) -> None:
-    """Block-accounting conservation for a paged ServeEngine, checked
-    from FIRST PRINCIPLES against the engine's own state (never against
-    the allocator's cached counts alone), across BOTH tiers of the KV
-    memory hierarchy.  Device: every block is free, allocated, or
-    scratch (free + allocated + 1 == pool size), and every allocated
-    block's refcount equals its OWNER COUNT — one per live block-table
-    cell pointing at it plus one per resident prefix entry holding it.
-    Host: used + free slots == host capacity, and every used host slot
-    is owned by EXACTLY ONE swapped-out request's swap state (the
-    host-tier refcount — exclusive ownership until swap-in frees the
-    slot), with the swapped flag and the state dict agreeing.  Call
-    between ticks during alias/COW/evict/swap churn; a leak (refcount
-    without an owner) or a use-after-free (owner without a refcount)
-    fails here long before it corrupts tokens."""
-    assert engine.kv_layout == "paged", "conservation is a paged contract"
-    balloc = engine._balloc
-    stats = balloc.stats()
-    assert (
-        stats["blocks_free"] + stats["blocks_allocated"] + 1
-        == stats["blocks_total"]
-    ), stats
-    # Host tier: capacity partition + exclusive slot ownership.
+def _merge_engine_block_owners(engine, owners: "dict[int, int]") -> None:
+    """Count one engine's device-block owners into ``owners``: live
+    block-table cells, resident prefix entries, and handoff-parked
+    ALIAS payloads (their references moved with the payload at
+    `handoff_out` and are adopted by a table row at restore — between
+    the two, the parked payload IS the owner).  Also asserts every
+    freed row is fully zeroed onto scratch — a stale block id there is
+    exactly the frozen-write corruption the zeroing discipline
+    prevents."""
+    for row, req in enumerate(engine._row_req):
+        if req is None:
+            assert not engine._table[row].any(), (row, engine._table[row])
+            continue
+        for b in engine._table[row]:
+            if b:
+                owners[int(b)] = owners.get(int(b), 0) + 1
+    if engine._prefix is not None:
+        for entry in engine._prefix.export_blocks():
+            for b in entry["blocks"]:
+                owners[b] = owners.get(b, 0) + 1
+    for state in engine._handoff_state.values():
+        if state["mode"] == "alias":
+            for b in state["blocks"]:
+                owners[b] = owners.get(b, 0) + 1
+
+
+def _assert_host_tier_conserved(engine) -> None:
+    """Host swap tier: capacity partition + exclusive slot ownership +
+    the parked-request bookkeeping (every swap/handoff state entry is a
+    queued request and vice versa)."""
     host = engine._host_pool
     assert host.used_count + host.free_count == host.capacity, host.stats()
     slot_owners: "dict[int, int]" = {}
@@ -230,32 +237,90 @@ def assert_kv_conserved(engine) -> None:
         sorted(slot_owners), host.used_slots(),
     )
     assert all(n == 1 for n in slot_owners.values()), slot_owners
+    for rid in engine._handoff_state:
+        req = engine._by_id[rid]
+        assert any(q is req for q in engine._queue), (
+            f"handoff-parked request {rid} not queued"
+        )
     for req in engine._queue:
         if req.swapped:
             assert req.id in engine._swap_state, (
                 f"swapped request {req.id} has no swap state"
             )
-    owners = {0: 1}  # scratch: the allocator's own immortal reference
-    for row, req in enumerate(engine._row_req):
-        if req is None:
-            # A freed row must be fully zeroed onto scratch — a stale
-            # block id here is exactly the frozen-write corruption the
-            # zeroing discipline exists to prevent.
-            assert not engine._table[row].any(), (row, engine._table[row])
-            continue
-        for b in engine._table[row]:
-            if b:
-                owners[int(b)] = owners.get(int(b), 0) + 1
-    if engine._prefix is not None:
-        for entry in engine._prefix.export_blocks():
-            for b in entry["blocks"]:
-                owners[b] = owners.get(b, 0) + 1
+
+
+def _assert_refcounts(balloc, owners: "dict[int, int]", context: str) -> None:
+    stats = balloc.stats()
+    assert (
+        stats["blocks_free"] + stats["blocks_allocated"] + 1
+        == stats["blocks_total"]
+    ), stats
     for b in range(stats["blocks_total"]):
         assert balloc.refcount(b) == owners.get(b, 0), (
             f"block {b}: refcount {balloc.refcount(b)} != "
-            f"{owners.get(b, 0)} owner(s) "
-            f"(owners counted from tables + prefix entries + scratch)"
+            f"{owners.get(b, 0)} owner(s) (owners counted from {context})"
         )
+
+
+def assert_kv_conserved(engine) -> None:
+    """Block-accounting conservation for a paged ServeEngine — or a
+    whole `DisaggServer` — checked from FIRST PRINCIPLES against the
+    live state (never against the allocator's cached counts alone),
+    across every tier of the KV hierarchy AND the disaggregated handoff
+    boundary.  Device: every block is free, allocated, or scratch
+    (free + allocated + 1 == pool size), and every allocated block's
+    refcount equals its OWNER COUNT — one per live block-table cell
+    pointing at it, one per resident prefix entry holding it, one per
+    handoff-parked alias payload carrying it.  Host: used + free slots
+    == capacity, and every used slot is owned by EXACTLY ONE parked
+    request (swap state, or — for the disagg dma staging pool — one
+    in-flight handoff payload).  For a DisaggServer this means every
+    block is owned by exactly one tier's accounting at every instant of
+    the handoff: no double-count while the payload is parked, no orphan
+    after restore.  Call between ticks during churn; a leak (refcount
+    without an owner) or a use-after-free (owner without a refcount)
+    fails here long before it corrupts tokens."""
+    if hasattr(engine, "tiers"):  # a DisaggServer: cross-tier accounting
+        server = engine
+        prefill = server.tiers["prefill"]
+        decode = server.tiers["decode"]
+        if server.handoff == "alias":
+            assert prefill._balloc is decode._balloc, (
+                "alias handoff requires ONE shared allocator"
+            )
+            owners = {0: 1}  # scratch: the allocator's own reference
+            _merge_engine_block_owners(prefill, owners)
+            _merge_engine_block_owners(decode, owners)
+            _assert_refcounts(
+                prefill._balloc, owners,
+                "both tiers' tables + prefix entries + parked handoff "
+                "payloads + scratch",
+            )
+            for eng in (prefill, decode):
+                _assert_host_tier_conserved(eng)
+        else:
+            for eng in (prefill, decode):
+                assert_kv_conserved(eng)
+            # The dma staging pool: every used slot owned by exactly
+            # one parked handoff payload (exclusive, like host slots).
+            staging = server.staging
+            slot_owners: "dict[int, int]" = {}
+            for state in decode._handoff_state.values():
+                if state["mode"] == "dma":
+                    for slot in state["slots"]:
+                        slot_owners[slot] = slot_owners.get(slot, 0) + 1
+            assert sorted(slot_owners) == staging.used_slots(), (
+                sorted(slot_owners), staging.used_slots(),
+            )
+            assert all(n == 1 for n in slot_owners.values()), slot_owners
+        return
+    assert engine.kv_layout == "paged", "conservation is a paged contract"
+    _assert_host_tier_conserved(engine)
+    owners = {0: 1}  # scratch: the allocator's own immortal reference
+    _merge_engine_block_owners(engine, owners)
+    _assert_refcounts(
+        engine._balloc, owners, "tables + prefix entries + scratch"
+    )
 
 
 def assert_metrics_exposed(text: str, names) -> None:

@@ -50,6 +50,14 @@ def test_bench_emits_one_json_line(monkeypatch):
         "bench_serve_fleet",
         lambda: {"ok": True, "scaling": {"x2": 2.0}, "stubbed": True},
     )
+    # And the disagg child (a monolithic engine plus two two-tier
+    # servers); its own coverage is test_bench_serve_disagg_stanza.
+    monkeypatch.setattr(
+        bench,
+        "bench_serve_disagg",
+        lambda: {"ok": True, "tpot_isolation": {"ratio": 1.5},
+                 "stubbed": True},
+    )
     # And the 1024-endpoint obs-scale stanza; its own coverage is
     # test_bench_obs_scale_small (and the full size runs in `make bench`).
     monkeypatch.setattr(
@@ -73,7 +81,8 @@ def test_bench_emits_one_json_line(monkeypatch):
     extras = parsed["extras"]
     assert {
         "rung", "target_s", "fleet", "wire", "northstar_mesh",
-        "serve_prefix", "serve_fleet", "chaos", "obs_scale", "compute",
+        "serve_prefix", "serve_fleet", "serve_disagg", "chaos",
+        "obs_scale", "compute",
     } <= extras.keys()
     assert extras["fleet"]["target_met"]
     assert extras["wire"]["target_met"]
@@ -214,6 +223,39 @@ def test_bench_serve_fleet_stanza():
         < fleets["n2"]["hit_rate"]
         < fleets["n4"]["hit_rate"]
     )
+
+
+@pytest.mark.slow
+def test_bench_serve_disagg_stanza():
+    """The disaggregated-serving stanza (ISSUE 17): decode-tier chat
+    TPOT p95 must beat the monolithic engine's under the long-prompt
+    burst (the paired-round floor estimator), per-class goodput must
+    not regress, the alias handoff must adopt blocks by reference
+    (alias counter > 0, zero copied blocks), and greedy outputs must be
+    token-identical monolithic vs disagg across BOTH handoff paths
+    (asserted inside the child; re-pinned here)."""
+    import bench
+
+    out = bench.bench_serve_disagg()
+    assert out.get("ok"), out
+    assert out["greedy_identical"]
+    iso = out["tpot_isolation"]
+    assert iso["ratio"] > 1.0
+    assert (
+        iso["decode_tier_chat_tpot_p95_s"] < iso["mono_chat_tpot_p95_s"]
+    )
+    assert out["alias"]["alias_blocks"] > 0
+    assert out["alias"]["copied_blocks"] == 0
+    assert out["goodput"]["disagg"]["chat"] >= out["goodput"]["mono"]["chat"]
+    ho = out["handoff"]
+    assert ho["prefill"]["handoff_out_blocks"] > 0
+    assert (
+        ho["decode"]["handoff_in_blocks"]
+        == ho["prefill"]["handoff_out_blocks"]
+    )
+    assert ho["decode"]["handoffs_dma"] > 0
+    # Calibration rode the report: the SLO is derived on-box.
+    assert out["calibration"]["tpot_slo_s"] > 0
 
 
 @pytest.mark.slow

@@ -1,0 +1,311 @@
+"""Disaggregated prefill/decode serving (tpu_dra/parallel/disagg.py):
+tier wiring contracts, block-table handoff on both paths (in-process
+alias, cross-pool DMA stream), greedy token identity vs the padded
+oracle under churn with conservation asserted between EVERY tick, the
+one-trace span chain, the waterfall's handoff phase, backpressure
+deferral, and router tier awareness."""
+
+import pytest
+
+from tpu_dra.fleet.router import PrefixRouter, ReplicaView
+from tpu_dra.obs.requests import reduce_request
+from tpu_dra.parallel.burnin import BurninConfig, init_params
+from tpu_dra.parallel.disagg import DisaggServer
+from tpu_dra.parallel.serve import ServeEngine
+from tpu_dra.utils import trace
+
+from helpers import assert_kv_conserved
+from test_serve import isolated
+
+CFG = BurninConfig(
+    vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2, seq=32, batch=4
+)
+
+# Mixed long-prompt / short-chat stream (prompt, max_new, priority) —
+# the interference shape disaggregation exists for.
+STREAM = [
+    ([5, 9, 2, 7, 11, 3], 5, 0),
+    ([1, 2, 3], 5, 5),
+    ([4, 4, 4, 4, 8, 1, 6, 2], 3, 0),
+    ([7, 8], 4, 5),
+    ([3, 1, 4, 1, 5, 9], 4, 0),
+    ([2, 6], 3, 5),
+]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG)
+
+
+def _specs(decode_slots=4, decode_kv=None):
+    prefill = dict(slots=2, prompt_slots=8, max_new_cap=5, prefix_window=2)
+    decode = dict(
+        slots=decode_slots, prompt_slots=8, max_new_cap=5, prefix_window=2
+    )
+    if decode_kv is not None:
+        decode["kv_blocks"] = decode_kv
+    return prefill, decode
+
+
+class TestHandoffChurn:
+    @pytest.mark.parametrize("mode", ["alias", "dma"])
+    def test_greedy_identity_under_churn(self, params, mode):
+        """The acceptance gate: greedy tokens IDENTICAL to the padded
+        oracle for the whole mixed stream on BOTH handoff paths, with
+        block conservation asserted across the handoff boundary between
+        every tick — every block owned by exactly one tier's accounting
+        while payloads are parked, in flight, and restored."""
+        prefill, decode = _specs()
+        srv = DisaggServer(
+            params, CFG, prefill=prefill, decode=decode,
+            handoff=mode, name=f"churn-{mode}",
+        )
+        try:
+            dids = [
+                srv.submit(p, m, priority=pr) for p, m, pr in STREAM
+            ]
+            for _ in range(500):
+                if not srv.pending:
+                    break
+                srv.tick()
+                assert_kv_conserved(srv)
+            assert not srv.pending, "server did not drain"
+            for did, (p, m, _) in zip(dids, STREAM):
+                req = srv.result(did)
+                assert req.done, did
+                assert req.tokens == list(isolated(params, CFG, p, m)), (
+                    mode, did
+                )
+                assert req.handoffs == 1 and req.handoff_mode == mode
+                assert req.handoff_blocks > 0 and req.handoff_s >= 0.0
+            stats = srv.disagg_stats()
+            assert stats["prefill"]["handoff_out_requests"] == len(STREAM)
+            assert stats["decode"]["handoff_in_requests"] == len(STREAM)
+            assert stats["decode"][f"handoffs_{mode}"] == len(STREAM)
+        finally:
+            srv.close()
+
+    def test_alias_handoff_is_zero_copy(self, params):
+        """In-process handoff moves REFERENCES: the decode tier's alias
+        counter grows by exactly the handed-off blocks and its
+        fresh-allocation counter stays untouched (zero device copies —
+        the PR 10 aliasing discipline)."""
+        prefill, decode = _specs()
+        srv = DisaggServer(
+            params, CFG, prefill=prefill, decode=decode,
+            handoff="alias", name="zero-copy",
+        )
+        try:
+            did = srv.submit([5, 9, 2, 7], 4)
+            srv.run()
+            req = srv.result(did)
+            assert req.done and req.handoff_blocks > 0
+            eng = srv.tiers["decode"]
+            assert (
+                eng._kv_counts["alias_blocks"] == req.handoff_blocks
+            )
+            assert eng._kv_counts["alloc_blocks"] == 0
+            # And the dma control: the same request through the block
+            # stream allocates fresh decode-pool blocks instead.
+        finally:
+            srv.close()
+        srv2 = DisaggServer(
+            params, CFG, prefill=_specs()[0], decode=_specs()[1],
+            handoff="dma", name="dma-copy",
+        )
+        try:
+            did = srv2.submit([5, 9, 2, 7], 4)
+            srv2.run()
+            req2 = srv2.result(did)
+            eng2 = srv2.tiers["decode"]
+            assert eng2._kv_counts["alloc_blocks"] == req2.handoff_blocks
+            assert eng2._kv_counts["alias_blocks"] == 0
+            assert req2.tokens == req.tokens  # both paths, same tokens
+        finally:
+            srv2.close()
+
+    def test_backpressure_defers_handoffs(self, params):
+        """A saturated decode tier defers handoffs (prefill rows stay
+        occupied — the backlog-growth story PrefillBacklogGrowth
+        watches), and every deferred request still finishes
+        token-identically once capacity frees."""
+        prefill, decode = _specs(decode_slots=1, decode_kv=24)
+        srv = DisaggServer(
+            params, CFG, prefill=prefill, decode=decode,
+            handoff="alias", decode_queue_cap=1, name="backpressure",
+        )
+        try:
+            dids = [srv.submit(p, m) for p, m, _ in STREAM]
+            for _ in range(500):
+                if not srv.pending:
+                    break
+                srv.tick()
+                assert_kv_conserved(srv)
+            assert not srv.pending
+            assert srv.disagg_stats()["deferred_handoffs"] > 0
+            for did, (p, m, _) in zip(dids, STREAM):
+                assert srv.result(did).tokens == list(
+                    isolated(params, CFG, p, m)
+                )
+        finally:
+            srv.close()
+
+
+class TestOneTrace:
+    def test_span_chain_and_waterfall(self, params):
+        """A handed-off request stays ONE trace — fleet.route root,
+        prefill-tier serve.queue/serve.admit + prefill.run, the
+        handoff.<mode> span, decode-tier serve.decode + serve.request —
+        and its waterfall grows a handoff phase while closure stays
+        >= 0.95 (the phases still tile submit->finish)."""
+        prefill, decode = _specs()
+        srv = DisaggServer(
+            params, CFG, prefill=prefill, decode=decode,
+            handoff="dma", name="one-trace",
+        )
+        try:
+            did = srv.submit([5, 9, 2, 7, 11, 3], 5)
+            srv.run()
+            req = srv.result(did)
+            assert req.done
+            spans = trace.EXPORTER.spans(trace_id=req.trace_id)
+            names = [s["name"] for s in spans]
+            for expected in (
+                "fleet.route", "serve.queue", "serve.admit",
+                "prefill.run", "handoff.dma", "serve.decode",
+                "serve.request",
+            ):
+                assert expected in names, (expected, names)
+            handoff_span = next(
+                s for s in spans if s["name"] == "handoff.dma"
+            )
+            assert handoff_span["attributes"]["blocks"] == (
+                req.handoff_blocks
+            )
+            # Handoff timestamps ride the monotonic clock mapped to the
+            # epoch anchor: the span chain is ordered.
+            t_prefill = next(
+                s for s in spans if s["name"] == "prefill.run"
+            )["start_unix_s"]
+            assert t_prefill <= handoff_span["start_unix_s"]
+            rec = reduce_request(req)
+            assert rec.phase_s["handoff"] > 0.0
+            assert rec.closure >= 0.95, rec.phase_s
+        finally:
+            srv.close()
+
+
+class TestContracts:
+    def test_engine_tier_validation(self, params):
+        with pytest.raises(ValueError, match="tier must be"):
+            ServeEngine(
+                params, CFG, slots=1, prompt_slots=8, max_new_cap=4,
+                tier="middle",
+            )
+        with pytest.raises(ValueError, match="require kv_layout='paged'"):
+            ServeEngine(
+                params, CFG, slots=1, prompt_slots=8, max_new_cap=4,
+                kv_layout="rows", tier="prefill",
+            )
+
+    def test_handoff_engine_contract(self, params):
+        eng = ServeEngine(
+            params, CFG, slots=1, prompt_slots=8, max_new_cap=4,
+            prefix_window=2, name="ho-contract",
+        )
+        try:
+            with pytest.raises(ValueError, match="mode must be"):
+                eng.handoff_out(0, mode="teleport")
+            with pytest.raises(ValueError, match="requires a staging"):
+                eng.handoff_out(0, mode="dma")
+            with pytest.raises(ValueError, match="no in-flight request"):
+                eng.handoff_out(0, mode="alias")
+        finally:
+            eng.close()
+        rows = ServeEngine(
+            params, CFG, slots=1, prompt_slots=8, max_new_cap=4,
+            kv_layout="rows", name="ho-rows",
+        )
+        try:
+            with pytest.raises(RuntimeError, match="kv_layout='paged'"):
+                rows.handoff_out(0, mode="alias")
+            with pytest.raises(RuntimeError, match="kv_layout='paged'"):
+                rows.handoff_in({})
+        finally:
+            rows.close()
+
+    def test_server_spec_validation(self, params):
+        prefill, decode = _specs()
+        with pytest.raises(ValueError, match="handoff must be"):
+            DisaggServer(
+                params, CFG, prefill=prefill, decode=decode,
+                handoff="teleport",
+            )
+        with pytest.raises(ValueError, match="must not set"):
+            DisaggServer(
+                params, CFG, prefill=dict(prefill, tier="mono"),
+                decode=decode,
+            )
+        with pytest.raises(ValueError, match="ONE device pool"):
+            DisaggServer(
+                params, CFG, prefill=dict(prefill, kv_blocks=64),
+                decode=decode, handoff="alias",
+            )
+        with pytest.raises(ValueError, match="staging_blocks only"):
+            DisaggServer(
+                params, CFG, prefill=prefill, decode=decode,
+                handoff="alias", staging_blocks=8,
+            )
+        with pytest.raises(ValueError, match="share one block size"):
+            DisaggServer(
+                params, CFG, prefill=prefill,
+                decode=dict(decode, prefix_window=4),
+            )
+        with pytest.raises(ValueError, match="share one pool format"):
+            DisaggServer(
+                params, CFG, prefill=prefill,
+                decode=dict(decode, kv_int8=True),
+            )
+
+    def test_doomed_request_fails_at_submit(self, params):
+        """The submit-time failure discipline: a request whose block
+        table could never fit a decode-tier row (or the dma staging
+        pool) raises at the front door, not after spinning run()."""
+        prefill, _ = _specs()
+        small = dict(
+            slots=2, prompt_slots=2, max_new_cap=2, prefix_window=2,
+            kv_blocks=8,  # past the shared-pool floor; rows stay tiny
+        )
+        srv = DisaggServer(
+            params, CFG, prefill=prefill, decode=small, name="small-dec"
+        )
+        try:
+            with pytest.raises(ValueError, match="decode-tier row"):
+                srv.submit([5, 9, 2, 7, 11, 3], 5)
+        finally:
+            srv.close()
+        prefill2, decode2 = _specs()
+        with pytest.raises(ValueError, match="staging_blocks must be"):
+            DisaggServer(
+                params, CFG, prefill=prefill2, decode=decode2,
+                handoff="dma", staging_blocks=2,
+            )
+
+
+class TestRouterTierAwareness:
+    def test_decode_tier_views_never_admit(self):
+        router = PrefixRouter(policy="affinity")
+        views = [
+            ReplicaView(name="d0", tier="decode", queue_depth=0, slots=4),
+            ReplicaView(name="m0", tier="mono", queue_depth=3, slots=4),
+        ]
+        placement = router.route([1, 2, 3], views)
+        assert placement.replica == "m0"  # idle decode tier still skipped
+
+    def test_all_decode_fleet_is_a_config_error(self):
+        router = PrefixRouter()
+        with pytest.raises(ValueError, match="decode-tier handoff"):
+            router.route(
+                [1, 2], [ReplicaView(name="d0", tier="decode")]
+            )

@@ -403,6 +403,7 @@ class TestDefaultRules:
         assert names == [
             "ServeGoodputBurnRate",
             "FleetQueueGrowth",
+            "PrefillBacklogGrowth",
             "ClaimEvictionSpike",
             "FleetDigestStale",
             "KVPoolPressure",

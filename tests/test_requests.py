@@ -27,8 +27,8 @@ from helpers import metric_total
 def finished_request(
     rid=0, *, priority=0, enqueued=100.0, admitted=100.5,
     first_token=100.7, finished=101.7, swapped_s=0.0, swap_dma_s=0.0,
-    preemptions=0, tokens=(1, 2, 3), slo=None, engine="unit-eng",
-    trace_id="t" * 32,
+    handoff_s=0.0, preemptions=0, tokens=(1, 2, 3), slo=None,
+    engine="unit-eng", trace_id="t" * 32,
 ):
     """A hand-built finished Request with a complete monotone timeline —
     the reduction is duck-typed host-side data, no engine needed."""
@@ -46,6 +46,7 @@ def finished_request(
     req.tpot_s = 0.01 if len(tokens) > 1 else 0.0
     req.swapped_s = swapped_s
     req.swap_dma_s = swap_dma_s
+    req.handoff_s = handoff_s
     req.preemptions = preemptions
     req.slo = dict(slo or {})
     return req
@@ -213,8 +214,8 @@ class TestRecorderAndDoc:
         obsreq.observe_finished(
             finished_request(
                 rid=11, priority=2, engine="render-eng",
-                swapped_s=0.3, swap_dma_s=0.05, preemptions=1,
-                trace_id="d" * 32,
+                swapped_s=0.3, swap_dma_s=0.05, handoff_s=0.1,
+                preemptions=1, trace_id="d" * 32,
             )
         )
         doc = obsreq.requests_doc(engine="render-eng")
@@ -234,6 +235,7 @@ class TestRecorderAndDoc:
             obsreq.requests_doc(trace_id="e" * 32)
         )
         assert "preempted-host" not in wf_clean
+        assert "handoff" not in wf_clean  # never handed off: hidden too
         # Unknown trace: an explanation, not a stack trace.
         assert "no finished request matches" in obsreq.render_waterfall(
             obsreq.requests_doc(trace_id="f" * 32)

@@ -214,6 +214,8 @@ def test_clis_render(rig):
     assert explain.waterfall_cmd(args, out=buf) == 0
     out = buf.getvalue()
     for phase in obsreq.PHASES:
+        if phase == "handoff":
+            continue  # mono engine, never handed off: the zero phase hides
         assert phase in out, phase
     assert "preemption(s)" in out
     # An unknown trace id explains itself, rc still 0 (not an error).

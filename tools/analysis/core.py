@@ -143,6 +143,11 @@ class Config:
         # Block birth/age records feed the /debug/kv age histograms: a
         # wall-clock read here would let an NTP step fake block ages.
         "tpu_dra/parallel/paged.py",
+        # Handoff timestamps (enqueue -> placement -> park -> restore)
+        # feed the handoff.{alias,dma} spans and the waterfall's handoff
+        # phase: a wall-clock read here would break span monotonicity
+        # across the tier boundary.
+        "tpu_dra/parallel/disagg.py",
     )
     # Where the metric registry lives and which doc must list every metric.
     metric_prefix: str = "tpu_dra_"

@@ -339,6 +339,7 @@ class ServeFleet:
                 occupancy=eng.occupancy,
                 slots=eng.slots,
                 goodput=self._rolling_goodput(name),
+                tier=getattr(eng, "tier", "mono"),
             )
             for name, eng in self._engines.items()
         ]

@@ -71,6 +71,10 @@ class ReplicaView:
     occupancy: int = 0
     slots: int = 1
     goodput: "float | None" = None  # rolling, None = no SLO signal
+    # Disaggregation tier (docs/SERVING.md "Disaggregated serving"):
+    # decode-tier replicas are handoff TARGETS, never admission targets
+    # — the router skips them; "mono" and "prefill" replicas admit.
+    tier: str = "mono"
 
 
 @dataclass
@@ -140,6 +144,13 @@ class PrefixRouter:
         empty fleet (zero replicas is a config error, not a queue)."""
         if not views:
             raise ValueError("cannot route: no replicas")
+        views = [v for v in views if v.tier != "decode"]
+        if not views:
+            raise ValueError(
+                "cannot route: every replica is a decode-tier handoff "
+                "target (a disaggregated fleet needs prefill or mono "
+                "replicas at the front door)"
+            )
         loads = {v.name: round(self.load_of(v), 4) for v in views}
         if self.policy == "random":
             pick = self._rng.choice(views)

@@ -385,6 +385,40 @@ def fleet_queue_growth(
     )
 
 
+def prefill_backlog_growth(
+    *,
+    growth_threshold: float = 4.0,
+    window_s: float = 60.0,
+    for_s: float = 0.0,
+) -> AlertRule:
+    """Disaggregated prefill backlog growing across the window
+    (``tpu_dra_disagg_prefill_queue_depth``, parallel/disagg.py): the
+    decode tier is saturated — handoffs defer, prefill rows stay
+    occupied, admission waves stall — or prompt arrivals outrun the
+    prefill tier's wave budget.  Either way requests are stacking up in
+    front of prefill while demand still rises (docs/SERVING.md
+    "Disaggregated serving")."""
+
+    def expr(view):
+        growth = view.delta(
+            "tpu_dra_disagg_prefill_queue_depth", window_s=window_s
+        )
+        return (
+            growth > growth_threshold,
+            round(growth, 3),
+            f"prefill backlog grew {growth:+.1f} over {window_s:.0f}s",
+        )
+
+    return AlertRule(
+        name="PrefillBacklogGrowth",
+        expr=expr,
+        for_s=for_s,
+        severity="warn",
+        description=f"disaggregated prefill-tier backlog grew > "
+        f"{growth_threshold} in the window",
+    )
+
+
 def eviction_spike(
     *,
     rate_threshold: float = 0.1,
@@ -736,6 +770,7 @@ def default_rules(
     return [
         goodput_burn_rate(window_s=window_s, for_s=for_s),
         fleet_queue_growth(window_s=window_s, for_s=for_s),
+        prefill_backlog_growth(window_s=window_s, for_s=for_s),
         eviction_spike(window_s=window_s, for_s=for_s),
         digest_staleness(stale_after_s=max(window_s * 5, 1.0), for_s=for_s),
         kv_pool_pressure(window_s=window_s, for_s=for_s),

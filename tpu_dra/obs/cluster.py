@@ -93,9 +93,24 @@ def endpoint_row(collector, health: dict, window_s: float) -> dict:
             ),
             3,
         )
+    # Disaggregation tier (docs/SERVING.md "Disaggregated serving"):
+    # which tier roles this endpoint's engines serve, from the value-1
+    # tier gauge.  None when the endpoint exposes no tier series at all
+    # (absent is not zero — a pre-tier endpoint, not a "mono" one);
+    # a disagg server's endpoint reports both roles ("prefill+decode").
+    tiers = [
+        t
+        for t in ("prefill", "decode", "mono")
+        if collector.value(
+            "tpu_dra_serve_tier_engines", endpoint=name, tier=t
+        )
+        is not None
+    ]
+    tier = "+".join(tiers) if tiers else None
     out = dict(health)
     out.update(
         {
+            "tier": tier,
             "dominant_phase": dominant_phase,
             "dominant_phase_frac": dominant_phase_frac,
             "kv_free_frac": kv_free_frac,
@@ -301,7 +316,8 @@ def render_text(doc: dict, *, top: "int | None" = None) -> str:
     if truncated_to_worst:
         rows = sorted(rows, key=_badness, reverse=True)[:top]
     out.append(
-        f"{'endpoint':<22} {'up':<4} {'stale_s':>7} {'scrape_ms':>9} "
+        f"{'endpoint':<22} {'up':<4} {'tier':>14} {'stale_s':>7} "
+        f"{'scrape_ms':>9} "
         f"{'series':>6} {'spans/s':>8} {'occ':>5} {'queue':>5} "
         f"{'goodput':>7} {'evic/s':>7} {'rej/s':>7} {'phase':>12} "
         f"{'kvfree':>6} {'swap/s':>6} {'wasted':>6}"
@@ -316,6 +332,7 @@ def render_text(doc: dict, *, top: "int | None" = None) -> str:
             phase = "-"
         out.append(
             f"{row['endpoint']:<22} {'UP' if row['up'] else 'DOWN':<4} "
+            f"{(row.get('tier') or '-'):>14} "
             f"{_fmt(row['staleness_s'], 7)} "
             f"{_fmt(row['scrape_duration_s'] * 1e3, 9, 2)} "
             f"{_fmt(row['series'], 6)} {_fmt(row['spans_per_s'], 8)} "

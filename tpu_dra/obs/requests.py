@@ -14,7 +14,9 @@ lifted to request scope):
 | ---------------- | -------------------------------------------------- |
 | ``queue``          | submit → admission into a batch row              |
 | ``admit``          | admission → first token (placement + prefill)    |
-| ``decode``         | first token → finish, host-parked time excluded  |
+| ``decode``         | first token → finish, parked time excluded       |
+| ``handoff``        | parked between prefill-tier finish and           |
+|                    | decode-tier admission (disaggregated serving)    |
 | ``preempted-host`` | parked in the host swap tier mid-decode          |
 | ``swap-dma``       | block DMA of the preemption round trip           |
 
@@ -57,10 +59,11 @@ from tpu_dra.utils.servestats import _pctl
 logger = logging.getLogger(__name__)
 
 # The canonical waterfall vocabulary, in render order.  The phases tile
-# submit->finish: queue + admit + decode + preempted-host + swap-dma ==
-# finished_at - enqueued_at (closure >= 0.95 pinned by test — the
-# residue is float rounding, never unattributed wall time).
-PHASES = ("queue", "admit", "decode", "preempted-host", "swap-dma")
+# submit->finish: queue + admit + decode + handoff + preempted-host +
+# swap-dma == finished_at - enqueued_at (closure >= 0.95 pinned by
+# test — the residue is float rounding, never unattributed wall time).
+PHASES = ("queue", "admit", "decode", "handoff", "preempted-host",
+          "swap-dma")
 
 
 @dataclass
@@ -127,11 +130,20 @@ def reduce_request(req) -> "RequestRecord | None":
     swapped = max(0.0, getattr(req, "swapped_s", 0.0))
     dma = min(max(0.0, getattr(req, "swap_dma_s", 0.0)), swapped)
     hosted = swapped - dma
-    decode = max(0.0, req.finished_at - req.first_token_at - swapped)
+    # The disaggregated handoff window (parallel/disagg.py): parked
+    # between the prefill tier's first token and decode-tier admission.
+    # Clamped into the first-token→finish window alongside the swapped
+    # window so decode never goes negative on a clock oddity.
+    span = max(0.0, req.finished_at - req.first_token_at)
+    handoff = min(
+        max(0.0, getattr(req, "handoff_s", 0.0)), max(0.0, span - swapped)
+    )
+    decode = max(0.0, span - swapped - handoff)
     phases = {
         "queue": queue,
         "admit": admit,
         "decode": decode,
+        "handoff": handoff,
         "preempted-host": hosted,
         "swap-dma": dma,
     }
@@ -456,7 +468,8 @@ def render_text(doc: dict) -> str:
         out.append(
             f"{'seq':>6} {'engine':<12} {'req':>4} {'cls':>3} {'tok':>4} "
             f"{'total_ms':>9} {'ttft_ms':>8} {'queue':>6} {'admit':>6} "
-            f"{'decode':>6} {'host':>6} {'dma':>6} {'clos':>5} trace"
+            f"{'decode':>6} {'hand':>6} {'host':>6} {'dma':>6} "
+            f"{'clos':>5} trace"
         )
         for r in rows:
             total = r["total_s"]
@@ -469,6 +482,7 @@ def render_text(doc: dict) -> str:
                 f"{r['class']:>3} {r['tokens']:>4} {total * 1e3:>9.2f} "
                 f"{r['ttft_s'] * 1e3:>8.2f} {fracs['queue']:>6.0%} "
                 f"{fracs['admit']:>6.0%} {fracs['decode']:>6.0%} "
+                f"{fracs['handoff']:>6.0%} "
                 f"{fracs['preempted-host']:>6.0%} {fracs['swap-dma']:>6.0%} "
                 f"{r['closure']:>5.2f} {r['trace_id'][:16]}"
             )
@@ -481,9 +495,9 @@ _BAR_WIDTH = 32
 def render_waterfall(doc: dict) -> str:
     """The per-request waterfall (``tpudra waterfall <trace-id>``): one
     block per request in the document, each phase a bar proportional to
-    its share of submit→finish.  The swap phases only print when the
-    request was actually preempted — a clean request reads as three
-    bars, not five."""
+    its share of submit→finish.  The swap and handoff phases only print
+    when the request was actually preempted or handed off — a clean
+    monolithic request reads as three bars, not six."""
     rows = doc.get("requests", [])
     if not rows:
         return (
@@ -506,7 +520,8 @@ def render_waterfall(doc: dict) -> str:
         )
         for phase in PHASES:
             v = r["phase_s"].get(phase, 0.0)
-            if v <= 0.0 and phase in ("preempted-host", "swap-dma"):
+            if v <= 0.0 and phase in ("handoff", "preempted-host",
+                                      "swap-dma"):
                 continue
             frac = v / total if total > 0 else 0.0
             bar = "#" * max(1 if v > 0 else 0, round(frac * _BAR_WIDTH))

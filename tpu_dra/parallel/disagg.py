@@ -1,0 +1,539 @@
+"""Disaggregated prefill/decode serving over the paged pool.
+
+`DisaggServer` splits admission into two tiers of `ServeEngine`
+(docs/SERVING.md "Disaggregated serving", ROADMAP item 1 — the
+DistServe/Splitwise-style tier separation):
+
+- the **prefill tier** admits waiting requests in prompt-length-aware
+  waves, runs the prompt prefill into paged blocks, fetches the first
+  token, and then runs NO decode steps (``tier="prefill"`` engines skip
+  the decode loop entirely — the row sits frozen);
+- the **decode tier** receives each prefilled request as a **block
+  table, never a row copy**, and runs the decode steps to finish.
+
+The handoff unit is the block table:
+
+- ``handoff="alias"`` (in-process): both tiers share ONE device pool +
+  block allocator, and the handoff moves the row's block REFERENCES
+  into the decode engine's table — zero device copies, the PR 10
+  aliasing discipline (`tpu_dra_serve_kv_alias_total` counts the
+  adopted blocks).
+- ``handoff="dma"`` (cross-pool): each block streams through a bounded
+  `swap.HostBlockPool` staging area, one `read_block` fetch and one
+  `write_block` restore at a time — the PR 13 swap mechanism repurposed
+  engine→engine.  The exact bytes round-trip, so greedy decode
+  continues token-identically.
+
+Why it pays: a heavy wave of long prompts no longer prefills inside the
+engine that is mid-decode for everyone else — resident requests' TPOT
+stops inflating under prompt bursts (the bench's ``serve_disagg``
+stanza measures exactly this: decode-tier TPOT p95 under a long-prompt
+burst vs the monolithic engine's).
+
+The handed-off request stays ONE trace: ``fleet.route`` root (minted at
+`submit`, emitted at prefill placement) → ``serve.queue`` /
+``serve.admit`` on the prefill tier → ``prefill.run`` (admission to
+handoff) → ``handoff.alias`` / ``handoff.dma`` (the parked window
+between tiers) → ``serve.decode`` / ``serve.request`` on the decode
+tier.  The waterfall grows a ``handoff`` phase for the parked window
+(obs/requests.py), keeping closure >= 0.95.
+
+Backpressure is the observable story: when the decode tier is saturated
+(its queue at ``decode_queue_cap``, or the dma staging pool full),
+handoffs defer, prefill rows stay occupied, admission waves stall, and
+the server backlog grows — `tpu_dra_disagg_prefill_queue_depth` rises
+and the `PrefillBacklogGrowth` alert (obs/alerts.py) walks
+pending→firing.  This module is also the structural prerequisite for
+ROADMAP item 3(c): fleet KV migration reuses the same block-stream
+handoff.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+import weakref
+
+from tpu_dra.parallel.serve import ServeEngine
+from tpu_dra.parallel.swap import HostBlockPool
+from tpu_dra.utils import trace
+from tpu_dra.utils.metrics import DISAGG_PREFILL_QUEUE_DEPTH
+
+_unix_of = trace.unix_of
+
+_SERVER_IDS = itertools.count()
+
+HANDOFF_MODES = ("alias", "dma")
+
+# Engine knobs the server owns — a tier spec naming one of these would
+# fight the wiring the server does (tier identity, engine names, the
+# paged layout the handoff requires).
+_RESERVED_SPEC_KEYS = ("tier", "name", "kv_layout", "telemetry")
+
+
+def _weak_sampler(ref: "weakref.ref", fn):
+    """Scrape-time gauge callback holding only a weakref to the server
+    (the serve.py discipline): None retires the series once the server
+    is collected, close() retires it deterministically."""
+
+    def sample():
+        server = ref()
+        return None if server is None else fn(server)
+
+    return sample
+
+
+class _Pending:
+    """A server-queued request: validated at arrival, prefill-placed by
+    a later admission wave.  ``windows`` is its prompt's block-grid
+    footprint — the unit the prompt-length-aware wave budget spends."""
+
+    __slots__ = (
+        "did", "prompt", "max_new", "seed", "stop_sequences",
+        "use_prefix_cache", "priority", "enqueued_at", "trace_ctx",
+        "windows",
+    )
+
+    def __init__(self, did, prompt, max_new, seed, stop_sequences,
+                 use_prefix_cache, priority, enqueued_at, trace_ctx,
+                 windows):
+        self.did = did
+        self.prompt = prompt
+        self.max_new = max_new
+        self.seed = seed
+        self.stop_sequences = stop_sequences
+        self.use_prefix_cache = use_prefix_cache
+        self.priority = priority
+        self.enqueued_at = enqueued_at
+        self.trace_ctx = trace_ctx
+        self.windows = windows
+
+
+class DisaggServer:
+    """Two tier-sized `ServeEngine`s behind one admission front door.
+
+    ``prefill`` / ``decode``: ServeEngine kwargs for each tier — sized
+    independently (``slots``, ``kv_blocks``, ``attn_backend``, SLO
+    knobs...); the server wires ``tier=``, ``name=``,
+    ``kv_layout="paged"`` and ``telemetry=`` itself, so those keys are
+    rejected.  Both tiers must share the model (one ``params`` /
+    ``config``), the block size (``prefix_window``) and the pool format
+    (``kv_int8``) — the handoff payload is a block table in that
+    format.  A cross-format handoff (fp16 prefill into an int8 decode
+    pool) would need a re-quantization pass and would break the greedy
+    token-identity contract; size an int8 decode tier by applying
+    ``kv_int8=True`` to both specs.
+
+    ``handoff="alias"``: the tiers share ONE pool — the decode spec's
+    ``kv_blocks`` sizes it (a prefill-spec ``kv_blocks`` is rejected),
+    and a handoff moves block references with zero device copies.
+    ``handoff="dma"``: each tier keeps its own pool and blocks stream
+    through a ``staging_blocks``-slot `HostBlockPool` (default: one
+    worst-case row, the bounded-stream floor).
+
+    ``prefill_wave``: the admission wave's per-tick budget in prompt
+    WINDOWS (block-grid columns), not request count — one long prompt
+    spends the budget many short chats would share, which is what keeps
+    a long-prompt burst from monopolizing the prefill tier's tick
+    (default: two worst-case prompts' worth).  ``decode_queue_cap``:
+    handoffs defer while the decode engine holds this many waiters
+    (default: its ``slots`` — one full extra round), the backpressure
+    that surfaces as prefill backlog growth."""
+
+    def __init__(
+        self,
+        params,
+        config,
+        *,
+        prefill: dict,
+        decode: dict,
+        handoff: str = "alias",
+        staging_blocks: "int | None" = None,
+        prefill_wave: "int | None" = None,
+        decode_queue_cap: "int | None" = None,
+        telemetry: bool = True,
+        name: "str | None" = None,
+    ):
+        if handoff not in HANDOFF_MODES:
+            raise ValueError(
+                f"handoff must be one of {HANDOFF_MODES}, got {handoff!r}"
+            )
+        for label, spec in (("prefill", prefill), ("decode", decode)):
+            bad = sorted(set(spec) & set(_RESERVED_SPEC_KEYS))
+            if bad:
+                raise ValueError(
+                    f"the {label} spec must not set {bad}: the "
+                    "DisaggServer wires tier identity, engine names, "
+                    "the paged layout and telemetry itself"
+                )
+        if handoff == "alias" and prefill.get("kv_blocks") is not None:
+            raise ValueError(
+                "handoff='alias' shares ONE device pool between the "
+                "tiers, sized by the decode spec's kv_blocks — a "
+                "prefill-spec kv_blocks would size a pool that is "
+                "immediately discarded"
+            )
+        if handoff == "alias" and staging_blocks is not None:
+            raise ValueError(
+                "staging_blocks only applies to handoff='dma' (the "
+                "alias handoff moves references, nothing is staged)"
+            )
+        self.name = name or f"disagg-{next(_SERVER_IDS)}"
+        self.handoff = handoff
+        self.telemetry = telemetry
+        self._prefill = ServeEngine(
+            params, config, tier="prefill",
+            name=f"{self.name}-prefill", kv_layout="paged",
+            telemetry=telemetry, **prefill,
+        )
+        self._decode = ServeEngine(
+            params, config, tier="decode",
+            name=f"{self.name}-decode", kv_layout="paged",
+            telemetry=telemetry, **decode,
+        )
+        if self._prefill._block_size != self._decode._block_size:
+            raise ValueError(
+                "the tiers must share one block size: the handoff unit "
+                f"is a block table (prefill prefix_window "
+                f"{self._prefill._block_size} vs decode "
+                f"{self._decode._block_size})"
+            )
+        if self._prefill._kv_int8 != self._decode._kv_int8:
+            raise ValueError(
+                "the tiers must share one pool format (kv_int8): the "
+                "handoff payload is a block table in that format — "
+                "apply kv_int8 to both specs or neither"
+            )
+        self._w = self._prefill._block_size
+        self._shared_pool = handoff == "alias"
+        if self._shared_pool:
+            # ONE pool + allocator: the decode spec sized it; the
+            # prefill engine's init-time pool is dropped here (a
+            # transient double allocation at construction).  From now
+            # on every pool-threading jit call on EITHER tier donates
+            # the shared buffer, so `_sync_pool` must rebind both
+            # engines after each tier op — the tiers tick strictly
+            # sequentially for exactly this reason.
+            shared_total = self._decode._balloc.stats()["blocks_total"]
+            floor = self._prefill._table_cols + 1 + (
+                1 if self._prefill._prefix is not None else 0
+            )
+            if shared_total < floor:
+                raise ValueError(
+                    f"the shared pool (decode kv_blocks={shared_total}) "
+                    f"must hold at least {floor} blocks — one worst-case "
+                    "prefill-tier admission (its table columns, a COW "
+                    "block when the prefix cache could park it) + scratch"
+                )
+            self._prefill._balloc = self._decode._balloc
+            self._prefill._pool = self._decode._pool
+            self._staging = None
+        else:
+            cap = (
+                self._prefill._table_cols
+                if staging_blocks is None
+                else staging_blocks
+            )
+            if cap < self._prefill._table_cols:
+                raise ValueError(
+                    f"staging_blocks must be >= {self._prefill._table_cols} "
+                    "(one worst-case row — a smaller staging pool could "
+                    f"never stream the longest legal request), got {cap}"
+                )
+            self._staging = HostBlockPool(cap)
+        wave = (
+            2 * (self._prefill.prompt_slots // self._w)
+            if prefill_wave is None
+            else prefill_wave
+        )
+        if wave < self._prefill.prompt_slots // self._w:
+            raise ValueError(
+                f"prefill_wave must be >= "
+                f"{self._prefill.prompt_slots // self._w} windows (one "
+                f"worst-case prompt — a smaller wave budget could never "
+                f"admit the longest legal request), got {wave}"
+            )
+        self.prefill_wave = wave
+        self.decode_queue_cap = (
+            self._decode.slots
+            if decode_queue_cap is None
+            else decode_queue_cap
+        )
+        if self.decode_queue_cap < 1:
+            raise ValueError(
+                "decode_queue_cap must be >= 1 (0 would defer every "
+                f"handoff forever), got {decode_queue_cap}"
+            )
+        self._backlog: "list[_Pending]" = []
+        self._by_did: "dict[int, object]" = {}
+        self._next_did = 0
+        self._done: "list" = []
+        self._deferred_handoffs = 0
+        self._closed = False
+
+        ref = weakref.ref(self)
+        # The PrefillBacklogGrowth series: everything waiting for
+        # prefill-tier capacity — the server backlog plus the prefill
+        # engine's own queue (absent once the server closes).
+        DISAGG_PREFILL_QUEUE_DEPTH.set_function(
+            _weak_sampler(
+                ref,
+                lambda s: len(s._backlog) + len(s._prefill._queue),
+            ),
+            server=self.name,
+        )
+
+    # -- tier access (tests, conservation checks, smoke) -----------------
+    @property
+    def tiers(self) -> "dict[str, ServeEngine]":
+        """The tier engines by role — the conservation check and the
+        smoke walk these directly."""
+        return {"prefill": self._prefill, "decode": self._decode}
+
+    @property
+    def staging(self) -> "HostBlockPool | None":
+        """The dma staging pool (None under handoff='alias')."""
+        return self._staging
+
+    # -- admission front door --------------------------------------------
+    def submit(self, prompt: "list[int]", max_new: "int | None" = None,
+               *, seed: "int | None" = None,
+               stop_sequences: "list[list[int]] | None" = None,
+               use_prefix_cache: bool = True,
+               priority: int = 0) -> int:
+        """Queue a request for the prefill tier; returns a SERVER-wide
+        id (use `result()` to fetch the finished Request).  Validation
+        is eager and covers BOTH tiers: the prompt contract (the
+        prefill engine's validator speaks for the shared config) plus
+        the handoff contract — the request's full block-table footprint
+        must fit a decode-tier row, and under handoff='dma' the staging
+        pool, or the handoff could never complete (the submit-time
+        failure discipline: a doomed request must fail here, not spin a
+        later `run()` to its tick bound)."""
+        self._check_open()
+        budget, stops = self._prefill.validate_request(
+            prompt, max_new, seed, stop_sequences, priority
+        )
+        cols = -(-(len(prompt) + budget) // self._w)
+        if cols > self._decode._table_cols:
+            raise ValueError(
+                f"request needs {cols} blocks but a decode-tier row "
+                f"holds {self._decode._table_cols} — size the decode "
+                "tier (prompt_slots + max_new_cap) for the prefill "
+                "tier's longest admitted request (docs/SERVING.md "
+                "\"Disaggregated serving\")"
+            )
+        if self._staging is not None and cols > self._staging.capacity:
+            raise ValueError(
+                f"request needs {cols} blocks but the dma staging pool "
+                f"holds {self._staging.capacity} — its handoff could "
+                "never stream (raise staging_blocks)"
+            )
+        did = self._next_did
+        self._next_did += 1
+        self._backlog.append(
+            _Pending(
+                did=did, prompt=list(prompt), max_new=budget,
+                seed=seed, stop_sequences=stops,
+                use_prefix_cache=bool(use_prefix_cache),
+                priority=priority,
+                enqueued_at=time.perf_counter(),
+                trace_ctx=trace.TraceContext.new(),
+                windows=-(-len(prompt) // self._w),
+            )
+        )
+        return did
+
+    def _admit_wave(self) -> int:
+        """Place backlogged requests onto the prefill tier, highest
+        priority first and earliest arrival among equals, spending at
+        most ``prefill_wave`` prompt WINDOWS — the prompt-length-aware
+        wave: the budget is block-grid work, so one long prompt
+        consumes what many short chats would share and a long-prompt
+        burst cannot monopolize the tick.  The wave stops at the first
+        item that would overrun the remaining budget (head-of-line per
+        class, the fleet `_place_queued` discipline) or when the
+        prefill engine's queue would exceed its free rows (placement
+        past that would just deepen the engine queue the backlog
+        already measures)."""
+        if not self._backlog:
+            return 0
+        room = (
+            sum(r is None for r in self._prefill._row_req)
+            - len(self._prefill._queue)
+        )
+        if room <= 0:
+            return 0
+        pending = sorted(
+            self._backlog, key=lambda p: (-p.priority, p.enqueued_at)
+        )
+        budget = self.prefill_wave
+        placed: "set[int]" = set()
+        for item in pending:
+            if len(placed) >= room:
+                break
+            if item.windows > budget:
+                break
+            budget -= item.windows
+            rid = self._prefill.submit(
+                item.prompt, item.max_new, seed=item.seed,
+                stop_sequences=item.stop_sequences,
+                use_prefix_cache=item.use_prefix_cache,
+                enqueued_at=item.enqueued_at,
+                priority=item.priority,
+                trace_parent=item.trace_ctx,
+            )
+            req = self._prefill.request(rid)
+            self._by_did[item.did] = req
+            placed.add(item.did)
+            if self.telemetry:
+                # The server-wide trace ROOT (the fleet.route
+                # convention): identity = the context minted at submit,
+                # duration = arrival -> prefill-tier placement.
+                now = time.perf_counter()
+                trace.emit_span(
+                    "fleet.route", context=item.trace_ctx,
+                    start_unix_s=_unix_of(item.enqueued_at),
+                    duration_s=now - item.enqueued_at,
+                    fleet=self.name, request=item.did,
+                    replica=self._prefill.name, reason="prefill",
+                    tier="prefill",
+                    queue_depth=len(self._backlog),
+                )
+        if placed:
+            self._backlog = [
+                p for p in self._backlog if p.did not in placed
+            ]
+        return len(placed)
+
+    def _drain_prefill(self) -> int:
+        """Hand prefilled rows off to the decode tier, highest priority
+        first.  Every occupied prefill row is ready — the prefill tier
+        runs no decode steps, so an occupied row IS a finished prefill
+        with its first token emitted and pos/tok frozen.  A handoff
+        defers (row stays, retried next tick) when the decode queue is
+        at ``decode_queue_cap`` or the dma staging pool cannot hold the
+        row — the backpressure path that grows the prefill backlog."""
+        ready = [
+            (row, req)
+            for row, req in enumerate(self._prefill._row_req)
+            if req is not None
+        ]
+        ready.sort(key=lambda e: (-e[1].priority, e[1].enqueued_at))
+        moved = 0
+        for row, req in ready:
+            if len(self._decode._queue) >= self.decode_queue_cap:
+                self._deferred_handoffs += len(ready) - moved
+                break
+            payload = self._prefill.handoff_out(
+                row, mode=self.handoff, staging=self._staging
+            )
+            if payload is None:  # dma staging full: bounded stream defers
+                self._deferred_handoffs += len(ready) - moved
+                break
+            self._decode.handoff_in(payload)
+            moved += 1
+        return moved
+
+    def tick(self) -> "list":
+        """One server step: admission wave into the prefill tier →
+        prefill tick (prompt prefill + first tokens, no decode steps) →
+        drain finished prefills into the decode tier as block tables →
+        decode tick.  Strictly sequential — under handoff='alias' the
+        tiers share one donated pool buffer.  Returns the requests that
+        finished this tick (decode-tier finishes, plus one-token
+        requests that finished at prefill admission)."""
+        self._check_open()
+        self._admit_wave()
+        done = list(self._prefill.tick())
+        if self._shared_pool:
+            self._decode._pool = self._prefill._pool
+        self._drain_prefill()
+        if self._shared_pool:
+            self._decode._pool = self._prefill._pool
+        done.extend(self._decode.tick())
+        if self._shared_pool:
+            self._prefill._pool = self._decode._pool
+        self._done.extend(done)
+        return done
+
+    def run(self, until_idle: int = 10_000) -> "list":
+        """Tick until the backlog and both tiers drain; returns all
+        requests completed during the call.  ``until_idle`` bounds the
+        loop (the engine `run` contract)."""
+        done = []
+        for _ in range(until_idle):
+            if not self._backlog and not self.pending:
+                break
+            done.extend(self.tick())
+        else:
+            raise RuntimeError(
+                "disagg server did not drain within the tick bound"
+            )
+        return done
+
+    @property
+    def pending(self) -> bool:
+        """True while either tier holds queued or in-flight work."""
+        return bool(
+            self._backlog
+            or self._prefill.pending
+            or self._decode.pending
+        )
+
+    def result(self, did: int):
+        """The finished (or in-flight) Request for a server id; None
+        while the request still waits in the server backlog.  The
+        OBJECT is tracked, not an engine id — the decode tier assigns
+        the request a fresh local id at `handoff_in`."""
+        return self._by_did.get(did)
+
+    def disagg_stats(self) -> dict:
+        """The server's json-able accounting (the smoke's `/debug`-side
+        view): backlog + per-tier queue/occupancy, handoff traffic by
+        direction and mode, deferred-handoff count, and the dma staging
+        pool's residency."""
+        stats = {
+            "server": self.name,
+            "handoff": self.handoff,
+            "backlog": len(self._backlog),
+            "deferred_handoffs": self._deferred_handoffs,
+            "prefill": {
+                "queue_depth": self._prefill.queue_depth,
+                "occupancy": self._prefill.occupancy,
+                "handoff_out_requests":
+                    self._prefill._handoff_counts["out_requests"],
+                "handoff_out_blocks":
+                    self._prefill._handoff_counts["out_blocks"],
+            },
+            "decode": {
+                "queue_depth": self._decode.queue_depth,
+                "occupancy": self._decode.occupancy,
+                "handoff_in_requests":
+                    self._decode._handoff_counts["in_requests"],
+                "handoff_in_blocks":
+                    self._decode._handoff_counts["in_blocks"],
+                "handoffs_alias":
+                    self._decode._handoff_counts["alias"],
+                "handoffs_dma": self._decode._handoff_counts["dma"],
+            },
+        }
+        if self._staging is not None:
+            stats["staging"] = self._staging.stats()
+        return stats
+
+    def close(self) -> None:
+        """Kill the server: retire its backlog gauge and close both
+        tier engines (their own gauge retirement + crisp death
+        semantics).  Idempotent; finished requests stay readable."""
+        self._closed = True
+        DISAGG_PREFILL_QUEUE_DEPTH.remove_function(server=self.name)
+        self._prefill.close()
+        self._decode.close()
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise RuntimeError(
+                f"DisaggServer {self.name!r} is closed: no further "
+                "submissions or ticks (restart with a fresh server)"
+            )

@@ -171,6 +171,8 @@ from tpu_dra.parallel.prefixcache import PagedPrefixCache, PrefixCache
 from tpu_dra.parallel.swap import AgeHeatPolicy, HostBlockPool
 from tpu_dra.utils import servestats, trace
 from tpu_dra.utils.metrics import (
+    DISAGG_HANDOFF_BLOCKS,
+    DISAGG_HANDOFFS,
     SERVE_BATCH_OCCUPANCY,
     SERVE_KV_ALIAS,
     SERVE_KV_BLOCKS,
@@ -182,6 +184,7 @@ from tpu_dra.utils.metrics import (
     SERVE_QUEUE_WAIT_SECONDS,
     SERVE_SLO_TOTAL,
     SERVE_STEP_PHASE_SECONDS,
+    SERVE_TIER_ENGINES,
     SERVE_TPOT_SECONDS,
     SERVE_TTFT_SECONDS,
     SERVE_WASTED_STEPS,
@@ -280,6 +283,18 @@ class Request:
     # waterfall phases from exactly these two numbers.
     swapped_s: float = 0.0
     swap_dma_s: float = 0.0
+    # Disaggregated serving (docs/SERVING.md "Disaggregated serving"):
+    # how many times this request's KV moved between tiers as a block
+    # table, the blocks that moved, the mode of the LAST move ("alias" =
+    # refcount alias in a shared pool, zero device copies; "dma" = the
+    # bounded block stream over read_block/write_block), and the seconds
+    # decode sat parked between prefill finish and decode-tier admission
+    # — obs/requests.py renders that window as the `handoff` waterfall
+    # phase.
+    handoffs: int = 0
+    handoff_blocks: int = 0
+    handoff_mode: str = ""
+    handoff_s: float = 0.0
     submitted_at: float = 0.0
     ttft_s: float = 0.0
     # The engine that served this request (ServeEngine.name, stamped at
@@ -318,6 +333,7 @@ class Request:
     trace_parent: "object | None" = field(default=None, repr=False)
     _last_token_at: float = field(default=0.0, repr=False)
     _swapped_at: float = field(default=0.0, repr=False)
+    _handoff_at: float = field(default=0.0, repr=False)
 
 
 class ServeEngine:
@@ -433,11 +449,16 @@ class ServeEngine:
         tpot_slo_s: "float | None" = None,
         telemetry: bool = True,
         name: "str | None" = None,
+        tier: str = "mono",
         mesh=None,
     ):
         jax, jnp = _jax_mods()
 
         c = config
+        if tier not in ("mono", "prefill", "decode"):
+            raise ValueError(
+                f"tier must be 'mono', 'prefill', or 'decode', got {tier!r}"
+            )
         # Every row must fit prompt + its budget in the context.
         _check_window(c, prompt_slots, max_new_cap, "prompt_slots")
         if slots < 1:
@@ -467,6 +488,12 @@ class ServeEngine:
         if kv_layout not in ("paged", "rows"):
             raise ValueError(
                 f"kv_layout must be 'paged' or 'rows', got {kv_layout!r}"
+            )
+        if tier != "mono" and kv_layout != "paged":
+            raise ValueError(
+                "prefill/decode tier engines require kv_layout='paged': "
+                "the handoff unit is a block table (docs/SERVING.md "
+                "\"Disaggregated serving\")"
             )
         if kv_layout == "paged" and c.moe_experts > 0:
             raise ValueError(
@@ -576,6 +603,7 @@ class ServeEngine:
         self._device_steps = 0
         self.temperature = temperature
         self.with_logprobs = with_logprobs
+        self.tier = tier
         self.mesh = mesh
 
         cache_sh = pool_sh = None
@@ -655,6 +683,17 @@ class ServeEngine:
             self._swap_counts = {
                 "out_blocks": 0, "in_blocks": 0,
                 "preemptions": 0, "in_requests": 0,
+            }
+            # Disaggregated handoff (docs/SERVING.md "Disaggregated
+            # serving"): per-request parked state between `handoff_in`
+            # and the admitting `_handoff_restore` (req.id -> mode +
+            # blocks/staging slots + the frozen pos/tok), plus the
+            # cumulative traffic counters kv_block_stats reports.
+            self._handoff_state: "dict[int, dict]" = {}
+            self._handoff_counts = {
+                "out_requests": 0, "out_blocks": 0,
+                "in_requests": 0, "in_blocks": 0,
+                "alias": 0, "dma": 0,
             }
             if mesh is not None:
                 from jax.sharding import NamedSharding
@@ -758,6 +797,13 @@ class ServeEngine:
                 ref, lambda e: sum(r is not None for r in e._row_req)
             ),
             engine=self.name,
+        )
+        # Tier identity as a value-1 gauge (the build-info convention:
+        # labels carry the payload) — `tpudra top`'s per-tier column
+        # derives from this series; a pre-tier endpoint simply lacks it
+        # (absent is not zero).
+        SERVE_TIER_ENGINES.set_function(
+            _weak_sampler(ref, lambda e: 1), engine=self.name, tier=tier
         )
         if kv_layout == "paged":
             # Block-state gauges, one series triple per engine: free is
@@ -1189,6 +1235,14 @@ class ServeEngine:
                 # credit, no COW (its parked entries were released at
                 # swap-out).
                 need = len(self._swap_state[req.id]["host_slots"])
+            elif req.id in self._handoff_state:
+                # Handed-off head: alias payloads already own their
+                # blocks (the refs moved with the block table — nothing
+                # to allocate), a DMA payload's demand is exact like a
+                # swap-in's (tables are fully preallocated at admission,
+                # so a handed-off row never grows mid-decode).
+                ho = self._handoff_state[req.id]
+                need = 0 if ho["mode"] == "alias" else len(ho["slots"])
             else:
                 use = (
                     self._prefix.peek(req.prompt, min_use=self._block_size)
@@ -1382,6 +1436,209 @@ class ServeEngine:
                 duration_s=restored - req._swapped_at,
                 request=req.id, row=row, blocks=len(own),
                 parked_s=round(restored - req._swapped_at, 6),
+            )
+
+    # -- disaggregated prefill/decode handoff (docs/SERVING.md
+    # "Disaggregated serving").  The unit of transfer is the BLOCK
+    # TABLE, never a row copy: `handoff_out` ships a prefilled row off a
+    # prefill-tier engine (alias mode moves the refcounts with the
+    # payload — zero device copies; dma mode streams each block through
+    # a HostBlockPool, one bounded read_block at a time, the `_swap_out`
+    # mechanism repurposed engine->engine), `handoff_in` parks the
+    # payload in the decode engine's queue, and `_handoff_restore`
+    # rebuilds the row at the decode tier's next admission — pos and
+    # pending token frozen across the move, so greedy decode continues
+    # token-identically (`_swap_in`'s restore contract).
+    def handoff_out(self, row: int, *, mode: str,
+                    staging: "object | None" = None) -> "dict | None":
+        """Ship row ``row``'s KV off this engine as a block-table
+        payload for another engine's `handoff_in`.  ``mode="alias"``
+        moves the table's block references into the payload — valid
+        ONLY between engines sharing one pool + allocator (the
+        DisaggServer's in-process tiers); ``mode="dma"`` streams each
+        block into ``staging`` (a ``swap.HostBlockPool``) and drops the
+        device references.  Returns the payload, or ``None`` when a dma
+        staging pool cannot hold the row (every stored slot rolled
+        back — the caller defers the handoff and retries; the row stays
+        live and untouched).  The request leaves this engine entirely:
+        row freed, pins released, `_by_id` forgotten."""
+        jax, jnp = _jax_mods()
+
+        self._check_open()
+        if self._kv_layout != "paged":
+            raise RuntimeError(
+                "handoff_out needs kv_layout='paged': the handoff unit "
+                "is a block table"
+            )
+        if mode not in ("alias", "dma"):
+            raise ValueError(f"mode must be 'alias' or 'dma', got {mode!r}")
+        if mode == "dma" and staging is None:
+            raise ValueError("mode='dma' requires a staging HostBlockPool")
+        req = self._row_req[row]
+        if req is None:
+            raise ValueError(f"row {row} holds no in-flight request")
+        now = time.perf_counter()
+        blocks = [int(b) for b in self._table[row] if b]
+        payload: "dict" = {
+            "request": req, "mode": mode, "source": self.name,
+            "pos": self._pos[row], "tok": self._tok[row],
+        }
+        if mode == "alias":
+            # The refcounts MOVE with the payload: no unref, no copy —
+            # the decode engine's table row becomes the new owner at
+            # `_handoff_restore` (the PR 10 aliasing discipline).
+            payload["blocks"] = blocks
+        else:
+            slots = []
+            for b in blocks:
+                data = jax.device_get(
+                    self._read_block(self._pool, jnp.int32(b))
+                )
+                slot = staging.store(data)
+                if slot is None:
+                    # Bounded stream: on a full staging pool, roll back
+                    # what this payload stored and leave the row live.
+                    for s in slots:
+                        staging.free(s)
+                    return None
+                slots.append(slot)
+            payload["slots"] = slots
+            payload["staging"] = staging
+            self._balloc.unref(blocks, step=self._device_steps)
+        # Zero onto scratch before the blocks can be reallocated (alias
+        # mode: before the DECODE tier can extend them) — the frozen
+        # row keeps stepping until reassigned (the _finish discipline).
+        self._table[row, :] = 0
+        for entry in self._row_pins[row]:
+            self._prefix.release(entry)
+        self._row_pins[row] = []
+        self._row_req[row] = None
+        self._by_id.pop(req.id, None)
+        req.handoffs += 1
+        req.handoff_blocks += len(blocks)
+        req._handoff_at = now
+        self._handoff_counts["out_requests"] += 1
+        self._handoff_counts["out_blocks"] += len(blocks)
+        if self.telemetry:
+            # The prefill tier's span covers admission through the
+            # moment the row left: prompt prefill + first token + any
+            # wait for decode-tier capacity while frozen in the row.
+            trace.emit_span(
+                "prefill.run", parent=req.trace_ctx,
+                start_unix_s=_unix_of(req.admitted_at),
+                duration_s=now - req.admitted_at,
+                request=req.id, blocks=len(blocks), mode=mode,
+                prompt_len=len(req.prompt),
+            )
+        return payload
+
+    def handoff_in(self, payload: dict) -> int:
+        """Accept a `handoff_out` payload: adopt the request under a
+        fresh local id, park the frozen block table (or staged slots)
+        in `_handoff_state`, and queue the request — the next admission
+        wave restores it through `_handoff_restore` under the same
+        block-demand gate as every other head.  Returns the local id."""
+        self._check_open()
+        if self._kv_layout != "paged":
+            raise RuntimeError(
+                "handoff_in needs kv_layout='paged': the handoff unit "
+                "is a block table"
+            )
+        req = payload["request"]
+        mode = payload["mode"]
+        cols = (
+            payload["blocks"] if mode == "alias" else payload["slots"]
+        )
+        if len(cols) > self._table_cols:
+            raise ValueError(
+                f"handoff payload needs {len(cols)} blocks but this "
+                f"engine's table rows hold {self._table_cols} — size the "
+                "decode tier for the prefill tier's longest admitted "
+                "request (docs/SERVING.md \"Disaggregated serving\")"
+            )
+        req.id = self._next_id
+        self._next_id += 1
+        req.replica = self.name
+        self._by_id[req.id] = req
+        self._handoff_state[req.id] = {
+            "mode": mode,
+            "blocks": payload.get("blocks", []),
+            "slots": payload.get("slots", []),
+            "staging": payload.get("staging"),
+            "pos": payload["pos"],
+            "tok": payload["tok"],
+            "source": payload["source"],
+        }
+        # Head selection orders by (priority, enqueued_at), both carried
+        # across the handoff — the request keeps its fleet-level place.
+        self._queue.append(req)
+        return req.id
+
+    def _handoff_restore(self, req: Request, row: int) -> None:
+        """Rebuild a handed-off request in free row ``row``: alias mode
+        adopts the payload's block references directly into the table
+        (zero device copies); dma mode allocates fresh blocks and
+        `write_block`s each staged payload back in (the exact bytes
+        `handoff_out` fetched, so greedy decode continues
+        token-identically).  The caller cleared the demand through
+        `_ensure_admittable`."""
+        jnp = _jax_mods()[1]
+
+        now = time.perf_counter()
+        state = self._handoff_state.pop(req.id)
+        mode = state["mode"]
+        if mode == "alias":
+            cols = list(state["blocks"])
+            # Zero-copy adoption is an alias in the pool's accounting:
+            # the moved refcounts land in this engine's table without a
+            # single device touch (the acceptance counter for "in
+            # -process handoff adds zero device copies").
+            self._kv_counts["alias_blocks"] += len(cols)
+            SERVE_KV_ALIAS.inc(len(cols), engine=self.name)
+        else:
+            slots = state["slots"]
+            staging = state["staging"]
+            own = self._balloc.alloc(
+                len(slots), step=self._device_steps, origin="handoff"
+            )
+            if own is None:
+                raise RuntimeError(
+                    "handoff accounting violated: demand was cleared "
+                    "but the allocator came up short"
+                )
+            for b, slot in zip(own, slots):
+                self._pool = self._write_block(
+                    self._pool, jnp.int32(b), staging.load(slot)
+                )
+                staging.free(slot)
+            self._kv_counts["alloc_blocks"] += len(own)
+            cols = list(own)
+        table_row = np.zeros((self._table_cols,), np.int32)
+        table_row[: len(cols)] = cols
+        self._table[row, :] = table_row
+        self._row_req[row] = req
+        self._row_pins[row] = []
+        self._pos[row] = state["pos"]
+        self._tok[row] = state["tok"]
+        restored = time.perf_counter()
+        req.handoff_s += restored - req._handoff_at
+        req.handoff_mode = mode
+        # TPOT measures DECODE (the `_swap_in` discipline): the parked
+        # window between tiers is accounted once in handoff_s, so the
+        # first decode-tier token's arrival gap starts at the restore.
+        req._last_token_at = restored
+        self._handoff_counts[mode] += 1
+        self._handoff_counts["in_requests"] += 1
+        self._handoff_counts["in_blocks"] += len(cols)
+        DISAGG_HANDOFFS.inc(engine=self.name, mode=mode)
+        DISAGG_HANDOFF_BLOCKS.inc(len(cols), engine=self.name, mode=mode)
+        if self.telemetry:
+            trace.emit_span(
+                f"handoff.{mode}", parent=req.trace_ctx,
+                start_unix_s=_unix_of(req._handoff_at),
+                duration_s=restored - req._handoff_at,
+                request=req.id, row=row, blocks=len(cols),
+                source=state["source"], target=self.name,
             )
 
     def _admit_paged(self, req: Request, row: int, prompt, length: int):
@@ -1607,6 +1864,15 @@ class ServeEngine:
             req = self._queue.pop(head)
             if req.swapped:
                 self._swap_in(req, row)
+                continue
+            if (
+                self._kv_layout == "paged"
+                and req.id in self._handoff_state
+            ):
+                # A handed-off request joins no admission wave: its
+                # first token was fetched by the prefill tier and rides
+                # the payload frozen, exactly like a swap-in's.
+                self._handoff_restore(req, row)
                 continue
             t_admit = time.perf_counter()
             req.admitted_at = t_admit
@@ -1910,7 +2176,17 @@ class ServeEngine:
         # tick's opening admissions, before its finishes.
         occupancy = sum(r is not None for r in self._row_req)
         queue_depth = len(self._queue)
-        calls = self.steps_per_tick if self.scheduling == "continuous" else 1
+        if self.tier == "prefill":
+            # A prefill-tier engine runs NO decode steps: the admission
+            # wave above did the prompt prefill and fetched the first
+            # token, and the row now sits frozen (pos/tok intact) until
+            # the DisaggServer drains it through `handoff_out` — a
+            # max_new == 1 request simply finished inside the wave.
+            calls = 0
+        else:
+            calls = (
+                self.steps_per_tick if self.scheduling == "continuous" else 1
+            )
         for s in range(calls):
             if s:
                 # Step-granularity join: rows freed by the previous
@@ -2011,6 +2287,7 @@ class ServeEngine:
         self._profile_left = 0
         SERVE_QUEUE_DEPTH.remove_function(engine=self.name)
         SERVE_BATCH_OCCUPANCY.remove_function(engine=self.name)
+        SERVE_TIER_ENGINES.remove_function(engine=self.name, tier=self.tier)
         if self._kv_layout == "paged":
             for state in ("free", "allocated", "aliased", "host"):
                 SERVE_KV_BLOCKS.remove(engine=self.name, state=state)
@@ -2310,6 +2587,13 @@ class ServeEngine:
         stats["swap_out_blocks_total"] = self._swap_counts["out_blocks"]
         stats["swap_in_blocks_total"] = self._swap_counts["in_blocks"]
         stats["preemptions_total"] = self._swap_counts["preemptions"]
+        # Disaggregated handoff traffic (docs/SERVING.md "Disaggregated
+        # serving"): block tables shipped out of / restored into this
+        # engine, by handoff mode.
+        stats["handoff_out_blocks_total"] = self._handoff_counts["out_blocks"]
+        stats["handoff_in_blocks_total"] = self._handoff_counts["in_blocks"]
+        stats["handoffs_alias_total"] = self._handoff_counts["alias"]
+        stats["handoffs_dma_total"] = self._handoff_counts["dma"]
         return stats
 
     def kv_snapshot(self) -> "dict | None":

@@ -509,9 +509,11 @@ SERVE_REQUEST_PHASE_SECONDS = REGISTRY.histogram(
     "Per-request submit->finish wall time by waterfall phase and "
     "priority class: queue (submit to admission), admit (placement + "
     "prefill to first token), decode (first token to finish, host"
-    "-parked time excluded), preempted-host (parked in the host swap "
-    "tier mid-decode), swap-dma (block DMA of the preemption round "
-    "trip); the phases tile submit->finish (closure >= 0.95)",
+    "-parked and handoff-parked time excluded), handoff (parked "
+    "between prefill-tier finish and decode-tier admission in a "
+    "disaggregated deployment), preempted-host (parked in the host "
+    "swap tier mid-decode), swap-dma (block DMA of the preemption "
+    "round trip); the phases tile submit->finish (closure >= 0.95)",
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
              0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
 )
@@ -551,6 +553,36 @@ FLEET_QUEUE_DEPTH = REGISTRY.gauge(
 FLEET_SCALE_HINTS = REGISTRY.counter(
     "tpu_dra_fleet_scale_hints_total",
     "ServeFleet.scale_hint() verdicts by hint (grow, shrink, hold)",
+)
+# Disaggregated prefill/decode serving (parallel/disagg.py,
+# docs/SERVING.md "Disaggregated serving"): tier identity per engine,
+# the prefill-side backlog the PrefillBacklogGrowth alert watches, and
+# the block-table handoff traffic between tiers.
+SERVE_TIER_ENGINES = REGISTRY.gauge(
+    "tpu_dra_serve_tier_engines",
+    "Engines serving each disaggregation tier, value 1 per live engine "
+    "(labels engine + tier: prefill | decode | mono) — the build-info "
+    "convention, labels carry the payload; a pre-tier endpoint simply "
+    "lacks the series (absent is not zero)",
+)
+DISAGG_PREFILL_QUEUE_DEPTH = REGISTRY.gauge(
+    "tpu_dra_disagg_prefill_queue_depth",
+    "Requests waiting for prefill-tier capacity per DisaggServer "
+    "(server backlog plus the prefill engines' own queues, sampled at "
+    "scrape) — the series PrefillBacklogGrowth differentiates",
+)
+DISAGG_HANDOFFS = REGISTRY.counter(
+    "tpu_dra_disagg_handoffs_total",
+    "Prefill->decode KV handoffs completed per decode engine by mode: "
+    "alias (refcount alias of the block table into the decode engine's "
+    "table — zero device copies) or dma (bounded block stream over the "
+    "read_block/write_block primitives through the staging "
+    "HostBlockPool)",
+)
+DISAGG_HANDOFF_BLOCKS = REGISTRY.counter(
+    "tpu_dra_disagg_handoff_blocks_total",
+    "KV blocks moved prefill->decode per decode engine and handoff "
+    "mode (alias | dma)",
 )
 METRIC_SAMPLE_ERRORS = REGISTRY.counter(
     "tpu_dra_metric_sample_errors_total",
