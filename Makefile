@@ -11,7 +11,7 @@ TAG     ?= latest
         observability-smoke perf-smoke explain-smoke serve-smoke \
         serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke paged-smoke \
         kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke \
-        disagg-smoke
+        disagg-smoke capacity-smoke
 
 # `analyze` runs the full rule registry — the L-style rules lint would
 # run plus the whole-repo invariants — so `all` needs only one pass.
@@ -26,7 +26,7 @@ TAG     ?= latest
 # worst-K/paged operator surfaces), and `disagg-smoke` on a
 # disaggregated-serving regression (block-table handoff identity, tier
 # metrics, the /debug/cluster tier column, PrefillBacklogGrowth).
-all: analyze kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke disagg-smoke test
+all: analyze kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke disagg-smoke capacity-smoke test
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -199,6 +199,19 @@ obs-top-smoke:
 obs-scale-smoke:
 	$(PYTHON) -m pytest tests/test_obs_scale_smoke.py -q -m 'not slow'
 
+# Fleet capacity ledger floor (docs/OBSERVABILITY.md "Capacity
+# ledger"): a kubesim controller commit opens the ledger with real
+# node/chip facts, a serve engine binds and earns busy chip-seconds,
+# /debug/capacity serves json/text/filters/400s with /debug/index
+# advertising it, `tpudra capacity` renders the same bytes, and
+# killing the consumer while the claim stays allocated walks
+# StrandedCapacity pending -> firing -> resolved over a real collector
+# (resolution only at deallocate).  The conservation property (busy +
+# idle tiles the allocated wall, closure >= 0.95 under preemption/swap
+# churn) is tests/test_capacity.py (slow-marked, CI --runslow).
+capacity-smoke:
+	$(PYTHON) -m pytest tests/test_capacity_smoke.py -q -m 'not slow'
+
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
@@ -213,5 +226,5 @@ help:
 	@echo "         perf-smoke explain-smoke serve-smoke serve-obs-smoke"
 	@echo "         chaos-smoke fleet-smoke obs-top-smoke paged-smoke"
 	@echo "         kernel-smoke kv-smoke swap-smoke requests-smoke"
-	@echo "         obs-scale-smoke"
+	@echo "         obs-scale-smoke capacity-smoke"
 	@echo "         image clean"

@@ -2568,6 +2568,160 @@ def bench_obs_scale(
             server.server_close()
 
 
+def bench_capacity(
+    nodes: int = 4,
+    claims_per_node: int = 2,
+    chips_per_claim: int = 4,
+    serve_s: float = 600.0,
+    kill_at_s: float = 480.0,
+    dealloc_at_s: float = 540.0,
+    tick_s: float = 5.0,
+    closure_floor: float = 0.95,
+) -> "dict":
+    """Capacity-ledger stanza (ISSUE 18): a synthetic fleet of
+    ``nodes * claims_per_node`` allocated claims served over an
+    injected-clock timeline, with one node killed mid-run — its
+    consumers go step-silent while the NAS still says allocated, and
+    the ledger must produce the chaos evidence: a nonzero stranded
+    chip-second window on exactly the killed node, conservation
+    (closure >= ``closure_floor``: busy + idle explains the allocated
+    wall everywhere the consumers lived), and fragmentation evidence
+    from the post-kill availability picture.  Jax-free (the obs
+    plane's own discipline), so it runs in-process."""
+    from tpu_dra.obs import capacity
+
+    registered = []
+    try:
+        capacity.reset()
+        now = [0.0]
+        engines = {}  # name -> mutable snapshot state
+
+        def make_provider(name, slots):
+            state = {
+                "busy_s": 0.0, "idle_s": 0.0, "steps": 0,
+                "last_step_t": 0.0, "alive": True,
+            }
+            engines[name] = state
+
+            def provider():
+                return {
+                    "engine": name,
+                    "slots": slots,
+                    "busy_s": state["busy_s"],
+                    "idle_s": state["idle_s"],
+                    "steps": state["steps"],
+                    "last_step_age_s": now[0] - state["last_step_t"],
+                }
+
+            capacity.register(name, provider)
+            registered.append(name)
+            return state
+
+        claims = []  # (uid, node, engine_state)
+        for n in range(nodes):
+            node = f"bench-n{n}"
+            for c in range(claims_per_node):
+                uid = f"cap-{n}-{c}"
+                capacity.claim_allocated(
+                    claim_uid=uid, claim=uid, node=node,
+                    chips=chips_per_claim, cls="tpu", now_mono=0.0,
+                )
+                state = make_provider(f"eng-{n}-{c}", slots=4)
+                capacity.bind(uid, f"eng-{n}-{c}")
+                claims.append((uid, node, state))
+
+        killed_node = f"bench-n{nodes - 1}"
+        # The serving timeline: every tick, each live consumer tiles the
+        # tick wall 70/30 busy/idle (a steady continuous-batching load).
+        # At kill_at_s the killed node's consumers stop stepping; at
+        # dealloc_at_s the controller re-places them (deallocate).
+        t = 0.0
+        deallocated = False
+        while t < serve_s:
+            t = min(serve_s, t + tick_s)
+            now[0] = t
+            if t > kill_at_s:
+                for _, node, state in claims:
+                    if node == killed_node:
+                        state["alive"] = False
+            for _, node, state in claims:
+                if state["alive"]:
+                    state["busy_s"] += 0.7 * tick_s
+                    state["idle_s"] += 0.3 * tick_s
+                    state["steps"] += 1
+                    state["last_step_t"] = t
+            if not deallocated and t >= dealloc_at_s:
+                for uid, node, _ in claims:
+                    if node == killed_node:
+                        capacity.claim_deallocated(uid, now_mono=t)
+                deallocated = True
+            # The scrape cadence: settle as a collector round would.
+            capacity.settle(now_mono=t)
+
+        # Post-kill availability: the killed node's chips came back free
+        # but scattered (the re-placement fragmented it); a healthy node
+        # shows one contiguous block.
+        capacity.observe_node(
+            killed_node,
+            [(0, 0, 0), (2, 0, 0), (0, 2, 0), (2, 2, 0)],
+        )
+        capacity.observe_node("bench-n0", [(0, 0, 0), (1, 0, 0)])
+
+        doc = capacity.capacity_doc(
+            limit=len(claims), now_mono=serve_s,
+            stranded_after_s=capacity.DEFAULT_STRANDED_AFTER_S,
+        )
+        totals = doc["totals"]
+        by_node = {n["node"]: n for n in doc["nodes"]}
+        stranded_on_killed = by_node[killed_node]["stranded_chip_s"]
+        stranded_elsewhere = sum(
+            n["stranded_chip_s"]
+            for n in doc["nodes"]
+            if n["node"] != killed_node
+        )
+        # The stranded window the kill should have produced: silence
+        # from the kill to the controller's re-placement, per chip.
+        expected_stranded = (
+            (dealloc_at_s - kill_at_s)
+            * claims_per_node * chips_per_claim
+        )
+        frag = by_node[killed_node]["fragmentation_ratio"]
+        ok = bool(
+            totals["closure"] >= closure_floor
+            and stranded_on_killed > 0
+            and stranded_elsewhere == 0
+            and 0.5 * expected_stranded
+            <= stranded_on_killed
+            <= 1.5 * expected_stranded
+            and frag is not None and frag > 0
+            and by_node["bench-n0"]["fragmentation_ratio"] == 0.0
+            and totals["chips_open"]
+            == (nodes - 1) * claims_per_node * chips_per_claim
+        )
+        return {
+            "claims": len(claims),
+            "nodes": nodes,
+            "chips_per_claim": chips_per_claim,
+            "serve_s": serve_s,
+            "closure": totals["closure"],
+            "closure_floor": closure_floor,
+            "busy_chip_s": totals["busy_chip_s"],
+            "idle_chip_s": totals["idle_chip_s"],
+            "stranded_chip_s_killed_node": round(stranded_on_killed, 2),
+            "stranded_chip_s_expected": round(expected_stranded, 2),
+            "stranded_chip_s_elsewhere": round(stranded_elsewhere, 2),
+            "killed_node_fragmentation_ratio": frag,
+            "chips_open_after_dealloc": totals["chips_open"],
+            "ok": ok,
+        }
+    except Exception as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    finally:
+        for name in registered:
+            capacity.unregister(name)
+        capacity.reset()
+
+
 _CHAOS_CHILD = r"""
 import json
 import statistics
@@ -3178,6 +3332,7 @@ def main() -> int:
     serve_disagg = bench_serve_disagg()
     chaos = bench_chaos()
     obs_scale = bench_obs_scale()
+    capacity = bench_capacity()
     p50 = alloc["p50_s"]
     line = {
         "metric": "claim_to_pod_running_p50",
@@ -3230,6 +3385,12 @@ def main() -> int:
             # governance (breach alert fires, neighbors unperturbed)
             # (docs/OBSERVABILITY.md "Obs plane at scale").
             "obs_scale": obs_scale,
+            # Capacity ledger under chaos: a node kill mid-timeline must
+            # yield a nonzero stranded chip-second window on exactly the
+            # killed node with conservation (closure >= 0.95) holding
+            # everywhere else, plus post-kill fragmentation evidence
+            # (docs/OBSERVABILITY.md "Capacity ledger").
+            "capacity": capacity,
             "compute": compute,
         },
     }

@@ -65,6 +65,13 @@ def test_bench_emits_one_json_line(monkeypatch):
         "bench_obs_scale",
         lambda: {"ok": True, "endpoints": 1024, "stubbed": True},
     )
+    # And the capacity-ledger timeline (jax-free but ~120 injected
+    # ticks); its own coverage is test_bench_capacity_stanza.
+    monkeypatch.setattr(
+        bench,
+        "bench_capacity",
+        lambda: {"ok": True, "closure": 1.0, "stubbed": True},
+    )
     import io
     from contextlib import redirect_stdout
 
@@ -82,7 +89,7 @@ def test_bench_emits_one_json_line(monkeypatch):
     assert {
         "rung", "target_s", "fleet", "wire", "northstar_mesh",
         "serve_prefix", "serve_fleet", "serve_disagg", "chaos",
-        "obs_scale", "compute",
+        "obs_scale", "capacity", "compute",
     } <= extras.keys()
     assert extras["fleet"]["target_met"]
     assert extras["wire"]["target_met"]
@@ -333,6 +340,28 @@ def test_bench_obs_scale_small():
     assert out["rule_eval_s_per_round"] < out["rule_eval_budget_s"]
     assert out["series_total"] > 24  # every endpoint minted its series
     assert out["ring_bytes"] > 0
+
+
+def test_bench_capacity_stanza():
+    """The capacity-ledger stanza (ISSUE 18) on a CI-friendly injected
+    timeline: conservation holds (closure >= floor), the node kill
+    strands chips on exactly the killed node for exactly the
+    kill-to-deallocate window, and the post-kill availability picture
+    carries the fragmentation evidence."""
+    import bench
+
+    out = bench.bench_capacity(
+        serve_s=120.0, kill_at_s=96.0, dealloc_at_s=108.0, tick_s=2.0
+    )
+    assert out["ok"], out
+    assert out["closure"] >= out["closure_floor"]
+    assert out["stranded_chip_s_killed_node"] > 0
+    assert out["stranded_chip_s_elsewhere"] == 0
+    assert (
+        out["stranded_chip_s_killed_node"]
+        == out["stranded_chip_s_expected"]
+    )
+    assert out["killed_node_fragmentation_ratio"] == 0.75
 
 
 class TestSalvageProtocol:

@@ -28,6 +28,19 @@ def _get(url: str) -> str:
     return urllib.request.urlopen(url, timeout=5).read().decode()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clean_capacity_ledger():
+    """Earlier test modules' kubesim allocations leave open entries in
+    the process-global capacity ledger, and a MetricsServer serves
+    /debug/capacity from that module state regardless of its private
+    registry — so the StrandedCapacity default rule would (correctly)
+    page on long-dead claims through any rig here.  This module tests
+    the collector machinery, not the ledger; start it clean."""
+    from tpu_dra.obs import capacity
+
+    capacity.reset()
+
+
 def make_collector(*endpoints, **kw):
     """A collector wired for test isolation: private alert recorder (the
     global one is shared process state) and explicit rules."""
@@ -410,6 +423,8 @@ class TestDefaultRules:
             "KVSwapThrash",
             "ScrapeDown",
             "ObsCardinalityBreach",
+            "StrandedCapacity",
+            "NodeFragmentation",
         ]
 
 
@@ -570,7 +585,14 @@ class TestClusterEndpoint:
         assert doc["endpoints_up"] == 1
         (row,) = doc["endpoints"]
         assert row["endpoint"] == "ep0" and row["up"]
-        assert {"spans_per_s", "goodput", "evictions_per_s"} <= row.keys()
+        assert {
+            "spans_per_s", "goodput", "evictions_per_s", "util",
+            "stranded_chips",
+        } <= row.keys()
+        # Capacity columns are absent-not-zero: this endpoint exposes
+        # no ledger series, so both stay None (rendered "-"), never a
+        # fake 0 that would read as "measured and fine".
+        assert row["util"] is None and row["stranded_chips"] is None
         assert {a["rule"] for a in doc["alerts"]} == {
             r.name for r in collector.engine.rules
         }

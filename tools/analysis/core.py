@@ -140,6 +140,10 @@ class Config:
         # Request waterfalls are derived from the engines' monotonic
         # timelines: a wall-clock read here would skew every phase bar.
         "tpu_dra/obs/requests.py",
+        # The capacity ledger's wall/busy/idle/stranded attribution is
+        # all monotonic durations: a wall-clock read would let an NTP
+        # step fabricate (or erase) stranded chip-seconds.
+        "tpu_dra/obs/capacity.py",
         # Block birth/age records feed the /debug/kv age histograms: a
         # wall-clock read here would let an NTP step fake block ages.
         "tpu_dra/parallel/paged.py",

@@ -206,6 +206,40 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
         help="max per-block records to fetch per engine",
     )
 
+    capacity = sub.add_parser(
+        "capacity",
+        help="capacity ledger from /debug/capacity (per-claim busy/idle"
+        "/stranded chip-seconds, node fragmentation, engine "
+        "utilization)",
+    )
+    _add_endpoint_args(
+        capacity, env="TPUDRA_CONTROLLER", what="controller or serve"
+    )
+    capacity.add_argument(
+        "--node", default="", help="only claims/evidence for this node"
+    )
+    capacity.add_argument(
+        "--claim", default="", help="only this claim (name or uid)"
+    )
+    capacity.add_argument(
+        "--class", dest="cls", default="",
+        help="only this claim class (tpu | subslice | core)",
+    )
+    capacity.add_argument(
+        "--stranded-after", type=float, default=None,
+        help="step-silence grace window in seconds before allocated "
+        "chips count as stranded (server default: 5)",
+    )
+    capacity.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output form (text: ledger + node/engine tables; json: "
+        "the raw document)",
+    )
+    capacity.add_argument(
+        "--limit", type=int, default=256,
+        help="max claim rows to fetch",
+    )
+
     reqs = sub.add_parser(
         "requests",
         help="per-request latency attribution from /debug/requests "
@@ -502,6 +536,42 @@ def kv_cmd(args: argparse.Namespace, out=None) -> int:
     return 0
 
 
+def _fetch_capacity(args: argparse.Namespace) -> dict:
+    return fetch_debug(
+        args.endpoint, args.pprof_path, "capacity",
+        {
+            "limit": args.limit,
+            "node": args.node,
+            "claim": args.claim,
+            "class": args.cls,
+            "stranded_after": args.stranded_after,
+        },
+    )
+
+
+def capacity_cmd(args: argparse.Namespace, out=None) -> int:
+    from tpu_dra.obs import capacity as obscap
+
+    # Call-time stream resolution, like serve_stats.
+    out = sys.stdout if out is None else out
+    try:
+        doc = _fetch_capacity(args)
+    except (urllib.error.URLError, OSError) as e:
+        print(
+            f"error: cannot reach endpoint at {args.endpoint}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    if args.format == "json":
+        print(json.dumps(doc, indent=2), file=out)
+    else:
+        # render_text consumes the fetched document, so the CLI output
+        # is byte-identical to /debug/capacity?format=text on the
+        # server.
+        print(obscap.render_text(doc), end="", file=out)
+    return 0
+
+
 def _fetch_requests(args: argparse.Namespace, trace_id: str = "") -> dict:
     return fetch_debug(
         args.endpoint, args.pprof_path, "requests",
@@ -724,6 +794,8 @@ def main(argv: "list[str] | None" = None) -> int:
         return serve_stats(args)
     if args.command == "kv":
         return kv_cmd(args)
+    if args.command == "capacity":
+        return capacity_cmd(args)
     if args.command == "requests":
         return requests_cmd(args)
     if args.command == "waterfall":

@@ -63,6 +63,21 @@ DRIVER_API_GROUP = tpucrd.GROUP_NAME
 logger = logging.getLogger(__name__)
 
 
+def _capacity_chips(allocated: "nascrd.AllocatedDevices") -> int:
+    """Whole chips a claim holds for capacity-ledger accounting: tpu
+    claims hold their devices outright; subslice/core claims hold
+    their parent chips (availability pops whole parents for them, so
+    the chip is unschedulable for anyone else — the ledger charges the
+    claim for the silicon it fences, not the fraction it carves)."""
+    if allocated.tpu is not None:
+        return len(allocated.tpu.devices)
+    if allocated.subslice is not None:
+        return len({d.parent_uuid for d in allocated.subslice.devices})
+    if allocated.core is not None:
+        return len({d.parent_uuid for d in allocated.core.devices})
+    return 0
+
+
 class ControllerDriver:
     def __init__(self, clientset: ClientSet, namespace: str = "tpu-dra"):
         self.lock = PerNodeMutex()
@@ -557,6 +572,26 @@ class ControllerDriver:
                                 trace_id=ctx.trace_id,
                             )
                         )
+                        # Open the capacity-ledger entry beside the
+                        # verdict: from this commit every chip-second
+                        # the claim holds is attributable.  Lazy import
+                        # — controller -> obs is not an eager layer
+                        # edge (the serve.py discipline).
+                        from tpu_dra.obs import capacity as obscap
+
+                        allocated = nas.spec.allocated_claims.get(
+                            claim.metadata.uid
+                        )
+                        if allocated is not None:
+                            obscap.claim_allocated(
+                                claim_uid=claim.metadata.uid,
+                                claim=claim.metadata.name,
+                                namespace=claim.metadata.namespace,
+                                node=selected_node,
+                                chips=_capacity_chips(allocated),
+                                cls=allocated.type(),
+                                trace_id=ctx.trace_id,
+                            )
                         created = parse_time(
                             claim.metadata.creation_timestamp
                         )
@@ -672,6 +707,20 @@ class ControllerDriver:
             else:
                 raise ValueError(f"unknown AllocatedDevices type: {allocated.type()}")
             del nas.spec.allocated_claims[claim_uid]
+            # Close the capacity-ledger entry: freezes the claim's
+            # busy/idle/stranded attribution and settles it into the
+            # chip-seconds counters.  Lazy import — controller -> obs
+            # is not an eager layer edge (the serve.py discipline).
+            from tpu_dra.obs import capacity as obscap
+
+            obscap.claim_deallocated(
+                claim_uid,
+                claim=claim.metadata.name,
+                namespace=claim.metadata.namespace,
+                node=selected_node,
+                chips=_capacity_chips(allocated),
+                cls=allocated.type(),
+            )
             # Drop the claim's traceparent + lifecycle annotations with its
             # allocation.
             nas.metadata.annotations.pop(
@@ -1059,6 +1108,14 @@ class ControllerDriver:
                 if snapshot is None:
                     snapshot = build_snapshot(potential_node, nas, pvs)
                     self.availability.store(snapshot)
+                    # Freshly-built snapshot = new free-state evidence:
+                    # feed the capacity ledger's per-node fragmentation
+                    # signal (largest contiguous free subslice vs total
+                    # free).  Lazy import — controller -> obs is not an
+                    # eager layer edge (the serve.py discipline).
+                    from tpu_dra.obs import capacity as obscap
+
+                    obscap.observe_snapshot(snapshot)
 
             per_kind: dict[str, list[ClaimAllocation]] = {
                 tpucrd.TPU_CLAIM_PARAMETERS_KIND: [],

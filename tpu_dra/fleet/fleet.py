@@ -275,6 +275,20 @@ class ServeFleet:
     def replicas(self) -> "list[str]":
         return list(self._engines)
 
+    def bind_claim(self, claim_uid: str) -> bool:
+        """Join an allocated claim to this fleet in the capacity ledger:
+        every replica engine binds as a consumer, so the claim's
+        chip-seconds attribute from the replicas' step accounting (a
+        gang claim serves through all of them).  Lazy import — fleet ->
+        obs is not an eager layer edge (the serve.py discipline).
+        Returns False when the ledger has no open entry for the uid."""
+        from tpu_dra.obs import capacity as obscap
+
+        ok = True
+        for name in self._engines:
+            ok = obscap.bind(claim_uid, name) and ok
+        return ok
+
     def engine(self, replica: str):
         return self._engines[replica]
 

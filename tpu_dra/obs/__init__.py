@@ -25,6 +25,11 @@ pane of glass over all of them:
   document (per-request waterfall phase decomposition, per-priority
   -class TTFT/TPOT/goodput aggregates) behind ``tpudra requests`` /
   ``tpudra waterfall`` and the per-class ``SLOClassBurn`` rules.
+- ``capacity``    — the capacity ledger: ``/debug/capacity`` chip-second
+  attribution (busy/idle/stranded per claim/node/class) joining the
+  controller's allocation lifecycle, the engines' device-step
+  accounting, and per-node fragmentation evidence, behind ``tpudra
+  capacity`` and the ``StrandedCapacity``/``NodeFragmentation`` rules.
 
 jax-free ON PURPOSE (the ``fleet``/``servestats`` discipline, enforced
 by the A101-A103 gate): the collector is control-plane code that must
@@ -33,18 +38,22 @@ run in any binary — or its own tiny pod — without paying a jax import.
 
 from tpu_dra.obs import alerts, cluster, collector, promparse  # noqa: F401
 
-__all__ = ["alerts", "cluster", "collector", "kv", "promparse", "requests"]
+__all__ = [
+    "alerts", "capacity", "cluster", "collector", "kv", "promparse",
+    "requests",
+]
 
 
 def __getattr__(name: str):
-    # `kv` and `requests` load LAZILY on purpose (the fleet/__init__
-    # PEP 562 shape): /debug/index advertises /debug/kv and
-    # /debug/requests exactly when the module is loaded, and it is the
-    # engines that load them (registering their snapshot/class
-    # providers) — a collector pod or control-plane binary that merely
-    # imports tpu_dra.obs must not advertise an empty introspection
-    # endpoint and draw useless fetch traffic.
-    if name in ("kv", "requests"):
+    # `kv`, `requests`, and `capacity` load LAZILY on purpose (the
+    # fleet/__init__ PEP 562 shape): /debug/index advertises /debug/kv,
+    # /debug/requests, and /debug/capacity exactly when the module is
+    # loaded, and it is the engines (snapshot/class/capacity providers)
+    # or the controller (allocation lifecycle hooks) that load them — a
+    # collector pod or control-plane binary that merely imports
+    # tpu_dra.obs must not advertise an empty introspection endpoint
+    # and draw useless fetch traffic.
+    if name in ("kv", "requests", "capacity"):
         import importlib
 
         return importlib.import_module(f"tpu_dra.obs.{name}")

@@ -29,7 +29,8 @@ per-class aggregates — the measurement side of QoS isolation.
 
 Rule expressions receive the collector itself and use its view protocol
 (``rate`` / ``delta`` / ``max_value`` / ``endpoint_health`` /
-``fetch_requests``), so custom rules are one lambda away; a raising
+``fetch_requests`` / ``fetch_capacity``), so custom rules are one
+lambda away; a raising
 expression marks the rule's status with the error instead of killing
 the evaluation loop.
 """
@@ -761,6 +762,106 @@ def obs_cardinality_breach(
     )
 
 
+def stranded_capacity(
+    *,
+    stranded_after_s: float = 5.0,
+    min_chips: int = 1,
+    for_s: float = 0.0,
+) -> AlertRule:
+    """Chips allocated to claims whose consumers produce no device
+    steps: the capacity ledger's ``chips_stranded`` total across every
+    capable endpoint (``view.fetch_capacity`` — the controller's plane
+    joined against the engines' step accounting).  A claim is stranded
+    once every bound engine has been step-silent past
+    ``stranded_after_s`` — including the engine that never bound or
+    whose process died (the chaos node-kill story: the NAS still says
+    allocated, the silicon earns nothing).  Resolves when the consumer
+    steps again or the claim deallocates.  The value is the fleet-wide
+    stranded chip count; the detail names the worst claims."""
+
+    def expr(view):
+        chips = 0
+        claims = []
+        for doc in view.fetch_capacity(stranded_after_s=stranded_after_s):
+            chips += doc.get("totals", {}).get("chips_stranded", 0)
+            claims += [
+                (r.get("chips", 0), r.get("claim") or r.get("claim_uid"))
+                for r in doc.get("claims", [])
+                if r.get("stranded_now")
+            ]
+        if chips < min_chips:
+            return False, float(chips), "no stranded capacity"
+        claims.sort(reverse=True)
+        named = ", ".join(f"{name} ({n} chips)" for n, name in claims[:4])
+        if len(claims) > 4:
+            named += f", +{len(claims) - 4} more"
+        return (
+            True,
+            float(chips),
+            f"{chips} allocated chip(s) with no device steps for "
+            f"> {stranded_after_s:g}s: " + (named or "claims unnamed"),
+        )
+
+    return AlertRule(
+        name="StrandedCapacity",
+        expr=expr,
+        for_s=for_s,
+        severity="page",
+        description="allocated chips whose consumers produce no device "
+        f"steps for > {stranded_after_s:g}s (claims held open over dead "
+        "or idle consumers)",
+    )
+
+
+def node_fragmentation(
+    *, min_gang_chips: int = 2, for_s: float = 0.0
+) -> AlertRule:
+    """Free chips plentiful but unschedulable: a node's largest
+    contiguous free subslice fell below the smallest schedulable gang
+    (``min_gang_chips``) while at least that many chips sit free — the
+    capacity ledger's per-node fragmentation evidence, the defrag
+    victim-picking signal ROADMAP item 4 names.  Resolves when
+    deallocation (or defrag) reopens a contiguous block.  The value is
+    the worst offending node's fragmentation ratio."""
+
+    def expr(view):
+        worst = 0.0
+        offenders = []
+        for doc in view.fetch_capacity():
+            for row in doc.get("nodes", []):
+                free = row.get("free_chips")
+                largest = row.get("largest_free_subslice")
+                if free is None or largest is None:
+                    continue
+                if free >= min_gang_chips and largest < min_gang_chips:
+                    ratio = row.get("fragmentation_ratio") or 0.0
+                    worst = max(worst, ratio)
+                    offenders.append(
+                        f"{row['node']} ({free} free, largest block "
+                        f"{largest})"
+                    )
+        if not offenders:
+            return (
+                False, 0.0,
+                f"every node with >= {min_gang_chips} free chips can "
+                f"still place a {min_gang_chips}-chip gang",
+            )
+        named = ", ".join(sorted(offenders)[:4])
+        if len(offenders) > 4:
+            named += f", +{len(offenders) - 4} more"
+        return True, round(worst, 4), "fragmented free capacity: " + named
+
+    return AlertRule(
+        name="NodeFragmentation",
+        expr=expr,
+        for_s=for_s,
+        severity="warn",
+        description="a node's free chips cannot place the smallest "
+        f"schedulable gang ({min_gang_chips} chips) despite free "
+        "capacity — defragmentation candidate",
+    )
+
+
 def default_rules(
     *, window_s: float = 60.0, for_s: float = 0.0
 ) -> "list[AlertRule]":
@@ -777,4 +878,6 @@ def default_rules(
         kv_swap_thrash(window_s=window_s, for_s=for_s),
         scrape_down(for_s=for_s),
         obs_cardinality_breach(window_s=window_s, for_s=for_s),
+        stranded_capacity(for_s=for_s),
+        node_fragmentation(for_s=for_s),
     ]

@@ -1,0 +1,807 @@
+"""Capacity ledger — chip-second attribution from claim to token.
+
+The controller's NAS records *who holds which devices* and the serve
+tier records *what the silicon did*, but no surface joined them: "we
+allocated 256 chips and served 0.56 goodput" was unanswerable per
+claim, per node, or per class.  This module is the join — the evidence
+plane ROADMAP item 4 (defrag victim picking) and item 5 (goodput-per
+-chip autoscaling) both block on.  Three planes feed it:
+
+1. **Allocation lifecycle** (controller): ``claim_allocated`` /
+   ``claim_deallocated`` open and close ledger entries on the monotonic
+   clock, each emitting a ``CapacityRecord`` into a flight recorder
+   beside the ``decisions.py`` verdicts (``/debug/capacity`` carries
+   the event ring too).
+2. **Device-step accounting** (serve engines): engines REGISTER a
+   weakref-backed snapshot provider (the ``obs/kv.py`` discipline)
+   returning cumulative occupancy-weighted busy/idle device seconds —
+   busy + idle tiles the engine's step wall time exactly, which is the
+   conservation invariant the ledger closes on.  ``bind`` joins a claim
+   to its consumer engine(s) and baselines their counters, so every
+   allocated chip-second attributes to **busy** (occupancy-weighted
+   step time), **idle** (allocated, stepping, unoccupied), or
+   **stranded** (allocated while the consumer produced no device steps
+   past a grace window).
+3. **Fragmentation evidence** (controller availability snapshots):
+   ``observe_snapshot`` reduces a node's free chips to the defrag
+   signal item 4 names — largest contiguous free subslice vs total
+   free chips — per node, latest observation wins.
+
+jax-free ON PURPOSE (the ``servestats``/``fleet`` inversion, enforced
+by the A101-A103 gate): this module never imports the engine or the
+controller; both push their halves in through lazy seams.
+``MetricsServer`` serves ``capacity_doc`` at ``/debug/capacity``
+(json/text, ``node=``/``claim=``/``class=`` filters, 400 on bad
+queries like its siblings) and ``render_text`` draws the same document
+for ``tpudra capacity``, byte-identical to the server's text form.
+
+Settlement: ``settle`` moves the attribution deltas into
+``tpu_dra_capacity_chip_seconds_total{node,state}`` — counters are
+monotonic, so attribution that later re-classifies (a stranded claim's
+engine waking up) settles forward only.  It runs on every document
+build and at every ``/metrics`` exposition (the
+``tpu_dra_capacity_open_claims`` sampler), so
+``rate(state="stranded")`` reads as *chips currently stranded*.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tpu_dra.utils.metrics import (
+    CAPACITY_CHIP_SECONDS,
+    CAPACITY_OPEN_CLAIMS,
+    CAPACITY_UTILIZATION,
+    NODE_FRAGMENTATION_RATIO,
+    RING_DROPPED,
+)
+
+# Claim classes: the allocation's device type (the NAS vocabulary) —
+# whole chips, carved subslices, or cores.  The `class=` filter on
+# /debug/capacity validates against this closed set.
+CLASSES = ("tpu", "subslice", "core")
+
+# Event vocabulary of the CapacityRecord ring.
+ALLOCATED = "allocate"
+DEALLOCATED = "deallocate"
+
+# A consumer producing no device steps for longer than this is
+# stranded (query-overridable: `stranded_after=` on /debug/capacity,
+# `stranded_after_s=` on the alert factory) — long enough that a tick
+# gap never flaps the attribution, short enough that CI can cross it.
+DEFAULT_STRANDED_AFTER_S = 5.0
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CAPACITY = 4096
+# Closed allocations kept for the document's recent-history half.
+CLOSED_KEPT = 1024
+
+
+@dataclass
+class CapacityRecord:
+    """One allocation-lifecycle event: a claim's chips entering or
+    leaving the ledger (the decisions.DecisionRecord shape)."""
+
+    seq: int = 0  # recorder-assigned, monotonic per process
+    ts_unix: float = 0.0
+    event: str = ALLOCATED
+    claim_uid: str = ""
+    claim: str = ""
+    namespace: str = ""
+    node: str = ""
+    chips: int = 0
+    cls: str = ""  # device type: tpu | subslice | core
+    wall_s: float = 0.0  # allocated wall seconds (deallocate events)
+    trace_id: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "ts_unix": self.ts_unix,
+            "event": self.event,
+            "claim_uid": self.claim_uid,
+            "claim": self.claim,
+            "namespace": self.namespace,
+            "node": self.node,
+            "chips": self.chips,
+            "class": self.cls,
+            "wall_s": round(self.wall_s, 6),
+            "trace_id": self.trace_id,
+        }
+
+
+class CapacityFlightRecorder:
+    """Bounded, lock-protected ring of CapacityRecords (the
+    decisions.FlightRecorder contract: deque eviction, dropped counter,
+    oldest-first query)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._records: "collections.deque[CapacityRecord]" = (
+            collections.deque(maxlen=capacity)
+        )
+        self._seq = 0
+        self._dropped = 0
+
+    def record(self, rec: CapacityRecord) -> CapacityRecord:
+        if not rec.ts_unix:
+            rec.ts_unix = time.time()  # noqa: A201 — display stamp, not a duration
+        dropped = False
+        with self._lock:
+            self._seq += 1
+            rec.seq = self._seq
+            if len(self._records) == self.capacity:
+                self._dropped += 1  # append below evicts the oldest
+                dropped = True
+            self._records.append(rec)
+        if dropped:
+            RING_DROPPED.inc(ring="capacity")
+        return rec
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    @property
+    def recorded(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._dropped = 0
+
+    def query(
+        self,
+        claim: "str | None" = None,
+        node: "str | None" = None,
+        limit: "int | None" = None,
+    ) -> "list[CapacityRecord]":
+        """Oldest-first snapshot; ``claim`` matches name or uid;
+        ``limit`` keeps the most recent N after filtering."""
+        with self._lock:
+            out = list(self._records)
+        if claim:
+            out = [r for r in out if claim in (r.claim, r.claim_uid)]
+        if node:
+            out = [r for r in out if r.node == node]
+        if limit is not None and limit < len(out):
+            out = out[len(out) - limit:]
+        return out
+
+
+RECORDER = CapacityFlightRecorder()
+
+
+@dataclass
+class _Allocation:
+    """One claim's ledger entry: identity, chip count, lifecycle
+    stamps, its bound consumer engines (with counter baselines), the
+    chip-seconds already settled into the counters, and the frozen
+    attribution once closed."""
+
+    claim_uid: str
+    claim: str
+    namespace: str
+    node: str
+    chips: int
+    cls: str
+    t_open: float  # monotonic
+    t_close: "float | None" = None
+    engines: "list[str]" = field(default_factory=list)
+    baselines: "dict[str, tuple[float, float]]" = field(default_factory=dict)
+    # Last attribution each engine's provider actually served (post
+    # -baseline busy/idle deltas) — a consumer whose process dies keeps
+    # the device time it earned instead of having its history zeroed.
+    observed: "dict[str, tuple[float, float]]" = field(default_factory=dict)
+    # Most recent instant any bound consumer was seen producing device
+    # steps (None = never) — bounds the stranded window to the actual
+    # step silence, not the claim's whole life.
+    last_active: "float | None" = None
+    settled: "dict[str, float]" = field(
+        default_factory=lambda: {"busy": 0.0, "idle": 0.0, "stranded": 0.0}
+    )
+    final: "dict | None" = None
+
+
+_LOCK = threading.Lock()
+_OPEN: "dict[str, _Allocation]" = {}
+_CLOSED: "collections.deque[_Allocation]" = collections.deque(maxlen=CLOSED_KEPT)
+_PROVIDERS: "dict[str, object]" = {}
+_FRAG: "dict[str, dict]" = {}
+
+
+# -- engine provider registry (the obs/kv.py shape) --------------------------
+
+
+def register(name: str, provider) -> None:
+    """Register an engine's capacity snapshot provider: a zero-arg
+    callable returning ``{"engine", "slots", "busy_s", "idle_s",
+    "steps", "last_step_age_s"}``, or ``None`` once its owner is gone
+    (auto-unregistered at the next read).  Two live engines sharing a
+    name overwrite each other — the per-engine gauge discipline."""
+    with _LOCK:
+        _PROVIDERS[name] = provider
+
+
+def unregister(name: str) -> None:
+    with _LOCK:
+        _PROVIDERS.pop(name, None)
+    CAPACITY_UTILIZATION.remove(engine=name)
+
+
+def providers() -> "list[str]":
+    with _LOCK:
+        return sorted(_PROVIDERS)
+
+
+def snapshots() -> "dict[str, dict]":
+    """Live snapshots by engine name.  A provider returning ``None``
+    retires itself (identity-checked against re-registration under a
+    recycled name); one that RAISES is only skipped for this read —
+    introspection must never take the debug server down."""
+    with _LOCK:
+        items = sorted(_PROVIDERS.items())
+    out: "dict[str, dict]" = {}
+    dead: "list[tuple[str, object]]" = []
+    for name, provider in items:
+        try:
+            snap = provider()
+        except Exception:
+            logger.debug(
+                "capacity provider %s raised; skipping this read", name,
+                exc_info=True,
+            )
+            continue
+        if snap is None:
+            dead.append((name, provider))
+            continue
+        out[name] = snap
+    if dead:
+        with _LOCK:
+            for name, provider in dead:
+                if _PROVIDERS.get(name) is provider:
+                    del _PROVIDERS[name]
+        for name, _ in dead:
+            CAPACITY_UTILIZATION.remove(engine=name)
+    return out
+
+
+# -- allocation lifecycle (controller-pushed) --------------------------------
+
+
+def claim_allocated(
+    *,
+    claim_uid: str,
+    claim: str = "",
+    namespace: str = "",
+    node: str = "",
+    chips: int = 0,
+    cls: str = "tpu",
+    trace_id: str = "",
+    now_mono: "float | None" = None,
+) -> CapacityRecord:
+    """Open a ledger entry at allocation commit.  Re-allocating an
+    already-open uid (controller retry replaying a commit) keeps the
+    original open stamp — wall time must not reset on replay."""
+    now = time.monotonic() if now_mono is None else now_mono
+    with _LOCK:
+        if claim_uid not in _OPEN:
+            _OPEN[claim_uid] = _Allocation(
+                claim_uid=claim_uid, claim=claim, namespace=namespace,
+                node=node, chips=chips, cls=cls, t_open=now,
+            )
+    # Mint the node's three counter series at zero so consumers see an
+    # explicit 0 (chips allocated, nothing attributed yet) instead of
+    # an absent series — absent means "no ledger here at all".
+    for state in ("busy", "idle", "stranded"):
+        CAPACITY_CHIP_SECONDS.inc(0.0, node=node, state=state)
+    return RECORDER.record(
+        CapacityRecord(
+            event=ALLOCATED, claim_uid=claim_uid, claim=claim,
+            namespace=namespace, node=node, chips=chips, cls=cls,
+            trace_id=trace_id,
+        )
+    )
+
+
+def claim_deallocated(
+    claim_uid: str,
+    *,
+    claim: str = "",
+    namespace: str = "",
+    node: str = "",
+    chips: int = 0,
+    cls: str = "",
+    trace_id: str = "",
+    now_mono: "float | None" = None,
+) -> CapacityRecord:
+    """Close a ledger entry at deallocate: freeze its attribution from
+    the live engine snapshots (the engines may die right after), settle
+    it into the counters, and move it to the closed history.  An
+    unknown uid (allocated before this process started) still records
+    the lifecycle event from the caller's identity fields."""
+    now = time.monotonic() if now_mono is None else now_mono
+    snaps = snapshots()
+    with _LOCK:
+        alloc = _OPEN.pop(claim_uid, None)
+        if alloc is not None:
+            alloc.t_close = now
+            alloc.final = _attribute(
+                alloc, snaps, now, DEFAULT_STRANDED_AFTER_S
+            )
+            _CLOSED.append(alloc)
+    if alloc is not None:
+        _settle_alloc(alloc, alloc.final)
+        claim, namespace = alloc.claim, alloc.namespace
+        node, chips, cls = alloc.node, alloc.chips, alloc.cls
+        wall = alloc.final["wall_s"]
+    else:
+        wall = 0.0
+    return RECORDER.record(
+        CapacityRecord(
+            event=DEALLOCATED, claim_uid=claim_uid, claim=claim,
+            namespace=namespace, node=node, chips=chips, cls=cls,
+            wall_s=wall, trace_id=trace_id,
+        )
+    )
+
+
+def bind(
+    claim_uid: str, engine: str, *, now_mono: "float | None" = None
+) -> bool:
+    """Join a claim to a consumer engine, baselining the engine's
+    cumulative busy/idle counters so only device time from the bind
+    forward attributes to this claim.  A gang claim serving a fleet
+    binds once per replica engine; binding an unknown or closed uid
+    returns False (nothing to attribute against)."""
+    del now_mono  # symmetry with the other lifecycle hooks
+    snaps = snapshots()
+    with _LOCK:
+        alloc = _OPEN.get(claim_uid)
+        if alloc is None:
+            return False
+        if engine not in alloc.engines:
+            alloc.engines.append(engine)
+            snap = snaps.get(engine)
+            if snap is not None:
+                alloc.baselines[engine] = (
+                    float(snap.get("busy_s", 0.0)),
+                    float(snap.get("idle_s", 0.0)),
+                )
+    return True
+
+
+def open_claims() -> "list[str]":
+    with _LOCK:
+        return sorted(_OPEN)
+
+
+# -- fragmentation evidence (controller-pushed) ------------------------------
+
+
+def largest_contiguous_block(coords) -> int:
+    """Largest axis-aligned box of chips fully contained in ``coords``
+    (ICI-contiguous sub-mesh chip count — the biggest gang this free
+    set can place).  Brute force over origins × box dims: host meshes
+    are tens of chips, and this runs only on availability-snapshot
+    builds, never on a serve path."""
+    free = {tuple(c) for c in coords}
+    if not free:
+        return 0
+    max_x = len({c[0] for c in free})
+    max_y = len({c[1] for c in free})
+    max_z = len({c[2] for c in free})
+    best = 1
+    for ox, oy, oz in free:
+        for dx in range(1, max_x + 1):
+            if (ox + dx - 1, oy, oz) not in free:
+                break
+            for dy in range(1, max_y + 1):
+                if any(
+                    (ox + i, oy + dy - 1, oz) not in free
+                    for i in range(dx)
+                ):
+                    break
+                for dz in range(1, max_z + 1):
+                    if any(
+                        (ox + i, oy + j, oz + dz - 1) not in free
+                        for i in range(dx)
+                        for j in range(dy)
+                    ):
+                        break
+                    best = max(best, dx * dy * dz)
+    return best
+
+
+def observe_node(node: str, free_coords) -> dict:
+    """Record one node's fragmentation evidence from its free-chip
+    coordinates: total free vs the largest contiguous subslice, latest
+    observation per node wins.  Ratio 0 = every free chip sits in one
+    schedulable block; near 1 = plentiful free chips no gang can use
+    (the defrag victim-picking signal, ROADMAP item 4)."""
+    coords = list(free_coords)
+    free = len(coords)
+    largest = largest_contiguous_block(coords)
+    ratio = 0.0 if free == 0 else round(1.0 - largest / free, 4)
+    row = {
+        "node": node,
+        "free_chips": free,
+        "largest_free_subslice": largest,
+        "fragmentation_ratio": ratio,
+    }
+    with _LOCK:
+        _FRAG[node] = row
+    NODE_FRAGMENTATION_RATIO.set(ratio, node=node)
+    return row
+
+
+def observe_snapshot(snapshot) -> dict:
+    """``observe_node`` over a controller ``NodeSnapshot`` (duck-typed:
+    ``.node`` + ``.free_chips`` uuid→AllocatableTpu) — the hook the
+    driver calls beside ``availability.store``."""
+    return observe_node(
+        snapshot.node,
+        [t.coord for t in snapshot.free_chips.values()],
+    )
+
+
+# -- attribution -------------------------------------------------------------
+
+
+def _attribute(
+    alloc: _Allocation,
+    snaps: "dict[str, dict]",
+    now: float,
+    stranded_after_s: float,
+) -> dict:
+    """One allocation's chip-second attribution at time ``now``.
+
+    busy/idle come from the bound engines' cumulative counters past
+    their bind baselines, clamped into the claim's wall window; an
+    engine whose provider is gone (process died) keeps the last deltas
+    it actually served instead of having its history zeroed.
+    ``closure`` = covered / wall is the conservation evidence (how much
+    of the allocated wall the device accounting explains).  Wall the
+    engines never covered folds into idle while a consumer has stepped
+    within ``stranded_after_s`` and into **stranded** once every
+    consumer has been step-silent past it — bounded by the actual
+    silence window, and with absent providers (no engine ever bound, or
+    its process died) counting as silent from their last observed step:
+    exactly the chaos node-kill story."""
+    end = alloc.t_close if alloc.t_close is not None else now
+    wall = max(0.0, end - alloc.t_open)
+    busy = idle = 0.0
+    for name in alloc.engines:
+        snap = snaps.get(name)
+        if snap is not None:
+            busy0, idle0 = alloc.baselines.get(name, (0.0, 0.0))
+            alloc.observed[name] = (
+                max(0.0, float(snap.get("busy_s", 0.0)) - busy0),
+                max(0.0, float(snap.get("idle_s", 0.0)) - idle0),
+            )
+            age = snap.get("last_step_age_s")
+            if age is not None:
+                seen = end - float(age)
+                if alloc.last_active is None or seen > alloc.last_active:
+                    alloc.last_active = seen
+        b, i = alloc.observed.get(name, (0.0, 0.0))
+        busy += b
+        idle += i
+    busy = min(busy, wall)
+    idle = min(idle, max(0.0, wall - busy))
+    covered = busy + idle
+    closure = covered / wall if wall > 0 else 1.0
+    uncovered = max(0.0, wall - covered)
+    silent_gap = end - (
+        alloc.last_active if alloc.last_active is not None else alloc.t_open
+    )
+    silent = silent_gap > stranded_after_s
+    if silent:
+        stranded = min(uncovered, silent_gap)
+        idle += uncovered - stranded
+    else:
+        stranded = 0.0
+        idle += uncovered
+    chips = max(0, alloc.chips)
+    util = busy / (busy + idle) if busy + idle > 0 else None
+    return {
+        "claim_uid": alloc.claim_uid,
+        "claim": alloc.claim,
+        "namespace": alloc.namespace,
+        "node": alloc.node,
+        "class": alloc.cls,
+        "chips": chips,
+        "engines": list(alloc.engines),
+        "open": alloc.t_close is None,
+        "wall_s": round(wall, 6),
+        "busy_chip_s": round(busy * chips, 6),
+        "idle_chip_s": round(idle * chips, 6),
+        "stranded_chip_s": round(stranded * chips, 6),
+        "closure": round(closure, 4),
+        "utilization": None if util is None else round(util, 4),
+        "stranded_now": bool(silent and alloc.t_close is None),
+    }
+
+
+def _settle_alloc(alloc: _Allocation, attr: dict) -> None:
+    """Move one allocation's attribution deltas into the node/state
+    counters.  Counters are monotonic: attribution that re-classifies
+    later (a stranded claim's engine waking folds its window back into
+    idle) settles forward only — the already-settled chip-seconds
+    stand as the record of what was true when settled."""
+    for state in ("busy", "idle", "stranded"):
+        total = attr[f"{state}_chip_s"]
+        delta = total - alloc.settled[state]
+        if delta > 1e-9:
+            CAPACITY_CHIP_SECONDS.inc(delta, node=alloc.node, state=state)
+            alloc.settled[state] = total
+
+
+def settle(now_mono: "float | None" = None) -> int:
+    """Settle every open allocation's attribution into
+    ``tpu_dra_capacity_chip_seconds_total`` and refresh the per-engine
+    utilization gauges; returns the number of open claims (the
+    ``tpu_dra_capacity_open_claims`` sample).  Runs on every document
+    build and every /metrics exposition, so counter rates track the
+    live state between scrapes."""
+    now = time.monotonic() if now_mono is None else now_mono
+    snaps = snapshots()
+    with _LOCK:
+        allocs = list(_OPEN.values())
+    for alloc in allocs:
+        _settle_alloc(
+            alloc, _attribute(alloc, snaps, now, DEFAULT_STRANDED_AFTER_S)
+        )
+    for name, snap in snaps.items():
+        busy = float(snap.get("busy_s", 0.0))
+        idle = float(snap.get("idle_s", 0.0))
+        if busy + idle > 0:
+            CAPACITY_UTILIZATION.set(
+                round(busy / (busy + idle), 4), engine=name
+            )
+    return len(allocs)
+
+
+# Scrape-time settlement: the open-claims gauge's sampler drives
+# settle(), so every /metrics exposition carries freshly-settled
+# chip-second counters (the collector never reads a stale attribution).
+CAPACITY_OPEN_CLAIMS.set_function(settle)
+
+
+# -- the /debug/capacity document --------------------------------------------
+
+
+def capacity_doc(
+    node: "str | None" = None,
+    claim: "str | None" = None,
+    cls: "str | None" = None,
+    limit: int = 256,
+    stranded_after_s: float = DEFAULT_STRANDED_AFTER_S,
+    now_mono: "float | None" = None,
+) -> dict:
+    """The ``/debug/capacity`` JSON document (filters mirror the query
+    parameters; `render_text` consumes exactly this shape).  Filters
+    narrow the claim rows AND the rollups computed from them — a
+    ``node=`` query is that node's whole story.  Open claims attribute
+    live; closed claims carry the attribution frozen at deallocate."""
+    now = time.monotonic() if now_mono is None else now_mono
+    settle(now)
+    snaps = snapshots()
+    with _LOCK:
+        open_allocs = list(_OPEN.values())
+        closed_allocs = list(_CLOSED)
+        frag = {n: dict(row) for n, row in _FRAG.items()}
+    rows = [
+        _attribute(a, snaps, now, stranded_after_s) for a in open_allocs
+    ]
+    rows += [dict(a.final) for a in closed_allocs if a.final is not None]
+    if node:
+        rows = [r for r in rows if r["node"] == node]
+        frag = {n: row for n, row in frag.items() if n == node}
+    if claim:
+        rows = [r for r in rows if claim in (r["claim"], r["claim_uid"])]
+    if cls:
+        rows = [r for r in rows if r["class"] == cls]
+    # Open claims first, then newest-closed — the live fleet reads first.
+    rows.sort(key=lambda r: (not r["open"], r["claim_uid"]))
+    omitted = max(0, len(rows) - limit)
+    rows = rows[:limit]
+
+    nodes: "dict[str, dict]" = {}
+    for n in sorted(set(frag) | {r["node"] for r in rows if r["node"]}):
+        nodes[n] = {
+            "node": n,
+            "chips_open": 0,
+            "busy_chip_s": 0.0,
+            "idle_chip_s": 0.0,
+            "stranded_chip_s": 0.0,
+            "chips_stranded": 0,
+            "free_chips": None,
+            "largest_free_subslice": None,
+            "fragmentation_ratio": None,
+        }
+        nodes[n].update(
+            {k: v for k, v in frag.get(n, {}).items() if k != "node"}
+        )
+    classes: "dict[str, dict]" = {}
+    totals = {
+        "chips_open": 0, "chips_stranded": 0, "busy_chip_s": 0.0,
+        "idle_chip_s": 0.0, "stranded_chip_s": 0.0,
+    }
+    covered_chip_s = wall_chip_s = 0.0
+    for r in rows:
+        buckets = [totals]
+        if r["node"] in nodes:
+            buckets.append(nodes[r["node"]])
+        c = classes.setdefault(
+            r["class"],
+            {
+                "class": r["class"], "chips_open": 0, "chips_stranded": 0,
+                "busy_chip_s": 0.0, "idle_chip_s": 0.0,
+                "stranded_chip_s": 0.0,
+            },
+        )
+        buckets.append(c)
+        for b in buckets:
+            if r["open"]:
+                b["chips_open"] += r["chips"]
+                if r["stranded_now"]:
+                    b["chips_stranded"] += r["chips"]
+            b["busy_chip_s"] = round(b["busy_chip_s"] + r["busy_chip_s"], 6)
+            b["idle_chip_s"] = round(b["idle_chip_s"] + r["idle_chip_s"], 6)
+            b["stranded_chip_s"] = round(
+                b["stranded_chip_s"] + r["stranded_chip_s"], 6
+            )
+        covered_chip_s += r["busy_chip_s"] + r["idle_chip_s"]
+        wall_chip_s += r["wall_s"] * r["chips"]
+    totals["closure"] = (
+        round(covered_chip_s / wall_chip_s, 4) if wall_chip_s > 0 else 1.0
+    )
+    for rollup in list(nodes.values()) + list(classes.values()):
+        spent = rollup["busy_chip_s"] + rollup["idle_chip_s"]
+        rollup["utilization"] = (
+            round(rollup["busy_chip_s"] / spent, 4) if spent > 0 else None
+        )
+    engines = []
+    for name in sorted(snaps):
+        snap = snaps[name]
+        busy = float(snap.get("busy_s", 0.0))
+        idle = float(snap.get("idle_s", 0.0))
+        engines.append(
+            {
+                "engine": name,
+                "slots": snap.get("slots", 0),
+                "busy_s": round(busy, 6),
+                "idle_s": round(idle, 6),
+                "steps": snap.get("steps", 0),
+                "utilization": (
+                    round(busy / (busy + idle), 4) if busy + idle > 0 else None
+                ),
+                "last_step_age_s": (
+                    None
+                    if snap.get("last_step_age_s") is None
+                    else round(float(snap["last_step_age_s"]), 3)
+                ),
+            }
+        )
+    return {
+        "claims": rows,
+        "claims_omitted": omitted,
+        "nodes": sorted(nodes.values(), key=lambda n: n["node"]),
+        "classes": sorted(classes.values(), key=lambda c: c["class"]),
+        "engines": engines,
+        "totals": totals,
+        "stranded_after_s": stranded_after_s,
+        "count": len(rows),
+        "recorded": RECORDER.recorded,
+        "dropped": RECORDER.dropped,
+    }
+
+
+def render_text(doc: dict) -> str:
+    """Plain-text form of the document (``/debug/capacity?format=text``
+    and ``tpudra capacity`` render this byte-identically)."""
+    t = doc.get("totals", {})
+    head = (
+        f"capacity ledger: {t.get('chips_open', 0)} chip(s) open across "
+        f"{sum(1 for r in doc.get('claims', ()) if r['open'])} claim(s), "
+        f"closure {t.get('closure', 1.0):.0%}"
+    )
+    if t.get("chips_stranded"):
+        head += f", {t['chips_stranded']} chip(s) STRANDED"
+    out = [head]
+    claims = doc.get("claims", [])
+    if claims:
+        out.append(
+            f"  {'claim':<20} {'node':<12} {'class':<8} {'chips':>5} "
+            f"{'state':<6} {'wall_s':>8} {'busy':>8} {'idle':>8} "
+            f"{'strand':>8} {'closure':>7} engines"
+        )
+        for r in claims:
+            state = "open" if r["open"] else "closed"
+            if r.get("stranded_now"):
+                state = "STRAND"
+            out.append(
+                f"  {(r['claim'] or r['claim_uid']):<20} "
+                f"{(r['node'] or '-'):<12} {r['class']:<8} "
+                f"{r['chips']:>5} {state:<6} {r['wall_s']:>8.2f} "
+                f"{r['busy_chip_s']:>8.2f} {r['idle_chip_s']:>8.2f} "
+                f"{r['stranded_chip_s']:>8.2f} {r['closure']:>7.2f} "
+                f"{','.join(r['engines']) or '-'}"
+            )
+        if doc.get("claims_omitted"):
+            out.append(
+                f"  ({doc['claims_omitted']} more claim(s) past the limit)"
+            )
+    else:
+        out.append("  (no allocations recorded in this process)")
+    nodes = doc.get("nodes", [])
+    if nodes:
+        out.append("nodes:")
+        out.append(
+            f"  {'node':<16} {'open':>5} {'busy':>9} {'idle':>9} "
+            f"{'strand':>9} {'util':>5} {'free':>5} {'largest':>7} "
+            f"{'frag':>5}"
+        )
+        for n in nodes:
+            util = "-" if n["utilization"] is None else f"{n['utilization']:.2f}"
+            free = "-" if n["free_chips"] is None else str(n["free_chips"])
+            largest = (
+                "-"
+                if n["largest_free_subslice"] is None
+                else str(n["largest_free_subslice"])
+            )
+            frag = (
+                "-"
+                if n["fragmentation_ratio"] is None
+                else f"{n['fragmentation_ratio']:.2f}"
+            )
+            out.append(
+                f"  {n['node']:<16} {n['chips_open']:>5} "
+                f"{n['busy_chip_s']:>9.2f} {n['idle_chip_s']:>9.2f} "
+                f"{n['stranded_chip_s']:>9.2f} {util:>5} {free:>5} "
+                f"{largest:>7} {frag:>5}"
+            )
+    engines = doc.get("engines", [])
+    if engines:
+        out.append("engines:")
+        out.append(
+            f"  {'engine':<20} {'slots':>5} {'busy_s':>9} {'idle_s':>9} "
+            f"{'util':>5} {'steps':>7} last_step"
+        )
+        for e in engines:
+            util = "-" if e["utilization"] is None else f"{e['utilization']:.2f}"
+            age = (
+                "never"
+                if e["last_step_age_s"] is None
+                else f"{e['last_step_age_s']:.1f}s ago"
+            )
+            out.append(
+                f"  {e['engine']:<20} {e['slots']:>5} {e['busy_s']:>9.2f} "
+                f"{e['idle_s']:>9.2f} {util:>5} {e['steps']:>7} {age}"
+            )
+    if doc.get("dropped"):
+        out.append(
+            f"(capacity recorder wrapped: {doc['dropped']} older "
+            "event(s) dropped)"
+        )
+    return "\n".join(out) + "\n"
+
+
+def reset() -> None:
+    """Drop all ledger state (tests and bench stanzas only — a live
+    process never resets its attribution history)."""
+    with _LOCK:
+        _OPEN.clear()
+        _CLOSED.clear()
+        _FRAG.clear()
+    RECORDER.clear()

@@ -107,6 +107,34 @@ def endpoint_row(collector, health: dict, window_s: float) -> dict:
         is not None
     ]
     tier = "+".join(tiers) if tiers else None
+    # Capacity ledger (docs/OBSERVABILITY.md "Capacity ledger"): the
+    # busiest engine's busy fraction, and the stranded chip count as
+    # the chip-seconds counter's rate (d(stranded chip-s)/dt = chips
+    # currently stranded — the ledger settles at every exposition).
+    # Both None when the endpoint exposes no ledger series at all
+    # (absent is not zero — a pre-ledger endpoint, the swap column's
+    # discipline).
+    util = collector.max_value(
+        "tpu_dra_capacity_utilization", endpoint=name
+    )
+    stranded_chips = None
+    if (
+        collector.value(
+            "tpu_dra_capacity_chip_seconds_total",
+            endpoint=name,
+            state="stranded",
+        )
+        is not None
+    ):
+        stranded_chips = round(
+            collector.rate(
+                "tpu_dra_capacity_chip_seconds_total",
+                window_s=window_s,
+                endpoint=name,
+                state="stranded",
+            ),
+            1,
+        )
     out = dict(health)
     out.update(
         {
@@ -115,6 +143,8 @@ def endpoint_row(collector, health: dict, window_s: float) -> dict:
             "dominant_phase_frac": dominant_phase_frac,
             "kv_free_frac": kv_free_frac,
             "swaps_per_s": swaps_per_s,
+            "util": None if util is None else round(util, 3),
+            "stranded_chips": stranded_chips,
             "wasted_steps": collector.value(
                 "tpu_dra_serve_wasted_steps_total", endpoint=name
             ),
@@ -320,7 +350,8 @@ def render_text(doc: dict, *, top: "int | None" = None) -> str:
         f"{'scrape_ms':>9} "
         f"{'series':>6} {'spans/s':>8} {'occ':>5} {'queue':>5} "
         f"{'goodput':>7} {'evic/s':>7} {'rej/s':>7} {'phase':>12} "
-        f"{'kvfree':>6} {'swap/s':>6} {'wasted':>6}"
+        f"{'kvfree':>6} {'swap/s':>6} {'wasted':>6} {'util':>5} "
+        f"{'strand':>6}"
     )
     for row in rows:
         if row.get("dominant_phase"):
@@ -341,7 +372,9 @@ def render_text(doc: dict, *, top: "int | None" = None) -> str:
             f"{_fmt(row['rejections_per_s'], 7, 3)} {phase:>12} "
             f"{_fmt(row.get('kv_free_frac'), 6, 3)} "
             f"{_fmt(row.get('swaps_per_s'), 6, 1)} "
-            f"{_fmt(row.get('wasted_steps'), 6, 0)}"
+            f"{_fmt(row.get('wasted_steps'), 6, 0)} "
+            f"{_fmt(row.get('util'), 5, 2)} "
+            f"{_fmt(row.get('stranded_chips'), 6, 1)}"
         )
     if not doc["endpoints"]:
         out.append("(no endpoints configured)")

@@ -423,6 +423,10 @@ class ObsCollector:
         # {query key: documents}) — per-class rules and the cluster doc
         # share one fetch per distinct query per round.
         self._requests_memo: "tuple[int, dict]" = (-1, {})
+        # fetch_capacity memo, same round-keyed shape: the stranded /
+        # fragmentation rules plus the cluster rollup share one ledger
+        # fetch per distinct query per round.
+        self._capacity_memo: "tuple[int, dict]" = (-1, {})
         self._now_override: "float | None" = None  # scrape_once(now_mono=)
         self._rounds = 0
         self._snapshots = 0
@@ -1126,6 +1130,71 @@ class ObsCollector:
                 self._requests_memo = (self._rounds, {})
             if self._requests_memo[0] == rounds:
                 self._requests_memo[1][key] = out
+        return out
+
+    # -- cross-process capacity ledger -----------------------------------------
+
+    def fetch_capacity(
+        self,
+        node: "str | None" = None,
+        claim: "str | None" = None,
+        cls: "str | None" = None,
+        limit: int = 256,
+        stranded_after_s: "float | None" = None,
+    ) -> "list[dict]":
+        """``/debug/capacity`` ledger documents from every endpoint
+        whose ``/debug/index`` advertises the path (capability
+        discovery — a process where neither the controller nor an
+        engine loaded the ledger is never asked).  Each document gains
+        an ``endpoint`` field; fetch failures skip the endpoint,
+        best-effort like the trace join.  ``stranded_after_s`` passes
+        the grace window through to each ledger's attribution, so the
+        ``StrandedCapacity`` rule and a human's query agree on what
+        counts as stranded.
+
+        Results are memoized PER SCRAPE ROUND (keyed on the query) like
+        ``fetch_requests``: the stranded and fragmentation rules plus
+        the cluster rollup share fetches within one evaluation cycle."""
+        key = (node, claim, cls, limit, stranded_after_s)
+        with self._lock:
+            rounds = self._rounds
+            memo_round, memo = self._capacity_memo
+            if memo_round == rounds and key in memo:
+                return memo[key]
+            states = list(self._states.values())
+        out: "list[dict]" = []
+        for state in states:
+            ep = state.endpoint
+            if not state.serves(f"{ep.pprof_path}/capacity"):
+                continue
+            query: dict = {"format": "json", "limit": limit}
+            if node:
+                query["node"] = node
+            if claim:
+                query["claim"] = claim
+            if cls:
+                query["class"] = cls
+            if stranded_after_s is not None:
+                query["stranded_after"] = stranded_after_s
+            url = (
+                f"{ep.url}{ep.pprof_path}/capacity?"
+                + urllib.parse.urlencode(query)
+            )
+            try:
+                doc = json.loads(self._get(url))
+            except Exception as e:
+                logger.debug("capacity fetch from %s failed: %s", ep.url, e)
+                continue
+            doc["endpoint"] = ep.name
+            out.append(doc)
+        with self._lock:
+            # The I/O ran outside the lock; re-key against the CURRENT
+            # round so a result that straddled a round boundary never
+            # poisons the new round's memo.
+            if self._capacity_memo[0] != self._rounds:
+                self._capacity_memo = (self._rounds, {})
+            if self._capacity_memo[0] == rounds:
+                self._capacity_memo[1][key] = out
         return out
 
     def assemble_trace_tree(self, trace_id: "str | None" = None) -> str:

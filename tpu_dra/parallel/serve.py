@@ -783,6 +783,26 @@ class ServeEngine:
                 lambda e: None if e is None else e.request_class_stats()
             )(ref_req()),
         )
+        # Capacity ledger (docs/OBSERVABILITY.md "Capacity ledger"):
+        # cumulative occupancy-weighted busy/idle device seconds,
+        # accumulated in tick() so busy + idle tiles the engine's step
+        # wall exactly — the attribution the controller's allocation
+        # ledger joins against.  Weakref provider, lazy import, same
+        # discipline as the two registrations above.
+        self._cap_busy_s = 0.0
+        self._cap_idle_s = 0.0
+        self._cap_steps = 0
+        self._cap_last_step_mono: "float | None" = None
+        from tpu_dra.obs import capacity as obscap
+
+        self._obscap = obscap
+        ref_cap = weakref.ref(self)
+        obscap.register(
+            self.name,
+            lambda: (
+                lambda e: None if e is None else e.capacity_snapshot()
+            )(ref_cap()),
+        )
         # Scrape-time gauges, one series per engine.  The sampler holds a
         # weakref: a collected engine's series retires itself at the next
         # scrape, and close() retires it deterministically.  Two live
@@ -2201,11 +2221,24 @@ class ServeEngine:
                 break
             self._step_once()
         finished = self._done[done_before:]
+        # Wall stamp taken BEFORE the metric observations below, so the
+        # recorded phase fractions divide by the tick the phases
+        # actually tiled, not tick + recording overhead.
+        step_wall = time.perf_counter() - t0
+        # Capacity accounting (telemetry on or off — the controller's
+        # ledger joins against it either way): occupancy-weighted split
+        # so busy + idle tiles Σ step_wall exactly, the conservation
+        # invariant /debug/capacity closes on.  The step stamp advances
+        # only when rows held work — an engine ticking over an empty
+        # batch is NOT producing device steps, which is exactly the
+        # stranded signal.
+        frac = min(1.0, occupancy / self.slots) if self.slots else 0.0
+        self._cap_busy_s += step_wall * frac
+        self._cap_idle_s += step_wall * (1.0 - frac)
+        self._cap_steps += 1
+        if occupancy > 0:
+            self._cap_last_step_mono = time.monotonic()
         if self.telemetry:
-            # Wall stamp taken BEFORE the metric observations below, so
-            # the recorded phase fractions divide by the tick the phases
-            # actually tiled, not tick + recording overhead.
-            step_wall = time.perf_counter() - t0
             phases = dict(self._phase_acc)
             for p, v in phases.items():
                 if v > 0.0:
@@ -2295,6 +2328,7 @@ class ServeEngine:
 
             obskv.unregister(self.name)
         self._obsreq.unregister(self.name)
+        self._obscap.unregister(self.name)
 
     def _check_open(self) -> None:
         if self._closed:
@@ -2647,6 +2681,27 @@ class ServeEngine:
             }
         )
         return snap
+
+    def capacity_snapshot(self) -> dict:
+        """The capacity-ledger provider payload (the
+        ``tpu_dra.obs.capacity`` contract): cumulative occupancy
+        -weighted busy/idle device seconds (busy + idle == Σ tick step
+        wall, the conservation invariant), step count, and the age of
+        the last step that held work — ``None`` age means this engine
+        never stepped an occupied batch, which the ledger reads as
+        stranded once the grace window passes.  Host-side counters
+        only; readable after close()."""
+        last = self._cap_last_step_mono
+        return {
+            "engine": self.name,
+            "slots": self.slots,
+            "busy_s": self._cap_busy_s,
+            "idle_s": self._cap_idle_s,
+            "steps": self._cap_steps,
+            "last_step_age_s": (
+                None if last is None else time.monotonic() - last
+            ),
+        }
 
     @property
     def prefix_stats(self) -> "dict[str, int]":

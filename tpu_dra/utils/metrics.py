@@ -596,7 +596,33 @@ METRIC_SAMPLE_ERRORS = REGISTRY.counter(
 RING_DROPPED = REGISTRY.counter(
     "tpu_dra_ring_dropped_total",
     "Records evicted from bounded telemetry rings by ring name (trace, "
-    "decisions, engine, fleet, requests, obs_alerts)",
+    "decisions, engine, fleet, requests, obs_alerts, capacity)",
+)
+# Capacity ledger (obs/capacity.py): the controller/serve join that
+# attributes every allocated chip-second.  The chip-seconds counter is
+# settled (monotonically) from the ledger on every exposition via the
+# open-claims gauge's sampler, so rate(state="stranded") reads as chips
+# currently stranded.
+CAPACITY_CHIP_SECONDS = REGISTRY.counter(
+    "tpu_dra_capacity_chip_seconds_total",
+    "Allocated chip-seconds attributed by the capacity ledger, by node "
+    "and state (busy | idle | stranded)",
+)
+CAPACITY_UTILIZATION = REGISTRY.gauge(
+    "tpu_dra_capacity_utilization",
+    "Per-engine busy fraction of accounted device time "
+    "(busy_s / (busy_s + idle_s)) from the capacity ledger",
+)
+CAPACITY_OPEN_CLAIMS = REGISTRY.gauge(
+    "tpu_dra_capacity_open_claims",
+    "Claims currently open in the capacity ledger (sampling this gauge "
+    "settles the chip-seconds counters)",
+)
+NODE_FRAGMENTATION_RATIO = REGISTRY.gauge(
+    "tpu_dra_node_fragmentation_ratio",
+    "1 - largest contiguous free subslice / total free chips per node "
+    "(0 = all free chips schedulable as one gang; near 1 = free "
+    "capacity no gang can use)",
 )
 TRACE_SPANS_DROPPED = REGISTRY.counter(
     "tpu_dra_trace_spans_dropped_total",
@@ -768,6 +794,21 @@ def debug_index(server: "MetricsServer") -> dict:
         # no paged pool to introspect, and the index must not pay the
         # import to find out (the ring discipline above).
         endpoints[f"{pprof}/kv"] = kv
+    cap = _ring_info(
+        "tpu_dra.obs.capacity",
+        lambda m: {
+            "kind": "capacity",
+            "open_claims": len(m.open_claims()),
+            "engines": len(m.providers()),
+            "recorded": m.RECORDER.recorded,
+            "dropped": m.RECORDER.dropped,
+        },
+    )
+    if cap is not None:
+        # Loaded by whichever half reaches it first — the controller's
+        # allocation hooks or an engine's provider registration; an
+        # unloaded ledger means no plane pushed capacity data here.
+        endpoints[f"{pprof}/capacity"] = cap
     cluster = _ring_info(
         "tpu_dra.obs.collector",
         lambda m: {
@@ -845,6 +886,8 @@ class MetricsServer:
                         self._send_requests(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/kv":
                         self._send_kv(parse_qs(parsed.query))
+                    elif parsed.path == f"{outer.pprof_path}/capacity":
+                        self._send_capacity(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/fleet":
                         self._send_fleet(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/cluster":
@@ -1030,6 +1073,45 @@ class MetricsServer:
                 )
                 if fmt == "text":
                     self._send(200, obskv.render_text(doc))
+                else:
+                    import json
+
+                    self._send(200, json.dumps(doc), "application/json")
+
+            def _send_capacity(self, query: dict) -> None:
+                # Local import, like its siblings — obs.capacity is
+                # jax-free by design: the controller pushes allocation
+                # lifecycle in, engines push device-step accounting in,
+                # so the same endpoint serves from either binary.
+                from tpu_dra.obs import capacity as obscap
+
+                limit = _query_int(query, "limit", 256, cap=4096)
+                stranded_after = _query_float(
+                    query,
+                    "stranded_after",
+                    obscap.DEFAULT_STRANDED_AFTER_S,
+                    cap=3600.0,
+                )
+                fmt = query.get("format", ["json"])[0]
+                if fmt not in ("json", "text"):
+                    raise _BadQuery(
+                        f"format must be json or text, got {fmt!r}"
+                    )
+                cls = query.get("class", [""])[0] or None
+                if cls is not None and cls not in obscap.CLASSES:
+                    raise _BadQuery(
+                        "class must be one of "
+                        f"{', '.join(obscap.CLASSES)}, got {cls!r}"
+                    )
+                doc = obscap.capacity_doc(
+                    node=query.get("node", [""])[0] or None,
+                    claim=query.get("claim", [""])[0] or None,
+                    cls=cls,
+                    limit=limit,
+                    stranded_after_s=stranded_after,
+                )
+                if fmt == "text":
+                    self._send(200, obscap.render_text(doc))
                 else:
                     import json
 
