@@ -11,7 +11,7 @@ TAG     ?= latest
         observability-smoke perf-smoke explain-smoke serve-smoke \
         serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke paged-smoke \
         kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke \
-        disagg-smoke capacity-smoke
+        disagg-smoke capacity-smoke wave-smoke
 
 # `analyze` runs the full rule registry — the L-style rules lint would
 # run plus the whole-repo invariants — so `all` needs only one pass.
@@ -26,7 +26,10 @@ TAG     ?= latest
 # worst-K/paged operator surfaces), and `disagg-smoke` on a
 # disaggregated-serving regression (block-table handoff identity, tier
 # metrics, the /debug/cluster tier column, PrefillBacklogGrowth).
-all: analyze kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke disagg-smoke capacity-smoke test
+# `wave-smoke` fails fast on a wave-scheduling regression (batch
+# placement, priority preemption + `tpudra explain` Preempted,
+# PreemptionChurn lifecycle, defrag healing /debug/capacity).
+all: analyze kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke disagg-smoke capacity-smoke wave-smoke test
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -212,6 +215,19 @@ obs-scale-smoke:
 capacity-smoke:
 	$(PYTHON) -m pytest tests/test_capacity_smoke.py -q -m 'not slow'
 
+# Wave scheduling floor (docs/SCHEDULING.md "Wave scheduling"): a
+# kubesim cluster in wave mode places a pod burst through the batch
+# planner (wave metrics move), a high-priority whole-node gang preempts
+# strictly-lower-priority singles on a full cluster (`tpudra explain`
+# renders Preempted for every victim, PreemptionChurn walks pending ->
+# firing -> resolved over a real collector), and the wave-idle defrag
+# pass heals a checkerboarded node (fragmentation ratio drops in
+# /debug/capacity, tpu_dra_defrag_migrations_total moves).  The
+# 1024-node wave-vs-per-pod paired measurement is `bench.py` stanza
+# "fanout_128" key "wave_arm".
+wave-smoke:
+	$(PYTHON) -m pytest tests/test_wave_smoke.py -q -m 'not slow'
+
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
@@ -226,5 +242,5 @@ help:
 	@echo "         perf-smoke explain-smoke serve-smoke serve-obs-smoke"
 	@echo "         chaos-smoke fleet-smoke obs-top-smoke paged-smoke"
 	@echo "         kernel-smoke kv-smoke swap-smoke requests-smoke"
-	@echo "         obs-scale-smoke capacity-smoke"
+	@echo "         obs-scale-smoke capacity-smoke wave-smoke"
 	@echo "         image clean"

@@ -354,8 +354,206 @@ def _fleet_scale_once(
             cluster.stop()
 
 
+def _wave_arm(
+    nodes: int, pods: int, obs_endpoints: int, obs_rounds: int
+) -> "dict":
+    """Wave-vs-per-pod paired placement arm (ISSUE 19) at ``nodes`` Ready
+    NAS objects: the per-pod baseline runs the full UnsuitableNodes fan-out
+    plus one NAS commit per pod (the pre-wave reconciler), the wave arm
+    scores the identical pod burst in ONE WavePlanner pass (first-fit
+    probes, node-grouped commits).  Each arm gets its own apiserver+driver
+    so neither warms the other's caches.  Gates (in ``ok``): the wave
+    beats the baseline's placement-completion p95 (paired ratio > 1), its
+    NAS writes stay below the per-pod commit count, both arms place every
+    pod — and the obs plane holds its scrape-round budget at the same
+    endpoint cardinality (the wave fleet is only operable if it is
+    observable at that scale)."""
+    from tpu_dra.api import nas_v1alpha1 as nascrd
+    from tpu_dra.api.k8s import (
+        Pod,
+        ResourceClaim,
+        ResourceClaimSpec,
+        ResourceClass,
+    )
+    from tpu_dra.api.meta import ObjectMeta
+    from tpu_dra.api.tpu_v1alpha1 import (
+        DeviceClassParametersSpec,
+        TpuClaimParametersSpec,
+    )
+    from tpu_dra.client.apiserver import FakeApiServer
+    from tpu_dra.client.clientset import ClientSet
+    from tpu_dra.controller.driver import ControllerDriver
+    from tpu_dra.controller.types import ClaimAllocation
+    from tpu_dra.controller.waves import WaveItem, WavePlanner
+
+    ns = "tpu-dra"
+
+    def make_fleet(prefix):
+        cs = ClientSet(FakeApiServer())
+        nas_client = cs.node_allocation_states(ns)
+        names = [f"{prefix}-n{i:04d}" for i in range(nodes)]
+        for name in names:
+            devices = [
+                nascrd.AllocatableDevice(
+                    tpu=nascrd.AllocatableTpu(
+                        index=j,
+                        uuid=f"{name}-chip-{j}",
+                        coord=(j % 2, j // 2, 0),
+                        ici_domain=name,
+                        cores=4,
+                        hbm_bytes=16 * 1024**3,
+                        product="tpu-v5e",
+                        generation="v5e",
+                        libtpu_version="1.10.0",
+                        runtime_version="2.0.0",
+                    )
+                )
+                for j in range(4)
+            ]
+            nas_client.create(
+                nascrd.NodeAllocationState(
+                    metadata=ObjectMeta(name=name, namespace=ns),
+                    spec=nascrd.NodeAllocationStateSpec(
+                        allocatable_devices=devices, host_topology="2x2x1"
+                    ),
+                    status=nascrd.STATUS_READY,
+                )
+            )
+        driver = ControllerDriver(cs, ns)
+        driver.start_nas_informer()
+        driver.nas_informer.wait_synced(120.0)
+        return cs, driver, names
+
+    def make_workload(cs, prefix):
+        workload = []
+        for p in range(pods):
+            claim = cs.resource_claims(NS).create(
+                ResourceClaim(
+                    metadata=ObjectMeta(name=f"{prefix}-c{p}", namespace=NS),
+                    spec=ResourceClaimSpec(
+                        resource_class_name="tpu.google.com"
+                    ),
+                )
+            )
+            workload.append(
+                (
+                    Pod(
+                        metadata=ObjectMeta(
+                            name=f"{prefix}-p{p}", uid=f"{prefix}u{p}"
+                        )
+                    ),
+                    ClaimAllocation(
+                        claim=claim,
+                        class_=ResourceClass(),
+                        claim_parameters=TpuClaimParametersSpec(count=1),
+                        class_parameters=DeviceClassParametersSpec(True),
+                    ),
+                )
+            )
+        return workload
+
+    def count_writes(driver):
+        box = {"n": 0}
+        orig = driver._note_node_write
+
+        def wrapped(*a, **kw):
+            box["n"] += 1
+            return orig(*a, **kw)
+
+        driver._note_node_write = wrapped
+        return box
+
+    def pct(values, q):
+        s = sorted(values)
+        return s[int(q * (len(s) - 1))] if s else 0.0
+
+    # Per-pod baseline: the scheduler hands pods over one at a time; pod
+    # k's placement completes after k full fan-outs + k commits, so its
+    # completion time is cumulative from the burst's arrival.
+    cs, driver, names = make_fleet("pp")
+    writes = count_writes(driver)
+    completions = []
+    base_placed = 0
+    try:
+        t0 = time.perf_counter()
+        for pod, ca in make_workload(cs, "pp"):
+            driver.unsuitable_nodes(pod, [ca], names)
+            suitable = sorted(set(names) - set(ca.unsuitable_nodes))
+            if suitable:
+                driver.allocate_batch([ca], suitable[0])
+                base_placed += 1
+            completions.append(time.perf_counter() - t0)
+    finally:
+        driver.close()
+    base_writes = writes["n"]
+    base_p95 = pct(completions, 0.95)
+
+    # Wave arm: the identical burst, one planning pass.  Every pod's
+    # placement completes when the wave commits, so the per-pod p95 IS the
+    # wave wall.
+    cs, driver, names = make_fleet("wv")
+    writes = count_writes(driver)
+    try:
+        planner = WavePlanner(driver, cs)
+        items = [
+            WaveItem(
+                pod=pod,
+                cas=[ca],
+                potential_nodes=names,
+                seq=planner.next_seq(),
+            )
+            for pod, ca in make_workload(cs, "wv")
+        ]
+        outcome = planner.run_wave(items)
+    finally:
+        driver.close()
+    wave_writes = writes["n"]
+    wave_p95 = outcome.wall_s
+
+    obs = bench_obs_scale(endpoints=obs_endpoints, rounds=obs_rounds)
+    obs_ok = bool(obs.get("ok")) and (
+        obs.get("round_wall_p95_s", float("inf"))
+        < obs.get("round_p95_budget_s", 0.0)
+    )
+
+    speedup = base_p95 / wave_p95 if wave_p95 > 0 else 0.0
+    return {
+        "nodes": nodes,
+        "pods": pods,
+        "baseline_place_p50_s": round(pct(completions, 0.50), 4),
+        "baseline_place_p95_s": round(base_p95, 4),
+        "baseline_placed": base_placed,
+        "baseline_nas_writes": base_writes,
+        "wave_wall_s": round(outcome.wall_s, 4),
+        "wave_place_p95_s": round(wave_p95, 4),
+        "wave_placed": len(outcome.placed),
+        "wave_nas_writes": wave_writes,
+        "wave_nodes_committed": outcome.nodes_committed,
+        "place_p95_speedup": round(speedup, 2),
+        "obs_scale": {
+            "endpoints": obs.get("endpoints"),
+            "rounds": obs.get("rounds"),
+            "round_wall_p95_s": obs.get("round_wall_p95_s"),
+            "round_p95_budget_s": obs.get("round_p95_budget_s"),
+            "ok": obs_ok,
+            **(
+                {"error": obs["error"]} if "error" in obs else {}
+            ),
+        },
+        "ok": bool(
+            base_placed == pods
+            and len(outcome.placed) == pods
+            and speedup > 1.0
+            and wave_writes < base_writes
+            and obs_ok
+        ),
+    }
+
+
 def bench_fanout_scale(
-    nodes: int = 128, pods: int = 16, passes: int = 6
+    nodes: int = 128, pods: int = 16, passes: int = 6,
+    wave_nodes: int = 1024, wave_pods: int = 64,
+    obs_endpoints: int = 1024, obs_rounds: int = 3,
 ) -> "dict":
     """Isolated UnsuitableNodes fan-out at 2x the north-star node count
     (ISSUE 2 acceptance: fan-out p95 and placement-cache hit rate at 128
@@ -497,7 +695,7 @@ def bench_fanout_scale(
     def pct(values, q):
         return values[int(q * (len(values) - 1))] if values else 0.0
 
-    return {
+    out = {
         "nodes": nodes,
         "pods": pods,
         "passes": passes * 2,
@@ -511,6 +709,13 @@ def bench_fanout_scale(
         "placement_cache_hits": hits,
         "placement_cache_misses": misses,
     }
+    try:
+        out["wave_arm"] = _wave_arm(
+            wave_nodes, wave_pods, obs_endpoints, obs_rounds
+        )
+    except Exception as exc:  # pragma: no cover - diagnostics only
+        out["wave_arm"] = {"ok": False, "error": repr(exc)}
+    return out
 
 
 def bench_wire(samples: int = 8) -> "dict":

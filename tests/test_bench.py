@@ -302,14 +302,30 @@ def test_bench_chaos_stanza():
 def test_bench_fanout_scale_small():
     """The isolated fan-out stanza (ISSUE 2): probes complete, the report
     carries the acceptance keys, and the repeated-wave workload actually
-    hits the placement cache."""
+    hits the placement cache.  The wave arm (ISSUE 19) rides along at a
+    CI-friendly size: both arms place every pod and the wave's
+    node-grouped commit writes the NAS strictly fewer times than the
+    per-pod baseline (the speedup ratio is reported but not gated here —
+    at toy sizes the paired timing is noise; the 1024-node run gates
+    it)."""
     import bench
 
-    out = bench.bench_fanout_scale(nodes=12, pods=4, passes=3)
+    out = bench.bench_fanout_scale(
+        nodes=12, pods=4, passes=3,
+        wave_nodes=12, wave_pods=8, obs_endpoints=8, obs_rounds=2,
+    )
     assert out["nodes"] == 12
     assert out["fanout_samples"] > 0
     assert 0 <= out["fanout_p50_s"] <= out["fanout_p95_s"] < 30
     assert out["placement_cache_hit_rate"] > 0.5
+    arm = out["wave_arm"]
+    assert "error" not in arm, arm
+    assert arm["baseline_placed"] == 8 and arm["wave_placed"] == 8
+    assert arm["wave_nas_writes"] < arm["baseline_nas_writes"]
+    assert arm["wave_nas_writes"] == arm["wave_nodes_committed"]
+    assert arm["place_p95_speedup"] > 0
+    assert arm["obs_scale"]["endpoints"] == 8
+    assert arm["obs_scale"]["ok"], arm["obs_scale"]
 
 
 def test_bench_wire_small():

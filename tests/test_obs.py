@@ -418,6 +418,7 @@ class TestDefaultRules:
             "FleetQueueGrowth",
             "PrefillBacklogGrowth",
             "ClaimEvictionSpike",
+            "PreemptionChurn",
             "FleetDigestStale",
             "KVPoolPressure",
             "KVSwapThrash",

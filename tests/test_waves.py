@@ -1,0 +1,530 @@
+"""Wave-planned scheduling tests (controller/waves.py): batch scoring vs
+the sequential per-pod path, priority ordering, preemption (strictly-lower
+only), defragmentation, and the node-grouped commit's double-booking guard.
+"""
+
+import uuid as uuidlib
+
+import pytest
+
+from helpers import make_plugin_stack
+from tpu_dra.api import nas_v1alpha1 as nascrd
+from tpu_dra.api.k8s import (
+    Pod,
+    ResourceClaim,
+    ResourceClaimSpec,
+    ResourceClass,
+)
+from tpu_dra.api.meta import ObjectMeta
+from tpu_dra.api.tpu_v1alpha1 import (
+    DeviceClassParametersSpec,
+    TpuClaimParametersSpec,
+)
+from tpu_dra.client import ClientSet, FakeApiServer, NasClient
+from tpu_dra.controller import decisions
+from tpu_dra.controller.availability import compute_free_chips
+from tpu_dra.controller.driver import ControllerDriver
+from tpu_dra.controller.types import ClaimAllocation
+from tpu_dra.controller.waves import (
+    WaveItem,
+    WavePlanner,
+    requested_chips,
+)
+from tpu_dra.plugin.driver import NodeDriver
+from tpu_dra.api import serde
+from tpu_dra.utils.metrics import (
+    CLAIM_PREEMPTIONS,
+    DEFRAG_MIGRATIONS,
+    WAVE_PODS,
+)
+
+NS = "default"
+DRIVER_NS = "tpu-dra"
+
+
+def build_fleet(tmp_path, n_nodes, mesh="2x2x1"):
+    """A Ready fleet over a fresh fake apiserver: real node plugins publish
+    the NAS objects, the controller driver's informer tracks them."""
+    cs = ClientSet(FakeApiServer())
+    driver = ControllerDriver(cs, DRIVER_NS)
+    nodes = [f"node-{i}" for i in range(n_nodes)]
+    for node in nodes:
+        _, _, state = make_plugin_stack(tmp_path / node, cs, node=node, mesh=mesh)
+        nas = nascrd.NodeAllocationState(
+            metadata=ObjectMeta(name=node, namespace=DRIVER_NS)
+        )
+        NodeDriver(nas, NasClient(nas, cs), state, start_gc=False)
+    driver.start_nas_informer()
+    assert driver.nas_informer.wait_synced(5.0)
+    return cs, driver, nodes
+
+
+def make_workload(cs, name, *, priority=0, count=None, topology=None):
+    """A (pod, ClaimAllocation) pair over a real apiserver claim."""
+    pod = Pod(
+        metadata=ObjectMeta(
+            name=f"pod-{name}", namespace=NS, uid=str(uuidlib.uuid4())
+        )
+    )
+    cs.pods(NS).create(pod)
+    claim = cs.resource_claims(NS).create(
+        ResourceClaim(
+            metadata=ObjectMeta(name=f"claim-{name}", namespace=NS),
+            spec=ResourceClaimSpec(resource_class_name="tpu.google.com"),
+        )
+    )
+    if count is None and topology is None:
+        count = 1  # the driver's parameter defaulting, done by hand
+    ca = ClaimAllocation(
+        claim=claim,
+        class_=ResourceClass(),
+        claim_parameters=TpuClaimParametersSpec(
+            count=count, topology=topology, priority=priority
+        ),
+        class_parameters=DeviceClassParametersSpec(True),
+    )
+    return pod, ca
+
+
+def make_item(planner, nodes, pod, *cas):
+    return WaveItem(
+        pod=pod,
+        cas=list(cas),
+        potential_nodes=list(nodes),
+        seq=planner.next_seq(),
+    )
+
+
+def count_nas_writes(driver):
+    """Wrap the driver's committed-NAS-write hook with a counter (the
+    FakeApiServer has no request ledger; every locked GET+UPDATE commit
+    lands exactly one `_note_node_write`)."""
+    counter = {"n": 0}
+    orig = driver._note_node_write
+
+    def counting(*args, **kwargs):
+        counter["n"] += 1
+        return orig(*args, **kwargs)
+
+    driver._note_node_write = counting
+    return counter
+
+
+def drain_deallocations(cs, driver):
+    """Stand in for the reconciler's _sync_claim deallocation half: release
+    every claim whose eviction requested it (the tests drive this
+    synchronously instead of running worker threads)."""
+    drained = 0
+    for claim in cs.resource_claims(NS).list():
+        if not claim.status.deallocation_requested or claim.status.reserved_for:
+            continue
+        if claim.status.allocation is not None:
+            driver.deallocate(claim)
+            claim.status.allocation = None
+            claim.status.driver_name = ""
+        claim.status.deallocation_requested = False
+        cs.resource_claims(NS).update_status(claim)
+        drained += 1
+    return drained
+
+
+class TestRequestedChips:
+    def test_count_topology_and_default(self):
+        assert requested_chips(
+            ClaimAllocation(
+                claim=ResourceClaim(),
+                class_=ResourceClass(),
+                claim_parameters=TpuClaimParametersSpec(topology="2x2x1"),
+            )
+        ) == 4
+        assert requested_chips(
+            ClaimAllocation(
+                claim=ResourceClaim(),
+                class_=ResourceClass(),
+                claim_parameters=TpuClaimParametersSpec(count=3),
+            )
+        ) == 3
+        assert requested_chips(
+            ClaimAllocation(
+                claim=ResourceClaim(),
+                class_=ResourceClass(),
+                claim_parameters=TpuClaimParametersSpec(),
+            )
+        ) == 1
+
+
+class TestWaveEquivalence:
+    def test_wave_matches_sequential_with_fewer_nas_writes(self, tmp_path):
+        """Uncontended cluster: the wave places every pod on the same node
+        the sequential fan-out+commit would, with fewer NAS writes (one per
+        node touched, not one per pod)."""
+        pods = 4
+
+        # Sequential baseline: full fan-out, then a per-pod commit.
+        cs_a, driver_a, nodes = build_fleet(tmp_path / "seq", 2)
+        writes_a = count_nas_writes(driver_a)
+        seq_nodes = {}
+        try:
+            for i in range(pods):
+                pod, ca = make_workload(cs_a, f"s{i}")
+                driver_a.unsuitable_nodes(pod, [ca], nodes)
+                target = sorted(set(nodes) - set(ca.unsuitable_nodes))[0]
+                driver_a.allocate_batch([ca], target)
+                seq_nodes[f"s{i}"] = target
+        finally:
+            driver_a.close()
+
+        # Wave: one batched pass.
+        cs_b, driver_b, nodes = build_fleet(tmp_path / "wave", 2)
+        writes_b = count_nas_writes(driver_b)
+        try:
+            planner = WavePlanner(driver_b, cs_b)
+            items = []
+            for i in range(pods):
+                pod, ca = make_workload(cs_b, f"w{i}")
+                items.append(make_item(planner, nodes, pod, ca))
+            placed0 = WAVE_PODS.value(outcome="placed")
+            outcome = planner.run_wave(items)
+        finally:
+            driver_b.close()
+
+        assert len(outcome.placed) == pods and not outcome.deferred
+        assert WAVE_PODS.value(outcome="placed") - placed0 == pods
+        wave_nodes = {
+            it.pod.metadata.name.removeprefix("pod-w"): it.assigned_node
+            for it in outcome.placed
+        }
+        assert wave_nodes == {
+            k.removeprefix("s"): v for k, v in seq_nodes.items()
+        }
+        # Same placements, but committed node-grouped: every pod fits on
+        # node-0, so the wave pays ONE NAS write where sequential paid one
+        # per pod.
+        assert writes_a["n"] == pods
+        assert writes_b["n"] == outcome.nodes_committed == 1
+        # Both claims' allocations are live in the wave fleet's NAS.
+        nas = cs_b.node_allocation_states(DRIVER_NS).get("node-0")
+        assert len(nas.spec.allocated_claims) == pods
+
+    def test_priority_orders_before_fifo(self, tmp_path):
+        """On a node with room for one pod, a higher-priority item enqueued
+        LATER beats the earlier low-priority item."""
+        cs, driver, nodes = build_fleet(tmp_path, 1)
+        try:
+            planner = WavePlanner(driver, cs)
+            pod_low, ca_low = make_workload(cs, "low", priority=0, count=3)
+            pod_high, ca_high = make_workload(cs, "high", priority=5, count=3)
+            low_item = make_item(planner, nodes, pod_low, ca_low)
+            high_item = make_item(planner, nodes, pod_high, ca_high)
+            outcome = planner.run_wave([low_item, high_item])
+            assert [it.pod.metadata.name for it in outcome.placed] == [
+                "pod-high"
+            ]
+            assert [it.pod.metadata.name for it in outcome.deferred] == [
+                "pod-low"
+            ]
+        finally:
+            driver.close()
+
+
+class TestPreemption:
+    def test_equal_priority_never_preempts(self, tmp_path):
+        """The serve-layer livelock rule: an unplaceable item never evicts
+        allocations of its OWN priority class."""
+        cs, driver, nodes = build_fleet(tmp_path, 1)
+        try:
+            planner = WavePlanner(driver, cs)
+            pod_a, ca_a = make_workload(cs, "a", priority=5, count=4)
+            outcome = planner.run_wave(
+                [make_item(planner, nodes, pod_a, ca_a)]
+            )
+            assert len(outcome.placed) == 1
+
+            preempt0 = CLAIM_PREEMPTIONS.total()
+            pod_b, ca_b = make_workload(cs, "b", priority=5, count=4)
+            outcome = planner.run_wave(
+                [make_item(planner, nodes, pod_b, ca_b)]
+            )
+            assert len(outcome.deferred) == 1 and not outcome.preempted_for
+            assert outcome.preemptions == 0
+            assert CLAIM_PREEMPTIONS.total() == preempt0
+            victim = cs.resource_claims(NS).get("claim-a")
+            assert not victim.status.deallocation_requested
+            assert not decisions.has_eviction_record(
+                victim.metadata.uid, "node-0"
+            )
+        finally:
+            driver.close()
+
+    def test_preemption_evicts_lower_and_gang_replaces(self, tmp_path):
+        """A priority-5 gang displaces a priority-0 allocation: victims get
+        the Preempted record + deallocationRequested, the node is held
+        against low-priority back-fill, and once the victims drain the gang
+        places on the freed chips."""
+        cs, driver, nodes = build_fleet(tmp_path, 1)
+        try:
+            planner = WavePlanner(driver, cs)
+            pod_v, ca_v = make_workload(cs, "victim", priority=0, count=4)
+            outcome = planner.run_wave(
+                [make_item(planner, nodes, pod_v, ca_v)]
+            )
+            assert len(outcome.placed) == 1
+            victim_uid = ca_v.claim.metadata.uid
+
+            preempt0 = CLAIM_PREEMPTIONS.value(reason="priority")
+            pod_g, ca_g = make_workload(cs, "gang", priority=5, topology="2x2x1")
+            item = make_item(planner, nodes, pod_g, ca_g)
+            outcome = planner.run_wave([item])
+            assert [it.outcome for it in outcome.preempted_for] == [
+                "preempted_for"
+            ]
+            assert outcome.preemptions == 1
+            assert CLAIM_PREEMPTIONS.value(reason="priority") - preempt0 == 1
+            victim = cs.resource_claims(NS).get("claim-victim")
+            assert victim.status.deallocation_requested
+            assert not victim.status.reserved_for
+            assert decisions.has_eviction_record(victim_uid, "node-0")
+            # The consuming pod was deleted with it.
+            from tpu_dra.client.apiserver import NotFoundError
+
+            with pytest.raises(NotFoundError):
+                cs.pods(NS).get("pod-victim")
+            # The freed node is held against lower-priority probes, open to
+            # the beneficiary's class.
+            assert driver.preemption_holds.blocks("node-0", 0) is not None
+            assert driver.preemption_holds.blocks("node-0", 5) is None
+
+            # Drain the eviction (the reconciler's _sync_claim half), then
+            # the next wave lands the gang on the freed chips.
+            assert drain_deallocations(cs, driver) == 1
+            pod_g2 = cs.pods(NS).get("pod-gang")
+            ca_g2 = ClaimAllocation(
+                claim=cs.resource_claims(NS).get("claim-gang"),
+                class_=ResourceClass(),
+                claim_parameters=ca_g.claim_parameters,
+                class_parameters=ca_g.class_parameters,
+            )
+            outcome = planner.run_wave(
+                [make_item(planner, nodes, pod_g2, ca_g2)]
+            )
+            assert len(outcome.placed) == 1
+            assert outcome.placed[0].assigned_node == "node-0"
+            # Beneficiary committed: the hold is gone.
+            assert driver.preemption_holds.blocks("node-0", 0) is None
+            nas = cs.node_allocation_states(DRIVER_NS).get("node-0")
+            assert set(nas.spec.allocated_claims) == {
+                ca_g2.claim.metadata.uid
+            }
+        finally:
+            driver.close()
+
+
+class TestDefrag:
+    def test_defrag_opens_contiguous_subslice(self, tmp_path):
+        """Checkerboarded node (free chips exist but no contiguous pair):
+        the defrag pass migrates the scattered holders; their re-placement
+        packs, leaving a contiguous free block."""
+        cs, driver, nodes = build_fleet(tmp_path, 1)
+        try:
+            planner = WavePlanner(driver, cs)
+            # Fill the 4-chip node with four 1-chip claims.
+            singles = []
+            for i in range(4):
+                pod, ca = make_workload(cs, f"d{i}", count=1)
+                singles.append(ca)
+            outcome = planner.run_wave(
+                [
+                    make_item(
+                        planner, nodes, cs.pods(NS).get(f"pod-d{i}"), ca
+                    )
+                    for i, ca in enumerate(singles)
+                ]
+            )
+            assert len(outcome.placed) == 4
+
+            # Checkerboard: free the two claims holding one diagonal, and
+            # release the survivors' pod reservations (defrag only migrates
+            # claims with no live consumers).
+            nas = cs.node_allocation_states(DRIVER_NS).get("node-0")
+            coord_of = {
+                uid: alloc.tpu.devices[0].uuid
+                for uid, alloc in nas.spec.allocated_claims.items()
+            }
+            # The node is full, so compute_free_chips is empty; read chip
+            # coords straight off the allocatable table instead.
+            chips = {
+                d.tpu.uuid: d.tpu.coord
+                for d in nas.spec.allocatable_devices
+                if d.tpu is not None
+            }
+            diagonal = {(0, 1, 0), (1, 0, 0)}
+            survivors = []
+            for ca in singles:
+                claim = cs.resource_claims(NS).get(ca.claim.metadata.name)
+                if chips[coord_of[claim.metadata.uid]] in diagonal:
+                    # These two finish and leave: deallocate + delete.
+                    driver.deallocate(claim)
+                    claim.status.allocation = None
+                    claim.status.reserved_for = []
+                    claim = cs.resource_claims(NS).update_status(claim)
+                    claim.metadata.finalizers = []
+                    cs.resource_claims(NS).update(claim)
+                    cs.resource_claims(NS).delete(claim.metadata.name)
+                else:
+                    claim.status.reserved_for = []
+                    cs.resource_claims(NS).update_status(claim)
+                    survivors.append(ca)
+
+            nas = cs.node_allocation_states(DRIVER_NS).get("node-0")
+            free = [c.coord for c in compute_free_chips(nas).values()]
+            from tpu_dra.obs.capacity import largest_contiguous_block
+
+            assert len(free) == 2
+            assert largest_contiguous_block(free) == 1  # checkerboarded
+
+            migrations0 = DEFRAG_MIGRATIONS.total()
+            assert planner.defrag_tick(target_chips=2) == 2
+            assert DEFRAG_MIGRATIONS.total() - migrations0 == 2
+            assert CLAIM_PREEMPTIONS.value(reason="defrag") >= 2
+
+            # Drain the migrations and re-place the claims (immediate-mode
+            # re-placement in the reconciler; driven synchronously here) —
+            # place_count packs, so the remaining free pair is contiguous.
+            assert drain_deallocations(cs, driver) == 2
+            for ca in survivors:
+                claim = cs.resource_claims(NS).get(ca.claim.metadata.name)
+                if claim.status.allocation is not None:
+                    continue
+                allocation = driver.allocate(
+                    claim,
+                    ca.claim_parameters,
+                    ResourceClass(),
+                    ca.class_parameters,
+                    "",
+                )
+                claim.status.allocation = allocation
+                cs.resource_claims(NS).update_status(claim)
+            nas = cs.node_allocation_states(DRIVER_NS).get("node-0")
+            free = [c.coord for c in compute_free_chips(nas).values()]
+            assert len(free) == 2
+            assert largest_contiguous_block(free) == 2  # subslice opened
+        finally:
+            driver.close()
+
+    def test_defrag_skips_reserved_and_high_priority(self, tmp_path):
+        """Claims with live consumers or above the defrag priority ceiling
+        are never migrated, even on a fragmented node."""
+        cs, driver, nodes = build_fleet(tmp_path, 1)
+        try:
+            planner = WavePlanner(driver, cs)
+            pods = {}
+            for i, prio in enumerate([0, 3, 0, 0]):
+                pod, ca = make_workload(cs, f"k{i}", count=1, priority=prio)
+                pods[i] = (pod, ca)
+            outcome = planner.run_wave(
+                [
+                    make_item(planner, nodes, pod, ca)
+                    for pod, ca in pods.values()
+                ]
+            )
+            assert len(outcome.placed) == 4
+            # Free k2+k3 (whatever they hold): claims k0 (reserved) and k1
+            # (priority 3) stay; neither is migratable.
+            for i in (2, 3):
+                claim = cs.resource_claims(NS).get(f"claim-k{i}")
+                driver.deallocate(claim)
+                claim.status.allocation = None
+                claim.status.reserved_for = []
+                cs.resource_claims(NS).update_status(claim)
+            # k1 drops its consumer but keeps priority 3 > ceiling 0.
+            claim = cs.resource_claims(NS).get("claim-k1")
+            claim.status.reserved_for = []
+            cs.resource_claims(NS).update_status(claim)
+
+            migrations0 = DEFRAG_MIGRATIONS.total()
+            planner.defrag_tick(target_chips=2)
+            assert DEFRAG_MIGRATIONS.total() == migrations0
+            assert not cs.resource_claims(NS).get(
+                "claim-k0"
+            ).status.deallocation_requested
+            assert not cs.resource_claims(NS).get(
+                "claim-k1"
+            ).status.deallocation_requested
+        finally:
+            driver.close()
+
+
+class TestCommitGuard:
+    def test_forged_stale_pick_cannot_double_book(self, tmp_path):
+        """Node-grouped commit regression: if a second pod's pending pick
+        was seeded from a stale/forged snapshot and overlaps the first
+        pod's chips, the promote-time guard under the node lock rejects it
+        — the batch commits the first pod, defers the second, and the NAS
+        holds each chip exactly once."""
+        cs, driver, nodes = build_fleet(tmp_path, 1)
+        try:
+            planner = WavePlanner(driver, cs)
+            pod_a, ca_a = make_workload(cs, "a", count=2)
+            pod_b, ca_b = make_workload(cs, "b", count=2)
+            # Probe A for real (seeds its pending pick on node-0)...
+            assert driver.probe_node(pod_a, [ca_a], "node-0")
+            # ...then forge B's pick as a byte-copy of A's — the exact
+            # double-booking a stale availability snapshot would produce.
+            pick_a = driver.tpu.pending_allocated_claims.get(
+                ca_a.claim.metadata.uid, "node-0"
+            )
+            forged = serde.deepcopy(pick_a)
+            forged.claim_info = nascrd.ClaimInfo(
+                name=ca_b.claim.metadata.name,
+                namespace=NS,
+                uid=ca_b.claim.metadata.uid,
+            )
+            driver.tpu.pending_allocated_claims.set(
+                ca_b.claim.metadata.uid, "node-0", forged
+            )
+
+            item_a = make_item(planner, nodes, pod_a, ca_a)
+            item_b = make_item(planner, nodes, pod_b, ca_b)
+            item_a.assigned_node = item_b.assigned_node = "node-0"
+            failed = planner._commit_node("node-0", [item_a, item_b])
+
+            # The promote guard fired under the node lock: the forged pick
+            # was dropped with a conflict record and the batch aborted with
+            # only the already-promoted prefix in the NAS — at no point
+            # does any chip have two owners.  Both items defer (the abort
+            # discards the batch results; the prefix heals via the
+            # idempotent-retry path next wave).
+            assert len(failed) == 2
+            nas = cs.node_allocation_states(DRIVER_NS).get("node-0")
+            owners = {}
+            for uid, alloc in nas.spec.allocated_claims.items():
+                for dev in alloc.tpu.devices:
+                    owners.setdefault(dev.uuid, []).append(uid)
+            assert all(len(v) == 1 for v in owners.values())
+            assert ca_b.claim.metadata.uid not in nas.spec.allocated_claims
+
+            # Retry wave (the reconciler re-syncs deferred pods): the
+            # prefix-committed claim is handed its existing allocation, the
+            # forged claim re-probes fresh, and BOTH pods land on disjoint
+            # chips.
+            outcome = planner.run_wave(
+                [
+                    make_item(planner, nodes, pod_a, ca_a),
+                    make_item(planner, nodes, pod_b, ca_b),
+                ]
+            )
+            assert len(outcome.placed) == 2
+            nas = cs.node_allocation_states(DRIVER_NS).get("node-0")
+            owners = {}
+            for uid, alloc in nas.spec.allocated_claims.items():
+                for dev in alloc.tpu.devices:
+                    owners.setdefault(dev.uuid, []).append(uid)
+            assert sorted(owners) and all(
+                len(v) == 1 for v in owners.values()
+            )
+            assert set(nas.spec.allocated_claims) == {
+                ca_a.claim.metadata.uid,
+                ca_b.claim.metadata.uid,
+            }
+        finally:
+            driver.close()

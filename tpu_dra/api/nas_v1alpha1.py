@@ -44,11 +44,17 @@ STATUS_NOT_READY = "NotReady"
 
 @dataclass
 class ClaimInfo:
-    """Identifying info about a claim (nas.go:24-28)."""
+    """Identifying info about a claim (nas.go:24-28).
+
+    ``priority`` is the claim's wave-scheduling priority class, copied from
+    the claim parameters at allocation time so preemption victim selection
+    can read it straight off the NAS without a claim-parameters round trip.
+    """
 
     namespace: str = ""
     name: str = ""
     uid: str = ""
+    priority: int = 0
 
 
 @dataclass
@@ -185,6 +191,21 @@ class AllocatedDevices:
         if self.core is not None:
             return CORE_DEVICE_TYPE
         return UNKNOWN_DEVICE_TYPE
+
+
+def chips_held(allocated: AllocatedDevices) -> int:
+    """Whole chips a claim holds: tpu claims hold their devices outright;
+    subslice/core claims hold their parent chips (availability pops whole
+    parents for them, so the chip is unschedulable for anyone else).  Both
+    the capacity ledger and preemption victim selection charge a claim for
+    the silicon it fences, not the fraction it carves."""
+    if allocated.tpu is not None:
+        return len(allocated.tpu.devices)
+    if allocated.subslice is not None:
+        return len({d.parent_uuid for d in allocated.subslice.devices})
+    if allocated.core is not None:
+        return len({d.parent_uuid for d in allocated.core.devices})
+    return 0
 
 
 @dataclass

@@ -175,6 +175,11 @@ class TpuClaimParametersSpec:
     selector: TpuSelector | None = None
     sharing: TpuSharing | None = None
     gang: GangConfig | None = None
+    # Scheduling priority class (TPU-first surface, no reference analog):
+    # higher wins during wave planning; a wave may preempt STRICTLY lower
+    # priority allocations to place this claim (equal priority never
+    # preempts — the livelock rule).  Defaults to 0.
+    priority: int | None = None
 
 
 @dataclass
@@ -190,6 +195,7 @@ class SubsliceClaimParametersSpec:
     profile: str = ""
     sharing: SubsliceSharing | None = None
     tpu_claim_name: str = field(default="", metadata={"json": "tpuClaimName"})
+    priority: int | None = None  # wave-scheduling priority class (default 0)
 
 
 @dataclass
@@ -211,6 +217,7 @@ class CoreClaimParametersSpec:
 
     profile: str = ""
     subslice_claim_name: str = field(default="", metadata={"json": "subsliceClaimName"})
+    priority: int | None = None  # wave-scheduling priority class (default 0)
 
 
 @dataclass
@@ -239,19 +246,29 @@ def default_tpu_claim_parameters_spec(
     new = serde.deepcopy(spec) if spec is not None else TpuClaimParametersSpec()
     if new.count is None and new.topology is None:
         new.count = 1
+    if new.priority is None:
+        new.priority = 0
     return new
 
 
 def default_subslice_claim_parameters_spec(
     spec: SubsliceClaimParametersSpec | None,
 ) -> SubsliceClaimParametersSpec:
-    return serde.deepcopy(spec) if spec is not None else SubsliceClaimParametersSpec()
+    new = (
+        serde.deepcopy(spec) if spec is not None else SubsliceClaimParametersSpec()
+    )
+    if new.priority is None:
+        new.priority = 0
+    return new
 
 
 def default_core_claim_parameters_spec(
     spec: CoreClaimParametersSpec | None,
 ) -> CoreClaimParametersSpec:
-    return serde.deepcopy(spec) if spec is not None else CoreClaimParametersSpec()
+    new = serde.deepcopy(spec) if spec is not None else CoreClaimParametersSpec()
+    if new.priority is None:
+        new.priority = 0
+    return new
 
 
 __all__ = [

@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from tpu_dra.api import nas_v1alpha1 as nascrd
 from tpu_dra.api.topology import Placement
@@ -87,6 +87,14 @@ class NodeSnapshot:
     # Free core intervals inside each allocated subslice claim:
     # parent claim uid -> unit-size free placements.
     core_free_intervals: "dict[str, list[Placement]]"
+    # Wave-priority accounting over the same merged (NAS + pending)
+    # document the free maps were computed from: claim uid ->
+    # (priority, whole chips held).  The preemption planner's victim
+    # facts — who holds silicon on this node and at what class — without
+    # a claim-parameters round trip per candidate (controller/waves.py).
+    allocated_priorities: "dict[str, tuple[int, int]]" = field(
+        default_factory=dict
+    )
 
     @property
     def fingerprint(self) -> tuple:
@@ -225,6 +233,13 @@ def build_snapshot(
         free_chips=compute_free_chips(crd),
         subslice_candidates=compute_subslice_candidates(crd),
         core_free_intervals=compute_core_free_intervals(crd),
+        allocated_priorities={
+            uid: (
+                alloc.claim_info.priority if alloc.claim_info else 0,
+                nascrd.chips_held(alloc),
+            )
+            for uid, alloc in crd.spec.allocated_claims.items()
+        },
     )
 
 

@@ -37,7 +37,11 @@ from tpu_dra.controller import decisions
 from tpu_dra.controller.availability import NodeSnapshot, compute_free_intervals
 from tpu_dra.controller.decisions import ReasonCode
 from tpu_dra.controller.pending import PerNodeAllocatedClaims
-from tpu_dra.controller.types import ClaimAllocation
+from tpu_dra.controller.types import (
+    ClaimAllocation,
+    claim_priority,
+    validate_priority,
+)
 
 OnSuccessCallback = Callable[[], None]
 
@@ -88,6 +92,7 @@ class CoreDriver:
                 "core claim requires subsliceClaimName (the shared subslice "
                 "claim the cores are carved from)"
             )
+        validate_priority(params.priority)
 
     def allocate(
         self,
@@ -214,6 +219,7 @@ class CoreDriver:
                     namespace=ca.claim.metadata.namespace,
                     name=ca.claim.metadata.name,
                     uid=claim_uid,
+                    priority=claim_priority(ca.claim_parameters),
                 ),
                 core=nascrd.AllocatedCores(
                     devices=[

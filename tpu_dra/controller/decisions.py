@@ -62,6 +62,10 @@ class ReasonCode:
     # Commit-time staleness: a pending pick conflicted with committed state
     # under the node lock (promote guard) and was dropped for re-placement.
     STALE_NAS = "StaleNAS"
+    # Wave scheduling (controller/waves.py): the allocation was evicted for
+    # a strictly-higher-priority placement (or a defrag migration), or the
+    # probe bounced off a node held open while such a preemption drains.
+    PREEMPTED = "Preempted"
 
     ALL = (
         INSUFFICIENT_CHIPS,
@@ -74,6 +78,7 @@ class ReasonCode:
         NODE_NOT_READY,
         NAS_GET_FAILED,
         STALE_NAS,
+        PREEMPTED,
     )
 
 
@@ -295,18 +300,20 @@ def record_conflict(claim, node: str, detail: str) -> None:
     )
 
 
-def record_eviction(claim, node: str, detail: str) -> None:
-    """Flight-record a node-failure eviction: the claim was allocated on
-    ``node``, the node went NotReady, and recovery (the sweep in
-    controller/recovery.py, or the deallocate path draining a dead node)
-    is moving it so the claim (and its gang) re-places on survivors.  The
-    record is the victim's explanation — `tpudra explain <claim>` shows
-    the eviction beside the subsequent re-placement verdicts.  Callers
-    dedupe per incident; this also moves
-    ``tpu_dra_claim_evictions_total{reason=NodeNotReady}``."""
+def record_eviction(
+    claim, node: str, detail: str, reason: str = ReasonCode.NODE_NOT_READY
+) -> None:
+    """Flight-record an eviction: the claim was allocated on ``node`` and
+    is being moved — because the node went NotReady (recovery sweep /
+    dead-node drain, the default reason) or because wave scheduling
+    preempted it for a higher-priority placement or a defrag migration
+    (``reason=ReasonCode.PREEMPTED``).  The record is the victim's
+    explanation — `tpudra explain <claim>` shows the eviction beside the
+    subsequent re-placement verdicts.  Callers dedupe per incident; this
+    also moves ``tpu_dra_claim_evictions_total{reason=}``."""
     from tpu_dra.utils.metrics import CLAIM_EVICTIONS
 
-    CLAIM_EVICTIONS.inc(reason=ReasonCode.NODE_NOT_READY)
+    CLAIM_EVICTIONS.inc(reason=reason)
     RECORDER.record(
         DecisionRecord(
             namespace=claim.metadata.namespace,
@@ -314,7 +321,7 @@ def record_eviction(claim, node: str, detail: str) -> None:
             claim=claim.metadata.name,
             node=node,
             verdict=EVICTED,
-            reason=ReasonCode.NODE_NOT_READY,
+            reason=reason,
             detail=detail,
         )
     )

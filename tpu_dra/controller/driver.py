@@ -42,7 +42,12 @@ from tpu_dra.controller.decisions import ReasonCode
 from tpu_dra.controller.nodelock import PerNodeMutex
 from tpu_dra.controller.subslice_allocator import SubsliceDriver
 from tpu_dra.controller.tpu_allocator import TpuDriver
-from tpu_dra.controller.types import ClaimAllocation, params_fingerprint
+from tpu_dra.controller.types import (
+    ClaimAllocation,
+    PreemptionHolds,
+    claim_priority,
+    params_fingerprint,
+)
 from tpu_dra.utils import trace
 from tpu_dra.client.events import parse_time
 from tpu_dra.utils.metrics import (
@@ -63,19 +68,8 @@ DRIVER_API_GROUP = tpucrd.GROUP_NAME
 logger = logging.getLogger(__name__)
 
 
-def _capacity_chips(allocated: "nascrd.AllocatedDevices") -> int:
-    """Whole chips a claim holds for capacity-ledger accounting: tpu
-    claims hold their devices outright; subslice/core claims hold
-    their parent chips (availability pops whole parents for them, so
-    the chip is unschedulable for anyone else — the ledger charges the
-    claim for the silicon it fences, not the fraction it carves)."""
-    if allocated.tpu is not None:
-        return len(allocated.tpu.devices)
-    if allocated.subslice is not None:
-        return len({d.parent_uuid for d in allocated.subslice.devices})
-    if allocated.core is not None:
-        return len({d.parent_uuid for d in allocated.core.devices})
-    return 0
+# Shared with the capacity ledger and preemption victim selection.
+_capacity_chips = nascrd.chips_held
 
 
 class ControllerDriver:
@@ -150,6 +144,11 @@ class ControllerDriver:
         from tpu_dra.controller.gang_tracker import GangTracker
 
         self.gangs = GangTracker(clientset, namespace)
+        # Wave-preemption node holds (controller/waves.py): while victims
+        # on a node drain toward deallocation, probes below the
+        # beneficiary's priority are rejected so immediate-mode
+        # re-placements can't back-fill the freed chips first.
+        self.preemption_holds = PreemptionHolds()
 
     def start_nas_informer(self, wait_synced_s: "float | None" = 5.0) -> None:
         """Serve UnsuitableNodes reads from a LIST+WATCH cache instead of a
@@ -439,6 +438,7 @@ class ControllerDriver:
             namespace=claim.metadata.namespace,
             name=claim.metadata.name,
             uid=claim_uid,
+            priority=claim_priority(claim_params),
         )
         gang_name = None
         if (
@@ -850,6 +850,45 @@ class ControllerDriver:
                 for ca in cas:
                     ca.unsuitable_nodes = sorted(set(ca.unsuitable_nodes))
 
+    def probe_node(
+        self,
+        pod: Pod,
+        cas: list[ClaimAllocation],
+        node: str,
+        *,
+        dead_pending: "frozenset[str] | None" = None,
+        trace_id: str = "",
+    ) -> bool:
+        """One (pod, node) suitability probe — the wave planner's scoring
+        primitive (controller/waves.py).  Runs the same snapshot/memo-backed
+        pass as the full fan-out but against a single node, so a first-fit
+        scan stops paying per-node cost at the first suitable node and
+        seeds pending picks only there (the full fan-out seeds on EVERY
+        suitable node, invalidating every other pod's memos).  Callers
+        scanning many nodes should resolve ``dead_pending`` once via
+        ``_dead_pending_claims`` and share it.  Returns True when every
+        claim can place on ``node``."""
+        if dead_pending is None:
+            dead_pending = self._dead_pending_claims([node])
+        claims_fp = tuple(
+            sorted(
+                (ca.claim.metadata.uid, params_fingerprint(ca)) for ca in cas
+            )
+        )
+        for ca in cas:
+            # A re-probe must reflect the FRESH verdict: drop any stale
+            # unsuitable entry for this node before asking again.
+            if node in ca.unsuitable_nodes:
+                ca.unsuitable_nodes = [
+                    n for n in ca.unsuitable_nodes if n != node
+                ]
+        self._unsuitable_node(pod, cas, node, dead_pending, claims_fp, trace_id)
+        # Same canonical-order discipline as unsuitable_nodes: the lists
+        # feed PodSchedulingContext status comparisons.
+        for ca in cas:
+            ca.unsuitable_nodes = sorted(set(ca.unsuitable_nodes))
+        return all(node not in ca.unsuitable_nodes for ca in cas)
+
     def _dead_pending_claims(self, nodes: list[str]) -> "frozenset[str]":
         """Pending-cache claim UIDs whose claim no longer exists.
 
@@ -976,6 +1015,24 @@ class ControllerDriver:
         # the unsuitable_nodes appends.
         for ca in allcas:
             ca.node_rejections.pop(potential_node, None)
+        # Preemption-hold gate — BEFORE the memo paths, so neither a stale
+        # pre-hold "suitable" verdict replays through a hold nor a hold
+        # verdict is memoized past its release.  Checked against the pod's
+        # best claim priority: the preemption beneficiary passes, the
+        # evicted class (and everyone below the bar) bounces.
+        hold_detail = self.preemption_holds.blocks(
+            potential_node,
+            max((claim_priority(ca.claim_parameters) for ca in allcas), default=0),
+        )
+        if hold_detail is not None:
+            for ca in allcas:
+                decisions.reject(
+                    ca, potential_node, ReasonCode.PREEMPTED, hold_detail
+                )
+            self._record_decisions(
+                pod, allcas, potential_node, decisions.PROVENANCE_FRESH, trace_id
+            )
+            return
         with self.lock.locked(potential_node):
             # Memo FAST PATH: the verdict memo keys on (rv, pending
             # versions, pod, claims) — all readable without materializing

@@ -156,6 +156,9 @@ class Controller:
         recheck_period_s: float = DEFAULT_RECHECK_PERIOD_S,
         error_backoff_base_s: float = ERROR_BACKOFF_BASE_S,
         node_recovery_period_s: "float | None" = None,
+        wave_scheduling: bool = False,
+        wave_period_s: float = 0.05,
+        defrag_interval_s: float = 1.0,
     ):
         self.driver = driver
         self.clientset = clientset
@@ -191,6 +194,22 @@ class Controller:
         self._threads: list[threading.Thread] = []
         self._watches = []
         self._stop = threading.Event()
+        # Wave-planned scheduling (controller/waves.py): instead of per-pod
+        # fan-out + commit inside each scheduling-context sync, pending pods
+        # buffer into the next wave and a dedicated loop scores them as one
+        # batch (priority order, shared snapshots/memos, node-grouped NAS
+        # commits, preemption, defrag on idle ticks).
+        self.wave_period_s = wave_period_s
+        self.defrag_interval_s = defrag_interval_s
+        self.wave_planner = None
+        self._wave_cond = threading.Condition()
+        self._wave_buffer: "dict[tuple, Any]" = {}
+        if wave_scheduling:
+            from tpu_dra.controller.waves import WavePlanner
+
+            self.wave_planner = WavePlanner(
+                driver, clientset, self.recorder, namespace=driver.namespace
+            )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -210,9 +229,17 @@ class Controller:
             self._threads.append(t)
         if self._recovery_loop is not None:
             self._recovery_loop.start()
+        if self.wave_planner is not None:
+            t = threading.Thread(
+                target=self._wave_loop, name="wave-planner", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
 
     def stop(self) -> None:
         self._stop.set()
+        with self._wave_cond:
+            self._wave_cond.notify_all()
         if self._recovery_loop is not None:
             self._recovery_loop.stop()
         self._queue.close()
@@ -627,6 +654,11 @@ class Controller:
         if not claims:
             return self.recheck_period_s
 
+        if self.wave_planner is not None:
+            # Wave mode: don't fan out or commit here — buffer the pod for
+            # the next wave and let the planner score the whole batch.
+            return self._enqueue_wave_item(sc, pod, claims)
+
         if sc.spec.potential_nodes:
             self.driver.unsuitable_nodes(pod, claims, sc.spec.potential_nodes)
             self._record_unplaceable(claims, sc.spec.potential_nodes)
@@ -645,6 +677,16 @@ class Controller:
                 self._allocate_pod_claims(claims, selected_node, selected_user)
 
         # Publish unsuitable nodes (controller.go:703-729).
+        self._publish_unsuitable(sc, claims)
+
+        return self.recheck_period_s
+
+    def _publish_unsuitable(
+        self, sc: PodSchedulingContext, claims: "list[ClaimAllocation]"
+    ) -> None:
+        """Publish per-claim unsuitable-node lists into the scheduling
+        context status (modified-compare, so an unchanged verdict costs no
+        write and no watch event)."""
         modified = False
         existing = {entry.name: entry for entry in sc.status.resource_claims}
         for ca in claims:
@@ -665,4 +707,91 @@ class Controller:
                 sc.metadata.namespace
             ).update_status(sc)
 
+    # -- wave-planned scheduling (controller/waves.py) -----------------------
+
+    def _enqueue_wave_item(
+        self, sc: PodSchedulingContext, pod: Pod,
+        claims: "list[ClaimAllocation]",
+    ) -> float:
+        """Buffer one pod's pending claims for the next scheduling wave.
+        Re-syncs of a still-buffered pod refresh its claims but keep the
+        original FIFO seq (a recheck must not jump the queue)."""
+        from tpu_dra.controller.waves import WaveItem
+
+        nodes = list(sc.spec.potential_nodes)
+        if sc.spec.selected_node and sc.spec.selected_node not in nodes:
+            nodes.append(sc.spec.selected_node)
+        if not nodes:
+            return self.recheck_period_s
+        key = (sc.metadata.namespace, pod.metadata.name)
+        with self._wave_cond:
+            prev = self._wave_buffer.get(key)
+            seq = prev.seq if prev is not None else self.wave_planner.next_seq()
+            self._wave_buffer[key] = WaveItem(
+                pod=pod,
+                cas=claims,
+                potential_nodes=nodes,
+                sc=sc,
+                selected_node=sc.spec.selected_node,
+                seq=seq,
+            )
+            self._wave_cond.notify()
         return self.recheck_period_s
+
+    def _wave_loop(self) -> None:
+        """The wave pacemaker: drain the buffer into one batched planning
+        pass per period; on idle ticks, run the defrag pass instead."""
+        last_defrag = time.monotonic()
+        while not self._stop.is_set():
+            with self._wave_cond:
+                if not self._wave_buffer:
+                    self._wave_cond.wait(self.wave_period_s)
+                empty = not self._wave_buffer
+            if self._stop.is_set():
+                return
+            if empty:
+                now = time.monotonic()
+                if (
+                    self.defrag_interval_s > 0
+                    and now - last_defrag >= self.defrag_interval_s
+                ):
+                    last_defrag = now
+                    try:
+                        self.wave_planner.defrag_tick()
+                    except Exception:
+                        logger.exception("defrag tick failed")
+                continue
+            # Debounce one period so a pod burst coalesces into one wave.
+            self._stop.wait(self.wave_period_s)
+            with self._wave_cond:
+                items = sorted(
+                    self._wave_buffer.values(), key=lambda it: it.seq
+                )
+                self._wave_buffer.clear()
+            try:
+                outcome = self.wave_planner.run_wave(items)
+            except Exception:
+                logger.exception(
+                    "wave planning failed; pods retry on recheck"
+                )
+                continue
+            for item in outcome.deferred + outcome.preempted_for:
+                try:
+                    if item.sc is not None:
+                        self._publish_unsuitable(item.sc, item.cas)
+                    self._record_unplaceable(item.cas, item.potential_nodes)
+                except ApiError as e:
+                    logger.warning(
+                        "publishing wave verdict for pod %s failed: %s",
+                        item.pod.metadata.name, e,
+                    )
+                # Retry well before the 30s recheck: a preempted-for pod
+                # should land as soon as its victims drain.
+                self._queue.add(
+                    (
+                        "PodSchedulingContext",
+                        item.pod.metadata.namespace,
+                        item.pod.metadata.name,
+                    ),
+                    max(4 * self.wave_period_s, 0.2),
+                )

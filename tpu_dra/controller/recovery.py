@@ -44,6 +44,51 @@ logger = logging.getLogger(__name__)
 DEFAULT_SWEEP_PERIOD_S = 5.0
 
 
+def request_eviction(
+    clientset,
+    recorder,
+    claim,
+    node: str,
+    *,
+    detail: str,
+    reason: str = decisions.ReasonCode.NODE_NOT_READY,
+    event_reason: str = "NodeNotReady",
+    releasable=None,
+    record: bool = True,
+) -> bool:
+    """The ONE eviction actuation sequence, shared by node-failure recovery
+    and wave preemption/defrag (controller/waves.py): flight-record the
+    eviction (reason-coded, so `tpudra explain` tells the victim why),
+    emit a Warning Event, prune the ``reservedFor`` consumers that
+    ``releasable(ref)`` approves (default: every pod consumer — the
+    preemption semantics; recovery passes its dead-node predicate), and
+    set ``deallocationRequested`` once the claim is unreserved so the
+    reconciler's ordinary sync path deallocates and re-places it.
+
+    Returns True when it acted (recorded, pruned, or requested).  Callers
+    dedupe the ``record`` flag per incident; the pruning half is
+    level-triggered and idempotent."""
+    if record:
+        decisions.record_eviction(claim, node, detail, reason=reason)
+        if recorder is not None:
+            recorder.event(claim, TYPE_WARNING, event_reason, detail)
+    changed = False
+    kept = []
+    for ref in claim.status.reserved_for:
+        if ref.resource == "pods" and (releasable is None or releasable(ref)):
+            changed = True
+            continue
+        kept.append(ref)
+    if changed:
+        claim.status.reserved_for = kept
+    if not kept and not claim.status.deallocation_requested:
+        claim.status.deallocation_requested = True
+        changed = True
+    if changed:
+        clientset.resource_claims(claim.metadata.namespace).update_status(claim)
+    return changed or record
+
+
 class NodeRecovery:
     """Periodic sweep turning NotReady nodes' allocated claims into
     deallocation requests the reconciler re-places."""
@@ -115,33 +160,25 @@ class NodeRecovery:
             first_time = key not in self._recorded
             self._recorded.add(key)
         if first_time:
-            decisions.record_eviction(claim, node, detail)
-            self._recorder.event(claim, TYPE_WARNING, "NodeNotReady", detail)
             self.evicted_claims.append((claim_uid, node))
 
-        # Prune consumers that cannot release the claim themselves: pods
-        # that are gone, deleting, or bound to the dead node (kubesim's
-        # eviction deletes those, but a wedged kubelet must not deadlock
-        # recovery).  Surviving consumers elsewhere keep the claim in use
-        # — a shared claim is NOT yanked from under a live pod on a
-        # healthy node.
-        changed = False
-        kept = []
-        for ref in claim.status.reserved_for:
-            if ref.resource == "pods" and self._pod_releasable(
+        # Prune only consumers that cannot release the claim themselves:
+        # pods that are gone, deleting, or bound to the dead node
+        # (kubesim's eviction deletes those, but a wedged kubelet must not
+        # deadlock recovery).  Surviving consumers elsewhere keep the
+        # claim in use — a shared claim is NOT yanked from under a live
+        # pod on a healthy node.
+        return request_eviction(
+            self._clientset,
+            self._recorder,
+            claim,
+            node,
+            detail=detail,
+            record=first_time,
+            releasable=lambda ref: self._pod_releasable(
                 claim.metadata.namespace, ref.name, ref.uid, node
-            ):
-                changed = True
-                continue
-            kept.append(ref)
-        if changed:
-            claim.status.reserved_for = kept
-        if not kept and not claim.status.deallocation_requested:
-            claim.status.deallocation_requested = True
-            changed = True
-        if changed:
-            claims.update_status(claim)
-        return changed or first_time
+            ),
+        )
 
     def _pod_releasable(self, namespace, name, uid, node) -> bool:
         try:

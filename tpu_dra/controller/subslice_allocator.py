@@ -39,7 +39,9 @@ from tpu_dra.controller.pending import PerNodeAllocatedClaims
 from tpu_dra.controller.types import (
     ClaimAllocation,
     SearchMemo,
+    claim_priority,
     params_fingerprint,
+    validate_priority,
 )
 OnSuccessCallback = Callable[[], None]
 
@@ -70,6 +72,7 @@ class SubsliceDriver:
         if not params.profile:
             raise ValueError("subslice claim requires a profile")
         SubsliceProfile.parse(params.profile)  # raises on malformed
+        validate_priority(params.priority)
 
     def allocate(
         self,
@@ -230,6 +233,7 @@ class SubsliceDriver:
                     namespace=ca.claim.metadata.namespace,
                     name=ca.claim.metadata.name,
                     uid=claim_uid,
+                    priority=claim_priority(ca.claim_parameters),
                 ),
                 subslice=nascrd.AllocatedSubslices(
                     devices=[

@@ -34,7 +34,9 @@ from tpu_dra.controller.placement import place_count, place_topology
 from tpu_dra.controller.types import (
     ClaimAllocation,
     SearchMemo,
+    claim_priority,
     params_fingerprint,
+    validate_priority,
 )
 from tpu_dra.utils.quantity import Quantity
 
@@ -66,6 +68,7 @@ class TpuDriver:
                 raise ValueError("gang config requires a name")
             if params.gang.size < 1:
                 raise ValueError(f"invalid gang size: {params.gang.size}")
+        validate_priority(params.priority)
 
     def allocate(
         self,
@@ -207,6 +210,7 @@ class TpuDriver:
                     namespace=ca.claim.metadata.namespace,
                     name=ca.claim.metadata.name,
                     uid=claim_uid,
+                    priority=claim_priority(ca.claim_parameters),
                 ),
                 tpu=nascrd.AllocatedTpus(
                     devices=devices,

@@ -37,6 +37,72 @@ class ClaimAllocation:
     allocation: AllocationResult | None = None
     error: Exception | None = None
 
+    @property
+    def priority(self) -> int:
+        """The claim's wave-scheduling priority class, read off the resolved
+        (defaulted) claim parameters; 0 when the params carry none."""
+        return claim_priority(self.claim_parameters)
+
+
+def claim_priority(claim_parameters: Any) -> int:
+    """Priority class of resolved claim parameters (default 0).  All three
+    claim-parameter kinds carry an optional ``priority``; anything without
+    the field — e.g. device-class params — is priority 0."""
+    p = getattr(claim_parameters, "priority", None)
+    return int(p) if p is not None else 0
+
+
+def validate_priority(priority: Any) -> None:
+    """Shared claim-parameter priority check (all three allocators): an
+    int >= 0 or unset.  Negative classes are rejected rather than clamped —
+    a claim that cannot decide its own class should not silently become
+    universally preemptible."""
+    if priority is None:
+        return
+    if isinstance(priority, bool) or not isinstance(priority, int):
+        raise ValueError(f"priority must be an integer, got {priority!r}")
+    if priority < 0:
+        raise ValueError(f"priority must be >= 0, got {priority}")
+
+
+class PreemptionHolds:
+    """Node reservations opened by the wave planner while a preemption
+    drains: after victims on a node are sent to deallocation, lower-priority
+    claims must not back-fill the freed chips before the beneficiary's next
+    wave lands (the immediate-mode re-placement race).  A hold rejects
+    probes below ``min_priority`` on the node until the beneficiary commits
+    (release) or the TTL lapses (leak bound when the beneficiary dies)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._holds: "dict[str, tuple[int, float]]" = {}  # node -> (min_prio, deadline)
+
+    def hold(self, node: str, min_priority: int, ttl_s: float = 30.0) -> None:
+        with self._lock:
+            self._holds[node] = (min_priority, time.monotonic() + ttl_s)
+
+    def release(self, node: str) -> None:
+        with self._lock:
+            self._holds.pop(node, None)
+
+    def blocks(self, node: str, priority: int) -> "str | None":
+        """A human-readable detail when ``priority`` may not place on
+        ``node`` right now, else None."""
+        with self._lock:
+            entry = self._holds.get(node)
+            if entry is None:
+                return None
+            min_priority, deadline = entry
+            if time.monotonic() > deadline:
+                del self._holds[node]
+                return None
+        if priority >= min_priority:
+            return None
+        return (
+            f"node held for a pending priority>={min_priority} placement "
+            f"(preemption in progress)"
+        )
+
 
 def params_fingerprint(ca: ClaimAllocation) -> str:
     """Canonical fingerprint of a claim's resolved parameters (placement

@@ -1,0 +1,654 @@
+"""Wave-planned scheduling — batch scoring, priorities, preemption, defrag.
+
+PR 2 made a single probe cheap (availability snapshots, verdict memos,
+per-allocator search memos), but the reconciler still planned one pod at a
+time: at 1024 nodes and steady-state claim waves, per-pod probing re-walks
+the same snapshots O(pods x nodes) — and, worse, every pod's full fan-out
+seeds pending picks on EVERY suitable node (the allocators'
+``unsuitable_node`` reserves tentative capacity per probe), bumping the
+per-node pending versions and invalidating every later pod's memos.  The
+commit side paid one locked NAS GET+UPDATE per pod even when a wave lands
+many pods on the same node.
+
+``WavePlanner`` is the batch alternative the reconciler opts into
+(``Controller(wave_scheduling=True)``):
+
+- **Score**: all pending pods collected into one wave, ordered by
+  (priority desc, FIFO seq).  Each item first-fit scans its candidate
+  nodes through ``ControllerDriver.probe_node`` — the same snapshot/memo
+  machinery as the fan-out, but the scan stops at the first suitable node,
+  so pending picks seed ONLY where the pod will actually commit.  Nodes a
+  wave probes and rejects stay snapshot-clean, so every later item (and
+  identical claim shapes via the search memos, which key on
+  (snapshot fingerprint, params) and are pod-independent) reuses them.
+  The dead-pending sweep resolves once per wave, not once per pod.
+- **Commit**: assignments group by node; each node pays ONE locked NAS
+  GET+UPDATE for every pod the wave placed there
+  (``driver.allocate_batch`` with all pods' claims), instead of one per
+  pod.  The promote-time overlap guards re-validate every pick against
+  committed truth under the node lock, so a stale or forged snapshot can
+  at worst cost a retry, never a double-booking.
+- **Preempt**: an unplaceable item with priority > 0 may evict
+  STRICTLY-lower-priority allocations (equal priority never preempts —
+  the serve layer's livelock rule) through the shared eviction helper
+  (``recovery.request_eviction``: flight-recorded ``Preempted`` reason,
+  Warning Event, reservedFor prune, deallocationRequested).  The node is
+  then HELD against probes below the beneficiary's priority until it
+  commits (or a TTL lapses), so immediate-mode re-placements can't
+  back-fill the freed chips first.  The item defers; the next wave places
+  it on the drained node.
+- **Defrag**: on wave-idle ticks, where the capacity ledger's evidence
+  shows ``free >= demand but largest-contiguous < demand`` (PR 18's
+  fragmentation ratio), scattered low-priority claims with no live
+  consumers are migrated — evicted with the same ``Preempted`` record,
+  reason-labelled ``defrag`` — so their immediate-mode re-placement packs
+  and a contiguous subslice opens.  This mirrors the reference driver's
+  MIG placement discipline: carve-outs steer toward contiguity instead of
+  accreting fragmentation.
+
+Metrics: ``tpu_dra_wave_pods_total{outcome}``, ``tpu_dra_wave_plan_seconds``,
+``tpu_dra_claim_preemptions_total{reason}``,
+``tpu_dra_defrag_migrations_total`` (utils/metrics.py); alert:
+``PreemptionChurn`` (obs/alerts.py).  Docs: docs/SCHEDULING.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tpu_dra.api import nas_v1alpha1 as nascrd, tpu_v1alpha1 as tpucrd
+from tpu_dra.api.k8s import (
+    Pod,
+    PodSchedulingContext,
+    ResourceClaimConsumerReference,
+)
+from tpu_dra.api.topology import Topology
+from tpu_dra.client.apiserver import ApiError, NotFoundError
+from tpu_dra.controller import decisions
+from tpu_dra.controller.availability import compute_free_chips
+from tpu_dra.controller.decisions import ReasonCode
+from tpu_dra.controller.recovery import request_eviction
+from tpu_dra.controller.types import ClaimAllocation
+from tpu_dra.utils import trace
+from tpu_dra.utils.metrics import (
+    CLAIM_PREEMPTIONS,
+    DEFRAG_MIGRATIONS,
+    WAVE_PLAN_SECONDS,
+    WAVE_PODS,
+)
+
+logger = logging.getLogger(__name__)
+
+# Outcomes (the tpu_dra_wave_pods_total label values).
+PLACED = "placed"
+DEFERRED = "deferred"
+PREEMPTED_FOR = "preempted_for"
+
+FINALIZER = f"{tpucrd.GROUP_NAME}/deletion-protection"
+
+
+def requested_chips(ca: ClaimAllocation) -> int:
+    """Whole chips a pending claim will fence once placed — the demand side
+    of preemption/defrag planning, mirroring ``nascrd.chips_held`` on the
+    supply side: tpu claims take count/topology-size chips, a subslice
+    claim pops one parent chip, core claims carve from an already-held
+    subslice (zero new chips)."""
+    params = ca.claim_parameters
+    if isinstance(params, tpucrd.TpuClaimParametersSpec):
+        if params.topology:
+            return Topology.parse(params.topology).size
+        return int(params.count or 1)
+    if isinstance(params, tpucrd.SubsliceClaimParametersSpec):
+        return 1
+    return 0
+
+
+@dataclass
+class WaveItem:
+    """One pod's pending claims, queued for the next scheduling wave."""
+
+    pod: Pod
+    cas: list[ClaimAllocation]
+    potential_nodes: list[str]
+    sc: "PodSchedulingContext | None" = None
+    selected_node: str = ""  # scheduler hint; probed first when set
+    seq: int = 0  # planner-assigned FIFO tiebreaker (enqueue order)
+    # Filled by the planner:
+    assigned_node: str = ""
+    outcome: str = ""
+
+    @property
+    def priority(self) -> int:
+        """The pod's scheduling class: the max over its claims (a gang
+        member claim at priority N must not be starved by a sibling claim
+        someone left at the default)."""
+        return max((ca.priority for ca in self.cas), default=0)
+
+    def candidates(self) -> list[str]:
+        """Candidate nodes in probe order: the scheduler's selected node
+        first (it already converged there once), then the rest sorted for
+        determinism."""
+        nodes = sorted(set(self.potential_nodes))
+        if self.selected_node and self.selected_node in nodes:
+            nodes.remove(self.selected_node)
+            nodes.insert(0, self.selected_node)
+        return nodes
+
+
+@dataclass
+class WaveOutcome:
+    """What one wave did — the planner's return value and the bench's
+    measurement surface."""
+
+    placed: list[WaveItem] = field(default_factory=list)
+    deferred: list[WaveItem] = field(default_factory=list)
+    preempted_for: list[WaveItem] = field(default_factory=list)
+    preemptions: int = 0  # victim claims sent to deallocation this wave
+    nodes_committed: int = 0  # distinct NAS objects written (one lock each)
+    wall_s: float = 0.0
+
+    @property
+    def items(self) -> list[WaveItem]:
+        return self.placed + self.preempted_for + self.deferred
+
+
+class WavePlanner:
+    """Scores a wave of pending pods against shared availability snapshots
+    and commits placements node-grouped.  Owned by the reconciler's wave
+    loop; usable standalone against a driver + clientset (tests, bench)."""
+
+    def __init__(
+        self,
+        driver,
+        clientset,
+        recorder=None,
+        *,
+        namespace: str = "tpu-dra",
+        hold_ttl_s: float = 30.0,
+        defrag_max_priority: int = 0,
+        defrag_target_chips: "int | None" = None,
+    ):
+        self.driver = driver
+        self.clientset = clientset
+        self.recorder = recorder
+        self.namespace = namespace
+        self.hold_ttl_s = hold_ttl_s
+        # Defrag migrates only claims at or below this class — by default
+        # exactly the priority-0 pool, so a deliberate priority choice is
+        # never churned for tidiness.
+        self.defrag_max_priority = defrag_max_priority
+        # Explicit contiguous-demand target for defrag; None -> use the
+        # largest contiguous demand the last wave failed to place.
+        self.defrag_target_chips = defrag_target_chips
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        # Largest topology-claim size a wave deferred (the organic defrag
+        # demand signal); cleared when a wave has no such deferral.
+        self._unmet_contiguous_demand = 0
+
+    def next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    # -- scoring -------------------------------------------------------------
+
+    def run_wave(self, items: list[WaveItem]) -> WaveOutcome:
+        """Score + preempt + commit one wave.  Never raises for per-item or
+        per-node failures — failed items land in ``deferred`` and retry on
+        the reconciler's next sync."""
+        outcome = WaveOutcome()
+        if not items:
+            return outcome
+        t0 = time.perf_counter()
+        with WAVE_PLAN_SECONDS.time(), trace.span(
+            "controller.wave", pods=len(items)
+        ) as sp:
+            trace_id = sp.context.trace_id
+            # Priority-then-FIFO: the whole point of batching — a
+            # high-priority gang arriving late in the burst scores before
+            # the low-priority flood that arrived first.
+            order = sorted(items, key=lambda it: (-it.priority, it.seq))
+            all_nodes = sorted({n for it in items for n in it.potential_nodes})
+            # ONE dead-pending sweep for the whole wave (per-pod planning
+            # paid one per fan-out).
+            dead = self.driver._dead_pending_claims(all_nodes)
+
+            assignments: "dict[str, list[WaveItem]]" = {}
+            unmet_contiguous = 0
+            for item in order:
+                node = self._score_item(item, dead, trace_id)
+                if node is not None:
+                    item.assigned_node = node
+                    assignments.setdefault(node, []).append(item)
+                    continue
+                if item.priority > 0 and self._plan_preemption(item, outcome):
+                    item.outcome = PREEMPTED_FOR
+                    outcome.preempted_for.append(item)
+                else:
+                    item.outcome = DEFERRED
+                    outcome.deferred.append(item)
+                for ca in item.cas:
+                    params = ca.claim_parameters
+                    if (
+                        isinstance(params, tpucrd.TpuClaimParametersSpec)
+                        and params.topology
+                    ):
+                        unmet_contiguous = max(
+                            unmet_contiguous,
+                            Topology.parse(params.topology).size,
+                        )
+            self._unmet_contiguous_demand = unmet_contiguous
+
+            # Node-grouped commit: one locked NAS GET+UPDATE per node
+            # covers every pod the wave placed there.
+            for node in sorted(assignments):
+                group = assignments[node]
+                failed = self._commit_node(node, group)
+                for item in group:
+                    if item in failed:
+                        item.outcome = DEFERRED
+                        outcome.deferred.append(item)
+                    else:
+                        item.outcome = PLACED
+                        outcome.placed.append(item)
+                if len(failed) < len(group):
+                    outcome.nodes_committed += 1
+                    # A successful commit at or above a hold's bar is the
+                    # beneficiary landing: release the node.
+                    best = max(
+                        (it.priority for it in group if it not in failed),
+                        default=0,
+                    )
+                    holds = getattr(self.driver, "preemption_holds", None)
+                    if holds is not None and holds.blocks(node, best) is None:
+                        holds.release(node)
+        for item in outcome.items:
+            WAVE_PODS.inc(outcome=item.outcome)
+        outcome.wall_s = time.perf_counter() - t0
+        return outcome
+
+    def _score_item(
+        self, item: WaveItem, dead, trace_id: str
+    ) -> "str | None":
+        """First-fit over the item's candidates through the shared
+        snapshot/memo probe.  A suitable probe has already seeded the
+        pending picks on that node, so the subsequent commit (and every
+        later item's probe of the same node) accounts for this placement."""
+        for node in item.candidates():
+            try:
+                if self.driver.probe_node(
+                    item.pod, item.cas, node,
+                    dead_pending=dead, trace_id=trace_id,
+                ):
+                    return node
+            except Exception:
+                logger.exception(
+                    "wave probe of node %s for pod %s failed; skipping node",
+                    node, item.pod.metadata.name,
+                )
+        return None
+
+    # -- commit --------------------------------------------------------------
+
+    def _commit_node(self, node: str, group: list[WaveItem]) -> "_IdentitySet":
+        """Commit every claim of every pod assigned to ``node`` with one
+        locked NAS GET+UPDATE (driver.allocate_batch over the union).
+        Returns the items whose claims did NOT all commit (identity set);
+        those defer and retry.  Mirrors the reconciler's per-pod
+        ``_allocate_pod_claims``, generalized to many pods per node."""
+        failed = _IdentitySet()
+        pending_by_item: "list[tuple[WaveItem, list[ClaimAllocation]]]" = []
+        roots: "dict[str, trace.TraceContext]" = {}
+        batch: list[ClaimAllocation] = []
+        for item in group:
+            pending: list[ClaimAllocation] = []
+            for ca in item.cas:
+                if ca.claim.status.allocation is not None:
+                    continue
+                claim = ca.claim
+                try:
+                    with trace.span(
+                        "controller.allocate_claim",
+                        claim_uid=claim.metadata.uid,
+                        claim=claim.metadata.name,
+                        namespace=claim.metadata.namespace,
+                        node=node,
+                    ) as sp:
+                        roots[claim.metadata.uid] = sp.context
+                        if FINALIZER not in claim.metadata.finalizers:
+                            claim.metadata.finalizers.append(FINALIZER)
+                            ca.claim = self.clientset.resource_claims(
+                                claim.metadata.namespace
+                            ).update(claim)
+                except ApiError:
+                    logger.warning(
+                        "wave commit: finalizer write failed for claim %s; "
+                        "pod %s defers",
+                        claim.metadata.name, item.pod.metadata.name,
+                    )
+                    failed.add(item)
+                    break
+                pending.append(ca)
+            if item in failed:
+                continue
+            pending_by_item.append((item, pending))
+            batch.extend(pending)
+
+        results: dict = {}
+        if batch:
+            try:
+                results = self.driver.allocate_batch(
+                    batch, node, parents=roots
+                )
+            except Exception:
+                # A mid-batch promote failure commits the already-promoted
+                # prefix to the NAS and raises (dropping the results dict),
+                # so every item here defers.  That is safe, not lossy:
+                # allocate_batch's idempotent-retry path hands a
+                # prefix-committed claim its existing allocation on the
+                # next wave, and the claims that never promoted re-probe
+                # fresh.
+                logger.exception(
+                    "wave commit on node %s failed mid-batch "
+                    "(committed prefix heals on retry; rest re-probes)",
+                    node,
+                )
+
+        for item, pending in pending_by_item:
+            ok = True
+            for ca in pending:
+                claim = ca.claim
+                allocation = results.get(claim.metadata.uid)
+                if allocation is None:
+                    ok = False
+                    continue
+                claim.status.allocation = allocation
+                claim.status.driver_name = tpucrd.GROUP_NAME
+                claim.status.reserved_for.append(self._consumer(item.pod))
+                try:
+                    with trace.span(
+                        "controller.claim.update_status",
+                        parent=roots.get(claim.metadata.uid),
+                        claim_uid=claim.metadata.uid,
+                    ):
+                        self.clientset.resource_claims(
+                            claim.metadata.namespace
+                        ).update_status(claim)
+                except ApiError:
+                    # NAS committed; the reconciler's idempotent-retry path
+                    # heals the claim status on the next sync.
+                    logger.warning(
+                        "wave commit: status write failed for claim %s "
+                        "(NAS committed; sync retries)", claim.metadata.name,
+                    )
+                    ok = False
+                    continue
+                if self.recorder is not None:
+                    self.recorder.eventf(
+                        claim, "Normal", "Allocated",
+                        "allocated on node %s", node,
+                    )
+            if not ok:
+                failed.add(item)
+        return failed
+
+    @staticmethod
+    def _consumer(pod: Pod) -> ResourceClaimConsumerReference:
+        return ResourceClaimConsumerReference(
+            resource="pods", name=pod.metadata.name, uid=pod.metadata.uid
+        )
+
+    # -- preemption ----------------------------------------------------------
+
+    def _plan_preemption(self, item: WaveItem, outcome: WaveOutcome) -> bool:
+        """Pick the cheapest node where evicting strictly-lower-priority
+        claims frees enough chips for ``item``, and send those victims to
+        deallocation.  The item itself defers — eviction is asynchronous
+        (deallocationRequested drains through the reconciler), so the
+        beneficiary lands on a subsequent wave against the HELD node.
+
+        Victim facts (priority, chips held) come straight off the NAS
+        ClaimInfo — the same accounting ``NodeSnapshot.allocated_priorities``
+        carries for probe-path consumers."""
+        needed = sum(
+            requested_chips(ca)
+            for ca in item.cas
+            if ca.claim.status.allocation is None
+        )
+        if needed <= 0:
+            return False
+        best = None  # (evicted_chips, victim_count, node, victims)
+        for node in item.candidates():
+            try:
+                nas = self.clientset.node_allocation_states(
+                    self.namespace
+                ).get(node)
+            except ApiError:
+                continue
+            if nas.status != nascrd.STATUS_READY:
+                continue
+            free = len(compute_free_chips(nas))
+            evictable = []
+            for uid, alloc in sorted(nas.spec.allocated_claims.items()):
+                info = alloc.claim_info
+                if info is None or not info.namespace:
+                    continue  # nothing to drive an eviction against
+                if info.priority >= item.priority:
+                    continue  # strictly-lower only: never equal priority
+                evictable.append(
+                    (info.priority, -nascrd.chips_held(alloc), uid, info)
+                )
+            # Lowest class first; within a class, biggest holdings first
+            # (fewest victims for the chips).
+            evictable.sort(key=lambda v: (v[0], v[1], v[2]))
+            victims, gained = [], 0
+            for _prio, negchips, uid, info in evictable:
+                if free + gained >= needed:
+                    break
+                victims.append((uid, info))
+                gained += -negchips
+            if victims and free + gained >= needed:
+                cost = (gained, len(victims), node)
+                if best is None or cost < best[0]:
+                    best = (cost, node, victims)
+        if best is None:
+            return False
+        _cost, node, victims = best
+        evicted = 0
+        for uid, info in victims:
+            if self._evict(
+                node, uid, info,
+                reason_label="priority",
+                detail=(
+                    f"preempted on {node} for pod "
+                    f"{item.pod.metadata.name!r} "
+                    f"(priority {item.priority} > {info.priority})"
+                ),
+            ):
+                evicted += 1
+        if evicted:
+            outcome.preemptions += evicted
+            holds = getattr(self.driver, "preemption_holds", None)
+            if holds is not None:
+                holds.hold(node, item.priority, ttl_s=self.hold_ttl_s)
+            logger.info(
+                "wave preemption: %d victim claim(s) on %s draining for "
+                "pod %s (priority %d)",
+                evicted, node, item.pod.metadata.name, item.priority,
+            )
+        return evicted > 0
+
+    def _evict(
+        self, node: str, uid: str, info, *, reason_label: str, detail: str
+    ) -> bool:
+        """Evict one victim through the shared eviction sequence
+        (recovery.request_eviction): Preempted flight record + Warning
+        Event, consuming pods deleted (preemption overrides consumer
+        liveness — unlike node recovery, which only prunes consumers that
+        cannot release the claim themselves), reservedFor pruned,
+        deallocationRequested set.  Level-triggered: repeat calls on a
+        still-draining victim record/count once per (claim, node)."""
+        claims = self.clientset.resource_claims(info.namespace)
+        try:
+            claim = claims.get(info.name)
+        except (NotFoundError, ApiError):
+            return False
+        if claim.metadata.uid != uid or claim.status.allocation is None:
+            return False
+        first_time = not decisions.has_eviction_record(uid, node)
+        # Delete the consuming pods first: their template-owned claims GC
+        # with them, and a bare claim with pruned reservations deallocates
+        # through the ordinary sync path.
+        for ref in list(claim.status.reserved_for):
+            if ref.resource != "pods":
+                continue
+            try:
+                self.clientset.pods(info.namespace).delete(ref.name)
+            except (NotFoundError, ApiError):
+                pass
+        try:
+            claim = claims.get(info.name)
+        except NotFoundError:
+            # Cascade GC beat us to the object; record the why anyway —
+            # the flight recorder is the victim's only explanation.
+            if first_time:
+                decisions.record_eviction(
+                    claim, node, detail, reason=ReasonCode.PREEMPTED
+                )
+                self._count_eviction(reason_label)
+            return first_time
+        if claim.metadata.uid != uid:
+            return False
+        try:
+            acted = request_eviction(
+                self.clientset,
+                self.recorder,
+                claim,
+                node,
+                detail=detail,
+                reason=ReasonCode.PREEMPTED,
+                event_reason="Preempted",
+                record=first_time,
+            )
+        except ApiError as e:
+            logger.warning(
+                "eviction of claim %s on %s failed (retried next wave): %s",
+                info.name, node, e,
+            )
+            return False
+        if first_time and acted:
+            self._count_eviction(reason_label)
+        return first_time and acted
+
+    @staticmethod
+    def _count_eviction(reason_label: str) -> None:
+        CLAIM_PREEMPTIONS.inc(reason=reason_label)
+        if reason_label == "defrag":
+            DEFRAG_MIGRATIONS.inc()
+
+    # -- defrag --------------------------------------------------------------
+
+    def defrag_tick(self, target_chips: "int | None" = None) -> int:
+        """One defrag pass over the fleet, run on wave-idle ticks: where a
+        node's ledger evidence shows ``free >= target`` chips but no
+        contiguous block of ``target`` (PR 18's stranded-capacity shape),
+        migrate scattered claims — at/below ``defrag_max_priority``, with
+        NO live consumers — so their immediate-mode re-placement packs and
+        a contiguous subslice opens.  The demand ``target`` is an explicit
+        override, the planner's configured target, or the largest
+        contiguous demand the last wave failed to place.  Returns the
+        number of migrations started."""
+        target = (
+            target_chips
+            or self.defrag_target_chips
+            or self._unmet_contiguous_demand
+        )
+        if not target or target <= 1:
+            return 0
+        try:
+            nases = self.clientset.node_allocation_states(
+                self.namespace
+            ).list()
+        except ApiError:
+            return 0
+        # Evidence is recomputed fresh from committed NAS truth and pushed
+        # back into the ledger (lazy import — controller -> obs is not an
+        # eager layer edge).
+        from tpu_dra.obs import capacity as obscap
+
+        migrated = 0
+        for nas in sorted(nases, key=lambda n: n.metadata.name):
+            node = nas.metadata.name
+            if nas.status != nascrd.STATUS_READY:
+                continue
+            free_coords = [
+                chip.coord for chip in compute_free_chips(nas).values()
+            ]
+            obscap.observe_node(node, free_coords)
+            if len(free_coords) < target:
+                continue  # not enough free silicon: preemption's job
+            largest = obscap.largest_contiguous_block(free_coords)
+            if largest >= target:
+                continue  # a contiguous block already exists
+            for uid, alloc in sorted(nas.spec.allocated_claims.items()):
+                info = alloc.claim_info
+                if info is None or not info.namespace:
+                    continue
+                if info.priority > self.defrag_max_priority:
+                    continue
+                held = nascrd.chips_held(alloc)
+                if held == 0 or held >= target:
+                    continue  # not a scatterer (or the demand shape itself)
+                try:
+                    claim = self.clientset.resource_claims(
+                        info.namespace
+                    ).get(info.name)
+                except (NotFoundError, ApiError):
+                    continue
+                if (
+                    claim.metadata.uid != uid
+                    or claim.status.allocation is None
+                    or claim.status.deallocation_requested
+                    or claim.status.reserved_for
+                ):
+                    continue  # live consumers are never migrated
+                if self._evict(
+                    node, uid, info,
+                    reason_label="defrag",
+                    detail=(
+                        f"defragmentation: migrating off {node} to open a "
+                        f"contiguous {target}-chip subslice "
+                        f"(free={len(free_coords)}, "
+                        f"largest-contiguous={largest})"
+                    ),
+                ):
+                    migrated += 1
+        if migrated:
+            logger.info(
+                "defrag: %d migration(s) started toward a contiguous "
+                "%d-chip subslice", migrated, target,
+            )
+        return migrated
+
+
+class _IdentitySet:
+    """Tiny identity-keyed set (WaveItem is an unhashable dataclass)."""
+
+    def __init__(self):
+        self._ids: "set[int]" = set()
+        self._refs: list = []  # keep referents alive while ids are compared
+
+    def add(self, obj) -> None:
+        if id(obj) not in self._ids:
+            self._ids.add(id(obj))
+            self._refs.append(obj)
+
+    def __contains__(self, obj) -> bool:
+        return id(obj) in self._ids
+
+    def __len__(self) -> int:
+        return len(self._ids)

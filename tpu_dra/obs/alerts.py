@@ -450,6 +450,38 @@ def eviction_spike(
     )
 
 
+def preemption_churn(
+    *,
+    rate_threshold: float = 0.05,
+    window_s: float = 60.0,
+    for_s: float = 0.0,
+) -> AlertRule:
+    """Wave-planner preemptions (``tpu_dra_claim_preemptions_total`` —
+    priority evictions plus defrag migrations) arriving faster than an
+    occasional displacement: either the cluster is oversubscribed at the
+    high-priority tier (every wave evicts someone) or defrag is thrashing
+    the same claims back and forth instead of converging."""
+
+    def expr(view):
+        rate = view.rate(
+            "tpu_dra_claim_preemptions_total", window_s=window_s
+        )
+        return (
+            rate > rate_threshold,
+            round(rate, 4),
+            f"{rate:.3f} preemptions/s over {window_s:.0f}s",
+        )
+
+    return AlertRule(
+        name="PreemptionChurn",
+        expr=expr,
+        for_s=for_s,
+        severity="warn",
+        description=f"claim preemptions > {rate_threshold}/s (priority "
+        "tier oversubscribed, or defrag thrashing)",
+    )
+
+
 def digest_staleness(
     *, stale_after_s: float = 300.0, for_s: float = 0.0
 ) -> AlertRule:
@@ -873,6 +905,7 @@ def default_rules(
         fleet_queue_growth(window_s=window_s, for_s=for_s),
         prefill_backlog_growth(window_s=window_s, for_s=for_s),
         eviction_spike(window_s=window_s, for_s=for_s),
+        preemption_churn(window_s=window_s, for_s=for_s),
         digest_staleness(stale_after_s=max(window_s * 5, 1.0), for_s=for_s),
         kv_pool_pressure(window_s=window_s, for_s=for_s),
         kv_swap_thrash(window_s=window_s, for_s=for_s),

@@ -120,6 +120,7 @@ class SimCluster:
         evict_after_s: "float | None" = None,
         recreate_evicted: bool = False,
         metrics_endpoint: "str | None" = None,
+        wave_scheduling: bool = False,
     ):
         # ``metrics_endpoint`` (e.g. "127.0.0.1:0") starts a MetricsServer
         # with the cluster, serving this process's registry and /debug
@@ -177,6 +178,11 @@ class SimCluster:
             recheck_period_s=0.2,
             error_backoff_base_s=0.02,
             node_recovery_period_s=0.2,  # sim scale, like recheck_period_s
+            # Wave-planned scheduling (controller/waves.py): batch scoring,
+            # priorities/preemption, defrag on idle ticks.
+            wave_scheduling=wave_scheduling,
+            wave_period_s=0.02,
+            defrag_interval_s=0.2,
         )
         self.kubesim = KubeSim(
             self.clientset,

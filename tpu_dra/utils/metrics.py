@@ -334,6 +334,33 @@ CLAIM_EVICTIONS = REGISTRY.counter(
     "Allocated claims evicted for re-placement by the node-failure "
     "recovery sweep (controller/recovery.py), by reason code",
 )
+# Wave scheduling (controller/waves.py): the reconciler batches pending
+# pods into one priority-ordered planning pass over shared availability
+# snapshots, commits node-grouped, and may preempt strictly-lower-priority
+# allocations or migrate scattered small claims to open contiguous
+# subslices.
+WAVE_PODS = REGISTRY.counter(
+    "tpu_dra_wave_pods_total",
+    "Pods scored by the wave planner by outcome: placed (committed this "
+    "wave), deferred (no fit, retried next wave), preempted_for "
+    "(deferred while lower-priority victims drain)",
+)
+WAVE_PLAN_SECONDS = REGISTRY.histogram(
+    "tpu_dra_wave_plan_seconds",
+    "Wave planner wall time per wave (score + preempt + node-grouped "
+    "commit of every pending pod in the batch)",
+)
+CLAIM_PREEMPTIONS = REGISTRY.counter(
+    "tpu_dra_claim_preemptions_total",
+    "Allocated claims sent to deallocation by wave scheduling, by reason "
+    "(priority: displaced by a strictly-higher-priority placement; "
+    "defrag: migrated to open a contiguous subslice)",
+)
+DEFRAG_MIGRATIONS = REGISTRY.counter(
+    "tpu_dra_defrag_migrations_total",
+    "Scattered low-priority claims migrated by the wave-idle defrag pass "
+    "to open a contiguous subslice",
+)
 # Claim lifecycle latency: created -> allocated is a controller-side
 # observation from the claim's creationTimestamp; allocated -> prepared and
 # created -> prepared are plugin-side, joined across processes via the
