@@ -11,7 +11,7 @@ TAG     ?= latest
         observability-smoke perf-smoke explain-smoke serve-smoke \
         serve-obs-smoke chaos-smoke fleet-smoke obs-top-smoke paged-smoke \
         kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke \
-        disagg-smoke capacity-smoke wave-smoke
+        disagg-smoke capacity-smoke wave-smoke incident-smoke
 
 # `analyze` runs the full rule registry — the L-style rules lint would
 # run plus the whole-repo invariants — so `all` needs only one pass.
@@ -29,7 +29,7 @@ TAG     ?= latest
 # `wave-smoke` fails fast on a wave-scheduling regression (batch
 # placement, priority preemption + `tpudra explain` Preempted,
 # PreemptionChurn lifecycle, defrag healing /debug/capacity).
-all: analyze kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke disagg-smoke capacity-smoke wave-smoke test
+all: analyze kernel-smoke kv-smoke swap-smoke requests-smoke obs-scale-smoke disagg-smoke capacity-smoke wave-smoke incident-smoke test
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -228,6 +228,17 @@ capacity-smoke:
 wave-smoke:
 	$(PYTHON) -m pytest tests/test_wave_smoke.py -q -m 'not slow'
 
+# Incident correlation floor (docs/OBSERVABILITY.md "Incident
+# correlation"): a kubesim node kill takes the victim's pane down,
+# evicts its claim, and strands the re-placed chips; a real collector
+# fuses the three firings into exactly ONE incident root-caused to the
+# killed node, /debug/incidents serves the merged timeline
+# (json/text/filters/400s), `tpudra incidents`/`tpudra incident <id>`
+# render the same bytes, incident-open writes ONE tagged snapshot, and
+# revive + deallocate walks open -> mitigated -> resolved.
+incident-smoke:
+	$(PYTHON) -m pytest tests/test_incident_smoke.py tests/test_incidents.py -q -m 'not slow'
+
 image:
 	docker build -t $(IMAGE):$(TAG) -f deployments/container/Dockerfile.ubuntu .
 
@@ -243,4 +254,5 @@ help:
 	@echo "         chaos-smoke fleet-smoke obs-top-smoke paged-smoke"
 	@echo "         kernel-smoke kv-smoke swap-smoke requests-smoke"
 	@echo "         obs-scale-smoke capacity-smoke wave-smoke"
+	@echo "         incident-smoke"
 	@echo "         image clean"

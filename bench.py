@@ -3024,6 +3024,7 @@ cluster.start()
 import os as _os
 
 from tpu_dra.obs import alerts as obsalerts
+from tpu_dra.obs import incidents as obsincidents
 from tpu_dra.obs.collector import ObsCollector
 from tpu_dra.utils.metrics import MetricsServer
 
@@ -3035,12 +3036,27 @@ collector = ObsCollector(
     interval_s=0.05,
     timeout_s=2.0,
     rules=[
+        # keep_firing_for damps the storm's oscillation: between the two
+        # seeded kills a rule dipping under threshold holds its firing
+        # state instead of flapping the incident lifecycle.
         obsalerts.eviction_spike(
-            rate_threshold=0.05, window_s=2.0, for_s=0.1
+            rate_threshold=0.05, window_s=2.0, for_s=0.1,
+            keep_firing_for=0.5,
         ),
-        obsalerts.scrape_down(for_s=0.1),
+        obsalerts.scrape_down(for_s=0.1, keep_firing_for=0.5),
+        # The third member of the kill's cascade: the dead node's gang
+        # claims hold chips with no device steps (the gang pods never
+        # bind engines), so the ledger strands them until deallocation.
+        obsalerts.stranded_capacity(
+            stranded_after_s=2.0, min_chips=1, for_s=0.1,
+            keep_firing_for=0.5,
+        ),
     ],
     recorder=obsalerts.AlertFlightRecorder(),
+    incident_recorder=obsincidents.IncidentFlightRecorder(),
+    # Longer than the whole chaos window: the storm's second kill must
+    # REOPEN the one incident, never mint a sibling.
+    resolve_hold_s=60.0,
     snapshot_dir=obs_snap,
     auto_discover_local=True,  # adopts the SimCluster pane
 )
@@ -3127,14 +3143,41 @@ try:
         )
         for v in killed
     )
-    # The observability plane's verdict on the same chaos: both alerts
-    # must complete their lifecycle (the eviction wave and the dead
-    # endpoint fire, then resolve once the storm passes and the node
-    # pane returns).  Wait out the rate windows before judging.
+    # Let the third cascade member land before cleanup: the gang claims
+    # strand (no device steps) a grace window after allocation, and the
+    # incident must attach StrandedCapacity while the storm's other two
+    # members are on the books.
+    stranded_deadline = time.monotonic() + 15
+    while time.monotonic() < stranded_deadline:
+        if any(
+            e.rule == "StrandedCapacity" and e.state == "firing"
+            for e in collector.engine.recorder.query()
+        ):
+            break
+        time.sleep(0.1)
+    # Deleting the gang deallocates the claims (the controller closes
+    # the ledger entries), so StrandedCapacity resolves and the incident
+    # can mitigate — the full lifecycle, not a forever-open incident.
+    for i in range(GANG):
+        try:
+            cluster.delete_pod(NS, f"worker-{i}")
+        except Exception:
+            pass
+    # The observability plane's verdict on the same chaos: every alert
+    # must complete its lifecycle (the eviction wave, the dead endpoint,
+    # and the stranded claims fire, then resolve once the storm passes,
+    # the node pane returns, and the gang deallocates) — and the ONE
+    # fused incident must leave the open state.  Wait out the rate
+    # windows before judging.
     obs_deadline = time.monotonic() + 30
     while time.monotonic() < obs_deadline:
         status = {s["rule"]: s["state"] for s in collector.engine.status()}
-        if all(st == "ok" for st in status.values()):
+        incident_states = {
+            i["state"] for i in collector.incidents.query()
+        }
+        if all(st == "ok" for st in status.values()) and "open" not in (
+            incident_states
+        ):
             break
         time.sleep(0.1)
     collector.stop()
@@ -3153,12 +3196,42 @@ try:
 
     eviction_alert = lifecycle("ClaimEvictionSpike")
     scrape_alert = lifecycle("ScrapeDown")
+    stranded_alert = lifecycle("StrandedCapacity")
     post_mortem = collector.dump_snapshot(reason="post-chaos")
+    # The incident engine's verdict: the whole seeded storm — two kills,
+    # an eviction wave, a dead scrape target, stranded chips — must fuse
+    # into exactly ONE incident whose ranked root cause names a killed
+    # node, with all three rule families attached and the merged
+    # evidence timeline in causal (non-decreasing stamp) order.
+    incident_docs = collector.incidents.query(limit=16)
+    one_incident = len(incident_docs) == 1
+    inc = incident_docs[0] if incident_docs else {}
+    inc_root = inc.get("root_cause", "")
+    inc_members = {m["rule"] for m in inc.get("members", ())}
+    inc_stamps = [t["ts_unix"] for t in inc.get("timeline", ())]
+    incident_summary = {
+        "count": len(incident_docs),
+        "one_incident": one_incident,
+        "id": inc.get("id", ""),
+        "state": inc.get("state", ""),
+        "root_cause": inc_root,
+        "root_names_victim": any(v in inc_root for v in killed),
+        "member_rules": sorted(inc_members),
+        "timeline_events": len(inc_stamps),
+        "timeline_monotonic": inc_stamps == sorted(inc_stamps),
+        "snapshot_tagged": bool(inc.get("snapshot")),
+    }
     obs_ok = bool(
         all(eviction_alert.values())
         and all(scrape_alert.values())
+        and all(stranded_alert.values())
         and collector.rounds > 10
         and _os.path.isdir(post_mortem)
+        and one_incident
+        and incident_summary["root_names_victim"]
+        and len(inc_members) >= 3
+        and incident_summary["timeline_monotonic"]
+        and inc.get("state") in ("mitigated", "resolved")
     )
     out["control_plane"] = {
         "nodes": 4, "gang_size": GANG, "kills": len(killed),
@@ -3173,6 +3246,8 @@ try:
         "obs": {
             "eviction_alert": eviction_alert,
             "scrape_down_alert": scrape_alert,
+            "stranded_alert": stranded_alert,
+            "incidents": incident_summary,
             "alert_events": len(hist),
             "scrape_rounds": collector.rounds,
             "snapshots": len(_os.listdir(obs_snap)),

@@ -289,7 +289,19 @@ def test_bench_chaos_stanza():
     assert obs["ok"], obs
     assert all(obs["eviction_alert"].values())
     assert all(obs["scrape_down_alert"].values())
+    assert all(obs["stranded_alert"].values())
     assert obs["snapshots"] >= 1 and obs["scrape_rounds"] > 10
+    # The incident engine fused the whole storm (ISSUE 20): exactly ONE
+    # incident, root-caused to a killed node, with the full three-rule
+    # cascade on a causally ordered timeline, and the open wrote the
+    # incident-tagged snapshot.
+    inc = obs["incidents"]
+    assert inc["one_incident"], inc
+    assert inc["root_names_victim"], inc
+    assert len(inc["member_rules"]) >= 3, inc
+    assert inc["timeline_monotonic"] and inc["timeline_events"] >= 3
+    assert inc["state"] in ("mitigated", "resolved")
+    assert inc["snapshot_tagged"]
     assert out["elastic_train"]["loss_continuity_ok"]
     assert out["elastic_train"]["devices_after"] < out["elastic_train"][
         "devices_before"

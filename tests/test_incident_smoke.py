@@ -1,0 +1,251 @@
+"""`make incident-smoke` — the ISSUE 20 story end to end, in CI
+seconds: a kubesim node kill takes the victim's pane endpoint down,
+evicts its claim, and strands the re-placed chips; a REAL collector
+fuses the three alert firings into exactly ONE incident whose ranked
+root cause names the killed node; `/debug/incidents` serves the
+timeline over HTTP (json/text/filters/400s) with the CLI rendering the
+same bytes; incident-open writes ONE tagged snapshot; and
+revive + deallocate walks the lifecycle open -> mitigated -> resolved."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from test_chaos import NS, make_pod, setup_workload
+from tpu_dra.controller import decisions
+from tpu_dra.obs import alerts as obsalerts
+from tpu_dra.obs import capacity
+from tpu_dra.obs import incidents as obsincidents
+from tpu_dra.obs.collector import Endpoint, ObsCollector, set_active
+from tpu_dra.sim import SimCluster
+from tpu_dra.utils.metrics import MetricsServer
+
+from helpers import metric_value
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def _wait(pred, timeout=90.0, poll=0.05, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = pred()
+        if value:
+            return value
+        time.sleep(poll)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_incident_story_over_http(tmp_path, capsys):
+    from tpu_dra.cmds import explain as cli
+
+    capacity.reset()
+    cluster = SimCluster(
+        str(tmp_path / "sim"), nodes=2, mesh="2x2x1",
+        metrics_endpoint="127.0.0.1:0", recreate_evicted=True,
+    )
+    cluster.start()
+    collector = node_pane = None
+    snap_dir = tmp_path / "snaps"
+    try:
+        # -- 1. a claim with no consumer: the first incident member -------
+        setup_workload(cluster)
+        cluster.clientset.pods(NS).create(make_pod("inc-pod"))
+        cluster.wait_for_pod_running(NS, "inc-pod", timeout=60)
+        victim = cluster.clientset.pods(NS).get("inc-pod").spec.node_name
+        claim_uid = (
+            cluster.clientset.resource_claims(NS)
+            .get("inc-pod-tpu").metadata.uid
+        )
+        _wait(
+            lambda: claim_uid in capacity.open_claims(),
+            what="ledger to see the allocation commit",
+        )
+        ctrl_url = f"http://127.0.0.1:{cluster.metrics_server.port}"
+        # The victim node's plugin pane: dies with the node, revives on
+        # the same port — the ScrapeDown member of the cascade.
+        node_pane = MetricsServer("127.0.0.1:0")
+        node_pane.start()
+        pane_port = node_pane.port
+
+        collector = ObsCollector(
+            [
+                Endpoint(ctrl_url, name="ctrl"),
+                Endpoint(f"http://127.0.0.1:{pane_port}", name=victim),
+            ],
+            rules=[
+                obsalerts.scrape_down(),
+                obsalerts.eviction_spike(
+                    rate_threshold=0.01, window_s=5.0
+                ),
+                obsalerts.stranded_capacity(
+                    stranded_after_s=0.5, min_chips=1
+                ),
+            ],
+            recorder=obsalerts.AlertFlightRecorder(),
+            incident_recorder=obsincidents.IncidentFlightRecorder(),
+            resolve_hold_s=30.0,
+            snapshot_dir=str(snap_dir),
+        )
+        time.sleep(0.6)  # the unbound claim crosses stranded_after_s
+        events = collector.scrape_once(now_mono=1000.0)
+        assert "firing" in [e.state for e in events]
+        assert collector.incidents.open_count() == 1
+
+        # Incident open wrote ONE snapshot, tagged with the incident id
+        # — not one per firing rule.
+        (inc,) = collector.incidents.query()
+        snaps = sorted(os.listdir(snap_dir))
+        assert len(snaps) == 1
+        with open(snap_dir / snaps[0] / "cluster.json") as f:
+            assert json.load(f)["reason"] == f"incident:{inc['id']}"
+
+        # -- 2. the kill: pane down, claim evicted, chips re-strand -------
+        node_pane.stop()
+        node_pane = None
+        cluster.kill_node(victim)
+        _wait(
+            lambda: any(
+                r.verdict == decisions.EVICTED and r.node == victim
+                for r in decisions.RECORDER.query()
+            ),
+            what="eviction record for the killed node",
+        )
+        # Recreation mints a fresh claim for the re-placed pod; wait for
+        # it to land on the survivor and re-open the ledger.
+        def replaced():
+            try:
+                pod = cluster.clientset.pods(NS).get("inc-pod")
+            except Exception:
+                return None
+            return (
+                pod.status.phase == "Running"
+                and pod.spec.node_name != victim
+            )
+
+        _wait(replaced, what="evicted pod to re-place on the survivor")
+        claim_uid = (
+            cluster.clientset.resource_claims(NS)
+            .get("inc-pod-tpu").metadata.uid
+        )
+        _wait(
+            lambda: claim_uid in capacity.open_claims(),
+            what="re-placed claim to re-open the ledger",
+        )
+        events = collector.scrape_once(now_mono=1001.0)
+        fired = {e.rule for e in events if e.state == "firing"}
+        assert {"ScrapeDown", "ClaimEvictionSpike"} <= fired
+
+        # -- 3. ONE incident, root-caused to the killed node --------------
+        docs = collector.incidents.query()
+        assert len(docs) == 1, "the cascade must fuse, not mint siblings"
+        (inc,) = docs
+        assert inc["state"] == "open"
+        assert {m["rule"] for m in inc["members"]} == {
+            "ScrapeDown", "ClaimEvictionSpike", "StrandedCapacity",
+        }
+        assert inc["root_rule"] == "ScrapeDown"
+        assert inc["root_cause"].startswith(f"{victim} NotReady")
+        assert "eviction" in inc["root_cause"]
+        assert "stranded" in inc["root_cause"]
+        stamps = [t["ts_unix"] for t in inc["timeline"]]
+        assert stamps == sorted(stamps), "timeline must be causally ordered"
+        assert victim in inc["labels"].get("node", [])
+        assert len(os.listdir(snap_dir)) == 1, (
+            "member attach must not write more snapshots"
+        )
+
+        # -- 4. /debug/incidents over HTTP: json, text, filters, 400s -----
+        obs_server = collector.serve()
+        base = f"http://127.0.0.1:{obs_server.port}"
+        doc = json.loads(_get(base + "/debug/incidents"))
+        assert doc["open"] == 1 and doc["count"] == 1
+        assert doc["incidents"][0]["id"] == inc["id"]
+        detail = json.loads(
+            _get(base + f"/debug/incidents?id={inc['id']}")
+        )
+        assert detail["detail"] and len(detail["incidents"]) == 1
+        assert len(detail["incidents"][0]["timeline"]) >= 3
+        assert json.loads(
+            _get(base + f"/debug/incidents?node={victim}")
+        )["count"] == 1
+        assert json.loads(
+            _get(base + "/debug/incidents?node=nope")
+        )["count"] == 0
+        assert json.loads(
+            _get(base + "/debug/incidents?rule=ScrapeDown")
+        )["count"] == 1
+        text = _get(base + "/debug/incidents?format=text")
+        assert inc["id"] in text and f"{victim} NotReady" in text
+        dtext = _get(base + f"/debug/incidents?id={inc['id']}&format=text")
+        assert "timeline:" in dtext and "*ScrapeDown" in dtext
+        assert "docs/OBSERVABILITY.md#scrapedown" in dtext
+        for bad in ("format=xml", "limit=0", "limit=x"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(base + f"/debug/incidents?{bad}")
+            assert exc.value.code == 400, bad
+        index = json.loads(_get(base + "/debug/index"))
+        assert index["endpoints"]["/debug/incidents"]["open"] == 1
+
+        # -- 5. the CLI renders the same bytes ----------------------------
+        rc = cli.main(["incidents", "--endpoint", base])
+        out = capsys.readouterr().out
+        assert rc == 0 and out == text
+        rc = cli.main(["incident", inc["id"], "--endpoint", base])
+        out = capsys.readouterr().out
+        assert rc == 0 and out == dtext
+        rc = cli.main(
+            ["incidents", "--endpoint", base, "--format", "json"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0 and json.loads(out)["open"] == 1
+        # The cluster pane banners the open incident.
+        rc = cli.main(["top", "--endpoint", base])
+        out = capsys.readouterr().out
+        assert rc == 0 and "1 INCIDENT:" in out
+        assert f"{victim} NotReady" in out
+
+        # -- 6. mitigation: revive the pane, deallocate the claim ---------
+        node_pane = MetricsServer(f"127.0.0.1:{pane_port}")
+        node_pane.start()
+        cluster.delete_pod(NS, "inc-pod")
+        _wait(
+            lambda: claim_uid not in capacity.open_claims(),
+            what="controller deallocate to close the ledger entry",
+        )
+        events = collector.scrape_once(now_mono=1010.0)
+        assert {e.state for e in events} == {"resolved"}
+        (inc,) = collector.incidents.query()
+        assert inc["state"] == "mitigated"
+
+        # -- 7. the resolve hold elapses: incident closes -----------------
+        collector.scrape_once(now_mono=1041.0)
+        (inc,) = collector.incidents.query()
+        assert inc["state"] == "resolved"
+        assert collector.incidents.open_count() == 0
+        exposed = collector.registry.expose()
+        for state in ("opened", "mitigated", "resolved"):
+            assert metric_value(
+                exposed, "tpu_dra_obs_incidents_total", state=state
+            ) == 1, state
+        assert metric_value(exposed, "tpu_dra_obs_incident_open") == 0
+        # The resolved incident still serves — with its full timeline.
+        closed = json.loads(
+            _get(base + f"/debug/incidents?id={inc['id']}")
+        )["incidents"][0]
+        assert closed["state"] == "resolved"
+        assert len(closed["timeline"]) >= 3
+    finally:
+        if collector is not None:
+            collector.close()
+        set_active(None)
+        if node_pane is not None:
+            node_pane.stop()
+        cluster.stop()
+        capacity.reset()

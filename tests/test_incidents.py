@@ -1,0 +1,761 @@
+"""Incident correlation engine: alert hysteresis, firing fusion, causal
+root-cause ranking, evidence timelines, lifecycle, and the collector's
+one-snapshot-per-incident + capability-churn behavior."""
+
+import json
+import os
+import re
+
+import pytest
+
+from tpu_dra.obs import alerts as obsalerts
+from tpu_dra.obs import incidents as obsincidents
+from tpu_dra.utils.metrics import RING_DROPPED
+
+
+class FakeView:
+    """Minimal rule view (the test_obs shape)."""
+
+    def __init__(self, rates=None, health=()):
+        self.rates = rates or {}
+        self.health = list(health)
+
+    def rate(self, name, *, window_s=60.0, endpoint=None, **labels):
+        key = (name,) + tuple(sorted(labels.items()))
+        return self.rates.get(key, self.rates.get((name,), 0.0))
+
+    def endpoint_health(self, now_mono=None):
+        return self.health
+
+
+class FetchView:
+    """Canned evidence planes for the incident engine's fetch fan-in."""
+
+    def __init__(self, decisions=(), capacity=(), requests=(), kv=()):
+        self.decisions = [dict(d) for d in decisions]
+        self.capacity = [dict(d) for d in capacity]
+        self.requests = [dict(d) for d in requests]
+        self.kv = [dict(d) for d in kv]
+        self.fetches = []
+
+    def fetch_decisions(self, **kw):
+        self.fetches.append(("decisions", kw))
+        return self.decisions
+
+    def fetch_capacity(self, **kw):
+        self.fetches.append(("capacity", kw))
+        return self.capacity
+
+    def fetch_requests(self, **kw):
+        self.fetches.append(("requests", kw))
+        return self.requests
+
+    def fetch_kv(self, **kw):
+        self.fetches.append(("kv", kw))
+        return self.kv
+
+
+def firing_event(rule, detail="", value=1.0, ts=1000.0, severity="page"):
+    return obsalerts.AlertEvent(
+        rule=rule, severity=severity, state="firing",
+        prev_state="pending", value=value, detail=detail, ts_unix=ts,
+    )
+
+
+def resolved_event(rule, ts=1000.0):
+    return obsalerts.AlertEvent(
+        rule=rule, state="resolved", prev_state="firing", ts_unix=ts
+    )
+
+
+def engine(**kw):
+    kw.setdefault("recorder", obsincidents.IncidentFlightRecorder())
+    return obsincidents.IncidentEngine(**kw)
+
+
+class TestKeepFiringFor:
+    """Satellite: keep_firing_for hysteresis on the alert engine."""
+
+    def rule(self, keep):
+        return obsalerts.AlertRule(
+            name="Osc",
+            expr=lambda v: (v.rate("x") > 1, v.rate("x"), "d"),
+            for_s=0.0,
+            keep_firing_for=keep,
+        )
+
+    def test_oscillation_without_hysteresis_flaps(self):
+        eng = obsalerts.AlertEngine(
+            [self.rule(0.0)], recorder=obsalerts.AlertFlightRecorder()
+        )
+        hot = FakeView(rates={("x",): 5.0})
+        cold = FakeView(rates={("x",): 0.0})
+        states = []
+        for i, view in enumerate([hot, cold, hot, cold, hot]):
+            for ev in eng.evaluate(view, now_mono=100.0 + i):
+                states.append(ev.state)
+        assert states.count("firing") == 3  # every hot round re-fires
+        assert states.count("resolved") == 2
+
+    def test_keep_firing_for_holds_one_firing_state(self):
+        eng = obsalerts.AlertEngine(
+            [self.rule(2.5)], recorder=obsalerts.AlertFlightRecorder()
+        )
+        hot = FakeView(rates={("x",): 5.0})
+        cold = FakeView(rates={("x",): 0.0})
+        states = []
+        # Oscillates every second: quiet gaps (1s) < keep_firing_for
+        # (2.5s), so ONE firing spans the whole storm.
+        for i, view in enumerate([hot, cold, hot, cold, hot]):
+            for ev in eng.evaluate(view, now_mono=100.0 + i):
+                states.append(ev.state)
+        assert states == ["pending", "firing"]
+        assert eng.firing() == ["Osc"]
+        # Quiet past the hold finally resolves.
+        eng.evaluate(cold, now_mono=105.0)
+        ev = eng.evaluate(cold, now_mono=108.0)
+        assert [e.state for e in ev] == ["resolved"]
+
+    def test_loud_round_restarts_the_hold(self):
+        eng = obsalerts.AlertEngine(
+            [self.rule(2.0)], recorder=obsalerts.AlertFlightRecorder()
+        )
+        hot = FakeView(rates={("x",): 5.0})
+        cold = FakeView(rates={("x",): 0.0})
+        eng.evaluate(hot, now_mono=100.0)
+        assert eng.evaluate(cold, now_mono=101.0) == []  # hold starts
+        assert eng.evaluate(hot, now_mono=102.5) == []  # re-fired: reset
+        # 1.9s after the reset: still inside the restarted hold.
+        assert eng.evaluate(cold, now_mono=103.0) == []
+        assert eng.evaluate(cold, now_mono=104.4) == []
+        ev = eng.evaluate(cold, now_mono=105.1)
+        assert [e.state for e in ev] == ["resolved"]
+
+    def test_default_rules_thread_keep_firing_for(self):
+        for rule in obsalerts.default_rules(keep_firing_for=7.5):
+            assert rule.keep_firing_for == 7.5, rule.name
+
+
+class TestRunbooks:
+    """Satellite: every stock rule links a docs/OBSERVABILITY.md anchor."""
+
+    def test_every_stock_rule_has_a_runbook(self):
+        rules = obsalerts.default_rules() + [
+            obsalerts.slo_class_burn(
+                obsalerts.ClassSLO(cls=0, ttft_p95_s=0.1)
+            )
+        ]
+        for rule in rules:
+            assert rule.runbook.startswith("docs/OBSERVABILITY.md#"), (
+                rule.name
+            )
+
+    def test_runbook_anchors_exist_in_the_doc(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, "docs", "OBSERVABILITY.md")) as f:
+            doc = f.read()
+        # GitHub heading slugs: lowercase, spaces -> dashes, drop other
+        # punctuation (the backtick-free rule names slug to themselves).
+        slugs = {
+            re.sub(r"[^a-z0-9 -]", "", line.lstrip("#").strip().lower())
+            .replace(" ", "-")
+            for line in doc.splitlines()
+            if line.startswith("#")
+        }
+        rules = obsalerts.default_rules() + [
+            obsalerts.slo_class_burn(
+                obsalerts.ClassSLO(cls=0, ttft_p95_s=0.1)
+            )
+        ]
+        for rule in rules:
+            anchor = rule.runbook.split("#", 1)[1]
+            assert anchor in slugs, (
+                f"{rule.name} runbook anchor #{anchor} has no heading in "
+                "docs/OBSERVABILITY.md"
+            )
+
+    def test_status_doc_carries_runbook(self):
+        eng = obsalerts.AlertEngine(
+            [obsalerts.scrape_down()],
+            recorder=obsalerts.AlertFlightRecorder(),
+        )
+        (status,) = eng.status()
+        assert status["runbook"] == "docs/OBSERVABILITY.md#scrapedown"
+
+
+class TestCorrelation:
+    def test_causal_cascade_fuses_into_one_incident(self):
+        eng = engine()
+        view = FetchView()
+        eng.observe(
+            [firing_event("ScrapeDown", "1/2 endpoint(s) down: node-pane")],
+            view, now_mono=100.0,
+        )
+        eng.observe(
+            [firing_event("ClaimEvictionSpike", "0.5 evictions/s")],
+            view, now_mono=101.0,
+        )
+        eng.observe(
+            [
+                firing_event(
+                    "StrandedCapacity",
+                    "4 allocated chip(s) with no device steps for > 2s: "
+                    "default/gang-a (4 chips)",
+                )
+            ],
+            view, now_mono=102.0,
+        )
+        docs = eng.query()
+        assert len(docs) == 1
+        assert {m["rule"] for m in docs[0]["members"]} == {
+            "ScrapeDown", "ClaimEvictionSpike", "StrandedCapacity",
+        }
+        assert docs[0]["root_rule"] == "ScrapeDown"
+
+    def test_unrelated_scoped_rules_stay_siblings(self):
+        eng = engine()
+        view = FetchView()
+        eng.observe(
+            [
+                firing_event(
+                    "NodeFragmentation",
+                    "fragmented free capacity: node-1 (4 free, largest "
+                    "block 1)",
+                )
+            ],
+            view, now_mono=100.0,
+        )
+        # SLOClassBurn is neither causally adjacent to NodeFragmentation
+        # nor sharing a label dimension value -> a second incident.
+        eng.observe(
+            [firing_event("SLOClassBurn-class0", "class 0: ttft over")],
+            view, now_mono=101.0,
+        )
+        assert len(eng.query()) == 2
+
+    def test_shared_node_label_fuses(self):
+        eng = engine()
+        view = FetchView(
+            capacity=[{
+                "endpoint": "ctrl",
+                "claims": [{
+                    "claim": "default/gang-a", "claim_uid": "u1",
+                    "node": "node-1", "chips": 4,
+                    "stranded_chip_s": 12.0, "stranded_now": True,
+                }],
+            }],
+        )
+        eng.observe(
+            [
+                firing_event(
+                    "StrandedCapacity",
+                    "4 allocated chip(s) with no device steps for > 2s: "
+                    "default/gang-a (4 chips)",
+                )
+            ],
+            view, now_mono=100.0,
+        )
+        # Evidence enriched the incident with node-1; the fragmentation
+        # alert names the same node -> fuses despite no causal edge
+        # being needed.
+        eng.observe(
+            [
+                firing_event(
+                    "NodeFragmentation",
+                    "fragmented free capacity: node-1 (4 free, largest "
+                    "block 1)",
+                )
+            ],
+            view, now_mono=101.0,
+        )
+        docs = eng.query()
+        assert len(docs) == 1
+        assert "node-1" in docs[0]["labels"]["node"]
+
+    def test_firing_outside_window_opens_new_incident(self):
+        eng = engine(correlation_window_s=10.0)
+        view = FetchView()
+        eng.observe(
+            [firing_event("ScrapeDown", "1/2 endpoint(s) down: a")],
+            view, now_mono=100.0,
+        )
+        eng.observe(
+            [firing_event("ClaimEvictionSpike", "0.5 evictions/s")],
+            view, now_mono=150.0,
+        )
+        assert len(eng.query()) == 2
+
+
+class TestVerdict:
+    def test_root_cause_names_the_dead_node_from_evidence(self):
+        eng = engine()
+        view = FetchView(
+            decisions=[{
+                "endpoint": "ctrl",
+                "decisions": [
+                    {
+                        "seq": 1, "ts_unix": 999.0, "claim": "default/g0",
+                        "claim_uid": "u0", "node": "node-3",
+                        "verdict": "evicted", "reason": "NodeNotReady",
+                    },
+                    {
+                        "seq": 2, "ts_unix": 999.5, "claim": "default/g1",
+                        "claim_uid": "u1", "node": "node-3",
+                        "verdict": "evicted", "reason": "NodeNotReady",
+                    },
+                    # Non-eviction verdicts are not incident evidence.
+                    {
+                        "seq": 3, "ts_unix": 999.6, "claim": "default/g2",
+                        "claim_uid": "u2", "node": "node-2",
+                        "verdict": "allocated", "reason": "Scored",
+                    },
+                ],
+            }],
+            capacity=[{
+                "endpoint": "ctrl",
+                "claims": [{
+                    "claim": "default/g0", "claim_uid": "u0",
+                    "node": "node-3", "chips": 4,
+                    "stranded_chip_s": 480.0, "stranded_now": True,
+                }],
+            }],
+        )
+        eng.observe(
+            [
+                firing_event(
+                    "ScrapeDown", "1/2 endpoint(s) down: local:9001",
+                    ts=1000.0,
+                ),
+                firing_event(
+                    "ClaimEvictionSpike", "0.4 evictions/s", ts=1000.5
+                ),
+                firing_event(
+                    "StrandedCapacity",
+                    "4 allocated chip(s) with no device steps for > 2s: "
+                    "default/g0 (4 chips)",
+                    ts=1001.0,
+                ),
+            ],
+            view, now_mono=100.0,
+        )
+        (doc,) = eng.query()
+        assert doc["root_rule"] == "ScrapeDown"
+        assert doc["root_cause"].startswith("node-3 NotReady")
+        assert "2 eviction(s)" in doc["root_cause"]
+        assert "480 stranded chip-s" in doc["root_cause"]
+        # Eviction evidence filtered to evicted verdicts only.
+        assert len(doc["evidence"]["decisions"]) == 2
+
+    def test_timeline_is_merged_and_monotonic(self):
+        eng = engine()
+        view = FetchView(
+            decisions=[{
+                "endpoint": "ctrl",
+                "decisions": [{
+                    "seq": 1, "ts_unix": 999.0, "claim": "default/g0",
+                    "claim_uid": "u0", "node": "node-3",
+                    "verdict": "evicted", "reason": "NodeNotReady",
+                }],
+            }],
+        )
+        eng.observe(
+            [firing_event("ScrapeDown", "1/2 down: a", ts=1000.0)],
+            view, now_mono=100.0,
+        )
+        eng.observe(
+            [firing_event("ClaimEvictionSpike", "0.4/s", ts=1002.0)],
+            view, now_mono=102.0,
+        )
+        (doc,) = eng.query()
+        stamps = [t["ts_unix"] for t in doc["timeline"]]
+        assert stamps == sorted(stamps)
+        # The eviction record (999.0) sorts BEFORE the alerts that
+        # noticed it — causal order, not arrival order.
+        assert doc["timeline"][0]["source"] == "decision"
+        sources = {t["source"] for t in doc["timeline"]}
+        assert sources == {"decision", "alert"}
+        # Endpoint attribution rides every evidence entry.
+        assert doc["timeline"][0]["endpoint"] == "ctrl"
+
+    def test_evidence_refresh_keeps_first_seen_stamps(self):
+        eng = engine()
+        view = FetchView(
+            capacity=[{
+                "endpoint": "ctrl",
+                "claims": [{
+                    "claim": "default/g0", "claim_uid": "u0",
+                    "node": "n1", "chips": 2, "stranded_chip_s": 1.0,
+                    "stranded_now": True,
+                }],
+            }],
+        )
+        eng.observe(
+            [firing_event("StrandedCapacity", "2 chips: default/g0 (2 chips)")],
+            view, now_mono=100.0,
+        )
+        (doc,) = eng.query()
+        first = [
+            t["ts_unix"] for t in doc["timeline"]
+            if t["source"] == "capacity"
+        ]
+        # A member transition triggers a re-fetch; the capacity row is
+        # the same entity, so its stamp must not move.
+        eng.observe(
+            [resolved_event("StrandedCapacity", ts=1010.0)],
+            view, now_mono=110.0,
+        )
+        (doc,) = eng.query()
+        again = [
+            t["ts_unix"] for t in doc["timeline"]
+            if t["source"] == "capacity"
+        ]
+        assert first == again
+
+
+class TestLifecycle:
+    def test_open_mitigated_resolved_with_hold(self):
+        eng = engine(resolve_hold_s=5.0)
+        view = FetchView()
+        eng.observe(
+            [firing_event("ScrapeDown", "1/1 down: a")], view, now_mono=100.0
+        )
+        (doc,) = eng.query()
+        assert doc["state"] == "open"
+        events = eng.observe(
+            [resolved_event("ScrapeDown")], view, now_mono=101.0
+        )
+        assert [e.state for e in events] == ["mitigated"]
+        (doc,) = eng.query()
+        assert doc["state"] == "mitigated"
+        # Inside the hold: still mitigated.
+        assert eng.observe([], view, now_mono=103.0) == []
+        events = eng.observe([], view, now_mono=106.5)
+        assert [e.state for e in events] == ["resolved"]
+        (doc,) = eng.query()
+        assert doc["state"] == "resolved"
+        assert eng.open_count() == 0
+
+    def test_refire_during_hold_reopens_same_incident(self):
+        eng = engine(resolve_hold_s=60.0)
+        view = FetchView()
+        eng.observe(
+            [firing_event("ScrapeDown", "1/1 down: a")], view, now_mono=100.0
+        )
+        eng.observe([resolved_event("ScrapeDown")], view, now_mono=101.0)
+        events = eng.observe(
+            [firing_event("ScrapeDown", "1/1 down: a")], view, now_mono=110.0
+        )
+        assert [e.state for e in events] == ["reopened"]
+        docs = eng.query()
+        assert len(docs) == 1  # the SAME incident, no sibling
+        assert docs[0]["state"] == "open"
+
+    def test_lifecycle_counts_metrics(self):
+        class Stub:
+            def __init__(self):
+                self.counts = {}
+                self.value = 0
+
+            def inc(self, n=1, **labels):
+                key = labels.get("state")
+                self.counts[key] = self.counts.get(key, 0) + n
+
+            def set(self, v, **labels):
+                self.value = v
+
+        total, open_g = Stub(), Stub()
+        eng = engine(
+            resolve_hold_s=1.0, incidents_total=total, incident_open=open_g
+        )
+        view = FetchView()
+        eng.observe(
+            [
+                firing_event("ScrapeDown", "1/1 down: a"),
+                firing_event("ClaimEvictionSpike", "0.5/s"),
+            ],
+            view, now_mono=100.0,
+        )
+        assert open_g.value == 1
+        eng.observe(
+            [resolved_event("ScrapeDown"), resolved_event("ClaimEvictionSpike")],
+            view, now_mono=101.0,
+        )
+        eng.observe([], view, now_mono=103.0)
+        assert total.counts == {"opened": 1, "mitigated": 1, "resolved": 1}
+        # The member attach is a ring event, never a metric label.
+        assert "member" not in total.counts
+        assert open_g.value == 0
+
+    def test_recorder_ring_bounds_and_dropped_metric(self):
+        rec = obsincidents.IncidentFlightRecorder(capacity=3)
+        before = RING_DROPPED.value(ring="obs_incidents")
+        for i in range(5):
+            rec.record(
+                obsincidents.IncidentEvent(incident=f"inc-{i}", state="opened")
+            )
+        assert rec.recorded == 5
+        assert rec.dropped == 2
+        assert len(rec.query()) == 3
+        assert RING_DROPPED.value(ring="obs_incidents") == before + 2
+
+
+class TestDocumentAndRender:
+    def build(self):
+        eng = engine(resolve_hold_s=60.0)
+        view = FetchView(
+            decisions=[{
+                "endpoint": "ctrl",
+                "decisions": [{
+                    "seq": 1, "ts_unix": 999.0, "claim": "default/g0",
+                    "claim_uid": "u0", "node": "node-3",
+                    "verdict": "evicted", "reason": "NodeNotReady",
+                }],
+            }],
+        )
+        rules = {
+            r.name: r
+            for r in [obsalerts.scrape_down(), obsalerts.eviction_spike()]
+        }
+        eng.observe(
+            [
+                firing_event("ScrapeDown", "1/2 down: a", ts=1000.0),
+                firing_event("ClaimEvictionSpike", "0.4/s", ts=1000.5),
+            ],
+            view, now_mono=100.0, rules=rules,
+        )
+        return eng
+
+    def test_listing_and_filters(self):
+        eng = self.build()
+        doc = obsincidents.incidents_doc(eng, now_mono=105.0)
+        assert doc["open"] == 1 and doc["count"] == 1
+        assert not doc["detail"]
+        assert obsincidents.incidents_doc(eng, node="node-3")["count"] == 1
+        assert obsincidents.incidents_doc(eng, node="node-9")["count"] == 0
+        assert (
+            obsincidents.incidents_doc(eng, rule="ScrapeDown")["count"] == 1
+        )
+        assert obsincidents.incidents_doc(eng, rule="Nope")["count"] == 0
+
+    def test_detail_render_carries_members_timeline_runbook(self):
+        eng = self.build()
+        (inc,) = eng.query()
+        doc = obsincidents.incidents_doc(eng, id=inc["id"], now_mono=105.0)
+        assert doc["detail"]
+        text = obsincidents.render_text(doc)
+        assert f"incident {inc['id']}" in text
+        assert "root cause:" in text
+        assert "node-3 NotReady" in text
+        assert "timeline:" in text
+        assert "docs/OBSERVABILITY.md#scrapedown" in text
+        # The root member is starred.
+        assert "*ScrapeDown" in text
+
+    def test_listing_render_shows_root_cause(self):
+        eng = self.build()
+        doc = obsincidents.incidents_doc(eng, now_mono=105.0)
+        text = obsincidents.render_text(doc)
+        assert "1 open" in text
+        assert "node-3 NotReady" in text
+
+    def test_doc_without_engine_is_empty_not_error(self):
+        doc = obsincidents.incidents_doc(None)
+        assert doc["incidents"] == [] and doc["open"] == 0
+        assert obsincidents.render_text(doc).startswith("incidents: 0 open")
+
+
+class TestCollectorIntegration:
+    def collector(self, tmp_path, rules):
+        from tpu_dra.obs.collector import ObsCollector
+
+        return ObsCollector(
+            rules=rules,
+            recorder=obsalerts.AlertFlightRecorder(),
+            incident_recorder=obsincidents.IncidentFlightRecorder(),
+            snapshot_dir=str(tmp_path),
+            resolve_hold_s=60.0,
+        )
+
+    def test_incident_open_writes_one_tagged_snapshot(self, tmp_path):
+        """Satellite: one bounded snapshot per incident OPEN — not one
+        per firing rule — tagged with the incident id."""
+        rules = [
+            obsalerts.AlertRule(
+                name="A", expr=lambda v: (True, 1.0, "a"), for_s=0.0
+            ),
+            obsalerts.AlertRule(
+                name="B", expr=lambda v: (True, 1.0, "b"), for_s=0.0
+            ),
+        ]
+        collector = self.collector(tmp_path, rules)
+        collector.scrape_once(now_mono=100.0)
+        snaps = sorted(os.listdir(tmp_path))
+        assert len(snaps) == 1, (
+            "two rules firing in one round must write ONE snapshot"
+        )
+        with open(tmp_path / snaps[0] / "cluster.json") as f:
+            meta = json.load(f)
+        (inc,) = collector.incidents.query()
+        assert meta["reason"] == f"incident:{inc['id']}"
+        assert inc["snapshot"].endswith(snaps[0])
+        # Later rounds with the rules STILL firing add no snapshots.
+        collector.scrape_once(now_mono=101.0)
+        collector.scrape_once(now_mono=102.0)
+        assert len(os.listdir(tmp_path)) == 1
+
+    def test_collector_feeds_incident_engine(self, tmp_path):
+        rules = [
+            obsalerts.AlertRule(
+                name="A", expr=lambda v: (v.rounds <= 1, 1.0, "a"), for_s=0.0
+            ),
+        ]
+        collector = self.collector(tmp_path, rules)
+        collector.scrape_once(now_mono=100.0)
+        assert collector.incidents.open_count() == 1
+        collector.scrape_once(now_mono=101.0)
+        (inc,) = collector.incidents.query()
+        assert inc["state"] == "mitigated"
+
+
+class TestCapabilityChurn:
+    """Satellite: an endpoint whose /debug/index drops a capability
+    mid-stream (rolling restart) degrades that endpoint's fetches
+    without poisoning the round or the evidence fan-in."""
+
+    def collector(self, index_doc):
+        from tpu_dra.obs.collector import ObsCollector
+
+        state = {"index": index_doc, "index_fails": False}
+        collector = ObsCollector(
+            ["http://fake-node:1"],
+            rules=[],
+            recorder=obsalerts.AlertFlightRecorder(),
+            incident_recorder=obsincidents.IncidentFlightRecorder(),
+            index_refresh_rounds=2,
+        )
+
+        def fake_get(url):
+            if url.endswith("/metrics"):
+                return "# HELP t x\n# TYPE t counter\nt 1\n"
+            if "/debug/index" in url:
+                if state["index_fails"]:
+                    raise OSError("index endpoint restarting")
+                return json.dumps(state["index"])
+            if "/debug/capacity" in url:
+                return json.dumps({"claims": [], "nodes": [], "totals": {}})
+            if "/debug/requests" in url:
+                return json.dumps({"requests": [], "summary": {}})
+            raise OSError(f"unexpected fetch: {url}")
+
+        collector._get = fake_get
+        return collector, state
+
+    def index_with(self, *paths):
+        return {
+            "component": "node",
+            "endpoints": {p: {"kind": "x"} for p in paths},
+        }
+
+    def test_dropped_capability_degrades_fetch_without_poisoning(self):
+        collector, state = self.collector(
+            self.index_with(
+                "/metrics", "/debug/index", "/debug/capacity",
+                "/debug/requests",
+            )
+        )
+        collector.scrape_once(now_mono=100.0)
+        assert len(collector.fetch_capacity()) == 1
+        assert len(collector.fetch_requests()) == 1
+        # Rolling restart: the replacement build serves no capacity
+        # ledger.  After the refresh interval the collector converges.
+        state["index"] = self.index_with(
+            "/metrics", "/debug/index", "/debug/requests"
+        )
+        collector.scrape_once(now_mono=101.0)
+        collector.scrape_once(now_mono=102.0)
+        health = collector.endpoint_health()
+        assert health[0]["up"], "index churn must not mark the scrape down"
+        assert collector.fetch_capacity() == []
+        # The OTHER planes still fetch — one dropped capability degrades
+        # exactly itself.
+        assert len(collector.fetch_requests()) == 1
+
+    def test_index_refresh_failure_keeps_last_good_index(self):
+        collector, state = self.collector(
+            self.index_with("/metrics", "/debug/index", "/debug/capacity")
+        )
+        collector.scrape_once(now_mono=100.0)
+        assert len(collector.fetch_capacity()) == 1
+        # The index endpoint itself blips during the refresh: the last
+        # good capability set must survive (not be wiped to "serves
+        # everything" OR "serves nothing").
+        state["index_fails"] = True
+        collector.scrape_once(now_mono=101.0)
+        collector.scrape_once(now_mono=102.0)
+        health = collector.endpoint_health()
+        assert health[0]["up"]
+        assert len(collector.fetch_capacity()) == 1
+
+    def test_evidence_fetch_survives_capability_churn(self):
+        collector, state = self.collector(
+            self.index_with(
+                "/metrics", "/debug/index", "/debug/capacity",
+            )
+        )
+        collector.scrape_once(now_mono=100.0)
+        state["index"] = self.index_with("/metrics", "/debug/index")
+        collector.scrape_once(now_mono=101.0)
+        collector.scrape_once(now_mono=102.0)
+        # The incident engine's evidence fetch over the degraded
+        # endpoint: empty planes, no exception, no member loss.
+        eng = collector.incidents
+        eng.observe(
+            [
+                firing_event(
+                    "StrandedCapacity", "2 chips: default/g0 (2 chips)"
+                )
+            ],
+            collector, now_mono=103.0,
+        )
+        (doc,) = eng.query()
+        assert doc["evidence"].get("capacity", []) == []
+        assert {m["rule"] for m in doc["members"]} == {"StrandedCapacity"}
+
+
+class TestCausalGraph:
+    def test_depths_put_roots_upstream(self):
+        depths = obsincidents.causal_depths(obsincidents.CAUSAL_EDGES)
+        assert depths["ScrapeDown"] == 0
+        assert depths["ClaimEvictionSpike"] > depths["ScrapeDown"]
+        assert depths["StrandedCapacity"] > depths["ClaimEvictionSpike"]
+        assert depths["SLOClassBurn"] > depths["StrandedCapacity"]
+
+    def test_cycle_terminates(self):
+        depths = obsincidents.causal_depths({"A": ("B",), "B": ("A",)})
+        assert set(depths) == {"A", "B"}
+
+    def test_family_collapses_class_instances(self):
+        assert obsincidents.family("SLOClassBurn-class3") == "SLOClassBurn"
+        assert obsincidents.family("ScrapeDown") == "ScrapeDown"
+
+    def test_member_labels_parsers(self):
+        assert obsincidents.member_labels(
+            "ScrapeDown", "2/4 endpoint(s) down: a, b"
+        ) == {"endpoint": ["a", "b"]}
+        assert obsincidents.member_labels(
+            "StrandedCapacity",
+            "4 allocated chip(s) with no device steps for > 2s: "
+            "default/g0 (4 chips), default/g1 (2 chips)",
+        ) == {"claim": ["default/g0", "default/g1"]}
+        assert obsincidents.member_labels(
+            "NodeFragmentation",
+            "fragmented free capacity: node-1 (4 free, largest block 1)",
+        ) == {"node": ["node-1"]}
+        assert obsincidents.member_labels(
+            "SLOClassBurn-class2", "class 2: ttft"
+        ) == {"class": ["2"]}
+        assert obsincidents.member_labels("FleetQueueGrowth", "grew") == {}
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -136,6 +136,9 @@ class Config:
         "tpu_dra/obs/collector.py",
         "tpu_dra/obs/alerts.py",
         "tpu_dra/obs/cluster.py",
+        # Incident ages, correlation windows, and resolve holds are all
+        # monotonic durations; wall clock appears only as display stamps.
+        "tpu_dra/obs/incidents.py",
         "tpu_dra/obs/kv.py",
         # Request waterfalls are derived from the engines' monotonic
         # timelines: a wall-clock read here would skew every phase bar.

@@ -375,6 +375,47 @@ def parse_args(argv: "list[str] | None" = None) -> argparse.Namespace:
         "--limit", type=int, default=256,
         help="max alert transition events to fetch",
     )
+
+    incidents = sub.add_parser(
+        "incidents",
+        help="fused incidents (root cause + lifecycle) from "
+        "/debug/incidents",
+    )
+    _add_endpoint_args(incidents, env="TPUDRA_OBS", what="obs collector")
+    incidents.add_argument(
+        "--node", default="",
+        help="only incidents naming this node (or endpoint)",
+    )
+    incidents.add_argument(
+        "--rule", default="",
+        help="only incidents with this member rule",
+    )
+    incidents.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output form (text: the incident listing; json: raw)",
+    )
+    incidents.add_argument(
+        "--limit", type=int, default=64,
+        help="max incidents (and lifecycle events) to fetch",
+    )
+
+    incident = sub.add_parser(
+        "incident",
+        help="one incident in full: member rules, merged timeline, "
+        "attached evidence",
+    )
+    incident.add_argument(
+        "id", help="incident id (from `tpudra incidents`, e.g. inc-0001)"
+    )
+    _add_endpoint_args(incident, env="TPUDRA_OBS", what="obs collector")
+    incident.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output form (text: the root-caused timeline; json: raw)",
+    )
+    incident.add_argument(
+        "--limit", type=int, default=64,
+        help="max lifecycle events to fetch",
+    )
     return parser.parse_args(argv)
 
 
@@ -786,6 +827,42 @@ def alerts_cmd(args: argparse.Namespace, out=None) -> int:
     return 0
 
 
+def _fetch_incidents(args: argparse.Namespace) -> dict:
+    return fetch_debug(
+        args.endpoint, args.pprof_path, "incidents",
+        {
+            "id": getattr(args, "id", ""),
+            "node": getattr(args, "node", ""),
+            "rule": getattr(args, "rule", ""),
+            "limit": args.limit,
+        },
+    )
+
+
+def incidents_cmd(args: argparse.Namespace, out=None) -> int:
+    """Both ``tpudra incidents`` (the listing) and ``tpudra incident
+    <id>`` (the full timeline): the server's incidents_doc carries
+    ``detail`` when an id filter is present, and render_text follows it
+    — so this output is byte-identical to
+    ``/debug/incidents?format=text`` with the same filters."""
+    from tpu_dra.obs import incidents as obsincidents
+
+    out = sys.stdout if out is None else out
+    try:
+        doc = _fetch_incidents(args)
+    except (urllib.error.URLError, OSError) as e:
+        print(
+            f"error: cannot reach collector at {args.endpoint}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    if args.format == "json":
+        print(json.dumps(doc, indent=2), file=out)
+    else:
+        print(obsincidents.render_text(doc), end="", file=out)
+    return 0
+
+
 def main(argv: "list[str] | None" = None) -> int:
     args = parse_args(argv)
     if args.command == "explain":
@@ -806,6 +883,8 @@ def main(argv: "list[str] | None" = None) -> int:
         return top(args)
     if args.command == "alerts":
         return alerts_cmd(args)
+    if args.command in ("incidents", "incident"):
+        return incidents_cmd(args)
     return 2  # unreachable: subparsers are required
 
 

@@ -30,17 +30,21 @@ pane of glass over all of them:
   controller's allocation lifecycle, the engines' device-step
   accounting, and per-node fragmentation evidence, behind ``tpudra
   capacity`` and the ``StrandedCapacity``/``NodeFragmentation`` rules.
+- ``incidents``   — incident correlation: the ``IncidentEngine`` fusing
+  co-occurring alert firings with their decision/capacity/request/KV
+  evidence into one root-caused incident timeline — ``/debug/incidents``
+  behind ``tpudra incidents`` / ``tpudra incident <id>``.
 
 jax-free ON PURPOSE (the ``fleet``/``servestats`` discipline, enforced
 by the A101-A103 gate): the collector is control-plane code that must
 run in any binary — or its own tiny pod — without paying a jax import.
 """
 
-from tpu_dra.obs import alerts, cluster, collector, promparse  # noqa: F401
+from tpu_dra.obs import alerts, cluster, collector, incidents, promparse  # noqa: F401
 
 __all__ = [
-    "alerts", "capacity", "cluster", "collector", "kv", "promparse",
-    "requests",
+    "alerts", "capacity", "cluster", "collector", "incidents", "kv",
+    "promparse", "requests",
 ]
 
 

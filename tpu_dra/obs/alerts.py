@@ -62,6 +62,16 @@ class AlertRule:
     for_s: float = 0.0  # continuous fire time before pending -> firing
     severity: str = "warn"  # warn | page (rendering/priority only)
     description: str = ""
+    # Hysteresis on the way DOWN (the Prometheus keep_firing_for
+    # semantics): a firing rule must stay quiet this long before it
+    # resolves, so a series oscillating around its threshold holds one
+    # firing state instead of flapping firing -> resolved -> firing and
+    # churning incident lifecycles.
+    keep_firing_for: float = 0.0
+    # Anchor into docs/OBSERVABILITY.md — the operator's "what do I do
+    # about it" link, rendered by `tpudra alerts` and on incident
+    # member-rule rows.
+    runbook: str = ""
 
 
 @dataclass
@@ -72,9 +82,11 @@ class AlertStatus:
     severity: str = "warn"
     state: str = OK
     since_mono: float = 0.0  # when the current state was entered
+    quiet_since_mono: float = 0.0  # firing rule's first quiet round (0 = loud)
     value: float = 0.0  # latest expression value
     detail: str = ""
     error: str = ""  # last expression failure, "" when healthy
+    runbook: str = ""  # the rule's docs anchor, for rendering
     transitions: int = 0
 
     def to_dict(self, now_mono: "float | None" = None) -> dict:
@@ -89,6 +101,7 @@ class AlertStatus:
             "value": self.value,
             "detail": self.detail,
             "error": self.error,
+            "runbook": self.runbook,
             "transitions": self.transitions,
         }
 
@@ -212,7 +225,9 @@ class AlertEngine:
         self._eval_seconds = eval_seconds
         self._lock = threading.Lock()
         self._status: "dict[str, AlertStatus]" = {
-            r.name: AlertStatus(rule=r.name, severity=r.severity)
+            r.name: AlertStatus(
+                rule=r.name, severity=r.severity, runbook=r.runbook
+            )
             for r in self.rules
         }
 
@@ -276,6 +291,7 @@ class AlertEngine:
             status.transitions += 1
 
         if fired:
+            status.quiet_since_mono = 0.0  # any loud round restarts the hold
             if status.state in (OK, RESOLVED):
                 enter(PENDING)
             if status.state == PENDING and now - status.since_mono >= rule.for_s:
@@ -284,6 +300,16 @@ class AlertEngine:
             if status.state == PENDING:
                 enter(OK)
             elif status.state == FIRING:
+                # keep_firing_for is for_s's mirror on the way down: the
+                # rule must stay quiet that long before resolving, so a
+                # series oscillating around its threshold holds one
+                # continuous firing state instead of flapping.
+                if rule.keep_firing_for > 0:
+                    if not status.quiet_since_mono:
+                        status.quiet_since_mono = now
+                    if now - status.quiet_since_mono < rule.keep_firing_for:
+                        return out
+                status.quiet_since_mono = 0.0
                 enter(RESOLVED)
             elif status.state == RESOLVED:
                 # Quiet decay back to ok: resolved was the notification.
@@ -315,6 +341,7 @@ def goodput_burn_rate(
     burn_threshold: float = 2.0,
     window_s: float = 60.0,
     for_s: float = 0.0,
+    keep_firing_for: float = 0.0,
 ) -> AlertRule:
     """Serve goodput error-budget burn rate: the fraction of requests
     missing their SLO (``tpu_dra_serve_slo_total{slo="request"}``)
@@ -354,6 +381,8 @@ def goodput_burn_rate(
         severity="page",
         description=f"goodput error budget burning > {burn_threshold}x "
         f"(target {slo_target})",
+        keep_firing_for=keep_firing_for,
+        runbook="docs/OBSERVABILITY.md#servegoodputburnrate",
     )
 
 
@@ -362,6 +391,7 @@ def fleet_queue_growth(
     growth_threshold: float = 4.0,
     window_s: float = 60.0,
     for_s: float = 0.0,
+    keep_firing_for: float = 0.0,
 ) -> AlertRule:
     """Fleet-level overflow queue growing across the window: every
     replica at its admission cap and demand still rising."""
@@ -383,6 +413,8 @@ def fleet_queue_growth(
         severity="warn",
         description=f"fleet overflow queue grew > {growth_threshold} in "
         f"the window",
+        keep_firing_for=keep_firing_for,
+        runbook="docs/OBSERVABILITY.md#fleetqueuegrowth",
     )
 
 
@@ -391,6 +423,7 @@ def prefill_backlog_growth(
     growth_threshold: float = 4.0,
     window_s: float = 60.0,
     for_s: float = 0.0,
+    keep_firing_for: float = 0.0,
 ) -> AlertRule:
     """Disaggregated prefill backlog growing across the window
     (``tpu_dra_disagg_prefill_queue_depth``, parallel/disagg.py): the
@@ -417,6 +450,8 @@ def prefill_backlog_growth(
         severity="warn",
         description=f"disaggregated prefill-tier backlog grew > "
         f"{growth_threshold} in the window",
+        keep_firing_for=keep_firing_for,
+        runbook="docs/OBSERVABILITY.md#prefillbackloggrowth",
     )
 
 
@@ -425,6 +460,7 @@ def eviction_spike(
     rate_threshold: float = 0.1,
     window_s: float = 60.0,
     for_s: float = 0.0,
+    keep_firing_for: float = 0.0,
 ) -> AlertRule:
     """Claim evictions (``tpu_dra_claim_evictions_total`` — the recovery
     sweep draining dead nodes) arriving faster than the background rate:
@@ -447,6 +483,8 @@ def eviction_spike(
         severity="page",
         description=f"claim evictions > {rate_threshold}/s (node failures "
         "being drained)",
+        keep_firing_for=keep_firing_for,
+        runbook="docs/OBSERVABILITY.md#claimevictionspike",
     )
 
 
@@ -455,6 +493,7 @@ def preemption_churn(
     rate_threshold: float = 0.05,
     window_s: float = 60.0,
     for_s: float = 0.0,
+    keep_firing_for: float = 0.0,
 ) -> AlertRule:
     """Wave-planner preemptions (``tpu_dra_claim_preemptions_total`` —
     priority evictions plus defrag migrations) arriving faster than an
@@ -479,11 +518,16 @@ def preemption_churn(
         severity="warn",
         description=f"claim preemptions > {rate_threshold}/s (priority "
         "tier oversubscribed, or defrag thrashing)",
+        keep_firing_for=keep_firing_for,
+        runbook="docs/OBSERVABILITY.md#preemptionchurn",
     )
 
 
 def digest_staleness(
-    *, stale_after_s: float = 300.0, for_s: float = 0.0
+    *,
+    stale_after_s: float = 300.0,
+    for_s: float = 0.0,
+    keep_firing_for: float = 0.0,
 ) -> AlertRule:
     """A fleet replica's prefix digest has not refreshed in too long:
     affinity routing is running on stale promises (spill storm ahead)."""
@@ -504,6 +548,8 @@ def digest_staleness(
         for_s=for_s,
         severity="warn",
         description=f"a replica digest is older than {stale_after_s:.0f}s",
+        keep_firing_for=keep_firing_for,
+        runbook="docs/OBSERVABILITY.md#fleetdigeststale",
     )
 
 
@@ -512,6 +558,7 @@ def kv_pool_pressure(
     free_frac_threshold: float = 0.1,
     window_s: float = 60.0,
     for_s: float = 0.0,
+    keep_firing_for: float = 0.0,
 ) -> AlertRule:
     """Paged KV pool starving: the free-block fraction
     (``tpu_dra_serve_kv_blocks{state}``) is below threshold while
@@ -552,6 +599,8 @@ def kv_pool_pressure(
         severity="warn",
         description=f"paged KV free blocks < {free_frac_threshold:.0%} "
         "of pool while zero-copy alias rate falls (eviction storm)",
+        keep_firing_for=keep_firing_for,
+        runbook="docs/OBSERVABILITY.md#kvpoolpressure",
     )
 
 
@@ -561,6 +610,7 @@ def kv_swap_thrash(
     free_frac_threshold: float = 0.25,
     window_s: float = 60.0,
     for_s: float = 0.0,
+    keep_firing_for: float = 0.0,
 ) -> AlertRule:
     """KV memory hierarchy thrashing: a sustained swap-IN rate
     (``tpu_dra_serve_kv_swaps_total{direction="in"}``) while the device
@@ -599,6 +649,8 @@ def kv_swap_thrash(
         description=f"host-tier swap-in rate >= {swap_in_per_s:g} "
         f"blocks/s while free blocks < {free_frac_threshold:.0%} of "
         "pool (requests cycling through the swap tier)",
+        keep_firing_for=keep_firing_for,
+        runbook="docs/OBSERVABILITY.md#kvswapthrash",
     )
 
 
@@ -634,6 +686,7 @@ def slo_class_burn(
     min_requests: int = 1,
     window_requests: int = 64,
     for_s: float = 0.0,
+    keep_firing_for: float = 0.0,
 ) -> AlertRule:
     """Per-priority-class SLO burn: the class's observed TTFT/TPOT p95
     over the most recent ``window_requests`` finished requests (the
@@ -709,10 +762,15 @@ def slo_class_burn(
         severity="page",
         description=f"priority class {slo.cls} out of SLO ({objectives}) "
         f"over its last {window_requests} finished requests",
+        keep_firing_for=keep_firing_for,
+        # Per-class instances share one runbook: the remedy is the same.
+        runbook="docs/OBSERVABILITY.md#sloclassburn",
     )
 
 
-def scrape_down(*, for_s: float = 0.0) -> AlertRule:
+def scrape_down(
+    *, for_s: float = 0.0, keep_firing_for: float = 0.0
+) -> AlertRule:
     """One or more scrape targets unreachable — the observability plane's
     own liveness.  Fires from scrape health, not from scraped data, so
     it works when a process dies taking its exposition with it."""
@@ -737,11 +795,16 @@ def scrape_down(*, for_s: float = 0.0) -> AlertRule:
         for_s=for_s,
         severity="page",
         description="a configured scrape endpoint is unreachable",
+        keep_firing_for=keep_firing_for,
+        runbook="docs/OBSERVABILITY.md#scrapedown",
     )
 
 
 def obs_cardinality_breach(
-    *, window_s: float = 60.0, for_s: float = 0.0
+    *,
+    window_s: float = 60.0,
+    for_s: float = 0.0,
+    keep_firing_for: float = 0.0,
 ) -> AlertRule:
     """A scrape target is minting series faster than its budget: the
     collector refused new series this window
@@ -791,6 +854,8 @@ def obs_cardinality_breach(
         description="an endpoint exhausted its series budget; its new "
         "series are being dropped at ingest (existing series still "
         "update)",
+        keep_firing_for=keep_firing_for,
+        runbook="docs/OBSERVABILITY.md#obscardinalitybreach",
     )
 
 
@@ -799,6 +864,7 @@ def stranded_capacity(
     stranded_after_s: float = 5.0,
     min_chips: int = 1,
     for_s: float = 0.0,
+    keep_firing_for: float = 0.0,
 ) -> AlertRule:
     """Chips allocated to claims whose consumers produce no device
     steps: the capacity ledger's ``chips_stranded`` total across every
@@ -842,11 +908,16 @@ def stranded_capacity(
         description="allocated chips whose consumers produce no device "
         f"steps for > {stranded_after_s:g}s (claims held open over dead "
         "or idle consumers)",
+        keep_firing_for=keep_firing_for,
+        runbook="docs/OBSERVABILITY.md#strandedcapacity",
     )
 
 
 def node_fragmentation(
-    *, min_gang_chips: int = 2, for_s: float = 0.0
+    *,
+    min_gang_chips: int = 2,
+    for_s: float = 0.0,
+    keep_firing_for: float = 0.0,
 ) -> AlertRule:
     """Free chips plentiful but unschedulable: a node's largest
     contiguous free subslice fell below the smallest schedulable gang
@@ -891,26 +962,30 @@ def node_fragmentation(
         description="a node's free chips cannot place the smallest "
         f"schedulable gang ({min_gang_chips} chips) despite free "
         "capacity — defragmentation candidate",
+        keep_firing_for=keep_firing_for,
+        runbook="docs/OBSERVABILITY.md#nodefragmentation",
     )
 
 
 def default_rules(
-    *, window_s: float = 60.0, for_s: float = 0.0
+    *, window_s: float = 60.0, for_s: float = 0.0, keep_firing_for: float = 0.0
 ) -> "list[AlertRule]":
     """The stock rule set over the telemetry the repo already emits.
-    ``window_s``/``for_s`` scale the whole set together — CI smokes run
-    them at sim timescales (sub-second), deployments at minutes."""
+    ``window_s``/``for_s``/``keep_firing_for`` scale the whole set
+    together — CI smokes run them at sim timescales (sub-second),
+    deployments at minutes."""
+    kw = {"for_s": for_s, "keep_firing_for": keep_firing_for}
     return [
-        goodput_burn_rate(window_s=window_s, for_s=for_s),
-        fleet_queue_growth(window_s=window_s, for_s=for_s),
-        prefill_backlog_growth(window_s=window_s, for_s=for_s),
-        eviction_spike(window_s=window_s, for_s=for_s),
-        preemption_churn(window_s=window_s, for_s=for_s),
-        digest_staleness(stale_after_s=max(window_s * 5, 1.0), for_s=for_s),
-        kv_pool_pressure(window_s=window_s, for_s=for_s),
-        kv_swap_thrash(window_s=window_s, for_s=for_s),
-        scrape_down(for_s=for_s),
-        obs_cardinality_breach(window_s=window_s, for_s=for_s),
-        stranded_capacity(for_s=for_s),
-        node_fragmentation(for_s=for_s),
+        goodput_burn_rate(window_s=window_s, **kw),
+        fleet_queue_growth(window_s=window_s, **kw),
+        prefill_backlog_growth(window_s=window_s, **kw),
+        eviction_spike(window_s=window_s, **kw),
+        preemption_churn(window_s=window_s, **kw),
+        digest_staleness(stale_after_s=max(window_s * 5, 1.0), **kw),
+        kv_pool_pressure(window_s=window_s, **kw),
+        kv_swap_thrash(window_s=window_s, **kw),
+        scrape_down(**kw),
+        obs_cardinality_breach(window_s=window_s, **kw),
+        stranded_capacity(**kw),
+        node_fragmentation(**kw),
     ]

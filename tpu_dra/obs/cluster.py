@@ -259,6 +259,22 @@ def cluster_doc(
         alerts = [a for a in alerts if a["rule"] == rule]
     recorder = collector.engine.recorder
     events = recorder.query(rule=rule or None, limit=limit)
+    # Open/mitigated incidents lead the pane: the fused root cause is
+    # the line an operator reads before any per-endpoint row.
+    incident_engine = getattr(collector, "incidents", None)
+    incidents = (
+        incident_engine.query(limit=limit) if incident_engine else []
+    )
+    active_incidents = [
+        {
+            "id": i["id"],
+            "state": i["state"],
+            "root_cause": i["root_cause"],
+            "members": len(i["members"]),
+        }
+        for i in incidents
+        if i["state"] in ("open", "mitigated")
+    ]
     return {
         "collector": collector.name,
         "rounds": collector.rounds,
@@ -271,6 +287,8 @@ def cluster_doc(
         "classes": class_rows(collector),
         "alerts": alerts,
         "firing": [a["rule"] for a in alerts if a["state"] == "firing"],
+        "incidents": active_incidents,
+        "incidents_open": len(active_incidents),
         "alert_events": [e.to_dict() for e in events],
         "recorded": recorder.recorded,
         "dropped": recorder.dropped,
@@ -341,6 +359,18 @@ def render_text(doc: dict, *, top: "int | None" = None) -> str:
         f", FIRING: {', '.join(firing)}" if firing else ", no alerts firing"
     )
     out = [head]
+    # The incident banner outranks every endpoint row: the fused root
+    # cause IS the answer the operator opened the pane for.
+    incidents = doc.get("incidents", [])
+    if incidents:
+        out.append(
+            f"{len(incidents)} INCIDENT{'S' if len(incidents) > 1 else ''}: "
+            + "; ".join(
+                f"{i['id']} [{i['state']}] {i['root_cause'] or '-'}"
+                for i in incidents
+            )
+            + "  (tpudra incident <id> for the timeline)"
+        )
     rows = doc["endpoints"]
     truncated_to_worst = top is not None and len(rows) > top
     if truncated_to_worst:
@@ -436,11 +466,16 @@ def render_alerts_text(doc: dict) -> str:
         f"{'value':>10} detail"
     )
     for a in doc["alerts"]:
-        out.append(
+        line = (
             f"{a['rule']:<26} {a['state']:<9} {a['severity']:<5} "
             f"{a['for_s']:>8.1f} {a['value']:>10.3f} "
             f"{a['detail'] or a['error']}"
         )
+        # The runbook anchor rides each rule row: state -> remedy in one
+        # read (.get — older documents predate the field).
+        if a.get("runbook"):
+            line += f"  [{a['runbook']}]"
+        out.append(line)
     events = doc.get("alert_events", [])
     if events:
         out.append("transitions:")

@@ -48,6 +48,7 @@ import zlib
 
 from tpu_dra.obs import promparse
 from tpu_dra.obs.alerts import AlertEngine, default_rules
+from tpu_dra.obs.incidents import IncidentEngine
 from tpu_dra.utils.metrics import Registry
 
 logger = logging.getLogger(__name__)
@@ -105,6 +106,7 @@ class EndpointState:
         self.last_text = ""  # last GOOD exposition (post-mortem food)
         self.samples: "list[promparse.Sample]" = []
         self.index: "dict | None" = None  # /debug/index capability doc
+        self.index_round = -1  # round the index was last (re)fetched
         # Scheduler state: a deterministic phase in [0, 1) spreads this
         # endpoint across the scrape interval (no thundering round);
         # degraded endpoints run at a longer effective interval.
@@ -367,6 +369,10 @@ class ObsCollector:
         rules: "list | None" = None,
         registry: "Registry | None" = None,
         recorder=None,  # alerts.AlertFlightRecorder, defaults to the global
+        incident_recorder=None,  # incidents.IncidentFlightRecorder
+        correlation_window_s: float = 120.0,
+        resolve_hold_s: float = 30.0,
+        index_refresh_rounds: int = 16,
         snapshot_dir: "str | None" = None,
         snapshot_max_exposition_bytes: int = 256 * 1024,
         snapshot_max_total_bytes: int = 16 * 1024 * 1024,
@@ -409,6 +415,11 @@ class ObsCollector:
         # process cannot grow the collector without bound.
         self.series_budget_per_endpoint = series_budget_per_endpoint
         self.series_budget_total = series_budget_total
+        # Capability churn (rolling restarts): an endpoint's /debug/index
+        # is refreshed every this-many rounds, so a capability dropped or
+        # added mid-stream converges instead of being trusted forever
+        # from the first scrape.
+        self.index_refresh_rounds = max(1, index_refresh_rounds)
         self._lock = threading.Lock()
         self._states: "dict[str, EndpointState]" = {}
         # series name -> {(endpoint name, label pairs): SeriesRing} —
@@ -427,6 +438,9 @@ class ObsCollector:
         # fragmentation rules plus the cluster rollup share one ledger
         # fetch per distinct query per round.
         self._capacity_memo: "tuple[int, dict]" = (-1, {})
+        # fetch_kv / fetch_decisions memos, same shape: the incident
+        # engine's evidence fan-in shares one fetch per query per round.
+        self._decisions_memo: "tuple[int, dict]" = (-1, {})
         self._now_override: "float | None" = None  # scrape_once(now_mono=)
         self._rounds = 0
         self._snapshots = 0
@@ -499,6 +513,27 @@ class ObsCollector:
             recorder=recorder,
             alerts_total=alerts_total,
             eval_seconds=rule_eval_seconds,
+        )
+        # The incident engine sits on the alert engine's transition
+        # stream (_finish_round feeds it every round's events) and fuses
+        # co-occurring firings + their evidence into root-caused
+        # incidents — the /debug/incidents surface.
+        incidents_total = self.registry.counter(
+            "tpu_dra_obs_incidents_total",
+            "Incident lifecycle transitions by entered state (opened, "
+            "reopened, mitigated, resolved)",
+        )
+        incident_open = self.registry.gauge(
+            "tpu_dra_obs_incident_open",
+            "Incidents currently open or mitigated (awaiting the resolve "
+            "hold)",
+        )
+        self.incidents = IncidentEngine(
+            correlation_window_s=correlation_window_s,
+            resolve_hold_s=resolve_hold_s,
+            recorder=incident_recorder,
+            incidents_total=incidents_total,
+            incident_open=incident_open,
         )
         for ep in endpoints:
             self.add_endpoint(ep)
@@ -575,21 +610,34 @@ class ObsCollector:
         raises — failure marks the endpoint down and keeps stale data."""
         with self._lock:
             state = self._states.get(name)
+            rounds = self._rounds
         if state is None:
             return False
         ep = state.endpoint
         now = time.monotonic() if now_mono is None else now_mono
         t0 = time.perf_counter()
         text, index, error = "", None, ""
+        # Re-read /debug/index periodically, not just once: a rolling
+        # restart can drop (or add) a capability mid-stream, and serves()
+        # must converge on the new truth instead of trusting the first
+        # scrape forever.
+        index_due = (
+            state.index is None
+            or rounds - state.index_round >= self.index_refresh_rounds
+        )
         try:
             text = self._get(ep.url + ep.metrics_path)
-            if state.index is None:
+            if index_due:
                 try:
                     index = json.loads(
                         self._get(f"{ep.url}{ep.pprof_path}/index")
                     )
                 except Exception:
-                    index = {}  # pre-index build: capabilities unknown
+                    # First fetch failing = pre-index build, capabilities
+                    # unknown (optimistic {}); a REFRESH failing keeps
+                    # the last good index — a transient index error must
+                    # not wipe known capabilities.
+                    index = {} if state.index is None else None
         except Exception as e:
             error = f"{type(e).__name__}: {e}"
         duration = time.perf_counter() - t0
@@ -627,6 +675,7 @@ class ObsCollector:
                 state.samples = samples
                 if index is not None:
                     state.index = index
+                    state.index_round = self._rounds
                 dropped = 0
                 for s in samples:
                     bucket = self._rings.setdefault(s.name, {})
@@ -834,15 +883,27 @@ class ObsCollector:
             ring, _ = self._self_ring("tpu_dra_obs_scrape_round_seconds", ())
             ring.add(now, wall)
         events = self.engine.evaluate(self, now_mono=now_mono)
-        if self.snapshot_dir and any(e.state == "firing" for e in events):
-            try:
-                self.dump_snapshot(
-                    reason="+".join(
-                        e.rule for e in events if e.state == "firing"
+        # Fold the round's alert transitions into the incident set (the
+        # engine fetches its evidence through our memoized fan-ins).
+        rule_defs = {r.name: r for r in self.engine.rules}
+        incident_events = self.incidents.observe(
+            events, self, now_mono=now_mono, rules=rule_defs
+        )
+        # ONE post-mortem snapshot per incident OPEN, tagged with the
+        # incident id — not one per firing rule: a cascade's second and
+        # third alerts attach to the open incident, whose snapshot
+        # already captured the event.
+        if self.snapshot_dir:
+            for iev in incident_events:
+                if iev.state != "opened":
+                    continue
+                try:
+                    path = self.dump_snapshot(
+                        reason=f"incident:{iev.incident}"
                     )
-                )
-            except Exception:
-                logger.exception("post-mortem snapshot failed")
+                    self.incidents.set_snapshot(iev.incident, path)
+                except Exception:
+                    logger.exception("post-mortem snapshot failed")
         return events
 
     def _self_ring(
@@ -1195,6 +1256,65 @@ class ObsCollector:
                 self._capacity_memo = (self._rounds, {})
             if self._capacity_memo[0] == rounds:
                 self._capacity_memo[1][key] = out
+        return out
+
+    # -- cross-process decision evidence ---------------------------------------
+
+    def fetch_decisions(
+        self,
+        claim: "str | None" = None,
+        node: "str | None" = None,
+        pod: "str | None" = None,
+        limit: int = 256,
+    ) -> "list[dict]":
+        """``/debug/decisions`` flight-recorder documents from every
+        endpoint whose ``/debug/index`` advertises the path (capability
+        discovery — an engine-only process never ran the controller).
+        Each document gains an ``endpoint`` field; fetch failures skip
+        the endpoint, best-effort like the trace join.  This is the
+        incident engine's eviction/preemption evidence plane.
+
+        Results are memoized PER SCRAPE ROUND (keyed on the query) like
+        ``fetch_capacity``: one round's incident refreshes share fetches
+        instead of re-GETting identical recorder documents."""
+        key = (claim, node, pod, limit)
+        with self._lock:
+            rounds = self._rounds
+            memo_round, memo = self._decisions_memo
+            if memo_round == rounds and key in memo:
+                return memo[key]
+            states = list(self._states.values())
+        out: "list[dict]" = []
+        for state in states:
+            ep = state.endpoint
+            if not state.serves(f"{ep.pprof_path}/decisions"):
+                continue
+            query: dict = {"format": "json", "limit": limit}
+            if claim:
+                query["claim"] = claim
+            if node:
+                query["node"] = node
+            if pod:
+                query["pod"] = pod
+            url = (
+                f"{ep.url}{ep.pprof_path}/decisions?"
+                + urllib.parse.urlencode(query)
+            )
+            try:
+                doc = json.loads(self._get(url))
+            except Exception as e:
+                logger.debug("decisions fetch from %s failed: %s", ep.url, e)
+                continue
+            doc["endpoint"] = ep.name
+            out.append(doc)
+        with self._lock:
+            # The I/O ran outside the lock; re-key against the CURRENT
+            # round so a result that straddled a round boundary never
+            # poisons the new round's memo.
+            if self._decisions_memo[0] != self._rounds:
+                self._decisions_memo = (self._rounds, {})
+            if self._decisions_memo[0] == rounds:
+                self._decisions_memo[1][key] = out
         return out
 
     def assemble_trace_tree(self, trace_id: "str | None" = None) -> str:

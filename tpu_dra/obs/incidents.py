@@ -1,0 +1,1043 @@
+"""Incident correlation — fuse alerts, decisions, capacity, and traces
+into one root-caused timeline.
+
+After the last four observability PRs a seeded node kill produces four
+independent firing alerts (ScrapeDown, ClaimEvictionSpike,
+StrandedCapacity, SLOClassBurn) across four debug endpoints, and the
+operator joins them by hand.  This module is the join: an
+``IncidentEngine`` sits on the ``AlertEngine``'s transition stream and
+FUSES co-occurring evidence into one **Incident** — the on-call surface
+production fleets actually page on, instead of alert confetti.
+
+**Correlation.**  A rule entering ``firing`` within the correlation
+window of an open incident attaches as a member instead of minting a
+sibling when the two are plausibly one event: they share an entity
+label (node / endpoint / claim / class — parsed from the rule detail's
+declared formats), one of them is fleet-scoped (a fleet-wide symptom
+can be caused by any node), or the declared causal-edge graph links
+their rule families (``CAUSAL_EDGES`` — e.g. ScrapeDown →
+ClaimEvictionSpike → StrandedCapacity → SLOClassBurn).  Two node-scoped
+alerts on different nodes with no causal edge stay separate incidents.
+
+**Evidence.**  When an incident opens or its membership changes, the
+engine pulls the matching records through the collector's per-round
+-memoized fetch fan-ins: eviction/preemption ``DecisionRecords``
+(``fetch_decisions``), the capacity ledger's stranded-claim rows
+(``fetch_capacity``), the worst-K request waterfalls in a violating
+class (``fetch_requests`` — trace exemplars, each carrying its
+``trace_id``), and the KV/swap counters for the named engines
+(``fetch_kv``).  Every evidence item carries its endpoint attribution
+and a display stamp, and the whole set renders as ONE merged,
+causally-ordered timeline.  Evidence also ENRICHES the incident's
+labels — the eviction records name the dead node even when the firing
+rule's own detail does not — which is how the verdict gets a node name
+out of a scrape-down on an anonymous endpoint.
+
+**Root cause.**  Candidate causes rank by causal-graph depth (the
+upstream-most firing family wins), then earliest onset, then blast
+radius (count of downstream members); the verdict is one line —
+``node-3 NotReady → 2 eviction(s) → 4 stranded chip(s) → class-0 SLO
+burn`` — built from the ranked members and their evidence.
+
+**Lifecycle.**  ``open`` → ``mitigated`` (every member rule resolved)
+→ ``resolved`` (mitigated held quiet for ``resolve_hold_s``); a member
+re-firing during the hold REOPENS the same incident instead of minting
+a new one.  Transitions land in the ring-buffered
+``IncidentFlightRecorder`` (the ``controller/decisions.py`` shape) and
+move ``tpu_dra_obs_incidents_total{state}`` /
+``tpu_dra_obs_incident_open`` on the collector's registry.
+``MetricsServer`` serves ``incidents_doc`` at ``/debug/incidents``
+(json/text, ``id=``/``node=``/``rule=`` filters, 400 on bad queries)
+and ``render_text`` draws the same document for ``tpudra incidents`` /
+``tpudra incident <id>``, byte-identical to the server's text form.
+
+jax-free ON PURPOSE (the obs-layer discipline, enforced by the
+A101-A103 gate): the engine never imports the collector, the controller
+or an engine — alert events and the fetch view are pushed in.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+
+# Incident lifecycle states.
+OPEN = "open"
+MITIGATED = "mitigated"
+RESOLVED = "resolved"
+
+# Recorder/metric event vocabulary (the `state` label values of
+# tpu_dra_obs_incidents_total, plus the ring-only `member` attach).
+OPENED = "opened"
+REOPENED = "reopened"
+MEMBER = "member"  # ring event only — an attach is not a state change
+
+DEFAULT_CAPACITY = 4096
+# Resolved incidents kept for the document's history half.
+CLOSED_KEPT = 256
+
+# The declared causal-edge graph over rule FAMILIES (SLOClassBurn-class0
+# and SLOClassBurn-class1 are one family): upstream -> downstream.  The
+# edges encode which failure plausibly produces which symptom — a dead
+# node takes its scrape endpoint down, strands its claims, and the
+# recovery sweep's evictions follow; a starved KV pool thrashes the swap
+# tier before the class SLOs burn.  Root-cause ranking prefers the
+# upstream-most firing family, and correlation treats a direct edge
+# (either direction) as overlap even when no entity label is shared.
+CAUSAL_EDGES: "dict[str, tuple[str, ...]]" = {
+    "ScrapeDown": (
+        "ClaimEvictionSpike", "StrandedCapacity", "FleetDigestStale",
+    ),
+    "ClaimEvictionSpike": (
+        "StrandedCapacity", "PreemptionChurn", "FleetQueueGrowth",
+    ),
+    "StrandedCapacity": (
+        "SLOClassBurn", "NodeFragmentation", "ServeGoodputBurnRate",
+    ),
+    "PreemptionChurn": ("SLOClassBurn", "ServeGoodputBurnRate"),
+    "NodeFragmentation": ("FleetQueueGrowth",),
+    "FleetDigestStale": ("ServeGoodputBurnRate",),
+    "KVPoolPressure": ("KVSwapThrash",),
+    "KVSwapThrash": ("SLOClassBurn", "ServeGoodputBurnRate"),
+    "FleetQueueGrowth": ("SLOClassBurn", "ServeGoodputBurnRate"),
+    "PrefillBacklogGrowth": ("SLOClassBurn", "ServeGoodputBurnRate"),
+}
+
+# Families the graph does not know rank downstream of everything it
+# does: an undeclared custom rule can join an incident but never
+# outranks a declared cause for the verdict.
+UNKNOWN_DEPTH = 99
+
+
+def family(rule_name: str) -> str:
+    """The rule's causal family: per-class instances collapse
+    (``SLOClassBurn-class0`` -> ``SLOClassBurn``)."""
+    return rule_name.partition("-class")[0]
+
+
+def causal_depths(edges: "dict[str, tuple[str, ...]]") -> "dict[str, int]":
+    """Longest-path depth per family from the graph's roots (families
+    nothing points at).  Plain relaxation, bounded by the family count,
+    so an accidental cycle in a user-supplied graph terminates instead
+    of recursing forever."""
+    fams = set(edges)
+    for downs in edges.values():
+        fams.update(downs)
+    depth = {f: 0 for f in fams}
+    for _ in range(len(fams)):
+        changed = False
+        for up, downs in edges.items():
+            for down in downs:
+                if depth[down] < depth[up] + 1:
+                    depth[down] = depth[up] + 1
+                    changed = True
+        if not changed:
+            break
+    return depth
+
+
+# Entity-label parsers over the stock rules' declared detail formats
+# (this module owns both sides of the contract — the formats are pinned
+# by the alert tests).  A family with no parser is fleet-scoped.
+_SCRAPE_DOWN_RE = re.compile(r" down: (.+)$")
+_CLAIM_RE = re.compile(r"(\S+) \(\d+ chips?\)")
+_FRAG_NODE_RE = re.compile(r"(\S+) \(\d+ free")
+_CLASS_RE = re.compile(r"-class(\d+)$")
+
+
+def member_labels(rule_name: str, detail: str) -> "dict[str, list[str]]":
+    """The entity labels one firing rule names, parsed from its detail:
+    ``{"endpoint": [...]}`` / ``{"claim": [...]}`` / ``{"node": [...]}``
+    / ``{"class": [...]}``; empty = fleet-scoped."""
+    fam = family(rule_name)
+    if fam == "ScrapeDown":
+        m = _SCRAPE_DOWN_RE.search(detail)
+        if m:
+            return {"endpoint": [e.strip() for e in m.group(1).split(",")]}
+        return {}
+    if fam == "StrandedCapacity":
+        claims = _CLAIM_RE.findall(detail)
+        return {"claim": claims} if claims else {}
+    if fam == "NodeFragmentation":
+        nodes = _FRAG_NODE_RE.findall(detail)
+        return {"node": nodes} if nodes else {}
+    if fam == "SLOClassBurn":
+        m = _CLASS_RE.search(rule_name)
+        return {"class": [m.group(1)]} if m else {}
+    return {}
+
+
+@dataclass
+class IncidentEvent:
+    """One incident lifecycle transition (the flight-recorder record)."""
+
+    seq: int = 0
+    ts_unix: float = 0.0
+    incident: str = ""
+    state: str = OPENED  # opened | member | reopened | mitigated | resolved
+    rule: str = ""  # the alert rule that drove the transition, if one
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "ts_unix": self.ts_unix,
+            "incident": self.incident,
+            "state": self.state,
+            "rule": self.rule,
+            "detail": self.detail,
+        }
+
+
+class IncidentFlightRecorder:
+    """Bounded, lock-protected ring of IncidentEvents (the controller
+    FlightRecorder contract: eviction at capacity moves ``dropped`` and
+    the shared ``tpu_dra_ring_dropped_total{ring="obs_incidents"}``)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._records: "collections.deque[IncidentEvent]" = collections.deque(
+            maxlen=capacity
+        )
+        self._seq = 0
+        self._dropped = 0
+
+    def record(self, rec: IncidentEvent) -> IncidentEvent:
+        if not rec.ts_unix:
+            # Epoch anchor for display/joins; incident ages are monotonic.
+            rec.ts_unix = time.time()  # noqa: A201 — display stamp, not a duration
+        dropped = False
+        with self._lock:
+            self._seq += 1
+            rec.seq = self._seq
+            if len(self._records) == self.capacity:
+                self._dropped += 1  # append below evicts the oldest
+                dropped = True
+            self._records.append(rec)
+        if dropped:
+            from tpu_dra.utils.metrics import RING_DROPPED
+
+            RING_DROPPED.inc(ring="obs_incidents")
+        return rec
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    @property
+    def recorded(self) -> int:
+        """Total events ever recorded (monotonic, survives eviction)."""
+        with self._lock:
+            return self._seq
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._dropped = 0
+
+    def query(
+        self,
+        incident: "str | None" = None,
+        state: "str | None" = None,
+        limit: "int | None" = None,
+    ) -> "list[IncidentEvent]":
+        """Oldest-first snapshot, filtered; ``limit`` keeps the most
+        recent N after filtering."""
+        with self._lock:
+            out = list(self._records)
+        if incident:
+            out = [r for r in out if r.incident == incident]
+        if state:
+            out = [r for r in out if r.state == state]
+        if limit is not None and limit < len(out):
+            out = out[len(out) - limit:]
+        return out
+
+
+# The process-wide recorder, shared like decisions.RECORDER: incident
+# engines write it, /debug/index advertises its counts.
+RECORDER = IncidentFlightRecorder()
+
+
+@dataclass
+class IncidentMember:
+    """One alert rule's membership in an incident."""
+
+    rule: str
+    severity: str = "warn"
+    runbook: str = ""
+    state: str = "firing"  # the member's latest alert state
+    onset_unix: float = 0.0  # first firing (display stamp)
+    onset_mono: float = 0.0  # first firing (ordering/age clock)
+    value: float = 0.0
+    detail: str = ""
+    labels: "dict[str, list[str]]" = field(default_factory=dict)
+    depth: int = UNKNOWN_DEPTH
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "runbook": self.runbook,
+            "state": self.state,
+            "onset_unix": self.onset_unix,
+            "value": self.value,
+            "detail": self.detail,
+            "labels": {k: list(v) for k, v in self.labels.items()},
+            "depth": self.depth,
+        }
+
+
+@dataclass
+class Incident:
+    """One fused incident: members, merged labels, attached evidence,
+    the causally-ordered timeline, and the ranked verdict."""
+
+    id: str
+    state: str = OPEN
+    opened_unix: float = 0.0
+    opened_mono: float = 0.0
+    mitigated_mono: float = 0.0  # entering mitigated (0 = never)
+    resolved_mono: float = 0.0
+    last_attach_mono: float = 0.0  # correlation-window anchor
+    members: "dict[str, IncidentMember]" = field(default_factory=dict)
+    labels: "dict[str, list[str]]" = field(default_factory=dict)
+    root_rule: str = ""
+    root_cause: str = ""
+    timeline: "list[dict]" = field(default_factory=list)
+    evidence: "dict[str, list[dict]]" = field(default_factory=dict)
+    snapshot: str = ""  # post-mortem snapshot dir tagged with this id
+    # Stable display stamps for re-fetched evidence rows: an item keeps
+    # the stamp of its FIRST observation across refreshes, so rebuilt
+    # timelines stay ordered instead of re-stamping everything "now".
+    first_seen: "dict[tuple, float]" = field(default_factory=dict)
+
+    def merge_labels(self, labels: "dict[str, list[str]]") -> None:
+        for dim, values in labels.items():
+            have = self.labels.setdefault(dim, [])
+            for v in values:
+                if v not in have:
+                    have.append(v)
+
+    def to_dict(self, now_mono: "float | None" = None) -> dict:
+        now = time.monotonic() if now_mono is None else now_mono
+        age_anchor = (
+            self.resolved_mono if self.state == RESOLVED else now
+        )
+        return {
+            "id": self.id,
+            "state": self.state,
+            "opened_unix": self.opened_unix,
+            "age_s": round(max(0.0, age_anchor - self.opened_mono), 3),
+            "root_rule": self.root_rule,
+            "root_cause": self.root_cause,
+            "members": [
+                m.to_dict()
+                for m in sorted(
+                    self.members.values(),
+                    key=lambda m: (m.depth, m.onset_mono, m.rule),
+                )
+            ],
+            "labels": {k: list(v) for k, v in self.labels.items()},
+            "timeline": [dict(t) for t in self.timeline],
+            "evidence": {
+                plane: [dict(r) for r in rows]
+                for plane, rows in self.evidence.items()
+            },
+            "snapshot": self.snapshot,
+        }
+
+
+class IncidentEngine:
+    """Consumes the AlertEngine's transition stream and maintains the
+    open/mitigated/resolved incident set.  Thread-safe: ``observe`` runs
+    on the collector's round thread, the document builders on the debug
+    server's threads."""
+
+    def __init__(
+        self,
+        *,
+        correlation_window_s: float = 120.0,
+        resolve_hold_s: float = 30.0,
+        evidence_limit: int = 64,
+        worst_k_requests: int = 4,
+        recorder: "IncidentFlightRecorder | None" = None,
+        incidents_total=None,  # Counter with {state} label, or None
+        incident_open=None,  # plain Gauge, or None
+        causal_edges: "dict[str, tuple[str, ...]] | None" = None,
+    ):
+        self.correlation_window_s = correlation_window_s
+        self.resolve_hold_s = resolve_hold_s
+        self.evidence_limit = evidence_limit
+        self.worst_k_requests = worst_k_requests
+        self.recorder = recorder if recorder is not None else RECORDER
+        self._incidents_total = incidents_total
+        self._incident_open = incident_open
+        self.causal_edges = (
+            dict(CAUSAL_EDGES) if causal_edges is None else dict(causal_edges)
+        )
+        self._depths = causal_depths(self.causal_edges)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._active: "list[Incident]" = []  # open or mitigated
+        self._closed: "collections.deque[Incident]" = collections.deque(
+            maxlen=CLOSED_KEPT
+        )
+
+    # -- correlation ----------------------------------------------------------
+
+    def _depth(self, fam: str) -> int:
+        return self._depths.get(fam, UNKNOWN_DEPTH)
+
+    def _edge(self, fam_a: str, fam_b: str) -> bool:
+        return fam_b in self.causal_edges.get(fam_a, ()) or fam_a in (
+            self.causal_edges.get(fam_b, ())
+        )
+
+    def _correlates(
+        self,
+        incident: Incident,
+        rule_name: str,
+        labels: "dict[str, list[str]]",
+        now: float,
+    ) -> bool:
+        """Does this firing rule belong to ``incident``?  Inside the
+        correlation window (anchored at the LAST attach, so a cascade
+        that keeps developing keeps fusing), plus label overlap, a
+        fleet scope on either side, or a declared causal edge."""
+        if now - incident.last_attach_mono > self.correlation_window_s:
+            return False
+        fam = family(rule_name)
+        if any(self._edge(fam, family(r)) for r in incident.members):
+            return True
+        if not labels or not incident.labels:
+            return True  # fleet scope: the fleet contains every node
+        for dim, values in labels.items():
+            have = incident.labels.get(dim, ())
+            if any(v in have for v in values):
+                return True
+        return False
+
+    # -- the observe hook (collector round thread) ----------------------------
+
+    def observe(
+        self,
+        events,
+        view,
+        now_mono: "float | None" = None,
+        rules: "dict | None" = None,
+    ) -> "list[IncidentEvent]":
+        """One evaluation round's alert transitions, folded into the
+        incident set.  Evidence fetches run OUTSIDE the engine lock
+        (they do HTTP through the view's per-round-memoized fan-ins);
+        returns the incident transitions produced — the collector keys
+        its one-snapshot-per-incident-open on the ``opened`` events."""
+        now = time.monotonic() if now_mono is None else now_mono
+        rules = rules or {}
+        out: "list[IncidentEvent]" = []
+        refresh: "list[Incident]" = []
+        with self._lock:
+            for ev in events:
+                if ev.state == "firing":
+                    self._on_firing(ev, now, rules, out, refresh)
+                elif ev.state in ("resolved", "ok", "pending"):
+                    self._on_quiet(ev, now)
+            self._advance_lifecycle(now, out)
+            active = list(self._active)
+        for inc in refresh:
+            evidence = self._fetch_evidence(inc, view)
+            with self._lock:
+                self._apply_evidence(inc, evidence, now)
+        with self._lock:
+            for inc in active:
+                if inc not in refresh:
+                    self._rebuild(inc, now)
+        for ev in out:
+            self.recorder.record(ev)
+            if self._incidents_total is not None and ev.state != MEMBER:
+                self._incidents_total.inc(state=ev.state)
+        if self._incident_open is not None:
+            self._incident_open.set(self.open_count())
+        return out
+
+    def _on_firing(self, ev, now, rules, out, refresh) -> None:
+        labels = member_labels(ev.rule, ev.detail)
+        target: "Incident | None" = None
+        for inc in self._active:
+            if self._correlates(inc, ev.rule, labels, now):
+                target = inc
+                break
+        if target is None:
+            self._seq += 1
+            target = Incident(
+                id=f"inc-{self._seq:04d}",
+                opened_unix=ev.ts_unix,
+                opened_mono=now,
+                last_attach_mono=now,
+            )
+            self._active.append(target)
+            out.append(
+                IncidentEvent(
+                    incident=target.id,
+                    state=OPENED,
+                    rule=ev.rule,
+                    detail=ev.detail,
+                )
+            )
+        elif target.state == MITIGATED:
+            # A member re-firing during the resolve hold reopens the
+            # SAME incident — the hysteresis that stops one oscillating
+            # cascade from minting a fresh incident per flap.
+            target.state = OPEN
+            target.mitigated_mono = 0.0
+            out.append(
+                IncidentEvent(
+                    incident=target.id,
+                    state=REOPENED,
+                    rule=ev.rule,
+                    detail=ev.detail,
+                )
+            )
+        rule_def = rules.get(ev.rule)
+        member = target.members.get(ev.rule)
+        if member is None:
+            member = target.members[ev.rule] = IncidentMember(
+                rule=ev.rule,
+                severity=ev.severity,
+                runbook=getattr(rule_def, "runbook", "") if rule_def else "",
+                onset_unix=ev.ts_unix,
+                onset_mono=now,
+                depth=self._depth(family(ev.rule)),
+            )
+            if len(target.members) > 1:
+                # The open event already tells the first member's story.
+                out.append(
+                    IncidentEvent(
+                        incident=target.id,
+                        state=MEMBER,
+                        rule=ev.rule,
+                        detail=ev.detail,
+                    )
+                )
+        member.state = "firing"
+        member.value = ev.value
+        member.detail = ev.detail
+        member.labels = labels
+        target.merge_labels(labels)
+        target.last_attach_mono = now
+        self._timeline_add(
+            target,
+            key=("alert", ev.rule, ev.seq),
+            ts_unix=ev.ts_unix,
+            source="alert",
+            endpoint="",
+            what=f"{ev.rule} {ev.prev_state} -> firing: {ev.detail}",
+        )
+        if target not in refresh:
+            refresh.append(target)
+
+    def _on_quiet(self, ev, now) -> None:
+        for inc in self._active:
+            member = inc.members.get(ev.rule)
+            if member is None:
+                continue
+            member.state = ev.state
+            if ev.state == "resolved":
+                member.value = ev.value
+                member.detail = ev.detail
+            self._timeline_add(
+                inc,
+                key=("alert", ev.rule, ev.seq),
+                ts_unix=ev.ts_unix,
+                source="alert",
+                endpoint="",
+                what=(
+                    f"{ev.rule} {ev.prev_state} -> {ev.state}"
+                    + (f": {ev.detail}" if ev.detail else "")
+                ),
+            )
+
+    def _advance_lifecycle(self, now: float, out) -> None:
+        still_active: "list[Incident]" = []
+        for inc in self._active:
+            quiet = all(
+                m.state in ("resolved", "ok") for m in inc.members.values()
+            )
+            if inc.state == OPEN and quiet and inc.members:
+                inc.state = MITIGATED
+                inc.mitigated_mono = now
+                out.append(
+                    IncidentEvent(
+                        incident=inc.id,
+                        state=MITIGATED,
+                        detail=f"all {len(inc.members)} member rule(s) quiet",
+                    )
+                )
+            if (
+                inc.state == MITIGATED
+                and now - inc.mitigated_mono >= self.resolve_hold_s
+            ):
+                inc.state = RESOLVED
+                inc.resolved_mono = now
+                self._closed.append(inc)
+                out.append(
+                    IncidentEvent(
+                        incident=inc.id,
+                        state=RESOLVED,
+                        detail=(
+                            f"held quiet {self.resolve_hold_s:g}s after "
+                            "mitigation"
+                        ),
+                    )
+                )
+                continue
+            still_active.append(inc)
+        self._active = still_active
+
+    # -- evidence -------------------------------------------------------------
+
+    def _fetch_evidence(self, inc: Incident, view) -> "dict[str, list[dict]]":
+        """Pull the evidence planes this incident's member families make
+        relevant, through the view's per-round-memoized fan-ins.  Runs
+        outside the engine lock (network I/O); each fetch is best-effort
+        — a missing capability degrades that plane to empty."""
+        with self._lock:
+            fams = {family(r) for r in inc.members}
+            classes = sorted(
+                {v for v in inc.labels.get("class", ())}
+            )
+        out: "dict[str, list[dict]]" = {}
+        limit = self.evidence_limit
+        # Evictions/preemptions are core evidence for every control
+        # -plane incident family; a pure serving incident (KV planes
+        # only) skips the controller fetch.
+        def decisions_plane():
+            rows = []
+            for doc in view.fetch_decisions(limit=limit) or []:
+                for rec in doc.get("decisions", []):
+                    if rec.get("verdict") != "evicted":
+                        continue
+                    row = dict(rec)
+                    row["endpoint"] = doc.get("endpoint", "")
+                    rows.append(row)
+            return rows[-limit:]
+
+        def capacity_plane():
+            rows = []
+            for doc in view.fetch_capacity(limit=limit) or []:
+                for rec in doc.get("claims", []):
+                    if not rec.get("stranded_now"):
+                        continue
+                    row = {
+                        k: rec.get(k)
+                        for k in (
+                            "claim", "claim_uid", "node", "chips",
+                            "stranded_chip_s",
+                        )
+                    }
+                    row["endpoint"] = doc.get("endpoint", "")
+                    rows.append(row)
+            return rows[:limit]
+
+        def requests_plane():
+            rows = []
+            for cls in classes or [None]:
+                docs = view.fetch_requests(
+                    cls=None if cls is None else int(cls), limit=limit
+                ) or []
+                for doc in docs:
+                    for rec in doc.get("requests", []):
+                        row = {
+                            k: rec.get(k)
+                            for k in (
+                                "request", "class", "trace_id", "ts_unix",
+                                "total_s", "ttft_s", "tpot_s", "slo",
+                            )
+                        }
+                        row["endpoint"] = doc.get("endpoint", "")
+                        rows.append(row)
+            # Worst-K waterfalls by end-to-end latency: the trace
+            # exemplars an operator opens first.
+            rows.sort(key=lambda r: r.get("total_s") or 0.0, reverse=True)
+            return rows[: self.worst_k_requests]
+
+        def kv_plane():
+            return [
+                {
+                    "engine": doc.get("engine", ""),
+                    "endpoint": doc.get("endpoint", ""),
+                    "free_blocks": doc.get("blocks_free"),
+                    "allocated_blocks": doc.get("blocks_allocated"),
+                    "swaps_in": doc.get("swap_in_blocks_total"),
+                    "swaps_out": doc.get("swap_out_blocks_total"),
+                }
+                for doc in view.fetch_kv() or []
+            ][:limit]
+
+        # Evidence is best-effort PER PLANE: a malformed document (or a
+        # capability dropped mid-fetch) degrades that plane to empty —
+        # it never poisons the scrape round or the sibling planes.
+        if fams & {
+            "ScrapeDown", "ClaimEvictionSpike", "StrandedCapacity",
+            "PreemptionChurn", "NodeFragmentation",
+        }:
+            out["decisions"] = self._safe(decisions_plane)
+        if fams & {
+            "StrandedCapacity", "NodeFragmentation", "ClaimEvictionSpike",
+            "ScrapeDown",
+        }:
+            out["capacity"] = self._safe(capacity_plane)
+        if "SLOClassBurn" in fams:
+            out["requests"] = self._safe(requests_plane)
+        if fams & {"KVPoolPressure", "KVSwapThrash"}:
+            out["kv"] = self._safe(kv_plane)
+        return out
+
+    @staticmethod
+    def _safe(fetch) -> list:
+        try:
+            return fetch() or []
+        except Exception:
+            return []
+
+    def _apply_evidence(
+        self, inc: Incident, evidence: "dict[str, list[dict]]", now: float
+    ) -> None:
+        """Write a fetched evidence set back under the lock: enrich the
+        incident labels (decision records name the dead node), fold the
+        stamped items into the timeline, and re-rank."""
+        inc.evidence = evidence
+        nodes = inc.labels.setdefault("node", [])
+        for rec in evidence.get("decisions", ()):
+            node = rec.get("node")
+            if node and node not in nodes:
+                nodes.append(node)
+            self._timeline_add(
+                inc,
+                key=("decision", rec.get("endpoint"), rec.get("seq")),
+                ts_unix=rec.get("ts_unix", 0.0),
+                source="decision",
+                endpoint=rec.get("endpoint", ""),
+                what=(
+                    f"claim {rec.get('claim') or rec.get('claim_uid')} "
+                    f"evicted from {rec.get('node')} "
+                    f"({rec.get('reason')})"
+                ),
+            )
+        for rec in evidence.get("capacity", ()):
+            node = rec.get("node")
+            if node and node not in nodes:
+                nodes.append(node)
+            self._timeline_add(
+                inc,
+                key=(
+                    "capacity", rec.get("endpoint"), rec.get("claim_uid"),
+                ),
+                ts_unix=0.0,  # stamped at first observation
+                source="capacity",
+                endpoint=rec.get("endpoint", ""),
+                what=(
+                    f"claim {rec.get('claim') or rec.get('claim_uid')} "
+                    f"stranded on {rec.get('node') or '-'} "
+                    f"({rec.get('chips')} chips, "
+                    f"{rec.get('stranded_chip_s') or 0.0:.1f} "
+                    "stranded chip-s)"
+                ),
+            )
+        if not nodes:
+            del inc.labels["node"]
+        for rec in evidence.get("requests", ()):
+            self._timeline_add(
+                inc,
+                key=("request", rec.get("endpoint"), rec.get("trace_id")),
+                ts_unix=rec.get("ts_unix", 0.0),
+                source="request",
+                endpoint=rec.get("endpoint", ""),
+                what=(
+                    f"request {rec.get('request')} class "
+                    f"{rec.get('class')} total "
+                    f"{rec.get('total_s') or 0.0:.3f}s ttft "
+                    f"{rec.get('ttft_s') or 0.0:.3f}s slo "
+                    f"{rec.get('slo') or '-'} trace {rec.get('trace_id')}"
+                ),
+            )
+        for rec in evidence.get("kv", ()):
+            self._timeline_add(
+                inc,
+                key=("kv", rec.get("endpoint"), rec.get("engine")),
+                ts_unix=0.0,
+                source="kv",
+                endpoint=rec.get("endpoint", ""),
+                what=(
+                    f"engine {rec.get('engine')}: free blocks "
+                    f"{rec.get('free_blocks')}, allocated "
+                    f"{rec.get('allocated_blocks')}, swaps in/out "
+                    f"{rec.get('swaps_in')}/{rec.get('swaps_out')}"
+                ),
+            )
+        self._rebuild(inc, now)
+
+    # -- timeline + verdict ---------------------------------------------------
+
+    def _timeline_add(
+        self,
+        inc: Incident,
+        *,
+        key: tuple,
+        ts_unix: float,
+        source: str,
+        endpoint: str,
+        what: str,
+    ) -> None:
+        """Idempotent timeline insert: an item keeps the display stamp
+        of its FIRST observation (evidence re-fetches must not reorder
+        history), deduped on its source key."""
+        if key in inc.first_seen:
+            return
+        stamp = ts_unix or time.time()  # noqa: A201 — display stamp, not a duration
+        inc.first_seen[key] = stamp
+        inc.timeline.append(
+            {
+                "ts_unix": stamp,
+                "source": source,
+                "endpoint": endpoint,
+                "what": what,
+            }
+        )
+
+    def _rebuild(self, inc: Incident, now: float) -> None:
+        """Re-sort the merged timeline (display stamps, causally stable
+        under the idempotent-insert discipline) and recompute the ranked
+        verdict.  Caller holds the lock."""
+        del now  # symmetry with the other fold hooks
+        inc.timeline.sort(key=lambda t: t["ts_unix"])
+        ranked = sorted(
+            inc.members.values(),
+            key=lambda m: (m.depth, m.onset_mono, m.rule),
+        )
+        if not ranked:
+            return
+        inc.root_rule = ranked[0].rule
+        inc.root_cause = " → ".join(
+            self._phrase(inc, m) for m in ranked
+        )
+
+    def _phrase(self, inc: Incident, member: IncidentMember) -> str:
+        """One ranked member's clause of the verdict line, preferring
+        the attached evidence's entity names over the rule detail."""
+        fam = family(member.rule)
+        if fam == "ScrapeDown":
+            not_ready = sorted(
+                {
+                    r.get("node")
+                    for r in inc.evidence.get("decisions", ())
+                    if r.get("reason") == "NodeNotReady" and r.get("node")
+                }
+            )
+            if not_ready:
+                return f"{','.join(not_ready)} NotReady"
+            eps = member.labels.get("endpoint", ())
+            return (
+                f"{','.join(eps)} down" if eps else "scrape target down"
+            )
+        if fam == "ClaimEvictionSpike":
+            evictions = len(inc.evidence.get("decisions", ()))
+            if evictions:
+                return f"{evictions} eviction(s)"
+            return "eviction spike"
+        if fam == "StrandedCapacity":
+            chip_s = sum(
+                r.get("stranded_chip_s") or 0.0
+                for r in inc.evidence.get("capacity", ())
+            )
+            if chip_s > 0:
+                return f"{chip_s:.0f} stranded chip-s"
+            return f"{member.value:.0f} stranded chip(s)"
+        if fam == "SLOClassBurn":
+            cls = member.labels.get("class", ["?"])[0]
+            return f"class-{cls} SLO burn"
+        if fam == "PreemptionChurn":
+            return "preemption churn"
+        if fam == "KVPoolPressure":
+            return "KV pool starved"
+        if fam == "KVSwapThrash":
+            return "KV swap thrash"
+        if fam == "NodeFragmentation":
+            nodes = member.labels.get("node", ())
+            return (
+                f"{','.join(nodes)} fragmented" if nodes else "fragmentation"
+            )
+        return member.rule
+
+    # -- read side ------------------------------------------------------------
+
+    def open_count(self) -> int:
+        """Incidents currently open or mitigated (held, not yet
+        resolved) — the ``tpu_dra_obs_incident_open`` sample."""
+        with self._lock:
+            return len(self._active)
+
+    def set_snapshot(self, incident_id: str, path: str) -> None:
+        """Tag an incident with its post-mortem snapshot directory (the
+        collector writes exactly one at open)."""
+        with self._lock:
+            for inc in self._active:
+                if inc.id == incident_id:
+                    inc.snapshot = path
+                    return
+
+    def query(
+        self,
+        *,
+        id: "str | None" = None,
+        node: "str | None" = None,
+        rule: "str | None" = None,
+        limit: int = 64,
+        now_mono: "float | None" = None,
+    ) -> "list[dict]":
+        """Incident documents, active first then newest-resolved,
+        filtered; ``limit`` caps the result."""
+        with self._lock:
+            incidents = list(self._active) + list(reversed(self._closed))
+            rows = [i.to_dict(now_mono) for i in incidents]
+        if id:
+            rows = [r for r in rows if r["id"] == id]
+        if node:
+            rows = [
+                r for r in rows if node in r["labels"].get("node", ())
+                or node in r["labels"].get("endpoint", ())
+            ]
+        if rule:
+            rows = [
+                r
+                for r in rows
+                if any(m["rule"] == rule for m in r["members"])
+            ]
+        return rows[:limit]
+
+
+# -- the /debug/incidents document --------------------------------------------
+
+
+def incidents_doc(
+    engine: "IncidentEngine | None",
+    *,
+    id: "str | None" = None,
+    node: "str | None" = None,
+    rule: "str | None" = None,
+    limit: int = 64,
+    now_mono: "float | None" = None,
+) -> dict:
+    """The ``/debug/incidents`` JSON document (filters mirror the query
+    parameters; ``render_text`` consumes exactly this shape).  ``id=``
+    switches the rendering to the full detail form — members, merged
+    timeline, evidence — for the matched incident(s)."""
+    recorder = engine.recorder if engine is not None else RECORDER
+    rows = (
+        engine.query(id=id, node=node, rule=rule, limit=limit, now_mono=now_mono)
+        if engine is not None
+        else []
+    )
+    return {
+        "incidents": rows,
+        "open": engine.open_count() if engine is not None else 0,
+        "count": len(rows),
+        "detail": bool(id),
+        "events": [
+            e.to_dict() for e in recorder.query(incident=id or None, limit=limit)
+        ],
+        "recorded": recorder.recorded,
+        "dropped": recorder.dropped,
+    }
+
+
+def _render_detail(inc: dict, out: "list[str]") -> None:
+    """The full-incident body (the ``id=`` / ``tpudra incident`` form)."""
+    out.append(
+        f"incident {inc['id']}: {inc['state']}, age {inc['age_s']:.1f}s, "
+        f"{len(inc['members'])} member rule(s)"
+    )
+    out.append(f"  root cause: {inc['root_cause'] or '-'}")
+    if inc.get("snapshot"):
+        out.append(f"  snapshot: {inc['snapshot']}")
+    labels = inc.get("labels", {})
+    if labels:
+        out.append(
+            "  labels: "
+            + "; ".join(
+                f"{dim}={','.join(values)}"
+                for dim, values in sorted(labels.items())
+            )
+        )
+    out.append(
+        f"  {'member rule':<26} {'state':<9} {'sev':<5} {'depth':>5} "
+        f"{'value':>10} runbook"
+    )
+    for m in inc["members"]:
+        root = "*" if m["rule"] == inc["root_rule"] else " "
+        out.append(
+            f" {root}{m['rule']:<26} {m['state']:<9} {m['severity']:<5} "
+            f"{m['depth']:>5} {m['value']:>10.3f} {m['runbook'] or '-'}"
+        )
+    timeline = inc.get("timeline", [])
+    if timeline:
+        out.append("  timeline:")
+        t0 = timeline[0]["ts_unix"]
+        for t in timeline:
+            out.append(
+                f"    +{t['ts_unix'] - t0:8.3f}s {t['source']:<9} "
+                f"{(t['endpoint'] or '-'):<18} {t['what']}"
+            )
+    for plane in ("decisions", "capacity", "requests", "kv"):
+        rows = inc.get("evidence", {}).get(plane)
+        if rows:
+            out.append(f"  evidence/{plane}: {len(rows)} record(s)")
+
+
+def render_text(doc: dict) -> str:
+    """Plain-text form of the document
+    (``/debug/incidents?format=text`` and ``tpudra incidents`` render
+    this byte-identically).  With an ``id=`` filter the document carries
+    ``detail`` and each matched incident renders in full."""
+    out = [
+        f"incidents: {doc['open']} open, {doc['count']} shown "
+        f"({doc['recorded']} lifecycle event(s) recorded)"
+    ]
+    if doc.get("detail"):
+        for inc in doc["incidents"]:
+            _render_detail(inc, out)
+        if not doc["incidents"]:
+            out.append("(no incident matched the filter)")
+    else:
+        if doc["incidents"]:
+            out.append(
+                f"  {'id':<10} {'state':<10} {'members':>7} {'age_s':>8} "
+                "root cause"
+            )
+            for inc in doc["incidents"]:
+                out.append(
+                    f"  {inc['id']:<10} {inc['state']:<10} "
+                    f"{len(inc['members']):>7} {inc['age_s']:>8.1f} "
+                    f"{inc['root_cause'] or '-'}"
+                )
+        else:
+            out.append("  (no incidents recorded)")
+    events = doc.get("events", [])
+    if events:
+        out.append("transitions:")
+        for e in events:
+            out.append(
+                f"  #{e['seq']:<5} {e['incident']:<10} {e['state']:<10} "
+                f"{e['rule'] or '-':<26} {e['detail']}"
+            )
+    if doc.get("dropped"):
+        out.append(
+            f"(incident recorder wrapped: {doc['dropped']} older "
+            "event(s) dropped)"
+        )
+    return "\n".join(out) + "\n"

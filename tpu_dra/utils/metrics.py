@@ -846,6 +846,23 @@ def debug_index(server: "MetricsServer") -> dict:
     )
     if cluster is not None and cluster.get("active"):
         endpoints[f"{pprof}/cluster"] = cluster
+        # The incident pane rides with the collector: no collector, no
+        # incident engine to serve.
+        incidents = _ring_info(
+            "tpu_dra.obs.collector",
+            lambda m: {
+                "kind": "incidents",
+                "open": m.ACTIVE.incidents.open_count() if m.ACTIVE else 0,
+                "recorded": m.ACTIVE.incidents.recorder.recorded
+                if m.ACTIVE
+                else 0,
+                "dropped": m.ACTIVE.incidents.recorder.dropped
+                if m.ACTIVE
+                else 0,
+            },
+        )
+        if incidents is not None:
+            endpoints[f"{pprof}/incidents"] = incidents
     component = _ring_info("tpu_dra.utils.trace", lambda m: m._COMPONENT)
     from tpu_dra.version import version_string
 
@@ -919,6 +936,8 @@ class MetricsServer:
                         self._send_fleet(parse_qs(parsed.query))
                     elif parsed.path == f"{outer.pprof_path}/cluster":
                         self._send_cluster(parse_qs(parsed.query))
+                    elif parsed.path == f"{outer.pprof_path}/incidents":
+                        self._send_incidents(parse_qs(parsed.query))
                     else:
                         self._send(404, "not found\n")
                 except _BadQuery as e:
@@ -1238,6 +1257,33 @@ class MetricsServer:
                     self._send(200, obscluster.render_text(doc))
                 elif fmt == "alerts":
                     self._send(200, obscluster.render_alerts_text(doc))
+                else:
+                    import json
+
+                    self._send(200, json.dumps(doc), "application/json")
+
+            def _send_incidents(self, query: dict) -> None:
+                # Local import, like its siblings — obs is jax-free by
+                # design, so any binary can host the incident pane.
+                from tpu_dra.obs import collector as obscollector
+                from tpu_dra.obs import incidents as obsincidents
+
+                limit = _query_int(query, "limit", 64, cap=4096)
+                fmt = query.get("format", ["json"])[0]
+                if fmt not in ("json", "text"):
+                    raise _BadQuery(
+                        f"format must be json or text, got {fmt!r}"
+                    )
+                active = obscollector.ACTIVE
+                doc = obsincidents.incidents_doc(
+                    active.incidents if active is not None else None,
+                    id=query.get("id", [""])[0] or None,
+                    node=query.get("node", [""])[0] or None,
+                    rule=query.get("rule", [""])[0] or None,
+                    limit=limit,
+                )
+                if fmt == "text":
+                    self._send(200, obsincidents.render_text(doc))
                 else:
                     import json
 
